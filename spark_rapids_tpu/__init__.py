@@ -1,0 +1,42 @@
+"""spark_rapids_tpu — a TPU-native accelerator for Spark-style columnar SQL execution.
+
+A brand-new framework with the capabilities of the RAPIDS Accelerator for Apache Spark
+(reference: /root/reference, NVIDIA spark-rapids v0.6.0-SNAPSHOT), re-designed TPU-first:
+
+- Columnar kernels are jax.jit'd XLA programs (+ Pallas for irregular ops) instead of
+  libcudf CUDA kernels (reference L0, SURVEY.md §1).
+- Device batches are padded JAX arrays with validity masks; row counts are device scalars
+  so one compiled kernel serves a whole bucket of batch sizes (XLA static-shape regime).
+- Memory runtime is an HBM budget + tiered spill (device→host→disk) in place of RMM
+  (reference GpuDeviceManager.scala / RapidsBufferCatalog.scala).
+- The shuffle data plane is ICI collectives (all_to_all under shard_map) intra-slice with
+  a host/TCP transport fallback, in place of UCX RDMA (reference shuffle-plugin).
+- Whole-stage fusion: pipelines of project/filter/aggregate are traced into ONE XLA
+  program per stage, which beats the reference's per-op kernel-launch model on TPU.
+
+Layout mirrors the reference's layer map (SURVEY.md §1):
+  config.py            — RapidsConf analog (reference RapidsConf.scala)
+  types.py             — Spark SQL type system
+  columnar/            — L2 columnar batch representation (GpuColumnVector.java analog)
+  ops/                 — L0 kernel library (libcudf analog, jax/XLA/Pallas)
+  plan/                — L3 planner/override layer (GpuOverrides/RapidsMeta/TypeChecks)
+  exec/                — L4 physical operators (GpuExec layer)
+  io/                  — L5 Parquet/ORC/CSV readers+writers
+  shuffle/             — L6 partitioning, shuffle manager, transports
+  runtime/             — L1 device & memory runtime (semaphore, spill, metrics, tracing)
+  udf/                 — L7 UDF compiler + pandas UDF runtime
+  ml/                  — L7 zero-copy ML export (ColumnarRdd analog)
+"""
+
+import jax as _jax
+
+# Spark semantics require LongType/DoubleType (64-bit). Verified supported on TPU v5e.
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from spark_rapids_tpu.config import RapidsConf  # noqa: E402,F401
+from spark_rapids_tpu.types import (  # noqa: E402,F401
+    BooleanType, ByteType, ShortType, IntegerType, LongType, FloatType, DoubleType,
+    StringType, DateType, TimestampType, DecimalType, NullType, DataType,
+)

@@ -1,0 +1,5 @@
+from spark_rapids_tpu.columnar.vector import (  # noqa: F401
+    TpuColumnVector, bucket_capacity,
+)
+from spark_rapids_tpu.columnar.batch import ColumnarBatch  # noqa: F401
+from spark_rapids_tpu.columnar import arrow as arrow_interop  # noqa: F401

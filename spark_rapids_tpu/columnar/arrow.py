@@ -1,0 +1,93 @@
+"""Arrow ↔ device conversion — the HostColumnarToGpu / arrow-import analog.
+
+Reference: GpuColumnVector.from(ArrowColumnVector) and HostColumnarToGpu.scala:249
+copy Arrow buffers into cudf device columns. Here pyarrow is the host columnar layer:
+fixed-width buffers go to device as padded jax arrays; strings are dictionary-encoded
+with an order-preserving (sorted) dictionary so device code-compares equal string
+compares; decimals (p<=18) travel as scaled int64.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.vector import TpuColumnVector, bucket_capacity
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+
+
+def _validity_of(arr: pa.Array) -> np.ndarray:
+    return pc.is_valid(arr).to_numpy(zero_copy_only=False)
+
+
+def _decimal_unscaled_int64(arr: pa.Array) -> np.ndarray:
+    """Low 64 bits of the two's-complement decimal128 storage; exact for p<=18."""
+    buf = arr.buffers()[1]
+    words = np.frombuffer(buf, dtype=np.int64)
+    off = arr.offset
+    return words[off * 2:(off + len(arr)) * 2:2].copy()
+
+
+def string_array_to_device(arr, capacity: int | None = None) -> TpuColumnVector:
+    """Dictionary-encode a string array with a sorted dictionary, codes to device."""
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    if pa.types.is_dictionary(arr.type):
+        dict_vals, codes_arr = arr.dictionary, arr.indices
+    else:
+        enc = pc.dictionary_encode(arr.cast(pa.string()))
+        dict_vals, codes_arr = enc.dictionary, enc.indices
+    dict_vals = dict_vals.cast(pa.string())
+    validity = _validity_of(arr)
+    codes = codes_arr.fill_null(0).to_numpy(zero_copy_only=False).astype(np.int32)
+    if len(dict_vals):
+        order = pc.array_sort_indices(dict_vals)
+        sorted_dict = dict_vals.take(order)
+        rank = np.empty(len(dict_vals), dtype=np.int32)
+        rank[order.to_numpy(zero_copy_only=False)] = np.arange(len(dict_vals), dtype=np.int32)
+        codes = rank[codes]
+    else:
+        sorted_dict = dict_vals
+    codes[~validity] = 0
+    cv = TpuColumnVector.from_numpy(T.STRING, codes, validity, capacity)
+    return cv.with_dictionary(sorted_dict)
+
+
+def array_to_device(arr, dtype: T.DataType | None = None,
+                    capacity: int | None = None) -> TpuColumnVector:
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    dtype = dtype or T.from_arrow_type(arr.type)
+    if isinstance(dtype, T.StringType):
+        return string_array_to_device(arr, capacity)
+    validity = _validity_of(arr)
+    if isinstance(dtype, T.DecimalType):
+        vals = _decimal_unscaled_int64(arr)
+    elif isinstance(dtype, T.DateType):
+        vals = arr.cast(pa.int32()).fill_null(0).to_numpy(zero_copy_only=False)
+    elif isinstance(dtype, T.TimestampType):
+        vals = arr.cast(pa.int64()).fill_null(0).to_numpy(zero_copy_only=False)
+    elif isinstance(dtype, T.NullType):
+        vals = np.zeros(len(arr), dtype=np.int8)
+        validity = np.zeros(len(arr), dtype=bool)
+    else:
+        np_dt = T.to_numpy_dtype(dtype)
+        vals = arr.fill_null(dtype.default_value()).to_numpy(
+            zero_copy_only=False).astype(np_dt, copy=False)
+    return TpuColumnVector.from_numpy(dtype, vals, validity, capacity)
+
+
+def table_to_device(table, schema: T.StructType | None = None,
+                    capacity: int | None = None) -> ColumnarBatch:
+    if isinstance(table, pa.RecordBatch):
+        table = pa.Table.from_batches([table])
+    if schema is None:
+        schema = T.StructType.from_arrow(table.schema)
+    n = table.num_rows
+    cap = capacity or bucket_capacity(n)
+    cols = [array_to_device(table.column(i), schema[i].data_type, cap)
+            for i in range(table.num_columns)]
+    return ColumnarBatch(cols, n, schema)

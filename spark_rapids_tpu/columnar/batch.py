@@ -1,0 +1,80 @@
+"""ColumnarBatch — a set of device columns plus a row count.
+
+Reference: Spark's ColumnarBatch wrapped by GpuColumnVector.from(Table)
+(GpuColumnVector.java). TPU twist: ``num_rows`` may be a *device scalar* while a fused
+XLA stage is in flight (e.g. a filter's surviving-row count), and is only synced to a
+host int at stage boundaries — cudf syncs after every kernel, we sync once per stage.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.vector import TpuColumnVector, bucket_capacity
+
+
+class ColumnarBatch:
+    __slots__ = ("columns", "_num_rows", "schema")
+
+    def __init__(self, columns, num_rows, schema: T.StructType | None = None):
+        self.columns = list(columns)
+        self._num_rows = num_rows
+        self.schema = schema
+        if self.columns:
+            cap = self.columns[0].capacity
+            assert all(c.capacity == cap for c in self.columns), \
+                "all columns in a batch must share one padded capacity"
+
+    @property
+    def num_rows(self) -> int:
+        """Host row count; forces a device sync if the count is still a device scalar."""
+        if not isinstance(self._num_rows, int):
+            self._num_rows = int(self._num_rows)
+        return self._num_rows
+
+    @property
+    def lazy_num_rows(self):
+        """Row count without forcing a sync (may be a jax scalar)."""
+        return self._num_rows
+
+    @property
+    def num_cols(self) -> int:
+        return len(self.columns)
+
+    @property
+    def capacity(self) -> int:
+        return self.columns[0].capacity if self.columns else bucket_capacity(self.num_rows)
+
+    def column(self, i: int) -> TpuColumnVector:
+        return self.columns[i]
+
+    def device_memory_size(self) -> int:
+        return sum(c.device_memory_size() for c in self.columns)
+
+    def with_columns(self, columns, schema=None):
+        return ColumnarBatch(columns, self._num_rows, schema or self.schema)
+
+    # -- host interop -------------------------------------------------------
+    def to_arrow(self):
+        import pyarrow as pa
+        n = self.num_rows
+        names = (self.schema.names if self.schema is not None
+                 else [f"c{i}" for i in range(self.num_cols)])
+        return pa.table({name: col.to_arrow(n) for name, col in zip(names, self.columns)})
+
+    @staticmethod
+    def from_arrow(table, schema: T.StructType | None = None) -> "ColumnarBatch":
+        from spark_rapids_tpu.columnar import arrow as ai
+        return ai.table_to_device(table, schema=schema)
+
+    @staticmethod
+    def empty(schema: T.StructType) -> "ColumnarBatch":
+        cap = bucket_capacity(0)
+        cols = [TpuColumnVector.all_null(f.data_type, cap) for f in schema]
+        return ColumnarBatch(cols, 0, schema)
+
+    def __repr__(self):
+        n = self._num_rows if isinstance(self._num_rows, int) else "<device>"
+        return f"ColumnarBatch(rows={n}, cols={self.num_cols}, cap={self.capacity})"

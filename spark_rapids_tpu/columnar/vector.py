@@ -1,0 +1,177 @@
+"""Device column vectors — the GpuColumnVector analog, TPU-first.
+
+Reference: sql-plugin/src/main/java/com/nvidia/spark/rapids/GpuColumnVector.java (1033
+LoC) adapts cudf device columns to Spark ColumnarBatch. Here a column is:
+
+- ``data``: a padded 1-D jax array on the accelerator. Capacities are bucketed to powers
+  of two so a single jit-compiled kernel serves every batch in the bucket (XLA's
+  static-shape regime — cudf has dynamic sizes, XLA must not).
+- ``validity``: a padded bool jax array; padded tail slots are always invalid. Invalid
+  slots hold the type's canonical default value so padding never perturbs hashes, sorts,
+  or reductions (cudf instead carries a bit mask into every kernel).
+- strings: ``data`` holds int32 codes into a **host-side sorted dictionary** (pyarrow
+  StringArray). Codes are order-preserving (dictionary sorted at encode time), so device
+  comparisons over codes ARE string comparisons; per-entry murmur3 hashes are computed
+  once per dictionary so hash partitioning of strings also stays on device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from spark_rapids_tpu import types as T
+
+_MIN_CAPACITY = 8
+
+
+def bucket_capacity(n: int) -> int:
+    """Smallest power-of-two capacity >= n (>= 8). Bounds the jit compile-cache."""
+    cap = _MIN_CAPACITY
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+def _sorted_dictionary(values: pa.Array):
+    """Sort + dedup a string dictionary; returns (sorted_dict, old_code→new_code map)."""
+    order = pc.array_sort_indices(values)
+    sorted_vals = values.take(order)
+    rank = np.empty(len(values), dtype=np.int32)
+    rank[order.to_numpy(zero_copy_only=False)] = np.arange(len(values), dtype=np.int32)
+    return sorted_vals, rank
+
+
+class TpuColumnVector:
+    """One device column. Immutable once built (functional style, unlike cudf's
+    refcounted mutable columns — XLA arrays are immutable so RAII shrinks to buffer
+    accounting, see runtime/arm.py)."""
+
+    __slots__ = ("dtype", "data", "validity", "dictionary", "_dict_device")
+
+    def __init__(self, dtype: T.DataType, data, validity, dictionary: pa.Array | None = None,
+                 dict_device=None):
+        self.dtype = dtype
+        self.data = data
+        self.validity = validity
+        self.dictionary = dictionary  # host pyarrow StringArray, sorted, for StringType
+        # lazy (words int32 (D,W), lengths int32 (D,)) device packing of the dictionary's
+        # UTF-8 bytes, shared by hashing and byte-level string kernels
+        self._dict_device = dict_device
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def from_numpy(dtype: T.DataType, values: np.ndarray, validity: np.ndarray | None = None,
+                   capacity: int | None = None, dictionary: pa.Array | None = None):
+        n = len(values)
+        cap = capacity or bucket_capacity(n)
+        np_dt = T.to_numpy_dtype(dtype)
+        data = np.zeros(cap, dtype=np_dt)
+        data[:n] = values
+        valid = np.zeros(cap, dtype=bool)
+        if validity is None:
+            valid[:n] = True
+        else:
+            valid[:n] = validity
+            # canonicalize nulls so padded/invalid slots are deterministic
+            data[~valid] = dtype.default_value()
+        return TpuColumnVector(dtype, jnp.asarray(data), jnp.asarray(valid), dictionary)
+
+    @staticmethod
+    def from_pylist(dtype: T.DataType, values, capacity: int | None = None):
+        """Convenience for tests: None entries become nulls."""
+        if isinstance(dtype, T.StringType):
+            arr = pa.array(values, type=pa.string())
+            from spark_rapids_tpu.columnar import arrow as ai
+            return ai.string_array_to_device(arr, capacity=capacity)
+        validity = np.array([v is not None for v in values], dtype=bool)
+        np_dt = T.to_numpy_dtype(dtype)
+        vals = np.array([v if v is not None else dtype.default_value() for v in values],
+                        dtype=np_dt)
+        return TpuColumnVector.from_numpy(dtype, vals, validity, capacity)
+
+    @staticmethod
+    def all_null(dtype: T.DataType, capacity: int):
+        data = jnp.full((capacity,), dtype.default_value(), dtype=dtype.jnp_dtype)
+        return TpuColumnVector(dtype, data, jnp.zeros((capacity,), dtype=jnp.bool_))
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return int(self.data.shape[0])
+
+    @property
+    def is_string(self) -> bool:
+        return isinstance(self.dtype, T.StringType)
+
+    def device_memory_size(self) -> int:
+        sz = self.data.nbytes + self.validity.nbytes
+        if self._dict_device is not None:
+            sz += sum(a.nbytes for a in self._dict_device)
+        return sz
+
+    # -- dictionary support -------------------------------------------------
+    def dictionary_words(self):
+        """Device packing of the dictionary's UTF-8 bytes as (words (D,W) int32,
+        lengths (D,) int32), built once per dictionary. Byte-level device kernels
+        (murmur3 with chained seeds, substring/length/like) gather rows from this
+        matrix by code — the on-TPU stand-in for cudf's string columns."""
+        if self._dict_device is None:
+            from spark_rapids_tpu.ops.hashing import pack_utf8_words
+            assert self.dictionary is not None
+            strs = self.dictionary.to_pylist()
+            words, lens = pack_utf8_words(strs)
+            if words.shape[0] == 0:
+                words = np.zeros((1, 1), dtype=np.int32)
+                lens = np.zeros(1, dtype=np.int32)
+            self._dict_device = (jnp.asarray(words), jnp.asarray(lens))
+        return self._dict_device
+
+    def with_dictionary(self, dictionary, data=None, validity=None):
+        return TpuColumnVector(self.dtype, self.data if data is None else data,
+                               self.validity if validity is None else validity,
+                               dictionary)
+
+    # -- host transfer ------------------------------------------------------
+    def to_host(self, num_rows: int):
+        """Copy the first num_rows to host numpy (values, validity)."""
+        return (np.asarray(self.data[:num_rows]), np.asarray(self.validity[:num_rows]))
+
+    def to_arrow(self, num_rows: int) -> pa.Array:
+        vals, valid = self.to_host(num_rows)
+        if self.is_string:
+            codes = pa.array(vals.astype(np.int32), type=pa.int32())
+            taken = self.dictionary.take(codes) if len(self.dictionary) else pa.nulls(
+                num_rows, pa.string())
+            return pc.if_else(pa.array(valid), taken, pa.nulls(num_rows, pa.string()))
+        if isinstance(self.dtype, T.DecimalType):
+            # rebuild decimal128 from scaled int64 (low word + sign extension)
+            words = np.zeros((num_rows, 2), dtype=np.int64)
+            words[:, 0] = vals
+            words[:, 1] = vals >> 63
+            buf = pa.py_buffer(words.tobytes())
+            mask = np.packbits(valid, bitorder="little")
+            arr = pa.Array.from_buffers(
+                pa.decimal128(self.dtype.precision, self.dtype.scale), num_rows,
+                [pa.py_buffer(mask.tobytes()), buf])
+            return arr
+        at = T.to_arrow_type(self.dtype)
+        arr = pa.array(vals, type=at if not isinstance(self.dtype, (T.DateType, T.TimestampType)) else None)
+        if isinstance(self.dtype, T.DateType):
+            arr = pa.array(vals.astype("int32")).cast(pa.date32())
+        elif isinstance(self.dtype, T.TimestampType):
+            arr = pa.array(vals.astype("int64")).cast(pa.timestamp("us", tz="UTC"))
+        if not valid.all():
+            arr = pc.if_else(pa.array(valid), arr, pa.nulls(num_rows, arr.type))
+        return arr
+
+    def block_until_ready(self):
+        jax.block_until_ready(self.data)
+        return self
+
+    def __repr__(self):
+        return (f"TpuColumnVector({self.dtype}, cap={self.capacity}"
+                f"{', dict=' + str(len(self.dictionary)) if self.dictionary is not None else ''})")

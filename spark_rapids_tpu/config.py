@@ -1,0 +1,340 @@
+"""Typed configuration registry — the RapidsConf analog.
+
+Reference: sql-plugin/src/main/scala/com/nvidia/spark/rapids/RapidsConf.scala:30,116,288
+(110 typed `spark.rapids.*` entries with docs/defaults/internal flags, byte-unit parsing,
+and markdown doc generation via `main`, RapidsConf.scala:1259). Same design here under the
+`spark.rapids.tpu.*` namespace: a ConfBuilder DSL registers ConfEntry objects; RapidsConf
+wraps a plain dict of overrides and resolves typed values; `python -m
+spark_rapids_tpu.config` regenerates docs/configs.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import typing
+
+_REGISTERED: "dict[str, ConfEntry]" = {}
+
+_BYTE_SUFFIXES = {
+    "b": 1, "k": 1 << 10, "kb": 1 << 10, "m": 1 << 20, "mb": 1 << 20,
+    "g": 1 << 30, "gb": 1 << 30, "t": 1 << 40, "tb": 1 << 40,
+}
+
+
+def parse_bytes(v) -> int:
+    """Parse '512m', '4g', plain ints — Spark byte-unit strings
+    (reference RapidsConf.scala byteConf entries)."""
+    if isinstance(v, (int, float)):
+        return int(v)
+    m = re.fullmatch(r"\s*(\d+)\s*([a-zA-Z]*)\s*", str(v))
+    if not m:
+        raise ValueError(f"cannot parse byte value {v!r}")
+    n, suf = int(m.group(1)), m.group(2).lower()
+    if suf and suf not in _BYTE_SUFFIXES:
+        raise ValueError(f"unknown byte suffix {suf!r} in {v!r}")
+    return n * _BYTE_SUFFIXES.get(suf, 1)
+
+
+def _parse_bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).strip().lower() in ("true", "1", "yes")
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfEntry:
+    key: str
+    doc: str
+    default: typing.Any
+    conv: typing.Callable
+    internal: bool = False
+
+    def get(self, settings: dict):
+        if self.key in settings:
+            return self.conv(settings[self.key])
+        return self.default
+
+
+class ConfBuilder:
+    """`conf("spark.rapids.tpu.x").doc(...).boolean_conf(default)` DSL
+    (reference RapidsConf.scala:288 ConfBuilder)."""
+
+    def __init__(self, key: str):
+        self._key = key
+        self._doc = ""
+        self._internal = False
+
+    def doc(self, d: str) -> "ConfBuilder":
+        self._doc = d
+        return self
+
+    def internal(self) -> "ConfBuilder":
+        self._internal = True
+        return self
+
+    def _register(self, default, conv) -> ConfEntry:
+        e = ConfEntry(self._key, self._doc, default, conv, self._internal)
+        if e.key in _REGISTERED:
+            raise ValueError(f"duplicate conf key {e.key}")
+        _REGISTERED[e.key] = e
+        return e
+
+    def boolean_conf(self, default: bool) -> ConfEntry:
+        return self._register(default, _parse_bool)
+
+    def integer_conf(self, default: int) -> ConfEntry:
+        return self._register(default, int)
+
+    def double_conf(self, default: float) -> ConfEntry:
+        return self._register(default, float)
+
+    def string_conf(self, default) -> ConfEntry:
+        return self._register(default, lambda v: v if v is None else str(v))
+
+    def bytes_conf(self, default) -> ConfEntry:
+        return self._register(parse_bytes(default), parse_bytes)
+
+
+def conf(key: str) -> ConfBuilder:
+    return ConfBuilder(key)
+
+
+# ---------------------------------------------------------------------------
+# Registry — mirrors the reference's main knobs (RapidsConf.scala:301-1139)
+# ---------------------------------------------------------------------------
+
+SQL_ENABLED = conf("spark.rapids.tpu.sql.enabled").doc(
+    "Enable TPU acceleration of SQL operators; when false every plan stays on CPU "
+    "(reference spark.rapids.sql.enabled)").boolean_conf(True)
+
+EXPLAIN = conf("spark.rapids.tpu.sql.explain").doc(
+    "NONE | ALL | NOT_ON_TPU — log why operators will / will not run on the TPU "
+    "(reference spark.rapids.sql.explain)").string_conf("NONE")
+
+BATCH_SIZE_BYTES = conf("spark.rapids.tpu.sql.batchSizeBytes").doc(
+    "Target size of output batches from coalescing and readers "
+    "(reference spark.rapids.sql.batchSizeBytes, RapidsConf.scala:411)"
+).bytes_conf("512m")
+
+MAX_READER_BATCH_SIZE_ROWS = conf("spark.rapids.tpu.sql.reader.batchSizeRows").doc(
+    "Soft cap on rows per reader batch (reference reader.batchSizeRows)"
+).integer_conf(2147483647)
+
+MAX_READER_BATCH_SIZE_BYTES = conf("spark.rapids.tpu.sql.reader.batchSizeBytes").doc(
+    "Soft cap on bytes per reader batch (reference reader.batchSizeBytes)"
+).bytes_conf("512m")
+
+CONCURRENT_TPU_TASKS = conf("spark.rapids.tpu.sql.concurrentTpuTasks").doc(
+    "Tasks admitted to the TPU concurrently via the semaphore "
+    "(reference spark.rapids.sql.concurrentGpuTasks, RapidsConf.scala:398)"
+).integer_conf(2)
+
+DEVICE_MEMORY_FRACTION = conf("spark.rapids.tpu.memory.hbm.allocFraction").doc(
+    "Fraction of HBM the pool budget may use "
+    "(reference spark.rapids.memory.gpu.allocFraction)").double_conf(0.9)
+
+DEVICE_MEMORY_LIMIT = conf("spark.rapids.tpu.memory.hbm.limitBytes").doc(
+    "Absolute HBM budget override; 0 = derive from allocFraction").bytes_conf(0)
+
+HOST_SPILL_STORAGE_SIZE = conf("spark.rapids.tpu.memory.host.spillStorageSize").doc(
+    "Bytes of host memory used for spilled device buffers before disk "
+    "(reference spark.rapids.memory.host.spillStorageSize)").bytes_conf("1g")
+
+SPILL_DIRS = conf("spark.rapids.tpu.memory.spill.dirs").doc(
+    "Comma-separated local dirs for the disk spill tier "
+    "(reference uses Spark local dirs, RapidsDiskStore.scala)").string_conf(None)
+
+UNSPILL_ENABLED = conf("spark.rapids.tpu.memory.hbm.unspill.enabled").doc(
+    "Re-promote spilled buffers back to HBM on access "
+    "(reference spark.rapids.memory.gpu.unspill.enabled)").boolean_conf(False)
+
+POOLED_MEMORY = conf("spark.rapids.tpu.memory.hbm.pooling.enabled").doc(
+    "Use the arena/bucket HBM pool allocator rather than raw device_put per buffer "
+    "(reference RMM pooling, GpuDeviceManager.scala:204)").boolean_conf(True)
+
+STABLE_SORT = conf("spark.rapids.tpu.sql.stableSort.enabled").doc(
+    "Force stable device sorts (reference spark.rapids.sql.stableSort.enabled)"
+).boolean_conf(False)
+
+HAS_NANS = conf("spark.rapids.tpu.sql.hasNans").doc(
+    "Assume floating point columns may hold NaNs, enabling Spark-exact NaN ordering "
+    "and equality (reference spark.rapids.sql.hasNans)").boolean_conf(True)
+
+IMPROVED_FLOAT_OPS = conf("spark.rapids.tpu.sql.improvedFloatOps.enabled").doc(
+    "Allow float aggregations whose ordering differs from CPU Spark "
+    "(reference spark.rapids.sql.variableFloatAgg.enabled)").boolean_conf(True)
+
+ENABLE_CAST_STRING_TO_FLOAT = conf("spark.rapids.tpu.sql.castStringToFloat.enabled").doc(
+    "Enable string→float casts which can differ in rounding from CPU "
+    "(reference spark.rapids.sql.castStringToFloat.enabled)").boolean_conf(False)
+
+DECIMAL_ENABLED = conf("spark.rapids.tpu.sql.decimalType.enabled").doc(
+    "Enable decimal(<=18) device execution (reference decimalType.enabled)"
+).boolean_conf(True)
+
+SHUFFLE_MANAGER_ENABLED = conf("spark.rapids.tpu.shuffle.enabled").doc(
+    "Use the catalog-backed accelerated shuffle instead of the serializing fallback "
+    "(reference RapidsShuffleManager wiring)").boolean_conf(True)
+
+SHUFFLE_TRANSPORT_CLASS = conf("spark.rapids.tpu.shuffle.transport.class").doc(
+    "Transport implementation classname for the P2P shuffle data plane "
+    "(reference spark.rapids.shuffle.transport.class, RapidsConf.scala:925)"
+).string_conf("spark_rapids_tpu.shuffle.transport.LocalTransport")
+
+SHUFFLE_COMPRESSION_CODEC = conf("spark.rapids.tpu.shuffle.compression.codec").doc(
+    "none | lz4 | copy — codec for shuffle buffers (reference "
+    "spark.rapids.shuffle.compression.codec over nvcomp; here a native C++ LZ4)"
+).string_conf("none")
+
+SHUFFLE_MAX_INFLIGHT_BYTES = conf(
+    "spark.rapids.tpu.shuffle.maxBytesInFlight").doc(
+    "Throttle on concurrently fetched shuffle bytes "
+    "(reference UCXShuffleTransport.scala:51-56)").bytes_conf("128m")
+
+SHUFFLE_BOUNCE_BUFFER_SIZE = conf("spark.rapids.tpu.shuffle.bounceBuffers.size").doc(
+    "Size of each staging (bounce) buffer used to window large transfers "
+    "(reference spark.rapids.shuffle.bounceBuffers.size, 4 MB default)").bytes_conf("4m")
+
+METRICS_LEVEL = conf("spark.rapids.tpu.sql.metrics.level").doc(
+    "ESSENTIAL | MODERATE | DEBUG (reference spark.rapids.sql.metrics.level, "
+    "RapidsConf.scala:465)").string_conf("MODERATE")
+
+TRACE_ENABLED = conf("spark.rapids.tpu.sql.trace.enabled").doc(
+    "Wrap hot regions in jax.profiler trace annotations (reference NVTX ranges, "
+    "NvtxWithMetrics.scala)").boolean_conf(False)
+
+CPU_FALLBACK_ENABLED = conf("spark.rapids.tpu.sql.cpuFallback.enabled").doc(
+    "Allow untagged operators to run via the host (pyarrow) fallback engine rather "
+    "than fail (the reference always retains Spark CPU execution)").boolean_conf(True)
+
+TEST_ENABLED = conf("spark.rapids.tpu.sql.test.enabled").doc(
+    "Fail if an operator unexpectedly falls back to CPU "
+    "(reference spark.rapids.sql.test.enabled, RapidsConf.scala:854)").internal(
+).boolean_conf(False)
+
+TEST_ALLOWED_NON_TPU = conf("spark.rapids.tpu.sql.test.allowedNonTpu").doc(
+    "Comma-separated operator class names allowed on CPU when test.enabled "
+    "(reference test.allowedNonGpu)").internal().string_conf("")
+
+ENABLE_WHOLE_STAGE_FUSION = conf("spark.rapids.tpu.sql.stageFusion.enabled").doc(
+    "Trace adjacent project/filter/aggregate operators into a single XLA program. "
+    "TPU-first optimization with no reference analog (cudf launches one kernel per op)"
+).boolean_conf(True)
+
+PARQUET_READER_TYPE = conf("spark.rapids.tpu.sql.format.parquet.reader.type").doc(
+    "PERFILE | MULTITHREADED | COALESCING (reference GpuParquetScan.scala:317,426 "
+    "reader strategies)").string_conf("MULTITHREADED")
+
+MULTITHREADED_READ_NUM_THREADS = conf(
+    "spark.rapids.tpu.sql.format.parquet.multiThreadedRead.numThreads").doc(
+    "Thread pool size for the multithreaded reader (reference "
+    "multiThreadedRead.numThreads)").integer_conf(20)
+
+CSV_ENABLED = conf("spark.rapids.tpu.sql.format.csv.enabled").doc(
+    "Enable accelerated CSV reading (reference spark.rapids.sql.format.csv.enabled)"
+).boolean_conf(True)
+
+ORC_ENABLED = conf("spark.rapids.tpu.sql.format.orc.enabled").doc(
+    "Enable accelerated ORC reading (reference spark.rapids.sql.format.orc.enabled)"
+).boolean_conf(True)
+
+NUM_LOCAL_TASKS = conf("spark.rapids.tpu.sql.localScheduler.numThreads").doc(
+    "Partition-task threads in the local scheduler (stands in for Spark executor "
+    "task slots; the reference delegates scheduling to Spark)").integer_conf(4)
+
+UDF_COMPILER_ENABLED = conf("spark.rapids.tpu.sql.udfCompiler.enabled").doc(
+    "Compile Python UDF bytecode into device expressions "
+    "(reference udf-compiler translates Scala bytecode → Catalyst)").boolean_conf(True)
+
+OOM_DUMP_DIR = conf("spark.rapids.tpu.memory.hbm.oomDumpDir").doc(
+    "Directory to write allocator state on device OOM "
+    "(reference spark.rapids.memory.gpu.oomDumpDir)").string_conf(None)
+
+
+class RapidsConf:
+    """Resolved view over user settings (reference RapidsConf.scala:1162 class)."""
+
+    def __init__(self, settings: dict | None = None):
+        self.settings = dict(settings or {})
+        unknown = [k for k in self.settings
+                   if k.startswith("spark.rapids.tpu.") and k not in _REGISTERED]
+        if unknown:
+            raise ValueError(f"unknown spark.rapids.tpu confs: {unknown}")
+
+    def get(self, entry: ConfEntry):
+        return entry.get(self.settings)
+
+    # convenience typed properties used throughout the engine
+    @property
+    def is_sql_enabled(self):
+        return self.get(SQL_ENABLED)
+
+    @property
+    def explain(self):
+        return self.get(EXPLAIN).upper()
+
+    @property
+    def batch_size_bytes(self):
+        return self.get(BATCH_SIZE_BYTES)
+
+    @property
+    def concurrent_tpu_tasks(self):
+        return self.get(CONCURRENT_TPU_TASKS)
+
+    @property
+    def metrics_level(self):
+        return self.get(METRICS_LEVEL).upper()
+
+    @property
+    def is_test_enabled(self):
+        return self.get(TEST_ENABLED)
+
+    @property
+    def allowed_non_tpu(self):
+        v = self.get(TEST_ALLOWED_NON_TPU)
+        return set(x.strip() for x in v.split(",") if x.strip())
+
+    @property
+    def is_cpu_fallback_enabled(self):
+        return self.get(CPU_FALLBACK_ENABLED)
+
+    @property
+    def stage_fusion_enabled(self):
+        return self.get(ENABLE_WHOLE_STAGE_FUSION)
+
+    def copy_with(self, **kv):
+        s = dict(self.settings)
+        s.update(kv)
+        return RapidsConf(s)
+
+
+def all_entries():
+    return dict(_REGISTERED)
+
+
+def generate_docs() -> str:
+    """Markdown doc table (reference RapidsConf.scala:1259 main → docs/configs.md)."""
+    lines = [
+        "# spark_rapids_tpu configuration",
+        "",
+        "Generated by `python -m spark_rapids_tpu.config`. "
+        "Mirrors the reference's docs/configs.md generator (RapidsConf.scala:1259).",
+        "",
+        "| Name | Default | Description |",
+        "|---|---|---|",
+    ]
+    for key in sorted(_REGISTERED):
+        e = _REGISTERED[key]
+        if e.internal:
+            continue
+        lines.append(f"| {e.key} | {e.default} | {e.doc} |")
+    return "\n".join(lines) + "\n"
+
+
+if __name__ == "__main__":
+    import pathlib
+    out = pathlib.Path(__file__).resolve().parent.parent / "docs" / "configs.md"
+    out.parent.mkdir(exist_ok=True)
+    out.write_text(generate_docs())
+    print(f"wrote {out}")

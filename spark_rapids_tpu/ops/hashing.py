@@ -1,0 +1,199 @@
+"""Spark-exact Murmur3_x86_32 on device.
+
+Reference: GpuHashPartitioning (com/nvidia/spark/rapids/GpuHashPartitioning.scala:92)
+relies on cudf's murmur3 matching Spark's `Murmur3Hash(exprs, 42)` bit-for-bit so GPU
+and CPU shuffles land rows in the same partitions. Here the same algorithm is written
+as jax int32 ops (wrapping two's-complement arithmetic + logical shifts), seed-chained
+across columns exactly like Spark's HashExpression.eval:
+
+    h = seed(42); for col in cols: if row not null in col: h = hash_col(value, h)
+    partition = pmod(fmix-free h? no — Spark applies fmix inside each column hash)
+
+Column rules (Spark Murmur3Hash / XxHash64 semantics, see also reference
+TypeChecks CastChecks for which types may feed a hash):
+  bool→hashInt(0/1), byte/short/int/date→hashInt, long/timestamp/decimal64→hashLong,
+  float→hashInt(floatToIntBits(x)) with -0.0→0.0, double→hashLong(doubleToLongBits),
+  string→hashUnsafeBytes over UTF-8, 4-byte little-endian words then signed tail bytes.
+Null values leave the running hash unchanged.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_C1 = np.int32(np.uint32(0xcc9e2d51))
+_C2 = np.int32(np.uint32(0x1b873593))
+_M5 = np.int32(np.uint32(0xe6546b64))
+_FX1 = np.int32(np.uint32(0x85ebca6b))
+_FX2 = np.int32(np.uint32(0xc2b2ae35))
+
+
+def _i32(x):
+    return x.astype(jnp.int32)
+
+
+def _rotl(x, n):
+    return lax.shift_left(x, jnp.int32(n)) | lax.shift_right_logical(x, jnp.int32(32 - n))
+
+
+def _mix_k1(k1):
+    k1 = k1 * _C1
+    k1 = _rotl(k1, 15)
+    return k1 * _C2
+
+
+def _mix_h1(h1, k1):
+    h1 = h1 ^ k1
+    h1 = _rotl(h1, 13)
+    return h1 * jnp.int32(5) + _M5
+
+
+def _fmix(h1, length):
+    h1 = h1 ^ jnp.int32(length)
+    h1 = h1 ^ lax.shift_right_logical(h1, jnp.int32(16))
+    h1 = h1 * _FX1
+    h1 = h1 ^ lax.shift_right_logical(h1, jnp.int32(13))
+    h1 = h1 * _FX2
+    h1 = h1 ^ lax.shift_right_logical(h1, jnp.int32(16))
+    return h1
+
+
+def hash_int(value_i32, seed_i32):
+    """Spark Murmur3_x86_32.hashInt, vectorized."""
+    h1 = _mix_h1(seed_i32, _mix_k1(value_i32))
+    return _fmix(h1, 4)
+
+
+def hash_long(value_i64, seed_i32):
+    """Spark Murmur3_x86_32.hashLong: low word then high word."""
+    low = _i32(value_i64)
+    high = _i32(lax.shift_right_logical(value_i64, jnp.int64(32)))
+    h1 = _mix_h1(seed_i32, _mix_k1(low))
+    h1 = _mix_h1(h1, _mix_k1(high))
+    return _fmix(h1, 8)
+
+
+def hash_float(value_f32, seed_i32):
+    v = jnp.where(value_f32 == jnp.float32(-0.0), jnp.float32(0.0), value_f32)
+    bits = lax.bitcast_convert_type(v.astype(jnp.float32), jnp.int32)
+    return hash_int(bits, seed_i32)
+
+
+def hash_double(value_f64, seed_i32):
+    v = jnp.where(value_f64 == jnp.float64(-0.0), jnp.float64(0.0), value_f64)
+    bits = lax.bitcast_convert_type(v.astype(jnp.float64), jnp.int64)
+    return hash_long(bits, seed_i32)
+
+
+def hash_string_words(words, lengths, seed_i32):
+    """hashUnsafeBytes over rows of 4-byte little-endian words.
+
+    words: (n, W) int32 — UTF-8 bytes packed little-endian, zero-padded.
+    lengths: (n,) int32 byte lengths. Whole words first, then each tail byte is its own
+    mix round using the SIGNED byte value, exactly like Spark's hashUnsafeBytes.
+    """
+    n, W = words.shape
+    n_words = lengths // 4
+    n_tail = lengths % 4
+
+    def word_round(i, h1):
+        k = words[:, i]
+        use = i < n_words
+        return jnp.where(use, _mix_h1(h1, _mix_k1(k)), h1)
+
+    h1 = lax.fori_loop(0, W, word_round, jnp.broadcast_to(seed_i32, (n,)).astype(jnp.int32))
+
+    # tail bytes: extract byte (n_words*4 + t) for t in 0..2, sign-extended
+    for t in range(3):
+        word = jnp.take_along_axis(words, n_words[:, None].astype(jnp.int32),
+                                   axis=1)[:, 0]
+        byte = lax.shift_right_logical(word, (jnp.int32(8) * t)) & jnp.int32(0xFF)
+        sbyte = jnp.where(byte >= 128, byte - 256, byte)  # signed java byte
+        use = t < n_tail
+        h1 = jnp.where(use, _mix_h1(h1, _mix_k1(sbyte)), h1)
+    return _fmix(h1, lengths)
+
+
+def pmod(hash_i32, divisor: int):
+    """Spark Pmod(hash, n): non-negative modulo."""
+    r = hash_i32 % jnp.int32(divisor)
+    return jnp.where(r < 0, r + jnp.int32(divisor), r)
+
+
+# ---------------------------------------------------------------------------
+# host-side reference (dictionary prep + tests)
+# ---------------------------------------------------------------------------
+
+def _hm_mix_k1(k1):
+    k1 = (k1 * 0xcc9e2d51) & 0xFFFFFFFF
+    k1 = ((k1 << 15) | (k1 >> 17)) & 0xFFFFFFFF
+    return (k1 * 0x1b873593) & 0xFFFFFFFF
+
+
+def _hm_mix_h1(h1, k1):
+    h1 ^= k1
+    h1 = ((h1 << 13) | (h1 >> 19)) & 0xFFFFFFFF
+    return (h1 * 5 + 0xe6546b64) & 0xFFFFFFFF
+
+
+def _hm_fmix(h1, length):
+    h1 ^= length
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85ebca6b) & 0xFFFFFFFF
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xc2b2ae35) & 0xFFFFFFFF
+    h1 ^= h1 >> 16
+    return h1
+
+
+def _to_signed(u):
+    return u - 0x100000000 if u >= 0x80000000 else u
+
+
+def murmur3_bytes_host(data: bytes, seed: int) -> int:
+    """Spark Murmur3_x86_32.hashUnsafeBytes on host (signed int32 result)."""
+    h1 = seed & 0xFFFFFFFF
+    n = len(data)
+    aligned = n - n % 4
+    for i in range(0, aligned, 4):
+        (k1,) = struct.unpack_from("<i", data, i)
+        h1 = _hm_mix_h1(h1, _hm_mix_k1(k1 & 0xFFFFFFFF))
+    for i in range(aligned, n):
+        b = data[i]
+        sb = b - 256 if b >= 128 else b
+        h1 = _hm_mix_h1(h1, _hm_mix_k1(sb & 0xFFFFFFFF))
+    return _to_signed(_hm_fmix(h1, n))
+
+
+def murmur3_int_host(v: int, seed: int) -> int:
+    h1 = _hm_mix_h1(seed & 0xFFFFFFFF, _hm_mix_k1(v & 0xFFFFFFFF))
+    return _to_signed(_hm_fmix(h1, 4))
+
+
+def murmur3_long_host(v: int, seed: int) -> int:
+    v &= 0xFFFFFFFFFFFFFFFF
+    h1 = _hm_mix_h1(seed & 0xFFFFFFFF, _hm_mix_k1(v & 0xFFFFFFFF))
+    h1 = _hm_mix_h1(h1, _hm_mix_k1((v >> 32) & 0xFFFFFFFF))
+    return _to_signed(_hm_fmix(h1, 8))
+
+
+def pack_utf8_words(strings, max_bytes: int | None = None):
+    """Pack a list of strings into (words int32 (n,W), lengths int32 (n,)) for
+    hash_string_words. Used once per string dictionary."""
+    bs = [s.encode("utf-8") if s is not None else b"" for s in strings]
+    max_b = max([len(b) for b in bs], default=0)
+    if max_bytes is not None:
+        max_b = max(max_b, max_bytes)
+    W = max(1, (max_b + 3) // 4)
+    raw = np.zeros((len(bs), W * 4), dtype=np.uint8)
+    lens = np.zeros(len(bs), dtype=np.int32)
+    for i, b in enumerate(bs):
+        raw[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
+        lens[i] = len(b)
+    words = raw.view("<i4").astype(np.int32)
+    return words, lens

@@ -1,0 +1,276 @@
+"""Spark SQL data types, mapped to TPU-resident representations.
+
+Mirrors the type surface the reference supports on GPU (reference TypeChecks.scala:129
+`TypeSig`, GpuColumnVector.java `getNonNestedRapidsType`): BOOLEAN, BYTE, SHORT, INT,
+LONG, FLOAT, DOUBLE, DATE, TIMESTAMP, STRING, DECIMAL(<=18), NULL, plus nested
+ARRAY/STRUCT/MAP (later rounds).
+
+Device representation (TPU-first, not a cudf translation):
+- fixed-width types: one padded jax array + bool validity mask.
+- DateType: int32 days since epoch. TimestampType: int64 microseconds since epoch (UTC),
+  matching Spark's internal representation.
+- DecimalType(p<=18): scaled int64 (reference supports the same bound via DECIMAL64,
+  GpuOverrides.scala DecimalType checks).
+- StringType: dictionary-encoded — int32 codes on device + a host-side sorted dictionary
+  (pyarrow), so comparisons/sorts/joins/group-bys run entirely on-device over codes; a
+  byte-mode (int32 offsets + uint8 data on device) is used by byte-level kernels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+
+class DataType:
+    """Base of the Spark SQL type hierarchy."""
+
+    #: jnp dtype of the device value array (None for types with no single array, e.g. NULL)
+    jnp_dtype = None
+    #: canonical Spark SQL name
+    sql_name = "unknown"
+
+    def __repr__(self):
+        return self.sql_name
+
+    def __eq__(self, other):
+        return type(self) is type(other)
+
+    def __hash__(self):
+        return hash(type(self))
+
+    @property
+    def is_numeric(self):
+        return isinstance(self, NumericType)
+
+    @property
+    def is_fixed_width(self):
+        return self.jnp_dtype is not None
+
+    def default_value(self):
+        """Canonical value stored in invalid (null) slots so padded garbage never leaks
+        into hashes/sorts (reference keeps nulls arbitrary and relies on cudf null
+        masks; on TPU we canonicalize instead)."""
+        return 0
+
+
+class NumericType(DataType):
+    pass
+
+
+class IntegralType(NumericType):
+    pass
+
+
+class FractionalType(NumericType):
+    pass
+
+
+class BooleanType(DataType):
+    jnp_dtype = jnp.bool_
+    sql_name = "boolean"
+
+    def default_value(self):
+        return False
+
+
+class ByteType(IntegralType):
+    jnp_dtype = jnp.int8
+    sql_name = "tinyint"
+
+
+class ShortType(IntegralType):
+    jnp_dtype = jnp.int16
+    sql_name = "smallint"
+
+
+class IntegerType(IntegralType):
+    jnp_dtype = jnp.int32
+    sql_name = "int"
+
+
+class LongType(IntegralType):
+    jnp_dtype = jnp.int64
+    sql_name = "bigint"
+
+
+class FloatType(FractionalType):
+    jnp_dtype = jnp.float32
+    sql_name = "float"
+
+    def default_value(self):
+        return 0.0
+
+
+class DoubleType(FractionalType):
+    jnp_dtype = jnp.float64
+    sql_name = "double"
+
+    def default_value(self):
+        return 0.0
+
+
+class StringType(DataType):
+    # device codes are int32 into a host dictionary; byte-mode uses offsets+uint8 data
+    jnp_dtype = jnp.int32
+    sql_name = "string"
+
+
+class DateType(DataType):
+    """Days since 1970-01-01, matching Spark's internal int32 representation."""
+    jnp_dtype = jnp.int32
+    sql_name = "date"
+
+
+class TimestampType(DataType):
+    """Microseconds since epoch UTC, matching Spark's internal int64 representation."""
+    jnp_dtype = jnp.int64
+    sql_name = "timestamp"
+
+
+@dataclasses.dataclass(frozen=True)
+class DecimalType(NumericType):
+    """Decimal with precision<=18 carried as scaled int64 (DECIMAL64, the same bound the
+    reference enforces in GpuOverrides tagging for cudf DType.DECIMAL64)."""
+    precision: int = 10
+    scale: int = 0
+    jnp_dtype = jnp.int64
+
+    MAX_PRECISION = 18
+
+    def __post_init__(self):
+        if self.precision > self.MAX_PRECISION:
+            raise ValueError(
+                f"DecimalType precision {self.precision} > {self.MAX_PRECISION} not "
+                f"supported on device (reference has the same DECIMAL64 bound)")
+
+    @property
+    def sql_name(self):  # type: ignore[override]
+        return f"decimal({self.precision},{self.scale})"
+
+    def __repr__(self):
+        return self.sql_name
+
+    def __eq__(self, other):
+        return (isinstance(other, DecimalType) and other.precision == self.precision
+                and other.scale == self.scale)
+
+    def __hash__(self):
+        return hash(("decimal", self.precision, self.scale))
+
+
+class NullType(DataType):
+    jnp_dtype = jnp.int8  # carrier; every slot is invalid
+    sql_name = "void"
+
+
+# ---------------------------------------------------------------------------
+# singletons (Spark-style)
+# ---------------------------------------------------------------------------
+BOOLEAN = BooleanType()
+BYTE = ByteType()
+SHORT = ShortType()
+INT = IntegerType()
+LONG = LongType()
+FLOAT = FloatType()
+DOUBLE = DoubleType()
+STRING = StringType()
+DATE = DateType()
+TIMESTAMP = TimestampType()
+NULL = NullType()
+
+
+_ARROW_TO_SPARK = {
+    pa.bool_(): BOOLEAN,
+    pa.int8(): BYTE,
+    pa.int16(): SHORT,
+    pa.int32(): INT,
+    pa.int64(): LONG,
+    pa.float32(): FLOAT,
+    pa.float64(): DOUBLE,
+    pa.string(): STRING,
+    pa.large_string(): STRING,
+    pa.string_view(): STRING,
+    pa.date32(): DATE,
+    pa.null(): NULL,
+}
+
+
+def from_arrow_type(at: pa.DataType) -> DataType:
+    """Map an Arrow type to the Spark SQL type the engine executes with."""
+    if at in _ARROW_TO_SPARK:
+        return _ARROW_TO_SPARK[at]
+    if pa.types.is_timestamp(at):
+        return TIMESTAMP
+    if pa.types.is_decimal(at):
+        return DecimalType(at.precision, at.scale)
+    if pa.types.is_dictionary(at):
+        return from_arrow_type(at.value_type)
+    raise TypeError(f"unsupported arrow type {at}")
+
+
+def to_arrow_type(dt: DataType) -> pa.DataType:
+    if isinstance(dt, DecimalType):
+        return pa.decimal128(dt.precision, dt.scale)
+    if isinstance(dt, TimestampType):
+        return pa.timestamp("us", tz="UTC")
+    for a, s in _ARROW_TO_SPARK.items():
+        if s == dt and a not in (pa.large_string(), pa.string_view()):
+            return a
+    raise TypeError(f"unsupported spark type {dt}")
+
+
+def to_numpy_dtype(dt: DataType):
+    return np.dtype(jnp.dtype(dt.jnp_dtype).name)
+
+
+@dataclasses.dataclass(frozen=True)
+class StructField:
+    name: str
+    data_type: DataType
+    nullable: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class StructType:
+    """Schema of a batch/plan output (Spark StructType analog)."""
+    fields: tuple
+
+    def __init__(self, fields):
+        object.__setattr__(self, "fields", tuple(fields))
+
+    @property
+    def names(self):
+        return [f.name for f in self.fields]
+
+    def __len__(self):
+        return len(self.fields)
+
+    def __getitem__(self, i):
+        if isinstance(i, str):
+            for f in self.fields:
+                if f.name == i:
+                    return f
+            raise KeyError(i)
+        return self.fields[i]
+
+    def index_of(self, name: str) -> int:
+        for i, f in enumerate(self.fields):
+            if f.name == name:
+                return i
+        raise KeyError(name)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def to_arrow(self) -> pa.Schema:
+        return pa.schema([pa.field(f.name, to_arrow_type(f.data_type), f.nullable)
+                          for f in self.fields])
+
+    @staticmethod
+    def from_arrow(schema: pa.Schema) -> "StructType":
+        return StructType([StructField(f.name, from_arrow_type(f.type), f.nullable)
+                           for f in schema])

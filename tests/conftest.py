@@ -1,0 +1,56 @@
+"""Test harness setup.
+
+Tests run on a virtual 8-device CPU platform (mirrors the reference's ring-1/ring-2
+strategy, SURVEY.md §4: protocol/memory logic testable without real hardware; the
+driver separately dry-runs the multi-chip path). Env must be set before jax imports.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force CPU even when a TPU is attached
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# the axon site hook re-selects the TPU platform regardless of env; override it
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pyarrow as pa  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+def make_table(n=1000, seed=0, with_nulls=True):
+    """Random mixed-type pyarrow table, the data_gen.py analog
+    (reference integration_tests/src/main/python/data_gen.py)."""
+    r = np.random.default_rng(seed)
+    null_mask = lambda: r.random(n) < 0.1 if with_nulls else np.zeros(n, bool)
+
+    def witness(vals, mask):
+        return pa.array([None if m else v for v, m in zip(vals.tolist(), mask)])
+
+    ints = witness(r.integers(-1000, 1000, n, dtype=np.int32), null_mask())
+    longs = witness(r.integers(-10**12, 10**12, n, dtype=np.int64), null_mask())
+    doubles = witness(r.normal(0, 100, n), null_mask())
+    floats = pa.array([None if m else float(np.float32(v)) for v, m in
+                       zip(r.normal(0, 10, n), null_mask())], type=pa.float32())
+    words = np.array(["apple", "banana", "cherry", "date", "elderberry", "fig",
+                      "grape", "", "kiwi", "lemon"])
+    strs = witness(words[r.integers(0, len(words), n)], null_mask())
+    bools = witness(r.integers(0, 2, n).astype(bool), null_mask())
+    return pa.table({
+        "i": ints, "l": longs, "d": doubles, "f": floats, "s": strs, "b": bools,
+    })
+
+
+@pytest.fixture
+def mixed_table():
+    return make_table()
