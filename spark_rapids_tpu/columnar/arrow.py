@@ -69,7 +69,10 @@ def array_to_device(arr, dtype: T.DataType | None = None,
     elif isinstance(dtype, T.DateType):
         vals = arr.cast(pa.int32()).fill_null(0).to_numpy(zero_copy_only=False)
     elif isinstance(dtype, T.TimestampType):
-        vals = arr.cast(pa.int64()).fill_null(0).to_numpy(zero_copy_only=False)
+        # normalize any source unit (s/ms/us/ns) to Spark's micros before the raw
+        # int64 view; naive timestamps are taken as UTC
+        us = pa.timestamp("us", tz=getattr(arr.type, "tz", None))
+        vals = arr.cast(us).cast(pa.int64()).fill_null(0).to_numpy(zero_copy_only=False)
     elif isinstance(dtype, T.NullType):
         vals = np.zeros(len(arr), dtype=np.int8)
         validity = np.zeros(len(arr), dtype=bool)

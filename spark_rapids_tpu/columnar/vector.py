@@ -36,15 +36,6 @@ def bucket_capacity(n: int) -> int:
     return cap
 
 
-def _sorted_dictionary(values: pa.Array):
-    """Sort + dedup a string dictionary; returns (sorted_dict, old_code→new_code map)."""
-    order = pc.array_sort_indices(values)
-    sorted_vals = values.take(order)
-    rank = np.empty(len(values), dtype=np.int32)
-    rank[order.to_numpy(zero_copy_only=False)] = np.arange(len(values), dtype=np.int32)
-    return sorted_vals, rank
-
-
 class TpuColumnVector:
     """One device column. Immutable once built (functional style, unlike cudf's
     refcounted mutable columns — XLA arrays are immutable so RAII shrinks to buffer

@@ -1,0 +1,175 @@
+"""Hash aggregate exec — Spark's two-phase aggregation on TPU.
+
+Reference: aggregate.scala GpuHashAggregateExec:240 with the update→concat→merge loop
+at 282-420 and computeAggregate:706: batches are aggregated incrementally (update
+aggregation per batch, then merge-aggregation of partials) so memory stays bounded;
+modes Partial/Final/Complete mirror Spark's AggregateMode.
+
+TPU-native realization (see ops/grouping.py): each batch goes through one fused XLA
+program — sort by keys, segment-reduce, compact one row per group. Partial results
+accumulate; when more than one partial batch exists they are concatenated and
+merge-aggregated (the same incremental loop as the reference). The group count stays
+a device scalar until a downstream sync."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.base import TpuExec, acquire_semaphore
+from spark_rapids_tpu.expr.core import Alias, Col, EvalContext, bind_references
+from spark_rapids_tpu.expr.aggregates import AggregateFunction
+from spark_rapids_tpu.ops import grouping as G
+from spark_rapids_tpu.ops.concat import concat_batches
+from spark_rapids_tpu.ops.filtering import compact_cols, gather_cols
+from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.runtime.tracing import trace_range
+
+PARTIAL = "partial"
+FINAL = "final"
+COMPLETE = "complete"
+
+
+def _agg_fn(e) -> AggregateFunction:
+    f = e.child if isinstance(e, Alias) else e
+    assert isinstance(f, AggregateFunction), f
+    return f
+
+
+class HashAggregateExec(TpuExec):
+    """group_exprs: grouping expressions; agg_exprs: Alias(AggregateFunction).
+
+    mode=COMPLETE: update + evaluate in one exec (single stage);
+    mode=PARTIAL: emits keys + state columns (pre-shuffle);
+    mode=FINAL: child output is PARTIAL layout; merges states and evaluates.
+    """
+
+    def __init__(self, group_exprs: list, agg_exprs: list, child: TpuExec,
+                 mode: str = COMPLETE, conf=None):
+        super().__init__(child, conf=conf)
+        self.mode = mode
+        if mode == FINAL:
+            # keys are the first child columns; aggs reference state columns
+            self.group_exprs = [bind_references(e, child.output)
+                                for e in group_exprs]
+            self.agg_exprs = list(agg_exprs)
+        else:
+            self.group_exprs = [bind_references(e, child.output)
+                                for e in group_exprs]
+            self.agg_exprs = [bind_references(e, child.output) for e in agg_exprs]
+        self._agg_time = self.metrics.metric(M.AGG_TIME, M.MODERATE)
+        self._concat_time = self.metrics.metric(M.CONCAT_TIME, M.MODERATE)
+
+    @property
+    def output(self):
+        fields = [T.StructField(e.name, e.dtype, True) for e in self.group_exprs]
+        if self.mode == PARTIAL:
+            for e in self.agg_exprs:
+                f = _agg_fn(e)
+                for i, st in enumerate(f.state_types):
+                    fields.append(T.StructField(f"{e.name}#state{i}", st, True))
+        else:
+            for e in self.agg_exprs:
+                fields.append(T.StructField(e.name, _agg_fn(e).dtype, True))
+        return T.StructType(fields)
+
+    def _partial_schema(self):
+        fields = [T.StructField(e.name, e.dtype, True) for e in self.group_exprs]
+        for e in self.agg_exprs:
+            f = _agg_fn(e)
+            for i, st in enumerate(f.state_types):
+                fields.append(T.StructField(f"{e.name}#state{i}", st, True))
+        return T.StructType(fields)
+
+    # ------------------------------------------------------------------
+    def _aggregate_batch(self, batch: ColumnarBatch, merge: bool) -> ColumnarBatch:
+        """One fused update-or-merge aggregation. In merge mode the batch is in
+        keys+state layout; in update mode it is raw child output. Returns a batch in
+        keys+state layout with one row per group."""
+        ctx = EvalContext.from_batch(batch)
+        cap = ctx.capacity
+        nkeys = len(self.group_exprs)
+        if nkeys:
+            if merge:
+                key_cols = [ctx.cols[i] for i in range(nkeys)]
+            else:
+                key_cols = [e.eval(ctx) for e in self.group_exprs]
+            perm, seg_ids, boundary, live = G.group_segments(
+                key_cols, ctx.num_rows, cap)
+            sorted_keys = gather_cols(key_cols, perm, live)
+            out_keys, n_groups = compact_cols(sorted_keys, boundary)
+        else:
+            live = jnp.arange(cap) < ctx.num_rows
+            perm = jnp.arange(cap, dtype=jnp.int32)
+            seg_ids = jnp.where(live, 0, cap - 1).astype(jnp.int32)
+            out_keys = []
+            n_groups = jnp.int32(1)  # global agg: always one row (Spark semantics)
+
+        group_valid = jnp.arange(cap, dtype=jnp.int32) < n_groups
+        state_cols = []
+        off = nkeys
+        for e in self.agg_exprs:
+            f = _agg_fn(e)
+            nstates = len(f.state_types)
+            if merge:
+                ins = gather_cols([ctx.cols[off + i] for i in range(nstates)],
+                                  perm, live)
+                outs = f.merge(ins, seg_ids, cap)
+            else:
+                if f.child is None:
+                    in_col = Col(jnp.zeros((cap,), jnp.int8), live, T.BYTE)
+                else:
+                    in_col = f.child.eval(ctx)
+                in_sorted = gather_cols([in_col], perm, live)[0]
+                outs = f.update(in_sorted, seg_ids, cap)
+            off += nstates
+            for o in outs:
+                state_cols.append(Col(o.values, o.validity & group_valid, o.dtype,
+                                      o.dictionary))
+        cols = [c.to_vector() for c in list(out_keys) + state_cols]
+        return ColumnarBatch(cols, n_groups, self._partial_schema())
+
+    def _finalize(self, partial: ColumnarBatch) -> ColumnarBatch:
+        ctx = EvalContext.from_batch(partial)
+        nkeys = len(self.group_exprs)
+        out = [ctx.cols[i] for i in range(nkeys)]
+        off = nkeys
+        for e in self.agg_exprs:
+            f = _agg_fn(e)
+            states = [ctx.cols[off + i] for i in range(len(f.state_types))]
+            off += len(f.state_types)
+            out.append(f.evaluate(states))
+        return ColumnarBatch([c.to_vector() for c in out], partial.lazy_num_rows,
+                             self.output)
+
+    def execute_partition(self, split):
+        def it():
+            acquire_semaphore(self.metrics)
+            merge_input = self.mode == FINAL
+            acc = None
+            for batch in self.child.execute_partition(split):
+                with trace_range("HashAggregate.agg", self._agg_time):
+                    partial = self._aggregate_batch(batch, merge=merge_input)
+                if acc is None:
+                    acc = partial
+                else:
+                    # incremental concat+merge loop (reference aggregate.scala:388)
+                    with trace_range("HashAggregate.concat", self._concat_time):
+                        both = concat_batches([acc, partial])
+                    with trace_range("HashAggregate.merge", self._agg_time):
+                        acc = self._aggregate_batch(both, merge=True)
+            if acc is None:
+                if self.group_exprs:
+                    return  # grouped agg over empty input → no rows (Spark)
+                empty = ColumnarBatch.empty(
+                    self._partial_schema() if merge_input else self.child.output)
+                acc = self._aggregate_batch(empty, merge=merge_input)
+            if self.mode == PARTIAL:
+                yield acc
+            else:
+                yield self._finalize(acc)
+        return self.wrap_output(it())
+
+    def args_string(self):
+        return f"keys={self.group_exprs} aggs={self.agg_exprs} mode={self.mode}"

@@ -1,0 +1,123 @@
+"""TpuExec — base of the physical operator layer.
+
+Reference: GpuExec.scala:40,281: base trait for all columnar operators, carrying the
+metric registry, coalesce-goal declarations, and doExecuteColumnar. Here an exec is a
+tree node with `execute_partition(split) -> Iterator[ColumnarBatch]`; a lightweight
+local task scheduler (the stand-in for Spark's task execution — the reference
+delegates scheduling to Spark itself, SURVEY.md §1) drives partitions through thread
+pool tasks gated by the TpuSemaphore."""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import typing
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.runtime.semaphore import TpuSemaphore
+from spark_rapids_tpu.runtime.tracing import trace_range
+
+_task_counter = itertools.count(1)
+_task_local = threading.local()
+
+
+def current_task_id() -> int:
+    tid = getattr(_task_local, "task_id", None)
+    if tid is None:
+        tid = next(_task_counter)
+        _task_local.task_id = tid
+    return tid
+
+
+class TaskContext:
+    """Per-task scope: semaphore auto-release on completion (reference
+    GpuSemaphore task-completion listener, GpuSemaphore.scala:58)."""
+
+    def __init__(self):
+        self.task_id = next(_task_counter)
+
+    def __enter__(self):
+        _task_local.task_id = self.task_id
+        return self
+
+    def __exit__(self, *exc):
+        TpuSemaphore.get().release_if_necessary(self.task_id)
+        _task_local.task_id = None
+        return False
+
+
+class TpuExec:
+    """Base physical operator."""
+
+    def __init__(self, *children: "TpuExec", conf: RapidsConf | None = None):
+        self.children = list(children)
+        self.conf = conf or (children[0].conf if children else RapidsConf())
+        self.metrics = M.MetricsRegistry(self.conf.metrics_level)
+        self._out_rows = self.metrics.metric(M.NUM_OUTPUT_ROWS, M.ESSENTIAL)
+        self._out_batches = self.metrics.metric(M.NUM_OUTPUT_BATCHES, M.MODERATE)
+        self._op_time = self.metrics.metric(M.OP_TIME, M.MODERATE)
+
+    @property
+    def child(self) -> "TpuExec":
+        return self.children[0]
+
+    @property
+    def output(self) -> T.StructType:
+        raise NotImplementedError
+
+    @property
+    def num_partitions(self) -> int:
+        return self.children[0].num_partitions if self.children else 1
+
+    def execute_partition(self, split: int) -> typing.Iterator[ColumnarBatch]:
+        raise NotImplementedError
+
+    # -- driver-side helpers -------------------------------------------------
+    def execute_collect(self):
+        """Run all partitions (threaded local scheduler) and collect to one arrow
+        table — the test/driver path (Spark collect())."""
+        import pyarrow as pa
+        from concurrent.futures import ThreadPoolExecutor
+        from spark_rapids_tpu.config import NUM_LOCAL_TASKS
+        nthreads = max(1, min(self.conf.get(NUM_LOCAL_TASKS), self.num_partitions))
+
+        def run(split):
+            with TaskContext():
+                return [b.to_arrow() for b in self.execute_partition(split)]
+
+        if self.num_partitions == 1:
+            parts = [run(0)]
+        else:
+            with ThreadPoolExecutor(max_workers=nthreads) as pool:
+                parts = list(pool.map(run, range(self.num_partitions)))
+        tables = [t for p in parts for t in p]
+        if not tables:
+            return self.output.to_arrow().empty_table()
+        return pa.concat_tables(tables)
+
+    def wrap_output(self, it):
+        """Instrument an output iterator with row/batch metrics."""
+        for b in it:
+            self._out_batches.add(1)
+            self._out_rows.add(b.num_rows)
+            yield b
+
+    def tree_string(self, indent=0):
+        s = "  " * indent + "*" + type(self).__name__ + " " + self.args_string() + "\n"
+        for c in self.children:
+            s += c.tree_string(indent + 1)
+        return s
+
+    def args_string(self):
+        return ""
+
+    def __repr__(self):
+        return self.tree_string().rstrip()
+
+
+def acquire_semaphore(metrics: M.MetricsRegistry):
+    TpuSemaphore.get().acquire_if_necessary(
+        current_task_id(), metrics.metric(M.SEMAPHORE_WAIT_TIME, M.MODERATE))

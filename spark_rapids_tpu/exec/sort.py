@@ -1,0 +1,105 @@
+"""Sort exec (reference GpuSortExec.scala:56). Batches within a partition are
+concatenated then sorted in one fused XLA program; SortOrder carries Spark's
+ASC/DESC + NULLS FIRST/LAST semantics (ops/sorting.py)."""
+
+from __future__ import annotations
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.base import TpuExec, acquire_semaphore
+from spark_rapids_tpu.expr.core import EvalContext, bind_references
+from spark_rapids_tpu.ops.concat import concat_batches
+from spark_rapids_tpu.ops.filtering import gather_cols
+from spark_rapids_tpu.ops.sorting import SortOrder, sort_permutation
+from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.runtime.tracing import trace_range
+
+import jax.numpy as jnp
+
+
+class SortExec(TpuExec):
+    def __init__(self, sort_exprs: list, orders: list, child: TpuExec,
+                 global_sort: bool = False, conf=None):
+        """sort_exprs: expressions producing sort keys; orders: list[SortOrder]."""
+        super().__init__(child, conf=conf)
+        self.sort_exprs = [bind_references(e, child.output) for e in sort_exprs]
+        self.orders = list(orders)
+        self.global_sort = global_sort
+        self._sort_time = self.metrics.metric(M.SORT_TIME, M.MODERATE)
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def execute_partition(self, split):
+        def it():
+            batches = list(self.child.execute_partition(split))
+            if not batches:
+                return
+            acquire_semaphore(self.metrics)
+            with trace_range("SortExec", self._sort_time):
+                batch = concat_batches(batches)
+                ctx = EvalContext.from_batch(batch)
+                key_cols = [e.eval(ctx) for e in self.sort_exprs]
+                perm = sort_permutation(key_cols, self.orders, ctx.num_rows,
+                                        ctx.capacity)
+                live = jnp.arange(ctx.capacity, dtype=jnp.int32) < ctx.num_rows
+                cols = gather_cols(ctx.cols, perm, live)
+                yield ColumnarBatch([c.to_vector() for c in cols],
+                                    batch.lazy_num_rows, self.output)
+        return self.wrap_output(it())
+
+    def args_string(self):
+        return str(list(zip(self.sort_exprs, self.orders)))
+
+
+class TakeOrderedAndProjectExec(TpuExec):
+    """limit + sort + project (reference GpuTakeOrderedAndProjectExec, limit.scala).
+    Sorts each partition, takes the first `limit` rows, then the driver merges."""
+
+    def __init__(self, limit: int, sort_exprs, orders, project_list, child, conf=None):
+        super().__init__(child, conf=conf)
+        self.limit = limit
+        self.sort_exprs = sort_exprs
+        self.orders = orders
+        self.project_list = project_list
+
+    @property
+    def output(self):
+        from spark_rapids_tpu.exec.basic import ProjectExec
+        if self.project_list:
+            tmp = ProjectExec(self.project_list, self.child, conf=self.conf)
+            return tmp.output
+        return self.child.output
+
+    @property
+    def num_partitions(self):
+        return 1
+
+    def execute_partition(self, split):
+        from spark_rapids_tpu.exec.basic import LocalLimitExec, ProjectExec
+        inner = SortExec(self.sort_exprs, self.orders, _GatherAllExec(self.child),
+                         conf=self.conf)
+        plan: TpuExec = LocalLimitExec(self.limit, inner, conf=self.conf)
+        if self.project_list:
+            plan = ProjectExec(self.project_list, plan, conf=self.conf)
+        return self.wrap_output(plan.execute_partition(0))
+
+
+class _GatherAllExec(TpuExec):
+    """Pulls every child partition into one (driver-side single partition)."""
+
+    def __init__(self, child, conf=None):
+        super().__init__(child, conf=conf)
+
+    @property
+    def output(self):
+        return self.child.output
+
+    @property
+    def num_partitions(self):
+        return 1
+
+    def execute_partition(self, split):
+        for p in range(self.child.num_partitions):
+            yield from self.child.execute_partition(p)

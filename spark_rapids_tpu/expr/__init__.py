@@ -1,0 +1,3 @@
+from spark_rapids_tpu.expr.core import (  # noqa: F401
+    Col, Expression, BoundReference, AttributeReference, Literal, Alias, bind_references,
+)

@@ -1,0 +1,260 @@
+"""Aggregate functions with Spark two-phase (update/merge) semantics.
+
+Reference: AggregateFunctions.scala:704 (GpuSum, GpuCount, GpuMin, GpuMax, GpuAverage,
+GpuFirst, GpuLast) consumed by GpuHashAggregateExec's update→concat→merge loop
+(aggregate.scala:282-420). Same decomposition here:
+
+  inputs      — expressions evaluated on the raw batch (pre-aggregation projection)
+  update      — segment-reduce raw values into per-group state columns
+  merge       — segment-reduce state columns of partial batches (re-aggregation)
+  evaluate    — final expression over state columns
+
+Null semantics implemented: COUNT never null and counts non-nulls (COUNT(*) counts
+rows); SUM/MIN/MAX/AVG ignore nulls and are null iff no non-null input; AVG of
+integrals is double; SUM of integrals is long (wrapping), of floats is double.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Col, Expression
+from spark_rapids_tpu.ops import grouping as G
+
+
+class AggregateFunction(Expression):
+    """Declarative aggregate. `state_types` names the partial-state columns."""
+
+    def __init__(self, child: Expression | None):
+        self.children = [child] if child is not None else []
+
+    @property
+    def child(self):
+        return self.children[0] if self.children else None
+
+    def with_children(self, children):
+        return type(self)(children[0] if children else None)
+
+    @property
+    def state_types(self) -> list:
+        raise NotImplementedError
+
+    def update(self, in_col: Col, seg_ids, capacity) -> list:
+        """Raw column → list of state Cols (one per state_types entry)."""
+        raise NotImplementedError
+
+    def merge(self, state_cols: list, seg_ids, capacity) -> list:
+        """Partial states → merged states."""
+        raise NotImplementedError
+
+    def evaluate(self, state_cols: list) -> Col:
+        """Merged states → final value column."""
+        raise NotImplementedError
+
+    def eval(self, ctx):
+        raise RuntimeError("aggregate functions are evaluated by the aggregate exec")
+
+    def __repr__(self):
+        return f"{type(self).__name__.lower()}({self.child!r})"
+
+
+def _sum_result_type(t: T.DataType) -> T.DataType:
+    if isinstance(t, T.DecimalType):
+        return T.DecimalType(min(t.precision + 10, T.DecimalType.MAX_PRECISION), t.scale)
+    if isinstance(t, T.IntegralType):
+        return T.LONG
+    return T.DOUBLE
+
+
+class Sum(AggregateFunction):
+    @property
+    def dtype(self):
+        return _sum_result_type(self.child.dtype)
+
+    @property
+    def state_types(self):
+        return [self.dtype]
+
+    def _acc_dtype(self):
+        return self.dtype.jnp_dtype
+
+    def update(self, in_col, seg_ids, capacity):
+        vals = in_col.values.astype(self._acc_dtype())
+        s, cnt = G.segment_sum(vals, in_col.validity, seg_ids, capacity)
+        return [Col(s, cnt > 0, self.dtype)]
+
+    def merge(self, state_cols, seg_ids, capacity):
+        st = state_cols[0]
+        s, cnt = G.segment_sum(st.values, st.validity, seg_ids, capacity)
+        return [Col(s, cnt > 0, self.dtype)]
+
+    def evaluate(self, state_cols):
+        return state_cols[0].canonicalized()
+
+
+class Count(AggregateFunction):
+    """COUNT(expr) counts non-null; COUNT(*) (child None) counts rows."""
+
+    @property
+    def dtype(self):
+        return T.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+    @property
+    def state_types(self):
+        return [T.LONG]
+
+    def update(self, in_col, seg_ids, capacity):
+        if self.child is None:
+            validity = jnp.ones_like(seg_ids, dtype=jnp.bool_)
+        else:
+            validity = in_col.validity
+        # count live rows only: seg_ids of padding point at the overflow bucket,
+        # which is discarded by the exec, so a plain segment count is safe
+        ones = validity.astype(jnp.int64)
+        s, _ = G.segment_sum(ones, jnp.ones_like(validity), seg_ids, capacity)
+        return [Col(s, jnp.ones_like(s, dtype=jnp.bool_), T.LONG)]
+
+    def merge(self, state_cols, seg_ids, capacity):
+        st = state_cols[0]
+        s, _ = G.segment_sum(st.values, st.validity, seg_ids, capacity)
+        return [Col(s, jnp.ones_like(s, dtype=jnp.bool_), T.LONG)]
+
+    def evaluate(self, state_cols):
+        return state_cols[0]
+
+    def __repr__(self):
+        return f"count({self.child!r})" if self.child else "count(*)"
+
+
+class Min(AggregateFunction):
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def state_types(self):
+        return [self.dtype]
+
+    def update(self, in_col, seg_ids, capacity):
+        m = G.segment_min(in_col.values, in_col.validity, seg_ids, capacity,
+                          self.dtype)
+        _, cnt = G.segment_sum(jnp.zeros_like(seg_ids, jnp.int64), in_col.validity,
+                               seg_ids, capacity)
+        return [Col(m, cnt > 0, self.dtype, in_col.dictionary)]
+
+    def merge(self, state_cols, seg_ids, capacity):
+        return self.update(state_cols[0], seg_ids, capacity)
+
+    def evaluate(self, state_cols):
+        return state_cols[0].canonicalized()
+
+
+class Max(AggregateFunction):
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def state_types(self):
+        return [self.dtype]
+
+    def update(self, in_col, seg_ids, capacity):
+        m = G.segment_max(in_col.values, in_col.validity, seg_ids, capacity,
+                          self.dtype)
+        _, cnt = G.segment_sum(jnp.zeros_like(seg_ids, jnp.int64), in_col.validity,
+                               seg_ids, capacity)
+        return [Col(m, cnt > 0, self.dtype, in_col.dictionary)]
+
+    def merge(self, state_cols, seg_ids, capacity):
+        return self.update(state_cols[0], seg_ids, capacity)
+
+    def evaluate(self, state_cols):
+        return state_cols[0].canonicalized()
+
+
+class Average(AggregateFunction):
+    """AVG: (sum: double|decimal, count: long) state; double result for non-decimal
+    (Spark). Decimal avg yields decimal with +4 scale (Spark rule), capped at 18."""
+
+    @property
+    def dtype(self):
+        ct = self.child.dtype
+        if isinstance(ct, T.DecimalType):
+            scale = min(ct.scale + 4, T.DecimalType.MAX_PRECISION)
+            return T.DecimalType(T.DecimalType.MAX_PRECISION, scale)
+        return T.DOUBLE
+
+    @property
+    def state_types(self):
+        ct = self.child.dtype
+        sum_t = _sum_result_type(ct)
+        return [sum_t, T.LONG]
+
+    def update(self, in_col, seg_ids, capacity):
+        sum_t = self.state_types[0]
+        vals = in_col.values.astype(sum_t.jnp_dtype)
+        s, cnt = G.segment_sum(vals, in_col.validity, seg_ids, capacity)
+        return [Col(s, cnt > 0, sum_t),
+                Col(cnt, jnp.ones_like(cnt, dtype=jnp.bool_), T.LONG)]
+
+    def merge(self, state_cols, seg_ids, capacity):
+        s_st, c_st = state_cols
+        s, _ = G.segment_sum(s_st.values, s_st.validity, seg_ids, capacity)
+        c, _ = G.segment_sum(c_st.values, c_st.validity, seg_ids, capacity)
+        return [Col(s, c > 0, self.state_types[0]),
+                Col(c, jnp.ones_like(c, dtype=jnp.bool_), T.LONG)]
+
+    def evaluate(self, state_cols):
+        s_st, c_st = state_cols
+        cnt = c_st.values
+        ok = cnt > 0
+        safe = jnp.where(ok, cnt, 1)
+        if isinstance(self.dtype, T.DecimalType):
+            in_scale = self.state_types[0].scale
+            up = self.dtype.scale - in_scale
+            num = s_st.values * (10 ** up)
+            mag = jnp.abs(num)
+            qm = (mag + safe // 2) // safe
+            vals = jnp.where(num < 0, -qm, qm)
+        else:
+            vals = s_st.values.astype(jnp.float64) / safe
+        return Col(vals, ok, self.dtype).canonicalized()
+
+    def __repr__(self):
+        return f"avg({self.child!r})"
+
+
+class First(AggregateFunction):
+    def __init__(self, child, ignore_nulls: bool = False):
+        super().__init__(child)
+        self.ignore_nulls = ignore_nulls
+
+    def with_children(self, children):
+        return First(children[0], self.ignore_nulls)
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def state_types(self):
+        return [self.dtype]
+
+    def update(self, in_col, seg_ids, capacity):
+        vals, valid = G.segment_first(in_col.values, in_col.validity, seg_ids,
+                                      capacity, self.ignore_nulls)
+        return [Col(vals, valid, self.dtype, in_col.dictionary)]
+
+    def merge(self, state_cols, seg_ids, capacity):
+        st = state_cols[0]
+        vals, valid = G.segment_first(st.values, st.validity, seg_ids, capacity,
+                                      self.ignore_nulls)
+        return [Col(vals, valid, self.dtype, st.dictionary)]
+
+    def evaluate(self, state_cols):
+        return state_cols[0].canonicalized()

@@ -1,0 +1,241 @@
+"""Arithmetic expressions with Spark semantics (non-ANSI mode).
+
+Reference: sql-plugin/.../org/apache/spark/sql/rapids/arithmetic.scala (676 LoC):
+GpuAdd/GpuSubtract/GpuMultiply wrap like Java (two's complement, cudf does the same),
+GpuDivide returns null on zero divisor ("Special case, in Spark divide by zero is
+null"), GpuIntegralDivide → LongType, GpuRemainder/GpuPmod null on zero divisor,
+GpuUnaryMinus, GpuAbs.
+
+Type promotion follows Spark's numeric precedence byte<short<int<long<float<double;
+Divide always yields double for non-decimal inputs (Spark implicit cast).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Col, Expression, valid_and
+
+_NUMERIC_ORDER = [T.ByteType, T.ShortType, T.IntegerType, T.LongType, T.FloatType,
+                  T.DoubleType]
+
+
+def promote(a: T.DataType, b: T.DataType) -> T.DataType:
+    if a == b:
+        return a
+    if isinstance(a, T.DecimalType) or isinstance(b, T.DecimalType):
+        # simplified decimal promotion: widen to the max precision/scale pair
+        da = a if isinstance(a, T.DecimalType) else None
+        db = b if isinstance(b, T.DecimalType) else None
+        if da and db:
+            scale = max(da.scale, db.scale)
+            prec = min(T.DecimalType.MAX_PRECISION,
+                       max(da.precision - da.scale, db.precision - db.scale) + scale)
+            return T.DecimalType(prec, scale)
+        other = b if da else a
+        if isinstance(other, IntegralTypeTuple):
+            return da or db
+        return T.DOUBLE
+    ia = _NUMERIC_ORDER.index(type(a))
+    ib = _NUMERIC_ORDER.index(type(b))
+    return a if ia >= ib else b
+
+
+IntegralTypeTuple = (T.ByteType, T.ShortType, T.IntegerType, T.LongType)
+
+
+def _cast_col(c: Col, to: T.DataType) -> Col:
+    if c.dtype == to:
+        return c
+    from spark_rapids_tpu.expr.cast import cast_col
+    return cast_col(c, to)
+
+
+class BinaryArithmetic(Expression):
+    symbol = "?"
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = [left, right]
+
+    @property
+    def left(self):
+        return self.children[0]
+
+    @property
+    def right(self):
+        return self.children[1]
+
+    @property
+    def dtype(self):
+        return promote(self.left.dtype, self.right.dtype)
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    def eval(self, ctx):
+        out_t = self.dtype
+        l = _cast_col(self.left.eval(ctx), out_t)
+        r = _cast_col(self.right.eval(ctx), out_t)
+        validity = valid_and(l.validity, r.validity)
+        vals = self.op(l.values, r.values)
+        return Col(vals, validity, out_t).canonicalized()
+
+    def op(self, lv, rv):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"({self.left!r} {self.symbol} {self.right!r})"
+
+
+class Add(BinaryArithmetic):
+    symbol = "+"
+
+    def op(self, lv, rv):
+        return lv + rv
+
+
+class Subtract(BinaryArithmetic):
+    symbol = "-"
+
+    def op(self, lv, rv):
+        return lv - rv
+
+
+class Multiply(BinaryArithmetic):
+    symbol = "*"
+
+    def op(self, lv, rv):
+        return lv * rv
+
+
+class Divide(BinaryArithmetic):
+    """Spark Divide: double result (non-decimal), NULL on zero divisor — even for
+    doubles (reference GpuDivide, arithmetic.scala)."""
+    symbol = "/"
+
+    @property
+    def dtype(self):
+        base = promote(self.left.dtype, self.right.dtype)
+        if isinstance(base, T.DecimalType):
+            return base
+        return T.DOUBLE
+
+    def eval(self, ctx):
+        out_t = self.dtype
+        l = _cast_col(self.left.eval(ctx), out_t)
+        r = _cast_col(self.right.eval(ctx), out_t)
+        zero = r.values == 0
+        validity = valid_and(l.validity, r.validity) & ~zero
+        safe_r = jnp.where(zero, jnp.ones_like(r.values), r.values)
+        if isinstance(out_t, T.DecimalType):
+            vals = l.values // safe_r  # simplified decimal division (scale 0 result)
+        else:
+            vals = l.values / safe_r
+        return Col(vals, validity, out_t).canonicalized()
+
+
+class IntegralDivide(BinaryArithmetic):
+    """Spark `div`: LongType result, null on zero divisor, truncation toward zero
+    (Java semantics; jnp floor-divides, so adjust)."""
+    symbol = "div"
+
+    @property
+    def dtype(self):
+        return T.LONG
+
+    def eval(self, ctx):
+        l = _cast_col(self.left.eval(ctx), T.LONG)
+        r = _cast_col(self.right.eval(ctx), T.LONG)
+        zero = r.values == 0
+        validity = valid_and(l.validity, r.validity) & ~zero
+        safe_r = jnp.where(zero, jnp.ones_like(r.values), r.values)
+        q = l.values // safe_r
+        rem = l.values - q * safe_r
+        # floor-div → trunc-div: bump quotient toward zero when signs differ & rem != 0
+        q = jnp.where((rem != 0) & ((l.values < 0) != (safe_r < 0)), q + 1, q)
+        return Col(q, validity, T.LONG).canonicalized()
+
+
+class Remainder(BinaryArithmetic):
+    """Spark %: Java remainder (sign follows dividend), null on zero divisor."""
+    symbol = "%"
+
+    def eval(self, ctx):
+        out_t = self.dtype
+        l = _cast_col(self.left.eval(ctx), out_t)
+        r = _cast_col(self.right.eval(ctx), out_t)
+        zero = r.values == 0
+        validity = valid_and(l.validity, r.validity) & ~zero
+        safe_r = jnp.where(zero, jnp.ones_like(r.values), r.values)
+        if isinstance(out_t, T.FractionalType):
+            vals = jnp.fmod(l.values, safe_r)  # C-style, sign of dividend (Java %)
+        else:
+            vals = _java_rem(l.values, safe_r)
+        return Col(vals, validity, out_t).canonicalized()
+
+
+class Pmod(BinaryArithmetic):
+    """Spark pmod: r = a % n (Java remainder); if r < 0 then (r + n) % n else r.
+    Null on zero divisor. Note the result keeps the divisor's sign for negative
+    divisors (pmod(-7, -3) = -1), matching Spark exactly."""
+    symbol = "pmod"
+
+    def eval(self, ctx):
+        out_t = self.dtype
+        l = _cast_col(self.left.eval(ctx), out_t)
+        r = _cast_col(self.right.eval(ctx), out_t)
+        zero = r.values == 0
+        validity = valid_and(l.validity, r.validity) & ~zero
+        safe_r = jnp.where(zero, jnp.ones_like(r.values), r.values)
+        if isinstance(out_t, T.FractionalType):
+            m = jnp.fmod(l.values, safe_r)
+            vals = jnp.where(m < 0, jnp.fmod(m + safe_r, safe_r), m)
+        else:
+            m = _java_rem(l.values, safe_r)
+            vals = jnp.where(m < 0, _java_rem(m + safe_r, safe_r), m)
+        return Col(vals, validity, out_t).canonicalized()
+
+
+def _java_rem(a, n):
+    """Java % (sign follows dividend) from python-style jnp.remainder."""
+    m = jnp.remainder(a, n)
+    return jnp.where((m != 0) & ((m < 0) != (a < 0)), m - n, m)
+
+
+class UnaryMinus(Expression):
+    def __init__(self, child):
+        self.children = [child]
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def with_children(self, children):
+        return UnaryMinus(children[0])
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        return c.with_(values=-c.values).canonicalized()
+
+    def __repr__(self):
+        return f"(- {self.children[0]!r})"
+
+
+class Abs(Expression):
+    def __init__(self, child):
+        self.children = [child]
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def with_children(self, children):
+        return Abs(children[0])
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        return c.with_(values=jnp.abs(c.values)).canonicalized()
+
+    def __repr__(self):
+        return f"abs({self.children[0]!r})"

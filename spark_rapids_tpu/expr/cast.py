@@ -1,0 +1,362 @@
+"""Cast — Spark (non-ANSI) cast semantics on device.
+
+Reference: sql-plugin/.../com/nvidia/spark/rapids/GpuCast.scala (1254 LoC) +
+TypeChecks CastChecks table (TypeChecks.scala:878). The reference spends most of its
+lines on exactly the edge cases implemented here:
+
+- int narrowing wraps like Java (long→int keeps low 32 bits);
+- float→integral truncates toward zero, clamps to the target range, NaN→0
+  (Java (long)/(int) conversion semantics);
+- numeric→boolean is `!= 0`; boolean→numeric is 1/0;
+- date↔timestamp via days*86_400_000_000 micros (floor for ts→date);
+- decimal rescale with overflow→null (reference GpuCast decimal paths);
+- string→numeric/date parses per *dictionary entry* on host with Spark's rules
+  (trim, optional sign, fractional truncation toward zero, overflow→null) then
+  gathers on device — exact and O(|dict|) host work;
+- numeric→string formats per row value via a host-built dictionary (Java formatting).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Col, Expression
+
+_INT_BOUNDS = {
+    T.ByteType: (-(2**7), 2**7 - 1),
+    T.ShortType: (-(2**15), 2**15 - 1),
+    T.IntegerType: (-(2**31), 2**31 - 1),
+    T.LongType: (-(2**63), 2**63 - 1),
+}
+
+_MICROS_PER_DAY = 86_400_000_000
+
+
+def _float_to_integral(vals, to: T.DataType):
+    lo, hi = _INT_BOUNDS[type(to)]
+    t = jnp.trunc(vals)
+    t = jnp.where(jnp.isnan(vals), 0.0, t)
+    t = jnp.clip(t, float(lo), float(hi))
+    # values beyond f64 exact range clamp correctly because lo/hi round outward
+    out = t.astype(jnp.int64)
+    out = jnp.clip(out, lo, hi)
+    return out.astype(to.jnp_dtype)
+
+
+def cast_col(c: Col, to: T.DataType) -> Col:
+    frm = c.dtype
+    if frm == to:
+        return c
+    if isinstance(frm, T.NullType):
+        from spark_rapids_tpu.expr.core import Literal
+        cap = int(c.values.shape[0])
+        if isinstance(to, T.StringType):
+            import pyarrow as pa
+            return Col(jnp.zeros(cap, jnp.int32), jnp.zeros(cap, jnp.bool_), to,
+                       pa.array([], type=pa.string()))
+        return Col(jnp.full(cap, to.default_value(), dtype=to.jnp_dtype),
+                   jnp.zeros(cap, jnp.bool_), to)
+
+    if isinstance(frm, T.StringType):
+        return _cast_from_string(c, to)
+    if isinstance(to, T.StringType):
+        return _cast_to_string(c)
+
+    vals, validity = c.values, c.validity
+
+    if isinstance(frm, T.BooleanType):
+        out = vals.astype(to.jnp_dtype)
+        return Col(out, validity, to).canonicalized()
+    if isinstance(to, T.BooleanType):
+        return Col(vals != 0, validity, to)
+
+    if isinstance(frm, T.DateType) and isinstance(to, T.TimestampType):
+        return Col(vals.astype(jnp.int64) * _MICROS_PER_DAY, validity, to).canonicalized()
+    if isinstance(frm, T.TimestampType) and isinstance(to, T.DateType):
+        return Col(jnp.floor_divide(vals, _MICROS_PER_DAY).astype(jnp.int32),
+                   validity, to).canonicalized()
+    if isinstance(frm, T.TimestampType) and isinstance(to, T.LongType):
+        return Col(jnp.floor_divide(vals, 1_000_000), validity, to).canonicalized()
+    if isinstance(frm, T.LongType) and isinstance(to, T.TimestampType):
+        return Col(vals * 1_000_000, validity, to).canonicalized()
+
+    if isinstance(frm, T.DecimalType) or isinstance(to, T.DecimalType):
+        return _cast_decimal(c, to)
+
+    if isinstance(frm, T.FractionalType) and isinstance(to, T.IntegralType):
+        return Col(_float_to_integral(vals, to), validity, to).canonicalized()
+
+    # integral→integral (wraps), integral→float, float↔double
+    return Col(vals.astype(to.jnp_dtype), validity, to).canonicalized()
+
+
+def _cast_decimal(c: Col, to: T.DataType) -> Col:
+    frm = c.dtype
+    vals, validity = c.values, c.validity
+    if isinstance(frm, T.DecimalType) and isinstance(to, T.DecimalType):
+        ds = to.scale - frm.scale
+        if ds >= 0:
+            out = vals * (10 ** ds)
+        else:
+            # Spark HALF_UP rounding on scale reduction: round magnitude, reapply sign
+            div = 10 ** (-ds)
+            mag = jnp.abs(vals)
+            qm = mag // div
+            rm = mag - qm * div
+            qm = qm + (2 * rm >= div)
+            out = jnp.where(vals < 0, -qm, qm)
+        bound = 10 ** to.precision
+        ok = (out < bound) & (out > -bound)
+        return Col(out, validity & ok, to).canonicalized()
+    if isinstance(frm, T.IntegralType) and isinstance(to, T.DecimalType):
+        out = vals.astype(jnp.int64) * (10 ** to.scale)
+        bound = 10 ** to.precision
+        ok = (out < bound) & (out > -bound)
+        return Col(out, validity & ok, to).canonicalized()
+    if isinstance(frm, T.DecimalType) and isinstance(to, T.IntegralType):
+        div = 10 ** frm.scale
+        q = jnp.floor_divide(vals, div)
+        rem = vals - q * div
+        q = jnp.where((rem != 0) & (vals < 0), q + 1, q)  # truncate toward zero
+        lo, hi = _INT_BOUNDS[type(to)]
+        ok = (q >= lo) & (q <= hi)
+        return Col(q.astype(to.jnp_dtype), validity & ok, to).canonicalized()
+    if isinstance(frm, T.DecimalType) and isinstance(to, T.FractionalType):
+        return Col((vals / (10 ** frm.scale)).astype(to.jnp_dtype), validity,
+                   to).canonicalized()
+    if isinstance(frm, T.FractionalType) and isinstance(to, T.DecimalType):
+        scaled = vals.astype(jnp.float64) * (10 ** to.scale)
+        nan = jnp.isnan(scaled)
+        # HALF_UP on magnitude
+        mag = jnp.abs(scaled)
+        r = jnp.floor(mag + 0.5)
+        out64 = jnp.where(scaled < 0, -r, r)
+        bound = float(10 ** to.precision)
+        ok = ~nan & (jnp.abs(out64) < bound)
+        out = jnp.where(ok, out64, 0.0).astype(jnp.int64)
+        return Col(out, validity & ok, to).canonicalized()
+    raise TypeError(f"unsupported decimal cast {frm} -> {to}")
+
+
+# ---------------------------------------------------------------------------
+# string casts (host dictionary transforms — see ops/strings.py design note)
+# ---------------------------------------------------------------------------
+
+def _parse_integral(s: str, lo: int, hi: int):
+    """Spark UTF8String.toLong-style: trim, optional sign, digits, allow fractional
+    tail truncated toward zero; overflow/garbage → null."""
+    s = s.strip()
+    if not s:
+        return None
+    try:
+        from decimal import Decimal, InvalidOperation
+        v = Decimal(s)
+        v = int(v.to_integral_value(rounding="ROUND_DOWN"))
+    except (InvalidOperation, ValueError, ArithmeticError):
+        return None
+    if v < lo or v > hi:
+        return None
+    return v
+
+
+def _parse_double(s: str):
+    t = s.strip()
+    if not t:
+        return None
+    low = t.lower()
+    if low in ("nan",):
+        return float("nan")
+    if low in ("inf", "+inf", "infinity", "+infinity"):
+        return float("inf")
+    if low in ("-inf", "-infinity"):
+        return float("-inf")
+    try:
+        if low.endswith(("d", "f")) and not low.endswith(("nd", "nf")):
+            # Java Double.parseDouble accepts trailing D/F
+            t = t[:-1]
+        return float(t)
+    except ValueError:
+        return None
+
+
+def _parse_date(s: str):
+    """Spark DateTimeUtils.stringToDate subset: yyyy[-m[m][-d[d]]] with optional
+    trailing time part after 'T' or ' '."""
+    import datetime
+    t = s.strip()
+    for sep in ("T", " "):
+        if sep in t:
+            t = t.split(sep, 1)[0]
+    parts = t.split("-")
+    try:
+        if len(parts) == 1:
+            d = datetime.date(int(parts[0]), 1, 1)
+        elif len(parts) == 2:
+            d = datetime.date(int(parts[0]), int(parts[1]), 1)
+        elif len(parts) == 3:
+            d = datetime.date(int(parts[0]), int(parts[1]), int(parts[2]))
+        else:
+            return None
+    except ValueError:
+        return None
+    return (d - datetime.date(1970, 1, 1)).days
+
+
+def _parse_bool(s: str):
+    t = s.strip().lower()
+    if t in ("t", "true", "y", "yes", "1"):
+        return True
+    if t in ("f", "false", "n", "no", "0"):
+        return False
+    return None
+
+
+def _cast_from_string(c: Col, to: T.DataType) -> Col:
+    from spark_rapids_tpu.ops.strings import dict_transform_to_values
+    if isinstance(to, T.IntegralType):
+        lo, hi = _INT_BOUNDS[type(to)]
+        return dict_transform_to_values(c, lambda s: _parse_integral(s, lo, hi), to)
+    if isinstance(to, T.DoubleType) or isinstance(to, T.FloatType):
+        def f(s):
+            v = _parse_double(s)
+            return v
+        return dict_transform_to_values(c, f, to)
+    if isinstance(to, T.BooleanType):
+        return dict_transform_to_values(c, _parse_bool, to)
+    if isinstance(to, T.DateType):
+        return dict_transform_to_values(c, _parse_date, to)
+    if isinstance(to, T.DecimalType):
+        def fdec(s, sc=to.scale, p=to.precision):
+            from decimal import Decimal, InvalidOperation, ROUND_HALF_UP
+            try:
+                v = Decimal(s.strip()).scaleb(sc).to_integral_value(ROUND_HALF_UP)
+            except (InvalidOperation, ValueError, ArithmeticError):
+                return None
+            v = int(v)
+            return v if -(10**p) < v < 10**p else None
+        return dict_transform_to_values(c, fdec, to)
+    raise TypeError(f"unsupported cast string -> {to}")
+
+
+def _java_double_str(v: float) -> str:
+    """Java Double.toString formatting (what Spark CAST(double AS STRING) emits)."""
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "Infinity" if v > 0 else "-Infinity"
+    if v == 0:
+        return "-0.0" if math.copysign(1, v) < 0 else "0.0"
+    a = abs(v)
+    if 1e-3 <= a < 1e7:
+        s = repr(a)
+        if "e" in s or "E" in s:
+            s = f"{a:.17g}"
+        if "." not in s:
+            s += ".0"
+    else:
+        m, e = f"{a:.16E}".split("E")
+        m = m.rstrip("0").rstrip(".")
+        # recompute with python repr mantissa for shortest form
+        sh = repr(a)
+        if "e" in sh:
+            m2, e2 = sh.split("e")
+            m = m2.rstrip("0").rstrip(".") if "." in m2 else m2
+            e = e2
+        if "." not in m:
+            m += ".0"
+        s = f"{m}E{int(e)}"
+    return "-" + s if v < 0 else s
+
+
+def _java_float_str(v) -> str:
+    """Java Float.toString: shortest decimal that round-trips the FLOAT value (the
+    widened double would print spurious digits, e.g. 0.10000000149011612)."""
+    f = np.float32(v)
+    if np.isnan(f):
+        return "NaN"
+    if np.isinf(f):
+        return "Infinity" if f > 0 else "-Infinity"
+    if f == 0:
+        return "-0.0" if np.signbit(f) else "0.0"
+    # shortest decimal that round-trips the f32 value
+    short = np.format_float_positional(abs(f), unique=True, trim="-")
+    a = abs(f.item())
+    if 1e-3 <= a < 1e7:
+        s = short if "." in short else short + ".0"
+    else:
+        import math as _m
+        e = _m.floor(_m.log10(a))
+        digits = short.replace(".", "").lstrip("0").rstrip("0") or "0"
+        s = digits[0] + ("." + digits[1:] if len(digits) > 1 else ".0") + f"E{e}"
+    return "-" + s if f < 0 else s
+
+
+def _cast_to_string(c: Col) -> Col:
+    """Format via a host-built dictionary over the distinct values actually present."""
+    frm = c.dtype
+    n = int(c.values.shape[0])
+    vals = np.asarray(c.values)
+    valid = np.asarray(c.validity)
+
+    if isinstance(frm, T.BooleanType):
+        fmt = lambda v: "true" if v else "false"
+    elif isinstance(frm, T.IntegralType):
+        fmt = lambda v: str(int(v))
+    elif isinstance(frm, T.DecimalType):
+        def fmt(v, sc=frm.scale):
+            from decimal import Decimal
+            return str(Decimal(int(v)).scaleb(-sc).quantize(
+                Decimal(1).scaleb(-sc)) if sc > 0 else Decimal(int(v)))
+    elif isinstance(frm, T.DateType):
+        import datetime
+        fmt = lambda v: (datetime.date(1970, 1, 1)
+                         + datetime.timedelta(days=int(v))).isoformat()
+    elif isinstance(frm, T.TimestampType):
+        import datetime
+        def fmt(v):
+            dt = (datetime.datetime(1970, 1, 1)
+                  + datetime.timedelta(microseconds=int(v)))
+            s = dt.strftime("%Y-%m-%d %H:%M:%S")
+            if dt.microsecond:
+                s += ("%.6f" % (dt.microsecond / 1e6))[1:].rstrip("0")
+            return s
+        fmt = fmt
+    elif isinstance(frm, T.FloatType):
+        fmt = _java_float_str
+    elif isinstance(frm, T.DoubleType):
+        fmt = lambda v: _java_double_str(float(v))
+    else:
+        raise TypeError(f"unsupported cast {frm} -> string")
+
+    import pyarrow as pa
+    uv, inv = np.unique(vals, return_inverse=True)
+    strs = [fmt(v) for v in uv]
+    uniq = sorted(set(strs))
+    index = {s: i for i, s in enumerate(uniq)}
+    code_of_uv = np.array([index[s] for s in strs], dtype=np.int32)
+    codes = code_of_uv[inv.reshape(-1)]
+    codes[~valid] = 0
+    return Col(jnp.asarray(codes), c.validity, T.STRING, pa.array(uniq, type=pa.string()))
+
+
+class Cast(Expression):
+    def __init__(self, child: Expression, to: T.DataType):
+        self.children = [child]
+        self.to = to
+
+    @property
+    def dtype(self):
+        return self.to
+
+    def with_children(self, children):
+        return Cast(children[0], self.to)
+
+    def eval(self, ctx):
+        return cast_col(self.children[0].eval(ctx), self.to)
+
+    def __repr__(self):
+        return f"cast({self.children[0]!r} AS {self.to})"

@@ -1,0 +1,84 @@
+"""Conditional expressions (reference conditionalExpressions.scala: GpuIf,
+GpuCaseWhen)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Col, Expression
+
+
+def _common_type(types):
+    from spark_rapids_tpu.expr.arithmetic import promote
+    out = None
+    for t in types:
+        if isinstance(t, T.NullType):
+            continue
+        out = t if out is None else (promote(out, t) if out != t else out)
+    return out or T.NULL
+
+
+class If(Expression):
+    def __init__(self, pred, then, other):
+        self.children = [pred, then, other]
+
+    @property
+    def dtype(self):
+        return _common_type([self.children[1].dtype, self.children[2].dtype])
+
+    def with_children(self, children):
+        return If(children[0], children[1], children[2])
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.expr.arithmetic import _cast_col
+        out_t = self.dtype
+        if isinstance(out_t, T.StringType):
+            from spark_rapids_tpu.ops.strings import if_strings
+            p = self.children[0].eval(ctx)
+            return if_strings(p, self.children[1].eval(ctx), self.children[2].eval(ctx))
+        p = self.children[0].eval(ctx)
+        a = _cast_col(self.children[1].eval(ctx), out_t)
+        b = _cast_col(self.children[2].eval(ctx), out_t)
+        take_a = p.values & p.validity  # null predicate → else branch (Spark)
+        vals = jnp.where(take_a, a.values, b.values)
+        validity = jnp.where(take_a, a.validity, b.validity)
+        return Col(vals, validity, out_t).canonicalized()
+
+    def __repr__(self):
+        return f"if({self.children[0]!r}, {self.children[1]!r}, {self.children[2]!r})"
+
+
+class CaseWhen(Expression):
+    """CASE WHEN p1 THEN v1 ... ELSE e END. branches: [(pred, value), ...]."""
+
+    def __init__(self, branches, else_value=None):
+        self.branches = [(p, v) for p, v in branches]
+        self.else_value = else_value
+        self.children = [x for pv in self.branches for x in pv] + (
+            [else_value] if else_value is not None else [])
+
+    @property
+    def dtype(self):
+        ts = [v.dtype for _, v in self.branches]
+        if self.else_value is not None:
+            ts.append(self.else_value.dtype)
+        return _common_type(ts)
+
+    def with_children(self, children):
+        n = len(self.branches)
+        branches = [(children[2 * i], children[2 * i + 1]) for i in range(n)]
+        ev = children[2 * n] if self.else_value is not None else None
+        return CaseWhen(branches, ev)
+
+    def eval(self, ctx):
+        # fold right-to-left into nested Ifs — identical semantics, shares code
+        from spark_rapids_tpu.expr.core import Literal
+        out = self.else_value if self.else_value is not None else Literal(None, self.dtype)
+        for p, v in reversed(self.branches):
+            out = If(p, v, out)
+        return out.eval(ctx)
+
+    def __repr__(self):
+        bs = " ".join(f"WHEN {p!r} THEN {v!r}" for p, v in self.branches)
+        return f"CASE {bs} ELSE {self.else_value!r} END"

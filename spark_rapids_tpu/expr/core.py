@@ -1,0 +1,304 @@
+"""Expression tree + columnar evaluation — the GpuExpression layer.
+
+Reference: the ~160 GPU expressions under
+sql-plugin/src/main/scala/org/apache/spark/sql/rapids/ (SURVEY.md component #20), each
+a Catalyst Expression whose `columnarEval` issues cudf kernels. Here `Expression.eval`
+builds jax ops over a `Col` (values + validity arrays). Because jax ops are traceable,
+the SAME eval path serves two execution modes:
+
+- eager: called with concrete device arrays, one XLA dispatch per op (cudf-style);
+- fused: called inside a single jax.jit trace covering a whole project/filter/aggregate
+  stage, letting XLA fuse everything into one TPU program — the TPU-first win the
+  reference cannot express (one CUDA kernel per op).
+
+Null semantics are Spark's three-valued logic: null in → null out for most ops, with
+Kleene AND/OR, null-safe equality, and the divide-by-zero→null rule implemented
+explicitly (reference arithmetic.scala GpuDivide "divide by zero is null").
+
+String columns flow as dictionary codes; scalar string functions run on the (small,
+host-side) dictionary and become device gathers — see strings.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+
+
+@jax.tree_util.register_pytree_node_class
+class Col:
+    """A column value during evaluation: padded values + validity, plus static dtype
+    and (for strings) the host dictionary. Registered as a pytree so Cols can cross
+    jit boundaries."""
+
+    __slots__ = ("values", "validity", "dtype", "dictionary")
+
+    def __init__(self, values, validity, dtype: T.DataType, dictionary=None):
+        self.values = values
+        self.validity = validity
+        self.dtype = dtype
+        self.dictionary = dictionary
+
+    def tree_flatten(self):
+        return (self.values, self.validity), (self.dtype, self.dictionary)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0], aux[1])
+
+    @staticmethod
+    def from_vector(cv, capacity=None):
+        return Col(cv.data, cv.validity, cv.dtype, cv.dictionary)
+
+    def to_vector(self):
+        from spark_rapids_tpu.columnar.vector import TpuColumnVector
+        return TpuColumnVector(self.dtype, self.values, self.validity, self.dictionary)
+
+    @property
+    def is_string(self):
+        return isinstance(self.dtype, T.StringType)
+
+    def with_(self, values=None, validity=None, dtype=None, dictionary="__keep__"):
+        return Col(self.values if values is None else values,
+                   self.validity if validity is None else validity,
+                   self.dtype if dtype is None else dtype,
+                   self.dictionary if isinstance(dictionary, str) and dictionary == "__keep__"
+                   else dictionary)
+
+    def canonicalized(self):
+        """Force invalid slots to the dtype default (keeps hashes/sorts deterministic
+        after ops that may write garbage into null slots)."""
+        default = jnp.asarray(self.dtype.default_value(), dtype=self.values.dtype)
+        return Col(jnp.where(self.validity, self.values, default), self.validity,
+                   self.dtype, self.dictionary)
+
+
+def valid_and(*validities):
+    out = validities[0]
+    for v in validities[1:]:
+        out = out & v
+    return out
+
+
+class Expression:
+    """Base expression. Subclasses define `dtype`, `nullable`, `children`, `eval`."""
+
+    children: typing.Sequence["Expression"] = ()
+
+    @property
+    def dtype(self) -> T.DataType:
+        raise NotImplementedError
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def eval(self, ctx: "EvalContext") -> Col:
+        raise NotImplementedError
+
+    # -- tree utilities -----------------------------------------------------
+    def transform(self, fn):
+        """Bottom-up transform returning a new tree (Catalyst transformUp analog)."""
+        new_children = [c.transform(fn) for c in self.children]
+        node = self.with_children(new_children) if new_children else self
+        return fn(node)
+
+    def with_children(self, children):
+        if not children:
+            return self
+        clone = dataclasses.replace(self) if dataclasses.is_dataclass(self) else self
+        clone.children = list(children)
+        return clone
+
+    def collect(self, pred):
+        out = [self] if pred(self) else []
+        for c in self.children:
+            out.extend(c.collect(pred))
+        return out
+
+    @property
+    def name(self):
+        return str(self)
+
+
+class EvalContext:
+    """Holds the input columns (as Cols) for bound-reference lookup during eval, the
+    number-of-rows scalar, and the batch capacity (static)."""
+
+    def __init__(self, cols, num_rows, capacity: int):
+        self.cols = list(cols)
+        self.num_rows = num_rows  # device or host scalar
+        self.capacity = capacity
+
+    @staticmethod
+    def from_batch(batch):
+        return EvalContext([Col.from_vector(c) for c in batch.columns],
+                           batch.lazy_num_rows, batch.capacity)
+
+    def row_mask(self):
+        """Bool mask of live (non-padding) rows."""
+        return jnp.arange(self.capacity) < self.num_rows
+
+
+class AttributeReference(Expression):
+    """Named column reference, resolved to a BoundReference before execution
+    (Catalyst AttributeReference analog)."""
+
+    def __init__(self, name: str, dtype: T.DataType, nullable: bool = True):
+        self._name = name
+        self._dtype = dtype
+        self._nullable = nullable
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return self._nullable
+
+    @property
+    def name(self):
+        return self._name
+
+    def eval(self, ctx):
+        raise RuntimeError(f"unresolved attribute {self._name}; bind_references first")
+
+    def __repr__(self):
+        return f"'{self._name}"
+
+
+class BoundReference(Expression):
+    def __init__(self, ordinal: int, dtype: T.DataType, nullable: bool = True,
+                 name: str = None):
+        self.ordinal = ordinal
+        self._dtype = dtype
+        self._nullable = nullable
+        self._name = name or f"input[{ordinal}]"
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return self._nullable
+
+    @property
+    def name(self):
+        return self._name
+
+    def eval(self, ctx):
+        return ctx.cols[self.ordinal]
+
+    def __repr__(self):
+        return f"input[{self.ordinal}:{self._dtype}]"
+
+
+class Literal(Expression):
+    def __init__(self, value, dtype: T.DataType | None = None):
+        self.value = value
+        if dtype is None:
+            dtype = _infer_literal_type(value)
+        self._dtype = dtype
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return self.value is None
+
+    def eval(self, ctx):
+        cap = ctx.capacity
+        if self.value is None:
+            vals = jnp.full((cap,), self._dtype.default_value(),
+                            dtype=self._dtype.jnp_dtype)
+            return Col(vals, jnp.zeros((cap,), jnp.bool_), self._dtype)
+        if isinstance(self._dtype, T.StringType):
+            import pyarrow as pa
+            d = pa.array([self.value], type=pa.string())
+            return Col(jnp.zeros((cap,), jnp.int32), jnp.ones((cap,), jnp.bool_),
+                       self._dtype, dictionary=d)
+        v = self.value
+        if isinstance(self._dtype, T.DecimalType) and not isinstance(v, int):
+            from decimal import Decimal
+            v = int(Decimal(str(v)).scaleb(self._dtype.scale))
+        vals = jnp.full((cap,), v, dtype=self._dtype.jnp_dtype)
+        return Col(vals, jnp.ones((cap,), jnp.bool_), self._dtype)
+
+    def __repr__(self):
+        return f"lit({self.value!r})"
+
+
+def _infer_literal_type(v):
+    if v is None:
+        return T.NULL
+    if isinstance(v, bool):
+        return T.BOOLEAN
+    if isinstance(v, int):
+        return T.INT if -(2**31) <= v < 2**31 else T.LONG
+    if isinstance(v, float):
+        return T.DOUBLE
+    if isinstance(v, str):
+        return T.STRING
+    raise TypeError(f"cannot infer literal type for {v!r}")
+
+
+class Alias(Expression):
+    def __init__(self, child: Expression, alias: str):
+        self.children = [child]
+        self.alias = alias
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def nullable(self):
+        return self.child.nullable
+
+    @property
+    def name(self):
+        return self.alias
+
+    def eval(self, ctx):
+        return self.child.eval(ctx)
+
+    def with_children(self, children):
+        return Alias(children[0], self.alias)
+
+    def __repr__(self):
+        return f"{self.child!r} AS {self.alias}"
+
+
+def bind_references(expr: Expression, schema: T.StructType) -> Expression:
+    """Replace AttributeReferences with BoundReferences against `schema`
+    (Catalyst BindReferences.bindReference analog, used by every exec)."""
+    def fn(node):
+        if isinstance(node, AttributeReference):
+            i = schema.index_of(node.name)
+            f = schema[i]
+            return BoundReference(i, f.data_type, f.nullable, node.name)
+        return node
+    return expr.transform(fn)
+
+
+# convenience: column factory used by the DataFrame layer and tests
+def col(name: str, dtype: T.DataType = None, nullable: bool = True):
+    return AttributeReference(name, dtype, nullable)
+
+
+def lit(value, dtype: T.DataType | None = None):
+    return Literal(value, dtype)

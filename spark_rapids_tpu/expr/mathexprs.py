@@ -1,0 +1,288 @@
+"""Math expressions (reference mathExpressions.scala, 447 LoC: GpuSqrt, GpuFloor,
+GpuCeil, GpuRound, GpuExp, GpuLog, GpuPow, trig…). Spark specifics: floor/ceil of
+double returns LONG; round is HALF_UP (Java BigDecimal), not banker's; log of
+non-positive is null (Spark returns null, Java would return NaN)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Col, Expression
+from spark_rapids_tpu.expr.arithmetic import _cast_col
+
+
+class _UnaryMath(Expression):
+    """double → double elementwise."""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    def with_children(self, children):
+        return type(self)(children[0])
+
+    def eval(self, ctx):
+        c = _cast_col(self.children[0].eval(ctx), T.DOUBLE)
+        return Col(self.op(c.values), c.validity, T.DOUBLE).canonicalized()
+
+    def op(self, v):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__.lower()}({self.children[0]!r})"
+
+
+class Sqrt(_UnaryMath):
+    def op(self, v):
+        return jnp.sqrt(v)
+
+
+class Exp(_UnaryMath):
+    def op(self, v):
+        return jnp.exp(v)
+
+
+class Sin(_UnaryMath):
+    def op(self, v):
+        return jnp.sin(v)
+
+
+class Cos(_UnaryMath):
+    def op(self, v):
+        return jnp.cos(v)
+
+
+class Tan(_UnaryMath):
+    def op(self, v):
+        return jnp.tan(v)
+
+
+class Asin(_UnaryMath):
+    def op(self, v):
+        return jnp.arcsin(v)
+
+
+class Acos(_UnaryMath):
+    def op(self, v):
+        return jnp.arccos(v)
+
+
+class Atan(_UnaryMath):
+    def op(self, v):
+        return jnp.arctan(v)
+
+
+class Cbrt(_UnaryMath):
+    def op(self, v):
+        return jnp.cbrt(v)
+
+
+class Signum(_UnaryMath):
+    def op(self, v):
+        return jnp.sign(v)
+
+
+class ToDegrees(_UnaryMath):
+    def op(self, v):
+        return jnp.degrees(v)
+
+
+class ToRadians(_UnaryMath):
+    def op(self, v):
+        return jnp.radians(v)
+
+
+class Log(Expression):
+    """ln(x); Spark returns null for x <= 0 (not NaN)."""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    def with_children(self, children):
+        return type(self)(children[0])
+
+    def eval(self, ctx):
+        c = _cast_col(self.children[0].eval(ctx), T.DOUBLE)
+        ok = c.values > 0
+        vals = jnp.log(jnp.where(ok, c.values, 1.0))
+        return Col(self.post(vals), c.validity & ok, T.DOUBLE).canonicalized()
+
+    def post(self, v):
+        return v
+
+    def __repr__(self):
+        return f"log({self.children[0]!r})"
+
+
+class Log2(Log):
+    def post(self, v):
+        return v / jnp.log(2.0)
+
+
+class Log10(Log):
+    def post(self, v):
+        return v / jnp.log(10.0)
+
+
+class Log1p(Expression):
+    def __init__(self, child):
+        self.children = [child]
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    def with_children(self, children):
+        return Log1p(children[0])
+
+    def eval(self, ctx):
+        c = _cast_col(self.children[0].eval(ctx), T.DOUBLE)
+        ok = c.values > -1
+        vals = jnp.log1p(jnp.where(ok, c.values, 0.0))
+        return Col(vals, c.validity & ok, T.DOUBLE).canonicalized()
+
+
+class Pow(Expression):
+    def __init__(self, left, right):
+        self.children = [left, right]
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    def with_children(self, children):
+        return Pow(children[0], children[1])
+
+    def eval(self, ctx):
+        l = _cast_col(self.children[0].eval(ctx), T.DOUBLE)
+        r = _cast_col(self.children[1].eval(ctx), T.DOUBLE)
+        validity = l.validity & r.validity
+        return Col(jnp.power(l.values, r.values), validity, T.DOUBLE).canonicalized()
+
+    def __repr__(self):
+        return f"pow({self.children[0]!r}, {self.children[1]!r})"
+
+
+class Atan2(Expression):
+    def __init__(self, left, right):
+        self.children = [left, right]
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    def with_children(self, children):
+        return Atan2(children[0], children[1])
+
+    def eval(self, ctx):
+        l = _cast_col(self.children[0].eval(ctx), T.DOUBLE)
+        r = _cast_col(self.children[1].eval(ctx), T.DOUBLE)
+        return Col(jnp.arctan2(l.values, r.values), l.validity & r.validity,
+                   T.DOUBLE).canonicalized()
+
+
+class Floor(Expression):
+    """floor(double) → LONG in Spark (decimal floor keeps decimal)."""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    @property
+    def dtype(self):
+        ct = self.children[0].dtype
+        if isinstance(ct, T.DecimalType):
+            return T.DecimalType(ct.precision, 0)
+        if isinstance(ct, T.IntegralType):
+            return ct
+        return T.LONG
+
+    def with_children(self, children):
+        return type(self)(children[0])
+
+    def eval(self, ctx):
+        ct = self.children[0].dtype
+        c = self.children[0].eval(ctx)
+        if isinstance(ct, T.IntegralType):
+            return c
+        if isinstance(ct, T.DecimalType):
+            div = 10 ** ct.scale
+            q = jnp.floor_divide(c.values, div)
+            return Col(q, c.validity, self.dtype).canonicalized()
+        from spark_rapids_tpu.expr.cast import _float_to_integral
+        v = self.round_op(_cast_col(c, T.DOUBLE).values)
+        return Col(_float_to_integral(v, T.LONG), c.validity, T.LONG).canonicalized()
+
+    def round_op(self, v):
+        return jnp.floor(v)
+
+    def __repr__(self):
+        return f"{type(self).__name__.lower()}({self.children[0]!r})"
+
+
+class Ceil(Floor):
+    def round_op(self, v):
+        return jnp.ceil(v)
+
+    def eval(self, ctx):
+        ct = self.children[0].dtype
+        if isinstance(ct, T.DecimalType):
+            c = self.children[0].eval(ctx)
+            div = 10 ** ct.scale
+            q = -jnp.floor_divide(-c.values, div)
+            return Col(q, c.validity, self.dtype).canonicalized()
+        return super().eval(ctx)
+
+
+class Round(Expression):
+    """round(x, d) HALF_UP (Spark/Hive), unlike numpy's banker's rounding."""
+
+    def __init__(self, child, digits: int = 0):
+        self.children = [child]
+        self.digits = digits
+
+    @property
+    def dtype(self):
+        ct = self.children[0].dtype
+        if isinstance(ct, (T.IntegralType, T.DecimalType)):
+            return ct
+        return ct  # float/double keep their type
+
+    def with_children(self, children):
+        return Round(children[0], self.digits)
+
+    def eval(self, ctx):
+        ct = self.children[0].dtype
+        c = self.children[0].eval(ctx)
+        d = self.digits
+        if isinstance(ct, T.IntegralType):
+            if d >= 0:
+                return c
+            div = 10 ** (-d)
+            mag = jnp.abs(c.values)
+            qm = (mag + div // 2) // div * div
+            return Col(jnp.where(c.values < 0, -qm, qm).astype(c.values.dtype),
+                       c.validity, ct).canonicalized()
+        if isinstance(ct, T.DecimalType):
+            ds = ct.scale - d
+            if ds <= 0:
+                return c
+            div = 10 ** ds
+            mag = jnp.abs(c.values)
+            qm = (mag + div // 2) // div * div
+            return Col(jnp.where(c.values < 0, -qm, qm), c.validity, ct).canonicalized()
+        scale = 10.0 ** d
+        v = c.values * scale
+        mag = jnp.floor(jnp.abs(v) + 0.5)
+        out = jnp.where(v < 0, -mag, mag) / scale
+        return Col(out.astype(c.values.dtype), c.validity, ct).canonicalized()
+
+    def __repr__(self):
+        return f"round({self.children[0]!r}, {self.digits})"

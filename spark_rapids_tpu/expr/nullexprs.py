@@ -1,0 +1,144 @@
+"""Null-handling expressions (reference nullExpressions.scala: GpuIsNull,
+GpuIsNotNull, GpuCoalesce, GpuIsNan, GpuNaNvl, GpuNvl)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Col, Expression
+
+
+class IsNull(Expression):
+    def __init__(self, child):
+        self.children = [child]
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+    def with_children(self, children):
+        return IsNull(children[0])
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        live = ctx.row_mask()
+        return Col(~c.validity & live, jnp.ones_like(c.validity), T.BOOLEAN)
+
+    def __repr__(self):
+        return f"isnull({self.children[0]!r})"
+
+
+class IsNotNull(Expression):
+    def __init__(self, child):
+        self.children = [child]
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+    def with_children(self, children):
+        return IsNotNull(children[0])
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        return Col(c.validity, jnp.ones_like(c.validity), T.BOOLEAN)
+
+    def __repr__(self):
+        return f"isnotnull({self.children[0]!r})"
+
+
+class IsNaN(Expression):
+    def __init__(self, child):
+        self.children = [child]
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+    def with_children(self, children):
+        return IsNaN(children[0])
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        return Col(jnp.isnan(c.values) & c.validity, jnp.ones_like(c.validity),
+                   T.BOOLEAN)
+
+    def __repr__(self):
+        return f"isnan({self.children[0]!r})"
+
+
+class Coalesce(Expression):
+    """First non-null child value per row."""
+
+    def __init__(self, *children):
+        self.children = list(children)
+
+    @property
+    def dtype(self):
+        from spark_rapids_tpu.expr.arithmetic import promote
+        t = self.children[0].dtype
+        for c in self.children[1:]:
+            if not isinstance(c.dtype, T.NullType):
+                t = c.dtype if isinstance(t, T.NullType) else promote(t, c.dtype)
+        return t
+
+    def with_children(self, children):
+        return Coalesce(*children)
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.expr.arithmetic import _cast_col
+        out_t = self.dtype
+        if isinstance(out_t, T.StringType):
+            from spark_rapids_tpu.ops.strings import coalesce_strings
+            return coalesce_strings([c.eval(ctx) for c in self.children])
+        cols = [_cast_col(c.eval(ctx), out_t) for c in self.children]
+        vals = cols[-1].values
+        validity = cols[-1].validity
+        for c in reversed(cols[:-1]):
+            vals = jnp.where(c.validity, c.values, vals)
+            validity = c.validity | validity
+        return Col(vals, validity, out_t).canonicalized()
+
+    def __repr__(self):
+        return f"coalesce({', '.join(map(repr, self.children))})"
+
+
+class NaNvl(Expression):
+    """nanvl(a, b): a unless a is NaN, then b."""
+
+    def __init__(self, left, right):
+        self.children = [left, right]
+
+    @property
+    def dtype(self):
+        from spark_rapids_tpu.expr.arithmetic import promote
+        return promote(self.children[0].dtype, self.children[1].dtype)
+
+    def with_children(self, children):
+        return NaNvl(children[0], children[1])
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.expr.arithmetic import _cast_col
+        out_t = self.dtype
+        l = _cast_col(self.children[0].eval(ctx), out_t)
+        r = _cast_col(self.children[1].eval(ctx), out_t)
+        use_r = jnp.isnan(l.values) & l.validity
+        vals = jnp.where(use_r, r.values, l.values)
+        validity = jnp.where(use_r, r.validity, l.validity)
+        return Col(vals, validity, out_t).canonicalized()
+
+    def __repr__(self):
+        return f"nanvl({self.children[0]!r}, {self.children[1]!r})"

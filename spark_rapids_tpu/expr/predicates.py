@@ -1,0 +1,257 @@
+"""Comparison and boolean predicates with Spark three-valued logic.
+
+Reference: sql-plugin/.../org/apache/spark/sql/rapids/predicates.scala (631 LoC):
+GpuEqualTo/GpuLessThan/... map to cudf comparators; GpuAnd/GpuOr implement Kleene
+logic (false AND null = false, true OR null = true); GpuEqualNullSafe (<=>).
+
+Spark float comparison details honored here (reference GpuGreaterThan etc. rely on
+cudf NaN handling + spark.rapids.sql.hasNans): NaN == NaN is TRUE in Spark, and NaN is
+greater than every other value. -0.0 == 0.0.
+
+String comparisons run over dictionary codes after aligning both sides onto one sorted
+union dictionary (order-preserving), so <,= on codes equals the string comparison.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Col, Expression, valid_and
+from spark_rapids_tpu.expr.arithmetic import promote, _cast_col
+
+
+def align_strings(l: Col, r: Col):
+    """Remap two string Cols onto a shared sorted dictionary (host union + device
+    gather). Order-preserving, so code comparisons == string comparisons."""
+    from spark_rapids_tpu.ops.strings import union_dictionaries
+    return union_dictionaries(l, r)
+
+
+def _comparable(l: Col, r: Col, ldt: T.DataType, rdt: T.DataType):
+    if isinstance(ldt, T.StringType) and isinstance(rdt, T.StringType):
+        return align_strings(l, r)
+    if ldt == rdt:
+        return l, r
+    ct = promote(ldt, rdt)
+    return _cast_col(l, ct), _cast_col(r, ct)
+
+
+def _float_total(lv, rv, op):
+    """Comparison with Spark NaN semantics: NaN equals NaN and sorts above +inf."""
+    l_nan = jnp.isnan(lv)
+    r_nan = jnp.isnan(rv)
+    if op == "eq":
+        return jnp.where(l_nan & r_nan, True, lv == rv)
+    if op == "lt":
+        return jnp.where(l_nan, False, jnp.where(r_nan, True, lv < rv))
+    if op == "le":
+        return jnp.where(l_nan, r_nan, jnp.where(r_nan, True, lv <= rv))
+    raise AssertionError(op)
+
+
+class BinaryComparison(Expression):
+    symbol = "?"
+
+    def __init__(self, left, right):
+        self.children = [left, right]
+
+    @property
+    def left(self):
+        return self.children[0]
+
+    @property
+    def right(self):
+        return self.children[1]
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    def eval(self, ctx):
+        l, r = self.left.eval(ctx), self.right.eval(ctx)
+        l, r = _comparable(l, r, self.left.dtype, self.right.dtype)
+        validity = valid_and(l.validity, r.validity)
+        vals = self.compare(l.values, r.values, isinstance(l.dtype, T.FractionalType))
+        return Col(vals & validity, validity, T.BOOLEAN)
+
+    def compare(self, lv, rv, is_float):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"({self.left!r} {self.symbol} {self.right!r})"
+
+
+class EqualTo(BinaryComparison):
+    symbol = "="
+
+    def compare(self, lv, rv, is_float):
+        return _float_total(lv, rv, "eq") if is_float else lv == rv
+
+
+class LessThan(BinaryComparison):
+    symbol = "<"
+
+    def compare(self, lv, rv, is_float):
+        return _float_total(lv, rv, "lt") if is_float else lv < rv
+
+
+class LessThanOrEqual(BinaryComparison):
+    symbol = "<="
+
+    def compare(self, lv, rv, is_float):
+        return _float_total(lv, rv, "le") if is_float else lv <= rv
+
+
+class GreaterThan(BinaryComparison):
+    symbol = ">"
+
+    def compare(self, lv, rv, is_float):
+        return _float_total(rv, lv, "lt") if is_float else lv > rv
+
+
+class GreaterThanOrEqual(BinaryComparison):
+    symbol = ">="
+
+    def compare(self, lv, rv, is_float):
+        return _float_total(rv, lv, "le") if is_float else lv >= rv
+
+
+class NotEqual(BinaryComparison):
+    symbol = "!="
+
+    def compare(self, lv, rv, is_float):
+        eq = _float_total(lv, rv, "eq") if is_float else lv == rv
+        return ~eq
+
+
+class EqualNullSafe(BinaryComparison):
+    """<=>: null <=> null is TRUE, never returns null."""
+    symbol = "<=>"
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx):
+        l, r = self.left.eval(ctx), self.right.eval(ctx)
+        l, r = _comparable(l, r, self.left.dtype, self.right.dtype)
+        both_valid = valid_and(l.validity, r.validity)
+        both_null = ~l.validity & ~r.validity
+        if isinstance(l.dtype, T.FractionalType):
+            eq = _float_total(l.values, r.values, "eq")
+        else:
+            eq = l.values == r.values
+        vals = (both_valid & eq) | both_null
+        return Col(vals, jnp.ones_like(vals), T.BOOLEAN)
+
+
+class And(Expression):
+    """Kleene AND: F & x = F; T & null = null."""
+
+    def __init__(self, left, right):
+        self.children = [left, right]
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    def with_children(self, children):
+        return And(children[0], children[1])
+
+    def eval(self, ctx):
+        l = self.children[0].eval(ctx)
+        r = self.children[1].eval(ctx)
+        lv = l.values & l.validity
+        rv = r.values & r.validity
+        false_l = l.validity & ~l.values
+        false_r = r.validity & ~r.values
+        vals = lv & rv
+        validity = (l.validity & r.validity) | false_l | false_r
+        return Col(vals & validity, validity, T.BOOLEAN)
+
+    def __repr__(self):
+        return f"({self.children[0]!r} AND {self.children[1]!r})"
+
+
+class Or(Expression):
+    """Kleene OR: T | x = T; F | null = null."""
+
+    def __init__(self, left, right):
+        self.children = [left, right]
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    def with_children(self, children):
+        return Or(children[0], children[1])
+
+    def eval(self, ctx):
+        l = self.children[0].eval(ctx)
+        r = self.children[1].eval(ctx)
+        true_l = l.validity & l.values
+        true_r = r.validity & r.values
+        vals = true_l | true_r
+        validity = (l.validity & r.validity) | true_l | true_r
+        return Col(vals & validity, validity, T.BOOLEAN)
+
+    def __repr__(self):
+        return f"({self.children[0]!r} OR {self.children[1]!r})"
+
+
+class Not(Expression):
+    def __init__(self, child):
+        self.children = [child]
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    def with_children(self, children):
+        return Not(children[0])
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        return Col(~c.values & c.validity, c.validity, T.BOOLEAN)
+
+    def __repr__(self):
+        return f"(NOT {self.children[0]!r})"
+
+
+class In(Expression):
+    """IN over a literal list (reference GpuInSet). Null semantics: x IN (...) is null
+    if x is null, or if no match and the list contains null."""
+
+    def __init__(self, child, values: list):
+        self.children = [child]
+        self.values = values
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    def with_children(self, children):
+        return In(children[0], self.values)
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.expr.core import Literal
+        c = self.children[0].eval(ctx)
+        has_null = any(v is None for v in self.values)
+        non_null = [v for v in self.values if v is not None]
+        match = jnp.zeros_like(c.validity)
+        for v in non_null:
+            lc = Literal(v, self.children[0].dtype).eval(ctx)
+            if c.is_string:
+                l2, r2 = _comparable(c, lc, c.dtype, lc.dtype)
+                match = match | (l2.values == r2.values)
+            else:
+                match = match | (c.values == lc.values)
+        validity = c.validity & (match | (~jnp.full_like(match, has_null)))
+        return Col(match & validity, validity, T.BOOLEAN)
+
+    def __repr__(self):
+        return f"({self.children[0]!r} IN {self.values!r})"

@@ -1,0 +1,211 @@
+"""String expressions (reference stringFunctions.scala: GpuUpper, GpuLower,
+GpuStringLocate, GpuSubstring, GpuStartsWith, GpuEndsWith, GpuContains, GpuLike,
+GpuConcat, GpuStringTrim…). All are dictionary transforms — see ops/strings.py."""
+
+from __future__ import annotations
+
+import re
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Col, Expression, Literal
+from spark_rapids_tpu.ops import strings as S
+
+
+class _UnaryString(Expression):
+    out_dtype = T.STRING
+
+    def __init__(self, child):
+        self.children = [child]
+
+    @property
+    def dtype(self):
+        return self.out_dtype
+
+    def with_children(self, children):
+        return type(self)(children[0])
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        if self.out_dtype == T.STRING:
+            return S.dict_transform_to_string(c, self.fn)
+        return S.dict_transform_to_values(c, self.fn, self.out_dtype)
+
+    def fn(self, s):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__.lower()}({self.children[0]!r})"
+
+
+class Upper(_UnaryString):
+    def fn(self, s):
+        return s.upper()
+
+
+class Lower(_UnaryString):
+    def fn(self, s):
+        return s.lower()
+
+
+class Length(_UnaryString):
+    out_dtype = T.INT
+
+    def fn(self, s):
+        return S.java_length(s)
+
+
+class Trim(_UnaryString):
+    def fn(self, s):
+        return s.strip(" ")
+
+
+class LTrim(_UnaryString):
+    def fn(self, s):
+        return s.lstrip(" ")
+
+
+class RTrim(_UnaryString):
+    def fn(self, s):
+        return s.rstrip(" ")
+
+
+class Reverse(_UnaryString):
+    def fn(self, s):
+        return s[::-1]
+
+
+class InitCap(_UnaryString):
+    def fn(self, s):
+        return " ".join(w[:1].upper() + w[1:].lower() if w else w
+                        for w in s.split(" "))
+
+
+class Substring(Expression):
+    """substring(str, pos[, len]) — Spark 1-based indexing, negative pos from end."""
+
+    def __init__(self, child, pos: Expression, length: Expression | None = None):
+        self.children = [child, pos] + ([length] if length is not None else [])
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def with_children(self, children):
+        return Substring(children[0], children[1],
+                         children[2] if len(children) > 2 else None)
+
+    def eval(self, ctx):
+        pos = self.children[1]
+        length = self.children[2] if len(self.children) > 2 else None
+        assert isinstance(pos, Literal) and (length is None or isinstance(length, Literal)), \
+            "substring pos/len must be literals (reference has the same GPU limitation)"
+        p = pos.value
+        ln = length.value if length is not None else None
+        c = self.children[0].eval(ctx)
+        return S.dict_transform_to_string(c, lambda s: S.java_substring(s, p, ln))
+
+    def __repr__(self):
+        return f"substring({self.children[0]!r})"
+
+
+class _StringPredicate(Expression):
+    def __init__(self, child, pattern: Expression):
+        self.children = [child, pattern]
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    def eval(self, ctx):
+        pat = self.children[1]
+        assert isinstance(pat, Literal), \
+            "pattern must be a literal (reference GpuStartsWith has the same limit)"
+        p = pat.value
+        c = self.children[0].eval(ctx)
+        return S.dict_transform_to_values(c, lambda s: self.test(s, p), T.BOOLEAN)
+
+    def test(self, s, p):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__.lower()}({self.children[0]!r}, {self.children[1]!r})"
+
+
+class StartsWith(_StringPredicate):
+    def test(self, s, p):
+        return s.startswith(p)
+
+
+class EndsWith(_StringPredicate):
+    def test(self, s, p):
+        return s.endswith(p)
+
+
+class Contains(_StringPredicate):
+    def test(self, s, p):
+        return p in s
+
+
+class Like(_StringPredicate):
+    def eval(self, ctx):
+        pat = self.children[1]
+        assert isinstance(pat, Literal)
+        rx = re.compile(S.like_to_regex(pat.value))
+        c = self.children[0].eval(ctx)
+        return S.dict_transform_to_values(
+            c, lambda s: rx.match(s) is not None, T.BOOLEAN)
+
+
+class RLike(_StringPredicate):
+    def eval(self, ctx):
+        pat = self.children[1]
+        assert isinstance(pat, Literal)
+        rx = re.compile(pat.value)
+        c = self.children[0].eval(ctx)
+        return S.dict_transform_to_values(
+            c, lambda s: rx.search(s) is not None, T.BOOLEAN)
+
+
+class Concat(Expression):
+    """concat of string columns/literals; null if any input null (Spark concat)."""
+
+    def __init__(self, *children):
+        self.children = list(children)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def with_children(self, children):
+        return Concat(*children)
+
+    def eval(self, ctx):
+        cols = [c.eval(ctx) for c in self.children]
+        out = cols[0]
+        for c in cols[1:]:
+            out = S.concat_cols(out, c)
+        return out
+
+    def __repr__(self):
+        return f"concat({', '.join(map(repr, self.children))})"
+
+
+class StringReplace(Expression):
+    def __init__(self, child, search: Expression, replace: Expression):
+        self.children = [child, search, replace]
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def with_children(self, children):
+        return StringReplace(children[0], children[1], children[2])
+
+    def eval(self, ctx):
+        se, re_ = self.children[1], self.children[2]
+        assert isinstance(se, Literal) and isinstance(re_, Literal)
+        c = self.children[0].eval(ctx)
+        return S.dict_transform_to_string(c, lambda s: s.replace(se.value, re_.value))

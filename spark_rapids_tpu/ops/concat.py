@@ -1,0 +1,51 @@
+"""Batch concatenation on device (reference: cudf Table.concatenate driven by
+GpuCoalesceBatches / ConcatAndConsumeAll). Implemented as dynamic_update_slice into a
+fresh padded buffer so it fuses and works with device-scalar row counts."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.vector import TpuColumnVector, bucket_capacity
+from spark_rapids_tpu.expr.core import Col
+
+
+def concat_batches(batches) -> ColumnarBatch:
+    """Concatenate batches (host-known row counts) into one device batch."""
+    batches = list(batches)
+    if len(batches) == 1:
+        return batches[0]
+    schema = batches[0].schema
+    total = sum(b.num_rows for b in batches)
+    cap = bucket_capacity(total)
+    ncols = batches[0].num_cols
+
+    # align string dictionaries per column across batches
+    from spark_rapids_tpu.ops.strings import align_many
+    per_col = []
+    for ci in range(ncols):
+        cols = [Col.from_vector(b.column(ci)) for b in batches]
+        if cols[0].is_string:
+            cols = align_many(cols)
+        per_col.append(cols)
+
+    out_cols = []
+    for ci in range(ncols):
+        cols = per_col[ci]
+        dt = cols[0].dtype
+        vals = jnp.full((cap,), dt.default_value(), dtype=cols[0].values.dtype)
+        valid = jnp.zeros((cap,), jnp.bool_)
+        off = 0
+        for b, c in zip(batches, cols):
+            n = b.num_rows
+            if n == 0:
+                continue
+            vals = jax.lax.dynamic_update_slice(vals, c.values[:n], (off,))
+            valid = jax.lax.dynamic_update_slice(valid, c.validity[:n], (off,))
+            off += n
+        out_cols.append(TpuColumnVector(dt, vals, valid,
+                                        cols[0].dictionary))
+    return ColumnarBatch(out_cols, total, schema)

@@ -1,0 +1,66 @@
+"""Filter/compaction and gather kernels.
+
+Reference: cudf apply_boolean_mask via GpuFilterExec (basicPhysicalOperators.scala:181).
+cudf compacts to a new smaller column; XLA needs static shapes, so we compact IN PLACE
+within the padded capacity: surviving rows are moved to the front (stable), the live
+row count becomes a device scalar, and the tail is marked invalid. The whole thing is
+a fused sort-by-flag — no host sync, so filters chain inside one XLA program."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from spark_rapids_tpu.expr.core import Col
+
+
+def selection_mask(pred: Col, num_rows, capacity: int):
+    """Rows kept by a filter: predicate true AND valid AND a live (non-pad) row."""
+    live = jnp.arange(capacity) < num_rows
+    return pred.values & pred.validity & live
+
+
+def compact_cols(cols, keep_mask):
+    """Stable-move surviving rows to the front. Returns (new_cols, new_count)."""
+    capacity = keep_mask.shape[0]
+    # stable argsort of the inverted mask: kept rows (False) first, original order
+    perm = jnp.argsort(~keep_mask, stable=True)
+    count = jnp.sum(keep_mask, dtype=jnp.int32)
+    live = jnp.arange(capacity, dtype=jnp.int32) < count
+    out = []
+    for c in cols:
+        vals = c.values[perm]
+        validity = c.validity[perm] & live
+        default = jnp.asarray(c.dtype.default_value(), dtype=vals.dtype)
+        out.append(Col(jnp.where(validity, vals, default), validity, c.dtype,
+                       c.dictionary))
+    return out, count
+
+
+def gather_cols(cols, indices, valid_out):
+    """Gather rows by index (join/sort output). valid_out masks output slots."""
+    out = []
+    for c in cols:
+        vals = c.values[indices]
+        validity = c.validity[indices] & valid_out
+        default = jnp.asarray(c.dtype.default_value(), dtype=vals.dtype)
+        out.append(Col(jnp.where(validity, vals, default), validity, c.dtype,
+                       c.dictionary))
+    return out
+
+
+def slice_to_capacity(cols, count, new_capacity: int):
+    """Shrink/grow the padded capacity (host-known count required)."""
+    out = []
+    for c in cols:
+        if new_capacity <= c.values.shape[0]:
+            vals = c.values[:new_capacity]
+            validity = c.validity[:new_capacity]
+        else:
+            pad = new_capacity - c.values.shape[0]
+            default = jnp.asarray(c.dtype.default_value(), dtype=c.values.dtype)
+            vals = jnp.concatenate([c.values, jnp.full((pad,), default)])
+            validity = jnp.concatenate([c.validity, jnp.zeros((pad,), jnp.bool_)])
+        out.append(Col(vals, validity, c.dtype, c.dictionary))
+    return out

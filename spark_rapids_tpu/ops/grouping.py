@@ -1,0 +1,120 @@
+"""Sort-based group-by — the cudf groupby analog under XLA's static-shape regime.
+
+Reference: GpuHashAggregateExec (aggregate.scala:240) calls cudf hash groupby, whose
+output size is data-dependent. XLA cannot produce data-dependent shapes, so the
+TPU-native design is a FUSED sort-based pipeline within the padded capacity:
+
+    sort rows by keys → flag group boundaries → segment-reduce values
+    → compact one row per group to the front → group count as a device scalar
+
+Everything is one XLA program (sort + cumsum + segment ops + gather); the number of
+groups never exceeds the number of live rows, so the input capacity bounds the output.
+Null keys form their own group (Spark GROUP BY semantics); null aggregation semantics
+(sum ignores nulls, null iff no non-null input, NaN handling in min/max) live in
+expr/aggregates.py which drives these primitives.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Col
+from spark_rapids_tpu.ops.sorting import sort_permutation, SortOrder
+from spark_rapids_tpu.ops.filtering import gather_cols, compact_cols
+
+
+def group_segments(key_cols, num_rows, capacity: int):
+    """Sort by keys and compute segment structure.
+
+    Returns (perm, seg_ids, boundary, live) where perm is the sorting permutation,
+    seg_ids[i] is the group index of sorted row i (padding rows get group capacity-1
+    overflow bucket that is later discarded), boundary marks first row of each group.
+    """
+    orders = [SortOrder() for _ in key_cols]
+    perm = sort_permutation(key_cols, orders, num_rows, capacity)
+    live = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+    sorted_keys = gather_cols(key_cols, perm, live)
+
+    neq = jnp.zeros((capacity,), jnp.bool_)
+    for c in sorted_keys:
+        prev_vals = jnp.roll(c.values, 1)
+        prev_valid = jnp.roll(c.validity, 1)
+        if isinstance(c.dtype, T.FractionalType):
+            # NaN == NaN for grouping (Spark), -0.0 == 0.0 (canonicalized already)
+            a, b = c.values, prev_vals
+            both_nan = jnp.isnan(a) & jnp.isnan(b)
+            differs = ~both_nan & ~(a == b)
+        else:
+            differs = c.values != prev_vals
+        neq = neq | differs | (c.validity != prev_valid)
+    first_live = jnp.arange(capacity) == 0
+    boundary = (first_live | neq) & live
+    seg_ids = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    seg_ids = jnp.where(live, seg_ids, capacity - 1)
+    seg_ids = jnp.clip(seg_ids, 0, capacity - 1)
+    return perm, seg_ids, boundary, live
+
+
+def segment_sum(values, validity, seg_ids, capacity):
+    data = jnp.where(validity, values, jnp.zeros_like(values))
+    s = jax.ops.segment_sum(data, seg_ids, num_segments=capacity)
+    cnt = jax.ops.segment_sum(validity.astype(jnp.int64), seg_ids,
+                              num_segments=capacity)
+    return s, cnt
+
+
+def segment_min(values, validity, seg_ids, capacity, dtype: T.DataType):
+    if isinstance(dtype, T.FractionalType):
+        sentinel = jnp.asarray(jnp.inf, values.dtype)
+        nan = jnp.isnan(values)
+        data = jnp.where(validity & ~nan, values, sentinel)
+        m = jax.ops.segment_min(data, seg_ids, num_segments=capacity)
+        # all-NaN group: min is NaN (Spark: NaN is largest; min picks non-NaN if any)
+        has_non_nan = jax.ops.segment_max((validity & ~nan).astype(jnp.int32),
+                                          seg_ids, num_segments=capacity)
+        has_nan = jax.ops.segment_max((validity & nan).astype(jnp.int32), seg_ids,
+                                      num_segments=capacity)
+        m = jnp.where((has_non_nan == 0) & (has_nan > 0), jnp.nan, m)
+        return m
+    info = jnp.iinfo(values.dtype) if values.dtype != jnp.bool_ else None
+    if values.dtype == jnp.bool_:
+        data = jnp.where(validity, values, True)
+        return jax.ops.segment_min(data.astype(jnp.int8), seg_ids,
+                                   num_segments=capacity).astype(jnp.bool_)
+    data = jnp.where(validity, values, jnp.asarray(info.max, values.dtype))
+    return jax.ops.segment_min(data, seg_ids, num_segments=capacity)
+
+
+def segment_max(values, validity, seg_ids, capacity, dtype: T.DataType):
+    if isinstance(dtype, T.FractionalType):
+        nan = jnp.isnan(values)
+        sentinel = jnp.asarray(-jnp.inf, values.dtype)
+        data = jnp.where(validity & ~nan, values, sentinel)
+        m = jax.ops.segment_max(data, seg_ids, num_segments=capacity)
+        has_nan = jax.ops.segment_max((validity & nan).astype(jnp.int32), seg_ids,
+                                      num_segments=capacity)
+        # any NaN in group → max is NaN (NaN is largest)
+        m = jnp.where(has_nan > 0, jnp.nan, m)
+        return m
+    if values.dtype == jnp.bool_:
+        data = jnp.where(validity, values, False)
+        return jax.ops.segment_max(data.astype(jnp.int8), seg_ids,
+                                   num_segments=capacity).astype(jnp.bool_)
+    info = jnp.iinfo(values.dtype)
+    data = jnp.where(validity, values, jnp.asarray(info.min, values.dtype))
+    return jax.ops.segment_max(data, seg_ids, num_segments=capacity)
+
+
+def segment_first(values, validity, seg_ids, capacity, ignore_nulls: bool):
+    """First (by sorted order) value per group; Spark First(ignoreNulls)."""
+    idx = jnp.arange(capacity, dtype=jnp.int32)
+    big = jnp.int32(capacity)
+    eligible = validity if ignore_nulls else jnp.ones_like(validity)
+    cand = jnp.where(eligible, idx, big)
+    pos = jax.ops.segment_min(cand, seg_ids, num_segments=capacity)
+    pos_clamped = jnp.clip(pos, 0, capacity - 1)
+    vals = values[pos_clamped]
+    valid = (pos < big) & validity[pos_clamped]
+    return vals, valid

@@ -79,15 +79,42 @@ def hash_long(value_i64, seed_i32):
 
 
 def hash_float(value_f32, seed_i32):
-    v = jnp.where(value_f32 == jnp.float32(-0.0), jnp.float32(0.0), value_f32)
-    bits = lax.bitcast_convert_type(v.astype(jnp.float32), jnp.int32)
+    """Spark hashes floatToIntBits (canonical NaN 0x7fc00000, -0.0 normalized)."""
+    v = value_f32.astype(jnp.float32)
+    v = jnp.where(v == jnp.float32(-0.0), jnp.float32(0.0), v)
+    bits = lax.bitcast_convert_type(v, jnp.int32)
+    bits = jnp.where(jnp.isnan(v), jnp.int32(0x7fc00000), bits)
     return hash_int(bits, seed_i32)
 
 
+def double_to_long_bits(v):
+    """Java Double.doubleToLongBits without an f64<->i64 bitcast (unsupported under
+    the TPU x64-emulation rewrite): reconstruct the IEEE-754 layout arithmetically
+    from frexp. Canonical NaN (0x7ff8000000000000) like Java."""
+    m, e = jnp.frexp(jnp.abs(v))  # abs(v) = m * 2^e, m in [0.5, 1)
+    biased = e.astype(jnp.int64) + 1022
+    normal = biased >= 1
+    # XLA flushes f64 subnormals to zero on TPU/CPU backends, so subnormal inputs
+    # have already been flushed by any upstream compute; bits = 0 keeps the engine
+    # self-consistent (documented divergence from CPU Spark, like the reference's
+    # GPU float caveats)
+    norm_mant = ((m * 2.0 - 1.0) * (2.0 ** 52)).astype(jnp.int64)
+    mant = jnp.where(normal, norm_mant, 0)
+    expf = jnp.where(normal, biased, 0)
+    bits = lax.shift_left(expf, jnp.int64(52)) | mant
+    bits = jnp.where(jnp.isinf(v), jnp.int64(0x7ff0000000000000), bits)
+    bits = jnp.where(v == 0, jnp.int64(0), bits)
+    sign = jnp.signbit(v).astype(jnp.int64)
+    bits = bits | lax.shift_left(sign, jnp.int64(63))
+    bits = jnp.where(jnp.isnan(v), jnp.int64(0x7ff8000000000000), bits)
+    return bits
+
+
 def hash_double(value_f64, seed_i32):
-    v = jnp.where(value_f64 == jnp.float64(-0.0), jnp.float64(0.0), value_f64)
-    bits = lax.bitcast_convert_type(v.astype(jnp.float64), jnp.int64)
-    return hash_long(bits, seed_i32)
+    """Spark hashes doubleToLongBits (canonical NaN, -0.0 normalized)."""
+    v = value_f64.astype(jnp.float64)
+    v = jnp.where(v == jnp.float64(-0.0), jnp.float64(0.0), v)
+    return hash_long(double_to_long_bits(v), seed_i32)
 
 
 def hash_string_words(words, lengths, seed_i32):

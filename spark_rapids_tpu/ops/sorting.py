@@ -1,0 +1,82 @@
+"""Multi-key sort with Spark ordering semantics.
+
+Reference: GpuSortExec.scala:56 + SortUtils.scala over cudf Table.orderBy. Spark
+ordering rules implemented here (the reference encodes the same in cudf flags):
+- per-key ASC/DESC with explicit NULLS FIRST/LAST;
+- floats: NaN is greater than every value (incl. +inf), NaN == NaN, -0.0 == 0.0;
+- strings sort by dictionary code (dictionary is sorted, so code order == UTF-8
+  lexicographic — actually python str order; matches Spark's UTF8String binary order
+  for the ASCII range).
+
+TPU-first notes: lax.sort is a single fused XLA sort over multiple key operands; no
+f64→i64 bitcast (unsupported under the TPU x64 rewrite), so float keys stay float with
+NaN lifted into a separate int8 key; padding rows carry a leading pad-rank key so they
+always sink to the end regardless of key direction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+from jax import lax
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Col
+
+
+@dataclasses.dataclass(frozen=True)
+class SortOrder:
+    ascending: bool = True
+    nulls_first: bool = None  # default: first when asc, last when desc (Spark)
+
+    @property
+    def resolved_nulls_first(self):
+        return self.ascending if self.nulls_first is None else self.nulls_first
+
+
+def _key_arrays(c: Col, order: SortOrder):
+    """Key operands for one sort column, in significance order."""
+    keys = []
+    nf = order.resolved_nulls_first
+    null_rank = jnp.where(c.validity, jnp.int8(1 if nf else 0),
+                          jnp.int8(0 if nf else 1))
+    keys.append(null_rank)
+    vals = c.values
+    if isinstance(c.dtype, T.FractionalType):
+        nan = jnp.isnan(vals)
+        # NaN largest: rank 1 after all finite for asc; first (rank 0) for desc
+        nan_rank = jnp.where(nan, jnp.int8(1), jnp.int8(0))
+        if not order.ascending:
+            nan_rank = jnp.int8(1) - nan_rank
+        keys.append(nan_rank)
+        vals = jnp.where(nan, jnp.zeros_like(vals), vals)
+        vals = jnp.where(vals == 0, jnp.zeros_like(vals), vals)  # -0.0 → 0.0
+        if not order.ascending:
+            vals = -vals
+    elif isinstance(c.dtype, T.BooleanType):
+        v8 = vals.astype(jnp.int8)
+        vals = v8 if order.ascending else (jnp.int8(1) - v8)
+    else:
+        if not order.ascending:
+            vals = ~vals  # order-reversing, overflow-free for ints
+    keys.append(vals)
+    return keys
+
+
+def sort_permutation(key_cols, orders, num_rows, capacity: int):
+    """Stable permutation sorting live rows by keys; padding sinks to the end."""
+    pad_rank = (jnp.arange(capacity, dtype=jnp.int32) >= num_rows).astype(jnp.int8)
+    operands = [pad_rank]
+    for c, o in zip(key_cols, orders):
+        operands.extend(_key_arrays(c, o))
+    iota = jnp.arange(capacity, dtype=jnp.int32)
+    res = lax.sort(tuple(operands) + (iota,), num_keys=len(operands), is_stable=True)
+    return res[-1]
+
+
+def sort_cols(cols, key_indices, orders, num_rows, capacity):
+    from spark_rapids_tpu.ops.filtering import gather_cols
+    perm = sort_permutation([cols[i] for i in key_indices], orders, num_rows, capacity)
+    live = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+    return gather_cols(cols, perm, live)

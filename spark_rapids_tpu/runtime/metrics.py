@@ -1,0 +1,103 @@
+"""Operator metrics — the GpuMetric analog.
+
+Reference: GpuExec.scala:32-140: GpuMetric wraps SQLMetric with levels
+ESSENTIAL/MODERATE/DEBUG gated by spark.rapids.sql.metrics.level; ~25 standard names
+(NUM_OUTPUT_ROWS, OP_TIME, SEMAPHORE_WAIT_TIME, SPILL bytes per tier, …) and
+makeSpillCallback feeding spill bytes back into the running operator's metrics."""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+ESSENTIAL = 0
+MODERATE = 1
+DEBUG = 2
+
+_LEVELS = {"ESSENTIAL": ESSENTIAL, "MODERATE": MODERATE, "DEBUG": DEBUG}
+
+# standard metric names (reference GpuExec.scala:42-67)
+NUM_OUTPUT_ROWS = "numOutputRows"
+NUM_OUTPUT_BATCHES = "numOutputBatches"
+NUM_INPUT_ROWS = "numInputRows"
+NUM_INPUT_BATCHES = "numInputBatches"
+OP_TIME = "opTime"
+TOTAL_TIME = "totalTime"
+SEMAPHORE_WAIT_TIME = "semaphoreWaitTime"
+PEAK_DEVICE_MEMORY = "peakDevMemory"
+SPILL_AMOUNT = "spillData"
+SPILL_AMOUNT_DISK = "spillDisk"
+SPILL_AMOUNT_HOST = "spillHost"
+BUILD_TIME = "buildTime"
+JOIN_TIME = "joinTime"
+SORT_TIME = "sortTime"
+AGG_TIME = "computeAggTime"
+CONCAT_TIME = "concatTime"
+READ_FS_TIME = "readFsTime"
+WRITE_TIME = "writeTime"
+PARTITION_TIME = "partitionTime"
+COLLECT_TIME = "collectTime"
+NUM_PARTITIONS = "partitions"
+
+
+class GpuMetric:
+    __slots__ = ("name", "level", "_value", "_lock")
+
+    def __init__(self, name: str, level: int = MODERATE):
+        self.name = name
+        self.level = level
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def add(self, v):
+        with self._lock:
+            self._value += int(v)
+
+    def set(self, v):
+        with self._lock:
+            self._value = int(v)
+
+    @property
+    def value(self):
+        return self._value
+
+    @contextmanager
+    def timed(self):
+        """Time a region in nanoseconds (reference NvtxWithMetrics couples a trace
+        range with a timing metric — see runtime/tracing.py for the range side)."""
+        t0 = time.perf_counter_ns()
+        try:
+            yield
+        finally:
+            self.add(time.perf_counter_ns() - t0)
+
+    def __repr__(self):
+        return f"GpuMetric({self.name}={self._value})"
+
+
+class _NoopMetric(GpuMetric):
+    """Stand-in for metrics above the configured level: all updates are dropped."""
+
+    def add(self, v):
+        pass
+
+    def set(self, v):
+        pass
+
+
+class MetricsRegistry:
+    """Per-operator metric set filtered by the configured level."""
+
+    def __init__(self, level_name: str = "MODERATE"):
+        self.level = _LEVELS.get(level_name.upper(), MODERATE)
+        self._metrics: dict[str, GpuMetric] = {}
+
+    def metric(self, name: str, level: int = MODERATE) -> GpuMetric:
+        if name not in self._metrics:
+            cls = _NoopMetric if level > self.level else GpuMetric
+            self._metrics[name] = cls(name, level)
+        return self._metrics[name]
+
+    def snapshot(self):
+        return {n: m.value for n, m in self._metrics.items() if m.level <= self.level}

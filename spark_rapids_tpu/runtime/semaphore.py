@@ -1,0 +1,56 @@
+"""TPU admission control — the GpuSemaphore analog.
+
+Reference: GpuSemaphore.scala:101: N tasks may hold the GPU concurrently
+(spark.rapids.sql.concurrentGpuTasks); tasks acquire before first device use and
+auto-release on completion; semaphore wait time is a first-class metric. Same design:
+a counted semaphore keyed by task, re-entrant per task, with wait-time accounting."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class TpuSemaphore:
+    _instance = None
+    _lock = threading.Lock()
+
+    def __init__(self, max_concurrent: int):
+        self.max_concurrent = max_concurrent
+        self._sem = threading.Semaphore(max_concurrent)
+        self._holders: dict[int, int] = {}
+        self._holders_lock = threading.Lock()
+
+    @classmethod
+    def initialize(cls, max_concurrent: int):
+        with cls._lock:
+            cls._instance = cls(max_concurrent)
+
+    @classmethod
+    def get(cls) -> "TpuSemaphore":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls(2)
+            return cls._instance
+
+    def acquire_if_necessary(self, task_id: int, wait_metric=None) -> None:
+        """Idempotent per-task acquire: a task holds at most one permit no matter how
+        many operators in its pipeline call this (reference acquireIfNecessary,
+        GpuSemaphore.scala:74 — 'if this task has not already acquired')."""
+        with self._holders_lock:
+            if task_id in self._holders:
+                return
+        t0 = time.perf_counter_ns()
+        self._sem.acquire()
+        if wait_metric is not None:
+            wait_metric.add(time.perf_counter_ns() - t0)
+        with self._holders_lock:
+            self._holders[task_id] = 1
+
+    def release_if_necessary(self, task_id: int) -> None:
+        """Release the task's permit entirely (reference completeAndRelease on task
+        completion)."""
+        with self._holders_lock:
+            if self._holders.pop(task_id, None) is None:
+                return
+        self._sem.release()

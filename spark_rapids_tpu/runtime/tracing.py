@@ -1,0 +1,38 @@
+"""Trace ranges — the NVTX analog.
+
+Reference: NvtxWithMetrics.scala:42 couples an NVTX range with a timing metric;
+ranges wrap every hot region (GpuSemaphore.scala:107, aggregate.scala:356) and are
+viewed in Nsight. TPU equivalent: jax.profiler.TraceAnnotation ranges viewable in
+Perfetto/XProf, coupled to GpuMetric timers, gated by spark.rapids.tpu.sql.trace.enabled."""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+_enabled = False
+
+
+def set_enabled(v: bool):
+    global _enabled
+    _enabled = bool(v)
+
+
+@contextmanager
+def trace_range(name: str, metric=None):
+    """NvtxWithMetrics analog: profiler annotation + optional timing metric."""
+    t0 = time.perf_counter_ns() if metric is not None else 0
+    if _enabled:
+        import jax
+        with jax.profiler.TraceAnnotation(name):
+            try:
+                yield
+            finally:
+                if metric is not None:
+                    metric.add(time.perf_counter_ns() - t0)
+    else:
+        try:
+            yield
+        finally:
+            if metric is not None:
+                metric.add(time.perf_counter_ns() - t0)

@@ -125,6 +125,19 @@ def test_murmur3_device_matches_host():
     assert list(dev) == host
 
 
+def test_hash_double_bits():
+    """doubleToLongBits reconstructed without bitcast (TPU x64-rewrite can't bitcast
+    f64<->i64); canonical NaN like Java; subnormals flush to zero (XLA FTZ)."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops import hashing as H
+    vs = np.array([0.0, 1.0, -1.0, 0.1, np.inf, -np.inf, np.nan, 2.5e300, -0.0,
+                   -123.456])
+    got = np.asarray(H.double_to_long_bits(jnp.asarray(vs)))
+    exp = [np.float64(v).view(np.int64) if not np.isnan(v)
+           else np.int64(0x7FF8000000000000) for v in vs]
+    assert [int(g) for g in got] == [int(e) for e in exp]
+
+
 def test_murmur3_chained_seed_device():
     """Multi-column hash chains seeds: h2 = hash(col2, hash(col1, 42))."""
     import jax.numpy as jnp
