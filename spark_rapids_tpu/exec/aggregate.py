@@ -145,10 +145,14 @@ class HashAggregateExec(TpuExec):
 
     def execute_partition(self, split):
         def it():
-            acquire_semaphore(self.metrics)
             merge_input = self.mode == FINAL
             acc = None
             for batch in self.child.execute_partition(split):
+                # acquire only once data is ready for device work — acquiring before
+                # pulling the child would hold a permit across a blocking shuffle map
+                # stage and deadlock the semaphore (reference RapidsShuffleIterator
+                # acquires on data arrival, RapidsShuffleIterator.scala:300)
+                acquire_semaphore(self.metrics)
                 with trace_range("HashAggregate.agg", self._agg_time):
                     partial = self._aggregate_batch(batch, merge=merge_input)
                 if acc is None:
@@ -162,6 +166,7 @@ class HashAggregateExec(TpuExec):
             if acc is None:
                 if self.group_exprs:
                     return  # grouped agg over empty input → no rows (Spark)
+                acquire_semaphore(self.metrics)
                 empty = ColumnarBatch.empty(
                     self._partial_schema() if merge_input else self.child.output)
                 acc = self._aggregate_batch(empty, merge=merge_input)

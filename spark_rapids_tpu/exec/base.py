@@ -38,14 +38,18 @@ class TaskContext:
 
     def __init__(self):
         self.task_id = next(_task_counter)
+        self._outer = None
 
     def __enter__(self):
+        # save/restore the enclosing task id so inline nested tasks (e.g. a map
+        # stage run on the calling thread) don't orphan the outer task's permit
+        self._outer = getattr(_task_local, "task_id", None)
         _task_local.task_id = self.task_id
         return self
 
     def __exit__(self, *exc):
         TpuSemaphore.get().release_if_necessary(self.task_id)
-        _task_local.task_id = None
+        _task_local.task_id = self._outer
         return False
 
 

@@ -178,6 +178,12 @@ class GlobalLimitExec(LocalLimitExec):
     """Whole-plan limit; requires a single partition upstream (Spark plans the same
     way: GlobalLimit over a single-partition exchange)."""
 
+    def __init__(self, limit: int, child, conf=None):
+        assert child.num_partitions == 1, \
+            "GlobalLimitExec requires a single-partition child (insert a " \
+            "SinglePartitioner exchange first, as Spark's planner does)"
+        super().__init__(limit, child, conf=conf)
+
     @property
     def num_partitions(self):
         return 1

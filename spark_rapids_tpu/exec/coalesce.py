@@ -1,0 +1,111 @@
+"""Batch coalescing — goal-driven re-batching of a columnar stream.
+
+Reference (SURVEY.md component #21): GpuCoalesceBatches.scala — `CoalesceGoal`:92
+(`TargetSize`, `RequireSingleBatch`), `AbstractGpuCoalesceIterator`:133 (collect
+batches until the goal is hit, then concat on device), `GpuCoalesceBatches`:455.
+Batches awaiting concat are held spillable (reference makes the on-deck batch
+spillable) so a large coalesce cannot OOM the device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.base import TpuExec
+from spark_rapids_tpu.ops.concat import concat_batches
+from spark_rapids_tpu.runtime import memory as mem
+from spark_rapids_tpu.runtime import metrics as M
+
+
+@dataclasses.dataclass(frozen=True)
+class CoalesceGoal:
+    """Base goal (reference CoalesceGoal:92)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class TargetSize(CoalesceGoal):
+    target_size_bytes: int
+
+
+@dataclasses.dataclass(frozen=True)
+class RequireSingleBatch(CoalesceGoal):
+    """Operators like out-of-core sort and build-side join need ONE batch
+    (reference RequireSingleBatch)."""
+
+
+def coalesce_iterator(it, goal: CoalesceGoal, metrics=None, use_catalog: bool = True):
+    """Re-batch `it` per `goal` (reference AbstractGpuCoalesceIterator:133)."""
+    concat_time = metrics.metric(M.CONCAT_TIME, M.MODERATE) if metrics else None
+
+    pending: list = []
+    pending_bytes = 0
+
+    def flush():
+        nonlocal pending, pending_bytes
+        if not pending:
+            return None
+        batches = [p.get_batch() if isinstance(p, mem.SpillableColumnarBatch) else p
+                   for p in pending]
+        if concat_time is not None:
+            with concat_time.timed():
+                out = concat_batches(batches)
+        else:
+            out = concat_batches(batches)
+        for p in pending:
+            if isinstance(p, mem.SpillableColumnarBatch):
+                p.close()
+        pending, pending_bytes = [], 0
+        return out
+
+    limit = (goal.target_size_bytes if isinstance(goal, TargetSize) else None)
+    try:
+        for batch in it:
+            if batch.num_rows == 0:
+                continue
+            size = batch.device_memory_size()
+            if limit is not None and pending and pending_bytes + size > limit:
+                yield flush()
+            pending.append(mem.SpillableColumnarBatch(batch, mem.ACTIVE_BATCHING_PRIORITY)
+                           if use_catalog else batch)
+            pending_bytes += size
+            if limit is not None and pending_bytes >= limit:
+                yield flush()
+        out = flush()
+        if out is not None:
+            yield out
+    finally:
+        # consumer may stop early (limit); release catalogued pending batches
+        for p in pending:
+            if isinstance(p, mem.SpillableColumnarBatch):
+                p.close()
+        pending = []
+
+
+def concat_all(it, schema) -> ColumnarBatch:
+    """Drain to exactly one batch (reference ConcatAndConsumeAll)."""
+    out = list(coalesce_iterator(it, RequireSingleBatch()))
+    if not out:
+        return ColumnarBatch.empty(schema)
+    assert len(out) == 1
+    return out[0]
+
+
+class CoalesceBatchesExec(TpuExec):
+    """Physical coalesce node (reference GpuCoalesceBatches:455)."""
+
+    def __init__(self, goal: CoalesceGoal, child: TpuExec, conf=None):
+        super().__init__(child, conf=conf)
+        self.goal = goal
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def execute_partition(self, split):
+        return self.wrap_output(
+            coalesce_iterator(self.child.execute_partition(split), self.goal,
+                              self.metrics))
+
+    def args_string(self):
+        return repr(self.goal)

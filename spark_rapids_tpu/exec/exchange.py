@@ -1,0 +1,118 @@
+"""Shuffle exchange exec — partition on device, exchange through the block store.
+
+Reference (SURVEY.md component #30): GpuShuffleExchangeExecBase.scala:80
+(`prepareBatchShuffleDependency`:167 partitions + slices on device and hands sliced
+batches to the shuffle manager), ShuffledBatchRDD reads one reduce partition.
+
+The map stage runs once, lazily, the first time any reduce partition executes
+(Spark's stage barrier stands in as a threading.Event here since scheduling is local;
+the distributed Mesh path in distributed/ replaces this with an ICI all_to_all).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.exec.base import TpuExec, TaskContext
+from spark_rapids_tpu.exec.coalesce import coalesce_iterator, TargetSize
+from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.shuffle.manager import ShuffleBlockStore
+from spark_rapids_tpu.shuffle.partitioning import Partitioner, RangePartitioner
+
+
+class ShuffleExchangeExec(TpuExec):
+    """Reference GpuShuffleExchangeExecBase:80."""
+
+    def __init__(self, partitioner: Partitioner, child: TpuExec, conf=None):
+        super().__init__(child, conf=conf)
+        self.partitioner = partitioner.bind(child.output)
+        self._map_done = threading.Event()
+        self._map_lock = threading.Lock()
+        self._shuffle_id = None
+        self._partition_time = self.metrics.metric(M.PARTITION_TIME, M.MODERATE)
+        self._reads_left = self.partitioner.num_partitions
+        self._reads_lock = threading.Lock()
+
+    @property
+    def output(self):
+        return self.child.output
+
+    @property
+    def num_partitions(self):
+        return self.partitioner.num_partitions
+
+    def _run_map_stage(self):
+        store = ShuffleBlockStore.get()
+        serialized = not self.conf.get(C.SHUFFLE_MANAGER_ENABLED)
+        self._shuffle_id = store.register_shuffle(serialized=serialized)
+
+        if isinstance(self.partitioner, RangePartitioner):
+            # driver-side sample pass to pick range bounds (reference
+            # GpuRangePartitioner.sketch over a reservoir sample; we sample the
+            # first batch of every input partition)
+            samples = []
+            for split in range(self.child.num_partitions):
+                with TaskContext():
+                    for b in self.child.execute_partition(split):
+                        samples.append(b)
+                        break
+            if samples:
+                self.partitioner.set_bounds_from_sample(samples)
+
+        def map_task(split):
+            with TaskContext():
+                for batch in self.child.execute_partition(split):
+                    if batch.num_rows == 0:
+                        continue
+                    with self._partition_time.timed():
+                        pieces = self.partitioner.partition(batch, split)
+                    for pid, piece in pieces:
+                        store.write_block(self._shuffle_id, pid, piece)
+
+        nthreads = max(1, min(self.conf.get(C.NUM_LOCAL_TASKS),
+                              self.child.num_partitions))
+        if self.child.num_partitions == 1:
+            map_task(0)
+        else:
+            with ThreadPoolExecutor(max_workers=nthreads) as pool:
+                list(pool.map(map_task, range(self.child.num_partitions)))
+
+    def _ensure_map_stage(self):
+        if self._map_done.is_set():
+            return
+        with self._map_lock:
+            if not self._map_done.is_set():
+                self._run_map_stage()
+                self._map_done.set()
+
+    def _reader(self, split):
+        store = ShuffleBlockStore.get()
+        # post-shuffle coalesce to target batch size (reference
+        # GpuShuffleCoalesceExec inserted by GpuTransitionOverrides:57-63)
+        it = store.read_partition(self._shuffle_id, split)
+        goal = TargetSize(self.conf.batch_size_bytes)
+        yield from coalesce_iterator(it, goal, self.metrics)
+        # free shuffle blocks once every reduce partition has been fully drained
+        # (the reference keeps them until Spark unregisters the shuffle; our local
+        # scheduler reads each partition exactly once per query)
+        with self._reads_lock:
+            self._reads_left -= 1
+            done = self._reads_left == 0
+        if done:
+            store.unregister_shuffle(self._shuffle_id)
+
+    def execute_partition(self, split):
+        # drop this task's permit before (possibly) blocking on the map stage —
+        # holding it would starve the map tasks and deadlock (the reference
+        # releases the semaphore while waiting on shuffle fetches,
+        # RapidsShuffleIterator.scala:300)
+        from spark_rapids_tpu.exec.base import current_task_id
+        from spark_rapids_tpu.runtime.semaphore import TpuSemaphore
+        TpuSemaphore.get().release_if_necessary(current_task_id())
+        self._ensure_map_stage()
+        return self.wrap_output(self._reader(split))
+
+    def args_string(self):
+        return f"{type(self.partitioner).__name__}({self.partitioner.num_partitions})"

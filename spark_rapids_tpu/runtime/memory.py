@@ -1,0 +1,343 @@
+"""Device & memory runtime — HBM budget, tiered spill stores, spillable batches.
+
+Reference (SURVEY.md components #4-#7):
+- GpuDeviceManager.scala:36,125,204 — acquire device, init RMM pool, pinned host pool.
+- RapidsBufferCatalog.scala:40,156 / RapidsBufferStore.scala:41 — catalog keyed by
+  buffer id over chained tiers device→host→disk with `synchronousSpill`:145.
+- DeviceMemoryEventHandler.scala:42 — RMM alloc-failure callback triggering spill.
+- SpillableColumnarBatch.scala:29 / SpillPriorities.scala:26.
+
+TPU twist: XLA has no alloc-failure callback to trap (SURVEY.md §7 hard parts), so the
+budget is enforced *proactively*: every batch registered with the catalog is counted
+against an HBM budget, and registration spills lower-priority buffers synchronously
+until the new buffer fits. Spill tiers are HBM → host numpy → disk pickle; "pinned"
+staging is plain host RAM (TPU DMA runs from pageable host memory via PJRT).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+import os
+import pickle
+import tempfile
+import threading
+import typing
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.vector import TpuColumnVector
+from spark_rapids_tpu.runtime.arm import LeakTracker
+
+# -- spill priorities (reference SpillPriorities.scala:26) ---------------------
+# Lower value spills FIRST.
+OUTPUT_FOR_SHUFFLE_INITIAL_PRIORITY = -1000.0   # shuffle output: spill early
+ACTIVE_ON_DECK_PRIORITY = 100.0                 # batches queued for processing
+ACTIVE_BATCHING_PRIORITY = 50.0                 # batches held by a running op
+
+
+class TierEnum:
+    DEVICE = "DEVICE"
+    HOST = "HOST"
+    DISK = "DISK"
+
+
+@dataclasses.dataclass
+class HostColumn:
+    """Host image of one TpuColumnVector (the RapidsHostColumnVector analog)."""
+    dtype: T.DataType
+    data: np.ndarray
+    validity: np.ndarray
+    dictionary: typing.Any  # pyarrow StringArray or None
+
+
+@dataclasses.dataclass
+class HostBatch:
+    columns: list
+    num_rows: int
+    schema: typing.Any
+
+    def nbytes(self) -> int:
+        out = 0
+        for c in self.columns:
+            out += c.data.nbytes + c.validity.nbytes
+            if c.dictionary is not None:
+                out += c.dictionary.nbytes
+        return out
+
+
+def batch_to_host(batch: ColumnarBatch) -> HostBatch:
+    cols = [HostColumn(c.dtype, np.asarray(c.data), np.asarray(c.validity), c.dictionary)
+            for c in batch.columns]
+    return HostBatch(cols, batch.num_rows, batch.schema)
+
+
+def host_to_batch(hb: HostBatch) -> ColumnarBatch:
+    cols = [TpuColumnVector(c.dtype, jnp.asarray(c.data), jnp.asarray(c.validity),
+                            c.dictionary) for c in hb.columns]
+    return ColumnarBatch(cols, hb.num_rows, hb.schema)
+
+
+class RapidsBuffer:
+    """One catalogued buffer; knows which tier currently holds it
+    (reference RapidsBufferStore.RapidsBufferBase)."""
+
+    __slots__ = ("buffer_id", "tier", "priority", "size", "_device", "_host",
+                 "_path", "spill_callback")
+
+    def __init__(self, buffer_id: int, batch: ColumnarBatch, priority: float,
+                 spill_callback=None):
+        self.buffer_id = buffer_id
+        self.tier = TierEnum.DEVICE
+        self.priority = priority
+        self.size = batch.device_memory_size()
+        self._device: ColumnarBatch | None = batch
+        self._host: HostBatch | None = None
+        self._path: str | None = None
+        self.spill_callback = spill_callback
+
+
+class BufferCatalog:
+    """Tiered buffer catalog with proactive budget-driven spill.
+
+    Reference: RapidsBufferCatalog.scala:40 (registry) + RapidsBufferStore.scala:145
+    (`synchronousSpill`) + DeviceMemoryEventHandler (OOM→spill). Here the device tier's
+    budget check runs at registration time instead of inside a malloc callback.
+    """
+
+    def __init__(self, device_budget: int, host_budget: int, spill_dir: str | None = None,
+                 unspill: bool = False):
+        self.device_budget = device_budget
+        self.host_budget = host_budget
+        self._spill_dir = spill_dir
+        self._unspill = unspill
+        self._lock = threading.RLock()
+        self._buffers: dict[int, RapidsBuffer] = {}
+        self._ids = itertools.count(1)
+        self.device_bytes = 0
+        self.host_bytes = 0
+        # metrics (reference GpuMetric spill counters)
+        self.spilled_to_host_bytes = 0
+        self.spilled_to_disk_bytes = 0
+
+    # -- registration --------------------------------------------------------
+    def add_batch(self, batch: ColumnarBatch, priority: float = ACTIVE_ON_DECK_PRIORITY,
+                  spill_callback=None) -> int:
+        with self._lock:
+            bid = next(self._ids)
+            buf = RapidsBuffer(bid, batch, priority, spill_callback)
+            self._buffers[bid] = buf
+            self.device_bytes += buf.size
+            self._ensure_device_budget(exclude=bid)
+            return bid
+
+    def _ensure_device_budget(self, exclude: int | None = None):
+        if self.device_bytes <= self.device_budget:
+            return
+        # spill lowest-priority device buffers first (reference spill-priority queue)
+        heap = [(b.priority, b.buffer_id) for b in self._buffers.values()
+                if b.tier == TierEnum.DEVICE and b.buffer_id != exclude]
+        heapq.heapify(heap)
+        while self.device_bytes > self.device_budget and heap:
+            _, bid = heapq.heappop(heap)
+            self._spill_device_buffer(self._buffers[bid])
+
+    def _spill_device_buffer(self, buf: RapidsBuffer):
+        hb = batch_to_host(buf._device)
+        # block so the device arrays can actually be freed before we drop the refs
+        buf._host = hb
+        buf._device = None
+        buf.tier = TierEnum.HOST
+        self.device_bytes -= buf.size
+        self.host_bytes += hb.nbytes()
+        self.spilled_to_host_bytes += buf.size
+        if buf.spill_callback:
+            buf.spill_callback(buf.size)
+        self._ensure_host_budget()
+
+    def _ensure_host_budget(self):
+        if self.host_bytes <= self.host_budget:
+            return
+        heap = [(b.priority, b.buffer_id) for b in self._buffers.values()
+                if b.tier == TierEnum.HOST]
+        heapq.heapify(heap)
+        while self.host_bytes > self.host_budget and heap:
+            _, bid = heapq.heappop(heap)
+            self._spill_host_buffer(self._buffers[bid])
+
+    def _spill_dir_path(self) -> str:
+        if self._spill_dir is None:
+            self._spill_dir = tempfile.mkdtemp(prefix="rapids_tpu_spill_")
+        os.makedirs(self._spill_dir, exist_ok=True)
+        return self._spill_dir
+
+    def _spill_host_buffer(self, buf: RapidsBuffer):
+        hb = buf._host
+        path = os.path.join(self._spill_dir_path(), f"buffer-{buf.buffer_id}.spill")
+        with open(path, "wb") as f:
+            pickle.dump(hb, f, protocol=pickle.HIGHEST_PROTOCOL)
+        self.host_bytes -= hb.nbytes()
+        self.spilled_to_disk_bytes += hb.nbytes()
+        buf._host = None
+        buf._path = path
+        buf.tier = TierEnum.DISK
+
+    # -- access --------------------------------------------------------------
+    def acquire_batch(self, buffer_id: int) -> ColumnarBatch:
+        """Materialize the buffer on device. If it was spilled and unspill is enabled
+        it is re-registered in the device tier (reference unspill.enabled,
+        RapidsBufferStore copy-back); otherwise the device copy is transient."""
+        with self._lock:
+            buf = self._buffers[buffer_id]
+            if buf.tier == TierEnum.DEVICE:
+                return buf._device
+            hb = buf._host
+            if hb is None:
+                with open(buf._path, "rb") as f:
+                    hb = pickle.load(f)
+            batch = host_to_batch(hb)
+            if self._unspill:
+                if buf.tier == TierEnum.HOST:
+                    self.host_bytes -= hb.nbytes()
+                else:
+                    os.unlink(buf._path)
+                    buf._path = None
+                buf._host = None
+                buf._device = batch
+                buf.tier = TierEnum.DEVICE
+                self.device_bytes += buf.size
+                self._ensure_device_budget(exclude=buffer_id)
+            return batch
+
+    def get_tier(self, buffer_id: int) -> str:
+        return self._buffers[buffer_id].tier
+
+    def update_priority(self, buffer_id: int, priority: float):
+        with self._lock:
+            self._buffers[buffer_id].priority = priority
+
+    def remove(self, buffer_id: int):
+        with self._lock:
+            buf = self._buffers.pop(buffer_id, None)
+            if buf is None:
+                return
+            if buf.tier == TierEnum.DEVICE:
+                self.device_bytes -= buf.size
+            elif buf.tier == TierEnum.HOST:
+                self.host_bytes -= buf._host.nbytes()
+            elif buf._path:
+                try:
+                    os.unlink(buf._path)
+                except OSError:
+                    pass
+
+    def synchronous_spill(self, target_device_bytes: int) -> int:
+        """Spill until the device tier holds <= target bytes; returns bytes spilled
+        (reference RapidsBufferStore.synchronousSpill:145)."""
+        with self._lock:
+            before = self.device_bytes
+            saved = self.device_budget
+            try:
+                self.device_budget = target_device_bytes
+                self._ensure_device_budget()
+            finally:
+                self.device_budget = saved
+            return before - self.device_bytes
+
+    @property
+    def num_buffers(self):
+        return len(self._buffers)
+
+
+class SpillableColumnarBatch:
+    """Handle over a catalogued batch; keeps data spillable while an operator holds it
+    (reference SpillableColumnarBatch.scala:29,74)."""
+
+    def __init__(self, batch: ColumnarBatch, priority: float = ACTIVE_ON_DECK_PRIORITY,
+                 catalog: "BufferCatalog | None" = None, spill_callback=None):
+        self.catalog = catalog or DeviceManager.get().catalog
+        self.buffer_id = self.catalog.add_batch(batch, priority, spill_callback)
+        self.num_rows = batch.num_rows
+        self.schema = batch.schema
+        self.size = batch.device_memory_size()
+        self._closed = False
+        self._leak = LeakTracker.track(f"SpillableColumnarBatch#{self.buffer_id}")
+
+    def get_batch(self) -> ColumnarBatch:
+        assert not self._closed, "use after close"
+        return self.catalog.acquire_batch(self.buffer_id)
+
+    def set_priority(self, priority: float):
+        self.catalog.update_priority(self.buffer_id, priority)
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            self.catalog.remove(self.buffer_id)
+            LeakTracker.release(self._leak)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class DeviceManager:
+    """Process-wide device state: the chosen device, the HBM budget, and the buffer
+    catalog (reference GpuDeviceManager.scala:36 + RapidsBufferCatalog.init:177).
+
+    One executor owns one TPU chip in the reference's model (GpuDeviceManager.scala:103);
+    here the local runtime owns jax.devices()[0] and multi-chip execution goes through
+    the Mesh path (distributed/), matching SURVEY.md §7's executor-per-chip decision.
+    """
+
+    _instance: "DeviceManager | None" = None
+    _lock = threading.Lock()
+
+    def __init__(self, conf: C.RapidsConf):
+        self.conf = conf
+        self.device = jax.devices()[0]
+        limit = conf.get(C.DEVICE_MEMORY_LIMIT)
+        if not limit:
+            stats = None
+            try:
+                stats = self.device.memory_stats()
+            except Exception:
+                pass
+            hbm = (stats or {}).get("bytes_limit", 0)
+            if not hbm:
+                hbm = 16 << 30  # CPU backend exposes no limit; assume one v5e chip's HBM
+            limit = int(hbm * conf.get(C.DEVICE_MEMORY_FRACTION))
+        spill_dirs = conf.get(C.SPILL_DIRS)
+        self.catalog = BufferCatalog(
+            device_budget=limit,
+            host_budget=conf.get(C.HOST_SPILL_STORAGE_SIZE),
+            spill_dir=spill_dirs.split(",")[0] if spill_dirs else None,
+            unspill=conf.get(C.UNSPILL_ENABLED),
+        )
+
+    @classmethod
+    def initialize(cls, conf: C.RapidsConf | None = None) -> "DeviceManager":
+        with cls._lock:
+            cls._instance = DeviceManager(conf or C.RapidsConf())
+            return cls._instance
+
+    @classmethod
+    def get(cls) -> "DeviceManager":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = DeviceManager(C.RapidsConf())
+            return cls._instance
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            cls._instance = None

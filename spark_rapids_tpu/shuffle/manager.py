@@ -1,0 +1,93 @@
+"""Catalog-backed shuffle manager — device-resident shuffle with spillable blocks.
+
+Reference (SURVEY.md components #29/#30/#36):
+- RapidsShuffleInternalManagerBase.scala:200 — a ShuffleManager whose writer caches
+  shuffle output in the spill-store catalog instead of writing Spark files
+  (`RapidsCachingWriter`:73), and whose reader short-circuits local blocks from the
+  catalog (`RapidsCachingReader`).
+- ShuffleBufferCatalog.scala — maps (shuffle, map, reduce) block ids to buffers.
+- GpuColumnarBatchSerializer.scala:50 — serializing fallback for the vanilla path.
+
+Here the "cluster" is the local task scheduler (exec/base.py) plus the distributed
+Mesh path (distributed/); this manager is the single-process block store both use.
+Blocks are registered spillable at OUTPUT_FOR_SHUFFLE priority so shuffle data is
+evicted from HBM first, exactly like the reference's SpillPriorities contract.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.runtime import memory as mem
+from spark_rapids_tpu.shuffle import serialization as ser
+
+
+class ShuffleBlockStore:
+    """Process-wide shuffle block registry (ShuffleBufferCatalog analog)."""
+
+    _instance = None
+    _ilock = threading.Lock()
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._shuffle_ids = itertools.count(0)
+        # shuffle_id -> reduce_id -> list[SpillableColumnarBatch]
+        self._blocks: dict[int, dict[int, list]] = {}
+        self._serialized_mode: dict[int, bool] = {}
+
+    @classmethod
+    def get(cls) -> "ShuffleBlockStore":
+        with cls._ilock:
+            if cls._instance is None:
+                cls._instance = ShuffleBlockStore()
+            return cls._instance
+
+    @classmethod
+    def reset(cls):
+        with cls._ilock:
+            if cls._instance is not None:
+                cls._instance.clear_all()
+            cls._instance = None
+
+    def register_shuffle(self, serialized: bool = False) -> int:
+        with self._lock:
+            sid = next(self._shuffle_ids)
+            self._blocks[sid] = {}
+            self._serialized_mode[sid] = serialized
+            return sid
+
+    # -- write side (RapidsCachingWriter.write:90) ---------------------------
+    def write_block(self, shuffle_id: int, reduce_id: int, batch: ColumnarBatch):
+        serialized = self._serialized_mode[shuffle_id]
+        if serialized:
+            blob = ser.serialize_batch(batch)
+        else:
+            blob = mem.SpillableColumnarBatch(
+                batch, priority=mem.OUTPUT_FOR_SHUFFLE_INITIAL_PRIORITY)
+        with self._lock:
+            self._blocks[shuffle_id].setdefault(reduce_id, []).append(blob)
+
+    # -- read side (RapidsCachingReader / RapidsShuffleIterator) -------------
+    def read_partition(self, shuffle_id: int, reduce_id: int):
+        with self._lock:
+            blobs = list(self._blocks[shuffle_id].get(reduce_id, ()))
+        for blob in blobs:
+            if isinstance(blob, bytes):
+                yield ser.deserialize_batch(blob)
+            else:
+                yield blob.get_batch()
+
+    def unregister_shuffle(self, shuffle_id: int):
+        with self._lock:
+            parts = self._blocks.pop(shuffle_id, {})
+            self._serialized_mode.pop(shuffle_id, None)
+        for blobs in parts.values():
+            for b in blobs:
+                if not isinstance(b, bytes):
+                    b.close()
+
+    def clear_all(self):
+        for sid in list(self._blocks):
+            self.unregister_shuffle(sid)

@@ -274,3 +274,51 @@ class StructType:
     def from_arrow(schema: pa.Schema) -> "StructType":
         return StructType([StructField(f.name, from_arrow_type(f.type), f.nullable)
                            for f in schema])
+
+    def to_json(self):
+        """JSON-able schema (Spark StructType.json analog, used by the shuffle frame)."""
+        return [{"name": f.name, "type": _type_to_json(f.data_type),
+                 "nullable": f.nullable} for f in self.fields]
+
+    @staticmethod
+    def from_json(obj) -> "StructType":
+        return StructType([StructField(f["name"], _type_from_json(f["type"]),
+                                       f["nullable"]) for f in obj])
+
+
+# -- compact type codes for the shuffle/spill wire format ----------------------
+_CODE_TO_TYPE = {
+    1: BOOLEAN, 2: BYTE, 3: SHORT, 4: INT, 5: LONG, 6: FLOAT, 7: DOUBLE,
+    8: STRING, 9: DATE, 10: TIMESTAMP, 11: NULL,
+}
+_TYPE_TO_CODE = {type(v): k for k, v in _CODE_TO_TYPE.items()}
+_DECIMAL_CODE = 12
+
+
+def type_code(dt: DataType) -> int:
+    if isinstance(dt, DecimalType):
+        # precision/scale <= 38 each fit a byte-pair packed above the code space
+        return _DECIMAL_CODE + (dt.precision << 8) + (dt.scale << 16)
+    return _TYPE_TO_CODE[type(dt)]
+
+
+def type_from_code(code: int) -> DataType:
+    if code & 0xFF == _DECIMAL_CODE:
+        return DecimalType((code >> 8) & 0xFF, (code >> 16) & 0xFF)
+    return _CODE_TO_TYPE[code]
+
+
+def _type_to_json(dt: DataType):
+    if isinstance(dt, DecimalType):
+        return {"decimal": [dt.precision, dt.scale]}
+    return dt.sql_name
+
+
+def _type_from_json(obj) -> DataType:
+    if isinstance(obj, dict):
+        p, s = obj["decimal"]
+        return DecimalType(p, s)
+    for t in _CODE_TO_TYPE.values():
+        if t.sql_name == obj:
+            return t
+    raise ValueError(f"unknown type json {obj!r}")

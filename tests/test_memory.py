@@ -1,0 +1,108 @@
+"""Spill framework tests — mirrors the reference's RapidsBufferCatalogSuite /
+RapidsDeviceMemoryStoreSuite / RapidsDiskStoreSuite (SURVEY.md §4 ring 2, runnable on
+the CPU backend like ring 1)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.runtime.memory import (
+    BufferCatalog, DeviceManager, SpillableColumnarBatch, TierEnum,
+    ACTIVE_ON_DECK_PRIORITY, OUTPUT_FOR_SHUFFLE_INITIAL_PRIORITY,
+)
+
+
+def make_batch(n=100, seed=0):
+    r = np.random.default_rng(seed)
+    t = pa.table({
+        "a": pa.array(r.integers(0, 1000, n), type=pa.int64()),
+        "b": pa.array(r.normal(size=n)),
+        "s": pa.array([["x", "yy", "zzz"][i % 3] for i in range(n)]),
+    })
+    return ColumnarBatch.from_arrow(t), t
+
+
+def test_add_and_acquire_roundtrip(tmp_path):
+    cat = BufferCatalog(device_budget=1 << 30, host_budget=1 << 30,
+                        spill_dir=str(tmp_path))
+    batch, t = make_batch()
+    bid = cat.add_batch(batch)
+    assert cat.get_tier(bid) == TierEnum.DEVICE
+    out = cat.acquire_batch(bid)
+    assert out.to_arrow().equals(t)
+    cat.remove(bid)
+    assert cat.num_buffers == 0
+    assert cat.device_bytes == 0
+
+
+def test_budget_spills_to_host_then_disk(tmp_path):
+    batch, t = make_batch()
+    one = batch.device_memory_size()
+    # room for ~2 batches on device and ~1 on host → 3rd add pushes one to disk
+    cat = BufferCatalog(device_budget=int(one * 2.5), host_budget=int(one * 1.2),
+                        spill_dir=str(tmp_path))
+    ids = [cat.add_batch(make_batch(seed=i)[0]) for i in range(4)]
+    tiers = [cat.get_tier(i) for i in ids]
+    assert tiers.count(TierEnum.DEVICE) <= 2
+    assert TierEnum.HOST in tiers or TierEnum.DISK in tiers
+    assert cat.device_bytes <= cat.device_budget
+    assert cat.host_bytes <= cat.host_budget
+    # every buffer still readable from any tier, bit-identical
+    for i, bid in enumerate(ids):
+        got = cat.acquire_batch(bid).to_arrow()
+        assert got.equals(make_batch(seed=i)[1])
+    assert cat.spilled_to_host_bytes > 0
+
+
+def test_spill_priority_order(tmp_path):
+    batch, _ = make_batch()
+    one = batch.device_memory_size()
+    cat = BufferCatalog(device_budget=one * 10, host_budget=one * 10,
+                        spill_dir=str(tmp_path))
+    shuffle_id = cat.add_batch(make_batch(seed=1)[0],
+                               priority=OUTPUT_FOR_SHUFFLE_INITIAL_PRIORITY)
+    active_id = cat.add_batch(make_batch(seed=2)[0], priority=ACTIVE_ON_DECK_PRIORITY)
+    spilled = cat.synchronous_spill(int(one * 1.5))
+    assert spilled > 0
+    # the low-priority shuffle output spilled first; the active batch stayed
+    assert cat.get_tier(shuffle_id) != TierEnum.DEVICE
+    assert cat.get_tier(active_id) == TierEnum.DEVICE
+
+
+def test_unspill_promotes_back(tmp_path):
+    batch, t = make_batch()
+    one = batch.device_memory_size()
+    cat = BufferCatalog(device_budget=one * 10, host_budget=one * 10,
+                        spill_dir=str(tmp_path), unspill=True)
+    bid = cat.add_batch(batch)
+    cat.synchronous_spill(0)
+    assert cat.get_tier(bid) == TierEnum.HOST
+    out = cat.acquire_batch(bid)
+    assert cat.get_tier(bid) == TierEnum.DEVICE
+    assert out.to_arrow().equals(t)
+
+
+def test_spillable_columnar_batch_lifecycle(tmp_path):
+    DeviceManager.reset()
+    batch, t = make_batch()
+    scb = SpillableColumnarBatch(batch)
+    try:
+        assert scb.num_rows == 100
+        assert scb.get_batch().to_arrow().equals(t)
+    finally:
+        scb.close()
+    with pytest.raises(AssertionError):
+        scb.get_batch()
+
+
+def test_spill_callback_feeds_metrics(tmp_path):
+    batch, _ = make_batch()
+    one = batch.device_memory_size()
+    cat = BufferCatalog(device_budget=one * 10, host_budget=one * 10,
+                        spill_dir=str(tmp_path))
+    seen = []
+    cat.add_batch(batch, spill_callback=seen.append)
+    cat.synchronous_spill(0)
+    assert seen and seen[0] == one

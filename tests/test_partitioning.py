@@ -1,0 +1,156 @@
+"""Partitioning tests — Spark-exact hash placement plus slicing invariants
+(reference: HashPartitioningSuite / GpuPartitioningSuite patterns, SURVEY.md §4)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.expr.core import col
+from spark_rapids_tpu.ops.hashing import (murmur3_int_host, murmur3_long_host,
+                                          murmur3_bytes_host, _to_signed)
+from spark_rapids_tpu.ops.sorting import SortOrder
+from spark_rapids_tpu.shuffle.partitioning import (
+    HashPartitioner, RangePartitioner, RoundRobinPartitioner, SinglePartitioner,
+    SPARK_HASH_SEED)
+
+from conftest import make_table
+
+
+def spark_hash_rows(table):
+    """Host model of Spark Murmur3Hash(seed=42) over (int, long, string) rows."""
+    out = []
+    for i in range(table.num_rows):
+        h = SPARK_HASH_SEED
+        for name in table.column_names:
+            v = table[name][i].as_py()
+            if v is None:
+                continue
+            t = table.schema.field(name).type
+            if t == pa.int32():
+                h = murmur3_int_host(v, h)
+            elif t == pa.int64():
+                h = murmur3_long_host(v, h)
+            elif t == pa.string():
+                h = murmur3_bytes_host(v.encode(), h)
+            else:
+                raise NotImplementedError(str(t))
+        out.append(_to_signed(h))
+    return out
+
+
+def collect_rows(parts):
+    tables = [b.to_arrow() for _, b in parts]
+    return pa.concat_tables(tables) if tables else None
+
+
+def same_multiset(a: pa.Table, b: pa.Table) -> bool:
+    def rows(t):
+        cols = [t[name].to_pylist() for name in t.column_names]
+        key = lambda v: (v is None, str(type(v)), v if v is not None else 0)
+        return sorted(zip(*cols), key=lambda r: tuple(key(v) for v in r))
+    return rows(a) == rows(b)
+
+
+def test_hash_partition_matches_spark_placement():
+    n = 500
+    r = np.random.default_rng(1)
+    t = pa.table({
+        "i": pa.array([None if m else int(v) for v, m in
+                       zip(r.integers(-10**6, 10**6, n), r.random(n) < 0.1)],
+                      type=pa.int32()),
+        "l": pa.array(r.integers(-10**12, 10**12, n), type=pa.int64()),
+        "s": pa.array([["a", "bb", "ccc", "dddd", None][i % 5] for i in range(n)]),
+    })
+    batch = ColumnarBatch.from_arrow(t)
+    nparts = 7
+    p = HashPartitioner([col("i"), col("l"), col("s")], nparts).bind(batch.schema)
+    parts = dict(p.partition(batch))
+    expect_ids = [h % nparts + (nparts if h % nparts < 0 else 0)
+                  for h in spark_hash_rows(t)]
+    # group expected rows per partition and compare as multisets
+    got_total = 0
+    for pid, pb in parts.items():
+        pt = pb.to_arrow()
+        got_total += pt.num_rows
+        want = t.filter(pa.array([e == pid for e in expect_ids]))
+        assert same_multiset(pt, want), f"partition {pid}"
+    assert got_total == n
+
+
+def test_round_robin_balanced():
+    t = make_table(n=1000)
+    batch = ColumnarBatch.from_arrow(t)
+    p = RoundRobinPartitioner(8)
+    parts = p.partition(batch, split=3)
+    sizes = [b.num_rows for _, b in parts]
+    assert sum(sizes) == 1000
+    assert max(sizes) - min(sizes) <= 1
+    assert same_multiset(collect_rows(parts), t)
+
+
+def test_single_partitioner():
+    t = make_table(n=50)
+    batch = ColumnarBatch.from_arrow(t)
+    parts = SinglePartitioner().partition(batch)
+    assert len(parts) == 1 and parts[0][0] == 0
+    assert parts[0][1].to_arrow().equals(t)
+
+
+@pytest.mark.parametrize("ascending", [True, False])
+def test_range_partitioner_orders_partitions(ascending):
+    n = 800
+    r = np.random.default_rng(7)
+    t = pa.table({"k": pa.array([None if m else int(v) for v, m in
+                                 zip(r.integers(-1000, 1000, n), r.random(n) < 0.05)],
+                                type=pa.int64()),
+                  "v": pa.array(np.arange(n), type=pa.int32())})
+    batch = ColumnarBatch.from_arrow(t)
+    p = RangePartitioner([col("k")], [SortOrder(ascending=ascending)], 5).bind(batch.schema)
+    p.set_bounds_from_sample([batch])
+    parts = sorted(p.partition(batch), key=lambda x: x[0])
+    assert sum(b.num_rows for _, b in parts) == n
+    # every key in partition p must be <= (asc) every key in partition p+1, with
+    # Spark null ordering (nulls first when ascending)
+    def keyfn(x):
+        return (x is None, x) if not ascending else (x is not None, x if x is not None else 0)
+    seq = []
+    for _, b in parts:
+        ks = b.to_arrow()["k"].to_pylist()
+        if ascending:
+            seq.append((min((k for k in ks if k is not None), default=None),
+                        max((k for k in ks if k is not None), default=None),
+                        any(k is None for k in ks)))
+    if ascending:
+        # nulls (first) only in partition 0; min/max ranges non-overlapping
+        for i in range(1, len(parts)):
+            assert not seq[i][2] or i == 0
+        prev_max = None
+        for mn, mx, _ in seq:
+            if mn is None:
+                continue
+            if prev_max is not None:
+                assert mn >= prev_max
+            prev_max = mx
+    # full multiset preserved
+    assert same_multiset(collect_rows(parts), t)
+
+
+def test_string_hash_partition_roundtrip():
+    t = pa.table({"s": pa.array(["apple", "banana", None, "", "चाय", "apple"] * 20)})
+    batch = ColumnarBatch.from_arrow(t)
+    p = HashPartitioner([col("s")], 4).bind(batch.schema)
+    parts = p.partition(batch)
+    assert sum(b.num_rows for _, b in parts) == t.num_rows
+    # same value always lands in the same partition
+    seen = {}
+    for pid, b in parts:
+        for v in b.to_arrow()["s"].to_pylist():
+            assert seen.setdefault(v, pid) == pid
+    expect = {h % 4 + (4 if h % 4 < 0 else 0)
+              for h in spark_hash_rows(t.filter(pa.array([v is not None for v in
+                                                          t["s"].to_pylist()])))}
+    got_nonnull = {pid for pid, b in parts
+                   for v in b.to_arrow()["s"].to_pylist() if v is not None}
+    assert got_nonnull == expect

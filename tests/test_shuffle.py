@@ -1,0 +1,133 @@
+"""Shuffle layer tests: serializer round-trip, block store, exchange exec
+(reference ring-1 mock-shuffle suites + GpuShuffleSuite patterns, SURVEY.md §4)."""
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.exec.basic import RangeExec
+from spark_rapids_tpu.exec.exchange import ShuffleExchangeExec
+from spark_rapids_tpu.expr.core import col
+from spark_rapids_tpu.ops.sorting import SortOrder
+from spark_rapids_tpu.shuffle import serialization as ser
+from spark_rapids_tpu.shuffle.manager import ShuffleBlockStore
+from spark_rapids_tpu.shuffle.partitioning import (HashPartitioner, RangePartitioner,
+                                                   RoundRobinPartitioner)
+
+from conftest import make_table
+from test_partitioning import same_multiset
+
+
+def test_serializer_roundtrip_all_types():
+    t = make_table(n=333)
+    from decimal import Decimal
+    t = t.append_column("dec", pa.array(
+        [None if i % 7 == 0 else Decimal(i * 1000 + i).scaleb(-2) for i in range(333)],
+        type=pa.decimal128(10, 2)))
+    t = t.append_column("ts", pa.array(
+        [None if i % 5 == 0 else i * 1000003 for i in range(333)],
+        type=pa.timestamp("us", tz="UTC")))
+    batch = ColumnarBatch.from_arrow(t)
+    blob = ser.serialize_batch(batch)
+    assert isinstance(blob, bytes)
+    out = ser.deserialize_batch(blob)
+    assert out.to_arrow().equals(t)
+    assert out.schema.names == batch.schema.names
+
+
+def test_serializer_empty_batch():
+    schema = T.StructType([T.StructField("a", T.LONG), T.StructField("s", T.STRING)])
+    out = ser.deserialize_batch(ser.serialize_batch(ColumnarBatch.empty(schema)))
+    assert out.num_rows == 0
+    assert out.schema.names == ["a", "s"]
+
+
+def test_block_store_write_read_unregister():
+    store = ShuffleBlockStore.get()
+    sid = store.register_shuffle()
+    t = make_table(n=64)
+    store.write_block(sid, 0, ColumnarBatch.from_arrow(t))
+    store.write_block(sid, 2, ColumnarBatch.from_arrow(t))
+    got = list(store.read_partition(sid, 0))
+    assert len(got) == 1 and got[0].to_arrow().equals(t)
+    assert list(store.read_partition(sid, 1)) == []
+    store.unregister_shuffle(sid)
+
+
+def _exchange_source(n=1000, parts=4):
+    """RangeExec source: id column 0..n across `parts` partitions."""
+    return RangeExec(0, n, 1, num_slices=parts, conf=RapidsConf())
+
+
+def test_hash_exchange_end_to_end():
+    src = _exchange_source(1000, 4)
+    ex = ShuffleExchangeExec(HashPartitioner([col("id")], 8), src)
+    out = ex.execute_collect()
+    assert sorted(out["id"].to_pylist()) == list(range(1000))
+
+
+def test_hash_exchange_serialized_fallback():
+    src = RangeExec(0, 500, 1, num_slices=3,
+                    conf=RapidsConf({"spark.rapids.tpu.shuffle.enabled": False}))
+    ex = ShuffleExchangeExec(HashPartitioner([col("id")], 5), src)
+    out = ex.execute_collect()
+    assert sorted(out["id"].to_pylist()) == list(range(500))
+
+
+def test_round_robin_exchange_balances():
+    src = _exchange_source(999, 3)
+    ex = ShuffleExchangeExec(RoundRobinPartitioner(7), src)
+    sizes = []
+    for p in range(7):
+        rows = sum(b.num_rows for b in ex.execute_partition(p))
+        sizes.append(rows)
+    assert sum(sizes) == 999
+
+
+def test_range_exchange_globally_sorted_partitions():
+    src = _exchange_source(2000, 4)
+    ex = ShuffleExchangeExec(
+        RangePartitioner([col("id")], [SortOrder(ascending=True)], 6), src)
+    maxes = []
+    for p in range(6):
+        vals = [v for b in ex.execute_partition(p) for v in b.to_arrow()["id"].to_pylist()]
+        if vals:
+            if maxes:
+                assert min(vals) >= maxes[-1]
+            maxes.append(max(vals))
+
+
+def test_two_phase_aggregate_over_exchange_no_deadlock():
+    """Regression: reduce tasks must not hold semaphore permits while blocked on the
+    shuffle map stage (the reference releases the semaphore while awaiting fetches,
+    RapidsShuffleIterator.scala:300)."""
+    from spark_rapids_tpu.exec.aggregate import HashAggregateExec, PARTIAL, FINAL
+    from spark_rapids_tpu.expr.aggregates import Sum, Count
+    from spark_rapids_tpu.expr.core import Alias
+    from spark_rapids_tpu.expr.arithmetic import Pmod
+    from spark_rapids_tpu.runtime.semaphore import TpuSemaphore
+
+    TpuSemaphore.initialize(2)  # tight permits + multi-partition exchange
+    conf = RapidsConf({"spark.rapids.tpu.sql.localScheduler.numThreads": 4})
+    src = RangeExec(0, 10000, 1, num_slices=4, conf=conf)
+    key = Alias(Pmod(col("id"), lit_long(10)), "k")
+    partial = HashAggregateExec([key], [Alias(Sum(col("id")), "s"),
+                                        Alias(Count(col("id")), "c")], src,
+                                mode=PARTIAL)
+    ex = ShuffleExchangeExec(HashPartitioner([col("k")], 6), partial)
+    final = HashAggregateExec([col("k")], [Alias(Sum(col("id")), "s"),
+                                           Alias(Count(col("id")), "c")], ex,
+                              mode=FINAL)
+    out = final.execute_collect().sort_by("k")
+    assert out["k"].to_pylist() == list(range(10))
+    assert out["c"].to_pylist() == [1000] * 10
+    expect = [sum(v for v in range(10000) if v % 10 == k) for k in range(10)]
+    assert out["s"].to_pylist() == expect
+
+
+def lit_long(v):
+    from spark_rapids_tpu.expr.core import Literal
+    from spark_rapids_tpu import types as TT
+    return Literal(v, TT.LONG)
