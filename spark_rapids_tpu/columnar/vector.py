@@ -135,7 +135,9 @@ class TpuColumnVector:
         vals, valid = self.to_host(num_rows)
         if self.is_string:
             codes = pa.array(vals.astype(np.int32), type=pa.int32())
-            taken = self.dictionary.take(codes) if len(self.dictionary) else pa.nulls(
+            # all-null string columns (e.g. outer-join null extension) have no dict
+            has_dict = self.dictionary is not None and len(self.dictionary)
+            taken = self.dictionary.take(codes) if has_dict else pa.nulls(
                 num_rows, pa.string())
             return pc.if_else(pa.array(valid), taken, pa.nulls(num_rows, pa.string()))
         if isinstance(self.dtype, T.DecimalType):

@@ -81,11 +81,27 @@ class ShuffleExchangeExec(TpuExec):
 
     def _ensure_map_stage(self):
         if self._map_done.is_set():
+            self._raise_if_failed()
             return
         with self._map_lock:
             if not self._map_done.is_set():
-                self._run_map_stage()
-                self._map_done.set()
+                try:
+                    self._run_map_stage()
+                except BaseException as e:
+                    # don't re-run the map stage per reduce task, and don't strand
+                    # the partially written blocks in the catalog
+                    self._map_error = e
+                    if self._shuffle_id is not None:
+                        ShuffleBlockStore.get().unregister_shuffle(self._shuffle_id)
+                        self._shuffle_id = None
+                finally:
+                    self._map_done.set()
+        self._raise_if_failed()
+
+    def _raise_if_failed(self):
+        err = getattr(self, "_map_error", None)
+        if err is not None:
+            raise RuntimeError("shuffle map stage failed") from err
 
     def _reader(self, split):
         store = ShuffleBlockStore.get()
@@ -93,15 +109,17 @@ class ShuffleExchangeExec(TpuExec):
         # GpuShuffleCoalesceExec inserted by GpuTransitionOverrides:57-63)
         it = store.read_partition(self._shuffle_id, split)
         goal = TargetSize(self.conf.batch_size_bytes)
-        yield from coalesce_iterator(it, goal, self.metrics)
-        # free shuffle blocks once every reduce partition has been fully drained
-        # (the reference keeps them until Spark unregisters the shuffle; our local
-        # scheduler reads each partition exactly once per query)
-        with self._reads_lock:
-            self._reads_left -= 1
-            done = self._reads_left == 0
-        if done:
-            store.unregister_shuffle(self._shuffle_id)
+        try:
+            yield from coalesce_iterator(it, goal, self.metrics)
+        finally:
+            # free shuffle blocks once every reduce partition has been drained OR
+            # abandoned (limit/early close) — the reference keeps them until Spark
+            # unregisters the shuffle; our local scheduler reads each partition once
+            with self._reads_lock:
+                self._reads_left -= 1
+                done = self._reads_left == 0
+            if done:
+                store.unregister_shuffle(self._shuffle_id)
 
     def execute_partition(self, split):
         # drop this task's permit before (possibly) blocking on the map stage —

@@ -1,0 +1,135 @@
+"""Equi-join gather-map kernels under XLA's static-shape regime.
+
+Reference (SURVEY.md component #16): GpuHashJoin.scala:289 calls cudf
+`innerJoinGatherMaps` / `leftJoinGatherMaps` etc — hash-table probes producing
+data-dependent-size gather maps, iterated out-of-core by JoinGatherer.scala.
+
+TPU-native design (no hash tables — irregular memory access is hostile to the MXU/VPU;
+sorts and searches are XLA-native):
+
+1. **Dense ranks**: concatenate build+stream key rows and run ONE fused multi-key sort
+   (ops.grouping.group_segments); equal key tuples — with Spark's NaN==NaN and
+   null-grouping semantics — get equal dense ranks. Rank equality IS key-tuple
+   equality (collision-free, unlike hashing).
+2. **Range probe**: sort build ranks once; per stream row `searchsorted` left/right
+   gives its contiguous match range [lo, hi) — the "gather map" is implicit.
+3. **Bounded expansion**: pair j maps to stream row i = searchsorted(cumsum(counts), j)
+   and build slot lo[i] + (j - start[i]); expansion is chunked to a fixed output
+   capacity so one compiled program serves any join size (the JoinGatherer analog).
+
+Join-type semantics (Spark):
+- nulls in keys never match (EqualTo); NaN matches NaN; -0.0 == 0.0 (canonicalized);
+- LeftOuter emits unmatched stream rows null-extended; FullOuter additionally emits
+  unmatched build rows (computed by the symmetric probe, no scatter);
+- LeftSemi emits each matching stream row once; LeftAnti the non-matching ones.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Col
+from spark_rapids_tpu.ops.grouping import group_segments
+
+INNER = "inner"
+LEFT_OUTER = "leftouter"
+RIGHT_OUTER = "rightouter"
+FULL_OUTER = "fullouter"
+LEFT_SEMI = "leftsemi"
+LEFT_ANTI = "leftanti"
+CROSS = "cross"
+
+_BUILD_NULL_RANK = jnp.int32(-2)
+_STREAM_NULL_RANK = jnp.int32(-1)
+_PAD_RANK = jnp.int32(2**31 - 1)
+
+
+def _concat_key_cols(build_keys, stream_keys):
+    out = []
+    for b, s in zip(build_keys, stream_keys):
+        vals = jnp.concatenate([b.values, s.values])
+        valid = jnp.concatenate([b.validity, s.validity])
+        out.append(Col(vals, valid, b.dtype, b.dictionary))
+    return out
+
+
+def join_ranks(build_keys, n_build, build_cap, stream_keys, n_stream, stream_cap):
+    """Dense ranks for both sides such that rank equality == key-tuple equality.
+    Null-keyed rows get side-specific sentinel ranks so they never match; padding
+    gets +inf rank. Returns (build_ranks, stream_ranks) int32 arrays."""
+    total_cap = build_cap + stream_cap
+    both = _concat_key_cols(build_keys, stream_keys)
+    # live across the concatenated array: build rows [0,n_build), stream rows
+    # [build_cap, build_cap+n_stream)
+    idx = jnp.arange(total_cap, dtype=jnp.int32)
+    live = jnp.where(idx < build_cap, idx < n_build, (idx - build_cap) < n_stream)
+    # group_segments sorts with padding sunk by its own live test (arange < num_rows),
+    # so feed it a permutation-friendly row count: instead we sort all rows and mask
+    # afterwards — pass num_rows=total_cap and handle liveness via rank sentinels.
+    perm, seg_ids, boundary, _ = group_segments(both, jnp.int32(total_cap), total_cap)
+    ranks = jnp.zeros((total_cap,), jnp.int32).at[perm].set(seg_ids)
+    any_null = jnp.zeros((total_cap,), jnp.bool_)
+    for c in both:
+        any_null = any_null | ~c.validity
+    is_build = idx < build_cap
+    ranks = jnp.where(any_null, jnp.where(is_build, _BUILD_NULL_RANK,
+                                          _STREAM_NULL_RANK), ranks)
+    ranks = jnp.where(live, ranks, _PAD_RANK)
+    return ranks[:build_cap], ranks[build_cap:]
+
+
+def probe(build_ranks, stream_ranks):
+    """Sorted-build probe. Returns (build_perm, lo, hi) with lo/hi per stream row."""
+    build_perm = jnp.argsort(build_ranks, stable=True)
+    sorted_build = build_ranks[build_perm]
+    lo = jnp.searchsorted(sorted_build, stream_ranks, side="left")
+    hi = jnp.searchsorted(sorted_build, stream_ranks, side="right")
+    # null/pad sentinels never match: stream sentinel ranks are negative/huge and
+    # distinct from build sentinels, but guard explicitly for safety
+    bad = (stream_ranks == _STREAM_NULL_RANK) | (stream_ranks == _PAD_RANK)
+    hi = jnp.where(bad, lo, hi)
+    return build_perm, lo, hi
+
+
+def pair_counts(lo, hi, n_stream, stream_cap, join_type):
+    """Per-stream-row emitted pair count for the join type."""
+    live = jnp.arange(stream_cap, dtype=jnp.int32) < n_stream
+    matches = (hi - lo).astype(jnp.int32)
+    if join_type in (INNER,):
+        counts = matches
+    elif join_type in (LEFT_OUTER, FULL_OUTER):
+        counts = jnp.maximum(matches, 1)
+    elif join_type == LEFT_SEMI:
+        counts = jnp.minimum(matches, 1)
+    elif join_type == LEFT_ANTI:
+        counts = (matches == 0).astype(jnp.int32)
+    else:
+        raise ValueError(f"unsupported join type for pair_counts: {join_type}")
+    return jnp.where(live, counts, 0)
+
+
+def expand_pairs(build_perm, lo, hi, counts, start_pair: int, out_cap: int):
+    """Materialize pairs [start_pair, start_pair+out_cap) as
+    (stream_idx, build_idx, build_matched, pair_live).
+
+    build_matched=False marks null-extension slots of outer joins. One compiled
+    program serves every chunk (static out_cap) — the JoinGatherer iteration."""
+    offsets = jnp.cumsum(counts)  # inclusive
+    total = offsets[-1]
+    j = jnp.arange(out_cap, dtype=jnp.int32) + jnp.int32(start_pair)
+    stream_idx = jnp.searchsorted(offsets, j, side="right").astype(jnp.int32)
+    stream_idx_c = jnp.clip(stream_idx, 0, counts.shape[0] - 1)
+    starts = offsets - counts
+    within = j - starts[stream_idx_c]
+    n_matches = (hi - lo)[stream_idx_c]
+    build_matched = within < n_matches
+    b_pos = jnp.clip(lo[stream_idx_c] + jnp.minimum(within, n_matches - 1), 0,
+                     build_perm.shape[0] - 1)
+    build_idx = build_perm[b_pos]
+    pair_live = j < total
+    return stream_idx_c, build_idx, build_matched & pair_live, pair_live
+
+
+def total_pairs(counts):
+    return jnp.sum(counts)

@@ -39,7 +39,9 @@ from spark_rapids_tpu.runtime.arm import LeakTracker
 # Lower value spills FIRST.
 OUTPUT_FOR_SHUFFLE_INITIAL_PRIORITY = -1000.0   # shuffle output: spill early
 ACTIVE_ON_DECK_PRIORITY = 100.0                 # batches queued for processing
-ACTIVE_BATCHING_PRIORITY = 50.0                 # batches held by a running op
+# batches an operator is actively coalescing/probing spill LAST (reference:
+# ACTIVE_BATCHING_PRIORITY = ACTIVE_ON_DECK_PRIORITY + 100)
+ACTIVE_BATCHING_PRIORITY = 200.0
 
 
 class TierEnum:

@@ -81,13 +81,12 @@ def slice_into_partitions(batch: ColumnarBatch, part_ids, num_partitions: int):
         for c in sorted_cols:
             vals = c.values[lo:lo + pcap]
             valid = c.validity[lo:lo + pcap]
+            default = jnp.asarray(c.dtype.default_value(), dtype=vals.dtype)
             if vals.shape[0] < pcap:  # partition tail ran past the padded capacity
                 pad = pcap - vals.shape[0]
-                default = jnp.asarray(c.dtype.default_value(), dtype=vals.dtype)
                 vals = jnp.concatenate([vals, jnp.full((pad,), default)])
                 valid = jnp.concatenate([valid, jnp.zeros((pad,), jnp.bool_)])
             idx = jnp.arange(pcap) < cnt
-            default = jnp.asarray(c.dtype.default_value(), dtype=vals.dtype)
             valid = valid & idx
             pcols.append(TpuColumnVector(c.dtype, jnp.where(valid, vals, default),
                                          valid, c.dictionary))
