@@ -1,0 +1,120 @@
+"""Benchmark: fused scan→filter→project→hash-aggregate stage throughput.
+
+BASELINE.md config-1 analog (q5-like hash aggregate): one XLA program doing
+filter + project + group-by(sum/count/min/max) over a padded columnar batch —
+the TPU-native counterpart of the reference's GpuFilterExec → GpuProjectExec →
+GpuHashAggregateExec pipeline (SURVEY.md §3.3). Prints ONE JSON line.
+
+`vs_baseline` is speedup over a single-core NumPy columnar implementation of the
+same query on the same host (the reference's own published claim is 3x-7x vs CPU
+Spark, docs/FAQ.md:82-88 — no numeric tables exist in-tree, BASELINE.md).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+CAP = 1 << 22          # 4M row padded batch
+N_ROWS = (1 << 22) - 37
+N_KEYS = 4096
+ITERS = 10
+
+
+def host_baseline(key_vals, key_valid, val_vals, val_valid, n):
+    """Single-core NumPy version of the same query (CPU Spark stand-in)."""
+    k = key_vals[:n]
+    kv = key_valid[:n]
+    v = val_vals[:n]
+    vm = val_valid[:n]
+    keep = vm & (v > 0.0)
+    k, kv, v = k[keep], kv[keep], v[keep]
+    proj = v * 2.0 + k.astype(np.float64) * 0.5
+    pvalid = kv  # val is valid for all kept rows
+    # group by (key, key_valid): null keys form one group
+    gk = np.where(kv, k, np.int64(-(1 << 62)))
+    order = np.argsort(gk, kind="stable")
+    gk, proj, pvalid = gk[order], proj[order], pvalid[order]
+    uniq, start = np.unique(gk, return_index=True)
+    sums = np.add.reduceat(np.where(pvalid, proj, 0.0), start)
+    cnts = np.add.reduceat(pvalid.astype(np.int64), start)
+    mins = np.minimum.reduceat(np.where(pvalid, proj, np.inf), start)
+    maxs = np.maximum.reduceat(np.where(pvalid, proj, -np.inf), start)
+    return uniq, sums, cnts, mins, maxs
+
+
+def timed_loop_fn(stage, iters):
+    """Run the stage `iters` times on-device inside one dispatch, with a data
+    dependency between iterations so XLA cannot elide or overlap them. One
+    dispatch per measurement is essential: the device link has O(10ms) roundtrip
+    latency, so per-call host timing measures the tunnel, not the kernel."""
+    import jax
+    import jax.numpy as jnp
+
+    def body(_, carry):
+        kv, km, vv, vm, nr = carry
+        out = stage(kv, km, vv, vm, nr)
+        # fold a result element back into the input (value ~0, keeps dtypes)
+        delta = (out[1][0] * 1e-30).astype(vv.dtype)
+        return (kv, km, vv + delta, vm, nr)
+
+    def run(kv, km, vv, vm, nr):
+        carry = jax.lax.fori_loop(0, iters, body, (kv, km, vv, vm, nr))
+        return stage(*carry)
+
+    return jax.jit(run)
+
+
+def main():
+    import jax
+    from __graft_entry__ import _build_stage
+
+    rng = np.random.default_rng(42)
+    key_vals = rng.integers(0, N_KEYS, CAP).astype(np.int64)
+    key_valid = rng.random(CAP) > 0.02
+    val_vals = rng.normal(0, 10, CAP)
+    val_valid = rng.random(CAP) > 0.02
+    num_rows = np.int32(N_ROWS)
+
+    stage = _build_stage()
+    dev_args = [jax.device_put(a) for a in
+                (key_vals, key_valid, val_vals, val_valid)]
+
+    def measure(iters):
+        fn = timed_loop_fn(stage, iters)
+        out = fn(*dev_args, num_rows)               # compile + warmup
+        _ = np.asarray(out[-1])                     # full host sync
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = fn(*dev_args, num_rows)
+            _ = np.asarray(out[-1])
+            best = min(best, time.perf_counter() - t0)
+        return best, out
+
+    t_short, _ = measure(1)
+    t_long, out = measure(1 + ITERS)
+    tpu_s = max((t_long - t_short) / ITERS, 1e-9)
+
+    t0 = time.perf_counter()
+    ref = host_baseline(key_vals, key_valid, val_vals, val_valid, N_ROWS)
+    cpu_s = time.perf_counter() - t0
+
+    # correctness spot-check: group count and total sum match the host baseline
+    n_groups = int(out[-1])
+    assert n_groups == len(ref[0]), (n_groups, len(ref[0]))
+    dev_sum = float(np.asarray(out[1])[:n_groups].sum())
+    assert abs(dev_sum - float(ref[1].sum())) < 1e-6 * max(1.0, abs(dev_sum))
+
+    rows_per_s = N_ROWS / tpu_s
+    print(json.dumps({
+        "metric": "fused_hash_aggregate_throughput",
+        "value": round(rows_per_s / 1e6, 3),
+        "unit": "Mrows/s",
+        "vs_baseline": round(cpu_s / tpu_s, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

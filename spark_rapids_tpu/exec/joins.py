@@ -111,7 +111,9 @@ class HashJoinExec(TpuExec):
         jt = join_type.lower().replace("_", "")
         self.join_type = jt
         if jt not in (J.INNER, J.LEFT_OUTER, J.RIGHT_OUTER, J.FULL_OUTER,
-                      J.LEFT_SEMI, J.LEFT_ANTI, J.CROSS):
+                      J.LEFT_SEMI, J.LEFT_ANTI):
+            # CROSS must go through NestedLoopJoinExec: the hash-probe kernel has
+            # no all-pairs mode, so accepting it here would only fail at run time
             raise ValueError(f"unsupported join type {join_type}")
         if condition is not None and jt not in (J.INNER, J.CROSS):
             # reference: conditional outer joins are not supported by GpuHashJoin
@@ -229,39 +231,67 @@ class HashJoinExec(TpuExec):
                 + (f" cond={self.condition}" if self.condition is not None else ""))
 
 
+class _SharedBroadcast:
+    """Broadcast build table shared across all stream partitions: materialized
+    once, closed by the LAST partition to finish, with a globally-merged
+    matched-row accumulator so full-outer unmatched-build rows are emitted
+    exactly once (reference GpuBroadcastExchangeExec + the shared gatherer state
+    in GpuBroadcastNestedLoopJoinExec)."""
+
+    def __init__(self, child, n_readers: int):
+        self._child = child
+        self._lock = threading.Lock()
+        self._sb: mem.SpillableColumnarBatch | None = None
+        self._readers_left = n_readers
+        self.matched_acc: np.ndarray | None = None
+
+    def get(self) -> mem.SpillableColumnarBatch:
+        with self._lock:
+            if self._sb is None:
+                batches = []
+                for split in range(self._child.num_partitions):
+                    with TaskContext():
+                        batches.extend(self._child.execute_partition(split))
+                def gen():
+                    yield from batches
+                self._sb = mem.SpillableColumnarBatch(
+                    concat_all(gen(), self._child.output),
+                    mem.ACTIVE_BATCHING_PRIORITY)
+            return self._sb
+
+    def merge_matched(self, local: np.ndarray) -> None:
+        with self._lock:
+            if self.matched_acc is None:
+                self.matched_acc = np.zeros_like(local)
+            np.logical_or(self.matched_acc, local, out=self.matched_acc)
+
+    def finish(self) -> bool:
+        """Count down one reader; True for the last one (who must close())."""
+        with self._lock:
+            self._readers_left -= 1
+            return self._readers_left == 0
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sb is not None:
+                self._sb.close()
+                self._sb = None
+
+
 class BroadcastHashJoinExec(HashJoinExec):
     """Build side is broadcast (materialized once, shared across stream partitions)
     — reference shim GpuBroadcastHashJoinExec + GpuBroadcastExchangeExec."""
 
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
-        self._broadcast: mem.SpillableColumnarBatch | None = None
-        self._bcast_lock = threading.Lock()
-
-    @property
-    def num_partitions(self):
-        return (self.children[0] if self.stream_is_left else self.children[1]).num_partitions
-
-    def _build_broadcast(self, build_child):
-        with self._bcast_lock:
-            if self._broadcast is None:
-                batches = []
-                for split in range(build_child.num_partitions):
-                    with TaskContext():
-                        batches.extend(build_child.execute_partition(split))
-                def gen():
-                    yield from batches
-                batch = concat_all(gen(), build_child.output)
-                self._broadcast = mem.SpillableColumnarBatch(
-                    batch, mem.ACTIVE_BATCHING_PRIORITY)
-            return self._broadcast
+        build_child = self.children[1] if self.stream_is_left else self.children[0]
+        self._shared = _SharedBroadcast(build_child, self.num_partitions)
 
     def execute_partition(self, split):
         def it():
-            build_child = self.children[1] if self.stream_is_left else self.children[0]
             stream_child = self.children[0] if self.stream_is_left else self.children[1]
             with trace_range("BroadcastHashJoin.build", self._build_time):
-                sb = self._build_broadcast(build_child)
+                sb = self._shared.get()
             bk = self.left_keys if not self.stream_is_left else self.right_keys
             sk = self.right_keys if not self.stream_is_left else self.left_keys
             core = _JoinCore(sb.get_batch(), bk, sk, self.join_type)
@@ -272,8 +302,14 @@ class BroadcastHashJoinExec(HashJoinExec):
                     build_perm, lo, hi, counts = core.probe_batch(stream_batch)
                 yield from self._emit(stream_batch, sb.get_batch(), core,
                                       build_perm, lo, hi, counts, out_schema)
-            if self.join_type == J.FULL_OUTER:
-                yield from self._emit_unmatched_build(core, sb.get_batch(), out_schema)
+            if core.build_matched_acc is not None:
+                self._shared.merge_matched(core.build_matched_acc)
+            if self._shared.finish():
+                if self.join_type == J.FULL_OUTER:
+                    core.build_matched_acc = self._shared.matched_acc
+                    yield from self._emit_unmatched_build(core, sb.get_batch(),
+                                                          out_schema)
+                self._shared.close()
         return self.wrap_output(it())
 
 
@@ -294,8 +330,7 @@ class NestedLoopJoinExec(TpuExec):
         self.condition = (bind_references(condition, self._pair_schema())
                           if condition is not None else None)
         self._join_time = self.metrics.metric(M.JOIN_TIME, M.MODERATE)
-        self._broadcast = None
-        self._bcast_lock = threading.Lock()
+        self._shared = _SharedBroadcast(self.children[1], self.num_partitions)
 
     def _pair_schema(self):
         return T.StructType(list(self.children[0].output) +
@@ -316,23 +351,9 @@ class NestedLoopJoinExec(TpuExec):
     def num_partitions(self):
         return self.children[0].num_partitions
 
-    def _build(self):
-        with self._bcast_lock:
-            if self._broadcast is None:
-                batches = []
-                for split in range(self.children[1].num_partitions):
-                    with TaskContext():
-                        batches.extend(self.children[1].execute_partition(split))
-                def gen():
-                    yield from batches
-                self._broadcast = mem.SpillableColumnarBatch(
-                    concat_all(gen(), self.children[1].output),
-                    mem.ACTIVE_BATCHING_PRIORITY)
-            return self._broadcast
-
     def execute_partition(self, split):
         def it():
-            sb = self._build()
+            sb = self._shared.get()
             build = sb.get_batch()
             n_build = build.num_rows
             out_schema = self.output
@@ -345,8 +366,12 @@ class NestedLoopJoinExec(TpuExec):
                     yield from self._join_batch(lb, build, n_build, out_schema,
                                                 pair_schema, right_matched_acc)
             if right_matched_acc is not None:
-                yield from self._unmatched_right(build, n_build, right_matched_acc,
-                                                 out_schema)
+                self._shared.merge_matched(right_matched_acc)
+            if self._shared.finish():
+                if self.join_type == J.FULL_OUTER:
+                    yield from self._unmatched_right(
+                        build, n_build, self._shared.matched_acc, out_schema)
+                self._shared.close()
         return self.wrap_output(it())
 
     def _join_batch(self, lb, build, n_build, out_schema, pair_schema, matched_acc):
@@ -355,8 +380,11 @@ class NestedLoopJoinExec(TpuExec):
         rcols = [Col.from_vector(c) for c in build.columns]
         total = n_left * n_build
         left_match = np.zeros(lb.capacity, dtype=bool)
+        jt = self.join_type
+        # inner/outer pair chunks stream out as soon as each is produced so only
+        # one expansion chunk is live at a time; semi/anti only need match flags
+        emit_pairs = jt in (J.INNER, J.LEFT_OUTER, J.FULL_OUTER)
         pos = 0
-        out_pairs = []
         while pos < total:
             out_cap = bucket_capacity(min(total - pos, _MAX_CHUNK_ROWS))
             j = jnp.arange(out_cap, dtype=jnp.int32) + jnp.int32(pos)
@@ -386,12 +414,9 @@ class NestedLoopJoinExec(TpuExec):
                 if matched_acc is not None and n_left > 0:
                     matched_acc[:n_build] = True
             pos += out_cap
-            out_pairs.append(batch)
-        jt = self.join_type
-        if jt in (J.INNER,):
-            yield from (b for b in out_pairs if b.num_rows)
-        elif jt in (J.LEFT_OUTER, J.FULL_OUTER):
-            yield from (b for b in out_pairs if b.num_rows)
+            if emit_pairs and batch.num_rows:
+                yield batch
+        if jt in (J.LEFT_OUTER, J.FULL_OUTER):
             yield from self._unmatched_left(lb, lcols, left_match, out_schema)
         elif jt in (J.LEFT_SEMI, J.LEFT_ANTI):
             want = left_match if jt == J.LEFT_SEMI else ~left_match

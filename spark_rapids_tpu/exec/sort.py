@@ -20,7 +20,12 @@ import jax.numpy as jnp
 class SortExec(TpuExec):
     def __init__(self, sort_exprs: list, orders: list, child: TpuExec,
                  global_sort: bool = False, conf=None):
-        """sort_exprs: expressions producing sort keys; orders: list[SortOrder]."""
+        """sort_exprs: expressions producing sort keys; orders: list[SortOrder].
+        global_sort gathers every partition first (a total order, as Spark gets
+        from range-partition + per-partition sort; out-of-core merge is the
+        RangePartitioner path in the exchange layer)."""
+        if global_sort and child.num_partitions > 1:
+            child = _GatherAllExec(child, conf=conf)
         super().__init__(child, conf=conf)
         self.sort_exprs = [bind_references(e, child.output) for e in sort_exprs]
         self.orders = list(orders)

@@ -1,0 +1,11 @@
+"""L3 planner/override layer: plan rewrite engine, metas, type checks, transitions.
+
+Reference: GpuOverrides.scala:431/3013, RapidsMeta.scala:70, TypeChecks.scala:129,
+GpuTransitionOverrides.scala:40, CostBasedOptimizer.scala:52 (SURVEY.md §1 L3)."""
+
+from spark_rapids_tpu.plan.nodes import (  # noqa: F401
+    PlanNode, ScanNode, ProjectNode, FilterNode, AggregateNode, JoinNode,
+    SortNode, LimitNode, UnionNode, RangeNode, ExchangeNode, WindowNode,
+    ExpandNode, GenerateNode,
+)
+from spark_rapids_tpu.plan.overrides import TpuOverrides, explain_plan  # noqa: F401

@@ -1,0 +1,526 @@
+"""Host (CPU) expression evaluator — the independent oracle / fallback path.
+
+Reference analogy: in the reference, unsupported nodes simply stay as Spark CPU
+execs and Spark's own interpreter runs them (SURVEY.md §1 L3). Our framework is
+standalone, so the host path is an independent NumPy implementation of the same
+expression semantics. It doubles as the CPU side of the equivalence test harness
+(reference SparkQueryCompareTestSuite.scala:183 withCpuSparkSession).
+
+Deliberately NOT jax: a second implementation that can disagree with the device
+path is exactly what makes ring-2 tests meaningful.
+"""
+
+from __future__ import annotations
+
+import datetime
+import math
+import re
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr import core as E
+from spark_rapids_tpu.expr import arithmetic as A
+from spark_rapids_tpu.expr import predicates as P
+from spark_rapids_tpu.expr import nullexprs as N
+from spark_rapids_tpu.expr import conditional as C
+from spark_rapids_tpu.expr import mathexprs as MM
+from spark_rapids_tpu.expr import strings as S
+from spark_rapids_tpu.expr import datetime as DT
+from spark_rapids_tpu.expr.cast import Cast
+
+
+class HostCol:
+    """Host column: python list of values with None for null (exactness over speed —
+    this is the oracle, not the fast path)."""
+
+    __slots__ = ("data", "dtype")
+
+    def __init__(self, data: list, dtype: T.DataType):
+        self.data = data
+        self.dtype = dtype
+
+    def __len__(self):
+        return len(self.data)
+
+    @staticmethod
+    def from_arrow(arr, dtype: T.DataType) -> "HostCol":
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        data = arr.to_pylist()
+        if isinstance(dtype, T.FloatType):
+            data = [None if v is None else float(np.float32(v)) for v in data]
+        return HostCol(data, dtype)
+
+    def to_arrow(self):
+        return pa.array(self.data, type=T.to_arrow_type(self.dtype))
+
+
+def table_schema(tbl: pa.Table) -> T.StructType:
+    return T.StructType([
+        T.StructField(f.name, T.from_arrow_type(f.type), True) for f in tbl.schema])
+
+
+def eval_host(expr: E.Expression, tbl: pa.Table) -> HostCol:
+    """Evaluate an expression tree against a pyarrow table, row-at-a-time."""
+    n = tbl.num_rows
+    if isinstance(expr, E.Alias):
+        return eval_host(expr.child, tbl)
+    if isinstance(expr, E.AttributeReference):
+        idx = tbl.schema.get_field_index(expr.name)
+        return HostCol.from_arrow(tbl.column(idx), expr.dtype)
+    if isinstance(expr, E.BoundReference):
+        return HostCol.from_arrow(tbl.column(expr.ordinal), expr.dtype)
+    if isinstance(expr, E.Literal):
+        return HostCol([expr.value] * n, expr.dtype)
+
+    kids = [eval_host(c, tbl) for c in getattr(expr, "children", [])]
+    fn = _DISPATCH.get(type(expr))
+    if fn is None:
+        for klass, f in _DISPATCH.items():
+            if isinstance(expr, klass):
+                fn = f
+                break
+    if fn is None:
+        raise NotImplementedError(f"host eval for {type(expr).__name__}")
+    return fn(expr, kids, n)
+
+
+# ---- helpers ---------------------------------------------------------------
+
+def _unary(fn):
+    def run(expr, kids, n):
+        (a,) = kids
+        return HostCol([None if v is None else fn(expr, v) for v in a.data],
+                       expr.dtype)
+    return run
+
+
+def _binary(fn):
+    def run(expr, kids, n):
+        a, b = kids
+        out = [None if (x is None or y is None) else fn(expr, x, y)
+               for x, y in zip(a.data, b.data)]
+        return HostCol(out, expr.dtype)
+    return run
+
+
+def _wrap_int(dtype: T.DataType, v: int) -> int:
+    bits = {T.ByteType: 8, T.ShortType: 16, T.IntegerType: 32, T.LongType: 64}
+    for cls, b in bits.items():
+        if isinstance(dtype, cls):
+            m = 1 << b
+            v = v & (m - 1)
+            return v - m if v >= (m >> 1) else v
+    return v
+
+
+def _num(expr, x, y, op):
+    dt = expr.dtype
+    if isinstance(dt, T.IntegralType):
+        return _wrap_int(dt, op(int(x), int(y)))
+    r = op(float(x), float(y))
+    if isinstance(dt, T.FloatType):
+        r = float(np.float32(r))
+    return r
+
+
+# ---- arithmetic ------------------------------------------------------------
+
+def _div(expr, kids, n):
+    a, b = kids
+    out = []
+    for x, y in zip(a.data, b.data):
+        if x is None or y is None or y == 0:
+            out.append(None)  # Spark: divide by zero → null
+        else:
+            out.append(float(x) / float(y))
+    return HostCol(out, expr.dtype)
+
+
+def _intdiv(expr, kids, n):
+    a, b = kids
+    out = []
+    for x, y in zip(a.data, b.data):
+        if x is None or y is None or y == 0:
+            out.append(None)
+        else:
+            q = abs(int(x)) // abs(int(y))
+            out.append(_wrap_int(T.LongType(), -q if (x < 0) != (y < 0) else q))
+    return HostCol(out, expr.dtype)
+
+
+def _rem(expr, kids, n):
+    a, b = kids
+    out = []
+    for x, y in zip(a.data, b.data):
+        if x is None or y is None or y == 0:
+            out.append(None)
+        else:
+            r = math.fmod(float(x), float(y)) if isinstance(
+                expr.dtype, T.FractionalType) else int(math.fmod(int(x), int(y)))
+            if isinstance(expr.dtype, T.FloatType):
+                r = float(np.float32(r))
+            out.append(r)
+    return HostCol(out, expr.dtype)
+
+
+def _pmod(expr, kids, n):
+    a, b = kids
+    out = []
+    for x, y in zip(a.data, b.data):
+        if x is None or y is None or y == 0:
+            out.append(None)
+        elif isinstance(expr.dtype, T.FractionalType):
+            r = math.fmod(float(x), float(y))
+            if r != 0 and (r < 0) != (float(y) < 0):
+                r += float(y)
+            out.append(float(np.float32(r)) if isinstance(expr.dtype, T.FloatType)
+                       else r)
+        else:
+            r = int(math.fmod(int(x), int(y)))
+            if r != 0 and (r < 0) != (y < 0):
+                r += int(y)
+            out.append(_wrap_int(expr.dtype, r))
+    return HostCol(out, expr.dtype)
+
+
+# ---- comparisons (Spark ordering: NaN > everything, NaN == NaN) ------------
+
+def _cmp_key(v):
+    if isinstance(v, float) and math.isnan(v):
+        return (1, 0.0)
+    return (0, v)
+
+
+def _compare(expr, x, y, op):
+    if isinstance(x, float) or isinstance(y, float):
+        kx, ky = _cmp_key(float(x)), _cmp_key(float(y))
+        return op((kx > ky) - (kx < ky), 0)
+    if isinstance(x, bool) or isinstance(y, bool):
+        x, y = int(x), int(y)
+    return op((x > y) - (x < y), 0)
+
+
+def _and(expr, kids, n):
+    a, b = kids
+    out = []
+    for x, y in zip(a.data, b.data):
+        if x is False or y is False:
+            out.append(False)
+        elif x is None or y is None:
+            out.append(None)
+        else:
+            out.append(True)
+    return HostCol(out, T.BOOLEAN)
+
+
+def _or(expr, kids, n):
+    a, b = kids
+    out = []
+    for x, y in zip(a.data, b.data):
+        if x is True or y is True:
+            out.append(True)
+        elif x is None or y is None:
+            out.append(None)
+        else:
+            out.append(False)
+    return HostCol(out, T.BOOLEAN)
+
+
+def _in(expr, kids, n):
+    col = kids[0]
+    vals = list(expr.values)  # In holds a literal python list, not child exprs
+    has_null = any(w is None for w in vals)
+    non_null = [w for w in vals if w is not None]
+    out = []
+    for v in col.data:
+        if v is None:
+            out.append(None)
+        elif any(_compare(expr, v, w, lambda c, _: c == 0) for w in non_null):
+            out.append(True)
+        elif has_null:
+            out.append(None)
+        else:
+            out.append(False)
+    return HostCol(out, T.BOOLEAN)
+
+
+# ---- null / conditional ----------------------------------------------------
+
+def _if(expr, kids, n):
+    p, a, b = kids
+    return HostCol([x if c is True else y
+                    for c, x, y in zip(p.data, a.data, b.data)], expr.dtype)
+
+
+def _casewhen(expr, kids, n):
+    nb = len(expr.branches)
+    out = []
+    for i in range(n):
+        val = kids[2 * nb].data[i] if expr.else_value is not None else None
+        for bi in range(nb):
+            if kids[2 * bi].data[i] is True:
+                val = kids[2 * bi + 1].data[i]
+                break
+        out.append(val)
+    return HostCol(out, expr.dtype)
+
+
+def _coalesce(expr, kids, n):
+    out = []
+    for i in range(n):
+        val = None
+        for k in kids:
+            if k.data[i] is not None:
+                val = k.data[i]
+                break
+        out.append(val)
+    return HostCol(out, expr.dtype)
+
+
+# ---- strings ---------------------------------------------------------------
+
+def _substring(expr, kids, n):
+    from spark_rapids_tpu.ops.strings import java_substring
+    s, pos, ln = kids
+    out = []
+    for v, p, l in zip(s.data, pos.data, ln.data):
+        out.append(None if (v is None or p is None or l is None)
+                   else java_substring(v, p, l))
+    return HostCol(out, T.STRING)
+
+
+def _like(expr, kids, n):
+    from spark_rapids_tpu.ops.strings import like_to_regex
+    s, p = kids
+    out = []
+    for v, pat in zip(s.data, p.data):
+        if v is None or pat is None:
+            out.append(None)
+        else:
+            out.append(re.fullmatch(like_to_regex(pat), v, re.DOTALL) is not None)
+    return HostCol(out, T.BOOLEAN)
+
+
+def _concat(expr, kids, n):
+    out = []
+    for i in range(n):
+        parts = [k.data[i] for k in kids]
+        out.append(None if any(p is None for p in parts) else "".join(parts))
+    return HostCol(out, T.STRING)
+
+
+# ---- datetime (days since epoch for DateType; micros for TimestampType) ----
+
+def _as_date(v) -> datetime.date:
+    return datetime.date(1970, 1, 1) + datetime.timedelta(days=int(v))
+
+
+def _date_part(expr, kids, n):
+    (a,) = kids
+    fn = {
+        DT.Year: lambda d: d.year, DT.Month: lambda d: d.month,
+        DT.DayOfMonth: lambda d: d.day,
+        DT.DayOfWeek: lambda d: (d.isoweekday() % 7) + 1,
+        DT.WeekDay: lambda d: d.weekday(),
+        DT.DayOfYear: lambda d: d.timetuple().tm_yday,
+        DT.Quarter: lambda d: (d.month - 1) // 3 + 1,
+    }[type(expr)]
+    return HostCol([None if v is None else fn(_as_date(v)) for v in a.data],
+                   expr.dtype)
+
+
+def _time_part(expr, kids, n):
+    (a,) = kids
+    out = []
+    for v in a.data:
+        if v is None:
+            out.append(None)
+            continue
+        secs = (int(v) // 1_000_000) % 86400
+        if isinstance(expr, DT.Hour):
+            out.append(secs // 3600)
+        elif isinstance(expr, DT.Minute):
+            out.append((secs // 60) % 60)
+        else:
+            out.append(secs % 60)
+    return HostCol(out, expr.dtype)
+
+
+# ---- cast ------------------------------------------------------------------
+
+def _host_cast(expr, kids, n):
+    (a,) = kids
+    src, dst = a.dtype, expr.dtype
+    out = []
+    for v in a.data:
+        out.append(None if v is None else _cast_one(v, src, dst, expr))
+    return HostCol(out, dst)
+
+
+def _cast_one(v, src, dst, expr):
+    if isinstance(dst, T.StringType):
+        if isinstance(src, T.BooleanType):
+            return "true" if v else "false"
+        if isinstance(src, T.FloatType) or isinstance(src, T.DoubleType):
+            return _spark_double_str(float(v), isinstance(src, T.FloatType))
+        if isinstance(src, T.DateType):
+            return _as_date(v).isoformat()
+        if isinstance(src, T.TimestampType):
+            dt = (datetime.datetime(1970, 1, 1)
+                  + datetime.timedelta(microseconds=int(v)))
+            s = dt.strftime("%Y-%m-%d %H:%M:%S")
+            if dt.microsecond:
+                s += (".%06d" % dt.microsecond).rstrip("0")
+            return s
+        return str(v)
+    if isinstance(dst, T.BooleanType):
+        if isinstance(src, T.StringType):
+            lv = v.strip().lower()
+            if lv in ("t", "true", "y", "yes", "1"):
+                return True
+            if lv in ("f", "false", "n", "no", "0"):
+                return False
+            return None
+        return bool(v) if not (isinstance(v, float) and math.isnan(v)) else True
+    if isinstance(dst, T.IntegralType):
+        if isinstance(src, T.StringType):
+            try:
+                iv = int(float(v.strip())) if "." in v or "e" in v.lower() \
+                    else int(v.strip())
+            except ValueError:
+                return None
+            return iv if iv == _wrap_int(dst, iv) else None
+        if isinstance(src, T.FractionalType):
+            if math.isnan(v) or math.isinf(v):
+                return 0 if math.isnan(v) else _clamp_int(dst, v)
+            return _clamp_int(dst, v)
+        return _wrap_int(dst, int(v))
+    if isinstance(dst, (T.FloatType, T.DoubleType)):
+        if isinstance(src, T.StringType):
+            try:
+                f = float(v.strip())
+            except ValueError:
+                return None
+        else:
+            f = float(v)
+        return float(np.float32(f)) if isinstance(dst, T.FloatType) else f
+    if isinstance(dst, T.DateType) and isinstance(src, T.StringType):
+        try:
+            d = datetime.date.fromisoformat(v.strip()[:10])
+            return (d - datetime.date(1970, 1, 1)).days
+        except ValueError:
+            return None
+    if isinstance(dst, T.TimestampType) and isinstance(src, T.DateType):
+        return int(v) * 86_400_000_000
+    if isinstance(dst, T.DateType) and isinstance(src, T.TimestampType):
+        return int(v) // 86_400_000_000 - (1 if int(v) % 86_400_000_000 < 0
+                                           and int(v) < 0 else 0)
+    return v
+
+
+def _clamp_int(dst, f):
+    lims = {T.ByteType: (-128, 127), T.ShortType: (-32768, 32767),
+            T.IntegerType: (-2**31, 2**31 - 1), T.LongType: (-2**63, 2**63 - 1)}
+    for cls, (lo, hi) in lims.items():
+        if isinstance(dst, cls):
+            if math.isinf(f):
+                return lo if f < 0 else hi
+            return max(lo, min(hi, int(f)))
+    return int(f)
+
+
+def _spark_double_str(d, is_float):
+    if math.isnan(d):
+        return "NaN"
+    if math.isinf(d):
+        return "Infinity" if d > 0 else "-Infinity"
+    # Java Double.toString-ish: shortest repr, scientific beyond 1e7/1e-3
+    if d == int(d) and abs(d) < 1e7:
+        return f"{d:.1f}"
+    r = repr(float(np.float32(d))) if is_float else repr(d)
+    return r
+
+
+# ---- dispatch table --------------------------------------------------------
+
+_DISPATCH = {
+    A.Add: _binary(lambda e, x, y: _num(e, x, y, lambda a, b: a + b)),
+    A.Subtract: _binary(lambda e, x, y: _num(e, x, y, lambda a, b: a - b)),
+    A.Multiply: _binary(lambda e, x, y: _num(e, x, y, lambda a, b: a * b)),
+    A.Divide: _div,
+    A.IntegralDivide: _intdiv,
+    A.Remainder: _rem,
+    A.Pmod: _pmod,
+    A.UnaryMinus: _unary(lambda e, v: _wrap_int(e.dtype, -int(v))
+                         if isinstance(e.dtype, T.IntegralType) else -v),
+    A.Abs: _unary(lambda e, v: _wrap_int(e.dtype, abs(int(v)))
+                  if isinstance(e.dtype, T.IntegralType) else abs(v)),
+    P.EqualTo: _binary(lambda e, x, y: _compare(e, x, y, lambda c, _: c == 0)),
+    P.NotEqual: _binary(lambda e, x, y: _compare(e, x, y, lambda c, _: c != 0)),
+    P.LessThan: _binary(lambda e, x, y: _compare(e, x, y, lambda c, _: c < 0)),
+    P.LessThanOrEqual: _binary(
+        lambda e, x, y: _compare(e, x, y, lambda c, _: c <= 0)),
+    P.GreaterThan: _binary(lambda e, x, y: _compare(e, x, y, lambda c, _: c > 0)),
+    P.GreaterThanOrEqual: _binary(
+        lambda e, x, y: _compare(e, x, y, lambda c, _: c >= 0)),
+    P.EqualNullSafe: lambda e, kids, n: HostCol(
+        [True if (x is None and y is None)
+         else False if (x is None or y is None)
+         else _compare(e, x, y, lambda c, _: c == 0)
+         for x, y in zip(kids[0].data, kids[1].data)], T.BOOLEAN),
+    P.And: _and,
+    P.Or: _or,
+    P.Not: _unary(lambda e, v: not v),
+    P.In: _in,
+    N.IsNull: lambda e, kids, n: HostCol(
+        [v is None for v in kids[0].data], T.BOOLEAN),
+    N.IsNotNull: lambda e, kids, n: HostCol(
+        [v is not None for v in kids[0].data], T.BOOLEAN),
+    N.IsNaN: lambda e, kids, n: HostCol(
+        [False if v is None else (isinstance(v, float) and math.isnan(v))
+         for v in kids[0].data], T.BOOLEAN),
+    N.Coalesce: _coalesce,
+    N.NaNvl: _binary(lambda e, x, y: y if math.isnan(float(x)) else x),
+    C.If: _if,
+    C.CaseWhen: _casewhen,
+    MM.Sqrt: _unary(lambda e, v: math.sqrt(v) if v >= 0 else float("nan")),
+    MM.Exp: _unary(lambda e, v: math.exp(v)),
+    MM.Sin: _unary(lambda e, v: math.sin(v)),
+    MM.Cos: _unary(lambda e, v: math.cos(v)),
+    MM.Tan: _unary(lambda e, v: math.tan(v)),
+    MM.Floor: _unary(lambda e, v: int(math.floor(v))),
+    MM.Ceil: _unary(lambda e, v: int(math.ceil(v))),
+    MM.Pow: _binary(lambda e, x, y: float(x) ** float(y)),
+    MM.Log: _unary(lambda e, v: math.log(v) if v > 0 else None),
+    MM.Log2: _unary(lambda e, v: math.log2(v) if v > 0 else None),
+    MM.Log10: _unary(lambda e, v: math.log10(v) if v > 0 else None),
+    MM.Log1p: _unary(lambda e, v: math.log1p(v) if v > -1 else None),
+    S.Upper: _unary(lambda e, v: v.upper()),
+    S.Lower: _unary(lambda e, v: v.lower()),
+    S.Length: _unary(lambda e, v: len(v)),
+    S.Trim: _unary(lambda e, v: v.strip(" ")),
+    S.LTrim: _unary(lambda e, v: v.lstrip(" ")),
+    S.RTrim: _unary(lambda e, v: v.rstrip(" ")),
+    S.Reverse: _unary(lambda e, v: v[::-1]),
+    S.StartsWith: _binary(lambda e, x, y: x.startswith(y)),
+    S.EndsWith: _binary(lambda e, x, y: x.endswith(y)),
+    S.Contains: _binary(lambda e, x, y: y in x),
+    S.Like: _like,
+    S.Concat: _concat,
+    S.Substring: _substring,
+    S.StringReplace: lambda e, kids, n: HostCol(
+        [None if (s is None or f is None or r is None)
+         else (s.replace(f, r) if f else s)
+         for s, f, r in zip(kids[0].data, kids[1].data, kids[2].data)], T.STRING),
+    DT.Year: _date_part, DT.Month: _date_part, DT.DayOfMonth: _date_part,
+    DT.DayOfWeek: _date_part, DT.WeekDay: _date_part, DT.DayOfYear: _date_part,
+    DT.Quarter: _date_part,
+    DT.Hour: _time_part, DT.Minute: _time_part, DT.Second: _time_part,
+    DT.DateAdd: _binary(lambda e, x, y: int(x) + (int(y) if not isinstance(
+        e, DT.DateSub) else -int(y))),
+    DT.DateDiff: _binary(lambda e, x, y: int(x) - int(y)),
+    Cast: _host_cast,
+}

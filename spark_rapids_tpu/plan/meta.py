@@ -1,0 +1,171 @@
+"""RapidsMeta analog — per-node wrappers carrying tagging state and conversion.
+
+Reference: RapidsMeta.scala:70 (base wrapper), :162 (willNotWorkOnGpu), :253
+(tagForGpu), :633 (convertIfNeeded); SparkPlanMeta:512, BaseExprMeta:737. Each plan
+node / expression gets a meta that records why it cannot run on the TPU; conversion
+replaces supported subtrees and leaves the rest on the host."""
+
+from __future__ import annotations
+
+import typing
+
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.expr import core as E
+
+
+class RapidsMeta:
+    def __init__(self, conf: RapidsConf, parent: "RapidsMeta | None" = None):
+        self.conf = conf
+        self.parent = parent
+        self.reasons: list[str] = []
+        self.child_metas: list[RapidsMeta] = []
+
+    def will_not_work(self, reason: str) -> None:
+        """Record a reason this node must stay on the host
+        (reference willNotWorkOnGpu, RapidsMeta.scala:162)."""
+        if reason not in self.reasons:
+            self.reasons.append(reason)
+
+    @property
+    def can_run_on_tpu(self) -> bool:
+        return not self.reasons
+
+    @property
+    def can_this_and_children_run(self) -> bool:
+        return self.can_run_on_tpu and all(
+            m.can_this_and_children_run for m in self.child_metas)
+
+    def tag_for_tpu(self) -> None:
+        raise NotImplementedError
+
+    def explain(self, indent: int = 0, all_nodes: bool = True) -> str:
+        raise NotImplementedError
+
+
+class ExprMeta(RapidsMeta):
+    """Wrapper for one expression node (reference BaseExprMeta:737)."""
+
+    def __init__(self, expr: E.Expression, rule, conf, parent=None):
+        super().__init__(conf, parent)
+        self.expr = expr
+        self.rule = rule
+        from spark_rapids_tpu.plan.overrides import wrap_expr
+        self.child_metas = [wrap_expr(c, conf, self)
+                            for c in getattr(expr, "children", [])]
+
+    def tag_for_tpu(self):
+        if self.rule is None:
+            self.will_not_work(
+                f"expression {type(self.expr).__name__} has no TPU implementation")
+        else:
+            if self.rule.checks is not None:
+                self.rule.checks.tag(self)
+            if self.rule.disabled_by_conf(self.conf):
+                self.will_not_work(
+                    f"expression {type(self.expr).__name__} disabled by conf "
+                    f"{self.rule.conf_key}")
+            if self.rule.tag_fn is not None:
+                self.rule.tag_fn(self)
+        for m in self.child_metas:
+            m.tag_for_tpu()
+
+    def explain(self, indent=0, all_nodes=True):
+        status = "will run on TPU" if self.can_run_on_tpu else (
+            "cannot run on TPU because " + "; ".join(self.reasons))
+        mine = "  " * indent + f"@{type(self.expr).__name__} {status}"
+        lines = [mine] if (all_nodes or not self.can_run_on_tpu) else []
+        for m in self.child_metas:
+            sub = m.explain(indent + 1, all_nodes)
+            if sub:
+                lines.append(sub)
+        return "\n".join(lines)
+
+
+class PlanMeta(RapidsMeta):
+    """Wrapper for one plan node (reference SparkPlanMeta:512)."""
+
+    def __init__(self, node, rule, conf, parent=None):
+        super().__init__(conf, parent)
+        self.node = node
+        self.rule = rule
+        from spark_rapids_tpu.plan.overrides import wrap_expr, wrap_plan_meta
+        self.child_metas = [wrap_plan_meta(c, conf, self)
+                            for c in node.children]
+        self.expr_metas = [wrap_expr(e, conf, self)
+                           for e in self._node_expressions()]
+
+    def _node_expressions(self) -> list:
+        from spark_rapids_tpu.plan import nodes as NN
+        n = self.node
+        if isinstance(n, NN.ProjectNode):
+            return list(n.project_list)
+        if isinstance(n, NN.FilterNode):
+            return [n.condition]
+        if isinstance(n, NN.AggregateNode):
+            return list(n.group_exprs) + list(n.agg_exprs)
+        if isinstance(n, NN.JoinNode):
+            ex = list(n.left_keys) + list(n.right_keys)
+            if n.condition is not None:
+                ex.append(n.condition)
+            return ex
+        if isinstance(n, NN.SortNode):
+            return [e for (e, _, _) in n.sort_exprs]
+        if isinstance(n, NN.ExchangeNode):
+            return list(n.keys)
+        if isinstance(n, NN.ExpandNode):
+            return [e for proj in n.projections for e in proj]
+        if isinstance(n, NN.WindowNode):
+            return list(n.window_exprs)
+        return []
+
+    def tag_for_tpu(self):
+        if self.rule is None:
+            self.will_not_work(
+                f"exec {type(self.node).__name__} has no TPU implementation")
+        else:
+            if self.rule.checks is not None:
+                self.rule.checks.tag(self)
+            if self.rule.disabled_by_conf(self.conf):
+                self.will_not_work(
+                    f"exec {type(self.node).__name__} disabled by conf "
+                    f"{self.rule.conf_key}")
+            if self.rule.tag_fn is not None:
+                self.rule.tag_fn(self)
+        for m in self.expr_metas:
+            m.tag_for_tpu()
+        # an unsupported expression anywhere in the node pins the node to host
+        for m in self.expr_metas:
+            if not m.can_this_and_children_run:
+                self.will_not_work(
+                    "not all expressions can run on TPU: " + _first_reason(m))
+        for m in self.child_metas:
+            m.tag_for_tpu()
+
+    def convert_if_needed(self):
+        """Produce the hybrid plan: TpuExec subtrees where possible, host nodes
+        elsewhere, with transitions inserted by plan/transitions.py
+        (reference convertIfNeeded, RapidsMeta.scala:633)."""
+        from spark_rapids_tpu.plan.transitions import build_hybrid
+        return build_hybrid(self)
+
+    def explain(self, indent=0, all_nodes=True):
+        status = ("will run on TPU" if self.can_run_on_tpu else
+                  "cannot run on TPU because " + "; ".join(self.reasons))
+        lines = ["  " * indent + f"*{type(self.node).__name__} {status}"]
+        for m in self.expr_metas:
+            sub = m.explain(indent + 1, all_nodes)
+            if sub:
+                lines.append(sub)
+        for m in self.child_metas:
+            lines.append(m.explain(indent + 1, all_nodes))
+        return "\n".join(lines)
+
+
+def _first_reason(meta: RapidsMeta) -> str:
+    if meta.reasons:
+        return meta.reasons[0]
+    for m in meta.child_metas:
+        r = _first_reason(m)
+        if r:
+            return r
+    return ""

@@ -1,0 +1,700 @@
+"""CPU physical plan nodes — the framework's "Spark plan" that the override layer
+rewrites onto the TPU.
+
+Reference analogy: Spark's SparkPlan nodes (ProjectExec, FilterExec,
+HashAggregateExec, SortMergeJoinExec, ShuffleExchangeExec…) that GpuOverrides wraps
+and replaces (GpuOverrides.scala:2723 wrapPlan). Since this framework is standalone,
+these nodes come with a host NumPy/pyarrow interpreter: a node left on the host
+actually executes there (partial-plan fallback, like ops the reference tags
+willNotWorkOnGpu and leaves to Spark).
+"""
+
+from __future__ import annotations
+
+import math
+import typing
+
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr import core as E
+from spark_rapids_tpu.expr.aggregates import (
+    AggregateFunction, Average, Count, First, Max, Min, Sum,
+)
+from spark_rapids_tpu.plan.host_eval import HostCol, eval_host
+
+
+class PlanNode:
+    """Base CPU plan node. `execute_host(split)` returns one pa.Table per partition."""
+
+    def __init__(self, *children: "PlanNode"):
+        self.children = list(children)
+
+    @property
+    def child(self) -> "PlanNode":
+        return self.children[0]
+
+    @property
+    def output(self) -> T.StructType:
+        raise NotImplementedError
+
+    @property
+    def num_partitions(self) -> int:
+        return self.children[0].num_partitions if self.children else 1
+
+    def execute_host(self, split: int) -> pa.Table:
+        raise NotImplementedError
+
+    def collect_host(self) -> pa.Table:
+        tables = [self.execute_host(i) for i in range(self.num_partitions)]
+        return pa.concat_tables(tables) if tables else self._empty()
+
+    def _empty(self) -> pa.Table:
+        return pa.table({f.name: pa.array([], T.to_arrow_type(f.data_type))
+                         for f in self.output})
+
+    def name(self) -> str:
+        return type(self).__name__.replace("Node", "")
+
+    def args_string(self) -> str:
+        return ""
+
+    def tree_string(self, indent: int = 0) -> str:
+        lines = ["  " * indent + f"{self.name()} {self.args_string()}".rstrip()]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
+
+def _project_table(tbl: pa.Table, exprs, out_schema: T.StructType) -> pa.Table:
+    cols = []
+    for e, f in zip(exprs, out_schema):
+        hc = eval_host(e, tbl)
+        cols.append(pa.array(hc.data, T.to_arrow_type(f.data_type)))
+    return pa.table({f.name: c for f, c in zip(out_schema, cols)})
+
+
+def _expr_name(e: E.Expression, i: int) -> str:
+    if isinstance(e, E.Alias):
+        return e.name
+    if isinstance(e, (E.AttributeReference, E.BoundReference)):
+        return e.name
+    return f"col{i}"
+
+
+class ScanNode(PlanNode):
+    """In-memory scan over pre-partitioned arrow tables (LocalTableScan analog)."""
+
+    def __init__(self, partitions: list, schema: T.StructType | None = None):
+        super().__init__()
+        self.partitions = list(partitions)
+        assert self.partitions, "ScanNode needs at least one partition"
+        if schema is None:
+            from spark_rapids_tpu.plan.host_eval import table_schema
+            schema = table_schema(self.partitions[0])
+        self._schema = schema
+
+    @property
+    def output(self):
+        return self._schema
+
+    @property
+    def num_partitions(self):
+        return len(self.partitions)
+
+    def execute_host(self, split):
+        return self.partitions[split]
+
+
+class RangeNode(PlanNode):
+    def __init__(self, start: int, end: int, step: int = 1, num_slices: int = 1):
+        super().__init__()
+        self.start, self.end, self.step = start, end, step
+        self.num_slices = num_slices
+
+    @property
+    def output(self):
+        return T.StructType([T.StructField("id", T.LONG, False)])
+
+    @property
+    def num_partitions(self):
+        return self.num_slices
+
+    def execute_host(self, split):
+        total = max(0, -(-(self.end - self.start) // self.step))
+        per = -(-total // self.num_slices)
+        lo, hi = split * per, min(total, (split + 1) * per)
+        vals = [self.start + i * self.step for i in range(lo, hi)]
+        return pa.table({"id": pa.array(vals, pa.int64())})
+
+    def args_string(self):
+        return f"({self.start}, {self.end}, {self.step})"
+
+
+class ProjectNode(PlanNode):
+    def __init__(self, project_list: list, child: PlanNode):
+        super().__init__(child)
+        self.project_list = [E.bind_references(e, child.output)
+                             for e in project_list]
+
+    @property
+    def output(self):
+        return T.StructType([
+            T.StructField(_expr_name(e, i), e.dtype, e.nullable)
+            for i, e in enumerate(self.project_list)])
+
+    def execute_host(self, split):
+        return _project_table(self.child.execute_host(split), self.project_list,
+                              self.output)
+
+    def args_string(self):
+        return str(self.project_list)
+
+
+class FilterNode(PlanNode):
+    def __init__(self, condition: E.Expression, child: PlanNode):
+        super().__init__(child)
+        self.condition = E.bind_references(condition, child.output)
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def execute_host(self, split):
+        tbl = self.child.execute_host(split)
+        pred = eval_host(self.condition, tbl)
+        mask = pa.array([v is True for v in pred.data])
+        return tbl.filter(mask)
+
+    def args_string(self):
+        return repr(self.condition)
+
+
+class LimitNode(PlanNode):
+    def __init__(self, n: int, child: PlanNode, global_limit: bool = False):
+        super().__init__(child)
+        self.n = n
+        self.global_limit = global_limit
+
+    @property
+    def output(self):
+        return self.child.output
+
+    @property
+    def num_partitions(self):
+        return 1 if self.global_limit else self.child.num_partitions
+
+    def execute_host(self, split):
+        if not self.global_limit:
+            return self.child.execute_host(split).slice(0, self.n)
+        remaining = self.n
+        parts = []
+        for i in range(self.child.num_partitions):
+            if remaining <= 0:
+                break
+            t = self.child.execute_host(i).slice(0, remaining)
+            remaining -= t.num_rows
+            parts.append(t)
+        return pa.concat_tables(parts) if parts else self._empty()
+
+    def args_string(self):
+        return f"n={self.n}"
+
+
+class UnionNode(PlanNode):
+    def __init__(self, *children: PlanNode):
+        super().__init__(*children)
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    @property
+    def num_partitions(self):
+        return sum(c.num_partitions for c in self.children)
+
+    def execute_host(self, split):
+        for c in self.children:
+            if split < c.num_partitions:
+                t = c.execute_host(split)
+                names = [f.name for f in self.output]
+                return t.rename_columns(names)
+            split -= c.num_partitions
+        raise IndexError(split)
+
+
+class AggregateNode(PlanNode):
+    """Group-by aggregate; exact Spark null/NaN grouping semantics on the host."""
+
+    def __init__(self, group_exprs: list, agg_exprs: list, child: PlanNode):
+        super().__init__(child)
+        self.group_exprs = [E.bind_references(e, child.output)
+                            for e in group_exprs]
+        self.agg_exprs = [E.bind_references(e, child.output) for e in agg_exprs]
+
+    @property
+    def output(self):
+        fields = [T.StructField(_expr_name(e, i), e.dtype, True)
+                  for i, e in enumerate(self.group_exprs)]
+        for i, e in enumerate(self.agg_exprs):
+            fields.append(T.StructField(
+                _expr_name(e, len(fields)), e.dtype, e.nullable))
+        return T.StructType(fields)
+
+    @property
+    def num_partitions(self):
+        return 1  # host interpreter aggregates globally
+
+    @staticmethod
+    def _group_key(vals):
+        out = []
+        for v in vals:
+            if isinstance(v, float) and math.isnan(v):
+                out.append(("nan",))
+            elif isinstance(v, float) and v == 0.0:
+                out.append(0.0)  # -0.0 == 0.0 for grouping
+            else:
+                out.append(v)
+        return tuple(out)
+
+    def execute_host(self, split):
+        tables = [self.child.execute_host(i)
+                  for i in range(self.child.num_partitions)]
+        tbl = pa.concat_tables(tables)
+        keys = [eval_host(e, tbl) for e in self.group_exprs]
+        groups: dict = {}
+        order: list = []
+        for i in range(tbl.num_rows):
+            k = self._group_key([kc.data[i] for kc in keys])
+            if k not in groups:
+                groups[k] = []
+                order.append(k)
+            groups[k].append(i)
+        if not self.group_exprs and not order:
+            order.append(())
+            groups[()] = []
+
+        agg_inputs = []
+        for e in self.agg_exprs:
+            f = e.child if isinstance(e, E.Alias) else e
+            assert isinstance(f, AggregateFunction), f
+            if isinstance(f, Count) and not f.children:
+                agg_inputs.append((f, None))
+            else:
+                agg_inputs.append((f, eval_host(f.children[0], tbl)))
+
+        out_cols = [[] for _ in self.output]
+        for k in order:
+            rows = groups[k]
+            ki = 0
+            for ki, kc in enumerate(keys):
+                out_cols[ki].append(kc.data[rows[0]] if rows else None)
+            base = len(keys)
+            for ai, (f, data) in enumerate(agg_inputs):
+                out_cols[base + ai].append(self._agg_one(f, data, rows))
+        return pa.table({
+            fld.name: pa.array(col, T.to_arrow_type(fld.data_type))
+            for fld, col in zip(self.output, out_cols)})
+
+    @staticmethod
+    def _agg_one(f: AggregateFunction, data, rows):
+        if isinstance(f, Count):
+            if data is None:
+                return len(rows)
+            return sum(1 for i in rows if data.data[i] is not None)
+        vals = [data.data[i] for i in rows if data.data[i] is not None]
+        if isinstance(f, Sum):
+            if not vals:
+                return None
+            s = sum(vals)
+            return _wrap_sum(s, f.dtype)
+        if isinstance(f, Average):
+            if not vals:
+                return None
+            return float(sum(vals)) / len(vals)
+        if isinstance(f, Min):
+            return _minmax(vals, is_min=True)
+        if isinstance(f, Max):
+            return _minmax(vals, is_min=False)
+        if isinstance(f, First):
+            if f.ignore_nulls:
+                return vals[0] if vals else None
+            return data.data[rows[0]] if rows else None
+        raise NotImplementedError(type(f).__name__)
+
+    def args_string(self):
+        return f"keys={self.group_exprs} aggs={self.agg_exprs}"
+
+
+def _wrap_sum(s, dtype):
+    if isinstance(dtype, T.IntegralType):
+        m = 1 << 64
+        s = int(s) & (m - 1)
+        return s - m if s >= (m >> 1) else s
+    return float(s)
+
+
+def _minmax(vals, is_min):
+    if not vals:
+        return None
+    def key(v):
+        if isinstance(v, float) and math.isnan(v):
+            return (1, 0.0)
+        if isinstance(v, bool):
+            return (0, int(v))
+        return (0, v)
+    return (min if is_min else max)(vals, key=key)
+
+
+class JoinNode(PlanNode):
+    """Equi-join (or cross join when no keys) with Spark null semantics:
+    null keys never match."""
+
+    TYPES = ("inner", "left", "right", "full", "leftsemi", "leftanti", "cross")
+
+    def __init__(self, left: PlanNode, right: PlanNode, left_keys: list,
+                 right_keys: list, join_type: str = "inner",
+                 condition: E.Expression | None = None):
+        super().__init__(left, right)
+        assert join_type in self.TYPES, join_type
+        self.left_keys = [E.bind_references(e, left.output) for e in left_keys]
+        self.right_keys = [E.bind_references(e, right.output)
+                           for e in right_keys]
+        self.join_type = join_type
+        self.condition = condition
+
+    @property
+    def left(self):
+        return self.children[0]
+
+    @property
+    def right(self):
+        return self.children[1]
+
+    @property
+    def output(self):
+        if self.join_type in ("leftsemi", "leftanti"):
+            return self.left.output
+        fields = []
+        lnull = self.join_type in ("right", "full")
+        rnull = self.join_type in ("left", "full")
+        for f in self.left.output:
+            fields.append(T.StructField(f.name, f.data_type,
+                                        f.nullable or lnull))
+        for f in self.right.output:
+            fields.append(T.StructField(f.name, f.data_type,
+                                        f.nullable or rnull))
+        return T.StructType(fields)
+
+    @property
+    def num_partitions(self):
+        return 1
+
+    @staticmethod
+    def _keys_of(tbl, key_exprs):
+        cols = [eval_host(e, tbl) for e in key_exprs]
+        out = []
+        for i in range(tbl.num_rows):
+            vals = [c.data[i] for c in cols]
+            if any(v is None for v in vals):
+                out.append(None)  # null key never matches
+            else:
+                out.append(AggregateNode._group_key(vals))
+        return out
+
+    def _pair_schema(self) -> T.StructType:
+        """Condition evaluation always sees left+right regardless of join type
+        (semi/anti output is left-only but the condition references both sides)."""
+        return T.StructType(list(self.left.output.fields)
+                            + list(self.right.output.fields))
+
+    def _cond_ok(self, ltbl, rtbl, li, ri):
+        if self.condition is None:
+            return True
+        arrays = ([ltbl.column(i).slice(li, 1).combine_chunks()
+                   for i in range(ltbl.num_columns)]
+                  + [rtbl.column(i).slice(ri, 1).combine_chunks()
+                     for i in range(rtbl.num_columns)])
+        names = ltbl.column_names + rtbl.column_names
+        joined = pa.Table.from_arrays(arrays, names=names)
+        cond = E.bind_references(self.condition, self._pair_schema())
+        return eval_host(cond, joined).data[0] is True
+
+    def execute_host(self, split):
+        ltbl = pa.concat_tables([self.left.execute_host(i)
+                                 for i in range(self.left.num_partitions)])
+        rtbl = pa.concat_tables([self.right.execute_host(i)
+                                 for i in range(self.right.num_partitions)])
+        if self.join_type == "cross" or not self.left_keys:
+            pairs = [(i, j) for i in range(ltbl.num_rows)
+                     for j in range(rtbl.num_rows)
+                     if self._cond_ok(ltbl, rtbl, i, j)]
+            return self._emit(ltbl, rtbl, pairs,
+                              {i for i, _ in pairs}, {j for _, j in pairs})
+
+        lkeys = self._keys_of(ltbl, self.left_keys)
+        rkeys = self._keys_of(rtbl, self.right_keys)
+        rindex: dict = {}
+        for j, k in enumerate(rkeys):
+            if k is not None:
+                rindex.setdefault(k, []).append(j)
+
+        pairs = []
+        matched_l: set = set()
+        matched_r: set = set()
+        for i, k in enumerate(lkeys):
+            for j in (rindex.get(k, []) if k is not None else []):
+                if self._cond_ok(ltbl, rtbl, i, j):
+                    pairs.append((i, j))
+                    matched_l.add(i)
+                    matched_r.add(j)
+        return self._emit(ltbl, rtbl, pairs, matched_l, matched_r)
+
+    def _emit(self, ltbl, rtbl, pairs, matched_l, matched_r):
+        jt = self.join_type
+        if jt == "leftsemi":
+            idx = sorted(matched_l)
+            return ltbl.take(pa.array(idx, pa.int64()))
+        if jt == "leftanti":
+            idx = [i for i in range(ltbl.num_rows) if i not in matched_l]
+            return ltbl.take(pa.array(idx, pa.int64()))
+        li = [p[0] for p in pairs]
+        ri = [p[1] for p in pairs]
+        if jt in ("left", "full"):
+            for i in range(ltbl.num_rows):
+                if i not in matched_l:
+                    li.append(i)
+                    ri.append(None)
+        if jt in ("right", "full"):
+            for j in range(rtbl.num_rows):
+                if j not in matched_r:
+                    li.append(None)
+                    ri.append(j)
+        li_arr, ri_arr = pa.array(li, pa.int64()), pa.array(ri, pa.int64())
+        # from_arrays (not a dict) so duplicate names across sides survive
+        arrays = ([ltbl.column(i).take(li_arr).combine_chunks()
+                   for i in range(ltbl.num_columns)]
+                  + [rtbl.column(i).take(ri_arr).combine_chunks()
+                     for i in range(rtbl.num_columns)])
+        return pa.Table.from_arrays(arrays, names=[f.name for f in self.output])
+
+    def args_string(self):
+        return (f"{self.join_type} lkeys={self.left_keys} "
+                f"rkeys={self.right_keys}")
+
+
+class SortNode(PlanNode):
+    def __init__(self, sort_exprs: list, child: PlanNode, global_sort: bool = True):
+        """sort_exprs: list of (expr, ascending, nulls_first)."""
+        super().__init__(child)
+        self.sort_exprs = [(E.bind_references(e, child.output), asc, nf)
+                           for (e, asc, nf) in sort_exprs]
+        self.global_sort = global_sort
+
+    @property
+    def output(self):
+        return self.child.output
+
+    @property
+    def num_partitions(self):
+        return 1 if self.global_sort else self.child.num_partitions
+
+    def execute_host(self, split):
+        if self.global_sort:
+            tbl = pa.concat_tables([self.child.execute_host(i)
+                                    for i in range(self.child.num_partitions)])
+        else:
+            tbl = self.child.execute_host(split)
+        import functools
+        cols = [eval_host(e, tbl) for (e, _, _) in self.sort_exprs]
+
+        def cmp(i, j):
+            for c, (e, asc, nulls_first) in zip(cols, self.sort_exprs):
+                a, b = c.data[i], c.data[j]
+                if a is None and b is None:
+                    continue
+                if a is None:
+                    return -1 if nulls_first else 1
+                if b is None:
+                    return 1 if nulls_first else -1
+                ka, kb = _minmax_key(a), _minmax_key(b)
+                if ka == kb:
+                    continue
+                r = -1 if ka < kb else 1
+                return r if asc else -r
+            return i - j  # stable
+        idx = sorted(range(tbl.num_rows), key=functools.cmp_to_key(cmp))
+        return tbl.take(pa.array(idx, pa.int64()))
+
+    def args_string(self):
+        return str([(repr(e), asc, nf) for e, asc, nf in self.sort_exprs])
+
+
+def _minmax_key(v):
+    if isinstance(v, float) and math.isnan(v):
+        return (1, 0.0)
+    if isinstance(v, bool):
+        return (0, int(v))
+    return (0, v)
+
+
+class ExchangeNode(PlanNode):
+    """Repartition rows across `num_out` partitions (ShuffleExchangeExec analog)."""
+
+    def __init__(self, child: PlanNode, partitioning: str, num_out: int,
+                 keys: list | None = None):
+        super().__init__(child)
+        assert partitioning in ("hash", "single", "roundrobin", "range")
+        self.partitioning = partitioning
+        self.num_out = num_out
+        self.keys = [E.bind_references(e, child.output) for e in (keys or [])]
+
+    @property
+    def output(self):
+        return self.child.output
+
+    @property
+    def num_partitions(self):
+        return self.num_out
+
+    def execute_host(self, split):
+        from spark_rapids_tpu.ops import hashing as H
+        out_rows = []
+        for i in range(self.child.num_partitions):
+            tbl = self.child.execute_host(i)
+            if self.partitioning == "single":
+                pids = [0] * tbl.num_rows
+            elif self.partitioning == "roundrobin":
+                pids = [(r + i) % self.num_out for r in range(tbl.num_rows)]
+            elif self.partitioning == "hash":
+                cols = [eval_host(e, tbl) for e in self.keys]
+                pids = []
+                for r in range(tbl.num_rows):
+                    h = 42
+                    for c in cols:
+                        v = c.data[r]
+                        if v is None:
+                            continue
+                        h = _host_hash_one(v, c.dtype, h)
+                    pids.append(h % self.num_out)  # python % == Spark Pmod
+            else:
+                raise NotImplementedError("host range partitioning")
+            keep = [r for r in range(tbl.num_rows) if pids[r] == split]
+            out_rows.append(tbl.take(pa.array(keep, pa.int64())))
+        return pa.concat_tables(out_rows) if out_rows else self._empty()
+
+    def args_string(self):
+        return f"{self.partitioning}({self.num_out}) keys={self.keys}"
+
+
+def _host_hash_one(v, dtype, seed):
+    from spark_rapids_tpu.ops import hashing as H
+    if isinstance(dtype, T.StringType):
+        return H.murmur3_bytes_host(v.encode("utf-8"), seed)
+    if isinstance(dtype, (T.LongType, T.TimestampType)):
+        return H.murmur3_long_host(int(v), seed)
+    if isinstance(dtype, T.DoubleType):
+        import struct
+        bits = struct.unpack("<q", struct.pack("<d", float(v)))[0]
+        if math.isnan(float(v)):
+            bits = 0x7ff8000000000000
+        return H.murmur3_long_host(bits, seed)
+    if isinstance(dtype, T.FloatType):
+        import struct
+        f32 = float(v)
+        bits = struct.unpack("<i", struct.pack("<f", f32))[0]
+        if math.isnan(f32):
+            bits = 0x7fc00000
+        return H.murmur3_int_host(bits, seed)
+    if isinstance(dtype, T.BooleanType):
+        return H.murmur3_int_host(1 if v else 0, seed)
+    return H.murmur3_int_host(int(v), seed)
+
+
+class WindowNode(PlanNode):
+    """Window aggregation over partition/order specs (GpuWindowExec analog).
+    Host interpreter lives in plan/host_window.py; the device exec in exec/window.py."""
+
+    def __init__(self, window_exprs: list, child: PlanNode):
+        """window_exprs: list of Alias(WindowExpression)."""
+        super().__init__(child)
+        self.window_exprs = window_exprs
+
+    @property
+    def output(self):
+        fields = list(self.child.output.fields)
+        for i, e in enumerate(self.window_exprs):
+            fields.append(T.StructField(_expr_name(e, len(fields)), e.dtype, True))
+        return T.StructType(fields)
+
+    @property
+    def num_partitions(self):
+        return 1
+
+    def execute_host(self, split):
+        from spark_rapids_tpu.plan.host_window import host_window
+        tbl = pa.concat_tables([self.child.execute_host(i)
+                                for i in range(self.child.num_partitions)])
+        return host_window(self, tbl)
+
+
+class ExpandNode(PlanNode):
+    """Each input row expands to len(projections) rows (GpuExpandExec analog,
+    reference GpuExpandExec.scala)."""
+
+    def __init__(self, projections: list, out_fields: list, child: PlanNode):
+        super().__init__(child)
+        self.projections = [[E.bind_references(e, child.output) for e in proj]
+                            for proj in projections]
+        self._out = T.StructType(out_fields)
+
+    @property
+    def output(self):
+        return self._out
+
+    def execute_host(self, split):
+        tbl = self.child.execute_host(split)
+        parts = [_project_table(tbl, proj, self.output)
+                 for proj in self.projections]
+        combined = pa.concat_tables(parts)
+        # Spark emits projections interleaved per input row
+        n, k = tbl.num_rows, len(self.projections)
+        idx = [p * n + r for r in range(n) for p in range(k)]
+        return combined.take(pa.array(idx, pa.int64()))
+
+
+class GenerateNode(PlanNode):
+    """explode(array) generator (GpuGenerateExec analog). The array comes from a
+    host list column; device-side, arrays are represented as fixed-width slots."""
+
+    def __init__(self, generator_col: str, child: PlanNode, outer: bool = False,
+                 element_type: T.DataType = None):
+        super().__init__(child)
+        self.generator_col = generator_col
+        self.outer = outer
+        self.element_type = element_type or T.LONG
+
+    @property
+    def output(self):
+        fields = [f for f in self.child.output if f.name != self.generator_col]
+        fields.append(T.StructField("col", self.element_type, True))
+        return T.StructType(fields)
+
+    def execute_host(self, split):
+        tbl = self.child.execute_host(split)
+        gen = tbl.column(self.generator_col).to_pylist()
+        keep_names = [f.name for f in self.output if f.name != "col"]
+        rows = {n: [] for n in keep_names}
+        out_vals = []
+        for i, arr in enumerate(gen):
+            # null or empty array: explode drops the row, explode_outer keeps it
+            items = arr if arr else ([None] if self.outer else [])
+            for v in items:
+                for nme in keep_names:
+                    rows[nme].append(tbl.column(nme)[i].as_py())
+                out_vals.append(v)
+        data = {n: pa.array(rows[n], T.to_arrow_type(
+            next(f.data_type for f in self.output if f.name == n)))
+            for n in keep_names}
+        data["col"] = pa.array(out_vals, T.to_arrow_type(self.element_type))
+        return pa.table(data)

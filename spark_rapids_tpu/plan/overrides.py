@@ -1,0 +1,330 @@
+"""TpuOverrides — the plan rewrite engine (GpuOverrides analog).
+
+Reference: GpuOverrides.scala:431 (rule registry), :2723 (wrapPlan), :3013/3037
+(apply: wrap → tag → explain → convert), RapidsConf `spark.rapids.sql.explain`.
+Rules are keyed by node/expression class; tagging records host-pinning reasons;
+conversion produces a hybrid host/TPU plan with transitions inserted."""
+
+from __future__ import annotations
+
+import typing
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.expr import core as E
+from spark_rapids_tpu.plan import typesig as TS
+from spark_rapids_tpu.plan.meta import ExprMeta, PlanMeta
+
+
+class ExprRule:
+    """Reference ExprRule, GpuOverrides.scala:204."""
+
+    def __init__(self, description: str, checks: TS.ExprChecks | None = None,
+                 conf_key: str | None = None, tag_fn=None):
+        self.description = description
+        self.checks = checks
+        self.conf_key = conf_key
+        self.tag_fn = tag_fn
+
+    def disabled_by_conf(self, conf: RapidsConf) -> bool:
+        if not self.conf_key:
+            return False
+        from spark_rapids_tpu import config as CFG
+        entry = (self.conf_key if not isinstance(self.conf_key, str)
+                 else CFG._REGISTERED[self.conf_key])
+        return not conf.get(entry)
+
+
+class ExecRule:
+    """Reference ExecRule, GpuOverrides.scala:260. `convert(meta, tpu_children)`
+    builds the TpuExec for an approved node."""
+
+    def __init__(self, description: str, convert, checks: TS.ExecChecks | None = None,
+                 conf_key: str | None = None, tag_fn=None):
+        self.description = description
+        self.convert = convert
+        self.checks = checks
+        self.conf_key = conf_key
+        self.tag_fn = tag_fn
+
+    def disabled_by_conf(self, conf: RapidsConf) -> bool:
+        if not self.conf_key:
+            return False
+        from spark_rapids_tpu import config as CFG
+        entry = (self.conf_key if not isinstance(self.conf_key, str)
+                 else CFG._REGISTERED[self.conf_key])
+        return not conf.get(entry)
+
+
+class Registry:
+    def __init__(self):
+        self.exec_rules: dict = {}
+        self.expr_rules: dict = {}
+
+    def exec_rule(self, node_cls, rule: ExecRule):
+        self.exec_rules[node_cls] = rule
+
+    def expr_rule(self, expr_cls, rule: ExprRule):
+        self.expr_rules[expr_cls] = rule
+
+    def lookup_expr(self, expr) -> ExprRule | None:
+        r = self.expr_rules.get(type(expr))
+        if r is not None:
+            return r
+        for cls, rule in self.expr_rules.items():
+            if isinstance(expr, cls):
+                return rule
+        return None
+
+    def lookup_exec(self, node) -> ExecRule | None:
+        return self.exec_rules.get(type(node))
+
+
+REGISTRY = Registry()
+
+
+def wrap_expr(expr: E.Expression, conf: RapidsConf, parent=None) -> ExprMeta:
+    return ExprMeta(expr, REGISTRY.lookup_expr(expr), conf, parent)
+
+
+def wrap_plan_meta(node, conf: RapidsConf, parent=None) -> PlanMeta:
+    return PlanMeta(node, REGISTRY.lookup_exec(node), conf, parent)
+
+
+class TpuOverrides:
+    """Entry point: CPU plan → hybrid plan (reference GpuOverrides.apply:3017)."""
+
+    def __init__(self, conf: RapidsConf | None = None):
+        self.conf = conf or RapidsConf()
+
+    def apply(self, plan):
+        if not self.conf.is_sql_enabled:
+            return plan
+        meta = wrap_plan_meta(plan, self.conf)
+        meta.tag_for_tpu()
+        explain = self.conf.explain
+        if explain != "NONE":
+            print(meta.explain(all_nodes=(explain == "ALL")))
+        return meta.convert_if_needed()
+
+
+def explain_plan(plan, conf: RapidsConf | None = None, all_nodes=True) -> str:
+    conf = conf or RapidsConf()
+    meta = wrap_plan_meta(plan, conf)
+    meta.tag_for_tpu()
+    return meta.explain(all_nodes=all_nodes)
+
+
+# ---------------------------------------------------------------------------
+# Rule registration (reference GpuOverrides.scala:773-2987)
+# ---------------------------------------------------------------------------
+
+def _register_all():
+    from spark_rapids_tpu.expr import arithmetic as A
+    from spark_rapids_tpu.expr import predicates as P
+    from spark_rapids_tpu.expr import nullexprs as N
+    from spark_rapids_tpu.expr import conditional as C
+    from spark_rapids_tpu.expr import mathexprs as MM
+    from spark_rapids_tpu.expr import strings as S
+    from spark_rapids_tpu.expr import datetime as DT
+    from spark_rapids_tpu.expr import aggregates as AG
+    from spark_rapids_tpu.expr.cast import Cast
+    from spark_rapids_tpu.plan import nodes as NN
+
+    R = REGISTRY
+
+    # -- expressions ---------------------------------------------------------
+    num = TS.NUMERIC
+    ordr = TS.ORDERABLE
+    comm = TS.COMMON
+
+    def ex(cls, desc, out_sig, in_sig=None, conf_key=None, tag_fn=None):
+        R.expr_rule(cls, ExprRule(desc, TS.ExprChecks(out_sig, in_sig),
+                                  conf_key, tag_fn))
+
+    ex(E.AttributeReference, "column reference", TS.ALL)
+    ex(E.BoundReference, "bound column reference", TS.ALL)
+    ex(E.Literal, "literal value", TS.ALL)
+    ex(E.Alias, "named expression", TS.ALL)
+
+    for cls in (A.Add, A.Subtract, A.Multiply):
+        ex(cls, f"{cls.__name__.lower()} of two numbers", num + TS.DECIMAL, num + TS.DECIMAL)
+    ex(A.Divide, "division (double or decimal)", TS.FRACTIONAL + TS.DECIMAL)
+    ex(A.IntegralDivide, "integral division", TS.INTEGRAL)
+    ex(A.Remainder, "remainder", num)
+    ex(A.Pmod, "positive modulo", num)
+    ex(A.UnaryMinus, "negation", num + TS.DECIMAL)
+    ex(A.Abs, "absolute value", num + TS.DECIMAL)
+
+    for cls in (P.EqualTo, P.NotEqual, P.LessThan, P.LessThanOrEqual,
+                P.GreaterThan, P.GreaterThanOrEqual, P.EqualNullSafe):
+        ex(cls, "comparison", TS.BOOLEAN, ordr)
+    for cls in (P.And, P.Or, P.Not):
+        ex(cls, "boolean logic", TS.BOOLEAN, TS.BOOLEAN)
+    ex(P.In, "IN membership", TS.BOOLEAN)
+
+    for cls in (N.IsNull, N.IsNotNull):
+        ex(cls, "null test", TS.BOOLEAN, TS.ALL)
+    ex(N.IsNaN, "NaN test", TS.BOOLEAN, TS.FRACTIONAL)
+    ex(N.Coalesce, "first non-null", comm + TS.DECIMAL)
+    ex(N.NaNvl, "NaN replacement", TS.FRACTIONAL)
+    ex(C.If, "conditional", comm + TS.DECIMAL)
+    ex(C.CaseWhen, "case/when", comm + TS.DECIMAL)
+
+    for cls in (MM.Sqrt, MM.Exp, MM.Sin, MM.Cos, MM.Tan, MM.Asin, MM.Acos,
+                MM.Atan, MM.Cbrt, MM.Signum, MM.ToDegrees, MM.ToRadians,
+                MM.Log, MM.Log2, MM.Log10, MM.Log1p, MM.Pow, MM.Atan2):
+        ex(cls, "math function", TS.FRACTIONAL, TS.FRACTIONAL)
+    ex(MM.Floor, "floor", TS.INTEGRAL + TS.FRACTIONAL)
+    ex(MM.Ceil, "ceiling", TS.INTEGRAL + TS.FRACTIONAL)
+    ex(MM.Round, "half-up rounding", num)
+
+    for cls in (S.Upper, S.Lower, S.Trim, S.LTrim, S.RTrim, S.Reverse,
+                S.InitCap, S.Concat, S.StringReplace, S.Substring):
+        ex(cls, "string function", TS.STRING, TS.STRING + TS.INTEGRAL)
+    ex(S.Length, "string length", TS.TypeSig([T.IntegerType]), TS.STRING)
+    for cls in (S.StartsWith, S.EndsWith, S.Contains, S.Like, S.RLike):
+        ex(cls, "string predicate", TS.BOOLEAN, TS.STRING)
+
+    for cls in (DT.Year, DT.Month, DT.DayOfMonth, DT.DayOfWeek, DT.WeekDay,
+                DT.DayOfYear, DT.Quarter, DT.LastDay):
+        ex(cls, "date part", TS.TypeSig([T.IntegerType, T.DateType]), TS.DATE)
+    for cls in (DT.Hour, DT.Minute, DT.Second):
+        ex(cls, "time part", TS.TypeSig([T.IntegerType]), TS.TIMESTAMP)
+    ex(DT.DateAdd, "date arithmetic", TS.DATE)
+    ex(DT.DateDiff, "date difference", TS.TypeSig([T.IntegerType]), TS.DATE)
+    ex(DT.UnixTimestampSeconds, "timestamp→seconds", TS.TypeSig([T.LongType]))
+
+    def tag_cast(meta):
+        c = meta.expr
+        from spark_rapids_tpu import config as CFG
+        if (isinstance(c.children[0].dtype, T.StringType)
+                and isinstance(c.dtype, T.FractionalType)
+                and not meta.conf.get(CFG.ENABLE_CAST_STRING_TO_FLOAT)):
+            meta.will_not_work(
+                "cast string→float disabled: rounding may differ from Spark "
+                "(enable with spark.rapids.tpu.sql.castStringToFloat.enabled)")
+    ex(Cast, "type cast", TS.ALL, None, None, tag_cast)
+
+    for cls in (AG.Sum, AG.Count, AG.Min, AG.Max, AG.Average, AG.First):
+        ex(cls, "aggregate function", comm + TS.DECIMAL)
+
+    # -- execs ---------------------------------------------------------------
+    from spark_rapids_tpu.exec import basic as XB
+    from spark_rapids_tpu.exec import aggregate as XA
+    from spark_rapids_tpu.exec import joins as XJ
+    from spark_rapids_tpu.exec import sort as XS
+    from spark_rapids_tpu.exec.exchange import ShuffleExchangeExec
+    from spark_rapids_tpu.shuffle import partitioning as SP
+
+    def conv_scan(meta, kids):
+        return XB.ArrowScanExec(meta.node.partitions, meta.node.output,
+                                conf=meta.conf)
+
+    def conv_range(meta, kids):
+        n = meta.node
+        return XB.RangeExec(n.start, n.end, n.step, n.num_slices, conf=meta.conf)
+
+    def conv_project(meta, kids):
+        return XB.ProjectExec(meta.node.project_list, kids[0], conf=meta.conf)
+
+    def conv_filter(meta, kids):
+        return XB.FilterExec(meta.node.condition, kids[0], conf=meta.conf)
+
+    def conv_limit(meta, kids):
+        cls = XB.GlobalLimitExec if meta.node.global_limit else XB.LocalLimitExec
+        return cls(meta.node.n, kids[0], conf=meta.conf)
+
+    def conv_union(meta, kids):
+        return XB.UnionExec(*kids, conf=meta.conf)
+
+    def conv_aggregate(meta, kids):
+        n = meta.node
+        child = kids[0]
+        if child.num_partitions == 1 or not n.group_exprs:
+            if child.num_partitions > 1:
+                # global aggregation without keys: gather all partitions first
+                child = XS._GatherAllExec(child, conf=meta.conf)
+            return XA.HashAggregateExec(n.group_exprs, n.agg_exprs, child,
+                                        mode=XA.COMPLETE, conf=meta.conf)
+        partial = XA.HashAggregateExec(n.group_exprs, n.agg_exprs, child,
+                                       mode=XA.PARTIAL, conf=meta.conf)
+        nkeys = len(n.group_exprs)
+        key_names = [f.name for f in partial.output][:nkeys]
+        keys = [E.col(k) for k in key_names]
+        ex_node = ShuffleExchangeExec(
+            SP.HashPartitioner(keys, child.num_partitions), partial,
+            conf=meta.conf)
+        return XA.HashAggregateExec(keys, n.agg_exprs, ex_node, mode=XA.FINAL,
+                                    conf=meta.conf)
+
+    def tag_join(meta):
+        n = meta.node
+        if n.condition is not None and n.left_keys and n.join_type != "inner":
+            meta.will_not_work(
+                "conditional outer hash join not supported (reference "
+                "GpuHashJoin.tagJoin)")
+        if not n.left_keys and n.join_type == "right":
+            # nested-loop handles left-preserving types only (build side = right,
+            # reference GpuBroadcastNestedLoopJoinExec build-side rules)
+            meta.will_not_work(
+                "keyless right outer join needs a left build side "
+                "(not yet supported); runs on host")
+
+    def conv_join(meta, kids):
+        n = meta.node
+        left, right = kids
+        jt = {"left": "leftouter", "right": "rightouter",
+              "full": "fullouter"}.get(n.join_type, n.join_type)
+        if not n.left_keys or n.join_type == "cross":
+            return XJ.NestedLoopJoinExec(
+                "inner" if jt == "cross" else jt, left, right,
+                condition=n.condition, conf=meta.conf)
+        return XJ.BroadcastHashJoinExec(
+            jt, n.left_keys, n.right_keys, left, right, condition=n.condition,
+            build_side="right", conf=meta.conf)
+
+    def conv_sort(meta, kids):
+        from spark_rapids_tpu.ops.sorting import SortOrder
+        n = meta.node
+        exprs = [e for (e, _, _) in n.sort_exprs]
+        orders = [SortOrder(ascending=asc, nulls_first=nf)
+                  for (_, asc, nf) in n.sort_exprs]
+        return XS.SortExec(exprs, orders, kids[0], global_sort=n.global_sort,
+                           conf=meta.conf)
+
+    def conv_exchange(meta, kids):
+        n = meta.node
+        if n.partitioning == "hash":
+            p = SP.HashPartitioner(n.keys, n.num_out)
+        elif n.partitioning == "single":
+            p = SP.SinglePartitioner()
+        elif n.partitioning == "roundrobin":
+            p = SP.RoundRobinPartitioner(n.num_out)
+        else:
+            from spark_rapids_tpu.ops.sorting import SortOrder
+            sort_orders = [SortOrder() for _ in n.keys]
+            p = SP.RangePartitioner(n.keys, sort_orders, n.num_out)
+        return ShuffleExchangeExec(p, kids[0], conf=meta.conf)
+
+    def exr(cls, desc, convert, sig=TS.ORDERABLE, conf_key=None, tag_fn=None):
+        R.exec_rule(cls, ExecRule(desc, convert, TS.ExecChecks(sig), conf_key,
+                                  tag_fn))
+
+    exr(NN.ScanNode, "in-memory scan onto device", conv_scan)
+    exr(NN.RangeNode, "range generator", conv_range)
+    exr(NN.ProjectNode, "columnar projection", conv_project)
+    exr(NN.FilterNode, "columnar filter", conv_filter)
+    exr(NN.LimitNode, "row limit", conv_limit)
+    exr(NN.UnionNode, "union all", conv_union)
+    exr(NN.AggregateNode, "hash aggregate (two-phase over exchange)",
+        conv_aggregate)
+    exr(NN.JoinNode, "broadcast/nested-loop join", conv_join,
+        tag_fn=tag_join)
+    exr(NN.SortNode, "device sort", conv_sort)
+    exr(NN.ExchangeNode, "shuffle exchange", conv_exchange)
+    # WindowNode / ExpandNode / GenerateNode get rules when their device execs
+    # land; until then they are tagged host-only and run via the interpreter.
+
+
+_register_all()

@@ -1,0 +1,109 @@
+"""Transition pass — host↔device bridges and coalesce insertion.
+
+Reference: GpuTransitionOverrides.scala:40/:484 inserts GpuRowToColumnarExec /
+GpuColumnarToRowExec / HostColumnarToGpu fences and coalesce nodes
+(:305 insertCoalesce). Here the fences are DeviceBridgeExec (host rows → device
+columns, the RowToColumnar analog) and HostBridgeNode (device columns → host arrow,
+the ColumnarToRow analog); coalesce is inserted after exchanges per the child's
+coalesce goal (GpuTransitionOverrides.scala:57-63)."""
+
+from __future__ import annotations
+
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.base import TpuExec, acquire_semaphore
+from spark_rapids_tpu.plan.nodes import PlanNode
+
+
+class DeviceBridgeExec(TpuExec):
+    """Runs a host plan subtree and moves its output onto the device
+    (reference GpuRowToColumnarExec / HostColumnarToGpu,
+    GpuRowToColumnarExec.scala:788, HostColumnarToGpu.scala:249)."""
+
+    def __init__(self, host_node: PlanNode, conf=None):
+        from spark_rapids_tpu.config import RapidsConf
+        super().__init__(conf=conf or RapidsConf())
+        self.host_node = host_node
+
+    @property
+    def output(self):
+        return self.host_node.output
+
+    @property
+    def num_partitions(self):
+        return self.host_node.num_partitions
+
+    def execute_partition(self, split):
+        def it():
+            tbl = self.host_node.execute_host(split)
+            acquire_semaphore(self.metrics)
+            yield ColumnarBatch.from_arrow(tbl, self.output)
+        return self.wrap_output(it())
+
+
+class HostBridgeNode(PlanNode):
+    """Runs a device subtree and materializes arrow tables for a host parent
+    (reference GpuColumnarToRowExec, GpuColumnarToRowExec.scala:341)."""
+
+    def __init__(self, tpu_exec: TpuExec):
+        super().__init__()
+        self.tpu_exec = tpu_exec
+
+    @property
+    def output(self):
+        return self.tpu_exec.output
+
+    @property
+    def num_partitions(self):
+        return self.tpu_exec.num_partitions
+
+    def execute_host(self, split):
+        from spark_rapids_tpu.exec.base import TaskContext
+        tables = []
+        with TaskContext():
+            for batch in self.tpu_exec.execute_partition(split):
+                tables.append(batch.to_arrow())
+        if not tables:
+            return self._empty()
+        return pa.concat_tables(tables)
+
+    def name(self):
+        return "HostBridge"
+
+    def tree_string(self, indent: int = 0):
+        lines = ["  " * indent + "HostBridge [device subtree below]"]
+        lines.append(self.tpu_exec.tree_string(indent + 1)
+                     if hasattr(self.tpu_exec, "tree_string")
+                     else "  " * (indent + 1) + type(self.tpu_exec).__name__)
+        return "\n".join(lines)
+
+
+def build_hybrid(meta):
+    """Postorder conversion: fully-supported subtrees become TpuExec trees; a host
+    node above a converted subtree reads through a HostBridgeNode; a converted node
+    above a host subtree reads through a DeviceBridgeExec. Returns either a TpuExec
+    (whole plan on device) or a PlanNode (root stayed on host)."""
+    node = meta.node
+    kids = [build_hybrid(m) for m in meta.child_metas]
+
+    if meta.can_run_on_tpu and meta.rule is not None:
+        # lift host children onto the device through bridges
+        dev_kids = [k if isinstance(k, TpuExec) else DeviceBridgeExec(k, meta.conf)
+                    for k in kids]
+        return meta.rule.convert(meta, dev_kids)
+
+    # node stays on host: device children drop back through bridges
+    host_kids = [k if isinstance(k, PlanNode) else HostBridgeNode(k)
+                 for k in kids]
+    node.children = host_kids
+    return node
+
+
+def execute_hybrid(plan) -> pa.Table:
+    """Collect a hybrid plan to a host arrow table regardless of where the root
+    landed (test harness entry)."""
+    if isinstance(plan, TpuExec):
+        return plan.execute_collect()
+    return plan.collect_host()

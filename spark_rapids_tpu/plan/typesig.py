@@ -1,0 +1,139 @@
+"""TypeSig — static type-support algebra for the override layer.
+
+Reference: TypeChecks.scala:129 (TypeSig set algebra with `+`/`-`, nested types,
+lit-only marks), :483 (ExprChecks), :878 (CastChecks), :1196 (supported_ops.md doc
+generator). The TPU build keeps the same shape: a rule declares which input/output
+types it supports; tagging diffs the declared signature against the actual types and
+records human-readable reasons when a node must stay on the host."""
+
+from __future__ import annotations
+
+from spark_rapids_tpu import types as T
+
+
+_ALL_BASIC = (
+    T.BooleanType, T.ByteType, T.ShortType, T.IntegerType, T.LongType,
+    T.FloatType, T.DoubleType, T.StringType, T.DateType, T.TimestampType,
+    T.DecimalType, T.NullType,
+)
+
+
+class TypeSig:
+    """An immutable set of supported DataType classes with set algebra."""
+
+    def __init__(self, classes=(), note: str | None = None):
+        self.classes = frozenset(classes)
+        self.notes = {}
+        if note:
+            for c in classes:
+                self.notes[c] = note
+
+    def __add__(self, other: "TypeSig") -> "TypeSig":
+        out = TypeSig(self.classes | other.classes)
+        out.notes = {**self.notes, **other.notes}
+        return out
+
+    def __sub__(self, other: "TypeSig") -> "TypeSig":
+        out = TypeSig(self.classes - other.classes)
+        out.notes = {c: n for c, n in self.notes.items() if c in out.classes}
+        return out
+
+    def supports(self, dt: T.DataType) -> bool:
+        return isinstance(dt, tuple(self.classes)) if self.classes else False
+
+    def reason_not_supported(self, dt: T.DataType, context: str) -> str | None:
+        if self.supports(dt):
+            return None
+        return f"{context} produces/consumes unsupported type {dt}"
+
+    def __repr__(self):
+        return "TypeSig(" + ", ".join(sorted(c.__name__ for c in self.classes)) + ")"
+
+
+BOOLEAN = TypeSig([T.BooleanType])
+INTEGRAL = TypeSig([T.ByteType, T.ShortType, T.IntegerType, T.LongType])
+FRACTIONAL = TypeSig([T.FloatType, T.DoubleType])
+NUMERIC = INTEGRAL + FRACTIONAL
+DECIMAL = TypeSig([T.DecimalType])
+STRING = TypeSig([T.StringType])
+DATE = TypeSig([T.DateType])
+TIMESTAMP = TypeSig([T.TimestampType])
+DATETIME = DATE + TIMESTAMP
+NULL = TypeSig([T.NullType])
+ALL = TypeSig(_ALL_BASIC)
+COMMON = NUMERIC + BOOLEAN + STRING + DATETIME + NULL
+ORDERABLE = COMMON + DECIMAL
+NONE = TypeSig()
+
+
+class ExecChecks:
+    """Per-exec type signature: all input and output columns must satisfy `sig`
+    (reference ExecChecks, TypeChecks.scala:726)."""
+
+    def __init__(self, sig: TypeSig = COMMON + DECIMAL):
+        self.sig = sig
+
+    def tag(self, meta) -> None:
+        for field in meta.node.output:
+            if not self.sig.supports(field.data_type):
+                meta.will_not_work(
+                    f"unsupported output type {field.data_type} for column "
+                    f"'{field.name}'")
+        for child in meta.node.children:
+            for field in child.output:
+                if not self.sig.supports(field.data_type):
+                    meta.will_not_work(
+                        f"unsupported input type {field.data_type} for column "
+                        f"'{field.name}'")
+
+
+class ExprChecks:
+    """Per-expression signature: child dtypes + result dtype
+    (reference ExprChecks, TypeChecks.scala:483)."""
+
+    def __init__(self, output_sig: TypeSig, input_sigs=None):
+        self.output_sig = output_sig
+        self.input_sigs = input_sigs  # list[TypeSig] | TypeSig | None
+
+    def tag(self, meta) -> None:
+        expr = meta.expr
+        try:
+            dt = expr.dtype
+        except Exception:
+            meta.will_not_work("cannot resolve result type")
+            return
+        if not self.output_sig.supports(dt):
+            meta.will_not_work(f"unsupported result type {dt}")
+        children = getattr(expr, "children", [])
+        if self.input_sigs is None:
+            return
+        sigs = (self.input_sigs if isinstance(self.input_sigs, list)
+                else [self.input_sigs] * len(children))
+        for c, sig in zip(children, sigs):
+            try:
+                cdt = c.dtype
+            except Exception:
+                continue
+            if not sig.supports(cdt):
+                meta.will_not_work(f"unsupported input type {cdt} for child {c}")
+
+
+def generate_supported_ops_doc(registry) -> str:
+    """Markdown support matrix, the docs/supported_ops.md generator analog
+    (reference TypeChecks.scala:1196)."""
+    lines = ["# Supported operators and expressions", "",
+             "Generated from the override rule registry.", "",
+             "## Execs", "", "| Exec | Description | Types |", "|---|---|---|"]
+    for cls, rule in sorted(registry.exec_rules.items(), key=lambda kv: kv[0].__name__):
+        sig = rule.checks.sig if rule.checks else ALL
+        tnames = ", ".join(sorted(c.__name__.replace("Type", "")
+                                  for c in sig.classes))
+        lines.append(f"| {cls.__name__} | {rule.description} | {tnames} |")
+    lines += ["", "## Expressions", "", "| Expression | Description | Result types |",
+              "|---|---|---|"]
+    for cls, rule in sorted(registry.expr_rules.items(), key=lambda kv: kv[0].__name__):
+        sig = rule.checks.output_sig if rule.checks else ALL
+        tnames = ", ".join(sorted(c.__name__.replace("Type", "")
+                                  for c in sig.classes))
+        lines.append(f"| {cls.__name__} | {rule.description} | {tnames} |")
+    return "\n".join(lines) + "\n"

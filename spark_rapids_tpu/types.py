@@ -167,6 +167,33 @@ class NullType(DataType):
     sql_name = "void"
 
 
+class ArrayType(DataType):
+    """Spark ArrayType. Host-side (interpreter/IO) representation is an arrow list
+    column; there is no flat device representation yet, so TypeSig keeps array
+    columns on the host (reference supports nested types in a limited op subset,
+    TypeChecks.scala TypeSig.ARRAY)."""
+
+    jnp_dtype = None
+    sql_name = "array"
+
+    def __init__(self, element_type: DataType, contains_null: bool = True):
+        self.element_type = element_type
+        self.contains_null = contains_null
+
+    def default_value(self):
+        return None
+
+    def __eq__(self, other):
+        return (isinstance(other, ArrayType)
+                and other.element_type == self.element_type)
+
+    def __hash__(self):
+        return hash(("array", self.element_type))
+
+    def __repr__(self):
+        return f"ArrayType({self.element_type!r})"
+
+
 # ---------------------------------------------------------------------------
 # singletons (Spark-style)
 # ---------------------------------------------------------------------------
@@ -209,10 +236,14 @@ def from_arrow_type(at: pa.DataType) -> DataType:
         return DecimalType(at.precision, at.scale)
     if pa.types.is_dictionary(at):
         return from_arrow_type(at.value_type)
+    if pa.types.is_list(at) or pa.types.is_large_list(at):
+        return ArrayType(from_arrow_type(at.value_type))
     raise TypeError(f"unsupported arrow type {at}")
 
 
 def to_arrow_type(dt: DataType) -> pa.DataType:
+    if isinstance(dt, ArrayType):
+        return pa.list_(to_arrow_type(dt.element_type))
     if isinstance(dt, DecimalType):
         return pa.decimal128(dt.precision, dt.scale)
     if isinstance(dt, TimestampType):

@@ -207,3 +207,44 @@ def test_join_empty_build_side():
     assert got["rv"].null_count == 50
     got_inner = run_join("inner", lt=lt, rt=rt)
     assert got_inner.num_rows == 0
+
+
+def test_broadcast_full_outer_multi_partition_stream():
+    """Regression: unmatched build rows must be emitted exactly once globally, not
+    once per stream partition (matched flags merge across partitions)."""
+    lt = left_table(300)
+    tables = [lt.slice(0, 100), lt.slice(100, 100), lt.slice(200, 100)]
+    rt = right_table()
+    conf = RapidsConf()
+    j = BroadcastHashJoinExec("fullouter", [col("lk")], [col("rk")],
+                              ArrowScanExec(tables, conf=conf),
+                              ArrowScanExec([rt], conf=conf))
+    got = j.execute_collect()
+    want = host_join(lt, rt, "lk", "rk", "fullouter")
+    assert same_multiset(got, want)
+
+
+def test_nested_loop_full_outer_multi_partition_left():
+    lt = pa.table({"a": pa.array([1, 5, 7, 9], type=pa.int64())})
+    tables = [lt.slice(0, 2), lt.slice(2, 2)]
+    rt = pa.table({"b": pa.array([6, 6, 100], type=pa.int64())})
+    conf = RapidsConf()
+    nl = NestedLoopJoinExec("fullouter", ArrowScanExec(tables, conf=conf),
+                            ArrowScanExec([rt], conf=conf),
+                            condition=GreaterThan(col("a"), col("b")))
+    got = nl.execute_collect()
+    rows = sorted(zip(got["a"].to_pylist(), got["b"].to_pylist()),
+                  key=lambda p: (p[0] is None, p[0] or 0, p[1] is None, p[1] or 0))
+    # pairs where a > b: (7,6)x2, (9,6)x2; unmatched left: 1, 5; unmatched right:
+    # 100 exactly once (6s both matched)
+    assert rows == [(1, None), (5, None), (7, 6), (7, 6), (9, 6), (9, 6),
+                    (None, 100)]
+
+
+def test_hash_join_rejects_cross():
+    lt = left_table(10)
+    rt = right_table(10)
+    conf = RapidsConf()
+    with pytest.raises(ValueError):
+        HashJoinExec("cross", [], [], ArrowScanExec([lt], conf=conf),
+                     ArrowScanExec([rt], conf=conf))
