@@ -1,0 +1,12 @@
+"""L5 I/O layer: accelerated file formats (SURVEY.md §1 L5).
+
+Reference: GpuParquetScan.scala (PERFILE/MULTITHREADED/COALESCING reader
+strategies), GpuOrcScan.scala, GpuBatchScanExec.scala (CSV), writers
+(GpuParquetFileFormat.scala, ColumnarOutputWriter.scala, GpuFileFormatDataWriter)."""
+
+from spark_rapids_tpu.io.filescan import (  # noqa: F401
+    FileScanNode, FileSourceScanExec, FilePartition,
+)
+from spark_rapids_tpu.io.writer import (  # noqa: F401
+    FileWriteNode, write_columnar, WriteStats,
+)

@@ -1,0 +1,246 @@
+"""File scan: plan node + device exec with partition-values handling.
+
+Reference: GpuFileSourceScanExec.scala:59 (DSv1), GpuBatchScanExec (DSv2),
+GpuMultiFileReader.scala plumbing, ColumnarPartitionReaderWithPartitionValues
+(partition-directory values concatenated as constant columns). Files are grouped
+into FilePartitions by target size like Spark's FilePartition packing."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import typing
+
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu import config as CFG
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.base import TpuExec, acquire_semaphore
+from spark_rapids_tpu.io import readers as R
+from spark_rapids_tpu.plan.nodes import PlanNode
+from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.runtime.tracing import trace_range
+
+
+@dataclasses.dataclass(frozen=True)
+class FilePartition:
+    """Files + constant partition-column values (from dir names a/b=1/...)."""
+    paths: tuple
+    partition_values: tuple = ()   # ((name, value), ...) applied to every row
+
+
+def discover_partitions(root: str, fmt: str) -> list[FilePartition]:
+    """Walk a (possibly hive-partitioned) directory into per-directory partitions."""
+    exts = {"parquet": (".parquet", ".pq"), "orc": (".orc",), "csv": (".csv",)}
+    out = []
+    for dirpath, dirnames, files in os.walk(root):
+        # prune hidden/metadata dirs (uncommitted _temporary-* output, _SUCCESS
+        # siblings…) the way Spark's file index skips '_'/'.' paths. NB: os.walk
+        # must not be wrapped in sorted() — that would drain the generator before
+        # this in-place prune is seen.
+        dirnames[:] = sorted(d for d in dirnames if not d.startswith(("_", ".")))
+        paths = tuple(sorted(
+            os.path.join(dirpath, f) for f in files
+            if f.endswith(exts[fmt]) and not f.startswith(("_", "."))))
+        if not paths:
+            continue
+        rel = os.path.relpath(dirpath, root)
+        pvals = []
+        if rel != ".":
+            for seg in rel.split(os.sep):
+                if "=" in seg:
+                    k, v = seg.split("=", 1)
+                    pvals.append((k, v))
+        out.append(FilePartition(paths, tuple(pvals)))
+    out.sort(key=lambda p: p.paths)
+    return out
+
+
+def _infer_partition_type(values: list) -> T.DataType:
+    try:
+        for v in values:
+            int(v)
+        return T.INT if all(-2**31 <= int(v) < 2**31 for v in values) else T.LONG
+    except ValueError:
+        return T.STRING
+
+
+class FileScanNode(PlanNode):
+    """CPU plan node for a file scan; the override layer converts it to
+    FileSourceScanExec. Host execution = the same readers without the device
+    upload (the CPU-Spark oracle path)."""
+
+    def __init__(self, paths_or_dir, fmt: str = "parquet",
+                 schema: T.StructType | None = None,
+                 pushed_filter=None, options: dict | None = None,
+                 files_per_partition: int = 1):
+        super().__init__()
+        self.fmt = fmt
+        self.options = options or {}
+        if isinstance(paths_or_dir, str) and os.path.isdir(paths_or_dir):
+            parts = discover_partitions(paths_or_dir, fmt)
+        else:
+            paths = ([paths_or_dir] if isinstance(paths_or_dir, str)
+                     else list(paths_or_dir))
+            parts = [FilePartition(tuple(paths[i:i + files_per_partition]))
+                     for i in range(0, len(paths), files_per_partition)]
+        if not parts:
+            raise ValueError(f"no {fmt} files under {paths_or_dir}")
+        keys0 = tuple(k for k, _ in parts[0].partition_values)
+        for p in parts[1:]:
+            if tuple(k for k, _ in p.partition_values) != keys0:
+                raise ValueError(
+                    "inconsistent partition directory layout: "
+                    f"{keys0} vs {tuple(k for k, _ in p.partition_values)} "
+                    f"under {p.paths[0]}")
+        self.partitions = parts
+        self.pushed_filter = pushed_filter  # Expression; converted per-read
+        self.reader = R.reader_for(fmt, **self.options)
+        if schema is None:
+            file_schema = T.StructType.from_arrow(
+                self.reader.schema_of(parts[0].paths[0]))
+            pfields = []
+            if parts[0].partition_values:
+                for i, (k, _) in enumerate(parts[0].partition_values):
+                    vals = [p.partition_values[i][1] for p in parts]
+                    pfields.append(T.StructField(
+                        k, _infer_partition_type(vals), False))
+            schema = T.StructType(list(file_schema.fields) + pfields)
+        self._schema = schema
+        self._n_partition_cols = (len(parts[0].partition_values)
+                                  if parts[0].partition_values else 0)
+
+    @property
+    def output(self):
+        return self._schema
+
+    @property
+    def num_partitions(self):
+        return len(self.partitions)
+
+    def _data_columns(self) -> list:
+        n = len(self._schema.fields) - self._n_partition_cols
+        return [f.name for f in self._schema.fields[:n]]
+
+    def _arrow_filter(self):
+        if self.pushed_filter is None:
+            return None
+        return R.spark_filter_to_arrow(self.pushed_filter)
+
+    def _append_partition_values(self, tbl: pa.Table, part: FilePartition):
+        """Constant partition columns for every row (reference
+        ColumnarPartitionReaderWithPartitionValues)."""
+        if not part.partition_values:
+            return tbl
+        n = len(self._schema.fields) - self._n_partition_cols
+        for (k, v), f in zip(part.partition_values, self._schema.fields[n:]):
+            val = int(v) if isinstance(f.data_type, T.IntegralType) else v
+            tbl = tbl.append_column(
+                pa.field(k, T.to_arrow_type(f.data_type)),
+                pa.array([val] * tbl.num_rows, T.to_arrow_type(f.data_type)))
+        return tbl
+
+    def _residual_filter(self, tbl: pa.Table) -> pa.Table:
+        """Exact Spark-semantics filter on the host for predicates the arrow
+        scanner cannot express (float comparisons with NaN ordering, etc.)."""
+        from spark_rapids_tpu.plan.host_eval import eval_host
+        from spark_rapids_tpu.expr.core import bind_references
+        if tbl.num_rows == 0:
+            return tbl
+        cond = bind_references(self.pushed_filter, self._schema)
+        pred = eval_host(cond, tbl)
+        return tbl.filter(pa.array([v is True for v in pred.data]))
+
+    def tables_for(self, split: int, batch_rows: int,
+                   strategy: str = "PERFILE", num_threads: int = 4,
+                   target_rows: int = 1 << 20):
+        part = self.partitions[split]
+        filt = self._arrow_filter()
+        residual = self.pushed_filter is not None and filt is None
+        cols = self._data_columns()
+        if strategy == "MULTITHREADED":
+            gen = R.multithreaded_tables(self.reader, list(part.paths), cols,
+                                         filt, batch_rows, num_threads)
+        elif strategy == "COALESCING":
+            gen = R.coalescing_tables(self.reader, list(part.paths), cols, filt,
+                                      batch_rows, target_rows)
+        else:
+            gen = R.perfile_tables(self.reader, list(part.paths), cols, filt,
+                                   batch_rows)
+        for tbl in gen:
+            tbl = self._append_partition_values(tbl, part)
+            if residual:
+                tbl = self._residual_filter(tbl)
+            yield tbl
+
+    def execute_host(self, split):
+        tables = list(self.tables_for(split, batch_rows=1 << 20))
+        if not tables:
+            return self._empty()
+        return pa.concat_tables(tables, promote_options="permissive")
+
+    def args_string(self):
+        return (f"{self.fmt} {len(self.partitions)} partitions"
+                + (f" filter={self.pushed_filter!r}" if self.pushed_filter is not None
+                   else ""))
+
+
+class FileSourceScanExec(TpuExec):
+    """Leaf device exec: host decode (strategy-selected) → one H2D per batch
+    (reference GpuFileSourceScanExec.doExecuteColumnar:376)."""
+
+    def __init__(self, node: FileScanNode, conf=None):
+        from spark_rapids_tpu.config import RapidsConf
+        super().__init__(conf=conf or RapidsConf())
+        self.node = node
+        self._scan_time = self.metrics.metric(M.READ_FS_TIME, M.MODERATE)
+
+    @property
+    def output(self):
+        return self.node.output
+
+    @property
+    def num_partitions(self):
+        return self.node.num_partitions
+
+    def execute_partition(self, split):
+        conf = self.conf
+        strategy = conf.get(CFG.PARQUET_READER_TYPE).upper()
+        batch_rows = min(conf.get(CFG.MAX_READER_BATCH_SIZE_ROWS), 1 << 20)
+        threads = conf.get(CFG.MULTITHREADED_READ_NUM_THREADS)
+
+        def it():
+            for tbl in self.node.tables_for(split, batch_rows, strategy,
+                                            threads):
+                acquire_semaphore(self.metrics)
+                with trace_range("FileScan.h2d", self._scan_time):
+                    yield ColumnarBatch.from_arrow(tbl, self.output)
+        return self.wrap_output(it())
+
+    def args_string(self):
+        return self.node.args_string()
+
+
+# self-registration with the override engine (kept here, not in overrides.py, so
+# plan/ never imports io/ — mirrors the reference's per-format ScanRule modules)
+def _register_scan_rule():
+    from spark_rapids_tpu.plan.overrides import REGISTRY, ExecRule
+    from spark_rapids_tpu.plan.typesig import ExecChecks, ORDERABLE
+
+    def conv_filescan(meta, kids):
+        return FileSourceScanExec(meta.node, conf=meta.conf)
+
+    def tag_filescan(meta):
+        fmt = meta.node.fmt
+        if fmt == "csv" and not meta.conf.get(CFG.CSV_ENABLED):
+            meta.will_not_work("CSV scan disabled by conf")
+        if fmt == "orc" and not meta.conf.get(CFG.ORC_ENABLED):
+            meta.will_not_work("ORC scan disabled by conf")
+
+    REGISTRY.exec_rule(FileScanNode, ExecRule(
+        "accelerated parquet/orc/csv scan", conv_filescan,
+        ExecChecks(ORDERABLE), None, tag_filescan))
+
+
+_register_scan_rule()
