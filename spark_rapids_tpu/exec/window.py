@@ -1,0 +1,262 @@
+"""Window exec — sort once, segmented scans for every frame (one XLA program).
+
+Reference: GpuWindowExec.scala:92 + GpuWindowExpression.scala (windowAggregation:
+847). Each task concatenates its input, sorts by (partition keys, order keys),
+derives partition/tie boundaries, then computes all window expressions with the
+kernels in ops/windowing.py. The planner (conv_window) guarantees rows of one
+window partition land in one task (hash exchange on partition_by)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.base import TpuExec, acquire_semaphore
+from spark_rapids_tpu.expr.core import (Alias, Col, EvalContext, bind_references)
+from spark_rapids_tpu.expr.aggregates import (AggregateFunction, Average, Count,
+                                              Max, Min, Sum)
+from spark_rapids_tpu.expr.windows import (DenseRank, Lag, Lead, Rank, RowNumber,
+                                           WindowExpression)
+from spark_rapids_tpu.ops import windowing as W
+from spark_rapids_tpu.ops.concat import concat_batches
+from spark_rapids_tpu.ops.filtering import gather_cols
+from spark_rapids_tpu.ops.sorting import SortOrder, sort_permutation
+from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.runtime.tracing import trace_range
+
+
+def _unalias(e):
+    return e.child if isinstance(e, Alias) else e
+
+
+def supported_window_expr(we: WindowExpression) -> str | None:
+    """Reason string when unsupported (used by the planner tag), else None."""
+    f = we.func
+    frame = we.spec.frame
+    if isinstance(f, (Lead, Lag)):
+        try:
+            is_string = isinstance(f.children[0].dtype, T.StringType)
+        except Exception:
+            is_string = False
+        if is_string and f.default is not None:
+            return ("lead/lag over strings with a non-null default not "
+                    "supported on device (default is not a dictionary code)")
+        return None
+    if isinstance(f, (RowNumber, Rank, DenseRank)):
+        return None
+    if isinstance(f, (Sum, Count, Min, Max, Average)):
+        if frame.is_unbounded_to_current or frame.is_unbounded_both:
+            return None
+        if frame.frame_type == "rows":
+            if isinstance(f, (Min, Max)):
+                return ("sliding min/max frames not supported on device "
+                        "(needs O(n*w) or a monotonic-deque kernel)")
+            return None
+        return f"range frame with offsets not supported: {frame}"
+    return f"window function {type(f).__name__} not supported"
+
+
+class WindowExec(TpuExec):
+    def __init__(self, window_exprs: list, child: TpuExec, conf=None):
+        """window_exprs: Alias(WindowExpression) list; all must share one spec's
+        partition/order for this exec (the planner groups them; reference
+        GpuWindowExec partitions its expressions the same way)."""
+        super().__init__(child, conf=conf)
+        self.window_exprs = [bind_references(e, child.output)
+                             for e in window_exprs]
+        specs = {repr((_unalias(e).spec.partition_by,
+                       _unalias(e).spec.order_by))
+                 for e in self.window_exprs}
+        assert len(specs) == 1, "one WindowExec handles one partition/order spec"
+        self._win_time = self.metrics.metric(M.OP_TIME, M.MODERATE)
+
+    @property
+    def output(self):
+        fields = list(self.child.output.fields)
+        for i, e in enumerate(self.window_exprs):
+            name = e.name if isinstance(e, Alias) else f"win{i}"
+            fields.append(T.StructField(name, e.dtype, e.nullable))
+        return T.StructType(fields)
+
+    def execute_partition(self, split):
+        def it():
+            batches = list(self.child.execute_partition(split))
+            if not batches:
+                return
+            acquire_semaphore(self.metrics)
+            with trace_range("WindowExec", self._win_time):
+                batch = concat_batches(batches)
+                yield self._compute(batch)
+        return self.wrap_output(it())
+
+    def _compute(self, batch: ColumnarBatch) -> ColumnarBatch:
+        cap = batch.capacity
+        ctx = EvalContext.from_batch(batch)
+        spec0 = _unalias(self.window_exprs[0]).spec
+        part_cols = [e.eval(ctx) for e in spec0.partition_by]
+        order_cols = [e.eval(ctx) for (e, _, _) in spec0.order_by]
+        orders = ([SortOrder() for _ in part_cols]
+                  + [SortOrder(asc, nf) for (_, asc, nf) in spec0.order_by])
+        num_rows = ctx.num_rows
+        perm = sort_permutation(part_cols + order_cols, orders, num_rows, cap)
+        live = jnp.arange(cap, dtype=jnp.int32) < num_rows
+        sorted_in = gather_cols(ctx.cols, perm, live)
+        sorted_part = gather_cols(part_cols, perm, live)
+        sorted_order = gather_cols(order_cols, perm, live)
+
+        part_boundary = self._boundaries(sorted_part, cap)
+        order_boundary = part_boundary | self._boundaries(sorted_order, cap) \
+            if sorted_order else part_boundary
+        seg_ids = jnp.cumsum(part_boundary.astype(jnp.int32)) - 1
+
+        sctx = EvalContext(sorted_in, batch.lazy_num_rows, cap)
+        out_cols = list(sorted_in)
+        for e in self.window_exprs:
+            we = _unalias(e)
+            out_cols.append(self._eval_window(
+                we, sctx, part_boundary, order_boundary, seg_ids, cap, live))
+        return ColumnarBatch([c.to_vector() for c in out_cols],
+                             batch.lazy_num_rows, self.output)
+
+    @staticmethod
+    def _boundaries(cols, cap) -> jnp.ndarray:
+        """True where any key differs from the previous row (first row = True)."""
+        b = jnp.zeros((cap,), jnp.bool_).at[0].set(True)
+        for c in cols:
+            prev_vals = jnp.roll(c.values, 1)
+            prev_valid = jnp.roll(c.validity, 1)
+            if isinstance(c.dtype, T.FractionalType):
+                both_nan = jnp.isnan(c.values) & jnp.isnan(prev_vals)
+                differs = ~both_nan & ~(c.values == prev_vals)
+            else:
+                differs = c.values != prev_vals
+            b = b | differs | (c.validity != prev_valid)
+        return b.at[0].set(True)
+
+    def _eval_window(self, we, sctx, part_b, order_b, seg_ids, cap, live):
+        f = we.func
+        frame = we.spec.frame
+        if isinstance(f, RowNumber):
+            return Col(W.row_number(part_b, cap), live, T.INT)
+        if isinstance(f, DenseRank):
+            return Col(W.dense_rank(order_b, part_b), live, T.INT)
+        if isinstance(f, Rank):
+            return Col(W.rank(order_b, part_b, cap), live, T.INT)
+        if isinstance(f, (Lead, Lag)):
+            c = f.children[0].eval(sctx)
+            off = f.offset if isinstance(f, Lead) else -f.offset
+            if f.default is None:
+                fill, fill_valid = jnp.asarray(
+                    c.dtype.default_value(), c.values.dtype), False
+            else:
+                fill = jnp.asarray(f.default, c.values.dtype)
+                fill_valid = True
+            vals, valid = W.shift_within_partition(
+                c.values, c.validity, seg_ids, off, cap, fill, fill_valid)
+            return Col(vals, valid & live, c.dtype, c.dictionary)
+        assert isinstance(f, AggregateFunction), f
+        return self._eval_agg_window(f, frame, sctx, part_b, order_b, cap, live)
+
+    def _eval_agg_window(self, f, frame, sctx, part_b, order_b, cap, live):
+        dict_ = None
+        if isinstance(f, Count) and not f.children:
+            vals = jnp.ones((cap,), jnp.int64)
+            valid = live
+            dtype = T.LONG
+        else:
+            c = f.children[0].eval(sctx)
+            vals, valid, dtype = c.values, c.validity & live, c.dtype
+            dict_ = c.dictionary
+        if isinstance(f, (Min, Max)) and vals.dtype == jnp.bool_:
+            vals = vals.astype(jnp.int8)  # iinfo sentinels need an int carrier
+
+        is_avg = isinstance(f, Average)
+        is_cnt = isinstance(f, Count)
+        out_dtype = f.dtype
+
+        cnt_scan = W.seg_cumsum((valid).astype(jnp.int64), part_b)
+        if isinstance(f, (Sum, Average)) or is_cnt:
+            acc_dt = (jnp.float64 if isinstance(dtype, T.FractionalType)
+                      else jnp.int64)
+            data = jnp.where(valid, vals, jnp.zeros_like(vals)).astype(acc_dt)
+            sum_scan = W.seg_cumsum(data, part_b)
+        nan_scan = nonnan_scan = None
+        if isinstance(f, (Min, Max)) and isinstance(dtype, T.FractionalType):
+            # Spark: NaN is the LARGEST value — min ignores NaN unless the frame
+            # is all-NaN; max is NaN as soon as the frame contains one
+            nan = jnp.isnan(vals)
+            nan_scan = W.seg_cumsum((valid & nan).astype(jnp.int32), part_b)
+            nonnan_scan = W.seg_cumsum((valid & ~nan).astype(jnp.int32), part_b)
+            eff_valid = valid & ~nan
+        else:
+            eff_valid = valid
+        if isinstance(f, Min):
+            sentinel = (jnp.asarray(jnp.inf, vals.dtype)
+                        if isinstance(dtype, T.FractionalType)
+                        else jnp.asarray(jnp.iinfo(vals.dtype).max, vals.dtype))
+            mm_scan = W.seg_cummin(jnp.where(eff_valid, vals, sentinel), part_b)
+        if isinstance(f, Max):
+            sentinel = (jnp.asarray(-jnp.inf, vals.dtype)
+                        if isinstance(dtype, T.FractionalType)
+                        else jnp.asarray(jnp.iinfo(vals.dtype).min, vals.dtype))
+            mm_scan = W.seg_cummax(jnp.where(eff_valid, vals, sentinel), part_b)
+
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        if frame.is_unbounded_both:
+            pos = self._partition_ends(part_b, cap)
+        elif frame.frame_type == "range" and frame.is_unbounded_to_current:
+            pos = W.tie_group_ends(order_b, part_b)
+        elif frame.frame_type == "rows" and frame.is_unbounded_to_current:
+            pos = idx
+        else:  # sliding rows frame [preceding, following] (sum/count/avg only)
+            pstart = W.seg_starts(part_b)
+            pend = self._partition_ends(part_b, cap)
+            fol = cap if frame.following is None else frame.following
+            pre = cap if frame.preceding is None else frame.preceding
+            hi = jnp.minimum(idx + fol, pend)
+            lo = jnp.maximum(idx - pre, pstart)
+            cnt_w = cnt_scan[hi] - jnp.where(lo > pstart, cnt_scan[lo - 1], 0)
+            sum_w = sum_scan[hi] - jnp.where(
+                lo > pstart, sum_scan[lo - 1], jnp.zeros_like(sum_scan[0]))
+            return self._finish(f, sum_w, cnt_w, None, out_dtype, live, None)
+
+        cnt_w = cnt_scan[pos]
+        if isinstance(f, (Sum, Average)) or is_cnt:
+            sum_w = sum_scan[pos]
+            return self._finish(f, sum_w, cnt_w, None, out_dtype, live, None)
+        mm_w = mm_scan[pos]
+        if nan_scan is not None:
+            if isinstance(f, Min):  # all-NaN frame → NaN
+                mm_w = jnp.where((nonnan_scan[pos] == 0) & (nan_scan[pos] > 0),
+                                 jnp.nan, mm_w)
+            else:                   # any NaN in frame → NaN
+                mm_w = jnp.where(nan_scan[pos] > 0, jnp.nan, mm_w)
+        return self._finish(f, None, cnt_w, mm_w, out_dtype, live, dict_)
+
+    @staticmethod
+    def _partition_ends(part_b, cap):
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        next_b = jnp.concatenate([part_b[1:], jnp.ones((1,), jnp.bool_)])
+        rev = lambda x: jnp.flip(x, 0)
+        return rev(W.seg_cummax(rev(jnp.where(next_b, idx, 0)), rev(next_b)))
+
+    @staticmethod
+    def _finish(f, sum_w, cnt_w, mm_w, out_dtype, live, dict_):
+        if isinstance(f, Count):
+            return Col(cnt_w.astype(jnp.int64), live, T.LONG)
+        if isinstance(f, Average):
+            vals = sum_w.astype(jnp.float64) / jnp.maximum(cnt_w, 1)
+            return Col(vals, (cnt_w > 0) & live, T.DOUBLE)
+        if isinstance(f, Sum):
+            dt = out_dtype.jnp_dtype
+            return Col(sum_w.astype(dt), (cnt_w > 0) & live, out_dtype)
+        # min/max: restore the value dtype (bool scans ran on an int8 carrier;
+        # string scans ran on dictionary codes — the sorted dictionary rides
+        # along so codes stay decodable, like expr/aggregates.py Min/Max)
+        if isinstance(out_dtype, T.BooleanType):
+            mm_w = mm_w.astype(jnp.bool_)
+        return Col(mm_w, (cnt_w > 0) & live, out_dtype, dict_)
+
+    def args_string(self):
+        return str(self.window_exprs)

@@ -1,0 +1,170 @@
+"""Window expressions: specs, frames, ranking and offset functions.
+
+Reference: GpuWindowExpression.scala (frame types, `windowAggregation`:847),
+GpuWindowExec.scala:92. A WindowExpression pairs a function (ranking / offset /
+aggregate) with a WindowSpec (partition keys, order keys, frame). Frames follow
+Spark: ROWS or RANGE, with UNBOUNDED/CURRENT/numeric offsets; Spark's default
+frame with an ORDER BY is RANGE UNBOUNDED PRECEDING..CURRENT ROW."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Expression
+from spark_rapids_tpu.expr.aggregates import AggregateFunction
+
+UNBOUNDED = None  # sentinel for unbounded preceding/following
+CURRENT = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowFrame:
+    """row/range frame with offsets relative to the current row. `preceding` and
+    `following` use UNBOUNDED (None) or non-negative ints (reference
+    GpuSpecifiedWindowFrame)."""
+    frame_type: str = "range"          # "rows" | "range"
+    preceding: int | None = UNBOUNDED
+    following: int | None = CURRENT
+
+    @property
+    def is_unbounded_to_current(self):
+        return self.preceding is UNBOUNDED and self.following == CURRENT
+
+    @property
+    def is_unbounded_both(self):
+        return self.preceding is UNBOUNDED and self.following is UNBOUNDED
+
+
+DEFAULT_FRAME = WindowFrame("range", UNBOUNDED, CURRENT)
+FULL_FRAME = WindowFrame("rows", UNBOUNDED, UNBOUNDED)
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowSpec:
+    partition_by: tuple = ()
+    order_by: tuple = ()               # ((expr, ascending, nulls_first), ...)
+    frame: WindowFrame = DEFAULT_FRAME
+
+    def with_frame(self, frame: WindowFrame) -> "WindowSpec":
+        return WindowSpec(self.partition_by, self.order_by, frame)
+
+
+class WindowFunction(Expression):
+    """Base for ranking/offset functions that only exist over a window."""
+    children: list = []
+
+    @property
+    def nullable(self):
+        return False
+
+
+class RowNumber(WindowFunction):
+    def __init__(self):
+        self.children = []
+
+    @property
+    def dtype(self):
+        return T.INT
+
+    def with_children(self, children):
+        return self
+
+    def __repr__(self):
+        return "row_number()"
+
+
+class Rank(WindowFunction):
+    def __init__(self):
+        self.children = []
+
+    @property
+    def dtype(self):
+        return T.INT
+
+    def with_children(self, children):
+        return self
+
+    def __repr__(self):
+        return "rank()"
+
+
+class DenseRank(WindowFunction):
+    def __init__(self):
+        self.children = []
+
+    @property
+    def dtype(self):
+        return T.INT
+
+    def with_children(self, children):
+        return self
+
+    def __repr__(self):
+        return "dense_rank()"
+
+
+class Lead(WindowFunction):
+    """lead(col, n, default) — value n rows after the current row within the
+    partition (reference GpuLead)."""
+
+    def __init__(self, child, offset: int = 1, default=None):
+        self.children = [child]
+        self.offset = offset
+        self.default = default
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    @property
+    def nullable(self):
+        return True
+
+    def with_children(self, children):
+        return type(self)(children[0], self.offset, self.default)
+
+    def __repr__(self):
+        return f"{type(self).__name__.lower()}({self.children[0]!r}, {self.offset})"
+
+
+class Lag(Lead):
+    pass
+
+
+class WindowExpression(Expression):
+    """func OVER spec (reference GpuWindowExpression)."""
+
+    def __init__(self, func: Expression, spec: WindowSpec):
+        assert isinstance(func, (WindowFunction, AggregateFunction)), func
+        self.func = func
+        self.spec = spec
+        # children cover the function inputs AND the spec's partition/order
+        # expressions so bind_references rewrites all of them
+        self._n_func = len(getattr(func, "children", []))
+        self.children = (list(getattr(func, "children", []))
+                         + [e for e in spec.partition_by]
+                         + [e for (e, _, _) in spec.order_by])
+
+    @property
+    def dtype(self):
+        return self.func.dtype
+
+    @property
+    def nullable(self):
+        if isinstance(self.func, (RowNumber, Rank, DenseRank)):
+            return False
+        return True
+
+    def with_children(self, children):
+        nf = self._n_func
+        f = self.func.with_children(children[:nf]) if nf else self.func
+        np_ = len(self.spec.partition_by)
+        parts = tuple(children[nf:nf + np_])
+        orders = tuple(
+            (c, asc, nfirst) for c, (_, asc, nfirst)
+            in zip(children[nf + np_:], self.spec.order_by))
+        return WindowExpression(f, WindowSpec(parts, orders, self.spec.frame))
+
+    def __repr__(self):
+        return f"{self.func!r} OVER {self.spec}"

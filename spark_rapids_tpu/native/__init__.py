@@ -1,0 +1,76 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+Reference: the reference's native layer is cuDF/RMM/nvcomp/UCX consumed through
+JNI (SURVEY.md L0). The TPU build keeps compute in XLA but implements the
+host-side native pieces in C++: the LZ4 block codec (nvcomp analog) here, built by
+`make` on first import and cached next to the sources."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libtpulz4.so")
+_lock = threading.Lock()
+_lib = None
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _build() -> None:
+    res = subprocess.run(["make", "-C", _DIR, "-s"], capture_output=True,
+                         text=True)
+    if res.returncode != 0:
+        raise NativeBuildError(
+            f"native build failed:\n{res.stdout}\n{res.stderr}")
+
+
+def lz4_lib():
+    """Load (building if needed) the native LZ4 library."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        src = os.path.join(_DIR, "lz4.cpp")
+        if (not os.path.exists(_LIB_PATH)
+                or os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)):
+            _build()
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.tpu_lz4_compress_bound.restype = ctypes.c_size_t
+        lib.tpu_lz4_compress_bound.argtypes = [ctypes.c_size_t]
+        lib.tpu_lz4_compress.restype = ctypes.c_size_t
+        lib.tpu_lz4_compress.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_void_p, ctypes.c_size_t]
+        lib.tpu_lz4_decompress.restype = ctypes.c_size_t
+        lib.tpu_lz4_decompress.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_void_p, ctypes.c_size_t]
+        _lib = lib
+        return _lib
+
+
+def lz4_compress(data: bytes) -> bytes:
+    if not data:
+        return b""
+    lib = lz4_lib()
+    bound = lib.tpu_lz4_compress_bound(len(data))
+    out = ctypes.create_string_buffer(bound)
+    n = lib.tpu_lz4_compress(data, len(data), out, bound)
+    if n == 0:
+        raise ValueError("lz4 compression failed")
+    return out.raw[:n]
+
+
+def lz4_decompress(data: bytes, decompressed_len: int) -> bytes:
+    if decompressed_len == 0:
+        return b""
+    lib = lz4_lib()
+    out = ctypes.create_string_buffer(decompressed_len)
+    n = lib.tpu_lz4_decompress(data, len(data), out, decompressed_len)
+    if n != decompressed_len:
+        raise ValueError("lz4 decompression failed (corrupt frame)")
+    return out.raw[:n]

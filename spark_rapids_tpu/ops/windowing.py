@@ -1,0 +1,119 @@
+"""Window kernels — segmented scans over sorted partitions, all inside one XLA
+program.
+
+Reference: cudf rolling/window aggregation driven by GpuWindowExpression
+(`.overWindow`:295, `windowAggregation`:847). cudf materializes per-row gather
+windows; the TPU-native design instead sorts once and computes SEGMENTED SCANS.
+
+Implementation note: jax.lax.associative_scan with a tuple carrier compiles
+pathologically on the TPU toolchain here, so scans use (a) the native cumsum for
+sums and (b) explicit Hillis-Steele log-step doubling (12 static steps at 4k
+capacity: roll + where, all plain XLA ops) for max/min — O(n log n) work, tiny
+programs, no data-dependent shapes:
+
+  - unbounded-preceding → current (ROWS): segmented inclusive scan
+  - RANGE ...→ current with ties: gather the scan value at each tie-group end
+  - unbounded both: segment totals broadcast
+  - sliding ROWS [p, f]: prefix-sum differences (sum/count/avg)
+  - ranking: positions vs segment starts / tie-group starts
+  - lead/lag: shifted gathers masked by partition membership
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+
+
+def _doubling_scan(values, mask_fn, combine):
+    """Inclusive scan by log-step doubling: out[i] = combine over the allowed
+    prefix. mask_fn(idx, s) says whether out[i-s] may fold into out[i]."""
+    cap = values.shape[0]
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    out = values
+    s = 1
+    while s < cap:
+        prev = jnp.roll(out, s)   # out[i-s]; head rows are masked off below
+        out = jnp.where(mask_fn(idx, s), combine(prev, out), out)
+        s <<= 1
+    return out
+
+
+def seg_starts(boundary):
+    """Index of the segment start for every row."""
+    idx = jnp.arange(boundary.shape[0], dtype=jnp.int32)
+    marked = jnp.where(boundary, idx, jnp.int32(0))
+    return _doubling_scan(marked, lambda i, s: i >= s, jnp.maximum)
+
+
+def segmented_scan(values, boundary, combine):
+    """Inclusive scan of `values` restarting where boundary=True."""
+    start = seg_starts(boundary)
+    return _doubling_scan(values, lambda i, s: (i - s) >= start, combine)
+
+
+def seg_cumsum(values, boundary):
+    """Segmented cumulative sum via ONE native cumsum + per-segment rebase
+    (cheaper than doubling for the common sum/count scans)."""
+    cs = jnp.cumsum(values, axis=0)
+    start = seg_starts(boundary)
+    base = jnp.where(start > 0, cs[jnp.maximum(start - 1, 0)],
+                     jnp.zeros_like(cs[0]))
+    return cs - base
+
+
+def seg_cummax(values, boundary):
+    return segmented_scan(values, boundary, jnp.maximum)
+
+
+def seg_cummin(values, boundary):
+    return segmented_scan(values, boundary, jnp.minimum)
+
+
+def tie_group_ends(order_boundary, part_boundary):
+    """For RANGE frames: last index of each row's order-key tie group within its
+    partition (rows with equal order keys share the frame end — Spark RANGE
+    CURRENT ROW includes ties)."""
+    n = order_boundary.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    rev = lambda x: jnp.flip(x, 0)
+    # a tie group ends where the NEXT row starts a new tie group (or at n-1)
+    next_is_boundary = jnp.concatenate(
+        [order_boundary[1:], jnp.ones((1,), jnp.bool_)])
+    end_idx = jnp.where(next_is_boundary, idx, jnp.int32(0))
+    # propagate each end backwards across its tie group: reversed segmented scan
+    ends = rev(seg_cummax(rev(end_idx), rev(next_is_boundary)))
+    return ends
+
+
+def row_number(part_boundary, capacity):
+    idx = jnp.arange(capacity, dtype=jnp.int32)
+    return idx - seg_starts(part_boundary) + 1
+
+
+def dense_rank(order_boundary, part_boundary):
+    newgrp = order_boundary & ~part_boundary
+    return seg_cumsum(newgrp.astype(jnp.int32), part_boundary) + 1
+
+
+def rank(order_boundary, part_boundary, capacity):
+    idx = jnp.arange(capacity, dtype=jnp.int32)
+    start = seg_starts(part_boundary)
+    tie_start = seg_cummax(jnp.where(order_boundary, idx, jnp.int32(0)),
+                           part_boundary)
+    return tie_start - start + 1
+
+
+def shift_within_partition(values, validity, seg_ids, offset: int, capacity: int,
+                           fill_value, fill_valid):
+    """lead (offset>0) / lag (offset<0) with partition-membership masking."""
+    idx = jnp.arange(capacity, dtype=jnp.int32)
+    src = idx + offset
+    in_range = (src >= 0) & (src < capacity)
+    src_c = jnp.clip(src, 0, capacity - 1)
+    same_part = in_range & (seg_ids[src_c] == seg_ids)
+    vals = jnp.where(same_part, values[src_c], fill_value)
+    valid = jnp.where(same_part, validity[src_c], fill_valid)
+    return vals, valid

@@ -1,0 +1,173 @@
+"""Host window interpreter — the CPU oracle for WindowNode (plain python loops,
+deliberately independent of the device's segmented-scan kernels)."""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Alias, bind_references
+from spark_rapids_tpu.expr.aggregates import (AggregateFunction, Average, Count,
+                                              Max, Min, Sum)
+from spark_rapids_tpu.expr.windows import (DenseRank, Lag, Lead, Rank, RowNumber,
+                                           WindowExpression)
+from spark_rapids_tpu.plan.host_eval import eval_host
+
+
+def _unalias(e):
+    return e.child if isinstance(e, Alias) else e
+
+
+def _cmp_key(v):
+    if v is None:
+        return None
+    if isinstance(v, float) and math.isnan(v):
+        return (1, 0.0)
+    if isinstance(v, bool):
+        return (0, int(v))
+    return (0, v)
+
+
+def host_window(node, tbl: pa.Table) -> pa.Table:
+    schema = node.child.output
+    exprs = [bind_references(e, schema) for e in node.window_exprs]
+    spec0 = _unalias(exprs[0]).spec
+    n = tbl.num_rows
+
+    part_cols = [eval_host(e, tbl).data for e in spec0.partition_by]
+    order_cols = [(eval_host(e, tbl).data, asc, nf)
+                  for (e, asc, nf) in spec0.order_by]
+
+    def sort_cmp(i, j):
+        for (data, asc, nf) in order_cols:
+            a, b = data[i], data[j]
+            if a is None and b is None:
+                continue
+            if a is None:
+                return -1 if nf else 1
+            if b is None:
+                return 1 if nf else -1
+            ka, kb = _cmp_key(a), _cmp_key(b)
+            if ka == kb:
+                continue
+            r = -1 if ka < kb else 1
+            return r if asc else -r
+        return i - j
+
+    # group rows by partition key, keep insertion order then sort within
+    groups: dict = {}
+    for i in range(n):
+        k = tuple(_cmp_key(c[i]) for c in part_cols)
+        groups.setdefault(k, []).append(i)
+    for k in groups:
+        groups[k].sort(key=functools.cmp_to_key(sort_cmp))
+
+    out_order: list[int] = []
+    results = [[None] * n for _ in exprs]
+    for k, rows in sorted(groups.items(),
+                          key=lambda kv: tuple(
+                              (x is None, x) for x in kv[0])):
+        out_order.extend(rows)
+        for ei, e in enumerate(exprs):
+            we = _unalias(e)
+            vals = _eval_one(we, rows, tbl, order_cols)
+            for r, v in zip(rows, vals):
+                results[ei][r] = v
+
+    arrays = [tbl.column(i).take(pa.array(out_order, pa.int64()))
+              for i in range(tbl.num_columns)]
+    names = list(tbl.column_names)
+    for ei, e in enumerate(exprs):
+        f = node.output.fields[tbl.num_columns + ei]
+        arrays.append(pa.array([results[ei][r] for r in out_order],
+                               T.to_arrow_type(f.data_type)))
+        names.append(f.name)
+    return pa.Table.from_arrays(arrays, names=names)
+
+
+def _tie_groups(rows, order_cols):
+    """Indices of rows grouped by equal order keys, in order."""
+    tg = []
+    for i, r in enumerate(rows):
+        if i == 0:
+            tg.append([i])
+            continue
+        prev = rows[i - 1]
+        same = all(_cmp_key(d[r]) == _cmp_key(d[prev]) for (d, _, _) in order_cols)
+        if same:
+            tg[-1].append(i)
+        else:
+            tg.append([i])
+    return tg
+
+
+def _frame_bounds(we, i, rows, order_cols):
+    """[lo, hi] inclusive positions within `rows` for row position i."""
+    fr = we.spec.frame
+    n = len(rows)
+    if fr.is_unbounded_both:
+        return 0, n - 1
+    if fr.frame_type == "range":
+        if not (fr.preceding is None and fr.following == 0):
+            raise NotImplementedError(
+                f"host window: range frame with offsets {fr}")
+        # unbounded preceding → current row including ties
+        for tg in _tie_groups(rows, order_cols):
+            if i in tg:
+                return 0, tg[-1]
+        return 0, i
+    lo = 0 if fr.preceding is None else max(0, i - fr.preceding)
+    hi = n - 1 if fr.following is None else min(n - 1, i + fr.following)
+    return lo, hi
+
+
+def _eval_one(we, rows, tbl, order_cols):
+    f = we.func
+    n = len(rows)
+    if isinstance(f, RowNumber):
+        return list(range(1, n + 1))
+    if isinstance(f, (Rank, DenseRank)):
+        out = []
+        rank_v, dense_v, seen = 0, 0, 0
+        for tg in _tie_groups(rows, order_cols):
+            dense_v += 1
+            rank_v = seen + 1
+            for _ in tg:
+                out.append(dense_v if isinstance(f, DenseRank) else rank_v)
+                seen += 1
+        return out
+    if isinstance(f, (Lead, Lag)):
+        data = eval_host(f.children[0], tbl).data
+        off = f.offset if isinstance(f, Lead) else -f.offset
+        out = []
+        for i in range(n):
+            j = i + off
+            out.append(data[rows[j]] if 0 <= j < n else f.default)
+        return out
+    assert isinstance(f, AggregateFunction)
+    data = (eval_host(f.children[0], tbl).data if f.children else None)
+    out = []
+    for i in range(n):
+        lo, hi = _frame_bounds(we, i, rows, order_cols)
+        frame_rows = rows[lo:hi + 1]
+        if isinstance(f, Count):
+            out.append(len(frame_rows) if data is None else
+                       sum(1 for r in frame_rows if data[r] is not None))
+            continue
+        vals = [data[r] for r in frame_rows if data[r] is not None]
+        if not vals:
+            out.append(None)
+        elif isinstance(f, Sum):
+            out.append(sum(vals))
+        elif isinstance(f, Average):
+            out.append(float(sum(vals)) / len(vals))
+        elif isinstance(f, Min):
+            out.append(min(vals, key=_cmp_key))
+        elif isinstance(f, Max):
+            out.append(max(vals, key=_cmp_key))
+        else:
+            raise NotImplementedError(type(f).__name__)
+    return out

@@ -618,7 +618,8 @@ class WindowNode(PlanNode):
     def __init__(self, window_exprs: list, child: PlanNode):
         """window_exprs: list of Alias(WindowExpression)."""
         super().__init__(child)
-        self.window_exprs = window_exprs
+        self.window_exprs = [E.bind_references(e, child.output)
+                             for e in window_exprs]
 
     @property
     def output(self):

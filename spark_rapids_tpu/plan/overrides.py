@@ -209,6 +209,12 @@ def _register_all():
     for cls in (AG.Sum, AG.Count, AG.Min, AG.Max, AG.Average, AG.First):
         ex(cls, "aggregate function", comm + TS.DECIMAL)
 
+    from spark_rapids_tpu.expr import windows as WX
+    ex(WX.WindowExpression, "window expression", TS.ALL)
+    for cls in (WX.RowNumber, WX.Rank, WX.DenseRank):
+        ex(cls, "ranking window function", TS.TypeSig([T.IntegerType]))
+    ex(WX.Lead, "lead/lag offset function", TS.ALL)
+
     # -- execs ---------------------------------------------------------------
     from spark_rapids_tpu.exec import basic as XB
     from spark_rapids_tpu.exec import aggregate as XA
@@ -321,10 +327,49 @@ def _register_all():
         conv_aggregate)
     exr(NN.JoinNode, "broadcast/nested-loop join", conv_join,
         tag_fn=tag_join)
+    from spark_rapids_tpu.exec.window import WindowExec, supported_window_expr
+    from spark_rapids_tpu.expr.core import Alias
+
+    def _unalias(e):
+        return e.child if isinstance(e, Alias) else e
+
+    def tag_window(meta):
+        n = meta.node
+        specs = set()
+        for e in n.window_exprs:
+            we = _unalias(e)
+            if not isinstance(we, WX.WindowExpression):
+                meta.will_not_work(f"not a window expression: {we!r}")
+                continue
+            reason = supported_window_expr(we)
+            if reason:
+                meta.will_not_work(reason)
+            specs.add(repr((we.spec.partition_by, we.spec.order_by)))
+        if len(specs) > 1:
+            meta.will_not_work(
+                "multiple window partition/order specs in one node "
+                "(the planner splits these into chained WindowExecs — TODO)")
+
+    def conv_window(meta, kids):
+        n = meta.node
+        child = kids[0]
+        we0 = _unalias(n.window_exprs[0])
+        if child.num_partitions > 1:
+            if we0.spec.partition_by:
+                child = ShuffleExchangeExec(
+                    SP.HashPartitioner(list(we0.spec.partition_by),
+                                       child.num_partitions),
+                    child, conf=meta.conf)
+            else:
+                child = XS._GatherAllExec(child, conf=meta.conf)
+        return WindowExec(n.window_exprs, child, conf=meta.conf)
+
     exr(NN.SortNode, "device sort", conv_sort)
     exr(NN.ExchangeNode, "shuffle exchange", conv_exchange)
-    # WindowNode / ExpandNode / GenerateNode get rules when their device execs
-    # land; until then they are tagged host-only and run via the interpreter.
+    exr(NN.WindowNode, "window via segmented scans", conv_window,
+        tag_fn=tag_window)
+    # ExpandNode / GenerateNode get rules when their device execs land; until
+    # then they are tagged host-only and run via the interpreter.
 
 
 _register_all()

@@ -1,0 +1,125 @@
+"""Table compression codecs for shuffle/spill buffers.
+
+Reference (SURVEY.md #34): TableCompressionCodec.scala:41,107 (codec registry +
+per-buffer codec descriptors), BatchedTableCompressor:137 (batched windows),
+NvcompLZ4CompressionCodec.scala (device LZ4), CopyCompressionCodec (test codec).
+TPU stance: compression runs on the host CPU beside the NIC/disk (serialized
+frames), with the LZ4 kernel in native C++ (native/lz4.cpp)."""
+
+from __future__ import annotations
+
+import concurrent.futures as futures
+import struct
+import zlib
+
+# magic, codec id, uncompressed len, crc32 of uncompressed payload (LZ4 block
+# format itself has no checksum; network frames need one)
+_CODEC_HEADER = struct.Struct("<4sBQI")
+_MAGIC = b"TPUC"
+CODEC_NONE = 0
+CODEC_COPY = 1
+CODEC_LZ4 = 2
+
+_NAMES = {"none": CODEC_NONE, "copy": CODEC_COPY, "lz4": CODEC_LZ4}
+
+
+class TableCompressionCodec:
+    codec_id = CODEC_NONE
+    name = "none"
+
+    def compress(self, data: bytes) -> bytes:
+        return data
+
+    def decompress(self, data: bytes, n: int) -> bytes:
+        return data
+
+    # -- framing -------------------------------------------------------------
+    def encode(self, data: bytes) -> bytes:
+        if self.codec_id == CODEC_NONE:
+            return data
+        crc = zlib.crc32(data) & 0xFFFFFFFF
+        return (_CODEC_HEADER.pack(_MAGIC, self.codec_id, len(data), crc)
+                + self.compress(data))
+
+    @staticmethod
+    def decode(blob: bytes) -> bytes:
+        """Self-describing decode: plain frames pass through (reference reads the
+        codec id from the per-buffer BufferMeta descriptor)."""
+        if len(blob) >= _CODEC_HEADER.size:
+            magic, cid, n, crc = _CODEC_HEADER.unpack_from(blob, 0)
+            if magic == _MAGIC:
+                codec = _BY_ID[cid]
+                data = codec.decompress(blob[_CODEC_HEADER.size:], n)
+                if (zlib.crc32(data) & 0xFFFFFFFF) != crc:
+                    raise ValueError("corrupt compressed frame (crc mismatch)")
+                return data
+        return blob
+
+
+class CopyCodec(TableCompressionCodec):
+    """Identity codec with the full framing path — the reference's COPY test
+    codec (TableCompressionCodec.scala)."""
+    codec_id = CODEC_COPY
+    name = "copy"
+
+    def compress(self, data):
+        return data
+
+    def decompress(self, data, n):
+        assert len(data) == n
+        return data
+
+
+class Lz4Codec(TableCompressionCodec):
+    codec_id = CODEC_LZ4
+    name = "lz4"
+
+    def compress(self, data):
+        from spark_rapids_tpu.native import lz4_compress
+        return lz4_compress(data)
+
+    def decompress(self, data, n):
+        from spark_rapids_tpu.native import lz4_decompress
+        return lz4_decompress(data, n)
+
+
+_BY_ID = {CODEC_NONE: TableCompressionCodec(), CODEC_COPY: CopyCodec(),
+          CODEC_LZ4: Lz4Codec()}
+
+
+def get_codec(name: str) -> TableCompressionCodec:
+    try:
+        return _BY_ID[_NAMES[name.lower()]]
+    except KeyError:
+        raise ValueError(f"unknown compression codec {name!r}") from None
+
+
+class BatchedTableCompressor:
+    """Compress many frames concurrently on a persistent thread pool (reference
+    BatchedTableCompressor:137 batches device buffers through nvcomp)."""
+
+    def __init__(self, codec: TableCompressionCodec, num_threads: int = 4):
+        self.codec = codec
+        self.num_threads = num_threads
+        self._pool = None
+
+    def _get_pool(self):
+        if self._pool is None:
+            self._pool = futures.ThreadPoolExecutor(
+                self.num_threads, thread_name_prefix="table-codec")
+        return self._pool
+
+    def compress_all(self, frames: list) -> list:
+        if self.codec.codec_id == CODEC_NONE or len(frames) <= 1:
+            return [self.codec.encode(f) for f in frames]
+        return list(self._get_pool().map(self.codec.encode, frames))
+
+    def decompress_all(self, frames: list) -> list:
+        if len(frames) <= 1:
+            return [TableCompressionCodec.decode(f) for f in frames]
+        return list(self._get_pool().map(TableCompressionCodec.decode, frames))
+
+    def close(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None

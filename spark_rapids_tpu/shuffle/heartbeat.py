@@ -1,0 +1,109 @@
+"""Shuffle liveness: executor registration + heartbeats.
+
+Reference (SURVEY.md #35): RapidsShuffleHeartbeatManager (driver side) +
+RapidsShuffleHeartbeatEndpoint (executor side), wired in Plugin.scala:140-166,197
+— executors RPC-register with the driver so every peer learns new shuffle
+executors (elasticity: late joiners see existing peers, existing peers learn of
+late joiners on their next beat)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class PeerInfo:
+    __slots__ = ("executor_id", "host", "port", "last_seen")
+
+    def __init__(self, executor_id: str, host: str, port: int):
+        self.executor_id = executor_id
+        self.host = host
+        self.port = port
+        self.last_seen = time.monotonic()
+
+    @property
+    def address(self):
+        return (self.host, self.port)
+
+
+class RapidsShuffleHeartbeatManager:
+    """Driver-side registry (reference RapidsShuffleHeartbeatManager)."""
+
+    def __init__(self, timeout_s: float = 60.0):
+        self._lock = threading.Lock()
+        self._peers: dict[str, PeerInfo] = {}
+        self.timeout_s = timeout_s
+
+    def register(self, executor_id: str, host: str, port: int) -> list:
+        """Register an executor; returns all CURRENT peers so a late joiner
+        learns existing executors immediately."""
+        with self._lock:
+            self._peers[executor_id] = PeerInfo(executor_id, host, port)
+            return [p for eid, p in self._peers.items() if eid != executor_id]
+
+    def heartbeat(self, executor_id: str) -> list:
+        """Refresh liveness; returns peers registered since (simplified: all
+        live peers — the reference returns deltas)."""
+        with self._lock:
+            p = self._peers.get(executor_id)
+            if p is None:
+                raise KeyError(f"unregistered executor {executor_id}")
+            p.last_seen = time.monotonic()
+            return [q for eid, q in self._peers.items() if eid != executor_id]
+
+    def live_peers(self) -> list:
+        now = time.monotonic()
+        with self._lock:
+            return [p for p in self._peers.values()
+                    if now - p.last_seen < self.timeout_s]
+
+    def expire_dead(self) -> list:
+        """Drop executors that missed their heartbeats (failure detection);
+        returns the expired peers so shuffles can be invalidated → recompute."""
+        now = time.monotonic()
+        with self._lock:
+            dead = [p for p in self._peers.values()
+                    if now - p.last_seen >= self.timeout_s]
+            for p in dead:
+                del self._peers[p.executor_id]
+            return dead
+
+
+class RapidsShuffleHeartbeatEndpoint:
+    """Executor-side periodic beat (reference RapidsShuffleHeartbeatEndpoint)."""
+
+    def __init__(self, manager: RapidsShuffleHeartbeatManager, executor_id: str,
+                 host: str, port: int, interval_s: float = 5.0):
+        self.manager = manager
+        self.executor_id = executor_id
+        self.peers: dict[str, PeerInfo] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.interval_s = interval_s
+        self._update(manager.register(executor_id, host, port))
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"heartbeat-{executor_id}")
+        self._thread.start()
+
+    def _update(self, peers):
+        with self._lock:
+            for p in peers:
+                self.peers[p.executor_id] = p
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._update(self.manager.heartbeat(self.executor_id))
+            except Exception:
+                pass  # driver unreachable: keep trying; Spark handles real death
+
+    def beat_now(self):
+        self._update(self.manager.heartbeat(self.executor_id))
+
+    def known_peers(self) -> list:
+        with self._lock:
+            return list(self.peers.values())
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=2)

@@ -36,6 +36,13 @@ class ShuffleBlockStore:
         # shuffle_id -> reduce_id -> list[SpillableColumnarBatch]
         self._blocks: dict[int, dict[int, list]] = {}
         self._serialized_mode: dict[int, bool] = {}
+        # notified on unregister so transports drop their serialized-frame
+        # caches alongside the device blocks
+        self._unregister_listeners: list = []
+
+    def add_unregister_listener(self, cb) -> None:
+        with self._lock:
+            self._unregister_listeners.append(cb)
 
     @classmethod
     def get(cls) -> "ShuffleBlockStore":
@@ -83,10 +90,13 @@ class ShuffleBlockStore:
         with self._lock:
             parts = self._blocks.pop(shuffle_id, {})
             self._serialized_mode.pop(shuffle_id, None)
+            listeners = list(self._unregister_listeners)
         for blobs in parts.values():
             for b in blobs:
                 if not isinstance(b, bytes):
                     b.close()
+        for cb in listeners:
+            cb(shuffle_id)
 
     def clear_all(self):
         for sid in list(self._blocks):

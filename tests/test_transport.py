@@ -1,0 +1,249 @@
+"""Shuffle transport + compression + heartbeat tests (ring 1: protocol logic
+without real multi-host hardware — reference RapidsShuffleTestHelper-based suites
+exercise the tag protocol the same way, SURVEY.md §4)."""
+
+import os
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.shuffle.compression import (
+    BatchedTableCompressor, CopyCodec, Lz4Codec, TableCompressionCodec,
+    get_codec,
+)
+from spark_rapids_tpu.shuffle.heartbeat import (
+    RapidsShuffleHeartbeatEndpoint, RapidsShuffleHeartbeatManager,
+)
+from spark_rapids_tpu.shuffle.manager import ShuffleBlockStore
+from spark_rapids_tpu.shuffle.transport import (
+    InflightThrottle, LocalTransport, RapidsShuffleTransport, TcpTransport,
+    TransportError,
+)
+
+
+def make_batch(n=100, seed=0):
+    r = np.random.default_rng(seed)
+    t = pa.table({
+        "a": pa.array([None if x % 7 == 0 else int(x)
+                       for x in r.integers(0, 1000, n)], pa.int64()),
+        "s": pa.array([f"row{i % 13}" for i in range(n)]),
+    })
+    return ColumnarBatch.from_arrow(t), t
+
+
+# -- native codec ------------------------------------------------------------
+
+def test_lz4_roundtrip_various():
+    from spark_rapids_tpu.native import lz4_compress, lz4_decompress
+    for data in [b"", b"x", b"abc" * 10000, os.urandom(65536),
+                 np.arange(50000, dtype=np.int64).tobytes()]:
+        assert lz4_decompress(lz4_compress(data), len(data)) == data
+
+
+def test_corrupt_frame_detected():
+    """LZ4 block format has no checksum, so the codec framing carries a crc32
+    that decode() verifies."""
+    data = b"hello world " * 1000
+    codec = get_codec("lz4")
+    enc = bytearray(codec.encode(data))
+    enc[-3] ^= 0xFF
+    with pytest.raises(ValueError):
+        TableCompressionCodec.decode(bytes(enc))
+    # structural corruption is caught by the decompressor itself
+    from spark_rapids_tpu.native import lz4_decompress
+    with pytest.raises(ValueError):
+        lz4_decompress(b"\xff\xff\xff\xff", 100)
+
+
+def test_codec_registry_and_framing():
+    payload = np.arange(10000, dtype=np.int32).tobytes()
+    for name in ("none", "copy", "lz4"):
+        codec = get_codec(name)
+        enc = codec.encode(payload)
+        assert TableCompressionCodec.decode(enc) == payload
+    assert isinstance(get_codec("lz4"), Lz4Codec)
+    with pytest.raises(ValueError):
+        get_codec("zstd9000")
+    comp = BatchedTableCompressor(get_codec("lz4"), num_threads=3)
+    frames = [os.urandom(1000) for _ in range(8)]
+    out = comp.decompress_all(comp.compress_all(frames))
+    assert out == frames
+
+
+# -- transports --------------------------------------------------------------
+
+@pytest.fixture
+def store():
+    ShuffleBlockStore.reset()
+    yield ShuffleBlockStore.get()
+    ShuffleBlockStore.reset()
+
+
+def fill_shuffle(store, n_blocks=3, reduce_ids=(0, 1)):
+    sid = store.register_shuffle()
+    expect = {}
+    for rid in reduce_ids:
+        tbls = []
+        for b in range(n_blocks):
+            batch, t = make_batch(50 + 10 * b, seed=rid * 10 + b)
+            store.write_block(sid, rid, batch)
+            tbls.append(t)
+        expect[rid] = pa.concat_tables(tbls)
+    return sid, expect
+
+
+def collect(client, sid, rid):
+    tables = [b.to_arrow() for b in client.fetch_blocks(sid, rid)]
+    return pa.concat_tables(tables)
+
+
+def test_local_transport(store):
+    sid, expect = fill_shuffle(store)
+    client = LocalTransport().make_client()
+    for rid in expect:
+        got = collect(client, sid, rid)
+        assert got.to_pylist() == expect[rid].to_pylist()
+
+
+@pytest.mark.parametrize("codec", ["none", "lz4"])
+def test_tcp_transport_roundtrip(store, codec):
+    sid, expect = fill_shuffle(store)
+    conf = RapidsConf({
+        "spark.rapids.tpu.shuffle.compression.codec": codec,
+        "spark.rapids.tpu.shuffle.bounceBuffers.size": "1k",  # force windowing
+    })
+    transport = TcpTransport(conf)
+    try:
+        client = transport.make_client(("127.0.0.1", transport.port))
+        for rid in expect:
+            got = collect(client, sid, rid)
+            assert got.to_pylist() == expect[rid].to_pylist()
+    finally:
+        transport.shutdown()
+
+
+def test_tcp_transport_concurrent_fetches(store):
+    sid, expect = fill_shuffle(store, n_blocks=4, reduce_ids=tuple(range(6)))
+    conf = RapidsConf({
+        "spark.rapids.tpu.shuffle.maxBytesInFlight": "8k",
+        "spark.rapids.tpu.shuffle.bounceBuffers.size": "2k",
+    })
+    transport = TcpTransport(conf)
+    results = {}
+    errors = []
+
+    def fetch(rid):
+        try:
+            client = transport.make_client(("127.0.0.1", transport.port))
+            results[rid] = collect(client, sid, rid)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+    try:
+        threads = [threading.Thread(target=fetch, args=(rid,))
+                   for rid in expect]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors
+        for rid in expect:
+            assert results[rid].to_pylist() == expect[rid].to_pylist()
+    finally:
+        transport.shutdown()
+
+
+def test_tcp_transport_unknown_shuffle_error(store):
+    transport = TcpTransport(RapidsConf())
+    try:
+        client = transport.make_client(("127.0.0.1", transport.port))
+        with pytest.raises(TransportError):
+            list(client.fetch_blocks(12345, 0))
+    finally:
+        transport.shutdown()
+
+
+def test_transport_factory_by_classname(store):
+    conf = RapidsConf({"spark.rapids.tpu.shuffle.transport.class":
+                       "spark_rapids_tpu.shuffle.transport.TcpTransport"})
+    t = RapidsShuffleTransport.make_transport(conf)
+    assert isinstance(t, TcpTransport)
+    t.shutdown()
+
+
+def test_inflight_throttle_bounds():
+    th = InflightThrottle(100)
+    state = {"cur": 0, "peak": 0}
+    lock = threading.Lock()
+
+    def worker(n):
+        for _ in range(20):
+            with th.acquire(n):
+                with lock:
+                    state["cur"] += n
+                    state["peak"] = max(state["peak"], state["cur"])
+                with lock:
+                    state["cur"] -= n
+    threads = [threading.Thread(target=worker, args=(40,)) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert state["peak"] <= 120  # 100 limit + one oversubscribed acquire
+
+
+def test_oversized_block_still_transfers():
+    """A single block larger than the inflight limit must not deadlock
+    (reference: throttle admits one request when idle)."""
+    th = InflightThrottle(10)
+    with th.acquire(1000):
+        pass
+
+
+# -- heartbeat ---------------------------------------------------------------
+
+def test_heartbeat_registration_and_peers():
+    mgr = RapidsShuffleHeartbeatManager(timeout_s=10)
+    a = RapidsShuffleHeartbeatEndpoint(mgr, "exec-a", "h1", 1111,
+                                       interval_s=600)
+    b = RapidsShuffleHeartbeatEndpoint(mgr, "exec-b", "h2", 2222,
+                                       interval_s=600)
+    try:
+        # late joiner saw the earlier peer at registration
+        assert [p.executor_id for p in b.known_peers()] == ["exec-a"]
+        # earlier peer learns the late joiner on its next beat
+        a.beat_now()
+        assert [p.executor_id for p in a.known_peers()] == ["exec-b"]
+        assert {p.executor_id for p in mgr.live_peers()} == {"exec-a", "exec-b"}
+    finally:
+        a.close()
+        b.close()
+
+
+def test_heartbeat_expiry_failure_detection():
+    mgr = RapidsShuffleHeartbeatManager(timeout_s=0.05)
+    mgr.register("exec-x", "h", 1)
+    import time
+    time.sleep(0.1)
+    dead = mgr.expire_dead()
+    assert [p.executor_id for p in dead] == ["exec-x"]
+    assert mgr.live_peers() == []
+    with pytest.raises(KeyError):
+        mgr.heartbeat("exec-x")
+
+
+def test_unregister_invalidates_server_cache(store):
+    sid, expect = fill_shuffle(store, n_blocks=1, reduce_ids=(0,))
+    transport = TcpTransport(RapidsConf())
+    try:
+        client = transport.make_client(("127.0.0.1", transport.port))
+        got = collect(client, sid, 0)
+        assert got.num_rows == expect[0].num_rows
+        assert (sid, 0) in transport.server._frame_cache
+        store.unregister_shuffle(sid)
+        assert (sid, 0) not in transport.server._frame_cache
+    finally:
+        transport.shutdown()
