@@ -71,6 +71,18 @@ class CaseWhen(Expression):
         ev = children[2 * n] if self.else_value is not None else None
         return CaseWhen(branches, ev)
 
+    # pyspark Column chaining: F.when(p, v).when(p2, v2).otherwise(e)
+    def when(self, cond, value) -> "CaseWhen":
+        from spark_rapids_tpu.expr.core import _auto_lit, Expression
+        c = cond if isinstance(cond, Expression) else _auto_lit(cond)
+        v = value if isinstance(value, Expression) else _auto_lit(value)
+        return CaseWhen(self.branches + [(c, v)], self.else_value)
+
+    def otherwise(self, value) -> "CaseWhen":
+        from spark_rapids_tpu.expr.core import _auto_lit, Expression
+        v = value if isinstance(value, Expression) else _auto_lit(value)
+        return CaseWhen(self.branches, v)
+
     def eval(self, ctx):
         # fold right-to-left into nested Ifs — identical semantics, shares code
         from spark_rapids_tpu.expr.core import Literal

@@ -126,6 +126,115 @@ class Expression:
     def name(self):
         return str(self)
 
+    # -- pyspark-Column-style operator sugar (used by the session API) -------
+    # NB: __eq__/__ne__ build expressions (like pyspark Column); identity
+    # hashing keeps expressions usable in sets/dicts, but `x in list_of_exprs`
+    # must not be used for structural equality anywhere in the engine.
+    def _bin(self, other, cls, swap=False):
+        o = other if isinstance(other, Expression) else _auto_lit(other)
+        return cls(o, self) if swap else cls(self, o)
+
+    def __add__(self, other):
+        from spark_rapids_tpu.expr.arithmetic import Add
+        return self._bin(other, Add)
+
+    def __radd__(self, other):
+        from spark_rapids_tpu.expr.arithmetic import Add
+        return self._bin(other, Add, swap=True)
+
+    def __sub__(self, other):
+        from spark_rapids_tpu.expr.arithmetic import Subtract
+        return self._bin(other, Subtract)
+
+    def __rsub__(self, other):
+        from spark_rapids_tpu.expr.arithmetic import Subtract
+        return self._bin(other, Subtract, swap=True)
+
+    def __mul__(self, other):
+        from spark_rapids_tpu.expr.arithmetic import Multiply
+        return self._bin(other, Multiply)
+
+    def __rmul__(self, other):
+        from spark_rapids_tpu.expr.arithmetic import Multiply
+        return self._bin(other, Multiply, swap=True)
+
+    def __truediv__(self, other):
+        from spark_rapids_tpu.expr.arithmetic import Divide
+        return self._bin(other, Divide)
+
+    def __rtruediv__(self, other):
+        from spark_rapids_tpu.expr.arithmetic import Divide
+        return self._bin(other, Divide, swap=True)
+
+    def __mod__(self, other):
+        from spark_rapids_tpu.expr.arithmetic import Remainder
+        return self._bin(other, Remainder)
+
+    def __rmod__(self, other):
+        from spark_rapids_tpu.expr.arithmetic import Remainder
+        return self._bin(other, Remainder, swap=True)
+
+    def __neg__(self):
+        from spark_rapids_tpu.expr.arithmetic import UnaryMinus
+        return UnaryMinus(self)
+
+    def __gt__(self, other):
+        from spark_rapids_tpu.expr.predicates import GreaterThan
+        return self._bin(other, GreaterThan)
+
+    def __ge__(self, other):
+        from spark_rapids_tpu.expr.predicates import GreaterThanOrEqual
+        return self._bin(other, GreaterThanOrEqual)
+
+    def __lt__(self, other):
+        from spark_rapids_tpu.expr.predicates import LessThan
+        return self._bin(other, LessThan)
+
+    def __le__(self, other):
+        from spark_rapids_tpu.expr.predicates import LessThanOrEqual
+        return self._bin(other, LessThanOrEqual)
+
+    def __eq__(self, other):
+        from spark_rapids_tpu.expr.predicates import EqualTo
+        return self._bin(other, EqualTo)
+
+    def __ne__(self, other):
+        from spark_rapids_tpu.expr.predicates import NotEqual
+        return self._bin(other, NotEqual)
+
+    def __and__(self, other):
+        from spark_rapids_tpu.expr.predicates import And
+        return self._bin(other, And)
+
+    def __or__(self, other):
+        from spark_rapids_tpu.expr.predicates import Or
+        return self._bin(other, Or)
+
+    def __invert__(self):
+        from spark_rapids_tpu.expr.predicates import Not
+        return Not(self)
+
+    __hash__ = object.__hash__
+
+    def alias(self, name: str) -> "Alias":
+        return Alias(self, name)
+
+    def cast(self, to: T.DataType):
+        from spark_rapids_tpu.expr.cast import Cast
+        return Cast(self, to)
+
+    def is_null(self):
+        from spark_rapids_tpu.expr.nullexprs import IsNull
+        return IsNull(self)
+
+    def is_not_null(self):
+        from spark_rapids_tpu.expr.nullexprs import IsNotNull
+        return IsNotNull(self)
+
+
+def _auto_lit(v):
+    return Literal(v, _infer_literal_type(v))
+
 
 class EvalContext:
     """Holds the input columns (as Cols) for bound-reference lookup during eval, the

@@ -1,0 +1,196 @@
+"""Column-function builders — the pyspark.sql.functions facade.
+
+Reference analogy: users of the reference write pyspark `F.*` expressions and the
+plugin maps them to Gpu* implementations (GpuOverrides expression rules). Here
+the same surface builds this engine's expression tree directly."""
+
+from __future__ import annotations
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr import arithmetic as _A
+from spark_rapids_tpu.expr import conditional as _C
+from spark_rapids_tpu.expr import datetime as _DT
+from spark_rapids_tpu.expr import mathexprs as _M
+from spark_rapids_tpu.expr import nullexprs as _N
+from spark_rapids_tpu.expr import predicates as _P
+from spark_rapids_tpu.expr import strings as _S
+from spark_rapids_tpu.expr import aggregates as _AG
+from spark_rapids_tpu.expr import windows as _W
+from spark_rapids_tpu.expr.cast import Cast
+from spark_rapids_tpu.expr.core import Alias, Expression, col, lit  # noqa: F401
+
+
+def _e(c):
+    from spark_rapids_tpu.session import _to_expr
+    return _to_expr(c)
+
+
+# aggregates
+def sum(c):  # noqa: A001
+    return _AG.Sum(_e(c))
+
+
+def count(c=None):
+    return _AG.Count(None if c is None else _e(c))
+
+
+def min(c):  # noqa: A001
+    return _AG.Min(_e(c))
+
+
+def max(c):  # noqa: A001
+    return _AG.Max(_e(c))
+
+
+def avg(c):
+    return _AG.Average(_e(c))
+
+
+mean = avg
+
+
+def first(c, ignore_nulls: bool = False):
+    return _AG.First(_e(c), ignore_nulls)
+
+
+# null / conditional
+def coalesce(*cs):
+    return _N.Coalesce([_e(c) for c in cs])
+
+
+def isnull(c):
+    return _N.IsNull(_e(c))
+
+
+def isnan(c):
+    return _N.IsNaN(_e(c))
+
+
+def _v(value):
+    """Value position: non-expressions are literals (pyspark convention — only
+    the first argument of col-flavored helpers treats strings as columns)."""
+    from spark_rapids_tpu.expr.core import _auto_lit
+    return value if isinstance(value, Expression) else _auto_lit(value)
+
+
+def when(cond, value):
+    return _C.CaseWhen([(_e(cond), _v(value))])
+
+
+def if_(cond, a, b):
+    return _C.If(_e(cond), _v(a), _v(b))
+
+
+def cast(c, to: T.DataType):
+    return Cast(_e(c), to)
+
+
+# strings
+def upper(c):
+    return _S.Upper(_e(c))
+
+
+def lower(c):
+    return _S.Lower(_e(c))
+
+
+def length(c):
+    return _S.Length(_e(c))
+
+
+def trim(c):
+    return _S.Trim(_e(c))
+
+
+def substring(c, pos, length_):
+    return _S.Substring(_e(c), _e(pos), _e(length_))
+
+
+def concat(*cs):
+    return _S.Concat([_e(c) for c in cs])
+
+
+def like(c, pattern: str):
+    return _S.Like(_e(c), lit(pattern))
+
+
+# math
+def sqrt(c):
+    return _M.Sqrt(_e(c))
+
+
+def pow(a, b):  # noqa: A001
+    return _M.Pow(_e(a), _e(b))
+
+
+def round(c, scale: int = 0):  # noqa: A001
+    return _M.Round(_e(c), scale)
+
+
+def floor(c):
+    return _M.Floor(_e(c))
+
+
+def ceil(c):
+    return _M.Ceil(_e(c))
+
+
+def abs(c):  # noqa: A001
+    return _A.Abs(_e(c))
+
+
+def pmod(a, b):
+    return _A.Pmod(_e(a), _e(b))
+
+
+# datetime
+def year(c):
+    return _DT.Year(_e(c))
+
+
+def month(c):
+    return _DT.Month(_e(c))
+
+
+def dayofmonth(c):
+    return _DT.DayOfMonth(_e(c))
+
+
+# windows
+def row_number():
+    return _W.RowNumber()
+
+
+def rank():
+    return _W.Rank()
+
+
+def dense_rank():
+    return _W.DenseRank()
+
+
+def lead(c, offset: int = 1, default=None):
+    return _W.Lead(_e(c), offset, default)
+
+
+def lag(c, offset: int = 1, default=None):
+    return _W.Lag(_e(c), offset, default)
+
+
+def over(func, partition_by=(), order_by=(), frame=None):
+    """Build func OVER (PARTITION BY ... ORDER BY ...). order_by items are
+    expressions (asc, nulls-first) or (expr, ascending, nulls_first) tuples."""
+    orders = []
+    for o in order_by:
+        if isinstance(o, tuple):
+            e, asc, nf = o
+            orders.append((_e(e), asc, nf))
+        else:
+            orders.append((_e(o), True, True))
+    spec = _W.WindowSpec(tuple(_e(p) for p in partition_by), tuple(orders),
+                         frame or _W.DEFAULT_FRAME)
+    return _W.WindowExpression(func, spec)
+
+
+def alias(e, name: str):
+    return Alias(_e(e), name)

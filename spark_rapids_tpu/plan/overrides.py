@@ -238,8 +238,14 @@ def _register_all():
         return XB.FilterExec(meta.node.condition, kids[0], conf=meta.conf)
 
     def conv_limit(meta, kids):
-        cls = XB.GlobalLimitExec if meta.node.global_limit else XB.LocalLimitExec
-        return cls(meta.node.n, kids[0], conf=meta.conf)
+        n, child = meta.node.n, kids[0]
+        if not meta.node.global_limit:
+            return XB.LocalLimitExec(n, child, conf=meta.conf)
+        if child.num_partitions > 1:
+            # Spark plans LocalLimit → single-partition exchange → GlobalLimit
+            child = XS._GatherAllExec(
+                XB.LocalLimitExec(n, child, conf=meta.conf), conf=meta.conf)
+        return XB.GlobalLimitExec(n, child, conf=meta.conf)
 
     def conv_union(meta, kids):
         return XB.UnionExec(*kids, conf=meta.conf)
@@ -366,10 +372,17 @@ def _register_all():
 
     exr(NN.SortNode, "device sort", conv_sort)
     exr(NN.ExchangeNode, "shuffle exchange", conv_exchange)
+    from spark_rapids_tpu.exec.expand import ExpandExec
+
+    def conv_expand(meta, kids):
+        n = meta.node
+        return ExpandExec(n.projections, n.output, kids[0], conf=meta.conf)
+
     exr(NN.WindowNode, "window via segmented scans", conv_window,
         tag_fn=tag_window)
-    # ExpandNode / GenerateNode get rules when their device execs land; until
-    # then they are tagged host-only and run via the interpreter.
+    exr(NN.ExpandNode, "interleaved multi-projection expand", conv_expand)
+    # GenerateNode (explode over array columns) stays host-only until device
+    # arrays land; the meta tags it and the interpreter runs it.
 
 
 _register_all()

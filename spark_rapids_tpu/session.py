@@ -1,0 +1,226 @@
+"""TpuSession + DataFrame — the user entry point.
+
+Reference analogy: the reference is a plugin inside Spark — users keep the Spark
+session/DataFrame API and the plugin rewrites plans underneath
+(Plugin.scala:45-70, SURVEY.md #1). This framework is standalone, so it ships the
+session facade itself: a DataFrame builds a CPU plan (plan/nodes.py); every
+action runs it through TpuOverrides and executes the hybrid plan, exactly the
+flow Spark would drive. `spark.rapids.tpu.*` conf keys keep their reference
+meanings (config.py).
+
+    from spark_rapids_tpu.session import TpuSession
+    import spark_rapids_tpu.functions as F
+
+    spark = TpuSession({"spark.rapids.tpu.sql.explain": "NONE"})
+    df = spark.read_parquet("/data/sales")
+    out = (df.filter(F.col("price") > 0)
+             .group_by("region").agg(F.sum("price").alias("total"))
+             .collect())
+"""
+
+from __future__ import annotations
+
+import typing
+
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.expr import core as E
+from spark_rapids_tpu.expr.aggregates import AggregateFunction
+from spark_rapids_tpu.plan import nodes as NN
+from spark_rapids_tpu.plan.overrides import TpuOverrides
+from spark_rapids_tpu.plan.transitions import execute_hybrid
+
+
+def _to_expr(c) -> E.Expression:
+    if isinstance(c, E.Expression):
+        return c
+    if isinstance(c, str):
+        return E.col(c)
+    return E.lit(c)
+
+
+class DataFrame:
+    def __init__(self, plan: NN.PlanNode, session: "TpuSession"):
+        self._plan = plan
+        self.session = session
+
+    # -- transformations (lazy: build plan nodes) ----------------------------
+    def select(self, *cols) -> "DataFrame":
+        return DataFrame(NN.ProjectNode([_to_expr(c) for c in cols],
+                                        self._plan), self.session)
+
+    def with_column(self, name: str, expr) -> "DataFrame":
+        keep = [E.col(f.name) for f in self._plan.output
+                if f.name != name]
+        return DataFrame(NN.ProjectNode(
+            keep + [E.Alias(_to_expr(expr), name)], self._plan), self.session)
+
+    def filter(self, condition) -> "DataFrame":
+        return DataFrame(NN.FilterNode(_to_expr(condition), self._plan),
+                         self.session)
+
+    where = filter
+
+    def group_by(self, *keys) -> "GroupedData":
+        return GroupedData([_to_expr(k) for k in keys], self)
+
+    def agg(self, *aggs) -> "DataFrame":
+        return GroupedData([], self).agg(*aggs)
+
+    def join(self, other: "DataFrame", on=None, how: str = "inner",
+             condition=None) -> "DataFrame":
+        jt = {"left_outer": "left", "right_outer": "right",
+              "full_outer": "full", "outer": "full",
+              "left_semi": "leftsemi", "semi": "leftsemi",
+              "left_anti": "leftanti", "anti": "leftanti"}.get(how, how)
+        if on is None:
+            lk, rk = [], []
+        else:
+            names = [on] if isinstance(on, str) else list(on)
+            lk = [E.col(n) for n in names]
+            rk = [E.col(n) for n in names]
+        return DataFrame(NN.JoinNode(self._plan, other._plan, lk, rk, jt,
+                                     condition), self.session)
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(NN.UnionNode(self._plan, other._plan), self.session)
+
+    def sort(self, *cols, ascending=True) -> "DataFrame":
+        ascs = (ascending if isinstance(ascending, (list, tuple))
+                else [ascending] * len(cols))
+        # Spark default: nulls first when ascending, last when descending
+        sort_exprs = [(_to_expr(c), bool(a), bool(a))
+                      for c, a in zip(cols, ascs)]
+        return DataFrame(NN.SortNode(sort_exprs, self._plan), self.session)
+
+    order_by = sort
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(NN.LimitNode(n, self._plan, global_limit=True),
+                         self.session)
+
+    def repartition(self, n: int, *keys) -> "DataFrame":
+        if keys:
+            return DataFrame(NN.ExchangeNode(
+                self._plan, "hash", n, keys=[_to_expr(k) for k in keys]),
+                self.session)
+        return DataFrame(NN.ExchangeNode(self._plan, "roundrobin", n),
+                         self.session)
+
+    def window(self, window_exprs: list) -> "DataFrame":
+        return DataFrame(NN.WindowNode(window_exprs, self._plan), self.session)
+
+    # -- metadata ------------------------------------------------------------
+    @property
+    def schema(self) -> T.StructType:
+        return self._plan.output
+
+    @property
+    def columns(self) -> list:
+        return [f.name for f in self._plan.output]
+
+    def explain(self, all_nodes: bool = True) -> str:
+        from spark_rapids_tpu.plan.overrides import explain_plan
+        return explain_plan(self._plan, self.session.conf, all_nodes)
+
+    # -- actions -------------------------------------------------------------
+    def collect(self) -> pa.Table:
+        hybrid = TpuOverrides(self.session.conf).apply(self._plan)
+        return execute_hybrid(hybrid)
+
+    def collect_host(self) -> pa.Table:
+        """CPU-only execution (the withCpuSparkSession analog for tests)."""
+        return self._plan.collect_host()
+
+    def count(self) -> int:
+        from spark_rapids_tpu.expr.aggregates import Count
+        agg = NN.AggregateNode([], [E.Alias(Count(None), "count")], self._plan)
+        out = execute_hybrid(TpuOverrides(self.session.conf).apply(agg))
+        return out.column("count")[0].as_py()
+
+    def to_pandas(self):
+        return self.collect().to_pandas()
+
+    def write_parquet(self, path: str, partition_by=None, mode="error"):
+        return self._write(path, "parquet", partition_by, mode)
+
+    def write_orc(self, path: str, partition_by=None, mode="error"):
+        return self._write(path, "orc", partition_by, mode)
+
+    def write_csv(self, path: str, mode="error"):
+        return self._write(path, "csv", None, mode)
+
+    def _write(self, path, fmt, partition_by, mode):
+        from spark_rapids_tpu.io.writer import write_columnar
+        hybrid = TpuOverrides(self.session.conf).apply(self._plan)
+        return write_columnar(hybrid, path, fmt, partition_by=partition_by,
+                              mode=mode)
+
+
+class GroupedData:
+    def __init__(self, keys: list, df: DataFrame):
+        self.keys = keys
+        self.df = df
+
+    def agg(self, *aggs) -> DataFrame:
+        named = []
+        for i, a in enumerate(aggs):
+            e = _to_expr(a)
+            inner = e.child if isinstance(e, E.Alias) else e
+            assert isinstance(inner, AggregateFunction), \
+                f"agg() requires aggregate expressions, got {e!r}"
+            named.append(e)
+        return DataFrame(NN.AggregateNode(self.keys, named, self.df._plan),
+                         self.df.session)
+
+    def count(self) -> DataFrame:
+        from spark_rapids_tpu.expr.aggregates import Count
+        return self.agg(E.Alias(Count(None), "count"))
+
+
+class TpuSession:
+    """The SparkSession stand-in; owns the conf and the read API
+    (reference RapidsDriverPlugin/SQLExecPlugin wiring, Plugin.scala:45-70)."""
+
+    def __init__(self, conf: dict | RapidsConf | None = None):
+        self.conf = (conf if isinstance(conf, RapidsConf)
+                     else RapidsConf(conf or {}))
+
+    # -- data sources --------------------------------------------------------
+    def read_parquet(self, path, pushed_filter=None,
+                     files_per_partition: int = 1) -> DataFrame:
+        from spark_rapids_tpu.io.filescan import FileScanNode
+        return DataFrame(FileScanNode(path, "parquet",
+                                      pushed_filter=pushed_filter,
+                                      files_per_partition=files_per_partition),
+                         self)
+
+    def read_orc(self, path, **kw) -> DataFrame:
+        from spark_rapids_tpu.io.filescan import FileScanNode
+        return DataFrame(FileScanNode(path, "orc", **kw), self)
+
+    def read_csv(self, path, schema: T.StructType | None = None,
+                 header: bool = True, delimiter: str = ",") -> DataFrame:
+        from spark_rapids_tpu.io.filescan import FileScanNode
+        return DataFrame(FileScanNode(
+            path, "csv", schema=schema,
+            options={"header": header, "delimiter": delimiter,
+                     "schema": schema}), self)
+
+    def create_dataframe(self, data, num_partitions: int = 1) -> DataFrame:
+        """From a pyarrow table / pandas DataFrame / dict of columns."""
+        if not isinstance(data, pa.Table):
+            data = pa.table(data) if isinstance(data, dict) else \
+                pa.Table.from_pandas(data)
+        per = -(-data.num_rows // max(1, num_partitions))
+        parts = ([data.slice(i * per, per) for i in range(num_partitions)]
+                 if num_partitions > 1 else [data])
+        return DataFrame(NN.ScanNode(parts), self)
+
+    def range(self, start: int, end: int | None = None, step: int = 1,
+              num_slices: int = 1) -> DataFrame:
+        if end is None:
+            start, end = 0, start
+        return DataFrame(NN.RangeNode(start, end, step, num_slices), self)

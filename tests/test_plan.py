@@ -264,3 +264,36 @@ def test_host_semi_join_with_condition():
                  "leftsemi", condition=GreaterThan(col("v"), col("w")))
     out = j.collect_host()
     assert out["a"].to_pylist() == [5]
+
+
+def test_expand_exec_equivalence(mixed_table):
+    """Rollup-style expand: (i, b, grouping_id) projections interleave per row
+    (reference GpuExpandExec)."""
+    from spark_rapids_tpu.plan import ExpandNode
+    scan = ScanNode(split_table(mixed_table.select(["i", "b", "l"]), 2))
+    projections = [
+        [col("i"), col("b"), lit(0)],
+        [col("i"), lit(None, T.BOOLEAN), lit(1)],
+        [lit(None, T.INT), lit(None, T.BOOLEAN), lit(3)],
+    ]
+    out_fields = [T.StructField("i", T.INT, True),
+                  T.StructField("b", T.BOOLEAN, True),
+                  T.StructField("gid", T.INT, False)]
+    node = ExpandNode(projections, out_fields, scan)
+    hybrid = assert_tpu_and_host_equal(node)
+    assert isinstance(hybrid, TpuExec)
+    agg = AggregateNode([col("gid")], [Alias(Count(None), "n")], node)
+    assert_tpu_and_host_equal(agg)
+
+
+def test_expand_with_strings(mixed_table):
+    from spark_rapids_tpu.plan import ExpandNode
+    scan = ScanNode([mixed_table.select(["s", "i"])])
+    projections = [
+        [col("s"), lit(0)],
+        [lit("all", T.STRING), lit(1)],
+    ]
+    out_fields = [T.StructField("s", T.STRING, True),
+                  T.StructField("gid", T.INT, False)]
+    node = ExpandNode(projections, out_fields, scan)
+    assert_tpu_and_host_equal(node)
