@@ -247,6 +247,20 @@ UDF_COMPILER_ENABLED = conf("spark.rapids.tpu.sql.udfCompiler.enabled").doc(
     "Compile Python UDF bytecode into device expressions "
     "(reference udf-compiler translates Scala bytecode → Catalyst)").boolean_conf(True)
 
+CACHE_SERIALIZER = conf("spark.rapids.tpu.sql.cache.serializer").doc(
+    "DataFrame cache tier: 'device' (spillable HBM batches) or 'parquet' "
+    "(blob files; reference ParquetCachedBatchSerializer)").string_conf("device")
+
+OPTIMIZER_ENABLED = conf("spark.rapids.tpu.sql.optimizer.enabled").doc(
+    "Cost-based rejection of unprofitable device sections "
+    "(reference spark.rapids.sql.optimizer.enabled, CostBasedOptimizer.scala:52)"
+).boolean_conf(False)
+
+OPTIMIZER_MIN_ROWS = conf("spark.rapids.tpu.sql.optimizer.minRows").doc(
+    "Estimated row count below which a plan stays on the host when the "
+    "optimizer is enabled (transfer+launch overhead dominates tiny inputs)"
+).integer_conf(4096)
+
 OOM_DUMP_DIR = conf("spark.rapids.tpu.memory.hbm.oomDumpDir").doc(
     "Directory to write allocator state on device OOM "
     "(reference spark.rapids.memory.gpu.oomDumpDir)").string_conf(None)

@@ -1,0 +1,84 @@
+"""ML integration: zero-copy export of query output to JAX arrays.
+
+Reference (SURVEY.md #41): ColumnarRdd.scala:49 + InternalColumnarRddConverter
+export a DataFrame as RDD[cudf.Table] without copies so XGBoost4J-Spark trains
+directly on GPU data; GpuBringBackToHost gates the device→host hop. TPU analog:
+the query's device batches stay jax arrays — `columnar_partitions` hands them to
+ML code with no host round-trip, and `to_feature_matrix` builds the (n, d)
+design matrix ON DEVICE (cast + stack, one XLA program), the row-matrix
+conversion XGBoost needs."""
+
+from __future__ import annotations
+
+import typing
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.base import TaskContext, TpuExec
+from spark_rapids_tpu.expr.core import Col
+from spark_rapids_tpu.ops.concat import concat_batches
+from spark_rapids_tpu.plan.overrides import TpuOverrides
+from spark_rapids_tpu.plan.transitions import DeviceBridgeExec
+
+
+def _device_plan(df) -> TpuExec:
+    from spark_rapids_tpu.plan.transitions import to_device_plan
+    return to_device_plan(df._plan, df.session.conf)
+
+
+def columnar_partitions(df) -> typing.Iterator[ColumnarBatch]:
+    """Yield each partition's data as ONE device ColumnarBatch (the
+    RDD[cudf.Table] analog: no host materialization)."""
+    plan = _device_plan(df)
+    for split in range(plan.num_partitions):
+        with TaskContext():
+            batches = list(plan.execute_partition(split))
+        if batches:
+            yield concat_batches(batches)
+
+
+def to_feature_matrix(df, feature_cols: list, label_col: str | None = None,
+                      dtype=jnp.float32):
+    """Collect a DataFrame into a dense on-device design matrix.
+
+    Returns (X, y, mask): X is (n, d) `dtype`, y is (n,) or None, mask is (n,)
+    bool marking rows where every feature (and label) is non-null — ML callers
+    filter or weight by it (the reference leaves null handling to XGBoost).
+    Padding rows are trimmed using the synced row count."""
+    plan = _device_plan(df)
+    names = [f.name for f in plan.output]
+    fidx = [names.index(c) for c in feature_cols]
+    lidx = names.index(label_col) if label_col is not None else None
+
+    xs, ys, ms = [], [], []
+    for split in range(plan.num_partitions):
+        with TaskContext():
+            batches = list(plan.execute_partition(split))
+        if not batches:
+            continue
+        b = concat_batches(batches)
+        n = b.num_rows                      # sync once per partition
+        cols = [Col.from_vector(b.column(i)) for i in fidx]
+        for c in cols:
+            if isinstance(c.dtype, T.StringType):
+                raise TypeError("string feature columns need encoding before "
+                                "to_feature_matrix")
+        feat = jnp.stack([c.values.astype(dtype) for c in cols], axis=1)[:n]
+        valid = jnp.stack([c.validity for c in cols], axis=1).all(axis=1)[:n]
+        if lidx is not None:
+            lc = Col.from_vector(b.column(lidx))
+            ys.append(lc.values.astype(dtype)[:n])
+            valid = valid & lc.validity[:n]
+        xs.append(feat)
+        ms.append(valid)
+    if not xs:
+        d = len(feature_cols)
+        return (jnp.zeros((0, d), dtype),
+                jnp.zeros((0,), dtype) if label_col else None,
+                jnp.zeros((0,), bool))
+    X = jnp.concatenate(xs, axis=0)
+    y = jnp.concatenate(ys, axis=0) if ys else None
+    mask = jnp.concatenate(ms, axis=0)
+    return X, y, mask

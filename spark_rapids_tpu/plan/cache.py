@@ -1,0 +1,159 @@
+"""DataFrame caching — materialize-once plan nodes.
+
+Reference (SURVEY.md #42): ParquetCachedBatchSerializer caches dataframes as
+GPU-written parquet blobs with a CPU fallback path. Two tiers here, selected by
+conf `spark.rapids.tpu.sql.cache.serializer`:
+  - "device": partitions materialize as SpillableColumnarBatches in the spill
+    hierarchy (evictable HBM→host→disk) — the fast path;
+  - "parquet": partitions are written once as parquet blobs in a temp dir and
+    re-read on use — survives device memory pressure entirely, byte-compatible
+    with external readers (the reference's actual design)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import threading
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu.plan.nodes import PlanNode
+
+
+class CacheNode(PlanNode):
+    def __init__(self, child: PlanNode, serializer: str = "device",
+                 session=None):
+        super().__init__(child)
+        assert serializer in ("device", "parquet")
+        self.serializer = serializer
+        self.session = session
+        self._n_parts = child.num_partitions  # pinned: survives child mutation
+        self._lock = threading.Lock()
+        self._host_tables: list | None = None
+        self._device_batches: list | None = None
+        self._parquet_dir: str | None = None
+
+    @property
+    def output(self):
+        return self.child.output
+
+    @property
+    def num_partitions(self):
+        return self._n_parts
+
+    # -- materialization ----------------------------------------------------
+    def _materialize_host(self):
+        with self._lock:
+            if self._host_tables is None:
+                self._host_tables = [self.child.execute_host(i)
+                                     for i in range(self._n_parts)]
+        return self._host_tables
+
+    def materialize_device(self, conf):
+        """Run the DEVICE plan for the child once; cache per-partition results.
+        Returns the number of cached device partitions — the DEVICE plan's
+        partitioning (e.g. an aggregate's post-exchange layout), which may
+        differ from the host interpreter's (called by CachedScanExec)."""
+        from spark_rapids_tpu.exec.base import TaskContext
+        from spark_rapids_tpu.ops.concat import concat_batches
+        from spark_rapids_tpu.plan.transitions import to_device_plan
+        from spark_rapids_tpu.runtime import memory as mem
+        with self._lock:
+            if self.serializer == "parquet":
+                if self._parquet_dir is None:
+                    self._write_parquet(conf)
+                return len(os.listdir(self._parquet_dir))
+            if self._device_batches is not None:
+                return len(self._device_batches)
+            hybrid = to_device_plan(self.child, conf)
+            out = []
+            for split in range(hybrid.num_partitions):
+                with TaskContext():
+                    batches = list(hybrid.execute_partition(split))
+                if batches:
+                    out.append(mem.SpillableColumnarBatch(
+                        concat_batches(batches)))
+                else:
+                    out.append(None)
+            self._device_batches = out
+            return len(out)
+
+    def _write_parquet(self, conf):
+        from spark_rapids_tpu.exec.base import TaskContext
+        from spark_rapids_tpu.plan.transitions import to_device_plan
+        d = tempfile.mkdtemp(prefix="tpu-cache-")
+        hybrid = to_device_plan(self.child, conf)
+        for split in range(hybrid.num_partitions):
+            with TaskContext():
+                tables = [b.to_arrow()
+                          for b in hybrid.execute_partition(split)]
+            tbl = (pa.concat_tables(tables) if tables else self._empty())
+            pq.write_table(tbl, os.path.join(d, f"part-{split:05d}.parquet"))
+        self._parquet_dir = d
+
+    def read_partition(self, split: int):
+        """Device-side read of a cached partition."""
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+        if self.serializer == "parquet":
+            tbl = pq.read_table(
+                os.path.join(self._parquet_dir, f"part-{split:05d}.parquet"))
+            return ColumnarBatch.from_arrow(tbl, self.output)
+        sb = self._device_batches[split]
+        return None if sb is None else sb.get_batch()
+
+    def execute_host(self, split):
+        return self._materialize_host()[split]
+
+    def unpersist(self):
+        with self._lock:
+            if self._device_batches:
+                for sb in self._device_batches:
+                    if sb is not None:
+                        sb.close()
+            self._device_batches = None
+            self._host_tables = None
+            if self._parquet_dir:
+                shutil.rmtree(self._parquet_dir, ignore_errors=True)
+                self._parquet_dir = None
+
+    def name(self):
+        return f"Cache[{self.serializer}]"
+
+
+class CachedScanExec:
+    """Leaf device exec over a CacheNode (imports deferred to avoid plan↔exec
+    import cycles at module load)."""
+
+    def __new__(cls, node: CacheNode, conf=None):
+        from spark_rapids_tpu.exec.base import TpuExec, acquire_semaphore
+
+        class _Exec(TpuExec):
+            def __init__(self, node, conf):
+                super().__init__(conf=conf)
+                self.node = node
+
+            @property
+            def output(self):
+                return self.node.output
+
+            @property
+            def num_partitions(self):
+                # the DEVICE cache layout, not the host interpreter's; forces
+                # materialization at planning time (once)
+                return self.node.materialize_device(self.conf)
+
+            def execute_partition(self, split):
+                def it():
+                    self.node.materialize_device(self.conf)
+                    batch = self.node.read_partition(split)
+                    if batch is not None:
+                        acquire_semaphore(self.metrics)
+                        yield batch
+                return self.wrap_output(it())
+
+            def args_string(self):
+                return self.node.name()
+
+        return _Exec(node, conf)

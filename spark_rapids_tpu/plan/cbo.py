@@ -1,0 +1,94 @@
+"""Cost-based optimizer — reject unprofitable device sections.
+
+Reference (SURVEY.md #13): CostBasedOptimizer.scala:52 with CpuCostModel /
+GpuCostModel: after tagging, estimate each section's cost on both sides and keep
+it on the CPU when acceleration wouldn't pay. On TPU the dominant term for small
+inputs is H2D transfer + dispatch latency (tens of ms over the tunnel), so the
+model pins a meta subtree to the host when its estimated row count is below
+`spark.rapids.tpu.sql.optimizer.minRows` and no device-resident source feeds it."""
+
+from __future__ import annotations
+
+from spark_rapids_tpu import config as CFG
+from spark_rapids_tpu.plan import nodes as NN
+
+
+def estimate_rows(node, _memo: dict | None = None) -> int:
+    """Static cardinality estimate (the CpuCostModel's row-count term).
+    Memoized per optimize() pass — parquet estimates open footers."""
+    if _memo is None:
+        _memo = {}
+    key = id(node)
+    if key in _memo:
+        return _memo[key]
+    rows = _estimate_rows(node, _memo)
+    _memo[key] = rows
+    return rows
+
+
+def _estimate_rows(node, memo) -> int:
+    from spark_rapids_tpu.io.filescan import FileScanNode
+    from spark_rapids_tpu.plan.cache import CacheNode
+
+    def est(n):
+        return estimate_rows(n, memo)
+
+    if isinstance(node, NN.ScanNode):
+        return sum(t.num_rows for t in node.partitions)
+    if isinstance(node, FileScanNode):
+        total = 0
+        for part in node.partitions:
+            for p in part.paths:
+                try:
+                    if node.fmt == "parquet":
+                        import pyarrow.parquet as pq
+                        total += pq.ParquetFile(p).metadata.num_rows
+                    else:
+                        import os
+                        total += max(1, os.path.getsize(p) // 64)
+                except Exception:
+                    total += 1 << 20  # unknown: assume big (stay on device)
+        return total
+    if isinstance(node, NN.RangeNode):
+        return max(0, -(-(node.end - node.start) // node.step))
+    if isinstance(node, NN.FilterNode):
+        return max(1, est(node.child) // 2)   # selectivity 0.5
+    if isinstance(node, NN.AggregateNode):
+        return max(1, est(node.child) // 10)  # grouping factor
+    if isinstance(node, NN.JoinNode):
+        return max(est(node.left), est(node.right))
+    if isinstance(node, NN.LimitNode):
+        return min(node.n, est(node.child))
+    if isinstance(node, NN.UnionNode):
+        return sum(est(c) for c in node.children)
+    if isinstance(node, CacheNode):
+        return est(node.child)
+    if node.children:
+        return max(est(c) for c in node.children)
+    return 1 << 20
+
+
+def optimize(meta) -> None:
+    """Walk the tagged meta tree; pin small subtrees to the host (reference
+    CostBasedOptimizer.optimize, called between tagging and conversion)."""
+    conf = meta.conf
+    if not conf.get(CFG.OPTIMIZER_ENABLED):
+        return
+    min_rows = conf.get(CFG.OPTIMIZER_MIN_ROWS)
+    _optimize_meta(meta, min_rows, {})
+
+
+def _optimize_meta(meta, min_rows: int, memo: dict) -> None:
+    from spark_rapids_tpu.plan.cache import CacheNode
+    node = getattr(meta, "node", None)
+    if node is not None and meta.can_run_on_tpu:
+        # a cache may already hold device-materialized data; pinning it to the
+        # host would re-execute its child from scratch — never profitable
+        if not isinstance(node, CacheNode):
+            rows = estimate_rows(node, memo)
+            if rows < min_rows:
+                meta.will_not_work(
+                    f"cost model: ~{rows} rows < optimizer.minRows={min_rows};"
+                    " transfer+dispatch overhead exceeds device speedup")
+    for m in meta.child_metas:
+        _optimize_meta(m, min_rows, memo)

@@ -75,6 +75,14 @@ def eval_host(expr: E.Expression, tbl: pa.Table) -> HostCol:
     if isinstance(expr, E.Literal):
         return HostCol([expr.value] * n, expr.dtype)
 
+    if hasattr(expr, "eval_arrow"):  # PythonUDF: worker-pool arrow exchange
+        child_cols = [eval_host(c, tbl) for c in expr.children]
+        child_tbl = pa.Table.from_arrays(
+            [pa.array(c.data, T.to_arrow_type(c.dtype)) for c in child_cols],
+            names=[f"a{i}" for i in range(len(child_cols))])
+        out = expr.eval_arrow(child_tbl)
+        return HostCol.from_arrow(out, expr.dtype)
+
     kids = [eval_host(c, tbl) for c in getattr(expr, "children", [])]
     fn = _DISPATCH.get(type(expr))
     if fn is None:

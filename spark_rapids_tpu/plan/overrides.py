@@ -102,6 +102,8 @@ class TpuOverrides:
             return plan
         meta = wrap_plan_meta(plan, self.conf)
         meta.tag_for_tpu()
+        from spark_rapids_tpu.plan.cbo import optimize
+        optimize(meta)  # no-op unless spark.rapids.tpu.sql.optimizer.enabled
         explain = self.conf.explain
         if explain != "NONE":
             print(meta.explain(all_nodes=(explain == "ALL")))
@@ -112,6 +114,8 @@ def explain_plan(plan, conf: RapidsConf | None = None, all_nodes=True) -> str:
     conf = conf or RapidsConf()
     meta = wrap_plan_meta(plan, conf)
     meta.tag_for_tpu()
+    from spark_rapids_tpu.plan.cbo import optimize
+    optimize(meta)
     return meta.explain(all_nodes=all_nodes)
 
 
@@ -209,6 +213,24 @@ def _register_all():
     for cls in (AG.Sum, AG.Count, AG.Min, AG.Max, AG.Average, AG.First):
         ex(cls, "aggregate function", comm + TS.DECIMAL)
 
+    from spark_rapids_tpu.udf.python_runtime import PythonUDF
+
+    def tag_pyudf(meta):
+        # only projections route through ArrowEvalPythonExec; a UDF anywhere
+        # else (filter condition, sort key, join condition, agg input) has no
+        # device path and must pin its exec to the host
+        p = meta.parent
+        while p is not None and not hasattr(p, "node"):
+            p = p.parent
+        if p is None or not isinstance(p.node, NN.ProjectNode):
+            meta.will_not_work(
+                "python UDF outside a projection runs on the host "
+                "(device path exists only via ArrowEvalPythonExec)")
+
+    R.expr_rule(PythonUDF, ExprRule(
+        "python UDF via arrow worker exchange (GpuArrowEvalPythonExec analog)",
+        None, None, tag_pyudf))
+
     from spark_rapids_tpu.expr import windows as WX
     ex(WX.WindowExpression, "window expression", TS.ALL)
     for cls in (WX.RowNumber, WX.Rank, WX.DenseRank):
@@ -232,6 +254,15 @@ def _register_all():
         return XB.RangeExec(n.start, n.end, n.step, n.num_slices, conf=meta.conf)
 
     def conv_project(meta, kids):
+        from spark_rapids_tpu.udf.python_runtime import (ArrowEvalPythonExec,
+                                                         PythonUDF)
+        has_udf = any(e.collect(lambda x: isinstance(x, PythonUDF))
+                      for e in meta.node.project_list)
+        if has_udf:
+            # reference GpuArrowEvalPythonExec: udf projections run through the
+            # python worker exchange instead of a device kernel
+            return ArrowEvalPythonExec(meta.node.project_list, kids[0],
+                                       conf=meta.conf)
         return XB.ProjectExec(meta.node.project_list, kids[0], conf=meta.conf)
 
     def conv_filter(meta, kids):
@@ -381,6 +412,14 @@ def _register_all():
     exr(NN.WindowNode, "window via segmented scans", conv_window,
         tag_fn=tag_window)
     exr(NN.ExpandNode, "interleaved multi-projection expand", conv_expand)
+
+    from spark_rapids_tpu.plan.cache import CachedScanExec, CacheNode
+
+    def conv_cache(meta, kids):
+        # kids are ignored: the cache materializes its child itself, once
+        return CachedScanExec(meta.node, conf=meta.conf)
+
+    exr(CacheNode, "materialized dataframe cache", conv_cache)
     # GenerateNode (explode over array columns) stays host-only until device
     # arrays land; the meta tags it and the interpreter runs it.
 

@@ -101,6 +101,16 @@ def build_hybrid(meta):
     return node
 
 
+def to_device_plan(plan, conf) -> TpuExec:
+    """Apply the overrides and guarantee a device root (bridging a host root up
+    through DeviceBridgeExec) — shared by ML export and the cache."""
+    from spark_rapids_tpu.plan.overrides import TpuOverrides
+    hybrid = TpuOverrides(conf).apply(plan)
+    if not isinstance(hybrid, TpuExec):
+        hybrid = DeviceBridgeExec(hybrid, conf)
+    return hybrid
+
+
 def execute_hybrid(plan) -> pa.Table:
     """Collect a hybrid plan to a host arrow table regardless of where the root
     landed (test harness entry)."""

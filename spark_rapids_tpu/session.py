@@ -112,6 +112,21 @@ class DataFrame:
     def window(self, window_exprs: list) -> "DataFrame":
         return DataFrame(NN.WindowNode(window_exprs, self._plan), self.session)
 
+    def cache(self, serializer: str | None = None) -> "DataFrame":
+        """Materialize-once cache (reference ParquetCachedBatchSerializer /
+        the device spill-store cache; conf spark.rapids.tpu.sql.cache.serializer)."""
+        from spark_rapids_tpu import config as CFG
+        from spark_rapids_tpu.plan.cache import CacheNode
+        ser = serializer or self.session.conf.get(CFG.CACHE_SERIALIZER)
+        return DataFrame(CacheNode(self._plan, ser, self.session), self.session)
+
+    def unpersist(self) -> "DataFrame":
+        from spark_rapids_tpu.plan.cache import CacheNode
+        if isinstance(self._plan, CacheNode):
+            self._plan.unpersist()
+            return DataFrame(self._plan.child, self.session)
+        return self
+
     # -- metadata ------------------------------------------------------------
     @property
     def schema(self) -> T.StructType:

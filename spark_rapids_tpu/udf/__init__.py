@@ -1,0 +1,4 @@
+"""L7 UDF layer: bytecode compiler + Python worker runtime (SURVEY.md #38-40)."""
+
+from spark_rapids_tpu.udf.compiler import compile_udf, udf  # noqa: F401
+from spark_rapids_tpu.udf.python_runtime import PythonUDF  # noqa: F401

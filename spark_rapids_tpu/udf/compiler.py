@@ -1,0 +1,282 @@
+"""UDF compiler: Python bytecode → engine expression trees.
+
+Reference (SURVEY.md #38): the udf-compiler module JIT-translates Scala/Java
+bytecode into Catalyst expressions via javassist CFG extraction + abstract
+interpretation of JVM opcodes (CFG.scala:329, Instruction.scala:953,
+CatalystExpressionBuilder.scala:430). Same design against CPython bytecode: a
+symbolic stack machine interprets the instruction stream; conditional jumps fork
+execution and merge as If(cond, then, else); the result is a bound Expression
+that runs fused on the device instead of a per-row Python call.
+
+Coverage: arithmetic/comparison/boolean operators, constants, arguments,
+ternaries and nested conditionals, `and`/`or` short-circuits (CPython 3.12
+emits COPY + POP_JUMP + POP_TOP for these; the fork at the jump reconverges as
+If), math.* calls, abs(), str methods (upper/lower/strip), len(). Anything else
+returns None and the caller falls back to the Python-worker runtime (#40),
+exactly the compiled-else-fallback contract of the reference's Plugin.scala:28."""
+
+from __future__ import annotations
+
+import dis
+import math
+import types as pytypes
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr import arithmetic as A
+from spark_rapids_tpu.expr import conditional as C
+from spark_rapids_tpu.expr import mathexprs as M
+from spark_rapids_tpu.expr import predicates as P
+from spark_rapids_tpu.expr import strings as S
+from spark_rapids_tpu.expr.core import Expression, Literal, _infer_literal_type
+
+
+class _CannotCompile(Exception):
+    pass
+
+
+# BINARY_OP argument → expression class (CPython 3.12 oparg values)
+_BINOPS = {
+    "+": A.Add, "-": A.Subtract, "*": A.Multiply, "/": A.Divide,
+    "%": A.Remainder, "//": A.IntegralDivide, "**": M.Pow,
+}
+
+_CMPOPS = {
+    "<": P.LessThan, "<=": P.LessThanOrEqual, ">": P.GreaterThan,
+    ">=": P.GreaterThanOrEqual, "==": P.EqualTo, "!=": P.NotEqual,
+}
+
+_MATH_CALLS = {
+    ("math", "sqrt"): M.Sqrt, ("math", "exp"): M.Exp, ("math", "sin"): M.Sin,
+    ("math", "cos"): M.Cos, ("math", "tan"): M.Tan, ("math", "asin"): M.Asin,
+    ("math", "acos"): M.Acos, ("math", "atan"): M.Atan,
+    ("math", "log"): M.Log, ("math", "log2"): M.Log2,
+    ("math", "log10"): M.Log10, ("math", "log1p"): M.Log1p,
+    ("math", "floor"): M.Floor, ("math", "ceil"): M.Ceil,
+}
+
+_STR_METHODS = {
+    "upper": S.Upper, "lower": S.Lower, "strip": S.Trim, "lstrip": S.LTrim,
+    "rstrip": S.RTrim,
+}
+
+
+class _Marker:
+    """Non-expression stack values (modules, bound methods, NULL)."""
+
+    def __init__(self, kind, payload=None):
+        self.kind = kind
+        self.payload = payload
+
+
+def _lit(v) -> Expression:
+    if isinstance(v, Expression):
+        return v
+    return Literal(v, _infer_literal_type(v))
+
+
+class _Compiler:
+    def __init__(self, fn, arg_exprs):
+        self.fn = fn
+        code = fn.__code__
+        if code.co_argcount != len(arg_exprs):
+            raise _CannotCompile("arity mismatch")
+        if code.co_flags & 0x08 or code.co_flags & 0x04:  # *args / **kwargs
+            raise _CannotCompile("varargs not supported")
+        if fn.__closure__:
+            self.cells = {name: cell.cell_contents for name, cell in
+                          zip(code.co_freevars, fn.__closure__)}
+        else:
+            self.cells = {}
+        self.args = {code.co_varnames[i]: arg_exprs[i]
+                     for i in range(code.co_argcount)}
+        self.instrs = list(dis.get_instructions(fn))
+        self.by_offset = {ins.offset: i for i, ins in enumerate(self.instrs)}
+        self.globals = fn.__globals__
+
+    def run(self) -> Expression:
+        return self._exec(0, [])
+
+    def _exec(self, idx: int, stack: list, depth: int = 0) -> Expression:
+        """Symbolically execute from instruction idx; returns the expression
+        produced at RETURN. Forks at conditional jumps (bounded depth)."""
+        if depth > 40:
+            raise _CannotCompile("too many branches")
+        stack = list(stack)
+        while idx < len(self.instrs):
+            ins = self.instrs[idx]
+            op = ins.opname
+            if op in ("RESUME", "NOP", "CACHE", "PRECALL",
+                      "COPY_FREE_VARS", "MAKE_CELL"):
+                idx += 1
+            elif op == "LOAD_FAST":
+                if ins.argval not in self.args:
+                    raise _CannotCompile(f"unknown local {ins.argval}")
+                stack.append(self.args[ins.argval])
+                idx += 1
+            elif op == "LOAD_CONST":
+                stack.append(_lit(ins.argval) if not isinstance(
+                    ins.argval, (tuple, frozenset, pytypes.CodeType))
+                    else _Marker("const", ins.argval))
+                idx += 1
+            elif op == "LOAD_DEREF":
+                if ins.argval not in self.cells:
+                    raise _CannotCompile(f"unknown closure var {ins.argval}")
+                v = self.cells[ins.argval]
+                if not isinstance(v, (int, float, str, bool, type(None))):
+                    raise _CannotCompile("non-scalar closure capture")
+                stack.append(_lit(v))
+                idx += 1
+            elif op == "LOAD_GLOBAL":
+                name = ins.argval
+                import builtins
+                v = self.globals.get(name, getattr(builtins, name, None))
+                if v is math:
+                    stack.append(_Marker("module", "math"))
+                elif v is abs:
+                    stack.append(_Marker("builtin", "abs"))
+                elif v is len:
+                    stack.append(_Marker("builtin", "len"))
+                elif isinstance(v, (int, float, str, bool)):
+                    stack.append(_lit(v))
+                else:
+                    raise _CannotCompile(f"unsupported global {name}")
+                idx += 1
+            elif op == "LOAD_ATTR":
+                recv = stack.pop()
+                if isinstance(recv, _Marker) and recv.kind == "module":
+                    key = (recv.payload, ins.argval)
+                    if key not in _MATH_CALLS:
+                        raise _CannotCompile(f"unsupported call {key}")
+                    stack.append(_Marker("mathfn", _MATH_CALLS[key]))
+                elif isinstance(recv, Expression):
+                    # method load on a column (3.12 encodes method bit in arg)
+                    if ins.argval not in _STR_METHODS:
+                        raise _CannotCompile(
+                            f"unsupported method {ins.argval}")
+                    stack.append(_Marker("strmethod",
+                                         (_STR_METHODS[ins.argval], recv)))
+                else:
+                    raise _CannotCompile("bad LOAD_ATTR receiver")
+                idx += 1
+            elif op == "LOAD_METHOD":
+                recv = stack.pop()
+                if not isinstance(recv, Expression) or \
+                        ins.argval not in _STR_METHODS:
+                    raise _CannotCompile(f"unsupported method {ins.argval}")
+                stack.append(_Marker("strmethod",
+                                     (_STR_METHODS[ins.argval], recv)))
+                idx += 1
+            elif op == "CALL":
+                nargs = ins.arg
+                cargs = [stack.pop() for _ in range(nargs)][::-1]
+                callee = stack.pop()
+                if isinstance(callee, _Marker) and callee.kind == "null":
+                    callee = stack.pop()  # NULL | callable layout
+                stack.append(self._call(callee, cargs))
+                idx += 1
+            elif op == "PUSH_NULL":
+                stack.append(_Marker("null"))
+                idx += 1
+            elif op == "BINARY_OP":
+                r, l = stack.pop(), stack.pop()
+                sym = ins.argrepr.rstrip("=")
+                if sym not in _BINOPS:
+                    raise _CannotCompile(f"unsupported binop {ins.argrepr}")
+                stack.append(_BINOPS[sym](self._expr(l), self._expr(r)))
+                idx += 1
+            elif op == "COMPARE_OP":
+                r, l = stack.pop(), stack.pop()
+                sym = ins.argrepr.strip()
+                if sym not in _CMPOPS:
+                    raise _CannotCompile(f"unsupported compare {sym}")
+                stack.append(_CMPOPS[sym](self._expr(l), self._expr(r)))
+                idx += 1
+            elif op == "UNARY_NEGATIVE":
+                stack.append(A.UnaryMinus(self._expr(stack.pop())))
+                idx += 1
+            elif op == "UNARY_NOT":
+                stack.append(P.Not(self._expr(stack.pop())))
+                idx += 1
+            elif op in ("POP_JUMP_IF_FALSE", "POP_JUMP_IF_TRUE"):
+                cond = self._expr(stack.pop())
+                target = self.by_offset[ins.argval]
+                if op == "POP_JUMP_IF_TRUE":
+                    cond = P.Not(cond)
+                then_e = self._exec(idx + 1, stack, depth + 1)
+                else_e = self._exec(target, stack, depth + 1)
+                return C.If(cond, then_e, else_e)
+            elif op == "COPY":
+                stack.append(stack[-ins.arg])
+                idx += 1
+            elif op == "POP_TOP":
+                stack.pop()
+                idx += 1
+            elif op == "SWAP":
+                stack[-1], stack[-ins.arg] = stack[-ins.arg], stack[-1]
+                idx += 1
+            elif op in ("JUMP_FORWARD", "JUMP_BACKWARD"):
+                idx = self.by_offset[ins.argval]
+            elif op == "RETURN_VALUE":
+                return self._expr(stack.pop())
+            elif op == "RETURN_CONST":
+                return _lit(ins.argval)
+            else:
+                raise _CannotCompile(f"unsupported opcode {op}")
+        raise _CannotCompile("fell off the end")
+
+    def _expr(self, v) -> Expression:
+        if isinstance(v, Expression):
+            return v
+        raise _CannotCompile(f"expected expression, got {v}")
+
+    def _call(self, callee, cargs) -> Expression:
+        if isinstance(callee, _Marker) and callee.kind == "mathfn":
+            if len(cargs) == 1:
+                from spark_rapids_tpu.expr.cast import Cast
+                return callee.payload(Cast(self._expr(cargs[0]), T.DOUBLE))
+            if len(cargs) == 2 and callee.payload is M.Pow:
+                return M.Pow(self._expr(cargs[0]), self._expr(cargs[1]))
+            raise _CannotCompile("bad math arity")
+        if isinstance(callee, _Marker) and callee.kind == "builtin":
+            if callee.payload == "abs" and len(cargs) == 1:
+                return A.Abs(self._expr(cargs[0]))
+            if callee.payload == "len" and len(cargs) == 1:
+                return S.Length(self._expr(cargs[0]))
+            raise _CannotCompile(f"unsupported builtin {callee.payload}")
+        if isinstance(callee, _Marker) and callee.kind == "strmethod":
+            cls, recv = callee.payload
+            if cargs:
+                raise _CannotCompile("string method args not supported")
+            return cls(recv)
+        raise _CannotCompile("unsupported callee")
+
+
+def compile_udf(fn, arg_exprs: list) -> Expression | None:
+    """Compile `fn(args…)` into an Expression over `arg_exprs`, or None when the
+    bytecode uses unsupported features (caller falls back to the Python-worker
+    runtime)."""
+    try:
+        return _Compiler(fn, list(arg_exprs)).run()
+    except _CannotCompile:
+        return None
+
+
+def udf(fn, return_type: T.DataType | None = None):
+    """Decorator/factory: `udf(lambda x: x * 2)(F.col('a'))` — compiled to a
+    device expression when possible, else a PythonUDF running in worker
+    processes (reference GpuScalaUDF + fallback, SURVEY.md #38/#39)."""
+
+    def build(*cols):
+        from spark_rapids_tpu.session import _to_expr
+        args = [_to_expr(c) for c in cols]
+        compiled = compile_udf(fn, args)
+        if compiled is not None:
+            return compiled
+        from spark_rapids_tpu.udf.python_runtime import PythonUDF
+        if return_type is None:
+            raise ValueError(
+                "UDF could not be compiled to device expressions; the Python "
+                "worker fallback needs an explicit return_type")
+        return PythonUDF(fn, args, return_type)
+
+    return build
