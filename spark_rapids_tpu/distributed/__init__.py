@@ -1,0 +1,8 @@
+"""Distributed execution over a device mesh (SURVEY.md §5 comm backend).
+
+The reference's distributed story is Spark tasks + the UCX shuffle; ours is
+two-tier: the TCP transport (shuffle/transport.py) for cross-host DCN, and THIS
+package for intra-slice execution — whole query stages jitted over a
+jax.sharding.Mesh with XLA collectives (all_to_all) riding ICI."""
+
+from spark_rapids_tpu.distributed.mesh import MeshExecutor  # noqa: F401

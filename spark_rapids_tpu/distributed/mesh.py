@@ -1,0 +1,309 @@
+"""MeshExecutor — SPMD aggregate execution over a TPU mesh in ONE jit.
+
+Reference analogy: a Spark stage = map tasks → UCX shuffle → reduce tasks
+(RapidsShuffleInternalManagerBase + GpuShuffleExchangeExec). On a TPU slice the
+idiomatic equivalent is a single compiled SPMD program: every chip holds one
+data shard; the "shuffle" is an XLA all_to_all over ICI inside the same program
+(no host hops, no per-block RPC). This module generalizes
+__graft_entry__.dryrun_multichip into a product executor:
+
+    shard-local: filter → project keys/values → sort-based partial aggregate
+    exchange:    hash-partition partial rows → lax.all_to_all over axis "data"
+    shard-local: merge-aggregate received partials → evaluate finals
+
+Strings participate via a mesh-global dictionary built on host at ingest (codes
+are ints on device). The exchange hash is mesh-internal (chained murmur3 over
+key carriers) — it only balances partials, it is NOT the Spark-compatible
+partitioning (that lives in shuffle/partitioning.py for the Spark shuffle path).
+
+Scaling note: per-shard capacity is static, so compile once and stream any
+number of row-chunks through; DCN-spanning jobs compose this with the TCP
+transport between slices (SURVEY.md §5 distributed backend mapping)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.vector import bucket_capacity
+from spark_rapids_tpu.expr.core import Alias, Col, EvalContext, bind_references
+from spark_rapids_tpu.expr.aggregates import AggregateFunction
+from spark_rapids_tpu.ops import grouping as G
+from spark_rapids_tpu.ops import hashing as H
+from spark_rapids_tpu.ops.filtering import compact_cols, gather_cols, selection_mask
+
+
+def _unalias(e):
+    return e.child if isinstance(e, Alias) else e
+
+
+def _mesh_hash(cols, capacity: int):
+    """Deterministic per-row hash for the internal exchange (chained murmur3
+    over value carriers; string codes hash as ints — mesh-internal only)."""
+    h = jnp.full((capacity,), jnp.int32(42))
+    for c in cols:
+        if c.values.dtype == jnp.int64:
+            nh = H.hash_long(c.values, h)
+        elif c.values.dtype == jnp.float64:
+            nh = H.hash_double(c.values, h)
+        else:
+            nh = H.hash_int(c.values.astype(jnp.int32), h)
+        h = jnp.where(c.validity, nh, h)
+    return h
+
+
+class MeshExecutor:
+    """Compile + run grouped aggregation across an n-device mesh."""
+
+    def __init__(self, n_devices: int | None = None, devices=None):
+        devs = (list(devices) if devices is not None
+                else jax.devices()[:n_devices or len(jax.devices())])
+        self.n = len(devs)
+        self.mesh = Mesh(np.array(devs), ("data",))
+
+    # -- host-side ingest ----------------------------------------------------
+    def _encode_shards(self, tables, schema: T.StructType):
+        """Pad each shard to one capacity; strings get a mesh-global dictionary."""
+        import pyarrow as pa
+        from spark_rapids_tpu.columnar.arrow import table_to_device
+        cap = bucket_capacity(max((t.num_rows for t in tables), default=1))
+        global_dicts = {}
+        for i, f in enumerate(schema):
+            if isinstance(f.data_type, T.StringType):
+                union = pa.concat_arrays(
+                    [t.column(i).combine_chunks().cast(pa.string()).unique()
+                     for t in tables]).unique().sort()
+                global_dicts[i] = union
+        shards = []
+        for t in tables:
+            batch = table_to_device(t, schema=schema)
+            cols = []
+            for i, cv in enumerate(batch.columns):
+                c = Col.from_vector(cv)
+                if i in global_dicts and c.dictionary is not None:
+                    remap = {v: j for j, v in
+                             enumerate(global_dicts[i].to_pylist())}
+                    m = np.array([remap[v] for v in
+                                  c.dictionary.to_pylist()] or [0], np.int32)
+                    c = Col(jnp.asarray(m)[c.values], c.validity, c.dtype,
+                            global_dicts[i])
+                cols.append(c)
+            # re-pad to the common mesh capacity
+            from spark_rapids_tpu.ops.filtering import slice_to_capacity
+            cols = slice_to_capacity(cols, t.num_rows, cap)
+            shards.append((cols, t.num_rows))
+        while len(shards) < self.n:  # fewer shards than chips: empty pads
+            cols = [Col(jnp.full((cap,), f.data_type.default_value(),
+                                 dtype=f.data_type.jnp_dtype),
+                        jnp.zeros((cap,), jnp.bool_), f.data_type,
+                        global_dicts.get(i))
+                    for i, f in enumerate(schema)]
+            shards.append((cols, 0))
+        return shards[:self.n], cap, global_dicts
+
+    # -- the SPMD program ----------------------------------------------------
+    def _build_step(self, schema, group_exprs, agg_exprs, filter_expr, cap):
+        n_dev = self.n
+        group_b = [bind_references(e, schema) for e in group_exprs]
+        aggs = [(_unalias(bind_references(e, schema))) for e in agg_exprs]
+        assert all(isinstance(a, AggregateFunction) for a in aggs)
+        filt_b = (bind_references(filter_expr, schema)
+                  if filter_expr is not None else None)
+        state_counts = [len(a.state_types) for a in aggs]
+
+        def local_partial(cols, n_rows):
+            ctx = EvalContext(cols, n_rows, cap)
+            if filt_b is not None:
+                pred = filt_b.eval(ctx)
+                keep = selection_mask(pred, n_rows, cap)
+                cols, n_rows = compact_cols(cols, keep)
+                ctx = EvalContext(cols, n_rows, cap)
+            keys = [e.eval(ctx) for e in group_b]
+            perm, seg_ids, boundary, live = G.group_segments(keys, n_rows, cap)
+            skeys = gather_cols(keys, perm, live)
+            states = []
+            for a in aggs:
+                in_col = (gather_cols([a.child.eval(ctx)], perm, live)[0]
+                          if a.children else
+                          Col(jnp.zeros((cap,), jnp.int8), live, T.NULL))
+                sts = a.update(in_col, seg_ids, cap)
+                per_row = [Col(s.values[seg_ids], s.validity[seg_ids], s.dtype,
+                               s.dictionary) for s in sts]
+                states.extend(per_row)
+            out, n_groups = compact_cols(skeys + states, boundary)
+            return out, n_groups
+
+        def shard_step(*flat):
+            nk = len(group_b)
+            n_state = sum(state_counts)
+            n_cols = len(schema.fields)
+            vals = flat[:n_cols]
+            vlds = flat[n_cols:2 * n_cols]
+            n_rows = flat[2 * n_cols][0]
+            cols = [Col(v[0], m[0], f.data_type)
+                    for v, m, f in zip(vals, vlds, schema.fields)]
+
+            partial, n_groups = local_partial(cols, n_rows)
+
+            # exchange: hash-partition partial rows over the mesh
+            pids = H.pmod(_mesh_hash(partial[:nk], cap), n_dev)
+            live = jnp.arange(cap, dtype=jnp.int32) < n_groups
+            sends_v, sends_m, sends_n = [], [], []
+            for p in range(n_dev):
+                mask = live & (pids == p)
+                pc, pn = compact_cols(partial, mask)
+                sends_v.append([c.values for c in pc])
+                sends_m.append([c.validity for c in pc])
+                sends_n.append(pn)
+            ncols_p = nk + n_state
+            stacked_v = [jnp.stack([sends_v[p][c] for p in range(n_dev)])
+                         for c in range(ncols_p)]
+            stacked_m = [jnp.stack([sends_m[p][c] for p in range(n_dev)])
+                         for c in range(ncols_p)]
+            sn = jnp.stack(sends_n)
+            recv_v = [jax.lax.all_to_all(a, "data", 0, 0) for a in stacked_v]
+            recv_m = [jax.lax.all_to_all(a, "data", 0, 0) for a in stacked_m]
+            rn = jax.lax.all_to_all(sn, "data", 0, 0)
+
+            # merge received partials
+            mcap = n_dev * cap
+            slot = jnp.arange(mcap, dtype=jnp.int32) % cap
+            rlive = slot < jnp.repeat(rn, cap)
+            rcols = []
+            src = partial  # dtype templates
+            for c in range(ncols_p):
+                v = recv_v[c].reshape(mcap)
+                m = recv_m[c].reshape(mcap) & rlive
+                proto = src[c]
+                default = jnp.asarray(proto.dtype.default_value(),
+                                      dtype=v.dtype)
+                rcols.append(Col(jnp.where(m, v, default), m, proto.dtype,
+                                 proto.dictionary))
+            # key validity defines row presence only together with rlive;
+            # null-keyed rows are real rows — track presence separately
+            present = rlive
+            (packed, m_rows) = compact_cols(
+                rcols + [Col(jnp.zeros((mcap,), jnp.int8), present, T.NULL)],
+                present)
+            packed = packed[:-1]
+            keys2 = packed[:nk]
+            perm, seg_ids, boundary, live2 = G.group_segments(
+                keys2, m_rows, mcap)
+            skeys2 = gather_cols(keys2, perm, live2)
+            out_states = []
+            si = nk
+            for a, nst in zip(aggs, state_counts):
+                sts = gather_cols(packed[si:si + nst], perm, live2)
+                merged = a.merge(sts, seg_ids, mcap)
+                out_states.extend(
+                    Col(s.values[seg_ids], s.validity[seg_ids], s.dtype,
+                        s.dictionary) for s in merged)
+                si += nst
+            out, out_groups = compact_cols(skeys2 + out_states, boundary)
+
+            # finals
+            finals = out[:nk]
+            si = nk
+            for a, nst in zip(aggs, state_counts):
+                finals.append(a.evaluate(out[si:si + nst]))
+                si += nst
+            ret_v = tuple(c.values[None] for c in finals)
+            ret_m = tuple(c.validity[None] for c in finals)
+            return ret_v + ret_m + (out_groups[None],)
+
+        spec2 = P("data", None)
+        n_out = len(group_b) + len(aggs)
+        shard_map = getattr(jax, "shard_map", None)
+        if shard_map is None:
+            from jax.experimental.shard_map import shard_map
+        n_in = len(schema.fields)
+        step = jax.jit(shard_map(
+            shard_step, mesh=self.mesh,
+            in_specs=tuple([spec2] * (2 * n_in) + [P("data")]),
+            out_specs=tuple([spec2] * (2 * n_out) + [P("data")])))
+        return step
+
+    # -- public API ----------------------------------------------------------
+    def aggregate(self, tables: list, group_exprs: list, agg_exprs: list,
+                  filter_expr=None, schema: T.StructType | None = None):
+        """tables: one pyarrow Table per shard (≤ n_devices). Returns one
+        pyarrow Table of grouped results."""
+        import pyarrow as pa
+        if schema is None:
+            schema = T.StructType.from_arrow(tables[0].schema)
+        shards, cap, _dicts = self._encode_shards(tables, schema)
+        step = self._build_step(schema, group_exprs, agg_exprs, filter_expr,
+                                cap)
+
+        sharding = NamedSharding(self.mesh, P("data", None))
+        n_in = len(schema.fields)
+        vals, masks = [], []
+        for ci in range(n_in):
+            vals.append(jax.device_put(
+                jnp.stack([s[0][ci].values for s in shards]), sharding))
+            masks.append(jax.device_put(
+                jnp.stack([s[0][ci].validity for s in shards]), sharding))
+        nrows = jax.device_put(
+            jnp.asarray([s[1] for s in shards], jnp.int32),
+            NamedSharding(self.mesh, P("data")))
+        out = step(*vals, *masks, nrows)
+
+        group_b = [bind_references(e, schema) for e in group_exprs]
+        aggs = [_unalias(bind_references(e, schema)) for e in agg_exprs]
+        n_out = len(group_b) + len(aggs)
+        out_v, out_m, groups = out[:n_out], out[n_out:2 * n_out], out[-1]
+        counts = np.asarray(groups)
+
+        names = []
+        dtypes = []
+        for i, e in enumerate(group_exprs):
+            names.append(e.name if isinstance(e, Alias) else
+                         getattr(e, "name", f"k{i}"))
+            dtypes.append(group_b[i].dtype)
+        for i, e in enumerate(agg_exprs):
+            names.append(e.name if isinstance(e, Alias) else f"agg{i}")
+            dtypes.append(aggs[i].dtype)
+
+        # keep per-key dictionaries for decode
+        key_dicts = [shards[0][0][_key_ordinal(group_b[i], schema)].dictionary
+                     if isinstance(dtypes[i], T.StringType) else None
+                     for i in range(len(group_b))] + [None] * len(aggs)
+
+        rows = {n: [] for n in names}
+        for d in range(len(counts)):
+            n_g = int(counts[d])
+            if n_g == 0:
+                continue
+            for ci, name in enumerate(names):
+                v = np.asarray(out_v[ci][d][:n_g])
+                m = np.asarray(out_m[ci][d][:n_g])
+                dt = dtypes[ci]
+                for j in range(n_g):
+                    if not m[j]:
+                        rows[name].append(None)
+                    elif key_dicts[ci] is not None:
+                        rows[name].append(
+                            key_dicts[ci][int(v[j])].as_py())
+                    else:
+                        rows[name].append(_pyval(v[j], dt))
+        return pa.table({n: pa.array(rows[n], T.to_arrow_type(dt))
+                         for n, dt in zip(names, dtypes)})
+
+
+def _key_ordinal(expr, schema) -> int:
+    from spark_rapids_tpu.expr.core import BoundReference
+    if isinstance(expr, BoundReference):
+        return expr.ordinal
+    return 0
+
+
+def _pyval(v, dt: T.DataType):
+    if isinstance(dt, T.BooleanType):
+        return bool(v)
+    if isinstance(dt, (T.FloatType, T.DoubleType)):
+        return float(v)
+    return int(v)

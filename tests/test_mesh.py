@@ -1,0 +1,103 @@
+"""Mesh executor tests over the virtual 8-device CPU mesh (SURVEY.md §5 — the
+ICI intra-slice exchange path the driver also dry-runs via __graft_entry__)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from conftest import make_table
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.distributed import MeshExecutor
+from spark_rapids_tpu.expr.core import Alias, col
+from spark_rapids_tpu.expr.aggregates import Average, Count, Max, Min, Sum
+
+
+def shards_of(tbl, n):
+    per = -(-tbl.num_rows // n)
+    return [tbl.slice(i * per, per) for i in range(n)]
+
+
+def host_groupby(tbl, key, val_specs):
+    import collections
+    import math
+    groups = collections.defaultdict(list)
+    keys = tbl.column(key).to_pylist()
+    for i, k in enumerate(keys):
+        groups[k].append(i)
+    out = {}
+    for k, idxs in groups.items():
+        out[k] = idxs
+    return out
+
+
+def test_mesh_aggregate_matches_host():
+    r = np.random.default_rng(3)
+    n = 4000
+    t = pa.table({
+        "k": pa.array([None if i % 31 == 0 else int(v) for i, v in
+                       enumerate(r.integers(0, 25, n))], pa.int64()),
+        "v": pa.array([None if i % 13 == 0 else float(v) for i, v in
+                       enumerate(r.normal(0, 10, n))], pa.float64()),
+    })
+    ex = MeshExecutor(8)
+    out = ex.aggregate(
+        shards_of(t, 8), [col("k")],
+        [Alias(Sum(col("v")), "s"), Alias(Count(col("v")), "c"),
+         Alias(Min(col("v")), "mn"), Alias(Max(col("v")), "mx"),
+         Alias(Average(col("v")), "avg")])
+    # host oracle via the single-process plan layer
+    from spark_rapids_tpu.plan import AggregateNode, ScanNode
+    want = AggregateNode(
+        [col("k")],
+        [Alias(Sum(col("v")), "s"), Alias(Count(col("v")), "c"),
+         Alias(Min(col("v")), "mn"), Alias(Max(col("v")), "mx"),
+         Alias(Average(col("v")), "avg")],
+        ScanNode([t])).collect_host()
+    got = {r_["k"]: r_ for r_ in out.to_pylist()}
+    exp = {r_["k"]: r_ for r_ in want.to_pylist()}
+    assert set(got) == set(exp)
+    for k in exp:
+        for f in ("c", "mn", "mx"):
+            assert got[k][f] == exp[k][f], (k, f, got[k], exp[k])
+        for f in ("s", "avg"):
+            a, b = got[k][f], exp[k][f]
+            assert (a is None) == (b is None)
+            if a is not None:
+                assert a == pytest.approx(b, rel=1e-9)
+
+
+def test_mesh_aggregate_with_filter_and_string_keys():
+    r = np.random.default_rng(9)
+    n = 2000
+    words = ["alpha", "beta", "gamma", "delta", None]
+    t = pa.table({
+        "g": pa.array([words[int(v) % 5] for v in r.integers(0, 1000, n)]),
+        "x": pa.array([int(v) for v in r.integers(-50, 50, n)], pa.int64()),
+    })
+    ex = MeshExecutor(8)
+    out = ex.aggregate(
+        shards_of(t, 5),  # fewer shards than chips: pads empties
+        [col("g")],
+        [Alias(Sum(col("x")), "s"), Alias(Count(None), "n")],
+        filter_expr=col("x") > F.lit(0))
+    from spark_rapids_tpu.plan import AggregateNode, FilterNode, ScanNode
+    want = AggregateNode(
+        [col("g")], [Alias(Sum(col("x")), "s"), Alias(Count(None), "n")],
+        FilterNode(col("x") > F.lit(0), ScanNode([t]))).collect_host()
+    got = sorted(out.to_pylist(), key=lambda d: (d["g"] is None, d["g"] or ""))
+    exp = sorted(want.to_pylist(), key=lambda d: (d["g"] is None, d["g"] or ""))
+    assert got == exp
+
+
+def test_mesh_partials_actually_exchange():
+    """Every key appears on every shard → without the all_to_all merge the
+    result would have n_dev copies of each group."""
+    t = pa.table({"k": pa.array([1, 2] * 64, pa.int64()),
+                  "v": pa.array([1.0] * 128)})
+    ex = MeshExecutor(8)
+    out = ex.aggregate(shards_of(t, 8), [col("k")],
+                       [Alias(Count(None), "n")])
+    assert sorted(out.to_pylist(), key=lambda d: d["k"]) == [
+        {"k": 1, "n": 64}, {"k": 2, "n": 64}]
