@@ -8,9 +8,18 @@ GpuHashAggregateExec pipeline (SURVEY.md §3.3). Prints ONE JSON line.
 `vs_baseline` is speedup over a single-core NumPy columnar implementation of the
 same query on the same host (the reference's own published claim is 3x-7x vs CPU
 Spark, docs/FAQ.md:82-88 — no numeric tables exist in-tree, BASELINE.md).
+
+Resilience (round-1 postmortem: a single axon backend-init failure produced
+rc=1 and a null metric): the measurement runs in a CHILD process with a
+timeout; the parent probes the backend first, retries once on failure, falls
+back to the CPU platform if the accelerator never comes up, and ALWAYS prints
+exactly one JSON line and exits 0.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -20,6 +29,9 @@ CAP = 1 << 22          # 4M row padded batch
 N_ROWS = (1 << 22) - 37
 N_KEYS = 4096
 ITERS = 10
+
+CHILD_TIMEOUT_S = 1200
+PROBE_TIMEOUT_S = 240   # first TPU compile/init can take ~40s; be generous
 
 
 def host_baseline(key_vals, key_valid, val_vals, val_valid, n):
@@ -50,7 +62,6 @@ def timed_loop_fn(stage, iters):
     dispatch per measurement is essential: the device link has O(10ms) roundtrip
     latency, so per-call host timing measures the tunnel, not the kernel."""
     import jax
-    import jax.numpy as jnp
 
     def body(_, carry):
         kv, km, vv, vm, nr = carry
@@ -66,9 +77,16 @@ def timed_loop_fn(stage, iters):
     return jax.jit(run)
 
 
-def main():
+def child_main():
+    """Measured run; prints the JSON line on success. Runs in a subprocess so a
+    wedged tunnel or backend crash cannot take down the parent."""
     import jax
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the axon site hook re-selects TPU regardless of env; override it
+        jax.config.update("jax_platforms", "cpu")
     from __graft_entry__ import _build_stage
+
+    platform = jax.devices()[0].platform
 
     rng = np.random.default_rng(42)
     key_vals = rng.integers(0, N_KEYS, CAP).astype(np.int64)
@@ -108,13 +126,95 @@ def main():
     assert abs(dev_sum - float(ref[1].sum())) < 1e-6 * max(1.0, abs(dev_sum))
 
     rows_per_s = N_ROWS / tpu_s
-    print(json.dumps({
+    line = {
         "metric": "fused_hash_aggregate_throughput",
         "value": round(rows_per_s / 1e6, 3),
         "unit": "Mrows/s",
         "vs_baseline": round(cpu_s / tpu_s, 3),
+    }
+    if platform != "tpu":
+        line["degraded"] = f"platform={platform}"
+    print(json.dumps(line))
+
+
+def _spawn(extra_env, timeout_s):
+    """Run this script as a measuring child; return its last JSON line or None."""
+    env = dict(os.environ)
+    env.update(extra_env)
+    env["_SRT_BENCH_CHILD"] = "1"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            timeout=timeout_s)
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout
+        if isinstance(out, bytes):
+            out = out.decode("utf-8", "replace")
+        return None, f"timeout after {timeout_s}s: {(out or '')[-2000:]}"
+    tail = (proc.stdout or "")[-2000:]
+    for ln in reversed((proc.stdout or "").splitlines()):
+        ln = ln.strip()
+        if ln.startswith("{") and ln.endswith("}"):
+            try:
+                parsed = json.loads(ln)
+                if "metric" in parsed:
+                    return parsed, tail
+            except (ValueError, TypeError):
+                continue
+    return None, f"rc={proc.returncode}: {tail}"
+
+
+def _probe_backend():
+    """Is the accelerator backend usable at all? Short subprocess probe."""
+    code = ("import jax; d = jax.devices(); "
+            "import jax.numpy as jnp; "
+            "x = jnp.ones((8,)) + 1; x.block_until_ready(); "
+            "print('PROBE_OK', d[0].platform)")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=dict(os.environ),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            timeout=PROBE_TIMEOUT_S)
+        return proc.returncode == 0 and "PROBE_OK" in (proc.stdout or "")
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def parent_main():
+    """Never exits non-zero; always prints one JSON line."""
+    attempts = []
+    # accelerator path: probe, then measure, with one retry
+    for attempt in range(2):
+        if _probe_backend():
+            parsed, err = _spawn({}, CHILD_TIMEOUT_S)
+            if parsed is not None:
+                print(json.dumps(parsed))
+                return
+            attempts.append(f"accel attempt {attempt}: {err}")
+        else:
+            attempts.append(f"accel probe {attempt}: backend unavailable")
+        if attempt == 0:
+            time.sleep(10)
+    # degraded path: force CPU so the metric is never null
+    parsed, err = _spawn({"JAX_PLATFORMS": "cpu"}, CHILD_TIMEOUT_S)
+    if parsed is not None:
+        parsed["degraded"] = "cpu-fallback: " + "; ".join(attempts)[-500:]
+        print(json.dumps(parsed))
+        return
+    attempts.append(f"cpu fallback: {err}")
+    print(json.dumps({
+        "metric": "fused_hash_aggregate_throughput",
+        "value": 0.0,
+        "unit": "Mrows/s",
+        "vs_baseline": 0.0,
+        "degraded": "; ".join(attempts)[-900:],
     }))
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("_SRT_BENCH_CHILD") == "1":
+        child_main()
+    else:
+        parent_main()

@@ -243,6 +243,17 @@ NUM_LOCAL_TASKS = conf("spark.rapids.tpu.sql.localScheduler.numThreads").doc(
     "Partition-task threads in the local scheduler (stands in for Spark executor "
     "task slots; the reference delegates scheduling to Spark)").integer_conf(4)
 
+MESH_ENABLED = conf("spark.rapids.tpu.mesh.enabled").doc(
+    "Run shuffle exchanges as SPMD all_to_all collectives over a "
+    "jax.sharding.Mesh (the ICI data plane; stands in for the reference's "
+    "UCX RapidsShuffleManager, shuffle-plugin UCXShuffleTransport.scala). "
+    "Joins, two-phase aggregates and global sorts then ride co-partitioned "
+    "mesh exchanges").boolean_conf(False)
+
+MESH_DEVICES = conf("spark.rapids.tpu.mesh.devices").doc(
+    "Number of mesh devices for collective exchanges; 0 uses every visible "
+    "device").integer_conf(0)
+
 UDF_COMPILER_ENABLED = conf("spark.rapids.tpu.sql.udfCompiler.enabled").doc(
     "Compile Python UDF bytecode into device expressions "
     "(reference udf-compiler translates Scala bytecode → Catalyst)").boolean_conf(True)

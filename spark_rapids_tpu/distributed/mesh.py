@@ -56,6 +56,54 @@ def _mesh_hash(cols, capacity: int):
     return h
 
 
+def encode_shards(tables, schema: T.StructType, n: int):
+    """Host-side mesh ingest shared by MeshExecutor and MeshExchangeExec: pad
+    each shard to one common capacity; string columns are re-coded against a
+    mesh-GLOBAL sorted dictionary (codes then compare/exchange as ints on
+    device, and code order == lexicographic order). Returns
+    (shards [(cols, n_rows)] * n, cap, global_dicts {ordinal: pa.Array})."""
+    import pyarrow as pa
+    from spark_rapids_tpu.columnar.arrow import table_to_device
+    from spark_rapids_tpu.ops.filtering import slice_to_capacity
+    if len(tables) > n:
+        raise ValueError(
+            f"{len(tables)} input shards > {n} mesh devices; "
+            "merge shards before calling the mesh executor")
+    cap = bucket_capacity(max((t.num_rows for t in tables), default=1))
+    global_dicts = {}
+    for i, f in enumerate(schema):
+        if isinstance(f.data_type, T.StringType):
+            union = pa.concat_arrays(
+                [t.column(i).combine_chunks().cast(pa.string()).unique()
+                 for t in tables]).unique().sort()
+            global_dicts[i] = union
+    shards = []
+    for t in tables:
+        batch = table_to_device(t, schema=schema)
+        cols = []
+        for i, cv in enumerate(batch.columns):
+            c = Col.from_vector(cv)
+            if i in global_dicts and c.dictionary is not None:
+                remap = {v: j for j, v in
+                         enumerate(global_dicts[i].to_pylist())}
+                m = np.array([remap[v] for v in
+                              c.dictionary.to_pylist()] or [0], np.int32)
+                c = Col(jnp.asarray(m)[c.values], c.validity, c.dtype,
+                        global_dicts[i])
+            cols.append(c)
+        # re-pad to the common mesh capacity
+        cols = slice_to_capacity(cols, t.num_rows, cap)
+        shards.append((cols, t.num_rows))
+    while len(shards) < n:  # fewer shards than chips: empty pads
+        cols = [Col(jnp.full((cap,), f.data_type.default_value(),
+                             dtype=f.data_type.jnp_dtype),
+                    jnp.zeros((cap,), jnp.bool_), f.data_type,
+                    global_dicts.get(i))
+                for i, f in enumerate(schema)]
+        shards.append((cols, 0))
+    return shards, cap, global_dicts
+
+
 class MeshExecutor:
     """Compile + run grouped aggregation across an n-device mesh."""
 
@@ -67,43 +115,7 @@ class MeshExecutor:
 
     # -- host-side ingest ----------------------------------------------------
     def _encode_shards(self, tables, schema: T.StructType):
-        """Pad each shard to one capacity; strings get a mesh-global dictionary."""
-        import pyarrow as pa
-        from spark_rapids_tpu.columnar.arrow import table_to_device
-        cap = bucket_capacity(max((t.num_rows for t in tables), default=1))
-        global_dicts = {}
-        for i, f in enumerate(schema):
-            if isinstance(f.data_type, T.StringType):
-                union = pa.concat_arrays(
-                    [t.column(i).combine_chunks().cast(pa.string()).unique()
-                     for t in tables]).unique().sort()
-                global_dicts[i] = union
-        shards = []
-        for t in tables:
-            batch = table_to_device(t, schema=schema)
-            cols = []
-            for i, cv in enumerate(batch.columns):
-                c = Col.from_vector(cv)
-                if i in global_dicts and c.dictionary is not None:
-                    remap = {v: j for j, v in
-                             enumerate(global_dicts[i].to_pylist())}
-                    m = np.array([remap[v] for v in
-                                  c.dictionary.to_pylist()] or [0], np.int32)
-                    c = Col(jnp.asarray(m)[c.values], c.validity, c.dtype,
-                            global_dicts[i])
-                cols.append(c)
-            # re-pad to the common mesh capacity
-            from spark_rapids_tpu.ops.filtering import slice_to_capacity
-            cols = slice_to_capacity(cols, t.num_rows, cap)
-            shards.append((cols, t.num_rows))
-        while len(shards) < self.n:  # fewer shards than chips: empty pads
-            cols = [Col(jnp.full((cap,), f.data_type.default_value(),
-                                 dtype=f.data_type.jnp_dtype),
-                        jnp.zeros((cap,), jnp.bool_), f.data_type,
-                        global_dicts.get(i))
-                    for i, f in enumerate(schema)]
-            shards.append((cols, 0))
-        return shards[:self.n], cap, global_dicts
+        return encode_shards(tables, schema, self.n)
 
     # -- the SPMD program ----------------------------------------------------
     def _build_step(self, schema, group_exprs, agg_exprs, filter_expr, cap):

@@ -244,7 +244,7 @@ def coalescing_tables(reader, paths, columns, filt, batch_rows, target_rows):
         for tbl in reader.read_file(p, columns, filt, batch_rows=cap):
             acc.append(tbl)
             acc_rows += tbl.num_rows
-            if acc_rows >= min(target_rows, cap):
+            if acc_rows >= target_rows:  # flush() re-slices to cap-row batches
                 yield from flush()
                 acc, acc_rows = [], 0
     if acc:

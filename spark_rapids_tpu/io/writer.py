@@ -57,7 +57,7 @@ class _TaskWriter:
     (reference GpuFileFormatDataWriter SingleDirectory/DynamicPartition writers)."""
 
     def __init__(self, temp_dir: str, task_id: int, fmt: str, compression: str,
-                 partition_by: list, schema: T.StructType):
+                 partition_by: list, schema: T.StructType, job_uuid: str):
         self.temp = os.path.join(temp_dir, f"task_{task_id}")
         os.makedirs(self.temp, exist_ok=True)
         self.fmt = fmt
@@ -67,10 +67,15 @@ class _TaskWriter:
         self.stats = WriteStats()
         self._file_counter = 0
         self._task_id = task_id
+        self._job_uuid = job_uuid
 
     def _next_name(self, subdir: str = "") -> str:
+        # job-unique uuid in the filename (Spark's FileOutputCommitter naming)
+        # so mode=append never collides with files from an earlier job that
+        # used the same task ids.
         ext = {"parquet": "parquet", "orc": "orc", "csv": "csv"}[self.fmt]
-        name = f"part-{self._task_id:05d}-{self._file_counter:04d}.{ext}"
+        name = (f"part-{self._task_id:05d}-{self._job_uuid}"
+                f"-{self._file_counter:04d}.{ext}")
         self._file_counter += 1
         d = os.path.join(self.temp, subdir)
         os.makedirs(d, exist_ok=True)
@@ -136,7 +141,8 @@ def write_columnar(exec_or_node, path: str, fmt: str = "parquet",
         if mode == "overwrite":
             shutil.rmtree(path)
     os.makedirs(path, exist_ok=True)
-    temp_dir = os.path.join(path, f"_temporary-{uuid.uuid4().hex[:8]}")
+    job_uuid = uuid.uuid4().hex[:12]
+    temp_dir = os.path.join(path, f"_temporary-{job_uuid}")
     os.makedirs(temp_dir, exist_ok=True)
     partition_by = partition_by or []
     schema = exec_or_node.output
@@ -145,7 +151,7 @@ def write_columnar(exec_or_node, path: str, fmt: str = "parquet",
 
     def run_split(split):
         writer = _TaskWriter(temp_dir, split, fmt, compression, partition_by,
-                             schema)
+                             schema, job_uuid)
         try:
             if isinstance(exec_or_node, TpuExec):
                 with TaskContext():

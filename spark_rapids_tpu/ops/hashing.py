@@ -133,7 +133,12 @@ def hash_string_words(words, lengths, seed_i32):
         use = i < n_words
         return jnp.where(use, _mix_h1(h1, _mix_k1(k)), h1)
 
-    h1 = lax.fori_loop(0, W, word_round, jnp.broadcast_to(seed_i32, (n,)).astype(jnp.int32))
+    # seed the carry with a data-dependent zero: under shard_map the loop body
+    # mixes in per-device data, so the carry must be device-varying from the
+    # start or the scan rejects the (unvarying-in, varying-out) carry types
+    h0 = (jnp.broadcast_to(seed_i32, (n,)).astype(jnp.int32)
+          + (lengths * 0).astype(jnp.int32))
+    h1 = lax.fori_loop(0, W, word_round, h0)
 
     # tail bytes: extract byte (n_words*4 + t) for t in 0..2, sign-extended
     for t in range(3):

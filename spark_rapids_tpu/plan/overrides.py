@@ -245,6 +245,28 @@ def _register_all():
     from spark_rapids_tpu.exec.exchange import ShuffleExchangeExec
     from spark_rapids_tpu.shuffle import partitioning as SP
 
+    def _mesh_n(conf) -> int:
+        """Mesh width when collective exchanges are enabled, else 0
+        (spark.rapids.tpu.mesh.enabled routes exchanges over ICI all_to_all,
+        the reference's RapidsShuffleManager/UCX analog)."""
+        from spark_rapids_tpu import config as CFG
+        if not conf.get(CFG.MESH_ENABLED):
+            return 0
+        from spark_rapids_tpu.distributed.exchange import mesh_devices
+        return len(mesh_devices(conf))
+
+    def _hash_exchange(keys, child, conf):
+        """Hash exchange: mesh collective when configured, threaded block-store
+        otherwise (reference GpuShuffleExchangeExec with/without the UCX
+        RapidsShuffleManager)."""
+        n_mesh = _mesh_n(conf)
+        if n_mesh > 1:
+            from spark_rapids_tpu.distributed.exchange import MeshExchangeExec
+            return MeshExchangeExec(SP.HashPartitioner(keys, n_mesh), child,
+                                    conf=conf)
+        return ShuffleExchangeExec(
+            SP.HashPartitioner(keys, child.num_partitions), child, conf=conf)
+
     def conv_scan(meta, kids):
         return XB.ArrowScanExec(meta.node.partitions, meta.node.output,
                                 conf=meta.conf)
@@ -295,9 +317,7 @@ def _register_all():
         nkeys = len(n.group_exprs)
         key_names = [f.name for f in partial.output][:nkeys]
         keys = [E.col(k) for k in key_names]
-        ex_node = ShuffleExchangeExec(
-            SP.HashPartitioner(keys, child.num_partitions), partial,
-            conf=meta.conf)
+        ex_node = _hash_exchange(keys, partial, meta.conf)
         return XA.HashAggregateExec(keys, n.agg_exprs, ex_node, mode=XA.FINAL,
                                     conf=meta.conf)
 
@@ -323,6 +343,20 @@ def _register_all():
             return XJ.NestedLoopJoinExec(
                 "inner" if jt == "cross" else jt, left, right,
                 condition=n.condition, conf=meta.conf)
+        n_mesh = _mesh_n(meta.conf)
+        if n_mesh > 1:
+            # shuffled hash join over co-partitioned mesh exchanges (reference
+            # GpuShuffledHashJoinBase.scala:97 riding GpuShuffleExchangeExec):
+            # both sides hash-partition by their keys with the same Spark-exact
+            # murmur3, so equal keys land on the same device
+            from spark_rapids_tpu.distributed.exchange import MeshExchangeExec
+            lex = MeshExchangeExec(
+                SP.HashPartitioner(n.left_keys, n_mesh), left, conf=meta.conf)
+            rex = MeshExchangeExec(
+                SP.HashPartitioner(n.right_keys, n_mesh), right, conf=meta.conf)
+            return XJ.HashJoinExec(
+                jt, n.left_keys, n.right_keys, lex, rex,
+                condition=n.condition, build_side="right", conf=meta.conf)
         return XJ.BroadcastHashJoinExec(
             jt, n.left_keys, n.right_keys, left, right, condition=n.condition,
             build_side="right", conf=meta.conf)
@@ -333,6 +367,17 @@ def _register_all():
         exprs = [e for (e, _, _) in n.sort_exprs]
         orders = [SortOrder(ascending=asc, nulls_first=nf)
                   for (_, asc, nf) in n.sort_exprs]
+        n_mesh = _mesh_n(meta.conf)
+        if n_mesh > 1 and n.global_sort:
+            # total order via range exchange + per-device sort (the reference's
+            # GpuRangePartitioner + per-partition GpuSortExec shape): partition
+            # d holds keys ≤ partition d+1, so reading partitions in order is
+            # globally sorted without a gather
+            from spark_rapids_tpu.distributed.exchange import MeshExchangeExec
+            part = SP.RangePartitioner(exprs, orders, n_mesh)
+            child = MeshExchangeExec(part, kids[0], conf=meta.conf)
+            return XS.SortExec(exprs, orders, child, global_sort=False,
+                               conf=meta.conf)
         return XS.SortExec(exprs, orders, kids[0], global_sort=n.global_sort,
                            conf=meta.conf)
 
@@ -348,6 +393,10 @@ def _register_all():
             from spark_rapids_tpu.ops.sorting import SortOrder
             sort_orders = [SortOrder() for _ in n.keys]
             p = SP.RangePartitioner(n.keys, sort_orders, n.num_out)
+        n_mesh = _mesh_n(meta.conf)
+        if n_mesh > 1 and n.num_out == n_mesh and n.partitioning != "single":
+            from spark_rapids_tpu.distributed.exchange import MeshExchangeExec
+            return MeshExchangeExec(p, kids[0], conf=meta.conf)
         return ShuffleExchangeExec(p, kids[0], conf=meta.conf)
 
     def exr(cls, desc, convert, sig=TS.ORDERABLE, conf_key=None, tag_fn=None):
@@ -393,10 +442,8 @@ def _register_all():
         we0 = _unalias(n.window_exprs[0])
         if child.num_partitions > 1:
             if we0.spec.partition_by:
-                child = ShuffleExchangeExec(
-                    SP.HashPartitioner(list(we0.spec.partition_by),
-                                       child.num_partitions),
-                    child, conf=meta.conf)
+                child = _hash_exchange(list(we0.spec.partition_by), child,
+                                       meta.conf)
             else:
                 child = XS._GatherAllExec(child, conf=meta.conf)
         return WindowExec(n.window_exprs, child, conf=meta.conf)

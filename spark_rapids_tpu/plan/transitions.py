@@ -9,6 +9,8 @@ coalesce goal (GpuTransitionOverrides.scala:57-63)."""
 
 from __future__ import annotations
 
+import copy
+
 import pyarrow as pa
 
 from spark_rapids_tpu import types as T
@@ -94,11 +96,15 @@ def build_hybrid(meta):
                     for k in kids]
         return meta.rule.convert(meta, dev_kids)
 
-    # node stays on host: device children drop back through bridges
+    # node stays on host: device children drop back through bridges. Rewire a
+    # shallow COPY so the user's logical plan is never mutated — a DataFrame
+    # re-planned for a second action must not see stale HostBridgeNode wrappers
+    # holding already-consumed exec instances.
     host_kids = [k if isinstance(k, PlanNode) else HostBridgeNode(k)
                  for k in kids]
-    node.children = host_kids
-    return node
+    clone = copy.copy(node)
+    clone.children = host_kids
+    return clone
 
 
 def to_device_plan(plan, conf) -> TpuExec:

@@ -81,8 +81,31 @@ class DataFrame:
             names = [on] if isinstance(on, str) else list(on)
             lk = [E.col(n) for n in names]
             rk = [E.col(n) for n in names]
-        return DataFrame(NN.JoinNode(self._plan, other._plan, lk, rk, jt,
-                                     condition), self.session)
+        jn = NN.JoinNode(self._plan, other._plan, lk, rk, jt, condition)
+        if on is None or jt in ("leftsemi", "leftanti"):
+            return DataFrame(jn, self.session)
+        # USING join: one key column per name, Spark semantics — left key for
+        # inner/left, right key for right, coalesce(left, right) for full;
+        # the right-side duplicate is dropped
+        from spark_rapids_tpu.expr.nullexprs import Coalesce
+        lout, rout = self._plan.output, other._plan.output
+        nl = len(lout.fields)
+        proj = []
+        for n in names:
+            li, ri = lout.index_of(n), rout.index_of(n)
+            lref = E.BoundReference(li, lout.fields[li].data_type)
+            rref = E.BoundReference(nl + ri, rout.fields[ri].data_type)
+            key = (rref if jt == "right"
+                   else Coalesce(lref, rref) if jt == "full" else lref)
+            proj.append(E.Alias(key, n))
+        for i, f in enumerate(lout.fields):
+            if f.name not in names:
+                proj.append(E.Alias(E.BoundReference(i, f.data_type), f.name))
+        for i, f in enumerate(rout.fields):
+            if f.name not in names:
+                proj.append(E.Alias(E.BoundReference(nl + i, f.data_type),
+                                    f.name))
+        return DataFrame(NN.ProjectNode(proj, jn), self.session)
 
     def union(self, other: "DataFrame") -> "DataFrame":
         return DataFrame(NN.UnionNode(self._plan, other._plan), self.session)

@@ -56,6 +56,36 @@ def murmur3_row_hash(cols: list[Col], capacity: int, seed: int = SPARK_HASH_SEED
     return h
 
 
+def range_part_ids(keys: list[Col], bounds: list[Col], orders, capacity: int):
+    """Partition id per row given `n-1` sorted bound rows: number of bounds the
+    row compares strictly greater than (lexicographic, Spark null/NaN ordering
+    via _key_arrays). Shared by the host RangePartitioner and the mesh exchange
+    (the mesh path passes keys/bounds already in one global dictionary space)."""
+    keys = list(keys)
+    bounds = list(bounds)
+    # align string dictionaries between keys and bounds so codes compare
+    for i, (k, b) in enumerate(zip(keys, bounds)):
+        if k.is_string and k.dictionary is not b.dictionary:
+            from spark_rapids_tpu.ops.strings import union_dictionaries
+            k2, b2 = union_dictionaries(k, b)
+            keys[i], bounds[i] = k2, b2
+    nb = bounds[0].values.shape[0]
+    row_keys = [ka for k, o in zip(keys, orders)
+                for ka in _key_arrays(k, o)]
+    bound_keys = [ka for b, o in zip(bounds, orders)
+                  for ka in _key_arrays(b, o)]
+    ids = jnp.zeros((capacity,), jnp.int32)
+    for j in range(nb):
+        gt = jnp.zeros((capacity,), jnp.bool_)
+        tie = jnp.ones((capacity,), jnp.bool_)
+        for rk, bk in zip(row_keys, bound_keys):
+            bj = bk[j]
+            gt = gt | (tie & (rk > bj))
+            tie = tie & (rk == bj)
+        ids = ids + gt.astype(jnp.int32)
+    return ids
+
+
 def slice_into_partitions(batch: ColumnarBatch, part_ids, num_partitions: int):
     """Stable-sort rows by partition id and slice into per-partition batches.
     Returns list[(part, ColumnarBatch)] for non-empty partitions
@@ -205,30 +235,7 @@ class RangePartitioner(Partitioner):
             return jnp.zeros((batch.capacity,), jnp.int32)
         ctx = EvalContext.from_batch(batch)
         keys = [e.eval(ctx) for e in self.sort_exprs]
-        # align string dictionaries between keys and bounds so codes compare
-        bounds = self._bounds
-        for i, (k, b) in enumerate(zip(keys, bounds)):
-            if k.is_string:
-                from spark_rapids_tpu.ops.strings import union_dictionaries
-                k2, b2 = union_dictionaries(k, b)
-                keys[i], bounds[i] = k2, b2
-        nb = bounds[0].values.shape[0]
-        # row > bound_j (lexicographic, Spark null/NaN ordering via _key_arrays)
-        row_keys = [ka for k, o in zip(keys, self.orders)
-                    for ka in _key_arrays(k, o)]
-        bound_keys = [ka for b, o in zip(bounds, self.orders)
-                      for ka in _key_arrays(b, o)]
-        cap = batch.capacity
-        ids = jnp.zeros((cap,), jnp.int32)
-        for j in range(nb):
-            gt = jnp.zeros((cap,), jnp.bool_)
-            tie = jnp.ones((cap,), jnp.bool_)
-            for rk, bk in zip(row_keys, bound_keys):
-                bj = bk[j]
-                gt = gt | (tie & (rk > bj))
-                tie = tie & (rk == bj)
-            ids = ids + gt.astype(jnp.int32)
-        return ids
+        return range_part_ids(keys, self._bounds, self.orders, batch.capacity)
 
     def partition(self, batch, split=0):
         return slice_into_partitions(batch, self.part_ids(batch), self.num_partitions)

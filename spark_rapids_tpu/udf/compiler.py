@@ -94,15 +94,26 @@ class _Compiler:
         self.globals = fn.__globals__
 
     def run(self) -> Expression:
+        # absolute backstop so pathologically branchy UDFs cannot stall the
+        # planner (each conditional forks both arms; cost can grow with 2^depth)
+        self._steps = 0
         return self._exec(0, [])
 
     def _exec(self, idx: int, stack: list, depth: int = 0) -> Expression:
         """Symbolically execute from instruction idx; returns the expression
-        produced at RETURN. Forks at conditional jumps (bounded depth)."""
+        produced at RETURN. Forks at conditional jumps (bounded depth). Loops
+        cannot become expressions: an unconditional loop (`while True`) is a
+        JUMP_BACKWARD revisiting an offset within one linear walk → detected
+        below; a conditional loop re-forks each iteration → depth bound."""
         if depth > 40:
             raise _CannotCompile("too many branches")
         stack = list(stack)
+        seen = set()  # instruction indices executed in this linear walk
         while idx < len(self.instrs):
+            self._steps += 1
+            if self._steps > 1_000_000:
+                raise _CannotCompile("UDF too complex to compile")
+            seen.add(idx)
             ins = self.instrs[idx]
             op = ins.opname
             if op in ("RESUME", "NOP", "CACHE", "PRECALL",
@@ -215,7 +226,10 @@ class _Compiler:
                 stack[-1], stack[-ins.arg] = stack[-ins.arg], stack[-1]
                 idx += 1
             elif op in ("JUMP_FORWARD", "JUMP_BACKWARD"):
-                idx = self.by_offset[ins.argval]
+                target = self.by_offset[ins.argval]
+                if target in seen:
+                    raise _CannotCompile("loop in UDF bytecode")
+                idx = target
             elif op == "RETURN_VALUE":
                 return self._expr(stack.pop())
             elif op == "RETURN_CONST":

@@ -225,3 +225,16 @@ def test_write_mode_ignore_and_bad_mode(tmp_path, mixed_table):
     assert stats.num_files == 0 and len(os.listdir(out)) == n_files
     with pytest.raises(ValueError, match="save mode"):
         write_columnar(src, out, "parquet", mode="overwrit")
+
+
+def test_write_mode_append_no_collision(tmp_path, mixed_table):
+    """Append must never overwrite files from an earlier job that used the same
+    task ids (part filenames carry a job-unique uuid)."""
+    from spark_rapids_tpu.exec.basic import ArrowScanExec
+    conf = RapidsConf()
+    src = ArrowScanExec([mixed_table], conf=conf)
+    out = str(tmp_path / "out")
+    write_columnar(src, out, "parquet")
+    write_columnar(src, out, "parquet", mode="append")
+    back = FileScanNode(out, "parquet").collect_host()
+    assert back.num_rows == 2 * mixed_table.num_rows

@@ -101,3 +101,163 @@ def test_mesh_partials_actually_exchange():
                        [Alias(Count(None), "n")])
     assert sorted(out.to_pylist(), key=lambda d: d["k"]) == [
         {"k": 1, "n": 64}, {"k": 2, "n": 64}]
+
+
+# ---------------------------------------------------------------------------
+# Session-level mesh execution: exchanges run as all_to_all collectives over
+# the 8-device CPU mesh (spark.rapids.tpu.mesh.enabled); group-by, join and
+# global sort ride the mesh exchange (VERDICT r1 item 2).
+# ---------------------------------------------------------------------------
+
+from spark_rapids_tpu.session import TpuSession
+
+
+def mesh_session():
+    return TpuSession({"spark.rapids.tpu.mesh.enabled": "true",
+                       "spark.rapids.tpu.mesh.devices": "8"})
+
+
+def physical_tree(df):
+    from spark_rapids_tpu.plan.overrides import TpuOverrides
+    return repr(TpuOverrides(df.session.conf).apply(df._plan))
+
+
+def norm_rows(tbl):
+    # floats: partial-aggregate accumulation order differs across partitionings
+    # (same as Spark), so compare at 1e-9 relative precision
+    def nv(v):
+        if isinstance(v, float):
+            return float(f"{v:.9e}")
+        return v
+    cols = tbl.column_names
+    return sorted((tuple(nv(r[c]) for c in cols) for r in tbl.to_pylist()),
+                  key=lambda t_: tuple((v is None, str(v)) for v in t_))
+
+
+def test_mesh_session_group_by():
+    spark = mesh_session()
+    t = make_table(3000, seed=11)
+    df = (spark.create_dataframe(t, num_partitions=5)
+          .group_by(F.col("i"))
+          .agg(F.sum(F.col("d")).alias("s"),
+               F.count(F.col("l")).alias("c"),
+               F.max(F.col("d")).alias("mx")))
+    got = df.collect()
+    exp = df.collect_host()
+    # the plan must actually contain a mesh exchange
+    assert "MeshExchangeExec" in physical_tree(df)
+    assert norm_rows(got) == norm_rows(exp)
+
+
+def project_like(exp, got):
+    """Project the host-oracle table onto the device output's column set (the
+    device path collapses the duplicated USING-join key like Spark; the host
+    plan keeps both copies)."""
+    idx = []
+    seen = set()
+    for i, n in enumerate(exp.column_names):
+        if n not in seen:
+            idx.append(i)
+            seen.add(n)
+    exp = exp.select(idx)
+    assert exp.column_names == got.column_names, (exp.column_names,
+                                                  got.column_names)
+    return exp
+
+
+def test_mesh_session_join():
+    spark = mesh_session()
+    r = np.random.default_rng(5)
+    left = pa.table({
+        "k": pa.array([None if i % 17 == 0 else int(v) for i, v in
+                       enumerate(r.integers(0, 40, 1200))], pa.int64()),
+        "lv": pa.array(r.normal(0, 5, 1200)),
+    })
+    right = pa.table({
+        "k": pa.array([None if i % 23 == 0 else int(v) for i, v in
+                       enumerate(r.integers(0, 40, 900))], pa.int64()),
+        "rv": pa.array(r.normal(0, 5, 900)),
+    })
+    ldf = spark.create_dataframe(left, num_partitions=4)
+    rdf = spark.create_dataframe(right, num_partitions=3)
+    df = ldf.join(rdf, on="k", how="inner")
+    got = df.collect()
+    exp = project_like(df.collect_host(), got)
+    assert "MeshExchangeExec" in physical_tree(df)
+    assert norm_rows(got) == norm_rows(exp)
+
+
+@pytest.mark.parametrize("how", ["left", "full"])
+def test_mesh_session_outer_joins(how):
+    spark = mesh_session()
+    r = np.random.default_rng(9)
+    left = pa.table({"k": pa.array([int(v) for v in r.integers(0, 12, 300)]),
+                     "lv": pa.array(r.normal(0, 5, 300))})
+    right = pa.table({"k": pa.array([int(v) for v in r.integers(6, 20, 250)]),
+                      "rv": pa.array(r.normal(0, 5, 250))})
+    ldf = spark.create_dataframe(left, num_partitions=3)
+    rdf = spark.create_dataframe(right, num_partitions=2)
+    df = ldf.join(rdf, on="k", how=how)
+    got = df.collect()
+    assert norm_rows(got) == norm_rows(project_like(df.collect_host(), got))
+
+
+def test_mesh_session_global_sort():
+    spark = mesh_session()
+    t = make_table(2500, seed=21)
+    df = (spark.create_dataframe(t, num_partitions=6)
+          .select(F.col("i"), F.col("d"))
+          .sort(F.col("i"), F.col("d")))
+    got = df.collect()
+    exp = df.collect_host()
+    assert "MeshExchangeExec" in physical_tree(df)
+    # global sort: exact row order must match the host oracle
+    assert got.to_pylist() == exp.to_pylist()
+
+
+def test_mesh_session_string_keys():
+    """String group-by/join keys hash by UTF-8 bytes through the mesh-global
+    dictionary, so both sides of the exchange agree on partition ids."""
+    spark = mesh_session()
+    r = np.random.default_rng(31)
+    words = ["alpha", "bravo", "charlie", "delta", "echo", "", "Ω-unicode"]
+    t = pa.table({
+        "w": pa.array([None if i % 19 == 0 else words[v] for i, v in
+                       enumerate(r.integers(0, len(words), 1000))]),
+        "v": pa.array(r.normal(0, 3, 1000)),
+    })
+    df = (spark.create_dataframe(t, num_partitions=4)
+          .group_by(F.col("w"))
+          .agg(F.sum(F.col("v")).alias("s"), F.count(None).alias("c")))
+    assert norm_rows(df.collect()) == norm_rows(df.collect_host())
+
+
+def test_mesh_repartition_roundrobin():
+    spark = mesh_session()
+    t = make_table(800, seed=41)
+    df = spark.create_dataframe(t, num_partitions=3).repartition(8)
+    got = df.collect()
+    assert "MeshExchangeExec" in physical_tree(df)
+    assert norm_rows(got) == norm_rows(t)
+
+
+def test_mesh_string_hash_spreads_devices():
+    """String keys must hash their UTF-8 bytes through the mesh-global
+    dictionary — distinct keys spread over devices, not funnel to one (the
+    degenerate empty-dictionary hash is consistent, so result-equality tests
+    alone cannot catch it)."""
+    from spark_rapids_tpu.distributed.exchange import MeshExchangeExec
+    from spark_rapids_tpu.exec.basic import ArrowScanExec
+    from spark_rapids_tpu.shuffle.partitioning import HashPartitioner
+    from spark_rapids_tpu.config import RapidsConf
+
+    words = [f"word-{i}" for i in range(64)]
+    t = pa.table({"w": pa.array(words * 4), "v": pa.array(range(256))})
+    conf = RapidsConf({"spark.rapids.tpu.mesh.enabled": "true",
+                       "spark.rapids.tpu.mesh.devices": "8"})
+    ex = MeshExchangeExec(HashPartitioner([F.col("w")], 8),
+                          ArrowScanExec([t], conf=conf), conf=conf)
+    sizes = [sum(b.num_rows for b in ex.execute_partition(d))
+             for d in range(8)]
+    assert sum(sizes) == 256
+    assert sum(1 for s_ in sizes if s_ > 0) >= 4, sizes

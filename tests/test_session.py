@@ -128,3 +128,17 @@ def test_when_otherwise_like_rdiv(spark):
     assert out.column("sign").to_pylist() == ["neg", "zero", "pos"]
     assert out.column("m").to_pylist() == [True, True, False]
     assert out.column("inv").to_pylist() == [-0.2, None, pytest.approx(1 / 7)]
+
+
+def test_dataframe_reusable_across_actions(spark):
+    """Planning one action must not mutate the logical plan: a second action on
+    the same DataFrame (partially host, partially device) must be correct."""
+    t = pa.table({"k": pa.array([1, 2, 1, 3, 2, 1]),
+                  "v": pa.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0])})
+    df = (spark.create_dataframe(t, num_partitions=2)
+          .filter(F.col("v") > 1.5)
+          .group_by(F.col("k"))
+          .agg(F.sum(F.col("v")).alias("s")))
+    first = norm(df.collect())
+    second = norm(df.collect())
+    assert first == second

@@ -126,3 +126,15 @@ def test_udf_in_filter_pins_to_host(spark):
     assert "outside a projection" in fdf.explain()
     out = fdf.collect()  # host path via worker pool
     assert sorted(out["a"].to_pylist()) == [12, 340]
+
+
+def test_udf_infinite_loop_falls_back():
+    """`while True: pass` must return None (host fallback) quickly, not hang
+    the symbolic executor (ADVICE r1)."""
+    from spark_rapids_tpu.udf.compiler import compile_udf
+
+    def bad(x):
+        while True:
+            pass
+
+    assert compile_udf(bad, ["x"]) is None
