@@ -33,13 +33,23 @@ class ProjectExec(TpuExec):
             T.StructField(e.name, e.dtype, e.nullable) for e in self.project_list])
 
     def execute_partition(self, split):
+        from spark_rapids_tpu.expr.misc import (MonotonicallyIncreasingID,
+                                                Rand)
+        positional = any(
+            e.collect(lambda x: isinstance(
+                x, (MonotonicallyIncreasingID, Rand)))
+            for e in self.project_list)
+
         def it():
+            offset = 0
             for batch in self.child.execute_partition(split):
                 acquire_semaphore(self.metrics)
                 with trace_range("ProjectExec", self._op_time):
-                    ctx = EvalContext.from_batch(batch)
+                    ctx = EvalContext.from_batch(batch, split, offset)
                     cols = [e.eval(ctx).to_vector() for e in self.project_list]
                     yield ColumnarBatch(cols, batch.lazy_num_rows, self.output)
+                if positional:  # host sync only when an expr needs positions
+                    offset += int(batch.num_rows)
         return self.wrap_output(it())
 
     def args_string(self):
@@ -61,7 +71,7 @@ class FilterExec(TpuExec):
             for batch in self.child.execute_partition(split):
                 acquire_semaphore(self.metrics)
                 with trace_range("FilterExec", self._op_time):
-                    ctx = EvalContext.from_batch(batch)
+                    ctx = EvalContext.from_batch(batch, split)
                     pred = self.condition.eval(ctx)
                     keep = selection_mask(pred, ctx.num_rows, ctx.capacity)
                     new_cols, count = compact_cols(ctx.cols, keep)

@@ -258,3 +258,109 @@ class First(AggregateFunction):
 
     def evaluate(self, state_cols):
         return state_cols[0].canonicalized()
+
+
+class Last(AggregateFunction):
+    """Spark Last(ignoreNulls) (reference AggregateFunctions.scala GpuLast)."""
+
+    def __init__(self, child, ignore_nulls: bool = False):
+        super().__init__(child)
+        self.ignore_nulls = ignore_nulls
+
+    def with_children(self, children):
+        return Last(children[0], self.ignore_nulls)
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def state_types(self):
+        return [self.dtype]
+
+    def update(self, in_col, seg_ids, capacity):
+        vals, valid = G.segment_last(in_col.values, in_col.validity, seg_ids,
+                                     capacity, self.ignore_nulls)
+        return [Col(vals, valid, self.dtype, in_col.dictionary)]
+
+    def merge(self, state_cols, seg_ids, capacity):
+        st = state_cols[0]
+        vals, valid = G.segment_last(st.values, st.validity, seg_ids, capacity,
+                                     self.ignore_nulls)
+        return [Col(vals, valid, self.dtype, st.dictionary)]
+
+    def evaluate(self, state_cols):
+        return state_cols[0].canonicalized()
+
+
+class _CentralMoment(AggregateFunction):
+    """Variance/stddev family over (n, sum, sum-of-squares) states — the
+    numerically simple merge form (reference aggregate functions use cudf's
+    m2-based groupby; sums suffice at double precision for SQL parity tests)."""
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    @property
+    def state_types(self):
+        return [T.LONG, T.DOUBLE, T.DOUBLE]
+
+    def update(self, in_col, seg_ids, capacity):
+        v = in_col.values.astype(jnp.float64)
+        zero = jnp.zeros_like(v)
+        vv = jnp.where(in_col.validity, v, zero)
+        s, cnt = G.segment_sum(vv, in_col.validity, seg_ids, capacity)
+        s2, _ = G.segment_sum(vv * vv, in_col.validity, seg_ids, capacity)
+        ones = jnp.ones_like(cnt, dtype=jnp.bool_)
+        return [Col(cnt, ones, T.LONG), Col(s, ones, T.DOUBLE),
+                Col(s2, ones, T.DOUBLE)]
+
+    def merge(self, state_cols, seg_ids, capacity):
+        n_st, s_st, s2_st = state_cols
+        n, _ = G.segment_sum(n_st.values, n_st.validity, seg_ids, capacity)
+        s, _ = G.segment_sum(s_st.values, s_st.validity, seg_ids, capacity)
+        s2, _ = G.segment_sum(s2_st.values, s2_st.validity, seg_ids, capacity)
+        ones = jnp.ones_like(n, dtype=jnp.bool_)
+        return [Col(n, ones, T.LONG), Col(s, ones, T.DOUBLE),
+                Col(s2, ones, T.DOUBLE)]
+
+    def _moments(self, state_cols):
+        n = state_cols[0].values
+        s = state_cols[1].values
+        s2 = state_cols[2].values
+        nf = n.astype(jnp.float64)
+        safe = jnp.where(n > 0, nf, 1.0)
+        mean = s / safe
+        m2 = jnp.maximum(s2 - s * mean, 0.0)  # sum((x-mean)^2)
+        return n, m2
+
+    def evaluate(self, state_cols):
+        n, m2 = self._moments(state_cols)
+        denom = self.denominator(n)
+        ok = denom > 0
+        vals = self.finish(m2 / jnp.where(ok, denom, 1.0))
+        return Col(vals, ok, T.DOUBLE)
+
+    def finish(self, var):
+        return var
+
+
+class VariancePop(_CentralMoment):
+    def denominator(self, n):
+        return n.astype(jnp.float64)
+
+
+class VarianceSamp(_CentralMoment):
+    def denominator(self, n):
+        return (n - 1).astype(jnp.float64)
+
+
+class StddevPop(VariancePop):
+    def finish(self, var):
+        return jnp.sqrt(var)
+
+
+class StddevSamp(VarianceSamp):
+    def finish(self, var):
+        return jnp.sqrt(var)

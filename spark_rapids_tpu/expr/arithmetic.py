@@ -239,3 +239,99 @@ class Abs(Expression):
 
     def __repr__(self):
         return f"abs({self.children[0]!r})"
+
+
+# ---------------------------------------------------------------------------
+# Bitwise (reference org/apache/spark/sql/rapids/bitwise.scala: GpuBitwiseAnd/
+# Or/Xor/Not, GpuShiftLeft/Right/RightUnsigned — Java shift semantics)
+# ---------------------------------------------------------------------------
+
+class BitwiseAnd(BinaryArithmetic):
+    symbol = "&"
+
+    def op(self, lv, rv):
+        return lv & rv
+
+
+class BitwiseOr(BinaryArithmetic):
+    symbol = "|"
+
+    def op(self, lv, rv):
+        return lv | rv
+
+
+class BitwiseXor(BinaryArithmetic):
+    symbol = "^"
+
+    def op(self, lv, rv):
+        return lv ^ rv
+
+
+class BitwiseNot(Expression):
+    def __init__(self, child):
+        self.children = [child]
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def with_children(self, children):
+        return BitwiseNot(children[0])
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        return c.with_(values=~c.values).canonicalized()
+
+    def __repr__(self):
+        return f"(~ {self.children[0]!r})"
+
+
+class _Shift(Expression):
+    """base SHIFT amount: Java masks the shift count to the base width
+    (x << 33 == x << 1 for ints); result type is the base's (int or long)."""
+    symbol = "?"
+
+    def __init__(self, base, amount):
+        self.children = [base, amount]
+
+    @property
+    def dtype(self):
+        base_t = self.children[0].dtype
+        return base_t if isinstance(base_t, T.LongType) else T.INT
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    def eval(self, ctx):
+        out_t = self.dtype
+        b = _cast_col(self.children[0].eval(ctx), out_t)
+        a = _cast_col(self.children[1].eval(ctx), T.INT)
+        width_mask = 63 if isinstance(out_t, T.LongType) else 31
+        amt = (a.values & width_mask).astype(b.values.dtype)
+        validity = valid_and(b.validity, a.validity)
+        return Col(self.op(b.values, amt), validity, out_t).canonicalized()
+
+    def __repr__(self):
+        return f"({self.children[0]!r} {self.symbol} {self.children[1]!r})"
+
+
+class ShiftLeft(_Shift):
+    symbol = "<<"
+
+    def op(self, bv, amt):
+        return bv << amt
+
+
+class ShiftRight(_Shift):
+    symbol = ">>"
+
+    def op(self, bv, amt):
+        return bv >> amt  # arithmetic shift on signed ints
+
+
+class ShiftRightUnsigned(_Shift):
+    symbol = ">>>"
+
+    def op(self, bv, amt):
+        unsigned = jnp.uint64 if bv.dtype == jnp.int64 else jnp.uint32
+        return (bv.astype(unsigned) >> amt.astype(unsigned)).astype(bv.dtype)

@@ -1,0 +1,191 @@
+"""Complex-type create/extract expressions.
+
+Reference: complexTypeCreator.scala (GpuCreateNamedStruct, GpuCreateArray) and
+complexTypeExtractors.scala (GpuGetStructField, GpuGetArrayItem) plus
+collectionOperations GpuSize. The reference materializes real nested cudf
+columns; our columnar layer is flat, so the device path covers the FUSED
+create+extract pairs (`struct(a, b).x`, `array(a, b)[i]`, `size(array(...))`)
+by algebraic rewrite inside eval — no nested column is ever materialized.
+Standalone nested outputs (a projection ENDING in struct/array) are pinned to
+the host by the planner's tag functions, mirroring how the reference gates
+nested types per-op through TypeSig (TypeChecks.scala:129).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Col, Expression, Literal
+
+
+class CreateNamedStruct(Expression):
+    """named_struct('a', x, 'b', y) — alternating name literals and values."""
+
+    def __init__(self, *name_value_pairs):
+        assert len(name_value_pairs) % 2 == 0, "name/value pairs required"
+        self.children = list(name_value_pairs)
+
+    @property
+    def field_names(self):
+        names = []
+        for e in self.children[0::2]:
+            assert isinstance(e, Literal), "struct field names must be literals"
+            names.append(e.value)
+        return names
+
+    @property
+    def field_values(self):
+        return self.children[1::2]
+
+    @property
+    def dtype(self):
+        return T.StructDataType(self.field_names,
+                                [v.dtype for v in self.field_values])
+
+    def with_children(self, children):
+        return CreateNamedStruct(*children)
+
+    def eval(self, ctx):
+        raise NotImplementedError(
+            "struct values have no flat device form; only fused "
+            "struct(...).field extraction runs on device")
+
+    def __repr__(self):
+        return f"named_struct({', '.join(map(repr, self.children))})"
+
+
+class GetStructField(Expression):
+    """struct.field — device path requires the child to be CreateNamedStruct
+    (fused extract); real struct columns stay on host."""
+
+    def __init__(self, child, name: str):
+        self.children = [child]
+        self.field = name
+
+    @property
+    def dtype(self):
+        ct = self.children[0].dtype
+        if isinstance(ct, T.StructDataType):
+            return ct.types[ct.names.index(self.field)]
+        return T.NULL
+
+    def with_children(self, children):
+        return GetStructField(children[0], self.field)
+
+    def eval(self, ctx):
+        src = self.children[0]
+        if not isinstance(src, CreateNamedStruct):
+            raise NotImplementedError(
+                "GetStructField on a real struct column runs on host")
+        i = src.field_names.index(self.field)
+        return src.field_values[i].eval(ctx)
+
+    def __repr__(self):
+        return f"{self.children[0]!r}.{self.field}"
+
+
+class CreateArray(Expression):
+    """array(a, b, c) — homogeneous element type (common promotion)."""
+
+    def __init__(self, *children):
+        self.children = list(children)
+
+    @property
+    def dtype(self):
+        from spark_rapids_tpu.expr.conditional import _common_type
+        elem = (_common_type([c.dtype for c in self.children])
+                if self.children else T.NULL)
+        return T.ArrayType(elem)
+
+    def with_children(self, children):
+        return CreateArray(*children)
+
+    def eval(self, ctx):
+        raise NotImplementedError(
+            "array values have no flat device form; only fused array(...)[i] "
+            "extraction runs on device")
+
+    def __repr__(self):
+        return f"array({', '.join(map(repr, self.children))})"
+
+
+class GetArrayItem(Expression):
+    """arr[i] — null when i is out of bounds (Spark non-ANSI). Device path
+    requires CreateArray child; a literal index selects one element, a column
+    index multiplexes across elements with jnp.where chains."""
+
+    def __init__(self, child, index):
+        self.children = [child, index]
+
+    @property
+    def dtype(self):
+        ct = self.children[0].dtype
+        return ct.element_type if isinstance(ct, T.ArrayType) else T.NULL
+
+    def with_children(self, children):
+        return GetArrayItem(children[0], children[1])
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.expr.arithmetic import _cast_col
+        src, idx = self.children
+        if not isinstance(src, CreateArray):
+            raise NotImplementedError(
+                "GetArrayItem on a real array column runs on host")
+        elem_t = self.dtype
+        elems = [_cast_col(e.eval(ctx), elem_t) for e in src.children]
+        n = len(elems)
+        if isinstance(idx, Literal):
+            i = idx.value
+            if i is None or i < 0 or i >= n:
+                return Col(jnp.full((ctx.capacity,), elem_t.default_value(),
+                                    elem_t.jnp_dtype),
+                           jnp.zeros((ctx.capacity,), jnp.bool_), elem_t)
+            return elems[int(i)]
+        ic = _cast_col(idx.eval(ctx), T.INT)
+        out = Col(jnp.full((ctx.capacity,), elem_t.default_value(),
+                           elem_t.jnp_dtype),
+                  jnp.zeros((ctx.capacity,), jnp.bool_), elem_t,
+                  elems[0].dictionary if elems and elems[0].is_string else None)
+        for i, e in enumerate(elems):
+            if e.is_string and e.dictionary is not out.dictionary:
+                from spark_rapids_tpu.ops.strings import union_dictionaries
+                e, out = union_dictionaries(e, out)
+            hit = ic.validity & (ic.values == i)
+            out = Col(jnp.where(hit, e.values, out.values),
+                      jnp.where(hit, e.validity, out.validity),
+                      elem_t, out.dictionary)
+        return out
+
+    def __repr__(self):
+        return f"{self.children[0]!r}[{self.children[1]!r}]"
+
+
+class Size(Expression):
+    """size(array) — element count; -1 for null input (Spark legacy mode).
+    Device path covers CreateArray (constant size, never null)."""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    @property
+    def dtype(self):
+        return T.INT
+
+    @property
+    def nullable(self):
+        return False
+
+    def with_children(self, children):
+        return Size(children[0])
+
+    def eval(self, ctx):
+        src = self.children[0]
+        if not isinstance(src, CreateArray):
+            raise NotImplementedError(
+                "size() on a real array column runs on host")
+        return Col(jnp.full((ctx.capacity,), len(src.children), jnp.int32),
+                   jnp.ones((ctx.capacity,), jnp.bool_), T.INT)
+
+    def __repr__(self):
+        return f"size({self.children[0]!r})"

@@ -94,3 +94,54 @@ class CaseWhen(Expression):
     def __repr__(self):
         bs = " ".join(f"WHEN {p!r} THEN {v!r}" for p, v in self.branches)
         return f"CASE {bs} ELSE {self.else_value!r} END"
+
+
+class _LeastGreatest(Expression):
+    """Spark least/greatest: skip nulls (null only when ALL inputs null);
+    NaN orders greater than any number (reference conditionalExpressions.scala
+    GpuLeast/GpuGreatest)."""
+
+    def __init__(self, *children):
+        self.children = list(children)
+
+    @property
+    def dtype(self):
+        return _common_type([c.dtype for c in self.children])
+
+    def with_children(self, children):
+        return type(self)(*children)
+
+    def eval(self, ctx):
+        import jax.numpy as jnp
+        from spark_rapids_tpu.expr.arithmetic import _cast_col
+        out_t = self.dtype
+        cols = [_cast_col(c.eval(ctx), out_t) for c in self.children]
+        out = cols[0]
+        for c in cols[1:]:
+            better = self.prefer(c.values, out.values)
+            take_c = c.validity & (~out.validity | better)
+            vals = jnp.where(take_c, c.values, out.values)
+            out = Col(vals, out.validity | c.validity, out_t)
+        return out.canonicalized()
+
+    @staticmethod
+    def _lt(a, b):
+        """a < b with Spark total order for floats: NaN greatest."""
+        import jax.numpy as jnp
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            return (a < b) | (jnp.isnan(b) & ~jnp.isnan(a))
+        return a < b
+
+    def __repr__(self):
+        name = type(self).__name__.lower()
+        return f"{name}({', '.join(map(repr, self.children))})"
+
+
+class Least(_LeastGreatest):
+    def prefer(self, cand, cur):
+        return self._lt(cand, cur)
+
+
+class Greatest(_LeastGreatest):
+    def prefer(self, cand, cur):
+        return self._lt(cur, cand)

@@ -240,15 +240,22 @@ class EvalContext:
     """Holds the input columns (as Cols) for bound-reference lookup during eval, the
     number-of-rows scalar, and the batch capacity (static)."""
 
-    def __init__(self, cols, num_rows, capacity: int):
+    def __init__(self, cols, num_rows, capacity: int, split: int = 0,
+                 row_offset: int = 0):
         self.cols = list(cols)
         self.num_rows = num_rows  # device or host scalar
         self.capacity = capacity
+        self.split = split  # task partition index (rand / partition-id exprs)
+        # rows already emitted by earlier batches of this partition; only
+        # maintained (host-synced) when the projection contains a
+        # row-position-dependent expression (monotonically_increasing_id, rand)
+        self.row_offset = row_offset
 
     @staticmethod
-    def from_batch(batch):
+    def from_batch(batch, split: int = 0, row_offset: int = 0):
         return EvalContext([Col.from_vector(c) for c in batch.columns],
-                           batch.lazy_num_rows, batch.capacity)
+                           batch.lazy_num_rows, batch.capacity, split,
+                           row_offset)
 
     def row_mask(self):
         """Bool mask of live (non-padding) rows."""

@@ -239,3 +239,286 @@ class UnixTimestampSeconds(Expression):
         c = self.children[0].eval(ctx)
         return Col(jnp.floor_divide(c.values, 1_000_000), c.validity,
                    T.LONG).canonicalized()
+
+
+# ---------------------------------------------------------------------------
+# Parse/format (reference datetimeExpressions.scala: GpuUnixTimestamp,
+# GpuFromUnixTime, GpuDateFormatClass — cudf strftime/strptime; here the format
+# runs through a host-built dictionary over distinct values, ops/strings.py)
+# ---------------------------------------------------------------------------
+
+_JAVA_FMT = [  # longest-match-first Java SimpleDateFormat → strftime tokens
+    ("yyyy", "%Y"), ("yy", "%y"), ("MM", "%m"), ("dd", "%d"), ("HH", "%H"),
+    ("mm", "%M"), ("ss", "%S"), ("EEEE", "%A"), ("EEE", "%a"), ("a", "%p"),
+    ("DDD", "%j"), ("hh", "%I"),
+]
+
+DEFAULT_TS_FMT = "yyyy-MM-dd HH:mm:ss"
+
+
+def java_fmt_to_strftime(fmt: str) -> str:
+    """Common-subset SimpleDateFormat → strftime; raises ValueError on tokens
+    outside the subset (the planner tags those to fall back to host)."""
+    out = []
+    i = 0
+    while i < len(fmt):
+        if fmt[i] == "'":  # java literal quoting
+            j = fmt.index("'", i + 1) if "'" in fmt[i + 1:] else len(fmt)
+            out.append(fmt[i + 1:j].replace("%", "%%"))
+            i = j + 1
+            continue
+        for tok, rep in _JAVA_FMT:
+            if fmt.startswith(tok, i):
+                out.append(rep)
+                i += len(tok)
+                break
+        else:
+            ch = fmt[i]
+            if ch.isalpha():
+                raise ValueError(f"unsupported datetime format token {ch!r}")
+            out.append("%%" if ch == "%" else ch)
+            i += 1
+    return "".join(out)
+
+
+def _epoch_dt(micros: int):
+    import datetime
+    return (datetime.datetime(1970, 1, 1)
+            + datetime.timedelta(microseconds=int(micros)))
+
+
+class _ToUnixSeconds(Expression):
+    """unix_timestamp / to_unix_timestamp over timestamp, date, or string
+    input (string parses with the literal Java format; bad parses → null)."""
+
+    def __init__(self, child, fmt=None):
+        from spark_rapids_tpu.expr.core import Literal as L
+        self.children = [child, fmt if fmt is not None
+                         else L(DEFAULT_TS_FMT, T.STRING)]
+
+    @property
+    def dtype(self):
+        return T.LONG
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.expr.core import Literal
+        from spark_rapids_tpu.ops.strings import dict_transform_to_values
+        fe = self.children[1]
+        assert isinstance(fe, Literal), "format must be a literal"
+        c = self.children[0].eval(ctx)
+        src = self.children[0].dtype
+        if isinstance(src, T.TimestampType):
+            return Col(jnp.floor_divide(c.values, 1_000_000), c.validity,
+                       T.LONG).canonicalized()
+        if isinstance(src, T.DateType):
+            return Col(c.values.astype(jnp.int64) * 86_400, c.validity,
+                       T.LONG).canonicalized()
+        assert isinstance(src, T.StringType), src
+        import datetime
+        pyfmt = java_fmt_to_strftime(fe.value)
+
+        def parse(s):
+            try:
+                dt = datetime.datetime.strptime(s, pyfmt)
+            except (ValueError, TypeError):
+                return None
+            return int((dt - datetime.datetime(1970, 1, 1)).total_seconds())
+        return dict_transform_to_values(c, parse, T.LONG)
+
+    def __repr__(self):
+        return (f"{type(self).__name__.lower()}({self.children[0]!r}, "
+                f"{self.children[1]!r})")
+
+
+class UnixTimestamp(_ToUnixSeconds):
+    pass
+
+
+class ToUnixTimestamp(_ToUnixSeconds):
+    pass
+
+
+class FromUnixTime(Expression):
+    """from_unixtime(seconds, fmt) → formatted string (UTC session zone)."""
+
+    def __init__(self, child, fmt=None):
+        from spark_rapids_tpu.expr.core import Literal as L
+        self.children = [child, fmt if fmt is not None
+                         else L(DEFAULT_TS_FMT, T.STRING)]
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def with_children(self, children):
+        return FromUnixTime(children[0], children[1])
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.expr.core import Literal
+        from spark_rapids_tpu.expr.arithmetic import _cast_col
+        from spark_rapids_tpu.ops.strings import value_transform_to_string
+        fe = self.children[1]
+        assert isinstance(fe, Literal), "format must be a literal"
+        pyfmt = java_fmt_to_strftime(fe.value)
+        c = _cast_col(self.children[0].eval(ctx), T.LONG)
+        return value_transform_to_string(
+            c, lambda sec: _epoch_dt(int(sec) * 1_000_000).strftime(pyfmt))
+
+    def __repr__(self):
+        return f"from_unixtime({self.children[0]!r}, {self.children[1]!r})"
+
+
+class DateFormatClass(Expression):
+    """date_format(ts|date, fmt) → string."""
+
+    def __init__(self, child, fmt):
+        self.children = [child, fmt]
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def with_children(self, children):
+        return DateFormatClass(children[0], children[1])
+
+    def eval(self, ctx):
+        import datetime
+        from spark_rapids_tpu.expr.core import Literal
+        from spark_rapids_tpu.ops.strings import value_transform_to_string
+        fe = self.children[1]
+        assert isinstance(fe, Literal), "format must be a literal"
+        pyfmt = java_fmt_to_strftime(fe.value)
+        c = self.children[0].eval(ctx)
+        if isinstance(self.children[0].dtype, T.DateType):
+            fmt = lambda d: (datetime.date(1970, 1, 1)
+                             + datetime.timedelta(days=int(d))).strftime(pyfmt)
+        else:
+            fmt = lambda us: _epoch_dt(us).strftime(pyfmt)
+        return value_transform_to_string(c, fmt)
+
+    def __repr__(self):
+        return f"date_format({self.children[0]!r}, {self.children[1]!r})"
+
+
+class AddMonths(Expression):
+    """add_months(date, n): calendar month add, day clamped to month end."""
+
+    def __init__(self, date, months):
+        self.children = [date, months]
+
+    @property
+    def dtype(self):
+        return T.DATE
+
+    def with_children(self, children):
+        return AddMonths(children[0], children[1])
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.expr.arithmetic import _cast_col
+        from spark_rapids_tpu.expr.core import valid_and
+        d = self.children[0].eval(ctx)
+        n = _cast_col(self.children[1].eval(ctx), T.INT)
+        days = _date_col(self.children[0].dtype, d)
+        y, m, dom = civil_from_days(days)
+        total = (y * 12 + (m - 1) + n.values).astype(jnp.int64)
+        ny = jnp.floor_divide(total, 12)
+        nm = total - ny * 12 + 1
+        # clamp day-of-month to the target month's length
+        month_start = days_from_civil(ny, nm, jnp.ones_like(nm))
+        ny2 = jnp.where(nm == 12, ny + 1, ny)
+        nm2 = jnp.where(nm == 12, 1, nm + 1)
+        month_len = days_from_civil(ny2, nm2, jnp.ones_like(nm)) - month_start
+        nd = jnp.minimum(dom, month_len)
+        out = days_from_civil(ny, nm, nd)
+        return Col(out.astype(jnp.int32), valid_and(d.validity, n.validity),
+                   T.DATE).canonicalized()
+
+    def __repr__(self):
+        return f"add_months({self.children[0]!r}, {self.children[1]!r})"
+
+
+class MonthsBetween(Expression):
+    """months_between(d1, d2[, roundOff]): whole months plus a /31-day
+    fraction, zero fraction when both are month-ends or the same day-of-month
+    (Spark semantics, date inputs)."""
+
+    def __init__(self, end, start, round_off=True):
+        self.children = [end, start]
+        self.round_off = round_off
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    def with_children(self, children):
+        return MonthsBetween(children[0], children[1], self.round_off)
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.expr.core import valid_and
+        e = self.children[0].eval(ctx)
+        s = self.children[1].eval(ctx)
+        ed = _date_col(self.children[0].dtype, e)
+        sd = _date_col(self.children[1].dtype, s)
+        ey, em, edom = civil_from_days(ed)
+        sy, sm, sdom = civil_from_days(sd)
+
+        def month_len(y, m):
+            start = days_from_civil(y, m, jnp.ones_like(m))
+            y2 = jnp.where(m == 12, y + 1, y)
+            m2 = jnp.where(m == 12, 1, m + 1)
+            return days_from_civil(y2, m2, jnp.ones_like(m)) - start
+
+        both_last = (edom == month_len(ey, em)) & (sdom == month_len(sy, sm))
+        months = ((ey - sy) * 12 + (em - sm)).astype(jnp.float64)
+        frac = jnp.where(both_last | (edom == sdom), 0.0,
+                         (edom - sdom).astype(jnp.float64) / 31.0)
+        out = months + frac
+        if self.round_off:
+            out = jnp.round(out * 1e8) / 1e8
+        return Col(out, valid_and(e.validity, s.validity),
+                   T.DOUBLE).canonicalized()
+
+    def __repr__(self):
+        return f"months_between({self.children[0]!r}, {self.children[1]!r})"
+
+
+class TruncDate(Expression):
+    """trunc(date, 'year'|'month'|'quarter'|'week') → date (bad fmt → null)."""
+
+    def __init__(self, date, fmt):
+        self.children = [date, fmt]
+
+    @property
+    def dtype(self):
+        return T.DATE
+
+    def with_children(self, children):
+        return TruncDate(children[0], children[1])
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.expr.core import Literal
+        fe = self.children[1]
+        assert isinstance(fe, Literal), "trunc format must be a literal"
+        lvl = (fe.value or "").lower()
+        d = self.children[0].eval(ctx)
+        days = _date_col(self.children[0].dtype, d)
+        y, m, _dom = civil_from_days(days)
+        if lvl in ("year", "yyyy", "yy"):
+            out = days_from_civil(y, jnp.ones_like(m), jnp.ones_like(m))
+        elif lvl in ("month", "mon", "mm"):
+            out = days_from_civil(y, m, jnp.ones_like(m))
+        elif lvl == "quarter":
+            qm = ((m - 1) // 3) * 3 + 1
+            out = days_from_civil(y, qm, jnp.ones_like(m))
+        elif lvl == "week":  # Monday start; epoch day 0 = Thursday
+            out = days - ((days + 3) % 7)
+        else:
+            return Col(jnp.zeros_like(days), jnp.zeros_like(d.validity),
+                       T.DATE)
+        return Col(out.astype(jnp.int32), d.validity, T.DATE).canonicalized()
+
+    def __repr__(self):
+        return f"trunc({self.children[0]!r}, {self.children[1]!r})"

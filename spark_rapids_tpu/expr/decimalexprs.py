@@ -1,0 +1,118 @@
+"""Decimal plan expressions (reference decimalExpressions.scala:
+GpuPromotePrecision — no-op marker around an already-cast child;
+GpuCheckOverflow — null out results beyond the target precision;
+GpuUnscaledValue / GpuMakeDecimal — long <-> unscaled-decimal reinterpret).
+
+Decimals are carried as unscaled int64 values (DecimalType(precision, scale),
+precision <= 18), matching the reference's DECIMAL64-only device support.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Col, Expression
+
+
+class PromotePrecision(Expression):
+    """Marker around a child Catalyst already cast to the join/arith type —
+    evaluates to the child's cast (reference GpuPromotePrecision)."""
+
+    def __init__(self, child, to: T.DecimalType | None = None):
+        self.children = [child]
+        self._to = to
+
+    @property
+    def dtype(self):
+        return self._to if self._to is not None else self.children[0].dtype
+
+    def with_children(self, children):
+        return PromotePrecision(children[0], self._to)
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.expr.cast import cast_col
+        c = self.children[0].eval(ctx)
+        return cast_col(c, self.dtype) if c.dtype != self.dtype else c
+
+    def __repr__(self):
+        return f"promote_precision({self.children[0]!r})"
+
+
+class CheckOverflow(Expression):
+    """Null out (non-ANSI) values whose unscaled magnitude exceeds the target
+    precision after rescale (reference GpuCheckOverflow)."""
+
+    def __init__(self, child, to: T.DecimalType, null_on_overflow: bool = True):
+        self.children = [child]
+        self.to = to
+        self.null_on_overflow = null_on_overflow
+
+    @property
+    def dtype(self):
+        return self.to
+
+    def with_children(self, children):
+        return CheckOverflow(children[0], self.to, self.null_on_overflow)
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.expr.cast import cast_col
+        c = self.children[0].eval(ctx)
+        if c.dtype != self.to:
+            c = cast_col(c, self.to)
+        limit = 10 ** self.to.precision
+        ok = (c.values > -limit) & (c.values < limit)
+        return Col(jnp.where(ok, c.values, 0), c.validity & ok, self.to)
+
+    def __repr__(self):
+        return f"check_overflow({self.children[0]!r}, {self.to})"
+
+
+class UnscaledValue(Expression):
+    """decimal → its unscaled long (reference GpuUnscaledValue)."""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    @property
+    def dtype(self):
+        return T.LONG
+
+    def with_children(self, children):
+        return UnscaledValue(children[0])
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        return Col(c.values.astype(jnp.int64), c.validity, T.LONG)
+
+    def __repr__(self):
+        return f"unscaled_value({self.children[0]!r})"
+
+
+class MakeDecimal(Expression):
+    """long (unscaled) → decimal(precision, scale); null when the value does
+    not fit the precision (reference GpuMakeDecimal)."""
+
+    def __init__(self, child, precision: int, scale: int,
+                 null_on_overflow: bool = True):
+        self.children = [child]
+        self.to = T.DecimalType(precision, scale)
+        self.null_on_overflow = null_on_overflow
+
+    @property
+    def dtype(self):
+        return self.to
+
+    def with_children(self, children):
+        return MakeDecimal(children[0], self.to.precision, self.to.scale,
+                           self.null_on_overflow)
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        v = c.values.astype(jnp.int64)
+        limit = 10 ** self.to.precision
+        ok = (v > -limit) & (v < limit)
+        return Col(jnp.where(ok, v, 0), c.validity & ok, self.to)
+
+    def __repr__(self):
+        return f"make_decimal({self.children[0]!r}, {self.to})"

@@ -286,3 +286,45 @@ class Round(Expression):
 
     def __repr__(self):
         return f"round({self.children[0]!r}, {self.digits})"
+
+
+class Sinh(_UnaryMath):
+    def op(self, v):
+        return jnp.sinh(v)
+
+
+class Cosh(_UnaryMath):
+    def op(self, v):
+        return jnp.cosh(v)
+
+
+class Tanh(_UnaryMath):
+    def op(self, v):
+        return jnp.tanh(v)
+
+
+class Asinh(_UnaryMath):
+    def op(self, v):
+        return jnp.arcsinh(v)
+
+
+class Acosh(_UnaryMath):
+    def op(self, v):
+        return jnp.arccosh(v)
+
+
+class Atanh(_UnaryMath):
+    def op(self, v):
+        return jnp.arctanh(v)
+
+
+class Expm1(_UnaryMath):
+    def op(self, v):
+        return jnp.expm1(v)
+
+
+class Rint(_UnaryMath):
+    """Java Math.rint: round-half-even to a double."""
+
+    def op(self, v):
+        return jnp.round(v)

@@ -1,0 +1,141 @@
+"""Non-deterministic and hash expressions.
+
+Reference: GpuMurmur3Hash (HashFunctions.scala — Spark-exact murmur3 over
+columns, the `hash()` SQL function), GpuRand (randomExpressions; the reference
+marks rand as non-deterministic: per-partition seeded, NOT bit-identical with
+CPU Spark), GpuMonotonicallyIncreasingID and GpuSparkPartitionID
+(datetimeExpressions neighbors in namedExpressions/MiscExpressions).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import Col, Expression
+
+
+class Murmur3Hash(Expression):
+    """hash(col, ...) — Spark Murmur3Hash with seed 42, bit-exact (same kernel
+    as the hash partitioner, ops/hashing.py + shuffle/partitioning.py)."""
+
+    def __init__(self, *children, seed: int = 42):
+        self.children = list(children)
+        self.seed = seed
+
+    @property
+    def dtype(self):
+        return T.INT
+
+    @property
+    def nullable(self):
+        return False
+
+    def with_children(self, children):
+        return Murmur3Hash(*children, seed=self.seed)
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.shuffle.partitioning import murmur3_row_hash
+        from spark_rapids_tpu.ops.hashing import pack_utf8_words
+        import numpy as np
+        cols = [c.eval(ctx) for c in self.children]
+        dict_words = {}
+        for i, c in enumerate(cols):
+            if c.is_string:
+                strs = (c.dictionary.to_pylist()
+                        if c.dictionary is not None else [])
+                words, lens = pack_utf8_words(strs)
+                if words.shape[0] == 0:
+                    words = np.zeros((1, 1), dtype=np.int32)
+                    lens = np.zeros(1, dtype=np.int32)
+                dict_words[i] = (jnp.asarray(words), jnp.asarray(lens))
+        h = murmur3_row_hash(cols, ctx.capacity, seed=self.seed,
+                             dict_words=dict_words)
+        return Col(h, jnp.ones((ctx.capacity,), jnp.bool_), T.INT)
+
+    def __repr__(self):
+        return f"hash({', '.join(map(repr, self.children))})"
+
+
+class Rand(Expression):
+    """rand([seed]) — uniform [0,1) doubles from a counter-based PRNG keyed by
+    (seed, partition). Like the reference's GpuRand this is a real RNG with the
+    same distribution but NOT bit-identical to CPU Spark's XORShiftRandom
+    stream (the reference carries the same caveat)."""
+
+    def __init__(self, seed: int = 0):
+        self.children = []
+        self.seed = int(seed)
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    @property
+    def nullable(self):
+        return False
+
+    def with_children(self, children):
+        return Rand(self.seed)
+
+    def eval(self, ctx):
+        key = jax.random.PRNGKey(self.seed ^ (ctx.split * 0x9E3779B9))
+        key = jax.random.fold_in(key, ctx.row_offset)  # fresh draw per batch
+        vals = jax.random.uniform(key, (ctx.capacity,), dtype=jnp.float64)
+        return Col(vals, jnp.ones((ctx.capacity,), jnp.bool_), T.DOUBLE)
+
+    def __repr__(self):
+        return f"rand({self.seed})"
+
+
+class SparkPartitionID(Expression):
+    """spark_partition_id() — the task's partition index."""
+
+    def __init__(self):
+        self.children = []
+
+    @property
+    def dtype(self):
+        return T.INT
+
+    @property
+    def nullable(self):
+        return False
+
+    def with_children(self, children):
+        return SparkPartitionID()
+
+    def eval(self, ctx):
+        return Col(jnp.full((ctx.capacity,), ctx.split, jnp.int32),
+                   jnp.ones((ctx.capacity,), jnp.bool_), T.INT)
+
+    def __repr__(self):
+        return "spark_partition_id()"
+
+
+class MonotonicallyIncreasingID(Expression):
+    """monotonically_increasing_id(): (partition_id << 33) + row_offset —
+    Spark's exact layout (31-bit partition, 33-bit per-partition counter)."""
+
+    def __init__(self):
+        self.children = []
+
+    @property
+    def dtype(self):
+        return T.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+    def with_children(self, children):
+        return MonotonicallyIncreasingID()
+
+    def eval(self, ctx):
+        base = (jnp.int64(ctx.split) << 33) + ctx.row_offset
+        ids = base + jnp.arange(ctx.capacity, dtype=jnp.int64)
+        return Col(ids, jnp.ones((ctx.capacity,), jnp.bool_), T.LONG)
+
+    def __repr__(self):
+        return "monotonically_increasing_id()"

@@ -209,3 +209,243 @@ class StringReplace(Expression):
         assert isinstance(se, Literal) and isinstance(re_, Literal)
         c = self.children[0].eval(ctx)
         return S.dict_transform_to_string(c, lambda s: s.replace(se.value, re_.value))
+
+
+class ConcatWs(Expression):
+    """concat_ws(sep, ...) — nulls are SKIPPED (unlike concat); never null when
+    the separator is a non-null literal (Spark semantics; reference
+    stringFunctions.scala GpuConcatWs)."""
+
+    def __init__(self, sep: Expression, *children):
+        self.children = [sep] + list(children)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def with_children(self, children):
+        return ConcatWs(children[0], *children[1:])
+
+    def eval(self, ctx):
+        import jax.numpy as jnp
+        sep = self.children[0]
+        assert isinstance(sep, Literal) and sep.value is not None, \
+            "concat_ws separator must be a non-null literal"
+        sep_col = Literal(sep.value, T.STRING).eval(ctx)
+        acc = Literal("", T.STRING).eval(ctx)
+        started = Col(jnp.zeros((ctx.capacity,), jnp.bool_),
+                      jnp.ones((ctx.capacity,), jnp.bool_), T.BOOLEAN)
+        for ch in self.children[1:]:
+            c = ch.eval(ctx)
+            joined = S.concat_cols(S.concat_cols(acc, sep_col), c)
+            valid_c = Col(c.validity, jnp.ones_like(c.validity), T.BOOLEAN)
+            use_joined = Col(c.validity & started.values,
+                             jnp.ones_like(c.validity), T.BOOLEAN)
+            # null c -> keep acc; first non-null -> c; else acc+sep+c
+            step = S.if_strings(use_joined, joined,
+                                S.if_strings(valid_c, c, acc))
+            # keep the accumulator non-null (skip-null semantics)
+            acc = Col(step.values, jnp.ones_like(step.validity), T.STRING,
+                      step.dictionary)
+            started = Col(started.values | c.validity,
+                          started.validity, T.BOOLEAN)
+        return acc
+
+    def __repr__(self):
+        return f"concat_ws({', '.join(map(repr, self.children))})"
+
+
+class _LiteralArgsStringFn(Expression):
+    """str column + literal args → dictionary transform."""
+
+    out_dtype = T.STRING
+
+    def __init__(self, child, *lits):
+        self.children = [child] + list(lits)
+
+    @property
+    def dtype(self):
+        return self.out_dtype
+
+    def with_children(self, children):
+        return type(self)(*children)
+
+    def _lit_args(self):
+        vals = []
+        for e in self.children[1:]:
+            assert isinstance(e, Literal) and e.value is not None, \
+                f"{type(self).__name__} arguments must be non-null literals"
+            vals.append(e.value)
+        return vals
+
+    def eval(self, ctx):
+        args = self._lit_args()
+        c = self.children[0].eval(ctx)
+        if isinstance(self.out_dtype, T.StringType):
+            return S.dict_transform_to_string(c, lambda s: self.fn(s, *args))
+        return S.dict_transform_to_values(c, lambda s: self.fn(s, *args),
+                                          self.out_dtype)
+
+    def fn(self, s, *args):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return (f"{type(self).__name__.lower()}"
+                f"({', '.join(map(repr, self.children))})")
+
+
+class StringLPad(_LiteralArgsStringFn):
+    """lpad(str, len, pad): pad to len (truncate if longer), Spark semantics."""
+
+    def fn(self, s, ln, pad):
+        if len(s) >= ln:
+            return s[:ln]
+        if not pad:
+            return s
+        need = ln - len(s)
+        return ((pad * need)[:need]) + s
+
+
+class StringRPad(_LiteralArgsStringFn):
+    def fn(self, s, ln, pad):
+        if len(s) >= ln:
+            return s[:ln]
+        if not pad:
+            return s
+        need = ln - len(s)
+        return s + (pad * need)[:need]
+
+
+class StringRepeat(_LiteralArgsStringFn):
+    def fn(self, s, n):
+        return s * max(int(n), 0)
+
+
+class StringLocate(Expression):
+    """locate(substr, str[, start]) — 1-based, 0 when absent (GpuStringLocate)."""
+
+    out_dtype = T.INT
+
+    def __init__(self, substr, child, start=None):
+        from spark_rapids_tpu.expr.core import Literal as L
+        self.children = [substr, child, start if start is not None else L(1, T.INT)]
+
+    @property
+    def dtype(self):
+        return T.INT
+
+    def with_children(self, children):
+        return StringLocate(children[0], children[1], children[2])
+
+    def eval(self, ctx):
+        sub, start = self.children[0], self.children[2]
+        assert isinstance(sub, Literal) and isinstance(start, Literal), \
+            "locate substr/start must be literals"
+        p, st = sub.value, start.value
+        c = self.children[1].eval(ctx)
+
+        def locate(s):
+            if st is None or p is None:
+                return None
+            if st <= 0:
+                return 0
+            return s.find(p, st - 1) + 1
+        return S.dict_transform_to_values(c, locate, T.INT)
+
+    def __repr__(self):
+        return f"locate({self.children[0]!r}, {self.children[1]!r})"
+
+
+class SubstringIndex(_LiteralArgsStringFn):
+    """substring_index(str, delim, count) — Spark/Hive semantics."""
+
+    def fn(self, s, delim, count):
+        if not delim or count == 0:
+            return ""
+        parts = s.split(delim)
+        if count > 0:
+            return delim.join(parts[:count])
+        return delim.join(parts[count:])
+
+
+class StringTranslate(_LiteralArgsStringFn):
+    """translate(str, from, to) — per-char mapping; chars beyond `to` delete."""
+
+    def fn(self, s, frm, to):
+        table = {}
+        for i, ch in enumerate(frm):
+            if ch not in table:
+                table[ord(ch)] = to[i] if i < len(to) else None
+        return s.translate(table)
+
+
+class FindInSet(_LiteralArgsStringFn):
+    """find_in_set(str, comma_list) over a literal list: 1-based index, 0 when
+    absent or when str contains a comma."""
+
+    out_dtype = T.INT
+
+    def __init__(self, child, str_list):
+        super().__init__(child, str_list)
+
+    def fn(self, s, str_list):
+        if "," in s:
+            return 0
+        items = str_list.split(",")
+        return items.index(s) + 1 if s in items else 0
+
+
+def _java_replacement_to_python(rep: str) -> str:
+    """Spark/Java `$1` group references → python `\\1` (literal \\$ kept)."""
+    out = []
+    i = 0
+    while i < len(rep):
+        ch = rep[i]
+        if ch == "\\" and i + 1 < len(rep):
+            nxt = rep[i + 1]
+            out.append(nxt if nxt == "$" else "\\" + nxt)
+            i += 2
+        elif ch == "$" and i + 1 < len(rep) and rep[i + 1].isdigit():
+            out.append("\\" + rep[i + 1])
+            i += 2
+        else:
+            out.append("\\\\" if ch == "\\" else ch)
+            i += 1
+    return "".join(out)
+
+
+class RegExpReplace(_LiteralArgsStringFn):
+    """regexp_replace(str, pattern, replacement) with literal pattern
+    (reference GpuRegExpReplace; Java-regex → python-re for the common
+    subset — the planner's tag fn rejects known-incompatible constructs)."""
+
+    def __init__(self, child, pattern, replacement):
+        super().__init__(child, pattern, replacement)
+
+    def eval(self, ctx):
+        pat, rep = self._lit_args()
+        rx = re.compile(pat)
+        py_rep = _java_replacement_to_python(rep)
+        c = self.children[0].eval(ctx)
+        return S.dict_transform_to_string(c, lambda s: rx.sub(py_rep, s))
+
+
+class RegExpExtract(_LiteralArgsStringFn):
+    """regexp_extract(str, pattern, idx): group idx of the FIRST match, or ""
+    when no match (Spark semantics; null only for null input)."""
+
+    def __init__(self, child, pattern, idx):
+        super().__init__(child, pattern, idx)
+
+    def eval(self, ctx):
+        pat, idx = self._lit_args()
+        rx = re.compile(pat)
+
+        def extract(s):
+            m = rx.search(s)
+            if m is None:
+                return ""
+            g = m.group(int(idx))
+            return g if g is not None else ""
+        c = self.children[0].eval(ctx)
+        return S.dict_transform_to_string(c, extract)

@@ -194,3 +194,200 @@ def over(func, partition_by=(), order_by=(), frame=None):
 
 def alias(e, name: str):
     return Alias(_e(e), name)
+
+
+# -- round-2 surface ---------------------------------------------------------
+
+def last(c, ignore_nulls: bool = False):
+    return _AG.Last(_e(c), ignore_nulls)
+
+
+def stddev(c):
+    return _AG.StddevSamp(_e(c))
+
+
+stddev_samp = stddev
+
+
+def stddev_pop(c):
+    return _AG.StddevPop(_e(c))
+
+
+def variance(c):
+    return _AG.VarianceSamp(_e(c))
+
+
+var_samp = variance
+
+
+def var_pop(c):
+    return _AG.VariancePop(_e(c))
+
+
+def bitwise_not(c):
+    return _A.BitwiseNot(_e(c))
+
+
+def shiftleft(c, n):
+    return _A.ShiftLeft(_e(c), _v(n))
+
+
+def shiftright(c, n):
+    return _A.ShiftRight(_e(c), _v(n))
+
+
+def shiftrightunsigned(c, n):
+    return _A.ShiftRightUnsigned(_e(c), _v(n))
+
+
+def least(*cs):
+    return _C.Least(*[_e(c) for c in cs])
+
+
+def greatest(*cs):
+    return _C.Greatest(*[_e(c) for c in cs])
+
+
+def concat_ws(sep: str, *cs):
+    return _S.ConcatWs(_v(sep), *[_e(c) for c in cs])
+
+
+def lpad(c, ln: int, pad: str = " "):
+    return _S.StringLPad(_e(c), _v(ln), _v(pad))
+
+
+def rpad(c, ln: int, pad: str = " "):
+    return _S.StringRPad(_e(c), _v(ln), _v(pad))
+
+
+def repeat(c, n: int):
+    return _S.StringRepeat(_e(c), _v(n))
+
+
+def locate(substr: str, c, pos: int = 1):
+    return _S.StringLocate(_v(substr), _e(c), _v(pos))
+
+
+def instr(c, substr: str):
+    return _S.StringLocate(_v(substr), _e(c), _v(1))
+
+
+def substring_index(c, delim: str, count: int):
+    return _S.SubstringIndex(_e(c), _v(delim), _v(count))
+
+
+def translate(c, frm: str, to: str):
+    return _S.StringTranslate(_e(c), _v(frm), _v(to))
+
+
+def find_in_set(c, str_list: str):
+    return _S.FindInSet(_e(c), _v(str_list))
+
+
+def regexp_replace(c, pattern: str, replacement: str):
+    return _S.RegExpReplace(_e(c), _v(pattern), _v(replacement))
+
+
+def regexp_extract(c, pattern: str, idx: int = 1):
+    return _S.RegExpExtract(_e(c), _v(pattern), _v(idx))
+
+
+def unix_timestamp(c, fmt: str | None = None):
+    return _DT.UnixTimestamp(_e(c), _v(fmt) if fmt is not None else None)
+
+
+def to_unix_timestamp(c, fmt: str | None = None):
+    return _DT.ToUnixTimestamp(_e(c), _v(fmt) if fmt is not None else None)
+
+
+def from_unixtime(c, fmt: str | None = None):
+    return _DT.FromUnixTime(_e(c), _v(fmt) if fmt is not None else None)
+
+
+def date_format(c, fmt: str):
+    return _DT.DateFormatClass(_e(c), _v(fmt))
+
+
+def date_sub(c, days: int):
+    return _DT.DateSub(_e(c), _v(days))
+
+
+def add_months(c, n):
+    return _DT.AddMonths(_e(c), _v(n))
+
+
+def months_between(end, start, round_off: bool = True):
+    return _DT.MonthsBetween(_e(end), _e(start), round_off)
+
+
+def trunc(c, fmt: str):
+    return _DT.TruncDate(_e(c), _v(fmt))
+
+
+def hash(*cs):  # noqa: A001
+    from spark_rapids_tpu.expr.misc import Murmur3Hash
+    return Murmur3Hash(*[_e(c) for c in cs])
+
+
+def rand(seed: int = 0):
+    from spark_rapids_tpu.expr.misc import Rand
+    return Rand(seed)
+
+
+def spark_partition_id():
+    from spark_rapids_tpu.expr.misc import SparkPartitionID
+    return SparkPartitionID()
+
+
+def monotonically_increasing_id():
+    from spark_rapids_tpu.expr.misc import MonotonicallyIncreasingID
+    return MonotonicallyIncreasingID()
+
+
+def struct(*name_value_pairs):
+    """named_struct('a', col, 'b', col) — alternating names and values."""
+    from spark_rapids_tpu.expr.complexexprs import CreateNamedStruct
+    return CreateNamedStruct(*[
+        _v(x) if i % 2 == 0 else _e(x)
+        for i, x in enumerate(name_value_pairs)])
+
+
+def get_field(struct_expr, name: str):
+    from spark_rapids_tpu.expr.complexexprs import GetStructField
+    return GetStructField(_e(struct_expr), name)
+
+
+def array(*cs):
+    from spark_rapids_tpu.expr.complexexprs import CreateArray
+    return CreateArray(*[_e(c) for c in cs])
+
+
+def element_at0(arr, idx):
+    """0-based array element (Spark's GetArrayItem; element_at is 1-based)."""
+    from spark_rapids_tpu.expr.complexexprs import GetArrayItem
+    return GetArrayItem(_e(arr), _e(idx) if isinstance(idx, Expression) else _v(idx))
+
+
+def size(c):
+    from spark_rapids_tpu.expr.complexexprs import Size
+    return Size(_e(c))
+
+
+def sinh(c):
+    return _M.Sinh(_e(c))
+
+
+def cosh(c):
+    return _M.Cosh(_e(c))
+
+
+def tanh(c):
+    return _M.Tanh(_e(c))
+
+
+def expm1(c):
+    return _M.Expm1(_e(c))
+
+
+def rint(c):
+    return _M.Rint(_e(c))

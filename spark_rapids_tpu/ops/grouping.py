@@ -118,3 +118,16 @@ def segment_first(values, validity, seg_ids, capacity, ignore_nulls: bool):
     vals = values[pos_clamped]
     valid = (pos < big) & validity[pos_clamped]
     return vals, valid
+
+
+def segment_last(values, validity, seg_ids, capacity, ignore_nulls: bool):
+    """Last (by sorted order) value per group; Spark Last(ignoreNulls)."""
+    idx = jnp.arange(capacity, dtype=jnp.int32)
+    small = jnp.int32(-1)
+    eligible = validity if ignore_nulls else jnp.ones_like(validity)
+    cand = jnp.where(eligible, idx, small)
+    pos = jax.ops.segment_max(cand, seg_ids, num_segments=capacity)
+    pos_clamped = jnp.clip(pos, 0, capacity - 1)
+    vals = values[pos_clamped]
+    valid = (pos > small) & validity[pos_clamped]
+    return vals, valid

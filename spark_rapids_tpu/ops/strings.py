@@ -206,3 +206,42 @@ def like_to_regex(pattern: str, escape: str = "\\") -> str:
             out.append(_re.escape(ch))
         i += 1
     return "^" + "".join(out) + "$"
+
+
+def value_transform_to_string(c: Col, fmt) -> Col:
+    """Fixed-width values → string Col via a host-built dictionary over the
+    distinct values actually present (one device→host sync; the from_unixtime/
+    date_format path — the reference formats on device via cudf strings)."""
+    import numpy as np
+    vals = np.asarray(c.values)
+    valid = np.asarray(c.validity)
+    uv, inv = np.unique(vals, return_inverse=True)
+    strs = [fmt(v) for v in uv]
+    null_of_uv = np.array([s is None for s in strs], dtype=bool)
+    uniq = sorted(set(s for s in strs if s is not None))
+    index = {s: i for i, s in enumerate(uniq)}
+    code_of_uv = np.array([index.get(s, 0) for s in strs], dtype=np.int32)
+    codes = code_of_uv[inv.reshape(-1)]
+    nulls = null_of_uv[inv.reshape(-1)]
+    codes[~valid | nulls] = 0
+    validity = c.validity & ~jnp.asarray(nulls)
+    return Col(jnp.asarray(codes), validity, T.STRING,
+               pa.array(uniq or [""], type=pa.string()))
+
+
+def value_transform_to_values(c: Col, fn, out_dtype: T.DataType) -> Col:
+    """Fixed-width values → fixed-width values via a host-built map over the
+    distinct values present (string-parse path, e.g. unix_timestamp(str))."""
+    import numpy as np
+    vals = np.asarray(c.values)
+    uv, inv = np.unique(vals, return_inverse=True)
+    np_dt = T.to_numpy_dtype(out_dtype)
+    outs = [fn(v) for v in uv]
+    null_of_uv = np.array([o is None for o in outs], dtype=bool)
+    val_of_uv = np.array([0 if o is None else o for o in outs], dtype=np_dt)
+    nulls = jnp.asarray(null_of_uv[inv.reshape(-1)])
+    out_vals = jnp.asarray(val_of_uv[inv.reshape(-1)])
+    validity = c.validity & ~nulls
+    return Col(jnp.where(validity, out_vals,
+                         jnp.asarray(out_dtype.default_value(), np_dt)),
+               validity, out_dtype)

@@ -532,3 +532,366 @@ _DISPATCH = {
     DT.DateDiff: _binary(lambda e, x, y: int(x) - int(y)),
     Cast: _host_cast,
 }
+
+
+# ---- round-2 expression surface (bitwise, strings, datetime, misc) ---------
+
+def _shift_host(expr, kids, n):
+    import spark_rapids_tpu.expr.arithmetic as _A2
+    base_t = expr.children[0].dtype
+    is_long = isinstance(base_t, T.LongType)
+    width = 63 if is_long else 31
+    bits = 64 if is_long else 32
+    out = []
+    for b, a in zip(kids[0].data, kids[1].data):
+        if b is None or a is None:
+            out.append(None)
+            continue
+        b = int(b)
+        amt = int(a) & width
+        if isinstance(expr, _A2.ShiftLeft):
+            v = b << amt
+        elif isinstance(expr, _A2.ShiftRightUnsigned):
+            v = (b & ((1 << bits) - 1)) >> amt
+        else:
+            v = b >> amt
+        out.append(_wrap_int(expr.dtype, v))
+    return HostCol(out, expr.dtype)
+
+
+def _least_greatest(expr, kids, n):
+    import spark_rapids_tpu.expr.conditional as _C2
+    greatest = isinstance(expr, _C2.Greatest)
+
+    def key(v):
+        if isinstance(v, float) and math.isnan(v):
+            return (1, 0.0)
+        return (0, v)
+    out = []
+    for i in range(n):
+        vals = [k.data[i] for k in kids if k.data[i] is not None]
+        if not vals:
+            out.append(None)
+        else:
+            out.append((max if greatest else min)(vals, key=key))
+    return HostCol(out, expr.dtype)
+
+
+def _concat_ws(expr, kids, n):
+    sep = expr.children[0].value
+    out = []
+    for i in range(n):
+        parts = [k.data[i] for k in kids[1:] if k.data[i] is not None]
+        out.append(sep.join(parts))
+    return HostCol(out, T.STRING)
+
+
+def _string_fn_host(expr, kids, n):
+    args = [c.value for c in expr.children[1:]]
+    return HostCol([None if s is None else expr.fn(s, *args)
+                    for s in kids[0].data], expr.dtype)
+
+
+def _locate_host(expr, kids, n):
+    p = expr.children[0].value
+    st = expr.children[2].value
+    out = []
+    for s in kids[1].data:
+        if s is None or p is None or st is None:
+            out.append(None)
+        elif st <= 0:
+            out.append(0)
+        else:
+            out.append(s.find(p, st - 1) + 1)
+    return HostCol(out, T.INT)
+
+
+def _regexp_replace_host(expr, kids, n):
+    import re as _re
+    from spark_rapids_tpu.expr.strings import _java_replacement_to_python
+    rx = _re.compile(expr.children[1].value)
+    rep = _java_replacement_to_python(expr.children[2].value)
+    return HostCol([None if s is None else rx.sub(rep, s)
+                    for s in kids[0].data], T.STRING)
+
+
+def _regexp_extract_host(expr, kids, n):
+    import re as _re
+    rx = _re.compile(expr.children[1].value)
+    idx = expr.children[2].value
+
+    def ext(s):
+        m = rx.search(s)
+        if m is None:
+            return ""
+        g = m.group(int(idx))
+        return g if g is not None else ""
+    return HostCol([None if s is None else ext(s) for s in kids[0].data],
+                   T.STRING)
+
+
+def _unix_ts_host(expr, kids, n):
+    src = expr.children[0].dtype
+    fmt = expr.children[1].value
+    out = []
+    for v in kids[0].data:
+        if v is None:
+            out.append(None)
+        elif isinstance(src, T.TimestampType):
+            out.append(int(v) // 1_000_000)
+        elif isinstance(src, T.DateType):
+            out.append(int(v) * 86_400)
+        else:
+            from spark_rapids_tpu.expr.datetime import java_fmt_to_strftime
+            try:
+                dt = datetime.datetime.strptime(v, java_fmt_to_strftime(fmt))
+                out.append(int((dt - datetime.datetime(1970, 1, 1))
+                               .total_seconds()))
+            except (ValueError, TypeError):
+                out.append(None)
+    return HostCol(out, T.LONG)
+
+
+def _from_unixtime_host(expr, kids, n):
+    from spark_rapids_tpu.expr.datetime import java_fmt_to_strftime
+    pyfmt = java_fmt_to_strftime(expr.children[1].value)
+    out = []
+    for v in kids[0].data:
+        out.append(None if v is None else
+                   (datetime.datetime(1970, 1, 1)
+                    + datetime.timedelta(seconds=int(v))).strftime(pyfmt))
+    return HostCol(out, T.STRING)
+
+
+def _date_format_host(expr, kids, n):
+    from spark_rapids_tpu.expr.datetime import java_fmt_to_strftime
+    pyfmt = java_fmt_to_strftime(expr.children[1].value)
+    is_date = isinstance(expr.children[0].dtype, T.DateType)
+    out = []
+    for v in kids[0].data:
+        if v is None:
+            out.append(None)
+        elif is_date:
+            out.append((datetime.date(1970, 1, 1)
+                        + datetime.timedelta(days=int(v))).strftime(pyfmt))
+        else:
+            out.append((datetime.datetime(1970, 1, 1)
+                        + datetime.timedelta(microseconds=int(v)))
+                       .strftime(pyfmt))
+    return HostCol(out, T.STRING)
+
+
+def _add_months_host(expr, kids, n):
+    import calendar
+    out = []
+    for d, m in zip(kids[0].data, kids[1].data):
+        if d is None or m is None:
+            out.append(None)
+            continue
+        date = datetime.date(1970, 1, 1) + datetime.timedelta(days=int(d))
+        total = date.year * 12 + (date.month - 1) + int(m)
+        y, mo = divmod(total, 12)
+        dom = min(date.day, calendar.monthrange(y, mo + 1)[1])
+        out.append((datetime.date(y, mo + 1, dom)
+                    - datetime.date(1970, 1, 1)).days)
+    return HostCol(out, T.DATE)
+
+
+def _months_between_host(expr, kids, n):
+    import calendar
+    out = []
+    for e_, s_ in zip(kids[0].data, kids[1].data):
+        if e_ is None or s_ is None:
+            out.append(None)
+            continue
+        d1 = datetime.date(1970, 1, 1) + datetime.timedelta(days=int(e_))
+        d2 = datetime.date(1970, 1, 1) + datetime.timedelta(days=int(s_))
+        last1 = d1.day == calendar.monthrange(d1.year, d1.month)[1]
+        last2 = d2.day == calendar.monthrange(d2.year, d2.month)[1]
+        months = (d1.year - d2.year) * 12 + (d1.month - d2.month)
+        frac = 0.0 if (d1.day == d2.day or (last1 and last2)) else \
+            (d1.day - d2.day) / 31.0
+        v = months + frac
+        if expr.round_off:
+            v = round(v * 1e8) / 1e8
+        out.append(float(v))
+    return HostCol(out, T.DOUBLE)
+
+
+def _trunc_date_host(expr, kids, n):
+    lvl = (expr.children[1].value or "").lower()
+    out = []
+    for d in kids[0].data:
+        if d is None:
+            out.append(None)
+            continue
+        date = datetime.date(1970, 1, 1) + datetime.timedelta(days=int(d))
+        if lvl in ("year", "yyyy", "yy"):
+            t = date.replace(month=1, day=1)
+        elif lvl in ("month", "mon", "mm"):
+            t = date.replace(day=1)
+        elif lvl == "quarter":
+            t = date.replace(month=((date.month - 1) // 3) * 3 + 1, day=1)
+        elif lvl == "week":
+            t = date - datetime.timedelta(days=date.weekday())
+        else:
+            out.append(None)
+            continue
+        out.append((t - datetime.date(1970, 1, 1)).days)
+    return HostCol(out, T.DATE)
+
+
+def _murmur3_host(expr, kids, n):
+    import struct as _struct
+    from spark_rapids_tpu.ops.hashing import (murmur3_int_host,
+                                              murmur3_long_host,
+                                              murmur3_bytes_host)
+
+    def murmur3_double_host(v, h):
+        if v == 0.0:
+            v = 0.0  # -0.0 hashes as +0.0 (Spark normalizes)
+        bits = _struct.unpack("<q", _struct.pack("<d", v))[0]
+        return murmur3_long_host(bits, h)
+    out = []
+    for i in range(n):
+        h = expr.seed
+        for k, ch in zip(kids, expr.children):
+            v = k.data[i]
+            if v is None:
+                continue
+            dt = ch.dtype
+            if isinstance(dt, (T.LongType, T.TimestampType)):
+                h = murmur3_long_host(int(v), h)
+            elif isinstance(dt, T.DecimalType):
+                h = murmur3_long_host(int(v), h)
+            elif isinstance(dt, T.DoubleType):
+                h = murmur3_double_host(float(v), h)
+            elif isinstance(dt, T.FloatType):
+                import struct as _struct
+                bits = _struct.unpack(
+                    "<i", _struct.pack("<f", float(v)))[0]
+                h = murmur3_int_host(bits, h)
+            elif isinstance(dt, T.StringType):
+                h = murmur3_bytes_host(v.encode("utf-8"), h)
+            elif isinstance(dt, T.BooleanType):
+                h = murmur3_int_host(1 if v else 0, h)
+            else:
+                h = murmur3_int_host(int(v), h)
+        out.append(h)
+    return HostCol(out, T.INT)
+
+
+def _struct_field_host(expr, kids, n):
+    # kids[0] holds per-row dicts (from _create_struct_host or arrow structs)
+    return HostCol([None if v is None else v.get(expr.field)
+                    for v in kids[0].data], expr.dtype)
+
+
+def _size_host(expr, kids, n):
+    return HostCol([-1 if v is None else len(v) for v in kids[0].data], T.INT)
+
+
+def _get_array_item_host(expr, kids, n):
+    out = []
+    for arr, i in zip(kids[0].data, kids[1].data):
+        if arr is None or i is None or i < 0 or i >= len(arr):
+            out.append(None)
+        else:
+            out.append(arr[int(i)])
+    return HostCol(out, expr.dtype)
+
+
+def _create_array_host(expr, kids, n):
+    return HostCol([[k.data[i] for k in kids] for i in range(n)], expr.dtype)
+
+
+def _create_struct_host(expr, kids, n):
+    names = expr.field_names
+    val_kids = kids[1::2]
+    return HostCol([{nm: k.data[i] for nm, k in zip(names, val_kids)}
+                    for i in range(n)], expr.dtype)
+
+
+def _register_round2():
+    import spark_rapids_tpu.expr.arithmetic as A2
+    import spark_rapids_tpu.expr.conditional as C2
+    import spark_rapids_tpu.expr.strings as S2
+    import spark_rapids_tpu.expr.datetime as DT2
+    import spark_rapids_tpu.expr.misc as MX
+    import spark_rapids_tpu.expr.decimalexprs as DX
+    import spark_rapids_tpu.expr.complexexprs as CX
+
+    _DISPATCH.update({
+        A2.BitwiseAnd: _binary(
+            lambda e, x, y: _wrap_int(e.dtype, int(x) & int(y))),
+        A2.BitwiseOr: _binary(
+            lambda e, x, y: _wrap_int(e.dtype, int(x) | int(y))),
+        A2.BitwiseXor: _binary(
+            lambda e, x, y: _wrap_int(e.dtype, int(x) ^ int(y))),
+        A2.BitwiseNot: _unary(lambda e, v: _wrap_int(e.dtype, ~int(v))),
+        A2.ShiftLeft: _shift_host,
+        A2.ShiftRight: _shift_host,
+        A2.ShiftRightUnsigned: _shift_host,
+        C2.Least: _least_greatest,
+        C2.Greatest: _least_greatest,
+        MM.Sinh: _unary(lambda e, v: math.sinh(v)),
+        MM.Cosh: _unary(lambda e, v: math.cosh(v)),
+        MM.Tanh: _unary(lambda e, v: math.tanh(v)),
+        MM.Asinh: _unary(lambda e, v: math.asinh(v)),
+        MM.Acosh: _unary(
+            lambda e, v: math.acosh(v) if v >= 1 else float("nan")),
+        MM.Atanh: _unary(
+            lambda e, v: math.atanh(v) if -1 < v < 1 else float("nan")),
+        MM.Expm1: _unary(lambda e, v: math.expm1(v)),
+        MM.Rint: _unary(lambda e, v: float(round(v / 2) * 2) if abs(
+            v - round(v)) == 0.5 and round(v) % 2 else float(round(v))),
+        S2.ConcatWs: _concat_ws,
+        S2.StringLPad: _string_fn_host,
+        S2.StringRPad: _string_fn_host,
+        S2.StringRepeat: _string_fn_host,
+        S2.SubstringIndex: _string_fn_host,
+        S2.StringTranslate: _string_fn_host,
+        S2.FindInSet: _string_fn_host,
+        S2.StringLocate: _locate_host,
+        S2.RegExpReplace: _regexp_replace_host,
+        S2.RegExpExtract: _regexp_extract_host,
+        S2.InitCap: _unary(
+            lambda e, v: "".join(
+                c.upper() if (i == 0 or v[i - 1] == " ") else c.lower()
+                for i, c in enumerate(v))),
+        S2.StringLocate: _locate_host,
+        DT2.UnixTimestamp: _unix_ts_host,
+        DT2.ToUnixTimestamp: _unix_ts_host,
+        DT2.FromUnixTime: _from_unixtime_host,
+        DT2.DateFormatClass: _date_format_host,
+        DT2.AddMonths: _add_months_host,
+        DT2.MonthsBetween: _months_between_host,
+        DT2.TruncDate: _trunc_date_host,
+        DT2.LastDay: _unary(lambda e, v: _last_day_host(v)),
+        MX.Murmur3Hash: _murmur3_host,
+        DX.PromotePrecision: lambda e, kids, n: HostCol(
+            kids[0].data, e.dtype),
+        DX.CheckOverflow: lambda e, kids, n: HostCol(
+            [None if (v is None or abs(int(v)) >= 10 ** e.to.precision)
+             else int(v) for v in kids[0].data], e.dtype),
+        DX.UnscaledValue: lambda e, kids, n: HostCol(
+            [None if v is None else int(v) for v in kids[0].data], T.LONG),
+        DX.MakeDecimal: lambda e, kids, n: HostCol(
+            [None if (v is None or abs(int(v)) >= 10 ** e.to.precision)
+             else int(v) for v in kids[0].data], e.dtype),
+        CX.CreateNamedStruct: _create_struct_host,
+        CX.CreateArray: _create_array_host,
+        CX.GetStructField: _struct_field_host,
+        CX.GetArrayItem: _get_array_item_host,
+        CX.Size: _size_host,
+    })
+
+
+def _last_day_host(days):
+    import calendar
+    d = datetime.date(1970, 1, 1) + datetime.timedelta(days=int(days))
+    last = calendar.monthrange(d.year, d.month)[1]
+    return (d.replace(day=last) - datetime.date(1970, 1, 1)).days
+
+
+_register_round2()

@@ -210,8 +210,148 @@ def _register_all():
                 "(enable with spark.rapids.tpu.sql.castStringToFloat.enabled)")
     ex(Cast, "type cast", TS.ALL, None, None, tag_cast)
 
-    for cls in (AG.Sum, AG.Count, AG.Min, AG.Max, AG.Average, AG.First):
+    for cls in (AG.Sum, AG.Count, AG.Min, AG.Max, AG.Average, AG.First,
+                AG.Last):
         ex(cls, "aggregate function", comm + TS.DECIMAL)
+    for cls in (AG.VariancePop, AG.VarianceSamp, AG.StddevPop, AG.StddevSamp):
+        ex(cls, "central-moment aggregate", TS.FRACTIONAL, num)
+
+    # -- bitwise (reference org/apache/spark/sql/rapids/bitwise.scala) -------
+    for cls in (A.BitwiseAnd, A.BitwiseOr, A.BitwiseXor):
+        ex(cls, "bitwise binary op", TS.INTEGRAL, TS.INTEGRAL)
+    ex(A.BitwiseNot, "bitwise not", TS.INTEGRAL, TS.INTEGRAL)
+    for cls in (A.ShiftLeft, A.ShiftRight, A.ShiftRightUnsigned):
+        ex(cls, "java shift", TS.INTEGRAL, TS.INTEGRAL)
+
+    # -- more math (mathExpressions.scala) ------------------------------------
+    for cls in (MM.Sinh, MM.Cosh, MM.Tanh, MM.Asinh, MM.Acosh, MM.Atanh,
+                MM.Expm1, MM.Rint):
+        ex(cls, "math function", TS.FRACTIONAL, TS.FRACTIONAL)
+    ex(C.Least, "least of arguments", ordr)
+    ex(C.Greatest, "greatest of arguments", ordr)
+
+    # -- more strings (stringFunctions.scala) ---------------------------------
+    def _lit_args_tag(first_child_count=1):
+        def tag(meta):
+            e = meta.expr
+            for a in e.children[first_child_count:]:
+                if not isinstance(a, E.Literal):
+                    meta.will_not_work(
+                        f"{type(e).__name__} requires literal arguments on "
+                        "the device (reference has the same limit)")
+                    return
+        return tag
+
+    def tag_concat_ws(meta):
+        sep = meta.expr.children[0]
+        if not isinstance(sep, E.Literal) or sep.value is None:
+            meta.will_not_work("concat_ws separator must be a non-null literal")
+
+    ex(S.ConcatWs, "concat with separator, nulls skipped", TS.STRING,
+       TS.STRING, None, tag_concat_ws)
+    for cls in (S.StringLPad, S.StringRPad, S.StringRepeat, S.SubstringIndex,
+                S.StringTranslate, S.FindInSet):
+        ex(cls, "string function", TS.STRING + TS.TypeSig([T.IntegerType]),
+           TS.STRING + TS.INTEGRAL, None, _lit_args_tag())
+
+    def tag_locate(meta):
+        e = meta.expr
+        if not (isinstance(e.children[0], E.Literal)
+                and isinstance(e.children[2], E.Literal)):
+            meta.will_not_work("locate substr/start must be literals")
+    ex(S.StringLocate, "locate/instr", TS.TypeSig([T.IntegerType]),
+       TS.STRING + TS.INTEGRAL, None, tag_locate)
+
+    def tag_regexp(meta):
+        import re as _re
+        e = meta.expr
+        for a in e.children[1:]:
+            if not isinstance(a, E.Literal):
+                meta.will_not_work("regexp pattern/args must be literals")
+                return
+        try:
+            _re.compile(e.children[1].value)
+        except _re.error as err:
+            meta.will_not_work(f"pattern not supported on device: {err}")
+    for cls in (S.RegExpReplace, S.RegExpExtract):
+        ex(cls, "regular expression function",
+           TS.STRING + TS.TypeSig([T.IntegerType]), TS.STRING + TS.INTEGRAL,
+           None, tag_regexp)
+
+    # -- datetime parse/format (datetimeExpressions.scala) --------------------
+    def tag_dt_format(meta):
+        e = meta.expr
+        fe = e.children[-1]
+        if not isinstance(fe, E.Literal):
+            meta.will_not_work("datetime format must be a literal")
+            return
+        try:
+            DT.java_fmt_to_strftime(fe.value)
+        except (ValueError, TypeError) as err:
+            meta.will_not_work(str(err))
+
+    for cls in (DT.UnixTimestamp, DT.ToUnixTimestamp):
+        ex(cls, "string/ts → unix seconds", TS.TypeSig([T.LongType]),
+           TS.STRING + TS.DATE + TS.TIMESTAMP, None, tag_dt_format)
+    ex(DT.FromUnixTime, "unix seconds → string", TS.STRING,
+       TS.INTEGRAL + TS.STRING, None, tag_dt_format)
+    ex(DT.DateFormatClass, "date_format", TS.STRING,
+       TS.DATE + TS.TIMESTAMP + TS.STRING, None, tag_dt_format)
+    ex(DT.DateSub, "date arithmetic", TS.DATE)
+    ex(DT.AddMonths, "calendar month add", TS.DATE)
+    ex(DT.MonthsBetween, "months between dates", TS.FRACTIONAL,
+       TS.DATE + TS.TIMESTAMP)
+    def tag_trunc(meta):
+        if not isinstance(meta.expr.children[1], E.Literal):
+            meta.will_not_work("trunc format must be a literal")
+    ex(DT.TruncDate, "date truncation", TS.DATE, TS.DATE + TS.STRING,
+       None, tag_trunc)
+
+    # -- hash / non-deterministic (HashFunctions.scala, randomExpressions) ---
+    from spark_rapids_tpu.expr import misc as MX
+    ex(MX.Murmur3Hash, "spark murmur3 hash", TS.TypeSig([T.IntegerType]),
+       comm + TS.DECIMAL)
+    ex(MX.Rand, "uniform random (per-partition stream, like the reference "
+       "NOT bit-identical with CPU Spark)", TS.FRACTIONAL)
+    ex(MX.SparkPartitionID, "partition id", TS.TypeSig([T.IntegerType]))
+    ex(MX.MonotonicallyIncreasingID, "monotonically increasing id",
+       TS.TypeSig([T.LongType]))
+
+    # -- decimal plan exprs (decimalExpressions.scala) ------------------------
+    from spark_rapids_tpu.expr import decimalexprs as DX
+    for cls in (DX.PromotePrecision, DX.CheckOverflow, DX.UnscaledValue,
+                DX.MakeDecimal):
+        ex(cls, "decimal precision plumbing", TS.DECIMAL + TS.INTEGRAL,
+           TS.DECIMAL + TS.INTEGRAL)
+
+    # -- complex-type create/extract (complexTypeCreator/Extractors.scala) ---
+    from spark_rapids_tpu.expr import complexexprs as CX
+
+    def tag_create(meta):
+        p = meta.parent
+        pe = getattr(p, "expr", None) if p is not None else None
+        if not isinstance(pe, (CX.GetStructField, CX.GetArrayItem, CX.Size)):
+            meta.will_not_work(
+                "nested values have no flat device form; only fused "
+                "create+extract pairs run on device (struct(..).f, arr[i])")
+
+    def tag_extract(meta):
+        e = meta.expr
+        if not isinstance(e.children[0],
+                          (CX.CreateNamedStruct, CX.CreateArray)):
+            meta.will_not_work(
+                "extraction from a materialized nested column runs on host")
+
+    ex(CX.CreateNamedStruct, "struct construction (fused)", TS.ALL, TS.ALL,
+       None, tag_create)
+    ex(CX.CreateArray, "array construction (fused)", TS.ALL, TS.ALL,
+       None, tag_create)
+    ex(CX.GetStructField, "struct field extraction", TS.ALL, TS.ALL,
+       None, tag_extract)
+    ex(CX.GetArrayItem, "array element extraction", TS.ALL, TS.ALL,
+       None, tag_extract)
+    ex(CX.Size, "collection size", TS.TypeSig([T.IntegerType]), TS.ALL,
+       None, tag_extract)
 
     from spark_rapids_tpu.udf.python_runtime import PythonUDF
 

@@ -266,6 +266,35 @@ class StructField:
 
 
 @dataclasses.dataclass(frozen=True)
+class StructDataType(DataType):
+    """Spark's StructType used as a COLUMN data type (struct<...> values).
+    Like ArrayType there is no flat device representation; device support is
+    limited to fused create+extract expression pairs (expr/complexexprs.py),
+    everything else stays on host (reference TypeChecks TypeSig.STRUCT)."""
+
+    jnp_dtype = None
+    sql_name = "struct"
+
+    def __init__(self, names: list, types: list):
+        self.names = list(names)
+        self.types = list(types)
+
+    def default_value(self):
+        return None
+
+    def __eq__(self, other):
+        return (isinstance(other, StructDataType)
+                and other.names == self.names and other.types == self.types)
+
+    def __hash__(self):
+        return hash(("struct", tuple(self.names)))
+
+    def __repr__(self):
+        inner = ", ".join(f"{n}: {t!r}" for n, t in
+                          zip(self.names, self.types))
+        return f"StructDataType({inner})"
+
+
 class StructType:
     """Schema of a batch/plan output (Spark StructType analog)."""
     fields: tuple

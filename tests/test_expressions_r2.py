@@ -1,0 +1,300 @@
+"""Round-2 expression surface: bitwise, least/greatest, string functions,
+regexp, datetime parse/format, hash/ids, decimal plumbing, complex-type fusion,
+variance aggregates — every device result checked against the host oracle
+(reference integration_tests asserts.py assert_gpu_and_cpu_are_equal pattern)."""
+
+import datetime
+import math
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from conftest import make_table
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import ColumnarBatch
+from spark_rapids_tpu.expr.core import EvalContext, bind_references, col, lit
+from spark_rapids_tpu.plan.host_eval import eval_host
+from spark_rapids_tpu.session import TpuSession
+
+
+def run_device(expr, table):
+    b = ColumnarBatch.from_arrow(table)
+    e = bind_references(expr, b.schema)
+    return (e.eval(EvalContext.from_batch(b)).to_vector()
+            .to_arrow(b.num_rows).to_pylist())
+
+
+def run_host(expr, table):
+    schema = T.StructType.from_arrow(table.schema)
+    return eval_host(bind_references(expr, schema), table).to_arrow().to_pylist()
+
+
+def check(expr, table, approx=False):
+    got = run_device(expr, table)
+    exp = run_host(expr, table)
+    if approx:
+        for g, e in zip(got, exp):
+            if g is None or e is None:
+                assert g == e, (got, exp)
+            elif isinstance(e, float) and math.isnan(e):
+                assert math.isnan(g)
+            else:
+                assert g == pytest.approx(e, rel=1e-12), (got, exp)
+    else:
+        assert got == exp, (got, exp)
+    return got
+
+
+@pytest.fixture
+def t():
+    return pa.table({
+        "a": pa.array([1, -2, None, 7, 0], type=pa.int32()),
+        "b": pa.array([3, 65, -1, None, 33], type=pa.int32()),
+        "l": pa.array([2**40, -3, None, 1, -2**40], type=pa.int64()),
+        "x": pa.array([1.5, -2.5, None, float("nan"), 0.5]),
+        "y": pa.array([2.0, None, 3.0, 1.0, -0.5]),
+        "s": pa.array(["hello world", "a,b,c", None, "ababab", ""]),
+        "w": pa.array(["apple", "kiwi", "fig", None, "apple"]),
+        "d": pa.array([0, 18262, 18291, None, 59], type=pa.date32()),
+        "sec": pa.array([0, 86399, None, 1600000000, -1], type=pa.int64()),
+        "ds": pa.array(["1970-01-01 00:00:00", "2020-01-02 03:04:05",
+                        None, "not a date", "2001-12-31 23:59:59"]),
+    })
+
+
+# -- bitwise -----------------------------------------------------------------
+
+def test_bitwise(t):
+    check(F._A.BitwiseAnd(col("a"), col("b")), t)
+    check(F._A.BitwiseOr(col("a"), col("b")), t)
+    check(F._A.BitwiseXor(col("a"), col("b")), t)
+    check(F.bitwise_not(col("a")), t)
+
+
+def test_shifts(t):
+    # shift of 65 on int32 masks to 1 (Java semantics)
+    check(F.shiftleft(col("a"), 1), t)
+    check(F._A.ShiftLeft(col("a"), col("b")), t)
+    check(F.shiftright(col("l"), 3), t)
+    check(F.shiftrightunsigned(col("a"), 2), t)
+    check(F.shiftrightunsigned(col("l"), 7), t)
+
+
+def test_least_greatest(t):
+    # skip-null semantics + NaN greatest
+    check(F.least(col("x"), col("y")), t)
+    check(F.greatest(col("x"), col("y")), t)
+    check(F.least(col("a"), col("b")), t)
+    check(F.greatest(col("a"), col("b"), F.lit(5)), t)
+
+
+def test_math_extras(t):
+    y = pa.table({"y": pa.array([0.5, -0.25, None, 1.0, 2.5])})
+    for fn in (F.sinh, F.cosh, F.tanh, F.expm1, F.rint):
+        check(fn(col("y")), y, approx=True)
+
+
+# -- strings -----------------------------------------------------------------
+
+def test_concat_ws(t):
+    check(F.concat_ws("-", col("s"), col("w")), t)
+    check(F.concat_ws(",", col("w")), t)
+
+
+def test_pad_repeat(t):
+    check(F.lpad(col("w"), 8, "*"), t)
+    check(F.rpad(col("w"), 3, "_"), t)
+    check(F.repeat(col("w"), 2), t)
+
+
+def test_locate_substring_index(t):
+    check(F.locate("b", col("s")), t)
+    check(F.locate("a", col("s"), 2), t)
+    check(F.instr(col("s"), "world"), t)
+    check(F.substring_index(col("s"), ",", 2), t)
+    check(F.substring_index(col("s"), "b", -1), t)
+
+
+def test_translate_find_in_set(t):
+    check(F.translate(col("s"), "abc", "xy"), t)
+    check(F.find_in_set(col("w"), "fig,apple,kiwi"), t)
+
+
+def test_regexp(t):
+    check(F.regexp_replace(col("s"), "[aeiou]", "#"), t)
+    check(F.regexp_replace(col("s"), "(a)(b)", "$2$1"), t)
+    check(F.regexp_extract(col("s"), r"(\w+) (\w+)", 2), t)
+    check(F.regexp_extract(col("s"), r"(z)x?", 1), t)
+
+
+# -- datetime ----------------------------------------------------------------
+
+def test_unix_timestamp_roundtrip(t):
+    check(F.unix_timestamp(col("ds")), t)
+    check(F.unix_timestamp(col("d")), t)
+    check(F.to_unix_timestamp(col("ds"), "yyyy-MM-dd HH:mm:ss"), t)
+    check(F.from_unixtime(col("sec")), t)
+    check(F.from_unixtime(col("sec"), "yyyy/MM/dd"), t)
+
+
+def test_date_format_trunc(t):
+    check(F.date_format(col("d"), "yyyy-MM-dd"), t)
+    check(F.trunc(col("d"), "year"), t)
+    check(F.trunc(col("d"), "month"), t)
+    check(F.trunc(col("d"), "quarter"), t)
+    check(F.trunc(col("d"), "week"), t)
+
+
+def test_add_months_between(t):
+    check(F.add_months(col("d"), 1), t)
+    check(F.add_months(col("d"), -13), t)
+    check(F.date_sub(col("d"), 40), t)
+    check(F.months_between(col("d"), F.cast(F.lit(59), T.DATE)), t,
+          approx=True)
+
+
+# -- hash / ids --------------------------------------------------------------
+
+def test_murmur3_hash_expression(t):
+    check(F.hash(col("a")), t)
+    check(F.hash(col("l")), t)
+    check(F.hash(col("w")), t)
+    check(F.hash(col("a"), col("w"), col("x")), t)
+
+
+def test_murmur3_known_vectors(t):
+    """Spark-generated golden values: hash() of int 42 and string 'abc' with
+    seed 42 (spark-shell: select hash(42), hash('abc'))."""
+    tt = pa.table({"i": pa.array([42], type=pa.int32()),
+                   "s": pa.array(["abc"])})
+    assert run_device(F.hash(col("i")), tt) == [-559580957]
+    assert run_device(F.hash(col("s")), tt) == [1635148468]
+
+
+def test_partition_ids_and_monotonic_id():
+    spark = TpuSession()
+    t_ = pa.table({"v": pa.array(range(100))})
+    df = spark.create_dataframe(t_, num_partitions=4).select(
+        F.col("v"), F.spark_partition_id().alias("p"),
+        F.monotonically_increasing_id().alias("mid"))
+    out = df.collect()
+    pids = set(out.column("p").to_pylist())
+    assert pids == {0, 1, 2, 3}
+    mids = out.column("mid").to_pylist()
+    assert len(set(mids)) == 100  # unique across partitions
+    for p, m in zip(out.column("p").to_pylist(), mids):
+        assert (m >> 33) == p
+
+
+def test_rand_uniform():
+    spark = TpuSession()
+    t_ = pa.table({"v": pa.array(range(1000))})
+    out = (spark.create_dataframe(t_, num_partitions=2)
+           .select(F.rand(7).alias("r")).collect())
+    vals = out.column("r").to_pylist()
+    assert all(0.0 <= v < 1.0 for v in vals)
+    assert 0.4 < float(np.mean(vals)) < 0.6
+
+
+# -- decimal plumbing --------------------------------------------------------
+
+def test_decimal_check_overflow():
+    from decimal import Decimal
+    from spark_rapids_tpu.expr.decimalexprs import CheckOverflow, UnscaledValue
+    tt = pa.table({"dec": pa.array(
+        [None, Decimal("12.34"), Decimal("-999.99"), Decimal("1000.00")],
+        type=pa.decimal128(9, 2))})
+    check(UnscaledValue(col("dec")), tt)
+    # precision 4, scale 2 → |unscaled| must stay below 10^4: 1000.00 nulls out
+    e2 = CheckOverflow(col("dec"), T.DecimalType(4, 2))
+    assert run_device(e2, tt) == run_host(e2, tt)
+
+
+def test_make_decimal_roundtrip():
+    from spark_rapids_tpu.expr.decimalexprs import MakeDecimal
+    tt = pa.table({"v": pa.array([123, None, -450, 10**10], type=pa.int64())})
+    e = MakeDecimal(col("v"), 9, 2)
+    assert run_device(e, tt) == run_host(e, tt)
+
+
+# -- complex types (fused) ---------------------------------------------------
+
+def test_struct_fusion(t):
+    e = F.get_field(F.struct("u", col("a"), "v", col("w")), "v")
+    check(e, t)
+    e2 = F.get_field(F.struct("u", col("a"), "v", col("w")), "u")
+    check(e2, t)
+
+
+def test_array_fusion(t):
+    check(F.element_at0(F.array(col("a"), col("b")), 1), t)
+    check(F.element_at0(F.array(col("a"), col("b")), 5), t)  # out of bounds
+    # column index multiplexes
+    idx_t = pa.table({"a": pa.array([10, 20, 30], type=pa.int32()),
+                      "b": pa.array([1, 2, None], type=pa.int32()),
+                      "i": pa.array([0, 1, 0], type=pa.int32())})
+    check(F.element_at0(F.array(col("a"), col("b")), col("i")), idx_t)
+    check(F.size(F.array(col("a"), col("b"), F.lit(1))), t)
+
+
+def test_complex_fallback_pins_host(t):
+    """A projection ENDING in a struct has no device form: planner must pin it
+    to host, and the session must still produce the right answer."""
+    spark = TpuSession()
+    df = spark.create_dataframe(t).select(
+        F.struct("u", F.col("a"), "v", F.col("w")).alias("st"))
+    from spark_rapids_tpu.plan.overrides import explain_plan
+    txt = explain_plan(df._plan, spark.conf)
+    assert "will run on TPU" not in txt.splitlines()[0] or "struct" in txt
+    out = df.collect()
+    assert out.column("st").to_pylist()[0] == {"u": 1, "v": "apple"}
+
+
+# -- aggregates --------------------------------------------------------------
+
+def test_variance_family_session():
+    spark = TpuSession()
+    r = np.random.default_rng(3)
+    tt = pa.table({
+        "k": pa.array([int(v) for v in r.integers(0, 5, 400)]),
+        "v": pa.array([None if i % 11 == 0 else float(x)
+                       for i, x in enumerate(r.normal(0, 3, 400))]),
+    })
+    df = (spark.create_dataframe(tt, num_partitions=3)
+          .group_by(F.col("k"))
+          .agg(F.var_pop(F.col("v")).alias("vp"),
+               F.variance(F.col("v")).alias("vs"),
+               F.stddev_pop(F.col("v")).alias("sp"),
+               F.stddev(F.col("v")).alias("ss"),
+               F.last(F.col("v"), ignore_nulls=True).alias("lst")))
+    got = {r_["k"]: r_ for r_ in df.collect().to_pylist()}
+    import statistics
+    groups = {}
+    for k, v in zip(tt.column("k").to_pylist(), tt.column("v").to_pylist()):
+        groups.setdefault(k, []).append(v)
+    for k, vs in groups.items():
+        xs = [v for v in vs if v is not None]
+        assert got[k]["vp"] == pytest.approx(statistics.pvariance(xs), rel=1e-9)
+        assert got[k]["vs"] == pytest.approx(statistics.variance(xs), rel=1e-9)
+        assert got[k]["sp"] == pytest.approx(statistics.pstdev(xs), rel=1e-9)
+        assert got[k]["ss"] == pytest.approx(statistics.stdev(xs), rel=1e-9)
+        last_nn = [v for v in vs if v is not None][-1]
+        assert got[k]["lst"] == pytest.approx(last_nn)
+
+
+# -- fallback tagging --------------------------------------------------------
+
+def test_unsupported_format_falls_back():
+    """A datetime format outside the device subset must tag will_not_work, not
+    crash — the plan falls back to host and still answers."""
+    spark = TpuSession()
+    tt = pa.table({"d": pa.array([0, 18262], type=pa.date32())})
+    df = spark.create_dataframe(tt).select(
+        F.date_format(F.col("d"), "QQQ w").alias("q"))  # unsupported tokens
+    from spark_rapids_tpu.plan.overrides import explain_plan
+    txt = explain_plan(df._plan, spark.conf)
+    assert "cannot run" in txt or "will run on host" in txt.lower() or \
+        "not" in txt.lower()
