@@ -51,9 +51,36 @@ class HostCol:
         data = arr.to_pylist()
         if isinstance(dtype, T.FloatType):
             data = [None if v is None else float(np.float32(v)) for v in data]
+        elif isinstance(dtype, T.DateType):
+            # internal convention: days since epoch (module docstring above)
+            data = [None if v is None else
+                    (v - datetime.date(1970, 1, 1)).days
+                    if isinstance(v, datetime.date) else int(v)
+                    for v in data]
+        elif isinstance(dtype, T.TimestampType):
+            def _us(v):
+                td = v.replace(tzinfo=None) - datetime.datetime(1970, 1, 1)
+                return (td.days * 86_400 + td.seconds) * 1_000_000 \
+                    + td.microseconds
+            data = [None if v is None else
+                    _us(v) if isinstance(v, datetime.datetime) else int(v)
+                    for v in data]
+        elif isinstance(dtype, T.DecimalType):
+            import decimal as _dec
+            # internal convention: unscaled int64 (types.py DECIMAL64)
+            data = [None if v is None else
+                    int(v.scaleb(dtype.scale)) if isinstance(v, _dec.Decimal)
+                    else int(v)
+                    for v in data]
         return HostCol(data, dtype)
 
     def to_arrow(self):
+        if isinstance(self.dtype, T.DecimalType):
+            import decimal as _dec
+            vals = [None if v is None else
+                    _dec.Decimal(int(v)).scaleb(-self.dtype.scale)
+                    for v in self.data]
+            return pa.array(vals, type=T.to_arrow_type(self.dtype))
         return pa.array(self.data, type=T.to_arrow_type(self.dtype))
 
 

@@ -238,12 +238,19 @@ def from_arrow_type(at: pa.DataType) -> DataType:
         return from_arrow_type(at.value_type)
     if pa.types.is_list(at) or pa.types.is_large_list(at):
         return ArrayType(from_arrow_type(at.value_type))
+    if pa.types.is_struct(at):
+        return StructDataType([at.field(i).name for i in range(at.num_fields)],
+                              [from_arrow_type(at.field(i).type)
+                               for i in range(at.num_fields)])
     raise TypeError(f"unsupported arrow type {at}")
 
 
 def to_arrow_type(dt: DataType) -> pa.DataType:
     if isinstance(dt, ArrayType):
         return pa.list_(to_arrow_type(dt.element_type))
+    if isinstance(dt, StructDataType):
+        return pa.struct([pa.field(n, to_arrow_type(t))
+                          for n, t in zip(dt.names, dt.types)])
     if isinstance(dt, DecimalType):
         return pa.decimal128(dt.precision, dt.scale)
     if isinstance(dt, TimestampType):
@@ -265,7 +272,6 @@ class StructField:
     nullable: bool = True
 
 
-@dataclasses.dataclass(frozen=True)
 class StructDataType(DataType):
     """Spark's StructType used as a COLUMN data type (struct<...> values).
     Like ArrayType there is no flat device representation; device support is
