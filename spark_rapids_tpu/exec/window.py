@@ -49,11 +49,18 @@ def supported_window_expr(we: WindowExpression) -> str | None:
         if frame.is_unbounded_to_current or frame.is_unbounded_both:
             return None
         if frame.frame_type == "rows":
-            if isinstance(f, (Min, Max)):
-                return ("sliding min/max frames not supported on device "
-                        "(needs O(n*w) or a monotonic-deque kernel)")
             return None
-        return f"range frame with offsets not supported: {frame}"
+        # bounded RANGE frame: Spark requires exactly one order key, and the
+        # device search needs it numeric (int/long/float/double/date/decimal)
+        ob = we.spec.order_by
+        if len(ob) != 1:
+            return ("bounded range frame needs exactly one order key, "
+                    f"got {len(ob)}")
+        okey_dt = ob[0][0].dtype
+        if not (okey_dt.is_numeric or isinstance(okey_dt, (T.DateType,
+                                                           T.TimestampType))):
+            return f"range frame over non-numeric order key {okey_dt}"
+        return None
     return f"window function {type(f).__name__} not supported"
 
 
@@ -111,11 +118,13 @@ class WindowExec(TpuExec):
         seg_ids = jnp.cumsum(part_boundary.astype(jnp.int32)) - 1
 
         sctx = EvalContext(sorted_in, batch.lazy_num_rows, cap)
+        bounds_memo = {}  # per-batch: partitions run concurrently in threads
         out_cols = list(sorted_in)
         for e in self.window_exprs:
             we = _unalias(e)
             out_cols.append(self._eval_window(
-                we, sctx, part_boundary, order_boundary, seg_ids, cap, live))
+                we, sctx, part_boundary, order_boundary, seg_ids, cap, live,
+                sorted_order, bounds_memo))
         return ColumnarBatch([c.to_vector() for c in out_cols],
                              batch.lazy_num_rows, self.output)
 
@@ -134,7 +143,8 @@ class WindowExec(TpuExec):
             b = b | differs | (c.validity != prev_valid)
         return b.at[0].set(True)
 
-    def _eval_window(self, we, sctx, part_b, order_b, seg_ids, cap, live):
+    def _eval_window(self, we, sctx, part_b, order_b, seg_ids, cap, live,
+                     sorted_order, bounds_memo):
         f = we.func
         frame = we.spec.frame
         if isinstance(f, RowNumber):
@@ -156,9 +166,54 @@ class WindowExec(TpuExec):
                 c.values, c.validity, seg_ids, off, cap, fill, fill_valid)
             return Col(vals, valid & live, c.dtype, c.dictionary)
         assert isinstance(f, AggregateFunction), f
-        return self._eval_agg_window(f, frame, sctx, part_b, order_b, cap, live)
+        return self._eval_agg_window(f, we, sctx, part_b, order_b, seg_ids,
+                                     cap, live, sorted_order, bounds_memo)
 
-    def _eval_agg_window(self, f, frame, sctx, part_b, order_b, cap, live):
+    def _frame_lo_hi(self, we, part_b, order_b, seg_ids, cap, sorted_order,
+                     bounds_memo):
+        """Per-row inclusive [lo, hi] index bounds of the frame. Every frame
+        shape reduces to this; aggregates then answer range queries
+        (prefix-sum differences / sparse-table gathers, ops/windowing.py).
+        Memoized per batch: all expressions share one partition/order spec and
+        usually repeat frames, and the range search is the priciest step."""
+        frame = we.spec.frame
+        cached = bounds_memo.get(frame)
+        if cached is not None:
+            return cached
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        pstart = W.seg_starts(part_b)
+        pend = self._partition_ends(part_b, cap)
+        if frame.is_unbounded_both:
+            lo, hi = pstart, pend
+        elif frame.frame_type == "rows":
+            if frame.is_unbounded_to_current:
+                lo, hi = pstart, idx
+            else:
+                lo = pstart if frame.preceding is None else \
+                    jnp.maximum(idx - frame.preceding, pstart)
+                hi = pend if frame.following is None else \
+                    jnp.minimum(idx + frame.following, pend)
+        elif frame.is_unbounded_to_current:
+            lo, hi = pstart, W.tie_group_ends(order_b, part_b)
+        else:
+            (_okey, asc, _nf) = we.spec.order_by[0]
+            oc = sorted_order[0]
+            lo, hi = W.range_frame_bounds(
+                oc.values, oc.validity, seg_ids, asc,
+                frame.preceding, frame.following, pstart, pend)
+        bounds_memo[frame] = (lo, hi)
+        return lo, hi
+
+    @staticmethod
+    def _range_sum(values, lo, hi):
+        """Sum over [lo, hi] via one global inclusive cumsum (lo/hi never cross
+        a partition, so cross-partition prefix mass cancels in the diff)."""
+        cs = jnp.cumsum(values, axis=0)
+        return cs[hi] - jnp.where(lo > 0, cs[jnp.maximum(lo - 1, 0)],
+                                  jnp.zeros_like(cs[0]))
+
+    def _eval_agg_window(self, f, we, sctx, part_b, order_b, seg_ids, cap,
+                         live, sorted_order, bounds_memo):
         dict_ = None
         if isinstance(f, Count) and not f.children:
             vals = jnp.ones((cap,), jnp.int64)
@@ -171,67 +226,47 @@ class WindowExec(TpuExec):
         if isinstance(f, (Min, Max)) and vals.dtype == jnp.bool_:
             vals = vals.astype(jnp.int8)  # iinfo sentinels need an int carrier
 
-        is_avg = isinstance(f, Average)
-        is_cnt = isinstance(f, Count)
         out_dtype = f.dtype
+        lo, hi = self._frame_lo_hi(we, part_b, order_b, seg_ids, cap,
+                                   sorted_order, bounds_memo)
+        nonempty = hi >= lo
+        lo_q = jnp.where(nonempty, lo, 0)
+        hi_q = jnp.where(nonempty, hi, 0)
 
-        cnt_scan = W.seg_cumsum((valid).astype(jnp.int64), part_b)
-        if isinstance(f, (Sum, Average)) or is_cnt:
+        cnt_w = jnp.where(
+            nonempty, self._range_sum(valid.astype(jnp.int64), lo_q, hi_q), 0)
+        if isinstance(f, (Sum, Average, Count)):
             acc_dt = (jnp.float64 if isinstance(dtype, T.FractionalType)
                       else jnp.int64)
             data = jnp.where(valid, vals, jnp.zeros_like(vals)).astype(acc_dt)
-            sum_scan = W.seg_cumsum(data, part_b)
-        nan_scan = nonnan_scan = None
-        if isinstance(f, (Min, Max)) and isinstance(dtype, T.FractionalType):
-            # Spark: NaN is the LARGEST value — min ignores NaN unless the frame
-            # is all-NaN; max is NaN as soon as the frame contains one
+            sum_w = self._range_sum(data, lo_q, hi_q)
+            return self._finish(f, sum_w, cnt_w, None, out_dtype, live, None)
+
+        # min/max: sparse-table range queries; Spark orders NaN as the LARGEST
+        # value — min ignores NaN unless the frame is all-NaN, max returns NaN
+        # as soon as the frame contains one
+        if isinstance(dtype, T.FractionalType):
             nan = jnp.isnan(vals)
-            nan_scan = W.seg_cumsum((valid & nan).astype(jnp.int32), part_b)
-            nonnan_scan = W.seg_cumsum((valid & ~nan).astype(jnp.int32), part_b)
+            nan_w = self._range_sum((valid & nan).astype(jnp.int32), lo_q, hi_q)
+            nonnan_w = self._range_sum((valid & ~nan).astype(jnp.int32),
+                                       lo_q, hi_q)
             eff_valid = valid & ~nan
+            sent = jnp.asarray(jnp.inf if isinstance(f, Min) else -jnp.inf,
+                               vals.dtype)
         else:
+            nan_w = None
             eff_valid = valid
-        if isinstance(f, Min):
-            sentinel = (jnp.asarray(jnp.inf, vals.dtype)
-                        if isinstance(dtype, T.FractionalType)
-                        else jnp.asarray(jnp.iinfo(vals.dtype).max, vals.dtype))
-            mm_scan = W.seg_cummin(jnp.where(eff_valid, vals, sentinel), part_b)
-        if isinstance(f, Max):
-            sentinel = (jnp.asarray(-jnp.inf, vals.dtype)
-                        if isinstance(dtype, T.FractionalType)
-                        else jnp.asarray(jnp.iinfo(vals.dtype).min, vals.dtype))
-            mm_scan = W.seg_cummax(jnp.where(eff_valid, vals, sentinel), part_b)
-
-        idx = jnp.arange(cap, dtype=jnp.int32)
-        if frame.is_unbounded_both:
-            pos = self._partition_ends(part_b, cap)
-        elif frame.frame_type == "range" and frame.is_unbounded_to_current:
-            pos = W.tie_group_ends(order_b, part_b)
-        elif frame.frame_type == "rows" and frame.is_unbounded_to_current:
-            pos = idx
-        else:  # sliding rows frame [preceding, following] (sum/count/avg only)
-            pstart = W.seg_starts(part_b)
-            pend = self._partition_ends(part_b, cap)
-            fol = cap if frame.following is None else frame.following
-            pre = cap if frame.preceding is None else frame.preceding
-            hi = jnp.minimum(idx + fol, pend)
-            lo = jnp.maximum(idx - pre, pstart)
-            cnt_w = cnt_scan[hi] - jnp.where(lo > pstart, cnt_scan[lo - 1], 0)
-            sum_w = sum_scan[hi] - jnp.where(
-                lo > pstart, sum_scan[lo - 1], jnp.zeros_like(sum_scan[0]))
-            return self._finish(f, sum_w, cnt_w, None, out_dtype, live, None)
-
-        cnt_w = cnt_scan[pos]
-        if isinstance(f, (Sum, Average)) or is_cnt:
-            sum_w = sum_scan[pos]
-            return self._finish(f, sum_w, cnt_w, None, out_dtype, live, None)
-        mm_w = mm_scan[pos]
-        if nan_scan is not None:
+            info = jnp.iinfo(vals.dtype)
+            sent = jnp.asarray(info.max if isinstance(f, Min) else info.min,
+                               vals.dtype)
+        combine = jnp.minimum if isinstance(f, Min) else jnp.maximum
+        table = W.sparse_table(jnp.where(eff_valid, vals, sent), combine, sent)
+        mm_w = W.range_query(table, combine, lo_q, hi_q)
+        if nan_w is not None:
             if isinstance(f, Min):  # all-NaN frame → NaN
-                mm_w = jnp.where((nonnan_scan[pos] == 0) & (nan_scan[pos] > 0),
-                                 jnp.nan, mm_w)
+                mm_w = jnp.where((nonnan_w == 0) & (nan_w > 0), jnp.nan, mm_w)
             else:                   # any NaN in frame → NaN
-                mm_w = jnp.where(nan_scan[pos] > 0, jnp.nan, mm_w)
+                mm_w = jnp.where(nan_w > 0, jnp.nan, mm_w)
         return self._finish(f, None, cnt_w, mm_w, out_dtype, live, dict_)
 
     @staticmethod

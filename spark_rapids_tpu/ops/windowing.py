@@ -68,10 +68,6 @@ def seg_cummax(values, boundary):
     return segmented_scan(values, boundary, jnp.maximum)
 
 
-def seg_cummin(values, boundary):
-    return segmented_scan(values, boundary, jnp.minimum)
-
-
 def tie_group_ends(order_boundary, part_boundary):
     """For RANGE frames: last index of each row's order-key tie group within its
     partition (rows with equal order keys share the frame end — Spark RANGE
@@ -117,3 +113,133 @@ def shift_within_partition(values, validity, seg_ids, offset: int, capacity: int
     vals = jnp.where(same_part, values[src_c], fill_value)
     valid = jnp.where(same_part, validity[src_c], fill_valid)
     return vals, valid
+
+
+# ---- variable-bound frames: [lo, hi] per row ------------------------------
+#
+# Sliding min/max and bounded RANGE frames reduce every frame shape to an
+# inclusive per-row index window [lo, hi]. min/max answer range queries with a
+# sparse table (log-levels of power-of-2 span minima — the TPU-native stand-in
+# for cudf's per-row rolling gather, reference GpuWindowExpression.scala:847);
+# sums/counts difference one global cumsum. All static shapes, O(n log n).
+
+def sparse_table(values, combine, sentinel):
+    """(L, n) table: t[k][i] = combine over values[i : i+2^k] (clamped).
+    Entries whose span crosses n are padded with `sentinel`; queries built by
+    `range_query` never read a padded slot for in-bounds [lo, hi]."""
+    n = values.shape[0]
+    levels = [values]
+    k = 0
+    while (1 << (k + 1)) <= n:
+        prev = levels[-1]
+        s = 1 << k
+        shifted = jnp.concatenate(
+            [prev[s:], jnp.full((s,), sentinel, prev.dtype)])
+        levels.append(combine(prev, shifted))
+        k += 1
+    return jnp.stack(levels)
+
+
+def range_query(table, combine, lo, hi):
+    """combine over [lo, hi] inclusive per row (requires hi >= lo; callers mask
+    empty frames separately). Two overlapping power-of-2 spans."""
+    L = table.shape[0]
+    w = hi - lo + 1
+    k = jnp.zeros_like(w)
+    for j in range(1, L):
+        k = k + (w >= (1 << j)).astype(k.dtype)
+    span = jnp.left_shift(jnp.ones_like(k), k)
+    a = table[k, lo]
+    b = table[k, hi - span + 1]
+    return combine(a, b)
+
+
+def searchsorted_lex(seg, rank, val, q_seg, q_rank, q_val, side: str):
+    """Vectorized first index j with (seg[j], rank[j], val[j]) >= (or > for
+    side='right') the per-row query triple, by branchless binary search —
+    log2(n) rounds of gathers, no data-dependent control flow. The arrays must
+    be lexicographically sorted (they are: rows sort by partition, then
+    null-rank, then order value)."""
+    n = seg.shape[0]
+    lo = jnp.zeros_like(q_seg, shape=q_seg.shape).astype(jnp.int32)
+    hi = jnp.full(q_seg.shape, n, jnp.int32)
+    steps = max(1, n.bit_length())
+    for _ in range(steps):
+        mid = (lo + hi) >> 1
+        m = jnp.clip(mid, 0, n - 1)
+        sj, rj, vj = seg[m], rank[m], val[m]
+        if side == "left":
+            vcmp = vj >= q_val
+        else:
+            vcmp = vj > q_val
+        ge = (sj > q_seg) | ((sj == q_seg) &
+                             ((rj > q_rank) | ((rj == q_rank) & vcmp)))
+        ge = ge & (mid < n)
+        hi = jnp.where(ge, mid, hi)
+        lo = jnp.where(ge, lo, jnp.minimum(mid + 1, n))
+    return lo
+
+
+def range_frame_bounds(order_col_values, order_validity, seg_ids, ascending,
+                       preceding, following, pstart, pend):
+    """Per-row [lo, hi] for a bounded RANGE frame over ONE numeric order key.
+
+    Sort-space transform: desc negates (bitwise-not for ints so INT_MIN is
+    safe), so the search is always ascending. Rows sort (within a partition)
+    as null-first-group < values < NaN-group < null-last-group — encoded in a
+    rank lane so null/NaN current rows resolve to their PEER GROUP on bounded
+    sides (Spark RangeBoundOrdering: null±offset is null, which compares equal
+    to nulls only; NaN is its own largest peer class).
+    """
+    v = order_col_values
+    is_float = jnp.issubdtype(v.dtype, jnp.floating)
+    if is_float:
+        s = jnp.where(jnp.isnan(v), jnp.float64(0), v.astype(jnp.float64))
+        s = s if ascending else -s
+        nan_rank_pos = jnp.isnan(v)
+        q_lo_sent = jnp.float64(-jnp.inf)
+        q_hi_sent = jnp.float64(jnp.inf)
+        pre = None if preceding is None else jnp.float64(preceding)
+        fol = None if following is None else jnp.float64(following)
+    else:
+        s = v.astype(jnp.int64)
+        s = s if ascending else ~s
+        nan_rank_pos = jnp.zeros(v.shape, jnp.bool_)
+        q_lo_sent = jnp.int64(jnp.iinfo(jnp.int64).min)
+        q_hi_sent = jnp.int64(jnp.iinfo(jnp.int64).max)
+        pre = None if preceding is None else jnp.int64(preceding)
+        fol = None if following is None else jnp.int64(following)
+
+    # rank within partition: nulls keep their sorted side, NaN sorts as the
+    # largest value class (asc) / smallest (desc negation puts it first, but
+    # the sort itself put NaN where 'NaN is largest' dictates — derive the
+    # rank from the OBSERVED layout by giving NaN the rank matching direction)
+    nan_rank = jnp.int32(2) if ascending else jnp.int32(-1)
+    rank = jnp.where(order_validity,
+                     jnp.where(nan_rank_pos, nan_rank, jnp.int32(1)),
+                     jnp.int32(0))
+    # null rows sort first or last depending on nulls_first: infer from layout
+    # (a null row at pstart ⇒ nulls-first). Both cases keep nulls one block.
+    null_first_here = ~order_validity[pstart]
+    rank = jnp.where(order_validity, rank,
+                     jnp.where(null_first_here, jnp.int32(-2), jnp.int32(3)))
+
+    s = jnp.where(order_validity & ~nan_rank_pos, s,
+                  jnp.zeros_like(s))  # peers distinguished by rank lane only
+    own_rank = rank
+    peer_only = ~order_validity | nan_rank_pos
+
+    if pre is None:
+        lo = pstart
+    else:
+        q_val = jnp.where(peer_only, q_lo_sent, s - pre)
+        lo = searchsorted_lex(seg_ids, rank, s, seg_ids, own_rank, q_val,
+                              side="left")
+    if fol is None:
+        hi = pend
+    else:
+        q_val = jnp.where(peer_only, q_hi_sent, s + fol)
+        hi = searchsorted_lex(seg_ids, rank, s, seg_ids, own_rank, q_val,
+                              side="right") - 1
+    return jnp.maximum(lo, pstart).astype(jnp.int32), \
+        jnp.minimum(hi, pend).astype(jnp.int32)

@@ -111,14 +111,54 @@ def _frame_bounds(we, i, rows, order_cols):
     if fr.is_unbounded_both:
         return 0, n - 1
     if fr.frame_type == "range":
-        if not (fr.preceding is None and fr.following == 0):
-            raise NotImplementedError(
-                f"host window: range frame with offsets {fr}")
-        # unbounded preceding → current row including ties
-        for tg in _tie_groups(rows, order_cols):
-            if i in tg:
-                return 0, tg[-1]
-        return 0, i
+        if fr.preceding is None and fr.following == 0:
+            # unbounded preceding → current row including ties
+            for tg in _tie_groups(rows, order_cols):
+                if i in tg:
+                    return 0, tg[-1]
+            return 0, i
+        # bounded range frame over ONE order key (Spark RangeBoundOrdering:
+        # null±offset compares equal to nulls only, NaN is its own peer class)
+        if len(order_cols) != 1:
+            raise ValueError(  # Spark rejects this at analysis too
+                "bounded range frame requires exactly one order key")
+        (data, asc, _nf) = order_cols[0]
+        v = data[rows[i]]
+        v_nan = isinstance(v, float) and math.isnan(v)
+
+        def in_lo(u):
+            if v is None or v_nan:   # peer group on bounded sides
+                return (u is None) if v is None else \
+                    (isinstance(u, float) and math.isnan(u))
+            if u is None or (isinstance(u, float) and math.isnan(u)):
+                return False
+            return (u >= v - fr.preceding) if asc else \
+                (u <= v + fr.preceding)
+
+        def in_hi(u):
+            if v is None or v_nan:
+                return (u is None) if v is None else \
+                    (isinstance(u, float) and math.isnan(u))
+            if u is None or (isinstance(u, float) and math.isnan(u)):
+                return False
+            return (u <= v + fr.following) if asc else \
+                (u >= v - fr.following)
+
+        lo = 0
+        if fr.preceding is not None:
+            lo = n
+            for j in range(n):
+                if in_lo(data[rows[j]]):
+                    lo = j
+                    break
+        hi = n - 1
+        if fr.following is not None:
+            hi = -1
+            for j in range(n - 1, -1, -1):
+                if in_hi(data[rows[j]]):
+                    hi = j
+                    break
+        return lo, hi
     lo = 0 if fr.preceding is None else max(0, i - fr.preceding)
     hi = n - 1 if fr.following is None else min(n - 1, i + fr.following)
     return lo, hi

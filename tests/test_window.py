@@ -147,17 +147,133 @@ def test_nan_min_max_window():
         assert math.isnan(rows[2][0]) and math.isnan(rows[2][1])
 
 
-def test_sliding_min_max_falls_back():
-    t = win_table(50)
+def test_sliding_min_max_on_device():
+    """Sliding rows min/max runs on device (sparse-table range queries,
+    ops/windowing.py — VERDICT r1 item #4)."""
+    t = win_table(200)
     node = WindowNode([
         Alias(WindowExpression(Min(col("v")),
                                spec(frame=WindowFrame("rows", 2, 2))), "m"),
+        Alias(WindowExpression(Max(col("v")),
+                               spec(frame=WindowFrame("rows", 3, 1))), "x"),
+        Alias(WindowExpression(Min(col("o")),
+                               spec(frame=WindowFrame("rows", 0, 4))), "mi"),
+        Alias(WindowExpression(Max(col("v")),
+                               spec(frame=WindowFrame("rows", 2, None))), "xu"),
+    ], ScanNode(split_table(t, 2)))
+    hybrid = check(node)
+    assert isinstance(hybrid, TpuExec), explain_plan(node)
+
+
+def test_sliding_min_max_nan_and_empty_frames():
+    t = pa.table({
+        "g": pa.array([1, 1, 1, 1, 1], pa.int64()),
+        "o": pa.array([1, 2, 3, 4, 5], pa.int32()),
+        "v": pa.array([1.0, float("nan"), None, 4.0, 2.0], pa.float64()),
+    })
+    node = WindowNode([
+        Alias(WindowExpression(Max(col("v")),
+                               spec(frame=WindowFrame("rows", 1, 1))), "mx"),
+        Alias(WindowExpression(Min(col("v")),
+                               spec(frame=WindowFrame("rows", 1, 1))), "mn"),
+    ], ScanNode([t]))
+    hybrid = check(node)
+    assert isinstance(hybrid, TpuExec), explain_plan(node)
+
+
+def test_range_frame_bounded_int_key():
+    """RANGE BETWEEN k PRECEDING AND k FOLLOWING over an int order key, asc and
+    desc, with nulls in the VALUE column (VERDICT r1 item #4)."""
+    r = np.random.default_rng(5)
+    n = 300
+    t = pa.table({
+        "g": pa.array([int(v) for v in r.integers(0, 6, n)], pa.int64()),
+        "o": pa.array([int(v) for v in r.integers(0, 40, n)], pa.int32()),
+        "v": pa.array([None if m < 0.1 else float(x) for x, m in
+                       zip(r.normal(0, 10, n), r.random(n))], pa.float64()),
+    })
+    for asc in (True, False):
+        sp = WindowSpec((col("g"),), ((col("o"), asc, True),),
+                        WindowFrame("range", 3, 5))
+        node = WindowNode([
+            Alias(WindowExpression(Sum(col("v")), sp), "s"),
+            Alias(WindowExpression(Count(col("v")), sp), "c"),
+            Alias(WindowExpression(Min(col("v")), sp), "mn"),
+            Alias(WindowExpression(Max(col("v")), sp), "mx"),
+            Alias(WindowExpression(Average(col("v")), sp), "av"),
+        ], ScanNode(split_table(t, 2)))
+        hybrid = check(node)
+        assert isinstance(hybrid, TpuExec), explain_plan(node)
+
+
+def test_range_frame_null_order_keys():
+    """Null order values form their own peer group on bounded sides (Spark
+    RangeBoundOrdering: null±offset compares equal only to nulls)."""
+    t = pa.table({
+        "g": pa.array([1, 1, 1, 1, 1, 2, 2], pa.int64()),
+        "o": pa.array([None, None, 1, 3, 9, None, 5], pa.int32()),
+        "v": pa.array([1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0], pa.float64()),
+    })
+    for nf in (True, False):
+        sp = WindowSpec((col("g"),), ((col("o"), True, nf),),
+                        WindowFrame("range", 2, 2))
+        node = WindowNode([
+            Alias(WindowExpression(Sum(col("v")), sp), "s"),
+            Alias(WindowExpression(Count(col("v")), sp), "c"),
+        ], ScanNode([t]))
+        hybrid = check(node)
+        assert isinstance(hybrid, TpuExec), explain_plan(node)
+
+
+def test_range_frame_one_sided_and_unbounded():
+    r = np.random.default_rng(9)
+    n = 120
+    t = pa.table({
+        "g": pa.array([int(v) for v in r.integers(0, 4, n)], pa.int64()),
+        "o": pa.array([int(v) for v in r.integers(0, 30, n)], pa.int32()),
+        "v": pa.array([float(x) for x in r.normal(0, 3, n)], pa.float64()),
+    })
+    sp1 = WindowSpec((col("g"),), ((col("o"), True, True),),
+                     WindowFrame("range", None, 4))   # unbounded → +4
+    sp2 = WindowSpec((col("g"),), ((col("o"), True, True),),
+                     WindowFrame("range", 2, None))   # -2 → unbounded
+    sp3 = WindowSpec((col("g"),), ((col("o"), True, True),),
+                     WindowFrame("range", 0, 0))      # peers only
+    node = WindowNode([
+        Alias(WindowExpression(Sum(col("v")), sp1), "s1"),
+        Alias(WindowExpression(Sum(col("v")), sp2), "s2"),
+        Alias(WindowExpression(Sum(col("v")), sp3), "s3"),
+    ], ScanNode(split_table(t, 3)))
+    hybrid = check(node)
+    assert isinstance(hybrid, TpuExec), explain_plan(node)
+
+
+def test_range_frame_float_key_with_nan():
+    t = pa.table({
+        "g": pa.array([1, 1, 1, 1, 1], pa.int64()),
+        "o": pa.array([1.0, 2.5, float("nan"), float("nan"), 9.0],
+                      pa.float64()),
+        "v": pa.array([1.0, 2.0, 4.0, 8.0, 16.0], pa.float64()),
+    })
+    sp = WindowSpec((col("g"),), ((col("o"), True, True),),
+                    WindowFrame("range", 2, 2))
+    node = WindowNode([
+        Alias(WindowExpression(Sum(col("v")), sp), "s"),
+    ], ScanNode([t]))
+    hybrid = check(node)
+    assert isinstance(hybrid, TpuExec), explain_plan(node)
+
+
+def test_range_frame_multi_order_key_falls_back():
+    t = win_table(40)
+    sp = WindowSpec((col("g"),),
+                    ((col("o"), True, True), (col("v"), True, True)),
+                    WindowFrame("range", 1, 1))
+    node = WindowNode([
+        Alias(WindowExpression(Sum(col("v")), sp), "s"),
     ], ScanNode([t]))
     txt = explain_plan(node)
-    assert "sliding min/max" in txt
-    # host path still produces the result
-    out = execute_hybrid(TpuOverrides(RapidsConf()).apply(node))
-    assert out.num_rows == 50
+    assert "one order key" in txt
 
 
 def test_window_no_order_by_full_frame():
