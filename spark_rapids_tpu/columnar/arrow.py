@@ -56,11 +56,30 @@ def string_array_to_device(arr, capacity: int | None = None) -> TpuColumnVector:
     return cv.with_dictionary(sorted_dict)
 
 
+def list_array_to_device(arr: pa.Array, dtype: T.ArrayType,
+                         capacity: int | None = None):
+    """List column → ListVector: flatten non-null lists into one padded flat
+    element vector on device; row offsets stay host metadata (the same
+    data/metadata split as string dictionaries)."""
+    from spark_rapids_tpu.columnar.vector import ListVector
+    validity = _validity_of(arr)
+    lengths = pc.list_value_length(arr).fill_null(0).to_numpy(
+        zero_copy_only=False).astype(np.int64)
+    offsets = np.concatenate([[0], np.cumsum(lengths)])
+    flat_arr = arr.flatten()  # elements of non-null lists, in row order
+    flat = array_to_device(flat_arr, dtype.element_type,
+                           bucket_capacity(len(flat_arr)))
+    cap = capacity or bucket_capacity(len(arr))
+    return ListVector(dtype, flat, offsets, validity, cap)
+
+
 def array_to_device(arr, dtype: T.DataType | None = None,
                     capacity: int | None = None) -> TpuColumnVector:
     if isinstance(arr, pa.ChunkedArray):
         arr = arr.combine_chunks()
     dtype = dtype or T.from_arrow_type(arr.type)
+    if isinstance(dtype, T.ArrayType):
+        return list_array_to_device(arr, dtype, capacity)
     if isinstance(dtype, T.StringType):
         return string_array_to_device(arr, capacity)
     validity = _validity_of(arr)

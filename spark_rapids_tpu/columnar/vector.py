@@ -168,3 +168,58 @@ class TpuColumnVector:
     def __repr__(self):
         return (f"TpuColumnVector({self.dtype}, cap={self.capacity}"
                 f"{', dict=' + str(len(self.dictionary)) if self.dictionary is not None else ''})")
+
+
+class ListVector(TpuColumnVector):
+    """Arrow-layout list column on device: a FLAT padded element vector plus
+    host row offsets (list structure is metadata, elements are the data — the
+    same split the I/O layer uses for string dictionaries).
+
+    Exists only between the arrow bridge and GenerateExec (explode): every
+    other exec's TypeSig rejects ArrayType, so the planner pins those to host
+    (reference GpuGenerateExec.scala consumes cudf LIST columns the same way —
+    the list column never survives past the generate).
+
+    ``data`` holds per-row element counts (int32, nulls count 0) so device
+    programs can expand without touching host metadata again; ``offsets`` is
+    the host-side prefix (len num_rows+1) into ``flat``.
+    """
+
+    __slots__ = ("flat", "offsets", "host_validity")
+
+    def __init__(self, dtype: T.DataType, flat: TpuColumnVector,
+                 offsets: np.ndarray, validity: np.ndarray, capacity: int):
+        n = len(offsets) - 1
+        lengths = np.zeros(capacity, dtype=np.int32)
+        lengths[:n] = np.diff(offsets)
+        valid = np.zeros(capacity, dtype=bool)
+        valid[:n] = validity
+        super().__init__(dtype, jnp.asarray(lengths), jnp.asarray(valid))
+        self.flat = flat
+        self.offsets = np.asarray(offsets, dtype=np.int64)
+        self.host_validity = np.asarray(validity, dtype=bool)
+
+    @property
+    def element_dtype(self) -> T.DataType:
+        return self.dtype.element_type
+
+    @property
+    def total_elements(self) -> int:
+        return int(self.offsets[-1])
+
+    def device_memory_size(self) -> int:
+        return self.data.nbytes + self.validity.nbytes + \
+            self.flat.device_memory_size()
+
+    def to_arrow(self, num_rows: int) -> pa.Array:
+        flat_arr = self.flat.to_arrow(self.total_elements)
+        off = self.offsets[:num_rows + 1]
+        # a null slot in the offsets array marks a null list (pyarrow API)
+        off_list = [None if (i < num_rows and not self.host_validity[i])
+                    else int(off[i]) for i in range(num_rows + 1)]
+        return pa.ListArray.from_arrays(pa.array(off_list, pa.int32()),
+                                        flat_arr)
+
+    def __repr__(self):
+        return (f"ListVector({self.dtype}, cap={self.capacity}, "
+                f"elems={self.total_elements})")

@@ -665,37 +665,52 @@ class ExpandNode(PlanNode):
 
 
 class GenerateNode(PlanNode):
-    """explode(array) generator (GpuGenerateExec analog). The array comes from a
-    host list column; device-side, arrays are represented as fixed-width slots."""
+    """explode/posexplode(array) generator (GpuGenerateExec analog). Device
+    side the list column rides the arrow bridge as a ListVector and the
+    expansion is one gather program (exec/generate.py)."""
 
     def __init__(self, generator_col: str, child: PlanNode, outer: bool = False,
-                 element_type: T.DataType = None):
+                 element_type: T.DataType = None, pos: bool = False):
         super().__init__(child)
         self.generator_col = generator_col
         self.outer = outer
+        self.pos = pos
         self.element_type = element_type or T.LONG
+        taken = {f.name for f in child.output if f.name != generator_col}
+        for out_name in (("pos", "col") if pos else ("col",)):
+            if out_name in taken:  # Spark allows duplicate names; we don't
+                raise ValueError(
+                    f"explode output column '{out_name}' collides with an "
+                    f"input column — rename the input first")
 
     @property
     def output(self):
         fields = [f for f in self.child.output if f.name != self.generator_col]
+        if self.pos:
+            fields.append(T.StructField("pos", T.INT, self.outer))
         fields.append(T.StructField("col", self.element_type, True))
         return T.StructType(fields)
 
     def execute_host(self, split):
         tbl = self.child.execute_host(split)
         gen = tbl.column(self.generator_col).to_pylist()
-        keep_names = [f.name for f in self.output if f.name != "col"]
+        keep_names = [f.name for f in self.output
+                      if f.name not in ("col", "pos")]
         rows = {n: [] for n in keep_names}
         out_vals = []
+        out_pos = []
         for i, arr in enumerate(gen):
             # null or empty array: explode drops the row, explode_outer keeps it
             items = arr if arr else ([None] if self.outer else [])
-            for v in items:
+            for p, v in enumerate(items):
                 for nme in keep_names:
                     rows[nme].append(tbl.column(nme)[i].as_py())
                 out_vals.append(v)
+                out_pos.append(p if arr else None)
         data = {n: pa.array(rows[n], T.to_arrow_type(
             next(f.data_type for f in self.output if f.name == n)))
             for n in keep_names}
+        if self.pos:
+            data["pos"] = pa.array(out_pos, pa.int32())
         data["col"] = pa.array(out_vals, T.to_arrow_type(self.element_type))
         return pa.table(data)

@@ -607,8 +607,47 @@ def _register_all():
         return CachedScanExec(meta.node, conf=meta.conf)
 
     exr(CacheNode, "materialized dataframe cache", conv_cache)
-    # GenerateNode (explode over array columns) stays host-only until device
-    # arrays land; the meta tags it and the interpreter runs it.
+
+    from spark_rapids_tpu.exec.generate import GenerateExec
+
+    def tag_generate(meta):
+        n = meta.node
+        try:
+            f = n.child.output[n.generator_col]
+        except KeyError:
+            meta.will_not_work(f"no such column {n.generator_col}")
+            return
+        if not isinstance(f.data_type, T.ArrayType):
+            meta.will_not_work(
+                f"generator input {n.generator_col} is {f.data_type}, "
+                "not an array")
+        elif f.data_type.element_type != n.element_type:
+            meta.will_not_work(
+                f"declared element type {n.element_type} != actual "
+                f"{f.data_type.element_type}")
+        elif isinstance(n.element_type, (T.ArrayType, T.StructDataType)):
+            meta.will_not_work(
+                f"nested element type {n.element_type} not supported on "
+                "device (flat element vectors only)")
+
+    def conv_generate(meta, kids):
+        n = meta.node
+        return GenerateExec(n.generator_col, kids[0], outer=n.outer,
+                            element_type=n.element_type, pos=n.pos,
+                            conf=meta.conf)
+
+    class GenerateChecks(TS.ExecChecks):
+        """The generator input column is ALLOWED to be an array (that is the
+        point); everything else follows the normal signature (reference
+        TypeChecks per-exec param overrides for GpuGenerateExec)."""
+
+        def input_fields(self, node):
+            return (f for f in super().input_fields(node)
+                    if f.name != node.generator_col)
+
+    R.exec_rule(NN.GenerateNode, ExecRule(
+        "explode via one device gather program", conv_generate,
+        GenerateChecks(TS.ORDERABLE), None, tag_generate))
 
 
 _register_all()

@@ -73,18 +73,23 @@ class ExecChecks:
     def __init__(self, sig: TypeSig = COMMON + DECIMAL):
         self.sig = sig
 
+    def input_fields(self, node):
+        """Input columns to type-check; subclasses may exempt columns an exec
+        consumes specially (e.g. GenerateExec's array input)."""
+        for child in node.children:
+            yield from child.output
+
     def tag(self, meta) -> None:
         for field in meta.node.output:
             if not self.sig.supports(field.data_type):
                 meta.will_not_work(
                     f"unsupported output type {field.data_type} for column "
                     f"'{field.name}'")
-        for child in meta.node.children:
-            for field in child.output:
-                if not self.sig.supports(field.data_type):
-                    meta.will_not_work(
-                        f"unsupported input type {field.data_type} for column "
-                        f"'{field.name}'")
+        for field in self.input_fields(meta.node):
+            if not self.sig.supports(field.data_type):
+                meta.will_not_work(
+                    f"unsupported input type {field.data_type} for column "
+                    f"'{field.name}'")
 
 
 class ExprChecks:

@@ -135,6 +135,18 @@ class DataFrame:
     def window(self, window_exprs: list) -> "DataFrame":
         return DataFrame(NN.WindowNode(window_exprs, self._plan), self.session)
 
+    def explode(self, column: str, outer: bool = False,
+                pos: bool = False) -> "DataFrame":
+        """explode/posexplode an array column into one row per element
+        (GpuGenerateExec analog; device path is one gather program)."""
+        f = self._plan.output[column]
+        if not isinstance(f.data_type, T.ArrayType):
+            raise TypeError(
+                f"explode: column '{column}' is {f.data_type}, not an array")
+        return DataFrame(NN.GenerateNode(
+            column, self._plan, outer=outer,
+            element_type=f.data_type.element_type, pos=pos), self.session)
+
     def cache(self, serializer: str | None = None) -> "DataFrame":
         """Materialize-once cache (reference ParquetCachedBatchSerializer /
         the device spill-store cache; conf spark.rapids.tpu.sql.cache.serializer)."""

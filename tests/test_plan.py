@@ -177,16 +177,20 @@ def test_fallback_host_execution_end_to_end(mixed_table):
 
     scan = ScanNode(split_table(mixed_table.select(["i", "l", "b"]), 2))
     proj = ProjectNode([col("i"), col("l"), col("b")], scan)
-    # GenerateNode has no device rule yet → host
+    # nested element type pins the generate to host (device rule rejects it)
     gen_tbl = pa.table({
         "k": pa.array([1, 2, 3], pa.int32()),
-        "arr": pa.array([[1, 2], [], [5]], pa.list_(pa.int64()))})
+        "arr": pa.array([[[1], [2]], [], [[5]]],
+                        pa.list_(pa.list_(pa.int64())))})
     g = NN.GenerateNode("arr", ScanNode([gen_tbl]), outer=False,
-                        element_type=T.LONG)
+                        element_type=T.ArrayType(T.LONG))
+    txt = explain_plan(g)
+    assert "nested element type" in txt
     hybrid = TpuOverrides(RapidsConf()).apply(g)
+    assert not isinstance(hybrid, TpuExec)
     out = execute_hybrid(hybrid)
     assert out.column("k").to_pylist() == [1, 1, 3]
-    assert out.column("col").to_pylist() == [1, 2, 5]
+    assert out.column("col").to_pylist() == [[1], [2], [5]]
 
 
 def test_explain_output(mixed_table):
