@@ -1,0 +1,2 @@
+"""Benchmark applications (reference integration_tests/.../mortgage/Benchmarks.scala
+role: runnable end-to-end workloads with external oracles)."""

@@ -137,15 +137,13 @@ class MeshExecutor:
             keys = [e.eval(ctx) for e in group_b]
             perm, seg_ids, boundary, live = G.group_segments(keys, n_rows, cap)
             skeys = gather_cols(keys, perm, live)
+            segctx = G.segment_structure(seg_ids, cap)
             states = []
             for a in aggs:
                 in_col = (gather_cols([a.child.eval(ctx)], perm, live)[0]
                           if a.children else
                           Col(jnp.zeros((cap,), jnp.int8), live, T.NULL))
-                sts = a.update(in_col, seg_ids, cap)
-                per_row = [Col(s.values[seg_ids], s.validity[seg_ids], s.dtype,
-                               s.dictionary) for s in sts]
-                states.extend(per_row)
+                states.extend(a.update(in_col, segctx))  # per-row states
             out, n_groups = compact_cols(skeys + states, boundary)
             return out, n_groups
 
@@ -206,14 +204,12 @@ class MeshExecutor:
             perm, seg_ids, boundary, live2 = G.group_segments(
                 keys2, m_rows, mcap)
             skeys2 = gather_cols(keys2, perm, live2)
+            segctx2 = G.segment_structure(seg_ids, mcap)
             out_states = []
             si = nk
             for a, nst in zip(aggs, state_counts):
                 sts = gather_cols(packed[si:si + nst], perm, live2)
-                merged = a.merge(sts, seg_ids, mcap)
-                out_states.extend(
-                    Col(s.values[seg_ids], s.validity[seg_ids], s.dtype,
-                        s.dictionary) for s in merged)
+                out_states.extend(a.merge(sts, segctx2))  # per-row states
                 si += nst
             out, out_groups = compact_cols(skeys2 + out_states, boundary)
 

@@ -98,15 +98,18 @@ class HashAggregateExec(TpuExec):
             perm, seg_ids, boundary, live = G.group_segments(
                 key_cols, ctx.num_rows, cap)
             sorted_keys = gather_cols(key_cols, perm, live)
-            out_keys, n_groups = compact_cols(sorted_keys, boundary)
         else:
             live = jnp.arange(cap) < ctx.num_rows
             perm = jnp.arange(cap, dtype=jnp.int32)
             seg_ids = jnp.where(live, 0, cap - 1).astype(jnp.int32)
-            out_keys = []
-            n_groups = jnp.int32(1)  # global agg: always one row (Spark semantics)
+            # global agg: always one output row, even on empty input (Spark)
+            boundary = jnp.arange(cap, dtype=jnp.int32) == 0
+            sorted_keys = []
+        segctx = G.segment_structure(seg_ids, cap)
 
-        group_valid = jnp.arange(cap, dtype=jnp.int32) < n_groups
+        # aggregate states are PER-ROW (row i = aggregate of its whole
+        # segment, ops/grouping.py) — one compaction pulls boundary rows of
+        # keys and states together
         state_cols = []
         off = nkeys
         for e in self.agg_exprs:
@@ -115,19 +118,19 @@ class HashAggregateExec(TpuExec):
             if merge:
                 ins = gather_cols([ctx.cols[off + i] for i in range(nstates)],
                                   perm, live)
-                outs = f.merge(ins, seg_ids, cap)
+                outs = f.merge(ins, segctx)
             else:
                 if f.child is None:
                     in_col = Col(jnp.zeros((cap,), jnp.int8), live, T.BYTE)
                 else:
                     in_col = f.child.eval(ctx)
                 in_sorted = gather_cols([in_col], perm, live)[0]
-                outs = f.update(in_sorted, seg_ids, cap)
+                outs = f.update(in_sorted, segctx)
             off += nstates
-            for o in outs:
-                state_cols.append(Col(o.values, o.validity & group_valid, o.dtype,
-                                      o.dictionary))
-        cols = [c.to_vector() for c in list(out_keys) + state_cols]
+            state_cols.extend(outs)
+        compacted, n_groups = compact_cols(list(sorted_keys) + state_cols,
+                                           boundary)
+        cols = [c.to_vector() for c in compacted]
         return ColumnarBatch(cols, n_groups, self._partial_schema())
 
     def _finalize(self, partial: ColumnarBatch) -> ColumnarBatch:

@@ -40,11 +40,11 @@ class AggregateFunction(Expression):
     def state_types(self) -> list:
         raise NotImplementedError
 
-    def update(self, in_col: Col, seg_ids, capacity) -> list:
+    def update(self, in_col: Col, segctx: 'G.SegCtx') -> list:
         """Raw column → list of state Cols (one per state_types entry)."""
         raise NotImplementedError
 
-    def merge(self, state_cols: list, seg_ids, capacity) -> list:
+    def merge(self, state_cols: list, segctx: 'G.SegCtx') -> list:
         """Partial states → merged states."""
         raise NotImplementedError
 
@@ -79,14 +79,14 @@ class Sum(AggregateFunction):
     def _acc_dtype(self):
         return self.dtype.jnp_dtype
 
-    def update(self, in_col, seg_ids, capacity):
+    def update(self, in_col, segctx):
         vals = in_col.values.astype(self._acc_dtype())
-        s, cnt = G.segment_sum(vals, in_col.validity, seg_ids, capacity)
+        s, cnt = G.segment_sum(vals, in_col.validity, segctx)
         return [Col(s, cnt > 0, self.dtype)]
 
-    def merge(self, state_cols, seg_ids, capacity):
+    def merge(self, state_cols, segctx):
         st = state_cols[0]
-        s, cnt = G.segment_sum(st.values, st.validity, seg_ids, capacity)
+        s, cnt = G.segment_sum(st.values, st.validity, segctx)
         return [Col(s, cnt > 0, self.dtype)]
 
     def evaluate(self, state_cols):
@@ -108,20 +108,18 @@ class Count(AggregateFunction):
     def state_types(self):
         return [T.LONG]
 
-    def update(self, in_col, seg_ids, capacity):
-        if self.child is None:
-            validity = jnp.ones_like(seg_ids, dtype=jnp.bool_)
-        else:
-            validity = in_col.validity
-        # count live rows only: seg_ids of padding point at the overflow bucket,
-        # which is discarded by the exec, so a plain segment count is safe
+    def update(self, in_col, segctx):
+        # COUNT(*): the exec passes a live-masked placeholder column, so its
+        # validity is exactly "row is live" — padding never counts (segments
+        # can span padding rows in the per-row scan design)
+        validity = in_col.validity
         ones = validity.astype(jnp.int64)
-        s, _ = G.segment_sum(ones, jnp.ones_like(validity), seg_ids, capacity)
+        s, _ = G.segment_sum(ones, jnp.ones_like(validity), segctx)
         return [Col(s, jnp.ones_like(s, dtype=jnp.bool_), T.LONG)]
 
-    def merge(self, state_cols, seg_ids, capacity):
+    def merge(self, state_cols, segctx):
         st = state_cols[0]
-        s, _ = G.segment_sum(st.values, st.validity, seg_ids, capacity)
+        s, _ = G.segment_sum(st.values, st.validity, segctx)
         return [Col(s, jnp.ones_like(s, dtype=jnp.bool_), T.LONG)]
 
     def evaluate(self, state_cols):
@@ -140,15 +138,15 @@ class Min(AggregateFunction):
     def state_types(self):
         return [self.dtype]
 
-    def update(self, in_col, seg_ids, capacity):
-        m = G.segment_min(in_col.values, in_col.validity, seg_ids, capacity,
+    def update(self, in_col, segctx):
+        m = G.segment_min(in_col.values, in_col.validity, segctx,
                           self.dtype)
-        _, cnt = G.segment_sum(jnp.zeros_like(seg_ids, jnp.int64), in_col.validity,
-                               seg_ids, capacity)
+        _, cnt = G.segment_sum(jnp.zeros_like(segctx.seg_ids, jnp.int64),
+                               in_col.validity, segctx)
         return [Col(m, cnt > 0, self.dtype, in_col.dictionary)]
 
-    def merge(self, state_cols, seg_ids, capacity):
-        return self.update(state_cols[0], seg_ids, capacity)
+    def merge(self, state_cols, segctx):
+        return self.update(state_cols[0], segctx)
 
     def evaluate(self, state_cols):
         return state_cols[0].canonicalized()
@@ -163,15 +161,15 @@ class Max(AggregateFunction):
     def state_types(self):
         return [self.dtype]
 
-    def update(self, in_col, seg_ids, capacity):
-        m = G.segment_max(in_col.values, in_col.validity, seg_ids, capacity,
+    def update(self, in_col, segctx):
+        m = G.segment_max(in_col.values, in_col.validity, segctx,
                           self.dtype)
-        _, cnt = G.segment_sum(jnp.zeros_like(seg_ids, jnp.int64), in_col.validity,
-                               seg_ids, capacity)
+        _, cnt = G.segment_sum(jnp.zeros_like(segctx.seg_ids, jnp.int64),
+                               in_col.validity, segctx)
         return [Col(m, cnt > 0, self.dtype, in_col.dictionary)]
 
-    def merge(self, state_cols, seg_ids, capacity):
-        return self.update(state_cols[0], seg_ids, capacity)
+    def merge(self, state_cols, segctx):
+        return self.update(state_cols[0], segctx)
 
     def evaluate(self, state_cols):
         return state_cols[0].canonicalized()
@@ -195,17 +193,17 @@ class Average(AggregateFunction):
         sum_t = _sum_result_type(ct)
         return [sum_t, T.LONG]
 
-    def update(self, in_col, seg_ids, capacity):
+    def update(self, in_col, segctx):
         sum_t = self.state_types[0]
         vals = in_col.values.astype(sum_t.jnp_dtype)
-        s, cnt = G.segment_sum(vals, in_col.validity, seg_ids, capacity)
+        s, cnt = G.segment_sum(vals, in_col.validity, segctx)
         return [Col(s, cnt > 0, sum_t),
                 Col(cnt, jnp.ones_like(cnt, dtype=jnp.bool_), T.LONG)]
 
-    def merge(self, state_cols, seg_ids, capacity):
+    def merge(self, state_cols, segctx):
         s_st, c_st = state_cols
-        s, _ = G.segment_sum(s_st.values, s_st.validity, seg_ids, capacity)
-        c, _ = G.segment_sum(c_st.values, c_st.validity, seg_ids, capacity)
+        s, _ = G.segment_sum(s_st.values, s_st.validity, segctx)
+        c, _ = G.segment_sum(c_st.values, c_st.validity, segctx)
         return [Col(s, c > 0, self.state_types[0]),
                 Col(c, jnp.ones_like(c, dtype=jnp.bool_), T.LONG)]
 
@@ -245,14 +243,13 @@ class First(AggregateFunction):
     def state_types(self):
         return [self.dtype]
 
-    def update(self, in_col, seg_ids, capacity):
-        vals, valid = G.segment_first(in_col.values, in_col.validity, seg_ids,
-                                      capacity, self.ignore_nulls)
+    def update(self, in_col, segctx):
+        vals, valid = G.segment_first(in_col.values, in_col.validity, segctx, self.ignore_nulls)
         return [Col(vals, valid, self.dtype, in_col.dictionary)]
 
-    def merge(self, state_cols, seg_ids, capacity):
+    def merge(self, state_cols, segctx):
         st = state_cols[0]
-        vals, valid = G.segment_first(st.values, st.validity, seg_ids, capacity,
+        vals, valid = G.segment_first(st.values, st.validity, segctx,
                                       self.ignore_nulls)
         return [Col(vals, valid, self.dtype, st.dictionary)]
 
@@ -278,14 +275,13 @@ class Last(AggregateFunction):
     def state_types(self):
         return [self.dtype]
 
-    def update(self, in_col, seg_ids, capacity):
-        vals, valid = G.segment_last(in_col.values, in_col.validity, seg_ids,
-                                     capacity, self.ignore_nulls)
+    def update(self, in_col, segctx):
+        vals, valid = G.segment_last(in_col.values, in_col.validity, segctx, self.ignore_nulls)
         return [Col(vals, valid, self.dtype, in_col.dictionary)]
 
-    def merge(self, state_cols, seg_ids, capacity):
+    def merge(self, state_cols, segctx):
         st = state_cols[0]
-        vals, valid = G.segment_last(st.values, st.validity, seg_ids, capacity,
+        vals, valid = G.segment_last(st.values, st.validity, segctx,
                                      self.ignore_nulls)
         return [Col(vals, valid, self.dtype, st.dictionary)]
 
@@ -306,21 +302,21 @@ class _CentralMoment(AggregateFunction):
     def state_types(self):
         return [T.LONG, T.DOUBLE, T.DOUBLE]
 
-    def update(self, in_col, seg_ids, capacity):
+    def update(self, in_col, segctx):
         v = in_col.values.astype(jnp.float64)
         zero = jnp.zeros_like(v)
         vv = jnp.where(in_col.validity, v, zero)
-        s, cnt = G.segment_sum(vv, in_col.validity, seg_ids, capacity)
-        s2, _ = G.segment_sum(vv * vv, in_col.validity, seg_ids, capacity)
+        s, cnt = G.segment_sum(vv, in_col.validity, segctx)
+        s2, _ = G.segment_sum(vv * vv, in_col.validity, segctx)
         ones = jnp.ones_like(cnt, dtype=jnp.bool_)
         return [Col(cnt, ones, T.LONG), Col(s, ones, T.DOUBLE),
                 Col(s2, ones, T.DOUBLE)]
 
-    def merge(self, state_cols, seg_ids, capacity):
+    def merge(self, state_cols, segctx):
         n_st, s_st, s2_st = state_cols
-        n, _ = G.segment_sum(n_st.values, n_st.validity, seg_ids, capacity)
-        s, _ = G.segment_sum(s_st.values, s_st.validity, seg_ids, capacity)
-        s2, _ = G.segment_sum(s2_st.values, s2_st.validity, seg_ids, capacity)
+        n, _ = G.segment_sum(n_st.values, n_st.validity, segctx)
+        s, _ = G.segment_sum(s_st.values, s_st.validity, segctx)
+        s2, _ = G.segment_sum(s2_st.values, s2_st.validity, segctx)
         ones = jnp.ones_like(n, dtype=jnp.bool_)
         return [Col(n, ones, T.LONG), Col(s, ones, T.DOUBLE),
                 Col(s2, ones, T.DOUBLE)]

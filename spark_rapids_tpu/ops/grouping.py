@@ -7,22 +7,43 @@ TPU-native design is a FUSED sort-based pipeline within the padded capacity:
     sort rows by keys → flag group boundaries → segment-reduce values
     → compact one row per group to the front → group count as a device scalar
 
-Everything is one XLA program (sort + cumsum + segment ops + gather); the number of
-groups never exceeds the number of live rows, so the input capacity bounds the output.
-Null keys form their own group (Spark GROUP BY semantics); null aggregation semantics
-(sum ignores nulls, null iff no non-null input, NaN handling in min/max) live in
-expr/aggregates.py which drives these primitives.
+Everything is one XLA program; the number of groups never exceeds the number of
+live rows, so the input capacity bounds the output. Null keys form their own
+group (Spark GROUP BY semantics); null aggregation semantics (sum ignores nulls,
+null iff no non-null input, NaN handling in min/max) live in expr/aggregates.py
+which drives these primitives.
+
+Segment reductions are SCAN-based, never scatter-based: TPU scatters at large
+segment counts are catastrophically slow (measured: jax.ops.segment_sum with
+4M segments does not finish in minutes on v5e, while the whole sort is ~7 ms).
+Sums difference one global cumsum at segment edges (exact for ints even across
+wrap; f64 cancellation error is ~ulp(prefix) — negligible at analytic scales);
+min/max/first/last ride segmented doubling scans (ops/windowing.py) gathered at
+per-row segment ends. Results are PER-ROW (row i holds the aggregate of row i's
+whole segment), so callers compact boundary rows to get one row per group.
 """
 
 from __future__ import annotations
+
+import typing
 
 import jax
 import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.expr.core import Col
+from spark_rapids_tpu.ops import windowing as W
 from spark_rapids_tpu.ops.sorting import sort_permutation, SortOrder
 from spark_rapids_tpu.ops.filtering import gather_cols, compact_cols
+
+
+class SegCtx(typing.NamedTuple):
+    """Shared segment structure for one sorted group-by batch."""
+    seg_ids: jnp.ndarray    # group index per sorted row (pad → capacity-1)
+    boundary: jnp.ndarray   # True at the first row of each segment
+    seg_start: jnp.ndarray  # index of the first row of the row's segment
+    seg_end: jnp.ndarray    # index of the last row of the row's segment
+    capacity: int
 
 
 def group_segments(key_cols, num_rows, capacity: int):
@@ -57,77 +78,97 @@ def group_segments(key_cols, num_rows, capacity: int):
     return perm, seg_ids, boundary, live
 
 
-def segment_sum(values, validity, seg_ids, capacity):
+def segment_structure(seg_ids, capacity: int) -> SegCtx:
+    """Per-row segment start/end from sorted seg_ids (two doubling scans,
+    shared by every aggregate in the batch)."""
+    idx = jnp.arange(capacity, dtype=jnp.int32)
+    prev = jnp.roll(seg_ids, 1)
+    boundary = (idx == 0) | (seg_ids != prev)
+    seg_start = W.seg_starts(boundary)
+    next_b = jnp.concatenate([boundary[1:], jnp.ones((1,), jnp.bool_)])
+    rev = lambda x: jnp.flip(x, 0)
+    seg_end = rev(W.seg_cummax(rev(jnp.where(next_b, idx, 0)), rev(next_b)))
+    return SegCtx(seg_ids, boundary, seg_start, seg_end, capacity)
+
+
+def _edge_sum(data, ctx: SegCtx):
+    """Per-row segment total of `data` via one global cumsum differenced at the
+    row's segment edges. Exact for ints (wrap cancels); f64 error ~ulp(prefix)."""
+    cs = jnp.cumsum(data, axis=0)
+    csz = jnp.concatenate([jnp.zeros((1,), cs.dtype), cs])
+    return csz[ctx.seg_end + 1] - csz[ctx.seg_start]
+
+
+def segment_sum(values, validity, ctx: SegCtx):
     data = jnp.where(validity, values, jnp.zeros_like(values))
-    s = jax.ops.segment_sum(data, seg_ids, num_segments=capacity)
-    cnt = jax.ops.segment_sum(validity.astype(jnp.int64), seg_ids,
-                              num_segments=capacity)
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        # floats: segmented doubling scan — no cancellation against foreign
+        # prefixes (edge-diff would subtract large cross-segment partials)
+        s = W.segmented_scan(data, ctx.boundary, jnp.add)[ctx.seg_end]
+    else:
+        s = _edge_sum(data, ctx)  # ints: exact even across wrap
+    cnt = _edge_sum(validity.astype(jnp.int64), ctx)
     return s, cnt
 
 
-def segment_min(values, validity, seg_ids, capacity, dtype: T.DataType):
+def segment_min(values, validity, ctx: SegCtx, dtype: T.DataType):
     if isinstance(dtype, T.FractionalType):
         sentinel = jnp.asarray(jnp.inf, values.dtype)
         nan = jnp.isnan(values)
         data = jnp.where(validity & ~nan, values, sentinel)
-        m = jax.ops.segment_min(data, seg_ids, num_segments=capacity)
+        m = W.segmented_scan(data, ctx.boundary, jnp.minimum)[ctx.seg_end]
         # all-NaN group: min is NaN (Spark: NaN is largest; min picks non-NaN if any)
-        has_non_nan = jax.ops.segment_max((validity & ~nan).astype(jnp.int32),
-                                          seg_ids, num_segments=capacity)
-        has_nan = jax.ops.segment_max((validity & nan).astype(jnp.int32), seg_ids,
-                                      num_segments=capacity)
-        m = jnp.where((has_non_nan == 0) & (has_nan > 0), jnp.nan, m)
-        return m
-    info = jnp.iinfo(values.dtype) if values.dtype != jnp.bool_ else None
+        has_non_nan = _edge_sum((validity & ~nan).astype(jnp.int32), ctx)
+        has_nan = _edge_sum((validity & nan).astype(jnp.int32), ctx)
+        return jnp.where((has_non_nan == 0) & (has_nan > 0), jnp.nan, m)
     if values.dtype == jnp.bool_:
-        data = jnp.where(validity, values, True)
-        return jax.ops.segment_min(data.astype(jnp.int8), seg_ids,
-                                   num_segments=capacity).astype(jnp.bool_)
+        data = jnp.where(validity, values, True).astype(jnp.int8)
+        return W.segmented_scan(data, ctx.boundary,
+                                jnp.minimum)[ctx.seg_end].astype(jnp.bool_)
+    info = jnp.iinfo(values.dtype)
     data = jnp.where(validity, values, jnp.asarray(info.max, values.dtype))
-    return jax.ops.segment_min(data, seg_ids, num_segments=capacity)
+    return W.segmented_scan(data, ctx.boundary, jnp.minimum)[ctx.seg_end]
 
 
-def segment_max(values, validity, seg_ids, capacity, dtype: T.DataType):
+def segment_max(values, validity, ctx: SegCtx, dtype: T.DataType):
     if isinstance(dtype, T.FractionalType):
         nan = jnp.isnan(values)
         sentinel = jnp.asarray(-jnp.inf, values.dtype)
         data = jnp.where(validity & ~nan, values, sentinel)
-        m = jax.ops.segment_max(data, seg_ids, num_segments=capacity)
-        has_nan = jax.ops.segment_max((validity & nan).astype(jnp.int32), seg_ids,
-                                      num_segments=capacity)
+        m = W.segmented_scan(data, ctx.boundary, jnp.maximum)[ctx.seg_end]
+        has_nan = _edge_sum((validity & nan).astype(jnp.int32), ctx)
         # any NaN in group → max is NaN (NaN is largest)
-        m = jnp.where(has_nan > 0, jnp.nan, m)
-        return m
+        return jnp.where(has_nan > 0, jnp.nan, m)
     if values.dtype == jnp.bool_:
-        data = jnp.where(validity, values, False)
-        return jax.ops.segment_max(data.astype(jnp.int8), seg_ids,
-                                   num_segments=capacity).astype(jnp.bool_)
+        data = jnp.where(validity, values, False).astype(jnp.int8)
+        return W.segmented_scan(data, ctx.boundary,
+                                jnp.maximum)[ctx.seg_end].astype(jnp.bool_)
     info = jnp.iinfo(values.dtype)
     data = jnp.where(validity, values, jnp.asarray(info.min, values.dtype))
-    return jax.ops.segment_max(data, seg_ids, num_segments=capacity)
+    return W.segmented_scan(data, ctx.boundary, jnp.maximum)[ctx.seg_end]
 
 
-def segment_first(values, validity, seg_ids, capacity, ignore_nulls: bool):
+def segment_first(values, validity, ctx: SegCtx, ignore_nulls: bool):
     """First (by sorted order) value per group; Spark First(ignoreNulls)."""
-    idx = jnp.arange(capacity, dtype=jnp.int32)
-    big = jnp.int32(capacity)
+    idx = jnp.arange(ctx.capacity, dtype=jnp.int32)
+    big = jnp.int32(ctx.capacity)
     eligible = validity if ignore_nulls else jnp.ones_like(validity)
     cand = jnp.where(eligible, idx, big)
-    pos = jax.ops.segment_min(cand, seg_ids, num_segments=capacity)
-    pos_clamped = jnp.clip(pos, 0, capacity - 1)
+    pos = W.segmented_scan(cand, ctx.boundary, jnp.minimum)[ctx.seg_end]
+    pos_clamped = jnp.clip(pos, 0, ctx.capacity - 1)
     vals = values[pos_clamped]
     valid = (pos < big) & validity[pos_clamped]
     return vals, valid
 
 
-def segment_last(values, validity, seg_ids, capacity, ignore_nulls: bool):
+def segment_last(values, validity, ctx: SegCtx, ignore_nulls: bool):
     """Last (by sorted order) value per group; Spark Last(ignoreNulls)."""
-    idx = jnp.arange(capacity, dtype=jnp.int32)
+    idx = jnp.arange(ctx.capacity, dtype=jnp.int32)
     small = jnp.int32(-1)
     eligible = validity if ignore_nulls else jnp.ones_like(validity)
     cand = jnp.where(eligible, idx, small)
-    pos = jax.ops.segment_max(cand, seg_ids, num_segments=capacity)
-    pos_clamped = jnp.clip(pos, 0, capacity - 1)
+    pos = W.segmented_scan(cand, ctx.boundary, jnp.maximum)[ctx.seg_end]
+    pos_clamped = jnp.clip(pos, 0, ctx.capacity - 1)
     vals = values[pos_clamped]
     valid = (pos > small) & validity[pos_clamped]
     return vals, valid
