@@ -1,80 +1,61 @@
-"""Benchmark: fused scan→filter→project→hash-aggregate stage throughput.
+"""Benchmark: TPC-H q1/q3/q5 end-to-end through the session API.
 
-BASELINE.md config-1 analog (q5-like hash aggregate): one XLA program doing
-filter + project + group-by(sum/count/min/max) over a padded columnar batch —
-the TPU-native counterpart of the reference's GpuFilterExec → GpuProjectExec →
-GpuHashAggregateExec pipeline (SURVEY.md §3.3). Prints ONE JSON line.
+BASELINE.md config-2 (TPC-H SF0.1+ scan+filter+agg+join on one TPU VM),
+replacing round-1's synthetic fused stage. Each query runs end-to-end
+(parquet scan → device pipeline → collect) and is first CHECKED against an
+independent single-core NumPy oracle (benchmarks/tpch.py) — a wrong answer
+reports value 0 rather than a throughput. Prints ONE JSON line:
 
-`vs_baseline` is speedup over a single-core NumPy columnar implementation of the
-same query on the same host (the reference's own published claim is 3x-7x vs CPU
-Spark, docs/FAQ.md:82-88 — no numeric tables exist in-tree, BASELINE.md).
+  value       = geomean over q1/q3/q5 of (lineitem rows / hot-run seconds), Mrows/s
+  vs_baseline = geomean over queries of (numpy oracle time / hot-run time)
+                (the reference's own claim is 3x-7x vs CPU Spark, docs/FAQ.md:82-88)
 
-Resilience (round-1 postmortem: a single axon backend-init failure produced
-rc=1 and a null metric): the measurement runs in a CHILD process with a
-timeout; the parent probes the backend first, retries once on failure, falls
-back to the CPU platform if the accelerator never comes up, and ALWAYS prints
-exactly one JSON line and exits 0.
+Resilience (round-1 postmortem + round-2 tunnel-wedge postmortem): the
+measurement runs in a CHILD process with a timeout; the parent probes the
+backend first with a SHORT timeout (a wedged tunnel hangs even trivial adds —
+see .claude/skills/verify/SKILL.md), retries once, falls back to the CPU
+platform if the accelerator never comes up, and ALWAYS prints exactly one
+JSON line and exits 0.
 """
 
 import json
+import math
 import os
 import subprocess
 import sys
 import time
 
-import numpy as np
-
-
-CAP = 1 << 22          # 4M row padded batch
-N_ROWS = (1 << 22) - 37
-N_KEYS = 4096
-ITERS = 10
-
-CHILD_TIMEOUT_S = 1200
+TPCH_SF = float(os.environ.get("TPCH_SF", "0.1"))
+DATA_DIR = os.environ.get("TPCH_DIR", f"/tmp/tpch_sf{TPCH_SF}")
+CHILD_TIMEOUT_S = 2400
 PROBE_TIMEOUT_S = 240   # first TPU compile/init can take ~40s; be generous
 
 
-def host_baseline(key_vals, key_valid, val_vals, val_valid, n):
-    """Single-core NumPy version of the same query (CPU Spark stand-in)."""
-    k = key_vals[:n]
-    kv = key_valid[:n]
-    v = val_vals[:n]
-    vm = val_valid[:n]
-    keep = vm & (v > 0.0)
-    k, kv, v = k[keep], kv[keep], v[keep]
-    proj = v * 2.0 + k.astype(np.float64) * 0.5
-    pvalid = kv  # val is valid for all kept rows
-    # group by (key, key_valid): null keys form one group
-    gk = np.where(kv, k, np.int64(-(1 << 62)))
-    order = np.argsort(gk, kind="stable")
-    gk, proj, pvalid = gk[order], proj[order], pvalid[order]
-    uniq, start = np.unique(gk, return_index=True)
-    sums = np.add.reduceat(np.where(pvalid, proj, 0.0), start)
-    cnts = np.add.reduceat(pvalid.astype(np.int64), start)
-    mins = np.minimum.reduceat(np.where(pvalid, proj, np.inf), start)
-    maxs = np.maximum.reduceat(np.where(pvalid, proj, -np.inf), start)
-    return uniq, sums, cnts, mins, maxs
+def _check_q1(got, exp):
+    assert len(got) == len(exp), (len(got), len(exp))
+    for g_, e in zip(got, exp):
+        g = list(g_.values())
+        assert g[0] == e[0] and g[1] == e[1], (g, e)
+        for a, b in zip(g[2:], e[2:]):
+            assert abs(a - b) <= 1e-6 * max(1.0, abs(b)), (g, e)
 
 
-def timed_loop_fn(stage, iters):
-    """Run the stage `iters` times on-device inside one dispatch, with a data
-    dependency between iterations so XLA cannot elide or overlap them. One
-    dispatch per measurement is essential: the device link has O(10ms) roundtrip
-    latency, so per-call host timing measures the tunnel, not the kernel."""
-    import jax
+def _check_q3(got, exp):
+    assert len(got) == len(exp), (len(got), len(exp))
+    for g, (k, d, p, rev) in zip(got, exp):
+        assert g["l_orderkey"] == k, (g, k)
+        assert abs(g["revenue"] - rev) <= 1e-6 * max(1.0, abs(rev))
 
-    def body(_, carry):
-        kv, km, vv, vm, nr = carry
-        out = stage(kv, km, vv, vm, nr)
-        # fold a result element back into the input (value ~0, keeps dtypes)
-        delta = (out[1][0] * 1e-30).astype(vv.dtype)
-        return (kv, km, vv + delta, vm, nr)
 
-    def run(kv, km, vv, vm, nr):
-        carry = jax.lax.fori_loop(0, iters, body, (kv, km, vv, vm, nr))
-        return stage(*carry)
+def _check_q5(got, exp):
+    assert len(got) == len(exp), (len(got), len(exp))
+    for g, (n, v) in zip(got, exp):
+        assert g["n_name"] == n, (g, n)
+        assert abs(g["revenue"] - v) <= 1e-6 * max(1.0, abs(v))
 
-    return jax.jit(run)
+
+CHECKS = {"q1": _check_q1, "q3": _check_q3, "q5": _check_q5}
+NP_QUERIES = {"q1": "np_q1", "q3": "np_q3", "q5": "np_q5"}
 
 
 def child_main():
@@ -84,53 +65,40 @@ def child_main():
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         # the axon site hook re-selects TPU regardless of env; override it
         jax.config.update("jax_platforms", "cpu")
-    from __graft_entry__ import _build_stage
+    import spark_rapids_tpu  # noqa: F401  (enables x64)
+    from spark_rapids_tpu.benchmarks import tpch
+    from spark_rapids_tpu.session import TpuSession
 
     platform = jax.devices()[0].platform
+    paths = tpch.generate(TPCH_SF, DATA_DIR)
+    spark = TpuSession()
+    dfs = tpch.load(spark, paths, files_per_partition=4)
+    tb = tpch.load_np(paths)
+    n_lineitem = len(tb["lineitem"]["l_orderkey"])
 
-    rng = np.random.default_rng(42)
-    key_vals = rng.integers(0, N_KEYS, CAP).astype(np.int64)
-    key_valid = rng.random(CAP) > 0.02
-    val_vals = rng.normal(0, 10, CAP)
-    val_valid = rng.random(CAP) > 0.02
-    num_rows = np.int32(N_ROWS)
-
-    stage = _build_stage()
-    dev_args = [jax.device_put(a) for a in
-                (key_vals, key_valid, val_vals, val_valid)]
-
-    def measure(iters):
-        fn = timed_loop_fn(stage, iters)
-        out = fn(*dev_args, num_rows)               # compile + warmup
-        _ = np.asarray(out[-1])                     # full host sync
+    speedups, mrows = [], []
+    for name, q in tpch.QUERIES.items():
+        df = q(dfs)
+        got = df.collect().to_pylist()          # warm (compiles cached after)
+        exp = getattr(tpch, NP_QUERIES[name])(tb)
+        CHECKS[name](got, exp)                  # wrong answer → no number
         best = float("inf")
-        for _ in range(3):
+        for _ in range(2):
             t0 = time.perf_counter()
-            out = fn(*dev_args, num_rows)
-            _ = np.asarray(out[-1])
+            df.collect()
             best = min(best, time.perf_counter() - t0)
-        return best, out
+        t0 = time.perf_counter()
+        getattr(tpch, NP_QUERIES[name])(tb)
+        np_t = time.perf_counter() - t0
+        speedups.append(np_t / best)
+        mrows.append(n_lineitem / best / 1e6)
 
-    t_short, _ = measure(1)
-    t_long, out = measure(1 + ITERS)
-    tpu_s = max((t_long - t_short) / ITERS, 1e-9)
-
-    t0 = time.perf_counter()
-    ref = host_baseline(key_vals, key_valid, val_vals, val_valid, N_ROWS)
-    cpu_s = time.perf_counter() - t0
-
-    # correctness spot-check: group count and total sum match the host baseline
-    n_groups = int(out[-1])
-    assert n_groups == len(ref[0]), (n_groups, len(ref[0]))
-    dev_sum = float(np.asarray(out[1])[:n_groups].sum())
-    assert abs(dev_sum - float(ref[1].sum())) < 1e-6 * max(1.0, abs(dev_sum))
-
-    rows_per_s = N_ROWS / tpu_s
+    geo = lambda xs: math.exp(sum(math.log(x) for x in xs) / len(xs))
     line = {
-        "metric": "fused_hash_aggregate_throughput",
-        "value": round(rows_per_s / 1e6, 3),
+        "metric": f"tpch_sf{TPCH_SF}_q1q3q5_geomean",
+        "value": round(geo(mrows), 3),
         "unit": "Mrows/s",
-        "vs_baseline": round(cpu_s / tpu_s, 3),
+        "vs_baseline": round(geo(speedups), 3),
     }
     if platform != "tpu":
         line["degraded"] = f"platform={platform}"
@@ -167,11 +135,13 @@ def _spawn(extra_env, timeout_s):
 
 
 def _probe_backend():
-    """Is the accelerator backend usable at all? Short subprocess probe."""
+    """Is the accelerator backend usable at all? Short subprocess probe — a
+    wedged tunnel hangs even trivial ops, so never dispatch without this."""
     code = ("import jax; d = jax.devices(); "
             "import jax.numpy as jnp; "
             "x = jnp.ones((8,)) + 1; x.block_until_ready(); "
-            "print('PROBE_OK', d[0].platform)")
+            "import numpy as np; print('PROBE_OK', float(np.asarray(x).sum()),"
+            " d[0].platform)")
     try:
         proc = subprocess.run(
             [sys.executable, "-c", code], env=dict(os.environ),
@@ -185,7 +155,6 @@ def _probe_backend():
 def parent_main():
     """Never exits non-zero; always prints one JSON line."""
     attempts = []
-    # accelerator path: probe, then measure, with one retry
     for attempt in range(2):
         if _probe_backend():
             parsed, err = _spawn({}, CHILD_TIMEOUT_S)
@@ -205,7 +174,7 @@ def parent_main():
         return
     attempts.append(f"cpu fallback: {err}")
     print(json.dumps({
-        "metric": "fused_hash_aggregate_throughput",
+        "metric": f"tpch_sf{TPCH_SF}_q1q3q5_geomean",
         "value": 0.0,
         "unit": "Mrows/s",
         "vs_baseline": 0.0,

@@ -295,7 +295,6 @@ def np_q3(tb):
     lkey, vol = lkey[order], vol[order]
     uk, start = np.unique(lkey, return_index=True)
     rev = np.add.reduceat(vol, start)
-    pos = np.searchsorted(okeys, uk)  # okeys sorted (dense orderkeys)
     osort = np.argsort(okeys, kind="stable")
     pos = osort[np.searchsorted(okeys, uk, sorter=osort)]
     rows = sorted(zip(uk, odate[pos], oprio[pos], rev),

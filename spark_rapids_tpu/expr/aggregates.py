@@ -141,8 +141,7 @@ class Min(AggregateFunction):
     def update(self, in_col, segctx):
         m = G.segment_min(in_col.values, in_col.validity, segctx,
                           self.dtype)
-        _, cnt = G.segment_sum(jnp.zeros_like(segctx.seg_ids, jnp.int64),
-                               in_col.validity, segctx)
+        cnt = G.segment_count(in_col.validity, segctx)
         return [Col(m, cnt > 0, self.dtype, in_col.dictionary)]
 
     def merge(self, state_cols, segctx):
@@ -164,8 +163,7 @@ class Max(AggregateFunction):
     def update(self, in_col, segctx):
         m = G.segment_max(in_col.values, in_col.validity, segctx,
                           self.dtype)
-        _, cnt = G.segment_sum(jnp.zeros_like(segctx.seg_ids, jnp.int64),
-                               in_col.validity, segctx)
+        cnt = G.segment_count(in_col.validity, segctx)
         return [Col(m, cnt > 0, self.dtype, in_col.dictionary)]
 
     def merge(self, state_cols, segctx):

@@ -99,16 +99,27 @@ def _edge_sum(data, ctx: SegCtx):
     return csz[ctx.seg_end + 1] - csz[ctx.seg_start]
 
 
+def _seg_scan(data, ctx: SegCtx, combine):
+    """Segmented inclusive scan reusing the PRECOMPUTED ctx.seg_start (the
+    generic windowing.segmented_scan would re-derive it per call)."""
+    from spark_rapids_tpu.ops.windowing import _doubling_scan
+    return _doubling_scan(data, lambda i, s: (i - s) >= ctx.seg_start, combine)
+
+
+def segment_count(validity, ctx: SegCtx):
+    """Per-row count of valid rows in the row's segment."""
+    return _edge_sum(validity.astype(jnp.int64), ctx)
+
+
 def segment_sum(values, validity, ctx: SegCtx):
     data = jnp.where(validity, values, jnp.zeros_like(values))
     if jnp.issubdtype(data.dtype, jnp.floating):
         # floats: segmented doubling scan — no cancellation against foreign
         # prefixes (edge-diff would subtract large cross-segment partials)
-        s = W.segmented_scan(data, ctx.boundary, jnp.add)[ctx.seg_end]
+        s = _seg_scan(data, ctx, jnp.add)[ctx.seg_end]
     else:
         s = _edge_sum(data, ctx)  # ints: exact even across wrap
-    cnt = _edge_sum(validity.astype(jnp.int64), ctx)
-    return s, cnt
+    return s, segment_count(validity, ctx)
 
 
 def segment_min(values, validity, ctx: SegCtx, dtype: T.DataType):
@@ -116,18 +127,17 @@ def segment_min(values, validity, ctx: SegCtx, dtype: T.DataType):
         sentinel = jnp.asarray(jnp.inf, values.dtype)
         nan = jnp.isnan(values)
         data = jnp.where(validity & ~nan, values, sentinel)
-        m = W.segmented_scan(data, ctx.boundary, jnp.minimum)[ctx.seg_end]
+        m = _seg_scan(data, ctx, jnp.minimum)[ctx.seg_end]
         # all-NaN group: min is NaN (Spark: NaN is largest; min picks non-NaN if any)
         has_non_nan = _edge_sum((validity & ~nan).astype(jnp.int32), ctx)
         has_nan = _edge_sum((validity & nan).astype(jnp.int32), ctx)
         return jnp.where((has_non_nan == 0) & (has_nan > 0), jnp.nan, m)
     if values.dtype == jnp.bool_:
         data = jnp.where(validity, values, True).astype(jnp.int8)
-        return W.segmented_scan(data, ctx.boundary,
-                                jnp.minimum)[ctx.seg_end].astype(jnp.bool_)
+        return _seg_scan(data, ctx, jnp.minimum)[ctx.seg_end].astype(jnp.bool_)
     info = jnp.iinfo(values.dtype)
     data = jnp.where(validity, values, jnp.asarray(info.max, values.dtype))
-    return W.segmented_scan(data, ctx.boundary, jnp.minimum)[ctx.seg_end]
+    return _seg_scan(data, ctx, jnp.minimum)[ctx.seg_end]
 
 
 def segment_max(values, validity, ctx: SegCtx, dtype: T.DataType):
@@ -135,17 +145,16 @@ def segment_max(values, validity, ctx: SegCtx, dtype: T.DataType):
         nan = jnp.isnan(values)
         sentinel = jnp.asarray(-jnp.inf, values.dtype)
         data = jnp.where(validity & ~nan, values, sentinel)
-        m = W.segmented_scan(data, ctx.boundary, jnp.maximum)[ctx.seg_end]
+        m = _seg_scan(data, ctx, jnp.maximum)[ctx.seg_end]
         has_nan = _edge_sum((validity & nan).astype(jnp.int32), ctx)
         # any NaN in group → max is NaN (NaN is largest)
         return jnp.where(has_nan > 0, jnp.nan, m)
     if values.dtype == jnp.bool_:
         data = jnp.where(validity, values, False).astype(jnp.int8)
-        return W.segmented_scan(data, ctx.boundary,
-                                jnp.maximum)[ctx.seg_end].astype(jnp.bool_)
+        return _seg_scan(data, ctx, jnp.maximum)[ctx.seg_end].astype(jnp.bool_)
     info = jnp.iinfo(values.dtype)
     data = jnp.where(validity, values, jnp.asarray(info.min, values.dtype))
-    return W.segmented_scan(data, ctx.boundary, jnp.maximum)[ctx.seg_end]
+    return _seg_scan(data, ctx, jnp.maximum)[ctx.seg_end]
 
 
 def segment_first(values, validity, ctx: SegCtx, ignore_nulls: bool):
@@ -154,7 +163,7 @@ def segment_first(values, validity, ctx: SegCtx, ignore_nulls: bool):
     big = jnp.int32(ctx.capacity)
     eligible = validity if ignore_nulls else jnp.ones_like(validity)
     cand = jnp.where(eligible, idx, big)
-    pos = W.segmented_scan(cand, ctx.boundary, jnp.minimum)[ctx.seg_end]
+    pos = _seg_scan(cand, ctx, jnp.minimum)[ctx.seg_end]
     pos_clamped = jnp.clip(pos, 0, ctx.capacity - 1)
     vals = values[pos_clamped]
     valid = (pos < big) & validity[pos_clamped]
@@ -167,7 +176,7 @@ def segment_last(values, validity, ctx: SegCtx, ignore_nulls: bool):
     small = jnp.int32(-1)
     eligible = validity if ignore_nulls else jnp.ones_like(validity)
     cand = jnp.where(eligible, idx, small)
-    pos = W.segmented_scan(cand, ctx.boundary, jnp.maximum)[ctx.seg_end]
+    pos = _seg_scan(cand, ctx, jnp.maximum)[ctx.seg_end]
     pos_clamped = jnp.clip(pos, 0, ctx.capacity - 1)
     vals = values[pos_clamped]
     valid = (pos > small) & validity[pos_clamped]
