@@ -149,9 +149,11 @@ UNSPILL_ENABLED = conf("spark.rapids.tpu.memory.hbm.unspill.enabled").doc(
     "Re-promote spilled buffers back to HBM on access "
     "(reference spark.rapids.memory.gpu.unspill.enabled)").boolean_conf(False)
 
-POOLED_MEMORY = conf("spark.rapids.tpu.memory.hbm.pooling.enabled").doc(
-    "Use the arena/bucket HBM pool allocator rather than raw device_put per buffer "
-    "(reference RMM pooling, GpuDeviceManager.scala:204)").boolean_conf(True)
+# NOTE: the reference's RMM pooling conf (spark.rapids.memory.gpu.pool,
+# GpuDeviceManager.scala:204) has no TPU analog to toggle: XLA owns the HBM
+# arena (BFC allocator) and the engine's power-of-two capacity bucketing
+# (columnar/vector.py:bucket_capacity) is the pooling strategy — it is not
+# optional, so no conf is registered for it.
 
 STABLE_SORT = conf("spark.rapids.tpu.sql.stableSort.enabled").doc(
     "Force stable device sorts (reference spark.rapids.sql.stableSort.enabled)"
@@ -195,6 +197,12 @@ SHUFFLE_MAX_INFLIGHT_BYTES = conf(
 SHUFFLE_BOUNCE_BUFFER_SIZE = conf("spark.rapids.tpu.shuffle.bounceBuffers.size").doc(
     "Size of each staging (bounce) buffer used to window large transfers "
     "(reference spark.rapids.shuffle.bounceBuffers.size, 4 MB default)").bytes_conf("4m")
+
+SHUFFLE_FETCH_MAX_RETRIES = conf("spark.rapids.tpu.shuffle.fetch.maxRetries").doc(
+    "Fetch failures tolerated per reduce partition before the query fails; "
+    "each failure invalidates the map outputs and recomputes them (reference "
+    "TransferError -> FetchFailedException -> stage retry, "
+    "RapidsShuffleIterator.scala:82)").integer_conf(2)
 
 METRICS_LEVEL = conf("spark.rapids.tpu.sql.metrics.level").doc(
     "ESSENTIAL | MODERATE | DEBUG (reference spark.rapids.sql.metrics.level, "

@@ -103,11 +103,53 @@ class ShuffleExchangeExec(TpuExec):
         if err is not None:
             raise RuntimeError("shuffle map stage failed") from err
 
+    def _invalidate_map_stage(self):
+        """Forget the map outputs so the next read recomputes them (the
+        standalone analog of Spark's FetchFailed → stage retry,
+        RapidsShuffleIterator.scala:82,153). `_reads_left` is NOT reset: it
+        counts reader completions, and each reduce partition still finishes
+        exactly once — the last one out unregisters whatever shuffle id is
+        then current."""
+        with self._map_lock:
+            if self._shuffle_id is not None:
+                ShuffleBlockStore.get().unregister_shuffle(self._shuffle_id)
+                self._shuffle_id = None
+            self._map_error = None
+            self._map_done.clear()
+
+    def _read_with_recompute(self, split):
+        """Stream one reduce partition; a fetch failure detected BEFORE any
+        batch was emitted invalidates the map outputs and recomputes them
+        (bounded by shuffle.fetch.maxRetries). A mid-stream failure after
+        partial emission cannot be retried safely — the consumer already saw
+        rows — and surfaces as TransportError (Spark would re-run the reduce
+        task there; the local scheduler has no task-level rerun).
+        KeyError counts as a fetch failure: a concurrent reader's
+        invalidation can yank the shuffle between ensure and read."""
+        from spark_rapids_tpu.shuffle.transport import TransportError
+        store = ShuffleBlockStore.get()
+        retries = self.conf.get(C.SHUFFLE_FETCH_MAX_RETRIES)
+        for attempt in range(retries + 1):
+            emitted = False
+            try:
+                for b in store.read_partition(self._shuffle_id, split):
+                    emitted = True
+                    yield b
+                return
+            except (TransportError, KeyError) as e:
+                if emitted or attempt == retries:
+                    raise TransportError(
+                        f"reduce {split} fetch failed"
+                        f"{' after partial read' if emitted else ''}: {e}"
+                    ) from e
+                self._invalidate_map_stage()
+                self._ensure_map_stage()
+
     def _reader(self, split):
         store = ShuffleBlockStore.get()
         # post-shuffle coalesce to target batch size (reference
         # GpuShuffleCoalesceExec inserted by GpuTransitionOverrides:57-63)
-        it = store.read_partition(self._shuffle_id, split)
+        it = self._read_with_recompute(split)
         goal = TargetSize(self.conf.batch_size_bytes)
         try:
             yield from coalesce_iterator(it, goal, self.metrics)

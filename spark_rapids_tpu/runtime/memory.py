@@ -114,11 +114,12 @@ class BufferCatalog:
     """
 
     def __init__(self, device_budget: int, host_budget: int, spill_dir: str | None = None,
-                 unspill: bool = False):
+                 unspill: bool = False, oom_dump_dir: str | None = None):
         self.device_budget = device_budget
         self.host_budget = host_budget
         self._spill_dir = spill_dir
         self._unspill = unspill
+        self._oom_dump_dir = oom_dump_dir
         self._lock = threading.RLock()
         self._buffers: dict[int, RapidsBuffer] = {}
         self._ids = itertools.count(1)
@@ -149,6 +150,42 @@ class BufferCatalog:
         while self.device_bytes > self.device_budget and heap:
             _, bid = heapq.heappop(heap)
             self._spill_device_buffer(self._buffers[bid])
+        if self.device_bytes > self.device_budget:
+            # nothing left to spill and still over budget: the OOM analog —
+            # dump allocator state for postmortems (reference
+            # spark.rapids.memory.gpu.oomDumpDir / DeviceMemoryEventHandler)
+            self._dump_oom_state(exclude)
+
+    def _dump_oom_state(self, exclude):
+        if not self._oom_dump_dir:
+            return
+        import datetime
+        import os
+        import time as _time
+        # rate-limit: a workload stuck over budget would otherwise write a
+        # file per allocation, under the catalog lock
+        now = _time.monotonic()
+        if now - getattr(self, "_last_oom_dump", -1e9) < 60.0:
+            return
+        self._last_oom_dump = now
+        try:
+            os.makedirs(self._oom_dump_dir, exist_ok=True)
+            stamp = datetime.datetime.now().strftime("%Y%m%d-%H%M%S-%f")
+            path = os.path.join(self._oom_dump_dir, f"hbm-oom-{stamp}.txt")
+            with open(path, "w") as f:
+                f.write(f"device_bytes={self.device_bytes} "
+                        f"budget={self.device_budget} "
+                        f"host_bytes={self.host_bytes} "
+                        f"host_budget={self.host_budget} "
+                        f"buffers={len(self._buffers)} "
+                        f"over_budget_buffer={exclude}\n")
+                f.write("buffer_id\ttier\tsize\tpriority\n")
+                for b in sorted(self._buffers.values(),
+                                key=lambda x: -x.size):
+                    f.write(f"{b.buffer_id}\t{b.tier}\t{b.size}\t"
+                            f"{b.priority}\n")
+        except OSError:
+            pass  # dumping must never turn an OOM into a crash
 
     def _spill_device_buffer(self, buf: RapidsBuffer):
         hb = batch_to_host(buf._device)
@@ -324,6 +361,7 @@ class DeviceManager:
             host_budget=conf.get(C.HOST_SPILL_STORAGE_SIZE),
             spill_dir=spill_dirs.split(",")[0] if spill_dirs else None,
             unspill=conf.get(C.UNSPILL_ENABLED),
+            oom_dump_dir=conf.get(C.OOM_DUMP_DIR),
         )
 
     @classmethod

@@ -1,0 +1,66 @@
+"""Fetch-failure recovery: transport retries, failover, then recompute.
+
+Reference: RapidsShuffleIterator.scala:82,153 — a TransferError from the UCX
+client surfaces as a FetchFailedException, Spark retries the fetch and
+ultimately recomputes the map stage. Two complementary layers here:
+
+- THIS module is the peer/network ladder for transport-backed reads
+  (cross-process fetches over shuffle/transport.py): retry the same peer with
+  a fresh connection, fail over to replica peers, finally call a recompute
+  callback.
+- exec/exchange.py owns the STAGE ladder for its local reads: a failed read
+  invalidates the map outputs and re-runs the map stage (Spark's
+  FetchFailed → stage retry), bounded by spark.rapids.tpu.shuffle.fetch.maxRetries.
+"""
+
+from __future__ import annotations
+
+import time
+
+from spark_rapids_tpu.shuffle.transport import TransportError
+
+
+class ShuffleFetchIterator:
+    """Iterate one reduce partition's batches with retry → failover →
+    recompute (RapidsShuffleIterator analog)."""
+
+    def __init__(self, client_factories: list, shuffle_id: int, reduce_id: int,
+                 recompute=None, max_retries: int = 2,
+                 retry_backoff_s: float = 0.05):
+        """client_factories: zero-arg callables, each returning a FRESH
+        ShuffleClient for one peer (a dead connection must not be reused).
+        recompute: zero-arg callable yielding the partition's batches by
+        re-running the map-side work; raises if it cannot.
+        max_retries: EXTRA attempts per peer beyond the first."""
+        self.client_factories = client_factories
+        self.shuffle_id = shuffle_id
+        self.reduce_id = reduce_id
+        self.recompute = recompute
+        self.max_retries = max_retries
+        self.retry_backoff_s = retry_backoff_s
+        self.errors: list[str] = []
+
+    def __iter__(self):
+        for factory in self.client_factories:
+            for attempt in range(self.max_retries + 1):
+                batches = []
+                try:
+                    client = factory()
+                    for b in client.fetch_blocks(self.shuffle_id,
+                                                 self.reduce_id):
+                        # buffer before yielding: a mid-stream failure must
+                        # not emit a partial partition twice
+                        batches.append(b)
+                except TransportError as e:
+                    self.errors.append(
+                        f"peer attempt {attempt}: {e}")
+                    if attempt < self.max_retries:  # no sleep before failover
+                        time.sleep(self.retry_backoff_s * (attempt + 1))
+                    continue
+                yield from batches
+                return
+        if self.recompute is None:
+            raise TransportError(
+                "all peers failed for shuffle %d reduce %d: %s"
+                % (self.shuffle_id, self.reduce_id, "; ".join(self.errors)))
+        yield from self.recompute()

@@ -106,3 +106,20 @@ def test_spill_callback_feeds_metrics(tmp_path):
     cat.add_batch(batch, spill_callback=seen.append)
     cat.synchronous_spill(0)
     assert seen and seen[0] == one
+
+
+def test_oom_dump_dir(tmp_path):
+    """When spill cannot reach the budget, allocator state is dumped
+    (spark.rapids.tpu.memory.hbm.oomDumpDir, reference oomDumpDir)."""
+    from spark_rapids_tpu.runtime.memory import (ACTIVE_ON_DECK_PRIORITY,
+                                                 BufferCatalog)
+    cat = BufferCatalog(device_budget=1, host_budget=1 << 30,
+                        oom_dump_dir=str(tmp_path))
+    b, _ = make_batch(64)
+    # a single unspillable-situation: add under a tiny budget; after spilling
+    # everything else (nothing), the new buffer itself keeps us over budget
+    cat.add_batch(b, ACTIVE_ON_DECK_PRIORITY)
+    dumps = list(tmp_path.glob("hbm-oom-*.txt"))
+    assert dumps, "expected an OOM dump file"
+    txt = dumps[0].read_text()
+    assert "device_bytes=" in txt and "buffer_id" in txt

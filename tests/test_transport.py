@@ -247,3 +247,122 @@ def test_unregister_invalidates_server_cache(store):
         assert (sid, 0) not in transport.server._frame_cache
     finally:
         transport.shutdown()
+
+
+# -- fetch failure → retry → failover → recompute ----------------------------
+
+def test_fetch_iterator_retries_then_succeeds(store):
+    """A peer that fails twice then recovers: the iterator retries the SAME
+    peer (fresh client each attempt) and yields the full partition once."""
+    from spark_rapids_tpu.shuffle.fetch import ShuffleFetchIterator
+
+    batch, t = make_batch(50, seed=3)
+    sid = store.register_shuffle()
+    store.write_block(sid, 0, batch)
+    fails = {"n": 2}
+
+    class FlakyClient:
+        def fetch_blocks(self, shuffle_id, reduce_id):
+            if fails["n"] > 0:
+                fails["n"] -= 1
+                yield from store.read_partition(shuffle_id, reduce_id)
+                raise TransportError("connection reset mid-stream")
+            yield from store.read_partition(shuffle_id, reduce_id)
+
+    it = ShuffleFetchIterator([FlakyClient], sid, 0, max_retries=3,
+                              retry_backoff_s=0.0)
+    got = [b.to_arrow() for b in it]
+    assert len(got) == 1 and got[0].num_rows == 50
+    assert len(it.errors) == 2  # partial stream was never emitted twice
+
+
+def test_fetch_iterator_fails_over_to_replica(store):
+    from spark_rapids_tpu.shuffle.fetch import ShuffleFetchIterator
+
+    batch, t = make_batch(30, seed=4)
+    sid = store.register_shuffle()
+    store.write_block(sid, 0, batch)
+
+    class DeadClient:
+        def fetch_blocks(self, shuffle_id, reduce_id):
+            raise TransportError("peer unreachable")
+            yield  # pragma: no cover
+
+    class GoodClient:
+        def fetch_blocks(self, shuffle_id, reduce_id):
+            yield from store.read_partition(shuffle_id, reduce_id)
+
+    it = ShuffleFetchIterator([DeadClient, GoodClient], sid, 0,
+                              max_retries=1, retry_backoff_s=0.0)
+    got = list(it)
+    assert len(got) == 1
+    assert len(it.errors) == 2  # both attempts against the dead peer logged
+
+
+def test_fetch_iterator_recomputes_when_all_peers_dead(store):
+    from spark_rapids_tpu.shuffle.fetch import ShuffleFetchIterator
+
+    batch, t = make_batch(20, seed=5)
+
+    class DeadClient:
+        def fetch_blocks(self, shuffle_id, reduce_id):
+            raise TransportError("peer unreachable")
+            yield  # pragma: no cover
+
+    recomputed = {"n": 0}
+
+    def recompute():
+        recomputed["n"] += 1
+        yield batch
+
+    it = ShuffleFetchIterator([DeadClient], 999, 0, recompute=recompute,
+                              max_retries=2, retry_backoff_s=0.0)
+    got = list(it)
+    assert len(got) == 1 and recomputed["n"] == 1
+
+    # without a recompute callback the error surfaces as TransportError
+    it2 = ShuffleFetchIterator([DeadClient], 999, 0, max_retries=1,
+                               retry_backoff_s=0.0)
+    with pytest.raises(TransportError):
+        list(it2)
+
+
+def test_exchange_recomputes_map_stage_on_fetch_failure():
+    """A TransportError surfaced from a reduce read invalidates the map
+    outputs and recomputes them (TransferError→FetchFailed→stage retry,
+    RapidsShuffleIterator.scala:82)."""
+    from spark_rapids_tpu.exec.basic import ArrowScanExec
+    from spark_rapids_tpu.exec.exchange import ShuffleExchangeExec
+    from spark_rapids_tpu.shuffle.partitioning import HashPartitioner
+    from spark_rapids_tpu.expr.core import col
+
+    _, t = make_batch(80, seed=6)
+    ex = ShuffleExchangeExec(
+        HashPartitioner([col("a")], 3), ArrowScanExec([t]),
+        conf=RapidsConf())
+
+    real_read = ShuffleBlockStore.read_partition
+    state = {"fails": 1, "map_runs": 0}
+    real_map = ShuffleExchangeExec._run_map_stage
+
+    def flaky_read(self, shuffle_id, split):
+        if state["fails"] > 0:
+            state["fails"] -= 1
+            raise TransportError("injected fetch failure")
+        return real_read(self, shuffle_id, split)
+
+    def counting_map(self):
+        state["map_runs"] += 1
+        return real_map(self)
+
+    ShuffleBlockStore.read_partition = flaky_read
+    ShuffleExchangeExec._run_map_stage = counting_map
+    try:
+        out = ex.execute_collect()
+    finally:
+        ShuffleBlockStore.read_partition = real_read
+        ShuffleExchangeExec._run_map_stage = real_map
+    assert out.num_rows == 80
+    assert sorted(out.column("a").to_pylist(), key=lambda v: (v is None, v)) \
+        == sorted(t.column("a").to_pylist(), key=lambda v: (v is None, v))
+    assert state["map_runs"] == 2  # original + one recompute
