@@ -266,7 +266,9 @@ class Round(Expression):
             if d >= 0:
                 return c
             div = 10 ** (-d)
-            mag = jnp.abs(c.values)
+            # widen to int64: the +div//2 step must not overflow the narrow
+            # type mid-computation; the final astype wraps like Java intValue
+            mag = jnp.abs(c.values.astype(jnp.int64))
             qm = (mag + div // 2) // div * div
             return Col(jnp.where(c.values < 0, -qm, qm).astype(c.values.dtype),
                        c.validity, ct).canonicalized()

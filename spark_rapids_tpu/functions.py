@@ -55,7 +55,7 @@ def first(c, ignore_nulls: bool = False):
 
 # null / conditional
 def coalesce(*cs):
-    return _N.Coalesce([_e(c) for c in cs])
+    return _N.Coalesce(*[_e(c) for c in cs])
 
 
 def isnull(c):
@@ -107,7 +107,7 @@ def substring(c, pos, length_):
 
 
 def concat(*cs):
-    return _S.Concat([_e(c) for c in cs])
+    return _S.Concat(*[_e(c) for c in cs])
 
 
 def like(c, pattern: str):

@@ -94,11 +94,12 @@ def double_to_long_bits(v):
     m, e = jnp.frexp(jnp.abs(v))  # abs(v) = m * 2^e, m in [0.5, 1)
     biased = e.astype(jnp.int64) + 1022
     normal = biased >= 1
-    # XLA flushes f64 subnormals to zero on TPU/CPU backends, so subnormal inputs
-    # have already been flushed by any upstream compute; bits = 0 keeps the engine
-    # self-consistent (documented divergence from CPU Spark, like the reference's
-    # GPU float caveats)
     norm_mant = ((m * 2.0 - 1.0) * (2.0 ** 52)).astype(jnp.int64)
+    # Subnormals hash as ±0: XLA's CPU and TPU backends run DAZ/FTZ — even
+    # frexp and multiplication see a subnormal operand as zero, so the true
+    # mantissa is unrecoverable inside a jitted program. DOCUMENTED
+    # divergence from CPU Spark (docs/compatibility.md), matching the
+    # reference's own GPU float caveats.
     mant = jnp.where(normal, norm_mant, 0)
     expf = jnp.where(normal, biased, 0)
     bits = lax.shift_left(expf, jnp.int64(52)) | mant

@@ -395,7 +395,57 @@ def _host_cast(expr, kids, n):
     return HostCol(out, dst)
 
 
+def _int_bounds(dst):
+    bits = {T.ByteType: 8, T.ShortType: 16, T.IntegerType: 32, T.LongType: 64}
+    b = next(n for cls, n in bits.items() if isinstance(dst, cls))
+    return -(1 << (b - 1)), (1 << (b - 1)) - 1
+
+
+def _cast_decimal_one(v, src, dst):
+    """Mirror of the device _cast_decimal (expr/cast.py:97): overflow → null,
+    truncate-toward-zero to ints, HALF_UP on scale reduction."""
+    if isinstance(src, T.DecimalType) and isinstance(dst, T.DecimalType):
+        ds = dst.scale - src.scale
+        if ds >= 0:
+            out = int(v) * (10 ** ds)
+        else:
+            div = 10 ** (-ds)
+            mag = abs(int(v))
+            qm, rm = divmod(mag, div)
+            qm += (2 * rm >= div)
+            out = -qm if v < 0 else qm
+        return out if abs(out) < 10 ** dst.precision else None
+    if isinstance(src, T.IntegralType) and isinstance(dst, T.DecimalType):
+        out = int(v) * (10 ** dst.scale)
+        return out if abs(out) < 10 ** dst.precision else None
+    if isinstance(src, T.DecimalType) and isinstance(dst, T.IntegralType):
+        q = abs(int(v)) // (10 ** src.scale)  # truncate toward zero
+        q = -q if v < 0 else q
+        lo, hi = _int_bounds(dst)
+        return q if lo <= q <= hi else None
+    if isinstance(src, T.DecimalType) and isinstance(dst, (T.FloatType,
+                                                           T.DoubleType)):
+        f = int(v) / (10 ** src.scale)
+        return float(np.float32(f)) if isinstance(dst, T.FloatType) else f
+    if isinstance(src, (T.FloatType, T.DoubleType)) and \
+            isinstance(dst, T.DecimalType):
+        scaled = float(v) * (10 ** dst.scale)
+        if math.isnan(scaled) or math.isinf(scaled):
+            return None
+        mag = math.floor(abs(scaled) + 0.5)
+        out = -mag if scaled < 0 else mag
+        return out if abs(out) < 10 ** dst.precision else None
+    if isinstance(src, T.DecimalType) and isinstance(dst, T.StringType):
+        import decimal as _dec
+        return str(_dec.Decimal(int(v)).scaleb(-src.scale))
+    if isinstance(src, T.DecimalType) and isinstance(dst, T.BooleanType):
+        return int(v) != 0
+    raise NotImplementedError(f"host decimal cast {src} -> {dst}")
+
+
 def _cast_one(v, src, dst, expr):
+    if isinstance(src, T.DecimalType) or isinstance(dst, T.DecimalType):
+        return _cast_decimal_one(v, src, dst)
     if isinstance(dst, T.StringType):
         if isinstance(src, T.BooleanType):
             return "true" if v else "false"
@@ -451,8 +501,7 @@ def _cast_one(v, src, dst, expr):
     if isinstance(dst, T.TimestampType) and isinstance(src, T.DateType):
         return int(v) * 86_400_000_000
     if isinstance(dst, T.DateType) and isinstance(src, T.TimestampType):
-        return int(v) // 86_400_000_000 - (1 if int(v) % 86_400_000_000 < 0
-                                           and int(v) < 0 else 0)
+        return int(v) // 86_400_000_000  # Python // floors, as Spark needs
     return v
 
 
@@ -528,6 +577,7 @@ _DISPATCH = {
     MM.Tan: _unary(lambda e, v: math.tan(v)),
     MM.Floor: _unary(lambda e, v: int(math.floor(v))),
     MM.Ceil: _unary(lambda e, v: int(math.ceil(v))),
+    MM.Round: _unary(lambda e, v: _round_half_up(e, v)),
     MM.Pow: _binary(lambda e, x, y: float(x) ** float(y)),
     MM.Log: _unary(lambda e, v: math.log(v) if v > 0 else None),
     MM.Log2: _unary(lambda e, v: math.log2(v) if v > 0 else None),
@@ -912,6 +962,35 @@ def _register_round2():
         CX.GetArrayItem: _get_array_item_host,
         CX.Size: _size_host,
     })
+
+
+def _round_half_up(expr, v):
+    """Spark/Hive round: HALF_UP away from zero (not banker's). Integral
+    results wrap like the device's astype; scaled infinities stay inf."""
+    d = expr.digits
+    src = expr.children[0].dtype
+    if isinstance(src, T.IntegralType):
+        if d >= 0:
+            return v
+        div = 10 ** (-d)
+        q = (abs(int(v)) + div // 2) // div * div
+        return _wrap_int(src, -q if v < 0 else q)
+    if isinstance(src, T.DecimalType):
+        ds = src.scale - d
+        if ds <= 0:
+            return int(v)
+        div = 10 ** ds
+        q = (abs(int(v)) + div // 2) // div * div
+        return -q if v < 0 else q
+    if math.isnan(v) or math.isinf(v):
+        return v
+    scaled = abs(v) * (10.0 ** d)
+    if math.isinf(scaled):  # overflowed the scale multiply: device keeps inf/x
+        out = (-scaled if v < 0 else scaled) / (10.0 ** d)
+    else:
+        out = (-math.floor(scaled + 0.5) if v < 0
+               else math.floor(scaled + 0.5)) / (10.0 ** d)
+    return float(np.float32(out)) if isinstance(src, T.FloatType) else out
 
 
 def _last_day_host(days):

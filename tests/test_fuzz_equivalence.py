@@ -1,0 +1,165 @@
+"""Randomized device/host equivalence fuzzing over the widened generator —
+the FuzzerUtils + assert_gpu_and_cpu_are_equal analog (reference
+integration_tests data_gen.py + asserts.py:238-382): many seeds, many
+expression shapes, every type column, exact or ulp-tolerant comparison."""
+
+import math
+
+import pyarrow as pa
+import pytest
+
+from conftest import make_table
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import ColumnarBatch
+from spark_rapids_tpu.expr.core import EvalContext, bind_references, col, lit
+from spark_rapids_tpu.plan.host_eval import eval_host
+
+
+def both(expr, table):
+    b = ColumnarBatch.from_arrow(table)
+    e = bind_references(expr, b.schema)
+    dev = (e.eval(EvalContext.from_batch(b)).to_vector()
+           .to_arrow(b.num_rows).to_pylist())
+    schema = T.StructType.from_arrow(table.schema)
+    host = eval_host(bind_references(expr, schema), table).to_arrow().to_pylist()
+    return dev, host
+
+
+def check(expr, table, rel=1e-9):
+    dev, host = both(expr, table)
+    assert len(dev) == len(host)
+    for g, e in zip(dev, host):
+        if e is None or g is None:
+            assert g == e, (expr, g, e)
+        elif isinstance(e, float):
+            if math.isnan(e):
+                assert isinstance(g, float) and math.isnan(g), (expr, g, e)
+            else:
+                assert g == pytest.approx(e, rel=rel, abs=1e-12), (expr, g, e)
+        else:
+            assert g == e, (expr, g, e)
+
+
+# expression shapes exercised per seed: arithmetic/comparison/conditional
+# over every column type the generator emits
+def _shapes():
+    c = col
+    return [
+        # numeric arithmetic incl. nulls and overflow wrap
+        c("i") + c("l"), c("l") * c("i"), c("d") / c("f"),
+        c("i") % F.lit(7), -c("l"),
+        F.abs(c("d")), F.round(c("d"), 1), F.floor(c("f")), F.ceil(c("d")),
+        # comparisons across types
+        c("i") < c("l"), c("d") >= c("f"), c("s") == F.lit("apple"),
+        c("dt") < F.cast(F.lit("2020-06-01"), T.DATE),
+        c("ts") >= F.cast(c("dt"), T.TIMESTAMP),
+        # conditionals + null plumbing
+        F.if_(c("b"), c("i"), F.lit(0)),
+        F.coalesce(c("i"), c("l")),
+        F.if_(c("d") > 0, c("d"), -c("d")),
+        F.isnull(c("f")), F.isnan(c("d")),
+        # strings
+        F.upper(c("s")), F.length(c("s")), F.substring(c("s"), 2, 3),
+        F.concat(c("s"), F.lit("!")), F.like(c("s"), "%a%"),
+        F.lpad(c("s"), 8, "*"),
+        # datetime
+        F.year(c("dt")), F.month(c("dt")), F.dayofmonth(c("dt")),
+        F.year(F.cast(c("ts"), T.DATE)),
+        F.date_format(c("dt"), "yyyy-MM-dd"),
+        F.add_months(c("dt"), 2), F.trunc(c("dt"), "month"),
+        # decimal
+        F.cast(c("dec"), T.DOUBLE), F.cast(c("dec"), T.LONG),
+        F.abs(c("dec")), c("dec") + c("dec"),
+        # casts, both directions
+        F.cast(c("i"), T.STRING), F.cast(c("d"), T.STRING),
+        F.cast(c("dt"), T.STRING), F.cast(c("i"), T.DOUBLE),
+        F.cast(c("l"), T.INT),       # wrapping
+        F.cast(c("d"), T.LONG),      # clamping
+        # hash
+        F.hash(c("i"), c("s"), c("dt")),
+    ]
+
+
+@pytest.mark.parametrize("seed", [1, 7, 23, 91])
+def test_fuzz_expressions(seed):
+    t = make_table(500, seed=seed)
+    for expr in _shapes():
+        check(expr, t)
+
+
+def test_fuzz_extreme_values():
+    """Boundary values the random generator rarely hits: int extremes,
+    denormals, infinities, empty strings, epoch edges."""
+    import numpy as np
+    t = pa.table({
+        "i": pa.array([-2**31, 2**31 - 1, 0, -1, None], pa.int32()),
+        "l": pa.array([-2**63, 2**63 - 1, 0, 1, None], pa.int64()),
+        "d": pa.array([float("inf"), float("-inf"), float("nan"),
+                       1e-300, None]),
+        "f": pa.array([3.4e38, -3.4e38, 0.0, None, 1.5], pa.float32()),
+        "s": pa.array(["", " ", None, "\t", "0"]),
+        "b": pa.array([True, False, None, True, False]),
+        "dt": pa.array([-719162, 0, 2932896, None, 1], pa.int32()
+                       ).cast(pa.date32()),
+        "ts": pa.array([0, -1, None, 253402300799000000, 1], pa.int64()
+                       ).cast(pa.timestamp("us", tz="UTC")),
+        "dec": pa.array([None if v is None else __import__("decimal").Decimal(v)
+                         for v in [None, 0, 1, -1, 10**10]],
+                        type=pa.decimal128(12, 0)),
+    })
+    c = col
+    for expr in [c("i") + c("i"),          # wraps at INT_MIN*2
+                 c("l") * F.lit(2),        # wraps
+                 F.abs(c("i")),
+                 F.cast(c("d"), T.LONG),   # inf clamps, nan -> 0
+                 F.cast(c("f"), T.DOUBLE),
+                 F.length(c("s")),
+                 F.year(c("dt")),
+                 F.cast(c("dec"), T.DOUBLE),
+                 F.hash(c("l"), c("d"))]:
+        check(expr, t)
+
+
+def test_subnormal_hash_documented_divergence():
+    """XLA runs DAZ/FTZ: subnormal doubles hash as 0.0 on device (documented
+    in docs/compatibility.md) — assert the divergence stays exactly that."""
+    t_sub = pa.table({"d": pa.array([5e-324])})
+    t_zero = pa.table({"d": pa.array([0.0])})
+    dev_sub, _ = both(F.hash(col("d")), t_sub)
+    dev_zero, host_zero = both(F.hash(col("d")), t_zero)
+    assert dev_sub == dev_zero           # device: subnormal == 0.0
+    assert dev_zero == host_zero         # and 0.0 itself is Spark-exact
+
+
+def test_decimal_cast_edges_match_device():
+    """Review regressions: overflow→null (not wrap), to-decimal scaling,
+    rescale HALF_UP — host oracle must mirror expr/cast.py exactly."""
+    import decimal as _dec
+    t = pa.table({
+        "big": pa.array([_dec.Decimal("3000000000.00"),
+                         _dec.Decimal("-3000000000.00"),
+                         _dec.Decimal("12.34"), None],
+                        type=pa.decimal128(12, 2)),
+        "i": pa.array([5, -7, 2**31 - 1, None], pa.int32()),
+        "d": pa.array([1.005, -2.5, float("nan"), 1e30]),
+    })
+    c = col
+    for expr in [F.cast(c("big"), T.INT),            # overflow → null
+                 F.cast(c("big"), T.LONG),
+                 F.cast(c("i"), T.DecimalType(10, 2)),
+                 F.cast(c("big"), T.DecimalType(12, 4)),   # upscale
+                 F.cast(c("big"), T.DecimalType(11, 0)),   # HALF_UP downscale
+                 F.cast(c("d"), T.DecimalType(10, 2))]:    # nan → null
+        check(expr, t)
+
+
+def test_round_edges_match_device():
+    t = pa.table({
+        "i": pa.array([2**31 - 1, -2**31, 15, -15, None], pa.int32()),
+        "d": pa.array([1e308, -1e308, 2.5, -2.5, None]),
+    })
+    check(F.round(col("i"), -1), t)   # wraps like device astype
+    check(F.round(col("d"), 1), t)    # inf-on-scale stays inf
+    check(F.round(col("d"), 0), t)
