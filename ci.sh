@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Single entry point for CI and local premerge (reference premerge scripts role).
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== unit + integration suite (virtual 8-device CPU mesh) =="
+python -m pytest tests/ -q
+
+echo "== driver entry points =="
+JAX_PLATFORMS=cpu python -c "
+import jax; jax.config.update('jax_platforms', 'cpu')
+import __graft_entry__ as g
+fn, args = g.entry()
+out = jax.jit(fn)(*args)
+assert out is not None
+print('entry() ok')"
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
+
+echo "== api coverage gate (max 11 missing vs reference GpuOverrides) =="
+python tools/api_validation.py 11
+
+echo "== config docs in sync =="
+python -m spark_rapids_tpu.config
+git diff --exit-code docs/configs.md || {
+  echo "docs/configs.md out of date: run python -m spark_rapids_tpu.config"; exit 1; }
+
+echo "CI OK"

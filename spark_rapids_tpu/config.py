@@ -284,6 +284,18 @@ OOM_DUMP_DIR = conf("spark.rapids.tpu.memory.hbm.oomDumpDir").doc(
     "Directory to write allocator state on device OOM "
     "(reference spark.rapids.memory.gpu.oomDumpDir)").string_conf(None)
 
+SPARK_VERSION = conf("spark.rapids.tpu.spark.version").doc(
+    "Spark behavior generation to emulate; selects the semantic shim "
+    "(reference ShimLoader picks a per-release shim jar the same way)"
+).string_conf("3.5.0")
+
+PARQUET_REBASE_MODE = conf(
+    "spark.rapids.tpu.sql.parquet.datetimeRebaseModeInRead").doc(
+    "EXCEPTION | CORRECTED | LEGACY for dates before 1582-10-15 in parquet "
+    "files (Spark spark.sql.parquet.datetimeRebaseModeInRead; LEGACY applies "
+    "the Julian->proleptic-Gregorian rebase, shims.rebase_julian_to_gregorian_days)"
+).string_conf("EXCEPTION")
+
 
 class RapidsConf:
     """Resolved view over user settings (reference RapidsConf.scala:1162 class)."""

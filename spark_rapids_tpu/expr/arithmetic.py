@@ -335,3 +335,23 @@ class ShiftRightUnsigned(_Shift):
     def op(self, bv, amt):
         unsigned = jnp.uint64 if bv.dtype == jnp.int64 else jnp.uint32
         return (bv.astype(unsigned) >> amt.astype(unsigned)).astype(bv.dtype)
+
+
+class UnaryPositive(Expression):
+    """+x: identity (reference GpuOverrides expr[UnaryPositive])."""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def with_children(self, children):
+        return UnaryPositive(children[0])
+
+    def eval(self, ctx):
+        return self.children[0].eval(ctx)
+
+    def __repr__(self):
+        return f"(+ {self.children[0]!r})"

@@ -189,3 +189,105 @@ class Size(Expression):
 
     def __repr__(self):
         return f"size({self.children[0]!r})"
+
+
+class ElementAt(Expression):
+    """element_at(array, i): ONE-based; negative indexes from the end; null
+    index → null; out of range → null (Spark non-ANSI). Device path requires
+    a fused CreateArray child like GetArrayItem (reference GpuOverrides
+    expr[ElementAt])."""
+
+    def __init__(self, child, index):
+        self.children = [child, index]
+
+    @property
+    def dtype(self):
+        ct = self.children[0].dtype
+        return ct.element_type if isinstance(ct, T.ArrayType) else T.NULL
+
+    def with_children(self, children):
+        return ElementAt(children[0], children[1])
+
+    def eval(self, ctx):
+        src, idx = self.children
+        if not isinstance(src, CreateArray):
+            raise NotImplementedError(
+                "ElementAt on a real array column runs on host")
+        n = len(src.children)
+
+        # 1-based → 0-based (negatives wrap from the end), then reuse the
+        # fused multiplex of GetArrayItem
+        if isinstance(idx, Literal):
+            i = idx.value
+            if i is None or i == 0:
+                zero = Literal(None, T.INT)
+                return GetArrayItem(src, zero).eval(ctx)
+            return GetArrayItem(
+                src, Literal(int(i) - 1 if i > 0 else n + int(i),
+                             T.INT)).eval(ctx)
+        from spark_rapids_tpu.expr.arithmetic import _cast_col
+        ic = _cast_col(idx.eval(ctx), T.INT)
+        shifted = jnp.where(ic.values > 0, ic.values - 1, n + ic.values)
+        # i == 0 is invalid in Spark element_at: make it out-of-range
+        shifted = jnp.where(ic.values == 0, jnp.int32(n), shifted)
+        zero_based = Col(shifted, ic.validity, T.INT)
+
+        class _Wrap(Expression):
+            def __init__(self, col):
+                self.children = []
+                self._col = col
+
+            @property
+            def dtype(self):
+                return T.INT
+
+            def with_children(self, children):
+                return self
+
+            def eval(self, _ctx):
+                return self._col
+
+        return GetArrayItem(src, _Wrap(zero_based)).eval(ctx)
+
+    def __repr__(self):
+        return f"element_at({self.children[0]!r}, {self.children[1]!r})"
+
+
+class ArrayContains(Expression):
+    """array_contains(array, value): true if present; null when absent but
+    the array holds a null; false otherwise (Spark). Device path over fused
+    CreateArray (reference GpuOverrides expr[ArrayContains])."""
+
+    def __init__(self, child, value):
+        self.children = [child, value]
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    def with_children(self, children):
+        return ArrayContains(children[0], children[1])
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.expr.arithmetic import _cast_col
+        src, needle = self.children
+        if not isinstance(src, CreateArray):
+            raise NotImplementedError(
+                "ArrayContains on a real array column runs on host")
+        elem_t = src.dtype.element_type
+        nv = _cast_col(needle.eval(ctx), elem_t)
+        found = jnp.zeros((ctx.capacity,), jnp.bool_)
+        has_null = jnp.zeros((ctx.capacity,), jnp.bool_)
+        for e in src.children:
+            ec = _cast_col(e.eval(ctx), elem_t)
+            if ec.is_string and nv.is_string and \
+                    ec.dictionary is not nv.dictionary:
+                from spark_rapids_tpu.ops.strings import union_dictionaries
+                ec, nv = union_dictionaries(ec, nv)
+            found = found | (ec.validity & (ec.values == nv.values))
+            has_null = has_null | ~ec.validity
+        valid = nv.validity & (found | ~has_null)
+        return Col(found, valid, T.BOOLEAN)
+
+    def __repr__(self):
+        return f"array_contains({self.children[0]!r}, {self.children[1]!r})"

@@ -330,3 +330,36 @@ class Rint(_UnaryMath):
 
     def op(self, v):
         return jnp.round(v)
+
+
+class Cot(_UnaryMath):
+    """cot(x) = cos/sin (reference GpuOverrides expr[Cot])."""
+
+    def op(self, v):
+        return jnp.cos(v) / jnp.sin(v)
+
+
+class Logarithm(Expression):
+    """log(base, x) — null for x <= 0 or base <= 0 (Spark)."""
+
+    def __init__(self, base, child):
+        self.children = [base, child]
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    def with_children(self, children):
+        return Logarithm(children[0], children[1])
+
+    def eval(self, ctx):
+        b = _cast_col(self.children[0].eval(ctx), T.DOUBLE)
+        c = _cast_col(self.children[1].eval(ctx), T.DOUBLE)
+        ok = (c.values > 0) & (b.values > 0)
+        vals = jnp.log(jnp.where(c.values > 0, c.values, 1.0)) / \
+            jnp.log(jnp.where(b.values > 0, b.values, 2.0))
+        return Col(vals, b.validity & c.validity & ok,
+                   T.DOUBLE).canonicalized()
+
+    def __repr__(self):
+        return f"log({self.children[0]!r}, {self.children[1]!r})"

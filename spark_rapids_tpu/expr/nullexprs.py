@@ -142,3 +142,41 @@ class NaNvl(Expression):
 
     def __repr__(self):
         return f"nanvl({self.children[0]!r}, {self.children[1]!r})"
+
+
+class AtLeastNNonNulls(Expression):
+    """True when >= n children are non-null (and non-NaN for floats —
+    Spark's DropNaN semantics; reference GpuOverrides expr[AtLeastNNonNulls],
+    used by DataFrame.dropna)."""
+
+    def __init__(self, n: int, *children):
+        self.n = int(n)
+        self.children = list(children)
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+    def with_children(self, children):
+        return AtLeastNNonNulls(self.n, *children)
+
+    def eval(self, ctx):
+        import jax.numpy as jnp
+        count = jnp.zeros((ctx.capacity,), jnp.int32)
+        for ch in self.children:
+            c = ch.eval(ctx)
+            ok = c.validity
+            if isinstance(c.dtype, T.FractionalType):
+                ok = ok & ~jnp.isnan(c.values)
+            count = count + ok.astype(jnp.int32)
+        from spark_rapids_tpu.expr.core import Col
+        return Col(count >= self.n,
+                   jnp.ones((ctx.capacity,), jnp.bool_), T.BOOLEAN)
+
+    def __repr__(self):
+        return f"atleastnnonnulls({self.n}, " + \
+            ", ".join(map(repr, self.children)) + ")"

@@ -449,3 +449,13 @@ class RegExpExtract(_LiteralArgsStringFn):
             return g if g is not None else ""
         c = self.children[0].eval(ctx)
         return S.dict_transform_to_string(c, extract)
+
+
+class Md5(_UnaryString):
+    """md5(str) → 32-char hex digest (reference GpuOverrides expr[Md5] /
+    HashFunctions). Like all string transforms, runs once per DICTIONARY
+    entry (ops/strings.py design note), not per row."""
+
+    def fn(self, s):
+        import hashlib
+        return hashlib.md5(s.encode("utf-8")).hexdigest()

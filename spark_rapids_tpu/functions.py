@@ -391,3 +391,37 @@ def expm1(c):
 
 def rint(c):
     return _M.Rint(_e(c))
+
+
+def jax_udf(fn, return_type: T.DataType, null_aware: bool = False):
+    """Accelerated user UDF (reference RapidsUDF.evaluateColumnar analog):
+    `F.jax_udf(lambda v: v * 2 + 1, T.DOUBLE)(F.col("x"))` runs fused inside
+    the device program, and composes anywhere an expression can appear
+    (projections, filters, aggregate inputs, join conditions)."""
+    from spark_rapids_tpu.udf.device_udf import jax_udf as _ju
+    return _ju(fn, return_type, null_aware)
+
+
+def md5(c):
+    return _S.Md5(_e(c))
+
+
+def cot(c):
+    return _M.Cot(_e(c))
+
+
+def log(base, c=None):
+    """log(x) natural, or log(base, x) (pyspark convention)."""
+    if c is None:
+        return _M.Log(_e(base))
+    return _M.Logarithm(_v(base), _e(c))
+
+
+def element_at(arr, i):
+    from spark_rapids_tpu.expr.complexexprs import ElementAt
+    return ElementAt(_e(arr), _v(i))
+
+
+def array_contains(arr, value):
+    from spark_rapids_tpu.expr.complexexprs import ArrayContains
+    return ArrayContains(_e(arr), _v(value))

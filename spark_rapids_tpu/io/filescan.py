@@ -154,19 +154,27 @@ class FileScanNode(PlanNode):
 
     def tables_for(self, split: int, batch_rows: int,
                    strategy: str = "PERFILE", num_threads: int = 4,
-                   target_rows: int = 1 << 20):
+                   target_rows: int = 1 << 20, rebase_mode: str | None = None):
+        reader = self.reader
+        if rebase_mode is not None and hasattr(reader, "rebase_mode") and \
+                reader.rebase_mode != rebase_mode.upper():
+            # fresh reader per divergent call: never mutate the shared one
+            # (concurrent host/device scans of this node must not interleave)
+            opts = {k: v for k, v in self.options.items()
+                    if k != "rebase_mode"}
+            reader = R.reader_for(self.fmt, rebase_mode=rebase_mode, **opts)
         part = self.partitions[split]
         filt = self._arrow_filter()
         residual = self.pushed_filter is not None and filt is None
         cols = self._data_columns()
         if strategy == "MULTITHREADED":
-            gen = R.multithreaded_tables(self.reader, list(part.paths), cols,
+            gen = R.multithreaded_tables(reader, list(part.paths), cols,
                                          filt, batch_rows, num_threads)
         elif strategy == "COALESCING":
-            gen = R.coalescing_tables(self.reader, list(part.paths), cols, filt,
+            gen = R.coalescing_tables(reader, list(part.paths), cols, filt,
                                       batch_rows, target_rows)
         else:
-            gen = R.perfile_tables(self.reader, list(part.paths), cols, filt,
+            gen = R.perfile_tables(reader, list(part.paths), cols, filt,
                                    batch_rows)
         for tbl in gen:
             tbl = self._append_partition_values(tbl, part)
@@ -211,8 +219,9 @@ class FileSourceScanExec(TpuExec):
         threads = conf.get(CFG.MULTITHREADED_READ_NUM_THREADS)
 
         def it():
-            for tbl in self.node.tables_for(split, batch_rows, strategy,
-                                            threads):
+            for tbl in self.node.tables_for(
+                    split, batch_rows, strategy, threads,
+                    rebase_mode=conf.get(CFG.PARQUET_REBASE_MODE)):
                 acquire_semaphore(self.metrics)
                 with trace_range("FileScan.h2d", self._scan_time):
                     yield ColumnarBatch.from_arrow(tbl, self.output)

@@ -100,6 +100,47 @@ class ParquetReader(FormatReader):
     the scan and prunes only at row-group granularity."""
 
     format_name = "parquet"
+    _REBASE_MODES = ("EXCEPTION", "CORRECTED", "LEGACY")
+
+    def __init__(self, rebase_mode: str = "EXCEPTION"):
+        self.rebase_mode = rebase_mode.upper()
+        if self.rebase_mode not in self._REBASE_MODES:
+            raise ValueError(
+                f"invalid datetimeRebaseModeInRead {rebase_mode!r}; "
+                f"expected one of {self._REBASE_MODES}")
+
+    def _rebase(self, tbl: pa.Table) -> pa.Table:
+        """Datetime rebase for legacy hybrid-calendar writers (reference
+        GpuParquetScan rebase checks; Spark datetimeRebaseModeInRead)."""
+        if self.rebase_mode == "CORRECTED":
+            return tbl
+        from spark_rapids_tpu.shims import (GREGORIAN_SWITCH_DAY,
+                                            rebase_julian_to_gregorian_days)
+        import numpy as np
+        for i, f in enumerate(tbl.schema):
+            if not pa.types.is_date32(f.type):
+                continue
+            col = tbl.column(i).combine_chunks()
+            days = col.cast(pa.int32()).to_numpy(zero_copy_only=False)
+            valid = ~np.asarray(col.is_null())
+            old = valid & (days < GREGORIAN_SWITCH_DAY)
+            if not old.any():
+                continue
+            if self.rebase_mode == "EXCEPTION":
+                raise ValueError(
+                    f"column '{f.name}' holds dates before 1582-10-15; set "
+                    "spark.rapids.tpu.sql.parquet.datetimeRebaseModeInRead "
+                    "to LEGACY (hybrid-calendar writer) or CORRECTED "
+                    "(proleptic writer)")
+            rebased = rebase_julian_to_gregorian_days(
+                days.astype("int64")).astype("int32")
+            arr = pa.array(rebased, pa.int32()).cast(pa.date32())
+            if not valid.all():
+                import pyarrow.compute as pc
+                arr = pc.if_else(pa.array(valid), arr,
+                                 pa.nulls(len(arr), pa.date32()))
+            tbl = tbl.set_column(i, f.name, arr)
+        return tbl
 
     def read_file(self, path, columns, filt, batch_rows):
         import pyarrow.dataset as ds
@@ -107,7 +148,7 @@ class ParquetReader(FormatReader):
         for batch in dset.to_batches(columns=columns, filter=filt,
                                      batch_size=batch_rows, use_threads=False):
             if batch.num_rows:
-                yield pa.Table.from_batches([batch])
+                yield self._rebase(pa.Table.from_batches([batch]))
 
     def schema_of(self, path):
         return pq.read_schema(path)
@@ -186,7 +227,7 @@ class CsvReader(FormatReader):
 
 def reader_for(fmt: str, **kw) -> FormatReader:
     if fmt == "parquet":
-        return ParquetReader()
+        return ParquetReader(rebase_mode=kw.get("rebase_mode", "EXCEPTION"))
     if fmt == "orc":
         return OrcReader()
     if fmt == "csv":

@@ -889,6 +889,75 @@ def _create_struct_host(expr, kids, n):
                     for i in range(n)], expr.dtype)
 
 
+def _ieee_div(a: float, b: float) -> float:
+    """IEEE-754 division like the device (x/0 → ±inf, 0/0 → nan), where
+    Python float division would raise ZeroDivisionError."""
+    return float(np.float64(a) / np.float64(b))
+
+
+def _at_least_n_host(expr, kids, n):
+    out = []
+    for i in range(n):
+        cnt = 0
+        for k in kids:
+            v = k.data[i]
+            if v is not None and not (isinstance(v, float) and math.isnan(v)):
+                cnt += 1
+        out.append(cnt >= expr.n)
+    return HostCol(out, T.BOOLEAN)
+
+
+def _element_at_host(expr, kids, n):
+    out = []
+    for arr, i in zip(kids[0].data, kids[1].data):
+        if arr is None or i is None or i == 0:
+            out.append(None)
+        else:
+            j = int(i) - 1 if i > 0 else len(arr) + int(i)
+            out.append(arr[j] if 0 <= j < len(arr) else None)
+    return HostCol(out, expr.dtype)
+
+
+def _array_contains_host(expr, kids, n):
+    out = []
+    for arr, v in zip(kids[0].data, kids[1].data):
+        if arr is None or v is None:
+            out.append(None)
+        elif any(x == v for x in arr if x is not None):
+            out.append(True)
+        elif any(x is None for x in arr):
+            out.append(None)
+        else:
+            out.append(False)
+    return HostCol(out, T.BOOLEAN)
+
+
+def _jax_udf_host(expr, kids, n):
+    """Run the user's jax fn on the host platform over unpadded arrays (the
+    oracle mirrors the device contract, minus padding)."""
+    import jax.numpy as jnp
+    arrs = []
+    for k in kids:
+        np_dt = T.to_numpy_dtype(k.dtype)
+        vals = np.array([v if v is not None else k.dtype.default_value()
+                         for v in k.data], dtype=np_dt)
+        valid = np.array([v is not None for v in k.data], dtype=bool)
+        arrs.append((jnp.asarray(vals), jnp.asarray(valid)))
+    if expr.null_aware:
+        vals, valid = expr.fn(*arrs)
+    else:
+        vals = expr.fn(*(v for v, _ in arrs))
+        valid = np.ones(n, dtype=bool)
+        for _, m in arrs:
+            valid = valid & np.asarray(m)
+    vals = np.asarray(vals)
+    valid = np.asarray(valid)
+    rt = expr.return_type
+    py = lambda v: (float(v) if isinstance(rt, (T.FloatType, T.DoubleType))
+                    else bool(v) if isinstance(rt, T.BooleanType) else int(v))
+    return HostCol([py(v) if m else None for v, m in zip(vals, valid)], rt)
+
+
 def _register_round2():
     import spark_rapids_tpu.expr.arithmetic as A2
     import spark_rapids_tpu.expr.conditional as C2
@@ -897,8 +966,10 @@ def _register_round2():
     import spark_rapids_tpu.expr.misc as MX
     import spark_rapids_tpu.expr.decimalexprs as DX
     import spark_rapids_tpu.expr.complexexprs as CX
+    from spark_rapids_tpu.udf.device_udf import JaxUDF
 
     _DISPATCH.update({
+        JaxUDF: _jax_udf_host,
         A2.BitwiseAnd: _binary(
             lambda e, x, y: _wrap_int(e.dtype, int(x) & int(y))),
         A2.BitwiseOr: _binary(
@@ -922,6 +993,16 @@ def _register_round2():
         MM.Expm1: _unary(lambda e, v: math.expm1(v)),
         MM.Rint: _unary(lambda e, v: float(round(v / 2) * 2) if abs(
             v - round(v)) == 0.5 and round(v) % 2 else float(round(v))),
+        MM.Cot: _unary(lambda e, v: _ieee_div(math.cos(v), math.sin(v))),
+        MM.Logarithm: _binary(
+            lambda e, b, x: _ieee_div(math.log(x), math.log(b))
+            if x > 0 and b > 0 else None),
+        A2.UnaryPositive: lambda e, kids, n: kids[0],
+        N.AtLeastNNonNulls: _at_least_n_host,
+        S2.Md5: _unary(lambda e, v: __import__("hashlib").md5(
+            v.encode("utf-8")).hexdigest()),
+        CX.ElementAt: _element_at_host,
+        CX.ArrayContains: _array_contains_host,
         S2.ConcatWs: _concat_ws,
         S2.StringLPad: _string_fn_host,
         S2.StringRPad: _string_fn_host,

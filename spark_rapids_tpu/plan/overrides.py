@@ -184,7 +184,7 @@ def _register_all():
     ex(MM.Round, "half-up rounding", num)
 
     for cls in (S.Upper, S.Lower, S.Trim, S.LTrim, S.RTrim, S.Reverse,
-                S.InitCap, S.Concat, S.StringReplace, S.Substring):
+                S.InitCap, S.Concat, S.StringReplace, S.Substring, S.Md5):
         ex(cls, "string function", TS.STRING, TS.STRING + TS.INTEGRAL)
     ex(S.Length, "string length", TS.TypeSig([T.IntegerType]), TS.STRING)
     for cls in (S.StartsWith, S.EndsWith, S.Contains, S.Like, S.RLike):
@@ -208,6 +208,14 @@ def _register_all():
             meta.will_not_work(
                 "cast string→float disabled: rounding may differ from Spark "
                 "(enable with spark.rapids.tpu.sql.castStringToFloat.enabled)")
+        if (isinstance(c.children[0].dtype, T.StringType)
+                and isinstance(c.dtype, T.DateType)):
+            from spark_rapids_tpu.shims import shim_for
+            if shim_for(meta.conf).lenient_string_to_date:
+                meta.will_not_work(
+                    "Spark 3.0-generation lenient date strings are not "
+                    "implemented by the device parser (shim "
+                    f"{shim_for(meta.conf)!r} pins this cast to host)")
     ex(Cast, "type cast", TS.ALL, None, None, tag_cast)
 
     for cls in (AG.Sum, AG.Count, AG.Min, AG.Max, AG.Average, AG.First,
@@ -225,8 +233,12 @@ def _register_all():
 
     # -- more math (mathExpressions.scala) ------------------------------------
     for cls in (MM.Sinh, MM.Cosh, MM.Tanh, MM.Asinh, MM.Acosh, MM.Atanh,
-                MM.Expm1, MM.Rint):
+                MM.Expm1, MM.Rint, MM.Cot):
         ex(cls, "math function", TS.FRACTIONAL, TS.FRACTIONAL)
+    ex(MM.Logarithm, "log with arbitrary base", TS.FRACTIONAL, TS.FRACTIONAL)
+    ex(A.UnaryPositive, "unary plus", TS.NUMERIC + TS.DECIMAL,
+       TS.NUMERIC + TS.DECIMAL)
+    ex(N.AtLeastNNonNulls, "dropna predicate", TS.BOOLEAN, TS.ALL)
     ex(C.Least, "least of arguments", ordr)
     ex(C.Greatest, "greatest of arguments", ordr)
 
@@ -330,7 +342,8 @@ def _register_all():
     def tag_create(meta):
         p = meta.parent
         pe = getattr(p, "expr", None) if p is not None else None
-        if not isinstance(pe, (CX.GetStructField, CX.GetArrayItem, CX.Size)):
+        if not isinstance(pe, (CX.GetStructField, CX.GetArrayItem, CX.Size,
+                               CX.ElementAt, CX.ArrayContains)):
             meta.will_not_work(
                 "nested values have no flat device form; only fused "
                 "create+extract pairs run on device (struct(..).f, arr[i])")
@@ -342,15 +355,20 @@ def _register_all():
             meta.will_not_work(
                 "extraction from a materialized nested column runs on host")
 
-    ex(CX.CreateNamedStruct, "struct construction (fused)", TS.ALL, TS.ALL,
+    nested_ok = TS.ALL + TS.NESTED
+    ex(CX.CreateNamedStruct, "struct construction (fused)", nested_ok,
+       TS.ALL, None, tag_create)
+    ex(CX.CreateArray, "array construction (fused)", nested_ok, TS.ALL,
        None, tag_create)
-    ex(CX.CreateArray, "array construction (fused)", TS.ALL, TS.ALL,
-       None, tag_create)
-    ex(CX.GetStructField, "struct field extraction", TS.ALL, TS.ALL,
+    ex(CX.GetStructField, "struct field extraction", TS.ALL, nested_ok,
        None, tag_extract)
-    ex(CX.GetArrayItem, "array element extraction", TS.ALL, TS.ALL,
+    ex(CX.GetArrayItem, "array element extraction", TS.ALL, nested_ok,
        None, tag_extract)
-    ex(CX.Size, "collection size", TS.TypeSig([T.IntegerType]), TS.ALL,
+    ex(CX.Size, "collection size", TS.TypeSig([T.IntegerType]), nested_ok,
+       None, tag_extract)
+    ex(CX.ElementAt, "1-based array element extraction", TS.ALL, nested_ok,
+       None, tag_extract)
+    ex(CX.ArrayContains, "array membership (fused)", TS.BOOLEAN, nested_ok,
        None, tag_extract)
 
     from spark_rapids_tpu.udf.python_runtime import PythonUDF
@@ -371,11 +389,20 @@ def _register_all():
         "python UDF via arrow worker exchange (GpuArrowEvalPythonExec analog)",
         None, None, tag_pyudf))
 
+    from spark_rapids_tpu.udf.device_udf import JaxUDF
+    # accelerated user UDF (reference RapidsUDF.evaluateColumnar): fuses into
+    # the surrounding device program; strings excluded (a user fn would see
+    # dictionary codes, not characters)
+    ex(JaxUDF, "user jax UDF fused into the device program",
+       TS.NUMERIC + TS.BOOLEAN + TS.DATETIME + TS.DECIMAL,
+       TS.NUMERIC + TS.BOOLEAN + TS.DATETIME + TS.DECIMAL)
+
     from spark_rapids_tpu.expr import windows as WX
     ex(WX.WindowExpression, "window expression", TS.ALL)
     for cls in (WX.RowNumber, WX.Rank, WX.DenseRank):
         ex(cls, "ranking window function", TS.TypeSig([T.IntegerType]))
     ex(WX.Lead, "lead/lag offset function", TS.ALL)
+    ex(WX.Lag, "lead/lag offset function", TS.ALL)
 
     # -- execs ---------------------------------------------------------------
     from spark_rapids_tpu.exec import basic as XB

@@ -64,6 +64,9 @@ ALL = TypeSig(_ALL_BASIC)
 COMMON = NUMERIC + BOOLEAN + STRING + DATETIME + NULL
 ORDERABLE = COMMON + DECIMAL
 NONE = TypeSig()
+ARRAY = TypeSig([T.ArrayType])
+STRUCT = TypeSig([T.StructDataType])
+NESTED = ARRAY + STRUCT
 
 
 class ExecChecks:

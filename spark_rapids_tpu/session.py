@@ -241,10 +241,15 @@ class TpuSession:
     # -- data sources --------------------------------------------------------
     def read_parquet(self, path, pushed_filter=None,
                      files_per_partition: int = 1) -> DataFrame:
+        from spark_rapids_tpu import config as CFG
         from spark_rapids_tpu.io.filescan import FileScanNode
+        # node-level default so host-fallback scans honor the conf too; the
+        # device exec re-applies its conf value per execution
+        opts = {"rebase_mode": self.conf.get(CFG.PARQUET_REBASE_MODE)}
         return DataFrame(FileScanNode(path, "parquet",
                                       pushed_filter=pushed_filter,
-                                      files_per_partition=files_per_partition),
+                                      files_per_partition=files_per_partition,
+                                      options=opts),
                          self)
 
     def read_orc(self, path, **kw) -> DataFrame:

@@ -1,0 +1,136 @@
+"""Shim layer — version-gated Spark semantics behind one stable interface.
+
+Reference: ShimLoader.scala + the per-version sql-plugin shim source sets
+(SURVEY.md component #2/#43): the reference compiles one shim jar per Spark
+release and picks one at runtime. A standalone engine has no Spark classpath
+to shim against, so the analog is SEMANTIC shims: one `SparkShim` object per
+supported Spark behavior-generation, chosen by `spark.rapids.tpu.spark.version`,
+gating the places where Spark releases genuinely disagree:
+
+- string→date casting: 3.0 parses lenient variants ("2021-1-5"), 3.2+ accepts
+  only the ANSI subset (yyyy[-M[-d]]).
+- element_at(arr, 0): error pre-3.4 semantics vs null under later ANSI-off
+  behavior — the engine always nulls, the 3.0 shim documents the divergence.
+- parquet datetime rebase for files written by legacy (hybrid-calendar)
+  writers: mode per spark.rapids.tpu.sql.parquet.datetimeRebaseModeInRead
+  (EXCEPTION | CORRECTED | LEGACY), with a real Julian→proleptic-Gregorian
+  day rebase (`rebase_julian_to_gregorian_days`) like Spark's
+  RebaseDateTime.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class SparkShim:
+    version_prefix = "3.5"
+    #: accept lenient date strings ("2021-1-5", "2021/01/05") in cast
+    lenient_string_to_date = False
+
+    def __repr__(self):
+        return f"SparkShim({self.version_prefix}.x)"
+
+
+class Spark30Shim(SparkShim):
+    version_prefix = "3.0"
+    lenient_string_to_date = True
+
+
+class Spark32Shim(SparkShim):
+    version_prefix = "3.2"
+
+
+class Spark35Shim(SparkShim):
+    version_prefix = "3.5"
+
+
+_SHIMS = [Spark30Shim, Spark32Shim, Spark35Shim]
+
+
+def load_shim(version: str) -> SparkShim:
+    """Latest shim whose version_prefix <= requested version (ShimLoader's
+    getShimVersion selection)."""
+    def key(p):
+        a, b = p.split(".")
+        return (int(a), int(b))
+    want = key(".".join(version.split(".")[:2]))
+    best = _SHIMS[0]
+    for s in _SHIMS:
+        if key(s.version_prefix) <= want:
+            best = s
+    return best()
+
+
+def shim_for(conf) -> SparkShim:
+    from spark_rapids_tpu import config as C
+    return load_shim(conf.get(C.SPARK_VERSION))
+
+
+# -- legacy (hybrid-calendar) datetime rebase --------------------------------
+# Spark RebaseDateTime: files written by Spark 2.x / Hive used the hybrid
+# Julian+Gregorian calendar; days before the 1582-10-15 switch must be
+# reinterpreted. JDN arithmetic, vectorized on host at scan time (the decode
+# stage is host-side; the rebase never touches the device path).
+
+GREGORIAN_SWITCH_DAY = -141427  # 1582-10-15 as days since 1970-01-01
+
+
+def _julian_jdn_to_ymd(jdn):
+    c = jdn + 32082
+    d = (4 * c + 3) // 1461
+    e = c - (1461 * d) // 4
+    m = (5 * e + 2) // 153
+    day = e - (153 * m + 2) // 5 + 1
+    month = m + 3 - 12 * (m // 10)
+    year = d - 4800 + m // 10
+    return year, month, day
+
+
+def _gregorian_ymd_to_jdn(y, m, d):
+    a = (14 - m) // 12
+    y2 = y + 4800 - a
+    m2 = m + 12 * a - 3
+    return (d + (153 * m2 + 2) // 5 + 365 * y2 + y2 // 4 - y2 // 100
+            + y2 // 400 - 32045)
+
+
+def rebase_julian_to_gregorian_days(days: np.ndarray) -> np.ndarray:
+    """Hybrid-calendar epoch days → proleptic Gregorian epoch days (read
+    rebase). Identity at/after the 1582-10-15 switch."""
+    days = np.asarray(days, dtype=np.int64)
+    old = days < GREGORIAN_SWITCH_DAY
+    if not old.any():
+        return days
+    jdn = days[old] + 2440588  # JDN of 1970-01-01
+    y, m, d = _julian_jdn_to_ymd(jdn)
+    out = days.copy()
+    out[old] = _gregorian_ymd_to_jdn(y, m, d) - 2440588
+    return out
+
+
+def rebase_gregorian_to_julian_days(days: np.ndarray) -> np.ndarray:
+    """Inverse (write rebase, LEGACY writer mode)."""
+    days = np.asarray(days, dtype=np.int64)
+    old = days < GREGORIAN_SWITCH_DAY
+    if not old.any():
+        return days
+    jdn = days[old] + 2440588
+    # invert gregorian jdn → ymd
+    a = jdn + 32044
+    b = (4 * a + 3) // 146097
+    c = a - (146097 * b) // 4
+    d_ = (4 * c + 3) // 1461
+    e = c - (1461 * d_) // 4
+    m = (5 * e + 2) // 153
+    day = e - (153 * m + 2) // 5 + 1
+    month = m + 3 - 12 * (m // 10)
+    year = 100 * b + d_ - 4800 + m // 10
+    # julian ymd → jdn
+    a2 = (14 - month) // 12
+    y2 = year + 4800 - a2
+    m2 = month + 12 * a2 - 3
+    jdn_j = day + (153 * m2 + 2) // 5 + 365 * y2 + y2 // 4 - 32083
+    out = days.copy()
+    out[old] = jdn_j - 2440588
+    return out

@@ -383,3 +383,78 @@ def test_unsupported_format_falls_back():
     txt = explain_plan(df._plan, spark.conf)
     assert "cannot run" in txt or "will run on host" in txt.lower() or \
         "not" in txt.lower()
+
+
+# -- round-2b surface: Md5, Cot, Logarithm, ElementAt, ArrayContains, etc. --
+
+def test_md5(t):
+    import hashlib
+    got = run_device(F.md5(col("w")), t)
+    for g, s in zip(got, t.column("w").to_pylist()):
+        if s is None:
+            assert g is None
+        else:
+            assert g == hashlib.md5(s.encode()).hexdigest()
+    check(F.md5(col("s")), t)
+
+
+def test_cot_logarithm(t):
+    y = pa.table({"y": pa.array([0.5, -0.25, None, 1.0, 2.5])})
+    check(F.cot(col("y")), y, approx=True)
+    check(F.log(2.0, col("y")), y, approx=True)   # neg → null
+    check(F.log(col("y")), y, approx=True)
+
+
+def test_unary_positive(t):
+    from spark_rapids_tpu.expr.arithmetic import UnaryPositive
+    check(UnaryPositive(col("a")), t)
+
+
+def test_at_least_n_non_nulls(t):
+    from spark_rapids_tpu.expr.nullexprs import AtLeastNNonNulls
+    for n in (1, 2, 3):
+        check(AtLeastNNonNulls(n, col("a"), col("x"), col("s")), t)
+
+
+def test_element_at_fused(t):
+    check(F.element_at(F.array(col("a"), col("b")), 1), t)
+    check(F.element_at(F.array(col("a"), col("b")), 2), t)
+    check(F.element_at(F.array(col("a"), col("b")), -1), t)   # from end
+    check(F.element_at(F.array(col("a"), col("b")), 5), t)    # out of range
+    idx_t = pa.table({"a": pa.array([10, 20, 30], type=pa.int32()),
+                      "b": pa.array([1, 2, None], type=pa.int32()),
+                      "i": pa.array([1, -1, 0], type=pa.int32())})
+    check(F.element_at(F.array(col("a"), col("b")), col("i")), idx_t)
+
+
+def test_array_contains_fused(t):
+    check(F.array_contains(F.array(col("a"), col("b")), 7), t)
+    check(F.array_contains(F.array(col("a"), col("b")), col("a")), t)
+    # null-element semantics: absent + null in array → null
+    nt = pa.table({"a": pa.array([1, None, 3], pa.int32()),
+                   "b": pa.array([9, 9, 9], pa.int32())})
+    check(F.array_contains(F.array(col("a"), col("b")), 1), nt)
+
+
+def test_lag_registered_on_device():
+    """WX.Lag was missing from the rule registry (api_validation caught it)."""
+    from spark_rapids_tpu.plan.overrides import REGISTRY
+    from spark_rapids_tpu.expr.windows import Lag
+    assert REGISTRY.lookup_expr(Lag(col("a"), 1)) is not None
+
+
+def test_fused_element_at_through_planner():
+    """Code review r2: the fused paths must be reachable through the PLANNER
+    (tag_create whitelist), not only via direct eval."""
+    from spark_rapids_tpu.plan.overrides import explain_plan
+    spark = TpuSession()
+    tt = pa.table({"a": pa.array([1, 2, 3], pa.int32()),
+                   "b": pa.array([9, None, 7], pa.int32())})
+    df = spark.create_dataframe(tt).select(
+        F.element_at(F.array(F.col("a"), F.col("b")), -1).alias("e"),
+        F.array_contains(F.array(F.col("a"), F.col("b")), 2).alias("c"))
+    txt = explain_plan(df._plan, spark.conf)
+    assert "will run on TPU" in txt.splitlines()[0], txt
+    out = df.collect().to_pylist()
+    assert [r["e"] for r in out] == [9, None, 7]
+    assert [r["c"] for r in out] == [False, True, False]

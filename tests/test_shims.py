@@ -1,0 +1,115 @@
+"""Shim layer + parquet datetime rebase (reference ShimLoader + the
+per-version shim source sets; Spark datetimeRebaseModeInRead semantics)."""
+
+import datetime
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu import config as CFG
+from spark_rapids_tpu.shims import (
+    GREGORIAN_SWITCH_DAY, Spark30Shim, Spark35Shim, load_shim,
+    rebase_gregorian_to_julian_days, rebase_julian_to_gregorian_days,
+)
+
+
+def test_shim_selection():
+    assert isinstance(load_shim("3.0.1"), Spark30Shim)
+    assert load_shim("3.2.4").version_prefix == "3.2"
+    assert load_shim("3.3.0").version_prefix == "3.2"  # newest <= requested
+    assert isinstance(load_shim("3.5.0"), Spark35Shim)
+    assert isinstance(load_shim("4.0.0"), Spark35Shim)
+
+
+def test_rebase_known_values():
+    """julian 1582-10-04 (hybrid day -141428) relabels as proleptic
+    gregorian 1582-10-04 = day -141438 (the 10-day cutover shift); modern
+    dates are untouched."""
+    d = np.array([GREGORIAN_SWITCH_DAY, GREGORIAN_SWITCH_DAY - 1, 0, 18262])
+    r = rebase_julian_to_gregorian_days(d)
+    assert r[0] == GREGORIAN_SWITCH_DAY
+    assert r[1] == GREGORIAN_SWITCH_DAY - 11  # -141428 -> -141438
+    assert r[2] == 0 and r[3] == 18262
+    # proleptic-gregorian label check via python datetime
+    lab = datetime.date(1970, 1, 1) + datetime.timedelta(days=int(r[1]))
+    assert lab == datetime.date(1582, 10, 4)
+
+
+def test_rebase_roundtrip_wide_range():
+    """Bijective except julian-only leap days (Feb 29 of century years the
+    Gregorian calendar skips) — Spark's RebaseDateTime rolls those to the
+    next valid day the same way."""
+    from spark_rapids_tpu.shims import _julian_jdn_to_ymd
+    rng = np.random.default_rng(0)
+    d = rng.integers(-700000, GREGORIAN_SWITCH_DAY, 5000)
+    y, m, day = _julian_jdn_to_ymd(d + 2440588)
+    julian_only_leap = (m == 2) & (day == 29) & (y % 100 == 0) & (y % 400 != 0)
+    d = d[~julian_only_leap]
+    rt = rebase_gregorian_to_julian_days(rebase_julian_to_gregorian_days(d))
+    assert (rt == d).all()
+
+
+@pytest.fixture
+def legacy_parquet(tmp_path):
+    """A parquet file holding pre-cutover dates (as a hybrid writer would)."""
+    days = np.array([GREGORIAN_SWITCH_DAY - 1, 0, -200000], dtype=np.int32)
+    t = pa.table({"d": pa.array(days, pa.int32()).cast(pa.date32()),
+                  "v": pa.array([1, 2, 3], pa.int64())})
+    p = tmp_path / "legacy"
+    p.mkdir()
+    pq.write_table(t, p / "part-0.parquet")
+    return str(p)
+
+
+def _read(path, mode):
+    from spark_rapids_tpu.session import TpuSession
+    spark = TpuSession({CFG.PARQUET_REBASE_MODE.key: mode})
+    return spark.read_parquet(path).collect()
+
+
+def test_rebase_exception_mode(legacy_parquet):
+    with pytest.raises(Exception, match="1582-10-15"):
+        _read(legacy_parquet, "EXCEPTION")
+
+
+def test_rebase_corrected_mode(legacy_parquet):
+    out = _read(legacy_parquet, "CORRECTED")
+    days = [(v - datetime.date(1970, 1, 1)).days
+            for v in out.column("d").to_pylist()]
+    assert sorted(days) == sorted([GREGORIAN_SWITCH_DAY - 1, 0, -200000])
+
+
+def test_rebase_legacy_mode(legacy_parquet):
+    out = _read(legacy_parquet, "LEGACY")
+    days = sorted((v - datetime.date(1970, 1, 1)).days
+                  for v in out.column("d").to_pylist())
+    exp = sorted(rebase_julian_to_gregorian_days(
+        np.array([GREGORIAN_SWITCH_DAY - 1, 0, -200000])).tolist())
+    assert days == exp
+
+
+def test_shim_pins_lenient_date_cast_to_host():
+    """The 3.0-generation shim pins string→date casts to host (the device
+    parser implements only the 3.2+ subset) — the ShimLoader mechanism
+    changing planner behavior."""
+    import spark_rapids_tpu.functions as F
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.plan.overrides import explain_plan
+    from spark_rapids_tpu.session import TpuSession
+    t = pa.table({"s": pa.array(["2021-01-05", "2021-1-5"])})
+
+    old = TpuSession({CFG.SPARK_VERSION.key: "3.0.1"})
+    df_old = old.create_dataframe(t).select(
+        F.cast(F.col("s"), T.DATE).alias("d"))
+    assert "3.0-generation" in explain_plan(df_old._plan, old.conf)
+
+    new = TpuSession({CFG.SPARK_VERSION.key: "3.5.0"})
+    df_new = new.create_dataframe(t).select(
+        F.cast(F.col("s"), T.DATE).alias("d"))
+    assert "3.0-generation" not in explain_plan(df_new._plan, new.conf)
+    # and both still answer
+    assert df_old.collect().num_rows == 2
+    assert df_new.collect().num_rows == 2
