@@ -289,6 +289,13 @@ SPARK_VERSION = conf("spark.rapids.tpu.spark.version").doc(
     "(reference ShimLoader picks a per-release shim jar the same way)"
 ).string_conf("3.5.0")
 
+PARQUET_DEVICE_DECODE = conf(
+    "spark.rapids.tpu.sql.parquet.deviceDecode.enabled").doc(
+    "Decode dictionary-encoded uncompressed parquet chunks on device "
+    "(bit-unpack + gather in one jitted program, ops/parquet_decode.py); "
+    "out-of-scope chunks fall back to arrow per column (reference "
+    "GpuParquetScan device decode, stage one)").boolean_conf(True)
+
 PARQUET_REBASE_MODE = conf(
     "spark.rapids.tpu.sql.parquet.datetimeRebaseModeInRead").doc(
     "EXCEPTION | CORRECTED | LEGACY for dates before 1582-10-15 in parquet "

@@ -212,11 +212,55 @@ class FileSourceScanExec(TpuExec):
     def num_partitions(self):
         return self.node.num_partitions
 
+    def _device_decode_batches(self, split, batch_rows: int):
+        """Row-group-at-a-time device decode (no arrow materialization).
+        Returns None when the partition is out of the device path's scope
+        (pushed filters, partition-dir values, temporal columns needing the
+        rebase, or row groups larger than the reader batch cap)."""
+        import pyarrow.parquet as pq
+        from spark_rapids_tpu.io import parquet_native as PN
+        node = self.node
+        if node.fmt != "parquet" or node.pushed_filter is not None:
+            return None
+        part = node.partitions[split]
+        if part.partition_values:
+            return None
+        # temporal columns stay on the arrow path: it owns the legacy
+        # datetime rebase handling (readers.py _rebase); nested columns
+        # need the arrow list/struct conversion
+        if any(isinstance(f.data_type, (T.DateType, T.TimestampType,
+                                        T.ArrayType, T.StructDataType))
+               for f in self.output):
+            return None
+        files = []
+        for path in part.paths:
+            pf = pq.ParquetFile(path)
+            md = pf.metadata
+            if any(md.row_group(g).num_rows > batch_rows
+                   for g in range(md.num_row_groups)):
+                return None  # honor reader.batchSizeRows: arrow path chunks
+            files.append((path, pf, md.num_row_groups))
+
+        def it():
+            cols = node._data_columns()
+            for path, pf, n_groups in files:
+                for rg in range(n_groups):
+                    acquire_semaphore(self.metrics)
+                    with trace_range("FileScan.devdecode", self._scan_time):
+                        yield PN.read_row_group_device(
+                            path, rg, self.output, cols, pf=pf)
+        return it()
+
     def execute_partition(self, split):
         conf = self.conf
         strategy = conf.get(CFG.PARQUET_READER_TYPE).upper()
         batch_rows = min(conf.get(CFG.MAX_READER_BATCH_SIZE_ROWS), 1 << 20)
         threads = conf.get(CFG.MULTITHREADED_READ_NUM_THREADS)
+
+        if conf.get(CFG.PARQUET_DEVICE_DECODE):
+            dev_it = self._device_decode_batches(split, batch_rows)
+            if dev_it is not None:
+                return self.wrap_output(dev_it)
 
         def it():
             for tbl in self.node.tables_for(
