@@ -280,6 +280,40 @@ OPTIMIZER_MIN_ROWS = conf("spark.rapids.tpu.sql.optimizer.minRows").doc(
     "optimizer is enabled (transfer+launch overhead dominates tiny inputs)"
 ).integer_conf(4096)
 
+OPTIMIZER_HOST_ROW_COST = conf("spark.rapids.tpu.sql.optimizer.host.rowCost").doc(
+    "Dual cost model: seconds per row·weight for host execution "
+    "(reference spark.rapids.sql.optimizer.cpu.exec.*, CostBasedOptimizer.scala)"
+).double_conf(60e-9)
+
+OPTIMIZER_TPU_ROW_COST = conf("spark.rapids.tpu.sql.optimizer.tpu.rowCost").doc(
+    "Dual cost model: seconds per row·weight for device execution "
+    "(reference spark.rapids.sql.optimizer.gpu.exec.*)").double_conf(1.5e-9)
+
+OPTIMIZER_TPU_DISPATCH_COST = conf(
+    "spark.rapids.tpu.sql.optimizer.tpu.dispatchCost").doc(
+    "Dual cost model: fixed seconds per device operator dispatch (jit call "
+    "over the runtime tunnel)").double_conf(2e-3)
+
+OPTIMIZER_TRANSFER_ROW_COST = conf(
+    "spark.rapids.tpu.sql.optimizer.transferRowCost").doc(
+    "Dual cost model: seconds per row crossing a host↔device boundary "
+    "(the reference's transitionCost per-byte analog)").double_conf(8e-9)
+
+PALLAS_ENABLED = conf("spark.rapids.tpu.sql.pallas.enabled").doc(
+    "Route the string murmur3 hash and parquet bit-unpack through the "
+    "hand-written Pallas TPU kernels (ops/pallas_kernels.py); when false "
+    "(or off-TPU) the fused-XLA jnp formulations run instead").boolean_conf(True)
+
+BROADCAST_TIMEOUT = conf("spark.rapids.tpu.sql.broadcast.timeout").doc(
+    "Seconds a consumer waits for the broadcast relation to materialize; "
+    "<=0 waits forever (Spark spark.sql.broadcastTimeout; reference "
+    "GpuBroadcastExchangeExec relation future)").double_conf(300.0)
+
+BROADCAST_MAX_TABLE_BYTES = conf("spark.rapids.tpu.sql.broadcast.maxTableBytes"
+                                 ).doc(
+    "Fail a broadcast whose materialized relation exceeds this size "
+    "(reference maxBroadcastTableSize guard); 0 disables").bytes_conf("8g")
+
 OOM_DUMP_DIR = conf("spark.rapids.tpu.memory.hbm.oomDumpDir").doc(
     "Directory to write allocator state on device OOM "
     "(reference spark.rapids.memory.gpu.oomDumpDir)").string_conf(None)

@@ -1,0 +1,124 @@
+"""BroadcastExchangeExec — standalone broadcast exchange operator.
+
+Reference: GpuBroadcastExchangeExecBase (org/.../execution/
+GpuBroadcastExchangeExec.scala:237) materializes the build side ONCE on its
+own broadcast thread pool with a timeout, serializes the contiguous table,
+and every consumer (broadcast hash join, nested-loop join, AQE reuse) reads
+the same relation; GpuBroadcastToCpuExec bridges the relation back to the
+host. Here the relation is a SpillableColumnarBatch (HBM-resident,
+spillable under pressure) built by a daemon worker; `broadcast()` blocks
+consumers on the shared future with `spark.sql.broadcastTimeout` semantics,
+and `execute_partition` is the host-bridge path (one single-partition
+stream of the relation).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+
+from spark_rapids_tpu import config as CFG
+from spark_rapids_tpu.exec.base import TaskContext, TpuExec
+from spark_rapids_tpu.exec.coalesce import concat_all
+from spark_rapids_tpu.runtime import memory as mem
+from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.runtime.tracing import trace_range
+
+class BroadcastTimeout(RuntimeError):
+    pass
+
+
+def _spawn_build(fn) -> concurrent.futures.Future:
+    """One dedicated daemon thread per broadcast build (like Spark's
+    relation-future threads). A bounded shared pool would deadlock when a
+    build side itself contains broadcast joins: outer builds could occupy
+    every worker while blocking on inner builds stuck in the queue."""
+    fut: concurrent.futures.Future = concurrent.futures.Future()
+
+    def run():
+        if not fut.set_running_or_notify_cancel():
+            return
+        try:
+            fut.set_result(fn())
+        except BaseException as e:  # noqa: BLE001 — future carries it
+            fut.set_exception(e)
+
+    threading.Thread(target=run, name="tpu-broadcast", daemon=True).start()
+    return fut
+
+
+class BroadcastExchangeExec(TpuExec):
+    """Materialize the child once as a shared, spillable device relation."""
+
+    def __init__(self, child: TpuExec, conf=None):
+        super().__init__(child, conf=conf)
+        self._build_time = self.metrics.metric(M.BUILD_TIME, M.ESSENTIAL)
+        self._lock = threading.Lock()
+        self._future: concurrent.futures.Future | None = None
+        t = float(self.conf.get(CFG.BROADCAST_TIMEOUT))
+        self._timeout = t if t > 0 else None  # <=0 waits forever
+        self._max_bytes = self.conf.get(CFG.BROADCAST_MAX_TABLE_BYTES)
+
+    @property
+    def output(self):
+        return self.child.output
+
+    @property
+    def num_partitions(self) -> int:
+        return 1
+
+    def _materialize(self) -> mem.SpillableColumnarBatch:
+        with trace_range("BroadcastExchange.build", self._build_time):
+            batches = []
+            for split in range(self.child.num_partitions):
+                with TaskContext():
+                    batches.extend(self.child.execute_partition(split))
+            batch = concat_all(iter(batches), self.child.output)
+            size = batch.device_memory_size()
+            if self._max_bytes and size > self._max_bytes:
+                raise RuntimeError(
+                    f"broadcast table {size} bytes exceeds "
+                    f"{CFG.BROADCAST_MAX_TABLE_BYTES.key}={self._max_bytes} "
+                    "(reference maxBroadcastTableSize guard)")
+            return mem.SpillableColumnarBatch(batch,
+                                              mem.ACTIVE_BATCHING_PRIORITY)
+
+    def broadcast(self) -> mem.SpillableColumnarBatch:
+        """The shared relation; first caller schedules the build, everyone
+        blocks on the same future (reference executeBroadcast + relation
+        future with broadcastTimeout)."""
+        with self._lock:
+            if self._future is None:
+                self._future = _spawn_build(self._materialize)
+            fut = self._future
+        try:
+            return fut.result(timeout=self._timeout)
+        except concurrent.futures.TimeoutError:
+            raise BroadcastTimeout(
+                f"broadcast of {self.child.args_string()!s} did not finish "
+                f"within {self._timeout}s") from None
+
+    def release(self) -> None:
+        """Close the relation (called by the last consumer). If the build is
+        still running (consumers timed out), a done-callback closes the
+        relation when it lands instead of orphaning it in HBM."""
+        with self._lock:
+            fut, self._future = self._future, None
+        if fut is None:
+            return
+
+        def close_result(f: concurrent.futures.Future):
+            if f.exception() is None:
+                f.result().close()
+
+        fut.add_done_callback(close_result)
+
+    def execute_partition(self, split: int):
+        # host-bridge / reuse path (GpuBroadcastToCpuExec analog): stream the
+        # relation as a normal single-partition exec without taking ownership
+        def it():
+            yield self.broadcast().get_batch()
+        return self.wrap_output(it())
+
+    def args_string(self):
+        return f"timeout={self._timeout}s"

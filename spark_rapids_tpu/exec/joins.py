@@ -232,32 +232,22 @@ class HashJoinExec(TpuExec):
 
 
 class _SharedBroadcast:
-    """Broadcast build table shared across all stream partitions: materialized
-    once, closed by the LAST partition to finish, with a globally-merged
-    matched-row accumulator so full-outer unmatched-build rows are emitted
-    exactly once (reference GpuBroadcastExchangeExec + the shared gatherer state
-    in GpuBroadcastNestedLoopJoinExec)."""
+    """Per-join consumer state over a BroadcastExchangeExec relation: a
+    reader countdown (the LAST stream partition releases the relation) and a
+    globally-merged matched-row accumulator so full-outer unmatched-build
+    rows are emitted exactly once (reference GpuBroadcastExchangeExec + the
+    shared gatherer state in GpuBroadcastNestedLoopJoinExec)."""
 
-    def __init__(self, child, n_readers: int):
-        self._child = child
+    def __init__(self, exchange, n_readers: int):
+        from spark_rapids_tpu.exec.broadcast import BroadcastExchangeExec
+        assert isinstance(exchange, BroadcastExchangeExec), exchange
+        self.exchange = exchange
         self._lock = threading.Lock()
-        self._sb: mem.SpillableColumnarBatch | None = None
         self._readers_left = n_readers
         self.matched_acc: np.ndarray | None = None
 
     def get(self) -> mem.SpillableColumnarBatch:
-        with self._lock:
-            if self._sb is None:
-                batches = []
-                for split in range(self._child.num_partitions):
-                    with TaskContext():
-                        batches.extend(self._child.execute_partition(split))
-                def gen():
-                    yield from batches
-                self._sb = mem.SpillableColumnarBatch(
-                    concat_all(gen(), self._child.output),
-                    mem.ACTIVE_BATCHING_PRIORITY)
-            return self._sb
+        return self.exchange.broadcast()
 
     def merge_matched(self, local: np.ndarray) -> None:
         with self._lock:
@@ -272,10 +262,7 @@ class _SharedBroadcast:
             return self._readers_left == 0
 
     def close(self) -> None:
-        with self._lock:
-            if self._sb is not None:
-                self._sb.close()
-                self._sb = None
+        self.exchange.release()
 
 
 class BroadcastHashJoinExec(HashJoinExec):
@@ -284,8 +271,11 @@ class BroadcastHashJoinExec(HashJoinExec):
 
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
-        build_child = self.children[1] if self.stream_is_left else self.children[0]
-        self._shared = _SharedBroadcast(build_child, self.num_partitions)
+        from spark_rapids_tpu.exec.broadcast import BroadcastExchangeExec
+        bi = 1 if self.stream_is_left else 0
+        exchange = BroadcastExchangeExec(self.children[bi], conf=self.conf)
+        self.children[bi] = exchange  # plan-visible broadcast exchange node
+        self._shared = _SharedBroadcast(exchange, self.num_partitions)
 
     def execute_partition(self, split):
         def it():
@@ -330,7 +320,10 @@ class NestedLoopJoinExec(TpuExec):
         self.condition = (bind_references(condition, self._pair_schema())
                           if condition is not None else None)
         self._join_time = self.metrics.metric(M.JOIN_TIME, M.MODERATE)
-        self._shared = _SharedBroadcast(self.children[1], self.num_partitions)
+        from spark_rapids_tpu.exec.broadcast import BroadcastExchangeExec
+        exchange = BroadcastExchangeExec(self.children[1], conf=self.conf)
+        self.children[1] = exchange  # plan-visible broadcast exchange node
+        self._shared = _SharedBroadcast(exchange, self.num_partitions)
 
     def _pair_schema(self):
         return T.StructType(list(self.children[0].output) +

@@ -212,11 +212,12 @@ class FileSourceScanExec(TpuExec):
     def num_partitions(self):
         return self.node.num_partitions
 
-    def _device_decode_batches(self, split, batch_rows: int):
+    def _device_decode_batches(self, split, batch_rows: int,
+                               batch_bytes: int):
         """Row-group-at-a-time device decode (no arrow materialization).
         Returns None when the partition is out of the device path's scope
         (pushed filters, partition-dir values, temporal columns needing the
-        rebase, or row groups larger than the reader batch cap)."""
+        rebase, or row groups larger than the reader batch caps)."""
         import pyarrow.parquet as pq
         from spark_rapids_tpu.io import parquet_native as PN
         node = self.node
@@ -236,9 +237,12 @@ class FileSourceScanExec(TpuExec):
         for path in part.paths:
             pf = pq.ParquetFile(path)
             md = pf.metadata
+            # honor BOTH reader caps: the arrow path re-chunks oversized
+            # groups, this path emits one batch per row group
             if any(md.row_group(g).num_rows > batch_rows
+                   or md.row_group(g).total_byte_size > batch_bytes
                    for g in range(md.num_row_groups)):
-                return None  # honor reader.batchSizeRows: arrow path chunks
+                return None
             files.append((path, pf, md.num_row_groups))
 
         def it():
@@ -258,7 +262,8 @@ class FileSourceScanExec(TpuExec):
         threads = conf.get(CFG.MULTITHREADED_READ_NUM_THREADS)
 
         if conf.get(CFG.PARQUET_DEVICE_DECODE):
-            dev_it = self._device_decode_batches(split, batch_rows)
+            dev_it = self._device_decode_batches(
+                split, batch_rows, conf.get(CFG.MAX_READER_BATCH_SIZE_BYTES))
             if dev_it is not None:
                 return self.wrap_output(dev_it)
 

@@ -124,7 +124,13 @@ def hash_string_words(words, lengths, seed_i32):
     words: (n, W) int32 — UTF-8 bytes packed little-endian, zero-padded.
     lengths: (n,) int32 byte lengths. Whole words first, then each tail byte is its own
     mix round using the SIGNED byte value, exactly like Spark's hashUnsafeBytes.
+
+    On TPU this dispatches to the Pallas kernel (ops/pallas_kernels.py);
+    the jnp formulation below is the off-TPU path and the test oracle.
     """
+    from spark_rapids_tpu.ops import pallas_kernels as PK
+    if PK.should_use():
+        return PK.murmur3_words(words, lengths, seed_i32)
     n, W = words.shape
     n_words = lengths // 4
     n_tail = lengths % 4

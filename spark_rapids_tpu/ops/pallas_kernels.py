@@ -1,0 +1,227 @@
+"""Hand-written Pallas TPU kernels for the irregular hot ops.
+
+SURVEY.md §7 design stance: XLA fuses the dense columnar math; Pallas covers
+the parts XLA lowers poorly on TPU — byte-level bit twiddling with per-row
+data-dependent control (string murmur3) and bit-packed decode (parquet
+RLE_DICTIONARY indices). Reference analogs: cudf's murmur3 device hash
+(GpuHashPartitioning.scala:92 depends on it) and libcudf's parquet index
+decoder (GpuParquetScan.scala:1235 `Table.readParquet`).
+
+Both kernels are lane-static reformulations — no dynamic gathers, which
+Mosaic lowers badly:
+
+* ``murmur3_words``: rows tile over the grid; the word loop and the
+  per-row tail-byte selection unroll over static columns with vector
+  selects, so each (TILE, W) block is pure VPU work.
+* ``bitunpack128``: 128 consecutive bit-packed values of width ``bw``
+  occupy exactly ``4*bw`` 32-bit words, so value lane j always reads word
+  ``(j*bw)>>5`` — a static column index. The unpack becomes a per-lane
+  shift/mask over statically-selected columns: zero gathers.
+
+Dispatch: compiled on TPU; ``interpret=True`` elsewhere (tests force the
+CPU platform). The jnp reference implementations in ops/hashing.py and
+ops/parquet_decode.py remain the oracle and the fallback.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+_C1 = np.int32(np.uint32(0xCC9E2D51))
+_C2 = np.int32(np.uint32(0x1B873593))
+_M5 = np.int32(np.uint32(0xE6546B64))
+_FX1 = np.int32(np.uint32(0x85EBCA6B))
+_FX2 = np.int32(np.uint32(0xC2B2AE35))
+
+
+# dispatch switch: None = auto (compiled kernels on TPU, jnp reference
+# elsewhere); True forces the kernels (interpret-mode off-TPU — tests);
+# False forces the jnp paths (spark.rapids.tpu.sql.pallas.enabled=false)
+_FORCE: bool | None = None
+_TPU_PROBE: bool | None = None  # latched result of the one-time compile probe
+
+
+def set_mode(force: bool | None) -> None:
+    global _FORCE
+    _FORCE = force
+
+
+def _probe_tpu() -> bool:
+    """Compile tiny instances of both kernels once on the TPU backend. A
+    Mosaic lowering failure inside an enclosing jit would surface as an
+    opaque engine error at compile time; probing here instead latches the
+    dispatch off so the jnp formulations keep the engine correct."""
+    global _TPU_PROBE
+    if _TPU_PROBE is None:
+        try:
+            w = jnp.zeros((8, 2), jnp.int32)
+            l = jnp.full((8,), 5, jnp.int32)
+            jax.block_until_ready(murmur3_words(w, l, 42))
+            jax.block_until_ready(
+                bitunpack128(jnp.zeros((32,), jnp.int32), 8, 100, 128))
+            _TPU_PROBE = True
+        except Exception:  # noqa: BLE001 — any lowering failure latches off
+            _TPU_PROBE = False
+    return _TPU_PROBE
+
+
+def should_use() -> bool:
+    """Do the engine's string-hash / parquet-unpack paths route here?"""
+    if _FORCE is not None:
+        return _FORCE
+    return jax.default_backend() == "tpu" and _probe_tpu()
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _rotl(x, n):
+    return lax.shift_left(x, jnp.int32(n)) | lax.shift_right_logical(
+        x, jnp.int32(32 - n))
+
+
+def _mix_k1(k1):
+    return _rotl(k1 * _C1, 15) * _C2
+
+
+def _mix_h1(h1, k1):
+    return _rotl(h1 ^ k1, 13) * jnp.int32(5) + _M5
+
+
+def _fmix(h1, length):
+    h1 = h1 ^ length
+    h1 = h1 ^ lax.shift_right_logical(h1, jnp.int32(16))
+    h1 = h1 * _FX1
+    h1 = h1 ^ lax.shift_right_logical(h1, jnp.int32(13))
+    h1 = h1 * _FX2
+    return h1 ^ lax.shift_right_logical(h1, jnp.int32(16))
+
+
+# ---------------------------------------------------------------------------
+# murmur3 string hash
+# ---------------------------------------------------------------------------
+
+_HASH_TILE = 256
+
+
+def _murmur3_kernel(words_ref, len_ref, seed_ref, out_ref, *, W: int):
+    words = words_ref[:]                      # (T, W) int32
+    lens = len_ref[:]                         # (T, 1) int32
+    h1 = seed_ref[:]                          # (T, 1) int32 running hash
+    n_words = lens // 4
+    n_tail = lens % 4
+    # whole-word rounds, statically unrolled; rows shorter than column i
+    # keep their running hash through a vector select
+    for i in range(W):
+        k = words[:, i:i + 1]
+        h1 = jnp.where(i < n_words, _mix_h1(h1, _mix_k1(k)), h1)
+    # the tail word (index n_words, per row) via static-column selects —
+    # a dynamic per-row gather would not vectorize on the VPU
+    tail_word = jnp.zeros_like(lens)
+    for i in range(W):
+        tail_word = jnp.where(n_words == i, words[:, i:i + 1], tail_word)
+    for t in range(3):
+        byte = lax.shift_right_logical(tail_word,
+                                       jnp.int32(8 * t)) & jnp.int32(0xFF)
+        sbyte = jnp.where(byte >= 128, byte - 256, byte)
+        h1 = jnp.where(t < n_tail, _mix_h1(h1, _mix_k1(sbyte)), h1)
+    out_ref[:] = _fmix(h1, lens)
+
+
+def murmur3_words(words, lengths, seed) -> jnp.ndarray:
+    """Spark Murmur3_x86_32.hashUnsafeBytes over packed word rows, as a
+    Pallas kernel. Same contract as ops.hashing.hash_string_words:
+    words (n, W) int32 little-endian UTF-8, lengths (n,) int32 → (n,) int32.
+    `seed` may be a scalar or a per-row (n,) running hash (the partitioner
+    chains column hashes, so the seed is usually row-varying).
+    """
+    n, W = words.shape
+    tile = min(_HASH_TILE, max(8, n))
+    n_pad = -(-n // tile) * tile
+    words_p = jnp.zeros((n_pad, W), jnp.int32).at[:n].set(
+        words.astype(jnp.int32))
+    lens_p = jnp.zeros((n_pad, 1), jnp.int32).at[:n, 0].set(
+        lengths.astype(jnp.int32))
+    seed_rows = jnp.broadcast_to(jnp.asarray(seed, jnp.int32), (n,))
+    seed_p = jnp.zeros((n_pad, 1), jnp.int32).at[:n, 0].set(seed_rows)
+    out = pl.pallas_call(
+        functools.partial(_murmur3_kernel, W=W),
+        out_shape=jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+        grid=(n_pad // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, W), lambda i: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+        interpret=_interpret(),
+    )(words_p, lens_p, seed_p)
+    return out[:n, 0]
+
+
+# ---------------------------------------------------------------------------
+# parquet bit-unpack
+# ---------------------------------------------------------------------------
+
+_UNPACK_TILE = 64  # rows of 128 values → 8192 values per grid step
+
+
+def _bitunpack_kernel(w_ref, out_ref, *, bw: int):
+    w = w_ref[:]                              # (T, 4*bw) int32 words
+    mask = jnp.int32((1 << bw) - 1) if bw < 32 else jnp.int32(-1)
+    cols = []
+    for j in range(128):
+        off = j * bw
+        w0, sh = off >> 5, off & 31
+        v = lax.shift_right_logical(w[:, w0:w0 + 1], jnp.int32(sh))
+        if sh + bw > 32:                      # value spans two words
+            v = v | lax.shift_left(w[:, w0 + 1:w0 + 2], jnp.int32(32 - sh))
+        cols.append(v & mask)
+    out_ref[:] = jnp.concatenate(cols, axis=1)
+
+
+def bitunpack128(words_u32, bit_width: int, n: int, capacity: int):
+    """Unpack `n` bit-packed values of `bit_width` bits from 32-bit words
+    into (capacity,) int32. 128 values of width bw span exactly 4*bw words,
+    so the kernel reads only statically-indexed columns.
+
+    words_u32: (ceil(n/128)*4*bw,) int32 — packed little-endian words.
+    """
+    if not 1 <= bit_width <= 32:
+        raise ValueError(f"bit width {bit_width} out of range")
+    bw = bit_width
+    n128 = max(1, -(-n // 128))
+    tile = min(_UNPACK_TILE, n128)
+    rows = -(-n128 // tile) * tile
+    need = rows * 4 * bw
+    w = jnp.zeros((need,), jnp.int32).at[:words_u32.shape[0]].set(
+        words_u32.astype(jnp.int32)).reshape(rows, 4 * bw)
+    out = pl.pallas_call(
+        functools.partial(_bitunpack_kernel, bw=bw),
+        out_shape=jax.ShapeDtypeStruct((rows, 128), jnp.int32),
+        grid=(rows // tile,),
+        in_specs=[pl.BlockSpec((tile, 4 * bw), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((tile, 128), lambda i: (i, 0)),
+        interpret=_interpret(),
+    )(w)
+    flat = out.reshape(-1)
+    idx = jnp.arange(capacity, dtype=jnp.int32)
+    safe = jnp.clip(idx, 0, flat.shape[0] - 1)
+    return jnp.where(idx < n, flat[safe], 0)
+
+
+def bytes_to_words_u32(packed: np.ndarray) -> np.ndarray:
+    """Host prep: pad a uint8 byte buffer to 4-byte alignment and view as
+    little-endian int32 words for bitunpack128."""
+    nb = len(packed)
+    pad = -nb % 4
+    if pad:
+        packed = np.concatenate([packed, np.zeros(pad, np.uint8)])
+    return packed.view("<i4").astype(np.int32)

@@ -57,11 +57,16 @@ def decode_dictionary_page(packed_bytes: np.ndarray, bit_width: int,
     index bytes and the dictionary live on device; run structure was already
     validated host-side (single bit-packed region — parse_rle_hybrid)."""
     from spark_rapids_tpu.columnar.vector import bucket_capacity
+    from spark_rapids_tpu.ops import pallas_kernels as PK
     pcap = max(bucket_capacity(n_present), 8)
-    packed_d = jnp.zeros((max(len(packed_bytes), 1),), jnp.uint8
-                         ).at[:len(packed_bytes)].set(
-        jnp.asarray(packed_bytes, dtype=jnp.uint8))
-    idx = unpack_bits_device(packed_d, bit_width, n_present, pcap)
+    if PK.should_use():
+        words = PK.bytes_to_words_u32(np.asarray(packed_bytes, np.uint8))
+        idx = PK.bitunpack128(jnp.asarray(words), bit_width, n_present, pcap)
+    else:
+        packed_d = jnp.zeros((max(len(packed_bytes), 1),), jnp.uint8
+                             ).at[:len(packed_bytes)].set(
+            jnp.asarray(packed_bytes, dtype=jnp.uint8))
+        idx = unpack_bits_device(packed_d, bit_width, n_present, pcap)
     nd = dict_values.shape[0]
     present = dict_values[jnp.clip(idx, 0, max(nd - 1, 0))]
     dl = jnp.zeros((capacity,), jnp.bool_).at[:len(def_levels)].set(

@@ -1,11 +1,21 @@
-"""Cost-based optimizer — reject unprofitable device sections.
+"""Cost-based optimizer — dual host/device cost model.
 
-Reference (SURVEY.md #13): CostBasedOptimizer.scala:52 with CpuCostModel /
-GpuCostModel: after tagging, estimate each section's cost on both sides and keep
-it on the CPU when acceleration wouldn't pay. On TPU the dominant term for small
-inputs is H2D transfer + dispatch latency (tens of ms over the tunnel), so the
-model pins a meta subtree to the host when its estimated row count is below
-`spark.rapids.tpu.sql.optimizer.minRows` and no device-resident source feeds it."""
+Reference (SURVEY.md #13): CostBasedOptimizer.scala:52 builds a CpuCostModel
+and a GpuCostModel, walks the tagged meta tree, costs each contiguous
+device-capable section on both sides (including row↔columnar transition
+costs at the section boundary), and reverts sections where acceleration
+would not pay (`costPreventsRunningOnGpu`).
+
+TPU translation of the cost terms:
+  host cost    = Σ rows(op) · weight(op) · host.rowCost
+  device cost  = Σ [dispatchCost + rows(op) · weight(op) · tpu.rowCost]
+                 + boundary_rows · transferRowCost      (H2D at leaves,
+                                                          D2H at the root)
+The fixed per-operator dispatch term models what dominates on TPU for small
+inputs: jit dispatch + tunnel latency, the analog of the reference's
+per-exec coefficient tables. `optimizer.minRows` remains as a hard floor
+(cheaper than costing when the answer is obvious).
+"""
 
 from __future__ import annotations
 
@@ -14,7 +24,7 @@ from spark_rapids_tpu.plan import nodes as NN
 
 
 def estimate_rows(node, _memo: dict | None = None) -> int:
-    """Static cardinality estimate (the CpuCostModel's row-count term).
+    """Static cardinality estimate (the cost models' shared row-count term).
     Memoized per optimize() pass — parquet estimates open footers."""
     if _memo is None:
         _memo = {}
@@ -61,6 +71,8 @@ def _estimate_rows(node, memo) -> int:
         return min(node.n, est(node.child))
     if isinstance(node, NN.UnionNode):
         return sum(est(c) for c in node.children)
+    if isinstance(node, NN.GenerateNode):
+        return est(node.child) * 4             # explode fan-out guess
     if isinstance(node, CacheNode):
         return est(node.child)
     if node.children:
@@ -68,27 +80,117 @@ def _estimate_rows(node, memo) -> int:
     return 1 << 20
 
 
+# relative per-row operator weights (the reference keys its coefficient
+# table by exec class the same way)
+_OP_WEIGHTS = (
+    (NN.SortNode, 6.0),
+    (NN.JoinNode, 5.0),
+    (NN.WindowNode, 5.0),
+    (NN.AggregateNode, 3.0),
+    (NN.ExchangeNode, 2.0),
+    (NN.GenerateNode, 2.0),
+    (NN.ExpandNode, 2.0),
+)
+
+
+def _op_weight(node) -> float:
+    for cls, w in _OP_WEIGHTS:
+        if isinstance(node, cls):
+            return w
+    return 1.0
+
+
+class _CostModel:
+    """One side of the dual model: per-op cost from shared cardinality."""
+
+    def __init__(self, row_cost: float, dispatch_cost: float = 0.0):
+        self.row_cost = row_cost
+        self.dispatch_cost = dispatch_cost
+
+    def op_cost(self, node, rows: int) -> float:
+        return self.dispatch_cost + rows * _op_weight(node) * self.row_cost
+
+
 def optimize(meta) -> None:
-    """Walk the tagged meta tree; pin small subtrees to the host (reference
-    CostBasedOptimizer.optimize, called between tagging and conversion)."""
+    """Walk the tagged meta tree; revert device sections the dual cost model
+    says are unprofitable (reference CostBasedOptimizer.optimize, called
+    between tagging and conversion)."""
     conf = meta.conf
     if not conf.get(CFG.OPTIMIZER_ENABLED):
         return
-    min_rows = conf.get(CFG.OPTIMIZER_MIN_ROWS)
-    _optimize_meta(meta, min_rows, {})
+    host = _CostModel(conf.get(CFG.OPTIMIZER_HOST_ROW_COST))
+    tpu = _CostModel(conf.get(CFG.OPTIMIZER_TPU_ROW_COST),
+                     conf.get(CFG.OPTIMIZER_TPU_DISPATCH_COST))
+    xfer = conf.get(CFG.OPTIMIZER_TRANSFER_ROW_COST)
+    memo = {}
+    # pass 1 — hard floor, PER NODE: a tiny operator (a global limit, a
+    # low-cardinality root) never pays for dispatch, but pinning it must not
+    # drag a large upstream scan off the device with it
+    _apply_min_rows(meta, conf.get(CFG.OPTIMIZER_MIN_ROWS), memo)
+    # pass 2 — dual cost comparison over the remaining device sections
+    _optimize_sections(meta, host, tpu, xfer, memo, parent_on_tpu=False)
 
 
-def _optimize_meta(meta, min_rows: int, memo: dict) -> None:
+def _apply_min_rows(meta, min_rows: int, memo: dict) -> None:
     from spark_rapids_tpu.plan.cache import CacheNode
     node = getattr(meta, "node", None)
-    if node is not None and meta.can_run_on_tpu:
-        # a cache may already hold device-materialized data; pinning it to the
-        # host would re-execute its child from scratch — never profitable
-        if not isinstance(node, CacheNode):
-            rows = estimate_rows(node, memo)
-            if rows < min_rows:
-                meta.will_not_work(
-                    f"cost model: ~{rows} rows < optimizer.minRows={min_rows};"
-                    " transfer+dispatch overhead exceeds device speedup")
-    for m in meta.child_metas:
-        _optimize_meta(m, min_rows, memo)
+    if (node is not None and meta.can_run_on_tpu
+            and not isinstance(node, CacheNode)):
+        rows = estimate_rows(node, memo)
+        if rows < min_rows:
+            meta.will_not_work(
+                f"cost model: ~{rows} rows < optimizer.minRows={min_rows};"
+                " transfer+dispatch overhead exceeds device speedup")
+    for m in _plan_metas(meta):
+        _apply_min_rows(m, min_rows, memo)
+
+
+def _plan_metas(meta):
+    """Child metas that wrap plan nodes (expression metas are costed with
+    their operator, not separately)."""
+    return [m for m in meta.child_metas if hasattr(m, "node")]
+
+
+def _section(meta, memo):
+    """Collect the maximal contiguous device-capable subtree rooted at
+    `meta`: (section metas, host-boundary metas below it)."""
+    nodes, fringe = [meta], []
+    for m in _plan_metas(meta):
+        if m.can_run_on_tpu:
+            sub_nodes, sub_fringe = _section(m, memo)
+            nodes.extend(sub_nodes)
+            fringe.extend(sub_fringe)
+        else:
+            fringe.append(m)
+    return nodes, fringe
+
+
+def _optimize_sections(meta, host, tpu, xfer, memo, parent_on_tpu):
+    from spark_rapids_tpu.plan.cache import CacheNode
+    node = getattr(meta, "node", None)
+    on_tpu = node is not None and meta.can_run_on_tpu
+    if on_tpu and not parent_on_tpu and not isinstance(node, CacheNode):
+        section, fringe = _section(meta, memo)
+        # a cache inside the section may hold device-materialized batches;
+        # reverting would re-execute its child — never profitable
+        if not any(isinstance(m.node, CacheNode) for m in section):
+            host_cost = tpu_cost = 0.0
+            for m in section:
+                rows = estimate_rows(m.node, memo)
+                host_cost += host.op_cost(m.node, rows)
+                tpu_cost += tpu.op_cost(m.node, rows)
+            # transitions: H2D for every host child feeding the section,
+            # D2H for the section's result
+            boundary = estimate_rows(meta.node, memo)
+            for m in fringe:
+                boundary += estimate_rows(m.node, memo)
+            tpu_cost += boundary * xfer
+            if tpu_cost >= host_cost:
+                why = (f"cost model: device {tpu_cost * 1e3:.2f}ms >= "
+                       f"host {host_cost * 1e3:.2f}ms over "
+                       f"{len(section)}-op section")
+                for m in section:
+                    m.will_not_work(why)
+                on_tpu = False
+    for m in _plan_metas(meta):
+        _optimize_sections(m, host, tpu, xfer, memo, on_tpu)

@@ -91,6 +91,59 @@ def wrap_plan_meta(node, conf: RapidsConf, parent=None) -> PlanMeta:
     return PlanMeta(node, REGISTRY.lookup_exec(node), conf, parent)
 
 
+def extract_python_udfs(plan):
+    """Spark ExtractPythonUDFs analog: pull PythonUDF calls out of filter
+    conditions into a projection so the UDF rides ArrowEvalPythonExec (the
+    GpuArrowEvalPythonExec path) while the residual condition stays a device
+    filter. Rewrites Filter(cond[udf]) into
+    Project[orig] ∘ Filter(cond[ref]) ∘ Project[orig..., udf AS __pyudf_j].
+    Non-mutating: rebuilt nodes are fresh; untouched subtrees are shared.
+    """
+    import copy as _copy
+    from spark_rapids_tpu.plan.nodes import FilterNode, ProjectNode
+    from spark_rapids_tpu.udf.python_runtime import PythonUDF
+
+    def replace_by_id(expr, mapping):
+        if id(expr) in mapping:
+            return mapping[id(expr)]
+        if not expr.children:
+            return expr
+        return expr.with_children(
+            [replace_by_id(c, mapping) for c in expr.children])
+
+    def rewrite(node):
+        kids = [rewrite(c) for c in node.children]
+        if any(k is not o for k, o in zip(kids, node.children)):
+            node = _copy.copy(node)
+            node.children = kids
+        if not isinstance(node, FilterNode):
+            return node
+        udfs = node.condition.collect(lambda x: isinstance(x, PythonUDF))
+        # dedupe repeated occurrences of the same UDF object: one projected
+        # column (and one worker round trip) feeds every use site
+        udfs = list({id(u): u for u in udfs}.values())
+        # drop UDFs nested inside another extracted UDF — the outer one's
+        # worker evaluation computes them, a separate column would be dead
+        nested = {id(d) for u in udfs for c in u.children
+                  for d in c.collect(lambda x: isinstance(x, PythonUDF))}
+        udfs = [u for u in udfs if id(u) not in nested]
+        if not udfs:
+            return node
+        child = node.children[0]
+        base = [E.BoundReference(i, f.data_type, f.nullable, f.name)
+                for i, f in enumerate(child.output.fields)]
+        k = len(base)
+        proj, mapping = list(base), {}
+        for j, u in enumerate(udfs):
+            mapping[id(u)] = E.BoundReference(k + j, u.dtype, True,
+                                              f"__pyudf_{j}")
+            proj.append(E.Alias(u, f"__pyudf_{j}"))
+        cond = replace_by_id(node.condition, mapping)
+        return ProjectNode(base, FilterNode(cond, ProjectNode(proj, child)))
+
+    return rewrite(plan)
+
+
 class TpuOverrides:
     """Entry point: CPU plan → hybrid plan (reference GpuOverrides.apply:3017)."""
 
@@ -100,6 +153,7 @@ class TpuOverrides:
     def apply(self, plan):
         if not self.conf.is_sql_enabled:
             return plan
+        plan = extract_python_udfs(plan)
         meta = wrap_plan_meta(plan, self.conf)
         meta.tag_for_tpu()
         from spark_rapids_tpu.plan.cbo import optimize
@@ -112,6 +166,7 @@ class TpuOverrides:
 
 def explain_plan(plan, conf: RapidsConf | None = None, all_nodes=True) -> str:
     conf = conf or RapidsConf()
+    plan = extract_python_udfs(plan)
     meta = wrap_plan_meta(plan, conf)
     meta.tag_for_tpu()
     from spark_rapids_tpu.plan.cbo import optimize

@@ -237,6 +237,14 @@ class TpuSession:
     def __init__(self, conf: dict | RapidsConf | None = None):
         self.conf = (conf if isinstance(conf, RapidsConf)
                      else RapidsConf(conf or {}))
+        from spark_rapids_tpu import config as CFG
+        from spark_rapids_tpu.ops import pallas_kernels as PK
+        # the Pallas dispatch is process-global (like the reference's
+        # executor-plugin init): only an EXPLICIT conf setting touches it, so
+        # constructing a default session never overrides another session's
+        # explicit choice
+        if CFG.PALLAS_ENABLED.key in self.conf.settings:
+            PK.set_mode(None if self.conf.get(CFG.PALLAS_ENABLED) else False)
 
     # -- data sources --------------------------------------------------------
     def read_parquet(self, path, pushed_filter=None,

@@ -248,3 +248,34 @@ def test_hash_join_rejects_cross():
     with pytest.raises(ValueError):
         HashJoinExec("cross", [], [], ArrowScanExec([lt], conf=conf),
                      ArrowScanExec([rt], conf=conf))
+
+
+def test_broadcast_exchange_exec_standalone():
+    """Standalone BroadcastExchangeExec (reference GpuBroadcastExchangeExecBase):
+    plan-visible node, one shared materialization, host-bridge stream path."""
+    import pyarrow as pa
+    from spark_rapids_tpu.exec.broadcast import BroadcastExchangeExec
+    tbl = pa.table({"k": pa.array([1, 2, 3], pa.int64())})
+    scan = ArrowScanExec([tbl])
+    bx = BroadcastExchangeExec(scan)
+    assert bx.num_partitions == 1
+    sb1 = bx.broadcast()
+    sb2 = bx.broadcast()
+    assert sb1 is sb2  # single shared relation
+    # host-bridge path streams the same relation
+    out = list(bx.execute_partition(0))
+    assert out[0].num_rows == 3
+    assert "BroadcastExchangeExec" in bx.tree_string()
+    bx.release()
+
+
+def test_broadcast_join_rides_exchange():
+    from spark_rapids_tpu.session import TpuSession
+    spark = TpuSession()
+    left = spark.create_dataframe({"k": pa.array([1, 2], pa.int64()),
+                                   "a": pa.array([10, 20], pa.int64())})
+    right = spark.create_dataframe({"k": pa.array([2, 3], pa.int64()),
+                                    "b": pa.array([7, 8], pa.int64())})
+    out = left.join(right, on="k").collect()
+    assert out.num_rows == 1
+    assert out["a"].to_pylist() == [20] and out["b"].to_pylist() == [7]

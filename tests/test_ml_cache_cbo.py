@@ -93,3 +93,25 @@ def test_cbo_pins_small_plans_to_host(spark):
 
     big = s.range(100000, num_slices=2).filter(F.col("id") > 2)
     assert "cost model" not in big.explain()
+
+
+def test_cbo_dual_cost_model_reverts_dispatch_bound_sections(spark):
+    """Reference CostBasedOptimizer builds Cpu/Gpu cost models and reverts
+    sections where acceleration does not pay; here the device dispatch cost
+    dominates a medium plan when cranked up, and a large plan stays on
+    device when dispatch is cheap."""
+    base = {"spark.rapids.tpu.sql.optimizer.enabled": "true",
+            "spark.rapids.tpu.sql.optimizer.minRows": "1"}
+    # huge per-dispatch overhead → host wins even at 100k rows
+    s1 = TpuSession(RapidsConf({**base,
+        "spark.rapids.tpu.sql.optimizer.tpu.dispatchCost": "10.0"}))
+    df1 = s1.range(100000, num_slices=2).filter(F.col("id") > 2)
+    txt1 = df1.explain()
+    assert "cost model: device" in txt1
+    assert df1.collect().num_rows == 99997  # host path still correct
+
+    # negligible dispatch cost → device wins at the same size
+    s2 = TpuSession(RapidsConf({**base,
+        "spark.rapids.tpu.sql.optimizer.tpu.dispatchCost": "1e-9"}))
+    df2 = s2.range(100000, num_slices=2).filter(F.col("id") > 2)
+    assert "cost model" not in df2.explain()

@@ -1,0 +1,141 @@
+"""Pallas kernel equivalence tests (interpret mode on the forced-CPU
+platform; the same kernels compile with Mosaic on TPU — bench path).
+
+Oracles: the jnp reference implementations in ops/hashing.py (itself pinned
+to Spark golden vectors in test_columnar.py) and ops/parquet_decode.py.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from spark_rapids_tpu.ops import hashing as H
+from spark_rapids_tpu.ops import parquet_decode as PD
+from spark_rapids_tpu.ops import pallas_kernels as PK
+
+
+def test_murmur3_words_matches_host_oracle():
+    rng = np.random.default_rng(7)
+    strs = ["", "a", "ab", "abc", "abcd", "hello world", "ünïcødé",
+            "x" * 37, "tail3_", "padded to sixteen"]
+    strs += ["".join(chr(rng.integers(32, 127)) for _ in range(rng.integers(0, 30)))
+             for _ in range(50)]
+    words, lens = H.pack_utf8_words(strs)
+    out = np.asarray(PK.murmur3_words(jnp.asarray(words), jnp.asarray(lens), 42))
+    host = [H.murmur3_bytes_host(s.encode("utf-8"), 42) for s in strs]
+    assert list(out) == host
+
+
+def test_murmur3_words_row_varying_seed():
+    strs = ["alpha", "bravo", "charlie", "d", ""]
+    words, lens = H.pack_utf8_words(strs)
+    seeds = np.array([42, -7, 0, 123456, 99], dtype=np.int32)
+    out = np.asarray(PK.murmur3_words(jnp.asarray(words), jnp.asarray(lens),
+                                      jnp.asarray(seeds)))
+    host = [H.murmur3_bytes_host(s.encode("utf-8"), int(sd))
+            for s, sd in zip(strs, seeds)]
+    assert list(out) == host
+
+
+def test_murmur3_words_matches_jnp_kernel_large():
+    rng = np.random.default_rng(11)
+    strs = ["s%d_%s" % (i, "y" * int(rng.integers(0, 25))) for i in range(1000)]
+    words, lens = H.pack_utf8_words(strs)
+    w, l = jnp.asarray(words), jnp.asarray(lens)
+    ref = np.asarray(H.hash_string_words(w, l, jnp.int32(42)))
+    out = np.asarray(PK.murmur3_words(w, l, 42))
+    assert (out == ref).all()
+
+
+@pytest.mark.parametrize("bw", [1, 2, 3, 5, 7, 8, 11, 13, 16, 20, 24, 31, 32])
+def test_bitunpack128_matches_reference(bw):
+    rng = np.random.default_rng(bw)
+    n = 300
+    vals = rng.integers(0, 2 ** min(bw, 31), size=n, dtype=np.int64)
+    # pack: value i at bits [i*bw, (i+1)*bw), little-endian bit order
+    total_bits = n * bw
+    buf = np.zeros((total_bits + 7) // 8, dtype=np.uint8)
+    for i, v in enumerate(vals):
+        for b in range(bw):
+            bit = i * bw + b
+            if (v >> b) & 1:
+                buf[bit >> 3] |= 1 << (bit & 7)
+    cap = 512
+    words = PK.bytes_to_words_u32(buf)
+    out = np.asarray(PK.bitunpack128(jnp.asarray(words), bw, n, cap))
+    ref = np.asarray(PD.unpack_bits_device(
+        jnp.asarray(buf), bw, n, cap)) if bw <= 25 else None
+    expect = np.zeros(cap, dtype=np.int64)
+    expect[:n] = vals
+    assert (out.astype(np.uint32) == expect.astype(np.uint32)).all()
+    if ref is not None:  # also agree with the stage-one jnp decoder
+        assert (out[:n] == ref[:n]).all()
+
+
+def test_bitunpack128_tiny_run():
+    # fewer than 128 values, width 4
+    vals = np.array([3, 9, 15, 0, 7, 1, 2, 4], dtype=np.int64)
+    buf = np.zeros(4, dtype=np.uint8)
+    for i, v in enumerate(vals):
+        for b in range(4):
+            bit = i * 4 + b
+            if (v >> b) & 1:
+                buf[bit >> 3] |= 1 << (bit & 7)
+    words = PK.bytes_to_words_u32(buf)
+    out = np.asarray(PK.bitunpack128(jnp.asarray(words), 4, len(vals), 16))
+    assert list(out[:8]) == list(vals)
+    assert (out[8:] == 0).all()
+
+
+def test_pallas_dispatch_through_partitioning(monkeypatch):
+    """Force the dispatch on (interpret mode off-TPU) and hash-partition a
+    string column end-to-end — device results must match the forced-off jnp
+    path bit for bit."""
+    import pyarrow as pa
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.shuffle.partitioning import HashPartitioner
+    from spark_rapids_tpu.expr.core import col
+
+    t = pa.table({"s": pa.array(["a", "bb", "ccc", None, "dddd", "é"] * 10),
+                  "v": pa.array(list(range(60)), pa.int64())})
+    batch = ColumnarBatch.from_arrow(t)
+
+    def run():
+        p = HashPartitioner([col("s")], 4).bind(batch.schema)
+        return {pid: part.to_arrow().to_pylist()
+                for pid, part in p.partition(batch)}
+
+    PK.set_mode(True)
+    try:
+        with_pallas = run()
+    finally:
+        PK.set_mode(False)
+    without = run()
+    PK.set_mode(None)
+    assert with_pallas == without
+
+
+def test_pallas_dispatch_through_parquet_decode(tmp_path):
+    """Forced-on dispatch through decode_dictionary_page equals forced-off."""
+    rng = np.random.default_rng(3)
+    dict_vals = jnp.asarray(rng.integers(0, 1000, 32), dtype=jnp.int64)
+    n = 100
+    idx = rng.integers(0, 32, n)
+    bw = 5
+    buf = np.zeros((n * bw + 7) // 8, dtype=np.uint8)
+    for i, v in enumerate(idx):
+        for b in range(bw):
+            bit = i * bw + b
+            if (v >> b) & 1:
+                buf[bit >> 3] |= 1 << (bit & 7)
+    dl = np.ones(n, dtype=np.int32)
+
+    PK.set_mode(True)
+    try:
+        v1, m1 = PD.decode_dictionary_page(buf, bw, n, dl, dict_vals, 128)
+    finally:
+        PK.set_mode(False)
+    v2, m2 = PD.decode_dictionary_page(buf, bw, n, dl, dict_vals, 128)
+    PK.set_mode(None)
+    assert (np.asarray(v1) == np.asarray(v2)).all()
+    assert (np.asarray(m1) == np.asarray(m2)).all()
