@@ -145,6 +145,18 @@ SPILL_DIRS = conf("spark.rapids.tpu.memory.spill.dirs").doc(
     "Comma-separated local dirs for the disk spill tier "
     "(reference uses Spark local dirs, RapidsDiskStore.scala)").string_conf(None)
 
+DIRECT_SPILL_ENABLED = conf(
+    "spark.rapids.tpu.memory.direct.storage.spill.enabled").doc(
+    "Spill the disk tier through the batched aligned direct-I/O store "
+    "(O_DIRECT; the GDS analog — reference "
+    "spark.rapids.memory.gpu.direct.storage.spill.enabled, RapidsGdsStore)"
+).boolean_conf(False)
+
+DIRECT_SPILL_BATCH_BYTES = conf(
+    "spark.rapids.tpu.memory.direct.storage.spill.batchWriteBufferSize").doc(
+    "Size at which a direct-spill batch file rotates (reference GDS "
+    "batchWriteBufferSize)").bytes_conf("64m")
+
 UNSPILL_ENABLED = conf("spark.rapids.tpu.memory.hbm.unspill.enabled").doc(
     "Re-promote spilled buffers back to HBM on access "
     "(reference spark.rapids.memory.gpu.unspill.enabled)").boolean_conf(False)

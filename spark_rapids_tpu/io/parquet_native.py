@@ -246,6 +246,27 @@ def read_chunk_pages(path: str, row_group: int, column: int,
         f.seek(start)
         buf = f.read(col.total_compressed_size)
 
+    # fast path: one native C call scans the whole chunk (thrift headers,
+    # def-level RLE decode, hybrid segmentation — native/parquet_host.cpp);
+    # the Python loop below is the fallback and the executable spec
+    try:
+        from spark_rapids_tpu.native import (NativeBuildError,
+                                             scan_chunk_native)
+        raw_pages, dict_info = scan_chunk_native(buf, col.num_values, max_def)
+    except (NativeBuildError, OSError):
+        pass  # no native toolchain: parse in Python below
+    else:
+        d_off, d_len, d_n = dict_info
+        dict_vals = _decode_plain_dictionary(
+            col.physical_type, buf[d_off:d_off + d_len], d_n)
+        pages = []
+        for (nv, dl, bw, values_off, body_off, body_len, _np_, rs) in raw_pages:
+            page_bytes = buf[body_off:body_off + body_len]
+            segs = [RleSegment("packed" if k == 1 else "rle", c, v, bo, bl)
+                    for (k, c, v, bo, bl) in rs]
+            pages.append((nv, dl, bw, page_bytes, values_off, segs))
+        return ChunkPages(col.physical_type, dict_vals, pages, col.num_values)
+
     pos = 0
     dict_vals = None
     pages = []

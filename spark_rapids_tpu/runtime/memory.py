@@ -91,7 +91,7 @@ class RapidsBuffer:
     (reference RapidsBufferStore.RapidsBufferBase)."""
 
     __slots__ = ("buffer_id", "tier", "priority", "size", "_device", "_host",
-                 "_path", "spill_callback")
+                 "_path", "_handle", "spill_callback")
 
     def __init__(self, buffer_id: int, batch: ColumnarBatch, priority: float,
                  spill_callback=None):
@@ -102,6 +102,7 @@ class RapidsBuffer:
         self._device: ColumnarBatch | None = batch
         self._host: HostBatch | None = None
         self._path: str | None = None
+        self._handle = None          # (file, offset, len) in the direct store
         self.spill_callback = spill_callback
 
 
@@ -114,12 +115,16 @@ class BufferCatalog:
     """
 
     def __init__(self, device_budget: int, host_budget: int, spill_dir: str | None = None,
-                 unspill: bool = False, oom_dump_dir: str | None = None):
+                 unspill: bool = False, oom_dump_dir: str | None = None,
+                 direct_spill: bool = False, direct_batch_bytes: int = 64 << 20):
         self.device_budget = device_budget
         self.host_budget = host_budget
         self._spill_dir = spill_dir
         self._unspill = unspill
         self._oom_dump_dir = oom_dump_dir
+        self._direct_spill = direct_spill
+        self._direct_batch_bytes = direct_batch_bytes
+        self._direct_store = None  # lazily created GDS-analog batch store
         self._lock = threading.RLock()
         self._buffers: dict[int, RapidsBuffer] = {}
         self._ids = itertools.count(1)
@@ -216,15 +221,31 @@ class BufferCatalog:
         os.makedirs(self._spill_dir, exist_ok=True)
         return self._spill_dir
 
+    def _get_direct_store(self):
+        if self._direct_store is None:
+            from spark_rapids_tpu.runtime.direct_spill import DirectSpillStore
+            self._direct_store = DirectSpillStore(
+                os.path.join(self._spill_dir_path(), "direct"),
+                batch_bytes=self._direct_batch_bytes)
+        return self._direct_store
+
     def _spill_host_buffer(self, buf: RapidsBuffer):
         hb = buf._host
-        path = os.path.join(self._spill_dir_path(), f"buffer-{buf.buffer_id}.spill")
-        with open(path, "wb") as f:
-            pickle.dump(hb, f, protocol=pickle.HIGHEST_PROTOCOL)
+        payload = pickle.dumps(hb, protocol=pickle.HIGHEST_PROTOCOL)
+        if self._direct_spill:
+            # GDS-analog batched aligned store (reference RapidsGdsStore)
+            buf._handle = self._get_direct_store().write(payload)
+            buf._path = None
+        else:
+            path = os.path.join(self._spill_dir_path(),
+                                f"buffer-{buf.buffer_id}.spill")
+            with open(path, "wb") as f:
+                f.write(payload)
+            buf._path = path
+            buf._handle = None
         self.host_bytes -= hb.nbytes()
         self.spilled_to_disk_bytes += hb.nbytes()
         buf._host = None
-        buf._path = path
         buf.tier = TierEnum.DISK
 
     # -- access --------------------------------------------------------------
@@ -238,12 +259,19 @@ class BufferCatalog:
                 return buf._device
             hb = buf._host
             if hb is None:
-                with open(buf._path, "rb") as f:
-                    hb = pickle.load(f)
+                if buf._handle is not None:
+                    hb = pickle.loads(
+                        self._get_direct_store().read(buf._handle))
+                else:
+                    with open(buf._path, "rb") as f:
+                        hb = pickle.load(f)
             batch = host_to_batch(hb)
             if self._unspill:
                 if buf.tier == TierEnum.HOST:
                     self.host_bytes -= hb.nbytes()
+                elif buf._handle is not None:
+                    self._get_direct_store().delete(buf._handle)
+                    buf._handle = None
                 else:
                     os.unlink(buf._path)
                     buf._path = None
@@ -270,6 +298,8 @@ class BufferCatalog:
                 self.device_bytes -= buf.size
             elif buf.tier == TierEnum.HOST:
                 self.host_bytes -= buf._host.nbytes()
+            elif buf._handle is not None:
+                self._get_direct_store().delete(buf._handle)
             elif buf._path:
                 try:
                     os.unlink(buf._path)
@@ -362,6 +392,8 @@ class DeviceManager:
             spill_dir=spill_dirs.split(",")[0] if spill_dirs else None,
             unspill=conf.get(C.UNSPILL_ENABLED),
             oom_dump_dir=conf.get(C.OOM_DUMP_DIR),
+            direct_spill=conf.get(C.DIRECT_SPILL_ENABLED),
+            direct_batch_bytes=conf.get(C.DIRECT_SPILL_BATCH_BYTES),
         )
 
     @classmethod

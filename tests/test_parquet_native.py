@@ -121,3 +121,56 @@ def test_unpack_bits_widths():
         got = np.asarray(unpack_bits_device(
             jnp.asarray(packed), bw, n, 256))[:n]
         assert (got == vals.astype(np.int32)).all(), bw
+
+
+def test_native_scanner_matches_python_parser(tmp_path, monkeypatch):
+    """The C scanner (native/parquet_host.cpp) and the Python parser must
+    produce identical ChunkPages structures — same pages, def levels, run
+    segmentation, and dictionary."""
+    from spark_rapids_tpu import native as N
+    try:
+        N.parquet_lib()  # the comparison is vacuous without the C library
+    except N.NativeBuildError:
+        pytest.skip("no native toolchain")
+    t = mixed_table(3000, seed=7)
+    f = str(tmp_path / "m.parquet")
+    pq.write_table(t, f, compression="NONE", use_dictionary=True,
+                   data_page_size=4096, row_group_size=1500)
+    md = pq.ParquetFile(f).metadata
+
+    def parse_all():
+        out = []
+        for rg in range(md.num_row_groups):
+            for c in range(md.num_columns):
+                try:
+                    out.append(PN.read_chunk_pages(f, rg, c, md=md))
+                except NotImplementedError:
+                    out.append(None)
+        return out
+
+    native = parse_all()
+
+    def boom(*a, **k):
+        raise N.NativeBuildError("forced python fallback")
+    monkeypatch.setattr(N, "scan_chunk_native", boom)
+    python = parse_all()
+
+    assert len(native) == len(python)
+    for cn, cp in zip(native, python):
+        assert (cn is None) == (cp is None)
+        if cn is None:
+            continue
+        assert cn.physical_type == cp.physical_type
+        assert cn.num_values == cp.num_values
+        if isinstance(cn.dict_values, list):
+            assert cn.dict_values == cp.dict_values
+        else:
+            assert (cn.dict_values == cp.dict_values).all()
+        assert len(cn.index_segments) == len(cp.index_segments)
+        for pn_, pp in zip(cn.index_segments, cp.index_segments):
+            nv_n, dl_n, bw_n, pb_n, vo_n, segs_n = pn_
+            nv_p, dl_p, bw_p, pb_p, vo_p, segs_p = pp
+            assert nv_n == nv_p and bw_n == bw_p and vo_n == vo_p
+            assert pb_n == pb_p
+            assert (dl_n == dl_p).all()
+            assert segs_n == segs_p
