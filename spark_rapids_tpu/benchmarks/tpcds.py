@@ -1,0 +1,439 @@
+"""TPC-DS subset benchmark: deterministic generator, star-join queries via
+the session API, and independent single-core NumPy oracles.
+
+Reference role: BASELINE.md config-3 (TPC-DS 10-query subset with the
+accelerated shuffle over ICI) and config-5 (full sweep); the reference's
+own nightly runs the analogous qa_nightly_select_test.py sweep
+(integration_tests). Queries follow the official TPC-DS text restricted to
+this schema subset: q3, q42, q52, q55 (date×item star aggregates), q7
+(demographics + promotion), q19 (brand revenue where customer and store
+zips differ).
+
+The generator is pure vectorized numpy with dense surrogate keys; group
+cardinalities and join selectivities track the spec closely enough for
+kernel benchmarking (same design stance as benchmarks/tpch.py).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+N_DATES = 366 * 5            # 1998..2002
+FIRST_YEAR = 1998
+CATEGORIES = ["Home", "Books", "Electronics", "Music", "Sports", "Shoes",
+              "Jewelry", "Men", "Women", "Children"]
+GENDERS = ["M", "F"]
+MARITAL = ["M", "S", "D", "W", "U"]
+EDUCATION = ["Primary", "Secondary", "College", "2 yr Degree", "4 yr Degree",
+             "Advanced Degree", "Unknown"]
+
+
+def generate(sf: float, outdir: str, files_per_table: int = 4) -> dict:
+    """Generate the subset at scale factor `sf` (SF1 ≈ 2.9M store_sales).
+    Returns {table: dir}. Idempotent per table."""
+    os.makedirs(outdir, exist_ok=True)
+    rng = np.random.default_rng(20260730)
+    n_ss = int(2_880_000 * sf)
+    n_item = max(int(18_000 * sf), 2000)
+    n_cust = max(int(100_000 * sf), 100)
+    n_addr = max(n_cust // 2, 50)
+    n_store = max(int(12 * max(sf, 1)), 2)
+    n_cd = 7 * 5 * 2 * 4     # education x marital x gender x dep buckets
+    n_promo = max(int(300 * sf), 10)
+
+    paths = {}
+
+    def write(name, table, nfiles=files_per_table):
+        from spark_rapids_tpu.benchmarks.common import write_partitioned
+        write_partitioned(outdir, name, table, nfiles, paths)
+
+    # date_dim: one row per day, d_date_sk dense from 1
+    sk = np.arange(1, N_DATES + 1, dtype=np.int64)
+    doy = (sk - 1) % 366
+    write("date_dim", pa.table({
+        "d_date_sk": pa.array(sk),
+        "d_year": pa.array((FIRST_YEAR + (sk - 1) // 366).astype(np.int32)),
+        "d_moy": pa.array((doy // 31 + 1).astype(np.int32)),
+        "d_dom": pa.array((doy % 31 + 1).astype(np.int32)),
+    }), 1)
+
+    # item
+    isk = np.arange(1, n_item + 1, dtype=np.int64)
+    cat_id = rng.integers(0, len(CATEGORIES), n_item)
+    brand_id = (cat_id + 1) * 1000 + rng.integers(1, 100, n_item)
+    write("item", pa.table({
+        "i_item_sk": pa.array(isk),
+        "i_item_id": pa.array([f"ITEM{k:08d}" for k in isk]),
+        "i_brand_id": pa.array(brand_id.astype(np.int32)),
+        "i_brand": pa.array([f"brand#{b}" for b in brand_id]),
+        "i_category_id": pa.array((cat_id + 1).astype(np.int32)),
+        "i_category": pa.array(np.array(CATEGORIES)[cat_id]),
+        "i_manufact_id": pa.array(
+            rng.integers(1, 140, n_item).astype(np.int32)),
+        "i_manager_id": pa.array(
+            rng.integers(1, 100, n_item).astype(np.int32)),
+    }), 1)
+
+    # customer_demographics: full cross of the filter dimensions
+    cd_sk = np.arange(1, n_cd + 1, dtype=np.int64)
+    write("customer_demographics", pa.table({
+        "cd_demo_sk": pa.array(cd_sk),
+        "cd_gender": pa.array(np.array(GENDERS)[(cd_sk - 1) % 2]),
+        "cd_marital_status": pa.array(
+            np.array(MARITAL)[((cd_sk - 1) // 2) % 5]),
+        "cd_education_status": pa.array(
+            np.array(EDUCATION)[((cd_sk - 1) // 10) % 7]),
+    }), 1)
+
+    # promotion
+    psk = np.arange(1, n_promo + 1, dtype=np.int64)
+    write("promotion", pa.table({
+        "p_promo_sk": pa.array(psk),
+        "p_channel_email": pa.array(
+            np.where(rng.random(n_promo) < 0.5, "N", "Y")),
+        "p_channel_event": pa.array(
+            np.where(rng.random(n_promo) < 0.5, "N", "Y")),
+    }), 1)
+
+    # customer_address / store (zips overlap so q19's <> filter selects)
+    zips = rng.integers(10000, 10100, n_addr)
+    write("customer_address", pa.table({
+        "ca_address_sk": pa.array(np.arange(1, n_addr + 1, dtype=np.int64)),
+        "ca_zip": pa.array([f"{z:05d}" for z in zips]),
+    }), 1)
+    szips = rng.integers(10000, 10100, n_store)
+    write("store", pa.table({
+        "s_store_sk": pa.array(np.arange(1, n_store + 1, dtype=np.int64)),
+        "s_store_name": pa.array([f"store{k}" for k in range(n_store)]),
+        "s_zip": pa.array([f"{z:05d}" for z in szips]),
+    }), 1)
+
+    # customer
+    write("customer", pa.table({
+        "c_customer_sk": pa.array(np.arange(1, n_cust + 1, dtype=np.int64)),
+        "c_current_addr_sk": pa.array(
+            rng.integers(1, n_addr + 1, n_cust).astype(np.int64)),
+    }), 1)
+
+    # store_sales (fact)
+    write("store_sales", pa.table({
+        "ss_sold_date_sk": pa.array(
+            rng.integers(1, N_DATES + 1, n_ss).astype(np.int64)),
+        "ss_item_sk": pa.array(
+            rng.integers(1, n_item + 1, n_ss).astype(np.int64)),
+        "ss_customer_sk": pa.array(
+            rng.integers(1, n_cust + 1, n_ss).astype(np.int64)),
+        "ss_cdemo_sk": pa.array(
+            rng.integers(1, n_cd + 1, n_ss).astype(np.int64)),
+        "ss_promo_sk": pa.array(
+            rng.integers(1, n_promo + 1, n_ss).astype(np.int64)),
+        "ss_store_sk": pa.array(
+            rng.integers(1, n_store + 1, n_ss).astype(np.int64)),
+        "ss_quantity": pa.array(
+            rng.integers(1, 100, n_ss).astype(np.int32)),
+        "ss_list_price": pa.array(
+            np.round(rng.uniform(1.0, 200.0, n_ss), 2)),
+        "ss_sales_price": pa.array(
+            np.round(rng.uniform(1.0, 200.0, n_ss), 2)),
+        "ss_ext_sales_price": pa.array(
+            np.round(rng.uniform(1.0, 20000.0, n_ss), 2)),
+        "ss_coupon_amt": pa.array(
+            np.round(rng.uniform(0.0, 50.0, n_ss), 2)),
+    }))
+    return paths
+
+
+def load(spark, paths: dict, files_per_partition: int = 2) -> dict:
+    from spark_rapids_tpu.benchmarks.common import load as _load
+    return _load(spark, paths, files_per_partition)
+
+
+# -- queries (session API; official TPC-DS text over this subset) -------------
+
+def _star(dfs, moy, year=None):
+    """store_sales ⋈ date_dim ⋈ item — the q3/q42/q52/q55 spine. q3 filters
+    only the month (it groups by d_year); the others pin one year too."""
+    import spark_rapids_tpu.functions as F
+    c = F.col
+    cond = c("d_moy") == F.lit(moy)
+    if year is not None:
+        cond = (c("d_year") == F.lit(year)) & cond
+    dd = (dfs["date_dim"].filter(cond)
+          .select(c("d_date_sk").alias("ss_sold_date_sk"), c("d_year")))
+    return (dfs["store_sales"]
+            .select(c("ss_sold_date_sk"), c("ss_item_sk"),
+                    c("ss_ext_sales_price"))
+            .join(dd, on="ss_sold_date_sk")
+            .select(c("ss_item_sk").alias("i_item_sk"), c("d_year"),
+                    c("ss_ext_sales_price")))
+
+
+def q3(dfs):
+    """Brand revenue by year for manufacturer 128 in November (official
+    TPC-DS q3: d_moy = 11 and i_manufact_id = 128, grouped by d_year)."""
+    import spark_rapids_tpu.functions as F
+    c = F.col
+    item = (dfs["item"].filter(c("i_manufact_id") == F.lit(128))
+            .select(c("i_item_sk"), c("i_brand_id"), c("i_brand")))
+    j = _star(dfs, 11).join(item, on="i_item_sk")
+    return (j.group_by(c("d_year"), c("i_brand_id"), c("i_brand"))
+            .agg(F.sum(c("ss_ext_sales_price")).alias("sum_agg"))
+            .sort(c("d_year"), c("sum_agg"), c("i_brand_id"),
+                  ascending=[True, False, True])
+            .limit(100))
+
+
+def q42(dfs):
+    """Category revenue for one manager's items, one month (official TPC-DS
+    q42: i_manager_id = 1, d_year = 2000, d_moy = 11)."""
+    import spark_rapids_tpu.functions as F
+    c = F.col
+    item = (dfs["item"].filter(c("i_manager_id") == F.lit(1))
+            .select(c("i_item_sk"), c("i_category_id"), c("i_category")))
+    j = _star(dfs, 11, 2000).join(item, on="i_item_sk")
+    return (j.group_by(c("d_year"), c("i_category_id"), c("i_category"))
+            .agg(F.sum(c("ss_ext_sales_price")).alias("sum_agg"))
+            .sort(c("sum_agg"), c("d_year"), c("i_category_id"),
+                  ascending=[False, True, True])
+            .limit(100))
+
+
+def q52(dfs):
+    """Brand revenue for one manager's items, one month (official TPC-DS
+    q52: i_manager_id = 1, d_year = 2000, d_moy = 11)."""
+    import spark_rapids_tpu.functions as F
+    c = F.col
+    item = (dfs["item"].filter(c("i_manager_id") == F.lit(1))
+            .select(c("i_item_sk"), c("i_brand_id"), c("i_brand")))
+    j = _star(dfs, 11, 2000).join(item, on="i_item_sk")
+    return (j.group_by(c("d_year"), c("i_brand_id"), c("i_brand"))
+            .agg(F.sum(c("ss_ext_sales_price")).alias("ext_price"))
+            .sort(c("d_year"), c("ext_price"), c("i_brand_id"),
+                  ascending=[True, False, True])
+            .limit(100))
+
+
+def q55(dfs):
+    """Brand revenue for one manager's items, one month (TPC-DS q55)."""
+    import spark_rapids_tpu.functions as F
+    c = F.col
+    item = (dfs["item"].filter(c("i_manager_id") == F.lit(28))
+            .select(c("i_item_sk"), c("i_brand_id"), c("i_brand")))
+    j = _star(dfs, 11, 1999).join(item, on="i_item_sk")
+    return (j.group_by(c("i_brand_id"), c("i_brand"))
+            .agg(F.sum(c("ss_ext_sales_price")).alias("ext_price"))
+            .sort(c("ext_price"), c("i_brand_id"), ascending=[False, True])
+            .limit(100))
+
+
+def q7(dfs):
+    """Average quantities for one demographic + non-event promos (TPC-DS q7)."""
+    import spark_rapids_tpu.functions as F
+    c = F.col
+    cd = (dfs["customer_demographics"]
+          .filter((c("cd_gender") == F.lit("M"))
+                  & (c("cd_marital_status") == F.lit("S"))
+                  & (c("cd_education_status") == F.lit("College")))
+          .select(c("cd_demo_sk").alias("ss_cdemo_sk")))
+    promo = (dfs["promotion"]
+             .filter((c("p_channel_email") == F.lit("N"))
+                     | (c("p_channel_event") == F.lit("N")))
+             .select(c("p_promo_sk").alias("ss_promo_sk")))
+    dd = (dfs["date_dim"].filter(c("d_year") == F.lit(2000))
+          .select(c("d_date_sk").alias("ss_sold_date_sk")))
+    item = dfs["item"].select(c("i_item_sk").alias("ss_item_sk"),
+                              c("i_item_id"))
+    j = (dfs["store_sales"]
+         .join(cd, on="ss_cdemo_sk")
+         .join(promo, on="ss_promo_sk")
+         .join(dd, on="ss_sold_date_sk")
+         .join(item, on="ss_item_sk"))
+    return (j.group_by(c("i_item_id"))
+            .agg(F.avg(c("ss_quantity")).alias("agg1"),
+                 F.avg(c("ss_list_price")).alias("agg2"),
+                 F.avg(c("ss_coupon_amt")).alias("agg3"),
+                 F.avg(c("ss_sales_price")).alias("agg4"))
+            .sort(c("i_item_id"))
+            .limit(100))
+
+
+def q19(dfs):
+    """Brand revenue where customer zip differs from store zip (TPC-DS q19)."""
+    import spark_rapids_tpu.functions as F
+    c = F.col
+    dd = (dfs["date_dim"]
+          .filter((c("d_year") == F.lit(1999)) & (c("d_moy") == F.lit(11)))
+          .select(c("d_date_sk").alias("ss_sold_date_sk")))
+    item = (dfs["item"].filter(c("i_manager_id") == F.lit(8))
+            .select(c("i_item_sk").alias("ss_item_sk"), c("i_brand_id"),
+                    c("i_brand"), c("i_manufact_id")))
+    cust = dfs["customer"].select(c("c_customer_sk").alias("ss_customer_sk"),
+                                  c("c_current_addr_sk").alias("ca_address_sk"))
+    addr = dfs["customer_address"].select(c("ca_address_sk"), c("ca_zip"))
+    store = dfs["store"].select(c("s_store_sk").alias("ss_store_sk"),
+                                c("s_zip"))
+    j = (dfs["store_sales"]
+         .select(c("ss_sold_date_sk"), c("ss_item_sk"), c("ss_customer_sk"),
+                 c("ss_store_sk"), c("ss_ext_sales_price"))
+         .join(dd, on="ss_sold_date_sk")
+         .join(item, on="ss_item_sk")
+         .join(cust, on="ss_customer_sk")
+         .join(addr, on="ca_address_sk")
+         .join(store, on="ss_store_sk")
+         .filter(c("ca_zip") != c("s_zip")))
+    return (j.group_by(c("i_brand_id"), c("i_brand"), c("i_manufact_id"))
+            .agg(F.sum(c("ss_ext_sales_price")).alias("ext_price"))
+            .sort(c("ext_price"), c("i_brand_id"), ascending=[False, True])
+            .limit(100))
+
+
+QUERIES = {"q3": q3, "q42": q42, "q52": q52, "q55": q55, "q7": q7, "q19": q19}
+
+
+# -- independent NumPy oracles ------------------------------------------------
+
+def load_np(paths: dict) -> dict:
+    from spark_rapids_tpu.benchmarks.common import load_np as _load_np
+    return _load_np(paths)
+
+
+def _lex_top(rows, keys, ascending, limit):
+    """Sort list-of-tuples rows by (key index, asc) spec, take limit."""
+    import functools
+
+    def cmp(a, b):
+        for k, asc in zip(keys, ascending):
+            if a[k] != b[k]:
+                lt = a[k] < b[k]
+                return (-1 if lt else 1) if asc else (1 if lt else -1)
+        return 0
+    return sorted(rows, key=functools.cmp_to_key(cmp))[:limit]
+
+
+def _star_np(tb, moy, year=None):
+    """Filtered fact rows: (item_sk, d_year, price) after the date join."""
+    dd = tb["date_dim"]
+    keep_d = dd["d_moy"] == moy
+    if year is not None:
+        keep_d &= dd["d_year"] == year
+    year_of = dict(zip(dd["d_date_sk"][keep_d], dd["d_year"][keep_d]))
+    ss = tb["store_sales"]
+    out = []
+    for dsk, isk, p in zip(ss["ss_sold_date_sk"], ss["ss_item_sk"],
+                           ss["ss_ext_sales_price"]):
+        y = year_of.get(dsk)
+        if y is not None:
+            out.append((isk, int(y), p))
+    return out
+
+
+def _rollup(tb, item_keep, moy, year, key_of):
+    """Sum price grouped by (d_year, key_of(item_row)) over the star spine."""
+    it = tb["item"]
+    idx = {k: i for i, k in enumerate(it["i_item_sk"])}
+    sums = {}
+    for isk, y, p in _star_np(tb, moy, year):
+        i = idx[isk]
+        if not item_keep[i]:
+            continue
+        key = (y,) + key_of(it, i)
+        sums[key] = sums.get(key, 0.0) + p
+    return [key + (v,) for key, v in sums.items()]
+
+
+def _brand_key(it, i):
+    return (int(it["i_brand_id"][i]), it["i_brand"][i])
+
+
+def np_q3(tb):
+    keep = tb["item"]["i_manufact_id"] == 128
+    rows = _rollup(tb, keep, 11, None, _brand_key)
+    return _lex_top(rows, [0, 3, 1], [True, False, True], 100)
+
+
+def np_q42(tb):
+    keep = tb["item"]["i_manager_id"] == 1
+    rows = _rollup(tb, keep, 11, 2000,
+                   lambda it, i: (int(it["i_category_id"][i]),
+                                  it["i_category"][i]))
+    return _lex_top(rows, [3, 0, 1], [False, True, True], 100)
+
+
+def np_q52(tb):
+    keep = tb["item"]["i_manager_id"] == 1
+    rows = _rollup(tb, keep, 11, 2000, _brand_key)
+    return _lex_top(rows, [0, 3, 1], [True, False, True], 100)
+
+
+def np_q55(tb):
+    keep = tb["item"]["i_manager_id"] == 28
+    rows = _rollup(tb, keep, 11, 1999, _brand_key)
+    rows = [(bid, b, v) for (_y, bid, b, v) in rows]
+    return _lex_top(rows, [2, 0], [False, True], 100)
+
+
+def np_q7(tb):
+    cd = tb["customer_demographics"]
+    cd_ok = set(cd["cd_demo_sk"][(cd["cd_gender"] == "M")
+                                 & (cd["cd_marital_status"] == "S")
+                                 & (cd["cd_education_status"] == "College")])
+    pr = tb["promotion"]
+    pr_ok = set(pr["p_promo_sk"][(pr["p_channel_email"] == "N")
+                                 | (pr["p_channel_event"] == "N")])
+    dd = tb["date_dim"]
+    dd_ok = set(dd["d_date_sk"][dd["d_year"] == 2000])
+    it = tb["item"]
+    item_id = {k: v for k, v in zip(it["i_item_sk"], it["i_item_id"])}
+    ss = tb["store_sales"]
+    acc = {}
+    for cdk, prk, ddk, ik, q, lp, ca, sp in zip(
+            ss["ss_cdemo_sk"], ss["ss_promo_sk"], ss["ss_sold_date_sk"],
+            ss["ss_item_sk"], ss["ss_quantity"], ss["ss_list_price"],
+            ss["ss_coupon_amt"], ss["ss_sales_price"]):
+        if cdk in cd_ok and prk in pr_ok and ddk in dd_ok:
+            a = acc.setdefault(item_id[ik], [0, 0.0, 0.0, 0.0, 0.0])
+            a[0] += 1
+            a[1] += q
+            a[2] += lp
+            a[3] += ca
+            a[4] += sp
+    rows = [(iid, a[1] / a[0], a[2] / a[0], a[3] / a[0], a[4] / a[0])
+            for iid, a in acc.items()]
+    return _lex_top(rows, [0], [True], 100)
+
+
+def np_q19(tb):
+    dd = tb["date_dim"]
+    dd_ok = set(dd["d_date_sk"][(dd["d_year"] == 1999)
+                                & (dd["d_moy"] == 11)])
+    it = tb["item"]
+    it_info = {k: (int(b), br, int(m)) for k, b, br, m, mg in zip(
+        it["i_item_sk"], it["i_brand_id"], it["i_brand"],
+        it["i_manufact_id"], it["i_manager_id"]) if mg == 8}
+    cu = tb["customer"]
+    cust_addr = dict(zip(cu["c_customer_sk"], cu["c_current_addr_sk"]))
+    ca = tb["customer_address"]
+    zip_of = dict(zip(ca["ca_address_sk"], ca["ca_zip"]))
+    st = tb["store"]
+    szip = dict(zip(st["s_store_sk"], st["s_zip"]))
+    ss = tb["store_sales"]
+    sums = {}
+    for ddk, ik, ck, sk, p in zip(
+            ss["ss_sold_date_sk"], ss["ss_item_sk"], ss["ss_customer_sk"],
+            ss["ss_store_sk"], ss["ss_ext_sales_price"]):
+        if ddk not in dd_ok or ik not in it_info:
+            continue
+        if zip_of[cust_addr[ck]] == szip[sk]:
+            continue
+        key = it_info[ik]
+        sums[key] = sums.get(key, 0.0) + p
+    rows = [(bid, b, m, s) for (bid, b, m), s in sums.items()]
+    return _lex_top(rows, [3, 0], [False, True], 100)
+
+
+NP_QUERIES = {"q3": np_q3, "q42": np_q42, "q52": np_q52, "q55": np_q55,
+              "q7": np_q7, "q19": np_q19}

@@ -55,19 +55,8 @@ def generate(sf: float, outdir: str, files_per_table: int = 4) -> dict:
     paths = {}
 
     def write(name, table, nfiles=files_per_table):
-        d = os.path.join(outdir, name)
-        paths[name] = d
-        if os.path.isdir(d) and any(f.endswith(".parquet")
-                                    for f in os.listdir(d)):
-            return
-        os.makedirs(d, exist_ok=True)
-        n = table.num_rows
-        per = max((n + nfiles - 1) // nfiles, 1)
-        for i in range(0, max(nfiles, 1)):
-            sl = table.slice(i * per, per)
-            if sl.num_rows == 0 and i > 0:
-                break
-            pq.write_table(sl, os.path.join(d, f"part-{i:04d}.parquet"))
+        from spark_rapids_tpu.benchmarks.common import write_partitioned
+        write_partitioned(outdir, name, table, nfiles, paths)
 
     # customer
     write("customer", pa.table({
@@ -138,8 +127,8 @@ def generate(sf: float, outdir: str, files_per_table: int = 4) -> dict:
 
 
 def load(spark, paths: dict, files_per_partition: int = 2) -> dict:
-    return {name: spark.read_parquet(p, files_per_partition=files_per_partition)
-            for name, p in paths.items()}
+    from spark_rapids_tpu.benchmarks.common import load as _load
+    return _load(spark, paths, files_per_partition)
 
 
 # -- queries (session API) ---------------------------------------------------
@@ -236,20 +225,9 @@ QUERIES = {"q1": q1, "q3": q3, "q5": q5}
 
 # -- independent NumPy oracles (single core, the CPU-Spark stand-in) ---------
 
-def _read_np(path):
-    t = pq.read_table(path)
-    out = {}
-    for name in t.column_names:
-        col = t.column(name)
-        if pa.types.is_date32(col.type):
-            out[name] = col.cast(pa.int32()).to_numpy()
-        else:
-            out[name] = col.to_numpy(zero_copy_only=False)
-    return out
-
-
 def load_np(paths: dict) -> dict:
-    return {name: _read_np(p) for name, p in paths.items()}
+    from spark_rapids_tpu.benchmarks.common import load_np as _load_np
+    return _load_np(paths)
 
 
 def np_q1(tb):

@@ -1,0 +1,55 @@
+"""TPC-DS subset end-to-end through the session API vs independent NumPy
+oracles (BASELINE.md config-3; reference qa_nightly_select_test role)."""
+
+import pytest
+
+from spark_rapids_tpu.benchmarks import tpcds
+from spark_rapids_tpu.session import TpuSession
+
+
+@pytest.fixture(scope="module")
+def data(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tpcds")
+    paths = tpcds.generate(0.012, str(d))
+    spark = TpuSession()
+    return tpcds.load(spark, paths), tpcds.load_np(paths)
+
+
+def _rows(df):
+    return [tuple(r.values()) for r in df.collect().to_pylist()]
+
+
+def _check(got, exp, float_cols):
+    assert len(got) == len(exp), (len(got), len(exp))
+    for g, e in zip(got, exp):
+        assert len(g) == len(e), (g, e)
+        for i, (a, b) in enumerate(zip(g, e)):
+            if i in float_cols:
+                assert a == pytest.approx(b, rel=1e-9), (g, e)
+            else:
+                assert a == b, (g, e)
+
+
+@pytest.mark.parametrize("name,float_cols", [
+    ("q3", {3}), ("q42", {3}), ("q52", {3}), ("q55", {2}),
+    ("q7", {1, 2, 3, 4}), ("q19", {3}),
+])
+def test_tpcds_query_matches_oracle(data, name, float_cols):
+    dfs, tb = data
+    got = _rows(tpcds.QUERIES[name](dfs))
+    exp = [tuple(r) for r in tpcds.NP_QUERIES[name](tb)]
+    assert exp, "vacuous test: oracle returned no rows"
+    _check(got, exp, float_cols)
+
+
+def test_tpcds_q3_over_mesh(tmp_path):
+    """Config-3's defining property: the subset also runs with exchanges as
+    all_to_all collectives over the virtual 8-device mesh."""
+    paths = tpcds.generate(0.003, str(tmp_path))
+    mesh = TpuSession({"spark.rapids.tpu.mesh.enabled": "true",
+                       "spark.rapids.tpu.mesh.devices": "8"})
+    dfs = tpcds.load(mesh, paths)
+    got = _rows(tpcds.q3(dfs))
+    exp = [tuple(r) for r in tpcds.np_q3(tpcds.load_np(paths))]
+    assert exp, "vacuous test: oracle returned no rows"
+    _check(got, exp, {3})
