@@ -311,6 +311,18 @@ OPTIMIZER_TRANSFER_ROW_COST = conf(
     "Dual cost model: seconds per row crossing a host↔device boundary "
     "(the reference's transitionCost per-byte analog)").double_conf(8e-9)
 
+CSV_DEVICE_DECODE = conf("spark.rapids.tpu.sql.csv.deviceDecode.enabled").doc(
+    "Parse in-scope CSV files on device (host boundary scan + device digit "
+    "kernels, io/csv_native.py); out-of-scope files use the arrow host "
+    "reader (reference decodes CSV via cudf, GpuBatchScanExec)"
+).boolean_conf(True)
+
+CSV_READ_FLOATS = conf("spark.rapids.tpu.sql.csv.read.float.enabled").doc(
+    "Allow float/double CSV columns on the device parse path; the final "
+    "power-of-ten division can differ from Spark's strtod by 1 ulp "
+    "(reference spark.rapids.sql.csv.read.float.enabled, same default)"
+).boolean_conf(False)
+
 PALLAS_ENABLED = conf("spark.rapids.tpu.sql.pallas.enabled").doc(
     "Route the string murmur3 hash and parquet bit-unpack through the "
     "hand-written Pallas TPU kernels (ops/pallas_kernels.py); when false "

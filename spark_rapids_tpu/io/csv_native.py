@@ -1,0 +1,141 @@
+"""Device CSV scan stage one: vectorized host boundary scan + device parse.
+
+Reference: GpuBatchScanExec / CSVPartitionReader hand raw CSV bytes to
+cudf's GPU parser (SURVEY.md #25). TPU realization mirrors the parquet
+stage-one split (io/parquet_native.py): field BOUNDARIES are metadata —
+one vectorized numpy pass finds delimiters/newlines and validates the
+row shape — while the BULK work (digit bytes → numbers) runs on device
+(ops/csv_decode.py).
+
+Scope (stage one): header optional (schema fields are matched to header
+columns BY NAME, like the host reader), single-byte delimiter, '\\n' line
+ends, no quoting/escapes, int32/int64/float64 columns on device (floats
+conf-gated; exponent/inf/nan notation in the body falls back). The whole
+scope decision happens in ONE host pass per file (`try_scan_for_device`)
+BEFORE the device iterator is committed — out-of-scope files return None
+and take the pyarrow host reader, the same per-type conservatism as the
+reference's spark.rapids.sql.csv.read.*.enabled confs."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+
+
+class CsvShape:
+    """Host-scanned structure of one CSV file, ready for device parsing."""
+
+    def __init__(self, data: np.ndarray, n_rows: int, starts: np.ndarray,
+                 lens: np.ndarray, col_of: dict):
+        self.data = data          # raw bytes as uint8 (device-bound)
+        self.n_rows = n_rows
+        self.starts = starts      # (n_rows, n_file_cols) int32
+        self.lens = lens          # (n_rows, n_file_cols) int32
+        self.col_of = col_of      # schema field name → file column index
+
+
+def column_in_scope(dtype, allow_floats: bool) -> bool:
+    if isinstance(dtype, T.DoubleType):
+        return allow_floats
+    return isinstance(dtype, (T.IntegerType, T.LongType))
+
+
+def try_scan_for_device(path: str, schema, delimiter: str = ",",
+                        header: bool = True,
+                        allow_floats: bool = False) -> CsvShape | None:
+    """One host pass deciding scope AND producing the field offsets.
+    Returns None for anything out of stage-one scope (caller uses the
+    pyarrow host reader) — never raises for well-formed-but-unsupported
+    content, so the device iterator is only committed when it can finish."""
+    if schema is None or not schema.fields:
+        return None
+    if not all(column_in_scope(f.data_type, allow_floats)
+               for f in schema.fields):
+        return None
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError:
+        return None
+    if b'"' in raw or b"\r" in raw:
+        return None
+    if raw and not raw.endswith(b"\n"):
+        raw += b"\n"
+    data = np.frombuffer(raw, dtype=np.uint8)
+    delim_byte = delimiter.encode()[0]
+
+    start = 0
+    if header:
+        first_nl = raw.find(b"\n")
+        if first_nl < 0:
+            return None
+        names = raw[:first_nl].decode("utf-8", "replace").split(delimiter)
+        start = first_nl + 1
+        col_of = {}
+        for f in schema.fields:
+            if f.name not in names:
+                return None       # host reader owns missing-column handling
+            col_of[f.name] = names.index(f.name)
+        n_file_cols = len(names)
+    else:
+        n_file_cols = len(schema.fields)
+        col_of = {f.name: i for i, f in enumerate(schema.fields)}
+
+    body = data[start:]
+    is_delim = body == delim_byte
+    is_nl = body == ord("\n")
+    n_rows = int(is_nl.sum())
+    if n_rows == 0:
+        return CsvShape(data, 0, np.zeros((0, n_file_cols), np.int32),
+                        np.zeros((0, n_file_cols), np.int32), col_of)
+    # float-notation gate on the BODY only (the header may legally contain
+    # e/n/i); exponent, nan and inf spellings need host strtod
+    if any(isinstance(f.data_type, T.DoubleType) for f in schema.fields):
+        lowered = body | np.uint8(0x20)   # ascii to-lower
+        if (np.isin(lowered, np.frombuffer(b"eni", np.uint8))).any():
+            return None
+    bounds = np.flatnonzero(is_delim | is_nl).astype(np.int64)
+    if len(bounds) != n_rows * n_file_cols:
+        return None               # ragged rows / embedded delimiters
+    b = bounds.reshape(n_rows, n_file_cols)
+    if not is_nl[b[:, -1]].all():
+        return None               # a row ends in a delimiter, not newline
+    prev = np.empty_like(b)
+    prev[:, 1:] = b[:, :-1]
+    prev[0, 0] = -1
+    prev[1:, 0] = b[:-1, -1]
+    starts = (prev + 1 + start).astype(np.int32)
+    lens = (b - prev - 1).astype(np.int32)
+    return CsvShape(data, n_rows, starts, lens, col_of)
+
+
+def decode_shape_device(shape: CsvShape, schema, capacity_fn):
+    """Parse a scanned file fully on device; returns a ColumnarBatch."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.columnar.vector import TpuColumnVector
+    from spark_rapids_tpu.ops import csv_decode as CD
+
+    n = shape.n_rows
+    cap = capacity_fn(max(n, 1))
+    data_d = jnp.asarray(shape.data)
+    cols = []
+    for f in schema.fields:
+        j = shape.col_of[f.name]
+        starts = np.full(cap, 0, np.int32)
+        lens = np.full(cap, -1, np.int32)
+        if n:
+            starts[:n] = shape.starts[:, j]
+            lens[:n] = shape.lens[:, j]
+        s_d, l_d = jnp.asarray(starts), jnp.asarray(lens)
+        if isinstance(f.data_type, T.LongType):
+            vals, valid = CD.parse_int64(data_d, s_d, l_d, cap)
+        elif isinstance(f.data_type, T.IntegerType):
+            vals, valid = CD.parse_int32(data_d, s_d, l_d, cap)
+        else:
+            vals, valid = CD.parse_float64(data_d, s_d, l_d, cap)
+        default = jnp.asarray(f.data_type.default_value(), vals.dtype)
+        vals = jnp.where(valid, vals, default)
+        cols.append(TpuColumnVector(f.data_type, vals, valid))
+    return ColumnarBatch(cols, n, schema)

@@ -255,6 +255,39 @@ class FileSourceScanExec(TpuExec):
                             path, rg, self.output, cols, pf=pf)
         return it()
 
+    def _csv_device_decode_batches(self, split):
+        """Whole-file device CSV parse for in-scope files (io/csv_native.py).
+        ALL scope checks run up front in one host pass per file — if any
+        file is out of scope the whole partition takes the host arrow
+        reader (reference gates per type the same way); the committed
+        device iterator can always finish."""
+        from spark_rapids_tpu.io import csv_native as CN
+        node = self.node
+        if node.fmt != "csv" or node.pushed_filter is not None:
+            return None
+        part = node.partitions[split]
+        if part.partition_values:
+            return None
+        allow_f = self.conf.get(CFG.CSV_READ_FLOATS)
+        schema = self.output
+        rdr = node.reader
+        shapes = []
+        for path in part.paths:
+            shape = CN.try_scan_for_device(path, schema, rdr.delimiter,
+                                           rdr.header, allow_f)
+            if shape is None:
+                return None
+            shapes.append(shape)
+        from spark_rapids_tpu.columnar.vector import bucket_capacity
+
+        def it():
+            for shape in shapes:
+                acquire_semaphore(self.metrics)
+                with trace_range("FileScan.csvdevdecode", self._scan_time):
+                    yield CN.decode_shape_device(shape, schema,
+                                                 bucket_capacity)
+        return it()
+
     def execute_partition(self, split):
         conf = self.conf
         strategy = conf.get(CFG.PARQUET_READER_TYPE).upper()
@@ -264,6 +297,11 @@ class FileSourceScanExec(TpuExec):
         if conf.get(CFG.PARQUET_DEVICE_DECODE):
             dev_it = self._device_decode_batches(
                 split, batch_rows, conf.get(CFG.MAX_READER_BATCH_SIZE_BYTES))
+            if dev_it is not None:
+                return self.wrap_output(dev_it)
+
+        if conf.get(CFG.CSV_DEVICE_DECODE):
+            dev_it = self._csv_device_decode_batches(split)
             if dev_it is not None:
                 return self.wrap_output(dev_it)
 

@@ -1,0 +1,104 @@
+"""Device CSV field parsing — digits to numbers as one fused XLA program.
+
+Reference: the reference decodes CSV on the GPU via cudf's CSV parser
+(GpuBatchScanExec.scala / CSVPartitionReader, SURVEY.md #25), gated per
+type by spark.rapids.sql.csv.read.*.enabled because device parsing is
+more lenient than Spark's. TPU stage one: the host computes field
+boundaries with vectorized numpy (io/csv_native.py — bytes→offsets is
+metadata, same split as the parquet stage-one design) and the device
+turns digit bytes into values: a gather of (row, char) byte matrices,
+then a static-K horner scan — no scalar loops, one jitted program per
+column batch.
+
+Unlike cudf's lenient parser, malformed fields here parse to NULL (closer
+to Spark); doubles divide by a power of ten at the end, which can differ
+from Spark's strtod by 1 ulp on long fractions — hence the off-by-default
+conf for floating point, mirroring the reference's gating."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+MAX_INT_CHARS = 20    # -9223372036854775808
+MAX_DBL_CHARS = 26
+
+
+def _gather_chars(data: jnp.ndarray, starts: jnp.ndarray, K: int):
+    """(n,) starts into (n, K) byte matrix (uint8), clipped gather."""
+    idx = starts[:, None] + jnp.arange(K, dtype=jnp.int32)[None, :]
+    return data[jnp.clip(idx, 0, data.shape[0] - 1)]
+
+
+def parse_int64(data: jnp.ndarray, starts: jnp.ndarray, lens: jnp.ndarray,
+                capacity: int):
+    """Parse int64 fields. Empty or malformed → null. data: (bytes,) uint8
+    on device; starts/lens: (capacity,) int32 (padded rows have len<0)."""
+    chars = _gather_chars(data, starts, MAX_INT_CHARS)  # (n, K)
+    j = jnp.arange(MAX_INT_CHARS, dtype=jnp.int32)[None, :]
+    in_field = j < lens[:, None]
+    neg = chars[:, 0] == ord("-")
+    signed = neg | (chars[:, 0] == ord("+"))
+    digit_pos = in_field & (j >= signed[:, None].astype(jnp.int32))
+    d = chars.astype(jnp.int32) - ord("0")
+    is_digit = (d >= 0) & (d <= 9)
+    ok = jnp.all(~digit_pos | is_digit, axis=1)
+    # at least one digit, sign alone is malformed, over-long fields null
+    # (a valid long is at most sign + 19 digits)
+    ndigits = jnp.sum(digit_pos, axis=1)
+    ok = ok & (ndigits > 0) & (lens >= 1) & (lens <= MAX_INT_CHARS)
+    # horner over static columns; accumulate NEGATIVE to hold Long.MIN,
+    # detecting wrap like Long.parseLong: val*10 - d < MIN ⇒ overflow
+    LIM = jnp.int64(-922337203685477580)  # MIN // 10 (toward zero)
+    val = jnp.zeros(chars.shape[0], jnp.int64)
+    overflow = jnp.zeros(chars.shape[0], jnp.bool_)
+    for col in range(MAX_INT_CHARS):
+        take = digit_pos[:, col]
+        dj = d[:, col].astype(jnp.int64)
+        overflow = overflow | (take & ((val < LIM) | ((val == LIM) & (dj > 8))))
+        val = jnp.where(take, val * 10 - dj, val)
+    # positive Long.MAX+1 case: -val wraps back to MIN
+    overflow = overflow | (~neg & (val == jnp.iinfo(jnp.int64).min))
+    val = jnp.where(neg, val, -val)
+    valid = ok & ~overflow & (lens >= 0)
+    empty = lens == 0          # Spark: empty field → null
+    valid = valid & ~empty
+    return jnp.where(valid, val, 0), valid
+
+
+def parse_float64(data: jnp.ndarray, starts: jnp.ndarray, lens: jnp.ndarray,
+                  capacity: int):
+    """Parse plain-decimal doubles (no exponent/inf/nan — those columns stay
+    on host; see io/csv_native.py scoping). 1-ulp divergence possible."""
+    chars = _gather_chars(data, starts, MAX_DBL_CHARS)
+    j = jnp.arange(MAX_DBL_CHARS, dtype=jnp.int32)[None, :]
+    in_field = j < lens[:, None]
+    neg = chars[:, 0] == ord("-")
+    signed = neg | (chars[:, 0] == ord("+"))
+    d = chars.astype(jnp.int32) - ord("0")
+    is_digit = (d >= 0) & (d <= 9)
+    is_dot = chars == ord(".")
+    body = in_field & (j >= signed[:, None].astype(jnp.int32))
+    ok = jnp.all(~body | is_digit | is_dot, axis=1)
+    ok = ok & (jnp.sum(body & is_dot, axis=1) <= 1)
+    ok = ok & (jnp.sum(body & is_digit, axis=1) > 0)
+    ok = ok & (lens <= MAX_DBL_CHARS)   # no silent truncation: null instead
+    mant = jnp.zeros(chars.shape[0], jnp.float64)
+    frac_digits = jnp.zeros(chars.shape[0], jnp.int32)
+    seen_dot = jnp.zeros(chars.shape[0], jnp.bool_)
+    for col in range(MAX_DBL_CHARS):
+        active = body[:, col]
+        dig = active & is_digit[:, col]
+        mant = jnp.where(dig, mant * 10.0 + d[:, col], mant)
+        frac_digits = jnp.where(dig & seen_dot, frac_digits + 1, frac_digits)
+        seen_dot = seen_dot | (active & is_dot[:, col])
+    val = mant / jnp.power(jnp.float64(10.0), frac_digits.astype(jnp.float64))
+    val = jnp.where(neg, -val, val)
+    valid = ok & (lens > 0)
+    return jnp.where(valid, val, 0.0), valid
+
+
+def parse_int32(data, starts, lens, capacity):
+    v, m = parse_int64(data, starts, lens, capacity)
+    in_range = (v >= -(2 ** 31)) & (v < 2 ** 31)
+    return v.astype(jnp.int32), m & in_range

@@ -238,3 +238,148 @@ def test_write_mode_append_no_collision(tmp_path, mixed_table):
     write_columnar(src, out, "parquet", mode="append")
     back = FileScanNode(out, "parquet").collect_host()
     assert back.num_rows == 2 * mixed_table.num_rows
+
+
+# -- device CSV decode (stage one: io/csv_native.py + ops/csv_decode.py) ------
+
+def _write_csv(tmp_path, text, name="t.csv"):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+def test_csv_device_decode_ints_matches_host(tmp_path):
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.session import TpuSession
+    text = "a,b\n1,10\n-5,9223372036854775807\n,42\n8,-9223372036854775808\n"
+    path = _write_csv(tmp_path, text)
+    schema = T.StructType([T.StructField("a", T.LONG), T.StructField("b", T.LONG)])
+
+    on = TpuSession().read_csv(path, schema=schema).collect()
+    off = TpuSession({"spark.rapids.tpu.sql.csv.deviceDecode.enabled": "false"}
+                     ).read_csv(path, schema=schema).collect()
+    assert on["a"].to_pylist() == off["a"].to_pylist() == [1, -5, None, 8]
+    assert on["b"].to_pylist() == off["b"].to_pylist() == \
+        [10, 9223372036854775807, 42, -9223372036854775808]
+
+    # '+7' parses like Spark (Long.parseLong) on device; pyarrow's host
+    # reader rejects it, so it is asserted on the device path only
+    p2 = _write_csv(tmp_path, "a\n+7\n", name="plus.csv")
+    on2 = TpuSession().read_csv(
+        p2, schema=T.StructType([T.StructField("a", T.LONG)])).collect()
+    assert on2["a"].to_pylist() == [7]
+
+
+def test_csv_device_decode_malformed_is_null(tmp_path):
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.session import TpuSession
+    text = "a\n12\nx9\n--3\n+\n8\n"
+    path = _write_csv(tmp_path, text)
+    schema = T.StructType([T.StructField("a", T.LONG)])
+    out = TpuSession().read_csv(path, schema=schema).collect()
+    assert out["a"].to_pylist() == [12, None, None, None, 8]
+
+
+def test_csv_device_decode_doubles_gated(tmp_path):
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.session import TpuSession
+    text = "x,y\n1.5,2\n-0.25,7\n,0\n3.,1\n"
+    path = _write_csv(tmp_path, text)
+    schema = T.StructType([T.StructField("x", T.DOUBLE), T.StructField("y", T.LONG)])
+    # default: float columns keep the whole file on the host reader
+    out = TpuSession().read_csv(path, schema=schema).collect()
+    assert out["x"].to_pylist() == [1.5, -0.25, None, 3.0]
+    # conf on: device parse, plain decimals are exact
+    on = TpuSession({"spark.rapids.tpu.sql.csv.read.float.enabled": "true"}
+                    ).read_csv(path, schema=schema).collect()
+    assert on["x"].to_pylist() == [1.5, -0.25, None, 3.0]
+    assert on["y"].to_pylist() == [2, 7, 0, 1]
+
+
+def test_csv_device_decode_fallback_scope(tmp_path):
+    """Quotes, exponents, ragged rows → host path, same results."""
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.session import TpuSession
+    schema = T.StructType([T.StructField("x", T.DOUBLE)])
+    path = _write_csv(tmp_path, "x\n1e3\n2.5\n", name="e.csv")
+    out = TpuSession({"spark.rapids.tpu.sql.csv.read.float.enabled": "true"}
+                     ).read_csv(path, schema=schema).collect()
+    assert out["x"].to_pylist() == [1000.0, 2.5]
+
+    schema2 = T.StructType([T.StructField("s", T.STRING)])
+    path2 = _write_csv(tmp_path, 's\n"a,b"\nplain\n', name="q.csv")
+    out2 = TpuSession().read_csv(path2, schema=schema2).collect()
+    assert out2["s"].to_pylist() == ["a,b", "plain"]
+
+
+def test_csv_device_decode_equivalence_fuzz(tmp_path):
+    import numpy as np
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.session import TpuSession
+    rng = np.random.default_rng(5)
+    n = 500
+    a = rng.integers(-10**12, 10**12, n)
+    rows = ["a,b"]
+    for i in range(n):
+        av = "" if rng.random() < 0.1 else str(a[i])
+        bv = str(rng.integers(-2**31, 2**31 - 1))
+        rows.append(f"{av},{bv}")
+    path = _write_csv(tmp_path, "\n".join(rows) + "\n", name="f.csv")
+    schema = T.StructType([T.StructField("a", T.LONG), T.StructField("b", T.INT)])
+    on = TpuSession().read_csv(path, schema=schema).collect()
+    off = TpuSession({"spark.rapids.tpu.sql.csv.deviceDecode.enabled": "false"}
+                     ).read_csv(path, schema=schema).collect()
+    assert on["a"].to_pylist() == off["a"].to_pylist()
+    assert on["b"].to_pylist() == off["b"].to_pylist()
+
+
+def test_csv_device_decode_header_name_mapping(tmp_path):
+    """Schema order != file header order: fields map BY NAME like the host
+    reader, never by position."""
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.session import TpuSession
+    path = _write_csv(tmp_path, "b,a\n1,2\n3,4\n", name="swap.csv")
+    schema = T.StructType([T.StructField("a", T.LONG), T.StructField("b", T.LONG)])
+    out = TpuSession().read_csv(path, schema=schema).collect()
+    assert out["a"].to_pylist() == [2, 4]
+    assert out["b"].to_pylist() == [1, 3]
+
+
+def test_csv_device_decode_overflow_and_overlong(tmp_path):
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.session import TpuSession
+    text = ("a\n9223372036854775807\n9223372036854775808\n"
+            "-9223372036854775808\n-9223372036854775809\n"
+            "123456789012345678901234567\n7\n")
+    path = _write_csv(tmp_path, text, name="ovf.csv")
+    schema = T.StructType([T.StructField("a", T.LONG)])
+    out = TpuSession().read_csv(path, schema=schema).collect()
+    assert out["a"].to_pylist() == [9223372036854775807, None,
+                                    -9223372036854775808, None, None, 7]
+
+
+def test_csv_quoted_file_falls_back_with_int_schema(tmp_path):
+    """A quoted field anywhere sends the whole file to the host reader even
+    when every column type is device-parseable."""
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.session import TpuSession
+    path = _write_csv(tmp_path, 'a\n"5"\n6\n', name="qint.csv")
+    schema = T.StructType([T.StructField("a", T.LONG)])
+    out = TpuSession().read_csv(path, schema=schema).collect()
+    assert out["a"].to_pylist() == [5, 6]
+
+
+def test_csv_float_gate_ignores_header_letters(tmp_path):
+    """'e' in a header name must not disqualify the device float path."""
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.session import TpuSession
+    path = _write_csv(tmp_path, "price,value\n1.5,2.25\n", name="hdr.csv")
+    schema = T.StructType([T.StructField("price", T.DOUBLE),
+                           T.StructField("value", T.DOUBLE)])
+    from spark_rapids_tpu.io import csv_native as CN
+    shape = CN.try_scan_for_device(path, schema, ",", True, True)
+    assert shape is not None  # in scope despite 'e' in 'price'/'value'
+    out = TpuSession({"spark.rapids.tpu.sql.csv.read.float.enabled": "true"}
+                     ).read_csv(path, schema=schema).collect()
+    assert out["price"].to_pylist() == [1.5]
+    assert out["value"].to_pylist() == [2.25]
