@@ -311,6 +311,18 @@ OPTIMIZER_TRANSFER_ROW_COST = conf(
     "Dual cost model: seconds per row crossing a host↔device boundary "
     "(the reference's transitionCost per-byte analog)").double_conf(8e-9)
 
+ADAPTIVE_COALESCE_ENABLED = conf(
+    "spark.rapids.tpu.sql.adaptive.coalescePartitions.enabled").doc(
+    "After a shuffle map stage materializes, merge contiguous small reduce "
+    "partitions into advisory-sized reader partitions (AQE; reference "
+    "GpuCustomShuffleReaderExec + Spark CoalesceShufflePartitions)"
+).boolean_conf(True)
+
+ADVISORY_PARTITION_BYTES = conf(
+    "spark.rapids.tpu.sql.adaptive.advisoryPartitionSizeInBytes").doc(
+    "Target size of a coalesced post-shuffle partition "
+    "(Spark spark.sql.adaptive.advisoryPartitionSizeInBytes)").bytes_conf("64m")
+
 CSV_DEVICE_DECODE = conf("spark.rapids.tpu.sql.csv.deviceDecode.enabled").doc(
     "Parse in-scope CSV files on device (host boundary scan + device digit "
     "kernels, io/csv_native.py); out-of-scope files use the arrow host "

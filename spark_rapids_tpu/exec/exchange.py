@@ -145,23 +145,32 @@ class ShuffleExchangeExec(TpuExec):
                 self._invalidate_map_stage()
                 self._ensure_map_stage()
 
+    def account_read_done(self):
+        """One reduce partition finished (drained OR abandoned unopened);
+        the last one frees the shuffle blocks — the reference keeps them
+        until Spark unregisters the shuffle; our local scheduler reads each
+        partition exactly once."""
+        with self._reads_lock:
+            self._reads_left -= 1
+            done = self._reads_left == 0
+        if done:
+            ShuffleBlockStore.get().unregister_shuffle(self._shuffle_id)
+
+    def read_reduce(self, pid):
+        """Stream ONE reduce partition with recompute + cleanup accounting;
+        shared by the direct reader and AdaptiveShuffleReaderExec. Each pid
+        must be consumed (or closed) exactly once across all readers."""
+        try:
+            yield from self._read_with_recompute(pid)
+        finally:
+            self.account_read_done()
+
     def _reader(self, split):
-        store = ShuffleBlockStore.get()
         # post-shuffle coalesce to target batch size (reference
         # GpuShuffleCoalesceExec inserted by GpuTransitionOverrides:57-63)
-        it = self._read_with_recompute(split)
         goal = TargetSize(self.conf.batch_size_bytes)
-        try:
-            yield from coalesce_iterator(it, goal, self.metrics)
-        finally:
-            # free shuffle blocks once every reduce partition has been drained OR
-            # abandoned (limit/early close) — the reference keeps them until Spark
-            # unregisters the shuffle; our local scheduler reads each partition once
-            with self._reads_lock:
-                self._reads_left -= 1
-                done = self._reads_left == 0
-            if done:
-                store.unregister_shuffle(self._shuffle_id)
+        yield from coalesce_iterator(self.read_reduce(split), goal,
+                                     self.metrics)
 
     def execute_partition(self, split):
         # drop this task's permit before (possibly) blocking on the map stage —
@@ -176,3 +185,83 @@ class ShuffleExchangeExec(TpuExec):
 
     def args_string(self):
         return f"{type(self.partitioner).__name__}({self.partitioner.num_partitions})"
+
+
+class AdaptiveShuffleReaderExec(TpuExec):
+    """AQE coalescing shuffle reader (reference GpuCustomShuffleReaderExec +
+    Spark's CoalesceShufflePartitions): after the map stage materializes,
+    contiguous small reduce partitions merge into reader partitions of
+    roughly `adaptive.advisoryPartitionSizeInBytes`, so a skewed or
+    over-partitioned shuffle doesn't pay per-partition read overhead.
+
+    The coalescing decision is EXECUTION-time (the AQE stage barrier):
+    `num_partitions` stays the exchange's static count so plan conversion
+    never triggers the upstream query; splits beyond the merged spec list
+    simply come up empty and account for nothing.
+
+    Only planned above exchanges with a single consumer (aggregate/window):
+    merging changes the row distribution across splits, which would break
+    the co-partitioning contract between the two sides of a shuffled join."""
+
+    def __init__(self, exchange: ShuffleExchangeExec, conf=None):
+        super().__init__(exchange, conf=conf)
+        self._specs: list | None = None
+        self._spec_lock = threading.Lock()
+
+    @property
+    def output(self):
+        return self.child.output
+
+    @property
+    def num_partitions(self):
+        # static: asking must NOT run the map stage (the planner asks during
+        # conversion); empty tail splits are cheap no-op tasks
+        return self.child.num_partitions
+
+    def _ensure_specs(self):
+        if self._specs is not None:
+            return self._specs
+        ex = self.child
+        ex._ensure_map_stage()        # own double-checked synchronization
+        with self._spec_lock:
+            if self._specs is None:
+                n = ex.partitioner.num_partitions
+                sizes = ShuffleBlockStore.get().partition_sizes(
+                    ex._shuffle_id, n)
+                target = self.conf.get(C.ADVISORY_PARTITION_BYTES)
+                specs, cur, cur_bytes = [], [], 0
+                for pid in range(n):
+                    if cur and cur_bytes + sizes[pid] > target:
+                        specs.append(cur)
+                        cur, cur_bytes = [], 0
+                    cur.append(pid)
+                    cur_bytes += sizes[pid]
+                if cur:
+                    specs.append(cur)
+                self._specs = specs
+        return self._specs
+
+    def execute_partition(self, split):
+        ex = self.child
+        goal = TargetSize(self.conf.batch_size_bytes)
+
+        def it():
+            specs = self._ensure_specs()
+            pids = specs[split] if split < len(specs) else []
+            opened = 0
+            try:
+                for pid in pids:
+                    opened += 1
+                    yield from ex.read_reduce(pid)   # accounts for itself
+            finally:
+                # early close mid-spec (limit): the open pid's read_reduce
+                # already accounted; the never-opened tail must too, or the
+                # shuffle blocks leak
+                for _ in pids[opened:]:
+                    ex.account_read_done()
+        return self.wrap_output(coalesce_iterator(it(), goal, self.metrics))
+
+    def args_string(self):
+        specs = self._specs
+        n = len(specs) if specs is not None else "?"
+        return f"coalesced={n}"

@@ -477,17 +477,24 @@ def _register_all():
         from spark_rapids_tpu.distributed.exchange import mesh_devices
         return len(mesh_devices(conf))
 
-    def _hash_exchange(keys, child, conf):
+    def _hash_exchange(keys, child, conf, adaptive=False):
         """Hash exchange: mesh collective when configured, threaded block-store
         otherwise (reference GpuShuffleExchangeExec with/without the UCX
-        RapidsShuffleManager)."""
+        RapidsShuffleManager). `adaptive` wraps the exchange in the AQE
+        coalescing reader — only valid for single-consumer exchanges
+        (aggregate/window), never the co-partitioned sides of a join."""
+        from spark_rapids_tpu import config as CFG
         n_mesh = _mesh_n(conf)
         if n_mesh > 1:
             from spark_rapids_tpu.distributed.exchange import MeshExchangeExec
             return MeshExchangeExec(SP.HashPartitioner(keys, n_mesh), child,
                                     conf=conf)
-        return ShuffleExchangeExec(
+        ex = ShuffleExchangeExec(
             SP.HashPartitioner(keys, child.num_partitions), child, conf=conf)
+        if adaptive and conf.get(CFG.ADAPTIVE_COALESCE_ENABLED):
+            from spark_rapids_tpu.exec.exchange import AdaptiveShuffleReaderExec
+            return AdaptiveShuffleReaderExec(ex, conf=conf)
+        return ex
 
     def conv_scan(meta, kids):
         return XB.ArrowScanExec(meta.node.partitions, meta.node.output,
@@ -539,7 +546,7 @@ def _register_all():
         nkeys = len(n.group_exprs)
         key_names = [f.name for f in partial.output][:nkeys]
         keys = [E.col(k) for k in key_names]
-        ex_node = _hash_exchange(keys, partial, meta.conf)
+        ex_node = _hash_exchange(keys, partial, meta.conf, adaptive=True)
         return XA.HashAggregateExec(keys, n.agg_exprs, ex_node, mode=XA.FINAL,
                                     conf=meta.conf)
 
@@ -665,7 +672,7 @@ def _register_all():
         if child.num_partitions > 1:
             if we0.spec.partition_by:
                 child = _hash_exchange(list(we0.spec.partition_by), child,
-                                       meta.conf)
+                                       meta.conf, adaptive=True)
             else:
                 child = XS._GatherAllExec(child, conf=meta.conf)
         return WindowExec(n.window_exprs, child, conf=meta.conf)

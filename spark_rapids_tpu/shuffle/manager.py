@@ -86,6 +86,19 @@ class ShuffleBlockStore:
             else:
                 yield blob.get_batch()
 
+    def partition_sizes(self, shuffle_id: int, num_partitions: int) -> list:
+        """Bytes per reduce partition — the map-output statistics AQE's
+        coalescing decision reads (Spark MapOutputStatistics analog)."""
+        with self._lock:
+            parts = self._blocks.get(shuffle_id, {})
+            out = []
+            for pid in range(num_partitions):
+                total = 0
+                for b in parts.get(pid, ()):
+                    total += len(b) if isinstance(b, bytes) else b.size
+                out.append(total)
+            return out
+
     def unregister_shuffle(self, shuffle_id: int):
         with self._lock:
             parts = self._blocks.pop(shuffle_id, {})

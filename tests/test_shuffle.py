@@ -126,3 +126,115 @@ def lit_long(v):
     from spark_rapids_tpu.expr.core import Literal
     from spark_rapids_tpu import types as TT
     return Literal(v, TT.LONG)
+
+
+def test_adaptive_reader_coalesces_small_partitions():
+    """AQE reader (GpuCustomShuffleReaderExec analog): many tiny reduce
+    partitions merge into few advisory-sized reader partitions, results
+    unchanged."""
+    import pyarrow as pa
+    import numpy as np
+    from spark_rapids_tpu.exec.basic import ArrowScanExec
+    from spark_rapids_tpu.exec.exchange import (AdaptiveShuffleReaderExec,
+                                                ShuffleExchangeExec)
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.shuffle.partitioning import HashPartitioner
+    from spark_rapids_tpu.expr.core import col
+
+    rng = np.random.default_rng(3)
+    tables = [pa.table({"k": pa.array(rng.integers(0, 100, 200)),
+                        "v": pa.array(np.arange(200) + i * 1000)})
+              for i in range(3)]
+    scan = ArrowScanExec(tables)
+    conf = RapidsConf()
+    ex = ShuffleExchangeExec(HashPartitioner([col("k")], 32), scan, conf=conf)
+    reader = AdaptiveShuffleReaderExec(ex, conf=conf)
+    # static count: asking must NOT run the map stage (the planner asks
+    # during conversion; the AQE barrier is execution-time)
+    assert reader.num_partitions == 32
+    assert not ex._map_done.is_set()
+    rows, nonempty = [], 0
+    for split in range(reader.num_partitions):
+        got = [b for b in reader.execute_partition(split)]
+        nonempty += bool(sum(b.num_rows for b in got))
+        for b in got:
+            rows.extend(b.to_arrow().to_pylist())
+    assert 1 <= len(reader._ensure_specs()) < 32   # tiny blocks merged
+    assert nonempty == len(reader._ensure_specs())
+    expect = [r for t in tables for r in t.to_pylist()]
+    key = lambda r: (r["k"], r["v"])  # noqa: E731
+    assert sorted(rows, key=key) == sorted(expect, key=key)
+
+
+def test_adaptive_reader_respects_advisory_size():
+    import pyarrow as pa
+    import numpy as np
+    from spark_rapids_tpu.exec.basic import ArrowScanExec
+    from spark_rapids_tpu.exec.exchange import (AdaptiveShuffleReaderExec,
+                                                ShuffleExchangeExec)
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.shuffle.partitioning import HashPartitioner
+    from spark_rapids_tpu.expr.core import col
+
+    t = pa.table({"k": pa.array(np.arange(4000) % 16),
+                  "v": pa.array(np.arange(4000, dtype=np.int64))})
+    scan = ArrowScanExec([t])
+    # tiny advisory target → little to no merging
+    conf = RapidsConf({
+        "spark.rapids.tpu.sql.adaptive.advisoryPartitionSizeInBytes": "1"})
+    ex = ShuffleExchangeExec(HashPartitioner([col("k")], 8), scan, conf=conf)
+    r1 = AdaptiveShuffleReaderExec(ex, conf=conf)
+    list(r1.execute_partition(0))
+    assert len(r1._ensure_specs()) == 8     # tiny target: no merging
+
+    conf2 = RapidsConf({
+        "spark.rapids.tpu.sql.adaptive.advisoryPartitionSizeInBytes": "1g"})
+    ex2 = ShuffleExchangeExec(HashPartitioner([col("k")], 8), scan, conf=conf2)
+    r2 = AdaptiveShuffleReaderExec(ex2, conf=conf2)
+    list(r2.execute_partition(0))
+    assert len(r2._ensure_specs()) == 1     # huge target: one reader spec
+
+
+def test_group_by_with_adaptive_default_on():
+    import pyarrow as pa
+    from spark_rapids_tpu.session import TpuSession
+    import spark_rapids_tpu.functions as F
+    spark = TpuSession()
+    df = spark.create_dataframe(
+        {"k": pa.array([1, 2, 1, 3, 2, 1], pa.int64()),
+         "v": pa.array([10, 20, 30, 40, 50, 60], pa.int64())},
+        num_partitions=3)
+    out = df.group_by("k").agg(F.alias(F.sum(F.col("v")), "s")).collect()
+    got = dict(zip(out["k"].to_pylist(), out["s"].to_pylist()))
+    assert got == {1: 100, 2: 70, 3: 40}
+
+
+def test_adaptive_reader_early_close_frees_blocks():
+    """Closing a coalesced reader mid-spec must still account for the
+    never-opened pids so the shuffle blocks are freed (limit early-out)."""
+    import pyarrow as pa
+    import numpy as np
+    from spark_rapids_tpu.exec.basic import ArrowScanExec
+    from spark_rapids_tpu.exec.exchange import (AdaptiveShuffleReaderExec,
+                                                ShuffleExchangeExec)
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.shuffle.manager import ShuffleBlockStore
+    from spark_rapids_tpu.shuffle.partitioning import HashPartitioner
+    from spark_rapids_tpu.expr.core import col
+
+    t = pa.table({"k": pa.array(np.arange(2000) % 16),
+                  "v": pa.array(np.arange(2000, dtype=np.int64))})
+    conf = RapidsConf({
+        "spark.rapids.tpu.sql.adaptive.advisoryPartitionSizeInBytes": "1g"})
+    ex = ShuffleExchangeExec(HashPartitioner([col("k")], 16),
+                             ArrowScanExec([t]), conf=conf)
+    reader = AdaptiveShuffleReaderExec(ex, conf=conf)
+    it = reader.execute_partition(0)   # one spec holding all 16 pids
+    next(it)                           # consume one batch then abandon
+    it.close()
+    sid = ex._shuffle_id
+    assert ex._reads_left == 0
+    assert sid not in ShuffleBlockStore.get()._blocks
+    # the remaining (empty) splits still work
+    for split in range(1, reader.num_partitions):
+        assert list(reader.execute_partition(split)) == []
