@@ -323,6 +323,12 @@ ADVISORY_PARTITION_BYTES = conf(
     "Target size of a coalesced post-shuffle partition "
     "(Spark spark.sql.adaptive.advisoryPartitionSizeInBytes)").bytes_conf("64m")
 
+ORC_DEVICE_DECODE = conf("spark.rapids.tpu.sql.orc.deviceDecode.enabled").doc(
+    "Decode in-scope ORC stripes on device (protobuf/RLEv2 run headers on "
+    "host, packed bits unpacked on device — io/orc_native.py); out-of-scope "
+    "files or columns fall back to the arrow host reader (reference "
+    "GpuOrcScan hands stripes to libcudf)").boolean_conf(True)
+
 CSV_DEVICE_DECODE = conf("spark.rapids.tpu.sql.csv.deviceDecode.enabled").doc(
     "Parse in-scope CSV files on device (host boundary scan + device digit "
     "kernels, io/csv_native.py); out-of-scope files use the arrow host "

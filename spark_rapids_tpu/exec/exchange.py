@@ -246,6 +246,12 @@ class AdaptiveShuffleReaderExec(TpuExec):
         goal = TargetSize(self.conf.batch_size_bytes)
 
         def it():
+            # drop this task's permit before (possibly) blocking on the map
+            # stage — holding it would starve the map tasks and deadlock
+            # (same guard as ShuffleExchangeExec.execute_partition)
+            from spark_rapids_tpu.exec.base import current_task_id
+            from spark_rapids_tpu.runtime.semaphore import TpuSemaphore
+            TpuSemaphore.get().release_if_necessary(current_task_id())
             specs = self._ensure_specs()
             pids = specs[split] if split < len(specs) else []
             opened = 0

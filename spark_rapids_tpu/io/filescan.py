@@ -288,6 +288,45 @@ class FileSourceScanExec(TpuExec):
                                                  bucket_capacity)
         return it()
 
+    def _orc_device_decode_batches(self, split, batch_rows, batch_bytes):
+        """Stripe-at-a-time device ORC decode (io/orc_native.py); None →
+        host arrow reader. Scope gates (compression, stripe caps) run up
+        front; unsupported COLUMNS fall back per column inside the stripe
+        read, mirroring the parquet path's granularity."""
+        from spark_rapids_tpu.io import orc_native as ON
+        node = self.node
+        if node.fmt != "orc" or node.pushed_filter is not None:
+            return None
+        part = node.partitions[split]
+        if part.partition_values:
+            return None
+        metas = []
+        for path in part.paths:
+            try:
+                meta = ON.read_meta(path)
+            except (NotImplementedError, OSError, IndexError):
+                return None
+            if any(si.num_rows > batch_rows
+                   or si.data_length > batch_bytes
+                   for si in meta.stripes):
+                return None  # arrow path re-chunks oversized stripes
+            metas.append(meta)
+        schema = self.output
+
+        def it():
+            import pyarrow.orc as orc
+            for path, meta in zip(part.paths, metas):
+                pf = None
+                for si_ in range(len(meta.stripes)):
+                    acquire_semaphore(self.metrics)
+                    with trace_range("FileScan.orcdevdecode",
+                                     self._scan_time):
+                        if pf is None:
+                            pf = orc.ORCFile(path)
+                        yield ON.read_stripe_device(path, meta, si_,
+                                                    schema, pf=pf)
+        return it()
+
     def execute_partition(self, split):
         conf = self.conf
         strategy = conf.get(CFG.PARQUET_READER_TYPE).upper()
@@ -302,6 +341,12 @@ class FileSourceScanExec(TpuExec):
 
         if conf.get(CFG.CSV_DEVICE_DECODE):
             dev_it = self._csv_device_decode_batches(split)
+            if dev_it is not None:
+                return self.wrap_output(dev_it)
+
+        if conf.get(CFG.ORC_DEVICE_DECODE):
+            dev_it = self._orc_device_decode_batches(
+                split, batch_rows, conf.get(CFG.MAX_READER_BATCH_SIZE_BYTES))
             if dev_it is not None:
                 return self.wrap_output(dev_it)
 

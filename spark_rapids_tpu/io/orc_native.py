@@ -1,0 +1,465 @@
+"""Native ORC stripe access: protobuf metadata + RLEv2 run scan on host,
+bulk bit-unpack on device (stage-one ORC device decode, SURVEY.md §7).
+
+Reference: GpuOrcScan.scala:375 (GpuOrcPartitionReader copies stripe bytes
+to the GPU where libcudf decodes). Same split as io/parquet_native.py: the
+PROTOBUF footers and RLEv2 run HEADERS are metadata — bytes to kilobytes,
+parsed here with a minimal proto-wire reader — while the packed payload
+bits go to the device (ops/orc_decode.py: MSB bit-unpack + zigzag).
+
+Stage-one scope: UNCOMPRESSED files, flat schemas, INT/LONG columns with
+DIRECT_V2 encoding (RLEv2 sub-encodings SHORT_REPEAT, DIRECT, DELTA;
+PATCHED_BASE falls back), FLOAT/DOUBLE raw-IEEE streams, PRESENT
+(boolean-RLE) null streams. Anything else falls back to the pyarrow ORC
+reader PER COLUMN, the same granularity as the parquet path."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+
+MAGIC = b"ORC"
+
+# ORC "closest fixed bit width" table: 5-bit code → bit width
+_WIDTH_TABLE = list(range(1, 25)) + [26, 28, 30, 32, 40, 48, 56, 64]
+
+
+class _ProtoReader:
+    """Just enough protobuf wire format for ORC footers."""
+
+    def __init__(self, buf: bytes, pos: int = 0, end: int | None = None):
+        self.buf = buf
+        self.pos = pos
+        self.end = len(buf) if end is None else end
+
+    def varint(self) -> int:
+        out = shift = 0
+        while True:
+            b = self.buf[self.pos]
+            self.pos += 1
+            out |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return out
+            shift += 7
+
+    def fields(self):
+        """Yield (field_number, wire_type, value_or_bytes)."""
+        while self.pos < self.end:
+            tag = self.varint()
+            fnum, wt = tag >> 3, tag & 7
+            if wt == 0:
+                yield fnum, wt, self.varint()
+            elif wt == 2:
+                ln = self.varint()
+                data = self.buf[self.pos:self.pos + ln]
+                self.pos += ln
+                yield fnum, wt, data
+            elif wt == 5:
+                data = self.buf[self.pos:self.pos + 4]
+                self.pos += 4
+                yield fnum, wt, data
+            elif wt == 1:
+                data = self.buf[self.pos:self.pos + 8]
+                self.pos += 8
+                yield fnum, wt, data
+            else:
+                raise NotImplementedError(f"proto wire type {wt}")
+
+
+class StripeInfo:
+    __slots__ = ("offset", "index_length", "data_length", "footer_length",
+                 "num_rows")
+
+    def __init__(self):
+        self.offset = self.index_length = self.data_length = 0
+        self.footer_length = self.num_rows = 0
+
+
+class OrcMeta:
+    __slots__ = ("stripes", "column_kinds", "column_names", "compression")
+
+    def __init__(self):
+        self.stripes: list[StripeInfo] = []
+        self.column_kinds: list[int] = []   # leaf type kind per column
+        self.column_names: list[str] = []
+        self.compression = 0
+
+
+# type kinds
+K_SHORT, K_INT, K_LONG = 2, 3, 4
+K_FLOAT, K_DOUBLE = 5, 6
+# stream kinds
+S_PRESENT, S_DATA = 0, 1
+# column encodings
+E_DIRECT, E_DICTIONARY, E_DIRECT_V2, E_DICTIONARY_V2 = 0, 1, 2, 3
+
+
+def read_meta(path: str) -> OrcMeta:
+    with open(path, "rb") as f:
+        f.seek(0, 2)
+        size = f.tell()
+        tail_len = min(size, 16 * 1024)
+        f.seek(size - tail_len)
+        tail = f.read(tail_len)
+        # layout: ...stripes | metadata | footer | postscript | psLen(1).
+        # The "ORC" magic rides at the end of the postscript (its writers
+        # encode it as a trailing length-delimited proto field), so the
+        # last 4 bytes are b"ORC" + psLen.
+        if tail[-4:-1] != MAGIC:
+            raise NotImplementedError("not an ORC file")
+        ps_len = tail[-1]
+        meta = OrcMeta()
+        footer_len = 0
+        for fnum, wt, val in _ProtoReader(tail[-1 - ps_len:-1]).fields():
+            if fnum == 1:
+                footer_len = val
+            elif fnum == 2:
+                meta.compression = val
+        if meta.compression != 0:
+            raise NotImplementedError("compressed ORC stays on the host path")
+        need = 1 + ps_len + footer_len
+        if need > tail_len:            # giant footer: re-read exactly enough
+            f.seek(size - need)
+            tail = f.read(need)
+    footer = tail[-1 - ps_len - footer_len:-1 - ps_len]
+    types: list[tuple[int, list, list]] = []   # (kind, subtypes, names)
+    for fnum, wt, val in _ProtoReader(footer).fields():
+        if fnum == 3:          # StripeInformation
+            si = StripeInfo()
+            for f2, _w, v in _ProtoReader(val).fields():
+                if f2 == 1:
+                    si.offset = v
+                elif f2 == 2:
+                    si.index_length = v
+                elif f2 == 3:
+                    si.data_length = v
+                elif f2 == 4:
+                    si.footer_length = v
+                elif f2 == 5:
+                    si.num_rows = v
+            meta.stripes.append(si)
+        elif fnum == 4:        # Type
+            kind, subtypes, names = 0, [], []
+            for f2, w2, v in _ProtoReader(val).fields():
+                if f2 == 1:
+                    kind = v
+                elif f2 == 2:
+                    if w2 == 0:
+                        subtypes.append(v)
+                    else:           # packed repeated uint32
+                        pr = _ProtoReader(v)
+                        while pr.pos < pr.end:
+                            subtypes.append(pr.varint())
+                elif f2 == 3:
+                    names.append(v.decode("utf-8"))
+            types.append((kind, subtypes, names))
+    if not types or types[0][0] != 12:          # root must be a struct
+        raise NotImplementedError("non-struct root type")
+    root_kind, subtypes, names = types[0]
+    for tid, name in zip(subtypes, names):
+        kind, sub, _n = types[tid]
+        if sub:
+            raise NotImplementedError(f"nested column {name}")
+        meta.column_kinds.append(kind)
+        meta.column_names.append(name)
+    return meta
+
+
+def _read_stripe_footer(raw: bytes, si: StripeInfo):
+    """(streams [(kind, column, length)], encodings [kind])."""
+    foot_off = si.offset + si.index_length + si.data_length
+    footer = raw[foot_off:foot_off + si.footer_length]
+    streams, encodings = [], []
+    for fnum, _w, val in _ProtoReader(footer).fields():
+        if fnum == 1:
+            kind = col = length = 0
+            for f2, _w2, v in _ProtoReader(val).fields():
+                if f2 == 1:
+                    kind = v
+                elif f2 == 2:
+                    col = v
+                elif f2 == 3:
+                    length = v
+            streams.append((kind, col, length))
+        elif fnum == 2:
+            enc = 0
+            for f2, _w2, v in _ProtoReader(val).fields():
+                if f2 == 1:
+                    enc = v
+            encodings.append(enc)
+    return streams, encodings
+
+
+def decode_boolean_rle(buf: bytes, n_bits: int) -> np.ndarray:
+    """PRESENT stream: byte-RLE over bit-bytes, bits MSB-first."""
+    out_bytes = bytearray()
+    pos = 0
+    need = (n_bits + 7) // 8
+    while len(out_bytes) < need and pos < len(buf):
+        h = buf[pos]
+        pos += 1
+        if h < 128:                      # run of h+3 copies of next byte
+            out_bytes.extend(buf[pos:pos + 1] * (h + 3))
+            pos += 1
+        else:                            # 256-h literal bytes
+            lit = 256 - h
+            out_bytes.extend(buf[pos:pos + lit])
+            pos += lit
+    bits = np.unpackbits(np.frombuffer(bytes(out_bytes[:need]), np.uint8),
+                         bitorder="big")
+    return bits[:n_bits].astype(np.int32)
+
+
+def _zz(v: int) -> int:
+    return (v >> 1) ^ -(v & 1)
+
+
+class _ByteReader:
+    def __init__(self, buf: bytes, pos: int = 0):
+        self.buf = buf
+        self.pos = pos
+
+    def byte(self) -> int:
+        b = self.buf[self.pos]
+        self.pos += 1
+        return b
+
+    def varint(self) -> int:
+        out = shift = 0
+        while True:
+            b = self.byte()
+            out |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return out
+            shift += 7
+
+
+def _unpack_msb_host(buf: bytes, byte_off: int, width: int,
+                     count: int) -> np.ndarray:
+    """Host MSB-first unpack for small runs (delta payloads). Expands only
+    the run's own bytes — runs always start byte-aligned."""
+    if width == 0 or count == 0:
+        return np.zeros(count, np.int64)
+    nbytes = (width * count + 7) // 8
+    bits = np.unpackbits(np.frombuffer(buf, np.uint8, nbytes, byte_off),
+                         bitorder="big")[:width * count]
+    mat = bits.reshape(count, width).astype(np.int64)
+    pw = (1 << np.arange(width - 1, -1, -1, dtype=np.int64))
+    return (mat * pw).sum(axis=1)
+
+
+def scan_rlev2(buf: bytes, start: int, end: int, n_values: int,
+               signed: bool):
+    """Split an RLEv2 stream into runs. Returns a list of
+    ('direct', count, width, payload_bit_offset) — device-unpacked — and
+    ('const', count, ndarray) — host-materialized (short-repeat/delta).
+    PATCHED_BASE raises (caller falls back per column)."""
+    r = _ByteReader(buf, start)
+    runs = []
+    got = 0
+    while got < n_values and r.pos < end:
+        h = r.byte()
+        enc = h >> 6
+        if enc == 0:                    # SHORT_REPEAT
+            nbytes = ((h >> 3) & 7) + 1
+            cnt = (h & 7) + 3
+            v = int.from_bytes(buf[r.pos:r.pos + nbytes], "big")
+            r.pos += nbytes
+            if signed:
+                v = _zz(v)
+            runs.append(("const", cnt, np.full(cnt, v, np.int64)))
+            got += cnt
+        elif enc == 1:                  # DIRECT
+            w = _WIDTH_TABLE[(h >> 1) & 31]
+            cnt = (((h & 1) << 8) | r.byte()) + 1
+            if w > 56:
+                raise NotImplementedError("direct width > 56")
+            runs.append(("direct", cnt, w, r.pos * 8))
+            r.pos += (cnt * w + 7) // 8
+            got += cnt
+        elif enc == 3:                  # DELTA
+            wcode = (h >> 1) & 31
+            w = 0 if wcode == 0 else _WIDTH_TABLE[wcode]
+            cnt = (((h & 1) << 8) | r.byte()) + 1
+            base = r.varint()
+            base = _zz(base) if signed else base
+            delta0 = _zz(r.varint())
+            vals = np.zeros(cnt, np.int64)
+            vals[0] = base
+            if cnt > 1:
+                vals[1] = base + delta0
+            if cnt > 2:
+                if w == 0:              # fixed-delta run
+                    deltas = np.full(cnt - 2, abs(delta0), np.int64)
+                else:
+                    deltas = _unpack_msb_host(buf, r.pos, w, cnt - 2)
+                    r.pos += (w * (cnt - 2) + 7) // 8
+                sign = 1 if delta0 >= 0 else -1
+                vals[2:] = vals[1] + sign * np.cumsum(deltas)
+            runs.append(("const", cnt, vals))
+            got += cnt
+        else:                           # PATCHED_BASE
+            raise NotImplementedError("patched-base run")
+    if got < n_values:
+        raise NotImplementedError("short RLEv2 stream")
+    return runs
+
+
+def intv2_column_to_device(raw: bytes, data_off: int, data_len: int,
+                           present: np.ndarray | None, n_rows: int,
+                           spark_type, capacity: int, raw_dev=None):
+    """One INT/LONG DIRECT_V2 column chunk → TpuColumnVector: run headers
+    host-side, DIRECT payload bits unpacked on device, const runs merged.
+    `raw_dev` is the stripe's device-resident byte array (uploaded ONCE per
+    stripe by read_stripe_device and shared across its columns)."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar.vector import TpuColumnVector, bucket_capacity
+    from spark_rapids_tpu.ops import orc_decode as OD
+    from spark_rapids_tpu.ops import parquet_decode as PD
+
+    n_present = n_rows if present is None else int(present.sum())
+    runs = scan_rlev2(raw, data_off, data_off + data_len, n_present, True)
+    pcap = max(bucket_capacity(max(n_present, 1)), 8)
+    bit_offsets = np.zeros(pcap, np.int64)
+    widths = np.zeros(pcap, np.int64)
+    const_mask = np.zeros(pcap, bool)
+    const_vals = np.zeros(pcap, np.int64)
+    at = 0
+    for run in runs:
+        if run[0] == "direct":
+            _k, cnt, w, bit0 = run
+            bit_offsets[at:at + cnt] = bit0 + w * np.arange(cnt)
+            widths[at:at + cnt] = w
+        else:
+            _k, cnt, vals = run
+            const_mask[at:at + cnt] = True
+            const_vals[at:at + cnt] = vals
+        at += cnt
+    packed_d = (raw_dev if raw_dev is not None
+                else jnp.asarray(np.frombuffer(raw, np.uint8)))
+    present_vals = OD.decode_intv2_device(
+        packed_d, jnp.asarray(bit_offsets), jnp.asarray(widths),
+        jnp.asarray(const_mask), jnp.asarray(const_vals), True, pcap)
+    if present is None:
+        vals = jnp.zeros((capacity,), jnp.int64).at[:pcap].set(
+            present_vals)[:capacity]
+        valid = (jnp.arange(capacity) < n_rows)
+    else:
+        pres = jnp.zeros((capacity,), jnp.bool_).at[:n_rows].set(
+            jnp.asarray(present.astype(bool)))
+        padded = jnp.zeros((capacity,), jnp.int64).at[:pcap].set(present_vals)
+        vals, valid = PD.expand_present_to_rows(padded, pres, capacity)
+    st = spark_type
+    out = vals.astype(st.jnp_dtype)
+    default = jnp.asarray(st.default_value(), out.dtype)
+    out = jnp.where(valid, out, default)
+    return TpuColumnVector(st, out, valid)
+
+
+def float_column_to_device(raw: bytes, data_off: int, data_len: int,
+                           present: np.ndarray | None, n_rows: int,
+                           spark_type, capacity: int):
+    """FLOAT/DOUBLE: the DATA stream is raw little-endian IEEE — one host
+    view + H2D, then the null-layout expand on device."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar.vector import TpuColumnVector
+    from spark_rapids_tpu.ops import parquet_decode as PD
+
+    isf32 = isinstance(spark_type, T.FloatType)
+    np_dt = "<f4" if isf32 else "<f8"
+    width = 4 if isf32 else 8
+    n_present = n_rows if present is None else int(present.sum())
+    vals_np = np.frombuffer(raw, np_dt, n_present, data_off).astype(
+        np.float32 if isf32 else np.float64)
+    del width
+    padded = np.zeros(capacity, vals_np.dtype)
+    padded[:n_present] = vals_np
+    if present is None:
+        vals = jnp.asarray(padded)
+        valid = jnp.arange(capacity) < n_rows
+    else:
+        pres = jnp.zeros((capacity,), jnp.bool_).at[:n_rows].set(
+            jnp.asarray(present.astype(bool)))
+        vals, valid = PD.expand_present_to_rows(jnp.asarray(padded), pres,
+                                                capacity)
+    default = jnp.asarray(spark_type.default_value(), vals.dtype)
+    vals = jnp.where(valid, vals, default)
+    return TpuColumnVector(spark_type, vals, valid)
+
+
+_KIND_TO_TYPE = {K_SHORT: T.INT, K_INT: T.INT, K_LONG: T.LONG,
+                 K_FLOAT: T.FLOAT, K_DOUBLE: T.DOUBLE}
+
+
+def read_stripe_device(path: str, meta: OrcMeta, stripe_idx: int, schema,
+                       pf=None):
+    """Read one stripe via the device path; out-of-scope columns fall back
+    to the pyarrow ORC reader PER COLUMN. Returns a ColumnarBatch."""
+    from spark_rapids_tpu.columnar.arrow import array_to_device
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.columnar.vector import bucket_capacity
+
+    si = meta.stripes[stripe_idx]
+    with open(path, "rb") as f:
+        f.seek(si.offset)
+        raw = f.read(si.index_length + si.data_length + si.footer_length)
+    # make offsets stripe-relative: footer stream lengths are laid out from
+    # the stripe start (index region first, then data region)
+    si_rel = StripeInfo()
+    si_rel.offset = 0
+    si_rel.index_length = si.index_length
+    si_rel.data_length = si.data_length
+    si_rel.footer_length = si.footer_length
+    streams, encodings = _read_stripe_footer(raw, si_rel)
+    n_rows = si.num_rows
+    cap = bucket_capacity(max(n_rows, 1))
+
+    # absolute offset of each stream within `raw` (file layout order)
+    offsets = {}
+    off = 0
+    for kind, col, length in streams:
+        offsets[(kind, col)] = (off, length)
+        off += length
+
+    name_to_col = {n: i for i, n in enumerate(meta.column_names)}
+    raw_dev = None  # uploaded lazily, ONCE, shared by every int column
+    cols, fields = [], []
+    for f_ in schema.fields:
+        sf_type = f_.data_type
+        try:
+            ci = name_to_col.get(f_.name)
+            if ci is None:
+                raise NotImplementedError(f"unknown column {f_.name}")
+            col_id = ci + 1                     # root struct is column 0
+            kind = meta.column_kinds[ci]
+            want = _KIND_TO_TYPE.get(kind)
+            if want is None or type(want) is not type(sf_type):
+                raise NotImplementedError(f"kind {kind} vs {sf_type}")
+            enc = encodings[col_id] if col_id < len(encodings) else 0
+            present = None
+            if (S_PRESENT, col_id) in offsets:
+                poff, plen = offsets[(S_PRESENT, col_id)]
+                present = decode_boolean_rle(raw[poff:poff + plen], n_rows)
+            doff, dlen = offsets[(S_DATA, col_id)]
+            if kind in (K_SHORT, K_INT, K_LONG):
+                if enc != E_DIRECT_V2:
+                    raise NotImplementedError(f"int encoding {enc}")
+                if raw_dev is None:
+                    import jax.numpy as jnp
+                    raw_dev = jnp.asarray(np.frombuffer(raw, np.uint8))
+                cols.append(intv2_column_to_device(
+                    raw, doff, dlen, present, n_rows, sf_type, cap,
+                    raw_dev=raw_dev))
+            else:
+                cols.append(float_column_to_device(
+                    raw, doff, dlen, present, n_rows, sf_type, cap))
+        except NotImplementedError:
+            import pyarrow.orc as orc
+            pfile = pf if pf is not None else orc.ORCFile(path)
+            tbl = pfile.read_stripe(stripe_idx, columns=[f_.name])
+            arr = (tbl.column(0) if hasattr(tbl, "column")
+                   else tbl[0])
+            cols.append(array_to_device(arr, sf_type, cap))
+        fields.append(f_)
+    return ColumnarBatch(cols, n_rows, T.StructType(fields))

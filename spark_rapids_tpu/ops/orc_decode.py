@@ -1,0 +1,48 @@
+"""Device ORC decode kernels — MSB bit-unpack + zigzag as one jit.
+
+Reference: GpuOrcScan.scala:375 copies stripe bytes and hands them to
+libcudf's ORC decoder. TPU stage one (same split as ops/parquet_decode.py):
+the RLEv2 run STRUCTURE is host metadata (io/orc_native.py); the packed
+payload bits decode here. ORC packs values MSB-first (big-endian bit
+order, unlike parquet's LSB-first), and widths vary per run, so the
+kernel takes per-value bit offsets and widths: an 8-byte big-endian
+window per value, one logical shift, one mask — pure vector ops.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def unpack_msb_device(packed: jnp.ndarray, bit_offsets: jnp.ndarray,
+                      widths: jnp.ndarray, capacity: int) -> jnp.ndarray:
+    """(bytes,) uint8 + per-value bit offsets/widths (MSB-first packing) →
+    (capacity,) int64 raw (pre-zigzag) values. Widths must be ≤ 56 so the
+    8-byte window always covers offset%8 + width bits."""
+    nbytes = packed.shape[0]
+    b0 = (bit_offsets >> 3).astype(jnp.int32)
+    sh = (bit_offsets & 7).astype(jnp.int64)
+    window = jnp.zeros((capacity,), jnp.int64)
+    for k in range(8):
+        byte = packed[jnp.clip(b0 + k, 0, nbytes - 1)].astype(jnp.int64)
+        window = window | lax.shift_left(byte, jnp.int64(8 * (7 - k)))
+    w = widths.astype(jnp.int64)
+    shifted = lax.shift_right_logical(window, jnp.int64(64) - sh - w)
+    mask = jnp.where(w >= 64, jnp.int64(-1),
+                     lax.shift_left(jnp.int64(1), w) - 1)
+    return shifted & mask
+
+
+def zigzag_decode(v: jnp.ndarray) -> jnp.ndarray:
+    return lax.shift_right_logical(v, jnp.int64(1)) ^ -(v & jnp.int64(1))
+
+
+def decode_intv2_device(packed: jnp.ndarray, bit_offsets, widths,
+                        const_mask, const_vals, signed: bool,
+                        capacity: int) -> jnp.ndarray:
+    """Merge device-unpacked DIRECT runs with host-decoded constant runs:
+    positions with const_mask take const_vals; the rest unpack+zigzag."""
+    raw = unpack_msb_device(packed, bit_offsets, widths, capacity)
+    vals = zigzag_decode(raw) if signed else raw
+    return jnp.where(const_mask, const_vals, vals)
