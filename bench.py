@@ -65,6 +65,10 @@ def child_main():
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         # the axon site hook re-selects TPU regardless of env; override it
         jax.config.update("jax_platforms", "cpu")
+    # persistent compile cache: repeated bench runs (and the driver's
+    # end-of-round run) must not re-pay every remote TPU compile
+    from __graft_entry__ import _enable_compile_cache
+    _enable_compile_cache()
     import spark_rapids_tpu  # noqa: F401  (enables x64)
     from spark_rapids_tpu.benchmarks import tpch
     from spark_rapids_tpu.session import TpuSession
@@ -155,10 +159,18 @@ def _probe_backend():
 def parent_main():
     """Never exits non-zero; always prints one JSON line."""
     attempts = []
-    for attempt in range(2):
+    # ladder: full SF with the long budget, then a smaller SF with a tighter
+    # budget (fewer rows AND fewer fresh compiles) — a degraded-scale TPU
+    # number beats a CPU fallback
+    ladder = [({}, CHILD_TIMEOUT_S),
+              ({"TPCH_SF": "0.01", "TPCH_DIR": "/tmp/tpch_sf0.01"}, 1200)]
+    for attempt, (env, budget) in enumerate(ladder):
         if _probe_backend():
-            parsed, err = _spawn({}, CHILD_TIMEOUT_S)
+            parsed, err = _spawn(env, budget)
             if parsed is not None:
+                if env.get("TPCH_SF"):
+                    parsed["degraded"] = (parsed.get("degraded", "") +
+                                          " reduced-sf=" + env["TPCH_SF"]).strip()
                 print(json.dumps(parsed))
                 return
             attempts.append(f"accel attempt {attempt}: {err}")

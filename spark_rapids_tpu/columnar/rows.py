@@ -1,0 +1,164 @@
+"""Fixed-width binary row format — the CudfUnsafeRow / row↔columnar
+codegen analog (SURVEY.md #9).
+
+Reference: GpuRowToColumnarExec.scala:788 + GeneratedUnsafeRowToCudfRowIterator
+(:635) generate Janino code that copies UnsafeRow fixed-width fields into
+packed device rows, and CudfUnsafeRow (java, 399 LoC) defines the packed
+layout; GpuColumnarToRowExec:341 goes the other way. The point of the
+codegen is to avoid per-row/per-field interpretation for FIXED-WIDTH
+schemas. The TPU build's analog of "generate code per schema" is
+"compute a strided layout per schema and execute it as whole-column numpy
+ops": zero per-row Python, one pass per column.
+
+Layout (UnsafeRow-flavored): each row is 8-byte words —
+  [null bitset words][one 8-byte slot per field]
+bools/ints zero-extended into their slot, floats/doubles bit-cast,
+dates/timestamps as their integer representation. Variable-width columns
+(strings) are out of the fast path, exactly like CudfUnsafeRow's
+fixed-width restriction — callers fall back to arrow for those schemas.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+
+_FIXED = (T.BooleanType, T.IntegerType, T.LongType, T.FloatType,
+          T.DoubleType, T.DateType, T.TimestampType, T.DecimalType)
+
+
+def is_fixed_width(schema) -> bool:
+    return all(isinstance(f.data_type, _FIXED) for f in schema.fields)
+
+
+def row_layout(schema):
+    """(null_words, total_words): the per-schema 'generated code'."""
+    nf = len(schema.fields)
+    if nf > 64 * 8:
+        raise NotImplementedError("more than 512 fields")
+    null_words = max(1, -(-nf // 64))
+    return null_words, null_words + nf
+
+
+def _col_bits(dtype, data: np.ndarray) -> np.ndarray:
+    """Column values → int64 slot bit patterns (vectorized)."""
+    if isinstance(dtype, (T.FloatType,)):
+        return np.ascontiguousarray(data.astype(np.float32)).view(
+            np.int32).astype(np.int64) & 0xFFFFFFFF
+    if isinstance(dtype, T.DoubleType):
+        return np.ascontiguousarray(data.astype(np.float64)).view(np.int64)
+    return data.astype(np.int64)
+
+
+def _bits_to_col(dtype, words: np.ndarray):
+    if isinstance(dtype, T.FloatType):
+        return words.astype(np.int64).astype(np.uint64).astype(
+            np.uint32).view(np.float32)
+    if isinstance(dtype, T.DoubleType):
+        return words.view(np.float64)
+    if isinstance(dtype, T.BooleanType):
+        return words.astype(bool)
+    if isinstance(dtype, T.IntegerType) or isinstance(dtype, T.DateType):
+        return words.astype(np.int32)
+    return words.copy()
+
+
+def pack_rows(batch) -> np.ndarray:
+    """ColumnarBatch (fixed-width schema) → (n, total_words) int64 row
+    buffer. One vectorized store per column; null bits packed per word."""
+    schema = batch.schema
+    if not is_fixed_width(schema):
+        raise NotImplementedError("variable-width schema: use arrow")
+    null_words, total = row_layout(schema)
+    n = batch.num_rows
+    out = np.zeros((n, total), np.int64)
+    for j, f in enumerate(schema.fields):
+        col = batch.column(j)
+        data = np.asarray(col.data)[:n]
+        valid = np.asarray(col.validity)[:n]
+        out[:, null_words + j] = np.where(valid, _col_bits(f.data_type, data),
+                                          0)
+        w, bit = j // 64, j % 64
+        out[:, w] |= np.where(valid, np.int64(0),
+                              np.int64(1) << np.int64(bit))
+    return out
+
+
+def unpack_rows(rows: np.ndarray, schema):
+    """(n, total_words) int64 row buffer → ColumnarBatch on device."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.columnar.vector import TpuColumnVector, bucket_capacity
+
+    null_words, total = row_layout(schema)
+    if rows.ndim != 2 or rows.shape[1] != total:
+        raise ValueError(f"row buffer shape {rows.shape} != (*, {total})")
+    n = rows.shape[0]
+    cap = bucket_capacity(max(n, 1))
+    cols = []
+    for j, f in enumerate(schema.fields):
+        w, bit = j // 64, j % 64
+        null = (rows[:, w] >> np.int64(bit)) & 1
+        valid_np = (null == 0)
+        data_np = _bits_to_col(f.data_type, rows[:, null_words + j])
+        want = f.data_type.jnp_dtype
+        padded = np.zeros(cap, dtype=want)
+        padded[:n] = np.where(valid_np, data_np,
+                              f.data_type.default_value()).astype(want)
+        vmask = np.zeros(cap, bool)
+        vmask[:n] = valid_np
+        cols.append(TpuColumnVector(f.data_type, jnp.asarray(padded),
+                                    jnp.asarray(vmask)))
+    return ColumnarBatch(cols, n, schema)
+
+
+def pack_arrow(tbl, schema) -> np.ndarray:
+    """Arrow table (fixed-width schema) → row buffer, host-only — no device
+    round-trip (the session collect() result is already host arrow)."""
+    import pyarrow as pa
+    if not is_fixed_width(schema):
+        raise NotImplementedError("variable-width schema: use arrow")
+    null_words, total = row_layout(schema)
+    n = tbl.num_rows
+    out = np.zeros((n, total), np.int64)
+    for j, f in enumerate(schema.fields):
+        arr = tbl.column(j).combine_chunks()
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.chunk(0) if arr.num_chunks else pa.nulls(0, arr.type)
+        valid = np.asarray(pa.compute.is_valid(arr))
+        if isinstance(f.data_type, T.DateType):
+            arr = arr.cast(pa.int32())
+        elif isinstance(f.data_type, T.TimestampType):
+            arr = arr.cast(pa.int64())
+        data = arr.to_numpy(zero_copy_only=False)
+        if data.dtype == object or isinstance(f.data_type, T.BooleanType):
+            data = np.array([0 if v is None else int(v)
+                             for v in arr.to_pylist()], np.int64)
+        else:
+            data = np.where(valid, np.nan_to_num(data, nan=0.0)
+                            if data.dtype.kind == "f" else data, 0)
+        out[:, null_words + j] = np.where(valid,
+                                          _col_bits(f.data_type, data), 0)
+        w, bit = j // 64, j % 64
+        out[:, w] |= np.where(valid, np.int64(0),
+                              np.int64(1) << np.int64(bit))
+    return out
+
+
+def unpack_rows_arrow(rows: np.ndarray, schema):
+    """Row buffer → arrow table, host-only (scan execution does the one
+    real H2D upload later)."""
+    import pyarrow as pa
+    null_words, total = row_layout(schema)
+    if rows.ndim != 2 or rows.shape[1] != total:
+        raise ValueError(f"row buffer shape {rows.shape} != (*, {total})")
+    cols, names = [], []
+    for j, f in enumerate(schema.fields):
+        w, bit = j // 64, j % 64
+        valid = ((rows[:, w] >> np.int64(bit)) & 1) == 0
+        data = _bits_to_col(f.data_type, rows[:, null_words + j])
+        cols.append(pa.array(data, T.to_arrow_type(f.data_type),
+                             mask=~valid))
+        names.append(f.name)
+    return pa.table(dict(zip(names, cols)))

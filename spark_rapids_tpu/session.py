@@ -184,6 +184,18 @@ class DataFrame:
         """CPU-only execution (the withCpuSparkSession analog for tests)."""
         return self._plan.collect_host()
 
+    def collect_row_buffer(self):
+        """Fixed-width fast path: collect as a packed binary row buffer
+        (reference GpuColumnarToRowExec + CudfUnsafeRow, SURVEY.md #9).
+        Returns (rows int64[n, words], schema); raises NotImplementedError
+        for variable-width schemas (use collect())."""
+        from spark_rapids_tpu.columnar import rows as R
+        schema = self._plan.output
+        if not R.is_fixed_width(schema):
+            raise NotImplementedError("variable-width schema: use collect()")
+        # host-only pack: collect() already materialized host arrow
+        return R.pack_arrow(self.collect(), schema), schema
+
     def count(self) -> int:
         from spark_rapids_tpu.expr.aggregates import Count
         agg = NN.AggregateNode([], [E.Alias(Count(None), "count")], self._plan)
@@ -271,6 +283,24 @@ class TpuSession:
             path, "csv", schema=schema,
             options={"header": header, "delimiter": delimiter,
                      "schema": schema}), self)
+
+    def create_dataframe_from_rows(self, rows, schema,
+                                   num_partitions: int = 1) -> DataFrame:
+        """Fixed-width fast path: a packed binary row buffer (see
+        columnar/rows.py) → DataFrame without per-row conversion
+        (reference GpuRowToColumnarExec's codegen'd fast path)."""
+        from spark_rapids_tpu.columnar import rows as R
+        import numpy as np
+        rows = np.asarray(rows)
+        n = rows.shape[0]
+        per = -(-n // max(1, num_partitions)) if n else 1
+        parts = []
+        for i in range(max(1, num_partitions)):
+            chunk = rows[i * per:(i + 1) * per]
+            if chunk.shape[0] == 0 and i > 0:
+                break
+            parts.append(R.unpack_rows_arrow(chunk, schema))
+        return DataFrame(NN.ScanNode(parts, schema), self)
 
     def create_dataframe(self, data, num_partitions: int = 1) -> DataFrame:
         """From a pyarrow table / pandas DataFrame / dict of columns."""

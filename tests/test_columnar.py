@@ -149,3 +149,66 @@ def test_murmur3_chained_seed_device():
     expect = [H.murmur3_long_host(int(bv), H.murmur3_int_host(int(av), 42))
               for av, bv in zip(a, b)]
     assert list(h2) == expect
+
+
+# -- fixed-width row format (CudfUnsafeRow analog, SURVEY.md #9) --------------
+
+def test_row_buffer_roundtrip():
+    import jax
+    import pyarrow as pa
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.columnar import rows as R
+    from spark_rapids_tpu import types as T
+
+    t = pa.table({
+        "i": pa.array([1, None, -3, 2**31 - 1], pa.int32()),
+        "l": pa.array([10, 2**62, None, -5], pa.int64()),
+        "f": pa.array([1.5, None, -0.25, 3.75], pa.float32()),
+        "d": pa.array([2.5, -1e300, None, 0.0], pa.float64()),
+        "b": pa.array([True, False, None, True], pa.bool_()),
+    })
+    batch = ColumnarBatch.from_arrow(t)
+    buf = R.pack_rows(batch)
+    nw, total = R.row_layout(batch.schema)
+    assert buf.shape == (4, total) and nw == 1
+    back = R.unpack_rows(buf, batch.schema)
+    assert back.to_arrow().to_pylist() == t.to_pylist()
+
+
+def test_row_buffer_many_fields_null_words():
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.columnar import rows as R
+    n_cols = 70    # spills into a second null bitset word
+    data = {f"c{j}": pa.array([j, None, j * 2], pa.int64())
+            for j in range(n_cols)}
+    t = pa.table(data)
+    batch = ColumnarBatch.from_arrow(t)
+    buf = R.pack_rows(batch)
+    nw, total = R.row_layout(batch.schema)
+    assert nw == 2 and total == 2 + n_cols
+    assert R.unpack_rows(buf, batch.schema).to_arrow().to_pylist() == \
+        t.to_pylist()
+
+
+def test_row_buffer_session_api():
+    import pyarrow as pa
+    from spark_rapids_tpu.session import TpuSession
+    import spark_rapids_tpu.functions as F
+    spark = TpuSession()
+    df = spark.create_dataframe({
+        "k": pa.array([1, 2, None, 4], pa.int64()),
+        "v": pa.array([0.5, None, 2.5, 4.0], pa.float64())})
+    buf, schema = df.collect_row_buffer()
+    assert buf.shape[0] == 4
+    df2 = spark.create_dataframe_from_rows(buf, schema, num_partitions=2)
+    assert df2.collect().to_pylist() == df.collect().to_pylist()
+    # and the re-imported frame computes on device
+    out = df2.filter(F.col("k") > F.lit(1)).collect()
+    assert sorted(x for x in out["k"].to_pylist()) == [2, 4]
+
+    sdf = spark.create_dataframe({"s": pa.array(["a", "b"])})
+    import pytest
+    with pytest.raises(NotImplementedError):
+        sdf.collect_row_buffer()
