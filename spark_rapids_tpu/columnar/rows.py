@@ -127,19 +127,28 @@ def pack_arrow(tbl, schema) -> np.ndarray:
         if isinstance(arr, pa.ChunkedArray):
             arr = arr.chunk(0) if arr.num_chunks else pa.nulls(0, arr.type)
         valid = np.asarray(pa.compute.is_valid(arr))
-        if isinstance(f.data_type, T.DateType):
+        dt = f.data_type
+        if isinstance(dt, T.DateType):
             arr = arr.cast(pa.int32())
-        elif isinstance(f.data_type, T.TimestampType):
+        elif isinstance(dt, T.TimestampType):
             arr = arr.cast(pa.int64())
-        data = arr.to_numpy(zero_copy_only=False)
-        if data.dtype == object or isinstance(f.data_type, T.BooleanType):
-            data = np.array([0 if v is None else int(v)
+        if isinstance(dt, T.DecimalType):
+            # engine/device repr is the scaled int64 (DECIMAL64); Decimal
+            # objects carry it exactly
+            data = np.array([0 if v is None else int(v.scaleb(dt.scale))
                              for v in arr.to_pylist()], np.int64)
         else:
-            data = np.where(valid, np.nan_to_num(data, nan=0.0)
-                            if data.dtype.kind == "f" else data, 0)
-        out[:, null_words + j] = np.where(valid,
-                                          _col_bits(f.data_type, data), 0)
+            # fill nulls BEFORE to_numpy: a nullable int column would
+            # otherwise come back as float64 and corrupt values > 2^53;
+            # valid NaN floats must survive (fill_null only touches nulls)
+            fill = (False if isinstance(dt, T.BooleanType)
+                    else 0.0 if isinstance(dt, (T.FloatType, T.DoubleType))
+                    else 0)
+            filled = pa.compute.fill_null(arr, fill)
+            data = filled.to_numpy(zero_copy_only=False)
+            if isinstance(dt, T.BooleanType):
+                data = data.astype(np.int64)
+        out[:, null_words + j] = np.where(valid, _col_bits(dt, data), 0)
         w, bit = j // 64, j % 64
         out[:, w] |= np.where(valid, np.int64(0),
                               np.int64(1) << np.int64(bit))
@@ -158,7 +167,17 @@ def unpack_rows_arrow(rows: np.ndarray, schema):
         w, bit = j // 64, j % 64
         valid = ((rows[:, w] >> np.int64(bit)) & 1) == 0
         data = _bits_to_col(f.data_type, rows[:, null_words + j])
-        cols.append(pa.array(data, T.to_arrow_type(f.data_type),
-                             mask=~valid))
+        if isinstance(f.data_type, T.DecimalType):
+            import decimal
+            sc = f.data_type.scale
+            vals = [None if not v else decimal.Decimal(int(x)).scaleb(-sc)
+                    for x, v in zip(data, valid)]
+            # scaleb of 0 keeps exponent 0; quantize for uniform scale
+            q = decimal.Decimal(1).scaleb(-sc)
+            vals = [None if v is None else v.quantize(q) for v in vals]
+            cols.append(pa.array(vals, T.to_arrow_type(f.data_type)))
+        else:
+            cols.append(pa.array(data, T.to_arrow_type(f.data_type),
+                                 mask=~valid))
         names.append(f.name)
     return pa.table(dict(zip(names, cols)))

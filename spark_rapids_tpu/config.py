@@ -130,6 +130,15 @@ CONCURRENT_TPU_TASKS = conf("spark.rapids.tpu.sql.concurrentTpuTasks").doc(
     "(reference spark.rapids.sql.concurrentGpuTasks, RapidsConf.scala:398)"
 ).integer_conf(2)
 
+DEVICE_ORDINAL = conf("spark.rapids.tpu.device.ordinal").doc(
+    "Which visible accelerator device this process acquires (reference: one "
+    "GPU per executor, GpuDeviceManager.scala:103)").integer_conf(0)
+
+DEVICE_EAGER_INIT = conf("spark.rapids.tpu.device.eagerInit").doc(
+    "Acquire and warm up the device at session creation instead of first "
+    "use — fails fast on a dead backend like the reference's executor "
+    "plugin (Plugin.scala:210 crash-fast)").boolean_conf(False)
+
 DEVICE_MEMORY_FRACTION = conf("spark.rapids.tpu.memory.hbm.allocFraction").doc(
     "Fraction of HBM the pool budget may use "
     "(reference spark.rapids.memory.gpu.allocFraction)").double_conf(0.9)

@@ -257,6 +257,10 @@ class TpuSession:
         # explicit choice
         if CFG.PALLAS_ENABLED.key in self.conf.settings:
             PK.set_mode(None if self.conf.get(CFG.PALLAS_ENABLED) else False)
+        # plugin bootstrap: config fixup/version check once per process;
+        # eager device acquisition when conf'd (reference Plugin.scala flow)
+        from spark_rapids_tpu import plugin as PL
+        PL.bootstrap(self.conf)
 
     # -- data sources --------------------------------------------------------
     def read_parquet(self, path, pushed_filter=None,

@@ -212,3 +212,33 @@ def test_row_buffer_session_api():
     import pytest
     with pytest.raises(NotImplementedError):
         sdf.collect_row_buffer()
+
+
+def test_row_buffer_arrow_pack_precision_and_nan():
+    """Host arrow pack: nullable int64 keeps full 64-bit precision, valid
+    NaN doubles survive, decimals keep their scale."""
+    import math
+    import decimal
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.columnar import rows as R
+    from spark_rapids_tpu import types as T
+
+    t = pa.table({
+        "big": pa.array([2**63 - 1, None, -(2**63) + 1], pa.int64()),
+        "d": pa.array([float("nan"), 1.5, None], pa.float64()),
+        "dec": pa.array([decimal.Decimal("1.23"), None,
+                         decimal.Decimal("-0.07")], pa.decimal128(5, 2)),
+    })
+    schema = T.StructType([
+        T.StructField("big", T.LONG),
+        T.StructField("d", T.DOUBLE),
+        T.StructField("dec", T.DecimalType(5, 2)),
+    ])
+    buf = R.pack_arrow(t, schema)
+    back = R.unpack_rows_arrow(buf, schema)
+    assert back["big"].to_pylist() == [2**63 - 1, None, -(2**63) + 1]
+    d = back["d"].to_pylist()
+    assert math.isnan(d[0]) and d[1] == 1.5 and d[2] is None
+    assert back["dec"].to_pylist() == [decimal.Decimal("1.23"), None,
+                                       decimal.Decimal("-0.07")]

@@ -1,0 +1,55 @@
+"""Plugin bootstrap tests (SURVEY.md #1; reference Plugin.scala lifecycle)."""
+
+import pytest
+
+from spark_rapids_tpu import config as CFG
+from spark_rapids_tpu import plugin as PL
+from spark_rapids_tpu.config import RapidsConf
+
+
+@pytest.fixture(autouse=True)
+def fresh():
+    PL.reset_for_tests()
+    yield
+    PL.reset_for_tests()
+
+
+def test_driver_init_builds_heartbeat_manager():
+    ctx = PL.driver_init(RapidsConf(
+        {"spark.rapids.tpu.shuffle.enabled": "true"}))
+    from spark_rapids_tpu.shuffle.heartbeat import RapidsShuffleHeartbeatManager
+    assert isinstance(ctx["heartbeat_manager"], RapidsShuffleHeartbeatManager)
+
+
+def test_executor_init_bad_ordinal_crashes_fast():
+    with pytest.raises(PL.PluginInitError, match="out of range"):
+        PL.executor_init(RapidsConf({"spark.rapids.tpu.device.ordinal": "99"}))
+
+
+def test_executor_init_acquires_device():
+    from spark_rapids_tpu.runtime.memory import DeviceManager
+    from spark_rapids_tpu.runtime.semaphore import TpuSemaphore
+    conf = RapidsConf({"spark.rapids.tpu.sql.concurrentTpuTasks": "3"})
+    PL.executor_init(conf)
+    assert DeviceManager.get() is not None
+    assert TpuSemaphore.get().max_concurrent == 3
+
+
+def test_bootstrap_idempotent_and_eager():
+    conf = RapidsConf({"spark.rapids.tpu.device.eagerInit": "true"})
+    PL.bootstrap(conf)
+    PL.bootstrap(RapidsConf({"spark.rapids.tpu.device.ordinal": "99"}))
+    # second call is a no-op: the bad ordinal never ran
+
+
+def test_session_triggers_bootstrap():
+    from spark_rapids_tpu.session import TpuSession
+    TpuSession()
+    assert PL._initialized
+
+
+def test_bootstrap_retains_context():
+    PL.bootstrap(RapidsConf({"spark.rapids.tpu.shuffle.enabled": "true"}))
+    from spark_rapids_tpu.shuffle.heartbeat import RapidsShuffleHeartbeatManager
+    assert isinstance(PL.context().get("heartbeat_manager"),
+                      RapidsShuffleHeartbeatManager)
