@@ -128,7 +128,23 @@ class GetArrayItem(Expression):
 
     def eval(self, ctx):
         from spark_rapids_tpu.expr.arithmetic import _cast_col
+        from spark_rapids_tpu.expr.strings import StringSplit, java_split
         src, idx = self.children
+        if isinstance(src, StringSplit):
+            # fused split(s, re)[i]: one python split per DICTIONARY entry
+            if not isinstance(idx, Literal):
+                raise NotImplementedError(
+                    "split(...)[col] runs on host (literal index only)")
+            from spark_rapids_tpu.ops import strings as S
+            pat, lim = src.pattern_limit()
+            c = src.children[0].eval(ctx)
+            i = idx.value
+
+            def fn(s):
+                parts = java_split(s, pat, lim)
+                return (parts[int(i)] if i is not None
+                        and 0 <= int(i) < len(parts) else None)
+            return S.dict_transform_to_string(c, fn)
         if not isinstance(src, CreateArray):
             raise NotImplementedError(
                 "GetArrayItem on a real array column runs on host")
@@ -181,6 +197,16 @@ class Size(Expression):
 
     def eval(self, ctx):
         src = self.children[0]
+        from spark_rapids_tpu.expr.strings import StringSplit, java_split
+        if isinstance(src, StringSplit):
+            from spark_rapids_tpu.ops import strings as S
+            pat, lim = src.pattern_limit()
+            c = src.children[0].eval(ctx)
+            out = S.dict_transform_to_values(
+                c, lambda s: len(java_split(s, pat, lim)), T.INT)
+            # legacy Spark: size(null) == -1, never null (matches host)
+            return Col(jnp.where(out.validity, out.values, -1),
+                       jnp.ones_like(out.validity), T.INT)
         if not isinstance(src, CreateArray):
             raise NotImplementedError(
                 "size() on a real array column runs on host")

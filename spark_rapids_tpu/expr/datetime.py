@@ -522,3 +522,57 @@ class TruncDate(Expression):
 
     def __repr__(self):
         return f"trunc({self.children[0]!r}, {self.children[1]!r})"
+
+
+class TimeAdd(Expression):
+    """timestamp + literal interval (reference GpuTimeAdd,
+    datetimeExpressions.scala): only microsecond-precision intervals
+    without a months component run on device — the planner tags months
+    intervals onto the host, same limit as the reference."""
+
+    def __init__(self, ts, interval_us):
+        self.children = [ts, interval_us]
+
+    @property
+    def dtype(self):
+        return T.TIMESTAMP
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    def eval(self, ctx):
+        t = self.children[0].eval(ctx)
+        us = _cast_col(self.children[1].eval(ctx), T.LONG)
+        return Col(t.values + us.values,
+                   valid_and(t.validity, us.validity),
+                   T.TIMESTAMP).canonicalized()
+
+    def __repr__(self):
+        return f"timeadd({self.children[0]!r}, {self.children[1]!r})"
+
+
+class DateAddInterval(Expression):
+    """date + literal interval in whole days (reference GpuDateAddInterval:
+    month components and sub-day remainders fall back, matching its
+    tagging)."""
+
+    def __init__(self, date, days):
+        self.children = [date, days]
+
+    @property
+    def dtype(self):
+        return T.DATE
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    def eval(self, ctx):
+        d = self.children[0].eval(ctx)
+        n = _cast_col(self.children[1].eval(ctx), T.INT)
+        days = _date_col(self.children[0].dtype, d)
+        return Col(days + n.values, valid_and(d.validity, n.validity),
+                   T.DATE).canonicalized()
+
+    def __repr__(self):
+        return (f"dateaddinterval({self.children[0]!r}, "
+                f"{self.children[1]!r})")

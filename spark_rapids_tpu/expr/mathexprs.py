@@ -363,3 +363,58 @@ class Logarithm(Expression):
 
     def __repr__(self):
         return f"log({self.children[0]!r}, {self.children[1]!r})"
+
+
+class BRound(Expression):
+    """bround(x, d) — HALF_EVEN (banker's) rounding (reference GpuBRound,
+    mathExpressions.scala). Floats use jnp.round (IEEE half-even); integral
+    and decimal inputs round the quotient to the nearest even multiple."""
+
+    def __init__(self, child, digits: int = 0):
+        self.children = [child]
+        self.digits = digits
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def with_children(self, children):
+        return BRound(children[0], self.digits)
+
+    def _half_even_div(self, v, div):
+        """Round v/div half-even, returning the rounded MULTIPLE (int64)."""
+        q = jnp.floor_divide(v, div)
+        rem = v - q * div
+        twice = rem * 2
+        up = (twice > div) | ((twice == div) & (q % 2 != 0))
+        return (q + up.astype(q.dtype)) * div
+
+    def eval(self, ctx):
+        ct = self.children[0].dtype
+        c = self.children[0].eval(ctx)
+        d = self.digits
+        if isinstance(ct, T.IntegralType):
+            if d >= 0:
+                return c
+            v = c.values.astype(jnp.int64)
+            out = self._half_even_div(v, 10 ** (-d))
+            # narrow types wrap like Java's intValue/byteValue (Spark
+            # non-ANSI; the host oracle applies the same _wrap_int)
+            return Col(out.astype(c.values.dtype), c.validity,
+                       ct).canonicalized()
+        if isinstance(ct, T.DecimalType):
+            ds = ct.scale - d
+            if ds <= 0:
+                return c
+            out = self._half_even_div(c.values, 10 ** ds)
+            return Col(out, c.validity, ct).canonicalized()
+        # float/double: device path is digits == 0 only (the planner tags
+        # other digits to host) — at scale 1 jnp.round's binary half-even
+        # equals Spark's decimal-string HALF_EVEN, because every exactly-
+        # representable .5 tie is also a decimal-string tie; at other scales
+        # the binary product turns decimal ties into non-ties and diverges
+        out = jnp.round(c.values)
+        return Col(out.astype(c.values.dtype), c.validity, ct).canonicalized()
+
+    def __repr__(self):
+        return f"bround({self.children[0]!r}, {self.digits})"

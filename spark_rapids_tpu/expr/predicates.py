@@ -255,3 +255,13 @@ class In(Expression):
 
     def __repr__(self):
         return f"({self.children[0]!r} IN {self.values!r})"
+
+
+class InSet(In):
+    """Optimized literal-set membership (reference GpuInSet) — same device
+    evaluation as In; Spark plans InSet when the list exceeds the
+    optimizer threshold."""
+
+    def __init__(self, child, values):
+        super().__init__(child, sorted(values, key=lambda v: (v is None,
+                                                              repr(v))))

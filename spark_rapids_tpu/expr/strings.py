@@ -459,3 +459,58 @@ class Md5(_UnaryString):
     def fn(self, s):
         import hashlib
         return hashlib.md5(s.encode("utf-8")).hexdigest()
+
+
+def java_split(s: str, pattern: str, limit: int) -> list:
+    """Java String.split / Spark split semantics: limit > 0 caps the part
+    count (limit == 1 returns the input unsplit); limit == 0 drops trailing
+    empty strings but an empty input still yields [""]; limit < 0 keeps
+    all parts."""
+    if s is None:
+        return []
+    if limit == 1:
+        return [s]      # python maxsplit=0 means UNLIMITED, not zero splits
+    maxsplit = limit - 1 if limit > 1 else 0
+    parts = re.split(pattern, s, maxsplit=maxsplit)
+    if limit == 0:
+        while parts and parts[-1] == "":
+            parts.pop()
+        if not parts and s == "":
+            return [""]  # Java: "".split(x) is [""], not []
+    return parts
+
+
+class StringSplit(Expression):
+    """split(str, regex[, limit]) → array<string> (reference GpuStringSplit,
+    stringFunctions.scala — literal pattern required). Like CreateArray,
+    the split array has no flat device form: only the FUSED uses
+    split(...)[i] and size(split(...)) run on device (dictionary
+    transforms); a materialized split column pins its exec to the host."""
+
+    def __init__(self, child, pattern, limit=None):
+        self.children = ([child, pattern]
+                         + ([limit] if limit is not None else []))
+
+    @property
+    def dtype(self):
+        return T.ArrayType(T.STRING)
+
+    def with_children(self, children):
+        return StringSplit(children[0], children[1],
+                           children[2] if len(children) > 2 else None)
+
+    def pattern_limit(self):
+        pat = self.children[1]
+        lim = self.children[2] if len(self.children) > 2 else None
+        assert isinstance(pat, Literal) and (lim is None
+                                             or isinstance(lim, Literal)), \
+            "split pattern/limit must be literals (reference limitation)"
+        return pat.value, (-1 if lim is None else lim.value)
+
+    def eval(self, ctx):
+        raise NotImplementedError(
+            "split arrays have no flat device form; only fused "
+            "split(...)[i] / size(split(...)) run on device")
+
+    def __repr__(self):
+        return f"split({', '.join(map(repr, self.children))})"

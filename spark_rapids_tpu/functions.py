@@ -425,3 +425,30 @@ def element_at(arr, i):
 def array_contains(arr, value):
     from spark_rapids_tpu.expr.complexexprs import ArrayContains
     return ArrayContains(_e(arr), _v(value))
+
+
+def bround(c, scale: int = 0):
+    from spark_rapids_tpu.expr.mathexprs import BRound
+    return BRound(_e(c), scale)
+
+
+def split(c, pattern: str, limit: int = -1):
+    from spark_rapids_tpu.expr.core import Literal
+    from spark_rapids_tpu.expr.strings import StringSplit
+    return StringSplit(_e(c), Literal(pattern),
+                       Literal(limit) if limit != -1 else None)
+
+
+def isin(c, values):
+    from spark_rapids_tpu.expr.predicates import InSet
+    return InSet(_e(c), list(values))
+
+
+def time_add(ts, interval_us):
+    from spark_rapids_tpu.expr.datetime import TimeAdd
+    return TimeAdd(_e(ts), _e(interval_us))
+
+
+def date_add_interval(d, days):
+    from spark_rapids_tpu.expr.datetime import DateAddInterval
+    return DateAddInterval(_e(d), _e(days))

@@ -1043,6 +1043,46 @@ def _register_round2():
         CX.GetArrayItem: _get_array_item_host,
         CX.Size: _size_host,
     })
+    from spark_rapids_tpu.expr.strings import StringSplit, java_split
+    from spark_rapids_tpu.expr.mathexprs import BRound
+    from spark_rapids_tpu.expr.predicates import InSet
+    from spark_rapids_tpu.expr.datetime import DateAddInterval, TimeAdd
+
+    def _split_host(expr, kids, n):
+        pat, lim = expr.pattern_limit()
+        return HostCol([None if v is None else java_split(v, pat, lim)
+                        for v in kids[0].data], expr.dtype)
+
+    _DISPATCH.update({
+        StringSplit: _split_host,
+        BRound: _unary(lambda e, v: _bround_half_even(e, v)),
+        InSet: _in,
+        TimeAdd: _binary(lambda e, x, y: int(x) + int(y)),
+        DateAddInterval: _binary(lambda e, x, y: int(x) + int(y)),
+    })
+
+
+def _bround_half_even(expr, v):
+    """Spark bround: HALF_EVEN (banker's), the host oracle for BRound."""
+    import decimal as _dec
+    d = expr.digits
+    src = expr.children[0].dtype
+    if isinstance(src, T.IntegralType):
+        if d >= 0:
+            return v
+        q = _dec.Decimal(int(v)).scaleb(d).quantize(
+            _dec.Decimal(1), rounding=_dec.ROUND_HALF_EVEN)
+        return _wrap_int(src, int(q) * (10 ** (-d)))
+    if isinstance(src, T.DecimalType):
+        ds = src.scale - d
+        if ds <= 0:
+            return v
+        q = _dec.Decimal(int(v)).scaleb(-ds).quantize(
+            _dec.Decimal(1), rounding=_dec.ROUND_HALF_EVEN)
+        return int(q) * (10 ** ds)
+    q = _dec.Decimal(repr(float(v))).quantize(
+        _dec.Decimal(1).scaleb(-d), rounding=_dec.ROUND_HALF_EVEN)
+    return float(q)
 
 
 def _round_half_up(expr, v):

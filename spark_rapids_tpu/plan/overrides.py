@@ -403,12 +403,35 @@ def _register_all():
                 "nested values have no flat device form; only fused "
                 "create+extract pairs run on device (struct(..).f, arr[i])")
 
-    def tag_extract(meta):
+    def tag_split(meta):
+        import re as _re
         e = meta.expr
-        if not isinstance(e.children[0],
-                          (CX.CreateNamedStruct, CX.CreateArray)):
+        if not isinstance(e.children[1], E.Literal):
+            meta.will_not_work("split pattern must be a literal")
+            return
+        try:
+            _re.compile(e.children[1].value)
+        except _re.error as err:
+            # neither side supports non-python regex syntax (the host oracle
+            # uses the same `re` engine — documented engine limitation,
+            # docs/compatibility.md, same as the regexp_* functions)
+            meta.will_not_work(f"pattern not supported (python regex): {err}")
+            return
+        tag_create(meta)  # fused-only, same parent rule as CreateArray
+
+    def tag_extract(meta):
+        from spark_rapids_tpu.expr.strings import StringSplit as _Split
+        e = meta.expr
+        ok = (CX.CreateNamedStruct, CX.CreateArray)
+        if isinstance(e, (CX.GetArrayItem, CX.Size)):
+            ok = ok + (_Split,)          # fused split(...)[i] / size(split)
+        if not isinstance(e.children[0], ok):
             meta.will_not_work(
                 "extraction from a materialized nested column runs on host")
+        if isinstance(e.children[0], _Split) and isinstance(
+                e, CX.GetArrayItem) and not isinstance(
+                e.children[1], E.Literal):
+            meta.will_not_work("split(...)[i] needs a literal index")
 
     nested_ok = TS.ALL + TS.NESTED
     ex(CX.CreateNamedStruct, "struct construction (fused)", nested_ok,
@@ -425,6 +448,24 @@ def _register_all():
        None, tag_extract)
     ex(CX.ArrayContains, "array membership (fused)", TS.BOOLEAN, nested_ok,
        None, tag_extract)
+    ex(S.StringSplit, "split to array (fused extract only)", nested_ok,
+       TS.STRING + TS.INTEGRAL, None, tag_split)
+    def tag_bround(meta):
+        e = meta.expr
+        if (isinstance(e.children[0].dtype, T.FractionalType)
+                and e.digits != 0):
+            meta.will_not_work(
+                "bround on floats with digits != 0 uses decimal-string tie "
+                "semantics the device cannot reproduce (runs on host)")
+    ex(MM.BRound, "half-even rounding", num + TS.DECIMAL, num + TS.DECIMAL,
+       None, tag_bround)
+    ex(P.InSet, "optimized literal-set membership", TS.BOOLEAN, ordr)
+    ex(DT.TimeAdd, "timestamp + literal interval",
+       TS.TypeSig([T.TimestampType]),
+       TS.TypeSig([T.TimestampType, T.LongType, T.IntegerType]))
+    ex(DT.DateAddInterval, "date + literal day interval",
+       TS.TypeSig([T.DateType]),
+       TS.TypeSig([T.DateType, T.IntegerType, T.LongType]))
 
     from spark_rapids_tpu.udf.python_runtime import PythonUDF
 

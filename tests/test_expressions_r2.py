@@ -458,3 +458,91 @@ def test_fused_element_at_through_planner():
     out = df.collect().to_pylist()
     assert [r["e"] for r in out] == [9, None, 7]
     assert [r["c"] for r in out] == [False, True, False]
+
+
+# -- round-2b additions: BRound, InSet, StringSplit, TimeAdd, DateAddInterval
+
+
+@pytest.fixture
+def spark():
+    from spark_rapids_tpu.session import TpuSession
+    return TpuSession()
+
+
+def test_bround_half_even(spark):
+    df = spark.create_dataframe({"x": pa.array(
+        [0.5, 1.5, 2.5, -0.5, -1.5, 2.675, 1.25])})
+    out = df.select(F.alias(F.bround(F.col("x"), 0), "r"),
+                    F.alias(F.bround(F.col("x"), 1), "r1")).collect()
+    assert out["r"].to_pylist() == [0.0, 2.0, 2.0, -0.0, -2.0, 3.0, 1.0]
+    assert out["r1"].to_pylist() == [0.5, 1.5, 2.5, -0.5, -1.5, 2.7, 1.2]
+
+
+def test_bround_integral(spark):
+    df = spark.create_dataframe({"x": pa.array([125, 135, -125, 7],
+                                               pa.int64())})
+    out = df.select(F.alias(F.bround(F.col("x"), -1), "r")).collect()
+    assert out["r"].to_pylist() == [120, 140, -120, 10]
+
+
+def test_inset(spark):
+    df = spark.create_dataframe({"x": pa.array([1, 2, 3, None, 5],
+                                               pa.int64())})
+    fdf = df.filter(F.isin(F.col("x"), {1, 5, 9}))
+    assert sorted(fdf.collect()["x"].to_pylist()) == [1, 5]
+    plan = fdf.explain()
+    assert "will run on TPU" in plan
+
+
+def test_string_split_fused_extract(spark):
+    df = spark.create_dataframe({"s": pa.array(
+        ["a,b,c", "x", "", None, "p,q"])})
+    out = df.select(
+        F.alias(F.element_at0(F.split(F.col("s"), ","), 0), "p0"),
+        F.alias(F.element_at0(F.split(F.col("s"), ","), 1), "p1"),
+        F.alias(F.size(F.split(F.col("s"), ",")), "n")).collect()
+    assert out["p0"].to_pylist() == ["a", "x", "", None, "p"]
+    assert out["p1"].to_pylist() == ["b", None, None, None, "q"]
+    assert out["n"].to_pylist() == [3, 1, 1, -1, 2]
+
+
+def test_string_split_matches_host_oracle(spark):
+    df = spark.create_dataframe({"s": pa.array(
+        ["a-b-c-d", "--x--", "no delim", None] * 5)})
+    q = df.select(F.alias(F.element_at0(F.split(F.col("s"), "-"), 2), "p"))
+    assert q.collect()["p"].to_pylist() == \
+        q.collect_host()["p"].to_pylist()
+
+
+def test_time_add_and_date_add_interval(spark):
+    import datetime
+    ts = [datetime.datetime(2020, 1, 1, 12, 0, 0), None]
+    df = spark.create_dataframe({
+        "t": pa.array(ts, pa.timestamp("us")),
+        "d": pa.array([datetime.date(2020, 1, 1), None], pa.date32())})
+    hour_us = 3600 * 1000000
+    out = df.select(
+        F.alias(F.time_add(F.col("t"), F.lit(hour_us)), "t2"),
+        F.alias(F.date_add_interval(F.col("d"), F.lit(10)), "d2")).collect()
+    got = out["t2"].to_pylist()
+    assert got[1] is None
+    assert got[0].replace(tzinfo=None) == datetime.datetime(2020, 1, 1, 13)
+    assert out["d2"].to_pylist() == [datetime.date(2020, 1, 11), None]
+
+
+def test_java_split_limit_semantics():
+    from spark_rapids_tpu.expr.strings import java_split
+    assert java_split("a,b,c", ",", 1) == ["a,b,c"]
+    assert java_split("a,b,c", ",", 2) == ["a", "b,c"]
+    assert java_split("a,b,c", ",", -1) == ["a", "b", "c"]
+    assert java_split("a,,", ",", 0) == ["a"]       # trailing empties drop
+    assert java_split("a,,", ",", -1) == ["a", "", ""]
+    assert java_split("", ",", 0) == [""]           # Java quirk
+    assert java_split(",", ",", 0) == []
+
+
+def test_bround_fractional_nonzero_digits_host_fallback(spark):
+    df = spark.create_dataframe({"x": pa.array([25.0, 35.0, 2.675])})
+    q = df.select(F.alias(F.bround(F.col("x"), -1), "r"))
+    assert "runs on host" in q.explain()
+    assert q.collect()["r"].to_pylist() == [20.0, 40.0, 0.0]
