@@ -10,9 +10,11 @@ program unpacks bits and gathers dictionary values (ops/parquet_decode.py).
 The parquet dictionary page maps 1:1 onto the engine's own dictionary-encoded
 string representation, so a string column never materializes per-row bytes.
 
-Scope (stage one): UNCOMPRESSED chunks, RLE_DICTIONARY-encoded data pages
-(v1), flat schemas, physical types INT32/INT64/FLOAT/DOUBLE/BYTE_ARRAY.
-Anything else falls back to the arrow decode path per column chunk.
+Scope: UNCOMPRESSED / SNAPPY / GZIP / ZSTD chunks (compressed page bodies
+decompress on host through arrow's C codecs — stage 1.5; the reference uses
+nvcomp on GPU), RLE_DICTIONARY-encoded data pages (v1), flat schemas,
+physical types INT32/INT64/FLOAT/DOUBLE/BYTE_ARRAY. Anything else falls
+back to the arrow decode path per column chunk.
 """
 
 from __future__ import annotations
@@ -220,16 +222,27 @@ def _decode_plain_dictionary(physical_type: str, raw: bytes, n: int):
 
 def read_chunk_pages(path: str, row_group: int, column: int,
                      md=None) -> ChunkPages:
-    """Parse one UNCOMPRESSED, dictionary-encoded column chunk into its raw
-    device-ready pieces. Raises NotImplementedError when out of stage-one
-    scope (caller falls back to arrow decode). `md` avoids re-parsing the
+    """Parse one dictionary-encoded column chunk (UNCOMPRESSED, or
+    SNAPPY/GZIP/ZSTD with page bodies decompressed on host) into its raw
+    device-ready pieces. Raises NotImplementedError when out of scope
+    (caller falls back to arrow decode). `md` avoids re-parsing the
     footer per chunk (wide-table footers are MBs)."""
     if md is None:
         import pyarrow.parquet as pq
         md = pq.ParquetFile(path).metadata
     col = md.row_group(row_group).column(column)
+    dec = None
     if col.compression != "UNCOMPRESSED":
-        raise NotImplementedError(f"codec {col.compression}")
+        # stage 1.5: page bodies decompress on host via arrow's C codecs
+        # (the reference decompresses on GPU through nvcomp; the DECODE —
+        # the bulk bit work — still runs on device either way)
+        import pyarrow as pa
+        if col.compression not in ("SNAPPY", "GZIP", "ZSTD"):
+            raise NotImplementedError(f"codec {col.compression}")
+        try:
+            dec = pa.Codec(col.compression.lower())
+        except Exception as e:
+            raise NotImplementedError(f"codec {col.compression}: {e}")
     if "RLE_DICTIONARY" not in col.encodings and \
             "PLAIN_DICTIONARY" not in col.encodings:
         raise NotImplementedError(f"encodings {col.encodings}")
@@ -248,14 +261,18 @@ def read_chunk_pages(path: str, row_group: int, column: int,
 
     # fast path: one native C call scans the whole chunk (thrift headers,
     # def-level RLE decode, hybrid segmentation — native/parquet_host.cpp);
-    # the Python loop below is the fallback and the executable spec
-    try:
-        from spark_rapids_tpu.native import (NativeBuildError,
-                                             scan_chunk_native)
-        raw_pages, dict_info = scan_chunk_native(buf, col.num_values, max_def)
-    except (NativeBuildError, OSError):
-        pass  # no native toolchain: parse in Python below
-    else:
+    # the Python loop below is the fallback, the executable spec, and the
+    # compressed-chunk path (bodies must decompress before scanning)
+    raw_pages = None
+    if dec is None:  # compressed bodies must decompress before scanning
+        try:
+            from spark_rapids_tpu.native import (NativeBuildError,
+                                                 scan_chunk_native)
+            raw_pages, dict_info = scan_chunk_native(buf, col.num_values,
+                                                     max_def)
+        except (NativeBuildError, OSError):
+            pass  # no native toolchain: parse in Python below
+    if raw_pages is not None:
         d_off, d_len, d_n = dict_info
         dict_vals = _decode_plain_dictionary(
             col.physical_type, buf[d_off:d_off + d_len], d_n)
@@ -274,15 +291,17 @@ def read_chunk_pages(path: str, row_group: int, column: int,
     while pos < len(buf) and values_seen < col.num_values:
         ph = parse_page_header(buf, pos)
         body = pos + ph.header_len
+        raw_body = buf[body:body + ph.compressed_size]
+        page_body = (raw_body if dec is None else
+                     bytes(dec.decompress(raw_body, ph.uncompressed_size)))
         if ph.page_type == 2:                       # dictionary page
             dict_vals = _decode_plain_dictionary(
-                col.physical_type, buf[body:body + ph.compressed_size],
-                ph.num_values)
+                col.physical_type, page_body, ph.num_values)
         elif ph.page_type == 0:                     # data page v1
             if ph.encoding not in (8, 2):           # RLE_DICT / PLAIN_DICT
                 raise NotImplementedError(f"page encoding {ph.encoding}")
             # work PAGE-relative so RleSegment offsets index page_bytes
-            page_bytes = buf[body:body + ph.compressed_size]
+            page_bytes = page_body
             p = 0
             if max_def:
                 # optional-field def levels: RLE with 4-byte length prefix

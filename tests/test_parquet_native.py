@@ -63,14 +63,41 @@ def test_multi_page_and_row_groups(tmp_path):
         assert got.column(name).to_pylist() == t.column(name).to_pylist(), name
 
 
-def test_compressed_falls_back_per_column(tmp_path):
-    """Snappy chunks are out of stage-one scope: the arrow fallback must
-    produce identical results through the same entry point."""
+def test_snappy_chunks_decode_on_device(tmp_path):
+    """Stage 1.5: snappy page bodies decompress on host (arrow C codec) and
+    the decode still runs on device; results identical to the source."""
     t = mixed_table(1000, seed=3)
     f = str(tmp_path / "snappy.parquet")
     pq.write_table(t, f, compression="SNAPPY", use_dictionary=True)
     schema = T.StructType.from_arrow(t.schema)
+    # the chunk parser itself accepts the compressed chunk (no fallback)
+    pages = PN.read_chunk_pages(f, 0, 0)
+    assert pages.num_values == 1000
     out = PN.read_row_group_device(f, 0, schema).to_arrow()
+    for name in t.column_names:
+        assert out.column(name).to_pylist() == t.column(name).to_pylist(), name
+
+
+@pytest.mark.parametrize("codec", ["GZIP", "ZSTD"])
+def test_gzip_zstd_chunks_decode_on_device(tmp_path, codec):
+    t = mixed_table(800, seed=6)
+    f = str(tmp_path / f"{codec.lower()}.parquet")
+    pq.write_table(t, f, compression=codec, use_dictionary=True)
+    assert PN.read_chunk_pages(f, 0, 0).num_values == 800
+    schema = T.StructType.from_arrow(t.schema)
+    out = PN.read_row_group_device(f, 0, schema).to_arrow()
+    for name in t.column_names:
+        assert out.column(name).to_pylist() == t.column(name).to_pylist(), name
+
+
+def test_unsupported_codec_falls_back_per_column(tmp_path):
+    t = mixed_table(500, seed=4)
+    f = str(tmp_path / "brotli.parquet")
+    pq.write_table(t, f, compression="BROTLI", use_dictionary=True)
+    with pytest.raises(NotImplementedError):
+        PN.read_chunk_pages(f, 0, 0)
+    schema = T.StructType.from_arrow(t.schema)
+    out = PN.read_row_group_device(f, 0, schema).to_arrow()  # arrow path
     for name in t.column_names:
         assert out.column(name).to_pylist() == t.column(name).to_pylist(), name
 
