@@ -9,7 +9,9 @@ bits go to the device (ops/orc_decode.py: MSB bit-unpack + zigzag).
 
 Stage-one scope: UNCOMPRESSED files, flat schemas, INT/LONG columns with
 DIRECT_V2 encoding (RLEv2 sub-encodings SHORT_REPEAT, DIRECT, DELTA;
-PATCHED_BASE falls back), FLOAT/DOUBLE raw-IEEE streams, PRESENT
+PATCHED_BASE falls back), FLOAT/DOUBLE raw-IEEE streams,
+DICTIONARY_V2 strings (the ORC dictionary maps 1:1 onto the engine's
+sorted string dictionary — per-row bytes never materialize), PRESENT
 (boolean-RLE) null streams. Anything else falls back to the pyarrow ORC
 reader PER COLUMN, the same granularity as the parquet path."""
 
@@ -89,8 +91,10 @@ class OrcMeta:
 # type kinds
 K_SHORT, K_INT, K_LONG = 2, 3, 4
 K_FLOAT, K_DOUBLE = 5, 6
+K_STRING = 7
 # stream kinds
 S_PRESENT, S_DATA = 0, 1
+S_LENGTH, S_DICT_DATA = 2, 3
 # column encodings
 E_DIRECT, E_DICTIONARY, E_DIRECT_V2, E_DICTIONARY_V2 = 0, 1, 2, 3
 
@@ -183,11 +187,13 @@ def _read_stripe_footer(raw: bytes, si: StripeInfo):
                     length = v
             streams.append((kind, col, length))
         elif fnum == 2:
-            enc = 0
+            enc = dict_size = 0
             for f2, _w2, v in _ProtoReader(val).fields():
                 if f2 == 1:
                     enc = v
-            encodings.append(enc)
+                elif f2 == 2:
+                    dict_size = v
+            encodings.append((enc, dict_size))
     return streams, encodings
 
 
@@ -308,7 +314,8 @@ def scan_rlev2(buf: bytes, start: int, end: int, n_values: int,
 
 def intv2_column_to_device(raw: bytes, data_off: int, data_len: int,
                            present: np.ndarray | None, n_rows: int,
-                           spark_type, capacity: int, raw_dev=None):
+                           spark_type, capacity: int, raw_dev=None,
+                           signed: bool = True, return_raw: bool = False):
     """One INT/LONG DIRECT_V2 column chunk → TpuColumnVector: run headers
     host-side, DIRECT payload bits unpacked on device, const runs merged.
     `raw_dev` is the stripe's device-resident byte array (uploaded ONCE per
@@ -319,7 +326,7 @@ def intv2_column_to_device(raw: bytes, data_off: int, data_len: int,
     from spark_rapids_tpu.ops import parquet_decode as PD
 
     n_present = n_rows if present is None else int(present.sum())
-    runs = scan_rlev2(raw, data_off, data_off + data_len, n_present, True)
+    runs = scan_rlev2(raw, data_off, data_off + data_len, n_present, signed)
     pcap = max(bucket_capacity(max(n_present, 1)), 8)
     bit_offsets = np.zeros(pcap, np.int64)
     widths = np.zeros(pcap, np.int64)
@@ -340,7 +347,9 @@ def intv2_column_to_device(raw: bytes, data_off: int, data_len: int,
                 else jnp.asarray(np.frombuffer(raw, np.uint8)))
     present_vals = OD.decode_intv2_device(
         packed_d, jnp.asarray(bit_offsets), jnp.asarray(widths),
-        jnp.asarray(const_mask), jnp.asarray(const_vals), True, pcap)
+        jnp.asarray(const_mask), jnp.asarray(const_vals), signed, pcap)
+    if return_raw:
+        return present_vals, n_present, pcap
     if present is None:
         vals = jnp.zeros((capacity,), jnp.int64).at[:pcap].set(
             present_vals)[:capacity]
@@ -389,7 +398,8 @@ def float_column_to_device(raw: bytes, data_off: int, data_len: int,
 
 
 _KIND_TO_TYPE = {K_SHORT: T.INT, K_INT: T.INT, K_LONG: T.LONG,
-                 K_FLOAT: T.FLOAT, K_DOUBLE: T.DOUBLE}
+                 K_FLOAT: T.FLOAT, K_DOUBLE: T.DOUBLE,
+                 K_STRING: T.STRING}
 
 
 def read_stripe_device(path: str, meta: OrcMeta, stripe_idx: int, schema,
@@ -436,7 +446,8 @@ def read_stripe_device(path: str, meta: OrcMeta, stripe_idx: int, schema,
             want = _KIND_TO_TYPE.get(kind)
             if want is None or type(want) is not type(sf_type):
                 raise NotImplementedError(f"kind {kind} vs {sf_type}")
-            enc = encodings[col_id] if col_id < len(encodings) else 0
+            enc, dict_size = (encodings[col_id]
+                              if col_id < len(encodings) else (0, 0))
             present = None
             if (S_PRESENT, col_id) in offsets:
                 poff, plen = offsets[(S_PRESENT, col_id)]
@@ -451,6 +462,15 @@ def read_stripe_device(path: str, meta: OrcMeta, stripe_idx: int, schema,
                 cols.append(intv2_column_to_device(
                     raw, doff, dlen, present, n_rows, sf_type, cap,
                     raw_dev=raw_dev))
+            elif kind == K_STRING:
+                if enc != E_DICTIONARY_V2:
+                    raise NotImplementedError(f"string encoding {enc}")
+                if raw_dev is None:
+                    import jax.numpy as jnp
+                    raw_dev = jnp.asarray(np.frombuffer(raw, np.uint8))
+                cols.append(string_column_to_device(
+                    raw, offsets, col_id, present, n_rows, cap,
+                    raw_dev=raw_dev, n_dict=dict_size))
             else:
                 cols.append(float_column_to_device(
                     raw, doff, dlen, present, n_rows, sf_type, cap))
@@ -463,3 +483,71 @@ def read_stripe_device(path: str, meta: OrcMeta, stripe_idx: int, schema,
             cols.append(array_to_device(arr, sf_type, cap))
         fields.append(f_)
     return ColumnarBatch(cols, n_rows, T.StructType(fields))
+
+
+def rlev2_decode_host(raw: bytes, off: int, length: int, n: int,
+                      signed: bool) -> np.ndarray:
+    """Fully host-materialized RLEv2 decode (small streams: LENGTH etc.)."""
+    out = np.zeros(n, np.int64)
+    at = 0
+    for run in scan_rlev2(raw, off, off + length, n, signed):
+        if run[0] == "direct":
+            _k, cnt, w, bit0 = run
+            vals = _unpack_msb_host(raw, bit0 // 8, w, cnt)
+            if bit0 % 8:
+                raise NotImplementedError("unaligned direct run")
+            if signed:
+                vals = (vals >> 1) ^ -(vals & 1)
+            out[at:at + cnt] = vals
+        else:
+            out[at:at + run[1]] = run[2]
+        at += run[1]
+    return out
+
+
+def string_column_to_device(raw: bytes, offsets: dict, col_id: int,
+                            present: np.ndarray | None, n_rows: int,
+                            capacity: int, raw_dev=None,
+                            n_dict: int = 0):
+    """DICTIONARY_V2 string column → engine string vector. The ORC
+    dictionary (DICTIONARY_DATA + LENGTH streams, entry count from the
+    stripe footer's ColumnEncoding.dictionarySize) maps 1:1 onto the
+    engine's sorted string dictionary — per-row bytes never materialize,
+    exactly like the parquet path (io/parquet_native.py chunk_to_device).
+    Indices (DATA stream, unsigned RLEv2) decode on device."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar.vector import TpuColumnVector
+    from spark_rapids_tpu.ops import parquet_decode as PD
+
+    if (S_DICT_DATA, col_id) not in offsets or \
+            (S_LENGTH, col_id) not in offsets or n_dict <= 0:
+        raise NotImplementedError("direct-encoded strings: host path")
+    ddoff, ddlen = offsets[(S_DICT_DATA, col_id)]
+    loff, llen = offsets[(S_LENGTH, col_id)]
+    doff, dlen = offsets[(S_DATA, col_id)]
+    lens = rlev2_decode_host(raw, loff, llen, n_dict, signed=False)
+    ends = np.cumsum(lens)
+    starts = ends - lens
+    blob = raw[ddoff:ddoff + ddlen]
+    entries = [blob[s:e].decode("utf-8") for s, e in zip(starts, ends)]
+    from spark_rapids_tpu.ops.strings import sorted_dict_and_rank
+    sorted_dict, rank = sorted_dict_and_rank(entries)
+
+    idx, n_present, pcap = intv2_column_to_device(
+        raw, doff, dlen, present, n_rows, T.LONG, capacity,
+        raw_dev=raw_dev, signed=False, return_raw=True)
+    safe = jnp.clip(idx.astype(jnp.int32), 0, max(n_dict - 1, 0))
+    codes_present = jnp.asarray(rank)[safe]
+    if present is None:
+        codes = jnp.zeros((capacity,), jnp.int32).at[:pcap].set(
+            codes_present)[:capacity]
+        valid = jnp.arange(capacity) < n_rows
+    else:
+        pres = jnp.zeros((capacity,), jnp.bool_).at[:n_rows].set(
+            jnp.asarray(present.astype(bool)))
+        padded = jnp.zeros((capacity,), jnp.int32).at[:pcap].set(
+            codes_present)
+        codes, valid = PD.expand_present_to_rows(padded, pres, capacity)
+    codes = jnp.where(valid, codes, 0)   # canonical-null invariant
+    cv = TpuColumnVector(T.STRING, codes, valid)
+    return cv.with_dictionary(sorted_dict)

@@ -343,16 +343,10 @@ def chunk_to_device(pages: ChunkPages, spark_type, capacity: int):
     is_string = pages.physical_type == "BYTE_ARRAY"
     sorted_dict = None
     if is_string:
-        import pyarrow as pa
-        import pyarrow.compute as pc
         # parquet dictionary == the engine's string dictionary, sorted for
         # order-preserving codes (columnar/arrow.py design)
-        dict_arr = pa.array(pages.dict_values, pa.string())
-        order = pc.array_sort_indices(dict_arr)
-        sorted_dict = dict_arr.take(order)
-        rank = np.empty(len(dict_arr), dtype=np.int32)
-        rank[order.to_numpy(zero_copy_only=False)] = np.arange(
-            len(dict_arr), dtype=np.int32)
+        from spark_rapids_tpu.ops.strings import sorted_dict_and_rank
+        sorted_dict, rank = sorted_dict_and_rank(pages.dict_values)
         dict_dev = jnp.asarray(rank)        # parquet idx -> sorted code
     else:
         dict_dev = jnp.asarray(np.asarray(pages.dict_values))

@@ -245,3 +245,18 @@ def value_transform_to_values(c: Col, fn, out_dtype: T.DataType) -> Col:
     return Col(jnp.where(validity, out_vals,
                          jnp.asarray(out_dtype.default_value(), np_dt)),
                validity, out_dtype)
+
+
+def sorted_dict_and_rank(entries):
+    """File-order dictionary entries → (sorted pa dictionary, rank array
+    mapping file-order index → sorted code). Shared by the parquet and ORC
+    device decoders (their on-disk dictionaries map 1:1 onto the engine's
+    sorted string dictionary)."""
+    import pyarrow.compute as pc
+    dict_arr = pa.array(entries, pa.string())
+    order = pc.array_sort_indices(dict_arr)
+    sorted_dict = dict_arr.take(order)
+    n = len(dict_arr)
+    rank = np.empty(max(n, 1), dtype=np.int32)
+    rank[order.to_numpy(zero_copy_only=False)] = np.arange(n, dtype=np.int32)
+    return sorted_dict, rank

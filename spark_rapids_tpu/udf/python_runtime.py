@@ -65,7 +65,13 @@ class PythonWorkerPool:
     _lock = threading.Lock()
 
     def __init__(self, max_workers: int = 4):
-        self.pool = futures.ProcessPoolExecutor(max_workers=max_workers)
+        import multiprocessing as mp
+        # spawn, never fork: the parent runs multithreaded JAX, and forking
+        # a threaded process intermittently dies with "Fatal Python error"
+        # (the reference sidesteps this the same way — its python workers
+        # are daemon-spawned fresh interpreters, python/rapids/daemon.py)
+        self.pool = futures.ProcessPoolExecutor(
+            max_workers=max_workers, mp_context=mp.get_context("spawn"))
 
     @classmethod
     def get(cls) -> "PythonWorkerPool":
