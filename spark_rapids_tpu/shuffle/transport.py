@@ -238,6 +238,20 @@ class TcpShuffleClient(ShuffleClient):
             yield ser.deserialize_batch(TableCompressionCodec.decode(blob))
 
     def fetch_serialized(self, shuffle_id, reduce_id):
+        # every socket failure — refused connect, reset/broken pipe mid-
+        # stream, timeout — must surface as TransportError: the exchange's
+        # recompute ladder (and the reference's TransferError→
+        # FetchFailedException mapping) keys on it, and a raw OSError would
+        # escape the retry entirely
+        try:
+            yield from self._fetch_serialized(shuffle_id, reduce_id)
+        except TransportError:
+            raise
+        except OSError as e:
+            raise TransportError(
+                f"peer {self.address} fetch failed: {e}") from e
+
+    def _fetch_serialized(self, shuffle_id, reduce_id):
         sock = socket.create_connection(self.address, timeout=30)
         try:
             _send_frame(sock, MSG_METADATA_REQ,

@@ -1,0 +1,140 @@
+"""Multi-process cluster ring — the reference's local-cluster mode analog
+(SURVEY.md §4 ring 3: pseudo-distributed runs exist to surface
+serialization and wire-format bugs that in-process tests can't).
+
+Real worker PROCESSES each host a block store + TCP shuffle server; the
+driver process fetches every reduce partition from every worker over real
+sockets and checks contents against independently re-generated expected
+tables. Spawn context (fresh interpreters), like the reference's executors.
+Signaling is file-based: multiprocessing queues/events shared with
+terminated children can deadlock the parent's interpreter exit.
+"""
+
+import multiprocessing as mp
+import os
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+
+def _expected_table(worker: int, rid: int) -> pa.Table:
+    rng = np.random.default_rng(worker * 100 + rid)
+    n = 50 + rid * 7
+    return pa.table({
+        "k": pa.array(rng.integers(0, 1000, n)),
+        "v": pa.array(rng.normal(size=n)),
+        "s": pa.array([f"w{worker}r{rid}x{i % 5}" for i in range(n)]),
+    })
+
+
+def _worker_main(worker: int, n_reduce: int, report_path: str):
+    """One 'executor': fill a local block store, serve it over TCP, then
+    idle until the driver terminates us."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import spark_rapids_tpu  # noqa: F401
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.shuffle.manager import ShuffleBlockStore
+    from spark_rapids_tpu.shuffle.transport import TcpTransport
+
+    store = ShuffleBlockStore.get()
+    sid = store.register_shuffle(serialized=True)
+    for rid in range(n_reduce):
+        store.write_block(sid, rid,
+                          ColumnarBatch.from_arrow(_expected_table(worker,
+                                                                   rid)))
+    transport = TcpTransport(RapidsConf(
+        {"spark.rapids.tpu.shuffle.compression.codec": "lz4"}))
+    tmp = report_path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(f"{transport.port} {sid}")
+    os.replace(tmp, report_path)
+    time.sleep(300)  # parent terminates us
+
+
+def _await_report(path: str, timeout: float = 60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            port, sid = open(path).read().split()
+            return int(port), int(sid)
+        time.sleep(0.1)
+    raise TimeoutError(path)
+
+
+def _spawn_worker(ctx, worker, n_reduce, tmp_path):
+    report = str(tmp_path / f"worker-{worker}.addr")
+    p = ctx.Process(target=_worker_main, args=(worker, n_reduce, report),
+                    daemon=True)
+    p.start()
+    return p, report
+
+
+def test_cluster_ring_cross_process_fetch(tmp_path):
+    n_workers, n_reduce = 2, 3
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.shuffle.transport import TcpTransport
+
+    ctx = mp.get_context("spawn")
+    procs = [_spawn_worker(ctx, w, n_reduce, tmp_path)
+             for w in range(n_workers)]
+    try:
+        peers = [(w, *_await_report(report))
+                 for w, (_p, report) in enumerate(procs)]
+        transport = TcpTransport(RapidsConf(
+            {"spark.rapids.tpu.shuffle.compression.codec": "lz4"}))
+        try:
+            for worker, port, sid in peers:
+                client = transport.make_client(("127.0.0.1", port))
+                for rid in range(n_reduce):
+                    batches = list(client.fetch_blocks(sid, rid))
+                    assert batches, (worker, rid)
+                    got = pa.concat_tables([b.to_arrow() for b in batches])
+                    exp = _expected_table(worker, rid)
+                    assert got.column("k").to_pylist() == \
+                        exp.column("k").to_pylist()
+                    assert got.column("s").to_pylist() == \
+                        exp.column("s").to_pylist()
+                    assert np.allclose(got.column("v").to_numpy(),
+                                       exp.column("v").to_numpy())
+        finally:
+            transport.shutdown()
+    finally:
+        for p, _ in procs:
+            p.terminate()
+            p.join(timeout=30)
+
+
+def test_cluster_ring_dead_peer_surfaces_transport_error(tmp_path):
+    """Failure-detection ring: killing a worker process turns subsequent
+    fetches into TransportError (the reference maps this to
+    FetchFailedException → stage retry, RapidsShuffleIterator.scala:82);
+    a raw ConnectionRefusedError would escape the recompute ladder."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.shuffle.transport import TcpTransport, TransportError
+
+    ctx = mp.get_context("spawn")
+    p, report = _spawn_worker(ctx, 0, 2, tmp_path)
+    try:
+        port, sid = _await_report(report)
+        transport = TcpTransport(RapidsConf())
+        try:
+            client = transport.make_client(("127.0.0.1", port))
+            assert list(client.fetch_blocks(sid, 0))   # alive: works
+            p.terminate()
+            p.join(timeout=30)
+            with pytest.raises(TransportError):
+                client2 = transport.make_client(("127.0.0.1", port))
+                list(client2.fetch_blocks(sid, 1))
+        finally:
+            transport.shutdown()
+    finally:
+        p.terminate()
+        p.join(timeout=30)
