@@ -8,8 +8,8 @@ parsed here with a minimal proto-wire reader — while the packed payload
 bits go to the device (ops/orc_decode.py: MSB bit-unpack + zigzag).
 
 Stage-one scope: UNCOMPRESSED files, flat schemas, INT/LONG columns with
-DIRECT_V2 encoding (RLEv2 sub-encodings SHORT_REPEAT, DIRECT, DELTA;
-PATCHED_BASE falls back), FLOAT/DOUBLE raw-IEEE streams,
+DIRECT_V2 encoding (all four RLEv2 sub-encodings: SHORT_REPEAT, DIRECT,
+DELTA, PATCHED_BASE), FLOAT/DOUBLE raw-IEEE streams,
 DICTIONARY_V2 strings (the ORC dictionary maps 1:1 onto the engine's
 sorted string dictionary — per-row bytes never materialize), PRESENT
 (boolean-RLE) null streams. Anything else falls back to the pyarrow ORC
@@ -25,6 +25,14 @@ MAGIC = b"ORC"
 
 # ORC "closest fixed bit width" table: 5-bit code → bit width
 _WIDTH_TABLE = list(range(1, 25)) + [26, 28, 30, 32, 40, 48, 56, 64]
+
+
+def _closest_fixed_bits(n: int) -> int:
+    """ORC getClosestFixedBits: the smallest encodable width ≥ n."""
+    for w in _WIDTH_TABLE:
+        if w >= n:
+            return w
+    return 64
 
 
 class _ProtoReader:
@@ -259,8 +267,9 @@ def scan_rlev2(buf: bytes, start: int, end: int, n_values: int,
                signed: bool):
     """Split an RLEv2 stream into runs. Returns a list of
     ('direct', count, width, payload_bit_offset) — device-unpacked — and
-    ('const', count, ndarray) — host-materialized (short-repeat/delta).
-    PATCHED_BASE raises (caller falls back per column)."""
+    ('const', count, ndarray) — host-materialized (short-repeat, delta,
+    patched-base; only widths > 56 still raise for the per-column
+    fallback)."""
     r = _ByteReader(buf, start)
     runs = []
     got = 0
@@ -305,8 +314,36 @@ def scan_rlev2(buf: bytes, start: int, end: int, n_values: int,
                 vals[2:] = vals[1] + sign * np.cumsum(deltas)
             runs.append(("const", cnt, vals))
             got += cnt
-        else:                           # PATCHED_BASE
-            raise NotImplementedError("patched-base run")
+        else:                           # PATCHED_BASE (host-materialized)
+            w = _WIDTH_TABLE[(h >> 1) & 31]
+            cnt = (((h & 1) << 8) | r.byte()) + 1
+            b3 = r.byte()
+            bw = ((b3 >> 5) & 7) + 1          # base width, bytes
+            pw = _WIDTH_TABLE[b3 & 31]        # patch width, bits
+            b4 = r.byte()
+            pgw = ((b4 >> 5) & 7) + 1         # patch gap width, bits
+            pll = b4 & 31                     # patch list length
+            if w > 56 or _closest_fixed_bits(pgw + pw) > 56:
+                raise NotImplementedError("patched-base width > 56")
+            base = int.from_bytes(buf[r.pos:r.pos + bw], "big")
+            r.pos += bw
+            sign_bit = 1 << (bw * 8 - 1)      # sign-magnitude base
+            if base & sign_bit:
+                base = -(base & (sign_bit - 1))
+            vals = _unpack_msb_host(buf, r.pos, w, cnt)
+            r.pos += (w * cnt + 7) // 8
+            # writers pack patch entries at getClosestFixedBits(pgw+pw);
+            # the gap stays in bits [pw, pw+pgw) (top padding is zero)
+            cw = _closest_fixed_bits(pgw + pw)
+            entries = _unpack_msb_host(buf, r.pos, cw, pll)
+            r.pos += (cw * pll + 7) // 8
+            at = 0
+            for e in entries:
+                at += int(e) >> pw
+                patch = int(e) & ((1 << pw) - 1)
+                vals[at] |= patch << w
+            runs.append(("const", cnt, base + vals))
+            got += cnt
     if got < n_values:
         raise NotImplementedError("short RLEv2 stream")
     return runs

@@ -146,3 +146,51 @@ def test_string_dictionary_v2_device_path(tmp_path):
                           T2.StructType([T2.StructField("s", T2.STRING)]))
     assert batch.to_arrow()["s"].to_pylist() == \
         t["s"].to_pylist()[:si.num_rows]
+
+
+def test_rlev2_patched_base_spec_golden():
+    """The official ORC spec's PATCHED_BASE example: base 2000, 8-bit
+    values, one 12-bit patch at gap 3 producing 1000000."""
+    buf = bytes([0x8e, 0x09, 0x2b, 0x21, 0x07, 0xd0, 0x1e, 0x00, 0x14,
+                 0x70, 0x28, 0x32, 0x3c, 0x46, 0x50, 0x5a, 0xfc, 0xe8])
+    runs = ON.scan_rlev2(buf, 0, len(buf), 10, True)
+    assert runs[0][0] == "const"
+    assert [int(v) for v in runs[0][2]] == \
+        [2030, 2000, 2020, 1000000, 2040, 2050, 2060, 2070, 2080, 2090]
+
+
+def test_rlev2_patched_base_nonaligned_patch_width():
+    """pgw+pw that is NOT an encodable width must read patch entries at
+    getClosestFixedBits(pgw+pw) like real writers (here 3+22=25 → 26)."""
+    import numpy as np
+
+    def pack_msb(values, width):
+        bits = []
+        for v in values:
+            bits.extend((v >> (width - 1 - i)) & 1 for i in range(width))
+        while len(bits) % 8:
+            bits.append(0)
+        by = bytearray()
+        for i in range(0, len(bits), 8):
+            by.append(int("".join(map(str, bits[i:i + 8])), 2))
+        return bytes(by)
+
+    # 6 values width 4; base 100; one patch at gap 3: patch=0x2ABCDE (22 bits)
+    w, cnt, bw, pw, pgw, pll = 4, 6, 1, 22, 3, 1
+    vals = [1, 2, 3, 4, 5, 6]
+    hdr0 = 0x80 | (3 << 1)          # enc=2, width code 3 → 4 bits, len hi 0
+    hdr1 = cnt - 1
+    hdr2 = ((bw - 1) << 5) | 21     # code 21 → 22 bits
+    hdr3 = ((pgw - 1) << 5) | pll
+    base = bytes([100])
+    payload = pack_msb(vals, w)
+    patch_val = 0x2ABCDE
+    entry = (3 << pw) | patch_val    # gap 3, patch
+    cw = ON._closest_fixed_bits(pgw + pw)
+    assert cw == 26
+    patches = pack_msb([entry], cw)
+    buf = bytes([hdr0, hdr1, hdr2, hdr3]) + base + payload + patches
+    runs = ON.scan_rlev2(buf, 0, len(buf), cnt, True)
+    got = [int(v) for v in runs[0][2]]
+    expect = [101, 102, 103, 100 + (4 | (patch_val << w)), 105, 106]
+    assert got == expect
