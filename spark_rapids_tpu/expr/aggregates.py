@@ -358,3 +358,23 @@ class StddevPop(VariancePop):
 class StddevSamp(VarianceSamp):
     def finish(self, var):
         return jnp.sqrt(var)
+
+
+class CollectList(AggregateFunction):
+    """collect_list(x) → array of non-null values per group (reference
+    GpuCollectList via cudf collect). Array results have no fixed-width
+    device form in this engine, so the planner pins the aggregate to the
+    host path (plan/nodes.py AggregateNode._agg_one)."""
+
+    @property
+    def dtype(self):
+        return T.ArrayType(self.child.dtype)
+
+    @property
+    def state_types(self):
+        raise NotImplementedError("collect_list runs on host")
+
+
+class CollectSet(CollectList):
+    """collect_set(x) — distinct non-null values (order unspecified in
+    Spark; first-seen order here)."""

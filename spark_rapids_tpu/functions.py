@@ -452,3 +452,13 @@ def time_add(ts, interval_us):
 def date_add_interval(d, days):
     from spark_rapids_tpu.expr.datetime import DateAddInterval
     return DateAddInterval(_e(d), _e(days))
+
+
+def collect_list(c):
+    from spark_rapids_tpu.expr.aggregates import CollectList
+    return CollectList(_e(c))
+
+
+def collect_set(c):
+    from spark_rapids_tpu.expr.aggregates import CollectSet
+    return CollectSet(_e(c))

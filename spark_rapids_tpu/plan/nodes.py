@@ -19,7 +19,8 @@ import pyarrow as pa
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.expr import core as E
 from spark_rapids_tpu.expr.aggregates import (
-    AggregateFunction, Average, Count, First, Max, Min, Sum,
+    AggregateFunction, Average, CollectList, CollectSet, Count, First, Last,
+    Max, Min, StddevPop, StddevSamp, Sum, VariancePop, VarianceSamp,
 )
 from spark_rapids_tpu.plan.host_eval import HostCol, eval_host
 
@@ -320,6 +321,32 @@ class AggregateNode(PlanNode):
             if f.ignore_nulls:
                 return vals[0] if vals else None
             return data.data[rows[0]] if rows else None
+        if isinstance(f, Last):
+            if f.ignore_nulls:
+                return vals[-1] if vals else None
+            return data.data[rows[-1]] if rows else None
+        if isinstance(f, CollectSet):           # before CollectList (subclass)
+            # arrays/structs are unhashable; dedupe on a structural key
+            seen, out = set(), []
+            for v in vals:
+                key = repr(v) if isinstance(v, (list, dict)) else v
+                if key not in seen:
+                    seen.add(key)
+                    out.append(v)
+            return out
+        if isinstance(f, CollectList):
+            return list(vals)
+        if isinstance(f, (VariancePop, VarianceSamp)):
+            n = len(vals)
+            # class hierarchy: StddevPop(VariancePop), StddevSamp(VarianceSamp)
+            ddof = 0 if isinstance(f, VariancePop) else 1
+            if n == 0 or n - ddof <= 0:
+                return None
+            mean = sum(float(v) for v in vals) / n
+            var = sum((float(v) - mean) ** 2 for v in vals) / (n - ddof)
+            if isinstance(f, (StddevPop, StddevSamp)):
+                return var ** 0.5
+            return var
         raise NotImplementedError(type(f).__name__)
 
     def args_string(self):

@@ -463,6 +463,13 @@ def _register_all():
     ex(DT.TimeAdd, "timestamp + literal interval",
        TS.TypeSig([T.TimestampType]),
        TS.TypeSig([T.TimestampType, T.LongType, T.IntegerType]))
+    def tag_collect(meta):
+        meta.will_not_work(
+            "collect_list/collect_set produce array results with no "
+            "fixed-width device form; the aggregate runs on host")
+    ex(AG.CollectList, "collect to array (host)", TS.ALL + TS.NESTED,
+       TS.ALL, None, tag_collect)
+
     ex(DT.DateAddInterval, "date + literal day interval",
        TS.TypeSig([T.DateType]),
        TS.TypeSig([T.DateType, T.IntegerType, T.LongType]))
@@ -532,7 +539,14 @@ def _register_all():
                                     conf=conf)
         ex = ShuffleExchangeExec(
             SP.HashPartitioner(keys, child.num_partitions), child, conf=conf)
-        if adaptive and conf.get(CFG.ADAPTIVE_COALESCE_ENABLED):
+        # explicit conf wins; otherwise the emulated Spark generation
+        # decides — AQE is default-on only since 3.2 (shims, SPARK-33679)
+        if CFG.ADAPTIVE_COALESCE_ENABLED.key in conf.settings:
+            adaptive_on = conf.get(CFG.ADAPTIVE_COALESCE_ENABLED)
+        else:
+            from spark_rapids_tpu.shims import shim_for
+            adaptive_on = shim_for(conf).adaptive_coalesce_default
+        if adaptive and adaptive_on:
             from spark_rapids_tpu.exec.exchange import AdaptiveShuffleReaderExec
             return AdaptiveShuffleReaderExec(ex, conf=conf)
         return ex

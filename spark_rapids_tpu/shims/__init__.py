@@ -27,6 +27,9 @@ class SparkShim:
     version_prefix = "3.5"
     #: accept lenient date strings ("2021-1-5", "2021/01/05") in cast
     lenient_string_to_date = False
+    #: AQE (and with it post-shuffle partition coalescing) is default-ON
+    #: only since Spark 3.2 (SPARK-33679); earlier generations must opt in
+    adaptive_coalesce_default = True
 
     def __repr__(self):
         return f"SparkShim({self.version_prefix}.x)"
@@ -35,6 +38,7 @@ class SparkShim:
 class Spark30Shim(SparkShim):
     version_prefix = "3.0"
     lenient_string_to_date = True
+    adaptive_coalesce_default = False
 
 
 class Spark32Shim(SparkShim):

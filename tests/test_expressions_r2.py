@@ -546,3 +546,28 @@ def test_bround_fractional_nonzero_digits_host_fallback(spark):
     q = df.select(F.alias(F.bround(F.col("x"), -1), "r"))
     assert "runs on host" in q.explain()
     assert q.collect()["r"].to_pylist() == [20.0, 40.0, 0.0]
+
+
+def test_collect_list_and_set(spark):
+    df = spark.create_dataframe({
+        "k": pa.array([1, 1, 2, 1, 2], pa.int64()),
+        "v": pa.array([10, 20, 30, 20, None], pa.int64())})
+    out = (df.group_by("k")
+           .agg(F.alias(F.collect_list(F.col("v")), "l"),
+                F.alias(F.collect_set(F.col("v")), "s"))
+           .collect())
+    rows = {r["k"]: r for r in out.to_pylist()}
+    assert rows[1]["l"] == [10, 20, 20] and rows[1]["s"] == [10, 20]
+    assert rows[2]["l"] == [30] and rows[2]["s"] == [30]
+
+
+def test_stddev_host_fallback_matches_device(spark):
+    import math
+    df = spark.create_dataframe({
+        "k": pa.array([1, 1, 1, 2, 2], pa.int64()),
+        "v": pa.array([1.0, 2.0, 4.0, 3.0, 5.0])})
+    q = df.group_by("k").agg(F.alias(F.stddev(F.col("v")), "s"))
+    dev = {r["k"]: r["s"] for r in q.collect().to_pylist()}
+    host = {r["k"]: r["s"] for r in q.collect_host().to_pylist()}
+    for k in dev:
+        assert math.isclose(dev[k], host[k], rel_tol=1e-9), k

@@ -113,3 +113,36 @@ def test_shim_pins_lenient_date_cast_to_host():
     # and both still answer
     assert df_old.collect().num_rows == 2
     assert df_new.collect().num_rows == 2
+
+
+def test_adaptive_default_is_version_gated():
+    """AQE coalescing defaults ON for 3.2+ and OFF for 3.0/3.1 (SPARK-33679),
+    unless the conf is set explicitly."""
+    import pyarrow as pa
+    import spark_rapids_tpu.functions as F
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.plan.overrides import TpuOverrides
+    from spark_rapids_tpu.exec.exchange import AdaptiveShuffleReaderExec
+
+    def final_agg_child(conf):
+        s = TpuSession(conf)
+        df = (s.create_dataframe({"k": pa.array([1, 2, 1], pa.int64())},
+                                 num_partitions=2)
+              .group_by("k").agg(F.alias(F.count(F.col("k")), "c")))
+        hybrid = TpuOverrides(s.conf).apply(df._plan)
+        # FINAL HashAggregate sits at/near the root; find the reader below
+        found = []
+
+        def walk(n):
+            if isinstance(n, AdaptiveShuffleReaderExec):
+                found.append(n)
+            for c in getattr(n, "children", []):
+                walk(c)
+        walk(hybrid)
+        return found
+
+    assert final_agg_child({})                                   # 3.5: on
+    assert not final_agg_child({"spark.rapids.tpu.spark.version": "3.1.2"})
+    assert final_agg_child({
+        "spark.rapids.tpu.spark.version": "3.1.2",
+        "spark.rapids.tpu.sql.adaptive.coalescePartitions.enabled": "true"})
