@@ -16,12 +16,16 @@ from spark_rapids_tpu.columnar.vector import TpuColumnVector, bucket_capacity
 
 
 class ColumnarBatch:
-    __slots__ = ("columns", "_num_rows", "schema")
+    __slots__ = ("columns", "_num_rows", "schema", "metadata")
 
-    def __init__(self, columns, num_rows, schema: T.StructType | None = None):
+    def __init__(self, columns, num_rows, schema: T.StructType | None = None,
+                 metadata: dict | None = None):
         self.columns = list(columns)
         self._num_rows = num_rows
         self.schema = schema
+        # scan provenance (input file path/offsets) for the metadata
+        # expressions (input_file_name family); None off the scan path
+        self.metadata = metadata
         if self.columns:
             cap = self.columns[0].capacity
             assert all(c.capacity == cap for c in self.columns), \
@@ -54,7 +58,8 @@ class ColumnarBatch:
         return sum(c.device_memory_size() for c in self.columns)
 
     def with_columns(self, columns, schema=None):
-        return ColumnarBatch(columns, self._num_rows, schema or self.schema)
+        return ColumnarBatch(columns, self._num_rows, schema or self.schema,
+                             metadata=self.metadata)
 
     # -- host interop -------------------------------------------------------
     def to_arrow(self):

@@ -47,7 +47,8 @@ class ProjectExec(TpuExec):
                 with trace_range("ProjectExec", self._op_time):
                     ctx = EvalContext.from_batch(batch, split, offset)
                     cols = [e.eval(ctx).to_vector() for e in self.project_list]
-                    yield ColumnarBatch(cols, batch.lazy_num_rows, self.output)
+                    yield ColumnarBatch(cols, batch.lazy_num_rows, self.output,
+                                        metadata=batch.metadata)
                 if positional:  # host sync only when an expr needs positions
                     offset += int(batch.num_rows)
         return self.wrap_output(it())
@@ -76,7 +77,7 @@ class FilterExec(TpuExec):
                     keep = selection_mask(pred, ctx.num_rows, ctx.capacity)
                     new_cols, count = compact_cols(ctx.cols, keep)
                     yield ColumnarBatch([c.to_vector() for c in new_cols], count,
-                                        self.output)
+                                        self.output, metadata=batch.metadata)
         return self.wrap_output(it())
 
     def args_string(self):
@@ -176,7 +177,8 @@ class LocalLimitExec(TpuExec):
                                                       c.dtype.default_value()),
                                             c.validity & live, c.dictionary)
                             for c in batch.columns]
-                    yield ColumnarBatch(cols, remaining, batch.schema)
+                    yield ColumnarBatch(cols, remaining, batch.schema,
+                                        metadata=batch.metadata)
                     remaining = 0
         return self.wrap_output(it())
 

@@ -241,7 +241,7 @@ class EvalContext:
     number-of-rows scalar, and the batch capacity (static)."""
 
     def __init__(self, cols, num_rows, capacity: int, split: int = 0,
-                 row_offset: int = 0):
+                 row_offset: int = 0, scan_meta: dict | None = None):
         self.cols = list(cols)
         self.num_rows = num_rows  # device or host scalar
         self.capacity = capacity
@@ -250,12 +250,15 @@ class EvalContext:
         # maintained (host-synced) when the projection contains a
         # row-position-dependent expression (monotonically_increasing_id, rand)
         self.row_offset = row_offset
+        # scan provenance (input_file_name family); None when unavailable
+        self.scan_meta = scan_meta
 
     @staticmethod
     def from_batch(batch, split: int = 0, row_offset: int = 0):
         return EvalContext([Col.from_vector(c) for c in batch.columns],
                            batch.lazy_num_rows, batch.capacity, split,
-                           row_offset)
+                           row_offset,
+                           scan_meta=getattr(batch, "metadata", None))
 
     def row_mask(self):
         """Bool mask of live (non-padding) rows."""

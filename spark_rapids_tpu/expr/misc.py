@@ -139,3 +139,106 @@ class MonotonicallyIncreasingID(Expression):
 
     def __repr__(self):
         return "monotonically_increasing_id()"
+
+
+class _ScanMetaExpr(Expression):
+    """Base for the input_file_name family (reference GpuInputFileName /
+    GpuInputFileBlockStart/Length, InputFileBlockRules): the value comes
+    from the batch's scan provenance; away from a 1:1 file↔batch scan
+    (coalescing readers, post-shuffle) Spark's own contract is the empty
+    string / -1, which is what a batch without metadata yields."""
+
+    meta_key = None
+
+    def __init__(self):
+        self.children = []
+
+    @property
+    def nullable(self):
+        return False
+
+    def with_children(self, children):
+        return type(self)()
+
+    def _meta_value(self, ctx):
+        meta = getattr(ctx, "scan_meta", None) or {}
+        return meta.get(self.meta_key)
+
+    def __repr__(self):
+        return f"{type(self).__name__.lower()}()"
+
+
+class InputFileName(_ScanMetaExpr):
+    meta_key = "input_file"
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def eval(self, ctx):
+        import pyarrow as pa
+        name = self._meta_value(ctx) or ""
+        d = pa.array([name], type=pa.string())
+        return Col(jnp.zeros((ctx.capacity,), jnp.int32),
+                   jnp.ones((ctx.capacity,), jnp.bool_), T.STRING,
+                   dictionary=d)
+
+
+class InputFileBlockStart(_ScanMetaExpr):
+    meta_key = "block_start"
+
+    @property
+    def dtype(self):
+        return T.LONG
+
+    def eval(self, ctx):
+        v = self._meta_value(ctx)
+        return Col(jnp.full((ctx.capacity,), -1 if v is None else int(v),
+                            jnp.int64),
+                   jnp.ones((ctx.capacity,), jnp.bool_), T.LONG)
+
+
+class InputFileBlockLength(InputFileBlockStart):
+    meta_key = "block_length"
+
+
+class ScalarSubquery(Expression):
+    """Scalar subquery, evaluated EAGERLY at plan-build time (Spark runs
+    subquery stages before the enclosing query; the reference's
+    GpuScalarSubquery likewise only carries the already-computed value).
+    After construction it behaves exactly like a literal."""
+
+    def __init__(self, value, dtype):
+        self.children = []
+        self.value = value
+        self._dtype = dtype
+
+    @classmethod
+    def from_dataframe(cls, df) -> "ScalarSubquery":
+        tbl = df.collect()
+        if tbl.num_columns != 1:
+            raise ValueError("scalar subquery must return one column")
+        if tbl.num_rows > 1:
+            raise ValueError(
+                "more than one row returned by a subquery used as an "
+                "expression")  # Spark's exact error condition
+        value = tbl.column(0)[0].as_py() if tbl.num_rows else None
+        return cls(value, df.schema.fields[0].data_type)
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return self.value is None
+
+    def with_children(self, children):
+        return self
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.expr.core import Literal
+        return Literal(self.value, self._dtype).eval(ctx)
+
+    def __repr__(self):
+        return f"scalar_subquery(={self.value!r})"

@@ -514,3 +514,78 @@ class StringSplit(Expression):
 
     def __repr__(self):
         return f"split({', '.join(map(repr, self.children))})"
+
+
+def json_path_get(doc: str, path: str):
+    """Spark get_json_object semantics for the common path subset:
+    $.field, $.a.b, $.a[0].b, $[1]. Returns the raw string for JSON
+    scalars, compact JSON text for objects/arrays, None for missing or
+    invalid documents."""
+    import json
+    if doc is None or not path.startswith("$"):
+        return None
+    try:
+        cur = json.loads(doc)
+    except (ValueError, TypeError):
+        return None
+    i = 1
+    n = len(path)
+    while i < n:
+        if path[i] == ".":
+            j = i + 1
+            while j < n and path[j] not in ".[":
+                j += 1
+            key = path[i + 1:j]
+            if not key or not isinstance(cur, dict) or key not in cur:
+                return None
+            cur = cur[key]
+            i = j
+        elif path[i] == "[":
+            j = path.find("]", i)
+            if j < 0:
+                return None
+            try:
+                idx = int(path[i + 1:j])
+            except ValueError:
+                return None
+            if not isinstance(cur, list) or not -len(cur) <= idx < len(cur):
+                return None
+            cur = cur[idx]
+            i = j + 1
+        else:
+            return None
+    if cur is None:
+        return None
+    if isinstance(cur, (dict, list)):
+        return json.dumps(cur, separators=(",", ":"))
+    if isinstance(cur, bool):
+        return "true" if cur else "false"
+    return str(cur)
+
+
+class GetJsonObject(Expression):
+    """get_json_object(json, path) with a literal path (reference
+    GpuGetJsonObject; cudf's parser has the same literal-path limit).
+    Dictionary transform: each distinct document parses once."""
+
+    out_dtype = T.STRING
+
+    def __init__(self, child, path):
+        self.children = [child, path]
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    def with_children(self, children):
+        return GetJsonObject(children[0], children[1])
+
+    def eval(self, ctx):
+        path = self.children[1]
+        assert isinstance(path, Literal), "json path must be a literal"
+        c = self.children[0].eval(ctx)
+        return S.dict_transform_to_string(
+            c, lambda s: json_path_get(s, path.value))
+
+    def __repr__(self):
+        return f"get_json_object({self.children[0]!r}, {self.children[1]!r})"

@@ -462,3 +462,31 @@ def collect_list(c):
 def collect_set(c):
     from spark_rapids_tpu.expr.aggregates import CollectSet
     return CollectSet(_e(c))
+
+
+def get_json_object(c, path: str):
+    from spark_rapids_tpu.expr.core import Literal
+    from spark_rapids_tpu.expr.strings import GetJsonObject
+    return GetJsonObject(_e(c), Literal(path))
+
+
+def input_file_name():
+    from spark_rapids_tpu.expr.misc import InputFileName
+    return InputFileName()
+
+
+def input_file_block_start():
+    from spark_rapids_tpu.expr.misc import InputFileBlockStart
+    return InputFileBlockStart()
+
+
+def input_file_block_length():
+    from spark_rapids_tpu.expr.misc import InputFileBlockLength
+    return InputFileBlockLength()
+
+
+def scalar_subquery(df):
+    """Evaluate a 1-column DataFrame eagerly as a scalar expression (Spark
+    executes subquery stages first; same contract)."""
+    from spark_rapids_tpu.expr.misc import ScalarSubquery
+    return ScalarSubquery.from_dataframe(df)

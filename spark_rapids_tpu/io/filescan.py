@@ -57,6 +57,15 @@ def discover_partitions(root: str, fmt: str) -> list[FilePartition]:
     return out
 
 
+
+
+def _scan_meta(path: str) -> dict:
+    """Scan provenance for the input_file_name expression family; whole-file
+    reads expose the file as one block (Spark: split start/length)."""
+    return {"input_file": path, "block_start": 0,
+            "block_length": os.path.getsize(path)}
+
+
 def _infer_partition_type(values: list) -> T.DataType:
     try:
         for v in values:
@@ -248,11 +257,14 @@ class FileSourceScanExec(TpuExec):
         def it():
             cols = node._data_columns()
             for path, pf, n_groups in files:
+                meta = _scan_meta(path)
                 for rg in range(n_groups):
                     acquire_semaphore(self.metrics)
                     with trace_range("FileScan.devdecode", self._scan_time):
-                        yield PN.read_row_group_device(
+                        batch = PN.read_row_group_device(
                             path, rg, self.output, cols, pf=pf)
+                    batch.metadata = meta
+                    yield batch
         return it()
 
     def _csv_device_decode_batches(self, split):
@@ -281,11 +293,13 @@ class FileSourceScanExec(TpuExec):
         from spark_rapids_tpu.columnar.vector import bucket_capacity
 
         def it():
-            for shape in shapes:
+            for path, shape in zip(part.paths, shapes):
                 acquire_semaphore(self.metrics)
                 with trace_range("FileScan.csvdevdecode", self._scan_time):
-                    yield CN.decode_shape_device(shape, schema,
-                                                 bucket_capacity)
+                    batch = CN.decode_shape_device(shape, schema,
+                                                   bucket_capacity)
+                batch.metadata = _scan_meta(path)
+                yield batch
         return it()
 
     def _orc_device_decode_batches(self, split, batch_rows, batch_bytes):
@@ -317,14 +331,17 @@ class FileSourceScanExec(TpuExec):
             import pyarrow.orc as orc
             for path, meta in zip(part.paths, metas):
                 pf = None
+                fmeta = _scan_meta(path)
                 for si_ in range(len(meta.stripes)):
                     acquire_semaphore(self.metrics)
                     with trace_range("FileScan.orcdevdecode",
                                      self._scan_time):
                         if pf is None:
                             pf = orc.ORCFile(path)
-                        yield ON.read_stripe_device(path, meta, si_,
-                                                    schema, pf=pf)
+                        batch = ON.read_stripe_device(path, meta, si_,
+                                                      schema, pf=pf)
+                    batch.metadata = fmeta
+                    yield batch
         return it()
 
     def execute_partition(self, split):
@@ -350,13 +367,20 @@ class FileSourceScanExec(TpuExec):
             if dev_it is not None:
                 return self.wrap_output(dev_it)
 
+        part = self.node.partitions[split]
+        # 1:1 provenance is provable only for single-file partitions on the
+        # host reader path (multi-file strategies may stitch files)
+        host_meta = _scan_meta(part.paths[0]) if len(part.paths) == 1 else None
+
         def it():
             for tbl in self.node.tables_for(
                     split, batch_rows, strategy, threads,
                     rebase_mode=conf.get(CFG.PARQUET_REBASE_MODE)):
                 acquire_semaphore(self.metrics)
                 with trace_range("FileScan.h2d", self._scan_time):
-                    yield ColumnarBatch.from_arrow(tbl, self.output)
+                    batch = ColumnarBatch.from_arrow(tbl, self.output)
+                batch.metadata = host_meta
+                yield batch
         return self.wrap_output(it())
 
     def args_string(self):

@@ -1053,7 +1053,26 @@ def _register_round2():
         return HostCol([None if v is None else java_split(v, pat, lim)
                         for v in kids[0].data], expr.dtype)
 
+    from spark_rapids_tpu.expr.strings import GetJsonObject, json_path_get
+
+    def _json_host(expr, kids, n):
+        path = expr.children[1].value
+        return HostCol([json_path_get(v, path) for v in kids[0].data],
+                       T.STRING)
+
+    def _scan_meta_host(expr, kids, n):
+        # host fallback has no batch provenance — Spark's own away-from-scan
+        # contract: "" / -1 (docs/compatibility.md)
+        if isinstance(expr, MX.InputFileName):
+            return HostCol([""] * n, T.STRING)
+        return HostCol([-1] * n, T.LONG)
+
     _DISPATCH.update({
+        MX.ScalarSubquery: lambda e, kids, n: HostCol([e.value] * n, e.dtype),
+        MX.InputFileName: _scan_meta_host,
+        MX.InputFileBlockStart: _scan_meta_host,
+        MX.InputFileBlockLength: _scan_meta_host,
+        GetJsonObject: _json_host,
         StringSplit: _split_host,
         BRound: _unary(lambda e, v: _bround_half_even(e, v)),
         InSet: _in,

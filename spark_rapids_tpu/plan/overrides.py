@@ -381,6 +381,12 @@ def _register_all():
     ex(MX.Rand, "uniform random (per-partition stream, like the reference "
        "NOT bit-identical with CPU Spark)", TS.FRACTIONAL)
     ex(MX.SparkPartitionID, "partition id", TS.TypeSig([T.IntegerType]))
+    ex(MX.InputFileName, "scan provenance: file path", TS.STRING)
+    ex(MX.ScalarSubquery, "pre-executed scalar subquery value", TS.ALL)
+    ex(MX.InputFileBlockStart, "scan provenance: block start",
+       TS.TypeSig([T.LongType]))
+    ex(MX.InputFileBlockLength, "scan provenance: block length",
+       TS.TypeSig([T.LongType]))
     ex(MX.MonotonicallyIncreasingID, "monotonically increasing id",
        TS.TypeSig([T.LongType]))
 
@@ -463,6 +469,13 @@ def _register_all():
     ex(DT.TimeAdd, "timestamp + literal interval",
        TS.TypeSig([T.TimestampType]),
        TS.TypeSig([T.TimestampType, T.LongType, T.IntegerType]))
+    def tag_json(meta):
+        if not isinstance(meta.expr.children[1], E.Literal):
+            meta.will_not_work("json path must be a literal (reference "
+                               "GpuGetJsonObject has the same limit)")
+    ex(S.GetJsonObject, "JSON path extraction", TS.STRING, TS.STRING,
+       None, tag_json)
+
     def tag_collect(meta):
         meta.will_not_work(
             "collect_list/collect_set produce array results with no "

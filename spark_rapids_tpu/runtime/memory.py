@@ -64,6 +64,7 @@ class HostBatch:
     columns: list
     num_rows: int
     schema: typing.Any
+    metadata: typing.Any = None   # scan provenance (input_file_name family)
 
     def nbytes(self) -> int:
         out = 0
@@ -77,13 +78,15 @@ class HostBatch:
 def batch_to_host(batch: ColumnarBatch) -> HostBatch:
     cols = [HostColumn(c.dtype, np.asarray(c.data), np.asarray(c.validity), c.dictionary)
             for c in batch.columns]
-    return HostBatch(cols, batch.num_rows, batch.schema)
+    return HostBatch(cols, batch.num_rows, batch.schema,
+                     getattr(batch, "metadata", None))
 
 
 def host_to_batch(hb: HostBatch) -> ColumnarBatch:
     cols = [TpuColumnVector(c.dtype, jnp.asarray(c.data), jnp.asarray(c.validity),
                             c.dictionary) for c in hb.columns]
-    return ColumnarBatch(cols, hb.num_rows, hb.schema)
+    return ColumnarBatch(cols, hb.num_rows, hb.schema,
+                         metadata=getattr(hb, "metadata", None))
 
 
 class RapidsBuffer:

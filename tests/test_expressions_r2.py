@@ -571,3 +571,31 @@ def test_stddev_host_fallback_matches_device(spark):
     host = {r["k"]: r["s"] for r in q.collect_host().to_pylist()}
     for k in dev:
         assert math.isclose(dev[k], host[k], rel_tol=1e-9), k
+
+
+def test_get_json_object(spark):
+    docs = ['{"a": 1, "b": {"c": "x"}}', '{"a": [10, 20]}', "not json",
+            None, '{"b": {"c": null}}', '{"arr": [{"k": 5}]}']
+    df = spark.create_dataframe({"j": pa.array(docs)})
+    out = df.select(
+        F.alias(F.get_json_object(F.col("j"), "$.a"), "a"),
+        F.alias(F.get_json_object(F.col("j"), "$.b.c"), "bc"),
+        F.alias(F.get_json_object(F.col("j"), "$.a[1]"), "a1"),
+        F.alias(F.get_json_object(F.col("j"), "$.arr[0].k"), "ak")).collect()
+    assert out["a"].to_pylist() == ["1", "[10,20]", None, None, None, None]
+    assert out["bc"].to_pylist() == ["x", None, None, None, None, None]
+    assert out["a1"].to_pylist() == [None, "20", None, None, None, None]
+    assert out["ak"].to_pylist() == [None, None, None, None, None, "5"]
+    # device equals host oracle
+    q = df.select(F.alias(F.get_json_object(F.col("j"), "$.b.c"), "r"))
+    assert q.collect()["r"].to_pylist() == q.collect_host()["r"].to_pylist()
+
+
+def test_scalar_subquery(spark):
+    big = spark.create_dataframe({"v": pa.array([5, 9, 2], pa.int64())})
+    mx = F.scalar_subquery(big.agg(F.alias(F.max(F.col("v")), "m")))
+    df = spark.create_dataframe({"x": pa.array([1, 9, 4], pa.int64())})
+    out = df.filter(F.col("x") == mx).collect()
+    assert out["x"].to_pylist() == [9]
+    with pytest.raises(ValueError, match="more than one row"):
+        F.scalar_subquery(big.select(F.col("v")))

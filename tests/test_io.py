@@ -383,3 +383,59 @@ def test_csv_float_gate_ignores_header_letters(tmp_path):
                      ).read_csv(path, schema=schema).collect()
     assert out["price"].to_pylist() == [1.5]
     assert out["value"].to_pylist() == [2.25]
+
+
+def test_input_file_name_metadata_exprs(tmp_path):
+    """input_file_name()/block offsets from scan provenance on the device
+    decode path (reference GpuInputFileName family)."""
+    import os
+    import numpy as np
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.session import TpuSession
+    import spark_rapids_tpu.functions as F
+
+    d = tmp_path / "t"
+    d.mkdir()
+    for i in range(2):
+        pq.write_table(pa.table({"a": pa.array(np.arange(5) + i * 10)}),
+                       str(d / f"part-{i}.parquet"), compression="NONE",
+                       use_dictionary=True)
+    spark = TpuSession()
+    df = spark.read_parquet(str(d), files_per_partition=2).select(
+        F.col("a"), F.alias(F.input_file_name(), "f"),
+        F.alias(F.input_file_block_start(), "bs"),
+        F.alias(F.input_file_block_length(), "bl"))
+    out = df.collect()
+    by_file = {}
+    for r in out.to_pylist():
+        by_file.setdefault(os.path.basename(r["f"]), []).append(r)
+    assert set(by_file) == {"part-0.parquet", "part-1.parquet"}
+    for rows in by_file.values():
+        assert all(r["bs"] == 0 and r["bl"] > 0 for r in rows)
+
+
+def test_input_file_name_survives_filter_and_host_path(tmp_path):
+    import os
+    import numpy as np
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.session import TpuSession
+    import spark_rapids_tpu.functions as F
+
+    p = str(tmp_path / "x.parquet")
+    pq.write_table(pa.table({"a": pa.array(np.arange(6))}), p,
+                   compression="NONE", use_dictionary=True)
+    spark = TpuSession()
+    df = (spark.read_parquet(p)
+          .filter(F.col("a") > 1)
+          .select(F.col("a"), F.alias(F.input_file_name(), "f")))
+    out = df.collect()
+    assert all(os.path.basename(v) == "x.parquet"
+               for v in out["f"].to_pylist())
+
+    # host reader path (device decode off) keeps single-file provenance too
+    off = TpuSession({"spark.rapids.tpu.sql.parquet.deviceDecode.enabled":
+                      "false"})
+    out2 = (off.read_parquet(p).select(F.alias(F.input_file_name(), "f"))
+            .collect())
+    assert all(os.path.basename(v) == "x.parquet"
+               for v in out2["f"].to_pylist())

@@ -70,14 +70,12 @@ EXPR_ALIASES = {
 
 EXEC_ALIASES = {
     "BatchScanExec": "FileScanNode/FileSourceScanExec (io/filescan.py)",
-    "BroadcastExchangeExec": "_SharedBroadcast inside joins (exec/joins.py)",
+    "BroadcastExchangeExec": "BroadcastExchangeExec (exec/broadcast.py)",
     "BroadcastNestedLoopJoinExec": "NestedLoopJoinExec (exec/joins.py)",
     "CartesianProductExec": "CartesianJoin (exec/joins.py)",
     "CoalesceExec": "CoalesceBatchesExec (exec/coalesce.py)",
     "CollectLimitExec": "LimitNode global (plan/nodes.py)",
-    "CustomShuffleReaderExec": "not applicable: AQE shuffle reader is a "
-                               "Spark-internal node; the local scheduler "
-                               "reads exchanges directly",
+    "CustomShuffleReaderExec": "AdaptiveShuffleReaderExec (exec/exchange.py)",
     "DataWritingCommandExec": "io/writer.py write_parquet/orc/csv",
     "FlatMapCoGroupsInPandasExec": "udf/python_runtime.py worker pool "
                                    "(cogroup shape pending)",
