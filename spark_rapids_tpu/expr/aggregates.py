@@ -378,3 +378,26 @@ class CollectList(AggregateFunction):
 class CollectSet(CollectList):
     """collect_set(x) — distinct non-null values (order unspecified in
     Spark; first-seen order here)."""
+
+
+class PivotFirst(AggregateFunction):
+    """PivotFirst(value, pivotColumn, pivotValues) — the aggregate Spark
+    plans under df.groupBy(..).pivot(..).agg(first(..)) (reference
+    GpuPivotFirst): per group, an array with one slot per pivot value
+    holding the first matching value. Array output → host path, like
+    collect_list."""
+
+    def __init__(self, value, pivot, pivot_values: list):
+        self.children = [value, pivot]
+        self.pivot_values = list(pivot_values)
+
+    def with_children(self, children):
+        return PivotFirst(children[0], children[1], self.pivot_values)
+
+    @property
+    def dtype(self):
+        return T.ArrayType(self.children[0].dtype)
+
+    @property
+    def state_types(self):
+        raise NotImplementedError("pivot_first runs on host")

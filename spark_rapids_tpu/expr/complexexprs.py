@@ -317,3 +317,76 @@ class ArrayContains(Expression):
 
     def __repr__(self):
         return f"array_contains({self.children[0]!r}, {self.children[1]!r})"
+
+
+class CreateMap(Expression):
+    """map(k1, v1, k2, v2, ...) — fused-only, like CreateArray/
+    CreateNamedStruct: only map(...)[key] extraction runs on device."""
+
+    def __init__(self, *children):
+        assert len(children) % 2 == 0, "map() needs key/value pairs"
+        self.children = list(children)
+
+    @property
+    def dtype(self):
+        from spark_rapids_tpu.expr.conditional import _common_type
+        ks = [c.dtype for c in self.children[0::2]]
+        vs = [c.dtype for c in self.children[1::2]]
+        return T.MapType(_common_type(ks) if ks else T.NULL,
+                         _common_type(vs) if vs else T.NULL)
+
+    def with_children(self, children):
+        return CreateMap(*children)
+
+    def eval(self, ctx):
+        raise NotImplementedError(
+            "map values have no flat device form; only fused map(...)[k] "
+            "extraction runs on device")
+
+    def __repr__(self):
+        return f"map({', '.join(map(repr, self.children))})"
+
+
+class GetMapValue(Expression):
+    """map[key] — null when the key is absent (Spark non-ANSI). Device path
+    requires a fused CreateMap child (reference GpuGetMapValue; same
+    design as GetArrayItem over CreateArray): a chain of key-equality
+    selects over the pair expressions."""
+
+    def __init__(self, child, key):
+        self.children = [child, key]
+
+    @property
+    def dtype(self):
+        ct = self.children[0].dtype
+        return ct.value_type if isinstance(ct, T.MapType) else T.NULL
+
+    def with_children(self, children):
+        return GetMapValue(children[0], children[1])
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.expr.arithmetic import _cast_col
+        from spark_rapids_tpu.expr.predicates import EqualTo
+        src, key = self.children
+        if not isinstance(src, CreateMap):
+            raise NotImplementedError(
+                "GetMapValue on a real map column runs on host")
+        elem_t = self.dtype
+        out = Col(jnp.full((ctx.capacity,), elem_t.default_value(),
+                           elem_t.jnp_dtype),
+                  jnp.zeros((ctx.capacity,), jnp.bool_), elem_t)
+        # later pairs win on duplicate keys (Spark map semantics)
+        for k_expr, v_expr in zip(src.children[0::2], src.children[1::2]):
+            hit_col = EqualTo(key, k_expr).eval(ctx)
+            hit = hit_col.validity & hit_col.values
+            v = _cast_col(v_expr.eval(ctx), elem_t)
+            if v.is_string and v.dictionary is not out.dictionary:
+                from spark_rapids_tpu.ops.strings import union_dictionaries
+                v, out = union_dictionaries(v, out)
+            out = Col(jnp.where(hit, v.values, out.values),
+                      jnp.where(hit, v.validity, out.validity),
+                      elem_t, out.dictionary)
+        return out
+
+    def __repr__(self):
+        return f"{self.children[0]!r}[{self.children[1]!r}]"

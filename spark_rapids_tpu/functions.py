@@ -490,3 +490,13 @@ def scalar_subquery(df):
     executes subquery stages first; same contract)."""
     from spark_rapids_tpu.expr.misc import ScalarSubquery
     return ScalarSubquery.from_dataframe(df)
+
+
+def create_map(*kvs):
+    from spark_rapids_tpu.expr.complexexprs import CreateMap
+    return CreateMap(*[_e(x) for x in kvs])
+
+
+def map_value(m, key):
+    from spark_rapids_tpu.expr.complexexprs import GetMapValue
+    return GetMapValue(_e(m), _e(key))

@@ -878,6 +878,21 @@ def _get_array_item_host(expr, kids, n):
     return HostCol(out, expr.dtype)
 
 
+def _create_map_host(expr, kids, n):
+    out = []
+    for i in range(n):
+        m = {}
+        for kc, vc in zip(kids[0::2], kids[1::2]):
+            m[kc.data[i]] = vc.data[i]   # later pairs win, Spark map semantics
+        out.append(m)
+    return HostCol(out, expr.dtype)
+
+
+def _get_map_value_host(expr, kids, n):
+    return HostCol([None if (m is None or k is None) else m.get(k)
+                    for m, k in zip(kids[0].data, kids[1].data)], expr.dtype)
+
+
 def _create_array_host(expr, kids, n):
     return HostCol([[k.data[i] for k in kids] for i in range(n)], expr.dtype)
 
@@ -1042,6 +1057,8 @@ def _register_round2():
         CX.GetStructField: _struct_field_host,
         CX.GetArrayItem: _get_array_item_host,
         CX.Size: _size_host,
+        CX.CreateMap: _create_map_host,
+        CX.GetMapValue: _get_map_value_host,
     })
     from spark_rapids_tpu.expr.strings import StringSplit, java_split
     from spark_rapids_tpu.expr.mathexprs import BRound

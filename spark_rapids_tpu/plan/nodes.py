@@ -20,7 +20,8 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.expr import core as E
 from spark_rapids_tpu.expr.aggregates import (
     AggregateFunction, Average, CollectList, CollectSet, Count, First, Last,
-    Max, Min, StddevPop, StddevSamp, Sum, VariancePop, VarianceSamp,
+    Max, Min, PivotFirst, StddevPop, StddevSamp, Sum, VariancePop,
+    VarianceSamp,
 )
 from spark_rapids_tpu.plan.host_eval import HostCol, eval_host
 
@@ -281,6 +282,9 @@ class AggregateNode(PlanNode):
             assert isinstance(f, AggregateFunction), f
             if isinstance(f, Count) and not f.children:
                 agg_inputs.append((f, None))
+            elif isinstance(f, PivotFirst):
+                agg_inputs.append((f, (eval_host(f.children[0], tbl),
+                                       eval_host(f.children[1], tbl))))
             else:
                 agg_inputs.append((f, eval_host(f.children[0], tbl)))
 
@@ -303,6 +307,15 @@ class AggregateNode(PlanNode):
             if data is None:
                 return len(rows)
             return sum(1 for i in rows if data.data[i] is not None)
+        if isinstance(f, PivotFirst):
+            vals_c, piv_c = data
+            out = [None] * len(f.pivot_values)
+            index = {v: j for j, v in enumerate(f.pivot_values)}
+            for i in rows:
+                j = index.get(piv_c.data[i])
+                if j is not None and out[j] is None:
+                    out[j] = vals_c.data[i]
+            return out
         vals = [data.data[i] for i in rows if data.data[i] is not None]
         if isinstance(f, Sum):
             if not vals:

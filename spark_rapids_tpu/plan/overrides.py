@@ -404,7 +404,8 @@ def _register_all():
         p = meta.parent
         pe = getattr(p, "expr", None) if p is not None else None
         if not isinstance(pe, (CX.GetStructField, CX.GetArrayItem, CX.Size,
-                               CX.ElementAt, CX.ArrayContains)):
+                               CX.ElementAt, CX.ArrayContains,
+                               CX.GetMapValue)):
             meta.will_not_work(
                 "nested values have no flat device form; only fused "
                 "create+extract pairs run on device (struct(..).f, arr[i])")
@@ -429,6 +430,8 @@ def _register_all():
         from spark_rapids_tpu.expr.strings import StringSplit as _Split
         e = meta.expr
         ok = (CX.CreateNamedStruct, CX.CreateArray)
+        if isinstance(e, CX.GetMapValue):
+            ok = (CX.CreateMap,)
         if isinstance(e, (CX.GetArrayItem, CX.Size)):
             ok = ok + (_Split,)          # fused split(...)[i] / size(split)
         if not isinstance(e.children[0], ok):
@@ -453,6 +456,10 @@ def _register_all():
     ex(CX.ElementAt, "1-based array element extraction", TS.ALL, nested_ok,
        None, tag_extract)
     ex(CX.ArrayContains, "array membership (fused)", TS.BOOLEAN, nested_ok,
+       None, tag_extract)
+    ex(CX.CreateMap, "map construction (fused)", nested_ok, TS.ALL,
+       None, tag_create)
+    ex(CX.GetMapValue, "map value extraction (fused)", TS.ALL, nested_ok,
        None, tag_extract)
     ex(S.StringSplit, "split to array (fused extract only)", nested_ok,
        TS.STRING + TS.INTEGRAL, None, tag_split)
@@ -482,6 +489,8 @@ def _register_all():
             "fixed-width device form; the aggregate runs on host")
     ex(AG.CollectList, "collect to array (host)", TS.ALL + TS.NESTED,
        TS.ALL, None, tag_collect)
+    ex(AG.PivotFirst, "pivot first-value aggregate (host)",
+       TS.ALL + TS.NESTED, TS.ALL, None, tag_collect)
 
     ex(DT.DateAddInterval, "date + literal day interval",
        TS.TypeSig([T.DateType]),

@@ -66,7 +66,8 @@ ORDERABLE = COMMON + DECIMAL
 NONE = TypeSig()
 ARRAY = TypeSig([T.ArrayType])
 STRUCT = TypeSig([T.StructDataType])
-NESTED = ARRAY + STRUCT
+MAP = TypeSig([T.MapType])
+NESTED = ARRAY + STRUCT + MAP
 
 
 class ExecChecks:

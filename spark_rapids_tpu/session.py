@@ -241,6 +241,54 @@ class GroupedData:
         from spark_rapids_tpu.expr.aggregates import Count
         return self.agg(E.Alias(Count(None), "count"))
 
+    def pivot(self, pivot_col, values: list) -> "PivotedGroupedData":
+        """df.group_by(k).pivot(p, values).agg(f(v)) — Spark's pivot.
+        Lowered by If-guard expansion (one guarded aggregate per pivot
+        value), which keeps every aggregate on the DEVICE kernels; the
+        PivotFirst expression (expr/aggregates.py) is the reference-shaped
+        host form for plans that carry it directly."""
+        return PivotedGroupedData(self.keys, self.df, _to_expr(pivot_col),
+                                  list(values))
+
+
+class PivotedGroupedData:
+    def __init__(self, keys: list, df: DataFrame, pivot_expr, values: list):
+        self.keys = keys
+        self.df = df
+        self.pivot_expr = pivot_expr
+        self.values = values
+
+    def agg(self, *aggs) -> DataFrame:
+        from spark_rapids_tpu.expr.aggregates import Count, First, Last
+        from spark_rapids_tpu.expr.conditional import If
+        named = []
+        for a in aggs:
+            e = _to_expr(a)
+            inner = e.child if isinstance(e, E.Alias) else e
+            assert isinstance(inner, AggregateFunction), \
+                f"agg() requires aggregate expressions, got {e!r}"
+            base_name = e.name if isinstance(e, E.Alias) else None
+            for pv in self.values:
+                child = inner.children[0] if inner.children else None
+                if child is None:
+                    # count(*) counts only the pivot value's rows (Spark
+                    # lowers pivot by grouping on the pivot column)
+                    guarded = Count(If(E.Literal(pv) == self.pivot_expr,
+                                       E.Literal(1), E.Literal(None, T.INT)))
+                else:
+                    guard = If(E.Literal(pv) == self.pivot_expr, child,
+                               E.Literal(None, child.dtype))
+                    if isinstance(inner, (First, Last)):
+                        # non-matching rows become nulls; they must not win
+                        guarded = type(inner)(guard, ignore_nulls=True)
+                    else:
+                        guarded = inner.with_children([guard])
+                col_name = (f"{pv}" if len(aggs) == 1 and base_name is None
+                            else f"{pv}_{base_name or type(inner).__name__.lower()}")
+                named.append(E.Alias(guarded, col_name))
+        return DataFrame(NN.AggregateNode(self.keys, named, self.df._plan),
+                         self.df.session)
+
 
 class TpuSession:
     """The SparkSession stand-in; owns the conf and the read API

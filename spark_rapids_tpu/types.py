@@ -248,6 +248,9 @@ def from_arrow_type(at: pa.DataType) -> DataType:
 def to_arrow_type(dt: DataType) -> pa.DataType:
     if isinstance(dt, ArrayType):
         return pa.list_(to_arrow_type(dt.element_type))
+    if isinstance(dt, MapType):
+        return pa.map_(to_arrow_type(dt.key_type),
+                       to_arrow_type(dt.value_type))
     if isinstance(dt, StructDataType):
         return pa.struct([pa.field(n, to_arrow_type(t))
                           for n, t in zip(dt.names, dt.types)])
@@ -388,3 +391,30 @@ def _type_from_json(obj) -> DataType:
         if t.sql_name == obj:
             return t
     raise ValueError(f"unknown type json {obj!r}")
+
+
+class MapType(DataType):
+    """Spark MapType. Like ArrayType/StructDataType there is no flat device
+    representation; device support is the fused CreateMap+GetMapValue pair
+    (expr/complexexprs.py), everything else stays on host (reference
+    TypeChecks TypeSig.MAP)."""
+
+    jnp_dtype = None
+    sql_name = "map"
+
+    def __init__(self, key_type: DataType, value_type: DataType,
+                 value_contains_null: bool = True):
+        self.key_type = key_type
+        self.value_type = value_type
+        self.value_contains_null = value_contains_null
+
+    def __eq__(self, other):
+        return (isinstance(other, MapType)
+                and other.key_type == self.key_type
+                and other.value_type == self.value_type)
+
+    def __hash__(self):
+        return hash(("map", self.key_type, self.value_type))
+
+    def __repr__(self):
+        return f"map<{self.key_type!r},{self.value_type!r}>"

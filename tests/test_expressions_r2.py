@@ -599,3 +599,53 @@ def test_scalar_subquery(spark):
     assert out["x"].to_pylist() == [9]
     with pytest.raises(ValueError, match="more than one row"):
         F.scalar_subquery(big.select(F.col("v")))
+
+
+def test_fused_map_extraction(spark):
+    df = spark.create_dataframe({
+        "k": pa.array(["a", "b", "zz", None]),
+        "x": pa.array([1, 2, 3, 4], pa.int64())})
+    m = F.create_map(F.lit("a"), F.col("x"), F.lit("b"),
+                     F.col("x") * F.lit(10))
+    q = df.select(F.alias(F.map_value(m, F.col("k")), "v"))
+    assert "cannot run on TPU" not in q.explain()   # fused path approved
+    got = q.collect()["v"].to_pylist()
+    assert got == [1, 20, None, None]
+    assert got == q.collect_host()["v"].to_pylist()  # device == host oracle
+
+
+def test_pivot_session_api(spark):
+    df = spark.create_dataframe({
+        "k": pa.array([1, 1, 2, 2, 1], pa.int64()),
+        "cat": pa.array(["x", "y", "x", "x", "x"]),
+        "v": pa.array([10, 20, 30, 40, 50], pa.int64())})
+    out = (df.group_by("k").pivot("cat", ["x", "y"])
+           .agg(F.alias(F.sum(F.col("v")), "s")).collect())
+    rows = {r["k"]: r for r in out.to_pylist()}
+    assert rows[1]["x_s"] == 60 and rows[1]["y_s"] == 20
+    assert rows[2]["x_s"] == 70 and rows[2]["y_s"] is None
+
+    # count(*) counts only matching rows; first() takes the first MATCH
+    out2 = (df.group_by("k").pivot("cat", ["x", "y"])
+            .agg(F.alias(F.count(F.col("v")), "c"),
+                 F.alias(F.first(F.col("v")), "f")).collect())
+    r2 = {r["k"]: r for r in out2.to_pylist()}
+    assert r2[1]["x_c"] == 2 and r2[1]["y_c"] == 1
+    assert r2[1]["x_f"] == 10 and r2[1]["y_f"] == 20
+    assert r2[2]["y_c"] == 0 and r2[2]["y_f"] is None
+
+
+def test_pivot_first_host_aggregate(spark):
+    from spark_rapids_tpu.expr.aggregates import PivotFirst
+    from spark_rapids_tpu.plan import nodes as NN
+    from spark_rapids_tpu.expr import core as E
+    df = spark.create_dataframe({
+        "k": pa.array([1, 1, 2], pa.int64()),
+        "cat": pa.array(["x", "y", "y"]),
+        "v": pa.array([10, 20, 30], pa.int64())})
+    pf = PivotFirst(E.col("v"), E.col("cat"), ["x", "y"])
+    plan = NN.AggregateNode([E.col("k")], [E.Alias(pf, "p")], df._plan)
+    from spark_rapids_tpu.session import DataFrame
+    out = DataFrame(plan, spark).collect()
+    rows = {r["k"]: r["p"] for r in out.to_pylist()}
+    assert rows[1] == [10, 20] and rows[2] == [None, 30]
