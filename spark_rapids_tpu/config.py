@@ -365,6 +365,11 @@ BROADCAST_MAX_TABLE_BYTES = conf("spark.rapids.tpu.sql.broadcast.maxTableBytes"
     "Fail a broadcast whose materialized relation exceeds this size "
     "(reference maxBroadcastTableSize guard); 0 disables").bytes_conf("8g")
 
+PROFILE_DIR = conf("spark.rapids.tpu.profile.dir").doc(
+    "Directory for a whole-session XProf/Perfetto capture "
+    "(jax.profiler.start_trace; the reference's Nsight workflow, "
+    "docs/dev/nvtx_profiling.md); empty disables").string_conf(None)
+
 OOM_DUMP_DIR = conf("spark.rapids.tpu.memory.hbm.oomDumpDir").doc(
     "Directory to write allocator state on device OOM "
     "(reference spark.rapids.memory.gpu.oomDumpDir)").string_conf(None)

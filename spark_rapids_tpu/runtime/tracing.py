@@ -36,3 +36,40 @@ def trace_range(name: str, metric=None):
         finally:
             if metric is not None:
                 metric.add(time.perf_counter_ns() - t0)
+
+
+_profiling = False
+_profile_dir = None
+
+
+def start_profile(outdir: str) -> None:
+    """Whole-session XProf capture (idempotent; stopped at interpreter
+    exit — use stop_profile() to flush earlier in long-lived processes).
+    Viewable in Perfetto/XProf — the Nsight-workflow analog."""
+    global _profiling, _profile_dir
+    if _profiling:
+        if outdir != _profile_dir:
+            import warnings
+            warnings.warn(
+                f"profiler already capturing to {_profile_dir}; "
+                f"ignoring profile.dir={outdir}", stacklevel=2)
+        return
+    _profile_dir = outdir
+    import atexit
+    import jax
+    jax.profiler.start_trace(outdir)
+    _profiling = True
+
+    atexit.register(stop_profile)
+
+
+def stop_profile() -> None:
+    """Flush and stop the capture (safe to call when not profiling)."""
+    global _profiling
+    if _profiling:
+        import jax
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _profiling = False

@@ -309,6 +309,16 @@ class TpuSession:
         # eager device acquisition when conf'd (reference Plugin.scala flow)
         from spark_rapids_tpu import plugin as PL
         PL.bootstrap(self.conf)
+        # tracing (NVTX analog): profiler annotations around hot regions,
+        # optional whole-session XProf capture (reference nvtx_profiling.md)
+        from spark_rapids_tpu.runtime import tracing
+        # process-global like the Pallas switch: only an EXPLICIT setting
+        # touches it, so a default session never clobbers another's choice
+        if CFG.TRACE_ENABLED.key in self.conf.settings:
+            tracing.set_enabled(self.conf.get(CFG.TRACE_ENABLED))
+        pdir = self.conf.get(CFG.PROFILE_DIR)
+        if pdir:
+            tracing.start_profile(pdir)
 
     # -- data sources --------------------------------------------------------
     def read_parquet(self, path, pushed_filter=None,

@@ -79,6 +79,18 @@ def _shapes():
         F.cast(c("d"), T.LONG),      # clamping
         # hash
         F.hash(c("i"), c("s"), c("dt")),
+        # round-2b surface: half-even rounding, set membership, split
+        # extraction, json paths, interval arithmetic, fused maps
+        F.bround(c("d")), F.bround(c("i"), -2),
+        F.isin(c("i"), {1, 5, None, 40}),
+        F.element_at0(F.split(c("s"), "a"), 0),
+        F.size(F.split(c("s"), "a", 2)),
+        F.get_json_object(F.concat(F.lit('{"k": "'), c("s"), F.lit('"}')),
+                          "$.k"),
+        F.time_add(c("ts"), F.lit(3600 * 1000000)),
+        F.date_add_interval(c("dt"), F.lit(45)),
+        F.map_value(F.create_map(F.lit("p"), c("i"), F.lit("q"), c("l")),
+                    c("s")),
     ]
 
 

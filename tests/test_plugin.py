@@ -53,3 +53,20 @@ def test_bootstrap_retains_context():
     from spark_rapids_tpu.shuffle.heartbeat import RapidsShuffleHeartbeatManager
     assert isinstance(PL.context().get("heartbeat_manager"),
                       RapidsShuffleHeartbeatManager)
+
+
+def test_trace_conf_wires_annotations(tmp_path):
+    """spark.rapids.tpu.sql.trace.enabled must actually flip the tracing
+    module (it was a dead conf); a traced query still runs."""
+    import pyarrow as pa
+    import spark_rapids_tpu.functions as F
+    from spark_rapids_tpu.runtime import tracing
+    from spark_rapids_tpu.session import TpuSession
+    s = TpuSession({"spark.rapids.tpu.sql.trace.enabled": "true"})
+    assert tracing._enabled
+    df = s.create_dataframe({"a": pa.array([1, 2, 3], pa.int64())})
+    assert df.filter(F.col("a") > 1).collect().num_rows == 2
+    TpuSession()                     # default session must NOT clobber it
+    assert tracing._enabled
+    TpuSession({"spark.rapids.tpu.sql.trace.enabled": "false"})
+    assert not tracing._enabled
