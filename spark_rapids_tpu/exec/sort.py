@@ -8,7 +8,6 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.exec.base import TpuExec, acquire_semaphore
 from spark_rapids_tpu.expr.core import EvalContext, bind_references
-from spark_rapids_tpu.ops.concat import concat_batches
 from spark_rapids_tpu.ops.filtering import gather_cols
 from spark_rapids_tpu.ops.sorting import SortOrder, sort_permutation
 from spark_rapids_tpu.runtime import metrics as M
@@ -38,12 +37,17 @@ class SortExec(TpuExec):
 
     def execute_partition(self, split):
         def it():
-            batches = list(self.child.execute_partition(split))
-            if not batches:
+            # single-batch goal via the coalesce layer (reference
+            # GpuSortExec + RequireSingleBatch): inputs accumulate in the
+            # SPILL STORE — under HBM pressure earlier batches move to
+            # host/disk instead of OOMing — with leak-safe close on error
+            from spark_rapids_tpu.exec.coalesce import concat_all
+            batch = concat_all(self.child.execute_partition(split),
+                               self.child.output)
+            if batch.num_rows == 0:
                 return
             acquire_semaphore(self.metrics)
             with trace_range("SortExec", self._sort_time):
-                batch = concat_batches(batches)
                 ctx = EvalContext.from_batch(batch)
                 key_cols = [e.eval(ctx) for e in self.sort_exprs]
                 perm = sort_permutation(key_cols, self.orders, ctx.num_rows,
