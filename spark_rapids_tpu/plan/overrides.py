@@ -93,53 +93,116 @@ def wrap_plan_meta(node, conf: RapidsConf, parent=None) -> PlanMeta:
 
 def extract_python_udfs(plan):
     """Spark ExtractPythonUDFs analog: pull PythonUDF calls out of filter
-    conditions into a projection so the UDF rides ArrowEvalPythonExec (the
-    GpuArrowEvalPythonExec path) while the residual condition stays a device
-    filter. Rewrites Filter(cond[udf]) into
-    Project[orig] ∘ Filter(cond[ref]) ∘ Project[orig..., udf AS __pyudf_j].
+    conditions, sort keys, and aggregate inputs into a projection below the
+    operator, so the UDF rides ArrowEvalPythonExec (the
+    GpuArrowEvalPythonExec path) while the residual operator stays on
+    device. Filter(cond[udf]) becomes
+    Project[orig] ∘ Filter(cond[ref]) ∘ Project[orig..., udf AS __pyudf_j];
+    Sort and Aggregate are rewritten the same way.
     Non-mutating: rebuilt nodes are fresh; untouched subtrees are shared.
     """
     import copy as _copy
-    from spark_rapids_tpu.plan.nodes import FilterNode, ProjectNode
+    from spark_rapids_tpu.plan.nodes import (AggregateNode, FilterNode,
+                                             ProjectNode, SortNode,
+                                             _expr_name)
     from spark_rapids_tpu.udf.python_runtime import PythonUDF
 
-    def replace_by_id(expr, mapping):
-        if id(expr) in mapping:
-            return mapping[id(expr)]
+    def replace_canonical(expr, ref_fn):
+        r = ref_fn(expr)
+        if r is not None:
+            return r
         if not expr.children:
             return expr
         return expr.with_children(
-            [replace_by_id(c, mapping) for c in expr.children])
+            [replace_canonical(c, ref_fn) for c in expr.children])
+
+    def outermost_udfs(exprs):
+        """(canonical outermost udfs, occurrence-id → canonical map).
+        bind_references copies expression nodes, so identity dedupe misses
+        reuse — canonicalize on STRUCTURE (function + repr'd argument
+        tree): one projected column (and one worker round trip) feeds
+        every use site."""
+        udfs = []
+        for e in exprs:
+            udfs.extend(e.collect(lambda x: isinstance(x, PythonUDF)))
+
+        def skey(u):
+            return (id(u.fn), u.vectorized, repr(u.children))
+        by_key, canon = {}, {}
+        for u in udfs:
+            k = skey(u)
+            by_key.setdefault(k, u)
+            canon[id(u)] = by_key[k]
+        uniq = list(by_key.values())
+        # drop UDFs nested inside another extracted UDF — the outer one's
+        # worker evaluation computes them, a separate column would be dead
+        nested = {skey(d) for u in uniq for c in u.children
+                  for d in c.collect(lambda x: isinstance(x, PythonUDF))}
+        return [u for u in uniq if skey(u) not in nested], canon
+
+    def extract(exprs, child):
+        """(rewritten exprs, udf projection node, base refs) or None."""
+        udfs, canon = outermost_udfs(exprs)
+        if not udfs:
+            return None
+        base = [E.BoundReference(i, f.data_type, f.nullable, f.name)
+                for i, f in enumerate(child.output.fields)]
+        k = len(base)
+        proj, ref_of = list(base), {}
+        for j, u in enumerate(udfs):
+            ref_of[id(u)] = E.BoundReference(k + j, u.dtype, True,
+                                             f"__pyudf_{j}")
+            proj.append(E.Alias(u, f"__pyudf_{j}"))
+        # every occurrence (bind_references may have copied the same UDF
+        # into distinct objects) maps to its canonical column
+        from spark_rapids_tpu.udf.python_runtime import PythonUDF as _PU
+
+        def canonical_ref(x):
+            if isinstance(x, _PU):
+                c = canon.get(id(x))
+                if c is not None and id(c) in ref_of:
+                    return ref_of[id(c)]
+            return None
+        new_exprs = [replace_canonical(e, canonical_ref) for e in exprs]
+        return new_exprs, ProjectNode(proj, child), base
 
     def rewrite(node):
         kids = [rewrite(c) for c in node.children]
         if any(k is not o for k, o in zip(kids, node.children)):
             node = _copy.copy(node)
             node.children = kids
-        if not isinstance(node, FilterNode):
-            return node
-        udfs = node.condition.collect(lambda x: isinstance(x, PythonUDF))
-        # dedupe repeated occurrences of the same UDF object: one projected
-        # column (and one worker round trip) feeds every use site
-        udfs = list({id(u): u for u in udfs}.values())
-        # drop UDFs nested inside another extracted UDF — the outer one's
-        # worker evaluation computes them, a separate column would be dead
-        nested = {id(d) for u in udfs for c in u.children
-                  for d in c.collect(lambda x: isinstance(x, PythonUDF))}
-        udfs = [u for u in udfs if id(u) not in nested]
-        if not udfs:
-            return node
-        child = node.children[0]
-        base = [E.BoundReference(i, f.data_type, f.nullable, f.name)
-                for i, f in enumerate(child.output.fields)]
-        k = len(base)
-        proj, mapping = list(base), {}
-        for j, u in enumerate(udfs):
-            mapping[id(u)] = E.BoundReference(k + j, u.dtype, True,
-                                              f"__pyudf_{j}")
-            proj.append(E.Alias(u, f"__pyudf_{j}"))
-        cond = replace_by_id(node.condition, mapping)
-        return ProjectNode(base, FilterNode(cond, ProjectNode(proj, child)))
+        if isinstance(node, FilterNode):
+            got = extract([node.condition], node.children[0])
+            if got is None:
+                return node
+            (cond,), proj, base = got
+            return ProjectNode(base, FilterNode(cond, proj))
+        if isinstance(node, SortNode):
+            keys = [e for (e, _a, _nf) in node.sort_exprs]
+            got = extract(keys, node.children[0])
+            if got is None:
+                return node
+            new_keys, proj, base = got
+            new_sort = [(ne, a, nf) for ne, (_e, a, nf)
+                        in zip(new_keys, node.sort_exprs)]
+            return ProjectNode(base,
+                               SortNode(new_sort, proj, node.global_sort))
+        if isinstance(node, AggregateNode):
+            exprs = list(node.group_exprs) + list(node.agg_exprs)
+            got = extract(exprs, node.children[0])
+            if got is None:
+                return node
+            new_exprs, proj, _base = got
+            ng = len(node.group_exprs)
+            # preserve output column names: a group key replaced wholesale
+            # by a __pyudf_ reference would otherwise rename the column
+            new_groups = [
+                ne if _expr_name(ne, i) == _expr_name(oe, i)
+                else E.Alias(ne, _expr_name(oe, i))
+                for i, (ne, oe) in enumerate(zip(new_exprs[:ng],
+                                                 node.group_exprs))]
+            return AggregateNode(new_groups, new_exprs[ng:], proj)
+        return node
 
     return rewrite(plan)
 

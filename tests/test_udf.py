@@ -166,3 +166,51 @@ def test_nested_udf_in_filter(spark):
     # 3a+1 > 10 → a in {5, 10}
     assert sorted(out["a"].to_pylist()) == [5, 10]
     assert list(out.schema.names) == ["a"]
+
+
+def test_udf_in_group_key_extracted(spark):
+    """A UDF group key rides ArrowEvalPythonExec below a DEVICE aggregate
+    (Spark ExtractPythonUDFs covers aggregates the same way)."""
+    bucket = udf(lambda x: int(str(abs(x))[0]) if x else 0, return_type=T.LONG)
+    df = spark.create_dataframe({
+        "a": pa.array([11, 19, 25, 31, 22], pa.int64())}, num_partitions=2)
+    q = df.group_by(F.alias(bucket(F.col("a")), "b")).agg(
+        F.alias(F.count(F.col("a")), "c"))
+    plan = q.explain()
+    assert "outside a projection" not in plan
+    rows = {r["b"]: r["c"] for r in q.collect().to_pylist()}
+    assert rows == {1: 2, 2: 2, 3: 1}
+
+
+def test_udf_in_agg_input_extracted(spark):
+    rev = udf(lambda x: int(str(abs(x))[::-1]) if x else 0, return_type=T.LONG)
+    df = spark.create_dataframe({
+        "k": pa.array([1, 1, 2], pa.int64()),
+        "a": pa.array([12, 34, 56], pa.int64())})
+    q = df.group_by("k").agg(F.alias(F.sum(rev(F.col("a"))), "s"))
+    assert "outside a projection" not in q.explain()
+    rows = {r["k"]: r["s"] for r in q.collect().to_pylist()}
+    assert rows == {1: 21 + 43, 2: 65}
+
+
+def test_udf_in_sort_key_extracted(spark):
+    rev = udf(lambda x: int(str(abs(x))[::-1]) if x else 0, return_type=T.LONG)
+    df = spark.create_dataframe({
+        "a": pa.array([12, 91, 40, 55], pa.int64())})
+    q = df.sort(rev(F.col("a")))       # keys: 21, 19, 4, 55
+    assert "outside a projection" not in q.explain()
+    assert q.collect()["a"].to_pylist() == [40, 91, 12, 55]
+    assert list(q.collect().schema.names) == ["a"]   # temp col dropped
+
+
+def test_udf_reused_in_filter_projects_once(spark):
+    """Structural dedupe: the same UDF call reused in one condition feeds
+    every use site from ONE projected column (bind_references copies
+    expression objects, so identity dedupe would miss this)."""
+    rev = udf(lambda x: int(str(abs(x))[::-1]) if x else 0, return_type=T.LONG)
+    df = spark.create_dataframe({"a": pa.array([12, 91, 40], pa.int64())})
+    e = rev(F.col("a"))
+    fdf = df.filter((e > F.lit(10)) & (e < F.lit(60)))   # 21, 19, 4
+    plan = fdf.explain()
+    assert plan.count("@PythonUDF") == 1   # one projected column, not two
+    assert sorted(fdf.collect()["a"].to_pylist()) == [12, 91]
