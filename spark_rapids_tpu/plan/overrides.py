@@ -171,6 +171,9 @@ def extract_python_udfs(plan):
         if any(k is not o for k, o in zip(kids, node.children)):
             node = _copy.copy(node)
             node.children = kids
+        # NB: join conditions are NOT extracted — the pair schema carries
+        # duplicate key names that the arrow bridge cannot materialize; a
+        # UDF join condition pins the join to host (documented limitation)
         if isinstance(node, FilterNode):
             got = extract([node.condition], node.children[0])
             if got is None:
