@@ -120,6 +120,34 @@ class DataFrame:
 
     order_by = sort
 
+    def sort_within_partitions(self, *cols, ascending=True) -> "DataFrame":
+        """Per-partition sort without a global exchange (Spark
+        sortWithinPartitions)."""
+        ascs = (ascending if isinstance(ascending, (list, tuple))
+                else [ascending] * len(cols))
+        sort_exprs = [(_to_expr(c), bool(a), bool(a))
+                      for c, a in zip(cols, ascs)]
+        return DataFrame(NN.SortNode(sort_exprs, self._plan,
+                                     global_sort=False), self.session)
+
+    def distinct(self) -> "DataFrame":
+        """Spark distinct(): group by every column (device group-by kernel)."""
+        keys = [E.col(f.name) for f in self._plan.output]
+        return DataFrame(NN.AggregateNode(keys, [], self._plan), self.session)
+
+    drop_duplicates = distinct
+
+    def drop(self, *names) -> "DataFrame":
+        drop_set = set(names)
+        keep = [E.col(f.name) for f in self._plan.output
+                if f.name not in drop_set]
+        return DataFrame(NN.ProjectNode(keep, self._plan), self.session)
+
+    def with_column_renamed(self, old: str, new: str) -> "DataFrame":
+        proj = [E.Alias(E.col(f.name), new) if f.name == old
+                else E.col(f.name) for f in self._plan.output]
+        return DataFrame(NN.ProjectNode(proj, self._plan), self.session)
+
     def limit(self, n: int) -> "DataFrame":
         return DataFrame(NN.LimitNode(n, self._plan, global_limit=True),
                          self.session)

@@ -142,3 +142,22 @@ def test_dataframe_reusable_across_actions(spark):
     first = norm(df.collect())
     second = norm(df.collect())
     assert first == second
+
+
+def test_dataframe_api_completeness():
+    """distinct/drop/rename/sortWithinPartitions (pyspark-surface parity)."""
+    import pyarrow as pa
+    from spark_rapids_tpu.session import TpuSession
+    spark = TpuSession()
+    df = spark.create_dataframe({
+        "a": pa.array([3, 1, 3, 2, 1], pa.int64()),
+        "b": pa.array([1.0, 2.0, 1.0, 3.0, 2.0])}, num_partitions=2)
+    d = df.distinct().collect()
+    assert sorted(zip(d["a"].to_pylist(), d["b"].to_pylist())) == \
+        [(1, 2.0), (2, 3.0), (3, 1.0)]
+    assert df.drop("b").columns == ["a"]
+    assert df.with_column_renamed("a", "x").columns == ["x", "b"]
+    swp = df.sort_within_partitions("a").collect()
+    # each partition independently ordered (partitions of sizes 3 and 2)
+    vals = swp["a"].to_pylist()
+    assert vals[:3] == sorted(vals[:3]) and vals[3:] == sorted(vals[3:])
