@@ -84,10 +84,41 @@ class HashAggregateExec(TpuExec):
 
     # ------------------------------------------------------------------
     def _aggregate_batch(self, batch: ColumnarBatch, merge: bool) -> ColumnarBatch:
-        """One fused update-or-merge aggregation. In merge mode the batch is in
-        keys+state layout; in update mode it is raw child output. Returns a batch in
-        keys+state layout with one row per group."""
-        ctx = EvalContext.from_batch(batch)
+        """One fused update-or-merge aggregation, jit-compiled per shape bucket
+        (runtime/fuse.py). In merge mode the batch is in keys+state layout; in
+        update mode it is raw child output. Returns a batch in keys+state
+        layout with one row per group."""
+        from spark_rapids_tpu.expr.core import Col
+        from spark_rapids_tpu.expr.misc import CONTEXT_SENSITIVE
+        from spark_rapids_tpu.runtime import fuse
+        ctx_sensitive = any(
+            e.collect(lambda x: isinstance(x, CONTEXT_SENSITIVE))
+            for e in (*self.group_exprs, *self.agg_exprs))
+        if batch.columns and not ctx_sensitive:
+            key = ("agg", merge, fuse.schema_key(
+                self._partial_schema() if merge else self.child.output),
+                tuple(fuse.expr_key(e) for e in self.group_exprs),
+                tuple(fuse.expr_key(e) for e in self.agg_exprs))
+
+            def build():
+                def kernel(cols, num_rows):
+                    ctx = EvalContext(cols, num_rows, cols[0].values.shape[0])
+                    return self._agg_kernel(ctx, merge)
+                return kernel
+
+            in_cols = [Col.from_vector(c) for c in batch.columns]
+            nr = jnp.asarray(batch.lazy_num_rows, jnp.int32)
+            compacted, n_groups = fuse.call_fused(
+                key, "HashAggregateExec", build, (in_cols, nr),
+                lambda: self._agg_kernel(EvalContext.from_batch(batch), merge))
+        else:
+            compacted, n_groups = self._agg_kernel(
+                EvalContext.from_batch(batch), merge)
+        cols = [c.to_vector() for c in compacted]
+        return ColumnarBatch(cols, n_groups, self._partial_schema())
+
+    def _agg_kernel(self, ctx: EvalContext, merge: bool):
+        """Pure per-batch aggregation body (traceable)."""
         cap = ctx.capacity
         nkeys = len(self.group_exprs)
         if nkeys:
@@ -95,8 +126,10 @@ class HashAggregateExec(TpuExec):
                 key_cols = [ctx.cols[i] for i in range(nkeys)]
             else:
                 key_cols = [e.eval(ctx) for e in self.group_exprs]
+            combined = G.combine_compact_keys(key_cols)
             perm, seg_ids, boundary, live = G.group_segments(
-                key_cols, ctx.num_rows, cap)
+                [combined] if combined is not None else key_cols,
+                ctx.num_rows, cap)
             sorted_keys = gather_cols(key_cols, perm, live)
         else:
             live = jnp.arange(cap) < ctx.num_rows
@@ -128,21 +161,41 @@ class HashAggregateExec(TpuExec):
                 outs = f.update(in_sorted, segctx)
             off += nstates
             state_cols.extend(outs)
-        compacted, n_groups = compact_cols(list(sorted_keys) + state_cols,
-                                           boundary)
-        cols = [c.to_vector() for c in compacted]
-        return ColumnarBatch(cols, n_groups, self._partial_schema())
+        return compact_cols(list(sorted_keys) + state_cols, boundary)
 
     def _finalize(self, partial: ColumnarBatch) -> ColumnarBatch:
-        ctx = EvalContext.from_batch(partial)
-        nkeys = len(self.group_exprs)
-        out = [ctx.cols[i] for i in range(nkeys)]
-        off = nkeys
-        for e in self.agg_exprs:
-            f = _agg_fn(e)
-            states = [ctx.cols[off + i] for i in range(len(f.state_types))]
-            off += len(f.state_types)
-            out.append(f.evaluate(states))
+        from spark_rapids_tpu.expr.core import Col
+        from spark_rapids_tpu.runtime import fuse
+
+        def body(ctx):
+            nkeys = len(self.group_exprs)
+            out = [ctx.cols[i] for i in range(nkeys)]
+            off = nkeys
+            for e in self.agg_exprs:
+                f = _agg_fn(e)
+                states = [ctx.cols[off + i] for i in range(len(f.state_types))]
+                off += len(f.state_types)
+                out.append(f.evaluate(states))
+            return out
+
+        if partial.columns:
+            key = ("agg_final", fuse.schema_key(self._partial_schema()),
+                   tuple(fuse.expr_key(e) for e in self.group_exprs),
+                   tuple(fuse.expr_key(e) for e in self.agg_exprs))
+
+            def build():
+                def kernel(cols, num_rows):
+                    return body(EvalContext(cols, num_rows,
+                                            cols[0].values.shape[0]))
+                return kernel
+
+            in_cols = [Col.from_vector(c) for c in partial.columns]
+            nr = jnp.asarray(partial.lazy_num_rows, jnp.int32)
+            out = fuse.call_fused(
+                key, "HashAggregateExec.finalize", build, (in_cols, nr),
+                lambda: body(EvalContext.from_batch(partial)))
+        else:
+            out = body(EvalContext.from_batch(partial))
         return ColumnarBatch([c.to_vector() for c in out], partial.lazy_num_rows,
                              self.output)
 

@@ -103,10 +103,13 @@ class TpuExec:
         return pa.concat_tables(tables)
 
     def wrap_output(self, it):
-        """Instrument an output iterator with row/batch metrics."""
+        """Instrument an output iterator with row/batch metrics. Row counts
+        accumulate LAZILY (device scalars fold in at metric read time) — a
+        per-batch host sync here would serialize every operator on the
+        accelerator round-trip."""
         for b in it:
             self._out_batches.add(1)
-            self._out_rows.add(b.num_rows)
+            self._out_rows.add_lazy(b.lazy_num_rows)
             yield b
 
     def tree_string(self, indent=0):

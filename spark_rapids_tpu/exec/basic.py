@@ -33,20 +33,44 @@ class ProjectExec(TpuExec):
             T.StructField(e.name, e.dtype, e.nullable) for e in self.project_list])
 
     def execute_partition(self, split):
-        from spark_rapids_tpu.expr.misc import (MonotonicallyIncreasingID,
+        from spark_rapids_tpu.expr.core import Col
+        from spark_rapids_tpu.expr.misc import (CONTEXT_SENSITIVE,
+                                                MonotonicallyIncreasingID,
                                                 Rand)
+        from spark_rapids_tpu.runtime import fuse
         positional = any(
             e.collect(lambda x: isinstance(
                 x, (MonotonicallyIncreasingID, Rand)))
             for e in self.project_list)
+        ctx_sensitive = any(
+            e.collect(lambda x: isinstance(x, CONTEXT_SENSITIVE))
+            for e in self.project_list)
+        exprs = self.project_list
+        key = ("project", fuse.schema_key(self.child.output),
+               tuple(fuse.expr_key(e) for e in exprs))
+
+        def build():
+            def kernel(cols, num_rows):
+                ctx = EvalContext(cols, num_rows, cols[0].values.shape[0])
+                return [e.eval(ctx) for e in exprs]
+            return kernel
 
         def it():
             offset = 0
             for batch in self.child.execute_partition(split):
                 acquire_semaphore(self.metrics)
                 with trace_range("ProjectExec", self._op_time):
-                    ctx = EvalContext.from_batch(batch, split, offset)
-                    cols = [e.eval(ctx).to_vector() for e in self.project_list]
+                    if ctx_sensitive or not batch.columns:
+                        ctx = EvalContext.from_batch(batch, split, offset)
+                        out = [e.eval(ctx) for e in exprs]
+                    else:
+                        in_cols = [Col.from_vector(c) for c in batch.columns]
+                        nr = jnp.asarray(batch.lazy_num_rows, jnp.int32)
+                        ctx = EvalContext.from_batch(batch, split, offset)
+                        out = fuse.call_fused(
+                            key, "ProjectExec", build, (in_cols, nr),
+                            lambda: [e.eval(ctx) for e in exprs])
+                    cols = [c.to_vector() for c in out]
                     yield ColumnarBatch(cols, batch.lazy_num_rows, self.output,
                                         metadata=batch.metadata)
                 if positional:  # host sync only when an expr needs positions
@@ -68,14 +92,41 @@ class FilterExec(TpuExec):
 
     def execute_partition(self, split):
         from spark_rapids_tpu.expr.core import Col
+        from spark_rapids_tpu.expr.misc import CONTEXT_SENSITIVE
+        from spark_rapids_tpu.runtime import fuse
+        cond = self.condition
+        ctx_sensitive = bool(
+            cond.collect(lambda x: isinstance(x, CONTEXT_SENSITIVE)))
+        key = ("filter", fuse.schema_key(self.child.output),
+               fuse.expr_key(cond))
+
+        def build():
+            def kernel(cols, num_rows):
+                cap = cols[0].values.shape[0]
+                ctx = EvalContext(cols, num_rows, cap)
+                pred = cond.eval(ctx)
+                keep = selection_mask(pred, num_rows, cap)
+                return compact_cols(ctx.cols, keep)
+            return kernel
+
+        def eager(batch):
+            ctx = EvalContext.from_batch(batch, split)
+            pred = cond.eval(ctx)
+            keep = selection_mask(pred, ctx.num_rows, ctx.capacity)
+            return compact_cols(ctx.cols, keep)
+
         def it():
             for batch in self.child.execute_partition(split):
                 acquire_semaphore(self.metrics)
                 with trace_range("FilterExec", self._op_time):
-                    ctx = EvalContext.from_batch(batch, split)
-                    pred = self.condition.eval(ctx)
-                    keep = selection_mask(pred, ctx.num_rows, ctx.capacity)
-                    new_cols, count = compact_cols(ctx.cols, keep)
+                    if ctx_sensitive or not batch.columns:
+                        new_cols, count = eager(batch)
+                    else:
+                        in_cols = [Col.from_vector(c) for c in batch.columns]
+                        nr = jnp.asarray(batch.lazy_num_rows, jnp.int32)
+                        new_cols, count = fuse.call_fused(
+                            key, "FilterExec", build, (in_cols, nr),
+                            lambda: eager(batch))
                     yield ColumnarBatch([c.to_vector() for c in new_cols], count,
                                         self.output, metadata=batch.metadata)
         return self.wrap_output(it())

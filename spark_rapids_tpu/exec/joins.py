@@ -22,6 +22,7 @@ from __future__ import annotations
 import threading
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
@@ -56,43 +57,198 @@ def _null_extended(cols, idx, valid):
     return gather_cols(cols, idx, valid)
 
 
+def _int_backed(dtype) -> bool:
+    """Orderable fixed-point key: comparisons over raw device values ARE key
+    comparisons (unlike string codes, which are only comparable under one
+    shared dictionary, or floats, which need NaN totalization)."""
+    return isinstance(dtype, (T.IntegralType, T.BooleanType, T.DateType,
+                              T.TimestampType, T.DecimalType))
+
+
 class _JoinCore:
-    """Shared probe machinery over one materialized build batch."""
+    """Shared probe machinery over one materialized build batch.
+
+    Single fixed-point-key joins take a FAST path: the build side is sorted
+    ONCE (invalid/padding rows forced to the type max and clamped out via the
+    valid count), and each stream batch probes with two searchsorted calls —
+    no per-batch re-sort of build+stream (the rank path pays a multi-key sort
+    over both sides per stream batch)."""
 
     def __init__(self, build_batch: ColumnarBatch, build_key_exprs,
                  stream_key_exprs, join_type: str):
+        from spark_rapids_tpu.runtime import fuse
         self.build_batch = build_batch
         self.build_key_exprs = build_key_exprs
         self.stream_key_exprs = stream_key_exprs
         self.join_type = join_type
+        from spark_rapids_tpu.expr.misc import CONTEXT_SENSITIVE
         bctx = EvalContext.from_batch(build_batch)
         self.build_keys_raw = [e.eval(bctx) for e in build_key_exprs]
         self.n_build = build_batch.num_rows
         self.build_cap = build_batch.capacity
+        # stream keys reading per-batch context (input_file_name family etc.)
+        # cannot be baked into a shared compiled program
+        self.ctx_sensitive = any(
+            e.collect(lambda x: isinstance(x, CONTEXT_SENSITIVE))
+            for e in stream_key_exprs)
+        self._stream_key_key = tuple(
+            fuse.expr_key(e) for e in stream_key_exprs)
         # matched-build tracking for full outer (host accumulation across stream)
         self.build_matched_acc = (np.zeros(self.build_cap, dtype=bool)
                                   if join_type == J.FULL_OUTER else None)
+        self.fast = (len(self.build_keys_raw) == 1
+                     and _int_backed(self.build_keys_raw[0].dtype))
+        if self.fast:
+            def prep(k, n_build):
+                cap = k.values.shape[0]
+                vals = k.values.astype(jnp.int8) if k.values.dtype == jnp.bool_ \
+                    else k.values
+                eligible = k.validity & (jnp.arange(cap, dtype=jnp.int32) < n_build)
+                masked = jnp.where(
+                    eligible, vals,
+                    jnp.asarray(jnp.iinfo(vals.dtype).max, vals.dtype))
+                # two sort keys: eligibility first so a LEGITIMATE max-valued
+                # key always lands inside [0, n_valid) even against the
+                # sentinel tail; the array stays globally sorted by `masked`
+                _, sorted_vals, perm = jax.lax.sort(
+                    [(~eligible).astype(jnp.int8), masked,
+                     jnp.arange(cap, dtype=jnp.int32)], num_keys=2)
+                n_valid = jnp.sum(eligible, dtype=jnp.int32)
+                return sorted_vals, perm, n_valid
+
+            key = ("join_build_prep", self.build_keys_raw[0].dtype)
+            args = (self.build_keys_raw[0],
+                    jnp.asarray(self.n_build, jnp.int32))
+            self._sorted_build, self._build_perm, self._n_valid = \
+                fuse.call_fused(key, "HashJoin.build_prep", lambda: prep, args,
+                                lambda: prep(*args))
 
     def probe_batch(self, stream_batch: ColumnarBatch):
-        sctx = EvalContext.from_batch(stream_batch)
-        stream_keys = [e.eval(sctx) for e in self.stream_key_exprs]
-        build_keys, stream_keys = _align_string_keys(self.build_keys_raw, stream_keys)
-        b_ranks, s_ranks = J.join_ranks(
-            build_keys, self.n_build, self.build_cap,
-            stream_keys, stream_batch.lazy_num_rows, stream_batch.capacity)
-        build_perm, lo, hi = J.probe(b_ranks, s_ranks)
+        from spark_rapids_tpu.runtime import fuse
         # from the stream (preserved) side's perspective, right/full outer are a
         # left outer over the swapped/streamed input
         jt = (J.LEFT_OUTER if self.join_type in (J.FULL_OUTER, J.RIGHT_OUTER)
               else self.join_type)
+        track_matched = self.build_matched_acc is not None
+        stream_key_exprs = self.stream_key_exprs
+        if self.ctx_sensitive:
+            return self._probe_batch_eager(stream_batch, jt, track_matched)
+        if self.fast:
+            return self._probe_batch_fast(stream_batch, jt, track_matched)
+
+        def kernel(build_keys_raw, n_build, stream_cols, n_stream):
+            scap = stream_cols[0].values.shape[0]
+            sctx = EvalContext(stream_cols, n_stream, scap)
+            stream_keys = [e.eval(sctx) for e in stream_key_exprs]
+            build_keys, stream_keys = _align_string_keys(build_keys_raw,
+                                                         stream_keys)
+            b_ranks, s_ranks = J.join_ranks(
+                build_keys, n_build, build_keys[0].values.shape[0],
+                stream_keys, n_stream, scap)
+            build_perm, lo, hi = J.probe(b_ranks, s_ranks)
+            counts = J.pair_counts(lo, hi, n_stream, scap, jt)
+            total = J.total_pairs(counts)
+            if track_matched:
+                # symmetric probe: which build rows matched this stream batch
+                _, blo, bhi = J.probe(s_ranks, b_ranks)
+                return build_perm, lo, hi, counts, total, (bhi - blo) > 0
+            return build_perm, lo, hi, counts, total, None
+
+        key = ("join_probe", jt, track_matched, self._stream_key_key,
+               fuse.schema_key(stream_batch.schema)
+               if stream_batch.schema else None)
+        stream_cols = [Col.from_vector(c) for c in stream_batch.columns]
+        n_build = jnp.asarray(self.n_build, jnp.int32)
+        n_stream = jnp.asarray(stream_batch.lazy_num_rows, jnp.int32)
+        build_perm, lo, hi, counts, total, matched = fuse.call_fused(
+            key, "HashJoin.probe", lambda: kernel,
+            (self.build_keys_raw, n_build, stream_cols, n_stream),
+            lambda: kernel(self.build_keys_raw, n_build, stream_cols,
+                           n_stream))
+        if track_matched:
+            self.build_matched_acc |= np.asarray(matched)
+        return build_perm, lo, hi, counts, total
+
+    def _probe_batch_eager(self, stream_batch, jt, track_matched):
+        """Context-sensitive stream keys: evaluate with the batch's full
+        context (scan provenance etc.) — never through a shared compiled
+        program."""
+        sctx = EvalContext.from_batch(stream_batch)
+        stream_keys = [e.eval(sctx) for e in self.stream_key_exprs]
+        build_keys, stream_keys = _align_string_keys(self.build_keys_raw,
+                                                     stream_keys)
+        b_ranks, s_ranks = J.join_ranks(
+            build_keys, self.n_build, self.build_cap,
+            stream_keys, stream_batch.lazy_num_rows, stream_batch.capacity)
+        build_perm, lo, hi = J.probe(b_ranks, s_ranks)
         counts = J.pair_counts(lo, hi, stream_batch.lazy_num_rows,
                                stream_batch.capacity, jt)
-        if self.build_matched_acc is not None:
-            # symmetric probe: which build rows matched this stream batch
-            s_perm, blo, bhi = J.probe(s_ranks, b_ranks)
-            matched = np.asarray((bhi - blo) > 0)
-            self.build_matched_acc |= matched
-        return build_perm, lo, hi, counts
+        total = J.total_pairs(counts)
+        if track_matched:
+            _, blo, bhi = J.probe(s_ranks, b_ranks)
+            self.build_matched_acc |= np.asarray((bhi - blo) > 0)
+        return build_perm, lo, hi, counts, total
+
+    def _probe_batch_fast(self, stream_batch, jt, track_matched):
+        """Pre-sorted-build probe: eval stream key, two searchsorted calls,
+        clamp to the valid-build prefix. O(n log n_build) compares, no sort."""
+        from spark_rapids_tpu.runtime import fuse
+        stream_key_exprs = self.stream_key_exprs
+
+        def kernel(sorted_build, n_valid, n_build, build_keys_raw, stream_cols,
+                   n_stream):
+            scap = stream_cols[0].values.shape[0]
+            sctx = EvalContext(stream_cols, n_stream, scap)
+            k = stream_key_exprs[0].eval(sctx)
+            svals = (k.values.astype(jnp.int8)
+                     if k.values.dtype == jnp.bool_ else k.values)
+            svals = svals.astype(sorted_build.dtype)
+            lo = jnp.minimum(
+                jnp.searchsorted(sorted_build, svals, side="left"), n_valid
+            ).astype(jnp.int32)
+            hi = jnp.minimum(
+                jnp.searchsorted(sorted_build, svals, side="right"), n_valid
+            ).astype(jnp.int32)
+            live = jnp.arange(scap, dtype=jnp.int32) < n_stream
+            hi = jnp.where(k.validity & live, hi, lo)
+            counts = J.pair_counts(lo, hi, n_stream, scap, jt)
+            total = J.total_pairs(counts)
+            if track_matched:
+                # which eligible build rows matched: probe the sorted stream
+                bk = build_keys_raw[0]
+                bvals = (bk.values.astype(jnp.int8)
+                         if bk.values.dtype == jnp.bool_ else bk.values)
+                s_eligible = k.validity & live
+                s_masked = jnp.where(
+                    s_eligible, svals,
+                    jnp.asarray(jnp.iinfo(svals.dtype).max, svals.dtype))
+                _, s_sorted = jax.lax.sort(
+                    [(~s_eligible).astype(jnp.int8), s_masked], num_keys=2)
+                ns = jnp.sum(s_eligible, dtype=jnp.int32)
+                blo = jnp.minimum(
+                    jnp.searchsorted(s_sorted, bvals, side="left"), ns)
+                bhi = jnp.minimum(
+                    jnp.searchsorted(s_sorted, bvals, side="right"), ns)
+                bcap = bvals.shape[0]
+                b_eligible = bk.validity & (
+                    jnp.arange(bcap, dtype=jnp.int32) < n_build)
+                return lo, hi, counts, total, (bhi > blo) & b_eligible
+            return lo, hi, counts, total, None
+
+        key = ("join_probe_fast", jt, track_matched, self._stream_key_key,
+               fuse.schema_key(stream_batch.schema)
+               if stream_batch.schema else None)
+        stream_cols = [Col.from_vector(c) for c in stream_batch.columns]
+        n_stream = jnp.asarray(stream_batch.lazy_num_rows, jnp.int32)
+        args = (self._sorted_build, self._n_valid,
+                jnp.asarray(self.n_build, jnp.int32), self.build_keys_raw,
+                stream_cols, n_stream)
+        lo, hi, counts, total, matched = fuse.call_fused(
+            key, "HashJoin.probe", lambda: kernel, args,
+            lambda: kernel(*args))
+        if track_matched:
+            self.build_matched_acc |= np.asarray(matched)
+        return self._build_perm, lo, hi, counts, total
 
     def unmatched_build_indices(self):
         assert self.build_matched_acc is not None
@@ -150,37 +306,51 @@ class HashJoinExec(TpuExec):
         return (self.children[0] if self.stream_is_left else self.children[1]).num_partitions
 
     def _emit(self, stream_batch, build_batch, core, build_perm, lo, hi, counts,
-              out_schema):
-        """Expand pairs in chunks and yield output batches."""
-        total = int(J.total_pairs(counts))
+              total, out_schema):
+        """Expand pairs in chunks (one fused program per chunk capacity) and
+        yield output batches."""
+        from spark_rapids_tpu.runtime import fuse
+        total = int(total)
         semi_anti = self.join_type in (J.LEFT_SEMI, J.LEFT_ANTI)
+        stream_is_left = self.stream_is_left
+        cond = self.condition
+        cond_key = fuse.expr_key(cond) if cond is not None else None
+        out_key = fuse.schema_key(out_schema)
         pos = 0
         while pos < total:
             out_cap = bucket_capacity(min(total - pos, _MAX_CHUNK_ROWS))
-            s_idx, b_idx, b_matched, live = J.expand_pairs(
-                build_perm, lo, hi, counts, pos, out_cap)
-            n_out = min(total - pos, out_cap)
-            s_cols = gather_cols([Col.from_vector(c) for c in stream_batch.columns],
-                                 s_idx, live)
-            if semi_anti:
-                cols = s_cols
-            else:
-                b_cols = _null_extended(
-                    [Col.from_vector(c) for c in build_batch.columns], b_idx,
-                    b_matched)
-                cols = (s_cols + b_cols) if self.stream_is_left else (b_cols + s_cols)
-            batch = ColumnarBatch([c.to_vector() for c in cols], n_out, out_schema)
-            if self.condition is not None:
-                batch = self._filter_condition(batch)
-            yield batch
-            pos += out_cap
 
-    def _filter_condition(self, batch):
-        ctx = EvalContext.from_batch(batch)
-        pred = self.condition.eval(ctx)
-        keep = selection_mask(pred, batch.lazy_num_rows, batch.capacity)
-        cols, count = compact_cols([Col.from_vector(c) for c in batch.columns], keep)
-        return ColumnarBatch([c.to_vector() for c in cols], count, batch.schema)
+            def kernel(build_perm, lo, hi, counts, s_in, b_in, start, n_out,
+                       _cap=out_cap):
+                s_idx, b_idx, b_matched, live = J.expand_pairs(
+                    build_perm, lo, hi, counts, start, _cap)
+                s_cols = gather_cols(s_in, s_idx, live)
+                if semi_anti:
+                    cols = s_cols
+                else:
+                    b_cols = _null_extended(b_in, b_idx, b_matched)
+                    cols = (s_cols + b_cols) if stream_is_left else (b_cols + s_cols)
+                if cond is not None:
+                    ctx = EvalContext(cols, n_out, _cap)
+                    pred = cond.eval(ctx)
+                    keep = pred.values & pred.validity & live
+                    return compact_cols(cols, keep)
+                return cols, None
+
+            key = ("join_emit", semi_anti, stream_is_left, out_cap,
+                   cond_key, out_key)
+            s_in = [Col.from_vector(c) for c in stream_batch.columns]
+            b_in = ([] if semi_anti else
+                    [Col.from_vector(c) for c in build_batch.columns])
+            start = jnp.asarray(pos, jnp.int32)
+            n_out_t = jnp.asarray(min(total - pos, out_cap), jnp.int32)
+            args = (build_perm, lo, hi, counts, s_in, b_in, start, n_out_t)
+            cols, count = fuse.call_fused(key, "HashJoin.emit",
+                                          lambda: kernel, args,
+                                          lambda: kernel(*args))
+            n_out = min(total - pos, out_cap) if count is None else count
+            yield ColumnarBatch([c.to_vector() for c in cols], n_out, out_schema)
+            pos += out_cap
 
     def execute_partition(self, split):
         def it():
@@ -200,9 +370,11 @@ class HashJoinExec(TpuExec):
                 for stream_batch in stream_child.execute_partition(split):
                     acquire_semaphore(self.metrics)
                     with trace_range("HashJoin.probe", self._join_time):
-                        build_perm, lo, hi, counts = core.probe_batch(stream_batch)
+                        build_perm, lo, hi, counts, total = core.probe_batch(
+                            stream_batch)
                     yield from self._emit(stream_batch, sb.get_batch(), core,
-                                          build_perm, lo, hi, counts, out_schema)
+                                          build_perm, lo, hi, counts, total,
+                                          out_schema)
                 if self.join_type == J.FULL_OUTER:
                     yield from self._emit_unmatched_build(core, sb.get_batch(),
                                                           out_schema)
@@ -289,9 +461,11 @@ class BroadcastHashJoinExec(HashJoinExec):
             for stream_batch in stream_child.execute_partition(split):
                 acquire_semaphore(self.metrics)
                 with trace_range("BroadcastHashJoin.probe", self._join_time):
-                    build_perm, lo, hi, counts = core.probe_batch(stream_batch)
+                    build_perm, lo, hi, counts, total = core.probe_batch(
+                        stream_batch)
                 yield from self._emit(stream_batch, sb.get_batch(), core,
-                                      build_perm, lo, hi, counts, out_schema)
+                                      build_perm, lo, hi, counts, total,
+                                      out_schema)
             if core.build_matched_acc is not None:
                 self._shared.merge_matched(core.build_matched_acc)
             if self._shared.finish():

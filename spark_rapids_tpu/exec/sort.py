@@ -48,12 +48,39 @@ class SortExec(TpuExec):
                 return
             acquire_semaphore(self.metrics)
             with trace_range("SortExec", self._sort_time):
-                ctx = EvalContext.from_batch(batch)
-                key_cols = [e.eval(ctx) for e in self.sort_exprs]
-                perm = sort_permutation(key_cols, self.orders, ctx.num_rows,
-                                        ctx.capacity)
-                live = jnp.arange(ctx.capacity, dtype=jnp.int32) < ctx.num_rows
-                cols = gather_cols(ctx.cols, perm, live)
+                from spark_rapids_tpu.expr.core import Col
+                from spark_rapids_tpu.expr.misc import CONTEXT_SENSITIVE
+                from spark_rapids_tpu.runtime import fuse
+                exprs, orders = self.sort_exprs, self.orders
+                ctx_sensitive = any(
+                    e.collect(lambda x: isinstance(x, CONTEXT_SENSITIVE))
+                    for e in exprs)
+
+                def kernel(cols, num_rows):
+                    cap = cols[0].values.shape[0]
+                    ctx = EvalContext(cols, num_rows, cap)
+                    key_cols = [e.eval(ctx) for e in exprs]
+                    perm = sort_permutation(key_cols, orders, num_rows, cap)
+                    live = jnp.arange(cap, dtype=jnp.int32) < num_rows
+                    return gather_cols(ctx.cols, perm, live)
+
+                if ctx_sensitive or not batch.columns:
+                    ctx = EvalContext.from_batch(batch, split)
+                    key_cols = [e.eval(ctx) for e in exprs]
+                    perm = sort_permutation(key_cols, orders, ctx.num_rows,
+                                            ctx.capacity)
+                    live = (jnp.arange(ctx.capacity, dtype=jnp.int32)
+                            < ctx.num_rows)
+                    cols = gather_cols(ctx.cols, perm, live)
+                else:
+                    key = ("sort", fuse.schema_key(self.child.output),
+                           tuple(fuse.expr_key(e) for e in exprs),
+                           tuple(repr(o) for o in orders))
+                    in_cols = [Col.from_vector(c) for c in batch.columns]
+                    nr = jnp.asarray(batch.lazy_num_rows, jnp.int32)
+                    cols = fuse.call_fused(key, "SortExec", lambda: kernel,
+                                           (in_cols, nr),
+                                           lambda: kernel(in_cols, nr))
                 yield ColumnarBatch([c.to_vector() for c in cols],
                                     batch.lazy_num_rows, self.output)
         return self.wrap_output(it())

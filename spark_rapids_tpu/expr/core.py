@@ -46,11 +46,21 @@ class Col:
         self.dictionary = dictionary
 
     def tree_flatten(self):
-        return (self.values, self.validity), (self.dtype, self.dictionary)
+        # the host dictionary is static aux data; wrap it so jit's cache can
+        # hash it (pa.Array is unhashable) — content-equal dictionaries from
+        # different batches then hit the same compiled program
+        d = self.dictionary
+        if d is not None:
+            from spark_rapids_tpu.runtime.fuse import DictRef
+            d = DictRef(d)
+        return (self.values, self.validity), (self.dtype, d)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(children[0], children[1], aux[0], aux[1])
+        d = aux[1]
+        if d is not None and type(d).__name__ == "DictRef":
+            d = d.arr
+        return cls(children[0], children[1], aux[0], d)
 
     @staticmethod
     def from_vector(cv, capacity=None):

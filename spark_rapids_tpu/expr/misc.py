@@ -242,3 +242,11 @@ class ScalarSubquery(Expression):
 
     def __repr__(self):
         return f"scalar_subquery(={self.value!r})"
+
+
+# Expressions whose eval reads per-partition / per-batch context (split,
+# row_offset, scan provenance): the whole-stage fuser (runtime/fuse.py) keeps
+# any projection containing one of these on the eager path rather than baking
+# one partition's context into a shared compiled program.
+CONTEXT_SENSITIVE = (Rand, SparkPartitionID, MonotonicallyIncreasingID,
+                     _ScanMetaExpr)

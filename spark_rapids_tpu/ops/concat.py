@@ -14,38 +14,47 @@ from spark_rapids_tpu.expr.core import Col
 
 
 def concat_batches(batches) -> ColumnarBatch:
-    """Concatenate batches (host-known row counts) into one device batch."""
+    """Concatenate batches (host-known row counts) into one device batch.
+
+    One fused XLA program per (k, capacities, schema) signature: pad-concat
+    every column, stable-compact live rows to the front (shared permutation),
+    slice to the output bucket. Row counts cross as a traced vector so varying
+    fill levels replay the same compiled program."""
+    from spark_rapids_tpu.runtime import fuse
     batches = list(batches)
     if len(batches) == 1:
         return batches[0]
     schema = batches[0].schema
-    total = sum(b.num_rows for b in batches)
+    counts = [b.num_rows for b in batches]
+    total = sum(counts)
     cap = bucket_capacity(total)
     ncols = batches[0].num_cols
+    caps = tuple(b.capacity for b in batches)
 
-    # align string dictionaries per column across batches
-    from spark_rapids_tpu.ops.strings import align_many
-    per_col = []
-    for ci in range(ncols):
-        cols = [Col.from_vector(b.column(ci)) for b in batches]
-        if cols[0].is_string:
-            cols = align_many(cols)
-        per_col.append(cols)
+    def kernel(per_col, counts_v):
+        from spark_rapids_tpu.ops.strings import align_many
+        from spark_rapids_tpu.ops.filtering import compact_cols, slice_to_capacity
+        live = jnp.concatenate([
+            jnp.arange(c, dtype=jnp.int32) < counts_v[i]
+            for i, c in enumerate(caps)])
+        merged = []
+        for cols in per_col:
+            if cols[0].is_string:
+                cols = align_many(cols)
+            merged.append(Col(
+                jnp.concatenate([c.values for c in cols]),
+                jnp.concatenate([c.validity for c in cols]),
+                cols[0].dtype, cols[0].dictionary))
+        compacted, count = compact_cols(merged, live)
+        return slice_to_capacity(compacted, count, cap)
 
-    out_cols = []
-    for ci in range(ncols):
-        cols = per_col[ci]
-        dt = cols[0].dtype
-        vals = jnp.full((cap,), dt.default_value(), dtype=cols[0].values.dtype)
-        valid = jnp.zeros((cap,), jnp.bool_)
-        off = 0
-        for b, c in zip(batches, cols):
-            n = b.num_rows
-            if n == 0:
-                continue
-            vals = jax.lax.dynamic_update_slice(vals, c.values[:n], (off,))
-            valid = jax.lax.dynamic_update_slice(valid, c.validity[:n], (off,))
-            off += n
-        out_cols.append(TpuColumnVector(dt, vals, valid,
-                                        cols[0].dictionary))
-    return ColumnarBatch(out_cols, total, schema)
+    per_col = [[Col.from_vector(b.column(ci)) for b in batches]
+               for ci in range(ncols)]
+    key = ("concat", len(batches), caps, cap,
+           tuple((f.name, f.data_type) for f in schema) if schema else
+           tuple(c[0].dtype for c in per_col))
+    counts_v = jnp.asarray(counts, jnp.int32)
+    out = fuse.call_fused(key, "concat", lambda: kernel,
+                          (per_col, counts_v),
+                          lambda: kernel(per_col, counts_v))
+    return ColumnarBatch([c.to_vector() for c in out], total, schema)

@@ -42,10 +42,23 @@ def _doubling_scan(values, mask_fn, combine):
 
 
 def seg_starts(boundary):
-    """Index of the segment start for every row."""
+    """Index of the segment start for every row: the most recent boundary at
+    or before the row. Marked indices are prefix-monotone (earlier segments
+    start earlier), so one NATIVE global cummax is exact — no cross-segment
+    contamination and ~30x cheaper than the log-step doubling scan."""
     idx = jnp.arange(boundary.shape[0], dtype=jnp.int32)
     marked = jnp.where(boundary, idx, jnp.int32(0))
-    return _doubling_scan(marked, lambda i, s: i >= s, jnp.maximum)
+    return jax.lax.cummax(marked)
+
+
+def seg_ends(boundary):
+    """Index of the segment end for every row: the next boundary (exclusive)
+    minus one. Suffix-monotone, so one native reversed cummin is exact."""
+    cap = boundary.shape[0]
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    next_b = jnp.concatenate([boundary[1:], jnp.ones((1,), jnp.bool_)])
+    marked = jnp.where(next_b, idx, jnp.int32(2**31 - 1))
+    return jax.lax.cummin(marked, reverse=True)
 
 
 def segmented_scan(values, boundary, combine):

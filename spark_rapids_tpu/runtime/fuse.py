@@ -1,0 +1,209 @@
+"""Whole-stage fusion: one jitted XLA program per (operator, shape bucket).
+
+Reference contrast: the reference issues one cudf CUDA kernel per expression op
+(GpuExpression columnarEval chains, SURVEY.md §1 L0/L4); kernel launches are
+cheap on-node so that is fine there. On TPU every eager jax op is a separate
+XLA program dispatch — through the axon tunnel each dispatch is a network
+round-trip, and even locally the per-op Python/trace overhead dominates small
+batches (round-2 profile: ~5.4k primitive binds per TPC-H q1 batch, ~99% of
+hot-run wall time). The TPU-native answer is whole-stage compilation, the same
+move Spark itself makes for codegen: trace the operator's ENTIRE per-batch
+computation (expression eval -> sort/segment/compact kernels) once per input
+shape bucket, then replay one compiled XLA program per batch.
+
+Kernels are cached at module level keyed by a SEMANTIC key (operator class +
+expression-tree structure + static config), because the planner rebuilds exec
+instances on every collect() — a per-instance `jax.jit` would recompile every
+run. `jax.jit`'s own cache then handles shape/dtype/dictionary variation
+under each kernel.
+
+Also the home of the compile/dispatch accounting the tuning story needs:
+`stage_metrics()` reports traces (XLA compiles) vs dispatches (program
+replays); a healthy query does O(stages) traces and O(batches) dispatches.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_lock = threading.Lock()
+_kernels: dict = {}
+_MAX_KERNELS = 2048
+
+# counters are module-global (queries share kernels); reset via reset_metrics()
+_counts = {"traces": 0, "dispatches": 0}
+
+# SRT_FUSE_PROFILE=1: block on every kernel dispatch and record wall time per
+# kernel name (kernel_profile()) — the steering tool for finding slow stages
+import os as _os
+_PROFILE = _os.environ.get("SRT_FUSE_PROFILE", "") == "1"
+_profile: dict = {}
+
+
+def kernel_profile() -> dict:
+    """{kernel_name: (total_seconds, calls)} — only populated under
+    SRT_FUSE_PROFILE=1."""
+    with _lock:
+        return dict(_profile)
+
+
+def stage_metrics() -> dict:
+    """{'traces': n_xla_compiles, 'dispatches': n_program_replays}."""
+    with _lock:
+        return dict(_counts)
+
+
+def reset_metrics():
+    with _lock:
+        _counts["traces"] = 0
+        _counts["dispatches"] = 0
+
+
+class BatchKernel:
+    """A jitted per-batch function with trace/dispatch accounting.
+
+    The wrapped python body runs once per (shape, dtype, aux) signature —
+    counting its executions counts XLA compiles; counting __call__ counts
+    dispatches."""
+
+    __slots__ = ("name", "_jit")
+
+    def __init__(self, fn, name: str):
+        self.name = name
+
+        def traced(*args):
+            with _lock:
+                _counts["traces"] += 1
+            return fn(*args)
+
+        self._jit = jax.jit(traced)
+
+    def __call__(self, *args):
+        with _lock:
+            _counts["dispatches"] += 1
+        if _PROFILE:
+            import time
+            t0 = time.perf_counter()
+            out = jax.block_until_ready(self._jit(*args))
+            dt = time.perf_counter() - t0
+            with _lock:
+                tot, n = _profile.get(self.name, (0.0, 0))
+                _profile[self.name] = (tot + dt, n + 1)
+            return out
+        return self._jit(*args)
+
+
+def get_kernel(key, name: str, build) -> BatchKernel:
+    """Fetch-or-create the kernel for semantic key `key`. `build()` returns the
+    pure per-batch function (it may close over expression trees — the key must
+    capture everything that affects the traced program)."""
+    with _lock:
+        k = _kernels.get(key)
+    if k is not None:
+        return k
+    k = BatchKernel(build(), name)
+    with _lock:
+        if len(_kernels) >= _MAX_KERNELS:   # runaway-plan backstop
+            _kernels.clear()
+        return _kernels.setdefault(key, k)
+
+
+def clear_kernels():
+    with _lock:
+        _kernels.clear()
+
+
+_EAGER = "eager"  # sentinel cache entry: this key cannot be traced
+
+_TRACE_ERRORS = tuple(
+    e for e in (getattr(jax.errors, n, None) for n in
+                ("ConcretizationTypeError", "TracerArrayConversionError",
+                 "TracerBoolConversionError", "TracerIntegerConversionError"))
+    if e is not None)
+
+
+def call_fused(key, name: str, build, args, eager):
+    """Run the kernel for `key` over `args`, falling back PERMANENTLY to
+    `eager()` if the computation turns out to be untraceable (host sync /
+    data-dependent Python control flow inside eval). The fallback latches per
+    key so the failed trace is paid once."""
+    with _lock:
+        k = _kernels.get(key)
+    if k is _EAGER:
+        return eager()
+    try:
+        if k is None:
+            k = get_kernel(key, name, build)
+        return k(*args)
+    except _TRACE_ERRORS:
+        with _lock:
+            _kernels[key] = _EAGER
+        return eager()
+
+
+# -- semantic keys over expression trees -------------------------------------
+
+def expr_key(e):
+    """Stable hashable key for an expression tree: class identity + every
+    constructor-visible field, recursively. Two expressions with equal keys
+    must trace to the same program over equal-signature inputs."""
+    from spark_rapids_tpu.expr.core import Expression
+    if isinstance(e, Expression):
+        parts = [type(e).__module__, type(e).__qualname__]
+        d = vars(e) if hasattr(e, "__dict__") else {
+            s: getattr(e, s, None) for s in getattr(e, "__slots__", ())}
+        for k in sorted(d):
+            parts.append((k, _value_key(d[k])))
+        return tuple(parts)
+    return _value_key(e)
+
+
+def _value_key(v):
+    from spark_rapids_tpu.expr.core import Expression
+    from spark_rapids_tpu import types as T
+    if isinstance(v, Expression):
+        return expr_key(v)
+    if isinstance(v, (list, tuple)):
+        return tuple(_value_key(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _value_key(x)) for k, x in v.items()))
+    if isinstance(v, (str, int, float, bool, bytes, type(None))):
+        return (type(v).__name__, v)
+    if isinstance(v, T.DataType):
+        return v
+    return repr(v)
+
+
+def schema_key(schema) -> tuple:
+    return tuple((f.name, f.data_type, f.nullable) for f in schema)
+
+
+class DictRef:
+    """Hashable identity for a host string dictionary crossing a jit cache
+    boundary (pa.Array itself is unhashable). Equality is CONTENT equality so
+    per-batch dictionary objects with equal values hit the same compiled
+    program; the hash is cheap (length only) — buckets stay small because
+    dictionaries recur."""
+
+    __slots__ = ("arr",)
+
+    def __init__(self, arr):
+        self.arr = arr
+
+    def __hash__(self):
+        return hash(len(self.arr))
+
+    def __eq__(self, other):
+        if not isinstance(other, DictRef):
+            return NotImplemented
+        if self.arr is other.arr:
+            return True
+        try:
+            return self.arr.equals(other.arr)
+        except (TypeError, AttributeError):
+            return False
+
+    def __repr__(self):
+        return f"DictRef(len={len(self.arr)})"

@@ -42,17 +42,27 @@ NUM_PARTITIONS = "partitions"
 
 
 class GpuMetric:
-    __slots__ = ("name", "level", "_value", "_lock")
+    __slots__ = ("name", "level", "_value", "_lock", "_pending")
 
     def __init__(self, name: str, level: int = MODERATE):
         self.name = name
         self.level = level
         self._value = 0
         self._lock = threading.Lock()
+        self._pending = []
 
     def add(self, v):
         with self._lock:
             self._value += int(v)
+
+    def add_lazy(self, v):
+        """Accumulate a possibly-device scalar WITHOUT forcing a host sync;
+        pending scalars are folded into the value at read time (value())."""
+        if isinstance(v, int):
+            self.add(v)
+            return
+        with self._lock:
+            self._pending.append(v)
 
     def set(self, v):
         with self._lock:
@@ -60,7 +70,12 @@ class GpuMetric:
 
     @property
     def value(self):
-        return self._value
+        with self._lock:
+            if self._pending:
+                for v in self._pending:
+                    self._value += int(v)
+                self._pending = []
+            return self._value
 
     @contextmanager
     def timed(self):
