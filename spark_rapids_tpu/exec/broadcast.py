@@ -115,9 +115,22 @@ class BroadcastExchangeExec(TpuExec):
 
     def execute_partition(self, split: int):
         # host-bridge / reuse path (GpuBroadcastToCpuExec analog): stream the
-        # relation as a normal single-partition exec without taking ownership
+        # relation as a normal single-partition exec without taking ownership.
+        # The batch is materialized BEFORE yielding: once device arrays are
+        # referenced they outlive a concurrent release() by the last join
+        # consumer; if that release closes the relation mid-acquire (spill
+        # file unlinked / use-after-close), rebuild via a fresh broadcast().
         def it():
-            yield self.broadcast().get_batch()
+            batch = None
+            for attempt in range(3):
+                sb = self.broadcast()
+                try:
+                    batch = sb.get_batch()
+                    break
+                except (AssertionError, OSError):
+                    if attempt == 2:
+                        raise
+            yield batch
         return self.wrap_output(it())
 
     def args_string(self):

@@ -516,16 +516,33 @@ class StringSplit(Expression):
         return f"split({', '.join(map(repr, self.children))})"
 
 
+class _RawInt(int):
+    """int that remembers its raw JSON token (Spark's get_json_object
+    returns the document's own text for scalar leaves: 1.00 stays "1.00",
+    1e2 stays "1e2" — not Python's re-rendering)."""
+    def __new__(cls, s):
+        o = super().__new__(cls, s)
+        o.raw = s
+        return o
+
+
+class _RawFloat(float):
+    def __new__(cls, s):
+        o = super().__new__(cls, s)
+        o.raw = s
+        return o
+
+
 def json_path_get(doc: str, path: str):
     """Spark get_json_object semantics for the common path subset:
-    $.field, $.a.b, $.a[0].b, $[1]. Returns the raw string for JSON
+    $.field, $.a.b, $.a[0].b, $[1]. Returns the raw token text for JSON
     scalars, compact JSON text for objects/arrays, None for missing or
     invalid documents."""
     import json
     if doc is None or not path.startswith("$"):
         return None
     try:
-        cur = json.loads(doc)
+        cur = json.loads(doc, parse_int=_RawInt, parse_float=_RawFloat)
     except (ValueError, TypeError):
         return None
     i = 1
@@ -560,6 +577,8 @@ def json_path_get(doc: str, path: str):
         return json.dumps(cur, separators=(",", ":"))
     if isinstance(cur, bool):
         return "true" if cur else "false"
+    if isinstance(cur, (_RawInt, _RawFloat)):
+        return cur.raw
     return str(cur)
 
 

@@ -201,8 +201,12 @@ def bitunpack128(words_u32, bit_width: int, n: int, capacity: int):
     tile = min(_UNPACK_TILE, n128)
     rows = -(-n128 // tile) * tile
     need = rows * 4 * bw
-    w = jnp.zeros((need,), jnp.int32).at[:words_u32.shape[0]].set(
-        words_u32.astype(jnp.int32)).reshape(rows, 4 * bw)
+    # a legal parquet chunk's final bit-packed run may declare more 8-value
+    # groups than remaining values — the packed buffer can be LONGER than
+    # `need`; truncate before writing into the padded buffer
+    k = min(words_u32.shape[0], need)
+    w = jnp.zeros((need,), jnp.int32).at[:k].set(
+        words_u32[:k].astype(jnp.int32)).reshape(rows, 4 * bw)
     out = pl.pallas_call(
         functools.partial(_bitunpack_kernel, bw=bw),
         out_shape=jax.ShapeDtypeStruct((rows, 128), jnp.int32),
