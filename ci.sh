@@ -17,7 +17,7 @@ print('entry() ok')"
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
 
 echo "== api coverage gate (0 missing vs reference GpuOverrides) =="
-python tools/api_validation.py 0 1
+python tools/api_validation.py 0 0
 
 echo "== config docs in sync =="
 python -m spark_rapids_tpu.config

@@ -402,6 +402,24 @@ def jax_udf(fn, return_type: T.DataType, null_aware: bool = False):
     return _ju(fn, return_type, null_aware)
 
 
+def pandas_agg_udf(fn, return_type: T.DataType):
+    """GROUPED_AGG pandas UDF (reference GpuAggregateInPandasExec):
+    `F.pandas_agg_udf(lambda s: s.max() - s.min(), T.DOUBLE)("v")` inside
+    `df.group_by(k).agg(...)`. Arguments are column NAMES; fn receives one
+    pandas Series per column and returns a scalar per group."""
+    from spark_rapids_tpu.udf.pandas_exec import PandasAggUDF
+
+    def make(*cols):
+        names = []
+        for c in cols:
+            if not isinstance(c, str):
+                raise TypeError(
+                    "pandas_agg_udf arguments must be column names")
+            names.append(c)
+        return PandasAggUDF(fn, return_type, names)
+    return make
+
+
 def md5(c):
     return _S.Md5(_e(c))
 

@@ -754,3 +754,174 @@ class GenerateNode(PlanNode):
             data["pos"] = pa.array(out_pos, pa.int32())
         data["col"] = pa.array(out_vals, T.to_arrow_type(self.element_type))
         return pa.table(data)
+
+
+class MapInPandasNode(PlanNode):
+    """df.mapInPandas(fn, schema) (reference GpuMapInPandasExec role). The
+    host path runs the user fn in-process over the partition's batches."""
+
+    def __init__(self, fn, schema: T.StructType, child: PlanNode):
+        super().__init__(child)
+        self.fn = fn
+        self.schema = schema
+
+    @property
+    def output(self):
+        return self.schema
+
+    def execute_host(self, split):
+        tbl = self.child.execute_host(split)
+        dfs = iter([tbl.to_pandas()] if tbl.num_rows else [])
+        outs = [pa.Table.from_pandas(df, schema=self.schema.to_arrow(),
+                                     preserve_index=False)
+                for df in self.fn(dfs)]
+        return pa.concat_tables(outs) if outs else self._empty()
+
+    def args_string(self):
+        return f"fn={getattr(self.fn, '__name__', 'fn')}"
+
+
+class GroupedMapInPandasNode(PlanNode):
+    """groupBy(keys).applyInPandas(fn, schema) (reference
+    GpuFlatMapGroupsInPandasExec role)."""
+
+    def __init__(self, key_names: list, fn, schema: T.StructType,
+                 child: PlanNode):
+        super().__init__(child)
+        self.key_names = list(key_names)
+        self.fn = fn
+        self.schema = schema
+        for k in self.key_names:
+            child.output.index_of(k)  # raises on unknown key
+
+    @property
+    def output(self):
+        return self.schema
+
+    @property
+    def num_partitions(self):
+        return 1  # host path groups globally
+
+    def execute_host(self, split):
+        tables = [self.child.execute_host(i)
+                  for i in range(self.child.num_partitions)]
+        df = pa.concat_tables(tables).to_pandas()
+        outs = []
+        if len(df):
+            for _, g in df.groupby(self.key_names, dropna=False, sort=False):
+                outs.append(pa.Table.from_pandas(
+                    self.fn(g.reset_index(drop=True)),
+                    schema=self.schema.to_arrow(), preserve_index=False))
+        return pa.concat_tables(outs) if outs else self._empty()
+
+    def args_string(self):
+        return f"keys={self.key_names} fn={getattr(self.fn, '__name__', 'fn')}"
+
+
+class CoGroupedMapInPandasNode(PlanNode):
+    """cogroup(l, r).applyInPandas(fn, schema) (reference
+    GpuFlatMapCoGroupsInPandasExec role)."""
+
+    def __init__(self, left_keys: list, right_keys: list, fn,
+                 schema: T.StructType, left: PlanNode, right: PlanNode):
+        super().__init__(left, right)
+        self.left_key_names = list(left_keys)
+        self.right_key_names = list(right_keys)
+        self.fn = fn
+        self.schema = schema
+        if len(self.left_key_names) != len(self.right_key_names):
+            raise ValueError("cogroup key lists must have equal arity")
+        for k in self.left_key_names:
+            left.output.index_of(k)
+        for k in self.right_key_names:
+            right.output.index_of(k)
+
+    @property
+    def output(self):
+        return self.schema
+
+    @property
+    def num_partitions(self):
+        return 1
+
+    def execute_host(self, split):
+        from spark_rapids_tpu.udf.pandas_exec import _norm_key
+        l = pa.concat_tables([self.children[0].execute_host(i)
+                              for i in range(self.children[0].num_partitions)])
+        r = pa.concat_tables([self.children[1].execute_host(i)
+                              for i in range(self.children[1].num_partitions)])
+        ldf, rdf = l.to_pandas(), r.to_pandas()
+
+        def groups(df, keys):
+            order, out = [], {}
+            if len(df):
+                for key, g in df.groupby(keys, dropna=False, sort=False):
+                    k = _norm_key(key if isinstance(key, tuple) else (key,))
+                    out[k] = g.reset_index(drop=True)
+                    order.append(k)
+            return out, order
+
+        lg, lorder = groups(ldf, self.left_key_names)
+        rg, rorder = groups(rdf, self.right_key_names)
+        outs = []
+        for k in lorder + [k for k in rorder if k not in lg]:
+            le = lg.get(k, ldf.iloc[0:0])
+            re = rg.get(k, rdf.iloc[0:0])
+            outs.append(pa.Table.from_pandas(
+                self.fn(le, re), schema=self.schema.to_arrow(),
+                preserve_index=False))
+        return pa.concat_tables(outs) if outs else self._empty()
+
+    def args_string(self):
+        return (f"lkeys={self.left_key_names} rkeys={self.right_key_names} "
+                f"fn={getattr(self.fn, '__name__', 'fn')}")
+
+
+class AggregateInPandasNode(PlanNode):
+    """groupBy(keys).agg(pandas_agg_udf) (reference GpuAggregateInPandasExec
+    role). udfs: list of (fn, [input col names], output name, dtype)."""
+
+    def __init__(self, key_names: list, udfs: list, child: PlanNode):
+        super().__init__(child)
+        self.key_names = list(key_names)
+        self.udfs = list(udfs)
+        for k in self.key_names:
+            child.output.index_of(k)
+
+    @property
+    def output(self):
+        fields = []
+        for k in self.key_names:
+            f = self.child.output[self.child.output.index_of(k)]
+            fields.append(T.StructField(k, f.data_type, True))
+        for fn, cols, name, dtype in self.udfs:
+            fields.append(T.StructField(name, dtype, True))
+        return T.StructType(fields)
+
+    @property
+    def num_partitions(self):
+        return 1
+
+    def execute_host(self, split):
+        df = pa.concat_tables([self.child.execute_host(i)
+                               for i in range(self.child.num_partitions)]
+                              ).to_pandas()
+        schema = self.output.to_arrow()
+        rows = {f.name: [] for f in schema}
+        nkeys = len(self.key_names)
+        if len(df):
+            for key, g in df.groupby(self.key_names, dropna=False, sort=False):
+                key = key if isinstance(key, tuple) else (key,)
+                for i, k in enumerate(self.key_names):
+                    v = key[i]
+                    if isinstance(v, float) and v != v:
+                        v = None  # pandas surfaces a null int64 key as NaN
+                    rows[k].append(v)
+                for fn, cols, name, _ in self.udfs:
+                    rows[name].append(
+                        fn(*[g[c].reset_index(drop=True) for c in cols]))
+        cols = [pa.array(rows[f.name], type=f.type) for f in schema]
+        return pa.Table.from_arrays(cols, schema=schema)
+
+    def args_string(self):
+        return f"keys={self.key_names} udfs={len(self.udfs)}"

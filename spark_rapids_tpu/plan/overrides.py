@@ -881,5 +881,69 @@ def _register_all():
         "explode via one device gather program", conv_generate,
         GenerateChecks(TS.ORDERABLE), None, tag_generate))
 
+    # -- pandas-UDF exec family (reference execution/python/ GpuMapInPandas,
+    # GpuFlatMapGroupsInPandas, GpuFlatMapCoGroupsInPandas,
+    # GpuAggregateInPandas) -----------------------------------------------
+    from spark_rapids_tpu.udf.pandas_exec import (
+        AggregateInPandasExec, CoGroupedMapInPandasExec,
+        GroupedMapInPandasExec, MapInPandasExec)
+
+    def conv_map_in_pandas(meta, kids):
+        n = meta.node
+        return MapInPandasExec(n.fn, n.schema, kids[0], conf=meta.conf)
+
+    def conv_grouped_map(meta, kids):
+        n = meta.node
+        child = kids[0]
+        if child.num_partitions > 1:
+            # groups must be whole within a partition (Spark required
+            # distribution for FlatMapGroupsInPandas)
+            child = _hash_exchange([E.col(k) for k in n.key_names], child,
+                                   meta.conf, adaptive=True)
+        return GroupedMapInPandasExec(n.key_names, n.fn, n.schema, child,
+                                      conf=meta.conf)
+
+    def conv_cogrouped_map(meta, kids):
+        n = meta.node
+        left, right = kids
+        nparts = max(left.num_partitions, right.num_partitions)
+        if nparts > 1:
+            # co-partition both sides with the SAME partitioner arity so
+            # matching groups land in the same split (never adaptive: the
+            # coalescing reader would break co-partitioning)
+            left = ShuffleExchangeExec(
+                SP.HashPartitioner([E.col(k) for k in n.left_key_names],
+                                   nparts), left, conf=meta.conf)
+            right = ShuffleExchangeExec(
+                SP.HashPartitioner([E.col(k) for k in n.right_key_names],
+                                   nparts), right, conf=meta.conf)
+        return CoGroupedMapInPandasExec(
+            n.left_key_names, n.right_key_names, n.fn, n.schema, left, right,
+            conf=meta.conf)
+
+    def conv_agg_in_pandas(meta, kids):
+        n = meta.node
+        child = kids[0]
+        if child.num_partitions > 1:
+            if n.key_names:
+                child = _hash_exchange([E.col(k) for k in n.key_names], child,
+                                       meta.conf, adaptive=True)
+            else:
+                child = XS._GatherAllExec(child, conf=meta.conf)
+        udfs = [(fn, cols) for fn, cols, _, _ in n.udfs]
+        return AggregateInPandasExec(n.key_names, udfs, n.output, child,
+                                     conf=meta.conf)
+
+    exr(NN.MapInPandasNode, "mapInPandas via arrow worker exchange",
+        conv_map_in_pandas)
+    exr(NN.GroupedMapInPandasNode,
+        "grouped applyInPandas over a hash exchange", conv_grouped_map)
+    exr(NN.CoGroupedMapInPandasNode,
+        "cogrouped applyInPandas over co-partitioned exchanges",
+        conv_cogrouped_map)
+    exr(NN.AggregateInPandasNode,
+        "grouped pandas aggregate UDFs over a hash exchange",
+        conv_agg_in_pandas)
+
 
 _register_all()

@@ -163,6 +163,13 @@ class DataFrame:
     def window(self, window_exprs: list) -> "DataFrame":
         return DataFrame(NN.WindowNode(window_exprs, self._plan), self.session)
 
+    def map_in_pandas(self, fn, schema) -> "DataFrame":
+        """df.mapInPandas(fn, schema): fn(iterator[pandas.DataFrame]) ->
+        iterator[pandas.DataFrame] over each partition (reference
+        GpuMapInPandasExec)."""
+        return DataFrame(NN.MapInPandasNode(fn, _to_schema(schema),
+                                            self._plan), self.session)
+
     def explode(self, column: str, outer: bool = False,
                 pos: bool = False) -> "DataFrame":
         """explode/posexplode an array column into one row per element
@@ -254,16 +261,54 @@ class GroupedData:
         self.keys = keys
         self.df = df
 
+    def _key_names(self) -> list:
+        names = []
+        for k in self.keys:
+            if isinstance(k, (E.AttributeReference, E.Alias)):
+                names.append(k.name)
+            else:
+                raise ValueError(
+                    "pandas grouped operations need plain column keys, got "
+                    f"{k!r}")
+        return names
+
     def agg(self, *aggs) -> DataFrame:
+        from spark_rapids_tpu.udf.pandas_exec import PandasAggUDF
         named = []
+        pandas_udfs = []
         for i, a in enumerate(aggs):
             e = _to_expr(a)
             inner = e.child if isinstance(e, E.Alias) else e
+            if isinstance(inner, PandasAggUDF):
+                name = e.name if isinstance(e, E.Alias) else f"udf{i}"
+                pandas_udfs.append((inner.fn, list(inner.input_cols), name,
+                                    inner.return_type))
+                continue
             assert isinstance(inner, AggregateFunction), \
                 f"agg() requires aggregate expressions, got {e!r}"
             named.append(e)
+        if pandas_udfs:
+            if named:
+                raise ValueError(
+                    "cannot mix pandas aggregate UDFs with builtin "
+                    "aggregates in one agg() (Spark AggregateInPandas "
+                    "restriction)")
+            return DataFrame(NN.AggregateInPandasNode(
+                self._key_names(), pandas_udfs, self.df._plan),
+                self.df.session)
         return DataFrame(NN.AggregateNode(self.keys, named, self.df._plan),
                          self.df.session)
+
+    def apply_in_pandas(self, fn, schema) -> DataFrame:
+        """groupBy(keys).applyInPandas(fn, schema): fn(pandas.DataFrame) ->
+        pandas.DataFrame per group (keys included in the group frame)."""
+        return DataFrame(NN.GroupedMapInPandasNode(
+            self._key_names(), fn, _to_schema(schema), self.df._plan),
+            self.df.session)
+
+    def cogroup(self, other: "GroupedData") -> "CoGroupedData":
+        """cogroup(df1.groupBy(k), df2.groupBy(k)) — Spark's cogroup."""
+        return CoGroupedData(self, other)
 
     def count(self) -> DataFrame:
         from spark_rapids_tpu.expr.aggregates import Count
@@ -277,6 +322,31 @@ class GroupedData:
         host form for plans that carry it directly."""
         return PivotedGroupedData(self.keys, self.df, _to_expr(pivot_col),
                                   list(values))
+
+
+def _to_schema(schema) -> T.StructType:
+    if isinstance(schema, T.StructType):
+        return schema
+    return T.StructType([T.StructField(n, dt, True) for n, dt in schema])
+
+
+class CoGroupedData:
+    """Pair of grouped frames for cogrouped applyInPandas (Spark
+    PandasCogroupedOps)."""
+
+    def __init__(self, left: GroupedData, right: GroupedData):
+        if len(left.keys) != len(right.keys):
+            raise ValueError("cogroup requires equal-arity grouping keys")
+        self.left = left
+        self.right = right
+
+    def apply_in_pandas(self, fn, schema) -> DataFrame:
+        """fn(left_group_df, right_group_df) -> pandas.DataFrame per key
+        present on either side (the absent side gets an empty frame)."""
+        return DataFrame(NN.CoGroupedMapInPandasNode(
+            self.left._key_names(), self.right._key_names(), fn,
+            _to_schema(schema), self.left.df._plan, self.right.df._plan),
+            self.left.df.session)
 
 
 class PivotedGroupedData:

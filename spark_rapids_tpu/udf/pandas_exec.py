@@ -1,0 +1,384 @@
+"""Pandas-UDF exec family: mapInPandas, grouped applyInPandas, grouped
+aggregate, and cogrouped applyInPandas.
+
+Reference (SURVEY.md #40): sql-plugin/src/main/scala/org/apache/spark/sql/
+rapids/execution/python/ — GpuMapInPandasExec.scala, GpuFlatMapGroupsInPandas
+Exec.scala, GpuAggregateInPandasExec.scala, GpuFlatMapCoGroupsInPandasExec
+.scala: device batches hop to python workers over Arrow, the GPU side handles
+batching/partitioning, the pandas side runs the user function.
+
+TPU realization: the engine keeps scan→exchange on device; each PARTITION
+crosses to a spawned worker as one multi-batch Arrow IPC stream (preserving
+Spark's iterator-of-batches contract for mapInPandas — a stateful user fn
+sees the whole partition), the worker groups/applies in pandas, and results
+ride Arrow back and device_put as columnar batches. Grouped shapes require a
+hash exchange on the keys first (the planner inserts it, like Spark's
+required-distribution for FlatMapGroupsInPandas).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as futures
+
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.base import TpuExec, acquire_semaphore
+from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.runtime.tracing import trace_range
+from spark_rapids_tpu.udf.python_runtime import (PythonWorkerPool,
+                                                 PythonWorkerSemaphore,
+                                                 _to_ipc)
+
+
+def _schema_ipc(schema: T.StructType) -> bytes:
+    return _to_ipc(schema.to_arrow().empty_table())
+
+
+def _read_schema(schema_ipc: bytes):
+    import pyarrow as pa_w
+    return pa_w.ipc.open_stream(schema_ipc).read_all().schema
+
+
+def _stream_ipc(tables) -> bytes:
+    """Serialize a sequence of same-schema tables as one multi-batch stream."""
+    sink = pa.BufferOutputStream()
+    writer = None
+    for t in tables:
+        if writer is None:
+            writer = pa.ipc.new_stream(sink, t.schema)
+        for b in t.to_batches():
+            writer.write_batch(b)
+    if writer is None:
+        return b""
+    writer.close()
+    return sink.getvalue().to_pybytes()
+
+
+def _df_to_table(df, schema):
+    import pyarrow as pa_w
+    cols = []
+    for f in schema:
+        col = pa_w.Array.from_pandas(df[f.name], type=f.type)
+        cols.append(col)
+    return pa_w.Table.from_arrays(cols, schema=schema)
+
+
+# ---------------------------------------------------------------------------
+# worker-side functions (run in spawned processes; import only stdlib + arrow
+# + pandas + cloudpickle)
+
+def _worker_map_partition(payload: bytes, ipc: bytes,
+                          schema_ipc: bytes) -> bytes:
+    """mapInPandas: fn(iterator[DataFrame]) -> iterator[DataFrame]."""
+    import cloudpickle
+    import pyarrow as pa_w
+    fn = cloudpickle.loads(payload)
+    schema = _read_schema(schema_ipc)
+    if ipc:
+        reader = pa_w.ipc.open_stream(ipc)
+        dfs = (pa_w.Table.from_batches([b]).to_pandas() for b in reader)
+    else:
+        dfs = iter(())
+    sink = pa_w.BufferOutputStream()
+    writer = pa_w.ipc.new_stream(sink, schema)
+    for out_df in fn(dfs):
+        writer.write_table(_df_to_table(out_df, schema))
+    writer.close()
+    return sink.getvalue().to_pybytes()
+
+
+def _worker_grouped_apply(payload: bytes, ipc: bytes, schema_ipc: bytes,
+                          key_names: tuple) -> bytes:
+    """applyInPandas: fn(group DataFrame incl. key columns) -> DataFrame."""
+    import cloudpickle
+    import pyarrow as pa_w
+    fn = cloudpickle.loads(payload)
+    schema = _read_schema(schema_ipc)
+    sink = pa_w.BufferOutputStream()
+    writer = pa_w.ipc.new_stream(sink, schema)
+    if ipc:
+        df = pa_w.ipc.open_stream(ipc).read_all().to_pandas()
+        if len(df):
+            for _, g in df.groupby(list(key_names), dropna=False, sort=False):
+                out_df = fn(g.reset_index(drop=True))
+                writer.write_table(_df_to_table(out_df, schema))
+    writer.close()
+    return sink.getvalue().to_pybytes()
+
+
+def _norm_key(vals):
+    """Hashable, NaN-stable group key (NaN groups with NaN, Spark/pandas
+    dropna=False semantics)."""
+    out = []
+    for v in vals:
+        if isinstance(v, float) and v != v:
+            out.append("__nan__")
+        else:
+            out.append(v)
+    return tuple(out)
+
+
+def _worker_cogrouped_apply(payload: bytes, l_ipc: bytes, r_ipc: bytes,
+                            schema_ipc: bytes, l_keys: tuple, r_keys: tuple,
+                            l_schema_ipc: bytes, r_schema_ipc: bytes) -> bytes:
+    """cogroup applyInPandas: fn(left_group_df, right_group_df) -> DataFrame.
+    Keys present on either side produce a call; the absent side gets an
+    empty frame with its full schema (Spark FlatMapCoGroupsInPandas)."""
+    import cloudpickle
+    import pyarrow as pa_w
+    fn = cloudpickle.loads(payload)
+    schema = _read_schema(schema_ipc)
+
+    def side(ipc, sch_ipc):
+        if ipc:
+            return pa_w.ipc.open_stream(ipc).read_all().to_pandas()
+        return _read_schema(sch_ipc).empty_table().to_pandas()
+
+    ldf = side(l_ipc, l_schema_ipc)
+    rdf = side(r_ipc, r_schema_ipc)
+
+    def groups(df, keys):
+        if not len(df):
+            return {}, []
+        order, out = [], {}
+        for key, g in df.groupby(list(keys), dropna=False, sort=False):
+            k = _norm_key(key if isinstance(key, tuple) else (key,))
+            out[k] = g.reset_index(drop=True)
+            order.append(k)
+        return out, order
+
+    lg, lorder = groups(ldf, l_keys)
+    rg, rorder = groups(rdf, r_keys)
+    keys = lorder + [k for k in rorder if k not in lg]
+    sink = pa_w.BufferOutputStream()
+    writer = pa_w.ipc.new_stream(sink, schema)
+    for k in keys:
+        out_df = fn(lg.get(k, ldf.iloc[0:0]), rg.get(k, rdf.iloc[0:0]))
+        writer.write_table(_df_to_table(out_df, schema))
+    writer.close()
+    return sink.getvalue().to_pybytes()
+
+
+def _worker_agg_pandas(payloads: list, ipc: bytes, schema_ipc: bytes,
+                       key_names: tuple, input_cols: tuple) -> bytes:
+    """Grouped aggregate pandas UDFs: one scalar per (group, udf).
+    payloads[i] aggregates over the series named in input_cols[i]."""
+    import cloudpickle
+    import pyarrow as pa_w
+    fns = [cloudpickle.loads(p) for p in payloads]
+    schema = _read_schema(schema_ipc)
+    rows = {f.name: [] for f in schema}
+    nkeys = len(key_names)
+    if ipc:
+        df = pa_w.ipc.open_stream(ipc).read_all().to_pandas()
+        if len(df):
+            for key, g in df.groupby(list(key_names), dropna=False,
+                                     sort=False):
+                key = key if isinstance(key, tuple) else (key,)
+                for i, name in enumerate(key_names):
+                    v = key[i]
+                    # pandas surfaces a null int64 key as float NaN
+                    if isinstance(v, float) and v != v:
+                        v = None
+                    rows[schema.field(i).name].append(v)
+                for i, fn in enumerate(fns):
+                    args = [g[c].reset_index(drop=True)
+                            for c in input_cols[i]]
+                    rows[schema.field(nkeys + i).name].append(fn(*args))
+    cols = [pa_w.array(rows[f.name], type=f.type) for f in schema]
+    out = pa_w.Table.from_arrays(cols, schema=schema)
+    return _to_ipc(out)
+
+
+# ---------------------------------------------------------------------------
+# expression marker for grouped aggregate pandas UDFs
+
+from spark_rapids_tpu.expr.core import Expression as _Expression
+
+
+class PandasAggUDF(_Expression):
+    """F.pandas_agg_udf(fn, return_type)(col...) — the GROUPED_AGG flavor of
+    Spark's pandas_udf: fn(Series...) -> scalar per group (reference
+    GpuAggregateInPandasExec's udf payloads). Only valid inside
+    group_by().agg(); the session layer routes it to AggregateInPandasNode."""
+
+    def __init__(self, fn, return_type: T.DataType, input_cols: list):
+        self.fn = fn
+        self.return_type = return_type
+        self.input_cols = list(input_cols)
+        self.children = []
+
+    def eval(self, ctx):
+        raise RuntimeError(
+            "pandas aggregate UDFs only run inside group_by().agg()")
+
+    def alias(self, name: str):
+        from spark_rapids_tpu.expr.core import Alias
+        return Alias(self, name)
+
+    @property
+    def name(self):
+        return getattr(self.fn, "__name__", "pandas_agg")
+
+    @property
+    def dtype(self):
+        return self.return_type
+
+    @property
+    def nullable(self):
+        return True
+
+    @property
+    def child(self):
+        return None
+
+    def __repr__(self):
+        return f"pandas_agg:{self.name}({', '.join(self.input_cols)})"
+
+
+# ---------------------------------------------------------------------------
+# exec side
+
+def _submit(worker_fn, *args) -> bytes:
+    with PythonWorkerSemaphore._sem:
+        fut = PythonWorkerPool.get().pool.submit(worker_fn, *args)
+        return fut.result()
+
+
+def _yield_ipc_batches(out_ipc: bytes, schema: T.StructType):
+    if not out_ipc:
+        return
+    reader = pa.ipc.open_stream(out_ipc)
+    for b in reader:
+        if b.num_rows:
+            yield ColumnarBatch.from_arrow(pa.Table.from_batches([b]), schema)
+
+
+class _PandasExecBase(TpuExec):
+    def __init__(self, fn, out_schema: T.StructType, *children, conf=None):
+        super().__init__(*children, conf=conf)
+        self.fn = fn
+        self.out_schema = out_schema
+        self._udf_time = self.metrics.metric(M.OP_TIME, M.MODERATE)
+
+    @property
+    def output(self):
+        return self.out_schema
+
+    def _partition_ipc(self, child, split) -> bytes:
+        tables = []
+        for batch in child.execute_partition(split):
+            acquire_semaphore(self.metrics)
+            tables.append(batch.to_arrow())
+        return _stream_ipc(tables)
+
+    def _payload(self):
+        import cloudpickle
+        return cloudpickle.dumps(self.fn)
+
+
+class MapInPandasExec(_PandasExecBase):
+    """df.mapInPandas(fn, schema) — reference GpuMapInPandasExec.scala:
+    the user fn sees the partition as an iterator of pandas DataFrames."""
+
+    def execute_partition(self, split):
+        def it():
+            with trace_range("MapInPandas", self._udf_time):
+                ipc = self._partition_ipc(self.child, split)
+                out = _submit(_worker_map_partition, self._payload(), ipc,
+                              _schema_ipc(self.out_schema))
+            yield from _yield_ipc_batches(out, self.out_schema)
+        return self.wrap_output(it())
+
+    def args_string(self):
+        return f"fn={getattr(self.fn, '__name__', 'fn')}"
+
+
+class GroupedMapInPandasExec(_PandasExecBase):
+    """groupBy(keys).applyInPandas(fn, schema) — reference
+    GpuFlatMapGroupsInPandasExec.scala. The planner hash-exchanges the child
+    on the keys first, so every group is entirely within one partition."""
+
+    def __init__(self, key_names: list, fn, out_schema, child, conf=None):
+        super().__init__(fn, out_schema, child, conf=conf)
+        self.key_names = list(key_names)
+
+    def execute_partition(self, split):
+        def it():
+            with trace_range("GroupedMapInPandas", self._udf_time):
+                ipc = self._partition_ipc(self.child, split)
+                out = _submit(_worker_grouped_apply, self._payload(), ipc,
+                              _schema_ipc(self.out_schema),
+                              tuple(self.key_names))
+            yield from _yield_ipc_batches(out, self.out_schema)
+        return self.wrap_output(it())
+
+    def args_string(self):
+        return f"keys={self.key_names} fn={getattr(self.fn, '__name__', 'fn')}"
+
+
+class CoGroupedMapInPandasExec(_PandasExecBase):
+    """cogroup(left, right).applyInPandas — reference
+    GpuFlatMapCoGroupsInPandasExec.scala. Both children are hash-exchanged
+    on their keys with the SAME partition count, so matching groups meet in
+    the same split."""
+
+    def __init__(self, left_keys: list, right_keys: list, fn, out_schema,
+                 left, right, conf=None):
+        super().__init__(fn, out_schema, left, right, conf=conf)
+        self.left_key_names = list(left_keys)
+        self.right_key_names = list(right_keys)
+
+    @property
+    def num_partitions(self):
+        return self.children[0].num_partitions
+
+    def execute_partition(self, split):
+        def it():
+            with trace_range("CoGroupedMapInPandas", self._udf_time):
+                l_ipc = self._partition_ipc(self.children[0], split)
+                r_ipc = self._partition_ipc(self.children[1], split)
+                out = _submit(_worker_cogrouped_apply, self._payload(), l_ipc,
+                              r_ipc, _schema_ipc(self.out_schema),
+                              tuple(self.left_key_names),
+                              tuple(self.right_key_names),
+                              _schema_ipc(self.children[0].output),
+                              _schema_ipc(self.children[1].output))
+            yield from _yield_ipc_batches(out, self.out_schema)
+        return self.wrap_output(it())
+
+    def args_string(self):
+        return (f"lkeys={self.left_key_names} rkeys={self.right_key_names} "
+                f"fn={getattr(self.fn, '__name__', 'fn')}")
+
+
+class AggregateInPandasExec(_PandasExecBase):
+    """groupBy(keys).agg(pandas_agg_udf(...)) — reference
+    GpuAggregateInPandasExec.scala: each UDF reduces its input series to one
+    scalar per group."""
+
+    def __init__(self, key_names: list, udfs: list, out_schema, child,
+                 conf=None):
+        """udfs: list of (fn, [input column names])."""
+        super().__init__(None, out_schema, child, conf=conf)
+        self.key_names = list(key_names)
+        self.udfs = list(udfs)
+
+    def execute_partition(self, split):
+        import cloudpickle
+
+        def it():
+            with trace_range("AggregateInPandas", self._udf_time):
+                ipc = self._partition_ipc(self.child, split)
+                payloads = [cloudpickle.dumps(fn) for fn, _ in self.udfs]
+                out = _submit(_worker_agg_pandas, payloads, ipc,
+                              _schema_ipc(self.out_schema),
+                              tuple(self.key_names),
+                              tuple(tuple(cols) for _, cols in self.udfs))
+            yield from _yield_ipc_batches(out, self.out_schema)
+        return self.wrap_output(it())
+
+    def args_string(self):
+        return f"keys={self.key_names} udfs={len(self.udfs)}"

@@ -76,8 +76,9 @@ EXEC_ALIASES = {
     "CollectLimitExec": ("aliased", "LimitNode global (plan/nodes.py)"),
     "CustomShuffleReaderExec": ("aliased", "AdaptiveShuffleReaderExec (exec/exchange.py)"),
     "DataWritingCommandExec": ("aliased", "io/writer.py write_parquet/orc/csv"),
-    "FlatMapCoGroupsInPandasExec": ("partial", "udf/python_runtime.py worker "
-                                    "pool exists; cogroup exec not implemented"),
+    "FlatMapCoGroupsInPandasExec": ("aliased", "CoGroupedMapInPandasExec "
+                                    "(udf/pandas_exec.py) over co-partitioned "
+                                    "hash exchanges"),
     "GlobalLimitExec": ("aliased", "LimitNode(global_limit=True)"),
     "LocalLimitExec": ("aliased", "LimitNode(global_limit=False)"),
     "SortAggregateExec": ("aliased", "HashAggregateExec (sort-based internally "
