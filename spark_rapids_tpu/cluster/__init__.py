@@ -1,0 +1,1 @@
+from spark_rapids_tpu.cluster.minicluster import MiniCluster  # noqa: F401

@@ -1,0 +1,307 @@
+"""MiniCluster: driver + N executor OS processes running one query end-to-end.
+
+Reference (SURVEY.md §1 L6, components #29-#33): on a real Spark cluster the
+reference's plugin rides Spark's own scheduling — the driver's DAGScheduler
+splits the plan at ShuffleDependency boundaries, executor JVMs run tasks, and
+RapidsShuffleInternalManagerBase.scala:200 + the UCX transport move shuffle
+blocks between executor processes (Plugin.scala:137-211 wires the executor
+side up). Standalone, this module IS that cluster: a spawn-based executor
+pool, a stage scheduler splitting the plan at explicit ExchangeNodes
+(plan/distribute.py is the EnsureRequirements analog), and the existing
+TcpTransport + ShuffleBlockStore as the inter-process data plane.
+
+Execution model:
+- the driver rewrites the logical plan with ensure_distribution(), then
+  schedules each ExchangeNode bottom-up as a MAP STAGE: every map task
+  executes one split of the exchange's child subtree on some executor,
+  partitions rows with the exchange's partitioner, and parks the buckets in
+  that executor's block store under a driver-assigned shuffle id;
+- the consumed exchange is replaced by a RemoteSourceNode carrying every
+  executor's block-server address; downstream tasks fetch their reduce
+  partition from all peers over TCP (union of blocks = the partition);
+- tasks ship with their RemoteSourceNodes PINNED to the task's reduce id, so
+  the subtree is single-partition on the executor and stage-local planning
+  (TpuOverrides) never inserts its own exchanges;
+- the final (result) stage returns Arrow IPC bytes to the driver.
+
+Scope note: stages whose inputs are not co-partitioned (e.g. a UNION mixing
+a scan leaf with a shuffle source) run as one task with unpinned sources —
+correct (the task redistributes locally) but not parallel across executors.
+"""
+
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+import os
+import traceback
+
+import pyarrow as pa
+
+# NOTE: engine imports stay INSIDE functions — the spawn bootstrap imports
+# this module in the executor child BEFORE _executor_main can select the jax
+# platform, and importing the engine under the axon env would initialize the
+# TPU backend in every executor.
+
+
+# ---------------------------------------------------------------------------
+# executor process
+# ---------------------------------------------------------------------------
+
+def _executor_main(conn, platform: str, conf_settings: dict):
+    """Executor entry (spawned): block server + task loop (the standalone
+    Plugin.scala:137-211 executor-side bring-up analog)."""
+    if platform:
+        os.environ["JAX_PLATFORMS"] = platform
+    import jax
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    import cloudpickle
+    import spark_rapids_tpu  # noqa: F401  (x64 etc.)
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.exec.base import TaskContext
+    from spark_rapids_tpu.plan.transitions import to_device_plan
+    from spark_rapids_tpu.shuffle.manager import ShuffleBlockStore
+    from spark_rapids_tpu.shuffle.transport import TcpTransport
+
+    conf = RapidsConf(conf_settings)
+    store = ShuffleBlockStore.get()
+    transport = TcpTransport(conf)
+    conn.send({"op": "ready", "port": transport.port, "pid": os.getpid()})
+
+    def run_map(task):
+        plan = task["plan"]
+        part = task["partitioner"].bind(plan.output)
+        sid = task["shuffle_id"]
+        store.ensure_shuffle(sid)
+        exec_root = to_device_plan(plan, conf)
+        with TaskContext():
+            for split in task["splits"]:
+                for batch in exec_root.execute_partition(split):
+                    for pid, piece in part.partition(batch, split):
+                        if piece.num_rows:
+                            store.write_block(sid, pid, piece)
+        return {"sizes": store.partition_sizes(sid, part.num_partitions)}
+
+    def run_result(task):
+        plan = task["plan"]
+        exec_root = to_device_plan(plan, conf)
+        tables = []
+        with TaskContext():
+            for split in task["splits"]:
+                for batch in exec_root.execute_partition(split):
+                    tables.append(batch.to_arrow())
+        if not tables:
+            out = plan.output.to_arrow().empty_table()
+        else:
+            out = pa.concat_tables(tables)
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, out.schema) as w:
+            w.write_table(out)
+        return {"ipc": sink.getvalue().to_pybytes()}
+
+    while True:
+        msg = conn.recv()
+        op = msg["op"]
+        if op == "stop":
+            transport.shutdown()
+            conn.send({"op": "bye"})
+            break
+        try:
+            if op == "map":
+                reply = run_map(cloudpickle.loads(msg["task"]))
+            elif op == "result":
+                reply = run_result(cloudpickle.loads(msg["task"]))
+            elif op == "ensure_shuffle":
+                store.ensure_shuffle(msg["shuffle_id"])
+                reply = {}
+            elif op == "drop_shuffle":
+                store.unregister_shuffle(msg["shuffle_id"])
+                reply = {}
+            else:
+                raise ValueError(f"unknown op {op}")
+            reply.update({"op": "done", "ok": True})
+        except BaseException:  # noqa: BLE001 — shipped back to the driver
+            reply = {"op": "done", "ok": False,
+                     "error": traceback.format_exc()}
+        conn.send(reply)
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def _clone_plan(plan):
+    import cloudpickle
+    return cloudpickle.loads(cloudpickle.dumps(plan))
+
+
+def _pin_sources(plan, reduce_id: int):
+    """Deep-replace every RemoteSourceNode with a pinned copy."""
+    from spark_rapids_tpu.plan import nodes as NN
+    if isinstance(plan, NN.RemoteSourceNode):
+        return plan.pinned(reduce_id)
+    plan.children = [_pin_sources(c, reduce_id) for c in plan.children]
+    return plan
+
+
+def _collect_sources(plan, out):
+    from spark_rapids_tpu.plan import nodes as NN
+    if isinstance(plan, NN.RemoteSourceNode):
+        out.append(plan)
+    for c in plan.children:
+        _collect_sources(c, out)
+    return out
+
+
+def _has_non_source_leaves(plan):
+    from spark_rapids_tpu.plan import nodes as NN
+    if not plan.children:
+        return not isinstance(plan, NN.RemoteSourceNode)
+    return any(_has_non_source_leaves(c) for c in plan.children)
+
+
+class MiniCluster:
+    """Driver for N executor processes; `collect(df)` runs the DataFrame's
+    plan across them (DAGScheduler + cluster-manager stand-in)."""
+
+    def __init__(self, n_executors: int = 2, conf=None, platform: str = "cpu"):
+        from spark_rapids_tpu.config import RapidsConf
+        self.conf = conf or RapidsConf()
+        self.n_executors = n_executors
+        self._shuffle_ids = itertools.count(1000)
+        ctx = mp.get_context("spawn")
+        self._conns, self._procs, self.addresses = [], [], []
+        for _ in range(n_executors):
+            parent, child = ctx.Pipe()
+            p = ctx.Process(target=_executor_main,
+                            args=(child, platform, dict(self.conf.settings)),
+                            daemon=True)
+            p.start()
+            hello = parent.recv()
+            assert hello["op"] == "ready"
+            self._conns.append(parent)
+            self._procs.append(p)
+            self.addresses.append(("127.0.0.1", hello["port"]))
+        self._rr = itertools.cycle(range(n_executors))
+
+    # -- task plumbing ------------------------------------------------------
+    def _dispatch(self, jobs):
+        """jobs: list of (executor_idx, op, task_dict). Runs each executor's
+        queue sequentially, executors in parallel; returns replies in job
+        order."""
+        import cloudpickle
+        by_exec: dict[int, list] = {}
+        for j, (ei, op, task) in enumerate(jobs):
+            by_exec.setdefault(ei, []).append((j, op, task))
+        replies = [None] * len(jobs)
+        # send one task per executor at a time (the Pipe is a simple duplex
+        # channel); round-robin until all queues drain
+        pending = {ei: list(q) for ei, q in by_exec.items()}
+        inflight = {}
+        while pending or inflight:
+            for ei, q in list(pending.items()):
+                if ei not in inflight and q:
+                    j, op, task = q.pop(0)
+                    self._conns[ei].send(
+                        {"op": op, "task": cloudpickle.dumps(task)})
+                    inflight[ei] = j
+                if not q:
+                    del pending[ei]
+            for ei, j in list(inflight.items()):
+                reply = self._conns[ei].recv()
+                if not reply.get("ok"):
+                    raise RuntimeError(
+                        f"executor {ei} task failed:\n{reply.get('error')}")
+                replies[j] = reply
+                del inflight[ei]
+        return replies
+
+    # -- scheduling ---------------------------------------------------------
+    def collect(self, df) -> pa.Table:
+        from spark_rapids_tpu.plan.distribute import (ensure_distribution,
+                                                      stage_order)
+        plan = _clone_plan(df._plan)
+        plan = ensure_distribution(plan, self.n_executors)
+        for exchange, parent, idx in stage_order(plan):
+            source = self._run_map_stage(exchange)
+            parent.children[idx] = source
+        return self._run_result_stage(plan)
+
+    def _run_map_stage(self, exchange):
+        from spark_rapids_tpu.plan import nodes as NN
+        from spark_rapids_tpu.shuffle import partitioning as SP
+        child = exchange.child
+        if exchange.partitioning == "hash":
+            part = SP.HashPartitioner(exchange.keys, exchange.num_out)
+        elif exchange.partitioning == "single":
+            part = SP.SinglePartitioner()
+        elif exchange.partitioning == "roundrobin":
+            part = SP.RoundRobinPartitioner(exchange.num_out)
+        else:
+            raise NotImplementedError(
+                "range partitioning needs driver-side sampling (use "
+                "sort with a single exchange in MiniCluster)")
+        sid = next(self._shuffle_ids)
+        # every executor must know the shuffle id — a peer with no map task
+        # for it still serves (empty) metadata requests from reducers
+        for c in self._conns:
+            c.send({"op": "ensure_shuffle", "shuffle_id": sid})
+        for c in self._conns:
+            reply = c.recv()
+            assert reply.get("ok"), reply
+        jobs = []
+        for split, task in self._stage_tasks(child):
+            task.update({"shuffle_id": sid, "partitioner": part})
+            jobs.append((next(self._rr), "map", task))
+        self._dispatch(jobs)
+        return NN.RemoteSourceNode(sid, child.output, part.num_partitions,
+                                   list(self.addresses))
+
+    def _stage_tasks(self, subtree):
+        """Yield (split, task) covering every partition of `subtree`.
+        Co-partitioned shuffle inputs → one pinned task per reduce id;
+        leaf-only stages → one task per leaf split; mixed → one task."""
+        sources = _collect_sources(subtree, [])
+        if sources and not _has_non_source_leaves(subtree) and \
+                len({s.n_parts for s in sources}) == 1:
+            n = sources[0].n_parts
+            for r in range(n):
+                yield r, {"plan": _pin_sources(_clone_plan(subtree), r),
+                          "splits": [0]}
+        elif not sources:
+            for s in range(subtree.num_partitions):
+                yield s, {"plan": subtree, "splits": [s]}
+        else:
+            yield 0, {"plan": subtree,
+                      "splits": list(range(subtree.num_partitions))}
+
+    def _run_result_stage(self, plan) -> pa.Table:
+        jobs = [(next(self._rr), "result", task)
+                for _, task in self._stage_tasks(plan)]
+        replies = self._dispatch(jobs)
+        tables = []
+        for r in replies:
+            t = pa.ipc.open_stream(r["ipc"]).read_all()
+            if t.num_rows or not tables:
+                tables.append(t)
+        return pa.concat_tables(tables)
+
+    def shutdown(self):
+        for c in self._conns:
+            try:
+                c.send({"op": "stop"})
+                c.recv()
+            except (EOFError, OSError):
+                pass
+        for p in self._procs:
+            p.join(timeout=10)
+            if p.is_alive():
+                p.terminate()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False

@@ -1,0 +1,55 @@
+"""RemoteFetchExec — stage input reading peer executors' shuffle blocks.
+
+Reference: RapidsShuffleIterator (RapidsShuffleInternalManagerBase.scala /
+RapidsShuffleClient.doFetch) — a reduce task's input iterator that fetches
+its partition's blocks from every mapper's block server. Here each fetch is
+the TcpTransport windowed/throttled protocol; blocks deserialize straight to
+device batches."""
+
+from __future__ import annotations
+
+from spark_rapids_tpu import config as CFG
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec.base import TpuExec, acquire_semaphore
+from spark_rapids_tpu.runtime import metrics as M
+
+
+class RemoteFetchExec(TpuExec):
+    def __init__(self, shuffle_id: int, schema: T.StructType, n_parts: int,
+                 locations: list, pinned_reduce: int | None = None,
+                 conf=None):
+        super().__init__(conf=conf)
+        self.shuffle_id = shuffle_id
+        self.schema = schema
+        self.n_parts = n_parts
+        self.locations = list(locations)
+        self.pinned_reduce = pinned_reduce
+        self._fetch_time = self.metrics.metric(M.READ_FS_TIME, M.MODERATE)
+
+    @property
+    def output(self):
+        return self.schema
+
+    @property
+    def num_partitions(self):
+        return 1 if self.pinned_reduce is not None else self.n_parts
+
+    def execute_partition(self, split):
+        from spark_rapids_tpu.shuffle.transport import (InflightThrottle,
+                                                        TcpShuffleClient)
+        rid = self.pinned_reduce if self.pinned_reduce is not None else split
+        bounce = self.conf.get(CFG.SHUFFLE_BOUNCE_BUFFER_SIZE)
+        throttle = InflightThrottle(
+            self.conf.get(CFG.SHUFFLE_MAX_INFLIGHT_BYTES))
+
+        def it():
+            for addr in self.locations:
+                client = TcpShuffleClient(tuple(addr), bounce, throttle)
+                for batch in client.fetch_blocks(self.shuffle_id, rid):
+                    acquire_semaphore(self.metrics)
+                    yield batch
+        return self.wrap_output(it())
+
+    def args_string(self):
+        return (f"shuffle={self.shuffle_id} pinned={self.pinned_reduce} "
+                f"peers={len(self.locations)}")

@@ -40,9 +40,12 @@ LEFT_SEMI = "leftsemi"
 LEFT_ANTI = "leftanti"
 CROSS = "cross"
 
-_BUILD_NULL_RANK = jnp.int32(-2)
-_STREAM_NULL_RANK = jnp.int32(-1)
-_PAD_RANK = jnp.int32(2**31 - 1)
+# plain ints (weak-typed under jnp ops): creating jnp scalars at import time
+# would initialize the default jax backend before a process has a chance to
+# select its platform (MiniCluster executors force CPU after import)
+_BUILD_NULL_RANK = -2
+_STREAM_NULL_RANK = -1
+_PAD_RANK = 2**31 - 1
 
 
 def _concat_key_cols(build_keys, stream_keys):

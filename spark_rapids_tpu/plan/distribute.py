@@ -1,0 +1,116 @@
+"""Distributed planning: EnsureRequirements at the plan level.
+
+Reference analogy: Spark's EnsureRequirements inserts ShuffleExchangeExec
+wherever a child's output partitioning does not satisfy an operator's
+required distribution; the MiniCluster driver (cluster/minicluster.py) then
+splits the plan at the explicit ExchangeNodes into stages, exactly like
+Spark's DAGScheduler splits at ShuffleDependency boundaries.
+
+The single-process engine instead inserts exchanges at the EXEC level inside
+TpuOverrides conversions — that is invisible to a cluster scheduler, so the
+distributed path makes every data movement explicit in the PLAN first. After
+this pass, any operator that needs co-located rows (keyed aggregate, equi
+join, window partitions, grouped pandas UDFs, global sort/limit) sits above
+an ExchangeNode that guarantees it; shipping each stage task with its
+sources pinned to one reduce partition then makes every stage-local
+conversion take the single-partition (no internal exchange) path.
+"""
+
+from __future__ import annotations
+
+from spark_rapids_tpu.expr import core as E
+from spark_rapids_tpu.plan import nodes as NN
+
+
+def _hash_dist(child, keys, n_parts):
+    """Hash-exchange unless the child is already exchanged on the same keys."""
+    if not keys:
+        return _single_dist(child)
+    if (isinstance(child, NN.ExchangeNode) and child.partitioning == "hash"
+            and [repr(k) for k in child.keys] == [repr(k) for k in keys]):
+        return child
+    return NN.ExchangeNode(child, "hash", n_parts, keys=keys)
+
+
+def dist_parts(node) -> int:
+    """Partition count under DISTRIBUTED execution. PlanNode.num_partitions
+    describes the single-process host interpreter (e.g. AggregateNode says 1
+    because the interpreter aggregates globally); distributed operators are
+    partition-preserving above the exchange this pass gave them."""
+    if isinstance(node, NN.ExchangeNode):
+        return node.num_out
+    if isinstance(node, NN.RemoteSourceNode):
+        return node.num_partitions
+    if isinstance(node, NN.UnionNode):
+        return sum(dist_parts(c) for c in node.children)
+    if not node.children:
+        return node.num_partitions
+    return dist_parts(node.children[0])
+
+
+def _single_dist(child):
+    if dist_parts(child) == 1:
+        return child
+    return NN.ExchangeNode(child, "single", 1)
+
+
+def ensure_distribution(node: NN.PlanNode, n_parts: int) -> NN.PlanNode:
+    """Bottom-up rewrite inserting the exchanges each operator requires."""
+    node.children = [ensure_distribution(c, n_parts) for c in node.children]
+
+    if isinstance(node, NN.AggregateNode):
+        keys = [k for k in node.group_exprs]
+        node.children = [_hash_dist(node.child, keys, n_parts)]
+    elif isinstance(node, NN.JoinNode):
+        left, right = node.children
+        if node.left_keys:
+            # co-partition both sides with the same arity
+            node.children = [
+                _hash_dist(left, node.left_keys, n_parts),
+                _hash_dist(right, node.right_keys, n_parts)]
+        else:
+            # keyless (cross / conditional) join: all rows in one task
+            node.children = [_single_dist(left), _single_dist(right)]
+    elif isinstance(node, NN.SortNode) and getattr(node, "global_sort", False):
+        node.children = [_single_dist(node.child)]
+    elif isinstance(node, NN.LimitNode) and node.global_limit:
+        node.children = [_single_dist(node.child)]
+    elif isinstance(node, NN.WindowNode):
+        from spark_rapids_tpu.expr import windows as WX
+
+        def _unalias(e):
+            return e.child if isinstance(e, E.Alias) else e
+        spec = _unalias(node.window_exprs[0]).spec
+        part_by = list(spec.partition_by)
+        node.children = ([_hash_dist(node.child, part_by, n_parts)]
+                         if part_by else [_single_dist(node.child)])
+    elif isinstance(node, NN.GroupedMapInPandasNode):
+        keys = [E.col(k) for k in node.key_names]
+        node.children = [_hash_dist(node.child, keys, n_parts)]
+    elif isinstance(node, NN.AggregateInPandasNode):
+        keys = [E.col(k) for k in node.key_names]
+        node.children = ([_hash_dist(node.child, keys, n_parts)]
+                         if keys else [_single_dist(node.child)])
+    elif isinstance(node, NN.CoGroupedMapInPandasNode):
+        left, right = node.children
+        node.children = [
+            _hash_dist(left, [E.col(k) for k in node.left_key_names], n_parts),
+            _hash_dist(right, [E.col(k) for k in node.right_key_names],
+                       n_parts)]
+    return node
+
+
+def stage_order(root: NN.PlanNode) -> list:
+    """Exchanges in bottom-up (dependency) order. Each entry is
+    (exchange_node, parent_node, child_index); the root 'result stage' is the
+    plan itself after all exchanges are replaced."""
+    out = []
+
+    def walk(node, parent, idx):
+        for i, c in enumerate(node.children):
+            walk(c, node, i)
+        if isinstance(node, NN.ExchangeNode) and parent is not None:
+            out.append((node, parent, idx))
+
+    walk(root, None, 0)
+    return out

@@ -925,3 +925,56 @@ class AggregateInPandasNode(PlanNode):
 
     def args_string(self):
         return f"keys={self.key_names} udfs={len(self.udfs)}"
+
+
+class RemoteSourceNode(PlanNode):
+    """Stage input: shuffle blocks served by cluster executors (the stage
+    boundary the MiniCluster driver leaves behind after scheduling a map
+    stage — reference role: ShuffledRowRDD reading RapidsShuffleManager
+    blocks, RapidsShuffleInternalManagerBase.scala:200).
+
+    `locations` are (host, port) block servers; partition r is the union of
+    every executor's blocks for reduce id r. When the driver ships a task it
+    PINS the node to that task's reduce id (pinned_reduce), making the node
+    single-partition so stage-local planning never inserts exchanges."""
+
+    def __init__(self, shuffle_id: int, schema: T.StructType, n_parts: int,
+                 locations: list, pinned_reduce: int | None = None):
+        super().__init__()
+        self.shuffle_id = shuffle_id
+        self.schema = schema
+        self.n_parts = n_parts
+        self.locations = list(locations)
+        self.pinned_reduce = pinned_reduce
+
+    @property
+    def output(self):
+        return self.schema
+
+    @property
+    def num_partitions(self):
+        return 1 if self.pinned_reduce is not None else self.n_parts
+
+    def pinned(self, reduce_id: int) -> "RemoteSourceNode":
+        return RemoteSourceNode(self.shuffle_id, self.schema, self.n_parts,
+                                self.locations, pinned_reduce=reduce_id)
+
+    def execute_host(self, split):
+        from spark_rapids_tpu import config as CFG
+        from spark_rapids_tpu.config import RapidsConf
+        from spark_rapids_tpu.shuffle.transport import (InflightThrottle,
+                                                        TcpShuffleClient)
+        conf = RapidsConf()
+        bounce = conf.get(CFG.SHUFFLE_BOUNCE_BUFFER_SIZE)
+        throttle = InflightThrottle(conf.get(CFG.SHUFFLE_MAX_INFLIGHT_BYTES))
+        rid = self.pinned_reduce if self.pinned_reduce is not None else split
+        tables = []
+        for addr in self.locations:
+            client = TcpShuffleClient(tuple(addr), bounce, throttle)
+            for batch in client.fetch_blocks(self.shuffle_id, rid):
+                tables.append(batch.to_arrow())
+        return pa.concat_tables(tables) if tables else self._empty()
+
+    def args_string(self):
+        return (f"shuffle={self.shuffle_id} parts={self.n_parts} "
+                f"pinned={self.pinned_reduce} hosts={len(self.locations)}")

@@ -934,6 +934,15 @@ def _register_all():
         return AggregateInPandasExec(n.key_names, udfs, n.output, child,
                                      conf=meta.conf)
 
+    def conv_remote_source(meta, kids):
+        from spark_rapids_tpu.cluster.remote import RemoteFetchExec
+        n = meta.node
+        return RemoteFetchExec(n.shuffle_id, n.schema, n.n_parts, n.locations,
+                               n.pinned_reduce, conf=meta.conf)
+
+    exr(NN.RemoteSourceNode, "remote shuffle fetch over TCP peers",
+        conv_remote_source)
+
     exr(NN.MapInPandasNode, "mapInPandas via arrow worker exchange",
         conv_map_in_pandas)
     exr(NN.GroupedMapInPandasNode,

@@ -65,6 +65,13 @@ class ShuffleBlockStore:
             self._serialized_mode[sid] = serialized
             return sid
 
+    def ensure_shuffle(self, shuffle_id: int, serialized: bool = False):
+        """Register a DRIVER-assigned shuffle id (MiniCluster executors must
+        agree on ids across processes, so the local counter cannot be used)."""
+        with self._lock:
+            self._blocks.setdefault(shuffle_id, {})
+            self._serialized_mode.setdefault(shuffle_id, serialized)
+
     # -- write side (RapidsCachingWriter.write:90) ---------------------------
     def write_block(self, shuffle_id: int, reduce_id: int, batch: ColumnarBatch):
         serialized = self._serialized_mode[shuffle_id]

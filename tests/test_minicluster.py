@@ -1,0 +1,97 @@
+"""MiniCluster: end-to-end queries across real OS processes.
+
+Reference role: the reference executes on a Spark cluster — driver schedules,
+executor JVMs exchange shuffle blocks over the transport
+(RapidsShuffleInternalManagerBase.scala:200, Plugin.scala:137-211). These
+tests stand up a driver + 2 executor processes and check oracle-correct
+results for shuffle-requiring shapes (group-by, join, global sort) and
+TPC-H q3 (VERDICT r2 'done' criterion)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.cluster import MiniCluster
+from spark_rapids_tpu.session import TpuSession
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with MiniCluster(n_executors=2, platform="cpu") as c:
+        yield c
+
+
+@pytest.fixture(scope="module")
+def spark():
+    return TpuSession()
+
+
+def _norm(rows):
+    def n(x):
+        if x is None or (isinstance(x, float) and x != x):
+            return (1, 0.0)
+        return (0, x)
+    return sorted(tuple(n(v) for v in r) for r in rows)
+
+
+def test_cluster_group_by(cluster, spark):
+    rng = np.random.default_rng(3)
+    n = 5000
+    tbl = pa.table({
+        "k": pa.array(rng.integers(0, 97, n).astype(np.int64)),
+        "v": pa.array(np.round(rng.uniform(-5, 5, n), 3)),
+    })
+    df = (spark.create_dataframe(tbl).repartition(4)
+          .group_by(F.col("k"))
+          .agg(F.sum(F.col("v")).alias("s"), F.count(F.col("v")).alias("c")))
+    got = cluster.collect(df)
+    exp = df.collect_host()
+    assert got.num_rows == 97
+    gm = {r["k"]: (r["s"], r["c"]) for r in got.to_pylist()}
+    for r in exp.to_pylist():
+        s, c = gm[r["k"]]
+        assert c == r["c"]
+        assert abs(s - r["s"]) < 1e-9 * max(1.0, abs(r["s"]))
+
+
+def test_cluster_join(cluster, spark):
+    rng = np.random.default_rng(4)
+    left = pa.table({
+        "k": pa.array(rng.integers(0, 50, 800).astype(np.int64)),
+        "a": pa.array(rng.integers(0, 1000, 800).astype(np.int64)),
+    })
+    right = pa.table({
+        "k": pa.array(rng.integers(0, 50, 300).astype(np.int64)),
+        "b": pa.array(rng.integers(0, 1000, 300).astype(np.int64)),
+    })
+    dl = spark.create_dataframe(left).repartition(3)
+    dr = spark.create_dataframe(right).repartition(2)
+    df = dl.join(dr, on="k")
+    got = cluster.collect(df)
+    exp = df.collect_host()
+    assert _norm(tuple(r.values()) for r in got.to_pylist()) == \
+        _norm(tuple(r.values()) for r in exp.to_pylist())
+
+
+def test_cluster_global_sort(cluster, spark):
+    rng = np.random.default_rng(5)
+    tbl = pa.table({"v": pa.array(rng.integers(-999, 999, 2000)
+                                  .astype(np.int64))})
+    df = spark.create_dataframe(tbl).repartition(4).sort(F.col("v"))
+    got = cluster.collect(df)
+    assert got.column("v").to_pylist() == sorted(tbl.column("v").to_pylist())
+
+
+def test_cluster_tpch_q3(cluster, spark, tmp_path_factory):
+    from spark_rapids_tpu.benchmarks import tpch
+    import bench
+    outdir = str(tmp_path_factory.mktemp("tpch_cluster"))
+    paths = tpch.generate(0.01, outdir)
+    dfs = tpch.load(spark, paths, files_per_partition=2)
+    tb = tpch.load_np(paths)
+    df = tpch.QUERIES["q3"](dfs)
+    got = cluster.collect(df).to_pylist()
+    exp = tpch.np_q3(tb)
+    bench.CHECKS["q3"](got, exp)
