@@ -9,12 +9,15 @@ row shape — while the BULK work (digit bytes → numbers) runs on device
 
 Scope (stage one): header optional (schema fields are matched to header
 columns BY NAME, like the host reader), single-byte delimiter, '\\n' line
-ends, no quoting/escapes, int32/int64/float64 columns on device (floats
-conf-gated; exponent/inf/nan notation in the body falls back). The whole
-scope decision happens in ONE host pass per file (`try_scan_for_device`)
-BEFORE the device iterator is committed — out-of-scope files return None
-and take the pyarrow host reader, the same per-type conservatism as the
-reference's spark.rapids.sql.csv.read.*.enabled confs."""
+ends, RFC-4180 quoted fields (boundaries masked by quote parity, wrapping
+quotes stripped; doubled/stray quotes inside content fall back — numeric
+columns never legally contain them), int32/int64/float64 columns on device
+(floats conf-gated; exponent/inf/nan notation in the body falls back). The
+whole scope decision happens in ONE host pass per file
+(`try_scan_for_device`) BEFORE the device iterator is committed —
+out-of-scope files return None and take the pyarrow host reader, the same
+per-type conservatism as the reference's
+spark.rapids.sql.csv.read.*.enabled confs."""
 
 from __future__ import annotations
 
@@ -58,7 +61,7 @@ def try_scan_for_device(path: str, schema, delimiter: str = ",",
             raw = f.read()
     except OSError:
         return None
-    if b'"' in raw or b"\r" in raw:
+    if b"\r" in raw:
         return None
     if raw and not raw.endswith(b"\n"):
         raw += b"\n"
@@ -68,8 +71,8 @@ def try_scan_for_device(path: str, schema, delimiter: str = ",",
     start = 0
     if header:
         first_nl = raw.find(b"\n")
-        if first_nl < 0:
-            return None
+        if first_nl < 0 or b'"' in raw[:first_nl]:
+            return None           # quoted headers: host reader
         names = raw[:first_nl].decode("utf-8", "replace").split(delimiter)
         start = first_nl + 1
         col_of = {}
@@ -85,6 +88,21 @@ def try_scan_for_device(path: str, schema, delimiter: str = ",",
     body = data[start:]
     is_delim = body == delim_byte
     is_nl = body == ord("\n")
+    is_quote = body == ord('"')
+    n_quotes = int(is_quote.sum())
+    if n_quotes:
+        # RFC 4180: delimiters/newlines INSIDE quotes are content, not
+        # boundaries. A char is in-quotes iff the count of quote chars
+        # BEFORE it is odd (doubled quotes toggle twice, preserving parity).
+        parity = np.zeros(len(body), np.int64)
+        np.cumsum(is_quote, out=parity)
+        in_quotes = np.empty(len(body), bool)
+        in_quotes[0] = False
+        in_quotes[1:] = (parity[:-1] & 1).astype(bool)
+        if n_quotes & 1:
+            return None           # unterminated quote: host reader
+        is_delim = is_delim & ~in_quotes
+        is_nl = is_nl & ~in_quotes
     n_rows = int(is_nl.sum())
     if n_rows == 0:
         return CsvShape(data, 0, np.zeros((0, n_file_cols), np.int32),
@@ -107,6 +125,19 @@ def try_scan_for_device(path: str, schema, delimiter: str = ",",
     prev[1:, 0] = b[:-1, -1]
     starts = (prev + 1 + start).astype(np.int32)
     lens = (b - prev - 1).astype(np.int32)
+    if n_quotes:
+        # unquote wrapped fields: "123" → 123 (content indices shift by one
+        # on each side). Quotes that are NOT a simple field wrapping (doubled
+        # quotes inside content, stray mid-field quotes) go to the host
+        # reader — numeric columns never legally contain them.
+        last = np.clip(starts + lens - 1, 0, len(data) - 1)
+        first_b = data[np.clip(starts, 0, len(data) - 1)]
+        quoted = (lens >= 2) & (first_b == ord('"')) & \
+            (data[last] == ord('"'))
+        if int(quoted.sum()) * 2 != n_quotes:
+            return None
+        starts = (starts + quoted).astype(np.int32)
+        lens = (lens - 2 * quoted).astype(np.int32)
     return CsvShape(data, n_rows, starts, lens, col_of)
 
 

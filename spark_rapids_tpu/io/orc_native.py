@@ -97,6 +97,48 @@ class OrcMeta:
 
 
 # type kinds
+C_NONE, C_ZLIB, C_SNAPPY, C_LZO, C_LZ4, C_ZSTD = 0, 1, 2, 3, 4, 5
+
+
+def _decompress_chunked(buf: bytes, codec: int) -> bytes:
+    """Decompress one ORC stream: a sequence of chunks, each with a 3-byte
+    little-endian header `(chunkLength << 1) | isOriginal` (ORC spec
+    'Compression'). ZLIB is raw DEFLATE; SNAPPY's uncompressed length rides
+    as the snappy-format leading varint (pyarrow's codec needs it
+    explicitly)."""
+    import zlib
+    out = []
+    pos = 0
+    n = len(buf)
+    while pos + 3 <= n:
+        hdr = buf[pos] | (buf[pos + 1] << 8) | (buf[pos + 2] << 16)
+        pos += 3
+        length = hdr >> 1
+        chunk = buf[pos:pos + length]
+        pos += length
+        if hdr & 1:                       # isOriginal: stored uncompressed
+            out.append(chunk)
+        elif codec == C_ZLIB:
+            out.append(zlib.decompressobj(wbits=-15).decompress(chunk))
+        elif codec == C_SNAPPY:
+            import pyarrow as pa
+            size = shift = 0
+            i = 0
+            while True:
+                b = chunk[i]
+                size |= (b & 0x7F) << shift
+                i += 1
+                if not b & 0x80:
+                    break
+                shift += 7
+            dec = pa.Codec("snappy").decompress(chunk, size)
+            out.append(dec.to_pybytes() if hasattr(dec, "to_pybytes")
+                       else bytes(dec))
+        else:
+            raise NotImplementedError(f"ORC compression codec {codec}")
+    return b"".join(out)
+
+
 K_SHORT, K_INT, K_LONG = 2, 3, 4
 K_FLOAT, K_DOUBLE = 5, 6
 K_STRING = 7
@@ -128,13 +170,16 @@ def read_meta(path: str) -> OrcMeta:
                 footer_len = val
             elif fnum == 2:
                 meta.compression = val
-        if meta.compression != 0:
-            raise NotImplementedError("compressed ORC stays on the host path")
+        if meta.compression not in (C_NONE, C_ZLIB, C_SNAPPY):
+            raise NotImplementedError(
+                f"ORC compression codec {meta.compression}: host path")
         need = 1 + ps_len + footer_len
         if need > tail_len:            # giant footer: re-read exactly enough
             f.seek(size - need)
             tail = f.read(need)
     footer = tail[-1 - ps_len - footer_len:-1 - ps_len]
+    if meta.compression != C_NONE:
+        footer = _decompress_chunked(footer, meta.compression)
     types: list[tuple[int, list, list]] = []   # (kind, subtypes, names)
     for fnum, wt, val in _ProtoReader(footer).fields():
         if fnum == 3:          # StripeInformation
@@ -178,10 +223,12 @@ def read_meta(path: str) -> OrcMeta:
     return meta
 
 
-def _read_stripe_footer(raw: bytes, si: StripeInfo):
+def _read_stripe_footer(raw: bytes, si: StripeInfo, compression: int = 0):
     """(streams [(kind, column, length)], encodings [kind])."""
     foot_off = si.offset + si.index_length + si.data_length
     footer = raw[foot_off:foot_off + si.footer_length]
+    if compression != C_NONE:
+        footer = _decompress_chunked(footer, compression)
     streams, encodings = [], []
     for fnum, _w, val in _ProtoReader(footer).fields():
         if fnum == 1:
@@ -458,16 +505,33 @@ def read_stripe_device(path: str, meta: OrcMeta, stripe_idx: int, schema,
     si_rel.index_length = si.index_length
     si_rel.data_length = si.data_length
     si_rel.footer_length = si.footer_length
-    streams, encodings = _read_stripe_footer(raw, si_rel)
+    streams, encodings = _read_stripe_footer(raw, si_rel, meta.compression)
     n_rows = si.num_rows
     cap = bucket_capacity(max(n_rows, 1))
 
-    # absolute offset of each stream within `raw` (file layout order)
+    # absolute offset of each stream within `raw` (file layout order). For
+    # compressed files, every stream decompresses on host and `raw` becomes
+    # the concatenation of the DECOMPRESSED streams — offsets, the device
+    # upload, and every decoder below then work unchanged (the reference
+    # decompresses on device, GpuOrcScan.scala:375; host inflate is this
+    # engine's stage-1.5, same as the parquet path).
     offsets = {}
-    off = 0
-    for kind, col, length in streams:
-        offsets[(kind, col)] = (off, length)
-        off += length
+    if meta.compression == C_NONE:
+        off = 0
+        for kind, col, length in streams:
+            offsets[(kind, col)] = (off, length)
+            off += length
+    else:
+        pieces = []
+        src_off = new_off = 0
+        for kind, col, length in streams:
+            blob = _decompress_chunked(raw[src_off:src_off + length],
+                                       meta.compression)
+            src_off += length
+            pieces.append(blob)
+            offsets[(kind, col)] = (new_off, len(blob))
+            new_off += len(blob)
+        raw = b"".join(pieces)
 
     name_to_col = {n: i for i, n in enumerate(meta.column_names)}
     raw_dev = None  # uploaded lazily, ONCE, shared by every int column
@@ -500,14 +564,18 @@ def read_stripe_device(path: str, meta: OrcMeta, stripe_idx: int, schema,
                     raw, doff, dlen, present, n_rows, sf_type, cap,
                     raw_dev=raw_dev))
             elif kind == K_STRING:
-                if enc != E_DICTIONARY_V2:
+                if enc == E_DICTIONARY_V2:
+                    if raw_dev is None:
+                        import jax.numpy as jnp
+                        raw_dev = jnp.asarray(np.frombuffer(raw, np.uint8))
+                    cols.append(string_column_to_device(
+                        raw, offsets, col_id, present, n_rows, cap,
+                        raw_dev=raw_dev, n_dict=dict_size))
+                elif enc == E_DIRECT_V2:
+                    cols.append(direct_string_column_to_device(
+                        raw, offsets, col_id, present, n_rows, cap))
+                else:
                     raise NotImplementedError(f"string encoding {enc}")
-                if raw_dev is None:
-                    import jax.numpy as jnp
-                    raw_dev = jnp.asarray(np.frombuffer(raw, np.uint8))
-                cols.append(string_column_to_device(
-                    raw, offsets, col_id, present, n_rows, cap,
-                    raw_dev=raw_dev, n_dict=dict_size))
             else:
                 cols.append(float_column_to_device(
                     raw, doff, dlen, present, n_rows, sf_type, cap))
@@ -588,3 +656,50 @@ def string_column_to_device(raw: bytes, offsets: dict, col_id: int,
     codes = jnp.where(valid, codes, 0)   # canonical-null invariant
     cv = TpuColumnVector(T.STRING, codes, valid)
     return cv.with_dictionary(sorted_dict)
+
+
+def direct_string_column_to_device(raw: bytes, offsets: dict, col_id: int,
+                                   present: np.ndarray | None, n_rows: int,
+                                   capacity: int):
+    """DIRECT_V2 string column (no dictionary): the DATA stream is the
+    concatenated UTF-8 bytes, LENGTH the per-present-row byte lengths
+    (unsigned RLEv2). A zero-copy arrow StringArray over (offsets, blob)
+    rides the engine's normal dictionary-encoding ingestion — same endpoint
+    as the reference's device byte columns (GpuOrcScan.scala:375), reached
+    via the engine's sorted-dictionary representation."""
+    import jax.numpy as jnp
+    import pyarrow as pa
+    from spark_rapids_tpu.columnar import arrow as ai
+    from spark_rapids_tpu.columnar.vector import TpuColumnVector
+    from spark_rapids_tpu.ops import parquet_decode as PD
+
+    doff, dlen = offsets[(S_DATA, col_id)]
+    loff, llen = offsets[(S_LENGTH, col_id)]
+    n_present = n_rows if present is None else int(present.sum())
+    if n_present == 0:
+        codes = jnp.zeros((capacity,), jnp.int32)
+        valid = jnp.zeros((capacity,), jnp.bool_)
+        cv = TpuColumnVector(T.STRING, codes, valid)
+        return cv.with_dictionary(pa.array([], pa.string()))
+    lens = rlev2_decode_host(raw, loff, llen, n_present, signed=False)
+    off_arr = np.zeros(n_present + 1, np.int32)
+    np.cumsum(lens, out=off_arr[1:])
+    blob = raw[doff:doff + dlen]
+    arr = pa.StringArray.from_buffers(
+        n_present, pa.py_buffer(off_arr.tobytes()), pa.py_buffer(blob))
+    cv = ai.string_array_to_device(arr)
+    codes_present = cv.data
+    k = min(codes_present.shape[0], capacity)
+    if present is None:
+        codes = jnp.zeros((capacity,), jnp.int32).at[:k].set(
+            codes_present[:k])
+        valid = jnp.arange(capacity) < n_rows
+    else:
+        pres = jnp.zeros((capacity,), jnp.bool_).at[:n_rows].set(
+            jnp.asarray(present.astype(bool)))
+        padded = jnp.zeros((capacity,), jnp.int32).at[:k].set(
+            codes_present[:k])
+        codes, valid = PD.expand_present_to_rows(padded, pres, capacity)
+    codes = jnp.where(valid, codes, 0)   # canonical-null invariant
+    return TpuColumnVector(T.STRING, codes, valid).with_dictionary(
+        cv.dictionary)

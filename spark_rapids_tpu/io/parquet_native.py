@@ -111,12 +111,19 @@ class PageHeader(typing.NamedTuple):
     num_values: int
     encoding: int             # 8=RLE_DICTIONARY(PLAIN_DICT=2), 0=PLAIN
     header_len: int
+    # v2 only: level-section byte lengths (levels are NEVER compressed) and
+    # whether the values section is compressed
+    def_len: int = 0
+    rep_len: int = 0
+    v2_compressed: bool = True
 
 
 def parse_page_header(buf: bytes, pos: int) -> PageHeader:
     r = _CompactReader(buf, pos)
     d = r.read_struct()
     ptype = d[1]
+    dl = rl = 0
+    v2c = True
     if ptype == 0:      # DataPageHeader (field 5)
         dph = d.get(5, {})
         nv, enc = dph.get(1, 0), dph.get(2, 0)
@@ -126,9 +133,11 @@ def parse_page_header(buf: bytes, pos: int) -> PageHeader:
     elif ptype == 3:    # DataPageHeaderV2 (field 8)
         dph = d.get(8, {})
         nv, enc = dph.get(1, 0), dph.get(4, 0)
+        dl, rl = dph.get(5, 0), dph.get(6, 0)
+        v2c = bool(dph.get(7, 1))
     else:
         nv, enc = 0, 0
-    return PageHeader(ptype, d[2], d[3], nv, enc, r.pos - pos)
+    return PageHeader(ptype, d[2], d[3], nv, enc, r.pos - pos, dl, rl, v2c)
 
 
 # -- RLE / bit-packed hybrid structure ---------------------------------------
@@ -272,6 +281,8 @@ def read_chunk_pages(path: str, row_group: int, column: int,
                                                      max_def)
         except (NativeBuildError, OSError):
             pass  # no native toolchain: parse in Python below
+        except NotImplementedError:
+            pass  # e.g. v2 data pages: the Python parser below handles them
     if raw_pages is not None:
         d_off, d_len, d_n = dict_info
         dict_vals = _decode_plain_dictionary(
@@ -292,14 +303,18 @@ def read_chunk_pages(path: str, row_group: int, column: int,
         ph = parse_page_header(buf, pos)
         body = pos + ph.header_len
         raw_body = buf[body:body + ph.compressed_size]
-        page_body = (raw_body if dec is None else
-                     bytes(dec.decompress(raw_body, ph.uncompressed_size)))
         if ph.page_type == 2:                       # dictionary page
+            page_body = (raw_body if dec is None else
+                         bytes(dec.decompress(raw_body,
+                                              ph.uncompressed_size)))
             dict_vals = _decode_plain_dictionary(
                 col.physical_type, page_body, ph.num_values)
         elif ph.page_type == 0:                     # data page v1
             if ph.encoding not in (8, 2):           # RLE_DICT / PLAIN_DICT
                 raise NotImplementedError(f"page encoding {ph.encoding}")
+            page_body = (raw_body if dec is None else
+                         bytes(dec.decompress(raw_body,
+                                              ph.uncompressed_size)))
             # work PAGE-relative so RleSegment offsets index page_bytes
             page_bytes = page_body
             p = 0
@@ -319,6 +334,28 @@ def read_chunk_pages(path: str, row_group: int, column: int,
                                     n_present)
             pages.append((ph.num_values, def_levels, bw, page_bytes,
                           p - 1, segs))
+            values_seen += ph.num_values
+        elif ph.page_type == 3:                     # data page v2
+            if ph.encoding not in (8, 2):
+                raise NotImplementedError(f"page encoding {ph.encoding}")
+            if ph.rep_len:
+                raise NotImplementedError("repeated (nested) v2 page")
+            # levels ride UNCOMPRESSED ahead of the (optionally compressed)
+            # values section; def levels have NO length prefix in v2
+            levels = raw_body[:ph.def_len]
+            data = raw_body[ph.def_len:]
+            if dec is not None and ph.v2_compressed:
+                data = bytes(dec.decompress(
+                    data, ph.uncompressed_size - ph.def_len - ph.rep_len))
+            if max_def and ph.def_len:
+                def_levels = decode_rle_host(levels, 0, ph.def_len, 1,
+                                             ph.num_values)
+            else:
+                def_levels = np.ones(ph.num_values, dtype=np.int32)
+            bw = data[0]
+            n_present = int(def_levels.sum())
+            segs = parse_rle_hybrid(data, 1, len(data), bw, n_present)
+            pages.append((ph.num_values, def_levels, bw, data, 0, segs))
             values_seen += ph.num_values
         else:
             raise NotImplementedError(f"page type {ph.page_type}")

@@ -358,15 +358,44 @@ def test_csv_device_decode_overflow_and_overlong(tmp_path):
                                     -9223372036854775808, None, None, 7]
 
 
-def test_csv_quoted_file_falls_back_with_int_schema(tmp_path):
-    """A quoted field anywhere sends the whole file to the host reader even
-    when every column type is device-parseable."""
+def test_csv_quoted_fields_device_path(tmp_path):
+    """RFC-4180 quoted fields stay on the DEVICE path: wrapping quotes strip,
+    and delimiters inside quotes are content, not boundaries."""
     from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.io import csv_native as CN
     from spark_rapids_tpu.session import TpuSession
-    path = _write_csv(tmp_path, 'a\n"5"\n6\n', name="qint.csv")
-    schema = T.StructType([T.StructField("a", T.LONG)])
+    path = _write_csv(tmp_path, 'a,b\n"5",10\n6,"20"\n"7","30"\n,40\n',
+                      name="qint.csv")
+    schema = T.StructType([T.StructField("a", T.LONG),
+                           T.StructField("b", T.LONG)])
+    shape = CN.try_scan_for_device(path, schema, ",", True, False)
+    assert shape is not None          # quoted numerics are in scope now
     out = TpuSession().read_csv(path, schema=schema).collect()
-    assert out["a"].to_pylist() == [5, 6]
+    assert out["a"].to_pylist() == [5, 6, 7, None]
+    assert out["b"].to_pylist() == [10, 20, 30, 40]
+
+
+def test_csv_quotes_mask_embedded_delims_and_newlines(tmp_path):
+    """Delimiters and newlines inside a quoted field must not split rows.
+    The quoted field itself is non-numeric -> that CELL parses null, but row
+    structure (and the sibling numeric column) survives on device."""
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.io import csv_native as CN
+    path = _write_csv(tmp_path, 'a,b\n"1,5",10\n2,20\n', name="qdelim.csv")
+    schema = T.StructType([T.StructField("a", T.LONG),
+                           T.StructField("b", T.LONG)])
+    shape = CN.try_scan_for_device(path, schema, ",", True, False)
+    assert shape is not None and shape.n_rows == 2
+    # stray/doubled quotes inside content -> host path
+    p2 = _write_csv(tmp_path, 'a\n"5""6"\n', name="qq.csv")
+    assert CN.try_scan_for_device(
+        p2, T.StructType([T.StructField("a", T.LONG)]), ",", True,
+        False) is None
+    # unterminated quote -> host path
+    p3 = _write_csv(tmp_path, 'a\n"5\n', name="unterm.csv")
+    assert CN.try_scan_for_device(
+        p3, T.StructType([T.StructField("a", T.LONG)]), ",", True,
+        False) is None
 
 
 def test_csv_float_gate_ignores_header_letters(tmp_path):

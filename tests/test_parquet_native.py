@@ -201,3 +201,21 @@ def test_native_scanner_matches_python_parser(tmp_path, monkeypatch):
             assert pb_n == pb_p
             assert (dl_n == dl_p).all()
             assert segs_n == segs_p
+
+
+@pytest.mark.parametrize("codec", ["NONE", "snappy"])
+def test_v2_data_pages_device_path(tmp_path, codec):
+    """DATA_PAGE_V2 (data_page_version='2.0'): uncompressed level prefix +
+    optionally-compressed values section, def levels without the v1 length
+    prefix — decodes on the device path, nulls included."""
+    t = mixed_table(3000, seed=11)
+    f = str(tmp_path / "v2.parquet")
+    pq.write_table(t, f, compression=codec, use_dictionary=True,
+                   data_page_version="2.0", data_page_size=4 << 10)
+    schema = T.StructType.from_arrow(t.schema)
+    md = pq.ParquetFile(f).metadata
+    outs = [PN.read_row_group_device(f, rg, schema).to_arrow()
+            for rg in range(md.num_row_groups)]
+    got = pa.concat_tables(outs)
+    for name in t.column_names:
+        assert got.column(name).to_pylist() == t.column(name).to_pylist(), name
