@@ -106,6 +106,36 @@ def child_main():
     }
     if platform != "tpu":
         line["degraded"] = f"platform={platform}"
+    # secondary metric: the 22-query TPC-DS sweep at small scale (breadth —
+    # window/decimal/basket shapes; reference qa_nightly role). Failures
+    # never take down the primary metric. Default OFF on the real chip: the
+    # sweep's compile volume could eat the child budget, and a timeout kill
+    # mid-dispatch wedges the tunnel (docs/perf_notes.md).
+    default_secondary = "1" if platform != "tpu" else "0"
+    if os.environ.get("TPCDS_SECONDARY", default_secondary) == "1":
+        try:
+            from spark_rapids_tpu.benchmarks import tpcds
+            sf = float(os.environ.get("TPCDS_SF", "0.01"))
+            dpaths = tpcds.generate(sf, os.environ.get(
+                "TPCDS_DIR", f"/tmp/tpcds_sf{sf}"))
+            ddfs = tpcds.load(spark, dpaths)
+            dtb = tpcds.load_np(dpaths)
+            t0 = time.perf_counter()
+            n_ok = 0
+            for qname, q in tpcds.QUERIES.items():
+                got = [tuple(r.values())
+                       for r in q(ddfs).collect().to_pylist()]
+                exp = [tuple(r) for r in tpcds.NP_QUERIES[qname](dtb)]
+                if len(got) == len(exp):
+                    n_ok += 1
+            wall = time.perf_counter() - t0
+            line["secondary"] = {
+                "metric": f"tpcds_sf{sf}_22q_sweep",
+                "queries_ok": n_ok, "queries_total": len(tpcds.QUERIES),
+                "wall_s": round(wall, 2),
+            }
+        except Exception as e:  # noqa: BLE001 — secondary must not kill primary
+            line["secondary"] = {"error": repr(e)[:200]}
     print(json.dumps(line))
 
 

@@ -54,24 +54,54 @@ def generate(sf: float, outdir: str, files_per_table: int = 4) -> dict:
     # date_dim: one row per day, d_date_sk dense from 1
     sk = np.arange(1, N_DATES + 1, dtype=np.int64)
     doy = (sk - 1) % 366
+    moy = (doy // 31 + 1).astype(np.int32)
     write("date_dim", pa.table({
         "d_date_sk": pa.array(sk),
         "d_year": pa.array((FIRST_YEAR + (sk - 1) // 366).astype(np.int32)),
-        "d_moy": pa.array((doy // 31 + 1).astype(np.int32)),
+        "d_moy": pa.array(moy),
         "d_dom": pa.array((doy % 31 + 1).astype(np.int32)),
+        "d_qoy": pa.array(((moy - 1) // 3 + 1).astype(np.int32)),
+        "d_dow": pa.array((doy % 7).astype(np.int32)),
+    }), 1)
+
+    # time_dim: one row per minute of day
+    tsk = np.arange(1, 24 * 60 + 1, dtype=np.int64)
+    write("time_dim", pa.table({
+        "t_time_sk": pa.array(tsk),
+        "t_hour": pa.array(((tsk - 1) // 60).astype(np.int32)),
+        "t_minute": pa.array(((tsk - 1) % 60).astype(np.int32)),
+    }), 1)
+
+    # household_demographics: dep x vehicle x buy-potential cross
+    n_hd = 10 * 6 * 3
+    hd_sk = np.arange(1, n_hd + 1, dtype=np.int64)
+    write("household_demographics", pa.table({
+        "hd_demo_sk": pa.array(hd_sk),
+        "hd_dep_count": pa.array(((hd_sk - 1) % 10).astype(np.int32)),
+        "hd_vehicle_count": pa.array(
+            (((hd_sk - 1) // 10) % 6 - 1).astype(np.int32)),
+        "hd_buy_potential": pa.array(
+            np.array([">10000", "5001-10000", "Unknown"])[
+                ((hd_sk - 1) // 60) % 3]),
     }), 1)
 
     # item
     isk = np.arange(1, n_item + 1, dtype=np.int64)
     cat_id = rng.integers(0, len(CATEGORIES), n_item)
     brand_id = (cat_id + 1) * 1000 + rng.integers(1, 100, n_item)
+    class_id = rng.integers(1, 17, n_item)
     write("item", pa.table({
         "i_item_sk": pa.array(isk),
         "i_item_id": pa.array([f"ITEM{k:08d}" for k in isk]),
+        "i_item_desc": pa.array([f"desc {k} words" for k in isk]),
         "i_brand_id": pa.array(brand_id.astype(np.int32)),
         "i_brand": pa.array([f"brand#{b}" for b in brand_id]),
+        "i_class_id": pa.array(class_id.astype(np.int32)),
+        "i_class": pa.array([f"class{c}" for c in class_id]),
         "i_category_id": pa.array((cat_id + 1).astype(np.int32)),
         "i_category": pa.array(np.array(CATEGORIES)[cat_id]),
+        "i_current_price": pa.array(
+            np.round(rng.uniform(0.5, 100.0, n_item), 2)),
         "i_manufact_id": pa.array(
             rng.integers(1, 140, n_item).astype(np.int32)),
         "i_manager_id": pa.array(
@@ -101,15 +131,30 @@ def generate(sf: float, outdir: str, files_per_table: int = 4) -> dict:
 
     # customer_address / store (zips overlap so q19's <> filter selects)
     zips = rng.integers(10000, 10100, n_addr)
+    cities = np.array(["Midway", "Fairview", "Oakland", "Salem", "Georgetown",
+                       "Ashland", "Marion", "Union", "Clinton", "Greenfield"])
+    states = np.array(["CA", "TX", "NY", "GA", "OH", "WA", "IL", "MI"])
     write("customer_address", pa.table({
         "ca_address_sk": pa.array(np.arange(1, n_addr + 1, dtype=np.int64)),
         "ca_zip": pa.array([f"{z:05d}" for z in zips]),
+        "ca_city": pa.array(cities[rng.integers(0, len(cities), n_addr)]),
+        "ca_state": pa.array(states[rng.integers(0, len(states), n_addr)]),
+        "ca_country": pa.array(np.repeat("United States", n_addr)),
+        "ca_gmt_offset": pa.array(
+            rng.choice([-5.0, -6.0, -7.0, -8.0], n_addr)),
     }), 1)
     szips = rng.integers(10000, 10100, n_store)
     write("store", pa.table({
         "s_store_sk": pa.array(np.arange(1, n_store + 1, dtype=np.int64)),
         "s_store_name": pa.array([f"store{k}" for k in range(n_store)]),
         "s_zip": pa.array([f"{z:05d}" for z in szips]),
+        "s_city": pa.array(cities[rng.integers(0, len(cities), n_store)]),
+        "s_county": pa.array(
+            [f"{c} County" for c in
+             cities[rng.integers(0, len(cities), n_store)]]),
+        "s_state": pa.array(states[rng.integers(0, len(states), n_store)]),
+        "s_number_employees": pa.array(
+            rng.integers(200, 300, n_store).astype(np.int32)),
     }), 1)
 
     # customer
@@ -117,22 +162,49 @@ def generate(sf: float, outdir: str, files_per_table: int = 4) -> dict:
         "c_customer_sk": pa.array(np.arange(1, n_cust + 1, dtype=np.int64)),
         "c_current_addr_sk": pa.array(
             rng.integers(1, n_addr + 1, n_cust).astype(np.int64)),
+        "c_first_name": pa.array([f"First{k % 500}" for k in range(n_cust)]),
+        "c_last_name": pa.array([f"Last{k % 700}" for k in range(n_cust)]),
     }), 1)
 
-    # store_sales (fact)
+    # store_sales (fact). Money columns that TPC-DS declares decimal(7,2)
+    # ride as decimal128(7,2) — the decimal-heavy queries aggregate them
+    # exactly on device (scaled-int64 backing).
+    def dec72(arr):
+        from decimal import Decimal
+        cents = np.round(np.asarray(arr) * 100).astype(np.int64)
+        return pa.array([Decimal(int(v)).scaleb(-2) for v in cents],
+                        pa.decimal128(7, 2))
+
+    # basket structure: a TICKET is one visit — one customer, household,
+    # date, store, and address per ticket (row counts per ticket span 1..25
+    # so q34's 15-20 band and q73's 1-5 band both select)
+    n_tk = max(n_ss // 13, 1)
+    tk_sizes = rng.integers(1, 26, n_tk)
+    ticket = np.repeat(np.arange(1, n_tk + 1, dtype=np.int64), tk_sizes)
+    if len(ticket) < n_ss:
+        ticket = np.concatenate(
+            [ticket, np.full(n_ss - len(ticket), n_tk, np.int64)])
+    ticket = ticket[:n_ss]
+    tk_cust = rng.integers(1, n_cust + 1, n_tk + 1).astype(np.int64)
+    tk_hd = rng.integers(1, n_hd + 1, n_tk + 1).astype(np.int64)
+    tk_date = rng.integers(1, N_DATES + 1, n_tk + 1).astype(np.int64)
+    tk_store = rng.integers(1, n_store + 1, n_tk + 1).astype(np.int64)
+    tk_addr = rng.integers(1, n_addr + 1, n_tk + 1).astype(np.int64)
     write("store_sales", pa.table({
-        "ss_sold_date_sk": pa.array(
-            rng.integers(1, N_DATES + 1, n_ss).astype(np.int64)),
+        "ss_sold_date_sk": pa.array(tk_date[ticket - 1]),
+        "ss_sold_time_sk": pa.array(
+            rng.integers(1, 24 * 60 + 1, n_ss).astype(np.int64)),
         "ss_item_sk": pa.array(
             rng.integers(1, n_item + 1, n_ss).astype(np.int64)),
-        "ss_customer_sk": pa.array(
-            rng.integers(1, n_cust + 1, n_ss).astype(np.int64)),
+        "ss_customer_sk": pa.array(tk_cust[ticket - 1]),
         "ss_cdemo_sk": pa.array(
             rng.integers(1, n_cd + 1, n_ss).astype(np.int64)),
+        "ss_hdemo_sk": pa.array(tk_hd[ticket - 1]),
+        "ss_addr_sk": pa.array(tk_addr[ticket - 1]),
         "ss_promo_sk": pa.array(
             rng.integers(1, n_promo + 1, n_ss).astype(np.int64)),
-        "ss_store_sk": pa.array(
-            rng.integers(1, n_store + 1, n_ss).astype(np.int64)),
+        "ss_store_sk": pa.array(tk_store[ticket - 1]),
+        "ss_ticket_number": pa.array(ticket),
         "ss_quantity": pa.array(
             rng.integers(1, 100, n_ss).astype(np.int32)),
         "ss_list_price": pa.array(
@@ -141,8 +213,15 @@ def generate(sf: float, outdir: str, files_per_table: int = 4) -> dict:
             np.round(rng.uniform(1.0, 200.0, n_ss), 2)),
         "ss_ext_sales_price": pa.array(
             np.round(rng.uniform(1.0, 20000.0, n_ss), 2)),
+        "ss_ext_list_price": pa.array(
+            np.round(rng.uniform(1.0, 20000.0, n_ss), 2)),
+        "ss_ext_tax": pa.array(
+            np.round(rng.uniform(0.0, 1800.0, n_ss), 2)),
         "ss_coupon_amt": pa.array(
             np.round(rng.uniform(0.0, 50.0, n_ss), 2)),
+        "ss_net_paid": dec72(rng.uniform(0.0, 20000.0, n_ss)),
+        "ss_net_profit": dec72(rng.uniform(-5000.0, 15000.0, n_ss)),
+        "ss_ext_wholesale_cost": dec72(rng.uniform(1.0, 10000.0, n_ss)),
     }))
     return paths
 
@@ -291,7 +370,536 @@ def q19(dfs):
             .limit(100))
 
 
-QUERIES = {"q3": q3, "q42": q42, "q52": q52, "q55": q55, "q7": q7, "q19": q19}
+def _win_avg(df, value_col, part_cols, out_name):
+    """value avg over (partition by part_cols) with a full-partition frame —
+    the q53/q63/q89 window shape."""
+    from spark_rapids_tpu.expr import core as E
+    from spark_rapids_tpu.expr import windows as WX
+    from spark_rapids_tpu.expr.aggregates import Average, Sum
+    spec = WX.WindowSpec(tuple(E.col(p) for p in part_cols), (),
+                         WX.WindowFrame("rows", None, None))
+    return df.window([E.Alias(
+        WX.WindowExpression(Average(E.col(value_col)), spec), out_name)])
+
+
+def _win_sum(df, value_col, part_cols, out_name):
+    from spark_rapids_tpu.expr import core as E
+    from spark_rapids_tpu.expr import windows as WX
+    from spark_rapids_tpu.expr.aggregates import Sum
+    spec = WX.WindowSpec(tuple(E.col(p) for p in part_cols), (),
+                         WX.WindowFrame("rows", None, None))
+    return df.window([E.Alias(
+        WX.WindowExpression(Sum(E.col(value_col)), spec), out_name)])
+
+
+def q53(dfs):
+    """Quarterly manufacturer sales vs their window average (TPC-DS q53:
+    sum by manufact x quarter, avg OVER (PARTITION BY i_manufact_id),
+    keep quarters deviating >10%)."""
+    import spark_rapids_tpu.functions as F
+    c = F.col
+    item = (dfs["item"]
+            .filter(c("i_category").isin("Books", "Home", "Electronics"))
+            .select(c("i_item_sk").alias("ss_item_sk"), c("i_manufact_id")))
+    dd = (dfs["date_dim"].filter(c("d_year") == F.lit(2000))
+          .select(c("d_date_sk").alias("ss_sold_date_sk"), c("d_qoy")))
+    store = dfs["store"].select(c("s_store_sk").alias("ss_store_sk"))
+    base = (dfs["store_sales"]
+            .select(c("ss_item_sk"), c("ss_sold_date_sk"), c("ss_store_sk"),
+                    c("ss_sales_price"))
+            .join(item, on="ss_item_sk").join(dd, on="ss_sold_date_sk")
+            .join(store, on="ss_store_sk")
+            .group_by(c("i_manufact_id"), c("d_qoy"))
+            .agg(F.sum(c("ss_sales_price")).alias("sum_sales")))
+    w = _win_avg(base, "sum_sales", ["i_manufact_id"], "avg_quarterly_sales")
+    return (w.filter((c("avg_quarterly_sales") > F.lit(0.0))
+                     & (F.abs(c("sum_sales") - c("avg_quarterly_sales"))
+                        / c("avg_quarterly_sales") > F.lit(0.1)))
+            .select(c("i_manufact_id"), c("sum_sales"),
+                    c("avg_quarterly_sales"))
+            .sort(c("avg_quarterly_sales"), c("sum_sales"),
+                  c("i_manufact_id"))
+            .limit(100))
+
+
+def q63(dfs):
+    """Monthly manager sales vs their window average (TPC-DS q63 — q53's
+    shape with i_manager_id and d_moy)."""
+    import spark_rapids_tpu.functions as F
+    c = F.col
+    item = (dfs["item"]
+            .filter(c("i_category").isin("Books", "Home", "Electronics"))
+            .select(c("i_item_sk").alias("ss_item_sk"), c("i_manager_id")))
+    dd = (dfs["date_dim"].filter(c("d_year") == F.lit(2000))
+          .select(c("d_date_sk").alias("ss_sold_date_sk"), c("d_moy")))
+    base = (dfs["store_sales"]
+            .select(c("ss_item_sk"), c("ss_sold_date_sk"),
+                    c("ss_sales_price"))
+            .join(item, on="ss_item_sk").join(dd, on="ss_sold_date_sk")
+            .group_by(c("i_manager_id"), c("d_moy"))
+            .agg(F.sum(c("ss_sales_price")).alias("sum_sales")))
+    w = _win_avg(base, "sum_sales", ["i_manager_id"], "avg_monthly_sales")
+    return (w.filter((c("avg_monthly_sales") > F.lit(0.0))
+                     & (F.abs(c("sum_sales") - c("avg_monthly_sales"))
+                        / c("avg_monthly_sales") > F.lit(0.1)))
+            .select(c("i_manager_id"), c("sum_sales"),
+                    c("avg_monthly_sales"))
+            .sort(c("i_manager_id"), c("avg_monthly_sales"), c("sum_sales"))
+            .limit(100))
+
+
+def q89(dfs):
+    """Monthly class sales per store vs the (category, brand, store) window
+    average (TPC-DS q89)."""
+    import spark_rapids_tpu.functions as F
+    c = F.col
+    item = (dfs["item"]
+            .filter(c("i_category").isin("Books", "Electronics", "Sports"))
+            .select(c("i_item_sk").alias("ss_item_sk"), c("i_category"),
+                    c("i_class"), c("i_brand")))
+    dd = (dfs["date_dim"].filter(c("d_year") == F.lit(1999))
+          .select(c("d_date_sk").alias("ss_sold_date_sk"), c("d_moy")))
+    store = dfs["store"].select(c("s_store_sk").alias("ss_store_sk"),
+                                c("s_store_name"))
+    base = (dfs["store_sales"]
+            .select(c("ss_item_sk"), c("ss_sold_date_sk"), c("ss_store_sk"),
+                    c("ss_sales_price"))
+            .join(item, on="ss_item_sk").join(dd, on="ss_sold_date_sk")
+            .join(store, on="ss_store_sk")
+            .group_by(c("i_category"), c("i_class"), c("i_brand"),
+                      c("s_store_name"), c("d_moy"))
+            .agg(F.sum(c("ss_sales_price")).alias("sum_sales")))
+    w = _win_avg(base, "sum_sales",
+                 ["i_category", "i_brand", "s_store_name"],
+                 "avg_monthly_sales")
+    return (w.filter((c("avg_monthly_sales") != F.lit(0.0))
+                     & (F.abs(c("sum_sales") - c("avg_monthly_sales"))
+                        / c("avg_monthly_sales") > F.lit(0.1)))
+            .select(c("i_category"), c("i_class"), c("i_brand"),
+                    c("s_store_name"), c("d_moy"), c("sum_sales"),
+                    c("avg_monthly_sales"))
+            .sort((c("sum_sales") - c("avg_monthly_sales")).alias("_d"),
+                  c("s_store_name"), c("i_class"), c("d_moy"))
+            .limit(100))
+
+
+def q98(dfs):
+    """Class revenue ratio (TPC-DS q98): item revenue and its share of the
+    class total via SUM OVER (PARTITION BY i_class)."""
+    import spark_rapids_tpu.functions as F
+    c = F.col
+    item = (dfs["item"]
+            .filter(c("i_category").isin("Sports", "Books", "Home"))
+            .select(c("i_item_sk").alias("ss_item_sk"), c("i_item_id"),
+                    c("i_item_desc"), c("i_category"), c("i_class"),
+                    c("i_current_price")))
+    dd = (dfs["date_dim"]
+          .filter((c("d_year") == F.lit(1999)) & (c("d_moy") == F.lit(2)))
+          .select(c("d_date_sk").alias("ss_sold_date_sk")))
+    base = (dfs["store_sales"]
+            .select(c("ss_item_sk"), c("ss_sold_date_sk"),
+                    c("ss_ext_sales_price"))
+            .join(item, on="ss_item_sk").join(dd, on="ss_sold_date_sk")
+            .group_by(c("i_item_id"), c("i_item_desc"), c("i_category"),
+                      c("i_class"), c("i_current_price"))
+            .agg(F.sum(c("ss_ext_sales_price")).alias("itemrevenue")))
+    w = _win_sum(base, "itemrevenue", ["i_class"], "class_revenue")
+    return (w.select(c("i_item_id"), c("i_item_desc"), c("i_category"),
+                     c("i_class"), c("i_current_price"), c("itemrevenue"),
+                     (c("itemrevenue") * F.lit(100.0) / c("class_revenue"))
+                     .alias("revenueratio"))
+            .sort(c("i_category"), c("i_class"), c("i_item_id"),
+                  c("i_item_desc"), c("revenueratio")))
+
+
+def q43(dfs):
+    """Store sales by day of week (TPC-DS q43: one conditional sum per
+    weekday)."""
+    import spark_rapids_tpu.functions as F
+    c = F.col
+    dd = (dfs["date_dim"].filter(c("d_year") == F.lit(2000))
+          .select(c("d_date_sk").alias("ss_sold_date_sk"), c("d_dow")))
+    store = dfs["store"].select(c("s_store_sk").alias("ss_store_sk"),
+                                c("s_store_name"))
+    j = (dfs["store_sales"]
+         .select(c("ss_sold_date_sk"), c("ss_store_sk"),
+                 c("ss_sales_price"))
+         .join(dd, on="ss_sold_date_sk").join(store, on="ss_store_sk"))
+    days = ["sun", "mon", "tue", "wed", "thu", "fri", "sat"]
+    aggs = [F.sum(F.when(c("d_dow") == F.lit(i), c("ss_sales_price")))
+            .alias(f"{d}_sales")
+            for i, d in enumerate(days)]
+    return (j.group_by(c("s_store_name")).agg(*aggs)
+            .sort(c("s_store_name")).limit(100))
+
+
+def q96(dfs):
+    """Count of evening high-dependent-count sales at one store
+    (TPC-DS q96)."""
+    import spark_rapids_tpu.functions as F
+    c = F.col
+    hd = (dfs["household_demographics"]
+          .filter(c("hd_dep_count") == F.lit(5))
+          .select(c("hd_demo_sk").alias("ss_hdemo_sk")))
+    td = (dfs["time_dim"]
+          .filter((c("t_hour") == F.lit(20)) & (c("t_minute") >= F.lit(30)))
+          .select(c("t_time_sk").alias("ss_sold_time_sk")))
+    store = (dfs["store"].filter(c("s_store_name") == F.lit("store0"))
+             .select(c("s_store_sk").alias("ss_store_sk")))
+    j = (dfs["store_sales"]
+         .select(c("ss_hdemo_sk"), c("ss_sold_time_sk"), c("ss_store_sk"))
+         .join(hd, on="ss_hdemo_sk").join(td, on="ss_sold_time_sk")
+         .join(store, on="ss_store_sk"))
+    return j.agg(F.count().alias("cnt"))
+
+
+def _ticket_counts(dfs, dep_lo, dep_hi, cnt_lo, cnt_hi, years):
+    """The q34/q73 spine: tickets by customer with household filters and a
+    HAVING on the per-ticket row count."""
+    import spark_rapids_tpu.functions as F
+    c = F.col
+    dd = (dfs["date_dim"]
+          .filter(c("d_year").isin(*years)
+                  & ((c("d_dom") >= F.lit(1)) & (c("d_dom") <= F.lit(3))
+                     | (c("d_dom") >= F.lit(25)) & (c("d_dom") <= F.lit(28))))
+          .select(c("d_date_sk").alias("ss_sold_date_sk")))
+    hd = (dfs["household_demographics"]
+          .filter((c("hd_dep_count") >= F.lit(dep_lo))
+                  & (c("hd_dep_count") <= F.lit(dep_hi))
+                  & (c("hd_buy_potential") != F.lit("Unknown")))
+          .select(c("hd_demo_sk").alias("ss_hdemo_sk")))
+    grouped = (dfs["store_sales"]
+               .select(c("ss_sold_date_sk"), c("ss_hdemo_sk"),
+                       c("ss_customer_sk"), c("ss_ticket_number"))
+               .join(dd, on="ss_sold_date_sk").join(hd, on="ss_hdemo_sk")
+               .group_by(c("ss_ticket_number"), c("ss_customer_sk"))
+               .agg(F.count().alias("cnt"))
+               .filter((c("cnt") >= F.lit(cnt_lo))
+                       & (c("cnt") <= F.lit(cnt_hi))))
+    cust = dfs["customer"].select(c("c_customer_sk").alias("ss_customer_sk"),
+                                  c("c_first_name"), c("c_last_name"))
+    return (grouped.join(cust, on="ss_customer_sk")
+            .select(c("c_last_name"), c("c_first_name"),
+                    c("ss_ticket_number"), c("cnt")))
+
+
+def q34(dfs):
+    """Large-ticket frequent shoppers (TPC-DS q34: 15-20 items/ticket)."""
+    import spark_rapids_tpu.functions as F
+    c = F.col
+    return (_ticket_counts(dfs, 2, 9, 15, 20, (1999, 2000, 2001))
+            .sort(c("c_last_name"), c("c_first_name"),
+                  c("ss_ticket_number"), c("cnt"),
+                  ascending=[True, True, True, False]))
+
+
+def q73(dfs):
+    """Small-ticket shoppers (TPC-DS q73: 1-5 items/ticket)."""
+    import spark_rapids_tpu.functions as F
+    c = F.col
+    # official text orders by (cnt desc, last name) only; the extra
+    # first-name/ticket keys make tie order deterministic for the oracle
+    return (_ticket_counts(dfs, 1, 9, 1, 5, (1999, 2000, 2001))
+            .sort(c("cnt"), c("c_last_name"), c("c_first_name"),
+                  c("ss_ticket_number"),
+                  ascending=[False, True, True, True])
+            .limit(1000))
+
+
+def q79(dfs):
+    """Per-ticket coupon amount and net profit for big stores on Mondays
+    (TPC-DS q79; ss_net_profit is decimal(7,2) — exact sums)."""
+    import spark_rapids_tpu.functions as F
+    c = F.col
+    dd = (dfs["date_dim"]
+          .filter((c("d_dow") == F.lit(1))
+                  & c("d_year").isin(1998, 1999, 2000))
+          .select(c("d_date_sk").alias("ss_sold_date_sk")))
+    hd = (dfs["household_demographics"]
+          .filter((c("hd_dep_count") == F.lit(6))
+                  | (c("hd_vehicle_count") > F.lit(2)))
+          .select(c("hd_demo_sk").alias("ss_hdemo_sk")))
+    store = (dfs["store"]
+             .filter((c("s_number_employees") >= F.lit(200))
+                     & (c("s_number_employees") <= F.lit(295)))
+             .select(c("s_store_sk").alias("ss_store_sk"), c("s_city")))
+    grouped = (dfs["store_sales"]
+               .select(c("ss_sold_date_sk"), c("ss_hdemo_sk"),
+                       c("ss_store_sk"), c("ss_customer_sk"),
+                       c("ss_ticket_number"), c("ss_coupon_amt"),
+                       c("ss_net_profit"))
+               .join(dd, on="ss_sold_date_sk").join(hd, on="ss_hdemo_sk")
+               .join(store, on="ss_store_sk")
+               .group_by(c("ss_ticket_number"), c("ss_customer_sk"),
+                         c("s_city"))
+               .agg(F.sum(c("ss_coupon_amt")).alias("amt"),
+                    F.sum(c("ss_net_profit")).alias("profit")))
+    cust = dfs["customer"].select(c("c_customer_sk").alias("ss_customer_sk"),
+                                  c("c_last_name"), c("c_first_name"))
+    return (grouped.join(cust, on="ss_customer_sk")
+            .select(c("c_last_name"), c("c_first_name"), c("s_city"),
+                    c("profit"), c("ss_ticket_number"), c("amt"))
+            .sort(c("c_last_name"), c("c_first_name"), c("s_city"),
+                  c("profit"))
+            .limit(100))
+
+
+def q48(dfs):
+    """Quantity sum under OR'd demographic/address/price-band predicates
+    (TPC-DS q48; the ss_net_profit bands hit the decimal column)."""
+    import spark_rapids_tpu.functions as F
+    c = F.col
+    dd = (dfs["date_dim"].filter(c("d_year") == F.lit(2000))
+          .select(c("d_date_sk").alias("ss_sold_date_sk")))
+    cd = (dfs["customer_demographics"]
+          .select(c("cd_demo_sk").alias("ss_cdemo_sk"),
+                  c("cd_marital_status"), c("cd_education_status")))
+    ca = (dfs["customer_address"]
+          .filter(c("ca_country") == F.lit("United States"))
+          .select(c("ca_address_sk").alias("ss_addr_sk"), c("ca_state")))
+    j = (dfs["store_sales"]
+         .select(c("ss_sold_date_sk"), c("ss_cdemo_sk"), c("ss_addr_sk"),
+                 c("ss_quantity"), c("ss_sales_price"), c("ss_net_profit"))
+         .join(dd, on="ss_sold_date_sk").join(cd, on="ss_cdemo_sk")
+         .join(ca, on="ss_addr_sk"))
+    price = c("ss_sales_price")
+    md = (((c("cd_marital_status") == F.lit("M"))
+           & (c("cd_education_status") == F.lit("4 yr Degree"))
+           & (price >= F.lit(100.0)) & (price <= F.lit(150.0)))
+          | ((c("cd_marital_status") == F.lit("D"))
+             & (c("cd_education_status") == F.lit("2 yr Degree"))
+             & (price >= F.lit(50.0)) & (price <= F.lit(100.0)))
+          | ((c("cd_marital_status") == F.lit("S"))
+             & (c("cd_education_status") == F.lit("College"))
+             & (price >= F.lit(150.0)) & (price <= F.lit(200.0))))
+    profit = c("ss_net_profit")
+    geo = ((c("ca_state").isin("CA", "TX", "OH")
+            & (profit >= F.lit(0)) & (profit <= F.lit(2000)))
+           | (c("ca_state").isin("NY", "GA", "WA")
+              & (profit >= F.lit(150)) & (profit <= F.lit(3000)))
+           | (c("ca_state").isin("IL", "MI")
+              & (profit >= F.lit(50)) & (profit <= F.lit(25000))))
+    return j.filter(md & geo).agg(F.sum(c("ss_quantity")).alias("total"))
+
+
+def q27(dfs):
+    """Item averages by state for one demographic slice (TPC-DS q27's base
+    grouping — the subset omits the ROLLUP levels)."""
+    import spark_rapids_tpu.functions as F
+    c = F.col
+    cd = (dfs["customer_demographics"]
+          .filter((c("cd_gender") == F.lit("F"))
+                  & (c("cd_marital_status") == F.lit("W"))
+                  & (c("cd_education_status") == F.lit("Primary")))
+          .select(c("cd_demo_sk").alias("ss_cdemo_sk")))
+    dd = (dfs["date_dim"].filter(c("d_year") == F.lit(1999))
+          .select(c("d_date_sk").alias("ss_sold_date_sk")))
+    store = (dfs["store"].filter(c("s_state").isin("CA", "TX", "NY", "OH"))
+             .select(c("s_store_sk").alias("ss_store_sk"), c("s_state")))
+    item = dfs["item"].select(c("i_item_sk").alias("ss_item_sk"),
+                              c("i_item_id"))
+    j = (dfs["store_sales"]
+         .join(cd, on="ss_cdemo_sk").join(dd, on="ss_sold_date_sk")
+         .join(store, on="ss_store_sk").join(item, on="ss_item_sk"))
+    return (j.group_by(c("i_item_id"), c("s_state"))
+            .agg(F.avg(c("ss_quantity")).alias("agg1"),
+                 F.avg(c("ss_list_price")).alias("agg2"),
+                 F.avg(c("ss_coupon_amt")).alias("agg3"),
+                 F.avg(c("ss_sales_price")).alias("agg4"))
+            .sort(c("i_item_id"), c("s_state"))
+            .limit(100))
+
+
+def q46(dfs):
+    """Weekend city shoppers whose bought-city differs from home city
+    (TPC-DS q46)."""
+    import spark_rapids_tpu.functions as F
+    c = F.col
+    dd = (dfs["date_dim"]
+          .filter(c("d_dow").isin(0, 6) & c("d_year").isin(1999, 2000, 2001))
+          .select(c("d_date_sk").alias("ss_sold_date_sk")))
+    hd = (dfs["household_demographics"]
+          .filter((c("hd_dep_count") == F.lit(5))
+                  | (c("hd_vehicle_count") == F.lit(3)))
+          .select(c("hd_demo_sk").alias("ss_hdemo_sk")))
+    store = (dfs["store"]
+             .filter(c("s_city").isin("Midway", "Fairview", "Oakland"))
+             .select(c("s_store_sk").alias("ss_store_sk")))
+    sale_addr = dfs["customer_address"].select(
+        c("ca_address_sk").alias("ss_addr_sk"),
+        c("ca_city").alias("bought_city"))
+    grouped = (dfs["store_sales"]
+               .select(c("ss_sold_date_sk"), c("ss_hdemo_sk"),
+                       c("ss_store_sk"), c("ss_addr_sk"),
+                       c("ss_customer_sk"), c("ss_ticket_number"),
+                       c("ss_coupon_amt"), c("ss_ext_sales_price"))
+               .join(dd, on="ss_sold_date_sk").join(hd, on="ss_hdemo_sk")
+               .join(store, on="ss_store_sk").join(sale_addr, on="ss_addr_sk")
+               .group_by(c("ss_ticket_number"), c("ss_customer_sk"),
+                         c("bought_city"))
+               .agg(F.sum(c("ss_coupon_amt")).alias("amt"),
+                    F.sum(c("ss_ext_sales_price")).alias("profit")))
+    cust = dfs["customer"].select(
+        c("c_customer_sk").alias("ss_customer_sk"), c("c_first_name"),
+        c("c_last_name"), c("c_current_addr_sk").alias("ca_address_sk"))
+    home = dfs["customer_address"].select(c("ca_address_sk"),
+                                          c("ca_city"))
+    return (grouped.join(cust, on="ss_customer_sk")
+            .join(home, on="ca_address_sk")
+            .filter(c("ca_city") != c("bought_city"))
+            .select(c("c_last_name"), c("c_first_name"), c("ca_city"),
+                    c("bought_city"), c("ss_ticket_number"), c("amt"),
+                    c("profit"))
+            .sort(c("c_last_name"), c("c_first_name"), c("ca_city"),
+                  c("bought_city"), c("ss_ticket_number"))
+            .limit(100))
+
+
+def q68(dfs):
+    """q46's shape over ext list price / ext tax (TPC-DS q68)."""
+    import spark_rapids_tpu.functions as F
+    c = F.col
+    dd = (dfs["date_dim"]
+          .filter((c("d_dom") >= F.lit(1)) & (c("d_dom") <= F.lit(2))
+                  & c("d_year").isin(1998, 1999, 2000))
+          .select(c("d_date_sk").alias("ss_sold_date_sk")))
+    hd = (dfs["household_demographics"]
+          .filter((c("hd_dep_count") == F.lit(4))
+                  | (c("hd_vehicle_count") == F.lit(3)))
+          .select(c("hd_demo_sk").alias("ss_hdemo_sk")))
+    store = (dfs["store"]
+             .filter(c("s_city").isin("Midway", "Fairview"))
+             .select(c("s_store_sk").alias("ss_store_sk")))
+    sale_addr = dfs["customer_address"].select(
+        c("ca_address_sk").alias("ss_addr_sk"),
+        c("ca_city").alias("bought_city"))
+    grouped = (dfs["store_sales"]
+               .select(c("ss_sold_date_sk"), c("ss_hdemo_sk"),
+                       c("ss_store_sk"), c("ss_addr_sk"),
+                       c("ss_customer_sk"), c("ss_ticket_number"),
+                       c("ss_ext_sales_price"), c("ss_ext_list_price"),
+                       c("ss_ext_tax"))
+               .join(dd, on="ss_sold_date_sk").join(hd, on="ss_hdemo_sk")
+               .join(store, on="ss_store_sk").join(sale_addr, on="ss_addr_sk")
+               .group_by(c("ss_ticket_number"), c("ss_customer_sk"),
+                         c("bought_city"))
+               .agg(F.sum(c("ss_ext_sales_price")).alias("extended_price"),
+                    F.sum(c("ss_ext_list_price")).alias("list_price"),
+                    F.sum(c("ss_ext_tax")).alias("extended_tax")))
+    cust = dfs["customer"].select(
+        c("c_customer_sk").alias("ss_customer_sk"), c("c_first_name"),
+        c("c_last_name"), c("c_current_addr_sk").alias("ca_address_sk"))
+    home = dfs["customer_address"].select(c("ca_address_sk"), c("ca_city"))
+    return (grouped.join(cust, on="ss_customer_sk")
+            .join(home, on="ca_address_sk")
+            .filter(c("ca_city") != c("bought_city"))
+            .select(c("c_last_name"), c("c_first_name"), c("ca_city"),
+                    c("bought_city"), c("ss_ticket_number"),
+                    c("extended_price"), c("extended_tax"), c("list_price"))
+            .sort(c("c_last_name"), c("ss_ticket_number"))
+            .limit(100))
+
+
+def q88(dfs):
+    """Half-hour traffic counts 8:30-12:30 (TPC-DS q88: eight filtered
+    counts cross-joined into one row)."""
+    import spark_rapids_tpu.functions as F
+    c = F.col
+    hd = (dfs["household_demographics"]
+          .filter(((c("hd_dep_count") == F.lit(3))
+                   & (c("hd_vehicle_count") <= F.lit(5)))
+                  | ((c("hd_dep_count") == F.lit(0))
+                     & (c("hd_vehicle_count") <= F.lit(2)))
+                  | ((c("hd_dep_count") == F.lit(1))
+                     & (c("hd_vehicle_count") <= F.lit(3))))
+          .select(c("hd_demo_sk").alias("ss_hdemo_sk")))
+    store = (dfs["store"].filter(c("s_store_name") == F.lit("store0"))
+             .select(c("s_store_sk").alias("ss_store_sk")))
+    base = (dfs["store_sales"]
+            .select(c("ss_hdemo_sk"), c("ss_sold_time_sk"), c("ss_store_sk"))
+            .join(hd, on="ss_hdemo_sk").join(store, on="ss_store_sk"))
+
+    td = dfs["time_dim"]
+    out = None
+    for i in range(8):
+        hour = 8 + (i + 1) // 2
+        lo_min = 30 if i % 2 == 0 else 0
+        t = (td.filter((c("t_hour") == F.lit(hour))
+                       & (c("t_minute") >= F.lit(lo_min))
+                       & (c("t_minute") < F.lit(lo_min + 30)))
+             .select(c("t_time_sk").alias("ss_sold_time_sk")))
+        cnt = (base.join(t, on="ss_sold_time_sk")
+               .agg(F.count().alias(f"h{i}")))
+        out = cnt if out is None else out.join(cnt, how="cross")
+    return out
+
+
+def q6(dfs):
+    """Customer states buying items priced over 1.2x their category average
+    (TPC-DS q6; the correlated avg subquery is planned as a category-average
+    join, as Spark itself rewrites it)."""
+    import spark_rapids_tpu.functions as F
+    c = F.col
+    cat_avg = (dfs["item"]
+               .group_by(c("i_category"))
+               .agg(F.avg(c("i_current_price")).alias("cat_avg")))
+    item = (dfs["item"]
+            .select(c("i_item_sk").alias("ss_item_sk"), c("i_category"),
+                    c("i_current_price"))
+            .join(cat_avg, on="i_category")
+            .filter(c("i_current_price") > F.lit(1.2) * c("cat_avg"))
+            .select(c("ss_item_sk")))
+    dd = (dfs["date_dim"]
+          .filter((c("d_year") == F.lit(2000)) & (c("d_moy") == F.lit(1)))
+          .select(c("d_date_sk").alias("ss_sold_date_sk")))
+    cust = dfs["customer"].select(
+        c("c_customer_sk").alias("ss_customer_sk"),
+        c("c_current_addr_sk").alias("ca_address_sk"))
+    addr = dfs["customer_address"].select(c("ca_address_sk"), c("ca_state"))
+    j = (dfs["store_sales"]
+         .select(c("ss_sold_date_sk"), c("ss_item_sk"), c("ss_customer_sk"))
+         .join(dd, on="ss_sold_date_sk").join(item, on="ss_item_sk")
+         .join(cust, on="ss_customer_sk").join(addr, on="ca_address_sk"))
+    return (j.group_by(c("ca_state"))
+            .agg(F.count().alias("cnt"))
+            .filter(c("cnt") >= F.lit(10))
+            .sort(c("cnt"), c("ca_state"))
+            .limit(100))
+
+
+def q65(dfs):
+    """Store items whose revenue is at most 10% of the store's average item
+    revenue (TPC-DS q65: two aggregations joined)."""
+    import spark_rapids_tpu.functions as F
+    c = F.col
+    dd = (dfs["date_dim"].filter(c("d_year") == F.lit(2000))
+          .select(c("d_date_sk").alias("ss_sold_date_sk")))
+    per_item = (dfs["store_sales"]
+                .select(c("ss_sold_date_sk"), c("ss_store_sk"),
+                        c("ss_item_sk"), c("ss_sales_price"))
+                .join(dd, on="ss_sold_date_sk")
+                .group_by(c("ss_store_sk"), c("ss_item_sk"))
+                .agg(F.sum(c("ss_sales_price")).alias("revenue")))
+    per_store = (per_item.group_by(c("ss_store_sk"))
+                 .agg(F.avg(c("revenue")).alias("ave")))
+    store = dfs["store"].select(c("s_store_sk").alias("ss_store_sk"),
+                                c("s_store_name"))
+    item = dfs["item"].select(c("i_item_sk").alias("ss_item_sk"),
+                              c("i_item_desc"), c("i_current_price"))
+    return (per_item.join(per_store, on="ss_store_sk")
+            .filter(c("revenue") <= F.lit(0.1) * c("ave"))
+            .join(store, on="ss_store_sk").join(item, on="ss_item_sk")
+            .select(c("s_store_name"), c("i_item_desc"), c("revenue"),
+                    c("i_current_price"))
+            .sort(c("s_store_name"), c("i_item_desc"))
+            .limit(100))
+
+
+QUERIES = {"q3": q3, "q42": q42, "q52": q52, "q55": q55, "q7": q7,
+           "q19": q19, "q6": q6, "q27": q27, "q34": q34, "q43": q43,
+           "q46": q46, "q48": q48, "q53": q53, "q63": q63, "q65": q65,
+           "q68": q68, "q73": q73, "q79": q79, "q88": q88, "q89": q89,
+           "q96": q96, "q98": q98}
 
 
 # -- independent NumPy oracles ------------------------------------------------
@@ -436,4 +1044,447 @@ def np_q19(tb):
 
 
 NP_QUERIES = {"q3": np_q3, "q42": np_q42, "q52": np_q52, "q55": np_q55,
-              "q7": np_q7, "q19": np_q19}
+              "q7": np_q7, "q19": np_q19, "q6": None, "q27": None,
+              "q34": None, "q43": None, "q46": None, "q48": None,
+              "q53": None, "q63": None, "q65": None, "q68": None,
+              "q73": None, "q79": None, "q88": None, "q89": None,
+              "q96": None, "q98": None}
+
+
+def _late_bind_oracles():
+    """The breadth oracles are defined below NP_QUERIES; bind by name."""
+    for name in list(NP_QUERIES):
+        if NP_QUERIES[name] is None:
+            NP_QUERIES[name] = globals()[f"np_{name}"]
+
+
+# -- oracles for the round-3 breadth queries ---------------------------------
+
+def _d(tb, **conds):
+    """date_dim selector: {d_date_sk} passing all column conditions."""
+    dd = tb["date_dim"]
+    keep = np.ones(len(dd["d_date_sk"]), bool)
+    for col, fn in conds.items():
+        keep &= fn(dd[col])
+    return set(dd["d_date_sk"][keep])
+
+
+def _window_dev(groups, part_of, thresh=0.1, zero_ok=False):
+    """q53/q63/q89 tail: per-partition mean over the AGGREGATED rows, keep
+    rows deviating more than `thresh` from it. groups: {key: sum}. Returns
+    [(key..., sum, avg)]."""
+    parts = {}
+    for key, s in groups.items():
+        parts.setdefault(part_of(key), []).append(s)
+    means = {p: sum(v) / len(v) for p, v in parts.items()}
+    out = []
+    for key, s in groups.items():
+        a = means[part_of(key)]
+        cond = (a != 0.0) if zero_ok else (a > 0.0)
+        if cond and abs(s - a) / a > thresh:
+            out.append(key + (s, a))
+    return out
+
+
+def np_q53(tb):
+    it = tb["item"]
+    ok_cat = np.isin(it["i_category"], ["Books", "Home", "Electronics"])
+    manu = {k: int(m) for k, m, o in zip(it["i_item_sk"], it["i_manufact_id"],
+                                         ok_cat) if o}
+    dd = tb["date_dim"]
+    keep = dd["d_year"] == 2000
+    qoy_of = dict(zip(dd["d_date_sk"][keep], dd["d_qoy"][keep]))
+    ss = tb["store_sales"]
+    groups = {}
+    for ddk, ik, p in zip(ss["ss_sold_date_sk"], ss["ss_item_sk"],
+                          ss["ss_sales_price"]):
+        q = qoy_of.get(ddk)
+        m = manu.get(ik)
+        if q is None or m is None:
+            continue
+        key = (m, int(q))
+        groups[key] = groups.get(key, 0.0) + p
+    dev = _window_dev(groups, lambda k: k[0])
+    rows = [(d[0], d[-2], d[-1]) for d in dev]
+    return _lex_top(rows, [2, 1, 0], [True, True, True], 100)
+
+
+def np_q63(tb):
+    it = tb["item"]
+    ok_cat = np.isin(it["i_category"], ["Books", "Home", "Electronics"])
+    mgr = {k: int(m) for k, m, o in zip(it["i_item_sk"], it["i_manager_id"],
+                                        ok_cat) if o}
+    dd = tb["date_dim"]
+    keep = dd["d_year"] == 2000
+    moy_of = dict(zip(dd["d_date_sk"][keep], dd["d_moy"][keep]))
+    ss = tb["store_sales"]
+    groups = {}
+    for ddk, ik, p in zip(ss["ss_sold_date_sk"], ss["ss_item_sk"],
+                          ss["ss_sales_price"]):
+        mo = moy_of.get(ddk)
+        m = mgr.get(ik)
+        if mo is None or m is None:
+            continue
+        key = (m, int(mo))
+        groups[key] = groups.get(key, 0.0) + p
+    dev = _window_dev(groups, lambda k: k[0])
+    rows = [(d[0], d[-2], d[-1]) for d in dev]
+    return _lex_top(rows, [0, 2, 1], [True, True, True], 100)
+
+
+def np_q89(tb):
+    it = tb["item"]
+    ok = np.isin(it["i_category"], ["Books", "Electronics", "Sports"])
+    info = {k: (cat, cl, br) for k, cat, cl, br, o in zip(
+        it["i_item_sk"], it["i_category"], it["i_class"], it["i_brand"], ok)
+        if o}
+    dd = tb["date_dim"]
+    keep = dd["d_year"] == 1999
+    moy_of = dict(zip(dd["d_date_sk"][keep], dd["d_moy"][keep]))
+    st = tb["store"]
+    sname = dict(zip(st["s_store_sk"], st["s_store_name"]))
+    ss = tb["store_sales"]
+    groups = {}
+    for ddk, ik, sk, p in zip(ss["ss_sold_date_sk"], ss["ss_item_sk"],
+                              ss["ss_store_sk"], ss["ss_sales_price"]):
+        mo = moy_of.get(ddk)
+        inf = info.get(ik)
+        if mo is None or inf is None:
+            continue
+        key = (inf[0], inf[1], inf[2], sname[sk], int(mo))
+        groups[key] = groups.get(key, 0.0) + p
+    dev = _window_dev(groups, lambda k: (k[0], k[2], k[3]), zero_ok=True)
+    rows = [d + (d[-2] - d[-1],) for d in dev]       # append sum-avg key
+    rows = _lex_top(rows, [7, 3, 1, 4], [True, True, True, True], 100)
+    return [r[:-1] for r in rows]
+
+
+def np_q98(tb):
+    it = tb["item"]
+    ok = np.isin(it["i_category"], ["Sports", "Books", "Home"])
+    info = {k: (iid, d, cat, cl, float(p)) for k, iid, d, cat, cl, p, o in
+            zip(it["i_item_sk"], it["i_item_id"], it["i_item_desc"],
+                it["i_category"], it["i_class"], it["i_current_price"], ok)
+            if o}
+    ok_d = _d(tb, d_year=lambda y: y == 1999, d_moy=lambda m: m == 2)
+    ss = tb["store_sales"]
+    groups = {}
+    for ddk, ik, p in zip(ss["ss_sold_date_sk"], ss["ss_item_sk"],
+                          ss["ss_ext_sales_price"]):
+        inf = info.get(ik)
+        if ddk not in ok_d or inf is None:
+            continue
+        groups[inf] = groups.get(inf, 0.0) + p
+    cls_total = {}
+    for key, s in groups.items():
+        cls_total[key[3]] = cls_total.get(key[3], 0.0) + s
+    rows = [key + (s, s * 100.0 / cls_total[key[3]])
+            for key, s in groups.items()]
+    return _lex_top(rows, [2, 3, 0, 1, 6],
+                    [True, True, True, True, True], len(rows))
+
+
+def np_q43(tb):
+    ok_d = tb["date_dim"]
+    keep = ok_d["d_year"] == 2000
+    dow_of = dict(zip(ok_d["d_date_sk"][keep], ok_d["d_dow"][keep]))
+    st = tb["store"]
+    sname = dict(zip(st["s_store_sk"], st["s_store_name"]))
+    ss = tb["store_sales"]
+    sums = {}
+    for ddk, sk, p in zip(ss["ss_sold_date_sk"], ss["ss_store_sk"],
+                          ss["ss_sales_price"]):
+        dow = dow_of.get(ddk)
+        if dow is None:
+            continue
+        row = sums.setdefault(sname[sk], [0.0] * 7)
+        row[int(dow)] += p
+    rows = [(n,) + tuple(v) for n, v in sums.items()]
+    return _lex_top(rows, [0], [True], 100)
+
+
+def np_q96(tb):
+    hd = tb["household_demographics"]
+    ok_hd = set(hd["hd_demo_sk"][hd["hd_dep_count"] == 5])
+    td = tb["time_dim"]
+    ok_t = set(td["t_time_sk"][(td["t_hour"] == 20)
+                               & (td["t_minute"] >= 30)])
+    st = tb["store"]
+    ok_s = set(st["s_store_sk"][st["s_store_name"] == "store0"])
+    ss = tb["store_sales"]
+    n = 0
+    for h, t, s in zip(ss["ss_hdemo_sk"], ss["ss_sold_time_sk"],
+                       ss["ss_store_sk"]):
+        if h in ok_hd and t in ok_t and s in ok_s:
+            n += 1
+    return [(n,)]
+
+
+def _np_tickets(tb, dep_lo, dep_hi, cnt_lo, cnt_hi, years):
+    ok_d = _d(tb, d_year=lambda y: np.isin(y, years),
+              d_dom=lambda d: ((d >= 1) & (d <= 3)) | ((d >= 25) & (d <= 28)))
+    hd = tb["household_demographics"]
+    ok_hd = set(hd["hd_demo_sk"][
+        (hd["hd_dep_count"] >= dep_lo) & (hd["hd_dep_count"] <= dep_hi)
+        & (hd["hd_buy_potential"] != "Unknown")])
+    ss = tb["store_sales"]
+    counts = {}
+    for ddk, h, ck, tk in zip(ss["ss_sold_date_sk"], ss["ss_hdemo_sk"],
+                              ss["ss_customer_sk"], ss["ss_ticket_number"]):
+        if ddk in ok_d and h in ok_hd:
+            key = (int(tk), int(ck))
+            counts[key] = counts.get(key, 0) + 1
+    cu = tb["customer"]
+    fn = dict(zip(cu["c_customer_sk"], cu["c_first_name"]))
+    ln = dict(zip(cu["c_customer_sk"], cu["c_last_name"]))
+    return [(ln[ck], fn[ck], tk, n) for (tk, ck), n in counts.items()
+            if cnt_lo <= n <= cnt_hi]
+
+
+def np_q34(tb):
+    rows = _np_tickets(tb, 2, 9, 15, 20, (1999, 2000, 2001))
+    return _lex_top(rows, [0, 1, 2, 3], [True, True, True, False],
+                    len(rows))
+
+
+def np_q73(tb):
+    rows = _np_tickets(tb, 1, 9, 1, 5, (1999, 2000, 2001))
+    return _lex_top(rows, [3, 0, 1, 2], [False, True, True, True], 1000)
+
+
+def np_q79(tb):
+    from decimal import Decimal
+    ok_d = _d(tb, d_dow=lambda d: d == 1,
+              d_year=lambda y: np.isin(y, (1998, 1999, 2000)))
+    hd = tb["household_demographics"]
+    ok_hd = set(hd["hd_demo_sk"][(hd["hd_dep_count"] == 6)
+                                 | (hd["hd_vehicle_count"] > 2)])
+    st = tb["store"]
+    ok_s = {k: c for k, c, n in zip(st["s_store_sk"], st["s_city"],
+                                    st["s_number_employees"])
+            if 200 <= n <= 295}
+    ss = tb["store_sales"]
+    sums = {}
+    for ddk, h, sk, ck, tk, amt, prof in zip(
+            ss["ss_sold_date_sk"], ss["ss_hdemo_sk"], ss["ss_store_sk"],
+            ss["ss_customer_sk"], ss["ss_ticket_number"],
+            ss["ss_coupon_amt"], ss["ss_net_profit"]):
+        if ddk not in ok_d or h not in ok_hd or sk not in ok_s:
+            continue
+        key = (int(tk), int(ck), ok_s[sk])
+        cur = sums.get(key)
+        if cur is None:
+            sums[key] = [amt, prof]
+        else:
+            cur[0] += amt
+            cur[1] += prof
+    cu = tb["customer"]
+    fn = dict(zip(cu["c_customer_sk"], cu["c_first_name"]))
+    ln = dict(zip(cu["c_customer_sk"], cu["c_last_name"]))
+    rows = [(ln[ck], fn[ck], city, v[1], tk, v[0])
+            for (tk, ck, city), v in sums.items()]
+    return _lex_top(rows, [0, 1, 2, 3], [True, True, True, True], 100)
+
+
+def np_q48(tb):
+    ok_d = _d(tb, d_year=lambda y: y == 2000)
+    cd = tb["customer_demographics"]
+    cd_info = {k: (m, e) for k, m, e in zip(
+        cd["cd_demo_sk"], cd["cd_marital_status"],
+        cd["cd_education_status"])}
+    ca = tb["customer_address"]
+    st_of = dict(zip(ca["ca_address_sk"], ca["ca_state"]))
+    ss = tb["store_sales"]
+    total = 0
+    for ddk, cdk, ak, q, sp, prof in zip(
+            ss["ss_sold_date_sk"], ss["ss_cdemo_sk"], ss["ss_addr_sk"],
+            ss["ss_quantity"], ss["ss_sales_price"], ss["ss_net_profit"]):
+        if ddk not in ok_d:
+            continue
+        m, e = cd_info[cdk]
+        p = float(sp)
+        md = ((m == "M" and e == "4 yr Degree" and 100.0 <= p <= 150.0)
+              or (m == "D" and e == "2 yr Degree" and 50.0 <= p <= 100.0)
+              or (m == "S" and e == "College" and 150.0 <= p <= 200.0))
+        if not md:
+            continue
+        state = st_of[ak]
+        pr = float(prof)
+        geo = ((state in ("CA", "TX", "OH") and 0 <= pr <= 2000)
+               or (state in ("NY", "GA", "WA") and 150 <= pr <= 3000)
+               or (state in ("IL", "MI") and 50 <= pr <= 25000))
+        if geo:
+            total += int(q)
+    return [(total,)]
+
+
+def np_q27(tb):
+    cd = tb["customer_demographics"]
+    ok_cd = set(cd["cd_demo_sk"][(cd["cd_gender"] == "F")
+                                 & (cd["cd_marital_status"] == "W")
+                                 & (cd["cd_education_status"] == "Primary")])
+    ok_d = _d(tb, d_year=lambda y: y == 1999)
+    st = tb["store"]
+    s_state = {k: s for k, s in zip(st["s_store_sk"], st["s_state"])
+               if s in ("CA", "TX", "NY", "OH")}
+    it = tb["item"]
+    iid = dict(zip(it["i_item_sk"], it["i_item_id"]))
+    ss = tb["store_sales"]
+    acc = {}
+    for ddk, cdk, sk, ik, q, lp, cam, sp in zip(
+            ss["ss_sold_date_sk"], ss["ss_cdemo_sk"], ss["ss_store_sk"],
+            ss["ss_item_sk"], ss["ss_quantity"], ss["ss_list_price"],
+            ss["ss_coupon_amt"], ss["ss_sales_price"]):
+        if ddk not in ok_d or cdk not in ok_cd or sk not in s_state:
+            continue
+        key = (iid[ik], s_state[sk])
+        cur = acc.setdefault(key, [0.0, 0.0, 0.0, 0.0, 0])
+        cur[0] += q
+        cur[1] += lp
+        cur[2] += cam
+        cur[3] += sp
+        cur[4] += 1
+    rows = [key + tuple(v / c[4] for v in c[:4])
+            for key, c in acc.items()]
+    return _lex_top(rows, [0, 1], [True, True], 100)
+
+
+def _np_city_tickets(tb, dfilter, hd_pred, cities, val_cols):
+    ok_d = dfilter
+    hd = tb["household_demographics"]
+    ok_hd = set(hd["hd_demo_sk"][hd_pred(hd)])
+    st = tb["store"]
+    ok_s = set(k for k, cty in zip(st["s_store_sk"], st["s_city"])
+               if cty in cities)
+    ca = tb["customer_address"]
+    city_of = dict(zip(ca["ca_address_sk"], ca["ca_city"]))
+    ss = tb["store_sales"]
+    sums = {}
+    for i, (ddk, h, sk, ak, ck, tk) in enumerate(zip(
+            ss["ss_sold_date_sk"], ss["ss_hdemo_sk"], ss["ss_store_sk"],
+            ss["ss_addr_sk"], ss["ss_customer_sk"],
+            ss["ss_ticket_number"])):
+        if ddk not in ok_d or h not in ok_hd or sk not in ok_s:
+            continue
+        key = (int(tk), int(ck), city_of[ak])
+        cur = sums.setdefault(key, [0.0] * len(val_cols))
+        for j, colname in enumerate(val_cols):
+            cur[j] += ss[colname][i]
+    cu = tb["customer"]
+    fn = dict(zip(cu["c_customer_sk"], cu["c_first_name"]))
+    ln = dict(zip(cu["c_customer_sk"], cu["c_last_name"]))
+    addr_of = dict(zip(cu["c_customer_sk"], cu["c_current_addr_sk"]))
+    rows = []
+    for (tk, ck, bought), v in sums.items():
+        home = city_of[addr_of[ck]]
+        if home == bought:
+            continue
+        rows.append((ln[ck], fn[ck], home, bought, tk) + tuple(v))
+    return rows
+
+
+def np_q46(tb):
+    ok_d = _d(tb, d_dow=lambda d: np.isin(d, (0, 6)),
+              d_year=lambda y: np.isin(y, (1999, 2000, 2001)))
+    rows = _np_city_tickets(
+        tb, ok_d,
+        lambda hd: (hd["hd_dep_count"] == 5) | (hd["hd_vehicle_count"] == 3),
+        ("Midway", "Fairview", "Oakland"),
+        ["ss_coupon_amt", "ss_ext_sales_price"])
+    return _lex_top(rows, [0, 1, 2, 3, 4], [True] * 5, 100)
+
+
+def np_q68(tb):
+    ok_d = _d(tb, d_dom=lambda d: (d >= 1) & (d <= 2),
+              d_year=lambda y: np.isin(y, (1998, 1999, 2000)))
+    rows = _np_city_tickets(
+        tb, ok_d,
+        lambda hd: (hd["hd_dep_count"] == 4) | (hd["hd_vehicle_count"] == 3),
+        ("Midway", "Fairview"),
+        ["ss_ext_sales_price", "ss_ext_tax", "ss_ext_list_price"])
+    return _lex_top(rows, [0, 4], [True, True], 100)
+
+
+def np_q88(tb):
+    hd = tb["household_demographics"]
+    ok_hd = set(hd["hd_demo_sk"][
+        ((hd["hd_dep_count"] == 3) & (hd["hd_vehicle_count"] <= 5))
+        | ((hd["hd_dep_count"] == 0) & (hd["hd_vehicle_count"] <= 2))
+        | ((hd["hd_dep_count"] == 1) & (hd["hd_vehicle_count"] <= 3))])
+    st = tb["store"]
+    ok_s = set(st["s_store_sk"][st["s_store_name"] == "store0"])
+    td = tb["time_dim"]
+    hour_of = dict(zip(td["t_time_sk"],
+                       zip(td["t_hour"], td["t_minute"])))
+    counts = [0] * 8
+    ss = tb["store_sales"]
+    for h, t, s in zip(ss["ss_hdemo_sk"], ss["ss_sold_time_sk"],
+                       ss["ss_store_sk"]):
+        if h not in ok_hd or s not in ok_s:
+            continue
+        hh, mm = hour_of[t]
+        for i in range(8):
+            hour = 8 + (i + 1) // 2
+            lo = 30 if i % 2 == 0 else 0
+            if hh == hour and lo <= mm < lo + 30:
+                counts[i] += 1
+                break
+    return [tuple(counts)]
+
+
+def np_q6(tb):
+    it = tb["item"]
+    cat_sums = {}
+    for cat, p in zip(it["i_category"], it["i_current_price"]):
+        cur = cat_sums.setdefault(cat, [0.0, 0])
+        cur[0] += p
+        cur[1] += 1
+    cat_avg = {c: s / n for c, (s, n) in cat_sums.items()}
+    ok_item = set(
+        k for k, cat, p in zip(it["i_item_sk"], it["i_category"],
+                               it["i_current_price"])
+        if p > 1.2 * cat_avg[cat])
+    ok_d = _d(tb, d_year=lambda y: y == 2000, d_moy=lambda m: m == 1)
+    cu = tb["customer"]
+    addr_of = dict(zip(cu["c_customer_sk"], cu["c_current_addr_sk"]))
+    ca = tb["customer_address"]
+    state_of = dict(zip(ca["ca_address_sk"], ca["ca_state"]))
+    ss = tb["store_sales"]
+    counts = {}
+    for ddk, ik, ck in zip(ss["ss_sold_date_sk"], ss["ss_item_sk"],
+                           ss["ss_customer_sk"]):
+        if ddk not in ok_d or ik not in ok_item:
+            continue
+        s = state_of[addr_of[ck]]
+        counts[s] = counts.get(s, 0) + 1
+    rows = [(s, n) for s, n in counts.items() if n >= 10]
+    return _lex_top(rows, [1, 0], [True, True], 100)
+
+
+def np_q65(tb):
+    ok_d = _d(tb, d_year=lambda y: y == 2000)
+    ss = tb["store_sales"]
+    rev = {}
+    for ddk, sk, ik, p in zip(ss["ss_sold_date_sk"], ss["ss_store_sk"],
+                              ss["ss_item_sk"], ss["ss_sales_price"]):
+        if ddk not in ok_d:
+            continue
+        key = (int(sk), int(ik))
+        rev[key] = rev.get(key, 0.0) + p
+    per_store = {}
+    for (sk, ik), r in rev.items():
+        cur = per_store.setdefault(sk, [0.0, 0])
+        cur[0] += r
+        cur[1] += 1
+    ave = {sk: s / n for sk, (s, n) in per_store.items()}
+    st = tb["store"]
+    sname = dict(zip(st["s_store_sk"], st["s_store_name"]))
+    it = tb["item"]
+    idesc = dict(zip(it["i_item_sk"], it["i_item_desc"]))
+    iprice = dict(zip(it["i_item_sk"], it["i_current_price"]))
+    rows = [(sname[sk], idesc[ik], r, iprice[ik])
+            for (sk, ik), r in rev.items() if r <= 0.1 * ave[sk]]
+    return _lex_top(rows, [0, 1], [True, True], 100)
+
+
+_late_bind_oracles()

@@ -229,6 +229,13 @@ class Expression:
     def alias(self, name: str) -> "Alias":
         return Alias(self, name)
 
+    def isin(self, *values):
+        """col.isin(a, b, ...) or col.isin([a, b]) (pyspark Column.isin)."""
+        from spark_rapids_tpu.expr.predicates import InSet
+        if len(values) == 1 and isinstance(values[0], (list, tuple, set)):
+            values = tuple(values[0])
+        return InSet(self, list(values))
+
     def cast(self, to: T.DataType):
         from spark_rapids_tpu.expr.cast import Cast
         return Cast(self, to)

@@ -33,6 +33,16 @@ def _check(got, exp, float_cols):
 @pytest.mark.parametrize("name,float_cols", [
     ("q3", {3}), ("q42", {3}), ("q52", {3}), ("q55", {2}),
     ("q7", {1, 2, 3, 4}), ("q19", {3}),
+    # round-3 breadth: window-heavy (q53/q63/q89/q98), decimal-heavy
+    # (q48/q79 over decimal(7,2) ss_net_profit — exact, no float slot),
+    # conditional aggregation (q43), multi-count cross join (q88/q96),
+    # ticket/basket shapes (q34/q73/q46/q68/q79), avg-subquery joins
+    # (q6/q65), state rollup base (q27)
+    ("q6", set()), ("q27", {2, 3, 4, 5}), ("q34", set()),
+    ("q43", {1, 2, 3, 4, 5, 6, 7}), ("q46", {5, 6}), ("q48", set()),
+    ("q53", {1, 2}), ("q63", {1, 2}), ("q65", {2, 3}),
+    ("q68", {5, 6, 7}), ("q73", set()), ("q79", {5}), ("q88", set()),
+    ("q89", {5, 6}), ("q96", set()), ("q98", {4, 5, 6}),
 ])
 def test_tpcds_query_matches_oracle(data, name, float_cols):
     dfs, tb = data
