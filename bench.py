@@ -121,19 +121,27 @@ def child_main():
             ddfs = tpcds.load(spark, dpaths)
             dtb = tpcds.load_np(dpaths)
             t0 = time.perf_counter()
-            n_ok = 0
+            n_ok, failed = 0, []
             for qname, q in tpcds.QUERIES.items():
                 got = [tuple(r.values())
                        for r in q(ddfs).collect().to_pylist()]
                 exp = [tuple(r) for r in tpcds.NP_QUERIES[qname](dtb)]
-                if len(got) == len(exp):
+                try:
+                    # full value equality (exact + per-column float approx),
+                    # same check as tests/test_tpcds.py
+                    tpcds.check_rows(got, exp, tpcds.FLOAT_COLS[qname])
                     n_ok += 1
+                except Exception:  # noqa: BLE001 — one bad query must not
+                    failed.append(qname)  # void the other 21 results
             wall = time.perf_counter() - t0
             line["secondary"] = {
                 "metric": f"tpcds_sf{sf}_22q_sweep",
                 "queries_ok": n_ok, "queries_total": len(tpcds.QUERIES),
+                "check": "value-equality",
                 "wall_s": round(wall, 2),
             }
+            if failed:
+                line["secondary"]["failed"] = failed
         except Exception as e:  # noqa: BLE001 — secondary must not kill primary
             line["secondary"] = {"error": repr(e)[:200]}
     print(json.dumps(line))

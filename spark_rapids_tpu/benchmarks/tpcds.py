@@ -1488,3 +1488,35 @@ def np_q65(tb):
 
 
 _late_bind_oracles()
+
+
+# Per-query float-tolerance column indexes shared by the test suite and
+# bench.py's recorded sweep: both must count a query "ok" under VALUE equality
+# (exact on keys/ints, rel-1e-9 on float slots) — row-count alone overstated
+# verification in BENCH_r03 (VERDICT r3 weak #3).
+FLOAT_COLS = {
+    "q3": {3}, "q42": {3}, "q52": {3}, "q55": {2}, "q7": {1, 2, 3, 4},
+    "q19": {3}, "q6": set(), "q27": {2, 3, 4, 5}, "q34": set(),
+    "q43": {1, 2, 3, 4, 5, 6, 7}, "q46": {5, 6}, "q48": set(),
+    "q53": {1, 2}, "q63": {1, 2}, "q65": {2, 3}, "q68": {5, 6, 7},
+    "q73": set(), "q79": {5}, "q88": set(), "q89": {5, 6}, "q96": set(),
+    "q98": {4, 5, 6},
+}
+
+
+def check_rows(got, exp, float_cols, rel=1e-9):
+    """Value-equality check (no pytest dependency). Raises AssertionError with
+    the first mismatching row pair. Explicit raises (not bare asserts): the
+    exception IS the contract, and must survive `python -O`."""
+    import math as _math
+    if len(got) != len(exp):
+        raise AssertionError((len(got), len(exp)))
+    for g, e in zip(got, exp):
+        if len(g) != len(e):
+            raise AssertionError((g, e))
+        for i, (a, b) in enumerate(zip(g, e)):
+            if i in float_cols and a is not None and b is not None:
+                if not _math.isclose(a, b, rel_tol=rel, abs_tol=1e-12):
+                    raise AssertionError((g, e))
+            elif a != b:   # exact slot, or a NULL in a float slot
+                raise AssertionError((g, e))

@@ -127,7 +127,7 @@ class BroadcastExchangeExec(TpuExec):
                 try:
                     batch = sb.get_batch()
                     break
-                except (AssertionError, OSError):
+                except mem.BufferClosedError:
                     if attempt == 2:
                         raise
             yield batch

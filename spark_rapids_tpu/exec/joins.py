@@ -202,12 +202,19 @@ class _JoinCore:
             k = stream_key_exprs[0].eval(sctx)
             svals = (k.values.astype(jnp.int8)
                      if k.values.dtype == jnp.bool_ else k.values)
-            svals = svals.astype(sorted_build.dtype)
+            # mixed-width keys (e.g. int64 probe vs int32 build): promote BOTH
+            # sides to the common dtype — casting the stream DOWN wraps values
+            # and fabricates matches. Integer widening is monotone, so the
+            # pre-sorted build array stays sorted and the n_valid clamp still
+            # masks the sentinel tail.
+            common = jnp.promote_types(svals.dtype, sorted_build.dtype)
+            svals = svals.astype(common)
+            sorted_common = sorted_build.astype(common)
             lo = jnp.minimum(
-                jnp.searchsorted(sorted_build, svals, side="left"), n_valid
+                jnp.searchsorted(sorted_common, svals, side="left"), n_valid
             ).astype(jnp.int32)
             hi = jnp.minimum(
-                jnp.searchsorted(sorted_build, svals, side="right"), n_valid
+                jnp.searchsorted(sorted_common, svals, side="right"), n_valid
             ).astype(jnp.int32)
             live = jnp.arange(scap, dtype=jnp.int32) < n_stream
             hi = jnp.where(k.validity & live, hi, lo)
@@ -218,6 +225,7 @@ class _JoinCore:
                 bk = build_keys_raw[0]
                 bvals = (bk.values.astype(jnp.int8)
                          if bk.values.dtype == jnp.bool_ else bk.values)
+                bvals = bvals.astype(common)  # same promotion, build→stream probe
                 s_eligible = k.validity & live
                 s_masked = jnp.where(
                     s_eligible, svals,

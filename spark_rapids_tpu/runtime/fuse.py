@@ -25,6 +25,7 @@ replays); a healthy query does O(stages) traces and O(batches) dispatches.
 from __future__ import annotations
 
 import threading
+import types as _types
 
 import jax
 
@@ -128,7 +129,11 @@ def call_fused(key, name: str, build, args, eager):
     """Run the kernel for `key` over `args`, falling back PERMANENTLY to
     `eager()` if the computation turns out to be untraceable (host sync /
     data-dependent Python control flow inside eval). The fallback latches per
-    key so the failed trace is paid once."""
+    key so the failed trace is paid once. Keys containing UNKEYABLE fields
+    (objects with no stable content key) are never cached — fusing them would
+    key compiled programs on object addresses."""
+    if not key_is_cacheable(key):
+        return eager()
     with _lock:
         k = _kernels.get(key)
     if k is _EAGER:
@@ -160,6 +165,70 @@ def expr_key(e):
     return _value_key(e)
 
 
+class _Unkeyable:
+    """Marker embedded in a semantic key when some field has no stable content
+    key (e.g. an arbitrary object whose repr would embed id()). call_fused
+    treats any key containing it as uncacheable and runs eagerly — a fresh
+    repr()-based key would either collide across distinct objects after
+    address reuse or never be shared, so neither caching behavior is safe."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<unkeyable>"
+
+
+UNKEYABLE = _Unkeyable()
+
+
+_fn_key_active = threading.local()
+
+
+def _fn_key(v):
+    """Stable content key for a plain Python function: bytecode + consts +
+    names + defaults + closure contents + the referenced module globals. Two
+    content-equal UDFs share one compiled kernel; anything address-dependent
+    (instance state, unkeyable globals) degrades to UNKEYABLE."""
+    if hasattr(v, "__func__"):          # bound method: instance state matters
+        return ("bound", _value_key(v.__self__), _fn_key(v.__func__))
+    # mutually-recursive globals (def a(): b(); def b(): a()) would recurse
+    # forever; on re-entry the participant's own bytecode already contributes
+    # at the outer level, so a name marker suffices
+    active = getattr(_fn_key_active, "ids", None)
+    if active is None:
+        active = _fn_key_active.ids = set()
+    if id(v) in active:
+        return ("recursive-fn", getattr(v, "__qualname__", "?"))
+    active.add(id(v))
+    try:
+        return _fn_key_inner(v)
+    finally:
+        active.discard(id(v))
+
+
+def _fn_key_inner(v):
+    code = v.__code__
+    consts = tuple(_value_key(c) for c in code.co_consts)
+    defaults = tuple(_value_key(d) for d in (v.__defaults__ or ()))
+    closure = tuple(_value_key(c.cell_contents)
+                    for c in (v.__closure__ or ()))
+    # a global read (`FACTOR`, `jnp`) is baked into the traced program just
+    # like a const — key its VALUE, not just its name, else two modules with
+    # different FACTORs collide on one kernel. Modules key by name; names not
+    # in __globals__ are builtins/attribute names (stable / covered by the
+    # object they're read from).
+    fglobals = getattr(v, "__globals__", {}) or {}
+    gparts = []
+    for name in code.co_names:
+        if name in fglobals:
+            g = fglobals[name]
+            gparts.append((name, ("mod", g.__name__)
+                           if isinstance(g, _types.ModuleType)
+                           else _value_key(g)))
+    return ("fn", code.co_code, consts, code.co_names, code.co_varnames,
+            defaults, closure, tuple(gparts))
+
+
 def _value_key(v):
     from spark_rapids_tpu.expr.core import Expression
     from spark_rapids_tpu import types as T
@@ -173,7 +242,24 @@ def _value_key(v):
         return (type(v).__name__, v)
     if isinstance(v, T.DataType):
         return v
-    return repr(v)
+    if isinstance(v, _types.CodeType):   # nested function consts
+        return ("code", v.co_code, tuple(_value_key(c) for c in v.co_consts),
+                v.co_names)
+    if callable(v) and hasattr(v, "__code__"):
+        try:
+            return _fn_key(v)
+        except (AttributeError, ValueError):
+            return UNKEYABLE
+    return UNKEYABLE
+
+
+def key_is_cacheable(key) -> bool:
+    """False if any component of a (nested-tuple) semantic key is UNKEYABLE."""
+    if key is UNKEYABLE:
+        return False
+    if isinstance(key, tuple):
+        return all(key_is_cacheable(p) for p in key)
+    return True
 
 
 def schema_key(schema) -> tuple:

@@ -50,6 +50,13 @@ class TierEnum:
     DISK = "DISK"
 
 
+class BufferClosedError(RuntimeError):
+    """A spillable buffer was acquired after close()/remove() — raised as a
+    dedicated type so callers that legitimately race a concurrent release
+    (broadcast host-bridge rebuild) can retry it without masking unrelated
+    assertion failures."""
+
+
 @dataclasses.dataclass
 class HostColumn:
     """Host image of one TpuColumnVector (the RapidsHostColumnVector analog)."""
@@ -257,7 +264,10 @@ class BufferCatalog:
         it is re-registered in the device tier (reference unspill.enabled,
         RapidsBufferStore copy-back); otherwise the device copy is transient."""
         with self._lock:
-            buf = self._buffers[buffer_id]
+            try:
+                buf = self._buffers[buffer_id]
+            except KeyError:
+                raise BufferClosedError(f"buffer {buffer_id} removed") from None
             if buf.tier == TierEnum.DEVICE:
                 return buf._device
             hb = buf._host
@@ -342,7 +352,8 @@ class SpillableColumnarBatch:
         self._leak = LeakTracker.track(f"SpillableColumnarBatch#{self.buffer_id}")
 
     def get_batch(self) -> ColumnarBatch:
-        assert not self._closed, "use after close"
+        if self._closed:
+            raise BufferClosedError(f"buffer {self.buffer_id} used after close")
         return self.catalog.acquire_batch(self.buffer_id)
 
     def set_priority(self, priority: float):

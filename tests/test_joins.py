@@ -279,3 +279,37 @@ def test_broadcast_join_rides_exchange():
     out = left.join(right, on="k").collect()
     assert out.num_rows == 1
     assert out["a"].to_pylist() == [20] and out["b"].to_pylist() == [7]
+
+
+@pytest.mark.parametrize("how", ["inner", "leftouter", "fullouter", "leftsemi",
+                                 "leftanti"])
+def test_mixed_width_key_join(how):
+    """int64 stream key vs int32 build key must NOT wrap on the fast path
+    (advisor r3 high): 2**32+5 is not equal to 5."""
+    lt = pa.table({"lk": pa.array([2**32 + 5, 5, -1, None, 2**31 + 7],
+                                  type=pa.int64()),
+                   "lv": pa.array(range(5), type=pa.int32())})
+    rt = pa.table({"rk": pa.array([5, 7, -1], type=pa.int32()),
+                   "rv": pa.array(range(3), type=pa.int32())})
+    conf = RapidsConf()
+    j = HashJoinExec(how, [col("lk")], [col("rk")],
+                     ArrowScanExec([lt], conf=conf), ArrowScanExec([rt], conf=conf))
+    got = j.execute_collect()
+    rt64 = pa.table({"rk": rt["rk"].cast(pa.int64()), "rv": rt["rv"]})
+    want = host_join(lt, rt64, "lk", "rk", how)
+    assert got.num_rows == want.num_rows, (how, got.to_pylist(), want.to_pylist())
+    if how in ("inner", "leftsemi", "leftanti"):
+        assert sorted(got["lv"].to_pylist()) == sorted(want["lv"].to_pylist()), how
+
+
+def test_mixed_width_key_join_wide_build():
+    """int32 stream key vs int64 build key (widening direction) stays correct."""
+    lt = pa.table({"lk": pa.array([5, -1, 3], type=pa.int32()),
+                   "lv": pa.array(range(3), type=pa.int32())})
+    rt = pa.table({"rk": pa.array([2**32 + 5, 5, -1], type=pa.int64()),
+                   "rv": pa.array(range(3), type=pa.int32())})
+    conf = RapidsConf()
+    j = HashJoinExec("inner", [col("lk")], [col("rk")],
+                     ArrowScanExec([lt], conf=conf), ArrowScanExec([rt], conf=conf))
+    got = j.execute_collect()
+    assert sorted(zip(got["lv"].to_pylist(), got["rv"].to_pylist())) == [(0, 1), (1, 2)]

@@ -6,6 +6,8 @@ import numpy as np
 import pyarrow as pa
 import pytest
 
+import spark_rapids_tpu.runtime.memory as mem_mod
+
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.runtime.memory import (
@@ -93,7 +95,7 @@ def test_spillable_columnar_batch_lifecycle(tmp_path):
         assert scb.get_batch().to_arrow().equals(t)
     finally:
         scb.close()
-    with pytest.raises(AssertionError):
+    with pytest.raises(mem_mod.BufferClosedError):
         scb.get_batch()
 
 

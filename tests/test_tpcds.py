@@ -20,36 +20,22 @@ def _rows(df):
 
 
 def _check(got, exp, float_cols):
-    assert len(got) == len(exp), (len(got), len(exp))
-    for g, e in zip(got, exp):
-        assert len(g) == len(e), (g, e)
-        for i, (a, b) in enumerate(zip(g, e)):
-            if i in float_cols:
-                assert a == pytest.approx(b, rel=1e-9), (g, e)
-            else:
-                assert a == b, (g, e)
+    # single source of truth with bench.py's recorded sweep
+    tpcds.check_rows(got, exp, float_cols)
 
 
-@pytest.mark.parametrize("name,float_cols", [
-    ("q3", {3}), ("q42", {3}), ("q52", {3}), ("q55", {2}),
-    ("q7", {1, 2, 3, 4}), ("q19", {3}),
-    # round-3 breadth: window-heavy (q53/q63/q89/q98), decimal-heavy
-    # (q48/q79 over decimal(7,2) ss_net_profit — exact, no float slot),
-    # conditional aggregation (q43), multi-count cross join (q88/q96),
-    # ticket/basket shapes (q34/q73/q46/q68/q79), avg-subquery joins
-    # (q6/q65), state rollup base (q27)
-    ("q6", set()), ("q27", {2, 3, 4, 5}), ("q34", set()),
-    ("q43", {1, 2, 3, 4, 5, 6, 7}), ("q46", {5, 6}), ("q48", set()),
-    ("q53", {1, 2}), ("q63", {1, 2}), ("q65", {2, 3}),
-    ("q68", {5, 6, 7}), ("q73", set()), ("q79", {5}), ("q88", set()),
-    ("q89", {5, 6}), ("q96", set()), ("q98", {4, 5, 6}),
-])
-def test_tpcds_query_matches_oracle(data, name, float_cols):
+# breadth: window-heavy (q53/q63/q89/q98), decimal-heavy (q48/q79 over
+# decimal(7,2) ss_net_profit — exact, no float slot), conditional aggregation
+# (q43), multi-count cross join (q88/q96), ticket/basket shapes
+# (q34/q73/q46/q68/q79), avg-subquery joins (q6/q65), state rollup base (q27);
+# float-tolerance columns come from the shared tpcds.FLOAT_COLS table
+@pytest.mark.parametrize("name", sorted(tpcds.FLOAT_COLS))
+def test_tpcds_query_matches_oracle(data, name):
     dfs, tb = data
     got = _rows(tpcds.QUERIES[name](dfs))
     exp = [tuple(r) for r in tpcds.NP_QUERIES[name](tb)]
     assert exp, "vacuous test: oracle returned no rows"
-    _check(got, exp, float_cols)
+    _check(got, exp, tpcds.FLOAT_COLS[name])
 
 
 def test_tpcds_q3_over_mesh(tmp_path):

@@ -1,0 +1,80 @@
+"""Probe the accelerator backend and append a dated line to docs/perf_notes.md.
+
+Round-4 protocol (VERDICT.md r3, next-round item 1): probe FIRST, probe often,
+log every attempt with a timestamp so a wedged tunnel is documented evidence
+rather than a round-end surprise. The probe runs a trivial add in a SHORT
+subprocess (a wedged tunnel hangs even `jnp.ones((8,)).sum()` — killing the
+subprocess before any real dispatch is safe; killing a real dispatch is what
+wedges the chip in the first place).
+
+Usage: python tools/tpu_probe.py [--note TEXT] [--timeout SECONDS]
+Exit code 0 = backend usable, 1 = unavailable (logged either way).
+"""
+
+import argparse
+import datetime
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+LOG = REPO / "docs" / "perf_notes.md"
+MARKER = "## Round-4 TPU probe log"
+
+PROBE_CODE = (
+    "import jax; d = jax.devices(); "
+    "import jax.numpy as jnp; "
+    "x = jnp.ones((8,)) + 1; x.block_until_ready(); "
+    "import numpy as np; "
+    "print('PROBE_OK', float(np.asarray(x).sum()), d[0].platform, "
+    "getattr(d[0], 'device_kind', '?'))"
+)
+
+
+def probe(timeout_s: float):
+    """Returns (ok, detail). Never raises."""
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", PROBE_CODE], env=dict(os.environ),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            timeout=timeout_s)
+        out = (proc.stdout or "").strip()
+        elapsed = time.time() - t0
+        if proc.returncode == 0 and "PROBE_OK" in out:
+            line = [l for l in out.splitlines() if "PROBE_OK" in l][-1]
+            return True, f"{line} ({elapsed:.1f}s)"
+        return False, f"rc={proc.returncode} ({elapsed:.1f}s): {out[-200:]}"
+    except subprocess.TimeoutExpired:
+        return False, f"timeout after {timeout_s:.0f}s (tunnel wedged)"
+
+
+def log_result(ok: bool, detail: str, note: str = ""):
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%d %H:%M UTC")
+    status = "OK" if ok else "UNAVAILABLE"
+    entry = f"- `{stamp}` **{status}** — {detail}"
+    if note:
+        entry += f" _({note})_"
+    text = LOG.read_text() if LOG.exists() else "# Perf notes\n"
+    if MARKER not in text:
+        text = text.rstrip() + f"\n\n{MARKER}\n\n"
+    text = text.rstrip() + "\n" + entry + "\n"
+    LOG.write_text(text)
+    print(entry)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--note", default="")
+    ap.add_argument("--timeout", type=float, default=240.0)
+    args = ap.parse_args()
+    ok, detail = probe(args.timeout)
+    log_result(ok, detail, args.note)
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()
