@@ -27,8 +27,12 @@ def write_partitioned(outdir: str, name: str, table: pa.Table,
 
 
 def load(spark, paths: dict, files_per_partition: int = 2) -> dict:
-    return {name: spark.read_parquet(p, files_per_partition=files_per_partition)
-            for name, p in paths.items()}
+    dfs = {name: spark.read_parquet(p,
+                                    files_per_partition=files_per_partition)
+           for name, p in paths.items()}
+    for name, df in dfs.items():     # make the tables visible to session.sql
+        spark.create_or_replace_temp_view(name, df)
+    return dfs
 
 
 def read_np(path):

@@ -1520,3 +1520,40 @@ def check_rows(got, exp, float_cols, rel=1e-9):
                     raise AssertionError((g, e))
             elif a != b:   # exact slot, or a NULL in a float slot
                 raise AssertionError((g, e))
+
+
+def np_q27_rollup(tb):
+    """Official q27 shape: GROUP BY ROLLUP (i_item_id, s_state) with
+    grouping(s_state), ordered nulls-first asc (Spark default)."""
+    cd = tb["customer_demographics"]
+    ok_cd = set(cd["cd_demo_sk"][(cd["cd_gender"] == "F")
+                                 & (cd["cd_marital_status"] == "W")
+                                 & (cd["cd_education_status"] == "Primary")])
+    ok_d = _d(tb, d_year=lambda y: y == 1999)
+    st = tb["store"]
+    s_state = {k: s for k, s in zip(st["s_store_sk"], st["s_state"])
+               if s in ("CA", "TX", "NY", "OH")}
+    it = tb["item"]
+    iid = dict(zip(it["i_item_sk"], it["i_item_id"]))
+    ss = tb["store_sales"]
+    acc = {}
+    for ddk, cdk, sk, ik, q, lp, cam, sp in zip(
+            ss["ss_sold_date_sk"], ss["ss_cdemo_sk"], ss["ss_store_sk"],
+            ss["ss_item_sk"], ss["ss_quantity"], ss["ss_list_price"],
+            ss["ss_coupon_amt"], ss["ss_sales_price"]):
+        if ddk not in ok_d or cdk not in ok_cd or sk not in s_state:
+            continue
+        for key, g in (((iid[ik], s_state[sk]), 0),
+                       ((iid[ik], None), 1), ((None, None), 3)):
+            cur = acc.setdefault((key, g), [0.0, 0.0, 0.0, 0.0, 0])
+            cur[0] += q
+            cur[1] += lp
+            cur[2] += cam
+            cur[3] += sp
+            cur[4] += 1
+    rows = [(k[0], k[1], g & 1) + tuple(v / c[4] for v in c[:4])
+            for (k, g), c in acc.items()]
+    # asc with nulls first on (i_item_id, s_state)
+    rows.sort(key=lambda r: ((r[0] is not None, r[0] or ""),
+                             (r[1] is not None, r[1] or "")))
+    return rows[:100]

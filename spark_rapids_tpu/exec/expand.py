@@ -67,6 +67,20 @@ class ExpandExec(TpuExec):
             target = bucket_capacity(out_rows)
             if target < out_cap:
                 out_cols = slice_to_capacity(out_cols, out_rows, target)
+                out_cap = target
+        # k projections make out_cap = k * 2^m; downstream kernels assume
+        # power-of-two bucket capacities (e.g. the segment range-sum tree) —
+        # pad dead rows up to the bucket
+        bucket = bucket_capacity(out_cap)
+        if bucket != out_cap:
+            pad = bucket - out_cap
+            out_cols = [
+                Col(jnp.concatenate(
+                        [c.values, jnp.zeros((pad,), c.values.dtype)]),
+                    jnp.concatenate([c.validity,
+                                     jnp.zeros((pad,), jnp.bool_)]),
+                    c.dtype, c.dictionary)
+                for c in out_cols]
         return ColumnarBatch([c.to_vector() for c in out_cols], out_rows,
                              self._out)
 

@@ -149,6 +149,8 @@ def _seg_sum_tree(data, ctx: SegCtx):
     levels = [data]
     while levels[-1].shape[0] > 1:
         x = levels[-1]
+        if x.shape[0] % 2:    # non-power-of-two capacity: zero-pad the level
+            x = jnp.concatenate([x, jnp.zeros((1,), x.dtype)])
         levels.append(x.reshape(-1, 2).sum(axis=1))
 
     lo = ctx.seg_start

@@ -220,16 +220,20 @@ class DataFrame:
         return self._plan.collect_host()
 
     def collect_row_buffer(self):
-        """Fixed-width fast path: collect as a packed binary row buffer
-        (reference GpuColumnarToRowExec + CudfUnsafeRow, SURVEY.md #9).
-        Returns (rows int64[n, words], schema); raises NotImplementedError
-        for variable-width schemas (use collect())."""
+        """Packed binary row collection (reference GpuColumnarToRowExec +
+        CudfUnsafeRow, SURVEY.md #9). Fixed-width schemas return
+        (rows int64[n, words], schema); schemas with strings return the
+        UnsafeRow-style variable layout ((words, row_offsets), schema) —
+        see columnar/rows.py pack_arrow_var."""
         from spark_rapids_tpu.columnar import rows as R
         schema = self._plan.output
-        if not R.is_fixed_width(schema):
-            raise NotImplementedError("variable-width schema: use collect()")
         # host-only pack: collect() already materialized host arrow
-        return R.pack_arrow(self.collect(), schema), schema
+        if R.is_fixed_width(schema):
+            return R.pack_arrow(self.collect(), schema), schema
+        if R.is_packable(schema):
+            return R.pack_arrow_var(self.collect(), schema), schema
+        raise NotImplementedError(
+            f"nested types in {schema}: use collect()")
 
     def count(self) -> int:
         from spark_rapids_tpu.expr.aggregates import Count
@@ -388,6 +392,56 @@ class PivotedGroupedData:
                          self.df.session)
 
 
+class UDFRegistration:
+    """Named-UDF registry (reference RapidsUDF + GpuUserDefinedFunction.scala:73
+    + hiveUDFs.scala: a user function that SHIPS its own device implementation
+    is routed to it by the planner; otherwise the usual ladder applies —
+    bytecode-compile to device expressions, else the python worker pool).
+
+        spark.udf.register("my_fn", fn=slow_row_fn, return_type=T.DOUBLE,
+                           device_fn=lambda v: v * 2.0)
+        spark.sql("select my_fn(x) from t")        # runs the jax impl, fused
+    """
+
+    def __init__(self, session: "TpuSession"):
+        self._session = session
+        self._fns: dict = {}
+
+    def register(self, name: str, fn=None, return_type: T.DataType | None = None,
+                 device_fn=None, null_aware: bool = False):
+        if fn is None and device_fn is None:
+            raise ValueError("register() needs fn and/or device_fn")
+        self._fns[name] = (fn, return_type, device_fn, null_aware)
+
+        def call(*cols):
+            return self.build(name, [_to_expr(c) for c in cols])
+        return call
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._fns
+
+    def build(self, name: str, args: list) -> E.Expression:
+        """Expression for a registered UDF call: device impl > compiled
+        bytecode > python worker (the reference's replacement-else-fallback
+        contract)."""
+        fn, return_type, device_fn, null_aware = self._fns[name]
+        if device_fn is not None:
+            from spark_rapids_tpu.udf.device_udf import JaxUDF
+            if return_type is None:
+                raise ValueError(f"UDF {name}: device_fn needs return_type")
+            return JaxUDF(device_fn, args, return_type, null_aware, name=name)
+        from spark_rapids_tpu.udf.compiler import compile_udf
+        compiled = compile_udf(fn, args)
+        if compiled is not None:
+            return compiled
+        from spark_rapids_tpu.udf.python_runtime import PythonUDF
+        if return_type is None:
+            raise ValueError(
+                f"UDF {name} could not be compiled to device expressions; "
+                "the python-worker fallback needs an explicit return_type")
+        return PythonUDF(fn, args, return_type)
+
+
 class TpuSession:
     """The SparkSession stand-in; owns the conf and the read API
     (reference RapidsDriverPlugin/SQLExecPlugin wiring, Plugin.scala:45-70)."""
@@ -395,6 +449,8 @@ class TpuSession:
     def __init__(self, conf: dict | RapidsConf | None = None):
         self.conf = (conf if isinstance(conf, RapidsConf)
                      else RapidsConf(conf or {}))
+        self._views: dict = {}   # temp-view catalog for session.sql()
+        self.udf = UDFRegistration(self)
         from spark_rapids_tpu import config as CFG
         from spark_rapids_tpu.ops import pallas_kernels as PK
         # the Pallas dispatch is process-global (like the reference's
@@ -422,10 +478,11 @@ class TpuSession:
     def read_parquet(self, path, pushed_filter=None,
                      files_per_partition: int = 1) -> DataFrame:
         from spark_rapids_tpu import config as CFG
-        from spark_rapids_tpu.io.filescan import FileScanNode
+        from spark_rapids_tpu.io.filescan import FileScanNode, rewrite_scan_path
         # node-level default so host-fallback scans honor the conf too; the
         # device exec re-applies its conf value per execution
         opts = {"rebase_mode": self.conf.get(CFG.PARQUET_REBASE_MODE)}
+        path = rewrite_scan_path(path, self.conf)
         return DataFrame(FileScanNode(path, "parquet",
                                       pushed_filter=pushed_filter,
                                       files_per_partition=files_per_partition,
@@ -433,24 +490,33 @@ class TpuSession:
                          self)
 
     def read_orc(self, path, **kw) -> DataFrame:
-        from spark_rapids_tpu.io.filescan import FileScanNode
-        return DataFrame(FileScanNode(path, "orc", **kw), self)
+        from spark_rapids_tpu.io.filescan import FileScanNode, rewrite_scan_path
+        return DataFrame(FileScanNode(rewrite_scan_path(path, self.conf),
+                                      "orc", **kw), self)
 
     def read_csv(self, path, schema: T.StructType | None = None,
                  header: bool = True, delimiter: str = ",") -> DataFrame:
-        from spark_rapids_tpu.io.filescan import FileScanNode
+        from spark_rapids_tpu.io.filescan import FileScanNode, rewrite_scan_path
         return DataFrame(FileScanNode(
-            path, "csv", schema=schema,
+            rewrite_scan_path(path, self.conf), "csv", schema=schema,
             options={"header": header, "delimiter": delimiter,
                      "schema": schema}), self)
 
     def create_dataframe_from_rows(self, rows, schema,
-                                   num_partitions: int = 1) -> DataFrame:
-        """Fixed-width fast path: a packed binary row buffer (see
-        columnar/rows.py) → DataFrame without per-row conversion
-        (reference GpuRowToColumnarExec's codegen'd fast path)."""
+                                   num_partitions: int = 1,
+                                   offsets=None) -> DataFrame:
+        """Packed binary row buffer → DataFrame without per-row conversion
+        (reference GpuRowToColumnarExec's codegen'd fast path). Pass
+        `offsets` for the variable-width layout from pack_arrow_var; a
+        (words, offsets) tuple in `rows` also works."""
         from spark_rapids_tpu.columnar import rows as R
         import numpy as np
+        if offsets is None and isinstance(rows, tuple) and len(rows) == 2:
+            rows, offsets = rows
+        if offsets is not None:
+            tbl = R.unpack_rows_arrow_var(np.asarray(rows),
+                                          np.asarray(offsets), schema)
+            return self.create_dataframe(tbl, num_partitions)
         rows = np.asarray(rows)
         n = rows.shape[0]
         per = -(-n // max(1, num_partitions)) if n else 1
@@ -477,3 +543,17 @@ class TpuSession:
         if end is None:
             start, end = 0, start
         return DataFrame(NN.RangeNode(start, end, step, num_slices), self)
+
+    # -- SQL -----------------------------------------------------------------
+    def create_or_replace_temp_view(self, name: str, df: DataFrame) -> None:
+        """Register `df` under `name` for session.sql() (SparkSession
+        createOrReplaceTempView analog)."""
+        self._views[name] = df
+
+    createOrReplaceTempView = create_or_replace_temp_view
+
+    def sql(self, text: str) -> DataFrame:
+        """Run a SQL query over the registered temp views (the reference's
+        entire surface is SQL text — qa_nightly_sql.py; see sql/)."""
+        from spark_rapids_tpu.sql import lower_sql
+        return DataFrame(lower_sql(text, self._views, self), self)

@@ -1,0 +1,18 @@
+"""SQL front-end: text → AST → engine plan.
+
+Reference role: the reference is a plugin inside Spark *SQL* — its whole test
+surface is SQL text (reference integration_tests qa_nightly_sql.py; the
+sql-plugin hooks Catalyst's physical planning). This framework is standalone,
+so it ships the front-end itself: a recursive-descent parser over the SQL
+subset the TPC-DS/TPC-H workloads exercise (SELECT / FROM comma+explicit
+joins / WHERE / GROUP BY [ROLLUP] / HAVING / window OVER / ORDER BY / LIMIT /
+scalar subqueries / derived tables / CASE / IN / BETWEEN / LIKE / CAST),
+lowered onto plan/nodes.py, with the same analysis moves Catalyst makes
+(filter pushdown into the join graph, equi-key extraction, aggregate/window
+separation, rollup → Expand).
+"""
+
+from spark_rapids_tpu.sql.parser import parse_sql
+from spark_rapids_tpu.sql.lower import lower_sql
+
+__all__ = ["parse_sql", "lower_sql"]

@@ -1,0 +1,1053 @@
+"""SQL AST → engine plan lowering (the Catalyst-analyzer role).
+
+Pipeline per SELECT block, mirroring the moves Spark's analyzer/optimizer
+makes before the reference plugin ever sees a plan:
+
+1. FROM: resolve tables (catalog views / CTEs / derived tables), then plan
+   the join graph — single-relation WHERE conjuncts push down as pre-join
+   filters, two-relation equi conjuncts become hash-join keys (greedy
+   connected-component join order), everything else lands in a post-join
+   filter. Explicit JOIN ... ON splits its condition the same way.
+2. Aggregation: distinct AggregateFunction subtrees (keyed by the fuse
+   module's structural expr keys) become AggregateNode columns; GROUP BY
+   ROLLUP lowers through ExpandNode with a grouping-id column exactly like
+   Spark's Expand (reference GpuExpandExec role).
+3. Window: post-aggregation WindowNode per distinct OVER expression.
+4. HAVING → Filter; SELECT → Project; DISTINCT → group-by-all; ORDER BY
+   resolves output names/aliases/ordinals (hidden sort columns are projected
+   in and dropped after the sort); LIMIT → LimitNode.
+
+Scalar subqueries execute eagerly at lowering time (expr/misc.ScalarSubquery
+— same contract as Spark's pre-executed subquery stages).
+"""
+
+from __future__ import annotations
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr import core as E
+from spark_rapids_tpu.expr.aggregates import (
+    AggregateFunction, Average, Count, Max, Min, StddevPop, StddevSamp, Sum,
+    VariancePop, VarianceSamp, First, Last,
+)
+from spark_rapids_tpu.plan import nodes as NN
+from spark_rapids_tpu.runtime import fuse
+from spark_rapids_tpu.sql import parser as P
+
+
+class SqlAnalysisError(ValueError):
+    pass
+
+
+# -- scopes -------------------------------------------------------------------
+
+class Scope:
+    """Columns of the current relation: (qualifier, name, dtype, nullable)
+    per output position."""
+
+    def __init__(self, cols):
+        self.cols = list(cols)
+
+    @classmethod
+    def for_relation(cls, plan, qualifier):
+        return cls([(qualifier, f.name, f.data_type, f.nullable)
+                    for f in plan.output])
+
+    def concat(self, other: "Scope") -> "Scope":
+        return Scope(self.cols + other.cols)
+
+    def find(self, parts) -> list:
+        """Matching positions for a (possibly qualified) identifier."""
+        if len(parts) == 1:
+            name = parts[0].lower()
+            return [i for i, (_, n, _, _) in enumerate(self.cols)
+                    if n.lower() == name]
+        qual, name = parts[0].lower(), parts[1].lower()
+        return [i for i, (q, n, _, _) in enumerate(self.cols)
+                if q is not None and q.lower() == qual and n.lower() == name]
+
+    def resolve(self, parts) -> E.BoundReference:
+        hits = self.find(parts)
+        if not hits:
+            raise SqlAnalysisError(f"column not found: {'.'.join(parts)}")
+        if len(hits) > 1:
+            raise SqlAnalysisError(f"ambiguous column: {'.'.join(parts)}")
+        i = hits[0]
+        _, name, dtype, nullable = self.cols[i]
+        return E.BoundReference(i, dtype, nullable, name)
+
+    def rel_of(self, parts, rel_ranges) -> int | None:
+        """Which relation (by index into rel_ranges: [(lo, hi), ...]) a
+        resolved column belongs to."""
+        hits = self.find(parts)
+        if len(hits) != 1:
+            return None
+        for ri, (lo, hi) in enumerate(rel_ranges):
+            if lo <= hits[0] < hi:
+                return ri
+        return None
+
+
+_TYPE_MAP = {
+    "int": T.INT, "integer": T.INT, "smallint": T.SHORT, "tinyint": T.BYTE,
+    "bigint": T.LONG, "long": T.LONG, "float": T.FLOAT, "real": T.FLOAT,
+    "double": T.DOUBLE, "string": T.STRING, "date": T.DATE,
+    "timestamp": T.TIMESTAMP, "boolean": T.BOOLEAN,
+}
+
+
+def _sql_type(name: str, args: tuple) -> T.DataType:
+    if name in _TYPE_MAP:
+        return _TYPE_MAP[name]
+    if name in ("decimal", "numeric"):
+        p = int(args[0]) if args else 10
+        s = int(args[1]) if len(args) > 1 else 0
+        return T.DecimalType(p, s)
+    if name in ("char", "varchar"):
+        return T.STRING
+    raise SqlAnalysisError(f"unsupported cast type {name}")
+
+
+_AGG_FUNCS = {
+    "sum": Sum, "min": Min, "max": Max, "avg": Average,
+    "stddev_samp": StddevSamp, "stddev": StddevSamp, "stddev_pop": StddevPop,
+    "var_samp": VarianceSamp, "variance": VarianceSamp,
+    "var_pop": VariancePop, "first": First, "last": Last,
+}
+
+
+class _Grouping(E.Expression):
+    """Placeholder for GROUPING(col) until rollup lowering rewrites it to a
+    grouping-id bit test; reaching eval means rollup wasn't in effect."""
+
+    def __init__(self, ref: E.Expression):
+        self.children = [ref]
+
+    @property
+    def dtype(self):
+        return T.INT
+
+    def with_children(self, children):
+        return _Grouping(children[0])
+
+    def eval(self, ctx):
+        raise SqlAnalysisError("grouping() outside GROUP BY ROLLUP")
+
+
+# -- expression conversion ----------------------------------------------------
+
+class _ExprConverter:
+    def __init__(self, scope: Scope, lowerer: "_Lowerer"):
+        self.scope = scope
+        self.lowerer = lowerer
+
+    def convert(self, a) -> E.Expression:
+        c = self.convert
+        if isinstance(a, P.Lit):
+            return E.Literal(a.value)
+        if isinstance(a, P.Ident):
+            return self.scope.resolve(a.parts)
+        if isinstance(a, P.UnOp):
+            if a.op == "-":
+                from spark_rapids_tpu.expr.arithmetic import UnaryMinus
+                inner = c(a.operand)
+                if isinstance(inner, E.Literal) and isinstance(
+                        inner.value, (int, float)) and not isinstance(
+                        inner.value, bool):
+                    return E.Literal(-inner.value, inner.dtype)
+                return UnaryMinus(inner)
+            from spark_rapids_tpu.expr.predicates import Not
+            return Not(c(a.operand))
+        if isinstance(a, P.BinOp):
+            from spark_rapids_tpu.expr import arithmetic as AR
+            from spark_rapids_tpu.expr import predicates as PR
+            from spark_rapids_tpu.expr.strings import Concat
+            l, r = c(a.left), c(a.right)
+            table = {
+                "+": AR.Add, "-": AR.Subtract, "*": AR.Multiply,
+                "/": AR.Divide, "%": AR.Remainder,
+                "=": PR.EqualTo, "<": PR.LessThan, "<=": PR.LessThanOrEqual,
+                ">": PR.GreaterThan, ">=": PR.GreaterThanOrEqual,
+                "<>": PR.NotEqual, "!=": PR.NotEqual,
+                "and": PR.And, "or": PR.Or,
+            }
+            if a.op == "||":
+                return Concat(l, r)
+            return table[a.op](l, r)
+        if isinstance(a, P.CaseAst):
+            from spark_rapids_tpu.expr.conditional import CaseWhen
+            from spark_rapids_tpu.expr.predicates import EqualTo
+            if a.operand is not None:
+                op = c(a.operand)
+                branches = [(EqualTo(op, c(w)), c(v)) for w, v in a.branches]
+            else:
+                branches = [(c(w), c(v)) for w, v in a.branches]
+            # typed NULL literals: give else/then NULLs the branch type
+            else_e = c(a.else_) if a.else_ is not None else None
+            branches, else_e = self._retype_nulls(branches, else_e)
+            return CaseWhen(branches, else_e)
+        if isinstance(a, P.CastAst):
+            from spark_rapids_tpu.expr.cast import Cast
+            return Cast(c(a.expr), _sql_type(a.type_name, a.type_args))
+        if isinstance(a, P.BetweenAst):
+            from spark_rapids_tpu.expr.predicates import (
+                And, GreaterThanOrEqual, LessThanOrEqual, Not)
+            e = c(a.expr)
+            cond = And(GreaterThanOrEqual(e, c(a.lo)),
+                       LessThanOrEqual(e, c(a.hi)))
+            return Not(cond) if a.negated else cond
+        if isinstance(a, P.InAst):
+            from spark_rapids_tpu.expr.predicates import InSet, Not
+            if isinstance(a.values, P.Select):
+                raise SqlAnalysisError(
+                    "IN (subquery) is not supported; rewrite as a join")
+            vals = []
+            for v in a.values:
+                ve = c(v)
+                if not isinstance(ve, E.Literal):
+                    from spark_rapids_tpu.expr.predicates import In
+                    ins = In(c(a.expr), [c(x) for x in a.values])
+                    return Not(ins) if a.negated else ins
+                vals.append(ve.value)
+            ins = InSet(c(a.expr), vals)
+            return Not(ins) if a.negated else ins
+        if isinstance(a, P.LikeAst):
+            from spark_rapids_tpu.expr.strings import Like
+            from spark_rapids_tpu.expr.predicates import Not
+            lk = Like(c(a.expr), E.Literal(a.pattern))
+            return Not(lk) if a.negated else lk
+        if isinstance(a, P.IsNullAst):
+            from spark_rapids_tpu.expr.nullexprs import IsNotNull, IsNull
+            return (IsNotNull if a.negated else IsNull)(c(a.expr))
+        if isinstance(a, P.SubqueryExpr):
+            from spark_rapids_tpu.expr.misc import ScalarSubquery
+            df = self.lowerer.dataframe(a.query)
+            return ScalarSubquery.from_dataframe(df)
+        if isinstance(a, P.FuncCall):
+            return self.func(a)
+        if isinstance(a, P.ExistsAst):
+            raise SqlAnalysisError(
+                "EXISTS is not supported; rewrite as a semi join")
+        if isinstance(a, P.Star):
+            raise SqlAnalysisError("* only allowed at select-list top level "
+                                   "or in count(*)")
+        raise SqlAnalysisError(f"unsupported SQL construct: {a!r}")
+
+    @staticmethod
+    def _retype_nulls(branches, else_e):
+        ts = [v.dtype for _, v in branches
+              if not (isinstance(v, E.Literal) and v.value is None)]
+        if else_e is not None and not (
+                isinstance(else_e, E.Literal) and else_e.value is None):
+            ts.append(else_e.dtype)
+        if not ts:
+            return branches, else_e
+        t0 = ts[0]
+        fixed = [(p, E.Literal(None, t0)
+                  if isinstance(v, E.Literal) and v.value is None else v)
+                 for p, v in branches]
+        if else_e is not None and isinstance(else_e, E.Literal) \
+                and else_e.value is None:
+            else_e = E.Literal(None, t0)
+        return fixed, else_e
+
+    def func(self, a: P.FuncCall) -> E.Expression:
+        c = self.convert
+        name = a.name
+        if a.over is not None:
+            return self._window(a)
+        if name in _AGG_FUNCS:
+            if a.distinct:
+                raise SqlAnalysisError(f"DISTINCT aggregate {name} not "
+                                       "supported")
+            if len(a.args) != 1:
+                raise SqlAnalysisError(f"{name} takes one argument")
+            return _AGG_FUNCS[name](c(a.args[0]))
+        if name == "count":
+            if a.distinct:
+                raise SqlAnalysisError("count(DISTINCT) not supported")
+            if not a.args or isinstance(a.args[0], P.Star):
+                return Count(None)
+            return Count(c(a.args[0]))
+        if name in ("substr", "substring"):
+            from spark_rapids_tpu.expr.strings import Substring
+            args = [c(x) for x in a.args]
+            return Substring(*args)
+        if name == "coalesce":
+            from spark_rapids_tpu.expr.nullexprs import Coalesce
+            return Coalesce(*[c(x) for x in a.args])
+        if name == "nullif":
+            from spark_rapids_tpu.expr.conditional import If
+            from spark_rapids_tpu.expr.predicates import EqualTo
+            x, y = c(a.args[0]), c(a.args[1])
+            return If(EqualTo(x, y), E.Literal(None, x.dtype), x)
+        if name == "abs":
+            from spark_rapids_tpu.expr.arithmetic import Abs
+            return Abs(c(a.args[0]))
+        if name == "grouping":
+            return _Grouping(c(a.args[0]))
+        if name in ("upper", "ucase"):
+            from spark_rapids_tpu.expr.strings import Upper
+            return Upper(c(a.args[0]))
+        if name in ("lower", "lcase"):
+            from spark_rapids_tpu.expr.strings import Lower
+            return Lower(c(a.args[0]))
+        if name == "length":
+            from spark_rapids_tpu.expr.strings import Length
+            return Length(c(a.args[0]))
+        if name == "trim":
+            from spark_rapids_tpu.expr.strings import Trim
+            return Trim(c(a.args[0]))
+        if name == "concat":
+            from spark_rapids_tpu.expr.strings import Concat
+            return Concat(*[c(x) for x in a.args])
+        if name == "round":
+            from spark_rapids_tpu.expr.mathexprs import Round
+            args = [c(x) for x in a.args]
+            scale = 0
+            if len(args) > 1:
+                assert isinstance(args[1], E.Literal)
+                scale = int(args[1].value)
+            return Round(args[0], scale)
+        if name == "sqrt":
+            from spark_rapids_tpu.expr.mathexprs import Sqrt
+            return Sqrt(c(a.args[0]))
+        if name in ("floor", "ceil", "ceiling"):
+            from spark_rapids_tpu.expr import mathexprs as MM
+            cls = MM.Floor if name == "floor" else MM.Ceil
+            return cls(c(a.args[0]))
+        if name in ("row_number", "rank", "dense_rank"):
+            raise SqlAnalysisError(f"{name}() requires an OVER clause")
+        # registered UDFs (session.udf.register — RapidsUDF analog): the
+        # registry picks the device impl or the compile/worker fallback
+        reg = getattr(self.lowerer.session, "udf", None)
+        if reg is not None and name in reg:
+            return reg.build(name, [c(x) for x in a.args])
+        raise SqlAnalysisError(f"unknown function {name}")
+
+    def _window(self, a: P.FuncCall) -> E.Expression:
+        from spark_rapids_tpu.expr import windows as WX
+        spec_ast = a.over
+        inner = P.FuncCall(a.name, a.args, a.distinct, None)
+        name = a.name
+        if name == "row_number":
+            func = WX.RowNumber()
+        elif name == "rank":
+            func = WX.Rank()
+        elif name == "dense_rank":
+            func = WX.DenseRank()
+        elif name in ("lead", "lag"):
+            args = [self.convert(x) for x in a.args]
+            off = int(args[1].value) if len(args) > 1 else 1
+            default = args[2] if len(args) > 2 else None
+            cls = WX.Lead if name == "lead" else WX.Lag
+            func = cls(args[0], off, default)
+        else:
+            func = self.func(inner)
+            if not isinstance(func, AggregateFunction):
+                raise SqlAnalysisError(f"{name} is not a window function")
+        parts = tuple(self.convert(p) for p in spec_ast.partition_by)
+        orders = tuple((self.convert(e), asc,
+                        asc if nf is None else nf)
+                       for (e, asc, nf) in spec_ast.order_by)
+        if spec_ast.frame is not None:
+            ftype, lo, hi = spec_ast.frame
+            frame = WX.WindowFrame(
+                ftype,
+                None if lo is None else -lo if lo < 0 else lo,
+                None if hi is None else hi)
+        elif orders:
+            frame = WX.DEFAULT_FRAME
+        else:
+            frame = WX.FULL_FRAME     # no ORDER BY → whole partition
+        return WX.WindowExpression(func, WX.WindowSpec(parts, orders, frame))
+
+
+# -- lowering -----------------------------------------------------------------
+
+def _flatten_and(a) -> list:
+    if isinstance(a, P.BinOp) and a.op == "and":
+        return _flatten_and(a.left) + _flatten_and(a.right)
+    return [a]
+
+
+def _flatten_or(a) -> list:
+    if isinstance(a, P.BinOp) and a.op == "or":
+        return _flatten_or(a.left) + _flatten_or(a.right)
+    return [a]
+
+
+def _and_of(conjs):
+    out = conjs[0]
+    for c in conjs[1:]:
+        out = P.BinOp("and", out, c)
+    return out
+
+
+def _hoist_common_or_conjuncts(conj) -> list:
+    """(a AND x) OR (a AND y) → [a, (x OR y)] — Catalyst's common-predicate
+    extraction from disjunctions. Without it, queries like TPC-DS q48 whose
+    equi-join conditions live inside every OR branch plan as cross joins
+    (billions of rows) instead of hash joins."""
+    if not (isinstance(conj, P.BinOp) and conj.op == "or"):
+        return [conj]
+    branch_conjs = [_flatten_and(b) for b in _flatten_or(conj)]
+    common = [c for c in branch_conjs[0]
+              if all(any(c == d for d in bc) for bc in branch_conjs[1:])]
+    if not common:
+        return [conj]
+    residuals = []
+    for bc in branch_conjs:
+        rem = list(bc)
+        for c in common:
+            rem.remove(next(d for d in rem if d == c))
+        residuals.append(rem)
+    if any(not rem for rem in residuals):
+        return common    # one branch became TRUE → the OR is implied
+    ors = [_and_of(rem) for rem in residuals]
+    out = ors[0]
+    for o in ors[1:]:
+        out = P.BinOp("or", out, o)
+    return common + [out]
+
+
+def _ast_idents(a) -> list:
+    """All column identifiers in an AST expression (not descending into
+    subqueries — those resolve in their own scope)."""
+    out = []
+
+    def walk(x):
+        if isinstance(x, P.Ident):
+            out.append(x)
+        elif isinstance(x, (P.SubqueryExpr, P.ExistsAst)):
+            return
+        elif isinstance(x, P.FuncCall):
+            for ar in x.args:
+                walk(ar)
+            if x.over:
+                for p_ in x.over.partition_by:
+                    walk(p_)
+                for (e_, _, _) in x.over.order_by:
+                    walk(e_)
+        elif isinstance(x, P.BinOp):
+            walk(x.left)
+            walk(x.right)
+        elif isinstance(x, P.UnOp):
+            walk(x.operand)
+        elif isinstance(x, P.CaseAst):
+            if x.operand is not None:
+                walk(x.operand)
+            for w, v in x.branches:
+                walk(w)
+                walk(v)
+            if x.else_ is not None:
+                walk(x.else_)
+        elif isinstance(x, P.CastAst):
+            walk(x.expr)
+        elif isinstance(x, P.BetweenAst):
+            walk(x.expr)
+            walk(x.lo)
+            walk(x.hi)
+        elif isinstance(x, P.InAst):
+            walk(x.expr)
+            if isinstance(x.values, list):
+                for v in x.values:
+                    walk(v)
+        elif isinstance(x, (P.LikeAst, P.IsNullAst)):
+            walk(x.expr)
+    walk(a)
+    return out
+
+
+class _Relation:
+    """One FROM item during join planning."""
+
+    def __init__(self, plan, scope: Scope):
+        self.plan = plan
+        self.scope = scope
+
+
+class _Lowerer:
+    def __init__(self, session, views: dict):
+        self.session = session
+        self.views = dict(views)
+
+    # public: full query → plan
+    def lower(self, q: P.Select):
+        for name, cte in q.ctes:
+            self.views = dict(self.views)
+            self.views[name] = self.dataframe(cte)
+        plan = self._select(q)
+        return plan
+
+    def dataframe(self, q: P.Select):
+        from spark_rapids_tpu.session import DataFrame
+        sub = _Lowerer(self.session, self.views)
+        return DataFrame(sub.lower(q), self.session)
+
+    # -- FROM/join planning ---------------------------------------------------
+    def _base_relation(self, item) -> _Relation:
+        if isinstance(item, P.TableRef):
+            if item.name not in self.views:
+                raise SqlAnalysisError(f"table not found: {item.name}")
+            df = self.views[item.name]
+            qual = item.alias or item.name
+            return _Relation(df._plan, Scope.for_relation(df._plan, qual))
+        if isinstance(item, P.SubqueryRef):
+            df = self.dataframe(item.query)
+            return _Relation(df._plan,
+                             Scope.for_relation(df._plan, item.alias))
+        if isinstance(item, P.JoinRef):
+            return self._explicit_join(item)
+        raise SqlAnalysisError(f"unsupported FROM item {item!r}")
+
+    def _explicit_join(self, j: P.JoinRef) -> _Relation:
+        left = self._base_relation(j.left)
+        right = self._base_relation(j.right)
+        combined = left.scope.concat(right.scope)
+        how = {"semi": "leftsemi", "anti": "leftanti"}.get(j.how, j.how)
+        lkeys, rkeys, residual = [], [], []
+        if j.using:
+            for nm in j.using:
+                lkeys.append(left.scope.resolve((nm,)))
+                rkeys.append(right.scope.resolve((nm,)))
+        elif j.on is not None:
+            nl = len(left.scope.cols)
+            for conj in _flatten_and(j.on):
+                eq = self._as_equi(conj, left.scope, right.scope)
+                if eq is not None:
+                    lkeys.append(eq[0])
+                    rkeys.append(eq[1])
+                else:
+                    residual.append(
+                        _ExprConverter(combined, self).convert(conj))
+        cond = None
+        if residual:
+            cond = residual[0]
+            from spark_rapids_tpu.expr.predicates import And
+            for r in residual[1:]:
+                cond = And(cond, r)
+        if how != "inner" or not lkeys:
+            plan = NN.JoinNode(left.plan, right.plan, lkeys, rkeys,
+                               "cross" if (how == "cross" or not lkeys)
+                               else how, cond)
+        else:
+            plan = NN.JoinNode(left.plan, right.plan, lkeys, rkeys, "inner")
+            if cond is not None:
+                plan = NN.FilterNode(cond, plan)
+        scope = (left.scope if how in ("leftsemi", "leftanti")
+                 else combined)
+        return _Relation(plan, scope)
+
+    def _as_equi(self, conj, lscope: Scope, rscope: Scope):
+        """conj as (left_key, right_key) bound to each side, or None."""
+        if not (isinstance(conj, P.BinOp) and conj.op == "="):
+            return None
+        if not (isinstance(conj.left, P.Ident)
+                and isinstance(conj.right, P.Ident)):
+            return None
+        a, b = conj.left.parts, conj.right.parts
+        if len(lscope.find(a)) == 1 and len(rscope.find(b)) == 1:
+            return lscope.resolve(a), rscope.resolve(b)
+        if len(lscope.find(b)) == 1 and len(rscope.find(a)) == 1:
+            return lscope.resolve(b), rscope.resolve(a)
+        return None
+
+    def _plan_from(self, q: P.Select):
+        """Comma-list join graph → (plan, scope)."""
+        rels = [self._base_relation(item) for item in q.from_]
+        conjuncts = _flatten_and(q.where) if q.where is not None else []
+        conjuncts = [h for c in conjuncts
+                     for h in _hoist_common_or_conjuncts(c)]
+
+        # which relations does each conjunct touch? (by unique column name
+        # or qualifier match, at AST level — before any join order exists)
+        def rel_ids_of(conj):
+            ids = set()
+            for ident in _ast_idents(conj):
+                hit = None
+                for ri, rel in enumerate(rels):
+                    k = len(rel.scope.find(ident.parts))
+                    if k:
+                        if hit is not None and hit != ri:
+                            return None   # ambiguous name across relations
+                        hit = ri
+                if hit is None:
+                    return None           # e.g. select-alias reference
+                ids.add(hit)
+            return ids
+
+        single = {}      # rel id -> [conjunct]
+        edges = []       # (rid_a, rid_b, conj)
+        leftover = []
+        for conj in conjuncts:
+            ids = rel_ids_of(conj)
+            if ids is None:
+                leftover.append(conj)
+            elif len(ids) <= 1:
+                single.setdefault(ids.pop() if ids else 0, []).append(conj)
+            elif len(ids) == 2 and self._is_equi_ast(conj):
+                a, b = sorted(ids)
+                edges.append((a, b, conj))
+            else:
+                leftover.append(conj)
+
+        # push single-relation filters down before joining
+        for ri, conjs in single.items():
+            rel = rels[ri]
+            conv = _ExprConverter(rel.scope, self)
+            cond = conv.convert(conjs[0])
+            from spark_rapids_tpu.expr.predicates import And
+            for cj in conjs[1:]:
+                cond = And(cond, conv.convert(cj))
+            rel.plan = NN.FilterNode(cond, rel.plan)
+
+        # greedy join: start from the relation with the most edges (the fact
+        # table in a star query), attach connected relations first
+        n = len(rels)
+        if n == 1:
+            return rels[0].plan, rels[0].scope
+        degree = [0] * n
+        for a, b, _ in edges:
+            degree[a] += 1
+            degree[b] += 1
+        start = max(range(n), key=lambda i: degree[i])
+        joined = {start}
+        plan, scope = rels[start].plan, rels[start].scope
+        remaining_edges = list(edges)
+        while len(joined) < n:
+            # pick the next relation connected to the joined set
+            pick = None
+            for a, b, _ in remaining_edges:
+                if (a in joined) != (b in joined):
+                    pick = b if a in joined else a
+                    break
+            if pick is None:    # disconnected → cross join the next one
+                pick = next(i for i in range(n) if i not in joined)
+            rel = rels[pick]
+            lkeys, rkeys, rest = [], [], []
+            for (a, b, conj) in remaining_edges:
+                other = b if a in joined else a if b in joined else None
+                if other != pick or (a in joined and b in joined):
+                    rest.append((a, b, conj))
+                    continue
+                eq = self._as_equi_bound(conj, scope, rel.scope)
+                if eq is None:
+                    leftover.append(conj)
+                else:
+                    lkeys.append(eq[0])
+                    rkeys.append(eq[1])
+            remaining_edges = rest
+            plan = NN.JoinNode(plan, rel.plan, lkeys, rkeys,
+                               "inner" if lkeys else "cross")
+            scope = scope.concat(rel.scope)
+            joined.add(pick)
+        # edges whose both endpoints joined via another path + leftovers
+        for (a, b, conj) in remaining_edges:
+            leftover.append(conj)
+        if leftover:
+            conv = _ExprConverter(scope, self)
+            cond = conv.convert(leftover[0])
+            from spark_rapids_tpu.expr.predicates import And
+            for cj in leftover[1:]:
+                cond = And(cond, conv.convert(cj))
+            plan = NN.FilterNode(cond, plan)
+        return plan, scope
+
+    @staticmethod
+    def _is_equi_ast(conj):
+        return (isinstance(conj, P.BinOp) and conj.op == "="
+                and isinstance(conj.left, P.Ident)
+                and isinstance(conj.right, P.Ident))
+
+    def _as_equi_bound(self, conj, lscope, rscope):
+        a, b = conj.left.parts, conj.right.parts
+        if len(lscope.find(a)) == 1 and len(rscope.find(b)) == 1:
+            return lscope.resolve(a), rscope.resolve(b)
+        if len(lscope.find(b)) == 1 and len(rscope.find(a)) == 1:
+            return lscope.resolve(b), rscope.resolve(a)
+        return None
+
+    # -- SELECT block ---------------------------------------------------------
+    def _select(self, q: P.Select):
+        if q.union_all is not None:
+            right = q.union_all
+            # ORDER BY/LIMIT parsed into the right arm apply to the union
+            order_by, limit = q.order_by, q.limit
+            if right.order_by or right.limit is not None:
+                order_by = order_by or right.order_by
+                limit = limit if limit is not None else right.limit
+                right = P.Select(right.items, right.from_, right.where,
+                                 right.group_by, right.rollup, right.having,
+                                 distinct=right.distinct,
+                                 union_all=right.union_all)
+            lq = P.Select(q.items, q.from_, q.where, q.group_by, q.rollup,
+                          q.having, distinct=q.distinct)
+            plan = NN.UnionNode(self._select(lq), self._select(right))
+            if order_by:
+                plan = self._order_union(plan, order_by)
+            if limit is not None:
+                plan = NN.LimitNode(limit, plan, global_limit=True)
+            return plan
+
+        if not q.from_:
+            # SELECT <literals>: one-row relation
+            import pyarrow as pa
+            plan = NN.ScanNode([pa.table({"_one": pa.array([1])})])
+            scope = Scope.for_relation(plan, None)
+        else:
+            plan, scope = self._plan_from(q)
+
+        conv = _ExprConverter(scope, self)
+
+        # expand stars, convert select items
+        items = []       # (Expression, out_name)
+        for i, it in enumerate(q.items):
+            if isinstance(it.expr, P.Star):
+                qual = it.expr.qualifier
+                for ci, (cq, nm, dt, nb) in enumerate(scope.cols):
+                    if qual is None or (cq or "").lower() == qual.lower():
+                        items.append((E.BoundReference(ci, dt, nb, nm), nm))
+                continue
+            e = conv.convert(it.expr)
+            nm = it.alias or self._auto_name(it.expr, len(items))
+            items.append((e, nm))
+
+        having_e = conv.convert(q.having) if q.having is not None else None
+        group_es = [self._group_expr(g, conv, q, items) for g in q.group_by]
+
+        # ORDER BY handled late (over output names); convert exprs lazily
+        order_items = q.order_by
+
+        has_agg = bool(group_es) or any(
+            self._contains_agg(e) for e, _ in items) or (
+            having_e is not None and self._contains_agg(having_e))
+
+        windows = {}     # expr_key -> (WindowExpression, out_col_name)
+
+        if has_agg:
+            plan, sub = self._aggregate(plan, scope, group_es, items,
+                                        having_e, q.rollup, order_items, conv)
+            items = [(sub(e), nm) for e, nm in items]
+            having_e = sub(having_e) if having_e is not None else None
+        else:
+            def sub(e):
+                return e
+
+        # windows (post-agg): pull distinct window exprs into a WindowNode
+        win_exprs = []
+        for e, _ in items:
+            self._collect_windows(e, win_exprs)
+        if having_e is not None:
+            self._collect_windows(having_e, win_exprs)
+        if win_exprs:
+            base_n = len(plan.output)
+            named, keys = [], {}
+            for w in win_exprs:
+                k = fuse.expr_key(w)
+                if k in keys:
+                    continue
+                nm = f"_w{len(named)}"
+                keys[k] = (len(named) + base_n, nm, w.dtype)
+                named.append(E.Alias(w, nm))
+            plan = NN.WindowNode(named, plan)
+
+            def wsub(e):
+                if e is None:
+                    return None
+                k = fuse.expr_key(e)
+                if k in keys:
+                    idx, nm, dt = keys[k]
+                    return E.BoundReference(idx, dt, True, nm)
+                return e.with_children([wsub(c) for c in e.children]) \
+                    if e.children else e
+            items = [(wsub(e), nm) for e, nm in items]
+            having_e = wsub(having_e)
+            windows = keys
+        else:
+            def wsub(e):
+                return e
+
+        if having_e is not None:
+            plan = NN.FilterNode(having_e, plan)
+
+        proj = [E.Alias(e, nm) for e, nm in items]
+        plan = NN.ProjectNode(proj, plan)
+
+        if q.distinct:
+            keys = [E.col(f.name) for f in plan.output]
+            plan = NN.AggregateNode(keys, [], plan)
+
+        if order_items:
+            # output-position map: name AND substituted-expression structure
+            out_names = [nm for _, nm in items]
+            key_to_idx = {}
+            for i, (e, _) in enumerate(items):
+                key_to_idx.setdefault(fuse.expr_key(e), i)
+            sort_exprs, hidden = [], []
+            for (ast, asc, nf) in order_items:
+                nulls_first = asc if nf is None else nf
+                try:
+                    e = self._resolve_order_item(ast, plan, out_names,
+                                                 key_to_idx, conv, sub, wsub)
+                except SqlAnalysisError:
+                    # expression over the projected output (q89's
+                    # `order by sum_sales - avg_monthly_sales`): carry it as
+                    # a hidden column, sort, then drop it
+                    out_conv = _ExprConverter(
+                        Scope.for_relation(plan, None), self)
+                    e = ("hidden", out_conv.convert(ast))
+                    hidden.append(e[1])
+                sort_exprs.append((e, asc, nulls_first))
+            if hidden:
+                n0 = len(plan.output)
+                keep = [E.Alias(E.BoundReference(i, f.data_type, f.nullable,
+                                                 f.name), f.name)
+                        for i, f in enumerate(plan.output)]
+                hcols = [E.Alias(h, f"_s{i}") for i, h in enumerate(hidden)]
+                plan = NN.ProjectNode(keep + hcols, plan)
+                hidx, fixed = n0, []
+                for (e, asc, nf) in sort_exprs:
+                    if isinstance(e, tuple):
+                        f = plan.output[hidx]
+                        e = E.BoundReference(hidx, f.data_type, f.nullable,
+                                             f.name)
+                        hidx += 1
+                    fixed.append((e, asc, nf))
+                plan = NN.SortNode(fixed, plan)
+                plan = NN.ProjectNode(keep, plan)
+            else:
+                plan = NN.SortNode(sort_exprs, plan)
+        if q.limit is not None:
+            plan = NN.LimitNode(q.limit, plan, global_limit=True)
+        return plan
+
+    def _resolve_order_item(self, ast, plan, out_names, key_to_idx, conv,
+                            sub, wsub):
+        out = plan.output
+        if isinstance(ast, P.Lit) and isinstance(ast.value, int):
+            idx = ast.value - 1
+            if not (0 <= idx < len(out)):
+                raise SqlAnalysisError(
+                    f"ORDER BY position {ast.value} out of range")
+            f = out[idx]
+            return E.BoundReference(idx, f.data_type, f.nullable, f.name)
+        if isinstance(ast, P.Ident):
+            nm = ast.parts[-1].lower()
+            hits = [i for i, onm in enumerate(out_names)
+                    if onm.lower() == nm]
+            if len(hits) == 1:
+                f = out[hits[0]]
+                return E.BoundReference(hits[0], f.data_type, f.nullable,
+                                        f.name)
+        # expression: convert + substitute, then match a projected item
+        raw = wsub(sub(conv.convert(ast)))
+        k = fuse.expr_key(raw)
+        if k in key_to_idx:
+            i = key_to_idx[k]
+            f = out[i]
+            return E.BoundReference(i, f.data_type, f.nullable, f.name)
+        raise SqlAnalysisError(
+            f"ORDER BY item must reference an output column, alias, "
+            f"ordinal, or a select-list expression (got {ast!r})")
+
+    @staticmethod
+    def _auto_name(ast, i):
+        if isinstance(ast, P.Ident):
+            return ast.parts[-1]
+        if isinstance(ast, P.FuncCall):
+            return f"{ast.name}"
+        return f"col{i}"
+
+    def _group_expr(self, g, conv, q, items):
+        # GROUP BY <ordinal> / <select alias> / <expr>
+        if isinstance(g, P.Lit) and isinstance(g.value, int):
+            idx = g.value - 1
+            if not (0 <= idx < len(items)):
+                raise SqlAnalysisError(f"GROUP BY position {g.value} "
+                                       "out of range")
+            return items[idx][0]
+        if isinstance(g, P.Ident) and len(g.parts) == 1:
+            try:
+                return conv.convert(g)
+            except SqlAnalysisError:
+                for e, nm in items:
+                    if nm.lower() == g.parts[0].lower():
+                        return e
+                raise
+        return conv.convert(g)
+
+    @staticmethod
+    def _contains_agg(e) -> bool:
+        from spark_rapids_tpu.expr.windows import WindowExpression
+        if isinstance(e, AggregateFunction):
+            return True
+        if isinstance(e, WindowExpression):
+            # aggregate INPUTS to a window count (avg(sum(x)) over ...);
+            # the window function itself does not
+            return any(_Lowerer._contains_agg(c) for c in e.children)
+        return any(_Lowerer._contains_agg(c) for c in e.children)
+
+    @staticmethod
+    def _collect_windows(e, out: list):
+        from spark_rapids_tpu.expr.windows import WindowExpression
+        if isinstance(e, WindowExpression):
+            out.append(e)
+            return
+        for c in e.children:
+            _Lowerer._collect_windows(c, out)
+
+    def _aggregate(self, plan, scope, group_es, items, having_e, rollup,
+                   order_items, conv):
+        """Build (Expand→)Aggregate; return (plan, substitution fn)."""
+        from spark_rapids_tpu.expr.windows import WindowExpression
+
+        # collect distinct aggregates from every post-agg expression
+        aggs = []        # [(key, AggregateFunction)]
+        seen = {}
+
+        def collect(e):
+            if isinstance(e, AggregateFunction):
+                k = fuse.expr_key(e)
+                if k not in seen:
+                    seen[k] = len(aggs)
+                    aggs.append((k, e))
+                return
+            for c in e.children:
+                collect(c)
+
+        for e, _ in items:
+            collect(e)
+        if having_e is not None:
+            collect(having_e)
+        # ORDER BY expressions may reference aggregates textually
+        order_bound = []
+        for (ast, asc, nf) in (order_items or []):
+            try:
+                order_bound.append(conv.convert(ast))
+            except SqlAnalysisError:
+                order_bound.append(None)   # alias/ordinal — resolved later
+        for ob in order_bound:
+            if ob is not None:
+                collect(ob)
+
+        gid_ref = None
+        if rollup:
+            plan, group_refs, gid_ref = self._expand_rollup(plan, group_es)
+            group_bound = group_refs + [gid_ref]
+        else:
+            group_bound = list(group_es)
+
+        agg_named = [E.Alias(a, f"_a{i}") for i, (_, a) in enumerate(aggs)]
+        agg_node = NN.AggregateNode(group_bound, agg_named, plan)
+        out = agg_node.output
+        n_group = len(group_bound)
+
+        group_keys = {fuse.expr_key(g): i for i, g in enumerate(group_es)}
+
+        def sub(e):
+            if e is None:
+                return None
+            if isinstance(e, _Grouping):
+                if gid_ref is None:
+                    raise SqlAnalysisError(
+                        "grouping() outside GROUP BY ROLLUP")
+                return self._grouping_bit(e, group_es, n_group, out)
+            k = fuse.expr_key(e)
+            if isinstance(e, AggregateFunction) and k in seen:
+                i = seen[k]
+                f = out[n_group + i]
+                return E.BoundReference(n_group + i, f.data_type, True,
+                                        f.name)
+            if k in group_keys:
+                i = group_keys[k]
+                f = out[i]
+                return E.BoundReference(i, f.data_type, f.nullable, f.name)
+            if isinstance(e, WindowExpression):
+                return e.with_children([sub(c) for c in e.children])
+            if e.children:
+                return e.with_children([sub(c) for c in e.children])
+            if isinstance(e, (E.BoundReference, E.AttributeReference)):
+                raise SqlAnalysisError(
+                    f"column {e!r} is neither grouped nor aggregated")
+            return e
+        return agg_node, sub
+
+    def _grouping_bit(self, g: _Grouping, group_es, n_group, out_schema):
+        """grouping(col) → (gid >> bit) & 1 over the aggregate output's
+        grouping-id column (Spark semantics: leftmost group col = MSB)."""
+        from spark_rapids_tpu.expr.arithmetic import BitwiseAnd, ShiftRight
+        from spark_rapids_tpu.expr.cast import Cast
+        target = fuse.expr_key(g.children[0])
+        pos = None
+        for i, ge in enumerate(group_es):
+            if fuse.expr_key(ge) == target:
+                pos = i
+                break
+        if pos is None:
+            raise SqlAnalysisError("grouping() argument must be a GROUP BY "
+                                   "column")
+        gid_idx = n_group - 1     # gid is the last group column
+        f = out_schema[gid_idx]
+        gid = E.BoundReference(gid_idx, f.data_type, False, f.name)
+        bit = len(group_es) - 1 - pos
+        shifted = ShiftRight(gid, E.Literal(bit)) if bit else gid
+        return Cast(BitwiseAnd(shifted, E.Literal(1)), T.INT)
+
+    def _expand_rollup(self, plan, group_es):
+        """ExpandNode projecting one copy of the input per rollup level with
+        nulled-out suffix group columns + a grouping-id literal (Spark's
+        Expand lowering of rollup; reference GpuExpandExec role)."""
+        child_fields = list(plan.output.fields)
+        n = len(group_es)
+        for g in group_es:
+            if not isinstance(g, (E.BoundReference, E.AttributeReference)):
+                raise SqlAnalysisError(
+                    "GROUP BY ROLLUP supports plain columns only")
+        projections = []
+        for level in range(n, -1, -1):      # n..0 kept prefix columns
+            gid = (1 << (n - level)) - 1
+            proj = [E.BoundReference(i, f.data_type, f.nullable, f.name)
+                    for i, f in enumerate(child_fields)]
+            for gi, g in enumerate(group_es):
+                proj.append(g if gi < level
+                            else E.Literal(None, g.dtype))
+            proj.append(E.Literal(gid, T.INT))
+            projections.append(proj)
+        out_fields = child_fields + [
+            T.StructField(f"_g{i}", g.dtype, True)
+            for i, g in enumerate(group_es)
+        ] + [T.StructField("_gid", T.INT, False)]
+        expand = NN.ExpandNode(projections, out_fields, plan)
+        base = len(child_fields)
+        group_refs = [E.BoundReference(base + i, g.dtype, True, self._gname(g))
+                      for i, g in enumerate(group_es)]
+        gid_ref = E.BoundReference(base + n, T.INT, False, "_gid")
+        return expand, group_refs, gid_ref
+
+    @staticmethod
+    def _gname(g):
+        return getattr(g, "name", None) or "g"
+
+    # -- ORDER BY over a union (names/ordinals only) --------------------------
+    def _order_union(self, plan, order_items):
+        sort_exprs = []
+        for (ast, asc, nf) in order_items:
+            nulls_first = asc if nf is None else nf
+            if isinstance(ast, P.Lit) and isinstance(ast.value, int):
+                idx = ast.value - 1
+            elif isinstance(ast, P.Ident) and len(ast.parts) == 1:
+                idx = plan.output.index_of(ast.parts[-1])
+            else:
+                raise SqlAnalysisError(
+                    "ORDER BY over UNION ALL supports output names/ordinals "
+                    f"only (got {ast!r})")
+            f = plan.output[idx]
+            sort_exprs.append((E.BoundReference(idx, f.data_type, f.nullable,
+                                                f.name), asc, nulls_first))
+        return NN.SortNode(sort_exprs, plan)
+
+
+def lower_sql(text: str, views: dict, session):
+    """Parse + lower `text` against `views` ({name: DataFrame})."""
+    q = P.parse_sql(text)
+    return _Lowerer(session, views).lower(q)

@@ -1,0 +1,49 @@
+"""Official TPC-DS query text through session.sql() vs the same NumPy
+oracles as the hand-built DataFrame suite (VERDICT r3 item 4: the reference
+is a Spark *SQL* plugin — qa_nightly_sql.py — so the SQL surface must run the
+official text, not hand translations)."""
+
+import pytest
+
+from spark_rapids_tpu.benchmarks import tpcds
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.sql.tpcds_queries import SQL_QUERIES
+
+
+@pytest.fixture(scope="module")
+def data(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tpcds_sql")
+    paths = tpcds.generate(0.012, str(d))
+    spark = TpuSession()
+    dfs = tpcds.load(spark, paths)   # registers temp views for session.sql
+    return spark, tpcds.load_np(paths)
+
+
+def _rows(df):
+    return [tuple(r.values()) for r in df.collect().to_pylist()]
+
+
+@pytest.mark.parametrize("name", sorted(SQL_QUERIES, key=lambda q: int(q[1:])))
+def test_sql_query_matches_oracle(data, name):
+    spark, tb = data
+    got = _rows(spark.sql(SQL_QUERIES[name]))
+    if name == "q27":
+        # official rollup shape (the DataFrame adaptation omits the rollup
+        # levels); g_state column shifts the float slots right by one
+        exp = [tuple(r) for r in tpcds.np_q27_rollup(tb)]
+        float_cols = {3, 4, 5, 6}
+    else:
+        exp = [tuple(r) for r in tpcds.NP_QUERIES[name](tb)]
+        float_cols = tpcds.FLOAT_COLS[name]
+    assert exp, "vacuous test: oracle returned no rows"
+    tpcds.check_rows(got, exp, float_cols)
+
+
+def test_sql_q3_matches_handbuilt(data):
+    """VERDICT r3 item 4's explicit 'done' check: session.sql(official q3)
+    returns the same oracle-checked rows as the hand-built q3."""
+    spark, tb = data
+    got_sql = _rows(spark.sql(SQL_QUERIES["q3"]))
+    dfs = {name: spark._views[name] for name in spark._views}
+    got_df = _rows(tpcds.QUERIES["q3"](dfs))
+    assert got_sql == got_df
