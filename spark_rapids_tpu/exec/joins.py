@@ -99,29 +99,133 @@ class _JoinCore:
         self.fast = (len(self.build_keys_raw) == 1
                      and _int_backed(self.build_keys_raw[0].dtype))
         if self.fast:
+            self._prep_fast_build()
+
+    def _prep_fast_build(self):
+        """Sort the single int build key once. Strategy picked from the key
+        RANGE (one cheap reduction + host sync per build, like the
+        reference's one-time build-table materialization):
+
+        - range fits the packed budget → ONE-operand int64 sort of
+          ((val - vmin) << idx_bits | row_idx); ~8x cheaper than the
+          3-operand comparator sort (docs/perf_notes.md fix-3 measurement).
+        - afterwards, uniqueness + compact domain decide the probe mode:
+          dense direct-address rank table (O(1) gather per stream row),
+          unique single-searchsorted, or the general two-searchsorted."""
+        from spark_rapids_tpu.runtime import fuse
+        import numpy as np
+        k = self.build_keys_raw[0]
+        cap = k.values.shape[0]
+        idx_bits = max(int(cap - 1).bit_length(), 1)
+
+        def stats(k, n_build):
+            vals = k.values.astype(jnp.int8) if k.values.dtype == jnp.bool_ \
+                else k.values
+            eligible = k.validity & (jnp.arange(cap, dtype=jnp.int32) < n_build)
+            big = jnp.asarray(jnp.iinfo(vals.dtype).max, vals.dtype)
+            small = jnp.asarray(jnp.iinfo(vals.dtype).min, vals.dtype)
+            vmin = jnp.min(jnp.where(eligible, vals, big))
+            vmax = jnp.max(jnp.where(eligible, vals, small))
+            return (vmin.astype(jnp.int64), vmax.astype(jnp.int64),
+                    jnp.sum(eligible, dtype=jnp.int32))
+
+        skey = ("join_build_stats", k.dtype, cap)
+        n_build_t = jnp.asarray(self.n_build, jnp.int32)
+        vmin_t, vmax_t, n_valid = fuse.call_fused(
+            skey, "HashJoin.build_stats", lambda: stats, (k, n_build_t),
+            lambda: stats(k, n_build_t))
+        vmin, vmax = int(vmin_t), int(vmax_t)    # one host sync per build
+        rng = max(vmax - vmin, 0)
+        # vmax+1 (the ineligible-row sentinel) must stay representable in
+        # int64 — the packed path keeps sorted keys as int64 precisely so a
+        # dtype-max key can never collide with/overflow into the sentinel
+        packable = (self.n_build > 0 and rng < (1 << (62 - idx_bits))
+                    and vmax < (1 << 62))
+
+        if packable:
+            def prep(k, n_build, vmin):
+                vals = k.values.astype(jnp.int8) \
+                    if k.values.dtype == jnp.bool_ else k.values
+                eligible = k.validity & (
+                    jnp.arange(cap, dtype=jnp.int32) < n_build)
+                rel = (vals.astype(jnp.int64) - vmin)
+                # ineligible rows above every real key (rng+1 relative)
+                rel = jnp.where(eligible, rel, jnp.asarray(rng + 1, jnp.int64))
+                packed = (rel << idx_bits) | jnp.arange(cap, dtype=jnp.int64)
+                s = jax.lax.sort(packed)
+                perm = (s & ((1 << idx_bits) - 1)).astype(jnp.int32)
+                # int64 ON PURPOSE: casting back to the key dtype would wrap
+                # the vmax+1 sentinel tail to INT_MIN when vmax == dtype max,
+                # breaking the sortedness searchsorted depends on (probe
+                # promotes both sides to a common type anyway)
+                sorted_vals = (s >> idx_bits) + vmin
+                nv = jnp.sum(eligible, dtype=jnp.int32)
+                same = (s[1:] >> idx_bits) == (s[:-1] >> idx_bits)
+                in_valid = (jnp.arange(cap - 1, dtype=jnp.int32) + 1) < nv
+                unique = ~jnp.any(same & in_valid)
+                return sorted_vals, perm, unique
+
+            pkey = ("join_build_pack", k.dtype, cap, idx_bits, rng + 1)
+            args = (k, n_build_t, jnp.asarray(vmin, jnp.int64))
+            self._sorted_build, self._build_perm, uniq_t = fuse.call_fused(
+                pkey, "HashJoin.build_prep", lambda: prep, args,
+                lambda: prep(*args))
+        else:
             def prep(k, n_build):
-                cap = k.values.shape[0]
-                vals = k.values.astype(jnp.int8) if k.values.dtype == jnp.bool_ \
-                    else k.values
-                eligible = k.validity & (jnp.arange(cap, dtype=jnp.int32) < n_build)
+                vals = k.values.astype(jnp.int8) \
+                    if k.values.dtype == jnp.bool_ else k.values
+                eligible = k.validity & (
+                    jnp.arange(cap, dtype=jnp.int32) < n_build)
                 masked = jnp.where(
                     eligible, vals,
                     jnp.asarray(jnp.iinfo(vals.dtype).max, vals.dtype))
                 # two sort keys: eligibility first so a LEGITIMATE max-valued
-                # key always lands inside [0, n_valid) even against the
-                # sentinel tail; the array stays globally sorted by `masked`
+                # key still lands inside [0, n_valid) against the sentinel
                 _, sorted_vals, perm = jax.lax.sort(
                     [(~eligible).astype(jnp.int8), masked,
                      jnp.arange(cap, dtype=jnp.int32)], num_keys=2)
-                n_valid = jnp.sum(eligible, dtype=jnp.int32)
-                return sorted_vals, perm, n_valid
+                nv = jnp.sum(eligible, dtype=jnp.int32)
+                same = sorted_vals[1:] == sorted_vals[:-1]
+                in_valid = (jnp.arange(cap - 1, dtype=jnp.int32) + 1) < nv
+                unique = ~jnp.any(same & in_valid)
+                return sorted_vals, perm, unique
 
-            key = ("join_build_prep", self.build_keys_raw[0].dtype)
-            args = (self.build_keys_raw[0],
-                    jnp.asarray(self.n_build, jnp.int32))
-            self._sorted_build, self._build_perm, self._n_valid = \
-                fuse.call_fused(key, "HashJoin.build_prep", lambda: prep, args,
-                                lambda: prep(*args))
+            key = ("join_build_prep", k.dtype, cap)
+            args = (k, n_build_t)
+            self._sorted_build, self._build_perm, uniq_t = fuse.call_fused(
+                key, "HashJoin.build_prep", lambda: prep, args,
+                lambda: prep(*args))
+        self._n_valid = n_valid
+        # probe-mode choice — static per compiled probe kernel
+        self._vmin = vmin
+        unique = bool(uniq_t) if self.n_build > 0 else True
+        dsize = rng + 2 if self.n_build > 0 else 1
+        self._probe_mode = "two"
+        if unique and self.build_matched_acc is None:
+            self._probe_mode = "one"
+            if dsize <= max(4 * cap, 1 << 22) and jax.devices()[0].platform \
+                    != "tpu":
+                # direct-address rank table: scatter once per build, O(1)
+                # gather per probe row (kept off-TPU: large 1:1 scatters
+                # serialize there; searchsorted stays the TPU path)
+                self._probe_mode = "dense"
+                self._dense_size = dsize
+
+                def mktable(sorted_vals, n_valid, vmin):
+                    i = jnp.arange(cap, dtype=jnp.int32)
+                    slot = jnp.where(
+                        i < n_valid,
+                        sorted_vals.astype(jnp.int64) - vmin,
+                        jnp.asarray(dsize, jnp.int64))   # tail → dropped
+                    table = jnp.full((dsize,), -1, jnp.int32)
+                    return table.at[slot].set(i, mode="drop")
+
+                tkey = ("join_dense_table", k.dtype, cap, dsize)
+                targs = (self._sorted_build, n_valid,
+                         jnp.asarray(vmin, jnp.int64))
+                self._dense_table = fuse.call_fused(
+                    tkey, "HashJoin.dense_table", lambda: mktable, targs,
+                    lambda: mktable(*targs))
 
     def probe_batch(self, stream_batch: ColumnarBatch):
         from spark_rapids_tpu.runtime import fuse
@@ -190,13 +294,18 @@ class _JoinCore:
         return build_perm, lo, hi, counts, total
 
     def _probe_batch_fast(self, stream_batch, jt, track_matched):
-        """Pre-sorted-build probe: eval stream key, two searchsorted calls,
-        clamp to the valid-build prefix. O(n log n_build) compares, no sort."""
+        """Pre-sorted-build probe. Modes (chosen at build, static per compiled
+        kernel): "dense" = O(1) direct-address rank-table gather (unique keys,
+        compact domain); "one" = single searchsorted + equality (unique keys);
+        "two" = general left+right searchsorted."""
         from spark_rapids_tpu.runtime import fuse
         stream_key_exprs = self.stream_key_exprs
+        mode = self._probe_mode
+        vmin = self._vmin
+        dsize = getattr(self, "_dense_size", 0)
 
         def kernel(sorted_build, n_valid, n_build, build_keys_raw, stream_cols,
-                   n_stream):
+                   n_stream, dense_table):
             scap = stream_cols[0].values.shape[0]
             sctx = EvalContext(stream_cols, n_stream, scap)
             k = stream_key_exprs[0].eval(sctx)
@@ -210,14 +319,30 @@ class _JoinCore:
             common = jnp.promote_types(svals.dtype, sorted_build.dtype)
             svals = svals.astype(common)
             sorted_common = sorted_build.astype(common)
-            lo = jnp.minimum(
-                jnp.searchsorted(sorted_common, svals, side="left"), n_valid
-            ).astype(jnp.int32)
-            hi = jnp.minimum(
-                jnp.searchsorted(sorted_common, svals, side="right"), n_valid
-            ).astype(jnp.int32)
             live = jnp.arange(scap, dtype=jnp.int32) < n_stream
-            hi = jnp.where(k.validity & live, hi, lo)
+            if mode == "dense":
+                slot = svals.astype(jnp.int64) - vmin
+                in_dom = (slot >= 0) & (slot < dsize - 1)
+                r = dense_table[jnp.clip(slot, 0, dsize - 1)]
+                hit = in_dom & (r >= 0) & k.validity & live
+                lo = jnp.where(hit, r, 0).astype(jnp.int32)
+                hi = jnp.where(hit, r + 1, lo).astype(jnp.int32)
+            elif mode == "one":
+                bcap_ = sorted_common.shape[0]
+                lo = jnp.minimum(
+                    jnp.searchsorted(sorted_common, svals, side="left"),
+                    n_valid).astype(jnp.int32)
+                found = (sorted_common[jnp.clip(lo, 0, bcap_ - 1)] == svals) \
+                    & (lo < n_valid) & k.validity & live
+                hi = jnp.where(found, lo + 1, lo).astype(jnp.int32)
+            else:
+                lo = jnp.minimum(
+                    jnp.searchsorted(sorted_common, svals, side="left"),
+                    n_valid).astype(jnp.int32)
+                hi = jnp.minimum(
+                    jnp.searchsorted(sorted_common, svals, side="right"),
+                    n_valid).astype(jnp.int32)
+                hi = jnp.where(k.validity & live, hi, lo)
             counts = J.pair_counts(lo, hi, n_stream, scap, jt)
             total = J.total_pairs(counts)
             if track_matched:
@@ -243,14 +368,21 @@ class _JoinCore:
                 return lo, hi, counts, total, (bhi > blo) & b_eligible
             return lo, hi, counts, total, None
 
-        key = ("join_probe_fast", jt, track_matched, self._stream_key_key,
+        # vmin/dsize are traced into the program only in dense mode; keying
+        # them otherwise would recompile per distinct build key range
+        key = ("join_probe_fast", jt, track_matched, mode,
+               vmin if mode == "dense" else None,
+               dsize if mode == "dense" else None,
+               self._stream_key_key,
                fuse.schema_key(stream_batch.schema)
                if stream_batch.schema else None)
         stream_cols = [Col.from_vector(c) for c in stream_batch.columns]
         n_stream = jnp.asarray(stream_batch.lazy_num_rows, jnp.int32)
+        dense = (self._dense_table if mode == "dense"
+                 else jnp.zeros((1,), jnp.int32))
         args = (self._sorted_build, self._n_valid,
                 jnp.asarray(self.n_build, jnp.int32), self.build_keys_raw,
-                stream_cols, n_stream)
+                stream_cols, n_stream, dense)
         lo, hi, counts, total, matched = fuse.call_fused(
             key, "HashJoin.probe", lambda: kernel, args,
             lambda: kernel(*args))

@@ -389,6 +389,20 @@ def chunk_to_device(pages: ChunkPages, spark_type, capacity: int):
         dict_dev = jnp.asarray(np.asarray(pages.dict_values))
     from spark_rapids_tpu.columnar.vector import bucket_capacity
 
+    # fast path: ONE data page, all-packed index segments → a single fused
+    # program (unpack + dict gather + null spread + canonicalize). The eager
+    # per-page pipeline below cost ~25 XLA dispatches per chunk — at TPC-H
+    # scan width that dominated hot-query wall time (docs/perf_notes.md r4).
+    if len(pages.index_segments) == 1:
+        (num_values, def_levels, bw, page_bytes, values_off, segs) = \
+            pages.index_segments[0]
+        if segs and all(s.kind == "packed" for s in segs):
+            packed = b"".join(page_bytes[s.byte_off:s.byte_off + s.byte_len]
+                              for s in segs)
+            return _decode_single_page_fused(
+                packed, bw, def_levels, dict_dev, num_values, capacity,
+                pages, spark_type, sorted_dict)
+
     all_vals, all_valid = [], []
     for (num_values, def_levels, bw, page_bytes, values_off, segs) in \
             pages.index_segments:
@@ -438,6 +452,73 @@ def chunk_to_device(pages: ChunkPages, spark_type, capacity: int):
     default = jnp.asarray(st.default_value(), out_v.dtype)
     out_v = jnp.where(out_m, out_v, default)
     return TpuColumnVector(st, out_v, out_m)
+
+
+def _decode_single_page_fused(packed: bytes, bw: int, def_levels, dict_dev,
+                              num_values: int, capacity: int, pages,
+                              spark_type, sorted_dict):
+    """One jitted program per (bit width, shape bucket, output type):
+    bit-unpack → dictionary gather → definition-level spread → canonical
+    nulls. Cached via the fuse kernel cache like every exec stage."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar.vector import (TpuColumnVector,
+                                                  bucket_capacity)
+    from spark_rapids_tpu.ops import parquet_decode as PD
+    from spark_rapids_tpu.ops import pallas_kernels as PK
+    from spark_rapids_tpu.runtime import fuse
+
+    is_string = pages.physical_type == "BYTE_ARRAY"
+    n_present = int(def_levels.sum())
+    pcap = max(bucket_capacity(max(n_present, 1)), 8)
+    bcap = max(bucket_capacity(max(len(packed), 1)), 8)
+    use_pallas = PK.should_use()     # probe OUTSIDE the traced program
+
+    np_to_spark = {"INT32": T.INT, "INT64": T.LONG,
+                   "FLOAT": T.FLOAT, "DOUBLE": T.DOUBLE}
+    st = T.STRING if is_string else (spark_type
+                                     or np_to_spark[pages.physical_type])
+    want = jnp.int32 if is_string else jnp.dtype(st.jnp_dtype)
+    default = 0 if is_string else st.default_value()
+
+    def kernel(packed_d, dict_d, dl_d, n_present_t, n_t):
+        if use_pallas:
+            # pallas tile shapes need the STATIC present count (closed over;
+            # it is part of the cache key below)
+            idx = PK.bitunpack128(packed_d, bw, n_present, pcap)
+        else:
+            idx = PD.unpack_bits_device(packed_d, bw, n_present_t, pcap)
+        nd = dict_d.shape[0]
+        present = dict_d[jnp.clip(idx, 0, max(nd - 1, 0))]
+        present_padded = jnp.zeros((capacity,), present.dtype
+                                   ).at[:min(pcap, capacity)].set(
+            present[:capacity])
+        vals, valid = PD.expand_present_to_rows(present_padded, dl_d,
+                                                capacity)
+        live = jnp.arange(capacity, dtype=jnp.int32) < n_t
+        m = valid & live
+        v = jnp.where(m, vals.astype(want), jnp.asarray(default, want))
+        return v, m
+
+    key = ("pq_page_decode", bw, pcap, bcap, capacity, str(want),
+           is_string, use_pallas, n_present if use_pallas else None)
+    if use_pallas:
+        words = PK.bytes_to_words_u32(np.frombuffer(packed, np.uint8))
+        packed_in = jnp.asarray(words)
+    else:
+        ph = np.zeros(bcap, np.uint8)
+        ph[:len(packed)] = np.frombuffer(packed, np.uint8)
+        packed_in = jnp.asarray(ph)
+    dh = np.zeros(capacity, bool)
+    nd_lv = min(len(def_levels), capacity)
+    dh[:nd_lv] = def_levels[:nd_lv].astype(bool)
+    n = min(num_values, pages.num_values, capacity)
+    args = (packed_in, dict_dev, jnp.asarray(dh),
+            jnp.asarray(n_present, jnp.int32), jnp.asarray(n, jnp.int32))
+    v, m = fuse.call_fused(key, "ParquetScan.decode", lambda: kernel, args,
+                           lambda: kernel(*args))
+    cv = TpuColumnVector(st, v, m)
+    return cv.with_dictionary(sorted_dict) if is_string else cv
 
 
 def read_row_group_device(path: str, row_group: int, schema,

@@ -32,6 +32,14 @@ import jax
 _lock = threading.Lock()
 _kernels: dict = {}
 _MAX_KERNELS = 2048
+# XLA:CPU's LLVM JIT owns a bounded code-memory region; ~3000 live
+# executables exhaust it and later compiles fail with "LLVM compilation
+# error: Cannot allocate memory" or SEGFAULT inside backend_compile_and_load
+# (measured on this box, docs/perf_notes.md r4). A kernel holds one
+# executable PER SHAPE SIGNATURE, so the backstop must budget executables,
+# not kernel objects.
+_MAX_EXECUTABLES = 900
+_inserts = 0
 
 # counters are module-global (queries share kernels); reset via reset_metrics()
 _counts = {"traces": 0, "dispatches": 0}
@@ -81,6 +89,13 @@ class BatchKernel:
 
         self._jit = jax.jit(traced)
 
+    def cache_size(self) -> int:
+        """Live compiled-executable count (one per traced shape signature)."""
+        try:
+            return max(int(self._jit._cache_size()), 1)
+        except Exception:
+            return 1
+
     def __call__(self, *args):
         with _lock:
             _counts["dispatches"] += 1
@@ -100,15 +115,31 @@ def get_kernel(key, name: str, build) -> BatchKernel:
     """Fetch-or-create the kernel for semantic key `key`. `build()` returns the
     pure per-batch function (it may close over expression trees — the key must
     capture everything that affects the traced program)."""
+    global _inserts
     with _lock:
         k = _kernels.get(key)
     if k is not None:
         return k
     k = BatchKernel(build(), name)
+    evicted = []
     with _lock:
-        if len(_kernels) >= _MAX_KERNELS:   # runaway-plan backstop
-            _kernels.clear()
-        return _kernels.setdefault(key, k)
+        _inserts += 1
+        if len(_kernels) >= _MAX_KERNELS or _inserts % 32 == 0:
+            total = sum(kk.cache_size() for kk in _kernels.values()
+                        if isinstance(kk, BatchKernel))   # skip _EAGER
+            if total > _MAX_EXECUTABLES or len(_kernels) >= _MAX_KERNELS:
+                # evict oldest (insertion order) until comfortably under
+                # budget; anything hot re-traces on next use
+                order = list(_kernels)
+                while order and (total > int(_MAX_EXECUTABLES * 0.75)
+                                 or len(_kernels) >= _MAX_KERNELS):
+                    victim = _kernels.pop(order.pop(0))
+                    if isinstance(victim, BatchKernel):
+                        total -= victim.cache_size()
+                        evicted.append(victim)
+        out = _kernels.setdefault(key, k)
+    del evicted   # destructors run outside the lock
+    return out
 
 
 def clear_kernels():

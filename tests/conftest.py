@@ -23,6 +23,20 @@ import pyarrow as pa  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _bound_xla_code_memory():
+    """Free compiled executables between test modules. XLA:CPU's LLVM JIT
+    code memory is bounded: ~3000 live executables in one process make later
+    compiles abort/segfault (docs/perf_notes.md round-4 finding). The engine
+    budgets its own fuse kernels; this drops everything else tests compile."""
+    yield
+    import gc
+    from spark_rapids_tpu.runtime import fuse
+    fuse.clear_kernels()
+    jax.clear_caches()
+    gc.collect()
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

@@ -313,3 +313,36 @@ def test_mixed_width_key_join_wide_build():
                      ArrowScanExec([lt], conf=conf), ArrowScanExec([rt], conf=conf))
     got = j.execute_collect()
     assert sorted(zip(got["lv"].to_pylist(), got["rv"].to_pylist())) == [(0, 1), (1, 2)]
+
+
+@pytest.mark.parametrize("how", ["inner", "leftouter"])
+def test_dtype_max_key_fast_path(how):
+    """A legitimate dtype-max key must keep matching on the packed fast path
+    (the ineligible-row sentinel is vmax+1 — kept in int64 so it can never
+    wrap into/collide with a real key)."""
+    import numpy as np
+    m32 = np.iinfo(np.int32).max
+    lt = pa.table({"lk": pa.array([m32, m32 - 1, 5, None], pa.int32()),
+                   "lv": pa.array(range(4), type=pa.int32())})
+    rt = pa.table({"rk": pa.array([m32, m32, 7], pa.int32()),
+                   "rv": pa.array(range(3), type=pa.int32())})
+    conf = RapidsConf()
+    j = HashJoinExec(how, [col("lk")], [col("rk")],
+                     ArrowScanExec([lt], conf=conf),
+                     ArrowScanExec([rt], conf=conf))
+    got = j.execute_collect()
+    want = host_join(lt, rt, "lk", "rk", how)
+    assert got.num_rows == want.num_rows, (got.to_pylist(), want.to_pylist())
+    assert sorted(got["lv"].to_pylist()) == sorted(want["lv"].to_pylist())
+    # and with int64 keys at the int64 max (packed path must refuse/stay safe)
+    m64 = np.iinfo(np.int64).max
+    lt64 = pa.table({"lk": pa.array([m64, 5], pa.int64()),
+                     "lv": pa.array([0, 1], pa.int32())})
+    rt64 = pa.table({"rk": pa.array([m64], pa.int64()),
+                     "rv": pa.array([9], pa.int32())})
+    j2 = HashJoinExec(how, [col("lk")], [col("rk")],
+                      ArrowScanExec([lt64], conf=conf),
+                      ArrowScanExec([rt64], conf=conf))
+    got2 = j2.execute_collect()
+    want2 = host_join(lt64, rt64, "lk", "rk", how)
+    assert got2.num_rows == want2.num_rows
