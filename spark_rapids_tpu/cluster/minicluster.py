@@ -24,9 +24,12 @@ Execution model:
   (TpuOverrides) never inserts its own exchanges;
 - the final (result) stage returns Arrow IPC bytes to the driver.
 
-Scope note: stages whose inputs are not co-partitioned (e.g. a UNION mixing
-a scan leaf with a shuffle source) run as one task with unpinned sources —
-correct (the task redistributes locally) but not parallel across executors.
+Fault tolerance: a dead executor (broken pipe / EOF on its channel, or a task
+failing with a transport error against a dead peer) raises ExecutorLostError;
+the driver HEALS the pool (respawns the slot with a fresh block server) and
+re-runs the query's stages from the start with fresh shuffle ids — the
+standalone, coarser-grained form of Spark's FetchFailed → lineage recompute
+(reference RapidsShuffleIterator.scala:82,153), bounded by max_attempts.
 """
 
 from __future__ import annotations
@@ -161,39 +164,80 @@ def _has_non_source_leaves(plan):
     return any(_has_non_source_leaves(c) for c in plan.children)
 
 
+class ExecutorLostError(RuntimeError):
+    """An executor process died (channel broke) or a task failed against a
+    dead shuffle peer; the driver heals the pool and retries the query."""
+
+
 class MiniCluster:
     """Driver for N executor processes; `collect(df)` runs the DataFrame's
     plan across them (DAGScheduler + cluster-manager stand-in)."""
 
-    def __init__(self, n_executors: int = 2, conf=None, platform: str = "cpu"):
+    def __init__(self, n_executors: int = 2, conf=None, platform: str = "cpu",
+                 max_attempts: int = 3):
         from spark_rapids_tpu.config import RapidsConf
         self.conf = conf or RapidsConf()
         self.n_executors = n_executors
+        self.max_attempts = max_attempts
+        self._platform = platform
         self._shuffle_ids = itertools.count(1000)
-        ctx = mp.get_context("spawn")
-        self._conns, self._procs, self.addresses = [], [], []
-        for _ in range(n_executors):
-            parent, child = ctx.Pipe()
-            p = ctx.Process(target=_executor_main,
-                            args=(child, platform, dict(self.conf.settings)),
-                            daemon=True)
-            p.start()
-            hello = parent.recv()
-            assert hello["op"] == "ready"
-            self._conns.append(parent)
-            self._procs.append(p)
-            self.addresses.append(("127.0.0.1", hello["port"]))
+        self._conns = [None] * n_executors
+        self._procs = [None] * n_executors
+        self.addresses = [None] * n_executors
+        for ei in range(n_executors):
+            self._spawn_executor(ei)
         self._rr = itertools.cycle(range(n_executors))
+        self.task_log: list = []        # (stage_op, executor_idx) per task
+        self._after_stage_hook = None   # test fault-injection point
+
+    def _spawn_executor(self, ei: int):
+        ctx = mp.get_context("spawn")
+        parent, child = ctx.Pipe()
+        p = ctx.Process(target=_executor_main,
+                        args=(child, self._platform,
+                              dict(self.conf.settings)),
+                        daemon=True)
+        p.start()
+        hello = parent.recv()
+        assert hello["op"] == "ready"
+        self._conns[ei] = parent
+        self._procs[ei] = p
+        self.addresses[ei] = ("127.0.0.1", hello["port"])
+
+    def _heal(self):
+        """Restart the WHOLE pool. Survivors may hold in-flight tasks whose
+        replies would desynchronize the request/reply pipe protocol on
+        retry (a stale ok=True task reply would be consumed as the next
+        ensure_shuffle ack); since the retry re-runs every stage anyway,
+        clean processes are both simpler and correct (Spark's
+        executor-replacement role)."""
+        for ei, p in enumerate(self._procs):
+            try:
+                self._conns[ei].close()
+            except OSError:
+                pass
+            if p is not None:
+                if p.is_alive():
+                    p.terminate()
+                p.join(timeout=5)
+                if p.is_alive():
+                    p.kill()
+                    p.join(timeout=5)
+            self._spawn_executor(ei)
 
     # -- task plumbing ------------------------------------------------------
     def _dispatch(self, jobs):
         """jobs: list of (executor_idx, op, task_dict). Runs each executor's
         queue sequentially, executors in parallel; returns replies in job
-        order."""
+        order. A broken channel or a transport-failure reply raises
+        ExecutorLostError (caught by collect()'s retry ladder)."""
         import cloudpickle
         by_exec: dict[int, list] = {}
         for j, (ei, op, task) in enumerate(jobs):
             by_exec.setdefault(ei, []).append((j, op, task))
+            self.task_log.append((op, ei))
+        if len(self.task_log) > 4096:    # observability ring, not a ledger
+            del self.task_log[:-2048]
         replies = [None] * len(jobs)
         # send one task per executor at a time (the Pipe is a simple duplex
         # channel); round-robin until all queues drain
@@ -203,22 +247,50 @@ class MiniCluster:
             for ei, q in list(pending.items()):
                 if ei not in inflight and q:
                     j, op, task = q.pop(0)
-                    self._conns[ei].send(
-                        {"op": op, "task": cloudpickle.dumps(task)})
+                    try:
+                        self._conns[ei].send(
+                            {"op": op, "task": cloudpickle.dumps(task)})
+                    except (BrokenPipeError, OSError) as e:
+                        raise ExecutorLostError(
+                            f"executor {ei} channel broke on send: {e}") \
+                            from e
                     inflight[ei] = j
                 if not q:
                     del pending[ei]
             for ei, j in list(inflight.items()):
-                reply = self._conns[ei].recv()
+                try:
+                    reply = self._conns[ei].recv()
+                except (EOFError, OSError) as e:
+                    raise ExecutorLostError(
+                        f"executor {ei} died mid-task: {e}") from e
                 if not reply.get("ok"):
+                    err = reply.get("error") or ""
+                    if "TransportError" in err:
+                        # fetch against a dead peer: a stage-level loss, not
+                        # a task bug — retry through the heal ladder
+                        raise ExecutorLostError(
+                            f"executor {ei} fetch failed:\n{err}")
                     raise RuntimeError(
-                        f"executor {ei} task failed:\n{reply.get('error')}")
+                        f"executor {ei} task failed:\n{err}")
                 replies[j] = reply
                 del inflight[ei]
         return replies
 
     # -- scheduling ---------------------------------------------------------
     def collect(self, df) -> pa.Table:
+        last = None
+        for attempt in range(self.max_attempts):
+            try:
+                return self._collect_once(df)
+            except ExecutorLostError as e:
+                # lineage recompute, coarse-grained: heal the pool and re-run
+                # all stages with fresh shuffle ids (Spark FetchFailed →
+                # stage retry; reference RapidsShuffleIterator.scala:82,153)
+                last = e
+                self._heal()
+        raise last
+
+    def _collect_once(self, df) -> pa.Table:
         from spark_rapids_tpu.plan.distribute import (ensure_distribution,
                                                       stage_order)
         plan = _clone_plan(df._plan)
@@ -226,6 +298,8 @@ class MiniCluster:
         for exchange, parent, idx in stage_order(plan):
             source = self._run_map_stage(exchange)
             parent.children[idx] = source
+            if self._after_stage_hook is not None:
+                self._after_stage_hook(self)
         return self._run_result_stage(plan)
 
     def _run_map_stage(self, exchange):
@@ -245,11 +319,14 @@ class MiniCluster:
         sid = next(self._shuffle_ids)
         # every executor must know the shuffle id — a peer with no map task
         # for it still serves (empty) metadata requests from reducers
-        for c in self._conns:
-            c.send({"op": "ensure_shuffle", "shuffle_id": sid})
-        for c in self._conns:
-            reply = c.recv()
-            assert reply.get("ok"), reply
+        try:
+            for c in self._conns:
+                c.send({"op": "ensure_shuffle", "shuffle_id": sid})
+            for c in self._conns:
+                reply = c.recv()
+                assert reply.get("ok"), reply
+        except (BrokenPipeError, EOFError, OSError) as e:
+            raise ExecutorLostError(f"ensure_shuffle: {e}") from e
         jobs = []
         for split, task in self._stage_tasks(child):
             task.update({"shuffle_id": sid, "partitioner": part})
@@ -261,7 +338,9 @@ class MiniCluster:
     def _stage_tasks(self, subtree):
         """Yield (split, task) covering every partition of `subtree`.
         Co-partitioned shuffle inputs → one pinned task per reduce id;
-        leaf-only stages → one task per leaf split; mixed → one task."""
+        everything else → one task per partition of the subtree (a UNION of
+        a scan leaf with a shuffle source spreads its leaf splits and reduce
+        partitions across executors instead of serializing in one task)."""
         sources = _collect_sources(subtree, [])
         if sources and not _has_non_source_leaves(subtree) and \
                 len({s.n_parts for s in sources}) == 1:
@@ -269,12 +348,9 @@ class MiniCluster:
             for r in range(n):
                 yield r, {"plan": _pin_sources(_clone_plan(subtree), r),
                           "splits": [0]}
-        elif not sources:
+        else:
             for s in range(subtree.num_partitions):
                 yield s, {"plan": subtree, "splits": [s]}
-        else:
-            yield 0, {"plan": subtree,
-                      "splits": list(range(subtree.num_partitions))}
 
     def _run_result_stage(self, plan) -> pa.Table:
         jobs = [(next(self._rr), "result", task)

