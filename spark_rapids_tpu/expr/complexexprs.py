@@ -223,8 +223,11 @@ class ElementAt(Expression):
     a fused CreateArray child like GetArrayItem (reference GpuOverrides
     expr[ElementAt])."""
 
-    def __init__(self, child, index):
+    def __init__(self, child, index, strict_zero: bool = False):
         self.children = [child, index]
+        # pre-3.4 shim semantics: index 0 raises instead of yielding null
+        # (set by the planner from the active SparkShim)
+        self.strict_zero = strict_zero
 
     @property
     def dtype(self):
@@ -232,7 +235,7 @@ class ElementAt(Expression):
         return ct.element_type if isinstance(ct, T.ArrayType) else T.NULL
 
     def with_children(self, children):
-        return ElementAt(children[0], children[1])
+        return ElementAt(children[0], children[1], self.strict_zero)
 
     def eval(self, ctx):
         src, idx = self.children
@@ -245,6 +248,8 @@ class ElementAt(Expression):
         # fused multiplex of GetArrayItem
         if isinstance(idx, Literal):
             i = idx.value
+            if i == 0 and self.strict_zero:
+                raise RuntimeError("SQL array indices start at 1")
             if i is None or i == 0:
                 zero = Literal(None, T.INT)
                 return GetArrayItem(src, zero).eval(ctx)

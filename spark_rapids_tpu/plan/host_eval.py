@@ -924,7 +924,11 @@ def _at_least_n_host(expr, kids, n):
 
 def _element_at_host(expr, kids, n):
     out = []
+    strict = getattr(expr, "strict_zero", False)
     for arr, i in zip(kids[0].data, kids[1].data):
+        if strict and i == 0:
+            # pre-3.4 shim generations (shims/__init__.py)
+            raise RuntimeError("SQL array indices start at 1")
         if arr is None or i is None or i == 0:
             out.append(None)
         else:

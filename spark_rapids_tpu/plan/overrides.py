@@ -519,8 +519,21 @@ def _register_all():
        None, tag_extract)
     ex(CX.Size, "collection size", TS.TypeSig([T.IntegerType]), nested_ok,
        None, tag_extract)
+    def tag_element_at(meta):
+        tag_extract(meta)
+        from spark_rapids_tpu.shims import shim_for
+        if shim_for(meta.conf).element_at_zero_errors:
+            # pre-3.4 generations raise on index 0; flag the expression so
+            # host eval and literal-index device eval enforce it, and pin
+            # data-dependent indexes to the host where the row-level error
+            # can actually be raised
+            meta.expr.strict_zero = True
+            if not isinstance(meta.expr.children[1], E.Literal):
+                meta.will_not_work(
+                    "element_at with a non-literal index under a pre-3.4 "
+                    "shim: the index-0 error is data-dependent (host only)")
     ex(CX.ElementAt, "1-based array element extraction", TS.ALL, nested_ok,
-       None, tag_extract)
+       None, tag_element_at)
     ex(CX.ArrayContains, "array membership (fused)", TS.BOOLEAN, nested_ok,
        None, tag_extract)
     ex(CX.CreateMap, "map construction (fused)", nested_ok, TS.ALL,

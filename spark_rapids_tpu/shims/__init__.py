@@ -30,6 +30,9 @@ class SparkShim:
     #: AQE (and with it post-shuffle partition coalescing) is default-ON
     #: only since Spark 3.2 (SPARK-33679); earlier generations must opt in
     adaptive_coalesce_default = True
+    #: element_at(arr, 0): pre-3.4 generations RAISE ("SQL array indices
+    #: start at 1"); 3.4+ ANSI-off returns null
+    element_at_zero_errors = False
 
     def __repr__(self):
         return f"SparkShim({self.version_prefix}.x)"
@@ -39,17 +42,34 @@ class Spark30Shim(SparkShim):
     version_prefix = "3.0"
     lenient_string_to_date = True
     adaptive_coalesce_default = False
+    element_at_zero_errors = True
+
+
+class Spark31Shim(Spark30Shim):
+    """3.1 keeps 3.0's date parsing and opt-in AQE."""
+    version_prefix = "3.1"
 
 
 class Spark32Shim(SparkShim):
     version_prefix = "3.2"
+    element_at_zero_errors = True
+
+
+class Spark33Shim(Spark32Shim):
+    version_prefix = "3.3"
+
+
+class Spark34Shim(SparkShim):
+    """3.4 flips element_at(arr, 0) from error to null (ANSI off)."""
+    version_prefix = "3.4"
 
 
 class Spark35Shim(SparkShim):
     version_prefix = "3.5"
 
 
-_SHIMS = [Spark30Shim, Spark32Shim, Spark35Shim]
+_SHIMS = [Spark30Shim, Spark31Shim, Spark32Shim, Spark33Shim, Spark34Shim,
+          Spark35Shim]
 
 
 def load_shim(version: str) -> SparkShim:

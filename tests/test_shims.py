@@ -19,7 +19,7 @@ from spark_rapids_tpu.shims import (
 def test_shim_selection():
     assert isinstance(load_shim("3.0.1"), Spark30Shim)
     assert load_shim("3.2.4").version_prefix == "3.2"
-    assert load_shim("3.3.0").version_prefix == "3.2"  # newest <= requested
+    assert load_shim("3.3.0").version_prefix == "3.3"  # newest <= requested
     assert isinstance(load_shim("3.5.0"), Spark35Shim)
     assert isinstance(load_shim("4.0.0"), Spark35Shim)
 
@@ -146,3 +146,36 @@ def test_adaptive_default_is_version_gated():
     assert final_agg_child({
         "spark.rapids.tpu.spark.version": "3.1.2",
         "spark.rapids.tpu.sql.adaptive.coalescePartitions.enabled": "true"})
+
+
+def test_shim_generations_cover_reference_versions():
+    """Six behavior generations, latest-not-exceeding selection across every
+    reference shim version (reference shims/spark301..320 + ShimLoader)."""
+    from spark_rapids_tpu.shims import load_shim
+    picks = {v: load_shim(v).version_prefix for v in
+             ("3.0.1", "3.0.2", "3.0.3", "3.1.1", "3.1.2", "3.2.0",
+              "3.3.2", "3.4.1", "3.5.0")}
+    assert picks == {"3.0.1": "3.0", "3.0.2": "3.0", "3.0.3": "3.0",
+                     "3.1.1": "3.1", "3.1.2": "3.1", "3.2.0": "3.2",
+                     "3.3.2": "3.3", "3.4.1": "3.4", "3.5.0": "3.5"}
+
+
+def test_element_at_zero_shim_divergence():
+    """element_at(arr, 0): pre-3.4 raises 'SQL array indices start at 1';
+    3.4+ (ANSI off) yields null."""
+    import pytest
+    import pyarrow as pa
+    import spark_rapids_tpu.functions as F
+    from spark_rapids_tpu.session import TpuSession
+
+    t = pa.table({"a": pa.array([1, 2], pa.int64()),
+                  "b": pa.array([3, 4], pa.int64())})
+    def q(spark):
+        df = spark.create_dataframe(t)
+        return df.select(F.element_at(F.array(F.col("a"), F.col("b")),
+                                      0).alias("x"))
+    new = TpuSession({"spark.rapids.tpu.spark.version": "3.5.0"})
+    assert q(new).collect().column("x").to_pylist() == [None, None]
+    old = TpuSession({"spark.rapids.tpu.spark.version": "3.2.0"})
+    with pytest.raises(RuntimeError, match="SQL array indices start at 1"):
+        q(old).collect()
