@@ -181,3 +181,161 @@ def unpack_rows_arrow(rows: np.ndarray, schema):
                                  mask=~valid))
         names.append(f.name)
     return pa.table(dict(zip(names, cols)))
+
+
+# -- variable-width rows ------------------------------------------------------
+# Reference: full UnsafeRow/CudfUnsafeRow semantics — a string field's 8-byte
+# slot holds (offset << 32) | byteLength with offset relative to the row
+# base, and the UTF-8 bytes live in the row's variable region after the
+# fixed slots; rows stay 8-byte aligned. Because rows vary in length the
+# buffer is (flat int64 words, int64 row offsets in words) instead of a 2-D
+# matrix. Packing stays fully vectorized: one ragged byte-scatter built from
+# arrow's own offsets buffers — zero per-row Python (the "codegen" stance of
+# the fixed-width path, extended to strings; reference
+# GpuRowToColumnarExec.scala:635 generated converter).
+
+_VAR = (T.StringType,)
+
+
+def is_packable(schema) -> bool:
+    """Fixed-width or string columns — the full UnsafeRow surface."""
+    return all(isinstance(f.data_type, _FIXED + _VAR) for f in schema.fields)
+
+
+def _string_parts(arr):
+    import pyarrow as pa
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    arr = arr.cast(pa.string())
+    valid = np.asarray(pa.compute.is_valid(arr))
+    # offsets/data straight from the arrow buffers (int32 offsets)
+    bufs = arr.buffers()
+    off = np.frombuffer(bufs[1], np.int32)[arr.offset:arr.offset + len(arr) + 1]
+    data = np.frombuffer(bufs[2], np.uint8) if bufs[2] is not None else \
+        np.zeros(0, np.uint8)
+    lens = (off[1:] - off[:-1]).astype(np.int64)
+    lens[~valid] = 0
+    return valid, off[:-1].astype(np.int64), lens, data
+
+
+def pack_arrow_var(tbl, schema):
+    """Arrow table (fixed-width + string schema) → (words int64[total],
+    row_offsets int64[n+1] in WORDS)."""
+    import pyarrow as pa
+    if not is_packable(schema):
+        raise NotImplementedError(f"unsupported types in {schema}")
+    null_words, base = row_layout(schema)
+    n = tbl.num_rows
+    var_cols = {}
+    var_bytes = np.zeros(n, np.int64)
+    for j, f in enumerate(schema.fields):
+        if isinstance(f.data_type, T.StringType):
+            parts = _string_parts(tbl.column(j))
+            var_cols[j] = parts
+            var_bytes += parts[2]
+    row_words = base + ((var_bytes + 7) >> 3)
+    offsets = np.zeros(n + 1, np.int64)
+    np.cumsum(row_words, out=offsets[1:])
+    words = np.zeros(int(offsets[-1]), np.int64)
+    rows0 = offsets[:-1]
+
+    # fixed slots + null bits (strided scatters, same as the 2-D path)
+    for j, f in enumerate(schema.fields):
+        w, bit = j // 64, j % 64
+        if j in var_cols:
+            continue
+        arr = tbl.column(j).combine_chunks()
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.chunk(0) if arr.num_chunks else pa.nulls(0, arr.type)
+        valid = np.asarray(pa.compute.is_valid(arr))
+        dt = f.data_type
+        if isinstance(dt, T.DateType):
+            arr = arr.cast(pa.int32())
+        elif isinstance(dt, T.TimestampType):
+            arr = arr.cast(pa.int64())
+        if isinstance(dt, T.DecimalType):
+            data = np.array([0 if v is None else int(v.scaleb(dt.scale))
+                             for v in arr.to_pylist()], np.int64)
+        else:
+            fill = (False if isinstance(dt, T.BooleanType)
+                    else 0.0 if isinstance(dt, (T.FloatType, T.DoubleType))
+                    else 0)
+            data = pa.compute.fill_null(arr, fill).to_numpy(
+                zero_copy_only=False)
+            if isinstance(dt, T.BooleanType):
+                data = data.astype(np.int64)
+        words[rows0 + null_words + j] = np.where(
+            valid, _col_bits(dt, data), 0)
+        words[rows0 + w] |= np.where(valid, np.int64(0),
+                                     np.int64(1) << np.int64(bit))
+
+    # variable region: per-row running byte cursor across string columns
+    bytes_view = words.view(np.uint8)   # little-endian words
+    cursor = np.full(n, base * 8, np.int64)   # byte offset from row base
+    for j, f in enumerate(schema.fields):
+        if j not in var_cols:
+            continue
+        w, bit = j // 64, j % 64
+        valid, src_off, lens, data = var_cols[j]
+        slot = np.where(valid, (cursor << 32) | lens, 0)
+        words[rows0 + null_words + j] = slot
+        words[rows0 + w] |= np.where(valid, np.int64(0),
+                                     np.int64(1) << np.int64(bit))
+        total = int(lens.sum())
+        if total:
+            dst0 = rows0 * 8 + cursor            # absolute byte start per row
+            starts = np.zeros(n, np.int64)
+            np.cumsum(lens[:-1], out=starts[1:])
+            within = np.arange(total, dtype=np.int64) - np.repeat(starts,
+                                                                  lens)
+            bytes_view[np.repeat(dst0, lens) + within] = \
+                data[np.repeat(src_off, lens) + within]
+        cursor += lens
+    return words, offsets
+
+
+def unpack_rows_arrow_var(words: np.ndarray, offsets: np.ndarray, schema):
+    """(words, row_offsets) → arrow table (inverse of pack_arrow_var)."""
+    import pyarrow as pa
+    null_words, base = row_layout(schema)
+    n = len(offsets) - 1
+    rows0 = offsets[:-1]
+    bytes_view = np.ascontiguousarray(words).view(np.uint8)
+    cols, names = [], []
+    for j, f in enumerate(schema.fields):
+        w, bit = j // 64, j % 64
+        valid = ((words[rows0 + w] >> np.int64(bit)) & 1) == 0
+        slot = words[rows0 + null_words + j]
+        if isinstance(f.data_type, T.StringType):
+            lens = np.where(valid, slot & 0xFFFFFFFF, 0)
+            rel = np.where(valid, slot >> 32, 0)
+            src0 = rows0 * 8 + rel
+            total = int(lens.sum())
+            out_bytes = np.zeros(total, np.uint8)
+            if total:
+                starts = np.zeros(n, np.int64)
+                np.cumsum(lens[:-1], out=starts[1:])
+                within = np.arange(total, dtype=np.int64) - np.repeat(
+                    starts, lens)
+                out_bytes = bytes_view[np.repeat(src0, lens) + within]
+            out_off = np.zeros(n + 1, np.int64)
+            out_off[1:] = np.cumsum(lens)
+            arr = pa.StringArray.from_buffers(
+                n, pa.py_buffer(out_off.astype(np.int32).tobytes()),
+                pa.py_buffer(out_bytes.tobytes()),
+                pa.py_buffer(np.packbits(valid, bitorder="little").tobytes()))
+            cols.append(arr)
+        elif isinstance(f.data_type, T.DecimalType):
+            import decimal
+            sc = f.data_type.scale
+            q = decimal.Decimal(1).scaleb(-sc)
+            vals = [None if not v else
+                    decimal.Decimal(int(x)).scaleb(-sc).quantize(q)
+                    for x, v in zip(slot, valid)]
+            cols.append(pa.array(vals, T.to_arrow_type(f.data_type)))
+        else:
+            data = _bits_to_col(f.data_type, slot)
+            cols.append(pa.array(data, T.to_arrow_type(f.data_type),
+                                 mask=~valid))
+        names.append(f.name)
+    return pa.table(dict(zip(names, cols)))

@@ -208,10 +208,16 @@ def test_row_buffer_session_api():
     out = df2.filter(F.col("k") > F.lit(1)).collect()
     assert sorted(x for x in out["k"].to_pylist()) == [2, 4]
 
+    # string schemas take the variable-width layout (r4: no longer an error)
     sdf = spark.create_dataframe({"s": pa.array(["a", "b"])})
+    (words, offsets), sschema = sdf.collect_row_buffer()
+    assert len(offsets) == 3
+    # nested types stay out of the row formats
     import pytest
+    ldf = spark.create_dataframe(
+        pa.table({"l": pa.array([[1, 2], [3]], pa.list_(pa.int64()))}))
     with pytest.raises(NotImplementedError):
-        sdf.collect_row_buffer()
+        ldf.collect_row_buffer()
 
 
 def test_row_buffer_arrow_pack_precision_and_nan():
@@ -242,3 +248,46 @@ def test_row_buffer_arrow_pack_precision_and_nan():
     assert math.isnan(d[0]) and d[1] == 1.5 and d[2] is None
     assert back["dec"].to_pylist() == [decimal.Decimal("1.23"), None,
                                        decimal.Decimal("-0.07")]
+
+
+def test_variable_width_row_roundtrip():
+    """UnsafeRow-style variable-width rows (VERDICT r3 missing #5): strings
+    pack as (offset<<32)|len slots + a per-row byte region; round trip is
+    exact, including nulls, empty strings, and multi-byte UTF-8."""
+    from spark_rapids_tpu.columnar import rows as R
+    from spark_rapids_tpu import types as T
+    t = pa.table({
+        "s": pa.array(["", "hello", None, "é中🙂", "x" * 300]),
+        "i": pa.array([1, None, 3, 4, 5], pa.int64()),
+        "t": pa.array([None, "b", "", None, "fin"]),
+        "d": pa.array([1.5, 2.5, None, float("nan"), -0.0]),
+    })
+    schema = T.StructType([
+        T.StructField("s", T.STRING, True),
+        T.StructField("i", T.LONG, True),
+        T.StructField("t", T.STRING, True),
+        T.StructField("d", T.DOUBLE, True),
+    ])
+    assert not R.is_fixed_width(schema) and R.is_packable(schema)
+    words, offsets = R.pack_arrow_var(t, schema)
+    # rows are 8-byte aligned, var region packed after the fixed slots
+    assert offsets[0] == 0 and offsets[-1] == len(words)
+    back = R.unpack_rows_arrow_var(words, offsets, schema)
+    for name in t.column_names:
+        got = back.column(name).to_pylist()
+        exp = t.column(name).to_pylist()
+        if name == "d":
+            assert got[:3] == exp[:3] and got[3] != got[3] and got[4] == 0.0
+        else:
+            assert got == exp, name
+
+
+def test_variable_width_rows_through_session():
+    from spark_rapids_tpu.session import TpuSession
+    spark = TpuSession()
+    t = pa.table({"s": pa.array(["a", None, "ccc"]),
+                  "v": pa.array([1, 2, 3], pa.int32())})
+    df = spark.create_dataframe(t)
+    buf, schema = df.collect_row_buffer()
+    df2 = spark.create_dataframe_from_rows(buf, schema)
+    assert df2.collect().to_pylist() == t.to_pylist()
