@@ -24,4 +24,24 @@ python -m spark_rapids_tpu.config
 git diff --exit-code docs/configs.md || {
   echo "docs/configs.md out of date: run python -m spark_rapids_tpu.config"; exit 1; }
 
+echo "== installable package (dist-jar analog) =="
+# import + run a query from the INSTALLED package, outside the repo dir
+instdir=$(mktemp -d)
+# --no-build-isolation: the CI box has no egress; setuptools is preinstalled
+pip install --quiet --no-build-isolation --target "$instdir" --no-deps .
+(cd /tmp && PYTHONPATH="$instdir" JAX_PLATFORMS=cpu python - <<'PYEOF'
+import jax; jax.config.update("jax_platforms", "cpu")
+import spark_rapids_tpu, pyarrow as pa
+assert "/repo/" not in spark_rapids_tpu.__file__, spark_rapids_tpu.__file__
+from spark_rapids_tpu.session import TpuSession
+spark = TpuSession()
+spark.create_or_replace_temp_view(
+    "t", spark.create_dataframe(pa.table({"k": [1, 2, 2], "v": [1.0, 2.0, 3.0]})))
+out = spark.sql("select k, sum(v) s from t group by k order by k").collect()
+assert out.to_pylist() == [{"k": 1, "s": 1.0}, {"k": 2, "s": 5.0}], out
+print("installed-package query ok")
+PYEOF
+)
+rm -rf "$instdir"
+
 echo "CI OK"

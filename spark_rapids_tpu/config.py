@@ -393,6 +393,13 @@ PARQUET_REBASE_MODE = conf(
     "the Julian->proleptic-Gregorian rebase, shims.rebase_julian_to_gregorian_days)"
 ).string_conf("EXCEPTION")
 
+ALLUXIO_PATHS_REPLACE = conf(
+    "spark.rapids.tpu.alluxio.pathsToReplace").doc(
+    "List of 'scheme://from->scheme://to' path-prefix rewrites applied to "
+    "every file scan, so cached-filesystem mounts transparently replace "
+    "direct storage paths (reference spark.rapids.alluxio.pathsToReplace, "
+    "RapidsConf.scala:1031); ';'-separated").string_conf(None)
+
 
 class RapidsConf:
     """Resolved view over user settings (reference RapidsConf.scala:1162 class)."""

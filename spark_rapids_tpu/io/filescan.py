@@ -75,6 +75,35 @@ def _infer_partition_type(values: list) -> T.DataType:
         return T.STRING
 
 
+def rewrite_scan_path(path, conf):
+    """Alluxio-style path-prefix replacement (reference
+    spark.rapids.alluxio.pathsToReplace, RapidsConf.scala:1031): rewrite
+    'from->to' prefixes on every scan path so a caching filesystem mount
+    transparently fronts direct storage."""
+    from spark_rapids_tpu import config as CFG
+    spec = conf.get(CFG.ALLUXIO_PATHS_REPLACE) if conf is not None else None
+    if not spec or not isinstance(path, (str, list, tuple)):
+        return path
+    rules = []
+    for rule in spec.split(";"):
+        rule = rule.strip()
+        if not rule:
+            continue
+        if "->" not in rule:
+            raise ValueError(
+                f"bad {CFG.ALLUXIO_PATHS_REPLACE.key} rule {rule!r}: "
+                "expected 'from->to'")
+        frm, to = rule.split("->", 1)
+        rules.append((frm.strip(), to.strip()))
+
+    def one(p):
+        for frm, to in rules:
+            if p.startswith(frm):
+                return to + p[len(frm):]
+        return p
+    return one(path) if isinstance(path, str) else [one(p) for p in path]
+
+
 class FileScanNode(PlanNode):
     """CPU plan node for a file scan; the override layer converts it to
     FileSourceScanExec. Host execution = the same readers without the device

@@ -7,7 +7,8 @@ PROTOBUF footers and RLEv2 run HEADERS are metadata — bytes to kilobytes,
 parsed here with a minimal proto-wire reader — while the packed payload
 bits go to the device (ops/orc_decode.py: MSB bit-unpack + zigzag).
 
-Stage-one scope: UNCOMPRESSED files, flat schemas, INT/LONG columns with
+Scope: flat schemas; UNCOMPRESSED or block-compressed streams
+(ZLIB/SNAPPY/LZ4/ZSTD — see _stream_bytes below); INT/LONG columns with
 DIRECT_V2 encoding (all four RLEv2 sub-encodings: SHORT_REPEAT, DIRECT,
 DELTA, PATCHED_BASE), FLOAT/DOUBLE raw-IEEE streams,
 DICTIONARY_V2 strings (the ORC dictionary maps 1:1 onto the engine's

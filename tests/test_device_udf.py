@@ -96,3 +96,47 @@ def test_jax_udf_host_oracle_agrees():
             assert v is None
         else:
             assert v == pytest.approx(abs(d) ** 0.5 + 1.0, rel=1e-6)
+
+
+def test_registered_udf_prefers_device_impl():
+    """RapidsUDF analog (reference GpuUserDefinedFunction.scala:73): the
+    registered device implementation is planned fused on TPU, not the row
+    fallback; callable from both the DataFrame API and SQL."""
+    from spark_rapids_tpu.plan.overrides import explain_plan
+    spark = TpuSession()
+    calls = {"row": 0}
+
+    def slow_row_fn(v):
+        calls["row"] += 1
+        return v * 2.0
+
+    my_fn = spark.udf.register("my_double", fn=slow_row_fn,
+                               return_type=T.DOUBLE,
+                               device_fn=lambda v: v * 2.0)
+    t = pa.table({"x": pa.array([1.0, 2.0, None, 4.0])})
+    spark.create_or_replace_temp_view("t", spark.create_dataframe(t))
+    df = spark.create_dataframe(t).select(my_fn(F.col("x")).alias("y"))
+    txt = explain_plan(df._plan, spark.conf)
+    assert "will run on TPU" in txt.splitlines()[0], txt
+    assert [r["y"] for r in df.collect().to_pylist()] == [2.0, 4.0, None, 8.0]
+    got = spark.sql("select my_double(x) y from t").collect().to_pylist()
+    assert [r["y"] for r in got] == [2.0, 4.0, None, 8.0]
+    assert calls["row"] == 0, "device impl must be used, not the row fn"
+
+
+def test_registered_udf_fallback_without_device_impl():
+    """No device_fn: the registry compiles the bytecode to device exprs when
+    it can, else routes to the python worker pool — never errors."""
+    spark = TpuSession()
+    spark.udf.register("plus_one", fn=lambda v: v + 1, return_type=T.LONG)
+    # closure over opaque state defeats the bytecode compiler -> worker pool
+    import math
+    spark.udf.register("opaque", fn=lambda v: int(math.floor(v)) + 1,
+                       return_type=T.LONG)
+    t = pa.table({"x": pa.array([1, 2, 3], pa.int64()),
+                  "d": pa.array([1.5, 2.5, 3.5])})
+    spark.create_or_replace_temp_view("t", spark.create_dataframe(t))
+    got = spark.sql("select plus_one(x) a from t order by a").collect()
+    assert got.column("a").to_pylist() == [2, 3, 4]
+    got = spark.sql("select opaque(d) b from t order by b").collect()
+    assert got.column("b").to_pylist() == [2, 3, 4]

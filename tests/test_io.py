@@ -468,3 +468,19 @@ def test_input_file_name_survives_filter_and_host_path(tmp_path):
             .collect())
     assert all(os.path.basename(v) == "x.parquet"
                for v in out2["f"].to_pylist())
+
+
+def test_alluxio_path_rewrite(tmp_path):
+    """Reference spark.rapids.alluxio.pathsToReplace (RapidsConf.scala:1031):
+    scan paths rewrite by prefix before file resolution."""
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.session import TpuSession
+    real = tmp_path / "mnt" / "alluxio" / "data"
+    real.mkdir(parents=True)
+    pq.write_table(pa.table({"x": pa.array([1, 2, 3])}),
+                   str(real / "f.parquet"))
+    spark = TpuSession({
+        "spark.rapids.tpu.alluxio.pathsToReplace":
+            f"s3://bucket->{tmp_path}/mnt/alluxio"})
+    df = spark.read_parquet("s3://bucket/data")
+    assert sorted(df.collect().column("x").to_pylist()) == [1, 2, 3]
