@@ -1,0 +1,39 @@
+"""Mortgage ETL application benchmark (reference MortgageSpark.scala role):
+pipe-delimited CSV scans -> delinquency aggregation -> join -> features ->
+summary, checked against an independent single-pass oracle, plus the
+parquet write/readback leg."""
+
+import pytest
+
+from spark_rapids_tpu.benchmarks import mortgage
+from spark_rapids_tpu.session import TpuSession
+
+
+@pytest.fixture(scope="module")
+def data(tmp_path_factory):
+    d = tmp_path_factory.mktemp("mortgage")
+    return mortgage.generate(0.01, str(d))
+
+
+def test_mortgage_etl_matches_oracle(data):
+    spark = TpuSession()
+    got = [tuple(r.values()) for r in
+           mortgage.etl(spark, data).collect().to_pylist()]
+    exp = mortgage.np_oracle(data)
+    assert len(got) == len(exp) == 3
+    for g, e in zip(got, exp):
+        assert g[:5] == e[:5], (g, e)
+        assert g[5] == pytest.approx(e[5], rel=1e-9)
+        assert g[6] == e[6]
+
+
+def test_mortgage_etl_writes_features(data, tmp_path):
+    spark = TpuSession()
+    out = str(tmp_path / "features")
+    mortgage.etl(spark, data, write_dir=out)
+    back = spark.read_parquet(out).collect()
+    exp = mortgage.np_oracle(data)
+    assert back.num_rows == sum(e[1] for e in exp)
+    cols = set(back.column_names)
+    assert {"loan_id", "ever_30", "ever_90", "ever_180",
+            "max_dq"} <= cols
