@@ -1197,8 +1197,9 @@ def np_q43(tb):
         dow = dow_of.get(ddk)
         if dow is None:
             continue
-        row = sums.setdefault(sname[sk], [0.0] * 7)
-        row[int(dow)] += p
+        # Spark sum over an empty/never-hit day is NULL, not 0.0
+        row = sums.setdefault(sname[sk], [None] * 7)
+        row[int(dow)] = (row[int(dow)] or 0.0) + p
     rows = [(n,) + tuple(v) for n, v in sums.items()]
     return _lex_top(rows, [0], [True], 100)
 
