@@ -126,6 +126,9 @@ class HashAggregateExec(TpuExec):
                 key_cols = [ctx.cols[i] for i in range(nkeys)]
             else:
                 key_cols = [e.eval(ctx) for e in self.group_exprs]
+            dense = self._agg_dense(ctx, merge, key_cols)
+            if dense is not None:
+                return dense
             combined = G.combine_compact_keys(key_cols)
             perm, seg_ids, boundary, live = G.group_segments(
                 [combined] if combined is not None else key_cols,
@@ -162,6 +165,105 @@ class HashAggregateExec(TpuExec):
             off += nstates
             state_cols.extend(outs)
         return compact_cols(list(sorted_keys) + state_cols, boundary)
+
+    def _agg_dense(self, ctx: EvalContext, merge: bool, key_cols):
+        """Sort-free small-domain aggregation: keys with statically-known
+        compact domains (dict strings / bools) and sum-shaped aggregates
+        (Sum/Count/Average) reduce straight into D per-group buckets —
+        scatter-add on CPU, one-hot MATMUL on TPU (the MXU-shaped group-by;
+        cudf's hash groupby plays this role in the reference,
+        aggregate.scala:706). The sorted segment path (q1: ~18 ms sort +
+        ~12 ms/column tree per batch) drops to ~1 ms/column.
+
+        Returns (cols, n_groups) or None when ineligible."""
+        import jax
+        from spark_rapids_tpu.columnar.vector import bucket_capacity
+        from spark_rapids_tpu.expr.aggregates import Average, Count, Sum
+
+        on_tpu = jax.devices()[0].platform == "tpu"
+        ks = G.compact_key_codes(key_cols, max_domain=128 if on_tpu else 4096)
+        if ks is None:
+            return None
+        fns = [_agg_fn(e) for e in self.agg_exprs]
+        if not all(isinstance(f, (Sum, Count, Average)) for f in fns):
+            return None
+        if on_tpu and any(
+                not jnp.issubdtype(jnp.dtype(st.jnp_dtype), jnp.floating)
+                for f in fns if isinstance(f, (Sum, Average))
+                for st in f.state_types[:1]):
+            return None   # int64 matmul is not an MXU op
+        codes, strides = ks
+        D = 1
+        for d in strides:
+            D *= d
+        cap = ctx.capacity
+        live = jnp.arange(cap, dtype=jnp.int32) < ctx.num_rows
+        codes = jnp.where(live, codes, jnp.int32(D))   # pad bucket, dropped
+
+        def gsum(vals, mask, acc_dtype):
+            return G.dense_group_sum(vals.astype(acc_dtype), mask & live,
+                                     codes, D, on_tpu)
+
+        rows_per = gsum(jnp.ones((cap,), jnp.int32),
+                        jnp.ones((cap,), jnp.bool_), jnp.int32)
+
+        state_cols = []   # (D,)-length states, padded to D_cap below
+        off = len(key_cols)
+        for e, f in zip(self.agg_exprs, fns):
+            nstates = len(f.state_types)
+            if merge:
+                ins = [ctx.cols[off + i] for i in range(nstates)]
+            elif f.child is None:
+                ins = [Col(jnp.zeros((cap,), jnp.int8), live, T.BYTE)]
+            else:
+                ins = [f.child.eval(ctx)]
+            off += nstates
+            if isinstance(f, Count):
+                s = gsum(ins[0].validity.astype(jnp.int64)
+                         if not merge else ins[0].values,
+                         ins[0].validity, jnp.int64)
+                state_cols.append(Col(s, jnp.ones_like(s, jnp.bool_),
+                                      T.LONG))
+                continue
+            sum_t = f.state_types[0]
+            acc = sum_t.jnp_dtype
+            s = gsum(ins[0].values, ins[0].validity, acc)
+            cnt = gsum(ins[0].validity.astype(jnp.int64), ins[0].validity,
+                       jnp.int64)
+            state_cols.append(Col(s, cnt > 0, sum_t))
+            if isinstance(f, Average):
+                if merge:
+                    c2 = gsum(ins[1].values, ins[1].validity, jnp.int64)
+                else:
+                    c2 = cnt
+                state_cols.append(Col(c2, jnp.ones_like(c2, jnp.bool_),
+                                      T.LONG))
+
+        # decode bucket index -> key columns (inverse of the stride mix)
+        D_cap = bucket_capacity(D)
+        bidx = jnp.arange(D_cap, dtype=jnp.int32)
+        key_out = []
+        for ki, (c, d) in enumerate(zip(key_cols, strides)):
+            tail = 1
+            for d2 in strides[ki + 1:]:
+                tail *= d2
+            part = (bidx // tail) % jnp.int32(d)
+            valid = (part != d - 1) & (bidx < D)
+            if c.is_string:
+                key_out.append(Col(jnp.where(valid, part, 0), valid,
+                                   T.STRING, c.dictionary))
+            else:   # boolean
+                key_out.append(Col(jnp.where(valid, part == 1, False),
+                                   valid, T.BOOLEAN))
+        present = jnp.zeros((D_cap,), jnp.bool_).at[:D].set(rows_per > 0)
+
+        def pad(col):
+            v = jnp.zeros((D_cap,), col.values.dtype).at[:D].set(col.values)
+            m = jnp.zeros((D_cap,), jnp.bool_).at[:D].set(col.validity)
+            return Col(v, m & present, col.dtype, col.dictionary)
+
+        out = key_out + [pad(c) for c in state_cols]
+        return compact_cols(out, present)
 
     def _finalize(self, partial: ColumnarBatch) -> ColumnarBatch:
         from spark_rapids_tpu.expr.core import Col

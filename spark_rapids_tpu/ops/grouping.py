@@ -46,12 +46,12 @@ class SegCtx(typing.NamedTuple):
     capacity: int
 
 
-def combine_compact_keys(key_cols):
-    """Fuse group keys with STATICALLY-known small domains (dictionary-coded
-    strings, booleans) into one int32 code column: sorts and boundary checks
-    then touch a single operand instead of one per key (~6x cheaper multi-key
-    group-by). Nulls get their own code (Spark groups nulls together).
-    Returns None when any key's domain is unknown or the product overflows."""
+def compact_key_codes(key_cols, max_domain: int = 1 << 20):
+    """(codes int32, strides) for keys whose domains are STATICALLY known
+    (dictionary-coded strings, booleans); nulls get each key's top code
+    (Spark groups nulls together). None when unknown/overflowing."""
+    if not key_cols:
+        return None
     strides = []
     K = 1
     for c in key_cols:
@@ -63,16 +63,50 @@ def combine_compact_keys(key_cols):
             return None
         strides.append(d)
         K *= d
-        if K > (1 << 20):
+        if K > max_domain:
             return None
-    if len(key_cols) < 2:
-        return None  # single key is already one operand
     combined = None
     for c, d in zip(key_cols, strides):
         code = c.values.astype(jnp.int32)
         code = jnp.where(c.validity, code, jnp.int32(d - 1))
         combined = code if combined is None else combined * d + code
+    return combined, strides
+
+
+def combine_compact_keys(key_cols):
+    """Fuse group keys with STATICALLY-known small domains (dictionary-coded
+    strings, booleans) into one int32 code column: sorts and boundary checks
+    then touch a single operand instead of one per key (~6x cheaper multi-key
+    group-by). Nulls get their own code (Spark groups nulls together).
+    Returns None when any key's domain is unknown or the product overflows."""
+    if len(key_cols) < 2:
+        return None  # single key is already one operand
+    ks = compact_key_codes(key_cols)
+    if ks is None:
+        return None
+    combined, _ = ks
     return Col(combined, jnp.ones_like(combined, dtype=jnp.bool_), T.INT)
+
+
+def dense_group_sum(vals, mask, codes, n_domain: int, use_matmul: bool):
+    """(n_domain,) per-group totals of `vals` over UNSORTED small-domain
+    codes — no sort, no segment structure. CPU: D-bucket scatter-add. TPU:
+    one-hot matmul (MXU-shaped; a cap-length scatter would serialize there,
+    the round-2 wedge lesson)."""
+    v = jnp.where(mask, vals, jnp.zeros_like(vals))
+    if use_matmul:
+        want = v.dtype
+        if jnp.issubdtype(want, jnp.integer):
+            # integer matmul is not an MXU op; f64 (emulated ~49-bit
+            # mantissa on TPU) sums counts exactly to ~5e14
+            v = v.astype(jnp.float64)
+        onehot = (codes[:, None] == jnp.arange(n_domain, dtype=jnp.int32)
+                  [None, :]).astype(v.dtype)
+        out = v @ onehot
+        return out.astype(want) if out.dtype != want else out
+    out = jnp.zeros((n_domain + 1,), v.dtype)
+    return out.at[jnp.clip(codes, 0, n_domain)].add(v,
+                                                    mode="drop")[:n_domain]
 
 
 def group_segments(key_cols, num_rows, capacity: int):
