@@ -75,7 +75,11 @@ def child_main():
 
     platform = jax.devices()[0].platform
     paths = tpch.generate(TPCH_SF, DATA_DIR)
-    spark = TpuSession()
+    # COALESCING stitches the per-partition files into few large batches —
+    # fewer per-batch fixed costs; measured fastest on both backends at this
+    # scale (docs/tuning.md; reference COALESCING reader role)
+    spark = TpuSession({
+        "spark.rapids.tpu.sql.format.parquet.reader.type": "COALESCING"})
     dfs = tpch.load(spark, paths, files_per_partition=4)
     tb = tpch.load_np(paths)
     n_lineitem = len(tb["lineitem"]["l_orderkey"])
