@@ -319,7 +319,11 @@ def _coalesce(expr, kids, n):
 
 def _substring(expr, kids, n):
     from spark_rapids_tpu.ops.strings import java_substring
-    s, pos, ln = kids
+    if len(kids) == 2:      # substring(s, pos): to end of string
+        s, pos = kids
+        ln = HostCol([2**31 - 1] * n, T.INT)
+    else:
+        s, pos, ln = kids
     out = []
     for v, p, l in zip(s.data, pos.data, ln.data):
         out.append(None if (v is None or p is None or l is None)

@@ -285,6 +285,10 @@ class _ExprConverter:
             return Abs(c(a.args[0]))
         if name == "grouping":
             return _Grouping(c(a.args[0]))
+        if name in ("least", "greatest"):
+            from spark_rapids_tpu.expr.conditional import Greatest, Least
+            cls = Least if name == "least" else Greatest
+            return cls(*[c(x) for x in a.args])
         if name in ("upper", "ucase"):
             from spark_rapids_tpu.expr.strings import Upper
             return Upper(c(a.args[0]))
