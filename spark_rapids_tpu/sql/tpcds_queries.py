@@ -521,3 +521,67 @@ select * from
              and household_demographics.hd_vehicle_count <= 3))
     and store.s_store_name = 'store0') s8
 """
+
+# -- SQL-only additions (no DataFrame adaptation exists; oracles in
+# benchmarks/tpcds.py np_q13/np_q36). State lists substitute the generator's
+# 8-state domain; q36 carries deterministic ORDER BY tie-breaks.
+
+SQL_QUERIES["q13"] = """
+select avg(ss_quantity) aq, avg(ss_ext_sales_price) ap,
+       avg(ss_ext_wholesale_cost) aw, sum(ss_ext_wholesale_cost) sw
+from store_sales, store, customer_demographics, household_demographics,
+     customer_address, date_dim
+where s_store_sk = ss_store_sk
+  and ss_sold_date_sk = d_date_sk and d_year = 2001
+  and ((ss_hdemo_sk = hd_demo_sk
+        and cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = 'M'
+        and cd_education_status = 'Advanced Degree'
+        and ss_sales_price between 100.00 and 200.00
+        and hd_dep_count = 3)
+       or (ss_hdemo_sk = hd_demo_sk
+           and cd_demo_sk = ss_cdemo_sk
+           and cd_marital_status = 'S'
+           and cd_education_status = 'College'
+           and ss_sales_price between 50.00 and 150.00
+           and hd_dep_count = 1)
+       or (ss_hdemo_sk = hd_demo_sk
+           and cd_demo_sk = ss_cdemo_sk
+           and cd_marital_status = 'W'
+           and cd_education_status = '2 yr Degree'
+           and ss_sales_price between 1.00 and 100.00
+           and hd_dep_count = 1))
+  and ((ss_addr_sk = ca_address_sk
+        and ca_country = 'United States'
+        and ca_state in ('CA', 'TX', 'OH')
+        and ss_net_profit between 0 and 2000)
+       or (ss_addr_sk = ca_address_sk
+           and ca_country = 'United States'
+           and ca_state in ('NY', 'GA', 'WA')
+           and ss_net_profit between 150 and 3000)
+       or (ss_addr_sk = ca_address_sk
+           and ca_country = 'United States'
+           and ca_state in ('IL', 'MI', 'CA')
+           and ss_net_profit between 50 and 2500))
+"""
+
+SQL_QUERIES["q36"] = """
+select sum(ss_net_profit) / sum(ss_ext_sales_price) gross_margin,
+       i_category, i_class,
+       grouping(i_category) + grouping(i_class) lochierarchy,
+       rank() over (partition by grouping(i_category) + grouping(i_class),
+                    case when grouping(i_class) = 0 then i_category end
+                    order by sum(ss_net_profit) / sum(ss_ext_sales_price)
+                    asc) rank_within_parent
+from store_sales, date_dim d1, item, store
+where d1.d_year = 2001
+  and d1.d_date_sk = ss_sold_date_sk
+  and i_item_sk = ss_item_sk
+  and s_store_sk = ss_store_sk
+  and s_state in ('CA', 'TX', 'NY', 'GA', 'OH', 'WA', 'IL', 'MI')
+group by rollup (i_category, i_class)
+order by lochierarchy desc,
+         case when lochierarchy = 0 then i_category end,
+         rank_within_parent, i_category, i_class
+limit 100
+"""

@@ -23,15 +23,23 @@ def _rows(df):
     return [tuple(r.values()) for r in df.collect().to_pylist()]
 
 
+# SQL-only queries (no DataFrame adaptation): oracle fn + float columns
+_SQL_ONLY = {
+    "q13": (tpcds.np_q13, {0, 1, 2, 3}),
+    "q36": (tpcds.np_q36, {0}),
+    # q27 runs the official rollup shape (the DataFrame adaptation omits
+    # the rollup levels); g_state shifts the float slots right by one
+    "q27": (tpcds.np_q27_rollup, {3, 4, 5, 6}),
+}
+
+
 @pytest.mark.parametrize("name", sorted(SQL_QUERIES, key=lambda q: int(q[1:])))
 def test_sql_query_matches_oracle(data, name):
     spark, tb = data
     got = _rows(spark.sql(SQL_QUERIES[name]))
-    if name == "q27":
-        # official rollup shape (the DataFrame adaptation omits the rollup
-        # levels); g_state column shifts the float slots right by one
-        exp = [tuple(r) for r in tpcds.np_q27_rollup(tb)]
-        float_cols = {3, 4, 5, 6}
+    if name in _SQL_ONLY:
+        oracle, float_cols = _SQL_ONLY[name]
+        exp = [tuple(r) for r in oracle(tb)]
     else:
         exp = [tuple(r) for r in tpcds.NP_QUERIES[name](tb)]
         float_cols = tpcds.FLOAT_COLS[name]
