@@ -33,20 +33,30 @@ def concat_batches(batches) -> ColumnarBatch:
 
     def kernel(per_col, counts_v):
         from spark_rapids_tpu.ops.strings import align_many
-        from spark_rapids_tpu.ops.filtering import compact_cols, slice_to_capacity
-        live = jnp.concatenate([
-            jnp.arange(c, dtype=jnp.int32) < counts_v[i]
-            for i, c in enumerate(caps)])
-        merged = []
+        # ordered dynamic_update_slice writes: batch i+1's window starts at
+        # off_i + count_i, overwriting batch i's padding tail — pure copies,
+        # no gather-based compaction. The work buffer is over-allocated by
+        # max(caps) so off_i + cap_i can never exceed it (jax clamps
+        # out-of-range dus starts, which would silently corrupt).
+        offs = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                jnp.cumsum(counts_v)[:-1].astype(jnp.int32)])
+        wcap = cap + max(caps)
+        total_t = jnp.sum(counts_v)
+        live = jnp.arange(cap, dtype=jnp.int32) < total_t
+        out = []
         for cols in per_col:
             if cols[0].is_string:
                 cols = align_many(cols)
-            merged.append(Col(
-                jnp.concatenate([c.values for c in cols]),
-                jnp.concatenate([c.validity for c in cols]),
-                cols[0].dtype, cols[0].dictionary))
-        compacted, count = compact_cols(merged, live)
-        return slice_to_capacity(compacted, count, cap)
+            v = jnp.zeros((wcap,), cols[0].values.dtype)
+            m = jnp.zeros((wcap,), jnp.bool_)
+            for i, c in enumerate(cols):
+                v = jax.lax.dynamic_update_slice(v, c.values, (offs[i],))
+                m = jax.lax.dynamic_update_slice(m, c.validity, (offs[i],))
+            # input pad regions hold canonical defaults (zeros), so the only
+            # cleanup is masking validity beyond the live total
+            out.append(Col(v[:cap], m[:cap] & live, cols[0].dtype,
+                           cols[0].dictionary))
+        return out
 
     per_col = [[Col.from_vector(b.column(ci)) for b in batches]
                for ci in range(ncols)]
