@@ -291,3 +291,32 @@ def test_variable_width_rows_through_session():
     buf, schema = df.collect_row_buffer()
     df2 = spark.create_dataframe_from_rows(buf, schema)
     assert df2.collect().to_pylist() == t.to_pylist()
+
+
+def test_concat_batches_edge_cases():
+    """Ordered-dus concat (r4): later windows overwrite earlier padding;
+    zero-row batches, mixed capacities, and cross-batch dictionaries."""
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.ops.concat import concat_batches
+
+    def B(d):
+        return ColumnarBatch.from_arrow(pa.table(d))
+
+    b1 = B({"s": pa.array(["b", "a", None]),
+            "v": pa.array([1, 2, None], pa.int64())})
+    b2 = B({"s": pa.array(["z"] * 9), "v": pa.array(range(9), pa.int64())})
+    b3 = B({"s": pa.array([None, "a"]), "v": pa.array([None, 100], pa.int64())})
+    out = concat_batches([b1, b2, b3]).to_arrow()
+    assert out.column("s").to_pylist() == ["b", "a", None] + ["z"] * 9 + [None, "a"]
+    assert out.column("v").to_pylist() == [1, 2, None] + list(range(9)) + [None, 100]
+
+    b0 = ColumnarBatch.from_arrow(pa.table({"s": pa.array([], pa.string()),
+                                            "v": pa.array([], pa.int64())}))
+    out = concat_batches([b1, b0, b3]).to_arrow()
+    assert out.column("v").to_pylist() == [1, 2, None, None, 100]
+
+    big = B({"s": pa.array([f"k{i % 5}" for i in range(500)]),
+             "v": pa.array(range(500), pa.int64())})
+    out = concat_batches([b1, big]).to_arrow()
+    assert out.num_rows == 503
+    assert out.column("v").to_pylist()[3:] == list(range(500))
