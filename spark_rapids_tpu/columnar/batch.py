@@ -67,7 +67,9 @@ class ColumnarBatch:
         n = self.num_rows
         names = (self.schema.names if self.schema is not None
                  else [f"c{i}" for i in range(self.num_cols)])
-        return pa.table({name: col.to_arrow(n) for name, col in zip(names, self.columns)})
+        # from_arrays, not a dict: Spark allows duplicate output column names
+        return pa.Table.from_arrays(
+            [col.to_arrow(n) for col in self.columns], names=list(names))
 
     @staticmethod
     def from_arrow(table, schema: T.StructType | None = None) -> "ColumnarBatch":

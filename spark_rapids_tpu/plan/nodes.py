@@ -52,8 +52,9 @@ class PlanNode:
         return pa.concat_tables(tables) if tables else self._empty()
 
     def _empty(self) -> pa.Table:
-        return pa.table({f.name: pa.array([], T.to_arrow_type(f.data_type))
-                         for f in self.output})
+        return pa.Table.from_arrays(
+            [pa.array([], T.to_arrow_type(f.data_type)) for f in self.output],
+            names=[f.name for f in self.output])
 
     def name(self) -> str:
         return type(self).__name__.replace("Node", "")
@@ -73,7 +74,8 @@ def _project_table(tbl: pa.Table, exprs, out_schema: T.StructType) -> pa.Table:
     for e, f in zip(exprs, out_schema):
         hc = eval_host(e, tbl)
         cols.append(pa.array(hc.data, T.to_arrow_type(f.data_type)))
-    return pa.table({f.name: c for f, c in zip(out_schema, cols)})
+    # from_arrays, not a dict: duplicate output names must survive
+    return pa.Table.from_arrays(list(cols), names=[f.name for f in out_schema])
 
 
 def _expr_name(e: E.Expression, i: int) -> str:
@@ -677,6 +679,36 @@ class WindowNode(PlanNode):
         tbl = pa.concat_tables([self.child.execute_host(i)
                                 for i in range(self.child.num_partitions)])
         return host_window(self, tbl)
+
+
+def build_rollup_expand(child: "PlanNode", keys: list):
+    """ROLLUP lowering shared by the SQL front-end and DataFrame.rollup():
+    one Expand projection per hierarchy level with nulled-out suffix group
+    columns + a grouping-id literal (Spark's Expand form of rollup;
+    reference GpuExpandExec role). `keys` must be BOUND column references.
+    Returns (expand_node, group_refs, gid_ref)."""
+    fields = list(child.output.fields)
+    n = len(keys)
+    projections = []
+    for level in range(n, -1, -1):
+        gid = (1 << (n - level)) - 1
+        proj = [E.BoundReference(i, f.data_type, f.nullable, f.name)
+                for i, f in enumerate(fields)]
+        for gi, g in enumerate(keys):
+            proj.append(g if gi < level else E.Literal(None, g.dtype))
+        proj.append(E.Literal(gid, T.INT))
+        projections.append(proj)
+    out_fields = fields + [
+        T.StructField(f"_g{i}", g.dtype, True) for i, g in enumerate(keys)
+    ] + [T.StructField("_gid", T.INT, False)]
+    expand = ExpandNode(projections, out_fields, child)
+    base = len(fields)
+    group_refs = [
+        E.BoundReference(base + i, g.dtype, True,
+                         getattr(g, "name", None) or f"_g{i}")
+        for i, g in enumerate(keys)]
+    gid_ref = E.BoundReference(base + n, T.INT, False, "_gid")
+    return expand, group_refs, gid_ref
 
 
 class ExpandNode(PlanNode):

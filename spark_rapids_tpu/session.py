@@ -66,6 +66,12 @@ class DataFrame:
     def group_by(self, *keys) -> "GroupedData":
         return GroupedData([_to_expr(k) for k in keys], self)
 
+    def rollup(self, *keys) -> "RollupData":
+        """df.rollup(a, b).agg(...) — hierarchical subtotals via Expand with
+        a grouping-id column, Spark's own lowering (the SQL front-end's
+        GROUP BY ROLLUP takes the same path; reference GpuExpandExec role)."""
+        return RollupData([_to_expr(k) for k in keys], self)
+
     def agg(self, *aggs) -> "DataFrame":
         return GroupedData([], self).agg(*aggs)
 
@@ -326,6 +332,41 @@ class GroupedData:
         host form for plans that carry it directly."""
         return PivotedGroupedData(self.keys, self.df, _to_expr(pivot_col),
                                   list(values))
+
+
+class RollupData:
+    """GROUP BY ROLLUP over plain columns (Expand + grouping-id, like the
+    SQL lowering sql/lower.py _expand_rollup)."""
+
+    def __init__(self, keys: list, df: DataFrame):
+        for k in keys:
+            if not isinstance(k, (E.AttributeReference, E.BoundReference)):
+                raise ValueError("rollup supports plain columns only")
+        self.keys = [E.bind_references(k, df._plan.output) for k in keys]
+        self.df = df
+
+    def agg(self, *aggs) -> DataFrame:
+        named = []
+        for a in aggs:
+            e = _to_expr(a)
+            inner = e.child if isinstance(e, E.Alias) else e
+            if not isinstance(inner, AggregateFunction):
+                raise ValueError(
+                    f"rollup().agg() requires aggregate expressions, got {e!r}"
+                    " (pandas aggregate UDFs are not supported under rollup)")
+            named.append(e)
+        expand, group_refs, gid_ref = NN.build_rollup_expand(
+            self.df._plan, self.keys)
+        group_named = [E.Alias(r, r.name) for r in group_refs]
+        agg_node = NN.AggregateNode(group_named + [E.Alias(gid_ref, "_gid")],
+                                    named, expand)
+        # drop the grouping-id column from the visible output — POSITIONALLY
+        # (an agg alias may collide with a key name)
+        gid_pos = len(group_refs)
+        keep = [E.Alias(E.BoundReference(i, f.data_type, f.nullable, f.name),
+                        f.name)
+                for i, f in enumerate(agg_node.output) if i != gid_pos]
+        return DataFrame(NN.ProjectNode(keep, agg_node), self.df.session)
 
 
 def _to_schema(schema) -> T.StructType:

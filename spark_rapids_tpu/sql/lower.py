@@ -998,39 +998,13 @@ class _Lowerer:
         return Cast(BitwiseAnd(shifted, E.Literal(1)), T.INT)
 
     def _expand_rollup(self, plan, group_es):
-        """ExpandNode projecting one copy of the input per rollup level with
-        nulled-out suffix group columns + a grouping-id literal (Spark's
-        Expand lowering of rollup; reference GpuExpandExec role)."""
-        child_fields = list(plan.output.fields)
-        n = len(group_es)
+        """Spark's Expand lowering of ROLLUP (shared with DataFrame.rollup:
+        plan/nodes.py build_rollup_expand)."""
         for g in group_es:
             if not isinstance(g, (E.BoundReference, E.AttributeReference)):
                 raise SqlAnalysisError(
                     "GROUP BY ROLLUP supports plain columns only")
-        projections = []
-        for level in range(n, -1, -1):      # n..0 kept prefix columns
-            gid = (1 << (n - level)) - 1
-            proj = [E.BoundReference(i, f.data_type, f.nullable, f.name)
-                    for i, f in enumerate(child_fields)]
-            for gi, g in enumerate(group_es):
-                proj.append(g if gi < level
-                            else E.Literal(None, g.dtype))
-            proj.append(E.Literal(gid, T.INT))
-            projections.append(proj)
-        out_fields = child_fields + [
-            T.StructField(f"_g{i}", g.dtype, True)
-            for i, g in enumerate(group_es)
-        ] + [T.StructField("_gid", T.INT, False)]
-        expand = NN.ExpandNode(projections, out_fields, plan)
-        base = len(child_fields)
-        group_refs = [E.BoundReference(base + i, g.dtype, True, self._gname(g))
-                      for i, g in enumerate(group_es)]
-        gid_ref = E.BoundReference(base + n, T.INT, False, "_gid")
-        return expand, group_refs, gid_ref
-
-    @staticmethod
-    def _gname(g):
-        return getattr(g, "name", None) or "g"
+        return NN.build_rollup_expand(plan, group_es)
 
     # -- ORDER BY over a union (names/ordinals only) --------------------------
     def _order_union(self, plan, order_items):

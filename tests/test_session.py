@@ -161,3 +161,44 @@ def test_dataframe_api_completeness():
     # each partition independently ordered (partitions of sizes 3 and 2)
     vals = swp["a"].to_pylist()
     assert vals[:3] == sorted(vals[:3]) and vals[3:] == sorted(vals[3:])
+
+
+def test_dataframe_rollup():
+    """df.rollup(a, b).agg(...) produces base + subtotal + grand-total rows
+    (Spark rollup; same Expand lowering as SQL's GROUP BY ROLLUP)."""
+    import pyarrow as pa
+    import spark_rapids_tpu.functions as F
+    from spark_rapids_tpu.session import TpuSession
+    spark = TpuSession()
+    t = pa.table({"a": pa.array(["x", "x", "y"]),
+                  "b": pa.array(["p", "q", "p"]),
+                  "v": pa.array([1.0, 2.0, 4.0])})
+    out = (spark.create_dataframe(t).rollup("a", "b")
+           .agg(F.sum(F.col("v")).alias("s"), F.count().alias("n"))
+           .collect().to_pylist())
+    rows = {(r["a"], r["b"]): (r["s"], r["n"]) for r in out}
+    assert rows == {
+        ("x", "p"): (1.0, 1), ("x", "q"): (2.0, 1), ("y", "p"): (4.0, 1),
+        ("x", None): (3.0, 2), ("y", None): (4.0, 1),
+        (None, None): (7.0, 3),
+    }, rows
+
+
+def test_dataframe_rollup_alias_collision_and_validation():
+    import pyarrow as pa
+    import pytest
+    import spark_rapids_tpu.functions as F
+    from spark_rapids_tpu.session import TpuSession
+    spark = TpuSession()
+    t = pa.table({"a": pa.array(["x", "x", "y"]),
+                  "v": pa.array([1.0, 2.0, 4.0])})
+    df = spark.create_dataframe(t)
+    # agg alias colliding with the key name stays a distinct column
+    out = df.rollup("a").agg(F.max(F.col("v")).alias("a")).collect()
+    assert out.num_columns == 2
+    rows = {r[0]: r[1] for r in zip(out.column(0).to_pylist(),
+                                    out.column(1).to_pylist())}
+    assert rows == {"x": 2.0, "y": 4.0, None: 4.0}, rows
+    # non-aggregate expressions are a plan-time error
+    with pytest.raises(ValueError, match="aggregate expressions"):
+        df.rollup("a").agg(F.col("v"))
