@@ -260,6 +260,13 @@ MULTITHREADED_READ_NUM_THREADS = conf(
     "Thread pool size for the multithreaded reader (reference "
     "multiThreadedRead.numThreads)").integer_conf(20)
 
+PARQUET_WRITER_TYPE = conf("spark.rapids.tpu.sql.format.parquet.writer.type").doc(
+    "NATIVE encodes Parquet pages from device columns (stats + null "
+    "compaction on device, thrift framing on host — reference "
+    "ColumnarOutputWriter.scala device-buffer write); ARROW round-trips "
+    "through host pyarrow. NATIVE falls back to ARROW for unsupported "
+    "schemas (lists, decimal>18) and partitioned writes.").string_conf("NATIVE")
+
 CSV_ENABLED = conf("spark.rapids.tpu.sql.format.csv.enabled").doc(
     "Enable accelerated CSV reading (reference spark.rapids.sql.format.csv.enabled)"
 ).boolean_conf(True)

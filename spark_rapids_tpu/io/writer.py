@@ -57,7 +57,8 @@ class _TaskWriter:
     (reference GpuFileFormatDataWriter SingleDirectory/DynamicPartition writers)."""
 
     def __init__(self, temp_dir: str, task_id: int, fmt: str, compression: str,
-                 partition_by: list, schema: T.StructType, job_uuid: str):
+                 partition_by: list, schema: T.StructType, job_uuid: str,
+                 native_parquet: bool = False):
         self.temp = os.path.join(temp_dir, f"task_{task_id}")
         os.makedirs(self.temp, exist_ok=True)
         self.fmt = fmt
@@ -68,6 +69,7 @@ class _TaskWriter:
         self._file_counter = 0
         self._task_id = task_id
         self._job_uuid = job_uuid
+        self.native_parquet = native_parquet
 
     def _next_name(self, subdir: str = "") -> str:
         # job-unique uuid in the filename (Spark's FileOutputCommitter naming)
@@ -80,6 +82,34 @@ class _TaskWriter:
         d = os.path.join(self.temp, subdir)
         os.makedirs(d, exist_ok=True)
         return os.path.join(d, name)
+
+    def write_batch(self, batch):
+        """Device-path write: encode Parquet pages straight from the device
+        columns (reference ColumnarOutputWriter device-buffer write). Falls
+        back to the arrow path for partitioned writes, non-parquet formats,
+        and schemas the native encoder can't frame."""
+        if self.native_parquet and not self.partition_by:
+            from spark_rapids_tpu.io import parquet_write_native as pwn
+            from spark_rapids_tpu.columnar.batch import ColumnarBatch
+            if (isinstance(batch, ColumnarBatch)
+                    and pwn.supports_schema(self.schema)
+                    and all(type(c).__name__ == "TpuColumnVector"
+                            for c in batch.columns)):
+                path = self._next_name()
+                try:
+                    nbytes = pwn.write_batch_file(
+                        path, batch, self.schema, self.compression)
+                except (TypeError, ValueError):
+                    # codec/schema edge the probe missed — arrow fallback
+                    if os.path.exists(path):
+                        os.unlink(path)
+                    self._file_counter -= 1
+                else:
+                    self.stats.num_files += 1
+                    self.stats.num_rows += batch.num_rows
+                    self.stats.num_bytes += nbytes
+                    return
+        self.write(batch.to_arrow())
 
     def write(self, tbl: pa.Table):
         if not self.partition_by:
@@ -125,7 +155,7 @@ class _TaskWriter:
 
 def write_columnar(exec_or_node, path: str, fmt: str = "parquet",
                    partition_by: list | None = None, compression: str = "snappy",
-                   mode: str = "error") -> WriteStats:
+                   mode: str = "error", conf=None) -> WriteStats:
     """Write a device exec's (or host node's) output — the
     GpuInsertIntoHadoopFsRelationCommand analog (job setup → per-partition task
     writers → commit + _SUCCESS)."""
@@ -148,15 +178,20 @@ def write_columnar(exec_or_node, path: str, fmt: str = "parquet",
     schema = exec_or_node.output
     total = WriteStats()
     lock = threading.Lock()
+    native_parquet = fmt == "parquet"
+    if conf is not None:
+        from spark_rapids_tpu import config as CFG
+        native_parquet = (native_parquet and
+                          conf.get(CFG.PARQUET_WRITER_TYPE).upper() == "NATIVE")
 
     def run_split(split):
         writer = _TaskWriter(temp_dir, split, fmt, compression, partition_by,
-                             schema, job_uuid)
+                             schema, job_uuid, native_parquet=native_parquet)
         try:
             if isinstance(exec_or_node, TpuExec):
                 with TaskContext():
                     for batch in exec_or_node.execute_partition(split):
-                        writer.write(batch.to_arrow())
+                        writer.write_batch(batch)
             else:
                 writer.write(exec_or_node.execute_host(split))
             writer.commit(path)

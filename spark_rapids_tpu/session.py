@@ -263,7 +263,7 @@ class DataFrame:
         from spark_rapids_tpu.io.writer import write_columnar
         hybrid = TpuOverrides(self.session.conf).apply(self._plan)
         return write_columnar(hybrid, path, fmt, partition_by=partition_by,
-                              mode=mode)
+                              mode=mode, conf=self.session.conf)
 
 
 class GroupedData:

@@ -1,0 +1,185 @@
+"""Native (device-encode) Parquet writer tests — VERDICT r3 weak #7.
+
+Round-trips files produced by io/parquet_write_native through BOTH pyarrow
+(independent reader — framing/thrift must be spec-exact) and the engine's own
+scan path. Reference suite analog: ParquetWriterSuite.scala."""
+
+import datetime
+import decimal
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.io import FileScanNode
+from spark_rapids_tpu.io.parquet_write_native import (
+    NativeParquetFile, supports_schema, write_batch_file)
+
+UTC = datetime.timezone.utc
+
+
+@pytest.fixture
+def spark():
+    from spark_rapids_tpu.session import TpuSession
+    return TpuSession()
+
+
+@pytest.fixture
+def spark_factory():
+    from spark_rapids_tpu.session import TpuSession
+    return TpuSession
+
+
+@pytest.fixture
+def typed_table():
+    return pa.table({
+        "i64": pa.array([5, None, 3, -2**40, 0], pa.int64()),
+        "i32": pa.array([1, 2, None, -4, 5], pa.int32()),
+        "i16": pa.array([1, None, -3, 4, 5], pa.int16()),
+        "i8": pa.array([7, None, 2, 1, -1], pa.int8()),
+        "f32": pa.array([1.0, None, 2.5, -3.25, 0.5], pa.float32()),
+        "f64": pa.array([1.5, float("nan"), None, -0.25, 2.0], pa.float64()),
+        "b": pa.array([True, False, None, True, False], pa.bool_()),
+        "s": pa.array(["b", "a", None, "cc", "a"], pa.string()),
+        "dt": pa.array([datetime.date(2020, 1, 1), None,
+                        datetime.date(1969, 12, 31),
+                        datetime.date(2024, 2, 29),
+                        datetime.date(1970, 1, 1)], pa.date32()),
+        "ts": pa.array([datetime.datetime(2020, 1, 1, 12, 30, tzinfo=UTC),
+                        None, None,
+                        datetime.datetime(1960, 5, 5, tzinfo=UTC),
+                        datetime.datetime(2038, 1, 19, 3, 14, tzinfo=UTC)],
+                       pa.timestamp("us", tz="UTC")),
+        "dec": pa.array([decimal.Decimal("12.34"), None,
+                         decimal.Decimal("-0.01"),
+                         decimal.Decimal("99999.99"),
+                         decimal.Decimal("0.00")], pa.decimal128(10, 2)),
+    })
+
+
+def _pylist_eq(got: pa.Table, exp: pa.Table):
+    assert got.num_rows == exp.num_rows
+    for name in exp.column_names:
+        g, e = got.column(name).to_pylist(), exp.column(name).to_pylist()
+        for a, b in zip(g, e):
+            if (isinstance(a, float) and isinstance(b, float)
+                    and np.isnan(b)):
+                assert np.isnan(a), (name, a, b)
+            else:
+                assert a == b, (name, a, b)
+
+
+@pytest.mark.parametrize("codec", ["snappy", "gzip", "uncompressed"])
+def test_roundtrip_pyarrow_all_types(tmp_path, typed_table, codec):
+    batch = ColumnarBatch.from_arrow(typed_table)
+    path = str(tmp_path / "t.parquet")
+    write_batch_file(path, batch, batch.schema, codec)
+    back = pq.read_table(path)
+    # types survive exactly (logical/converted types in the thrift schema)
+    for name in typed_table.column_names:
+        assert back.column(name).type == typed_table.column(name).type, name
+    _pylist_eq(back, typed_table)
+
+
+def test_roundtrip_own_reader(tmp_path, typed_table):
+    batch = ColumnarBatch.from_arrow(typed_table)
+    path = str(tmp_path / "t.parquet")
+    write_batch_file(path, batch, batch.schema, "snappy")
+    got = FileScanNode(path, "parquet").collect_host()
+    _pylist_eq(got, typed_table)
+
+
+def test_statistics_written(tmp_path, typed_table):
+    batch = ColumnarBatch.from_arrow(typed_table)
+    path = str(tmp_path / "t.parquet")
+    write_batch_file(path, batch, batch.schema, "snappy")
+    md = pq.ParquetFile(path).metadata
+    by_name = {md.row_group(0).column(i).path_in_schema:
+               md.row_group(0).column(i).statistics
+               for i in range(md.num_columns)}
+    assert by_name["i64"].min == -2**40 and by_name["i64"].max == 5
+    assert by_name["i64"].null_count == 1
+    assert by_name["s"].min == "a" and by_name["s"].max == "cc"
+    assert by_name["b"].min is False and by_name["b"].max is True
+    # f64 contains NaN -> min/max suppressed, null_count still honest
+    assert by_name["f64"].null_count == 1
+
+
+def test_multiple_row_groups(tmp_path):
+    tbl = pa.table({"x": pa.array(range(100), pa.int64())})
+    b1 = ColumnarBatch.from_arrow(tbl.slice(0, 60))
+    b2 = ColumnarBatch.from_arrow(tbl.slice(60, 40))
+    path = str(tmp_path / "t.parquet")
+    f = NativeParquetFile(path, b1.schema, "gzip")
+    f.append_batch(b1)
+    f.append_batch(b2)
+    f.close()
+    md = pq.ParquetFile(path).metadata
+    assert md.num_row_groups == 2
+    assert [md.row_group(i).num_rows for i in range(2)] == [60, 40]
+    assert pq.read_table(path).column("x").to_pylist() == list(range(100))
+
+
+def test_all_null_and_empty_strings(tmp_path):
+    tbl = pa.table({
+        "s": pa.array([None, None, None], pa.string()),
+        "i": pa.array([None, None, None], pa.int64()),
+        "e": pa.array(["", "x", ""], pa.string()),
+    })
+    batch = ColumnarBatch.from_arrow(tbl)
+    path = str(tmp_path / "t.parquet")
+    write_batch_file(path, batch, batch.schema, "snappy")
+    _pylist_eq(pq.read_table(path), tbl)
+
+
+def test_zero_rows(tmp_path):
+    tbl = pa.table({"x": pa.array([], pa.int64()),
+                    "s": pa.array([], pa.string())})
+    batch = ColumnarBatch.from_arrow(tbl)
+    path = str(tmp_path / "t.parquet")
+    write_batch_file(path, batch, batch.schema, "snappy")
+    back = pq.read_table(path)
+    assert back.num_rows == 0
+    assert back.column_names == ["x", "s"]
+
+
+def test_unsupported_schema_probe():
+    assert not supports_schema(T.StructType([
+        T.StructField("a", T.ArrayType(T.INT), True)]))
+    assert supports_schema(T.StructType([
+        T.StructField("a", T.INT, True)]))
+
+
+def test_session_write_uses_native(spark, tmp_path, typed_table):
+    """End-to-end: DataFrame.write_parquet routes through the native encoder
+    (created_by marker proves which writer produced the file)."""
+    df = spark.create_dataframe(typed_table)
+    out = str(tmp_path / "out")
+    stats = df.write_parquet(out)
+    assert stats.num_rows == typed_table.num_rows
+    files = [f for f in os.listdir(out) if f.endswith(".parquet")]
+    assert files
+    md = pq.ParquetFile(os.path.join(out, files[0])).metadata
+    assert b"spark-rapids-tpu native" in md.created_by.encode()
+    back = spark.read_parquet(out).collect()
+    got = pa.Table.from_arrays(
+        [back.column(n) for n in typed_table.column_names],
+        names=typed_table.column_names)
+    _pylist_eq(got, typed_table)
+
+
+def test_session_write_arrow_override(spark_factory, tmp_path):
+    """writer.type=ARROW keeps the old pyarrow path."""
+    spark = spark_factory({
+        "spark.rapids.tpu.sql.format.parquet.writer.type": "ARROW"})
+    t = pa.table({"x": pa.array([1, 2, 3], pa.int64())})
+    out = str(tmp_path / "out")
+    spark.create_dataframe(t).write_parquet(out)
+    files = [f for f in os.listdir(out) if f.endswith(".parquet")]
+    md = pq.ParquetFile(os.path.join(out, files[0])).metadata
+    assert b"spark-rapids-tpu native" not in md.created_by.encode()

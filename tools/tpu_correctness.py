@@ -152,9 +152,11 @@ def main():
     log_result(ok, detail, "correctness-subset probe")
     if not ok:
         sys.exit(1)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
     proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--child"],
-        env=dict(os.environ), stdout=subprocess.PIPE,
+        env=env, stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT, text=True)
     out = proc.stdout or ""
     print(out[-3000:])

@@ -1,0 +1,131 @@
+"""Background TPU-availability watcher (round 4).
+
+The tunnel flaps: 16 probes failed over 6h, then it answered at 03:46 UTC,
+then wedged again at 04:02 after an external kill. This watcher closes the
+loop the VERDICT asked for — probe often, and the MOMENT the chip answers,
+run the two on-chip deliverables before it can wedge again:
+
+  1. tools/tpu_correctness.py  -> TPU_CORRECTNESS.json  (numeric-regime subset)
+  2. bench.py                  -> BENCH_ONCHIP.json     (TPC-H ladder, value-checked)
+
+Every attempt is logged to docs/perf_notes.md via tpu_probe.log_result.
+Exits when both artifacts exist with platform=tpu, or when --max-hours is up.
+
+Usage: python tools/tpu_watcher.py [--interval 240] [--max-hours 10]
+"""
+
+import argparse
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+from tpu_probe import probe, log_result  # noqa: E402
+
+
+def _have_correctness():
+    p = REPO / "TPU_CORRECTNESS.json"
+    if not p.exists():
+        return False
+    try:
+        return json.loads(p.read_text()).get("platform") == "tpu"
+    except (ValueError, OSError):
+        return False
+
+
+def _have_bench():
+    p = REPO / "BENCH_ONCHIP.json"
+    if not p.exists():
+        return False
+    try:
+        d = json.loads(p.read_text())
+        return d.get("value", 0) > 0 and "degraded" not in d
+    except (ValueError, OSError):
+        return False
+
+
+def _run_correctness():
+    # generous budget, but bounded: a child hung on a wedged tunnel is not a
+    # live dispatch (the tunnel is already gone), and an unbounded wait would
+    # defeat --max-hours entirely
+    try:
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "tpu_correctness.py"),
+             "--out", str(REPO / "TPU_CORRECTNESS.json")],
+            cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, timeout=3600)
+    except subprocess.TimeoutExpired:
+        log_result(False, "correctness child hit 3600s watcher budget",
+                   "watcher")
+        return False
+    tail = (proc.stdout or "")[-1500:]
+    print(f"[watcher] correctness rc={proc.returncode}\n{tail}", flush=True)
+    return proc.returncode == 0
+
+
+def _run_bench():
+    # bench.py is self-probing and always prints one JSON line; budget covers
+    # its full ladder (2400s child + 1200s fallback + probes) with slack
+    try:
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "bench.py")],
+            cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, timeout=7200)
+    except subprocess.TimeoutExpired:
+        log_result(False, "bench hit 7200s watcher budget", "watcher")
+        return False
+    out = proc.stdout or ""
+    print(f"[watcher] bench rc={proc.returncode}: {out[-1000:]}", flush=True)
+    for ln in reversed(out.splitlines()):
+        ln = ln.strip()
+        if ln.startswith("{") and ln.endswith("}"):
+            try:
+                d = json.loads(ln)
+            except ValueError:
+                continue
+            if "metric" in d:
+                (REPO / "BENCH_ONCHIP.json").write_text(json.dumps(d, indent=1))
+                ok = "degraded" not in d
+                log_result(ok, f"bench {d['metric']} value={d['value']} "
+                               f"{d['unit']} vs_baseline={d['vs_baseline']}"
+                               + ("" if ok else f" DEGRADED {d['degraded'][:120]}"),
+                           "watcher on-chip bench")
+                return ok
+    return False
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=240.0)
+    ap.add_argument("--max-hours", type=float, default=10.0)
+    args = ap.parse_args()
+    deadline = time.time() + args.max_hours * 3600
+    n = 0
+    while time.time() < deadline:
+        n += 1
+        ok, detail = probe(75.0)
+        if not ok:
+            # don't spam the log with every failed probe; log every 4th
+            if n % 4 == 1:
+                log_result(False, detail, f"watcher probe #{n}")
+            time.sleep(args.interval)
+            continue
+        log_result(True, detail, f"watcher probe #{n}: chip is up")
+        if not _have_correctness():
+            _run_correctness()
+        if _have_correctness() and not _have_bench():
+            _run_bench()
+        if _have_correctness() and _have_bench():
+            print("[watcher] both on-chip artifacts captured; done", flush=True)
+            return 0
+        time.sleep(args.interval)
+    print("[watcher] deadline reached", flush=True)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
