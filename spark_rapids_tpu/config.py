@@ -383,7 +383,9 @@ OOM_DUMP_DIR = conf("spark.rapids.tpu.memory.hbm.oomDumpDir").doc(
 
 SPARK_VERSION = conf("spark.rapids.tpu.spark.version").doc(
     "Spark behavior generation to emulate; selects the semantic shim "
-    "(reference ShimLoader picks a per-release shim jar the same way)"
+    "(reference ShimLoader picks a per-release shim jar the same way). "
+    "A -<platform> suffix (3.0.1-databricks, 3.0.1-emr) selects that "
+    "platform's shim variant (reference spark301db/spark301emr/spark310db)"
 ).string_conf("3.5.0")
 
 PARQUET_DEVICE_DECODE = conf(

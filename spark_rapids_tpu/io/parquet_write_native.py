@@ -219,7 +219,9 @@ def _prep_column(col, num_rows: int):
     comp, cnt, nulls, vmin, vmax = k(col.data, col.validity,
                                      jnp.int32(num_rows))
     cnt = int(cnt)
-    return (np.asarray(comp)[:cnt], cnt, int(nulls),
+    # static device-side slice before transfer: capacities are power-of-two
+    # bucketed, so the padded tail can dwarf the live rows (to_host pattern)
+    return (np.asarray(comp[:num_rows])[:cnt], cnt, int(nulls),
             np.asarray(vmin)[()], np.asarray(vmax)[()])
 
 
@@ -329,7 +331,7 @@ def _stats_struct(w: _CompactWriter, fid: int, null_count: int,
 def _encode_column(col, dt: T.DataType, num_rows: int, codec: str):
     """Encode one column chunk: optional dictionary page + one v1 data page."""
     vals, n_valid, null_count, vmin, vmax = _prep_column(col, num_rows)
-    valid = (np.asarray(col.validity)[:num_rows] if null_count
+    valid = (np.asarray(col.validity[:num_rows]) if null_count
              else np.ones(num_rows, dtype=bool))
 
     pt, _, np_dt = _physical(dt)

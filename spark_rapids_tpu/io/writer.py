@@ -91,16 +91,24 @@ class _TaskWriter:
         if self.native_parquet and not self.partition_by:
             from spark_rapids_tpu.io import parquet_write_native as pwn
             from spark_rapids_tpu.columnar.batch import ColumnarBatch
+            from spark_rapids_tpu.columnar.vector import TpuColumnVector
             if (isinstance(batch, ColumnarBatch)
                     and pwn.supports_schema(self.schema)
-                    and all(type(c).__name__ == "TpuColumnVector"
+                    # exact type: subclasses (ListVector) carry structure the
+                    # flat encoder can't frame
+                    and all(type(c) is TpuColumnVector
                             for c in batch.columns)):
                 path = self._next_name()
                 try:
                     nbytes = pwn.write_batch_file(
                         path, batch, self.schema, self.compression)
-                except (TypeError, ValueError):
-                    # codec/schema edge the probe missed — arrow fallback
+                except (TypeError, ValueError) as e:
+                    # schema/codec are pre-validated, so this is an encoder
+                    # defect — fall back to arrow but never silently
+                    import warnings
+                    warnings.warn(
+                        f"native parquet encoder failed ({e!r}); "
+                        f"falling back to arrow writer for this task")
                     if os.path.exists(path):
                         os.unlink(path)
                     self._file_counter -= 1

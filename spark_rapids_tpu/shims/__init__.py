@@ -25,6 +25,9 @@ import numpy as np
 
 class SparkShim:
     version_prefix = "3.5"
+    #: "" = OSS Apache Spark; platform variants ("databricks", "emr") mirror
+    #: the reference's spark301db/spark301emr/spark310db shim modules
+    platform = ""
     #: accept lenient date strings ("2021-1-5", "2021/01/05") in cast
     lenient_string_to_date = False
     #: AQE (and with it post-shuffle partition coalescing) is default-ON
@@ -68,22 +71,89 @@ class Spark35Shim(SparkShim):
     version_prefix = "3.5"
 
 
+# -- platform-variant shims ---------------------------------------------------
+# The reference ships per-platform shim modules alongside the OSS ones
+# (shims/spark301db, shims/spark301emr, shims/spark310db — Databricks and
+# Amazon EMR builds of the same Spark release). The semantic deltas an engine
+# must honor:
+#  - Databricks Runtime enabled AQE by default from DBR 7.x (Spark 3.0),
+#    two releases before OSS flipped it in 3.2 (SPARK-33679), so the
+#    post-shuffle coalescing default differs from the same-numbered OSS shim.
+#  - EMR tracks OSS semantics; the reference's spark301emr module exists for
+#    packaging/classpath reasons, so its semantic shim is the OSS one with a
+#    distinct identity (tooling that logs the shim must see the platform).
+
+
+class Spark30DatabricksShim(Spark30Shim):
+    version_prefix = "3.0"
+    platform = "databricks"
+    adaptive_coalesce_default = True   # DBR 7.x default-on AQE
+
+
+class Spark31DatabricksShim(Spark31Shim):
+    version_prefix = "3.1"
+    platform = "databricks"
+    adaptive_coalesce_default = True
+
+
+class Spark30EmrShim(Spark30Shim):
+    version_prefix = "3.0"
+    platform = "emr"
+
+
+class Spark31EmrShim(Spark31Shim):
+    version_prefix = "3.1"
+    platform = "emr"
+
+
 _SHIMS = [Spark30Shim, Spark31Shim, Spark32Shim, Spark33Shim, Spark34Shim,
           Spark35Shim]
+
+#: platform -> ordered shim list; the ShimServiceProvider-discovery analog.
+#: register_shim() lets a deployment plug in its own platform the way the
+#: reference discovers shims through java.util.ServiceLoader
+#: (ShimLoader.scala:26-68).
+_PLATFORM_SHIMS = {
+    "": list(_SHIMS),
+    "databricks": [Spark30DatabricksShim, Spark31DatabricksShim],
+    "emr": [Spark30EmrShim, Spark31EmrShim],
+}
+
+
+def register_shim(shim_cls, platform: str = "") -> None:
+    """Add a shim to the selection table (ServiceLoader-registration analog).
+    Later registrations win ties on version_prefix."""
+    _PLATFORM_SHIMS.setdefault(platform, []).append(shim_cls)
 
 
 def load_shim(version: str) -> SparkShim:
     """Latest shim whose version_prefix <= requested version (ShimLoader's
-    getShimVersion selection)."""
+    getShimVersion selection). A `-<platform>` suffix ("3.0.1-databricks",
+    the spark.rapids.shims-provider-override analog) selects that platform's
+    shim set, falling back to OSS for generations the platform doesn't
+    specialize."""
+    version, _, platform = version.partition("-")
+
     def key(p):
         a, b = p.split(".")
         return (int(a), int(b))
     want = key(".".join(version.split(".")[:2]))
-    best = _SHIMS[0]
-    for s in _SHIMS:
-        if key(s.version_prefix) <= want:
-            best = s
-    return best()
+    candidates = list(_PLATFORM_SHIMS[""])
+    if platform:
+        if platform not in _PLATFORM_SHIMS:
+            raise ValueError(
+                f"unknown shim platform {platform!r}; registered: "
+                f"{sorted(p for p in _PLATFORM_SHIMS if p)}")
+        candidates += _PLATFORM_SHIMS[platform]
+    best, best_key = None, None
+    for s in candidates:
+        k = key(s.version_prefix)
+        if k <= want:
+            platform_match = getattr(s, "platform", "") == platform
+            rank = (k, platform_match)
+            if best_key is None or rank >= best_key:
+                best, best_key = s, rank
+    return (best or _SHIMS[0])()
 
 
 def shim_for(conf) -> SparkShim:

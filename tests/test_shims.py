@@ -179,3 +179,42 @@ def test_element_at_zero_shim_divergence():
     old = TpuSession({"spark.rapids.tpu.spark.version": "3.2.0"})
     with pytest.raises(RuntimeError, match="SQL array indices start at 1"):
         q(old).collect()
+
+
+def test_platform_variant_shims():
+    """Databricks/EMR shims (reference spark301db/spark301emr/spark310db):
+    DBR 7.x enabled AQE by default two releases before OSS 3.2; EMR tracks
+    OSS semantics under a distinct platform identity."""
+    from spark_rapids_tpu.shims import (
+        Spark30DatabricksShim, Spark30EmrShim, load_shim)
+    db = load_shim("3.0.1-databricks")
+    assert isinstance(db, Spark30DatabricksShim)
+    assert db.adaptive_coalesce_default          # OSS 3.0 has False
+    assert not load_shim("3.0.1").adaptive_coalesce_default
+    assert db.lenient_string_to_date             # inherits 3.0 semantics
+    emr = load_shim("3.0.1-emr")
+    assert isinstance(emr, Spark30EmrShim)
+    assert emr.platform == "emr"
+    assert not emr.adaptive_coalesce_default     # EMR == OSS semantics
+    assert load_shim("3.1.2-databricks").adaptive_coalesce_default
+    # platforms fall back to OSS shims for generations they don't specialize
+    assert load_shim("3.4.0-databricks").version_prefix == "3.4"
+    assert load_shim("3.4.0-databricks").platform == ""
+    with pytest.raises(ValueError):
+        load_shim("3.0.1-mapr")
+
+
+def test_register_shim_discovery():
+    """ServiceLoader-analog: a registered third-party shim participates in
+    selection and later registrations win ties (ShimLoader.scala:26-68)."""
+    from spark_rapids_tpu import shims as S
+
+    class CustomShim(S.Spark32Shim):
+        platform = "custom"
+    S.register_shim(CustomShim, "custom")
+    try:
+        assert isinstance(S.load_shim("3.2.0-custom"), CustomShim)
+        # OSS fallback above the registered prefix still applies
+        assert S.load_shim("3.5.0-custom").version_prefix == "3.5"
+    finally:
+        S._PLATFORM_SHIMS.pop("custom", None)
