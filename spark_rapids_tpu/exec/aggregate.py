@@ -46,18 +46,36 @@ class HashAggregateExec(TpuExec):
     """
 
     def __init__(self, group_exprs: list, agg_exprs: list, child: TpuExec,
-                 mode: str = COMPLETE, conf=None):
+                 mode: str = COMPLETE, conf=None, prefilter=None,
+                 preproject=None, prefilter_on_projected: bool = False):
         super().__init__(child, conf=conf)
         self.mode = mode
+        # whole-stage fusion (planner hoists child Filter/Project execs):
+        # `preproject` exprs re-derive the aggregation input inside the
+        # kernel; `prefilter` masks rows there (dense path) or compacts
+        # in-program (segment path) — no separate dispatches, no full-width
+        # intermediate batches. The reference gets this from whole-stage
+        # codegen feeding GpuHashAggregateExec; the fuse layer plays that
+        # role here. With preproject set, group/agg exprs must arrive BOUND
+        # against the hoisted project's output (the planner's logical nodes
+        # bind eagerly, so this holds by construction).
+        self.preproject = list(preproject) if preproject is not None else None
+        self.prefilter_on_projected = prefilter_on_projected
         if mode == FINAL:
             # keys are the first child columns; aggs reference state columns
             self.group_exprs = [bind_references(e, child.output)
                                 for e in group_exprs]
             self.agg_exprs = list(agg_exprs)
+        elif self.preproject is not None:
+            self.group_exprs = list(group_exprs)
+            self.agg_exprs = list(agg_exprs)
         else:
             self.group_exprs = [bind_references(e, child.output)
                                 for e in group_exprs]
             self.agg_exprs = [bind_references(e, child.output) for e in agg_exprs]
+        bind_to = child.output if not prefilter_on_projected else None
+        self.prefilter = (prefilter if prefilter is None or bind_to is None
+                          else bind_references(prefilter, bind_to))
         self._agg_time = self.metrics.metric(M.AGG_TIME, M.MODERATE)
         self._concat_time = self.metrics.metric(M.CONCAT_TIME, M.MODERATE)
 
@@ -91,14 +109,21 @@ class HashAggregateExec(TpuExec):
         from spark_rapids_tpu.expr.core import Col
         from spark_rapids_tpu.expr.misc import CONTEXT_SENSITIVE
         from spark_rapids_tpu.runtime import fuse
+        pre = self.prefilter if not merge else None
+        prep = self.preproject if not merge else None
         ctx_sensitive = any(
             e.collect(lambda x: isinstance(x, CONTEXT_SENSITIVE))
-            for e in (*self.group_exprs, *self.agg_exprs))
+            for e in (*self.group_exprs, *self.agg_exprs,
+                      *([pre] if pre is not None else []),
+                      *(prep or [])))
         if batch.columns and not ctx_sensitive:
             key = ("agg", merge, fuse.schema_key(
                 self._partial_schema() if merge else self.child.output),
                 tuple(fuse.expr_key(e) for e in self.group_exprs),
-                tuple(fuse.expr_key(e) for e in self.agg_exprs))
+                tuple(fuse.expr_key(e) for e in self.agg_exprs),
+                fuse.expr_key(pre) if pre is not None else None,
+                tuple(fuse.expr_key(e) for e in prep) if prep is not None
+                else None, self.prefilter_on_projected)
 
             def build():
                 def kernel(cols, num_rows):
@@ -120,21 +145,49 @@ class HashAggregateExec(TpuExec):
     def _agg_kernel(self, ctx: EvalContext, merge: bool):
         """Pure per-batch aggregation body (traceable)."""
         cap = ctx.capacity
+        keep = None
+
+        def eval_keep(c):
+            pred = self.prefilter.eval(c)
+            return (pred.values & pred.validity
+                    & (jnp.arange(cap, dtype=jnp.int32) < c.num_rows))
+
+        if not merge:
+            if self.prefilter is not None and not self.prefilter_on_projected:
+                keep = eval_keep(ctx)
+            if self.preproject is not None:
+                cols = [e.eval(ctx) for e in self.preproject]
+                ctx = EvalContext(cols, ctx.num_rows, cap)
+            if self.prefilter is not None and self.prefilter_on_projected:
+                keep = eval_keep(ctx)
         nkeys = len(self.group_exprs)
         if nkeys:
             if merge:
                 key_cols = [ctx.cols[i] for i in range(nkeys)]
             else:
                 key_cols = [e.eval(ctx) for e in self.group_exprs]
-            dense = self._agg_dense(ctx, merge, key_cols)
+            dense = self._agg_dense(ctx, merge, key_cols, live_mask=keep)
             if dense is not None:
                 return dense
+            if keep is not None:
+                # segment path sorts by key — masked rows must become padding,
+                # so compact first (still inside this one fused program)
+                new_cols, cnt = compact_cols(ctx.cols, keep)
+                ctx = EvalContext(new_cols, cnt, cap)
+                key_cols = [e.eval(ctx) for e in self.group_exprs]
+                keep = None
             combined = G.combine_compact_keys(key_cols)
             perm, seg_ids, boundary, live = G.group_segments(
                 [combined] if combined is not None else key_cols,
                 ctx.num_rows, cap)
             sorted_keys = gather_cols(key_cols, perm, live)
         else:
+            if keep is not None:
+                # segment kernels need contiguous runs — masked rows mid-run
+                # would split segment 0; compact inside this same program
+                new_cols, cnt = compact_cols(ctx.cols, keep)
+                ctx = EvalContext(new_cols, cnt, cap)
+                keep = None
             live = jnp.arange(cap) < ctx.num_rows
             perm = jnp.arange(cap, dtype=jnp.int32)
             seg_ids = jnp.where(live, 0, cap - 1).astype(jnp.int32)
@@ -166,7 +219,8 @@ class HashAggregateExec(TpuExec):
             state_cols.extend(outs)
         return compact_cols(list(sorted_keys) + state_cols, boundary)
 
-    def _agg_dense(self, ctx: EvalContext, merge: bool, key_cols):
+    def _agg_dense(self, ctx: EvalContext, merge: bool, key_cols,
+                   live_mask=None):
         """Sort-free small-domain aggregation: keys with statically-known
         compact domains (dict strings / bools) and sum-shaped aggregates
         (Sum/Count/Average) reduce straight into D per-group buckets —
@@ -198,6 +252,8 @@ class HashAggregateExec(TpuExec):
             D *= d
         cap = ctx.capacity
         live = jnp.arange(cap, dtype=jnp.int32) < ctx.num_rows
+        if live_mask is not None:
+            live = live & live_mask    # fused prefilter (see _agg_kernel)
         codes = jnp.where(live, codes, jnp.int32(D))   # pad bucket, dropped
 
         def gsum(vals, mask, acc_dtype):

@@ -141,6 +141,50 @@ class _JoinCore:
         # dtype-max key can never collide with/overflow into the sentinel
         packable = (self.n_build > 0 and rng < (1 << (62 - idx_bits))
                     and vmax < (1 << 62))
+        # one size/budget for BOTH dense-table builders (direct and
+        # post-sort) so they make consistent engage/skip decisions
+        dsize = rng + 2 if self.n_build > 0 else 1
+        dense_budget = max(4 * cap, 1 << 22)
+        direct_ok = (jax.default_backend() == "cpu" and self.n_build > 0
+                     and self.build_matched_acc is None
+                     and dsize <= dense_budget)
+        if direct_ok:
+            # CPU-only sort-free build: scatter row indices straight into the
+            # direct-address table (XLA:CPU scatters are cheap; the sort they
+            # replace was the dominant build cost — docs/perf_notes.md). A
+            # duplicate-key build falls through to the sorted paths below;
+            # on TPU large scatters serialize, so this path never engages.
+            def direct(k, n_build, vmin):
+                vals = k.values.astype(jnp.int8) \
+                    if k.values.dtype == jnp.bool_ else k.values
+                eligible = k.validity & (
+                    jnp.arange(cap, dtype=jnp.int32) < n_build)
+                rel = jnp.where(eligible, vals.astype(jnp.int64) - vmin,
+                                jnp.asarray(dsize, jnp.int64))
+                counts = jnp.zeros((dsize,), jnp.int32
+                                   ).at[rel].add(1, mode="drop")
+                table = jnp.full((dsize,), -1, jnp.int32
+                                 ).at[rel].set(
+                    jnp.arange(cap, dtype=jnp.int32), mode="drop")
+                return table, jnp.all(counts <= 1)
+
+            dkey = ("join_build_direct", k.dtype, cap, dsize)
+            dargs = (k, n_build_t, jnp.asarray(vmin, jnp.int64))
+            table_t, uniq_t = fuse.call_fused(
+                dkey, "HashJoin.build_prep", lambda: direct, dargs,
+                lambda: direct(*dargs))
+            if bool(uniq_t):
+                self._probe_mode = "dense"
+                self._dense_size = dsize
+                self._dense_table = table_t
+                # ranks ARE build-row indices for the direct table
+                self._build_perm = jnp.arange(cap, dtype=jnp.int32)
+                self._sorted_build = (k.values.astype(jnp.int8)
+                                      if k.values.dtype == jnp.bool_
+                                      else k.values)  # dtype carrier only
+                self._n_valid = n_valid
+                self._vmin = vmin
+                return
 
         if packable:
             def prep(k, n_build, vmin):
@@ -199,11 +243,10 @@ class _JoinCore:
         # probe-mode choice — static per compiled probe kernel
         self._vmin = vmin
         unique = bool(uniq_t) if self.n_build > 0 else True
-        dsize = rng + 2 if self.n_build > 0 else 1
         self._probe_mode = "two"
         if unique and self.build_matched_acc is None:
             self._probe_mode = "one"
-            if dsize <= max(4 * cap, 1 << 22) and jax.devices()[0].platform \
+            if dsize <= dense_budget and jax.devices()[0].platform \
                     != "tpu":
                 # direct-address rank table: scatter once per build, O(1)
                 # gather per probe row (kept off-TPU: large 1:1 scatters

@@ -379,18 +379,30 @@ class FileSourceScanExec(TpuExec):
         batch_rows = min(conf.get(CFG.MAX_READER_BATCH_SIZE_ROWS), 1 << 20)
         threads = conf.get(CFG.MULTITHREADED_READ_NUM_THREADS)
 
-        if conf.get(CFG.PARQUET_DEVICE_DECODE):
+        def decode_engaged(entry):
+            """Device decode pays only when a real accelerator is attached:
+            on the CPU backend the 'device' IS the host, so arrow decode is
+            strictly cheaper. An explicitly-set conf always wins (tests force
+            the device path on the CPU platform)."""
+            if entry.key in conf.settings:
+                return conf.get(entry)
+            if not conf.get(entry):
+                return False
+            import jax
+            return jax.default_backend() != "cpu"
+
+        if decode_engaged(CFG.PARQUET_DEVICE_DECODE):
             dev_it = self._device_decode_batches(
                 split, batch_rows, conf.get(CFG.MAX_READER_BATCH_SIZE_BYTES))
             if dev_it is not None:
                 return self.wrap_output(dev_it)
 
-        if conf.get(CFG.CSV_DEVICE_DECODE):
+        if decode_engaged(CFG.CSV_DEVICE_DECODE):
             dev_it = self._csv_device_decode_batches(split)
             if dev_it is not None:
                 return self.wrap_output(dev_it)
 
-        if conf.get(CFG.ORC_DEVICE_DECODE):
+        if decode_engaged(CFG.ORC_DEVICE_DECODE):
             dev_it = self._orc_device_decode_batches(
                 split, batch_rows, conf.get(CFG.MAX_READER_BATCH_SIZE_BYTES))
             if dev_it is not None:

@@ -281,12 +281,14 @@ def coalescing_tables(reader, paths, columns, filt, batch_rows, target_rows):
         for off in range(0, t.num_rows, cap):
             yield t.slice(off, cap)
 
-    for p in paths:
-        for tbl in reader.read_file(p, columns, filt, batch_rows=cap):
-            acc.append(tbl)
-            acc_rows += tbl.num_rows
-            if acc_rows >= target_rows:  # flush() re-slices to cap-row batches
-                yield from flush()
-                acc, acc_rows = [], 0
+    # sequential streaming accumulate-and-flush: peak host memory stays
+    # ~target_rows regardless of file sizes. Decode/compute overlap is the
+    # MULTITHREADED strategy's job (it pays whole-file buffering for it).
+    for tbl in perfile_tables(reader, paths, columns, filt, cap):
+        acc.append(tbl)
+        acc_rows += tbl.num_rows
+        if acc_rows >= target_rows:  # flush() re-slices to cap-row batches
+            yield from flush()
+            acc, acc_rows = [], 0
     if acc:
         yield from flush()

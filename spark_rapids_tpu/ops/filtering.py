@@ -24,18 +24,36 @@ def selection_mask(pred: Col, num_rows, capacity: int):
 def compact_cols(cols, keep_mask):
     """Stable-move surviving rows to the front. Returns (new_cols, new_count).
 
-    The j-th kept row's source index is recovered by binary search over the
-    running kept-count (one cumsum + one searchsorted) — ~4x cheaper than the
-    stable argsort-of-flags formulation, and callers never rely on the order
-    of the (invalid) tail."""
+    Backend-split formulation (same contract, different hardware optimum):
+
+    - TPU: the j-th kept row's source index is recovered by binary search over
+      the running kept-count (one cumsum + one searchsorted) — gathers
+      vectorize on the TPU while scatters serialize (the same reason
+      ops/grouping.py uses scan-based segment reductions).
+    - CPU: one cumsum + a scatter-with-drop per column (dropped rows target
+      index `capacity`, which XLA discards). XLA:CPU's searchsorted lowers to
+      ~log2(cap) gather sweeps and measured ~8x slower than the scatter
+      (docs/perf_notes.md round-4)."""
     capacity = keep_mask.shape[0]
     running = jnp.cumsum(keep_mask.astype(jnp.int32))
     count = running[-1]
     j = jnp.arange(capacity, dtype=jnp.int32)
-    perm = jnp.clip(jnp.searchsorted(running, j + 1, side="left"), 0,
-                    capacity - 1).astype(jnp.int32)
     live = j < count
     out = []
+    if jax.default_backend() == "cpu":
+        dest = jnp.where(keep_mask, running - 1, capacity)
+        for c in cols:
+            default = jnp.asarray(c.dtype.default_value(),
+                                  dtype=c.values.dtype)
+            vals = jnp.full((capacity,), default, c.values.dtype
+                            ).at[dest].set(c.values, mode="drop")
+            validity = jnp.zeros((capacity,), jnp.bool_
+                                 ).at[dest].set(c.validity, mode="drop")
+            out.append(Col(jnp.where(validity, vals, default), validity,
+                           c.dtype, c.dictionary))
+        return out, count
+    perm = jnp.clip(jnp.searchsorted(running, j + 1, side="left"), 0,
+                    capacity - 1).astype(jnp.int32)
     for c in cols:
         vals = c.values[perm]
         validity = c.validity[perm] & live

@@ -46,6 +46,12 @@ def _estimate_rows(node, memo) -> int:
     if isinstance(node, NN.ScanNode):
         return sum(t.num_rows for t in node.partitions)
     if isinstance(node, FileScanNode):
+        # cached on the node: scans persist across planning passes (the
+        # build-side chooser and optimize() both ask), and re-opening every
+        # parquet footer per pass scales with file count
+        cached = getattr(node, "_est_rows", None)
+        if cached is not None:
+            return cached
         total = 0
         for part in node.partitions:
             for p in part.paths:
@@ -58,6 +64,7 @@ def _estimate_rows(node, memo) -> int:
                         total += max(1, os.path.getsize(p) // 64)
                 except Exception:
                     total += 1 << 20  # unknown: assume big (stay on device)
+        node._est_rows = total
         return total
     if isinstance(node, NN.RangeNode):
         return max(0, -(-(node.end - node.start) // node.step))

@@ -219,6 +219,8 @@ class TpuOverrides:
     def apply(self, plan):
         if not self.conf.is_sql_enabled:
             return plan
+        from spark_rapids_tpu.plan.pruning import prune_columns
+        plan = prune_columns(plan)   # Catalyst ColumnPruning analog
         plan = extract_python_udfs(plan)
         meta = wrap_plan_meta(plan, self.conf)
         meta.tag_for_tpu()
@@ -691,14 +693,51 @@ def _register_all():
     def conv_aggregate(meta, kids):
         n = meta.node
         child = kids[0]
+        # whole-stage hoist of child Filter/Project execs into the
+        # aggregation kernel: predicates mask rows in-kernel and projections
+        # re-derive inputs there, skipping their dispatches and full-width
+        # intermediate batches (whole-stage-codegen role; the reference's
+        # GpuHashAggregateExec receives codegen-fused stages the same way)
+        from spark_rapids_tpu.expr.misc import CONTEXT_SENSITIVE
+
+        def clean_filter(f):
+            return not f.condition.collect(
+                lambda x: isinstance(x, CONTEXT_SENSITIVE))
+
+        def clean_project(p):
+            # CONTEXT_SENSITIVE covers the positional exprs too (Rand,
+            # MonotonicallyIncreasingID are members)
+            return not any(
+                e.collect(lambda x: isinstance(x, CONTEXT_SENSITIVE))
+                for e in p.project_list)
+
+        prefilter = preproject = None
+        pre_on_proj = False
+        if isinstance(child, XB.FilterExec) and clean_filter(child):
+            prefilter = child.condition           # Agg(Filter(...))
+            child = child.children[0]
+            if isinstance(child, XB.ProjectExec) and clean_project(child):
+                preproject = child.project_list   # Agg(Filter(Project(x)))
+                child = child.children[0]
+                pre_on_proj = True                # condition binds to project
+        elif isinstance(child, XB.ProjectExec) and clean_project(child):
+            preproject = child.project_list       # Agg(Project(...))
+            child = child.children[0]
+            if isinstance(child, XB.FilterExec) and clean_filter(child):
+                prefilter = child.condition       # Agg(Project(Filter(x)))
+                child = child.children[0]
+        fused = dict(prefilter=prefilter, preproject=preproject,
+                     prefilter_on_projected=pre_on_proj)
         if child.num_partitions == 1 or not n.group_exprs:
             if child.num_partitions > 1:
                 # global aggregation without keys: gather all partitions first
                 child = XS._GatherAllExec(child, conf=meta.conf)
             return XA.HashAggregateExec(n.group_exprs, n.agg_exprs, child,
-                                        mode=XA.COMPLETE, conf=meta.conf)
+                                        mode=XA.COMPLETE, conf=meta.conf,
+                                        **fused)
         partial = XA.HashAggregateExec(n.group_exprs, n.agg_exprs, child,
-                                       mode=XA.PARTIAL, conf=meta.conf)
+                                       mode=XA.PARTIAL, conf=meta.conf,
+                                       **fused)
         nkeys = len(n.group_exprs)
         key_names = [f.name for f in partial.output][:nkeys]
         keys = [E.col(k) for k in key_names]
@@ -729,6 +768,14 @@ def _register_all():
                 "inner" if jt == "cross" else jt, left, right,
                 condition=n.condition, conf=meta.conf)
         n_mesh = _mesh_n(meta.conf)
+        # inner joins may build either side; pick the smaller estimated child
+        # (reference GpuJoinUtils.getGpuBuildSide from Spark's size-based
+        # buildSide choice). Other types stream the preserved side.
+        build_side = "right"
+        if jt == "inner":
+            from spark_rapids_tpu.plan.cbo import estimate_rows
+            if estimate_rows(n.left) < estimate_rows(n.right):
+                build_side = "left"
         if n_mesh > 1:
             # shuffled hash join over co-partitioned mesh exchanges (reference
             # GpuShuffledHashJoinBase.scala:97 riding GpuShuffleExchangeExec):
@@ -741,10 +788,10 @@ def _register_all():
                 SP.HashPartitioner(n.right_keys, n_mesh), right, conf=meta.conf)
             return XJ.HashJoinExec(
                 jt, n.left_keys, n.right_keys, lex, rex,
-                condition=n.condition, build_side="right", conf=meta.conf)
+                condition=n.condition, build_side=build_side, conf=meta.conf)
         return XJ.BroadcastHashJoinExec(
             jt, n.left_keys, n.right_keys, left, right, condition=n.condition,
-            build_side="right", conf=meta.conf)
+            build_side=build_side, conf=meta.conf)
 
     def conv_sort(meta, kids):
         from spark_rapids_tpu.ops.sorting import SortOrder

@@ -1,0 +1,223 @@
+"""Column pruning: narrow file scans to the columns the plan actually uses.
+
+Reference: Spark's Catalyst ColumnPruning + SchemaPruning rules feed
+GpuParquetScan/GpuOrcScan a pruned readSchema, so the GPU decodes only live
+columns (GpuParquetScan.scala readDataSchema). This engine builds plans with
+eagerly BOUND ordinals (plan/nodes.py binds at construction), so the pass
+both narrows the FileScanNode schema and REBINDS every ordinal above it.
+
+`_prune(node, required)` returns `(new_node, mapping)` where `required` is
+the set of output ordinals the parent consumes (None = all) and `mapping`
+maps old output ordinals to new ones for every column that survived. Nodes
+whose output is expression-defined (Project, Aggregate) absorb the
+remapping; pass-through nodes (Filter, Sort, Limit, Exchange) propagate it.
+Unhandled node types conservatively require all of their children's columns
+— correctness never depends on a node being listed here.
+
+The rewrite is IDENTITY-PRESERVING: a subtree where nothing narrows returns
+the ORIGINAL node objects. TpuOverrides.apply runs this pass per execution,
+and stateful nodes (CacheNode's materialized batches) must survive repeat
+applies — a gratuitous copy would orphan their state. CacheNode is
+additionally a pruning barrier: its cache holds the child's full-width
+output, so the pass never narrows beneath one.
+
+Run by TpuOverrides.apply before tagging, and safe for host-interpreted
+plans too (pruned nodes execute_host the same way).
+"""
+
+from __future__ import annotations
+
+import copy
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr import core as E
+from spark_rapids_tpu.plan import nodes as N
+from spark_rapids_tpu.io.filescan import FileScanNode
+
+
+def _refs(expr) -> set:
+    return {e.ordinal for e in
+            expr.collect(lambda x: isinstance(x, E.BoundReference))}
+
+
+def _is_ident(mapping: dict) -> bool:
+    return all(o == n for o, n in mapping.items())
+
+
+def _remap(expr, mapping: dict):
+    if _is_ident(mapping):
+        return expr
+
+    def fn(e):
+        if isinstance(e, E.BoundReference):
+            return E.BoundReference(mapping[e.ordinal], e.dtype, e.nullable,
+                                    e.name)
+        return e
+    return expr.transform(fn)
+
+
+def _identity(node):
+    return node, {i: i for i in range(len(node.output.fields))}
+
+
+def prune_columns(root: N.PlanNode) -> N.PlanNode:
+    """Return an equivalent plan whose file scans read only live columns.
+    Subtrees with nothing to narrow come back as the original objects."""
+    new_root, _ = _prune(root, None)
+    return new_root
+
+
+def _all(node) -> set:
+    return set(range(len(node.output.fields)))
+
+
+def _prune(node: N.PlanNode, required: set | None):
+    from spark_rapids_tpu.plan.cache import CacheNode
+    if isinstance(node, CacheNode):
+        # barrier: the cache stores full-width child batches, and the node
+        # itself carries materialized state a rebuild would orphan
+        return _identity(node)
+    if isinstance(node, FileScanNode):
+        return _prune_scan(node, required)
+    if isinstance(node, N.ProjectNode):
+        keep = (sorted(required) if required is not None
+                else list(range(len(node.project_list))))
+        if not keep:                       # count(*)-style: keep one column
+            keep = [0]
+        kept_exprs = [node.project_list[i] for i in keep]
+        child_req = set()
+        for e in kept_exprs:
+            child_req |= _refs(e)
+        child, cmap = _prune(node.child, child_req)
+        mapping = {o: i for i, o in enumerate(keep)}
+        if child is node.child and _is_ident(cmap) and _is_ident(mapping) \
+                and len(keep) == len(node.project_list):
+            return node, mapping
+        new = N.ProjectNode([_remap(e, cmap) for e in kept_exprs], child)
+        return new, mapping
+    if isinstance(node, N.FilterNode):
+        req = (required if required is not None else _all(node))
+        child, cmap = _prune(node.child, req | _refs(node.condition))
+        if child is node.child and _is_ident(cmap):
+            return node, cmap
+        return N.FilterNode(_remap(node.condition, cmap), child), cmap
+    if isinstance(node, N.SortNode):
+        req = (required if required is not None else _all(node))
+        need = set(req)
+        for e, _, _ in node.sort_exprs:
+            need |= _refs(e)
+        child, cmap = _prune(node.child, need)
+        if child is node.child and _is_ident(cmap):
+            return node, cmap
+        new = N.SortNode([(_remap(e, cmap), asc, nf)
+                          for (e, asc, nf) in node.sort_exprs], child,
+                         node.global_sort)
+        return new, cmap
+    if isinstance(node, N.LimitNode):
+        child, cmap = _prune(node.child, required)
+        if child is node.child:
+            return node, cmap
+        return N.LimitNode(node.n, child, node.global_limit), cmap
+    if isinstance(node, N.ExchangeNode):
+        req = (required if required is not None else _all(node))
+        need = set(req)
+        for e in node.keys:
+            need |= _refs(e)
+        child, cmap = _prune(node.child, need)
+        if child is node.child and _is_ident(cmap):
+            return node, cmap
+        new = N.ExchangeNode(child, node.partitioning, node.num_out,
+                             [_remap(e, cmap) for e in node.keys])
+        return new, cmap
+    if isinstance(node, N.AggregateNode):
+        child_req = set()
+        for e in node.group_exprs + node.agg_exprs:
+            child_req |= _refs(e)
+        child, cmap = _prune(node.child, child_req)
+        if child is node.child and _is_ident(cmap):
+            return _identity(node)
+        new = N.AggregateNode([_remap(e, cmap) for e in node.group_exprs],
+                              [_remap(e, cmap) for e in node.agg_exprs],
+                              child)
+        return _identity(new)
+    if isinstance(node, N.JoinNode):
+        nleft = len(node.left.output.fields)
+        semi = node.join_type in ("leftsemi", "leftanti")
+        req = (required if required is not None else _all(node))
+        lreq = {i for i in req if i < nleft}
+        rreq = (set() if semi else {i - nleft for i in req if i >= nleft})
+        for e in node.left_keys:
+            lreq |= _refs(e)
+        for e in node.right_keys:
+            rreq |= _refs(e)
+        if node.condition is not None:
+            # the extra condition is stored unbound (name-resolved later):
+            # keep every column it names, on whichever side defines it
+            names = {a.name for a in node.condition.collect(
+                lambda x: isinstance(x, (E.AttributeReference,
+                                         E.BoundReference)))}
+            for i, f in enumerate(node.left.output.fields):
+                if f.name in names:
+                    lreq.add(i)
+            for i, f in enumerate(node.right.output.fields):
+                if f.name in names:
+                    rreq.add(i)
+        left, lmap = _prune(node.left, lreq)
+        right, rmap = _prune(node.right, rreq)
+        if left is node.left and right is node.right and _is_ident(lmap) \
+                and _is_ident(rmap):
+            return _identity(node)
+        new = N.JoinNode(left, right,
+                         [_remap(e, lmap) for e in node.left_keys],
+                         [_remap(e, rmap) for e in node.right_keys],
+                         node.join_type, node.condition)
+        nleft_new = len(left.output.fields)
+        mapping = dict(lmap)
+        if not semi:
+            for o, n2 in rmap.items():
+                mapping[o + nleft] = n2 + nleft_new
+        return new, mapping
+    # unhandled node type: conservatively require ALL columns of every child
+    # (children may still prune deeper inside their own subtrees)
+    new_children = [_prune(c, None)[0] for c in node.children]
+    if any(nc is not oc for nc, oc in zip(new_children, node.children)):
+        node = copy.copy(node)
+        node.children = list(new_children)
+    return _identity(node)
+
+
+def _prune_scan(node: FileScanNode, required: set | None):
+    fields = node.output.fields
+    if required is None or len(required) >= len(fields):
+        return _identity(node)
+    if node.fmt not in ("parquet", "orc"):
+        # row-oriented formats (CSV) parse every field anyway, and their
+        # reader options may carry a full parse schema — don't narrow
+        return _identity(node)
+    n_data = len(fields) - node._n_partition_cols
+    keep = sorted(required)
+    if not keep:
+        keep = [0]
+    # partition-value columns are per-file constants appended after the data
+    # columns; keep them all so _append_partition_values stays aligned
+    keep_data = [i for i in keep if i < n_data]
+    if not keep_data:
+        keep_data = [0]
+    kept = keep_data + list(range(n_data, len(fields)))
+    # pushed filters resolve by NAME against the scan schema — their columns
+    # must survive the narrowing
+    if node.pushed_filter is not None:
+        names = {a.name for a in node.pushed_filter.collect(
+            lambda x: isinstance(x, (E.AttributeReference,
+                                     E.BoundReference)))}
+        extra = [i for i, f in enumerate(fields[:n_data])
+                 if f.name in names and i not in kept]
+        kept = sorted(set(kept) | set(extra))
+    else:
+        kept = sorted(set(kept))
+    if len(kept) == len(fields):
+        return _identity(node)
+    new = copy.copy(node)
+    new._schema = T.StructType([fields[i] for i in kept])
+    new._n_partition_cols = node._n_partition_cols
+    return new, {o: i for i, o in enumerate(kept)}

@@ -255,7 +255,8 @@ def test_csv_device_decode_ints_matches_host(tmp_path):
     path = _write_csv(tmp_path, text)
     schema = T.StructType([T.StructField("a", T.LONG), T.StructField("b", T.LONG)])
 
-    on = TpuSession().read_csv(path, schema=schema).collect()
+    on = TpuSession({"spark.rapids.tpu.sql.csv.deviceDecode.enabled": "true"}
+                    ).read_csv(path, schema=schema).collect()
     off = TpuSession({"spark.rapids.tpu.sql.csv.deviceDecode.enabled": "false"}
                      ).read_csv(path, schema=schema).collect()
     assert on["a"].to_pylist() == off["a"].to_pylist() == [1, -5, None, 8]
@@ -265,7 +266,7 @@ def test_csv_device_decode_ints_matches_host(tmp_path):
     # '+7' parses like Spark (Long.parseLong) on device; pyarrow's host
     # reader rejects it, so it is asserted on the device path only
     p2 = _write_csv(tmp_path, "a\n+7\n", name="plus.csv")
-    on2 = TpuSession().read_csv(
+    on2 = TpuSession({"spark.rapids.tpu.sql.csv.deviceDecode.enabled": "true"}).read_csv(
         p2, schema=T.StructType([T.StructField("a", T.LONG)])).collect()
     assert on2["a"].to_pylist() == [7]
 
@@ -276,7 +277,7 @@ def test_csv_device_decode_malformed_is_null(tmp_path):
     text = "a\n12\nx9\n--3\n+\n8\n"
     path = _write_csv(tmp_path, text)
     schema = T.StructType([T.StructField("a", T.LONG)])
-    out = TpuSession().read_csv(path, schema=schema).collect()
+    out = TpuSession({"spark.rapids.tpu.sql.csv.deviceDecode.enabled": "true"}).read_csv(path, schema=schema).collect()
     assert out["a"].to_pylist() == [12, None, None, None, 8]
 
 
@@ -326,7 +327,8 @@ def test_csv_device_decode_equivalence_fuzz(tmp_path):
         rows.append(f"{av},{bv}")
     path = _write_csv(tmp_path, "\n".join(rows) + "\n", name="f.csv")
     schema = T.StructType([T.StructField("a", T.LONG), T.StructField("b", T.INT)])
-    on = TpuSession().read_csv(path, schema=schema).collect()
+    on = TpuSession({"spark.rapids.tpu.sql.csv.deviceDecode.enabled": "true"}
+                    ).read_csv(path, schema=schema).collect()
     off = TpuSession({"spark.rapids.tpu.sql.csv.deviceDecode.enabled": "false"}
                      ).read_csv(path, schema=schema).collect()
     assert on["a"].to_pylist() == off["a"].to_pylist()
@@ -353,7 +355,8 @@ def test_csv_device_decode_overflow_and_overlong(tmp_path):
             "123456789012345678901234567\n7\n")
     path = _write_csv(tmp_path, text, name="ovf.csv")
     schema = T.StructType([T.StructField("a", T.LONG)])
-    out = TpuSession().read_csv(path, schema=schema).collect()
+    out = TpuSession({"spark.rapids.tpu.sql.csv.deviceDecode.enabled": "true"}
+                     ).read_csv(path, schema=schema).collect()
     assert out["a"].to_pylist() == [9223372036854775807, None,
                                     -9223372036854775808, None, None, 7]
 
@@ -429,7 +432,8 @@ def test_input_file_name_metadata_exprs(tmp_path):
         pq.write_table(pa.table({"a": pa.array(np.arange(5) + i * 10)}),
                        str(d / f"part-{i}.parquet"), compression="NONE",
                        use_dictionary=True)
-    spark = TpuSession()
+    spark = TpuSession({
+        "spark.rapids.tpu.sql.parquet.deviceDecode.enabled": "true"})
     df = spark.read_parquet(str(d), files_per_partition=2).select(
         F.col("a"), F.alias(F.input_file_name(), "f"),
         F.alias(F.input_file_block_start(), "bs"),

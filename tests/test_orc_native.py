@@ -67,7 +67,8 @@ def test_stripe_device_matches_host(orc_file):
 
 def test_session_orc_scan_device_equals_host(orc_file):
     p, t = orc_file
-    on = TpuSession().read_orc(p).collect()
+    on = TpuSession({"spark.rapids.tpu.sql.orc.deviceDecode.enabled":
+                      "true"}).read_orc(p).collect()
     off = TpuSession({"spark.rapids.tpu.sql.orc.deviceDecode.enabled":
                       "false"}).read_orc(p).collect()
     for name in t.column_names:

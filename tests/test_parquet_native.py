@@ -125,7 +125,8 @@ def test_session_scan_uses_device_decode(unc_file):
 
 def test_device_decode_conf_off_matches(unc_file):
     path, t = unc_file
-    on = TpuSession().read_parquet(path).collect()
+    on = TpuSession({"spark.rapids.tpu.sql.parquet.deviceDecode.enabled":
+                      "true"}).read_parquet(path).collect()
     off = TpuSession({CFG.PARQUET_DEVICE_DECODE.key: "false"}) \
         .read_parquet(path).collect()
     for name in t.column_names:
