@@ -148,9 +148,8 @@ class HashAggregateExec(TpuExec):
         keep = None
 
         def eval_keep(c):
-            pred = self.prefilter.eval(c)
-            return (pred.values & pred.validity
-                    & (jnp.arange(cap, dtype=jnp.int32) < c.num_rows))
+            from spark_rapids_tpu.ops.filtering import selection_mask
+            return selection_mask(self.prefilter.eval(c), c.num_rows, cap)
 
         if not merge:
             if self.prefilter is not None and not self.prefilter_on_projected:

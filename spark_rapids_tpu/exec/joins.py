@@ -75,12 +75,17 @@ class _JoinCore:
     over both sides per stream batch)."""
 
     def __init__(self, build_batch: ColumnarBatch, build_key_exprs,
-                 stream_key_exprs, join_type: str):
+                 stream_key_exprs, join_type: str, stream_prefilter=None):
         from spark_rapids_tpu.runtime import fuse
         self.build_batch = build_batch
         self.build_key_exprs = build_key_exprs
         self.stream_key_exprs = stream_key_exprs
         self.join_type = join_type
+        # hoisted stream-side filter (inner single-int-key joins only — the
+        # planner guarantees that): the predicate masks probe rows in-kernel,
+        # so filtered rows emit zero pairs without a separate FilterExec
+        # dispatch + compaction (whole-stage-codegen role)
+        self.stream_prefilter = stream_prefilter
         from spark_rapids_tpu.expr.misc import CONTEXT_SENSITIVE
         bctx = EvalContext.from_batch(build_batch)
         self.build_keys_raw = [e.eval(bctx) for e in build_key_exprs]
@@ -90,14 +95,22 @@ class _JoinCore:
         # cannot be baked into a shared compiled program
         self.ctx_sensitive = any(
             e.collect(lambda x: isinstance(x, CONTEXT_SENSITIVE))
-            for e in stream_key_exprs)
-        self._stream_key_key = tuple(
-            fuse.expr_key(e) for e in stream_key_exprs)
+            for e in (*stream_key_exprs,
+                      *([stream_prefilter] if stream_prefilter is not None
+                        else [])))
+        self._stream_key_key = (tuple(
+            fuse.expr_key(e) for e in stream_key_exprs),
+            fuse.expr_key(stream_prefilter)
+            if stream_prefilter is not None else None)
         # matched-build tracking for full outer (host accumulation across stream)
         self.build_matched_acc = (np.zeros(self.build_cap, dtype=bool)
                                   if join_type == J.FULL_OUTER else None)
         self.fast = (len(self.build_keys_raw) == 1
                      and _int_backed(self.build_keys_raw[0].dtype))
+        # the hoisting planner rule guarantees these; the eager and rank
+        # probe paths do not evaluate the prefilter
+        assert stream_prefilter is None or (self.fast
+                                            and not self.ctx_sensitive)
         if self.fast:
             self._prep_fast_build()
 
@@ -145,7 +158,8 @@ class _JoinCore:
         # post-sort) so they make consistent engage/skip decisions
         dsize = rng + 2 if self.n_build > 0 else 1
         dense_budget = max(4 * cap, 1 << 22)
-        direct_ok = (jax.default_backend() == "cpu" and self.n_build > 0
+        from spark_rapids_tpu.runtime.hw import scatters_cheap
+        direct_ok = (scatters_cheap() and self.n_build > 0
                      and self.build_matched_acc is None
                      and dsize <= dense_budget)
         if direct_ok:
@@ -154,26 +168,37 @@ class _JoinCore:
             # replace was the dominant build cost — docs/perf_notes.md). A
             # duplicate-key build falls through to the sorted paths below;
             # on TPU large scatters serialize, so this path never engages.
-            def direct(k, n_build, vmin):
+            def rel_of(k, n_build, vmin):
                 vals = k.values.astype(jnp.int8) \
                     if k.values.dtype == jnp.bool_ else k.values
                 eligible = k.validity & (
                     jnp.arange(cap, dtype=jnp.int32) < n_build)
-                rel = jnp.where(eligible, vals.astype(jnp.int64) - vmin,
-                                jnp.asarray(dsize, jnp.int64))
-                counts = jnp.zeros((dsize,), jnp.int32
-                                   ).at[rel].add(1, mode="drop")
-                table = jnp.full((dsize,), -1, jnp.int32
-                                 ).at[rel].set(
-                    jnp.arange(cap, dtype=jnp.int32), mode="drop")
-                return table, jnp.all(counts <= 1)
+                return jnp.where(eligible, vals.astype(jnp.int64) - vmin,
+                                 jnp.asarray(dsize, jnp.int64))
 
-            dkey = ("join_build_direct", k.dtype, cap, dsize)
+            # two kernels so a duplicate-key build discards only the cheap
+            # uniqueness scatter, not a full table build
+            def uniq_check(k, n_build, vmin):
+                counts = jnp.zeros((dsize,), jnp.int32
+                                   ).at[rel_of(k, n_build, vmin)].add(
+                    1, mode="drop")
+                return jnp.all(counts <= 1)
+
+            def mktable_direct(k, n_build, vmin):
+                return jnp.full((dsize,), -1, jnp.int32
+                                ).at[rel_of(k, n_build, vmin)].set(
+                    jnp.arange(cap, dtype=jnp.int32), mode="drop")
+
+            dkey = ("join_build_direct_uniq", k.dtype, cap, dsize)
             dargs = (k, n_build_t, jnp.asarray(vmin, jnp.int64))
-            table_t, uniq_t = fuse.call_fused(
-                dkey, "HashJoin.build_prep", lambda: direct, dargs,
-                lambda: direct(*dargs))
+            uniq_t = fuse.call_fused(
+                dkey, "HashJoin.build_prep", lambda: uniq_check, dargs,
+                lambda: uniq_check(*dargs))
             if bool(uniq_t):
+                tkey = ("join_build_direct_table", k.dtype, cap, dsize)
+                table_t = fuse.call_fused(
+                    tkey, "HashJoin.build_prep", lambda: mktable_direct,
+                    dargs, lambda: mktable_direct(*dargs))
                 self._probe_mode = "dense"
                 self._dense_size = dsize
                 self._dense_table = table_t
@@ -246,8 +271,7 @@ class _JoinCore:
         self._probe_mode = "two"
         if unique and self.build_matched_acc is None:
             self._probe_mode = "one"
-            if dsize <= dense_budget and jax.devices()[0].platform \
-                    != "tpu":
+            if dsize <= dense_budget and scatters_cheap():
                 # direct-address rank table: scatter once per build, O(1)
                 # gather per probe row (kept off-TPU: large 1:1 scatters
                 # serialize there; searchsorted stays the TPU path)
@@ -347,6 +371,8 @@ class _JoinCore:
         vmin = self._vmin
         dsize = getattr(self, "_dense_size", 0)
 
+        stream_prefilter = self.stream_prefilter
+
         def kernel(sorted_build, n_valid, n_build, build_keys_raw, stream_cols,
                    n_stream, dense_table):
             scap = stream_cols[0].values.shape[0]
@@ -362,7 +388,11 @@ class _JoinCore:
             common = jnp.promote_types(svals.dtype, sorted_build.dtype)
             svals = svals.astype(common)
             sorted_common = sorted_build.astype(common)
-            live = jnp.arange(scap, dtype=jnp.int32) < n_stream
+            if stream_prefilter is not None:
+                live = selection_mask(stream_prefilter.eval(sctx),
+                                      n_stream, scap)
+            else:
+                live = jnp.arange(scap, dtype=jnp.int32) < n_stream
             if mode == "dense":
                 slot = svals.astype(jnp.int64) - vmin
                 in_dom = (slot >= 0) & (slot < dsize - 1)
@@ -445,8 +475,19 @@ class HashJoinExec(TpuExec):
 
     def __init__(self, join_type: str, left_keys, right_keys,
                  left: TpuExec, right: TpuExec, condition: Expression | None = None,
-                 build_side: str = "right", conf=None):
+                 build_side: str = "right", conf=None, stream_prefilter=None,
+                 stream_preproject=None, stream_schema=None):
         super().__init__(left, right, conf=conf)
+        # whole-stage hoists (planner-controlled, inner single-int-key joins
+        # only): `stream_prefilter` masks probe rows against the RAW stream
+        # child; `stream_preproject` re-derives the hoisted projection on
+        # post-join gathered rows in the emit kernel; `stream_schema` is the
+        # hoisted projection's output schema (the join's stream-side
+        # contribution, since the raw child is now wider)
+        self.stream_prefilter = stream_prefilter
+        self.stream_preproject = (list(stream_preproject)
+                                  if stream_preproject is not None else None)
+        self._stream_schema = stream_schema
         jt = join_type.lower().replace("_", "")
         self.join_type = jt
         if jt not in (J.INNER, J.LEFT_OUTER, J.RIGHT_OUTER, J.FULL_OUTER,
@@ -475,6 +516,11 @@ class HashJoinExec(TpuExec):
     @property
     def output(self) -> T.StructType:
         lf, rf = list(self.children[0].output), list(self.children[1].output)
+        if self._stream_schema is not None:
+            if self.stream_is_left:
+                lf = list(self._stream_schema)
+            else:
+                rf = list(self._stream_schema)
         if self.join_type in (J.LEFT_SEMI, J.LEFT_ANTI):
             return T.StructType(lf)
         # outer joins make the non-preserved side nullable
@@ -503,11 +549,16 @@ class HashJoinExec(TpuExec):
         while pos < total:
             out_cap = bucket_capacity(min(total - pos, _MAX_CHUNK_ROWS))
 
+            preproject = self.stream_preproject
+
             def kernel(build_perm, lo, hi, counts, s_in, b_in, start, n_out,
                        _cap=out_cap):
                 s_idx, b_idx, b_matched, live = J.expand_pairs(
                     build_perm, lo, hi, counts, start, _cap)
                 s_cols = gather_cols(s_in, s_idx, live)
+                if preproject is not None:
+                    pctx = EvalContext(s_cols, n_out, _cap)
+                    s_cols = [e.eval(pctx) for e in preproject]
                 if semi_anti:
                     cols = s_cols
                 else:
@@ -521,7 +572,9 @@ class HashJoinExec(TpuExec):
                 return cols, None
 
             key = ("join_emit", semi_anti, stream_is_left, out_cap,
-                   cond_key, out_key)
+                   cond_key, out_key,
+                   tuple(fuse.expr_key(e) for e in self.stream_preproject)
+                   if self.stream_preproject is not None else None)
             s_in = [Col.from_vector(c) for c in stream_batch.columns]
             b_in = ([] if semi_anti else
                     [Col.from_vector(c) for c in build_batch.columns])
@@ -548,7 +601,8 @@ class HashJoinExec(TpuExec):
                                             mem.ACTIVE_BATCHING_PRIORITY) as sb:
                 bk = self.left_keys if not self.stream_is_left else self.right_keys
                 sk = self.right_keys if not self.stream_is_left else self.left_keys
-                core = _JoinCore(sb.get_batch(), bk, sk, self.join_type)
+                core = _JoinCore(sb.get_batch(), bk, sk, self.join_type,
+                                 stream_prefilter=self.stream_prefilter)
                 out_schema = self.output
                 for stream_batch in stream_child.execute_partition(split):
                     acquire_semaphore(self.metrics)
@@ -639,7 +693,8 @@ class BroadcastHashJoinExec(HashJoinExec):
                 sb = self._shared.get()
             bk = self.left_keys if not self.stream_is_left else self.right_keys
             sk = self.right_keys if not self.stream_is_left else self.left_keys
-            core = _JoinCore(sb.get_batch(), bk, sk, self.join_type)
+            core = _JoinCore(sb.get_batch(), bk, sk, self.join_type,
+                             stream_prefilter=self.stream_prefilter)
             out_schema = self.output
             for stream_batch in stream_child.execute_partition(split):
                 acquire_semaphore(self.metrics)

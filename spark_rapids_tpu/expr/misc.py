@@ -250,3 +250,12 @@ class ScalarSubquery(Expression):
 # one partition's context into a shared compiled program.
 CONTEXT_SENSITIVE = (Rand, SparkPartitionID, MonotonicallyIncreasingID,
                      _ScanMetaExpr)
+
+
+def is_context_free(*exprs) -> bool:
+    """True when no expression reads per-batch/per-partition context — the
+    planner's fusibility predicate (hoisting into shared compiled kernels is
+    only sound for context-free trees)."""
+    return not any(
+        e.collect(lambda x: isinstance(x, CONTEXT_SENSITIVE))
+        for e in exprs if e is not None)

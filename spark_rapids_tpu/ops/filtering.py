@@ -40,7 +40,8 @@ def compact_cols(cols, keep_mask):
     j = jnp.arange(capacity, dtype=jnp.int32)
     live = j < count
     out = []
-    if jax.default_backend() == "cpu":
+    from spark_rapids_tpu.runtime.hw import scatters_cheap
+    if scatters_cheap():
         dest = jnp.where(keep_mask, running - 1, capacity)
         for c in cols:
             default = jnp.asarray(c.dtype.default_value(),

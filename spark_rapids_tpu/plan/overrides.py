@@ -698,18 +698,15 @@ def _register_all():
         # re-derive inputs there, skipping their dispatches and full-width
         # intermediate batches (whole-stage-codegen role; the reference's
         # GpuHashAggregateExec receives codegen-fused stages the same way)
-        from spark_rapids_tpu.expr.misc import CONTEXT_SENSITIVE
+        from spark_rapids_tpu.expr.misc import is_context_free
 
         def clean_filter(f):
-            return not f.condition.collect(
-                lambda x: isinstance(x, CONTEXT_SENSITIVE))
+            return is_context_free(f.condition)
 
         def clean_project(p):
-            # CONTEXT_SENSITIVE covers the positional exprs too (Rand,
-            # MonotonicallyIncreasingID are members)
-            return not any(
-                e.collect(lambda x: isinstance(x, CONTEXT_SENSITIVE))
-                for e in p.project_list)
+            # is_context_free covers the positional exprs too (Rand,
+            # MonotonicallyIncreasingID are CONTEXT_SENSITIVE members)
+            return is_context_free(*p.project_list)
 
         prefilter = preproject = None
         pre_on_proj = False
@@ -789,9 +786,61 @@ def _register_all():
             return XJ.HashJoinExec(
                 jt, n.left_keys, n.right_keys, lex, rex,
                 condition=n.condition, build_side=build_side, conf=meta.conf)
+        # whole-stage hoist of the stream side's Filter (and an intervening
+        # Project) into the probe/emit kernels — inner single-int-key joins
+        # only: filtered rows emit zero pairs, so no semantics change;
+        # outer/semi/anti emit per-unfiltered-row and keep their FilterExec.
+        # Broadcast path only — the mesh path partitions the stream BEFORE
+        # probing and must filter pre-exchange.
+        stream_prefilter = stream_preproject = stream_schema = None
+        left_keys, right_keys = n.left_keys, n.right_keys
+        if jt == "inner" and len(n.left_keys) == 1:
+            from spark_rapids_tpu.expr.misc import is_context_free as clean
+            import spark_rapids_tpu.exec.joins as _XJm
+
+            si = 0 if build_side == "right" else 1
+            skid = (left, right)[si]
+            proj = None
+            if (isinstance(skid, XB.ProjectExec)
+                    and isinstance(skid.children[0], XB.FilterExec)
+                    and clean(*skid.project_list)):
+                proj, fkid = skid, skid.children[0]
+            elif isinstance(skid, XB.FilterExec):
+                fkid = skid
+            else:
+                fkid = None
+            if (fkid is not None
+                    and _XJm._int_backed(n.left_keys[0].dtype)
+                    and _XJm._int_backed(n.right_keys[0].dtype)
+                    and clean(fkid.condition, *n.left_keys, *n.right_keys)):
+                stream_prefilter = fkid.condition
+                new_kid = fkid.children[0]
+                skeys = list((left_keys, right_keys)[si])
+                if proj is not None:
+                    # keys were bound against the project's output: substitute
+                    # each reference with the project expression it names, so
+                    # they evaluate against the RAW child (Alias unwrapped —
+                    # it is a naming shell, not a value node)
+                    plist = [e.child if isinstance(e, E.Alias) else e
+                             for e in proj.project_list]
+                    skeys = [k.transform(
+                        lambda x: plist[x.ordinal]
+                        if isinstance(x, E.BoundReference) else x)
+                        for k in skeys]
+                    stream_preproject = proj.project_list
+                    stream_schema = proj.output
+                else:
+                    stream_schema = None
+                if si == 0:
+                    left, left_keys = new_kid, skeys
+                else:
+                    right, right_keys = new_kid, skeys
         return XJ.BroadcastHashJoinExec(
-            jt, n.left_keys, n.right_keys, left, right, condition=n.condition,
-            build_side=build_side, conf=meta.conf)
+            jt, left_keys, right_keys, left, right, condition=n.condition,
+            build_side=build_side, conf=meta.conf,
+            stream_prefilter=stream_prefilter,
+            stream_preproject=stream_preproject,
+            stream_schema=stream_schema)
 
     def conv_sort(meta, kids):
         from spark_rapids_tpu.ops.sorting import SortOrder

@@ -1,0 +1,18 @@
+"""Hardware-trait predicates shared by kernel-strategy choices.
+
+Kernels with a formulation choice (scatter vs gather, direct table vs sort)
+ask these predicates instead of re-encoding backend names at every call
+site — the strategy stays consistent across the engine and a new backend is
+reasoned about once.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def scatters_cheap() -> bool:
+    """Large 1:1 scatters are near-memcpy on CPU-class backends but
+    SERIALIZE on the TPU (the reason ops/grouping.py uses scan-based segment
+    reductions there). Gather/searchsorted formulations stay the TPU path."""
+    return jax.default_backend() != "tpu"
