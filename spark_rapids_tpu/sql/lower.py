@@ -161,6 +161,8 @@ class _ExprConverter:
             from spark_rapids_tpu.expr import arithmetic as AR
             from spark_rapids_tpu.expr import predicates as PR
             from spark_rapids_tpu.expr.strings import Concat
+            if isinstance(a.right, P.IntervalAst) and a.op in ("+", "-"):
+                return _date_interval(c(a.left), a.right, a.op)
             l, r = c(a.left), c(a.right)
             table = {
                 "+": AR.Add, "-": AR.Subtract, "*": AR.Multiply,
@@ -187,7 +189,31 @@ class _ExprConverter:
             return CaseWhen(branches, else_e)
         if isinstance(a, P.CastAst):
             from spark_rapids_tpu.expr.cast import Cast
-            return Cast(c(a.expr), _sql_type(a.type_name, a.type_args))
+            to = _sql_type(a.type_name, a.type_args)
+            # typed literals (DATE '...', TIMESTAMP '...') fold to constants
+            # at plan time — Spark's Literal parsing. Explicit cast() keeps
+            # its runtime Spark cast semantics (lenient parse, NULL on bad
+            # input) — the two share an AST node but not behavior.
+            if a.typed_literal and isinstance(a.expr, P.Lit) \
+                    and isinstance(a.expr.value, str):
+                import datetime as _dt
+                s = a.expr.value.strip()
+                try:
+                    if isinstance(to, T.DateType):
+                        d = _dt.date.fromisoformat(s)
+                        return E.Literal((d - _dt.date(1970, 1, 1)).days,
+                                         T.DATE)
+                    if isinstance(to, T.TimestampType):
+                        ts = _dt.datetime.fromisoformat(s).replace(
+                            tzinfo=_dt.timezone.utc)
+                        epoch = _dt.datetime(1970, 1, 1,
+                                             tzinfo=_dt.timezone.utc)
+                        micros = (ts - epoch) // _dt.timedelta(microseconds=1)
+                        return E.Literal(micros, T.TIMESTAMP)
+                except ValueError as e:
+                    raise P.SqlParseError(
+                        f"invalid {a.type_name} literal {s!r}: {e}") from e
+            return Cast(c(a.expr), to)
         if isinstance(a, P.BetweenAst):
             from spark_rapids_tpu.expr.predicates import (
                 And, GreaterThanOrEqual, LessThanOrEqual, Not)
@@ -460,6 +486,28 @@ def _ast_idents(a) -> list:
             walk(x.expr)
     walk(a)
     return out
+
+
+def _date_interval(date_expr, iv, op: str):
+    """date ± INTERVAL literal → DateAddInterval / AddMonths (Spark lowers
+    calendar intervals the same way; day/week are fixed-length, month/year
+    are calendar adds)."""
+    from spark_rapids_tpu.expr import core as E
+    from spark_rapids_tpu.expr.datetime import AddMonths, DateAddInterval
+    try:
+        n = int(iv.value)
+    except ValueError as e:
+        raise P.SqlParseError(f"invalid interval value {iv.value!r}") from e
+    if op == "-":
+        n = -n
+    unit = iv.unit
+    if unit in ("day", "week"):
+        days = n * (7 if unit == "week" else 1)
+        return DateAddInterval(date_expr, E.Literal(days, T.INT))
+    if unit in ("month", "year"):
+        months = n * (12 if unit == "year" else 1)
+        return AddMonths(date_expr, E.Literal(months, T.INT))
+    raise P.SqlParseError(f"unsupported interval unit {iv.unit!r}")
 
 
 class _Relation:

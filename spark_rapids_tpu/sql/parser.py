@@ -174,10 +174,20 @@ class CaseAst:
 
 
 @dataclasses.dataclass
+class IntervalAst:
+    """INTERVAL '<n>' <unit> literal (TPC-H/DS date arithmetic)."""
+    value: str
+    unit: str
+
+
+@dataclasses.dataclass
 class CastAst:
     expr: typing.Any
     type_name: str
     type_args: tuple = ()
+    #: True for DATE '...' / TIMESTAMP '...' typed literals — folded to
+    #: constants at plan time; explicit cast() keeps Spark runtime semantics
+    typed_literal: bool = False
 
 
 @dataclasses.dataclass
@@ -605,6 +615,18 @@ class _Parser:
             self.expect_op(")")
             return e
         if t.kind in ("ident", "kw"):
+            # typed literals: DATE '...', TIMESTAMP '...', INTERVAL 'n' unit
+            low = str(t.value).lower()
+            if low in ("date", "timestamp") and self.toks[self.i + 1].kind \
+                    == "str":
+                self.next()
+                lit = self.next()
+                return CastAst(Lit(lit.value), low, typed_literal=True)
+            if low == "interval" and self.toks[self.i + 1].kind == "str":
+                self.next()
+                val = self.next().value
+                unit = self.ident().lower().rstrip("s")
+                return IntervalAst(val, unit)
             # function call or (qualified) identifier; soft keywords allowed
             name = self.ident()
             if self.at_op("("):

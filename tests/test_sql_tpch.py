@@ -1,0 +1,73 @@
+"""Official TPC-H SQL text (q1/q3/q5) through session.sql(), value-checked
+against the independent NumPy oracles — the same equality the DataFrame
+suite (test_tpch.py) enforces. Also covers the typed-literal grammar
+(DATE '...', INTERVAL 'n' unit) the official text depends on."""
+
+import pytest
+
+from spark_rapids_tpu.benchmarks import tpch
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.sql.tpch_queries import SQL_QUERIES
+
+SF = 0.01
+
+
+@pytest.fixture(scope="module")
+def env():
+    paths = tpch.generate(SF, f"/tmp/tpch_sf{SF}")
+    spark = TpuSession()
+    tpch.load(spark, paths, files_per_partition=2)  # registers temp views
+    return spark, tpch.load_np(paths)
+
+
+def test_sql_q1_matches_oracle(env):
+    spark, tb = env
+    got = spark.sql(SQL_QUERIES["q1"]).collect().to_pylist()
+    exp = tpch.np_q1(tb)
+    assert len(got) == len(exp)
+    for g_, e in zip(got, exp):
+        g = list(g_.values())
+        assert g[0] == e[0] and g[1] == e[1]
+        for a, b in zip(g[2:], e[2:]):
+            assert abs(a - b) <= 1e-6 * max(1.0, abs(b)), (g, e)
+
+
+def test_sql_q3_matches_oracle(env):
+    spark, tb = env
+    got = spark.sql(SQL_QUERIES["q3"]).collect().to_pylist()
+    exp = tpch.np_q3(tb)
+    assert len(got) == len(exp)
+    for g, (k, d, p, rev) in zip(got, exp):
+        assert g["l_orderkey"] == k
+        assert abs(g["revenue"] - rev) <= 1e-6 * max(1.0, abs(rev))
+
+
+def test_sql_q5_matches_oracle(env):
+    spark, tb = env
+    got = spark.sql(SQL_QUERIES["q5"]).collect().to_pylist()
+    exp = tpch.np_q5(tb)
+    assert len(got) == len(exp)
+    for g, (n, v) in zip(got, exp):
+        assert g["n_name"] == n
+        assert abs(g["revenue"] - v) <= 1e-6 * max(1.0, abs(v))
+
+
+def test_typed_literals_grammar():
+    spark = TpuSession()
+    import pyarrow as pa
+    spark.create_or_replace_temp_view(
+        "t", spark.create_dataframe(pa.table({"x": pa.array([1], pa.int64())})))
+    row = spark.sql(
+        "select date '2020-03-01' as d, "
+        "date '2020-03-01' + interval '2' day as d2, "
+        "date '2020-03-01' - interval '1' month as m, "
+        "date '2020-01-31' + interval '1' month as clamp, "
+        "date '2020-03-01' + interval '1' week as w, "
+        "timestamp '2020-03-01 12:30:00' as ts from t").collect().to_pylist()[0]
+    import datetime
+    assert row["d"] == datetime.date(2020, 3, 1)
+    assert row["d2"] == datetime.date(2020, 3, 3)
+    assert row["m"] == datetime.date(2020, 2, 1)
+    assert row["clamp"] == datetime.date(2020, 2, 29)   # month-end clamp
+    assert row["w"] == datetime.date(2020, 3, 8)
+    assert row["ts"].replace(tzinfo=None) == datetime.datetime(2020, 3, 1, 12, 30)
