@@ -273,6 +273,8 @@ def _value_key(v):
         return (type(v).__name__, v)
     if isinstance(v, T.DataType):
         return v
+    if isinstance(v, type):              # class-valued fields (strategy
+        return ("class", v.__module__, v.__qualname__)  # selectors etc.)
     if isinstance(v, _types.CodeType):   # nested function consts
         return ("code", v.co_code, tuple(_value_key(c) for c in v.co_consts),
                 v.co_names)

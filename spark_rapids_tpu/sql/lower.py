@@ -133,6 +133,30 @@ class _Grouping(E.Expression):
         raise SqlAnalysisError("grouping() outside GROUP BY ROLLUP")
 
 
+class _DistinctAgg(AggregateFunction):
+    """Marker for fn(DISTINCT x); _aggregate rewrites it two-level (Spark's
+    RewriteDistinctAggregates role: inner GROUP BY (keys, x) dedupes, outer
+    re-aggregates) — reaching eval means the rewrite didn't run."""
+
+    def __init__(self, fn_cls, child):
+        super().__init__(child)
+        self.fn_cls = fn_cls
+
+    def make(self, ref):
+        return self.fn_cls(ref)
+
+    @property
+    def dtype(self):
+        return self.fn_cls(self.child).dtype
+
+    def with_children(self, children):
+        return _DistinctAgg(self.fn_cls, children[0])
+
+    @property
+    def state_types(self):
+        raise SqlAnalysisError("DISTINCT aggregate outside rewrite")
+
+
 # -- expression conversion ----------------------------------------------------
 
 class _ExprConverter:
@@ -282,17 +306,24 @@ class _ExprConverter:
         if a.over is not None:
             return self._window(a)
         if name in _AGG_FUNCS:
-            if a.distinct:
-                raise SqlAnalysisError(f"DISTINCT aggregate {name} not "
-                                       "supported")
             if len(a.args) != 1:
                 raise SqlAnalysisError(f"{name} takes one argument")
+            if a.distinct:
+                if name not in ("sum", "avg"):
+                    raise SqlAnalysisError(
+                        f"DISTINCT aggregate {name} not supported")
+                return _DistinctAgg(_AGG_FUNCS[name], c(a.args[0]))
             return _AGG_FUNCS[name](c(a.args[0]))
         if name == "count":
-            if a.distinct:
-                raise SqlAnalysisError("count(DISTINCT) not supported")
             if not a.args or isinstance(a.args[0], P.Star):
+                if a.distinct:
+                    raise SqlAnalysisError("count(DISTINCT *) not supported")
                 return Count(None)
+            if a.distinct:
+                if len(a.args) != 1:
+                    raise SqlAnalysisError(
+                        "count(DISTINCT a, b, ...) not supported")
+                return _DistinctAgg(Count, c(a.args[0]))
             return Count(c(a.args[0]))
         if name in ("substr", "substring"):
             from spark_rapids_tpu.expr.strings import Substring
@@ -357,6 +388,10 @@ class _ExprConverter:
     def _window(self, a: P.FuncCall) -> E.Expression:
         from spark_rapids_tpu.expr import windows as WX
         spec_ast = a.over
+        if a.distinct:
+            # the two-level distinct rewrite has no window form
+            raise SqlAnalysisError(
+                f"DISTINCT aggregate {a.name} in a window not supported")
         inner = P.FuncCall(a.name, a.args, a.distinct, None)
         name = a.name
         if name == "row_number":
@@ -948,6 +983,44 @@ class _Lowerer:
         for c in e.children:
             _Lowerer._collect_windows(c, out)
 
+    def _rewrite_distinct(self, plan, group_bound, aggs, rollup):
+        """Spark RewriteDistinctAggregates (single distinct column form):
+        inner GROUP BY (keys, x) dedupes x per group, the outer aggregate
+        re-reduces. Min/Max mix in freely (distinct-insensitive: they
+        re-reduce over the inner partials)."""
+        from spark_rapids_tpu.expr.aggregates import Max, Min
+        if rollup:
+            raise SqlAnalysisError(
+                "DISTINCT aggregates with ROLLUP not supported")
+        xkeys = {fuse.expr_key(a.child) for _, a in aggs
+                 if isinstance(a, _DistinctAgg)}
+        if len(xkeys) != 1 or not all(
+                isinstance(a, (_DistinctAgg, Min, Max)) for _, a in aggs):
+            raise SqlAnalysisError(
+                "unsupported DISTINCT aggregate combination (one distinct "
+                "column, mixed only with min/max)")
+        x = next(a.child for _, a in aggs if isinstance(a, _DistinctAgg))
+        others = [(k, a) for k, a in aggs if not isinstance(a, _DistinctAgg)]
+        inner_aggs = [E.Alias(a, f"_m{i}") for i, (_, a) in enumerate(others)]
+        inner = NN.AggregateNode(list(group_bound) + [x], inner_aggs, plan)
+        iout = inner.output
+        ng = len(group_bound)
+        x_ref = E.BoundReference(ng, iout.fields[ng].data_type, True,
+                                 iout.fields[ng].name)
+        other_pos = {k: ng + 1 + i for i, (k, _) in enumerate(others)}
+        outer_aggs = []
+        for i, (k, a) in enumerate(aggs):
+            if isinstance(a, _DistinctAgg):
+                outer_aggs.append(E.Alias(a.make(x_ref), f"_a{i}"))
+            else:
+                j = other_pos[k]
+                ref = E.BoundReference(j, iout.fields[j].data_type, True,
+                                       iout.fields[j].name)
+                outer_aggs.append(E.Alias(type(a)(ref), f"_a{i}"))
+        outer_groups = [E.BoundReference(i, f.data_type, f.nullable, f.name)
+                        for i, f in enumerate(iout.fields[:ng])]
+        return NN.AggregateNode(outer_groups, outer_aggs, inner), ng
+
     def _aggregate(self, plan, scope, group_es, items, having_e, rollup,
                    order_items, conv):
         """Build (Expand→)Aggregate; return (plan, substitution fn)."""
@@ -989,10 +1062,15 @@ class _Lowerer:
         else:
             group_bound = list(group_es)
 
-        agg_named = [E.Alias(a, f"_a{i}") for i, (_, a) in enumerate(aggs)]
-        agg_node = NN.AggregateNode(group_bound, agg_named, plan)
+        if any(isinstance(a, _DistinctAgg) for _, a in aggs):
+            agg_node, n_group = self._rewrite_distinct(plan, group_bound,
+                                                       aggs, rollup)
+        else:
+            agg_named = [E.Alias(a, f"_a{i}")
+                         for i, (_, a) in enumerate(aggs)]
+            agg_node = NN.AggregateNode(group_bound, agg_named, plan)
+            n_group = len(group_bound)
         out = agg_node.output
-        n_group = len(group_bound)
 
         group_keys = {fuse.expr_key(g): i for i, g in enumerate(group_es)}
 

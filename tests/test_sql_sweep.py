@@ -117,3 +117,35 @@ def test_sql_sweep_device_matches_host(spark, sql):
     if not has_order:
         got, exp = sorted(got, key=repr), sorted(exp, key=repr)
     assert got == exp, f"{sql}\n{got[:5]} vs {exp[:5]}"
+
+
+def test_distinct_aggregates_rewrite():
+    """fn(DISTINCT x) lowers through the two-level rewrite (Spark
+    RewriteDistinctAggregates role): inner GROUP BY (keys, x) dedupes,
+    outer re-aggregates; min/max mix in (distinct-insensitive)."""
+    import pyarrow as pa
+    from spark_rapids_tpu.session import TpuSession
+    spark = TpuSession()
+    t = pa.table({"g": pa.array(["a", "a", "b", "b", "b", None]),
+                  "x": pa.array([1, 1, 2, None, 3, 2], pa.int64()),
+                  "y": pa.array([5.0, 6.0, 1.0, 2.0, 3.0, 9.0])})
+    spark.create_or_replace_temp_view("dt", spark.create_dataframe(t))
+    row = spark.sql("select count(distinct x) as c, sum(distinct x) as s, "
+                    "avg(distinct x) as a from dt").collect().to_pylist()[0]
+    assert row == {"c": 3, "s": 6, "a": 2.0}
+    rows = sorted(spark.sql(
+        "select g, count(distinct x) as c, min(y) as mn, max(y) as mx "
+        "from dt group by g").collect().to_pylist(),
+        key=lambda r: (r["g"] is None, r["g"]))
+    assert rows == [
+        {"g": "a", "c": 1, "mn": 5.0, "mx": 6.0},
+        {"g": "b", "c": 2, "mn": 1.0, "mx": 3.0},
+        {"g": None, "c": 1, "mn": 9.0, "mx": 9.0}]
+    # unsupported mixes fail loudly, not silently wrong
+    import pytest
+    from spark_rapids_tpu.sql.lower import SqlAnalysisError
+    with pytest.raises(SqlAnalysisError):
+        spark.sql("select count(distinct x), sum(y) from dt").collect()
+    with pytest.raises(SqlAnalysisError):
+        spark.sql("select count(distinct x), count(distinct g) from dt"
+                  ).collect()
