@@ -14,8 +14,17 @@ def write_partitioned(outdir: str, name: str, table: pa.Table,
     (skips a table directory that already holds parquet parts)."""
     d = os.path.join(outdir, name)
     paths[name] = d
-    if os.path.isdir(d) and any(f.endswith(".parquet") for f in os.listdir(d)):
-        return
+    if os.path.isdir(d):
+        parts = sorted(f for f in os.listdir(d) if f.endswith(".parquet"))
+        if parts:
+            # schema-evolution guard: a generator that grew a column or
+            # changed a dtype must regenerate stale cached dirs, not
+            # silently serve the old shape
+            old = pq.read_schema(os.path.join(d, parts[0]))
+            if old.equals(table.schema):
+                return
+            for f in parts:
+                os.unlink(os.path.join(d, f))
     os.makedirs(d, exist_ok=True)
     n = table.num_rows
     per = max((n + nfiles - 1) // nfiles, 1)

@@ -219,6 +219,8 @@ def generate(sf: float, outdir: str, files_per_table: int = 4) -> dict:
             np.round(rng.uniform(0.0, 1800.0, n_ss), 2)),
         "ss_coupon_amt": pa.array(
             np.round(rng.uniform(0.0, 50.0, n_ss), 2)),
+        "ss_wholesale_cost": pa.array(
+            np.round(rng.uniform(1.0, 100.0, n_ss), 2)),
         "ss_net_paid": dec72(rng.uniform(0.0, 20000.0, n_ss)),
         "ss_net_profit": dec72(rng.uniform(-5000.0, 15000.0, n_ss)),
         "ss_ext_wholesale_cost": dec72(rng.uniform(1.0, 10000.0, n_ss)),
@@ -1656,3 +1658,28 @@ def np_q36(tb):
                 (0, "") if cls is None else (1, cls))
     rows.sort(key=skey)
     return [tuple(r) for r in rows[:100]]
+
+
+def np_q28(tb):
+    """q28 oracle: six list-price buckets (avg / count / count distinct of
+    ss_list_price under quantity + price/coupon/wholesale disjunctions),
+    cross-joined into one row. Official default substitution parameters."""
+    ss = tb["store_sales"]
+    lp = ss["ss_list_price"]
+    qty = ss["ss_quantity"]
+    cp = ss["ss_coupon_amt"]
+    wc = ss["ss_wholesale_cost"]
+    params = [(0, 5, 8, 459, 57), (6, 10, 90, 2323, 31),
+              (11, 15, 142, 12214, 79), (16, 20, 135, 6071, 38),
+              (21, 25, 122, 836, 17), (26, 30, 154, 7326, 7)]
+    row = []
+    for qlo, qhi, lp0, cp0, wc0 in params:
+        m = ((qty >= qlo) & (qty <= qhi)
+             & (((lp >= lp0) & (lp <= lp0 + 10))
+                | ((cp >= cp0) & (cp <= cp0 + 1000))
+                | ((wc >= wc0) & (wc <= wc0 + 20))))
+        vals = lp[m]
+        row.append(float(vals.mean()) if len(vals) else None)
+        row.append(int(len(vals)))
+        row.append(int(len(np.unique(vals))))
+    return [tuple(row)]

@@ -986,40 +986,119 @@ class _Lowerer:
     def _rewrite_distinct(self, plan, group_bound, aggs, rollup):
         """Spark RewriteDistinctAggregates (single distinct column form):
         inner GROUP BY (keys, x) dedupes x per group, the outer aggregate
-        re-reduces. Min/Max mix in freely (distinct-insensitive: they
-        re-reduce over the inner partials)."""
-        from spark_rapids_tpu.expr.aggregates import Max, Min
+        re-reduces. Mixes supported without Expand:
+
+        - Min/Max over anything (distinct-insensitive; re-reduce partials);
+        - count/sum/avg over the SAME column x (TPC-DS q28's shape): the
+          inner also carries cnt = count(x) per (keys, x) group, and the
+          outer re-derives count(x)=sum(cnt), sum(x)=sum(x*cnt),
+          avg(x)=sum(x*cnt)/sum(cnt).
+
+        Distinct aggregates over several different columns need Spark's
+        Expand-based rewrite and are rejected."""
+        from spark_rapids_tpu.expr.aggregates import Average, Count, Max, Min
+        from spark_rapids_tpu.expr.arithmetic import Divide, Multiply
+        from spark_rapids_tpu.expr.cast import Cast
         if rollup:
             raise SqlAnalysisError(
                 "DISTINCT aggregates with ROLLUP not supported")
         xkeys = {fuse.expr_key(a.child) for _, a in aggs
                  if isinstance(a, _DistinctAgg)}
-        if len(xkeys) != 1 or not all(
-                isinstance(a, (_DistinctAgg, Min, Max)) for _, a in aggs):
+        if len(xkeys) != 1:
+            raise SqlAnalysisError(
+                "DISTINCT aggregates over several columns not supported")
+        xkey = next(iter(xkeys))
+        x = next(a.child for _, a in aggs if isinstance(a, _DistinctAgg))
+
+        def same_col(a):
+            return (isinstance(a, (Count, Sum, Average))
+                    and a.child is not None
+                    and fuse.expr_key(a.child) == xkey)
+
+        others = [(k, a) for k, a in aggs if not isinstance(a, _DistinctAgg)
+                  and not same_col(a)]
+        if not all(isinstance(a, (Min, Max)) for _, a in others):
             raise SqlAnalysisError(
                 "unsupported DISTINCT aggregate combination (one distinct "
-                "column, mixed only with min/max)")
-        x = next(a.child for _, a in aggs if isinstance(a, _DistinctAgg))
-        others = [(k, a) for k, a in aggs if not isinstance(a, _DistinctAgg)]
+                "column; mixes limited to min/max and count/sum/avg over "
+                "that same column)")
+        need_cnt = any(same_col(a) for _, a in aggs
+                       if not isinstance(a, _DistinctAgg))
         inner_aggs = [E.Alias(a, f"_m{i}") for i, (_, a) in enumerate(others)]
+        if need_cnt:
+            inner_aggs.append(E.Alias(Count(x), "_cnt"))
         inner = NN.AggregateNode(list(group_bound) + [x], inner_aggs, plan)
         iout = inner.output
         ng = len(group_bound)
-        x_ref = E.BoundReference(ng, iout.fields[ng].data_type, True,
-                                 iout.fields[ng].name)
+
+        def ref(j):
+            return E.BoundReference(j, iout.fields[j].data_type, True,
+                                    iout.fields[j].name)
+
+        if need_cnt and isinstance(x.dtype, T.DecimalType):
+            raise SqlAnalysisError(
+                "mixed distinct/non-distinct over a DECIMAL column "
+                "not supported")
+        x_ref = ref(ng)
         other_pos = {k: ng + 1 + i for i, (k, _) in enumerate(others)}
-        outer_aggs = []
-        for i, (k, a) in enumerate(aggs):
+        cnt_ref = ref(ng + 1 + len(others)) if need_cnt else None
+        # outer aggregates are PRIMITIVE (AggregateNode's contract); an avg
+        # re-derivation needs two of them + a division, so a final Project
+        # maps each original aggregate to its value
+        outer_aggs = []       # Alias(AggregateFunction)
+        final = []            # per original agg: ordinal | ("div", i, j)
+        memo = {}             # expr key -> ordinal (avg+count share Sum(cnt))
+
+        def add(agg_fn):
+            k = fuse.expr_key(agg_fn)
+            if k in memo:
+                return memo[k]
+            outer_aggs.append(E.Alias(agg_fn, f"_o{len(outer_aggs)}"))
+            memo[k] = len(outer_aggs) - 1
+            return memo[k]
+
+        for k, a in aggs:
             if isinstance(a, _DistinctAgg):
-                outer_aggs.append(E.Alias(a.make(x_ref), f"_a{i}"))
-            else:
-                j = other_pos[k]
-                ref = E.BoundReference(j, iout.fields[j].data_type, True,
-                                       iout.fields[j].name)
-                outer_aggs.append(E.Alias(type(a)(ref), f"_a{i}"))
+                final.append(add(a.make(x_ref)))
+            elif isinstance(a, (Min, Max)):
+                final.append(add(type(a)(ref(other_pos[k]))))
+            elif isinstance(a, Count):       # count(x) = sum(cnt)
+                final.append(add(Sum(cnt_ref)))
+            elif isinstance(a, Average):     # avg(x) = sum(x*cnt)/sum(cnt)
+                num = add(Sum(Multiply(Cast(x_ref, T.DOUBLE),
+                                       Cast(cnt_ref, T.DOUBLE))))
+                den = add(Sum(cnt_ref))
+                final.append(("div", num, den))
+            else:                            # sum(x) = sum(x*cnt)
+                st = Sum(x_ref).dtype
+                final.append(add(
+                    Sum(Multiply(Cast(x_ref, st), Cast(cnt_ref, st)))))
         outer_groups = [E.BoundReference(i, f.data_type, f.nullable, f.name)
                         for i, f in enumerate(iout.fields[:ng])]
-        return NN.AggregateNode(outer_groups, outer_aggs, inner), ng
+        agg_node = NN.AggregateNode(outer_groups, outer_aggs, inner)
+        aout = agg_node.output
+        proj = [E.BoundReference(i, f.data_type, f.nullable, f.name)
+                for i, f in enumerate(aout.fields[:ng])]
+        for i, spec in enumerate(final):
+            if isinstance(spec, tuple):
+                _, num, den = spec
+                e = Divide(
+                    E.BoundReference(ng + num, aout.fields[ng + num].data_type,
+                                     True, "n"),
+                    Cast(E.BoundReference(ng + den,
+                                          aout.fields[ng + den].data_type,
+                                          True, "d"), T.DOUBLE))
+            else:
+                j = ng + spec
+                e = E.BoundReference(j, aout.fields[j].data_type, True,
+                                     aout.fields[j].name)
+                if isinstance(aggs[i][1], Count):
+                    # count over an empty relation is 0, not the NULL an
+                    # empty outer Sum(cnt) yields
+                    from spark_rapids_tpu.expr.nullexprs import Coalesce
+                    e = Coalesce(e, E.Literal(0, T.LONG))
+            proj.append(E.Alias(e, f"_a{i}"))
+        return NN.ProjectNode(proj, agg_node), ng
 
     def _aggregate(self, plan, scope, group_es, items, having_e, rollup,
                    order_items, conv):

@@ -30,6 +30,8 @@ _SQL_ONLY = {
     # q27 runs the official rollup shape (the DataFrame adaptation omits
     # the rollup levels); g_state shifts the float slots right by one
     "q27": (tpcds.np_q27_rollup, {3, 4, 5, 6}),
+    # q28: six-bucket cross join; avgs at 0,3,6,9,12,15 (DISTINCT rewrite)
+    "q28": (tpcds.np_q28, {0, 3, 6, 9, 12, 15}),
 }
 
 
