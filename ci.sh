@@ -16,6 +16,27 @@ assert out is not None
 print('entry() ok')"
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
 
+echo "== on-chip tool dry-runs (CPU platform; round-4 postmortem gate) =="
+# The one TPU window round 4 got was burned by an untested child process
+# (ModuleNotFoundError). Run the EXACT subprocess invocations the watcher
+# uses, end-to-end, on the CPU platform, so they can never regress unseen.
+python tools/tpu_correctness.py --dryrun-cpu --out /tmp/ci_tpu_correctness.json
+python - <<'PYEOF'
+import json
+d = json.load(open("/tmp/ci_tpu_correctness.json"))
+assert d["ok"] and d["platform"] == "cpu", d
+print("correctness dry-run ok:", len(d["checks"]), "checks")
+PYEOF
+# bench measuring child, exact _spawn() invocation at tiny scale
+bench_line=$(_SRT_BENCH_CHILD=1 JAX_PLATFORMS=cpu TPCH_SF=0.01 \
+  TPCH_DIR=/tmp/tpch_ci_sf0.01 TPCDS_SECONDARY=0 python bench.py | tail -1)
+python -c '
+import json, sys
+d = json.loads(sys.argv[1])
+assert "metric" in d and d["value"] > 0, d
+print("bench-child dry-run ok:", d["metric"], d["value"], d["unit"])
+' "$bench_line"
+
 echo "== api coverage gate (0 missing vs reference GpuOverrides) =="
 python tools/api_validation.py 0 0
 

@@ -31,6 +31,9 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 def child_main():
     import numpy as np
     import jax
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the axon site hook re-selects TPU regardless of env; override it
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import pyarrow as pa
     import spark_rapids_tpu  # noqa: F401  (x64)
@@ -145,15 +148,25 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=str(REPO / "TPU_CORRECTNESS.json"))
     ap.add_argument("--probe-timeout", type=float, default=120.0)
+    ap.add_argument("--dryrun-cpu", action="store_true",
+                    help="CI gate (VERDICT r4 next #1a): run the EXACT "
+                         "parent->child subprocess path on the CPU platform, "
+                         "skipping the probe, so an import/PYTHONPATH/API "
+                         "regression can never meet the chip first")
     args = ap.parse_args()
     sys.path.insert(0, str(REPO / "tools"))
     from tpu_probe import probe, log_result
-    ok, detail = probe(args.probe_timeout)
-    log_result(ok, detail, "correctness-subset probe")
-    if not ok:
-        sys.exit(1)
+    if args.dryrun_cpu:
+        log_result = lambda *a, **k: None  # noqa: E731 — no probe-log noise
+    else:
+        ok, detail = probe(args.probe_timeout)
+        log_result(ok, detail, "correctness-subset probe")
+        if not ok:
+            sys.exit(1)
     env = dict(os.environ)
     env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    if args.dryrun_cpu:
+        env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--child"],
         env=env, stdout=subprocess.PIPE,

@@ -15,13 +15,22 @@ import argparse
 import datetime
 import os
 import pathlib
+import re
 import subprocess
 import sys
 import time
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 LOG = REPO / "docs" / "perf_notes.md"
-MARKER = "## Round-4 TPU probe log"
+MARKER = "## Round-5 TPU probe log"
+
+# Matches both a single UNAVAILABLE entry and a collapsed run
+# (`first` → `last` **UNAVAILABLE ×N**). Used to fold consecutive
+# identical failures into one line (VERDICT r4 weak #8: bounded log).
+_UNAVAIL_RE = re.compile(
+    r"^- `(?P<first>[0-9: -]+UTC)`(?: → `(?P<last>[0-9: -]+UTC)`)?"
+    r" \*\*UNAVAILABLE(?: ×(?P<n>\d+))?\*\* — (?P<detail>.*?)"
+    r"(?: _\((?P<note>.*)\)_)?$")
 
 PROBE_CODE = (
     "import jax; d = jax.devices(); "
@@ -52,6 +61,18 @@ def probe(timeout_s: float):
 
 
 def log_result(ok: bool, detail: str, note: str = ""):
+    # watcher + manual probes can overlap: serialize the read-modify-write
+    import fcntl
+    lockf = open(LOG.parent / ".probe_log.lock", "w")
+    fcntl.flock(lockf, fcntl.LOCK_EX)
+    try:
+        _log_result_locked(ok, detail, note)
+    finally:
+        fcntl.flock(lockf, fcntl.LOCK_UN)
+        lockf.close()
+
+
+def _log_result_locked(ok: bool, detail: str, note: str):
     stamp = datetime.datetime.now(datetime.timezone.utc).strftime(
         "%Y-%m-%d %H:%M UTC")
     status = "OK" if ok else "UNAVAILABLE"
@@ -61,7 +82,23 @@ def log_result(ok: bool, detail: str, note: str = ""):
     text = LOG.read_text() if LOG.exists() else "# Perf notes\n"
     if MARKER not in text:
         text = text.rstrip() + f"\n\n{MARKER}\n\n"
-    text = text.rstrip() + "\n" + entry + "\n"
+    text = text.rstrip()
+    # Bounded log: any run of consecutive UNAVAILABLE entries collapses into
+    # one `first → last ×N` line instead of appending forever. The run keeps
+    # the FIRST failure's detail; a differing latest detail is noted once.
+    lines = text.splitlines()
+    if not ok and lines and MARKER in text[:text.rfind(lines[-1])]:
+        m = _UNAVAIL_RE.match(lines[-1])
+        if m:
+            first = m.group("first")
+            n = int(m.group("n") or 1) + 1
+            base = re.sub(r" \(latest: .*\)$", "", m.group("detail") or "")
+            d = base if base == detail else f"{base} (latest: {detail})"
+            entry = f"- `{first}` → `{stamp}` **UNAVAILABLE ×{n}** — {d}"
+            if note:
+                entry += f" _(latest: {note})_"
+            text = "\n".join(lines[:-1])
+    text = text + "\n" + entry + "\n"
     LOG.write_text(text)
     print(entry)
 

@@ -109,9 +109,9 @@ def main():
         n += 1
         ok, detail = probe(75.0)
         if not ok:
-            # don't spam the log with every failed probe; log every 4th
-            if n % 4 == 1:
-                log_result(False, detail, f"watcher probe #{n}")
+            # log_result collapses consecutive timeout failures into one
+            # `first → last ×N` line, so logging every probe stays bounded
+            log_result(False, detail, f"watcher probe #{n}")
             time.sleep(args.interval)
             continue
         log_result(True, detail, f"watcher probe #{n}: chip is up")
