@@ -7,8 +7,14 @@ independent single-core NumPy oracle (benchmarks/tpch.py) — a wrong answer
 reports value 0 rather than a throughput. Prints ONE JSON line:
 
   value       = geomean over q1/q3/q5 of (lineitem rows / hot-run seconds), Mrows/s
-  vs_baseline = geomean over queries of (numpy oracle time / hot-run time)
-                (the reference's own claim is 3x-7x vs CPU Spark, docs/FAQ.md:82-88)
+  vs_baseline = geomean over queries of (numpy oracle E2E time / hot-run time),
+                where the oracle re-reads the query's parquet tables per run —
+                both sides pay the scan (VERDICT r4 next #2: the old preloaded-
+                array oracle capped q3/q5 at the decode floor). The reference's
+                own claim is 3x-7x vs CPU Spark, docs/FAQ.md:82-88.
+  vs_baseline_compute = the round-4-and-earlier denominator (oracle computes on
+                preloaded arrays; engine still pays its scan), kept one round
+                for continuity.
 
 Resilience (round-1 postmortem + round-2 tunnel-wedge postmortem): the
 measurement runs in a CHILD process with a timeout; the parent probes the
@@ -56,6 +62,25 @@ def _check_q5(got, exp):
 
 CHECKS = {"q1": _check_q1, "q3": _check_q3, "q5": _check_q5}
 NP_QUERIES = {"q1": "np_q1", "q3": "np_q3", "q5": "np_q5"}
+# (table -> columns) each query scans — the fair oracle re-reads exactly
+# these per run, mirroring what the engine's COLUMN-PRUNED plan scans every
+# collect() (plan/pruning.py narrows the FileScanNode the same way)
+Q_TABLES = {
+    "q1": {"lineitem": ["l_discount", "l_extendedprice", "l_linestatus",
+                        "l_quantity", "l_returnflag", "l_shipdate", "l_tax"]},
+    "q3": {"customer": ["c_custkey", "c_mktsegment"],
+           "orders": ["o_custkey", "o_orderdate", "o_orderkey",
+                      "o_shippriority"],
+           "lineitem": ["l_discount", "l_extendedprice", "l_orderkey",
+                        "l_shipdate"]},
+    "q5": {"customer": ["c_custkey", "c_nationkey"],
+           "orders": ["o_custkey", "o_orderdate", "o_orderkey"],
+           "lineitem": ["l_discount", "l_extendedprice", "l_orderkey",
+                        "l_suppkey"],
+           "supplier": ["s_nationkey", "s_suppkey"],
+           "nation": ["n_name", "n_nationkey", "n_regionkey"],
+           "region": ["r_name", "r_regionkey"]},
+}
 
 
 def child_main():
@@ -84,7 +109,9 @@ def child_main():
     tb = tpch.load_np(paths)
     n_lineitem = len(tb["lineitem"]["l_orderkey"])
 
-    speedups, mrows = [], []
+    from spark_rapids_tpu.benchmarks.common import read_np
+
+    speedups_e2e, speedups_compute, mrows = [], [], []
     for name, q in tpch.QUERIES.items():
         df = q(dfs)
         got = df.collect().to_pylist()          # warm (compiles cached after)
@@ -95,10 +122,20 @@ def child_main():
             t0 = time.perf_counter()
             df.collect()
             best = min(best, time.perf_counter() - t0)
+        # fair oracle: re-read this query's tables from parquet + compute
+        # (both sides pay the scan; OS page cache is warm for both)
+        t0 = time.perf_counter()
+        tb_q = {t: read_np(paths[t], columns=cols)
+                for t, cols in Q_TABLES[name].items()}
+        getattr(tpch, NP_QUERIES[name])(tb_q)
+        np_e2e = time.perf_counter() - t0
+        del tb_q
+        # legacy denominator: oracle computes on preloaded arrays
         t0 = time.perf_counter()
         getattr(tpch, NP_QUERIES[name])(tb)
-        np_t = time.perf_counter() - t0
-        speedups.append(np_t / best)
+        np_compute = time.perf_counter() - t0
+        speedups_e2e.append(np_e2e / best)
+        speedups_compute.append(np_compute / best)
         mrows.append(n_lineitem / best / 1e6)
 
     geo = lambda xs: math.exp(sum(math.log(x) for x in xs) / len(xs))
@@ -106,7 +143,9 @@ def child_main():
         "metric": f"tpch_sf{TPCH_SF}_q1q3q5_geomean",
         "value": round(geo(mrows), 3),
         "unit": "Mrows/s",
-        "vs_baseline": round(geo(speedups), 3),
+        "vs_baseline": round(geo(speedups_e2e), 3),
+        "vs_baseline_compute": round(geo(speedups_compute), 3),
+        "baseline_denominator": "numpy-oracle e2e (per-query parquet re-read)",
     }
     if platform != "tpu":
         line["degraded"] = f"platform={platform}"

@@ -44,9 +44,9 @@ def load(spark, paths: dict, files_per_partition: int = 2) -> dict:
     return dfs
 
 
-def read_np(path):
+def read_np(path, columns=None):
     """Read a table dir/file into {col: np.ndarray}; date32 → epoch-day i32."""
-    t = pq.read_table(path)
+    t = pq.read_table(path, columns=columns)
     out = {}
     for name in t.column_names:
         col = t.column(name)
