@@ -207,22 +207,26 @@ def _prep_kernel(cap: int, dt_name: str):
         else:
             vmin = jnp.where(vl, vals, hi).min()
             vmax = jnp.where(vl, vals, lo).max()
-        return comp, cnt, n - cnt, vmin, vmax
+        return comp, cnt, n - cnt, vmin, vmax, vl
 
     return k
 
 
 def _prep_column(col, num_rows: int):
     """Run the device prep; returns host-side (values[:n_valid], n_valid,
-    null_count, vmin, vmax) — one device->host transfer for the stream."""
+    null_count, vmin, vmax, valid[:num_rows]) — one device->host transfer
+    for the stream (the validity rides along so _encode_column doesn't pay
+    a second per-column transfer for definition levels)."""
     k = _prep_kernel(col.capacity, np.dtype(col.data.dtype).name)
-    comp, cnt, nulls, vmin, vmax = k(col.data, col.validity,
-                                     jnp.int32(num_rows))
-    cnt = int(cnt)
+    comp, cnt, nulls, vmin, vmax, vl = k(col.data, col.validity,
+                                         jnp.int32(num_rows))
+    cnt, nulls = int(cnt), int(nulls)
     # static device-side slice before transfer: capacities are power-of-two
-    # bucketed, so the padded tail can dwarf the live rows (to_host pattern)
-    return (np.asarray(comp[:num_rows])[:cnt], cnt, int(nulls),
-            np.asarray(vmin)[()], np.asarray(vmax)[()])
+    # bucketed, so the padded tail can dwarf the live rows (to_host pattern).
+    # All-valid columns (the common case) skip the validity transfer.
+    valid = np.asarray(vl[:num_rows]) if nulls else None
+    return (np.asarray(comp[:num_rows])[:cnt], cnt, nulls,
+            np.asarray(vmin)[()], np.asarray(vmax)[()], valid)
 
 
 # --- host framing ----------------------------------------------------------
@@ -330,9 +334,9 @@ def _stats_struct(w: _CompactWriter, fid: int, null_count: int,
 
 def _encode_column(col, dt: T.DataType, num_rows: int, codec: str):
     """Encode one column chunk: optional dictionary page + one v1 data page."""
-    vals, n_valid, null_count, vmin, vmax = _prep_column(col, num_rows)
-    valid = (np.asarray(col.validity[:num_rows]) if null_count
-             else np.ones(num_rows, dtype=bool))
+    vals, n_valid, null_count, vmin, vmax, valid = _prep_column(col, num_rows)
+    if valid is None:
+        valid = np.ones(num_rows, dtype=bool)
 
     pt, _, np_dt = _physical(dt)
     is_string = isinstance(dt, T.StringType)

@@ -186,11 +186,10 @@ def write_columnar(exec_or_node, path: str, fmt: str = "parquet",
     schema = exec_or_node.output
     total = WriteStats()
     lock = threading.Lock()
-    native_parquet = fmt == "parquet"
-    if conf is not None:
-        from spark_rapids_tpu import config as CFG
-        native_parquet = (native_parquet and
-                          conf.get(CFG.PARQUET_WRITER_TYPE).upper() == "NATIVE")
+    from spark_rapids_tpu import config as CFG
+    writer_type = (conf.get(CFG.PARQUET_WRITER_TYPE) if conf is not None
+                   else CFG.PARQUET_WRITER_TYPE.default)
+    native_parquet = fmt == "parquet" and str(writer_type).upper() == "NATIVE"
 
     def run_split(split):
         writer = _TaskWriter(temp_dir, split, fmt, compression, partition_by,

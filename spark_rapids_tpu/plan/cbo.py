@@ -48,10 +48,21 @@ def _estimate_rows(node, memo) -> int:
     if isinstance(node, FileScanNode):
         # cached on the node: scans persist across planning passes (the
         # build-side chooser and optimize() both ask), and re-opening every
-        # parquet footer per pass scales with file count
-        cached = getattr(node, "_est_rows", None)
-        if cached is not None:
-            return cached
+        # parquet footer per pass scales with file count. Keyed on file
+        # mtimes so a retained plan over files that grew/shrank (or a
+        # pruning-pass shallow clone of a stale node) re-estimates.
+        import os
+
+        def _mt(p):
+            try:
+                return os.path.getmtime(p)
+            except OSError:
+                return 0.0
+        fp = tuple((p, _mt(p))
+                   for part in node.partitions for p in part.paths)
+        if (getattr(node, "_est_rows", None) is not None
+                and getattr(node, "_est_rows_fp", None) == fp):
+            return node._est_rows
         total = 0
         for part in node.partitions:
             for p in part.paths:
@@ -60,11 +71,10 @@ def _estimate_rows(node, memo) -> int:
                         import pyarrow.parquet as pq
                         total += pq.ParquetFile(p).metadata.num_rows
                     else:
-                        import os
                         total += max(1, os.path.getsize(p) // 64)
                 except Exception:
                     total += 1 << 20  # unknown: assume big (stay on device)
-        node._est_rows = total
+        node._est_rows, node._est_rows_fp = total, fp
         return total
     if isinstance(node, NN.RangeNode):
         return max(0, -(-(node.end - node.start) // node.step))

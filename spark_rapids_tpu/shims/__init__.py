@@ -153,7 +153,16 @@ def load_shim(version: str) -> SparkShim:
             rank = (k, platform_match)
             if best_key is None or rank >= best_key:
                 best, best_key = s, rank
-    return (best or _SHIMS[0])()
+    chosen = best or _SHIMS[0]
+    if platform and getattr(chosen, "platform", "") != platform:
+        # e.g. load_shim("3.5.0-databricks") when the databricks set only
+        # specializes 3.0/3.1: the OSS generation serves the request, but
+        # newer platform semantic deltas are unmodeled — say so once.
+        import warnings
+        warnings.warn(
+            f"shim {version}-{platform}: no {platform} shim specializes "
+            f"{version}; using OSS {chosen.version_prefix} semantics")
+    return chosen()
 
 
 def shim_for(conf) -> SparkShim:

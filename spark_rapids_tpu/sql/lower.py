@@ -228,6 +228,11 @@ class _ExprConverter:
                         return E.Literal((d - _dt.date(1970, 1, 1)).days,
                                          T.DATE)
                     if isinstance(to, T.TimestampType):
+                        # Engine is UTC-only: Spark resolves TIMESTAMP
+                        # literals in spark.sql.session.timeZone; this build
+                        # fixes the session zone to UTC (docs/compatibility.md:
+                        # "session-timezone-dependent expressions assume
+                        # UTC"), so the fold pins UTC explicitly.
                         ts = _dt.datetime.fromisoformat(s).replace(
                             tzinfo=_dt.timezone.utc)
                         epoch = _dt.datetime(1970, 1, 1,
