@@ -51,12 +51,23 @@ def generate(sf: float, outdir: str, files_per_table: int = 4) -> dict:
         from spark_rapids_tpu.benchmarks.common import write_partitioned
         write_partitioned(outdir, name, table, nfiles, paths)
 
+    # separate stream for round-5 additions (catalog/web facts, preferred
+    # flag) so the original tables stay byte-identical with earlier rounds
+    rng5 = np.random.default_rng(20260731)
+
     # date_dim: one row per day, d_date_sk dense from 1
     sk = np.arange(1, N_DATES + 1, dtype=np.int64)
     doy = (sk - 1) % 366
     moy = (doy // 31 + 1).astype(np.int32)
+    base_days = int((np.datetime64(f"{FIRST_YEAR}-01-01")
+                     - np.datetime64("1970-01-01")) // np.timedelta64(1, "D"))
     write("date_dim", pa.table({
         "d_date_sk": pa.array(sk),
+        "d_date": pa.array((base_days + sk - 1).astype(np.int32),
+                           pa.int32()).cast(pa.date32()),
+        # month sequence from 1200 (the official queries' param range)
+        "d_month_seq": pa.array(
+            (1200 + ((sk - 1) // 366) * 12 + (moy - 1)).astype(np.int32)),
         "d_year": pa.array((FIRST_YEAR + (sk - 1) // 366).astype(np.int32)),
         "d_moy": pa.array(moy),
         "d_dom": pa.array((doy % 31 + 1).astype(np.int32)),
@@ -164,6 +175,8 @@ def generate(sf: float, outdir: str, files_per_table: int = 4) -> dict:
             rng.integers(1, n_addr + 1, n_cust).astype(np.int64)),
         "c_first_name": pa.array([f"First{k % 500}" for k in range(n_cust)]),
         "c_last_name": pa.array([f"Last{k % 700}" for k in range(n_cust)]),
+        "c_preferred_cust_flag": pa.array(
+            np.where(rng5.random(n_cust) < 0.5, "Y", "N")),
     }), 1)
 
     # store_sales (fact). Money columns that TPC-DS declares decimal(7,2)
@@ -225,6 +238,35 @@ def generate(sf: float, outdir: str, files_per_table: int = 4) -> dict:
         "ss_net_profit": dec72(rng.uniform(-5000.0, 15000.0, n_ss)),
         "ss_ext_wholesale_cost": dec72(rng.uniform(1.0, 10000.0, n_ss)),
     }))
+
+    # catalog_sales / web_sales (round 5): the cross-channel facts q38/q87's
+    # INTERSECT/EXCEPT and q14's shapes join against. Spec row ratios are
+    # roughly ss : cs : ws = 2 : 1 : 0.5; half of each channel's
+    # (customer, date) pairs ECHO store_sales visits so cross-channel
+    # set operations select a meaningful overlap (spec customers shop in
+    # several channels; independent draws would make the intersect ~empty).
+    ss_date, ss_cust = tk_date[ticket - 1], tk_cust[ticket - 1]
+
+    def channel(prefix, n_rows):
+        take = rng5.integers(0, n_ss, n_rows)
+        echo = rng5.random(n_rows) < 0.5
+        date = np.where(echo, ss_date[take],
+                        rng5.integers(1, N_DATES + 1, n_rows)).astype(np.int64)
+        cust = np.where(echo, ss_cust[take],
+                        rng5.integers(1, n_cust + 1, n_rows)).astype(np.int64)
+        return pa.table({
+            f"{prefix}_sold_date_sk": pa.array(date),
+            f"{prefix}_bill_customer_sk": pa.array(cust),
+            f"{prefix}_item_sk": pa.array(
+                rng5.integers(1, n_item + 1, n_rows).astype(np.int64)),
+            f"{prefix}_quantity": pa.array(
+                rng5.integers(1, 100, n_rows).astype(np.int32)),
+            f"{prefix}_list_price": pa.array(
+                np.round(rng5.uniform(1.0, 200.0, n_rows), 2)),
+        })
+
+    write("catalog_sales", channel("cs", max(n_ss // 2, 10)))
+    write("web_sales", channel("ws", max(n_ss // 4, 10)))
     return paths
 
 
@@ -1683,3 +1725,130 @@ def np_q28(tb):
         row.append(int(len(vals)))
         row.append(int(len(np.unique(vals))))
     return [tuple(row)]
+
+
+def _names_dates(tb, fact, date_col, cust_col, lo=1200, hi=1211):
+    """{(c_last_name, c_first_name, d_date)} for one sales channel within a
+    d_month_seq window — the q38/q87 arm."""
+    dd = tb["date_dim"]
+    sel = (dd["d_month_seq"] >= lo) & (dd["d_month_seq"] <= hi)
+    dmap = dict(zip(dd["d_date_sk"][sel].tolist(),
+                    dd["d_date"][sel].tolist()))
+    cu = tb["customer"]
+    fn = dict(zip(cu["c_customer_sk"], cu["c_first_name"]))
+    ln = dict(zip(cu["c_customer_sk"], cu["c_last_name"]))
+    f = tb[fact]
+    out = set()
+    for dk, ck in zip(f[date_col].tolist(), f[cust_col].tolist()):
+        d = dmap.get(dk)
+        if d is not None:
+            out.add((ln[ck], fn[ck], d))
+    return out
+
+
+def np_q38(tb):
+    s = (_names_dates(tb, "store_sales", "ss_sold_date_sk", "ss_customer_sk")
+         & _names_dates(tb, "catalog_sales", "cs_sold_date_sk",
+                        "cs_bill_customer_sk")
+         & _names_dates(tb, "web_sales", "ws_sold_date_sk",
+                        "ws_bill_customer_sk"))
+    return [(len(s),)]
+
+
+def np_q87(tb):
+    s = (_names_dates(tb, "store_sales", "ss_sold_date_sk", "ss_customer_sk")
+         - _names_dates(tb, "catalog_sales", "cs_sold_date_sk",
+                        "cs_bill_customer_sk")
+         - _names_dates(tb, "web_sales", "ws_sold_date_sk",
+                        "ws_bill_customer_sk"))
+    return [(len(s),)]
+
+
+_Q8_ZIPS = {"10000", "10005", "10010", "10015", "10020", "10025", "10030",
+            "10035", "10040", "10045", "10050", "10055", "10060", "10065",
+            "10070", "10075", "10080", "10085", "10090", "10095"}
+
+
+def np_q8(tb):
+    """Official q8: store net profit for stores whose 2-digit zip prefix
+    matches a V1 zip — V1 = (literal zip list) INTERSECT (zips with > 4
+    preferred customers). The inner join against V1 multiplies each sale by
+    the number of matching V1 zips (official semantics)."""
+    from collections import Counter
+    ca, cu, st = tb["customer_address"], tb["customer"], tb["store"]
+    z1 = {z for z in ca["ca_zip"] if z in _Q8_ZIPS}
+    azip = dict(zip(ca["ca_address_sk"], ca["ca_zip"]))
+    pref = cu["c_preferred_cust_flag"] == "Y"
+    cnt = Counter(azip[a] for a in cu["c_current_addr_sk"][pref].tolist())
+    v1 = z1 & {z for z, n in cnt.items() if n > 4}
+    ok_d = _d(tb, d_qoy=lambda q: q == 2, d_year=lambda y: y == 1998)
+    mult = {sk: sum(1 for z in v1 if z[:2] == zp[:2])
+            for sk, zp in zip(st["s_store_sk"], st["s_zip"])}
+    name = dict(zip(st["s_store_sk"], st["s_store_name"]))
+    ss = tb["store_sales"]
+    sums = {}
+    for dk, sk, prof in zip(ss["ss_sold_date_sk"], ss["ss_store_sk"],
+                            ss["ss_net_profit"]):
+        m = mult.get(sk, 0)
+        if dk not in ok_d or not m:
+            continue
+        key = name[sk]
+        sums[key] = sums.get(key, 0) + prof * m
+    return [(k, sums[k]) for k in sorted(sums)][:100]
+
+
+def np_q14(tb):
+    """Official q14 (iceberg, first variant): cross_items = items whose
+    (brand, class, category) sold in ALL THREE channels in 1999-2001
+    (INTERSECT), avg_sales = global q*lp mean over the channels (UNION ALL),
+    per-channel Nov-2001 group sums over cross_items with an iceberg HAVING
+    against avg_sales, then ROLLUP over (channel, brand, class, category)."""
+    it = tb["item"]
+    trip = {sk: (int(b), int(cl), int(ca)) for sk, b, cl, ca in zip(
+        it["i_item_sk"], it["i_brand_id"], it["i_class_id"],
+        it["i_category_id"])}
+    ok_d = _d(tb, d_year=lambda y: (y >= 1999) & (y <= 2001))
+    chans = [
+        ("store", "store_sales", "ss_sold_date_sk", "ss_item_sk",
+         "ss_quantity", "ss_list_price"),
+        ("catalog", "catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+         "cs_quantity", "cs_list_price"),
+        ("web", "web_sales", "ws_sold_date_sk", "ws_item_sk",
+         "ws_quantity", "ws_list_price"),
+    ]
+    trips_sold, tot, n_all = [], 0.0, 0
+    for _, t, dcol, icol, qcol, pcol in chans:
+        f = tb[t]
+        m = np.isin(f[dcol], list(ok_d))
+        trips_sold.append({trip[sk] for sk in f[icol][m].tolist()})
+        qp = f[qcol][m].astype(np.float64) * f[pcol][m]
+        tot += float(qp.sum())
+        n_all += len(qp)
+    cross_trips = trips_sold[0] & trips_sold[1] & trips_sold[2]
+    cross_sk = {sk for sk, tr in trip.items() if tr in cross_trips}
+    avg_sales = tot / n_all
+    ok_d2 = _d(tb, d_year=lambda y: y == 2001, d_moy=lambda m_: m_ == 11)
+    base = []
+    for ch, t, dcol, icol, qcol, pcol in chans:
+        f = tb[t]
+        groups = {}
+        for dk, sk, q, p in zip(f[dcol].tolist(), f[icol].tolist(),
+                                f[qcol].tolist(), f[pcol].tolist()):
+            if dk in ok_d2 and sk in cross_sk:
+                cur = groups.setdefault(trip[sk], [0.0, 0])
+                cur[0] += q * p
+                cur[1] += 1
+        for g, (s, n) in groups.items():
+            if s > avg_sales:
+                base.append((ch, g[0], g[1], g[2], s, n))
+    agg = {}
+    for ch, b, cl, ca, s, n in base:
+        for lvl in range(5):          # rollup levels (), (ch), ... (all 4)
+            key = tuple(v if i < lvl else None
+                        for i, v in enumerate((ch, b, cl, ca)))
+            cur = agg.setdefault(key, [0.0, 0])
+            cur[0] += s
+            cur[1] += n
+    rows = [k + (v[0], v[1]) for k, v in agg.items()]
+    rows.sort(key=lambda r: tuple((x is not None, x) for x in r[:4]))
+    return rows[:100]

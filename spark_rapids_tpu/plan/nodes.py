@@ -683,19 +683,31 @@ class WindowNode(PlanNode):
 
 def build_rollup_expand(child: "PlanNode", keys: list):
     """ROLLUP lowering shared by the SQL front-end and DataFrame.rollup():
-    one Expand projection per hierarchy level with nulled-out suffix group
-    columns + a grouping-id literal (Spark's Expand form of rollup;
-    reference GpuExpandExec role). `keys` must be BOUND column references.
-    Returns (expand_node, group_refs, gid_ref)."""
+    the hierarchy-level grouping sets [all, all-1, ..., []] through the
+    general grouping-sets Expand below."""
+    n = len(keys)
+    return build_grouping_sets_expand(
+        child, keys, [list(range(level)) for level in range(n, -1, -1)])
+
+
+def build_grouping_sets_expand(child: "PlanNode", keys: list, sets: list):
+    """GROUPING SETS/CUBE/ROLLUP lowering: one Expand projection per
+    grouping set, with group columns outside the set nulled out + a
+    grouping-id literal whose bit i (MSB = first key, Spark convention) is
+    1 when key i is nulled in that set (Spark's Expand form; reference
+    GpuExpandExec role). `keys` must be BOUND column references; `sets` is
+    a list of kept-key index lists. Returns (expand_node, group_refs,
+    gid_ref)."""
     fields = list(child.output.fields)
     n = len(keys)
     projections = []
-    for level in range(n, -1, -1):
-        gid = (1 << (n - level)) - 1
+    for kept in sets:
+        kept = set(kept)
+        gid = sum(1 << (n - 1 - i) for i in range(n) if i not in kept)
         proj = [E.BoundReference(i, f.data_type, f.nullable, f.name)
                 for i, f in enumerate(fields)]
         for gi, g in enumerate(keys):
-            proj.append(g if gi < level else E.Literal(None, g.dtype))
+            proj.append(g if gi in kept else E.Literal(None, g.dtype))
         proj.append(E.Literal(gid, T.INT))
         projections.append(proj)
     out_fields = fields + [

@@ -252,9 +252,22 @@ class _ExprConverter:
             return Not(cond) if a.negated else cond
         if isinstance(a, P.InAst):
             from spark_rapids_tpu.expr.predicates import InSet, Not
-            if isinstance(a.values, P.Select):
-                raise SqlAnalysisError(
-                    "IN (subquery) is not supported; rewrite as a join")
+            if isinstance(a.values, (P.Select, P.SetOp)):
+                # uncorrelated IN (subquery): evaluate eagerly like
+                # ScalarSubquery (Spark runs subquery stages first; the
+                # reference's InSubqueryExec broadcast plays this role) and
+                # fold into a literal-set membership
+                key = ("in", repr(a.values))
+                vals = self.lowerer._subq_cache.get(key)
+                if vals is None:
+                    tbl = self.lowerer.dataframe(a.values).collect()
+                    if tbl.num_columns != 1:
+                        raise SqlAnalysisError(
+                            "IN (subquery) must return exactly one column")
+                    vals = list(dict.fromkeys(tbl.column(0).to_pylist()))
+                    self.lowerer._subq_cache[key] = vals
+                ins = InSet(c(a.expr), vals)
+                return Not(ins) if a.negated else ins
             vals = []
             for v in a.values:
                 ve = c(v)
@@ -275,8 +288,13 @@ class _ExprConverter:
             return (IsNotNull if a.negated else IsNull)(c(a.expr))
         if isinstance(a, P.SubqueryExpr):
             from spark_rapids_tpu.expr.misc import ScalarSubquery
-            df = self.lowerer.dataframe(a.query)
-            return ScalarSubquery.from_dataframe(df)
+            key = ("scalar", repr(a.query))
+            sub = self.lowerer._subq_cache.get(key)
+            if sub is None:
+                sub = ScalarSubquery.from_dataframe(
+                    self.lowerer.dataframe(a.query))
+                self.lowerer._subq_cache[key] = sub
+            return sub
         if isinstance(a, P.FuncCall):
             return self.func(a)
         if isinstance(a, P.ExistsAst):
@@ -314,6 +332,8 @@ class _ExprConverter:
             if len(a.args) != 1:
                 raise SqlAnalysisError(f"{name} takes one argument")
             if a.distinct:
+                if name in ("min", "max"):   # distinct-insensitive
+                    return _AGG_FUNCS[name](c(a.args[0]))
                 if name not in ("sum", "avg"):
                     raise SqlAnalysisError(
                         f"DISTINCT aggregate {name} not supported")
@@ -562,19 +582,157 @@ class _Lowerer:
     def __init__(self, session, views: dict):
         self.session = session
         self.views = dict(views)
+        # eager-subquery memo: q14 references the same CTE-backed IN
+        # (subquery) / scalar subquery from several UNION ALL arms; one
+        # execution serves them all (keyed structurally — uncorrelated
+        # subqueries resolve only against this lowerer's views)
+        self._subq_cache: dict = {}
 
     # public: full query → plan
-    def lower(self, q: P.Select):
+    def lower(self, q):
         for name, cte in q.ctes:
             self.views = dict(self.views)
             self.views[name] = self.dataframe(cte)
-        plan = self._select(q)
-        return plan
+        return self._query(q)
 
-    def dataframe(self, q: P.Select):
+    def _query(self, q):
+        return self._setop(q) if isinstance(q, P.SetOp) else self._select(q)
+
+    def dataframe(self, q):
         from spark_rapids_tpu.session import DataFrame
         sub = _Lowerer(self.session, self.views)
         return DataFrame(sub.lower(q), self.session)
+
+    # -- set operations -------------------------------------------------------
+    def _setop(self, s: P.SetOp):
+        """UNION [ALL] / INTERSECT [ALL] / EXCEPT [ALL] (Spark lowers these
+        in ResolveSetOperations; the reference executes the resulting
+        union/join/aggregate plans on device — GpuUnionExec, GpuHashJoin).
+
+        - UNION: UnionNode (+ group-by-all dedup for the distinct form)
+        - INTERSECT: dedup(left) LEFT-SEMI join right on all columns,
+          null-safely (set-op NULLs compare equal, unlike join keys)
+        - EXCEPT: dedup(left) LEFT-ANTI join right, null-safe
+        - INTERSECT/EXCEPT ALL: each side numbers its duplicates with
+          row_number() over (partition by all columns); inner/anti join on
+          (columns, n) then yields exactly min(cl,cr) / (cl-cr) copies —
+          existing window + join machinery, no bespoke replicate exec."""
+        left = self._query(s.left)
+        right = self._query(s.right)
+        left, right = self._align_setop(left, right, s.op)
+        if s.op == "union":
+            plan = NN.UnionNode(left, right)
+            if not s.all:
+                plan = self._dedup(plan)
+        elif not s.all:
+            jt = "leftsemi" if s.op == "intersect" else "leftanti"
+            dl = self._dedup(left)
+            lkeys, rkeys = self._nullsafe_keys(dl, right)
+            plan = NN.JoinNode(dl, right, lkeys, rkeys, jt, None)
+        else:
+            plan = self._setop_all(left, right, s.op)
+        if s.order_by:
+            plan = self._order_union(plan, s.order_by)
+        if s.limit is not None:
+            plan = NN.LimitNode(s.limit, plan, global_limit=True)
+        return plan
+
+    def _align_setop(self, left, right, op):
+        """Spark WidenSetOperationTypes: equal arity, per-column least
+        common type (cast arms that differ)."""
+        from spark_rapids_tpu.expr.arithmetic import promote
+        from spark_rapids_tpu.expr.cast import Cast
+        lo, ro = left.output, right.output
+        if len(lo) != len(ro):
+            raise SqlAnalysisError(
+                f"{op.upper()} arms have {len(lo)} vs {len(ro)} columns")
+        targets = []
+        for lf, rf in zip(lo.fields, ro.fields):
+            if lf.data_type == rf.data_type:
+                targets.append(lf.data_type)
+            else:
+                try:
+                    targets.append(promote(lf.data_type, rf.data_type))
+                except Exception as e:
+                    raise SqlAnalysisError(
+                        f"{op.upper()} column {lf.name}: incompatible types "
+                        f"{lf.data_type} vs {rf.data_type}") from e
+
+        def cast_arm(plan, out):
+            if all(f.data_type == t for f, t in zip(out.fields, targets)):
+                return plan
+            proj = []
+            for i, (f, t) in enumerate(zip(out.fields, targets)):
+                r = E.BoundReference(i, f.data_type, f.nullable, f.name)
+                proj.append(E.Alias(r if f.data_type == t else Cast(r, t),
+                                    f.name))
+            return NN.ProjectNode(proj, plan)
+        return cast_arm(left, lo), cast_arm(right, ro)
+
+    def _dedup(self, plan):
+        """DISTINCT via group-by-all (Spark ReplaceDistinctWithAggregate)."""
+        keys = [E.BoundReference(i, f.data_type, f.nullable, f.name)
+                for i, f in enumerate(plan.output)]
+        return NN.AggregateNode(keys, [], plan)
+
+    @staticmethod
+    def _nullsafe_zero(dt):
+        if isinstance(dt, T.StringType):
+            return ""
+        if isinstance(dt, T.BooleanType):
+            return False
+        if isinstance(dt, (T.DoubleType, T.FloatType)):
+            return 0.0
+        return 0
+
+    def _nullsafe_keys(self, left, right, extra=0):
+        """Per-column join keys with set-op NULL semantics (NULL == NULL):
+        a nullable column contributes (IS NULL, coalesce(col, zero)) — both
+        keys non-null, so the engine's null-keys-never-match equi-join
+        machinery compares null-safely (GpuEqualNullSafe's <=> role).
+        `extra` trailing columns (e.g. a row_number) join as plain keys."""
+        from spark_rapids_tpu.expr.nullexprs import Coalesce, IsNull
+        lkeys, rkeys = [], []
+        n = len(left.output) - extra
+        # key lists must stay ALIGNED: expand a column on both sides when
+        # EITHER arm is nullable (arms may disagree on nullability)
+        nullable = [lf.nullable or rf.nullable
+                    for lf, rf in zip(left.output.fields,
+                                      right.output.fields)]
+        for keys, out in ((lkeys, left.output), (rkeys, right.output)):
+            for i, f in enumerate(out.fields):
+                r = E.BoundReference(i, f.data_type, f.nullable, f.name)
+                if i >= n or not nullable[i]:
+                    keys.append(r)
+                    continue
+                keys.append(IsNull(r))
+                keys.append(Coalesce(r, E.Literal(
+                    self._nullsafe_zero(f.data_type), f.data_type)))
+        return lkeys, rkeys
+
+    def _number_duplicates(self, plan):
+        """Append n = row_number() over (partition by all columns): the
+        k-th copy of each distinct row gets k. Equal rows are interchangeable
+        so any intra-partition order is correct."""
+        from spark_rapids_tpu.expr.windows import (RowNumber, WindowExpression,
+                                                   WindowSpec)
+        refs = [E.BoundReference(i, f.data_type, f.nullable, f.name)
+                for i, f in enumerate(plan.output)]
+        spec = WindowSpec(tuple(refs), ((refs[0], True, True),))
+        return NN.WindowNode(
+            [E.Alias(WindowExpression(RowNumber(), spec), "_n")], plan)
+
+    def _setop_all(self, left, right, op):
+        ln = self._number_duplicates(left)
+        rn = self._number_duplicates(right)
+        lkeys, rkeys = self._nullsafe_keys(ln, rn, extra=1)
+        jt = "leftsemi" if op == "intersect" else "leftanti"
+        joined = NN.JoinNode(ln, rn, lkeys, rkeys, jt, None)
+        # drop the helper row number
+        proj = [E.Alias(E.BoundReference(i, f.data_type, f.nullable, f.name),
+                        f.name)
+                for i, f in enumerate(joined.output.fields[:-1])]
+        return NN.ProjectNode(proj, joined)
 
     # -- FROM/join planning ---------------------------------------------------
     def _base_relation(self, item) -> _Relation:
@@ -761,26 +919,6 @@ class _Lowerer:
 
     # -- SELECT block ---------------------------------------------------------
     def _select(self, q: P.Select):
-        if q.union_all is not None:
-            right = q.union_all
-            # ORDER BY/LIMIT parsed into the right arm apply to the union
-            order_by, limit = q.order_by, q.limit
-            if right.order_by or right.limit is not None:
-                order_by = order_by or right.order_by
-                limit = limit if limit is not None else right.limit
-                right = P.Select(right.items, right.from_, right.where,
-                                 right.group_by, right.rollup, right.having,
-                                 distinct=right.distinct,
-                                 union_all=right.union_all)
-            lq = P.Select(q.items, q.from_, q.where, q.group_by, q.rollup,
-                          q.having, distinct=q.distinct)
-            plan = NN.UnionNode(self._select(lq), self._select(right))
-            if order_by:
-                plan = self._order_union(plan, order_by)
-            if limit is not None:
-                plan = NN.LimitNode(limit, plan, global_limit=True)
-            return plan
-
         if not q.from_:
             # SELECT <literals>: one-row relation
             import pyarrow as pa
@@ -817,8 +955,10 @@ class _Lowerer:
         windows = {}     # expr_key -> (WindowExpression, out_col_name)
 
         if has_agg:
+            grouping = (q.grouping_sets if q.grouping_sets is not None
+                        else q.rollup)
             plan, sub = self._aggregate(plan, scope, group_es, items,
-                                        having_e, q.rollup, order_items, conv)
+                                        having_e, grouping, order_items, conv)
             items = [(sub(e), nm) for e, nm in items]
             having_e = sub(having_e) if having_e is not None else None
         else:
@@ -988,6 +1128,35 @@ class _Lowerer:
         for c in e.children:
             _Lowerer._collect_windows(c, out)
 
+    @staticmethod
+    def _fast_distinct_ok(aggs, rollup) -> bool:
+        """True when the cheap no-Expand rewrite (_rewrite_distinct) applies:
+        ONE distinct argument, and every non-distinct aggregate is either
+        Min/Max or count/sum/avg over that same argument."""
+        from spark_rapids_tpu.expr.aggregates import Average, Count, Max, Min
+        if rollup:
+            return False
+        xkeys = {fuse.expr_key(a.child) for _, a in aggs
+                 if isinstance(a, _DistinctAgg)}
+        if len(xkeys) != 1:
+            return False
+        xkey = next(iter(xkeys))
+        x = next(a.child for _, a in aggs if isinstance(a, _DistinctAgg))
+
+        def same_col(a):
+            return (isinstance(a, (Count, Sum, Average))
+                    and a.child is not None
+                    and fuse.expr_key(a.child) == xkey)
+        others = [a for _, a in aggs if not isinstance(a, _DistinctAgg)
+                  and not same_col(a)]
+        if not all(isinstance(a, (Min, Max)) for a in others):
+            return False
+        need_cnt = any(same_col(a) for _, a in aggs
+                       if not isinstance(a, _DistinctAgg))
+        if need_cnt and isinstance(x.dtype, T.DecimalType):
+            return False
+        return True
+
     def _rewrite_distinct(self, plan, group_bound, aggs, rollup):
         """Spark RewriteDistinctAggregates (single distinct column form):
         inner GROUP BY (keys, x) dedupes x per group, the outer aggregate
@@ -999,8 +1168,8 @@ class _Lowerer:
           outer re-derives count(x)=sum(cnt), sum(x)=sum(x*cnt),
           avg(x)=sum(x*cnt)/sum(cnt).
 
-        Distinct aggregates over several different columns need Spark's
-        Expand-based rewrite and are rejected."""
+        Distinct aggregates over several different columns go through
+        _rewrite_distinct_expand (Spark's general Expand form)."""
         from spark_rapids_tpu.expr.aggregates import Average, Count, Max, Min
         from spark_rapids_tpu.expr.arithmetic import Divide, Multiply
         from spark_rapids_tpu.expr.cast import Cast
@@ -1105,6 +1274,163 @@ class _Lowerer:
             proj.append(E.Alias(e, f"_a{i}"))
         return NN.ProjectNode(proj, agg_node), ng
 
+    def _rewrite_distinct_expand(self, plan, group_bound, aggs):
+        """Spark RewriteDistinctAggregates, general (Expand) form — several
+        DISTINCT arguments and/or arbitrary regular aggregates (reference
+        inherits this whole plan shape from Catalyst and executes the Expand
+        via GpuExpandExec; aggregate.scala:240 distinct modes).
+
+        Expand emits one projection per distinct-argument group plus (when
+        regular aggregates exist) one "regular" projection; a branch id
+        disambiguates. Branch b for distinct argument x_i carries x_i and
+        NULLs for every other distinct/regular input column; the regular
+        branch carries the regular inputs and NULL x's. The inner aggregate
+        GROUP BY (keys, bid, x_1..x_m) then dedupes each distinct argument
+        per group while reducing regular partials (whose inputs are NULL on
+        distinct branches, so they reduce neutrally), and the outer
+        aggregate GROUP BY keys applies the original distinct functions to
+        the deduped x columns and merges the regular partials. Composes
+        with ROLLUP: `plan` may already be the rollup Expand, with its
+        grouping id last in `group_bound`."""
+        from spark_rapids_tpu.expr.aggregates import Average, Count, Max, Min
+        from spark_rapids_tpu.expr.arithmetic import Divide
+        from spark_rapids_tpu.expr.cast import Cast
+        from spark_rapids_tpu.expr.nullexprs import Coalesce
+
+        # distinct-argument groups, one per unique argument expression
+        dkeys, dexpr = [], {}
+        for _, a in aggs:
+            if isinstance(a, _DistinctAgg):
+                ck = fuse.expr_key(a.child)
+                if ck not in dexpr:
+                    dexpr[ck] = a.child
+                    dkeys.append(ck)
+        regulars = [(k, a) for k, a in aggs if not isinstance(a, _DistinctAgg)]
+        for _, a in regulars:
+            if not isinstance(a, (Min, Max, Count, Sum, Average)):
+                raise SqlAnalysisError(
+                    f"aggregate {a!r} cannot mix with DISTINCT aggregates")
+            if isinstance(a, (Sum, Average)) and a.child is not None \
+                    and isinstance(a.child.dtype, T.DecimalType):
+                raise SqlAnalysisError(
+                    "DECIMAL sum/avg mixed with DISTINCT aggregates "
+                    "not supported")
+        nk, m = len(group_bound), len(dkeys)
+        # one input column per regular aggregate (count(*) counts a live 1)
+        rcols = [E.Literal(1, T.INT) if a.child is None else a.child
+                 for _, a in regulars]
+
+        def null_of(e):
+            return E.Literal(None, e.dtype)
+
+        branches = ([("regular", None)] if regulars else []) \
+            + [("distinct", i) for i in range(m)]
+        projections = []
+        for kind, di in branches:
+            proj = list(group_bound)
+            proj.append(E.Literal(len(projections), T.INT))
+            for i, ck in enumerate(dkeys):
+                e = dexpr[ck]
+                proj.append(e if (kind == "distinct" and i == di)
+                            else null_of(e))
+            for rc in rcols:
+                proj.append(rc if kind == "regular" else null_of(rc))
+            projections.append(proj)
+        out_fields = (
+            [T.StructField(f"_k{i}", g.dtype, True)
+             for i, g in enumerate(group_bound)]
+            + [T.StructField("_bid", T.INT, False)]
+            + [T.StructField(f"_x{i}", dexpr[ck].dtype, True)
+               for i, ck in enumerate(dkeys)]
+            + [T.StructField(f"_rc{j}", rc.dtype, True)
+               for j, rc in enumerate(rcols)])
+        expand = NN.ExpandNode(projections, out_fields, plan)
+        eout = expand.output
+
+        def eref(j):
+            f = eout[j]
+            return E.BoundReference(j, f.data_type, f.nullable, f.name)
+
+        # inner: GROUP BY (keys, bid, x's); partial regular aggregates
+        inner_groups = [eref(j) for j in range(nk + 1 + m)]
+        inner_aggs = []
+        partial = []     # per regular agg: [ordinal(s) into inner agg cols]
+
+        def padd(fn):
+            inner_aggs.append(E.Alias(fn, f"_p{len(inner_aggs)}"))
+            return len(inner_aggs) - 1
+        rbase = nk + 1 + m
+        for j, (_, a) in enumerate(regulars):
+            rc_ref = eref(rbase + j)
+            if isinstance(a, (Min, Max)):
+                partial.append([padd(type(a)(rc_ref))])
+            elif isinstance(a, Count):
+                partial.append([padd(Count(rc_ref))])
+            elif isinstance(a, Sum):
+                partial.append([padd(Sum(rc_ref))])
+            else:                      # Average: sum+count partials
+                partial.append([padd(Sum(Cast(rc_ref, T.DOUBLE))),
+                                padd(Count(rc_ref))])
+        inner = NN.AggregateNode(inner_groups, inner_aggs, expand)
+        iout = inner.output
+
+        def iref(j, nullable=True):
+            f = iout[j]
+            return E.BoundReference(j, f.data_type, nullable, f.name)
+
+        outer_groups = [E.BoundReference(i, iout[i].data_type,
+                                         iout[i].nullable, iout[i].name)
+                        for i in range(nk)]
+        x_pos = {ck: nk + 1 + i for i, ck in enumerate(dkeys)}
+        pbase = nk + 1 + m
+        outer_aggs, final, memo = [], [], {}
+
+        def add(agg_fn):
+            k = fuse.expr_key(agg_fn)
+            if k not in memo:
+                outer_aggs.append(E.Alias(agg_fn, f"_o{len(outer_aggs)}"))
+                memo[k] = len(outer_aggs) - 1
+            return memo[k]
+
+        ri = iter(range(len(regulars)))
+        for _, a in aggs:
+            if isinstance(a, _DistinctAgg):
+                final.append(add(a.make(iref(x_pos[fuse.expr_key(a.child)]))))
+                continue
+            j = next(ri)
+            prefs = [iref(pbase + p) for p in partial[j]]
+            if isinstance(a, (Min, Max)):
+                final.append(add(type(a)(prefs[0])))
+            elif isinstance(a, Count):       # count = sum of partial counts
+                final.append(("cnt", add(Sum(prefs[0]))))
+            elif isinstance(a, Sum):
+                final.append(add(Sum(prefs[0])))
+            else:                            # avg = sum(sums)/sum(counts)
+                final.append(("div", add(Sum(prefs[0])),
+                              add(Sum(prefs[1]))))
+        agg_node = NN.AggregateNode(outer_groups, outer_aggs, inner)
+        aout = agg_node.output
+
+        def aref(j):
+            f = aout[j]
+            return E.BoundReference(j, f.data_type, True, f.name)
+
+        proj = [E.BoundReference(i, f.data_type, f.nullable, f.name)
+                for i, f in enumerate(aout.fields[:nk])]
+        for i, spec in enumerate(final):
+            a = aggs[i][1]
+            if isinstance(spec, tuple) and spec[0] == "div":
+                e = Divide(aref(nk + spec[1]),
+                           Cast(aref(nk + spec[2]), T.DOUBLE))
+            elif isinstance(spec, tuple):    # ("cnt", ord): empty → 0
+                e = Coalesce(aref(nk + spec[1]), E.Literal(0, T.LONG))
+            else:
+                e = aref(nk + spec)
+            if e.dtype != a.dtype:           # double-Sum widening (decimal-
+                e = Cast(e, a.dtype)         # free here) back to Spark's type
+            proj.append(E.Alias(e, f"_a{i}"))
+        return NN.ProjectNode(proj, agg_node), nk
+
     def _aggregate(self, plan, scope, group_es, items, having_e, rollup,
                    order_items, conv):
         """Build (Expand→)Aggregate; return (plan, substitution fn)."""
@@ -1141,14 +1467,20 @@ class _Lowerer:
 
         gid_ref = None
         if rollup:
-            plan, group_refs, gid_ref = self._expand_rollup(plan, group_es)
+            sets = rollup if isinstance(rollup, list) else None
+            plan, group_refs, gid_ref = self._expand_rollup(plan, group_es,
+                                                            sets)
             group_bound = group_refs + [gid_ref]
         else:
             group_bound = list(group_es)
 
         if any(isinstance(a, _DistinctAgg) for _, a in aggs):
-            agg_node, n_group = self._rewrite_distinct(plan, group_bound,
-                                                       aggs, rollup)
+            if self._fast_distinct_ok(aggs, rollup):
+                agg_node, n_group = self._rewrite_distinct(plan, group_bound,
+                                                           aggs, rollup)
+            else:
+                agg_node, n_group = self._rewrite_distinct_expand(
+                    plan, group_bound, aggs)
         else:
             agg_named = [E.Alias(a, f"_a{i}")
                          for i, (_, a) in enumerate(aggs)]
@@ -1207,14 +1539,18 @@ class _Lowerer:
         shifted = ShiftRight(gid, E.Literal(bit)) if bit else gid
         return Cast(BitwiseAnd(shifted, E.Literal(1)), T.INT)
 
-    def _expand_rollup(self, plan, group_es):
-        """Spark's Expand lowering of ROLLUP (shared with DataFrame.rollup:
-        plan/nodes.py build_rollup_expand)."""
+    def _expand_rollup(self, plan, group_es, sets=None):
+        """Spark's Expand lowering of ROLLUP / CUBE / GROUPING SETS (shared
+        with DataFrame.rollup: plan/nodes.py build_grouping_sets_expand).
+        `sets` is a list of kept-key index lists, or None for ROLLUP."""
         for g in group_es:
             if not isinstance(g, (E.BoundReference, E.AttributeReference)):
                 raise SqlAnalysisError(
-                    "GROUP BY ROLLUP supports plain columns only")
-        return NN.build_rollup_expand(plan, group_es)
+                    "GROUP BY ROLLUP/CUBE/GROUPING SETS supports plain "
+                    "columns only")
+        if sets is None:
+            return NN.build_rollup_expand(plan, group_es)
+        return NN.build_grouping_sets_expand(plan, group_es, sets)
 
     # -- ORDER BY over a union (names/ordinals only) --------------------------
     def _order_union(self, plan, order_items):

@@ -269,13 +269,28 @@ class Select:
     from_: list                  # [TableRef|SubqueryRef|JoinRef]; [] = no FROM
     where: typing.Any = None
     group_by: list = dataclasses.field(default_factory=list)
-    rollup: bool = False
+    rollup: bool = False         # legacy flag: GROUP BY ROLLUP(all group_by)
     having: typing.Any = None
     order_by: list = dataclasses.field(default_factory=list)
     limit: int | None = None
     distinct: bool = False
     ctes: list = dataclasses.field(default_factory=list)   # [(name, Select)]
-    union_all: "Select | None" = None
+    grouping_sets: list | None = None   # [[expr, ...], ...] (CUBE/ROLLUP/
+    #                       GROUPING SETS normalize to explicit set lists)
+
+
+@dataclasses.dataclass
+class SetOp:
+    """UNION/INTERSECT/EXCEPT tree over Select/SetOp arms. INTERSECT binds
+    tighter than UNION/EXCEPT (standard precedence); trailing ORDER BY/LIMIT
+    apply to the whole expression and ride the root node."""
+    op: str                      # union|intersect|except
+    all: bool
+    left: typing.Any             # Select | SetOp
+    right: typing.Any
+    order_by: list = dataclasses.field(default_factory=list)
+    limit: int | None = None
+    ctes: list = dataclasses.field(default_factory=list)
 
 
 # -- parser -------------------------------------------------------------------
@@ -340,7 +355,7 @@ class _Parser:
                             f"got {t.value!r}")
 
     # -- query ---------------------------------------------------------------
-    def parse_query(self) -> Select:
+    def parse_query(self):
         ctes = []
         if self.eat_kw("with"):
             while True:
@@ -356,7 +371,74 @@ class _Parser:
         q.ctes = ctes
         return q
 
-    def parse_select(self) -> Select:
+    def parse_select(self):
+        """Select expression with standard set-op precedence: INTERSECT
+        binds tighter than UNION/EXCEPT; trailing ORDER BY/LIMIT apply to
+        the whole expression. Returns Select or SetOp."""
+        q = self._setop_term()
+        while True:
+            if self.at_kw("union", "except"):
+                op = self.next().value
+            elif self.peek().kind == "ident" \
+                    and self.peek().value.lower() == "minus":
+                self.next()
+                op = "except"     # Spark: MINUS is EXCEPT DISTINCT
+            else:
+                break
+            all_ = self.eat_kw("all")
+            if not all_:
+                self.eat_kw("distinct")   # explicit DISTINCT is the default
+            q = SetOp(op, all_, q, self._setop_term())
+        if self.at_kw("order", "limit") and (q.order_by or
+                                             q.limit is not None):
+            # '(select ... order by a limit 5) order by b': the inner
+            # clauses already bound inside the parens — wrap in a derived
+            # table so the outer ORDER BY/LIMIT stack on top instead of
+            # appending to (or overwriting) the inner ones
+            q = Select([SelectItem(Star())], [SubqueryRef(q, "_sq")])
+        self._order_limit_tail(q)
+        return q
+
+    def _setop_term(self):
+        q = self._setop_primary()
+        while self.eat_kw("intersect"):
+            all_ = self.eat_kw("all")
+            if not all_:
+                self.eat_kw("distinct")
+            q = SetOp("intersect", all_, q, self._setop_primary())
+        return q
+
+    def _setop_primary(self):
+        if self.at_op("("):
+            self.next()
+            q = self.parse_query()     # parenthesized arm, may nest set ops
+            self.expect_op(")")
+            return q
+        return self.parse_select_atom()
+
+    def _order_limit_tail(self, sel):
+        if self.eat_kw("order"):
+            self.expect_kw("by")
+            sel.order_by.append(self.parse_order_item())
+            while self.eat_op(","):
+                sel.order_by.append(self.parse_order_item())
+        if self.eat_kw("limit"):
+            t = self.next()
+            if t.kind != "num" or not isinstance(t.value, int):
+                raise SqlParseError(f"LIMIT needs an integer at pos {t.pos}")
+            sel.limit = t.value
+
+    def _group_expr_list(self) -> list:
+        self.expect_op("(")
+        out = []
+        if not self.at_op(")"):       # GROUPING SETS allows the empty set ()
+            out.append(self.parse_expr())
+            while self.eat_op(","):
+                out.append(self.parse_expr())
+        self.expect_op(")")
+        return out
+
+    def parse_select_atom(self) -> Select:
         self.expect_kw("select")
         distinct = self.eat_kw("distinct")
         items = [self.parse_select_item()]
@@ -368,39 +450,61 @@ class _Parser:
             while self.eat_op(","):
                 from_.append(self.parse_table_ref())
         where = self.parse_expr() if self.eat_kw("where") else None
-        group_by, rollup = [], False
+        group_by, rollup, gsets = [], False, None
         if self.eat_kw("group"):
             self.expect_kw("by")
+            t = self.peek()
+            soft = t.value.lower() if t.kind == "ident" else ""
             if self.eat_kw("rollup"):
                 rollup = True
+                group_by = self._group_expr_list()
+            elif soft == "cube":
+                self.next()
+                group_by = self._group_expr_list()
+                n = len(group_by)
+                # all 2^n subsets, largest first (Spark emits gid ascending;
+                # gid order is irrelevant to grouping correctness)
+                gsets = [[i for i in range(n) if not (mask >> (n - 1 - i)) & 1]
+                         for mask in range(1 << n)]
+            elif soft == "grouping" and len(self.toks) > self.i + 1 \
+                    and self.toks[self.i + 1].kind == "ident" \
+                    and self.toks[self.i + 1].value.lower() == "sets":
+                self.next()
+                self.next()
                 self.expect_op("(")
-                group_by.append(self.parse_expr())
-                while self.eat_op(","):
-                    group_by.append(self.parse_expr())
+                sets_exprs = []
+                while True:
+                    if self.at_op("("):
+                        sets_exprs.append(self._group_expr_list())
+                    else:
+                        sets_exprs.append([self.parse_expr()])
+                    if not self.eat_op(","):
+                        break
                 self.expect_op(")")
+                # normalize: group_by = deduped union of all set exprs (by
+                # textual identity); each set lists indices into group_by
+                keyed = []
+                gsets = []
+                for se in sets_exprs:
+                    idxs = []
+                    for e in se:
+                        k = repr(e)
+                        for j, (k2, _) in enumerate(keyed):
+                            if k2 == k:
+                                idxs.append(j)
+                                break
+                        else:
+                            keyed.append((k, e))
+                            idxs.append(len(keyed) - 1)
+                    gsets.append(idxs)
+                group_by = [e for _, e in keyed]
             else:
                 group_by.append(self.parse_expr())
                 while self.eat_op(","):
                     group_by.append(self.parse_expr())
         having = self.parse_expr() if self.eat_kw("having") else None
-        sel = Select(items, from_, where, group_by, rollup, having,
-                     distinct=distinct)
-        if self.eat_kw("union"):
-            self.expect_kw("all")   # set-union would need dedup; UNION ALL only
-            sel.union_all = self.parse_select()
-            # ORDER BY/LIMIT after a union apply to the combined result; the
-            # leftmost Select carries them (checked below)
-        if self.eat_kw("order"):
-            self.expect_kw("by")
-            sel.order_by.append(self.parse_order_item())
-            while self.eat_op(","):
-                sel.order_by.append(self.parse_order_item())
-        if self.eat_kw("limit"):
-            t = self.next()
-            if t.kind != "num" or not isinstance(t.value, int):
-                raise SqlParseError(f"LIMIT needs an integer at pos {t.pos}")
-            sel.limit = t.value
-        return sel
+        return Select(items, from_, where, group_by, rollup, having,
+                      distinct=distinct, grouping_sets=gsets)
 
     def parse_select_item(self) -> SelectItem:
         if self.at_op("*"):
@@ -470,6 +574,19 @@ class _Parser:
                     on = self.parse_expr()
             left = JoinRef(left, right, how, on, using)
 
+    def _query_ahead(self) -> bool:
+        """At a '('-led position: does SELECT/WITH follow the open parens?
+        A necessary (not sufficient) sign of a parenthesized query
+        expression — '((select ...) except (select ...))'; the caller still
+        backtracks if the full parse doesn't close cleanly, because
+        '((select ...) a join ...)' starts identically but is a join tree."""
+        j = self.i
+        while j < len(self.toks) and self.toks[j].kind == "op" \
+                and self.toks[j].value == "(":
+            j += 1
+        t = self.toks[j] if j < len(self.toks) else self.toks[-1]
+        return t.kind == "kw" and t.value in ("select", "with")
+
     def parse_table_primary(self):
         if self.eat_op("("):
             if self.at_kw("select", "with"):
@@ -478,6 +595,25 @@ class _Parser:
                 self.eat_kw("as")
                 alias = self.ident()
                 return SubqueryRef(q, alias)
+            if self._query_ahead():
+                # '((select' is ambiguous: a set-op tree with parenthesized
+                # arms, or a join tree whose first element is an aliased
+                # subquery. Try the query-expression parse; backtrack to the
+                # join tree unless it closes at our ')'.
+                save = self.i
+                q = None
+                try:
+                    q = self.parse_query()
+                    if not self.at_op(")"):
+                        q = None
+                except SqlParseError:
+                    q = None
+                if q is not None:
+                    self.next()          # the ')'
+                    self.eat_kw("as")
+                    alias = self.ident()
+                    return SubqueryRef(q, alias)
+                self.i = save
             # parenthesized join tree
             t = self.parse_table_ref()
             self.expect_op(")")
@@ -486,7 +622,9 @@ class _Parser:
         alias = None
         if self.eat_kw("as"):
             alias = self.ident()
-        elif self.peek().kind == "ident":
+        elif self.peek().kind == "ident" \
+                and self.peek().value.lower() != "minus":
+            # MINUS is the EXCEPT synonym, not an implicit alias
             alias = self.ident()
         return TableRef(name, alias)
 

@@ -32,6 +32,15 @@ _SQL_ONLY = {
     "q27": (tpcds.np_q27_rollup, {3, 4, 5, 6}),
     # q28: six-bucket cross join; avgs at 0,3,6,9,12,15 (DISTINCT rewrite)
     "q28": (tpcds.np_q28, {0, 3, 6, 9, 12, 15}),
+    # round-5 set-operation queries (INTERSECT/EXCEPT lowering):
+    # q8 nests an INTERSECT inside FROM (decimal profit sums are exact);
+    # q38/q87 intersect/subtract the three sales channels
+    "q8": (tpcds.np_q8, set()),
+    "q38": (tpcds.np_q38, set()),
+    "q87": (tpcds.np_q87, set()),
+    # q14: cross-channel INTERSECT + IN-subquery + iceberg HAVING + 4-col
+    # rollup; sum_sales is float
+    "q14": (tpcds.np_q14, {4}),
 }
 
 
