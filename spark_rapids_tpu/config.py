@@ -267,6 +267,20 @@ PARQUET_WRITER_TYPE = conf("spark.rapids.tpu.sql.format.parquet.writer.type").do
     "through host pyarrow. NATIVE falls back to ARROW for unsupported "
     "schemas (lists, decimal>18) and partitioned writes.").string_conf("NATIVE")
 
+ORC_WRITER_TYPE = conf("spark.rapids.tpu.sql.format.orc.writer.type").doc(
+    "NATIVE encodes ORC stripes from device columns (null compaction + "
+    "stats on device, RLEv2/protobuf framing on host — reference "
+    "GpuOrcFileFormat.scala device-buffer write); ARROW round-trips "
+    "through host pyarrow. NATIVE falls back to ARROW for unsupported "
+    "schemas (lists, decimal>18) and partitioned writes.").string_conf("NATIVE")
+
+CSV_WRITER_TYPE = conf("spark.rapids.tpu.sql.format.csv.writer.type").doc(
+    "NATIVE formats CSV from device buffers (one transfer per column, "
+    "vectorized host text, no arrow round-trip); ARROW uses host pyarrow. "
+    "NATIVE falls back to ARROW for unsupported schemas and partitioned "
+    "writes; float/timestamp formatting differences are documented in "
+    "io/csv_write_native.py.").string_conf("NATIVE")
+
 CSV_ENABLED = conf("spark.rapids.tpu.sql.format.csv.enabled").doc(
     "Enable accelerated CSV reading (reference spark.rapids.sql.format.csv.enabled)"
 ).boolean_conf(True)

@@ -1,0 +1,109 @@
+"""Native CSV encode: device buffers -> vectorized host text, no arrow.
+
+Reference: GpuCsvScan's write counterpart rides ColumnarOutputWriter.scala:182
+(cudf formats text on-device). CSV is inherently a string format and this
+engine's device never materializes per-row strings (strings live as
+dictionary codes — io/parquet_write_native.py's stance), so the TPU-native
+split is: the device supplies each column's value buffer and validity in ONE
+transfer (static slice of the padded capacity), and the host produces bytes
+with vectorized numpy ops — no pyarrow Table is ever built.
+
+Formats (documented divergences from the arrow writer live here):
+- floats: shortest round-trip repr (numpy astype('U') = Python repr)
+- booleans: true/false (Spark CSV casing)
+- dates: ISO yyyy-mm-dd; timestamps: ISO with 'T' separator, microseconds
+- decimals: fixed-scale from the int64 backing
+- strings: RFC-4180 quoting (quote when the value contains delimiter,
+  quote, CR or LF; embedded quotes double)
+- nulls: empty field
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+
+
+def supports_schema(schema: T.StructType) -> bool:
+    ok = (T.BooleanType, T.ByteType, T.ShortType, T.IntegerType, T.LongType,
+          T.FloatType, T.DoubleType, T.StringType, T.DateType,
+          T.TimestampType, T.DecimalType)
+    return all(isinstance(f.data_type, ok) for f in schema.fields)
+
+
+def _quote_strings(vals: np.ndarray) -> np.ndarray:
+    """RFC-4180: quote values containing delimiter/quote/newline."""
+    need = (np.char.find(vals, ",") >= 0) | (np.char.find(vals, '"') >= 0) \
+        | (np.char.find(vals, "\n") >= 0) | (np.char.find(vals, "\r") >= 0)
+    if not need.any():
+        return vals
+    quoted = np.char.add(
+        np.char.add('"', np.char.replace(vals, '"', '""')), '"')
+    return np.where(need, quoted, vals)
+
+
+def _format_column(col, dt: T.DataType, num_rows: int) -> np.ndarray:
+    """One device->host transfer (values + validity), then vectorized text.
+    Returns a U-dtype array of num_rows formatted fields ('' for null)."""
+    vals = np.asarray(col.data[:num_rows])
+    valid = np.asarray(col.validity[:num_rows])
+    if isinstance(dt, T.StringType):
+        if col.dictionary is not None:
+            entries = np.array([s.as_py() for s in col.dictionary] + [""],
+                               dtype=object)
+            codes = np.where(valid, vals, len(entries) - 1)
+            txt = entries[codes].astype("U")
+        else:
+            txt = np.full(num_rows, "", dtype="U1").astype(object)
+        txt = _quote_strings(np.asarray(txt, dtype="U"))
+    elif isinstance(dt, T.BooleanType):
+        txt = np.where(vals, "true", "false")
+    elif isinstance(dt, T.DateType):
+        txt = vals.astype("datetime64[D]").astype("U")
+    elif isinstance(dt, T.TimestampType):
+        txt = vals.astype("datetime64[us]").astype("U")
+    elif isinstance(dt, T.DecimalType):
+        iv = vals.astype(np.int64)
+        s = dt.scale
+        if s == 0:
+            txt = iv.astype("U")
+        else:
+            sign = np.where(iv < 0, "-", "")
+            mag = np.abs(iv)
+            whole = (mag // 10**s).astype("U")
+            frac = np.char.zfill((mag % 10**s).astype("U"), s)
+            txt = np.char.add(np.char.add(np.char.add(sign, whole), "."),
+                              frac)
+    else:
+        # int/float: numpy str conversion (shortest repr for floats)
+        txt = vals.astype("U32")
+    return np.where(valid, txt, "")
+
+
+def write_batch_file(path: str, batch, schema: T.StructType,
+                     header: bool = True, append: bool = False) -> int:
+    """One batch -> CSV bytes appended to `path`. Returns bytes written."""
+    n = batch.num_rows
+    cols = [_format_column(c, f.data_type, n)
+            for f, c in zip(schema.fields, batch.columns)]
+    if cols:
+        line = cols[0].astype(object)
+        for c in cols[1:]:
+            line = line + ","
+            line = line + c.astype(object)
+    else:
+        line = np.full(n, "", dtype=object)
+    body = "\n".join(line.tolist())
+    out = []
+    if header:
+        out.append(",".join(
+            np.asarray(_quote_strings(np.array([f.name for f in
+                                                schema.fields], dtype="U")))
+            .tolist()))
+    if body or n:
+        out.append(body)
+    blob = ("\n".join(out) + "\n").encode("utf-8")
+    with open(path, "ab" if append else "wb") as f:
+        f.write(blob)
+    return len(blob)

@@ -316,8 +316,8 @@ def scan_rlev2(buf: bytes, start: int, end: int, n_values: int,
     """Split an RLEv2 stream into runs. Returns a list of
     ('direct', count, width, payload_bit_offset) — device-unpacked — and
     ('const', count, ndarray) — host-materialized (short-repeat, delta,
-    patched-base; only widths > 56 still raise for the per-column
-    fallback)."""
+    patched-base, and 57-64-bit direct; only patched-base widths > 56
+    still raise for the per-column fallback)."""
     r = _ByteReader(buf, start)
     runs = []
     got = 0
@@ -337,7 +337,26 @@ def scan_rlev2(buf: bytes, start: int, end: int, n_values: int,
             w = _WIDTH_TABLE[(h >> 1) & 31]
             cnt = (((h & 1) << 8) | r.byte()) + 1
             if w > 56:
-                raise NotImplementedError("direct width > 56")
+                # full-width values overflow the int64 device unpack;
+                # materialize on host with uint64 arithmetic (wraps mod
+                # 2^64, which IS two's-complement int64)
+                nbytes = (w * cnt + 7) // 8
+                bits = np.unpackbits(
+                    np.frombuffer(buf, np.uint8, nbytes, r.pos),
+                    bitorder="big")[:w * cnt]
+                mat = bits.reshape(cnt, w).astype(np.uint64)
+                pw = (np.uint64(1)
+                      << np.arange(w - 1, -1, -1, dtype=np.uint64))
+                u = (mat * pw).sum(axis=1, dtype=np.uint64)
+                if signed:
+                    vals = ((u >> np.uint64(1)).astype(np.int64)
+                            ^ -((u & np.uint64(1)).astype(np.int64)))
+                else:
+                    vals = u.astype(np.int64)
+                r.pos += nbytes
+                runs.append(("const", cnt, vals))
+                got += cnt
+                continue
             runs.append(("direct", cnt, w, r.pos * 8))
             r.pos += (cnt * w + 7) // 8
             got += cnt

@@ -58,7 +58,7 @@ class _TaskWriter:
 
     def __init__(self, temp_dir: str, task_id: int, fmt: str, compression: str,
                  partition_by: list, schema: T.StructType, job_uuid: str,
-                 native_parquet: bool = False):
+                 native: bool = False):
         self.temp = os.path.join(temp_dir, f"task_{task_id}")
         os.makedirs(self.temp, exist_ok=True)
         self.fmt = fmt
@@ -69,7 +69,7 @@ class _TaskWriter:
         self._file_counter = 0
         self._task_id = task_id
         self._job_uuid = job_uuid
-        self.native_parquet = native_parquet
+        self.native = native
 
     def _next_name(self, subdir: str = "") -> str:
         # job-unique uuid in the filename (Spark's FileOutputCommitter naming)
@@ -83,31 +83,46 @@ class _TaskWriter:
         os.makedirs(d, exist_ok=True)
         return os.path.join(d, name)
 
+    def _native_module(self):
+        if self.fmt == "parquet":
+            from spark_rapids_tpu.io import parquet_write_native as m
+        elif self.fmt == "orc":
+            from spark_rapids_tpu.io import orc_write_native as m
+        elif self.fmt == "csv":
+            from spark_rapids_tpu.io import csv_write_native as m
+        else:
+            return None
+        return m
+
     def write_batch(self, batch):
-        """Device-path write: encode Parquet pages straight from the device
-        columns (reference ColumnarOutputWriter device-buffer write). Falls
-        back to the arrow path for partitioned writes, non-parquet formats,
-        and schemas the native encoder can't frame."""
-        if self.native_parquet and not self.partition_by:
-            from spark_rapids_tpu.io import parquet_write_native as pwn
+        """Device-path write: encode Parquet pages / ORC stripes / CSV text
+        straight from the device columns (reference ColumnarOutputWriter
+        device-buffer write; GpuOrcFileFormat.scala). Falls back to the
+        arrow path for partitioned writes and schemas the native encoders
+        can't frame."""
+        m = self._native_module() if self.native else None
+        if m is not None and not self.partition_by:
             from spark_rapids_tpu.columnar.batch import ColumnarBatch
             from spark_rapids_tpu.columnar.vector import TpuColumnVector
             if (isinstance(batch, ColumnarBatch)
-                    and pwn.supports_schema(self.schema)
+                    and m.supports_schema(self.schema)
                     # exact type: subclasses (ListVector) carry structure the
-                    # flat encoder can't frame
+                    # flat encoders can't frame
                     and all(type(c) is TpuColumnVector
                             for c in batch.columns)):
                 path = self._next_name()
                 try:
-                    nbytes = pwn.write_batch_file(
-                        path, batch, self.schema, self.compression)
+                    if self.fmt == "csv":
+                        nbytes = m.write_batch_file(path, batch, self.schema)
+                    else:
+                        nbytes = m.write_batch_file(
+                            path, batch, self.schema, self.compression)
                 except (TypeError, ValueError) as e:
                     # schema/codec are pre-validated, so this is an encoder
                     # defect — fall back to arrow but never silently
                     import warnings
                     warnings.warn(
-                        f"native parquet encoder failed ({e!r}); "
+                        f"native {self.fmt} encoder failed ({e!r}); "
                         f"falling back to arrow writer for this task")
                     if os.path.exists(path):
                         os.unlink(path)
@@ -187,13 +202,15 @@ def write_columnar(exec_or_node, path: str, fmt: str = "parquet",
     total = WriteStats()
     lock = threading.Lock()
     from spark_rapids_tpu import config as CFG
-    writer_type = (conf.get(CFG.PARQUET_WRITER_TYPE) if conf is not None
-                   else CFG.PARQUET_WRITER_TYPE.default)
-    native_parquet = fmt == "parquet" and str(writer_type).upper() == "NATIVE"
+    entry = {"parquet": CFG.PARQUET_WRITER_TYPE, "orc": CFG.ORC_WRITER_TYPE,
+             "csv": CFG.CSV_WRITER_TYPE}.get(fmt)
+    writer_type = (conf.get(entry) if conf is not None
+                   else entry.default) if entry is not None else "ARROW"
+    native = str(writer_type).upper() == "NATIVE"
 
     def run_split(split):
         writer = _TaskWriter(temp_dir, split, fmt, compression, partition_by,
-                             schema, job_uuid, native_parquet=native_parquet)
+                             schema, job_uuid, native=native)
         try:
             if isinstance(exec_or_node, TpuExec):
                 with TaskContext():

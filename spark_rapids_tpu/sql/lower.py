@@ -253,20 +253,34 @@ class _ExprConverter:
         if isinstance(a, P.InAst):
             from spark_rapids_tpu.expr.predicates import InSet, Not
             if isinstance(a.values, (P.Select, P.SetOp)):
-                # uncorrelated IN (subquery): evaluate eagerly like
-                # ScalarSubquery (Spark runs subquery stages first; the
-                # reference's InSubqueryExec broadcast plays this role) and
-                # fold into a literal-set membership
+                # uncorrelated IN (subquery) reaching the expression layer
+                # (NOT IN, or a position the conjunct planner didn't push to
+                # a semi-join): evaluate eagerly like ScalarSubquery (Spark
+                # runs subquery stages first) and fold into a literal-set
+                # membership, widening both sides like Spark does
+                from spark_rapids_tpu.expr.arithmetic import promote
+                from spark_rapids_tpu.expr.cast import Cast
                 key = ("in", repr(a.values))
-                vals = self.lowerer._subq_cache.get(key)
-                if vals is None:
-                    tbl = self.lowerer.dataframe(a.values).collect()
+                hit = self.lowerer._subq_cache.get(key)
+                if hit is None:
+                    df = self.lowerer.dataframe(a.values)
+                    tbl = df.collect()
                     if tbl.num_columns != 1:
                         raise SqlAnalysisError(
                             "IN (subquery) must return exactly one column")
-                    vals = list(dict.fromkeys(tbl.column(0).to_pylist()))
-                    self.lowerer._subq_cache[key] = vals
-                ins = InSet(c(a.expr), vals)
+                    hit = (list(dict.fromkeys(tbl.column(0).to_pylist())),
+                           df.schema.fields[0].data_type)
+                    self.lowerer._subq_cache[key] = hit
+                vals, sub_dt = hit
+                lhs = c(a.expr)
+                if lhs.dtype != sub_dt:
+                    target = promote(lhs.dtype, sub_dt)
+                    if target != lhs.dtype:
+                        lhs = Cast(lhs, target)
+                    if isinstance(target, (T.DoubleType, T.FloatType)):
+                        vals = [None if v is None else float(v)
+                                for v in vals]
+                ins = InSet(lhs, vals)
                 return Not(ins) if a.negated else ins
             vals = []
             for v in a.values:
@@ -617,9 +631,13 @@ class _Lowerer:
           row_number() over (partition by all columns); inner/anti join on
           (columns, n) then yields exactly min(cl,cr) / (cl-cr) copies —
           existing window + join machinery, no bespoke replicate exec."""
-        left = self._query(s.left)
-        right = self._query(s.right)
-        left, right = self._align_setop(left, right, s.op)
+        def arm(q):
+            # a parenthesized arm may carry its own WITH clause — lower it
+            # through a sub-lowerer so its CTEs register (review catch)
+            if getattr(q, "ctes", None):
+                return self.dataframe(q)._plan
+            return self._query(q)
+        left, right = self._align_setop(arm(s.left), arm(s.right), s.op)
         if s.op == "union":
             plan = NN.UnionNode(left, right)
             if not s.all:
@@ -841,15 +859,38 @@ class _Lowerer:
             else:
                 leftover.append(conj)
 
-        # push single-relation filters down before joining
+        # push single-relation filters down before joining; a non-negated
+        # `expr IN (subquery)` conjunct becomes a LEFT-SEMI join against the
+        # subquery plan (Spark RewritePredicateSubquery; the reference
+        # executes it as a broadcast semi-join) instead of an eagerly
+        # collected literal set that scales device comparisons with the
+        # subquery's row count
         for ri, conjs in single.items():
             rel = rels[ri]
             conv = _ExprConverter(rel.scope, self)
-            cond = conv.convert(conjs[0])
-            from spark_rapids_tpu.expr.predicates import And
-            for cj in conjs[1:]:
-                cond = And(cond, conv.convert(cj))
-            rel.plan = NN.FilterNode(cond, rel.plan)
+            plain, semi = [], []
+            for cj in conjs:
+                if (isinstance(cj, P.InAst) and not cj.negated
+                        and isinstance(cj.values, (P.Select, P.SetOp))):
+                    semi.append(cj)
+                else:
+                    plain.append(cj)
+            if plain:
+                cond = conv.convert(plain[0])
+                from spark_rapids_tpu.expr.predicates import And
+                for cj in plain[1:]:
+                    cond = And(cond, conv.convert(cj))
+                rel.plan = NN.FilterNode(cond, rel.plan)
+            for cj in semi:
+                sub = self.dataframe(cj.values)._plan
+                if len(sub.output) != 1:
+                    raise SqlAnalysisError(
+                        "IN (subquery) must return exactly one column")
+                f0 = sub.output[0]
+                rel.plan = NN.JoinNode(
+                    rel.plan, sub, [conv.convert(cj.expr)],
+                    [E.BoundReference(0, f0.data_type, f0.nullable,
+                                      f0.name)], "leftsemi", None)
 
         # greedy join: start from the relation with the most edges (the fact
         # table in a star query), attach connected relations first

@@ -1,0 +1,228 @@
+"""Native (device-encode) ORC and CSV writer tests — VERDICT r4 next #3.
+
+ORC files round-trip through BOTH pyarrow.orc (independent reader — the
+RLEv2/protobuf framing must be spec-exact) and the engine's own device scan
+path (io/orc_native — the a1d7826-style cross-stack check). CSV round-trips
+through the engine's reader and python's csv module. Reference suite analog:
+OrcWriterSuite.scala / CsvScanSuite roles."""
+
+import csv
+import datetime
+import decimal
+import glob
+import io
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.orc as pa_orc
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.io import orc_native, orc_write_native, csv_write_native
+
+UTC = datetime.timezone.utc
+
+
+@pytest.fixture
+def spark():
+    from spark_rapids_tpu.session import TpuSession
+    return TpuSession()
+
+
+@pytest.fixture
+def typed_table():
+    return pa.table({
+        "i32": pa.array([1, None, 3, -4, 5], pa.int32()),
+        "i64": pa.array([10**12, 2, None, -2**40, 5], pa.int64()),
+        "f32": pa.array([1.0, 2.0, 3.0, None, 5.0], pa.float32()),
+        "f64": pa.array([1.5, None, 3.25, -0.5, 2.0]),
+        "s": pa.array(["apple", "banana", None, "apple", "cherry"]),
+        "b": pa.array([True, False, None, True, False]),
+        "dt": pa.array([datetime.date(2020, 1, 1),
+                        datetime.date(1999, 12, 31), None,
+                        datetime.date(2026, 7, 31),
+                        datetime.date(1969, 7, 20)]),
+        "ts": pa.array([datetime.datetime(2020, 1, 1, 12, 30, 15, 123456),
+                        # pre-2015: negative seconds vs the ORC epoch
+                        datetime.datetime(2014, 12, 31, 23, 59, 59, 999999),
+                        None,
+                        datetime.datetime(2015, 1, 1),
+                        datetime.datetime(1969, 7, 20, 20, 17)],
+                       pa.timestamp("us")),
+        "dec": pa.array([decimal.Decimal("1.23"), decimal.Decimal("-45.60"),
+                         None, decimal.Decimal("0.01"),
+                         decimal.Decimal("99999.99")], pa.decimal128(7, 2)),
+    })
+
+
+def _naive(rows):
+    return [v.replace(tzinfo=None) if isinstance(v, datetime.datetime)
+            else v for v in rows]
+
+
+def test_orc_all_types_pyarrow_roundtrip(tmp_path, typed_table):
+    b = ColumnarBatch.from_arrow(typed_table)
+    schema = T.StructType.from_arrow(typed_table.schema)
+    p = str(tmp_path / "t.orc")
+    orc_write_native.write_batch_file(p, b, schema)
+    back = pa_orc.read_table(p)
+    for name in typed_table.column_names:
+        assert back.column(name).to_pylist() == \
+            typed_table.column(name).to_pylist(), name
+
+
+def test_orc_cross_stack_device_read(tmp_path, typed_table):
+    """Native-writer stripes through the engine's device ORC decoder."""
+    b = ColumnarBatch.from_arrow(typed_table)
+    schema = T.StructType.from_arrow(typed_table.schema)
+    p = str(tmp_path / "t.orc")
+    orc_write_native.write_batch_file(p, b, schema)
+    meta = orc_native.read_meta(p)
+    got = orc_native.read_stripe_device(p, meta, 0, schema).to_arrow()
+    for name in typed_table.column_names:
+        # engine timestamps are UTC-aware (UTC-only engine)
+        assert _naive(got.column(name).to_pylist()) == \
+            typed_table.column(name).to_pylist(), name
+
+
+def test_orc_multi_stripe(tmp_path):
+    schema = T.StructType([T.StructField("x", T.LONG, True)])
+    f = orc_write_native.NativeOrcFile(str(tmp_path / "m.orc"), schema)
+    rng = np.random.default_rng(3)
+    allv = []
+    for _ in range(3):
+        vals = rng.integers(-10**9, 10**9, 700)   # >512: several RLEv2 runs
+        allv.extend(vals.tolist())
+        f.append_batch(ColumnarBatch.from_arrow(
+            pa.table({"x": pa.array(vals, pa.int64())})))
+    f.close()
+    back = pa_orc.read_table(str(tmp_path / "m.orc"))
+    assert back.column("x").to_pylist() == allv
+    meta = orc_native.read_meta(str(tmp_path / "m.orc"))
+    assert len(meta.stripes) == 3
+
+
+def test_orc_byte_rle_and_rlev2_edges():
+    """Encoder outputs decode with the engine reader's own decoders."""
+    # byte-RLE: long run + literals + short run
+    data = bytes([7] * 200 + [1, 2, 3, 4] + [9] * 3)
+    enc = orc_write_native.byte_rle(data)
+    bits = np.frombuffer(data, np.uint8)
+    dec = orc_native.decode_boolean_rle(enc, len(data) * 8)
+    packed = np.packbits(dec.astype(np.uint8)).tobytes()
+    assert packed == data
+    # RLEv2 direct: width-64 values and a >512 chunk
+    vals = np.array([0, 1, -1, 2**62, -2**62] * 200, np.int64)
+    enc = orc_write_native.rlev2_direct(vals, signed=True)
+    got = orc_native.rlev2_decode_host(enc, 0, len(enc), len(vals),
+                                       signed=True)
+    assert np.array_equal(np.asarray(got, np.int64), vals)
+
+
+def test_session_write_orc_native_and_arrow_opt_out(spark, tmp_path):
+    t = pa.table({"k": pa.array([2, 1, None], pa.int64()),
+                  "s": ["b", "a", None]})
+    df = spark.create_dataframe(t)
+    p = str(tmp_path / "o")
+    df.write_orc(p)
+    files = glob.glob(p + "/*.orc")
+    assert files and pa_orc.read_table(files[0]).num_rows == 3
+    back = spark.read_orc(p).collect().sort_by([("k", "ascending")])
+    assert back.column("s").to_pylist() == ["a", "b", None]
+    # config opt-out routes through arrow
+    from spark_rapids_tpu.session import TpuSession
+    s2 = TpuSession({"spark.rapids.tpu.sql.format.orc.writer.type": "ARROW"})
+    p2 = str(tmp_path / "o2")
+    s2.create_dataframe(t).write_orc(p2)
+    assert spark.read_orc(p2).collect().num_rows == 3
+
+
+def test_orc_unsupported_schema_falls_back(spark, tmp_path):
+    t = pa.table({"k": pa.array([1, 2], pa.int64()),
+                  "a": pa.array([[1, 2], [3]], pa.list_(pa.int64()))})
+    p = str(tmp_path / "lists")
+    spark.create_dataframe(t).write_orc(p)       # arrow fallback, no error
+    back = pa_orc.read_table(glob.glob(p + "/*.orc")[0])
+    assert back.column("a").to_pylist() == [[1, 2], [3]]
+
+
+def test_csv_native_quoting_and_nulls(spark, tmp_path):
+    t = pa.table({
+        "k": pa.array([1, 2, None, 4], pa.int64()),
+        "s": pa.array(["plain", "with,comma", 'with"quote', "x\ny"]),
+        "v": pa.array([1.5, None, 0.1, -2.25]),
+        "b": pa.array([True, None, False, True]),
+    })
+    df = spark.create_dataframe(t)
+    p = str(tmp_path / "c")
+    df.write_csv(p)
+    text = open(glob.glob(p + "/*.csv")[0]).read()
+    rows = list(csv.reader(io.StringIO(text)))   # independent RFC-4180 parse
+    assert rows[0] == ["k", "s", "v", "b"]
+    body = {r[0]: r for r in rows[1:]}
+    assert body["2"][1] == "with,comma"
+    assert body[""][1] == 'with"quote'
+    assert body["4"][1] == "x\ny"
+    assert body["2"][2] == "" and body[""][3] == "false"
+    back = spark.read_csv(p, schema=df.schema).collect().sort_by(
+        [("v", "ascending")])
+    assert back.column("s").to_pylist() == \
+        t.sort_by([("v", "ascending")]).column("s").to_pylist()
+
+
+def test_csv_native_typed_values(spark, tmp_path):
+    t = pa.table({
+        "dt": pa.array([datetime.date(2020, 1, 2), None]),
+        "ts": pa.array([datetime.datetime(2020, 1, 2, 3, 4, 5, 600000),
+                        None], pa.timestamp("us")),
+        "dec": pa.array([decimal.Decimal("-4.05"), None],
+                        pa.decimal128(7, 2)),
+    })
+    p = str(tmp_path / "cv")
+    spark.create_dataframe(t).write_csv(p)
+    text = open(glob.glob(p + "/*.csv")[0]).read().splitlines()
+    assert text[1].startswith("2020-01-02,2020-01-02T03:04:05.600000,-4.05")
+    assert text[2] == ",,"
+
+
+def test_csv_stats_and_commit(spark, tmp_path):
+    t = pa.table({"k": pa.array(range(100), pa.int64())})
+    p = str(tmp_path / "cs")
+    stats = spark.create_dataframe(t, num_partitions=3).write_csv(p)
+    assert stats.num_rows == 100 and stats.num_files >= 3
+    assert os.path.exists(os.path.join(p, "_SUCCESS"))
+    back = spark.read_csv(p, schema=T.StructType(
+        [T.StructField("k", T.LONG, True)])).collect()
+    assert sorted(back.column("k").to_pylist()) == list(range(100))
+
+
+@pytest.mark.parametrize("codec", ["zlib", "snappy", "none"])
+def test_orc_compressed_roundtrip(tmp_path, typed_table, codec):
+    """Chunked stream/footer compression readable by pyarrow AND the
+    engine's device reader (review catch: native default silently dropped
+    the arrow path's compression)."""
+    b = ColumnarBatch.from_arrow(typed_table)
+    schema = T.StructType.from_arrow(typed_table.schema)
+    p = str(tmp_path / f"c_{codec}.orc")
+    orc_write_native.write_batch_file(p, b, schema, compression=codec)
+    back = pa_orc.read_table(p)
+    for name in typed_table.column_names:
+        assert back.column(name).to_pylist() == \
+            typed_table.column(name).to_pylist(), name
+    meta = orc_native.read_meta(p)
+    got = orc_native.read_stripe_device(p, meta, 0, schema).to_arrow()
+    assert _naive(got.column("ts").to_pylist()) == \
+        typed_table.column("ts").to_pylist()
+
+
+def test_orc_zlib_actually_compresses(tmp_path):
+    t = pa.table({"s": pa.array(["constant string"] * 5000)})
+    b = ColumnarBatch.from_arrow(t)
+    schema = T.StructType.from_arrow(t.schema)
+    pz = str(tmp_path / "z.orc")
+    pn = str(tmp_path / "n.orc")
+    orc_write_native.write_batch_file(pz, b, schema, compression="zlib")
+    orc_write_native.write_batch_file(pn, b, schema, compression="none")
+    assert os.path.getsize(pz) < os.path.getsize(pn)

@@ -274,3 +274,38 @@ def test_in_subquery():
         "select x from ta where x not in (select y from tb) order by x")
     got = [r["x"] for r in df.collect().to_pylist()]
     assert got == [1, 3]
+
+
+def test_in_subquery_semi_join_and_widening():
+    """Review catches: pushed-down `x IN (subquery)` lowers to a left-semi
+    join (not an eagerly collected set); the eager fold (NOT IN / non-
+    pushdown positions) widens both sides like Spark instead of truncating
+    subquery values into the LHS dtype; a WITH clause inside a
+    parenthesized set-op arm registers its CTEs."""
+    spark = TpuSession()
+    spark.create_or_replace_temp_view(
+        "ia", spark.create_dataframe(pa.table({"x": [1, 2, 3, None]})))
+    spark.create_or_replace_temp_view(
+        "ib", spark.create_dataframe(pa.table({"y": [2.5, 2.0]})))
+    # int LHS vs double subquery: 2 matches 2.0, nothing matches 2.5
+    df = spark.sql("select x from ia where x in (select y from ib)")
+    assert [r["x"] for r in df.collect().to_pylist()] == [2]
+    assert df.collect().to_pylist() == df.collect_host().to_pylist()
+    df = spark.sql(
+        "select x from ia where x not in (select y from ib) order by x")
+    assert [r["x"] for r in df.collect().to_pylist()] == [1, 3]
+    # semi-join plan shape for the pushed-down form
+    from spark_rapids_tpu.plan import nodes as NN
+
+    def find(node, cls):
+        hits = [node] if isinstance(node, cls) else []
+        for c in node.children:
+            hits += find(c, cls)
+        return hits
+    plan = spark.sql("select x from ia where x in (select y from ib)")._plan
+    assert any(j.join_type == "leftsemi" for j in find(plan, NN.JoinNode))
+    # CTE inside a parenthesized set-op arm
+    got = spark.sql(
+        "(with w as (select 1 x) select x from w) union all select 2 x "
+        "order by x").collect().to_pylist()
+    assert got == [{"x": 1}, {"x": 2}]
