@@ -234,11 +234,25 @@ class HashAggregateExec(TpuExec):
         from spark_rapids_tpu.expr.aggregates import Average, Count, Sum
 
         on_tpu = jax.devices()[0].platform == "tpu"
-        ks = G.compact_key_codes(key_cols, max_domain=128 if on_tpu else 4096)
-        if ks is None:
-            return None
         fns = [_agg_fn(e) for e in self.agg_exprs]
         if not all(isinstance(f, (Sum, Count, Average)) for f in fns):
+            return None
+        # TPU domain bound: the f64 one-hot matmul materializes (cap, D) so
+        # D stays small; count-only aggregations (incl. DISTINCT dedup,
+        # which has no aggregates) ride the blocked Pallas one-hot kernel
+        # in the non-merge phase and stretch to medium domains — only when
+        # that kernel actually dispatches (probe latch), else the jnp
+        # fallback would materialize the very (cap, D) blowup the 128
+        # bound exists to prevent
+        count_only = all(isinstance(f, Count) for f in fns)
+        if on_tpu:
+            from spark_rapids_tpu.ops import pallas_kernels as PK
+            max_dom = (1024 if count_only and not merge
+                       and PK.should_use("onehot") else 128)
+        else:
+            max_dom = 4096
+        ks = G.compact_key_codes(key_cols, max_domain=max_dom)
+        if ks is None:
             return None
         if on_tpu and any(
                 not jnp.issubdtype(jnp.dtype(st.jnp_dtype), jnp.floating)
@@ -255,12 +269,14 @@ class HashAggregateExec(TpuExec):
             live = live & live_mask    # fused prefilter (see _agg_kernel)
         codes = jnp.where(live, codes, jnp.int32(D))   # pad bucket, dropped
 
-        def gsum(vals, mask, acc_dtype):
+        def gsum(vals, mask, acc_dtype, count_like=False):
             return G.dense_group_sum(vals.astype(acc_dtype), mask & live,
-                                     codes, D, on_tpu)
+                                     codes, D, on_tpu,
+                                     count_like=count_like)
 
         rows_per = gsum(jnp.ones((cap,), jnp.int32),
-                        jnp.ones((cap,), jnp.bool_), jnp.int32)
+                        jnp.ones((cap,), jnp.bool_), jnp.int32,
+                        count_like=True)
 
         state_cols = []   # (D,)-length states, padded to D_cap below
         off = len(key_cols)
@@ -276,7 +292,8 @@ class HashAggregateExec(TpuExec):
             if isinstance(f, Count):
                 s = gsum(ins[0].validity.astype(jnp.int64)
                          if not merge else ins[0].values,
-                         ins[0].validity, jnp.int64)
+                         ins[0].validity, jnp.int64,
+                         count_like=not merge)    # update inputs are 0/1
                 state_cols.append(Col(s, jnp.ones_like(s, jnp.bool_),
                                       T.LONG))
                 continue
@@ -284,7 +301,7 @@ class HashAggregateExec(TpuExec):
             acc = sum_t.jnp_dtype
             s = gsum(ins[0].values, ins[0].validity, acc)
             cnt = gsum(ins[0].validity.astype(jnp.int64), ins[0].validity,
-                       jnp.int64)
+                       jnp.int64, count_like=True)   # validity is 0/1
             state_cols.append(Col(s, cnt > 0, sum_t))
             if isinstance(f, Average):
                 if merge:

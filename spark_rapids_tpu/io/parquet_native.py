@@ -472,7 +472,7 @@ def _decode_single_page_fused(packed: bytes, bw: int, def_levels, dict_dev,
     n_present = int(def_levels.sum())
     pcap = max(bucket_capacity(max(n_present, 1)), 8)
     bcap = max(bucket_capacity(max(len(packed), 1)), 8)
-    use_pallas = PK.should_use()     # probe OUTSIDE the traced program
+    use_pallas = PK.should_use("bitunpack")     # probe OUTSIDE the traced program
 
     np_to_spark = {"INT32": T.INT, "INT64": T.LONG,
                    "FLOAT": T.FLOAT, "DOUBLE": T.DOUBLE}

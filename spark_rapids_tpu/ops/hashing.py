@@ -129,7 +129,7 @@ def hash_string_words(words, lengths, seed_i32):
     the jnp formulation below is the off-TPU path and the test oracle.
     """
     from spark_rapids_tpu.ops import pallas_kernels as PK
-    if PK.should_use():
+    if PK.should_use("murmur3"):
         return PK.murmur3_words(words, lengths, seed_i32)
     n, W = words.shape
     n_words = lengths // 4

@@ -52,30 +52,42 @@ def set_mode(force: bool | None) -> None:
     _FORCE = force
 
 
-def _probe_tpu() -> bool:
-    """Compile tiny instances of both kernels once on the TPU backend. A
+def _probe_tpu(kernel: str) -> bool:
+    """Compile a tiny instance of `kernel` once on the TPU backend. A
     Mosaic lowering failure inside an enclosing jit would surface as an
     opaque engine error at compile time; probing here instead latches the
-    dispatch off so the jnp formulations keep the engine correct."""
+    dispatch off so the jnp formulations keep the engine correct. Latches
+    are PER KERNEL: a lowering failure in one (e.g. a newly added kernel
+    that has never met real hardware) must not disable the proven ones."""
     global _TPU_PROBE
     if _TPU_PROBE is None:
+        _TPU_PROBE = {}
+    if kernel not in _TPU_PROBE:
         try:
-            w = jnp.zeros((8, 2), jnp.int32)
-            l = jnp.full((8,), 5, jnp.int32)
-            jax.block_until_ready(murmur3_words(w, l, 42))
-            jax.block_until_ready(
-                bitunpack128(jnp.zeros((32,), jnp.int32), 8, 100, 128))
-            _TPU_PROBE = True
+            if kernel == "murmur3":
+                w = jnp.zeros((8, 2), jnp.int32)
+                l = jnp.full((8,), 5, jnp.int32)
+                jax.block_until_ready(murmur3_words(w, l, 42))
+            elif kernel == "bitunpack":
+                jax.block_until_ready(
+                    bitunpack128(jnp.zeros((32,), jnp.int32), 8, 100, 128))
+            elif kernel == "onehot":
+                jax.block_until_ready(
+                    onehot_sum_f32(jnp.ones((256,), jnp.float32),
+                                   jnp.zeros((256,), jnp.int32), 140))
+            else:
+                raise ValueError(f"unknown pallas kernel {kernel!r}")
+            _TPU_PROBE[kernel] = True
         except Exception:  # noqa: BLE001 — any lowering failure latches off
-            _TPU_PROBE = False
-    return _TPU_PROBE
+            _TPU_PROBE[kernel] = False
+    return _TPU_PROBE[kernel]
 
 
-def should_use() -> bool:
-    """Do the engine's string-hash / parquet-unpack paths route here?"""
+def should_use(kernel: str = "murmur3") -> bool:
+    """Does the engine route `kernel`'s op here on this backend?"""
     if _FORCE is not None:
         return _FORCE
-    return jax.default_backend() == "tpu" and _probe_tpu()
+    return jax.default_backend() == "tpu" and _probe_tpu(kernel)
 
 
 def _interpret() -> bool:
@@ -229,3 +241,64 @@ def bytes_to_words_u32(packed: np.ndarray) -> np.ndarray:
     if pad:
         packed = np.concatenate([packed, np.zeros(pad, np.uint8)])
     return packed.view("<i4").astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# blocked one-hot matmul (medium-domain dense group-by / histogram)
+# ---------------------------------------------------------------------------
+
+_OH_BK = 1024    # row-block (codes/values) per grid step
+_OH_BD = 128     # domain lanes per grid step (one MXU/VPU lane tile)
+
+
+def _onehot_kernel(codes_ref, vals_ref, out_ref, *, bk: int):
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    d0 = pl.program_id(0) * _OH_BD
+    codes = codes_ref[0, :]                    # (bk,) int32
+    vals = vals_ref[0, :]                      # (bk,) f32
+    lanes = d0 + lax.broadcasted_iota(jnp.int32, (bk, _OH_BD), 1)
+    onehot = (codes[:, None] == lanes).astype(jnp.float32)
+    out_ref[0, :] += jnp.dot(vals, onehot,
+                             preferred_element_type=jnp.float32)
+
+
+def onehot_sum_f32(vals, codes, n_domain: int):
+    """(n_domain,) f32 bucket sums of `vals` over int32 `codes` — the
+    generalized one-hot-matmul group-by (VERDICT r4 next #7; reference
+    analog: cudf's hash groupby behind aggregate.scala:706).
+
+    The jnp formulation in ops/grouping.dense_group_sum materializes the
+    (cap, D) one-hot in HBM — fine at D<=128, ruinous at medium domains.
+    This kernel generates each (BK, 128) one-hot tile on the fly in VMEM
+    and feeds the MXU, so HBM traffic is O(cap + D) instead of O(cap*D):
+    rows stream once per 128-lane domain block, nothing is scattered (the
+    round-2 wedge lesson), and every shape is static.
+
+    Exactness: f32 accumulation — callers use it for 0/1 histograms and
+    per-batch counts (exact below 2^24) and f32 sums; f64 sums stay on the
+    jnp path."""
+    cap = vals.shape[0]
+    # lane-aligned row block: Mosaic wants multiples of 128 (the probe's
+    # aligned instance would not catch a misaligned caller)
+    bk = min(_OH_BK, -(-max(cap, 128) // 128) * 128)
+    capp = -(-cap // bk) * bk
+    dp = -(-n_domain // _OH_BD) * _OH_BD
+    codes2 = jnp.full((1, capp), -1, jnp.int32).at[0, :cap].set(
+        codes.astype(jnp.int32))
+    vals2 = jnp.zeros((1, capp), jnp.float32).at[0, :cap].set(
+        vals.astype(jnp.float32))
+    out = pl.pallas_call(
+        functools.partial(_onehot_kernel, bk=bk),
+        out_shape=jax.ShapeDtypeStruct((1, dp), jnp.float32),
+        grid=(dp // _OH_BD, capp // bk),
+        in_specs=[pl.BlockSpec((1, bk), lambda i, k: (0, k)),
+                  pl.BlockSpec((1, bk), lambda i, k: (0, k))],
+        out_specs=pl.BlockSpec((1, _OH_BD), lambda i, k: (0, i)),
+        interpret=_interpret(),
+    )(codes2, vals2)
+    return out[0, :n_domain]

@@ -59,7 +59,7 @@ def decode_dictionary_page(packed_bytes: np.ndarray, bit_width: int,
     from spark_rapids_tpu.columnar.vector import bucket_capacity
     from spark_rapids_tpu.ops import pallas_kernels as PK
     pcap = max(bucket_capacity(n_present), 8)
-    if PK.should_use():
+    if PK.should_use("bitunpack"):
         words = PK.bytes_to_words_u32(np.asarray(packed_bytes, np.uint8))
         idx = PK.bitunpack128(jnp.asarray(words), bit_width, n_present, pcap)
     else:

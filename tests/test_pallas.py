@@ -139,3 +139,48 @@ def test_pallas_dispatch_through_parquet_decode(tmp_path):
     PK.set_mode(None)
     assert (np.asarray(v1) == np.asarray(v2)).all()
     assert (np.asarray(m1) == np.asarray(m2)).all()
+
+
+def test_onehot_sum_matches_numpy():
+    """Blocked one-hot matmul kernel (medium-domain dense group-by,
+    VERDICT r4 next #7) vs a numpy bucket-add oracle; histograms of 0/1
+    values are exact."""
+    rng = np.random.default_rng(11)
+    for cap, D in [(4096, 12), (2048, 1000), (1500, 300), (100, 5),
+                   (8192, 1024)]:
+        codes = rng.integers(-1, D, cap).astype(np.int32)
+        vals = rng.normal(0, 10, cap).astype(np.float32)
+        got = np.asarray(PK.onehot_sum_f32(jnp.asarray(vals),
+                                           jnp.asarray(codes), D))
+        exp = np.zeros(D, np.float64)
+        np.add.at(exp, codes[codes >= 0], vals[codes >= 0].astype(np.float64))
+        assert np.allclose(got, exp, rtol=1e-3, atol=1e-2), (cap, D)
+    ones = np.ones(65536, np.float32)
+    codes = rng.integers(0, 1024, 65536).astype(np.int32)
+    got = np.asarray(PK.onehot_sum_f32(jnp.asarray(ones),
+                                       jnp.asarray(codes), 1024))
+    assert np.array_equal(got.astype(np.int64), np.bincount(codes,
+                                                            minlength=1024))
+
+
+def test_dense_group_sum_pallas_dispatch_equivalence():
+    """dense_group_sum(count_like) forced through the Pallas kernel equals
+    the jnp one-hot path — the dense aggregation spine's TPU route."""
+    from spark_rapids_tpu.ops import grouping as G
+    rng = np.random.default_rng(12)
+    cap, D = 4096, 700
+    codes = jnp.asarray(rng.integers(0, D + 1, cap).astype(np.int32))
+    ones = jnp.ones((cap,), jnp.int64)
+    mask = jnp.asarray(rng.random(cap) < 0.9)
+    PK.set_mode(True)
+    try:
+        a = np.asarray(G.dense_group_sum(ones, mask, codes, D, True,
+                                         count_like=True))
+    finally:
+        PK.set_mode(False)
+    b = np.asarray(G.dense_group_sum(ones, mask, codes, D, True,
+                                     count_like=True))
+    c = np.asarray(G.dense_group_sum(ones, mask, codes, D, False,
+                                     count_like=True))
+    PK.set_mode(None)
+    assert np.array_equal(a, b) and np.array_equal(a, c)
