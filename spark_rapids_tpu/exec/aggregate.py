@@ -247,7 +247,11 @@ class HashAggregateExec(TpuExec):
         count_only = all(isinstance(f, Count) for f in fns)
         if on_tpu:
             from spark_rapids_tpu.ops import pallas_kernels as PK
+            # mirror dense_group_sum's f32-exactness cap guard: a batch at
+            # or above 2^24 rows would fall through to the jnp one-hot,
+            # materializing the (cap, D) blowup the 128 bound prevents
             max_dom = (1024 if count_only and not merge
+                       and ctx.capacity < (1 << 24)
                        and PK.should_use("onehot") else 128)
         else:
             max_dom = 4096

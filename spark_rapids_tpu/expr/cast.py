@@ -206,6 +206,50 @@ def _parse_date(s: str):
     return (d - datetime.date(1970, 1, 1)).days
 
 
+_TS_RE = None
+
+
+def _parse_timestamp(s: str):
+    """Spark DateTimeUtils.stringToTimestamp ANSI subset (the 3.2+ shape):
+    [+-]y+[-m[m][-d[d]]] with an optional [T or space][h]h:[m]m[:[s]s[.f+]]
+    time part and an optional Z/UTC/±hh[:mm] zone. The engine is UTC-only;
+    offsets shift into UTC. Returns epoch micros or None (Spark ANSI-off
+    yields null for unparseable strings). Special datetime strings
+    ('epoch', 'now', ...) are a 3.0/3.1-generation behavior handled at plan
+    time (shims.special_datetime_strings); this parser never accepts
+    them — the 3.2+ semantics (SPARK-35581)."""
+    global _TS_RE
+    import datetime
+    import re
+    if _TS_RE is None:
+        _TS_RE = re.compile(
+            r"^([+-]?\d{4,6})(?:-(\d{1,2})(?:-(\d{1,2})"
+            r"(?:[ T](\d{1,2}):(\d{1,2})(?::(\d{1,2})(?:\.(\d{1,9}))?)?"
+            r"\s*(Z|UTC|[+-]\d{1,2}(?::\d{1,2})?)?)?)?)?$")
+    m = _TS_RE.match(s.strip())
+    if not m:
+        return None
+    try:
+        frac = (m[7] or "")[:6].ljust(6, "0")
+        dt = datetime.datetime(int(m[1]), int(m[2] or 1), int(m[3] or 1),
+                               int(m[4] or 0), int(m[5] or 0),
+                               int(m[6] or 0), int(frac),
+                               tzinfo=datetime.timezone.utc)
+    except ValueError:
+        return None
+    off = 0
+    if m[8] and m[8] not in ("Z", "UTC"):
+        zm = re.match(r"([+-])(\d{1,2})(?::(\d{1,2}))?$", m[8])
+        zh, zmin = int(zm[2]), int(zm[3] or 0)
+        # Java ZoneOffset bounds: |offset| <= 18:00, minutes <= 59
+        if zh > 18 or zmin > 59 or zh * 3600 + zmin * 60 > 18 * 3600:
+            return None
+        off = (zh * 3600 + zmin * 60) * (1 if zm[1] == "+" else -1)
+    epoch = datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc)
+    return ((dt - epoch) // datetime.timedelta(microseconds=1)
+            - off * 1_000_000)
+
+
 def _parse_bool(s: str):
     t = s.strip().lower()
     if t in ("t", "true", "y", "yes", "1"):
@@ -229,6 +273,8 @@ def _cast_from_string(c: Col, to: T.DataType) -> Col:
         return dict_transform_to_values(c, _parse_bool, to)
     if isinstance(to, T.DateType):
         return dict_transform_to_values(c, _parse_date, to)
+    if isinstance(to, T.TimestampType):
+        return dict_transform_to_values(c, _parse_timestamp, to)
     if isinstance(to, T.DecimalType):
         def fdec(s, sc=to.scale, p=to.precision):
             from decimal import Decimal, InvalidOperation, ROUND_HALF_UP

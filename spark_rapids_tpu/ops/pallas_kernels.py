@@ -44,7 +44,7 @@ _FX2 = np.int32(np.uint32(0xC2B2AE35))
 # elsewhere); True forces the kernels (interpret-mode off-TPU — tests);
 # False forces the jnp paths (spark.rapids.tpu.sql.pallas.enabled=false)
 _FORCE: bool | None = None
-_TPU_PROBE: bool | None = None  # latched result of the one-time compile probe
+_TPU_PROBE: dict | None = None  # per-kernel latched compile-probe results
 
 
 def set_mode(force: bool | None) -> None:
@@ -275,9 +275,10 @@ def onehot_sum_f32(vals, codes, n_domain: int):
     The jnp formulation in ops/grouping.dense_group_sum materializes the
     (cap, D) one-hot in HBM — fine at D<=128, ruinous at medium domains.
     This kernel generates each (BK, 128) one-hot tile on the fly in VMEM
-    and feeds the MXU, so HBM traffic is O(cap + D) instead of O(cap*D):
-    rows stream once per 128-lane domain block, nothing is scattered (the
-    round-2 wedge lesson), and every shape is static.
+    and feeds the MXU, cutting HBM traffic from O(cap*D) one-hot elements
+    to O(cap * D/128) input re-streams (rows stream once per 128-lane
+    domain block) + O(D) output; nothing is scattered (the round-2 wedge
+    lesson), and every shape is static.
 
     Exactness: f32 accumulation — callers use it for 0/1 histograms and
     per-batch counts (exact below 2^24) and f32 sums; f64 sums stay on the

@@ -502,6 +502,9 @@ def _cast_one(v, src, dst, expr):
             return (d - datetime.date(1970, 1, 1)).days
         except ValueError:
             return None
+    if isinstance(dst, T.TimestampType) and isinstance(src, T.StringType):
+        from spark_rapids_tpu.expr.cast import _parse_timestamp
+        return _parse_timestamp(v)
     if isinstance(dst, T.TimestampType) and isinstance(src, T.DateType):
         return int(v) * 86_400_000_000
     if isinstance(dst, T.DateType) and isinstance(src, T.TimestampType):

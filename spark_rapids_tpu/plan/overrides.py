@@ -332,13 +332,17 @@ def _register_all():
                 "cast string→float disabled: rounding may differ from Spark "
                 "(enable with spark.rapids.tpu.sql.castStringToFloat.enabled)")
         if (isinstance(c.children[0].dtype, T.StringType)
-                and isinstance(c.dtype, T.DateType)):
+                and isinstance(c.dtype, (T.DateType, T.TimestampType))):
             from spark_rapids_tpu.shims import shim_for
-            if shim_for(meta.conf).lenient_string_to_date:
+            shim = shim_for(meta.conf)
+            lenient = (shim.lenient_string_to_date
+                       if isinstance(c.dtype, T.DateType)
+                       else shim.lenient_string_to_timestamp)
+            if lenient:
                 meta.will_not_work(
-                    "Spark 3.0-generation lenient date strings are not "
-                    "implemented by the device parser (shim "
-                    f"{shim_for(meta.conf)!r} pins this cast to host)")
+                    f"Spark 3.0-generation lenient {c.dtype} strings are "
+                    "not implemented by the device parser (shim "
+                    f"{shim!r} pins this cast to host)")
     ex(Cast, "type cast", TS.ALL, None, None, tag_cast)
 
     for cls in (AG.Sum, AG.Count, AG.Min, AG.Max, AG.Average, AG.First,

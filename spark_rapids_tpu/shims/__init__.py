@@ -16,6 +16,29 @@ gating the places where Spark releases genuinely disagree:
   (EXCEPTION | CORRECTED | LEGACY), with a real Julian→proleptic-Gregorian
   day rebase (`rebase_julian_to_gregorian_days`) like Spark's
   RebaseDateTime.
+- string→timestamp casting: the device/host parser implements the 3.2+
+  ANSI subset; 3.0/3.1 lenient forms pin the cast to host
+  (`lenient_string_to_timestamp`).
+- special datetime strings (SPARK-35581): `cast('epoch'|'now'|'today'|
+  'yesterday'|'tomorrow' as date/timestamp)` resolves at plan time on
+  3.0/3.1 generations (`special_datetime_strings`) and yields null on
+  3.2+, matching the removal; DATE/TIMESTAMP typed literals keep them on
+  every generation, as Spark does.
+- AQE post-shuffle coalescing default (3.2 flip, SPARK-33679) incl. the
+  Databricks 3.0/3.1 early default-on.
+
+Explicit NON-GOALS (version divergences the engine's surface does not
+model; listed so the 6-generation facade is honest about its resolution —
+reference SparkShims.scala:73-210 gates dozens more):
+- spark.sql.legacy.timeParserPolicy=LEGACY (SimpleDateFormat quirks and
+  week-based tokens; the engine's device subset rejects unsupported
+  tokens on every generation and pins those expressions to host),
+- ANSI mode everywhere (ANSI interval types from 3.2, try_* functions,
+  error-on-overflow arithmetic; the engine is ANSI-off only),
+- char/varchar padding semantics (3.1+, SPARK-33480) — no char types,
+- regexp engine deltas across JDK releases (RLike rides Python `re` with
+  documented divergences in docs/compatibility.md),
+- CSV/JSON malformed-record policy changes across 3.x (PERMISSIVE only).
 """
 
 from __future__ import annotations
@@ -36,6 +59,14 @@ class SparkShim:
     #: element_at(arr, 0): pre-3.4 generations RAISE ("SQL array indices
     #: start at 1"); 3.4+ ANSI-off returns null
     element_at_zero_errors = False
+    #: accept lenient timestamp strings in cast (3.0/3.1); the device
+    #: parser implements the 3.2+ ANSI subset, so lenient generations pin
+    #: the cast to host
+    lenient_string_to_timestamp = False
+    #: cast('epoch'/'now'/'today'/'yesterday'/'tomorrow' as date/timestamp)
+    #: resolves on 3.0/3.1; REMOVED from casts in 3.2 (SPARK-35581) —
+    #: typed literals keep them on every generation
+    special_datetime_strings = False
 
     def __repr__(self):
         return f"SparkShim({self.version_prefix}.x)"
@@ -44,6 +75,8 @@ class SparkShim:
 class Spark30Shim(SparkShim):
     version_prefix = "3.0"
     lenient_string_to_date = True
+    lenient_string_to_timestamp = True
+    special_datetime_strings = True
     adaptive_coalesce_default = False
     element_at_zero_errors = True
 

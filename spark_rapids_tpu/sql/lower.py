@@ -38,6 +38,31 @@ class SqlAnalysisError(ValueError):
     pass
 
 
+def _special_datetime(s: str, to):
+    """Spark's special datetime strings (epoch/now/today/yesterday/
+    tomorrow) as a plan-time Literal, or None. Spark binds now/today to
+    query-start time; this engine binds to plan time (UTC-only)."""
+    import datetime as _dt
+    name = s.strip().lower()
+    if name not in ("epoch", "now", "today", "yesterday", "tomorrow"):
+        return None
+    now = _dt.datetime.now(_dt.timezone.utc)
+    epoch = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+    day = {"epoch": _dt.date(1970, 1, 1), "now": now.date(),
+           "today": now.date(),
+           "yesterday": now.date() - _dt.timedelta(days=1),
+           "tomorrow": now.date() + _dt.timedelta(days=1)}[name]
+    if isinstance(to, T.DateType):
+        return E.Literal((day - _dt.date(1970, 1, 1)).days, T.DATE)
+    if name == "now":
+        micros = (now - epoch) // _dt.timedelta(microseconds=1)
+    else:
+        midnight = _dt.datetime(day.year, day.month, day.day,
+                                tzinfo=_dt.timezone.utc)
+        micros = (midnight - epoch) // _dt.timedelta(microseconds=1)
+    return E.Literal(micros, T.TIMESTAMP)
+
+
 # -- scopes -------------------------------------------------------------------
 
 class Scope:
@@ -218,6 +243,18 @@ class _ExprConverter:
             # at plan time — Spark's Literal parsing. Explicit cast() keeps
             # its runtime Spark cast semantics (lenient parse, NULL on bad
             # input) — the two share an AST node but not behavior.
+            if isinstance(a.expr, P.Lit) and isinstance(a.expr.value, str) \
+                    and isinstance(to, (T.DateType, T.TimestampType)):
+                # special datetime strings (epoch/now/today/...): typed
+                # literals keep them on EVERY generation; plain casts only
+                # on 3.0/3.1 shims (SPARK-35581 removed them in 3.2)
+                sp = _special_datetime(a.expr.value, to)
+                if sp is not None:
+                    from spark_rapids_tpu.shims import shim_for
+                    if a.typed_literal or shim_for(
+                            self.lowerer.session.conf
+                            ).special_datetime_strings:
+                        return sp
             if a.typed_literal and isinstance(a.expr, P.Lit) \
                     and isinstance(a.expr.value, str):
                 import datetime as _dt

@@ -218,3 +218,69 @@ def test_register_shim_discovery():
         assert S.load_shim("3.5.0-custom").version_prefix == "3.5"
     finally:
         S._PLATFORM_SHIMS.pop("custom", None)
+
+
+def test_string_to_timestamp_cast_ansi_subset():
+    """Device string→timestamp cast implements the 3.2+ ANSI subset
+    (device == host on every shape incl. zones and fractions)."""
+    import pyarrow as pa
+    from spark_rapids_tpu.session import TpuSession
+    spark = TpuSession()
+    t = pa.table({"s": ["2021-01-05 12:30:45.123456", "2021-1-5",
+                        "2021-01-05T07:00:00+02:00", "2021-07",
+                        "2021-01-05 23:59:59Z", "epoch", "junk", None]})
+    spark.create_or_replace_temp_view("ts_t", spark.create_dataframe(t))
+    df = spark.sql("select cast(s as timestamp) ts from ts_t")
+    got = [r["ts"] for r in df.collect().to_pylist()]
+    exp = [r["ts"] for r in df.collect_host().to_pylist()]
+    assert got == exp
+    assert got[0].microsecond == 123456
+    assert got[2].hour == 5                  # +02:00 shifted into UTC
+    assert got[5] is None and got[6] is None and got[7] is None
+
+
+def test_special_datetime_strings_shim_divergence():
+    """SPARK-35581: cast('epoch'... as date/timestamp) resolves on 3.0/3.1
+    generations, yields null on 3.2+; DATE/TIMESTAMP typed literals keep
+    the special strings on every generation."""
+    import datetime
+    from spark_rapids_tpu.session import TpuSession
+    old = TpuSession({"spark.rapids.tpu.spark.version": "3.1.2"})
+    new = TpuSession({"spark.rapids.tpu.spark.version": "3.5.0"})
+    row = old.sql("select cast('epoch' as timestamp) e, "
+                  "cast('Epoch' as date) d").collect().to_pylist()[0]
+    assert row["e"] == datetime.datetime(1970, 1, 1,
+                                         tzinfo=datetime.timezone.utc)
+    assert row["d"] == datetime.date(1970, 1, 1)
+    row = old.sql("select cast('today' as date) t, "
+                  "cast('tomorrow' as date) tm").collect().to_pylist()[0]
+    assert (row["tm"] - row["t"]).days == 1
+    row = new.sql("select cast('epoch' as timestamp) e, "
+                  "cast('today' as date) t").collect().to_pylist()[0]
+    assert row["e"] is None and row["t"] is None
+    # typed literals: every generation
+    for s in (old, new):
+        row = s.sql("select timestamp 'epoch' e").collect().to_pylist()[0]
+        assert row["e"] == datetime.datetime(1970, 1, 1,
+                                             tzinfo=datetime.timezone.utc)
+
+
+def test_lenient_timestamp_cast_pins_to_host():
+    """3.0/3.1 generations tag string→timestamp casts of column data off
+    the device (the ANSI-subset device parser must not serve lenient
+    semantics it does not implement)."""
+    import spark_rapids_tpu.functions as F
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.plan.overrides import explain_plan
+    from spark_rapids_tpu.session import TpuSession
+    t = pa.table({"s": pa.array(["2021-01-05 10:00:00", "2021-1-5"])})
+    old = TpuSession({CFG.SPARK_VERSION.key: "3.1.2"})
+    df_old = old.create_dataframe(t).select(
+        F.cast(F.col("s"), T.TIMESTAMP).alias("ts"))
+    assert "3.0-generation" in explain_plan(df_old._plan, old.conf)
+    new = TpuSession({CFG.SPARK_VERSION.key: "3.5.0"})
+    df_new = new.create_dataframe(t).select(
+        F.cast(F.col("s"), T.TIMESTAMP).alias("ts"))
+    assert "3.0-generation" not in explain_plan(df_new._plan, new.conf)
+    assert df_old.collect().num_rows == 2
+    assert df_new.collect().num_rows == 2
