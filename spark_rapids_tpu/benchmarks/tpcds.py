@@ -138,6 +138,10 @@ def generate(sf: float, outdir: str, files_per_table: int = 4) -> dict:
             np.where(rng.random(n_promo) < 0.5, "N", "Y")),
         "p_channel_event": pa.array(
             np.where(rng.random(n_promo) < 0.5, "N", "Y")),
+        "p_channel_dmail": pa.array(
+            np.where(rng5.random(n_promo) < 0.5, "N", "Y")),
+        "p_channel_tv": pa.array(
+            np.where(rng5.random(n_promo) < 0.5, "N", "Y")),
     }), 1)
 
     # customer_address / store (zips overlap so q19's <> filter selects)
@@ -166,6 +170,8 @@ def generate(sf: float, outdir: str, files_per_table: int = 4) -> dict:
         "s_state": pa.array(states[rng.integers(0, len(states), n_store)]),
         "s_number_employees": pa.array(
             rng.integers(200, 300, n_store).astype(np.int32)),
+        "s_gmt_offset": pa.array(
+            rng5.choice([-5.0, -6.0, -7.0, -8.0], n_store)),
     }), 1)
 
     # customer
@@ -262,6 +268,8 @@ def generate(sf: float, outdir: str, files_per_table: int = 4) -> dict:
             f"{prefix}_quantity": pa.array(
                 rng5.integers(1, 100, n_rows).astype(np.int32)),
             f"{prefix}_list_price": pa.array(
+                np.round(rng5.uniform(1.0, 200.0, n_rows), 2)),
+            f"{prefix}_sales_price": pa.array(
                 np.round(rng5.uniform(1.0, 200.0, n_rows), 2)),
         })
 
@@ -1852,3 +1860,113 @@ def np_q14(tb):
     rows = [k + (v[0], v[1]) for k, v in agg.items()]
     rows.sort(key=lambda r: tuple((x is not None, x) for x in r[:4]))
     return rows[:100]
+
+
+_Q15_ZIPS = {"10005", "10010", "10020", "10035", "10040", "10055", "10070",
+             "10085", "10090"}
+
+
+def np_q15(tb):
+    """Official q15: catalog sales by customer zip — zip-list OR state OR
+    high-price disjunction, Q2/2001."""
+    cu, ca, cs = tb["customer"], tb["customer_address"], tb["catalog_sales"]
+    azip = dict(zip(ca["ca_address_sk"], ca["ca_zip"]))
+    astate = dict(zip(ca["ca_address_sk"], ca["ca_state"]))
+    caddr = dict(zip(cu["c_customer_sk"], cu["c_current_addr_sk"]))
+    ok_d = _d(tb, d_qoy=lambda q: q == 2, d_year=lambda y: y == 2001)
+    sums = {}
+    for dk, ck, p in zip(cs["cs_sold_date_sk"], cs["cs_bill_customer_sk"],
+                         cs["cs_sales_price"]):
+        if dk not in ok_d:
+            continue
+        a = caddr[ck]
+        z, st = azip[a], astate[a]
+        if z in _Q15_ZIPS or st in ("CA", "WA", "GA") or p > 150:
+            sums[z] = sums.get(z, 0.0) + p
+    return [(z, sums[z]) for z in sorted(sums)][:100]
+
+
+def np_q45(tb):
+    """Official q45: web sales by (zip, city) — zip-list OR item-id-subquery
+    disjunction, Q2/2001."""
+    cu, ca, ws, it = (tb["customer"], tb["customer_address"],
+                      tb["web_sales"], tb["item"])
+    azip = dict(zip(ca["ca_address_sk"], ca["ca_zip"]))
+    acity = dict(zip(ca["ca_address_sk"], ca["ca_city"]))
+    caddr = dict(zip(cu["c_customer_sk"], cu["c_current_addr_sk"]))
+    iid = dict(zip(it["i_item_sk"], it["i_item_id"]))
+    want_ids = {iid[k] for k in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29)
+                if k in iid}
+    ok_d = _d(tb, d_qoy=lambda q: q == 2, d_year=lambda y: y == 2001)
+    sums = {}
+    for dk, ck, ik, p in zip(ws["ws_sold_date_sk"],
+                             ws["ws_bill_customer_sk"], ws["ws_item_sk"],
+                             ws["ws_sales_price"]):
+        if dk not in ok_d:
+            continue
+        a = caddr[ck]
+        z = azip[a]
+        if z in _Q15_ZIPS or iid[ik] in want_ids:
+            key = (z, acity[a])
+            sums[key] = sums.get(key, 0.0) + p
+    return [k + (sums[k],) for k in sorted(sums)][:100]
+
+
+def np_q61(tb):
+    """Official q61: promoted vs total Books revenue at gmt -6, Nov 2000;
+    output (promotions, total, 100*promotions/total as decimal)."""
+    from decimal import Decimal, ROUND_HALF_UP
+    ss, st, pr, cu, ca, it = (tb["store_sales"], tb["store"],
+                              tb["promotion"], tb["customer"],
+                              tb["customer_address"], tb["item"])
+    ok_d = _d(tb, d_year=lambda y: y == 2000, d_moy=lambda m: m == 11)
+    ok_s = set(st["s_store_sk"][st["s_gmt_offset"] == -6.0])
+    ok_ca = set(ca["ca_address_sk"][ca["ca_gmt_offset"] == -6.0])
+    ok_i = set(it["i_item_sk"][it["i_category"] == "Books"])
+    ok_p = set(pr["p_promo_sk"][(pr["p_channel_dmail"] == "Y")
+                                | (pr["p_channel_email"] == "Y")
+                                | (pr["p_channel_tv"] == "Y")])
+    caddr = dict(zip(cu["c_customer_sk"], cu["c_current_addr_sk"]))
+    promo = total = 0.0
+    for dk, sk, pk, ck, ik, v in zip(
+            ss["ss_sold_date_sk"], ss["ss_store_sk"], ss["ss_promo_sk"],
+            ss["ss_customer_sk"], ss["ss_item_sk"],
+            ss["ss_ext_sales_price"]):
+        if dk not in ok_d or sk not in ok_s or ik not in ok_i \
+                or caddr[ck] not in ok_ca:
+            continue
+        total += v
+        if pk in ok_p:
+            promo += v
+    # cast(double as decimal(15,4)) twice, then (15,4)/(15,4) -> the
+    # engine's DECIMAL64-adjusted (18,6) HALF_UP division, then *100 at
+    # the same scale (docs/compatibility.md decimal arithmetic rules);
+    # Spark: sum over an empty relation is NULL
+    if total == 0.0:
+        return [(None, None, None)]
+    li = int(Decimal(repr(float(promo))).scaleb(4)
+             .to_integral_value(ROUND_HALF_UP))
+    ri = int(Decimal(repr(float(total))).scaleb(4)
+             .to_integral_value(ROUND_HALF_UP))
+    import math as _m
+    q = float(li) / float(ri) * 1e6
+    vals = int(_m.floor(q + 0.5) if q >= 0 else _m.ceil(q - 0.5))
+    ratio = Decimal(vals * 100).scaleb(-6)
+    return [(float(promo), float(total), ratio)]
+
+
+def np_q97(tb):
+    """Official q97: distinct (customer, item) pairs per channel over the
+    month window; full-outer overlap counts."""
+    lo, hi = 1200, 1211
+    dd = tb["date_dim"]
+    ok_d = set(dd["d_date_sk"][(dd["d_month_seq"] >= lo)
+                               & (dd["d_month_seq"] <= hi)])
+    ss, cs = tb["store_sales"], tb["catalog_sales"]
+    s = {(c, i) for d, c, i in zip(ss["ss_sold_date_sk"],
+                                   ss["ss_customer_sk"], ss["ss_item_sk"])
+         if d in ok_d}
+    c = {(cc, i) for d, cc, i in zip(cs["cs_sold_date_sk"],
+                                     cs["cs_bill_customer_sk"],
+                                     cs["cs_item_sk"]) if d in ok_d}
+    return [(len(s - c), len(c - s), len(s & c))]

@@ -44,6 +44,51 @@ def promote(a: T.DataType, b: T.DataType) -> T.DataType:
 
 IntegralTypeTuple = (T.ByteType, T.ShortType, T.IntegerType, T.LongType)
 
+# -- decimal multiply/divide typing (Spark DecimalPrecision, capped to the
+# -- engine's DECIMAL64 bound of 18; reference GpuMultiply/GpuDivide) --------
+
+_INT_DIGITS = {T.ByteType: 3, T.ShortType: 5, T.IntegerType: 10,
+               T.LongType: 18}
+
+
+def _as_dec(t: T.DataType) -> T.DecimalType | None:
+    if isinstance(t, T.DecimalType):
+        return t
+    d = _INT_DIGITS.get(type(t))
+    return T.DecimalType(d, 0) if d is not None else None
+
+
+def _dec_adjust(p: int, s: int) -> T.DecimalType:
+    """Spark adjustPrecisionScale with MAX_PRECISION=18 (DECIMAL64): when
+    the ideal precision overflows, keep the integral digits and at least
+    min(scale, 6) fractional digits."""
+    if p > 18:
+        s = max(18 - (p - s), min(s, 6))
+        p = 18
+    return T.DecimalType(p, max(s, 0))
+
+
+def decimal_mul_type(lt, rt):
+    """Result type for decimal multiply, or None when not a decimal op."""
+    if not (isinstance(lt, T.DecimalType) or isinstance(rt, T.DecimalType)):
+        return None
+    d1, d2 = _as_dec(lt), _as_dec(rt)
+    if d1 is None or d2 is None:        # decimal × fractional → double
+        return None
+    return _dec_adjust(d1.precision + d2.precision + 1, d1.scale + d2.scale)
+
+
+def decimal_div_type(lt, rt):
+    """Result type for decimal divide, or None when not a decimal op."""
+    if not (isinstance(lt, T.DecimalType) or isinstance(rt, T.DecimalType)):
+        return None
+    d1, d2 = _as_dec(lt), _as_dec(rt)
+    if d1 is None or d2 is None:
+        return None
+    s = max(6, d1.scale + d2.precision + 1)
+    p = d1.precision - d1.scale + d2.scale + s
+    return _dec_adjust(p, s)
+
 
 def _cast_col(c: Col, to: T.DataType) -> Col:
     if c.dtype == to:
@@ -102,8 +147,53 @@ class Subtract(BinaryArithmetic):
         return lv - rv
 
 
+def _round_half_up_i64(q):
+    """HALF_UP (away from zero) f64 → int64."""
+    return jnp.where(q >= 0, jnp.floor(q + 0.5),
+                     jnp.ceil(q - 0.5)).astype(jnp.int64)
+
+
 class Multiply(BinaryArithmetic):
     symbol = "*"
+
+    @property
+    def dtype(self):
+        dt = decimal_mul_type(self.left.dtype, self.right.dtype)
+        return dt if dt is not None else promote(self.left.dtype,
+                                                 self.right.dtype)
+
+    def eval(self, ctx):
+        out_t = self.dtype
+        if not isinstance(out_t, T.DecimalType):
+            return super().eval(ctx)
+        # decimal multiply at Spark's result scale: unscaled product lives
+        # at scale s1+s2, HALF_UP-rescaled to the adjusted result scale.
+        # Exact int64 when the ideal precision fits DECIMAL64; float64
+        # otherwise (~15 significant digits, docs/compatibility.md).
+        l, r = self.left.eval(ctx), self.right.eval(ctx)
+        d1, d2 = _as_dec(self.left.dtype), _as_dec(self.right.dtype)
+        lv = l.values.astype(jnp.int64)
+        rv = r.values.astype(jnp.int64)
+        drop = d1.scale + d2.scale - out_t.scale
+        if d1.precision + d2.precision + 1 <= 18:
+            prod = lv * rv
+            if drop:
+                div = 10 ** drop
+                a = jnp.abs(prod)
+                q = (a + div // 2) // div
+                prod = jnp.where(prod < 0, -q, q)
+            vals = prod
+            ok = jnp.abs(vals) < 10 ** out_t.precision   # overflow → null
+        else:
+            qf = (lv.astype(jnp.float64) * rv.astype(jnp.float64)
+                  / (10.0 ** drop))
+            # overflow check in the FLOAT domain: an out-of-int64-range
+            # cast saturates to INT64_MIN whose abs is itself negative,
+            # which would sail through an int-domain check
+            ok = jnp.abs(qf) < float(10 ** out_t.precision)
+            vals = _round_half_up_i64(jnp.where(ok, qf, 0.0))
+        validity = valid_and(l.validity, r.validity) & ok
+        return Col(vals, validity, out_t).canonicalized()
 
     def op(self, lv, rv):
         return lv * rv
@@ -116,22 +206,37 @@ class Divide(BinaryArithmetic):
 
     @property
     def dtype(self):
-        base = promote(self.left.dtype, self.right.dtype)
-        if isinstance(base, T.DecimalType):
-            return base
+        dt = decimal_div_type(self.left.dtype, self.right.dtype)
+        if dt is not None:
+            return dt
         return T.DOUBLE
 
     def eval(self, ctx):
         out_t = self.dtype
+        if isinstance(out_t, T.DecimalType):
+            # decimal divide, HALF_UP at Spark's (DECIMAL64-adjusted)
+            # result scale via float64 (~15 significant digits,
+            # docs/compatibility.md); NULL on zero divisor and overflow
+            l, r = self.left.eval(ctx), self.right.eval(ctx)
+            d1, d2 = _as_dec(self.left.dtype), _as_dec(self.right.dtype)
+            lv = l.values.astype(jnp.int64)
+            rv = r.values.astype(jnp.int64)
+            zero = rv == 0
+            k = out_t.scale + d2.scale - d1.scale
+            q = (lv.astype(jnp.float64)
+                 / jnp.where(zero, 1, rv).astype(jnp.float64)
+                 * (10.0 ** k))
+            # overflow check in the FLOAT domain (see Multiply)
+            ok = jnp.abs(q) < float(10 ** out_t.precision)
+            vals = _round_half_up_i64(jnp.where(ok, q, 0.0))
+            validity = valid_and(l.validity, r.validity) & ~zero & ok
+            return Col(vals, validity, out_t).canonicalized()
         l = _cast_col(self.left.eval(ctx), out_t)
         r = _cast_col(self.right.eval(ctx), out_t)
         zero = r.values == 0
         validity = valid_and(l.validity, r.validity) & ~zero
         safe_r = jnp.where(zero, jnp.ones_like(r.values), r.values)
-        if isinstance(out_t, T.DecimalType):
-            vals = l.values // safe_r  # simplified decimal division (scale 0 result)
-        else:
-            vals = l.values / safe_r
+        vals = l.values / safe_r
         return Col(vals, validity, out_t).canonicalized()
 
 

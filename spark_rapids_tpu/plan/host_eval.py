@@ -155,17 +155,78 @@ def _num(expr, x, y, op):
     dt = expr.dtype
     if isinstance(dt, T.IntegralType):
         return _wrap_int(dt, op(int(x), int(y)))
+    if isinstance(dt, T.DecimalType):
+        # add/subtract: rescale both unscaled ints to the (max) result
+        # scale, then the integer op is exact — same as the device's
+        # cast-to-promoted-then-add. Multiply has its own column fn (_mul).
+        from spark_rapids_tpu.expr.arithmetic import _as_dec
+        d1 = _as_dec(expr.left.dtype)
+        d2 = _as_dec(expr.right.dtype)
+        return op(int(x) * 10 ** (dt.scale - d1.scale),
+                  int(y) * 10 ** (dt.scale - d2.scale))
     r = op(float(x), float(y))
     if isinstance(dt, T.FloatType):
         r = float(np.float32(r))
     return r
 
 
+def _rhu(q: float):
+    return int(math.floor(q + 0.5) if q >= 0 else math.ceil(q - 0.5))
+
+
+def _mul(expr, kids, n):
+    """Multiply; the decimal path mirrors the device (arithmetic.Multiply):
+    same exact-int64 / float64 split, HALF_UP rescale, overflow → null.
+    Host decimal columns carry UNSCALED ints (same as the device)."""
+    a, b = kids
+    dt = expr.dtype
+    if not isinstance(dt, T.DecimalType):
+        return _binary(lambda e, x, y: _num(e, x, y,
+                                            lambda p, q: p * q))(expr, kids,
+                                                                 n)
+    from spark_rapids_tpu.expr.arithmetic import _as_dec
+    d1 = _as_dec(expr.left.dtype)
+    d2 = _as_dec(expr.right.dtype)
+    drop = d1.scale + d2.scale - dt.scale
+    exact = d1.precision + d2.precision + 1 <= 18
+    div = 10 ** drop
+    bound = 10 ** dt.precision
+    out = []
+    for x, y in zip(a.data, b.data):
+        if x is None or y is None:
+            out.append(None)
+            continue
+        if exact:
+            prod = int(x) * int(y)
+            if drop:
+                q = (abs(prod) + div // 2) // div
+                prod = -q if prod < 0 else q
+        else:
+            prod = _rhu(float(int(x)) * float(int(y)) / (10.0 ** drop))
+        out.append(None if abs(prod) >= bound else prod)
+    return HostCol(out, dt)
+
+
 # ---- arithmetic ------------------------------------------------------------
 
 def _div(expr, kids, n):
     a, b = kids
+    dt = expr.dtype
     out = []
+    if isinstance(dt, T.DecimalType):
+        # mirror of the device decimal divide (same float64 rounding);
+        # host decimal columns carry unscaled ints
+        from spark_rapids_tpu.expr.arithmetic import _as_dec
+        d1 = _as_dec(expr.left.dtype)
+        d2 = _as_dec(expr.right.dtype)
+        k = dt.scale + d2.scale - d1.scale
+        for x, y in zip(a.data, b.data):
+            if x is None or y is None or y == 0:
+                out.append(None)
+                continue
+            vals = _rhu(float(int(x)) / float(int(y)) * (10.0 ** k))
+            out.append(None if abs(vals) >= 10 ** dt.precision else vals)
+        return HostCol(out, dt)
     for x, y in zip(a.data, b.data):
         if x is None or y is None or y == 0:
             out.append(None)  # Spark: divide by zero → null
@@ -540,7 +601,7 @@ def _spark_double_str(d, is_float):
 _DISPATCH = {
     A.Add: _binary(lambda e, x, y: _num(e, x, y, lambda a, b: a + b)),
     A.Subtract: _binary(lambda e, x, y: _num(e, x, y, lambda a, b: a - b)),
-    A.Multiply: _binary(lambda e, x, y: _num(e, x, y, lambda a, b: a * b)),
+    A.Multiply: _mul,
     A.Divide: _div,
     A.IntegralDivide: _intdiv,
     A.Remainder: _rem,

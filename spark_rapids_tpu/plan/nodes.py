@@ -73,7 +73,13 @@ def _project_table(tbl: pa.Table, exprs, out_schema: T.StructType) -> pa.Table:
     cols = []
     for e, f in zip(exprs, out_schema):
         hc = eval_host(e, tbl)
-        cols.append(pa.array(hc.data, T.to_arrow_type(f.data_type)))
+        if isinstance(f.data_type, T.DecimalType):
+            # HostCol decimals carry UNSCALED ints — to_arrow applies the
+            # boundary conversion (a raw pa.array would misread the scale)
+            cols.append(HostCol(hc.data, f.data_type).to_arrow())
+        else:
+            # out-schema type coercion (host literals default to wide ints)
+            cols.append(pa.array(hc.data, T.to_arrow_type(f.data_type)))
     # from_arrays, not a dict: duplicate output names must survive
     return pa.Table.from_arrays(list(cols), names=[f.name for f in out_schema])
 

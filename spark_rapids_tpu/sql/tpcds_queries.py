@@ -15,9 +15,12 @@ front-end:
    ties; the oracle comparison does not).
 
 Structure — join shape, derived tables, CASE/BETWEEN/IN/HAVING, windows,
-ROLLUP — is the official text. q27 here is the FULL official rollup form
-(the hand-built adaptation omits the rollup levels; SQL is the more complete
-surface).
+ROLLUP, set operations (q8/q14/q38/q87), IN-subqueries (q14/q45), FULL
+OUTER JOIN (q97) — is the official text. q27 here is the FULL official
+rollup form (the hand-built adaptation omits the rollup levels; SQL is the
+more complete surface). Zip-list parameters substitute values from the
+generated 10000-10099 domain and magnitude thresholds scale to the subset's
+value ranges (rule 1); both are flagged inline.
 """
 
 SQL_QUERIES = {}
@@ -812,5 +815,108 @@ from(
  ) y
 group by rollup (channel, i_brand_id, i_class_id, i_category_id)
 order by channel,i_brand_id,i_class_id,i_category_id
+limit 100
+"""
+
+SQL_QUERIES["q15"] = """
+select ca_zip, sum(cs_sales_price)
+from catalog_sales, customer, customer_address, date_dim
+where cs_bill_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and (substr(ca_zip,1,5) in ('10005','10010','10020','10035','10040',
+                              '10055','10070','10085','10090')
+       or ca_state in ('CA','WA','GA')
+       or cs_sales_price > 150)
+  and cs_sold_date_sk = d_date_sk
+  and d_qoy = 2 and d_year = 2001
+group by ca_zip
+order by ca_zip
+limit 100
+"""
+
+SQL_QUERIES["q45"] = """
+select ca_zip, ca_city, sum(ws_sales_price)
+from web_sales, customer, customer_address, date_dim, item
+where ws_bill_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and ws_item_sk = i_item_sk
+  and (substr(ca_zip,1,5) in ('10005','10010','10020','10035','10040',
+                              '10055','10070','10085','10090')
+       or
+       i_item_id in (select i_item_id
+                     from item
+                     where i_item_sk in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29)
+                    )
+      )
+  and ws_sold_date_sk = d_date_sk
+  and d_qoy = 2 and d_year = 2001
+group by ca_zip, ca_city
+order by ca_zip, ca_city
+limit 100
+"""
+
+SQL_QUERIES["q61"] = """
+select promotions, total,
+       cast(promotions as decimal(15,4))/cast(total as decimal(15,4))*100
+from
+  (select sum(ss_ext_sales_price) promotions
+   from store_sales, store, promotion, date_dim, customer,
+        customer_address, item
+   where ss_sold_date_sk = d_date_sk
+     and ss_store_sk = s_store_sk
+     and ss_promo_sk = p_promo_sk
+     and ss_customer_sk = c_customer_sk
+     and ca_address_sk = c_current_addr_sk
+     and ss_item_sk = i_item_sk
+     and ca_gmt_offset = -6
+     and i_category = 'Books'
+     and (p_channel_dmail = 'Y' or p_channel_email = 'Y'
+          or p_channel_tv = 'Y')
+     and s_gmt_offset = -6
+     and d_year = 2000
+     and d_moy = 11) promotional_sales,
+  (select sum(ss_ext_sales_price) total
+   from store_sales, store, date_dim, customer, customer_address, item
+   where ss_sold_date_sk = d_date_sk
+     and ss_store_sk = s_store_sk
+     and ss_customer_sk = c_customer_sk
+     and ca_address_sk = c_current_addr_sk
+     and ss_item_sk = i_item_sk
+     and ca_gmt_offset = -6
+     and i_category = 'Books'
+     and s_gmt_offset = -6
+     and d_year = 2000
+     and d_moy = 11) all_sales
+order by promotions, total
+limit 100
+"""
+
+SQL_QUERIES["q97"] = """
+with ssci as (
+select ss_customer_sk customer_sk
+      ,ss_item_sk item_sk
+from store_sales,date_dim
+where ss_sold_date_sk = d_date_sk
+  and d_month_seq between 1200 and 1200 + 11
+group by ss_customer_sk
+        ,ss_item_sk),
+csci as(
+ select cs_bill_customer_sk customer_sk
+      ,cs_item_sk item_sk
+from catalog_sales,date_dim
+where cs_sold_date_sk = d_date_sk
+  and d_month_seq between 1200 and 1200 + 11
+group by cs_bill_customer_sk
+        ,cs_item_sk)
+select sum(case when ssci.customer_sk is not null
+                 and csci.customer_sk is null then 1 else 0 end) store_only
+      ,sum(case when ssci.customer_sk is null
+                 and csci.customer_sk is not null then 1 else 0 end)
+           catalog_only
+      ,sum(case when ssci.customer_sk is not null
+                 and csci.customer_sk is not null then 1 else 0 end)
+           store_and_catalog
+from ssci full outer join csci on (ssci.customer_sk = csci.customer_sk
+                               and ssci.item_sk = csci.item_sk)
 limit 100
 """

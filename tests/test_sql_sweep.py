@@ -309,3 +309,28 @@ def test_in_subquery_semi_join_and_widening():
         "(with w as (select 1 x) select x from w) union all select 2 x "
         "order by x").collect().to_pylist()
     assert got == [{"x": 1}, {"x": 2}]
+
+
+@pytest.mark.parametrize("query,want", [
+    ("select cast(1.5 as decimal(5,2)) * cast(2.0 as decimal(5,2)) v",
+     "3.0000"),
+    ("select cast(7.5 as decimal(5,2)) * 3 v", "22.50"),
+    ("select cast(1 as decimal(5,2)) / cast(3 as decimal(5,2)) v",
+     "0.33333333"),
+    ("select cast(-1 as decimal(5,2)) / cast(3 as decimal(5,2)) v",
+     "-0.33333333"),
+    ("select cast(1 as decimal(5,2)) / 0 v", "None"),
+    # DECIMAL64-adjusted scale: (15,4)/(15,4) -> (18,6)
+    ("select cast(84927.35 as decimal(15,4)) / "
+     "cast(87665.52 as decimal(15,4)) v", "0.968766"),
+])
+def test_decimal_multiply_divide(query, want):
+    """Spark DecimalPrecision rules capped to DECIMAL64 (q61's shape;
+    docs/compatibility.md) — device == host, HALF_UP at the result scale.
+    Regression: multiply used the max-scale promote (1.5*2.0 gave 300.00)
+    and divide floor-divided unscaled ints (anything/larger gave 0)."""
+    spark = TpuSession()
+    df = spark.sql(query)
+    dev = df.collect().to_pylist()
+    assert dev == df.collect_host().to_pylist()
+    assert str(list(dev[0].values())[0]) == want

@@ -41,6 +41,13 @@ _SQL_ONLY = {
     # q14: cross-channel INTERSECT + IN-subquery + iceberg HAVING + 4-col
     # rollup; sum_sales is float
     "q14": (tpcds.np_q14, {4}),
+    # round-5 breadth: catalog/web-channel queries
+    "q15": (tpcds.np_q15, {1}),
+    "q45": (tpcds.np_q45, {2}),
+    # q61: two scalar-aggregate derived tables cross-joined; decimal ratio
+    "q61": (tpcds.np_q61, {0, 1, 2}),
+    # q97: full-outer overlap of per-channel distinct (customer, item)
+    "q97": (tpcds.np_q97, set()),
 }
 
 
