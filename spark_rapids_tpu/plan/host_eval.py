@@ -164,10 +164,17 @@ def _num(expr, x, y, op):
         d2 = _as_dec(expr.right.dtype)
         return op(int(x) * 10 ** (dt.scale - d1.scale),
                   int(y) * 10 ** (dt.scale - d2.scale))
-    r = op(float(x), float(y))
+    r = op(_fval(x, expr.left.dtype), _fval(y, expr.right.dtype))
     if isinstance(dt, T.FloatType):
         r = float(np.float32(r))
     return r
+
+
+def _fval(v, dt) -> float:
+    """Numeric value as float — decimal host cols carry UNSCALED ints."""
+    if isinstance(dt, T.DecimalType):
+        return float(int(v)) / (10.0 ** dt.scale)
+    return float(v)
 
 
 def _rhu(q: float):
@@ -231,7 +238,8 @@ def _div(expr, kids, n):
         if x is None or y is None or y == 0:
             out.append(None)  # Spark: divide by zero → null
         else:
-            out.append(float(x) / float(y))
+            out.append(_fval(x, expr.left.dtype)
+                       / _fval(y, expr.right.dtype))
     return HostCol(out, expr.dtype)
 
 

@@ -323,6 +323,13 @@ def test_in_subquery_semi_join_and_widening():
     # DECIMAL64-adjusted scale: (15,4)/(15,4) -> (18,6)
     ("select cast(84927.35 as decimal(15,4)) / "
      "cast(87665.52 as decimal(15,4)) v", "0.968766"),
+    # mixed decimal/double rides double (host must read VALUES, not the
+    # unscaled ints its decimal columns carry)
+    ("select cast(1.50 as decimal(5,2)) + 0.25 v", "1.75"),
+    ("select cast(7.50 as decimal(5,2)) / 2.0 v", "3.75"),
+    # float64-path overflow -> null (not an INT64_MIN artifact)
+    ("select cast(-999999999999999999 as decimal(18,0)) * "
+     "cast(999999999999999999 as decimal(18,0)) v", "None"),
 ])
 def test_decimal_multiply_divide(query, want):
     """Spark DecimalPrecision rules capped to DECIMAL64 (q61's shape;
