@@ -117,6 +117,9 @@ def generate(sf: float, outdir: str, files_per_table: int = 4) -> dict:
             rng.integers(1, 140, n_item).astype(np.int32)),
         "i_manager_id": pa.array(
             rng.integers(1, 100, n_item).astype(np.int32)),
+        "i_color": pa.array(np.array(
+            ["slate", "blanched", "burnished", "floral", "honeydew",
+             "salmon", "powder", "peru"])[rng5.integers(0, 8, n_item)]),
     }), 1)
 
     # customer_demographics: full cross of the filter dimensions
@@ -271,6 +274,10 @@ def generate(sf: float, outdir: str, files_per_table: int = 4) -> dict:
                 np.round(rng5.uniform(1.0, 200.0, n_rows), 2)),
             f"{prefix}_sales_price": pa.array(
                 np.round(rng5.uniform(1.0, 200.0, n_rows), 2)),
+            f"{prefix}_ext_sales_price": pa.array(
+                np.round(rng5.uniform(1.0, 20000.0, n_rows), 2)),
+            f"{prefix}_bill_addr_sk": pa.array(
+                rng5.integers(1, n_addr + 1, n_rows).astype(np.int64)),
         })
 
     write("catalog_sales", channel("cs", max(n_ss // 2, 10)))
@@ -1970,3 +1977,44 @@ def np_q97(tb):
                                      cs["cs_bill_customer_sk"],
                                      cs["cs_item_sk"]) if d in ok_d}
     return [(len(s - c), len(c - s), len(s & c))]
+
+
+def _np_three_channel(tb, key_col, key_filter_col, key_filter_vals,
+                      year, moy):
+    """q33/q56 skeleton: per-channel sums by an item attribute, restricted
+    to items whose `key_filter_col` is in `key_filter_vals` and buyers at
+    gmt -5, summed across channels."""
+    it, ca = tb["item"], tb["customer_address"]
+    keep_keys = {k for k, v in zip(it[key_col], it[key_filter_col])
+                 if v in key_filter_vals}
+    attr = {sk: k for sk, k in zip(it["i_item_sk"], it[key_col])}
+    ok_ca = set(ca["ca_address_sk"][ca["ca_gmt_offset"] == -5.0])
+    ok_d = _d(tb, d_year=lambda y_: y_ == year, d_moy=lambda m: m == moy)
+    chans = [("store_sales", "ss_sold_date_sk", "ss_item_sk", "ss_addr_sk",
+              "ss_ext_sales_price"),
+             ("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+              "cs_bill_addr_sk", "cs_ext_sales_price"),
+             ("web_sales", "ws_sold_date_sk", "ws_item_sk",
+              "ws_bill_addr_sk", "ws_ext_sales_price")]
+    sums = {}
+    for t, dcol, icol, acol, vcol in chans:
+        f = tb[t]
+        for dk, ik, ak, v in zip(f[dcol], f[icol], f[acol], f[vcol]):
+            k = attr[ik]
+            if dk in ok_d and ak in ok_ca and k in keep_keys:
+                sums[k] = sums.get(k, 0.0) + v
+    rows = sorted(((k, s) for k, s in sums.items()),
+                  key=lambda r: (r[1], r[0]))
+    return rows[:100]
+
+
+def np_q33(tb):
+    """Official q33: Electronics manufacturers across the three channels."""
+    return _np_three_channel(tb, "i_manufact_id", "i_category",
+                             {"Electronics"}, 1998, 5)
+
+
+def np_q56(tb):
+    """Official q56: slate/blanched/burnished item ids across channels."""
+    return _np_three_channel(tb, "i_item_id", "i_color",
+                             {"slate", "blanched", "burnished"}, 2001, 2)

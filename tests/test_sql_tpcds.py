@@ -48,6 +48,10 @@ _SQL_ONLY = {
     "q61": (tpcds.np_q61, {0, 1, 2}),
     # q97: full-outer overlap of per-channel distinct (customer, item)
     "q97": (tpcds.np_q97, set()),
+    # q33/q56: three-channel UNION ALL sums by an item attribute, with an
+    # uncorrelated IN-subquery item filter; total_sales is float
+    "q33": (tpcds.np_q33, {1}),
+    "q56": (tpcds.np_q56, {1}),
 }
 
 
