@@ -1219,28 +1219,10 @@ def np_q89(tb):
 
 
 def np_q98(tb):
-    it = tb["item"]
-    ok = np.isin(it["i_category"], ["Sports", "Books", "Home"])
-    info = {k: (iid, d, cat, cl, float(p)) for k, iid, d, cat, cl, p, o in
-            zip(it["i_item_sk"], it["i_item_id"], it["i_item_desc"],
-                it["i_category"], it["i_class"], it["i_current_price"], ok)
-            if o}
-    ok_d = _d(tb, d_year=lambda y: y == 1999, d_moy=lambda m: m == 2)
-    ss = tb["store_sales"]
-    groups = {}
-    for ddk, ik, p in zip(ss["ss_sold_date_sk"], ss["ss_item_sk"],
-                          ss["ss_ext_sales_price"]):
-        inf = info.get(ik)
-        if ddk not in ok_d or inf is None:
-            continue
-        groups[inf] = groups.get(inf, 0.0) + p
-    cls_total = {}
-    for key, s in groups.items():
-        cls_total[key[3]] = cls_total.get(key[3], 0.0) + s
-    rows = [key + (s, s * 100.0 / cls_total[key[3]])
-            for key, s in groups.items()]
-    return _lex_top(rows, [2, 3, 0, 1, 6],
-                    [True, True, True, True, True], len(rows))
+    """q98 = the revenue-ratio skeleton over store_sales, no LIMIT."""
+    rows = _np_revenue_ratio(tb, "store_sales", "ss_sold_date_sk",
+                             "ss_item_sk", "ss_ext_sales_price", None)
+    return rows
 
 
 def np_q43(tb):
@@ -2018,3 +2000,40 @@ def np_q56(tb):
     """Official q56: slate/blanched/burnished item ids across channels."""
     return _np_three_channel(tb, "i_item_id", "i_color",
                              {"slate", "blanched", "burnished"}, 2001, 2)
+
+
+def _np_revenue_ratio(tb, fact, dcol, icol, vcol, limit):
+    """q98/q12/q20 skeleton: item revenue + class-partition revenue ratio."""
+    it = tb["item"]
+    ok = np.isin(it["i_category"], ["Sports", "Books", "Home"])
+    info = {k: (iid, d, cat, cl, float(p)) for k, iid, d, cat, cl, p, o in
+            zip(it["i_item_sk"], it["i_item_id"], it["i_item_desc"],
+                it["i_category"], it["i_class"], it["i_current_price"], ok)
+            if o}
+    ok_d = _d(tb, d_year=lambda y: y == 1999, d_moy=lambda m: m == 2)
+    f = tb[fact]
+    groups = {}
+    for ddk, ik, p in zip(f[dcol], f[icol], f[vcol]):
+        inf = info.get(ik)
+        if ddk not in ok_d or inf is None:
+            continue
+        groups[inf] = groups.get(inf, 0.0) + p
+    cls_total = {}
+    for key, s in groups.items():
+        cls_total[key[3]] = cls_total.get(key[3], 0.0) + s
+    rows = [key + (s, s * 100.0 / cls_total[key[3]])
+            for key, s in groups.items()]
+    return _lex_top(rows, [2, 3, 0, 1, 6],
+                    [True, True, True, True, True], limit)
+
+
+def np_q12(tb):
+    """Official q12: q98's revenue-ratio shape over web_sales."""
+    return _np_revenue_ratio(tb, "web_sales", "ws_sold_date_sk",
+                             "ws_item_sk", "ws_ext_sales_price", 100)
+
+
+def np_q20(tb):
+    """Official q20: q98's revenue-ratio shape over catalog_sales."""
+    return _np_revenue_ratio(tb, "catalog_sales", "cs_sold_date_sk",
+                             "cs_item_sk", "cs_ext_sales_price", 100)

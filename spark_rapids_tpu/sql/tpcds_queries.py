@@ -1063,3 +1063,37 @@ where i_color in ('slate','blanched','burnished'))
  order by total_sales, i_item_id
 limit 100
 """
+
+SQL_QUERIES["q12"] = """
+select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       sum(ws_ext_sales_price) itemrevenue,
+       sum(ws_ext_sales_price) * 100.0
+         / sum(sum(ws_ext_sales_price)) over (partition by i_class)
+         revenueratio
+from web_sales, item, date_dim
+where ws_item_sk = i_item_sk
+  and i_category in ('Sports', 'Books', 'Home')
+  and ws_sold_date_sk = d_date_sk
+  and d_year = 1999
+  and d_moy = 2
+group by i_item_id, i_item_desc, i_category, i_class, i_current_price
+order by i_category, i_class, i_item_id, i_item_desc, revenueratio
+limit 100
+"""
+
+SQL_QUERIES["q20"] = """
+select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       sum(cs_ext_sales_price) itemrevenue,
+       sum(cs_ext_sales_price) * 100.0
+         / sum(sum(cs_ext_sales_price)) over (partition by i_class)
+         revenueratio
+from catalog_sales, item, date_dim
+where cs_item_sk = i_item_sk
+  and i_category in ('Sports', 'Books', 'Home')
+  and cs_sold_date_sk = d_date_sk
+  and d_year = 1999
+  and d_moy = 2
+group by i_item_id, i_item_desc, i_category, i_class, i_current_price
+order by i_category, i_class, i_item_id, i_item_desc, revenueratio
+limit 100
+"""

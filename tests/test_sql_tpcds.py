@@ -52,6 +52,9 @@ _SQL_ONLY = {
     # uncorrelated IN-subquery item filter; total_sales is float
     "q33": (tpcds.np_q33, {1}),
     "q56": (tpcds.np_q56, {1}),
+    # q12/q20: q98's class-partition revenue-ratio window over web/catalog
+    "q12": (tpcds.np_q12, {4, 5, 6}),
+    "q20": (tpcds.np_q20, {4, 5, 6}),
 }
 
 
