@@ -187,6 +187,37 @@ def child_main():
                 line["secondary"]["failed"] = failed
         except Exception as e:  # noqa: BLE001 — secondary must not kill primary
             line["secondary"] = {"error": repr(e)[:200]}
+        try:
+            # the official-SQL-text suite through session.sql() — the
+            # reference's qa_nightly_sql.py role, value-checked. Every
+            # query runs under its own try: one engine error records that
+            # query as failed without voiding the rest (or the DataFrame
+            # sweep above, which has its own handler).
+            from spark_rapids_tpu.sql.tpcds_queries import SQL_QUERIES
+            oracles = tpcds.sql_suite_oracles()
+            t0 = time.perf_counter()
+            n_ok, failed = 0, []
+            for qname in sorted(SQL_QUERIES, key=lambda q: int(q[1:])):
+                oracle, float_cols = oracles[qname]
+                try:
+                    got = [tuple(r.values())
+                           for r in spark.sql(SQL_QUERIES[qname])
+                           .collect().to_pylist()]
+                    tpcds.check_rows(got, [tuple(r) for r in oracle(dtb)],
+                                     float_cols)
+                    n_ok += 1
+                except Exception:  # noqa: BLE001
+                    failed.append(qname)
+            line["sql_suite"] = {
+                "metric": f"tpcds_sf{sf}_{len(SQL_QUERIES)}q_sql_sweep",
+                "queries_ok": n_ok, "queries_total": len(SQL_QUERIES),
+                "check": "value-equality",
+                "wall_s": round(time.perf_counter() - t0, 2),
+            }
+            if failed:
+                line["sql_suite"]["failed"] = failed
+        except Exception as e:  # noqa: BLE001
+            line["sql_suite"] = {"error": repr(e)[:200]}
     print(json.dumps(line))
 
 

@@ -278,6 +278,12 @@ def generate(sf: float, outdir: str, files_per_table: int = 4) -> dict:
                 np.round(rng5.uniform(1.0, 20000.0, n_rows), 2)),
             f"{prefix}_bill_addr_sk": pa.array(
                 rng5.integers(1, n_addr + 1, n_rows).astype(np.int64)),
+            f"{prefix}_bill_cdemo_sk": pa.array(
+                rng5.integers(1, n_cd + 1, n_rows).astype(np.int64)),
+            f"{prefix}_promo_sk": pa.array(
+                rng5.integers(1, n_promo + 1, n_rows).astype(np.int64)),
+            f"{prefix}_coupon_amt": pa.array(
+                np.round(rng5.uniform(0.0, 50.0, n_rows), 2)),
         })
 
     write("catalog_sales", channel("cs", max(n_ss // 2, 10)))
@@ -1043,7 +1049,10 @@ def np_q55(tb):
     return _lex_top(rows, [2, 0], [False, True], 100)
 
 
-def np_q7(tb):
+def _np_demo_promo(tb, fact, dcol, icol, cdcol, prcol, qcol, lpcol,
+                   cacol, spcol):
+    """q7/q26 skeleton: per-item averages for single/College males on
+    non-email-or-non-event promotions in year 2000."""
     cd = tb["customer_demographics"]
     cd_ok = set(cd["cd_demo_sk"][(cd["cd_gender"] == "M")
                                  & (cd["cd_marital_status"] == "S")
@@ -1051,16 +1060,14 @@ def np_q7(tb):
     pr = tb["promotion"]
     pr_ok = set(pr["p_promo_sk"][(pr["p_channel_email"] == "N")
                                  | (pr["p_channel_event"] == "N")])
-    dd = tb["date_dim"]
-    dd_ok = set(dd["d_date_sk"][dd["d_year"] == 2000])
+    dd_ok = _d(tb, d_year=lambda y: y == 2000)
     it = tb["item"]
-    item_id = {k: v for k, v in zip(it["i_item_sk"], it["i_item_id"])}
-    ss = tb["store_sales"]
+    item_id = dict(zip(it["i_item_sk"], it["i_item_id"]))
+    f = tb[fact]
     acc = {}
     for cdk, prk, ddk, ik, q, lp, ca, sp in zip(
-            ss["ss_cdemo_sk"], ss["ss_promo_sk"], ss["ss_sold_date_sk"],
-            ss["ss_item_sk"], ss["ss_quantity"], ss["ss_list_price"],
-            ss["ss_coupon_amt"], ss["ss_sales_price"]):
+            f[cdcol], f[prcol], f[dcol], f[icol], f[qcol], f[lpcol],
+            f[cacol], f[spcol]):
         if cdk in cd_ok and prk in pr_ok and ddk in dd_ok:
             a = acc.setdefault(item_id[ik], [0, 0.0, 0.0, 0.0, 0.0])
             a[0] += 1
@@ -1071,6 +1078,13 @@ def np_q7(tb):
     rows = [(iid, a[1] / a[0], a[2] / a[0], a[3] / a[0], a[4] / a[0])
             for iid, a in acc.items()]
     return _lex_top(rows, [0], [True], 100)
+
+
+def np_q7(tb):
+    return _np_demo_promo(tb, "store_sales", "ss_sold_date_sk",
+                          "ss_item_sk", "ss_cdemo_sk", "ss_promo_sk",
+                          "ss_quantity", "ss_list_price", "ss_coupon_amt",
+                          "ss_sales_price")
 
 
 def np_q19(tb):
@@ -2037,3 +2051,45 @@ def np_q20(tb):
     """Official q20: q98's revenue-ratio shape over catalog_sales."""
     return _np_revenue_ratio(tb, "catalog_sales", "cs_sold_date_sk",
                              "cs_item_sk", "cs_ext_sales_price", 100)
+
+
+def np_q26(tb):
+    """Official q26: q7's demographics/promotion shape over catalog_sales."""
+    return _np_demo_promo(tb, "catalog_sales", "cs_sold_date_sk",
+                          "cs_item_sk", "cs_bill_cdemo_sk", "cs_promo_sk",
+                          "cs_quantity", "cs_list_price", "cs_coupon_amt",
+                          "cs_sales_price")
+
+
+def sql_suite_oracles():
+    """{name: (oracle_fn, float_cols)} for every official SQL text in
+    sql/tpcds_queries.py — shared by tests/test_sql_tpcds.py and bench.py's
+    SQL-suite sweep (reference qa_nightly_sql.py role). Most queries reuse
+    the DataFrame suite's oracles; the SQL-only ones have their own."""
+    sql_only = {
+        "q13": (np_q13, {0, 1, 2, 3}),
+        "q36": (np_q36, {0}),
+        "q27": (np_q27_rollup, {3, 4, 5, 6}),
+        "q28": (np_q28, {0, 3, 6, 9, 12, 15}),
+        "q8": (np_q8, set()),
+        "q38": (np_q38, set()),
+        "q87": (np_q87, set()),
+        "q14": (np_q14, {4}),
+        "q15": (np_q15, {1}),
+        "q45": (np_q45, {2}),
+        "q61": (np_q61, {0, 1, 2}),
+        "q97": (np_q97, set()),
+        "q33": (np_q33, {1}),
+        "q56": (np_q56, {1}),
+        "q12": (np_q12, {4, 5, 6}),
+        "q20": (np_q20, {4, 5, 6}),
+        "q26": (np_q26, {1, 2, 3, 4}),
+    }
+    from spark_rapids_tpu.sql.tpcds_queries import SQL_QUERIES
+    out = {}
+    for name in SQL_QUERIES:
+        if name in sql_only:
+            out[name] = sql_only[name]
+        else:
+            out[name] = (NP_QUERIES[name], FLOAT_COLS[name])
+    return out

@@ -23,51 +23,18 @@ def _rows(df):
     return [tuple(r.values()) for r in df.collect().to_pylist()]
 
 
-# SQL-only queries (no DataFrame adaptation): oracle fn + float columns
-_SQL_ONLY = {
-    "q13": (tpcds.np_q13, {0, 1, 2, 3}),
-    "q36": (tpcds.np_q36, {0}),
-    # q27 runs the official rollup shape (the DataFrame adaptation omits
-    # the rollup levels); g_state shifts the float slots right by one
-    "q27": (tpcds.np_q27_rollup, {3, 4, 5, 6}),
-    # q28: six-bucket cross join; avgs at 0,3,6,9,12,15 (DISTINCT rewrite)
-    "q28": (tpcds.np_q28, {0, 3, 6, 9, 12, 15}),
-    # round-5 set-operation queries (INTERSECT/EXCEPT lowering):
-    # q8 nests an INTERSECT inside FROM (decimal profit sums are exact);
-    # q38/q87 intersect/subtract the three sales channels
-    "q8": (tpcds.np_q8, set()),
-    "q38": (tpcds.np_q38, set()),
-    "q87": (tpcds.np_q87, set()),
-    # q14: cross-channel INTERSECT + IN-subquery + iceberg HAVING + 4-col
-    # rollup; sum_sales is float
-    "q14": (tpcds.np_q14, {4}),
-    # round-5 breadth: catalog/web-channel queries
-    "q15": (tpcds.np_q15, {1}),
-    "q45": (tpcds.np_q45, {2}),
-    # q61: two scalar-aggregate derived tables cross-joined; decimal ratio
-    "q61": (tpcds.np_q61, {0, 1, 2}),
-    # q97: full-outer overlap of per-channel distinct (customer, item)
-    "q97": (tpcds.np_q97, set()),
-    # q33/q56: three-channel UNION ALL sums by an item attribute, with an
-    # uncorrelated IN-subquery item filter; total_sales is float
-    "q33": (tpcds.np_q33, {1}),
-    "q56": (tpcds.np_q56, {1}),
-    # q12/q20: q98's class-partition revenue-ratio window over web/catalog
-    "q12": (tpcds.np_q12, {4, 5, 6}),
-    "q20": (tpcds.np_q20, {4, 5, 6}),
-}
+# every official text maps to (oracle fn, float columns) — the SQL-only
+# queries (set ops, cross-channel, rollup forms) carry their own oracles;
+# the rest reuse the DataFrame suite's. Shared with bench.py's SQL sweep.
+_ORACLES = tpcds.sql_suite_oracles()
 
 
 @pytest.mark.parametrize("name", sorted(SQL_QUERIES, key=lambda q: int(q[1:])))
 def test_sql_query_matches_oracle(data, name):
     spark, tb = data
     got = _rows(spark.sql(SQL_QUERIES[name]))
-    if name in _SQL_ONLY:
-        oracle, float_cols = _SQL_ONLY[name]
-        exp = [tuple(r) for r in oracle(tb)]
-    else:
-        exp = [tuple(r) for r in tpcds.NP_QUERIES[name](tb)]
-        float_cols = tpcds.FLOAT_COLS[name]
+    oracle, float_cols = _ORACLES[name]
+    exp = [tuple(r) for r in oracle(tb)]
     assert exp, "vacuous test: oracle returned no rows"
     tpcds.check_rows(got, exp, float_cols)
 
