@@ -156,6 +156,8 @@ def child_main():
     # mid-dispatch wedges the tunnel (docs/perf_notes.md).
     default_secondary = "1" if platform != "tpu" else "0"
     if os.environ.get("TPCDS_SECONDARY", default_secondary) == "1":
+        # shared setup: a failure here is reported as THE error for both
+        # sweeps (not a downstream NameError masking the real cause)
         try:
             from spark_rapids_tpu.benchmarks import tpcds
             sf = float(os.environ.get("TPCDS_SF", "0.01"))
@@ -163,6 +165,12 @@ def child_main():
                 "TPCDS_DIR", f"/tmp/tpcds_sf{sf}"))
             ddfs = tpcds.load(spark, dpaths)
             dtb = tpcds.load_np(dpaths)
+        except Exception as e:  # noqa: BLE001
+            line["secondary"] = {"error": repr(e)[:200]}
+            line["sql_suite"] = {"error": repr(e)[:200]}
+            print(json.dumps(line))
+            return
+        try:
             t0 = time.perf_counter()
             n_ok, failed = 0, []
             for qname, q in tpcds.QUERIES.items():

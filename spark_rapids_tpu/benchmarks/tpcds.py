@@ -131,6 +131,7 @@ def generate(sf: float, outdir: str, files_per_table: int = 4) -> dict:
             np.array(MARITAL)[((cd_sk - 1) // 2) % 5]),
         "cd_education_status": pa.array(
             np.array(EDUCATION)[((cd_sk - 1) // 10) % 7]),
+        "cd_dep_count": pa.array(((cd_sk - 1) // 70).astype(np.int32)),
     }), 1)
 
     # promotion
@@ -158,6 +159,9 @@ def generate(sf: float, outdir: str, files_per_table: int = 4) -> dict:
         "ca_city": pa.array(cities[rng.integers(0, len(cities), n_addr)]),
         "ca_state": pa.array(states[rng.integers(0, len(states), n_addr)]),
         "ca_country": pa.array(np.repeat("United States", n_addr)),
+        "ca_county": pa.array(
+            [f"{c} County" for c in
+             cities[rng5.integers(0, len(cities), n_addr)]]),
         "ca_gmt_offset": pa.array(
             rng.choice([-5.0, -6.0, -7.0, -8.0], n_addr)),
     }), 1)
@@ -186,6 +190,12 @@ def generate(sf: float, outdir: str, files_per_table: int = 4) -> dict:
         "c_last_name": pa.array([f"Last{k % 700}" for k in range(n_cust)]),
         "c_preferred_cust_flag": pa.array(
             np.where(rng5.random(n_cust) < 0.5, "Y", "N")),
+        "c_birth_year": pa.array(
+            rng5.integers(1924, 1993, n_cust).astype(np.int32)),
+        "c_birth_month": pa.array(
+            rng5.integers(1, 13, n_cust).astype(np.int32)),
+        "c_current_cdemo_sk": pa.array(
+            rng5.integers(1, n_cd + 1, n_cust).astype(np.int64)),
     }), 1)
 
     # store_sales (fact). Money columns that TPC-DS declares decimal(7,2)
@@ -284,6 +294,8 @@ def generate(sf: float, outdir: str, files_per_table: int = 4) -> dict:
                 rng5.integers(1, n_promo + 1, n_rows).astype(np.int64)),
             f"{prefix}_coupon_amt": pa.array(
                 np.round(rng5.uniform(0.0, 50.0, n_rows), 2)),
+            f"{prefix}_net_profit": pa.array(
+                np.round(rng5.uniform(-5000.0, 15000.0, n_rows), 2)),
         })
 
     write("catalog_sales", channel("cs", max(n_ss // 2, 10)))
@@ -2084,6 +2096,8 @@ def sql_suite_oracles():
         "q12": (np_q12, {4, 5, 6}),
         "q20": (np_q20, {4, 5, 6}),
         "q26": (np_q26, {1, 2, 3, 4}),
+        # q18: exact decimal averages (engine-mirrored int arithmetic)
+        "q18": (np_q18, set()),
     }
     from spark_rapids_tpu.sql.tpcds_queries import SQL_QUERIES
     out = {}
@@ -2093,3 +2107,75 @@ def sql_suite_oracles():
         else:
             out[name] = (NP_QUERIES[name], FLOAT_COLS[name])
     return out
+
+
+def np_q18(tb):
+    """Official q18: 7 decimal averages over catalog buyers (female,
+    education Unknown, birth-month set) rolled up over
+    (item, country, state, county). Mirrors the engine's exact integer
+    decimal arithmetic: each value casts to decimal(12,2) via float64
+    HALF_UP (expr/cast.py float->decimal), sums stay int, and the average
+    divides at +4 scale with integer HALF_UP (expr/aggregates.Average)."""
+    import math as _m
+    from decimal import Decimal
+
+    def to_cents(v):                      # cast(x as decimal(12,2)) mirror
+        scaled = float(v) * 100.0
+        r = _m.floor(abs(scaled) + 0.5)
+        return -r if scaled < 0 else r
+
+    cd = tb["customer_demographics"]
+    cd_ok = {k: int(dep) for k, g, e, dep in zip(
+        cd["cd_demo_sk"], cd["cd_gender"], cd["cd_education_status"],
+        cd["cd_dep_count"]) if g == "F" and e == "Unknown"}
+    cu = tb["customer"]
+    c_info = {k: (int(by), int(bm), int(ad)) for k, by, bm, ad in zip(
+        cu["c_customer_sk"], cu["c_birth_year"], cu["c_birth_month"],
+        cu["c_current_addr_sk"])}
+    ca = tb["customer_address"]
+    ca_info = {k: (co, st, cty) for k, co, st, cty in zip(
+        ca["ca_address_sk"], ca["ca_country"], ca["ca_state"],
+        ca["ca_county"])}
+    states = {"CA", "TX", "NY", "GA", "OH", "WA"}
+    months = {1, 6, 8, 9, 12, 2}
+    ok_d = _d(tb, d_year=lambda y: y == 1998)
+    iid_col = tb["item"]["i_item_id"]       # dense sks from 1
+    cs = tb["catalog_sales"]
+    acc = {}
+    for dk, ik, cdk, ck, q, lp, cam, sp, npf in zip(
+            cs["cs_sold_date_sk"], cs["cs_item_sk"],
+            cs["cs_bill_cdemo_sk"], cs["cs_bill_customer_sk"],
+            cs["cs_quantity"], cs["cs_list_price"], cs["cs_coupon_amt"],
+            cs["cs_sales_price"], cs["cs_net_profit"]):
+        dep = cd_ok.get(cdk)
+        if dk not in ok_d or dep is None:
+            continue
+        by, bm, ad = c_info[ck]
+        if bm not in months:
+            continue
+        country, state, county = ca_info[ad]
+        if state not in states:
+            continue
+        iid = iid_col[ik - 1]
+        vals = [to_cents(q), to_cents(lp), to_cents(cam), to_cents(sp),
+                to_cents(npf), to_cents(by), to_cents(dep)]
+        full = (iid, country, state, county)
+        for lvl in range(5):                    # rollup levels
+            key = tuple(v if i < lvl else None
+                        for i, v in enumerate(full))
+            a = acc.setdefault(key, [0] + [0] * 7)
+            a[0] += 1
+            for j, v in enumerate(vals):
+                a[1 + j] += v
+    rows = []
+    for key, a in acc.items():
+        cnt = a[0]
+        avgs = []
+        for j in range(7):                      # engine decimal avg mirror
+            num = a[1 + j] * 10 ** 4
+            qm = (abs(num) + cnt // 2) // cnt
+            avgs.append(Decimal(-qm if num < 0 else qm).scaleb(-6))
+        rows.append(key + tuple(avgs))
+    rows.sort(key=lambda r: tuple((v is not None, v) for v in
+                                  (r[1], r[2], r[3], r[0])))
+    return rows[:100]
