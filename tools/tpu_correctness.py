@@ -139,6 +139,46 @@ def child_main():
     except AssertionError as e:
         record("tpcds_q3_end_to_end", False, e)
 
+    # 7. round-5 SQL surfaces, tiny + bounded: set operations (null-safe
+    #    semi/anti + row_number ALL forms), the general multi-DISTINCT
+    #    Expand rewrite, grouping sets, and exact decimal multiply/divide —
+    #    each device result vs the host interpreter
+    t = pa.table({"x": pa.array([1, 1, 2, 3, None, None], pa.int64()),
+                  "y": pa.array(["a", "a", "b", "c", "d", None])})
+    spark.create_or_replace_temp_view("r5a", spark.create_dataframe(t))
+    t2 = pa.table({"x": pa.array([1, 2, 2, None, 5], pa.int64()),
+                   "y": pa.array(["a", "b", "b", None, "e"])})
+    spark.create_or_replace_temp_view("r5b", spark.create_dataframe(t2))
+    r5 = [
+        "select x, y from r5a intersect select x, y from r5b",
+        "select x, y from r5a except select x, y from r5b",
+        "select x, y from r5a intersect all select x, y from r5b",
+        "select x, y from r5a except all select x, y from r5b",
+        "select count(distinct x) cx, count(distinct y) cy, sum(x) s "
+        "from r5a",
+        "select y, count(distinct x) c from r5a group by rollup (y)",
+        "select cast(1 as decimal(5,2)) / cast(3 as decimal(5,2)) v, "
+        "cast(1.5 as decimal(5,2)) * cast(2.5 as decimal(5,2)) w",
+    ]
+    ok_all, detail = True, []
+    for q in r5:
+        # per-statement try: one device-side failure must record a FAIL,
+        # not abort the child and lose checks 1-6's measurements
+        try:
+            df = spark.sql(q)
+            g = sorted((tuple(r.values())
+                        for r in df.collect().to_pylist()), key=repr)
+            e = sorted((tuple(r.values())
+                        for r in df.collect_host().to_pylist()), key=repr)
+            if g != e:
+                ok_all = False
+                detail.append(f"{q[:40]}: {g} vs {e}")
+        except Exception as exc:  # noqa: BLE001
+            ok_all = False
+            detail.append(f"{q[:40]}: {exc!r:.120}")
+    record("r5_setops_distinct_decimal", ok_all,
+           "; ".join(detail) if detail else f"{len(r5)} statements match")
+
     results["ok"] = all(c["ok"] for c in results["checks"].values())
     print(json.dumps(results))
     return results
