@@ -350,7 +350,9 @@ class _ExprConverter:
             return self.func(a)
         if isinstance(a, P.ExistsAst):
             raise SqlAnalysisError(
-                "EXISTS is not supported; rewrite as a semi join")
+                "EXISTS is supported only as a top-level WHERE conjunct "
+                "(where it lowers to a semi/anti join); rewrite this "
+                "occurrence as a join")
         if isinstance(a, P.Star):
             raise SqlAnalysisError("* only allowed at select-list top level "
                                    "or in count(*)")
@@ -864,6 +866,21 @@ class _Lowerer:
         conjuncts = [h for c in conjuncts
                      for h in _hoist_common_or_conjuncts(c)]
 
+        # [NOT] EXISTS conjuncts apply as semi/anti joins over the COMPLETE
+        # join graph (the correlation may reference several outer relations)
+        exists_list = []
+        rest = []
+        for c in conjuncts:
+            if isinstance(c, P.ExistsAst):
+                exists_list.append((c.query, c.negated))
+            elif isinstance(c, P.UnOp) and c.op == "not" \
+                    and isinstance(c.operand, P.ExistsAst):
+                exists_list.append((c.operand.query,
+                                    not c.operand.negated))
+            else:
+                rest.append(c)
+        conjuncts = rest
+
         # which relations does each conjunct touch? (by unique column name
         # or qualifier match, at AST level — before any join order exists)
         def rel_ids_of(conj):
@@ -933,7 +950,20 @@ class _Lowerer:
         # table in a star query), attach connected relations first
         n = len(rels)
         if n == 1:
-            return rels[0].plan, rels[0].scope
+            plan, scope = rels[0].plan, rels[0].scope
+            if leftover:
+                # unresolvable conjuncts must raise (typo'd column), never
+                # silently drop the filter (review catch — the n>1 path
+                # already routed these through the converter)
+                conv = _ExprConverter(scope, self)
+                cond = conv.convert(leftover[0])
+                from spark_rapids_tpu.expr.predicates import And
+                for cj in leftover[1:]:
+                    cond = And(cond, conv.convert(cj))
+                plan = NN.FilterNode(cond, plan)
+            for sub_q, negated in exists_list:
+                plan = self._apply_exists(plan, scope, sub_q, negated)
+            return plan, scope
         degree = [0] * n
         for a, b, _ in edges:
             degree[a] += 1
@@ -979,7 +1009,90 @@ class _Lowerer:
             for cj in leftover[1:]:
                 cond = And(cond, conv.convert(cj))
             plan = NN.FilterNode(cond, plan)
+        for sub_q, negated in exists_list:
+            plan = self._apply_exists(plan, scope, sub_q, negated)
         return plan, scope
+
+    def _apply_exists(self, plan, scope, q2, negated: bool):
+        """[NOT] EXISTS (subquery) over the planned outer relation (Spark
+        RewritePredicateSubquery; the reference executes the result as a
+        broadcast semi/anti join). Correlation must be equality conjuncts
+        in the subquery's WHERE referencing outer columns — those become
+        the join keys; everything else must resolve inside the subquery.
+        An uncorrelated EXISTS folds at plan time (non-empty check)."""
+        if not isinstance(q2, P.Select) or q2.group_by or q2.having \
+                or getattr(q2, "grouping_sets", None) or q2.ctes \
+                or q2.limit == 0 \
+                or any(self._ast_has_agg(it.expr) for it in q2.items
+                       if not isinstance(it.expr, P.Star)):
+            # an ungrouped aggregate select always yields one row — row
+            # existence of its INPUT is the wrong question, so reject
+            # rather than silently answer it
+            raise SqlAnalysisError(
+                "EXISTS subqueries support plain SELECT ... FROM ... WHERE "
+                "shapes (no GROUP BY/HAVING/CTE/aggregates/LIMIT 0)")
+        sub = _Lowerer(self.session, self.views)
+        _, iscope = sub._plan_from(P.Select(q2.items, q2.from_, None))
+        pairs, inner_only = [], []      # [(outer parts, inner parts)]
+        for cj in (_flatten_and(q2.where) if q2.where is not None else []):
+            if isinstance(cj, P.BinOp) and cj.op == "=" \
+                    and isinstance(cj.left, P.Ident) \
+                    and isinstance(cj.right, P.Ident):
+                li, ri = cj.left.parts, cj.right.parts
+                l_in, r_in = len(iscope.find(li)), len(iscope.find(ri))
+                # inner resolution wins when a name exists in both scopes
+                # (Spark's inner-first rule)
+                if l_in == 0 and r_in == 1 and len(scope.find(li)) == 1:
+                    pairs.append((li, ri))
+                    continue
+                if r_in == 0 and l_in == 1 and len(scope.find(ri)) == 1:
+                    pairs.append((ri, li))
+                    continue
+            if all(iscope.find(i.parts) for i in _ast_idents(cj)):
+                inner_only.append(cj)
+                continue
+            raise SqlAnalysisError(
+                "EXISTS: only equality correlation to the outer query "
+                f"is supported (got {cj!r})")
+        # REPLAN the subquery with its inner-only conjuncts as the WHERE so
+        # _plan_from turns inner equi conjuncts into hash-join edges
+        # (filtering a cross product after the fact would blow up on
+        # multi-relation subqueries)
+        inner_where = None
+        for cj in inner_only:
+            inner_where = cj if inner_where is None \
+                else P.BinOp("and", inner_where, cj)
+        iplan, iscope = _Lowerer(self.session, self.views)._plan_from(
+            P.Select(q2.items, q2.from_, inner_where))
+        lkeys = [scope.resolve(op) for op, _ in pairs]
+        rkeys = [iscope.resolve(ip) for _, ip in pairs]
+        if not lkeys:
+            # uncorrelated: evaluate once at plan time, like scalar
+            # subqueries (Spark's pre-executed subquery stages)
+            from spark_rapids_tpu.session import DataFrame
+            n = DataFrame(NN.LimitNode(1, iplan, global_limit=True),
+                          self.session).collect().num_rows
+            if (n > 0) != negated:
+                return plan
+            return NN.FilterNode(E.Literal(False, T.BOOLEAN), plan)
+        return NN.JoinNode(plan, iplan, lkeys, rkeys,
+                           "leftanti" if negated else "leftsemi", None)
+
+    @staticmethod
+    def _ast_has_agg(a) -> bool:
+        """AST-level aggregate detection (pre-conversion): an ungrouped
+        aggregate select yields one row regardless of input rows, which
+        breaks EXISTS's row-existence reading of the subquery."""
+        if isinstance(a, P.FuncCall):
+            if a.over is None and a.name in (set(_AGG_FUNCS) | {"count"}):
+                return True
+            return any(_Lowerer._ast_has_agg(x) for x in a.args
+                       if not isinstance(x, P.Star))
+        for attr in ("left", "right", "operand", "expr", "lo", "hi"):
+            x = getattr(a, attr, None)
+            if x is not None and _Lowerer._ast_has_agg(x):
+                return True
+        return False
 
     @staticmethod
     def _is_equi_ast(conj):

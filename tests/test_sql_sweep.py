@@ -341,3 +341,35 @@ def test_decimal_multiply_divide(query, want):
     dev = df.collect().to_pylist()
     assert dev == df.collect_host().to_pylist()
     assert str(list(dev[0].values())[0]) == want
+
+
+@pytest.mark.parametrize("query,want", [
+    ("select k from eo where exists (select 1 from ei where ei.fk = eo.k) "
+     "order by k", [2, 4]),
+    ("select k from eo where not exists "
+     "(select 1 from ei where ei.fk = eo.k) order by k", [None, 1, 3]),
+    # inner-only predicates stay inside the subquery
+    ("select k from eo where exists (select 1 from ei "
+     "where ei.fk = eo.k and w > 2) order by k", [4]),
+    # uncorrelated: plan-time fold (non-empty / empty)
+    ("select k from eo where exists (select 1 from ei) order by k",
+     [None, 1, 2, 3, 4]),
+    ("select k from eo where exists (select 1 from ei where w > 100) "
+     "order by k", []),
+    ("select k from eo where not exists (select 1 from ei where w > 100) "
+     "order by k", [None, 1, 2, 3, 4]),
+])
+def test_exists_subqueries(query, want):
+    """[NOT] EXISTS lowers to a left-semi/anti join on the equality
+    correlation (Spark RewritePredicateSubquery role); uncorrelated forms
+    fold at plan time. NULL outer keys never match, so NOT EXISTS keeps
+    them — Spark's anti-join semantics."""
+    spark = TpuSession()
+    spark.create_or_replace_temp_view("eo", spark.create_dataframe(
+        pa.table({"k": [1, 2, 3, 4, None],
+                  "v": [10.0, 20.0, 30.0, 40.0, 50.0]})))
+    spark.create_or_replace_temp_view("ei", spark.create_dataframe(
+        pa.table({"fk": [2, 2, 4, 7], "w": [1, 2, 3, 4]})))
+    df = spark.sql(query)
+    got = [r["k"] for r in df.collect().to_pylist()]
+    assert got == [r["k"] for r in df.collect_host().to_pylist()] == want
