@@ -132,6 +132,11 @@ def generate(sf: float, outdir: str, files_per_table: int = 4) -> dict:
         "cd_education_status": pa.array(
             np.array(EDUCATION)[((cd_sk - 1) // 10) % 7]),
         "cd_dep_count": pa.array(((cd_sk - 1) // 70).astype(np.int32)),
+        "cd_purchase_estimate": pa.array(
+            (rng5.integers(1, 21, n_cd) * 500).astype(np.int32)),
+        "cd_credit_rating": pa.array(np.array(
+            ["Low Risk", "Good", "High Risk", "Unknown"])[
+                rng5.integers(0, 4, n_cd)]),
     }), 1)
 
     # promotion
@@ -2098,6 +2103,8 @@ def sql_suite_oracles():
         "q26": (np_q26, {1, 2, 3, 4}),
         # q18: exact decimal averages (engine-mirrored int arithmetic)
         "q18": (np_q18, set()),
+        # q69: EXISTS + two NOT EXISTS over the three channels
+        "q69": (np_q69, set()),
     }
     from spark_rapids_tpu.sql.tpcds_queries import SQL_QUERIES
     out = {}
@@ -2178,4 +2185,42 @@ def np_q18(tb):
         rows.append(key + tuple(avgs))
     rows.sort(key=lambda r: tuple((v is not None, v) for v in
                                   (r[1], r[2], r[3], r[0])))
+    return rows[:100]
+
+
+def np_q69(tb):
+    """Official q69: demographics of customers (in-state) who bought in
+    store but neither web nor catalog in Q2-2001 (EXISTS + two NOT
+    EXISTS). cs_bill_customer_sk substitutes cs_ship_customer_sk (subset
+    schema, header rule 2)."""
+    dd_ok = _d(tb, d_year=lambda y: y == 2001,
+               d_moy=lambda m: (m >= 4) & (m <= 6))
+
+    def buyers(fact, dcol, ccol):
+        f = tb[fact]
+        return {c for d, c in zip(f[dcol], f[ccol]) if d in dd_ok}
+    ss_b = buyers("store_sales", "ss_sold_date_sk", "ss_customer_sk")
+    ws_b = buyers("web_sales", "ws_sold_date_sk", "ws_bill_customer_sk")
+    cs_b = buyers("catalog_sales", "cs_sold_date_sk",
+                  "cs_bill_customer_sk")
+    ca = tb["customer_address"]
+    ok_ca = set(ca["ca_address_sk"][np.isin(ca["ca_state"],
+                                            ["CA", "TX", "NY"])])
+    cd = tb["customer_demographics"]
+    cd_info = {k: (g, m, e, int(pe), cr) for k, g, m, e, pe, cr in zip(
+        cd["cd_demo_sk"], cd["cd_gender"], cd["cd_marital_status"],
+        cd["cd_education_status"], cd["cd_purchase_estimate"],
+        cd["cd_credit_rating"])}
+    cu = tb["customer"]
+    counts = {}
+    for ck, ad, cdk in zip(cu["c_customer_sk"], cu["c_current_addr_sk"],
+                           cu["c_current_cdemo_sk"]):
+        if ad not in ok_ca or ck not in ss_b or ck in ws_b or ck in cs_b:
+            continue
+        g, m, e, pe, cr = cd_info[cdk]
+        key = (g, m, e, pe, cr)
+        counts[key] = counts.get(key, 0) + 1
+    rows = [(g, m, e, n, pe, n, cr, n)
+            for (g, m, e, pe, cr), n in counts.items()]
+    rows.sort(key=lambda r: (r[0], r[1], r[2], r[4], r[6]))
     return rows[:100]

@@ -1032,7 +1032,12 @@ class _Lowerer:
                 "EXISTS subqueries support plain SELECT ... FROM ... WHERE "
                 "shapes (no GROUP BY/HAVING/CTE/aggregates/LIMIT 0)")
         sub = _Lowerer(self.session, self.views)
-        _, iscope = sub._plan_from(P.Select(q2.items, q2.from_, None))
+        # scope-only pass: concat the base-relation scopes (no join tree —
+        # the real plan is built once below, with the inner WHERE)
+        iscope = None
+        for item in q2.from_:
+            s2 = sub._base_relation(item).scope
+            iscope = s2 if iscope is None else iscope.concat(s2)
         pairs, inner_only = [], []      # [(outer parts, inner parts)]
         for cj in (_flatten_and(q2.where) if q2.where is not None else []):
             if isinstance(cj, P.BinOp) and cj.op == "=" \
@@ -1058,12 +1063,9 @@ class _Lowerer:
         # _plan_from turns inner equi conjuncts into hash-join edges
         # (filtering a cross product after the fact would blow up on
         # multi-relation subqueries)
-        inner_where = None
-        for cj in inner_only:
-            inner_where = cj if inner_where is None \
-                else P.BinOp("and", inner_where, cj)
         iplan, iscope = _Lowerer(self.session, self.views)._plan_from(
-            P.Select(q2.items, q2.from_, inner_where))
+            P.Select(q2.items, q2.from_,
+                     _and_of(inner_only) if inner_only else None))
         lkeys = [scope.resolve(op) for op, _ in pairs]
         rkeys = [iscope.resolve(ip) for _, ip in pairs]
         if not lkeys:
@@ -1082,17 +1084,37 @@ class _Lowerer:
     def _ast_has_agg(a) -> bool:
         """AST-level aggregate detection (pre-conversion): an ungrouped
         aggregate select yields one row regardless of input rows, which
-        breaks EXISTS's row-existence reading of the subquery."""
-        if isinstance(a, P.FuncCall):
-            if a.over is None and a.name in (set(_AGG_FUNCS) | {"count"}):
-                return True
-            return any(_Lowerer._ast_has_agg(x) for x in a.args
-                       if not isinstance(x, P.Star))
-        for attr in ("left", "right", "operand", "expr", "lo", "hi"):
-            x = getattr(a, attr, None)
-            if x is not None and _Lowerer._ast_has_agg(x):
-                return True
-        return False
+        breaks EXISTS's row-existence reading of the subquery. Walks every
+        AST shape _ast_idents walks (incl. CASE branches and IN lists)."""
+        agg_names = set(_AGG_FUNCS) | {"count"}
+
+        def walk(x):
+            if isinstance(x, (P.SubqueryExpr, P.ExistsAst, P.Star)) \
+                    or x is None:
+                return False
+            if isinstance(x, P.FuncCall):
+                if x.over is None and x.name in agg_names:
+                    return True
+                return any(walk(ar) for ar in x.args)
+            if isinstance(x, P.BinOp):
+                return walk(x.left) or walk(x.right)
+            if isinstance(x, P.UnOp):
+                return walk(x.operand)
+            if isinstance(x, P.CaseAst):
+                return (walk(x.operand)
+                        or any(walk(w) or walk(v) for w, v in x.branches)
+                        or walk(x.else_))
+            if isinstance(x, P.CastAst):
+                return walk(x.expr)
+            if isinstance(x, P.BetweenAst):
+                return walk(x.expr) or walk(x.lo) or walk(x.hi)
+            if isinstance(x, P.InAst):
+                return walk(x.expr) or (isinstance(x.values, list)
+                                        and any(walk(v) for v in x.values))
+            if isinstance(x, (P.LikeAst, P.IsNullAst)):
+                return walk(x.expr)
+            return False
+        return walk(a)
 
     @staticmethod
     def _is_equi_ast(conj):
