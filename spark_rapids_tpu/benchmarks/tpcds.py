@@ -305,6 +305,22 @@ def generate(sf: float, outdir: str, files_per_table: int = 4) -> dict:
 
     write("catalog_sales", channel("cs", max(n_ss // 2, 10)))
     write("web_sales", channel("ws", max(n_ss // 4, 10)))
+
+    # inventory (round 5): weekly quantity-on-hand snapshots for a sampled
+    # item subset (q22's rollup; the spec snapshots weekly per warehouse —
+    # one warehouse keeps the subset fact compact)
+    inv_dates = np.arange(1, N_DATES + 1, 7, dtype=np.int64)
+    inv_items = np.arange(1, n_item + 1, max(1, n_item // 1000),
+                          dtype=np.int64)
+    dgrid, igrid = np.meshgrid(inv_dates, inv_items, indexing="ij")
+    n_inv = dgrid.size
+    write("inventory", pa.table({
+        "inv_date_sk": pa.array(dgrid.ravel()),
+        "inv_item_sk": pa.array(igrid.ravel()),
+        "inv_warehouse_sk": pa.array(np.ones(n_inv, np.int64)),
+        "inv_quantity_on_hand": pa.array(
+            rng5.integers(0, 1000, n_inv).astype(np.int32)),
+    }))
     return paths
 
 
@@ -2105,6 +2121,8 @@ def sql_suite_oracles():
         "q18": (np_q18, set()),
         # q69: EXISTS + two NOT EXISTS over the three channels
         "q69": (np_q69, set()),
+        # q22: inventory rollup; qoh average is float
+        "q22": (np_q22, {4}),
     }
     from spark_rapids_tpu.sql.tpcds_queries import SQL_QUERIES
     out = {}
@@ -2223,4 +2241,34 @@ def np_q69(tb):
     rows = [(g, m, e, n, pe, n, cr, n)
             for (g, m, e, pe, cr), n in counts.items()]
     rows.sort(key=lambda r: (r[0], r[1], r[2], r[4], r[6]))
+    return rows[:100]
+
+
+def np_q22(tb):
+    """Official q22: average quantity on hand rolled up over the item
+    hierarchy for a 12-month-seq window (i_item_id substitutes
+    i_product_name — subset schema, header rule 2)."""
+    dd = tb["date_dim"]
+    ok_d = set(dd["d_date_sk"][(dd["d_month_seq"] >= 1200)
+                               & (dd["d_month_seq"] <= 1211)])
+    it = tb["item"]
+    info = {k: (iid, b, cl, ca) for k, iid, b, cl, ca in zip(
+        it["i_item_sk"], it["i_item_id"], it["i_brand"], it["i_class"],
+        it["i_category"])}
+    inv = tb["inventory"]
+    acc = {}
+    for dk, ik, q in zip(inv["inv_date_sk"], inv["inv_item_sk"],
+                         inv["inv_quantity_on_hand"]):
+        if dk not in ok_d:
+            continue
+        full = info[ik]
+        for lvl in range(5):
+            key = tuple(v if i < lvl else None
+                        for i, v in enumerate(full))
+            a = acc.setdefault(key, [0, 0])
+            a[0] += 1
+            a[1] += int(q)
+    rows = [key + (a[1] / a[0],) for key, a in acc.items()]
+    rows.sort(key=lambda r: (r[4],) + tuple((v is not None, v)
+                                            for v in r[:4]))
     return rows[:100]

@@ -1189,3 +1189,18 @@ select
           cd_purchase_estimate, cd_credit_rating
  limit 100
 """
+
+SQL_QUERIES["q22"] = """
+select i_item_id,
+       i_brand,
+       i_class,
+       i_category,
+       avg(inv_quantity_on_hand) qoh
+       from inventory, date_dim, item
+       where inv_date_sk = d_date_sk
+              and inv_item_sk = i_item_sk
+              and d_month_seq between 1200 and 1200 + 11
+       group by rollup(i_item_id, i_brand, i_class, i_category)
+order by qoh, i_item_id, i_brand, i_class, i_category
+limit 100
+"""
