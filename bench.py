@@ -24,9 +24,11 @@ platform if the accelerator never comes up, and ALWAYS prints exactly one
 JSON line and exits 0.
 """
 
+import contextlib
 import json
 import math
 import os
+import statistics
 import subprocess
 import sys
 import time
@@ -35,6 +37,34 @@ TPCH_SF = float(os.environ.get("TPCH_SF", "0.1"))
 DATA_DIR = os.environ.get("TPCH_DIR", f"/tmp/tpch_sf{TPCH_SF}")
 CHILD_TIMEOUT_S = 2400
 PROBE_TIMEOUT_S = 240   # first TPU compile/init can take ~40s; be generous
+# statistically honest measurement (VERDICT r5 weak #1: run-to-run variance
+# was comparable to a round's progress): every timed section runs BENCH_REPS
+# times, the metric is the MEDIAN, and the relative spread (max-min)/median
+# is reported per query; a spread past BENCH_MAX_SPREAD marks the line
+# degraded so a noisy box can't mint a quiet number
+BENCH_REPS = int(os.environ.get("BENCH_REPS", "5"))
+BENCH_MAX_SPREAD = float(os.environ.get("BENCH_MAX_SPREAD", "0.5"))
+# the background TPU watcher probes the backend on a timer; its subprocess
+# competes with timed sections on small boxes (r5 memory notes: background
+# work doubled timings). Timed sections hold this pause file; the watcher
+# skips probing while it exists and is fresh (tools/tpu_watcher.py).
+PAUSE_FILE = os.environ.get("SRT_BENCH_PAUSE_FILE", "/tmp/srt_bench_pause")
+
+
+@contextlib.contextmanager
+def watcher_paused():
+    try:
+        with open(PAUSE_FILE, "w") as f:
+            f.write(str(os.getpid()))
+    except OSError:
+        pass
+    try:
+        yield
+    finally:
+        try:
+            os.unlink(PAUSE_FILE)
+        except OSError:
+            pass
 
 
 def _check_q1(got, exp):
@@ -60,8 +90,23 @@ def _check_q5(got, exp):
         assert abs(g["revenue"] - v) <= 1e-6 * max(1.0, abs(v))
 
 
-CHECKS = {"q1": _check_q1, "q3": _check_q3, "q5": _check_q5}
-NP_QUERIES = {"q1": "np_q1", "q3": "np_q3", "q5": "np_q5"}
+def _check_q18(got, exp):
+    import datetime
+    assert len(got) == len(exp), (len(got), len(exp))
+    epoch = datetime.date(1970, 1, 1)
+    for g, (c, o, d, t, s) in zip(got, exp):
+        assert g["c_custkey"] == c and g["o_orderkey"] == o, (g, (c, o))
+        gd = g["o_orderdate"]
+        if isinstance(gd, datetime.date):
+            gd = (gd - epoch).days
+        assert gd == d, (gd, d)
+        assert abs(g["o_totalprice"] - t) <= 1e-6 * max(1.0, abs(t))
+        assert abs(g["sum_qty"] - s) <= 1e-6 * max(1.0, abs(s))
+
+
+CHECKS = {"q1": _check_q1, "q3": _check_q3, "q5": _check_q5,
+          "q18": _check_q18}
+NP_QUERIES = {"q1": "np_q1", "q3": "np_q3", "q5": "np_q5", "q18": "np_q18"}
 # (table -> columns) each query scans — the fair oracle re-reads exactly
 # these per run, mirroring what the engine's COLUMN-PRUNED plan scans every
 # collect() (plan/pruning.py narrows the FileScanNode the same way)
@@ -80,6 +125,10 @@ Q_TABLES = {
            "supplier": ["s_nationkey", "s_suppkey"],
            "nation": ["n_name", "n_nationkey", "n_regionkey"],
            "region": ["r_name", "r_regionkey"]},
+    "q18": {"customer": ["c_custkey"],
+            "orders": ["o_custkey", "o_orderdate", "o_orderkey",
+                       "o_totalprice"],
+            "lineitem": ["l_orderkey", "l_quantity"]},
 }
 
 
@@ -112,43 +161,73 @@ def child_main():
     from spark_rapids_tpu.benchmarks.common import read_np
 
     speedups_e2e, speedups_compute, mrows = [], [], []
-    for name, q in tpch.QUERIES.items():
-        df = q(dfs)
-        got = df.collect().to_pylist()          # warm (compiles cached after)
-        exp = getattr(tpch, NP_QUERIES[name])(tb)
-        CHECKS[name](got, exp)                  # wrong answer → no number
-        best = float("inf")
-        for _ in range(2):
+    per_query, spreads = {}, []
+    with watcher_paused():
+        for name, q in tpch.QUERIES.items():
+            df = q(dfs)
+            got = df.collect().to_pylist()      # warm (compiles cached after)
+            exp = getattr(tpch, NP_QUERIES[name])(tb)
+            CHECKS[name](got, exp)              # wrong answer → no number
+            ts = []
+            for _ in range(BENCH_REPS):
+                t0 = time.perf_counter()
+                df.collect()
+                ts.append(time.perf_counter() - t0)
+            eng = statistics.median(ts)
+            spread = (max(ts) - min(ts)) / eng if eng > 0 else 0.0
+            # fair oracle: re-read this query's tables from parquet +
+            # compute, same rep count (both sides pay the scan; OS page
+            # cache is warm for both)
+            np_ts = []
+            for _ in range(BENCH_REPS):
+                t0 = time.perf_counter()
+                tb_q = {t: read_np(paths[t], columns=cols)
+                        for t, cols in Q_TABLES[name].items()}
+                getattr(tpch, NP_QUERIES[name])(tb_q)
+                np_ts.append(time.perf_counter() - t0)
+                del tb_q
+            np_e2e = statistics.median(np_ts)
+            # legacy denominator: oracle computes on preloaded arrays
             t0 = time.perf_counter()
-            df.collect()
-            best = min(best, time.perf_counter() - t0)
-        # fair oracle: re-read this query's tables from parquet + compute
-        # (both sides pay the scan; OS page cache is warm for both)
-        t0 = time.perf_counter()
-        tb_q = {t: read_np(paths[t], columns=cols)
-                for t, cols in Q_TABLES[name].items()}
-        getattr(tpch, NP_QUERIES[name])(tb_q)
-        np_e2e = time.perf_counter() - t0
-        del tb_q
-        # legacy denominator: oracle computes on preloaded arrays
-        t0 = time.perf_counter()
-        getattr(tpch, NP_QUERIES[name])(tb)
-        np_compute = time.perf_counter() - t0
-        speedups_e2e.append(np_e2e / best)
-        speedups_compute.append(np_compute / best)
-        mrows.append(n_lineitem / best / 1e6)
+            getattr(tpch, NP_QUERIES[name])(tb)
+            np_compute = time.perf_counter() - t0
+            speedups_e2e.append(np_e2e / eng)
+            speedups_compute.append(np_compute / eng)
+            mrows.append(n_lineitem / eng / 1e6)
+            spreads.append(spread)
+            per_query[name] = {
+                "engine_s": round(eng, 4), "spread": round(spread, 3),
+                "oracle_e2e_s": round(np_e2e, 4),
+                "vs_baseline": round(np_e2e / eng, 3),
+            }
 
     geo = lambda xs: math.exp(sum(math.log(x) for x in xs) / len(xs))
+    qnames = "".join(tpch.QUERIES)
     line = {
-        "metric": f"tpch_sf{TPCH_SF}_q1q3q5_geomean",
+        "metric": f"tpch_sf{TPCH_SF}_{qnames}_geomean",
         "value": round(geo(mrows), 3),
         "unit": "Mrows/s",
         "vs_baseline": round(geo(speedups_e2e), 3),
         "vs_baseline_compute": round(geo(speedups_compute), 3),
         "baseline_denominator": "numpy-oracle e2e (per-query parquet re-read)",
+        "reps": BENCH_REPS,
+        "stat": "median",
+        "spread": round(max(spreads), 3),
+        "variance_ok": max(spreads) <= BENCH_MAX_SPREAD,
+        "queries": per_query,
     }
+    if not line["variance_ok"]:
+        line["degraded"] = (f"spread {line['spread']} exceeds "
+                            f"{BENCH_MAX_SPREAD}")
     if platform != "tpu":
-        line["degraded"] = f"platform={platform}"
+        line["degraded"] = (line.get("degraded", "") +
+                            f" platform={platform}").strip()
+    if os.environ.get("BENCH_JOIN_MICRO", "1") == "1":
+        try:
+            with watcher_paused():
+                line["join_microbench"] = join_microbench(smoke=True)
+        except Exception as e:  # noqa: BLE001 — secondary must not kill line
+            line["join_microbench"] = {"error": repr(e)[:200]}
     # secondary metric: the 22-query TPC-DS sweep at small scale (breadth —
     # window/decimal/basket shapes; reference qa_nightly role). Failures
     # never take down the primary metric. Default OFF on the real chip: the
@@ -243,6 +322,115 @@ def child_main():
     print(json.dumps(line))
 
 
+def join_microbench(smoke: bool = False):
+    """Kernel-level join-spine microbench: the same unique-int-key probe
+    through three formulations, value-checked against each other before any
+    timing —
+
+      - ``pallas``: hash_join_build + hash_join_probe
+        (ops/pallas_kernels.py; interpret-mode off-TPU, Mosaic on chip)
+      - ``searchsorted``: sorted build + two searchsorted (the engine's
+        fast-path probe, exec/joins._probe_batch_fast mode "two")
+      - ``laxsort_rank``: join_ranks + probe (the general rank path —
+        ops/joining.py; the multi-key `lax.sort` spine)
+
+    Median-of-reps wall per formulation, in ms. Smoke mode (ci.sh gate)
+    shrinks the data so the check runs in seconds."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import spark_rapids_tpu  # noqa: F401  (x64)
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.expr.core import Col
+    from spark_rapids_tpu.ops import joining as J
+    from spark_rapids_tpu.ops import pallas_kernels as PK
+
+    n_build = 4096 if smoke else 16384
+    n_stream = (1 << 14) if smoke else (1 << 20)
+    reps = 3 if smoke else 5
+    rng = np.random.default_rng(20260804)
+    bk = rng.permutation(
+        np.arange(1, 8 * n_build + 1, 8)[:n_build]).astype(np.int64)
+    sk = np.concatenate([
+        rng.choice(bk, n_stream // 2),
+        rng.integers(0, 8 * n_build, n_stream - n_stream // 2),
+    ]).astype(np.int64)
+    bkj, skj = jnp.asarray(bk), jnp.asarray(sk)
+    b_valid = jnp.ones((n_build,), jnp.bool_)
+    H = PK.hash_join_buckets(n_build)
+
+    # production shape (exec/joins._JoinCore): the build preps ONCE per
+    # join, the probe runs per stream batch, and the rank path re-sorts
+    # build+stream per batch — so prep is timed separately and the parity
+    # comparison is per-batch probe cost
+    @jax.jit
+    def f_pallas_build(bkj):
+        return PK.hash_join_build(bkj, b_valid, H)
+
+    @jax.jit
+    def f_pallas_probe(tk, tr, skj):
+        pos, found = PK.hash_join_probe(tk, tr, skj, H)
+        return jnp.sum(found.astype(jnp.int64)), pos, found
+
+    @jax.jit
+    def f_ss_build(bkj):
+        return jax.lax.sort(bkj)
+
+    @jax.jit
+    def f_ss_probe(s, skj):
+        lo = jnp.searchsorted(s, skj, side="left")
+        hi = jnp.searchsorted(s, skj, side="right")
+        return jnp.sum((hi - lo).astype(jnp.int64))
+
+    @jax.jit
+    def f_rank(bkj, skj):
+        bcol = Col(bkj, b_valid, T.LONG)
+        scol = Col(skj, jnp.ones((n_stream,), jnp.bool_), T.LONG)
+        b_ranks, s_ranks = J.join_ranks([bcol], n_build, n_build,
+                                        [scol], n_stream, n_stream)
+        _, lo, hi = J.probe(b_ranks, s_ranks)
+        return jnp.sum((hi - lo).astype(jnp.int64))
+
+    # value check once, off the clock: all three agree on the match count,
+    # and every pallas hit points at a build row holding the probed key
+    tk, tr, ok = jax.block_until_ready(f_pallas_build(bkj))
+    assert bool(ok), "hash build refused unique keys"
+    m_pallas, pos, found = jax.block_until_ready(f_pallas_probe(tk, tr, skj))
+    sorted_bk = jax.block_until_ready(f_ss_build(bkj))
+    m_ss = int(f_ss_probe(sorted_bk, skj))
+    m_rank = int(f_rank(bkj, skj))
+    assert int(m_pallas) == m_ss == m_rank, (int(m_pallas), m_ss, m_rank)
+    pos_h, found_h = np.asarray(pos), np.asarray(found)
+    assert (bk[pos_h[found_h]] == sk[found_h]).all()
+
+    def timed(f, *args):
+        jax.block_until_ready(f(*args))
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(*args))
+            ts.append(time.perf_counter() - t0)
+        return statistics.median(ts) * 1000
+
+    pallas_build_ms = timed(f_pallas_build, bkj)
+    pallas_ms = timed(f_pallas_probe, tk, tr, skj)
+    ss_build_ms = timed(f_ss_build, bkj)
+    ss_ms = timed(f_ss_probe, sorted_bk, skj)
+    rank_ms = timed(f_rank, bkj, skj)
+    return {
+        "metric": "join_microbench",
+        "n_build": n_build, "n_stream": n_stream, "reps": reps,
+        "matches": m_ss,
+        "pallas_probe_ms": round(pallas_ms, 2),
+        "pallas_build_ms": round(pallas_build_ms, 2),
+        "searchsorted_probe_ms": round(ss_ms, 2),
+        "searchsorted_build_ms": round(ss_build_ms, 2),
+        "laxsort_rank_ms": round(rank_ms, 2),
+        "pallas_vs_laxsort": round(rank_ms / pallas_ms, 2),
+        "parity_ok": pallas_ms <= rank_ms,
+    }
+
+
 def _spawn(extra_env, timeout_s):
     """Run this script as a measuring child; return its last JSON line or None."""
     env = dict(os.environ)
@@ -329,7 +517,11 @@ def parent_main():
 
 
 if __name__ == "__main__":
-    if os.environ.get("_SRT_BENCH_CHILD") == "1":
+    if "--join-micro" in sys.argv:
+        # standalone kernel microbench (ci.sh smoke gate): one JSON line
+        with watcher_paused():
+            print(json.dumps(join_microbench(smoke="--smoke" in sys.argv)))
+    elif os.environ.get("_SRT_BENCH_CHILD") == "1":
         child_main()
     else:
         parent_main()

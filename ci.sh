@@ -34,8 +34,25 @@ python -c '
 import json, sys
 d = json.loads(sys.argv[1])
 assert "metric" in d and d["value"] > 0, d
-print("bench-child dry-run ok:", d["metric"], d["value"], d["unit"])
+assert "spread" in d and "queries" in d, d
+print("bench-child dry-run ok:", d["metric"], d["value"], d["unit"],
+      "spread", d["spread"])
 ' "$bench_line"
+
+echo "== radix spine: kernel interpret tests + join microbench smoke =="
+# the exact kernel set the next chip window's probe latch will exercise,
+# plus the join-spine microbench in smoke mode — parity of the Pallas
+# probe against the lax.sort rank path is a gate, not a hope
+JAX_PLATFORMS=cpu python -m pytest tests/test_pallas.py \
+  tests/test_readahead.py -q
+micro_line=$(JAX_PLATFORMS=cpu python bench.py --join-micro --smoke | tail -1)
+python -c '
+import json, sys
+d = json.loads(sys.argv[1])
+assert d["parity_ok"] and d["matches"] > 0, d
+print("join microbench smoke ok: pallas probe", d["pallas_probe_ms"],
+      "ms vs laxsort rank", d["laxsort_rank_ms"], "ms")
+' "$micro_line"
 
 echo "== api coverage gate (0 missing vs reference GpuOverrides) =="
 python tools/api_validation.py 0 0

@@ -371,10 +371,27 @@ CSV_READ_FLOATS = conf("spark.rapids.tpu.sql.csv.read.float.enabled").doc(
     "(reference spark.rapids.sql.csv.read.float.enabled, same default)"
 ).boolean_conf(False)
 
+SCAN_READAHEAD_DEPTH = conf("spark.rapids.tpu.sql.scan.readahead.depth").doc(
+    "Decoded host batches a file scan prefetches ahead of device compute on "
+    "a background thread (0 disables): host parquet/orc/csv decode of batch "
+    "N+1 overlaps device compute of batch N for every reader strategy "
+    "(reference MultiFileCloudParquetPartitionReader's prefetch role, "
+    "GpuParquetScan.scala:1377, generalized past the MULTITHREADED reader)"
+).integer_conf(2)
+
+SCAN_READAHEAD_MAX_BUFFER = conf(
+    "spark.rapids.tpu.sql.scan.readahead.maxBufferBytes").doc(
+    "Byte cap on host tables buffered by the scan readahead queue; the "
+    "effective budget also shrinks to the spill catalog's free host "
+    "headroom (runtime/memory.scan_readahead_budget) so prefetch never "
+    "competes with host spill storage").bytes_conf("256m")
+
 PALLAS_ENABLED = conf("spark.rapids.tpu.sql.pallas.enabled").doc(
-    "Route the string murmur3 hash and parquet bit-unpack through the "
-    "hand-written Pallas TPU kernels (ops/pallas_kernels.py); when false "
-    "(or off-TPU) the fused-XLA jnp formulations run instead").boolean_conf(True)
+    "Route the string murmur3 hash, parquet bit-unpack, dense group-by "
+    "one-hot matmul, exchange radix partition, and unique-key hash-join "
+    "probe through the hand-written Pallas TPU kernels "
+    "(ops/pallas_kernels.py); when false (or off-TPU) the fused-XLA jnp "
+    "formulations run instead").boolean_conf(True)
 
 BROADCAST_TIMEOUT = conf("spark.rapids.tpu.sql.broadcast.timeout").doc(
     "Seconds a consumer waits for the broadcast relation to materialize; "

@@ -117,24 +117,27 @@ class HashAggregateExec(TpuExec):
                       *([pre] if pre is not None else []),
                       *(prep or [])))
         if batch.columns and not ctx_sensitive:
+            in_cols = [Col.from_vector(c) for c in batch.columns]
+            nr = jnp.asarray(batch.lazy_num_rows, jnp.int32)
+            vmin_t, has_hint = self._key_range_hint(batch, in_cols, nr, merge)
             key = ("agg", merge, fuse.schema_key(
                 self._partial_schema() if merge else self.child.output),
                 tuple(fuse.expr_key(e) for e in self.group_exprs),
                 tuple(fuse.expr_key(e) for e in self.agg_exprs),
                 fuse.expr_key(pre) if pre is not None else None,
                 tuple(fuse.expr_key(e) for e in prep) if prep is not None
-                else None, self.prefilter_on_projected)
+                else None, self.prefilter_on_projected, has_hint)
 
             def build():
-                def kernel(cols, num_rows):
+                def kernel(cols, num_rows, vmin):
                     ctx = EvalContext(cols, num_rows, cols[0].values.shape[0])
-                    return self._agg_kernel(ctx, merge)
+                    return self._agg_kernel(
+                        ctx, merge,
+                        range_hint=(vmin, True) if has_hint else None)
                 return kernel
 
-            in_cols = [Col.from_vector(c) for c in batch.columns]
-            nr = jnp.asarray(batch.lazy_num_rows, jnp.int32)
             compacted, n_groups = fuse.call_fused(
-                key, "HashAggregateExec", build, (in_cols, nr),
+                key, "HashAggregateExec", build, (in_cols, nr, vmin_t),
                 lambda: self._agg_kernel(EvalContext.from_batch(batch), merge))
         else:
             compacted, n_groups = self._agg_kernel(
@@ -142,7 +145,59 @@ class HashAggregateExec(TpuExec):
         cols = [c.to_vector() for c in compacted]
         return ColumnarBatch(cols, n_groups, self._partial_schema())
 
-    def _agg_kernel(self, ctx: EvalContext, merge: bool):
+    def _key_range_hint(self, batch, in_cols, nr, merge: bool):
+        """(vmin_traced, has_hint) for the single-wide-int-key group-by: one
+        cheap min/max reduction + ONE host sync per batch decides whether
+        the key range fits the packed single-operand sort (the join-build
+        strategy-pick pattern, exec/joins._prep_fast_build). A statically
+        64-bit key (LONG/TIMESTAMP) otherwise forces the 2-operand wide
+        sort — ~3x the packed cost at 1M rows (docs/perf_notes.md). Gated
+        to big capacities (below, the comparator fallback is already
+        cheap), keys with no hoisted preprojection (the stats pass reads
+        the raw batch), and int dtypes too wide to pack statically."""
+        from spark_rapids_tpu.runtime import fuse
+        zero = jnp.zeros((), jnp.int64)
+        cap = batch.capacity
+        if (len(self.group_exprs) != 1 or cap < (1 << 17)
+                or (not merge and self.preproject is not None)):
+            return zero, False
+        e = self.group_exprs[0]
+        try:
+            kdt = e.dtype
+        except Exception:  # noqa: BLE001 — unresolvable dtype: no hint
+            return zero, False
+        if (not isinstance(kdt, (T.IntegralType, T.TimestampType))
+                or isinstance(kdt, T.BooleanType)
+                or jnp.iinfo(kdt.jnp_dtype).bits <= 32):
+            return zero, False   # narrow keys already pack statically
+        skey = ("agg_key_stats", merge, fuse.schema_key(
+            self._partial_schema() if merge else self.child.output),
+            fuse.expr_key(e))
+
+        def build():
+            def kernel(cols, num_rows):
+                cap_ = cols[0].values.shape[0]
+                ctx = EvalContext(cols, num_rows, cap_)
+                k = ctx.cols[0] if merge else e.eval(ctx)
+                vals = k.values.astype(jnp.int64)
+                eligible = k.validity & (
+                    jnp.arange(cap_, dtype=jnp.int32) < num_rows)
+                vmin = jnp.min(jnp.where(eligible, vals,
+                                         jnp.iinfo(jnp.int64).max))
+                vmax = jnp.max(jnp.where(eligible, vals,
+                                         jnp.iinfo(jnp.int64).min))
+                return vmin, vmax
+            return kernel
+
+        vmin_t, vmax_t = fuse.call_fused(
+            skey, "HashAggregateExec.key_stats", build, (in_cols, nr),
+            lambda: build()(in_cols, nr))
+        vmin, vmax = int(vmin_t), int(vmax_t)
+        w = 62 - max((cap - 1).bit_length(), 1) - 1
+        fits = vmax >= vmin and (vmax - vmin) < (1 << w)
+        return jnp.asarray(vmin if fits else 0, jnp.int64), fits
+
+    def _agg_kernel(self, ctx: EvalContext, merge: bool, range_hint=None):
         """Pure per-batch aggregation body (traceable)."""
         cap = ctx.capacity
         keep = None
@@ -178,7 +233,9 @@ class HashAggregateExec(TpuExec):
             combined = G.combine_compact_keys(key_cols)
             perm, seg_ids, boundary, live = G.group_segments(
                 [combined] if combined is not None else key_cols,
-                ctx.num_rows, cap)
+                ctx.num_rows, cap,
+                range_hint=(range_hint if combined is None
+                            and len(key_cols) == 1 else None))
             sorted_keys = gather_cols(key_cols, perm, live)
         else:
             if keep is not None:
@@ -273,47 +330,93 @@ class HashAggregateExec(TpuExec):
             live = live & live_mask    # fused prefilter (see _agg_kernel)
         codes = jnp.where(live, codes, jnp.int32(D))   # pad bucket, dropped
 
-        def gsum(vals, mask, acc_dtype, count_like=False):
-            return G.dense_group_sum(vals.astype(acc_dtype), mask & live,
-                                     codes, D, on_tpu,
-                                     count_like=count_like)
+        # memoized child eval + count images: aggregates sharing a child
+        # (sum(x) + avg(x) + count(x)) then feed IDENTICAL arrays to gsum,
+        # so the CPU resolve pass dedups their stacked rows by identity
+        from spark_rapids_tpu.runtime import fuse as _fuse
+        _child_memo: dict = {}
+        _cnt_memo: dict = {}
 
-        rows_per = gsum(jnp.ones((cap,), jnp.int32),
-                        jnp.ones((cap,), jnp.bool_), jnp.int32,
-                        count_like=True)
+        def eval_child(e):
+            k = _fuse.expr_key(e)
+            if k not in _child_memo:
+                _child_memo[k] = e.eval(ctx)
+            return _child_memo[k]
 
-        state_cols = []   # (D,)-length states, padded to D_cap below
-        off = len(key_cols)
-        for e, f in zip(self.agg_exprs, fns):
-            nstates = len(f.state_types)
-            if merge:
-                ins = [ctx.cols[off + i] for i in range(nstates)]
-            elif f.child is None:
-                ins = [Col(jnp.zeros((cap,), jnp.int8), live, T.BYTE)]
-            else:
-                ins = [f.child.eval(ctx)]
-            off += nstates
-            if isinstance(f, Count):
-                s = gsum(ins[0].validity.astype(jnp.int64)
-                         if not merge else ins[0].values,
-                         ins[0].validity, jnp.int64,
-                         count_like=not merge)    # update inputs are 0/1
-                state_cols.append(Col(s, jnp.ones_like(s, jnp.bool_),
-                                      T.LONG))
-                continue
-            sum_t = f.state_types[0]
-            acc = sum_t.jnp_dtype
-            s = gsum(ins[0].values, ins[0].validity, acc)
-            cnt = gsum(ins[0].validity.astype(jnp.int64), ins[0].validity,
-                       jnp.int64, count_like=True)   # validity is 0/1
-            state_cols.append(Col(s, cnt > 0, sum_t))
-            if isinstance(f, Average):
+        def cnt_vals(col):
+            a = _cnt_memo.get(id(col))
+            if a is None:
+                a = col.validity.astype(jnp.int64)
+                _cnt_memo[id(col)] = a
+            return a
+
+        def _state_cols(gsum):
+            """One walk over the aggregate list through `gsum`; the CPU path
+            runs it twice (record, then replay) so every f64-safe reduction
+            lands in one stacked masked-matvec pass
+            (G.resolve_dense_group_sums)."""
+            rows_per = gsum(jnp.ones((cap,), jnp.int32),
+                            jnp.ones((cap,), jnp.bool_), jnp.int32,
+                            count_like=True)
+            state_cols = []   # (D,)-length states, padded to D_cap below
+            off = len(key_cols)
+            for e, f in zip(self.agg_exprs, fns):
+                nstates = len(f.state_types)
                 if merge:
-                    c2 = gsum(ins[1].values, ins[1].validity, jnp.int64)
+                    ins = [ctx.cols[off + i] for i in range(nstates)]
+                elif f.child is None:
+                    ins = [Col(jnp.zeros((cap,), jnp.int8), live, T.BYTE)]
                 else:
-                    c2 = cnt
-                state_cols.append(Col(c2, jnp.ones_like(c2, jnp.bool_),
-                                      T.LONG))
+                    ins = [eval_child(f.child)]
+                off += nstates
+                if isinstance(f, Count):
+                    s = gsum(cnt_vals(ins[0])
+                             if not merge else ins[0].values,
+                             ins[0].validity, jnp.int64,
+                             count_like=not merge)   # update inputs are 0/1
+                    state_cols.append(Col(s, jnp.ones_like(s, jnp.bool_),
+                                          T.LONG))
+                    continue
+                sum_t = f.state_types[0]
+                acc = sum_t.jnp_dtype
+                s = gsum(ins[0].values, ins[0].validity, acc)
+                cnt = gsum(cnt_vals(ins[0]), ins[0].validity,
+                           jnp.int64, count_like=True)   # validity is 0/1
+                state_cols.append(Col(s, cnt > 0, sum_t))
+                if isinstance(f, Average):
+                    if merge:
+                        c2 = gsum(ins[1].values, ins[1].validity, jnp.int64)
+                    else:
+                        c2 = cnt
+                    state_cols.append(Col(c2, jnp.ones_like(c2, jnp.bool_),
+                                          T.LONG))
+            return rows_per, state_cols
+
+        if on_tpu:
+            def gsum(vals, mask, acc_dtype, count_like=False):
+                return G.dense_group_sum(vals.astype(acc_dtype), mask & live,
+                                         codes, D, on_tpu,
+                                         count_like=count_like)
+            rows_per, state_cols = _state_cols(gsum)
+        else:
+            # CPU: XLA's scatter-add costs ~50 ms per column at 1M rows
+            # (numpy bincount: ~6 ms); batching every f64-safe reduction of
+            # the batch into one shared-one-hot GEMM amortizes the one-hot
+            # materialization and runs ~6x faster for q1-shaped aggregates.
+            # Record pass enumerates the requests (outputs discarded),
+            # replay pass rebuilds the states from the batched results.
+            reqs = []
+
+            def record(vals, mask, acc_dtype, count_like=False):
+                reqs.append((vals, mask, acc_dtype, count_like))
+                return jnp.zeros((D,), acc_dtype)
+            _state_cols(record)
+            results = G.resolve_dense_group_sums(reqs, codes, D, live)
+            it = iter(results)
+
+            def replay(vals, mask, acc_dtype, count_like=False):
+                return next(it)
+            rows_per, state_cols = _state_cols(replay)
 
         # decode bucket index -> key columns (inverse of the stride mix)
         D_cap = bucket_capacity(D)

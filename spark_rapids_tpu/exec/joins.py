@@ -211,6 +211,40 @@ class _JoinCore:
                 self._vmin = vmin
                 return
 
+        from spark_rapids_tpu.ops import pallas_kernels as PK
+        # Pallas VMEM hash table (sparse domains the dense table can't
+        # afford; the TPU path where large scatters rule `dense` out).
+        # vmin > int64 min keeps the slot sentinel unambiguous; the build
+        # itself refuses duplicate keys / overfull buckets via `ok`.
+        nb = PK.hash_join_buckets(self.n_build)
+        if (nb and self.n_build > 0 and self.build_matched_acc is None
+                and vmin > jnp.iinfo(jnp.int64).min
+                and PK.should_use("hashjoin")):
+            def mktable_hash(k, n_build):
+                vals = k.values.astype(jnp.int8) \
+                    if k.values.dtype == jnp.bool_ else k.values
+                eligible = k.validity & (
+                    jnp.arange(cap, dtype=jnp.int32) < n_build)
+                return PK.hash_join_build(vals.astype(jnp.int64),
+                                          eligible, nb)
+            hkey = ("join_build_hash", k.dtype, cap, nb)
+            hargs = (k, n_build_t)
+            tk_t, tr_t, ok_t = fuse.call_fused(
+                hkey, "HashJoin.build_prep", lambda: mktable_hash, hargs,
+                lambda: mktable_hash(*hargs))
+            if bool(ok_t):    # one host sync per build, like vmin/vmax
+                self._probe_mode = "pallas_hash"
+                self._hash_buckets = nb
+                self._hash_keys, self._hash_rows = tk_t, tr_t
+                # probe positions ARE build-row indices
+                self._build_perm = jnp.arange(cap, dtype=jnp.int32)
+                self._sorted_build = (k.values.astype(jnp.int8)
+                                      if k.values.dtype == jnp.bool_
+                                      else k.values)  # dtype carrier only
+                self._n_valid = n_valid
+                self._vmin = vmin
+                return
+
         if packable:
             def prep(k, n_build, vmin):
                 vals = k.values.astype(jnp.int8) \
@@ -362,19 +396,23 @@ class _JoinCore:
 
     def _probe_batch_fast(self, stream_batch, jt, track_matched):
         """Pre-sorted-build probe. Modes (chosen at build, static per compiled
-        kernel): "dense" = O(1) direct-address rank-table gather (unique keys,
-        compact domain); "one" = single searchsorted + equality (unique keys);
-        "two" = general left+right searchsorted."""
+        kernel): "pallas_hash" = VMEM hash-table probe kernel (unique keys;
+        pallas_kernels.hash_join_probe, interpret-mode off-TPU); "dense" =
+        O(1) direct-address rank-table gather (unique keys, compact domain);
+        "one" = single searchsorted + equality (unique keys); "two" = general
+        left+right searchsorted."""
+        from spark_rapids_tpu.ops import pallas_kernels as PK
         from spark_rapids_tpu.runtime import fuse
         stream_key_exprs = self.stream_key_exprs
         mode = self._probe_mode
         vmin = self._vmin
         dsize = getattr(self, "_dense_size", 0)
+        hash_buckets = getattr(self, "_hash_buckets", 0)
 
         stream_prefilter = self.stream_prefilter
 
         def kernel(sorted_build, n_valid, n_build, build_keys_raw, stream_cols,
-                   n_stream, dense_table):
+                   n_stream, dense_table, hash_keys, hash_rows):
             scap = stream_cols[0].values.shape[0]
             sctx = EvalContext(stream_cols, n_stream, scap)
             k = stream_key_exprs[0].eval(sctx)
@@ -393,7 +431,16 @@ class _JoinCore:
                                       n_stream, scap)
             else:
                 live = jnp.arange(scap, dtype=jnp.int32) < n_stream
-            if mode == "dense":
+            if mode == "pallas_hash":
+                # equality over int64 images is equality over any narrower
+                # int key dtype, so no common-type promotion dance needed
+                pos, found = PK.hash_join_probe(
+                    hash_keys, hash_rows, svals.astype(jnp.int64),
+                    hash_buckets)
+                hit = found & k.validity & live
+                lo = jnp.where(hit, pos, 0).astype(jnp.int32)
+                hi = jnp.where(hit, pos + 1, lo).astype(jnp.int32)
+            elif mode == "dense":
                 slot = svals.astype(jnp.int64) - vmin
                 in_dom = (slot >= 0) & (slot < dsize - 1)
                 r = dense_table[jnp.clip(slot, 0, dsize - 1)]
@@ -441,11 +488,13 @@ class _JoinCore:
                 return lo, hi, counts, total, (bhi > blo) & b_eligible
             return lo, hi, counts, total, None
 
-        # vmin/dsize are traced into the program only in dense mode; keying
-        # them otherwise would recompile per distinct build key range
+        # vmin/dsize/bucket count are traced into the program only in their
+        # own modes; keying them otherwise would recompile per distinct
+        # build key range
         key = ("join_probe_fast", jt, track_matched, mode,
                vmin if mode == "dense" else None,
                dsize if mode == "dense" else None,
+               hash_buckets if mode == "pallas_hash" else None,
                self._stream_key_key,
                fuse.schema_key(stream_batch.schema)
                if stream_batch.schema else None)
@@ -453,9 +502,13 @@ class _JoinCore:
         n_stream = jnp.asarray(stream_batch.lazy_num_rows, jnp.int32)
         dense = (self._dense_table if mode == "dense"
                  else jnp.zeros((1,), jnp.int32))
+        hk = (self._hash_keys if mode == "pallas_hash"
+              else jnp.zeros((1,), jnp.int64))
+        hr = (self._hash_rows if mode == "pallas_hash"
+              else jnp.zeros((1,), jnp.int32))
         args = (self._sorted_build, self._n_valid,
                 jnp.asarray(self.n_build, jnp.int32), self.build_keys_raw,
-                stream_cols, n_stream, dense)
+                stream_cols, n_stream, dense, hk, hr)
         lo, hi, counts, total, matched = fuse.call_fused(
             key, "HashJoin.probe", lambda: kernel, args,
             lambda: kernel(*args))

@@ -414,9 +414,20 @@ class FileSourceScanExec(TpuExec):
         host_meta = _scan_meta(part.paths[0]) if len(part.paths) == 1 else None
 
         def it():
-            for tbl in self.node.tables_for(
-                    split, batch_rows, strategy, threads,
-                    rebase_mode=conf.get(CFG.PARQUET_REBASE_MODE)):
+            gen = self.node.tables_for(
+                split, batch_rows, strategy, threads,
+                rebase_mode=conf.get(CFG.PARQUET_REBASE_MODE))
+            depth = conf.get(CFG.SCAN_READAHEAD_DEPTH)
+            if depth > 0:
+                # readahead stays BEFORE the semaphore: it buffers host
+                # arrow tables only, so admission control still gates every
+                # device upload
+                from spark_rapids_tpu.runtime.memory import (
+                    scan_readahead_budget)
+                gen = R.readahead_tables(
+                    gen, depth, scan_readahead_budget(
+                        conf.get(CFG.SCAN_READAHEAD_MAX_BUFFER)))
+            for tbl in gen:
                 acquire_semaphore(self.metrics)
                 with trace_range("FileScan.h2d", self._scan_time):
                     batch = ColumnarBatch.from_arrow(tbl, self.output)

@@ -17,11 +17,12 @@ bulk bits on device):
   (PageHeader / ColumnMetaData / FileMetaData — mirror image of
   parquet_native._CompactReader), page compression, file assembly.
 
-Codecs: UNCOMPRESSED, GZIP (zlib, real compression), SNAPPY (spec-valid
-literal framing — readable by any Parquet reader; the codec exists for
-compatibility with readers that expect the default codec, it does not
-compress). Schemas with list columns or decimals beyond DECIMAL64 fall back
-to the arrow writer (io/writer.py routes).
+Codecs: UNCOMPRESSED, GZIP (zlib, real compression), SNAPPY (real
+compression via pyarrow's bundled codec — the same `pa.Codec` the ORC
+native writer uses, io/orc_write_native.py:_compress_chunked; spec-valid
+all-literal framing remains as the fallback if the codec is unavailable).
+Schemas with list columns or decimals beyond DECIMAL64 fall back to the
+arrow writer (io/writer.py routes).
 """
 
 from __future__ import annotations
@@ -259,9 +260,22 @@ def _def_levels_v1(valid: np.ndarray) -> bytes:
     return struct.pack("<I", len(body)) + body
 
 
+def _snappy(raw: bytes) -> bytes:
+    """Real SNAPPY page compression via pyarrow's bundled codec (ported
+    from the ORC writer, io/orc_write_native.py:77 — parquet compresses the
+    whole page body as one raw snappy block, no chunk headers needed).
+    Falls back to the spec-valid all-literal framing when the codec is
+    missing from the arrow build."""
+    try:
+        import pyarrow as pa
+        return bytes(pa.Codec("snappy").compress(raw))
+    except (ImportError, NotImplementedError, OSError):
+        return _snappy_literal(raw)
+
+
 def _snappy_literal(raw: bytes) -> bytes:
     """Spec-valid snappy framing of one all-literal chunk (no compression —
-    see module docstring)."""
+    the _snappy fallback)."""
     n = len(raw)
     out = bytearray(_varint(n))
     if n == 0:
@@ -284,7 +298,7 @@ def _compress(raw: bytes, codec: str) -> bytes:
         co = zlib.compressobj(6, zlib.DEFLATED, 31)
         return co.compress(raw) + co.flush()
     if codec == "snappy":
-        return _snappy_literal(raw)
+        return _snappy(raw)
     raise ValueError(f"native parquet writer: codec {codec}")
 
 

@@ -30,10 +30,12 @@ def compact_cols(cols, keep_mask):
       the running kept-count (one cumsum + one searchsorted) — gathers
       vectorize on the TPU while scatters serialize (the same reason
       ops/grouping.py uses scan-based segment reductions).
-    - CPU: one cumsum + a scatter-with-drop per column (dropped rows target
-      index `capacity`, which XLA discards). XLA:CPU's searchsorted lowers to
-      ~log2(cap) gather sweeps and measured ~8x slower than the scatter
-      (docs/perf_notes.md round-4)."""
+    - CPU: ONE scatter-with-drop builds the front-compaction permutation,
+      then every column rides cheap gathers. XLA:CPU's scatter costs ~50 ms
+      per array at 1M rows while a gather is ~8 ms, so paying the scatter
+      once instead of twice per column is ~3x at two columns and grows with
+      width; searchsorted lowers to ~log2(cap) gather sweeps and measured
+      ~8x slower still (docs/perf_notes.md round-4)."""
     capacity = keep_mask.shape[0]
     running = jnp.cumsum(keep_mask.astype(jnp.int32))
     count = running[-1]
@@ -43,13 +45,13 @@ def compact_cols(cols, keep_mask):
     from spark_rapids_tpu.runtime.hw import scatters_cheap
     if scatters_cheap():
         dest = jnp.where(keep_mask, running - 1, capacity)
+        perm = jnp.zeros((capacity,), jnp.int32).at[dest].set(
+            j, mode="drop")
         for c in cols:
+            vals = c.values[perm]
+            validity = c.validity[perm] & live
             default = jnp.asarray(c.dtype.default_value(),
-                                  dtype=c.values.dtype)
-            vals = jnp.full((capacity,), default, c.values.dtype
-                            ).at[dest].set(c.values, mode="drop")
-            validity = jnp.zeros((capacity,), jnp.bool_
-                                 ).at[dest].set(c.validity, mode="drop")
+                                  dtype=vals.dtype)
             out.append(Col(jnp.where(validity, vals, default), validity,
                            c.dtype, c.dictionary))
         return out, count

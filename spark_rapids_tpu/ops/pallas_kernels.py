@@ -17,6 +17,16 @@ Mosaic lowers badly:
   occupy exactly ``4*bw`` 32-bit words, so value lane j always reads word
   ``(j*bw)>>5`` — a static column index. The unpack becomes a per-lane
   shift/mask over statically-selected columns: zero gathers.
+* ``radix_ranks``: stable counting-sort ranks over a small partition domain
+  as dense (BK, DP) one-hot cumsums, with the sequential TPU grid carrying
+  the per-partition running count between row tiles. Backs both the
+  exchange partition step (GpuPartitioning.sliceInternalOnGpu analog) and
+  the hash-table build.
+* ``hash_join_build``/``hash_join_probe``: the cudf innerJoinGatherMaps
+  analog (GpuHashJoin.scala:289) for unique fixed-point keys — an open
+  (H, HJ_SLOTS) hash table whose build is a radix partition by Fibonacci
+  hash bucket and whose probe unrolls the slot loop statically over a
+  VMEM-resident table.
 
 Dispatch: compiled on TPU; ``interpret=True`` elsewhere (tests force the
 CPU platform). The jnp reference implementations in ops/hashing.py and
@@ -75,6 +85,15 @@ def _probe_tpu(kernel: str) -> bool:
                 jax.block_until_ready(
                     onehot_sum_f32(jnp.ones((256,), jnp.float32),
                                    jnp.zeros((256,), jnp.int32), 140))
+            elif kernel == "radix":
+                ids = jnp.asarray([1, 0, 2, 1, 0, 3, 3, 0], jnp.int32)
+                jax.block_until_ready(radix_partition_permutation(ids, 4))
+            elif kernel == "hashjoin":
+                keys = jnp.arange(16, dtype=jnp.int64)
+                elig = jnp.ones((16,), jnp.bool_)
+                tk, tr, ok = hash_join_build(keys, elig, 128)
+                jax.block_until_ready(
+                    hash_join_probe(tk, tr, keys[:8], 128))
             else:
                 raise ValueError(f"unknown pallas kernel {kernel!r}")
             _TPU_PROBE[kernel] = True
@@ -303,3 +322,195 @@ def onehot_sum_f32(vals, codes, n_domain: int):
         interpret=_interpret(),
     )(codes2, vals2)
     return out[0, :n_domain]
+
+
+# ---------------------------------------------------------------------------
+# radix partition (stable counting-sort ranks over small partition domains)
+# ---------------------------------------------------------------------------
+
+_RP_BK = 256            # max rows per grid step
+_RP_TILE_BUDGET = 1 << 19  # one-hot tile elements (2 MB i32): bk*dp bound
+RADIX_MAX_PARTS = 4096  # lane cap (hash_join_buckets tops out here)
+
+
+def _radix_kernel(ids_ref, rank_ref, counts_ref, *, bk: int, dp: int):
+    """One grid step over a row tile. The per-partition running count
+    (`counts_ref`, one block revisited every step — the sequential TPU grid
+    is the carry chain) turns per-tile exclusive one-hot cumsums into global
+    stable ranks: rank(row) = rows with the same id in earlier tiles +
+    same-id rows above it in this tile. All dense (BK, DP) VPU work — the
+    scatter that cudf's radix partition would do stays outside the kernel."""
+    k = pl.program_id(0)
+
+    @pl.when(k == 0)
+    def _init():
+        counts_ref[...] = jnp.zeros_like(counts_ref)
+
+    ids = ids_ref[0, :]                                   # (bk,) int32
+    lanes = lax.broadcasted_iota(jnp.int32, (bk, dp), 1)
+    onehot = (ids[:, None] == lanes).astype(jnp.int32)    # out-of-range → 0s
+    carry = counts_ref[0, :]                              # (dp,) prior tiles
+    incl = jnp.cumsum(onehot, axis=0)
+    rank_ref[0, :] = jnp.sum(onehot * (incl - onehot + carry[None, :]),
+                             axis=1, dtype=jnp.int32)
+    counts_ref[0, :] = carry + incl[-1, :]
+
+
+def radix_ranks(ids, num_lanes: int):
+    """Stable radix ranks: for int32 `ids` in [0, num_lanes), returns
+    (ranks, counts) where ranks[i] = #{j < i : ids[j] == ids[i]} and
+    counts[l] = #{ids == l}. Ids outside [0, num_lanes) (padding sentinel)
+    get rank 0 and are not counted."""
+    cap = ids.shape[0]
+    dp = -(-max(num_lanes, 1) // 128) * 128
+    if dp > RADIX_MAX_PARTS:
+        raise ValueError(f"radix domain {num_lanes} exceeds {RADIX_MAX_PARTS}")
+    bk = min(_RP_BK, max(8, cap), max(8, _RP_TILE_BUDGET // dp))
+    n_pad = -(-cap // bk) * bk
+    # out-of-range ids (incl. callers' padding sentinels) map to id=dp —
+    # no lane match, so zero rank and zero count; dp-pad lanes beyond
+    # num_lanes must not silently rank rows either
+    ids = ids.astype(jnp.int32)
+    ids = jnp.where((ids >= 0) & (ids < num_lanes), ids, jnp.int32(dp))
+    ids_p = jnp.full((1, n_pad), dp, jnp.int32).at[0, :cap].set(ids)
+    ranks, counts = pl.pallas_call(
+        functools.partial(_radix_kernel, bk=bk, dp=dp),
+        out_shape=[jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
+                   jax.ShapeDtypeStruct((1, dp), jnp.int32)],
+        grid=(n_pad // bk,),
+        in_specs=[pl.BlockSpec((1, bk), lambda i: (0, i))],
+        out_specs=[pl.BlockSpec((1, bk), lambda i: (0, i)),
+                   pl.BlockSpec((1, dp), lambda i: (0, 0))],
+        interpret=_interpret(),
+    )(ids_p)
+    return ranks[0, :cap], counts[0, :num_lanes]
+
+
+def radix_partition_permutation(ids, num_lanes: int):
+    """Stable permutation grouping rows by id (== argsort(ids, stable) for
+    ids in [0, num_lanes)) via the radix-rank kernel plus one 1:1 scatter —
+    the GpuPartitioning.sliceInternalOnGpu radix analog, replacing the
+    comparator `lax.sort` the partition step otherwise pays."""
+    cap = ids.shape[0]
+    ranks, counts = radix_ranks(ids, num_lanes)
+    offsets = jnp.cumsum(counts) - counts                 # exclusive
+    dest = offsets[jnp.clip(ids, 0, num_lanes - 1)] + ranks
+    return jnp.zeros((cap,), jnp.int32).at[dest].set(
+        jnp.arange(cap, dtype=jnp.int32), mode="drop")
+
+
+# ---------------------------------------------------------------------------
+# VMEM hash-table join build + probe (unique fixed-point keys)
+# ---------------------------------------------------------------------------
+
+HJ_SLOTS = 8            # bucket capacity; build falls back above this load
+_HJ_TILE = 8192         # stream rows per grid step: big tiles keep the grid
+#                         short (interpret mode pays per-step overhead; the
+#                         (tile, HJ_SLOTS) gather is ~512 KB in VMEM)
+_HJ_EMPTY = np.int64(np.iinfo(np.int64).min)  # slot sentinel (engage gate
+#                                               requires vmin > int64 min)
+# Fibonacci multiplicative constant 0x9E3779B97F4A7C15 as a signed int64
+_HJ_MULT = np.int64(np.uint64(0x9E3779B97F4A7C15).astype(np.int64))
+
+
+def _hj_bucket(vals_i64, h_bits: int):
+    h = vals_i64 * _HJ_MULT
+    return lax.shift_right_logical(h, jnp.int64(64 - h_bits)).astype(jnp.int32)
+
+
+def hash_join_build(keys_i64, eligible, num_buckets: int):
+    """Build the (num_buckets, HJ_SLOTS) open hash table over unique int64
+    keys: bucket = Fibonacci hash of the key, slot = the key's stable radix
+    rank within its bucket (the radix kernel again — build IS a radix
+    partition by hash bucket). Returns (table_keys, table_rows, ok) flat
+    (H*S,) arrays + a device scalar; ok=False means a bucket overflowed
+    HJ_SLOTS and the table must be discarded (caller falls back to the
+    searchsorted probe). cudf's innerJoinGatherMaps builds the same shape
+    with atomics (GpuHashJoin.scala:289); here the bucket ranks come from
+    the sequential-grid carry chain instead."""
+    if num_buckets & (num_buckets - 1) or num_buckets < 128:
+        raise ValueError(f"num_buckets {num_buckets}: need a power of two >= 128")
+    h_bits = num_buckets.bit_length() - 1
+    cap = keys_i64.shape[0]
+    bucket = jnp.where(eligible, _hj_bucket(keys_i64, h_bits),
+                       jnp.int32(num_buckets))            # sentinel lane
+    ranks, counts = radix_ranks(bucket, num_buckets)
+    ok = jnp.max(counts) <= HJ_SLOTS
+    slot = bucket * HJ_SLOTS + jnp.minimum(ranks, HJ_SLOTS - 1)
+    slot = jnp.where(eligible, slot, jnp.int32(num_buckets * HJ_SLOTS))
+    table_keys = jnp.full((num_buckets * HJ_SLOTS,), _HJ_EMPTY,
+                          jnp.int64).at[slot].set(keys_i64, mode="drop")
+    table_rows = jnp.full((num_buckets * HJ_SLOTS,), -1,
+                          jnp.int32).at[slot].set(
+        jnp.arange(cap, dtype=jnp.int32), mode="drop")
+    # duplicate keys land in one bucket (same hash) with distinct ranks: the
+    # unique-keys probe contract would silently under-count them, so the
+    # build refuses — S*(S-1)/2 static column compares over the table
+    t2 = table_keys.reshape(num_buckets, HJ_SLOTS)
+    dup = jnp.zeros((), jnp.bool_)
+    for s in range(HJ_SLOTS):
+        for t in range(s + 1, HJ_SLOTS):
+            dup = dup | jnp.any((t2[:, s] == t2[:, t])
+                                & (t2[:, s] != _HJ_EMPTY))
+    return table_keys, table_rows, ok & ~dup
+
+
+def _hash_probe_kernel(sk_ref, tk_ref, tr_ref, pos_ref, found_ref,
+                       *, h_bits: int):
+    """Probe one stream tile against the whole table (resident in VMEM —
+    both table blocks map to (0, 0) every grid step). The slot loop unrolls
+    statically; the only dynamic access is the per-row bucket gather, the
+    same class as the engine's dictionary-decode gathers."""
+    svals = sk_ref[0, :]                                  # (T,) int64
+    base = _hj_bucket(svals, h_bits) * HJ_SLOTS
+    tk = tk_ref[0, :]
+    tr = tr_ref[0, :]
+    pos = jnp.full(svals.shape, -1, jnp.int32)
+    found = jnp.zeros(svals.shape, jnp.bool_)
+    for s in range(HJ_SLOTS):
+        cand = tk[base + s]
+        hit = cand == svals                               # EMPTY never matches
+        pos = jnp.where(hit, tr[base + s], pos)
+        found = found | hit
+    pos_ref[0, :] = pos
+    found_ref[0, :] = found.astype(jnp.int32)
+
+
+def hash_join_probe(table_keys, table_rows, stream_i64, num_buckets: int):
+    """(build_row, found) per stream key — the innerJoinGatherMaps probe.
+    Unique-keys contract: at most one slot matches. Validity/liveness
+    masking is the caller's job (hash of an invalid row's value is
+    harmless; its hit is masked off outside)."""
+    h_bits = num_buckets.bit_length() - 1
+    n = stream_i64.shape[0]
+    tile = min(_HJ_TILE, max(8, n))
+    n_pad = -(-n // tile) * tile
+    hs = num_buckets * HJ_SLOTS
+    sp = jnp.zeros((1, n_pad), jnp.int64).at[0, :n].set(stream_i64)
+    pos, found = pl.pallas_call(
+        functools.partial(_hash_probe_kernel, h_bits=h_bits),
+        out_shape=[jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
+                   jax.ShapeDtypeStruct((1, n_pad), jnp.int32)],
+        grid=(n_pad // tile,),
+        in_specs=[
+            pl.BlockSpec((1, tile), lambda i: (0, i)),
+            pl.BlockSpec((1, hs), lambda i: (0, 0)),
+            pl.BlockSpec((1, hs), lambda i: (0, 0)),
+        ],
+        out_specs=[pl.BlockSpec((1, tile), lambda i: (0, i)),
+                   pl.BlockSpec((1, tile), lambda i: (0, i))],
+        interpret=_interpret(),
+    )(sp, table_keys.reshape(1, hs), table_rows.reshape(1, hs))
+    return pos[0, :n], found[0, :n].astype(jnp.bool_)
+
+
+def hash_join_buckets(n_build: int) -> int:
+    """Bucket count for a build of `n_build` rows: ~0.25 load factor over
+    HJ_SLOTS-deep buckets, clamped to the VMEM table budget. Returns 0 when
+    the build cannot meet the load factor (too big — caller falls back)."""
+    want = 128
+    while want * HJ_SLOTS < 4 * max(n_build, 1) and want < 4096:
+        want *= 2
+    if want * HJ_SLOTS < 2 * n_build:   # >0.5 load: overflow too likely
+        return 0
+    return want

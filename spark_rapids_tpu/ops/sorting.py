@@ -78,14 +78,43 @@ def _key_bits(c: Col) -> int | None:
     return None
 
 
-def _packed_key(key_cols, orders, num_rows, capacity: int):
+def _packed_key(key_cols, orders, num_rows, capacity: int,
+                range_hint=None):
     """Pack (pad-rank, per-key null-rank + value image, row index) into ONE
     int64 sort operand. lax.sort cost grows steeply with operand count
     (~4x from 1 to 4 operands at 256k rows on both CPU and TPU backends), so
     a single packed operand with the row index in the low bits — uniqueness
     makes stability free — is the fast path whenever the static widths fit.
-    Returns None when the keys cannot be packed order-faithfully."""
+    Returns None when the keys cannot be packed order-faithfully.
+
+    `range_hint=(vmin, vmax_minus_vmin_fits)` (single int key only) lets a
+    caller that already paid a range reduction + host sync (the join-build
+    pattern, exec/aggregate.py) pack a statically-too-wide int64 key as
+    `value - vmin`: vmin rides in as a TRACED scalar so one compiled
+    program serves every in-range batch."""
     iota_bits = max((capacity - 1).bit_length(), 1)
+    if (range_hint is not None and len(key_cols) == 1
+            and isinstance(key_cols[0].dtype,
+                           (T.IntegralType, T.DateType, T.TimestampType))
+            and not isinstance(key_cols[0].dtype, T.BooleanType)):
+        vmin, fits = range_hint
+        if fits:
+            c, o = key_cols[0], orders[0]
+            w = 62 - iota_bits - 1      # value bits left beside the ranks
+            nf = o.resolved_nulls_first
+            acc = (jnp.arange(capacity, dtype=jnp.int32)
+                   >= num_rows).astype(jnp.int64)
+            null_rank = jnp.where(c.validity, jnp.int64(1 if nf else 0),
+                                  jnp.int64(0 if nf else 1))
+            acc = (acc << 1) | null_rank
+            u = c.values.astype(jnp.int64) - vmin
+            u = jnp.clip(u, 0, (1 << w) - 1)
+            u = jnp.where(c.validity, u, 0)
+            if not o.ascending:
+                u = ((1 << w) - 1) - u
+            acc = (acc << w) | u
+            return ((acc << iota_bits)
+                    | jnp.arange(capacity, dtype=jnp.int64)), iota_bits
     total = 1 + iota_bits  # pad rank + tiebreaker
     widths = []
     for c in key_cols:
@@ -117,13 +146,54 @@ def _packed_key(key_cols, orders, num_rows, capacity: int):
     return (acc << iota_bits) | jnp.arange(capacity, dtype=jnp.int64), iota_bits
 
 
-def sort_permutation(key_cols, orders, num_rows, capacity: int):
+def _wide_single_key(key_cols, orders, num_rows, capacity: int):
+    """Single int key too wide for the packed operand (int64/timestamp):
+    TWO int64 operands instead of the 4-operand stable comparator sort
+    (~2.6x cheaper at 1M rows). Operand 1 is the order image with null/pad
+    rows forced to the extremes; operand 2 carries (rank, row-index) so
+    rank ties between a real extreme value, a null, and padding resolve
+    correctly and the unique index makes stability free."""
+    if len(key_cols) != 1:
+        return None
+    c, o = key_cols[0], orders[0]
+    if (not isinstance(c.dtype, (T.IntegralType, T.DateType,
+                                 T.TimestampType))
+            or isinstance(c.dtype, T.BooleanType)):
+        return None
+    if _key_bits(c) is not None:
+        return None   # narrow enough for the packed path
+    big = jnp.iinfo(jnp.int64).max
+    small = jnp.iinfo(jnp.int64).min
+    v = c.values.astype(jnp.int64)
+    if not o.ascending:
+        v = ~v        # order-reversing, overflow-free
+    nf = o.resolved_nulls_first
+    v = jnp.where(c.validity, v, small if nf else big)
+    live = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+    v = jnp.where(live, v, big)
+    # rank: valid 1; nulls 0 (first) or 2 (last); padding 3 — dominates
+    # operand-1 ties against real extreme values
+    rank = jnp.where(c.validity, jnp.int64(1),
+                     jnp.int64(0 if nf else 2))
+    rank = jnp.where(live, rank, jnp.int64(3))
+    iota_bits = max((capacity - 1).bit_length(), 1)
+    op2 = (rank << iota_bits) | jnp.arange(capacity, dtype=jnp.int64)
+    _, s2 = lax.sort((v, op2), num_keys=2, is_stable=False)
+    return (s2 & ((1 << iota_bits) - 1)).astype(jnp.int32)
+
+
+def sort_permutation(key_cols, orders, num_rows, capacity: int,
+                     range_hint=None):
     """Stable permutation sorting live rows by keys; padding sinks to the end."""
-    packed = _packed_key(key_cols, orders, num_rows, capacity)
+    packed = _packed_key(key_cols, orders, num_rows, capacity,
+                         range_hint=range_hint)
     if packed is not None:
         key, iota_bits = packed
         (s,) = lax.sort((key,), num_keys=1, is_stable=False)
         return (s & ((1 << iota_bits) - 1)).astype(jnp.int32)
+    wide = _wide_single_key(key_cols, orders, num_rows, capacity)
+    if wide is not None:
+        return wide
     pad_rank = (jnp.arange(capacity, dtype=jnp.int32) >= num_rows).astype(jnp.int8)
     operands = [pad_rank]
     for c, o in zip(key_cols, orders):
@@ -138,3 +208,21 @@ def sort_cols(cols, key_indices, orders, num_rows, capacity):
     perm = sort_permutation([cols[i] for i in key_indices], orders, num_rows, capacity)
     live = jnp.arange(capacity, dtype=jnp.int32) < num_rows
     return gather_cols(cols, perm, live)
+
+
+def partition_permutation(part_ids, num_partitions: int, num_rows,
+                          capacity: int):
+    """Stable permutation grouping live rows by partition id with padding
+    sunk to the end — the exchange partition step. Ids are a tiny dense
+    domain, so a comparator sort is overkill: when the radix latch is up
+    the Pallas counting-rank kernel (pallas_kernels.radix_partition_permutation)
+    produces the permutation from one-hot cumsums; otherwise the stable
+    argsort stands in."""
+    from spark_rapids_tpu.ops import pallas_kernels as PK
+    live = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+    ids = jnp.where(live, part_ids.astype(jnp.int32),
+                    jnp.int32(num_partitions))
+    if (num_partitions + 1 <= PK.RADIX_MAX_PARTS
+            and PK.should_use("radix")):
+        return PK.radix_partition_permutation(ids, num_partitions + 1)
+    return jnp.argsort(ids, stable=True)

@@ -337,6 +337,17 @@ class BufferCatalog:
         return len(self._buffers)
 
 
+def scan_readahead_budget(max_buffer_bytes: int) -> int:
+    """Byte budget for scan-readahead host buffering: the configured cap,
+    shrunk to the spill catalog's free host headroom so prefetched tables
+    never evict spilled device buffers to disk. The floor guarantees the
+    readahead thread can always stage at least one typical reader batch
+    (a zero budget would serialize decode behind compute again)."""
+    cat = DeviceManager.get().catalog
+    headroom = max(cat.host_budget - cat.host_bytes, 0)
+    return max(min(max_buffer_bytes, headroom), 16 << 20)
+
+
 class SpillableColumnarBatch:
     """Handle over a catalogued batch; keeps data spillable while an operator holds it
     (reference SpillableColumnarBatch.scala:29,74)."""

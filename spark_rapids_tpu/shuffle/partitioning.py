@@ -93,9 +93,11 @@ def slice_into_partitions(batch: ColumnarBatch, part_ids, num_partitions: int):
     cap = batch.capacity
     n = batch.num_rows
     live = jnp.arange(cap, dtype=jnp.int32) < n
-    # padding rows sort to the end via a sentinel id
     ids = jnp.where(live, part_ids.astype(jnp.int32), jnp.int32(num_partitions))
-    perm = jnp.argsort(ids, stable=True)
+    # radix-rank kernel when latched, stable argsort otherwise; padding rows
+    # sink to the end via the sentinel id either way
+    from spark_rapids_tpu.ops.sorting import partition_permutation
+    perm = partition_permutation(part_ids, num_partitions, n, cap)
     cols = [Col.from_vector(c) for c in batch.columns]
     sorted_cols = gather_cols(cols, perm, live[perm])
     counts = np.asarray(jnp.bincount(ids, length=num_partitions + 1))[:num_partitions]

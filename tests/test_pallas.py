@@ -163,6 +163,176 @@ def test_onehot_sum_matches_numpy():
                                                             minlength=1024))
 
 
+def _np_stable_ranks(ids, lanes):
+    ranks = np.zeros(len(ids), np.int32)
+    seen = {}
+    for i, v in enumerate(ids):
+        if 0 <= v < lanes:
+            ranks[i] = seen.get(v, 0)
+            seen[v] = seen.get(v, 0) + 1
+    return ranks, np.bincount(ids[(ids >= 0) & (ids < lanes)],
+                              minlength=lanes)[:lanes]
+
+
+@pytest.mark.parametrize("shape", ["uniform", "skewed", "single", "empty"])
+def test_radix_ranks_matches_numpy(shape):
+    rng = np.random.default_rng(3)
+    lanes = 9
+    if shape == "uniform":
+        ids = rng.integers(0, lanes, 700).astype(np.int32)
+    elif shape == "skewed":          # one partition takes almost everything
+        ids = np.where(rng.random(700) < 0.95, 4,
+                       rng.integers(0, lanes, 700)).astype(np.int32)
+    elif shape == "single":
+        ids = np.full(300, 7, np.int32)
+    else:                            # every row out of range (all padding)
+        ids = np.full(128, lanes, np.int32)
+    ranks, counts = PK.radix_ranks(jnp.asarray(ids), lanes)
+    exp_ranks, exp_counts = _np_stable_ranks(ids, lanes)
+    assert (np.asarray(counts) == exp_counts).all()
+    assert (np.asarray(ranks) == exp_ranks).all()
+
+
+@pytest.mark.parametrize("nparts", [1, 2, 5, 64, 300])
+def test_radix_partition_permutation_is_stable_argsort(nparts):
+    rng = np.random.default_rng(nparts)
+    ids = rng.integers(0, nparts, 1000).astype(np.int32)
+    perm = np.asarray(PK.radix_partition_permutation(jnp.asarray(ids),
+                                                     nparts))
+    assert (perm == np.argsort(ids, kind="stable")).all()
+
+
+def test_partition_permutation_routing_with_padding():
+    """ops/sorting.partition_permutation forced through the radix kernel
+    equals the stable-argsort path, padding sunk to the end."""
+    from spark_rapids_tpu.ops.sorting import partition_permutation
+    rng = np.random.default_rng(8)
+    cap, n = 512, 389
+    ids = jnp.asarray(rng.integers(0, 6, cap).astype(np.int32))
+    PK.set_mode(True)
+    try:
+        with_pallas = np.asarray(partition_permutation(ids, 6, n, cap))
+    finally:
+        PK.set_mode(False)
+    without = np.asarray(partition_permutation(ids, 6, n, cap))
+    PK.set_mode(None)
+    assert (with_pallas == without).all()
+
+
+def _np_hash_oracle(bk, sk):
+    lookup = {int(k): i for i, k in enumerate(bk)}
+    pos = np.array([lookup.get(int(s), -1) for s in sk], np.int32)
+    return pos, pos >= 0
+
+
+@pytest.mark.parametrize("dtype", [np.int64, np.int32, np.int16])
+def test_hash_join_build_probe_dtypes(dtype):
+    rng = np.random.default_rng(hash(dtype.__name__) % 2**31)
+    lo = int(np.iinfo(dtype).min) // 2
+    hi = int(np.iinfo(dtype).max) // 2
+    bk = rng.choice(np.arange(lo, hi, max((hi - lo) // 4000, 1),
+                              dtype=np.int64), 1500, replace=False)
+    sk = np.concatenate([rng.choice(bk, 800),
+                         rng.integers(lo, hi, 700)]).astype(np.int64)
+    H = PK.hash_join_buckets(len(bk))
+    tk, tr, ok = PK.hash_join_build(jnp.asarray(bk),
+                                    jnp.ones(len(bk), bool), H)
+    assert bool(ok)
+    pos, found = PK.hash_join_probe(tk, tr, jnp.asarray(sk), H)
+    exp_pos, exp_found = _np_hash_oracle(bk, sk)
+    assert (np.asarray(found) == exp_found).all()
+    assert (np.asarray(pos)[exp_found] == exp_pos[exp_found]).all()
+
+
+def test_hash_join_build_null_mask_and_empty():
+    rng = np.random.default_rng(4)
+    bk = rng.permutation(np.arange(0, 10**7, 2500)[:2000]).astype(np.int64)
+    elig = rng.random(2000) < 0.7     # ineligible = null / beyond n_build
+    H = PK.hash_join_buckets(2000)
+    tk, tr, ok = PK.hash_join_build(jnp.asarray(bk), jnp.asarray(elig), H)
+    assert bool(ok)
+    pos, found = PK.hash_join_probe(tk, tr, jnp.asarray(bk), H)
+    # eligible keys find themselves; ineligible keys were never inserted
+    assert (np.asarray(found) == elig).all()
+    assert (np.asarray(pos)[elig] == np.arange(2000)[elig]).all()
+    # empty build: nothing matches
+    tk0, tr0, ok0 = PK.hash_join_build(
+        jnp.asarray(bk), jnp.zeros(2000, bool), H)
+    assert bool(ok0)
+    _, found0 = PK.hash_join_probe(tk0, tr0, jnp.asarray(bk), H)
+    assert not np.asarray(found0).any()
+
+
+def test_hash_join_build_refuses_duplicates():
+    bk = np.array([5, 9, 5, 11] * 40, np.int64)    # duplicate keys
+    H = PK.hash_join_buckets(len(bk))
+    _, _, ok = PK.hash_join_build(jnp.asarray(bk),
+                                  jnp.ones(len(bk), bool), H)
+    assert not bool(ok)
+
+
+def test_hash_join_build_refuses_bucket_overflow():
+    # 128 buckets x 8 slots; hash all keys into few buckets by volume:
+    # 2000 unique keys over 128 buckets averages >8 per bucket
+    bk = np.arange(1, 2001, dtype=np.int64) * 977
+    _, _, ok = PK.hash_join_build(jnp.asarray(bk),
+                                  jnp.ones(len(bk), bool), 128)
+    assert not bool(ok)
+
+
+def test_probe_latch_smoke():
+    """The per-kernel compile probes the next chip window will take: every
+    kernel's tiny instance must run clean in interpret mode so a Mosaic
+    failure (not a code bug) is the only thing that can latch it off."""
+    import spark_rapids_tpu.ops.pallas_kernels as mod
+    saved = mod._TPU_PROBE
+    mod._TPU_PROBE = None
+    try:
+        for kernel in ("murmur3", "bitunpack", "onehot", "radix",
+                       "hashjoin"):
+            assert mod._probe_tpu(kernel) is True, kernel
+    finally:
+        mod._TPU_PROBE = saved
+
+
+def test_join_core_pallas_hash_equivalence():
+    """_JoinCore forced through the pallas_hash probe mode equals the
+    forced-off jnp paths for every join type the mode serves, across
+    sparse int64 keys with nulls."""
+    import pyarrow as pa
+    from spark_rapids_tpu.session import TpuSession
+    rng = np.random.default_rng(9)
+    bk = rng.permutation(np.arange(0, 2**44, 2**44 // 3000)[:3000])
+    sk = np.concatenate([rng.choice(bk, 2000),
+                         rng.integers(0, 2**44, 1000)]).astype(np.int64)
+    bnull = rng.random(3000) < 0.05
+    snull = rng.random(3000) < 0.05
+    spark = TpuSession()
+    build = spark.create_dataframe(pa.table({
+        "k": pa.array([None if m else int(v) for v, m in zip(bk, bnull)],
+                      pa.int64()),
+        "b": pa.array(np.arange(3000, dtype=np.int64))}))
+    stream = spark.create_dataframe(pa.table({
+        "k": pa.array([None if m else int(v) for v, m in zip(sk, snull)],
+                      pa.int64()),
+        "s": pa.array(np.arange(3000, dtype=np.int64))}))
+
+    def run(how):
+        out = stream.join(build, on="k", how=how).collect().to_pylist()
+        return sorted((tuple(r.values()) for r in out),
+                      key=lambda t: tuple((v is None, v or 0) for v in t))
+
+    for how in ("inner", "left", "left_semi", "left_anti"):
+        PK.set_mode(True)
+        try:
+            a = run(how)
+        finally:
+            PK.set_mode(False)
+        b = run(how)
+        PK.set_mode(None)
+        assert a == b, how
+
+
 def test_dense_group_sum_pallas_dispatch_equivalence():
     """dense_group_sum(count_like) forced through the Pallas kernel equals
     the jnp one-hot path — the dense aggregation spine's TPU route."""

@@ -1,0 +1,118 @@
+"""Scan readahead (io/readers.readahead_tables + the filescan wiring):
+results must be byte-identical at every queue depth, batches must never
+reorder or drop under a slow producer, decode must actually overlap the
+consumer, and producer errors must surface at the consumer."""
+
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.io.readers import readahead_tables
+
+
+def _tables(n, rows=100):
+    rng = np.random.default_rng(1)
+    return [pa.table({"i": pa.array(np.full(rows, k, np.int64)),
+                      "v": pa.array(rng.random(rows))})
+            for k in range(n)]
+
+
+def test_readahead_preserves_order_and_content():
+    tabs = _tables(7)
+    for depth in (0, 1, 4, 100):
+        got = list(readahead_tables(iter(tabs), depth))
+        assert len(got) == len(tabs)
+        for a, b in zip(got, tabs):
+            assert a is b  # same objects, same order
+
+
+def test_readahead_slow_reader_no_reorder_no_drop():
+    """Injected slow producer: every item arrives, in order, exactly once —
+    and decode of item N+1 overlaps consumption of item N (wall clock well
+    under the serial sum)."""
+    tabs = _tables(6)
+    delay = 0.1
+
+    def slow_gen():
+        for t in tabs:
+            time.sleep(delay)       # "decode"
+            yield t
+
+    t0 = time.perf_counter()
+    got = []
+    for t in readahead_tables(slow_gen(), depth=2):
+        time.sleep(delay)           # "device compute"
+        got.append(t)
+    wall = time.perf_counter() - t0
+    assert [t["i"][0].as_py() for t in got] == list(range(6))
+    serial = 2 * delay * len(tabs)
+    # overlapped pipeline ≈ serial/2 + one pipeline fill; generous margin
+    # for slow CI boxes — the structural guarantee (order/count) is above
+    assert wall < serial * 0.85, (wall, serial)
+
+
+def test_readahead_budget_still_completes():
+    """A byte budget far below one table still makes progress (the
+    one-staged-table floor) and loses nothing."""
+    tabs = _tables(5, rows=1000)
+    got = list(readahead_tables(iter(tabs), depth=4, budget_bytes=1))
+    assert len(got) == 5
+
+
+def test_readahead_propagates_errors():
+    def bad_gen():
+        yield _tables(1)[0]
+        raise ValueError("decode exploded")
+
+    it = readahead_tables(bad_gen(), depth=2)
+    next(it)
+    with pytest.raises(ValueError, match="decode exploded"):
+        next(it)
+
+
+def test_readahead_early_close_stops_producer():
+    produced = []
+
+    def gen():
+        for t in _tables(50):
+            produced.append(1)
+            time.sleep(0.01)
+            yield t
+
+    it = readahead_tables(gen(), depth=2)
+    next(it)
+    it.close()
+    time.sleep(0.2)
+    n = len(produced)
+    time.sleep(0.2)
+    assert len(produced) == n  # producer thread stopped
+    assert n < 50
+
+
+@pytest.mark.parametrize("depth", [0, 1, 4])
+def test_filescan_depth_equivalence(tmp_path, depth):
+    """End-to-end scan through the session: every depth yields identical
+    values, including the residual-filter path."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from spark_rapids_tpu.session import TpuSession
+    rng = np.random.default_rng(2)
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 50, 5000).astype(np.int64)),
+        "v": pa.array(rng.random(5000)),
+    })
+    for i in range(4):
+        pq.write_table(t.slice(i * 1250, 1250),
+                       tmp_path / f"part-{i}.parquet")
+    spark = TpuSession({
+        "spark.rapids.tpu.sql.scan.readahead.depth": depth})
+    df = spark.read_parquet(str(tmp_path))
+    out = df.collect()
+    assert out.num_rows == 5000
+    got = sorted(zip(out["k"].to_pylist(), out["v"].to_pylist()))
+    exp = sorted(zip(t["k"].to_pylist(), t["v"].to_pylist()))
+    assert got == exp

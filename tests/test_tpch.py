@@ -46,3 +46,20 @@ def test_q5(data):
     for g, (n, v) in zip(got, exp):
         assert g["n_name"] == n
         assert g["revenue"] == pytest.approx(v, rel=1e-9)
+
+
+def test_q18(data):
+    import datetime
+    dfs, tb = data
+    got = tpch.q18(dfs).collect().to_pylist()
+    exp = tpch.np_q18(tb)
+    assert len(got) == len(exp)   # may be empty at tiny SF — both sides
+    epoch = datetime.date(1970, 1, 1)
+    for g, (c, o, d, t, s) in zip(got, exp):
+        assert g["c_custkey"] == c and g["o_orderkey"] == o
+        gd = g["o_orderdate"]
+        if isinstance(gd, datetime.date):
+            gd = (gd - epoch).days
+        assert gd == d
+        assert g["o_totalprice"] == pytest.approx(t, rel=1e-9)
+        assert g["sum_qty"] == pytest.approx(s, rel=1e-9)

@@ -27,6 +27,19 @@ sys.path.insert(0, str(REPO / "tools"))
 from tpu_probe import probe, log_result  # noqa: E402
 
 
+def _bench_paused() -> bool:
+    """bench.py holds a pause file around timed sections — probing then
+    would share the box with the measurement and inflate its spread (the
+    r5 variance postmortem). Stale files (>1h: a killed bench) are ignored
+    so a crash can never silence the watcher."""
+    p = pathlib.Path(os.environ.get("SRT_BENCH_PAUSE_FILE",
+                                    "/tmp/srt_bench_pause"))
+    try:
+        return (time.time() - p.stat().st_mtime) < 3600
+    except OSError:
+        return False
+
+
 def _have_correctness():
     p = REPO / "TPU_CORRECTNESS.json"
     if not p.exists():
@@ -106,6 +119,9 @@ def main():
     deadline = time.time() + args.max_hours * 3600
     n = 0
     while time.time() < deadline:
+        if _bench_paused():
+            time.sleep(30)
+            continue
         n += 1
         ok, detail = probe(75.0)
         if not ok:
