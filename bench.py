@@ -201,6 +201,12 @@ def child_main():
                 "vs_baseline": round(np_e2e / eng, 3),
             }
 
+    # resilience counters (retry/split/fetch-failover totals across the
+    # whole ladder run): with faults disabled these must be zero — a later
+    # round seeing nonzero values here caught a real robustness regression
+    from spark_rapids_tpu.runtime import metrics as rmetrics
+    resilience = rmetrics.resilience_snapshot()
+
     geo = lambda xs: math.exp(sum(math.log(x) for x in xs) / len(xs))
     qnames = "".join(tpch.QUERIES)
     line = {
@@ -215,6 +221,7 @@ def child_main():
         "spread": round(max(spreads), 3),
         "variance_ok": max(spreads) <= BENCH_MAX_SPREAD,
         "queries": per_query,
+        "resilience": resilience,
     }
     if not line["variance_ok"]:
         line["degraded"] = (f"spread {line['spread']} exceeds "

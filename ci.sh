@@ -35,8 +35,11 @@ import json, sys
 d = json.loads(sys.argv[1])
 assert "metric" in d and d["value"] > 0, d
 assert "spread" in d and "queries" in d, d
+# with no faults configured the retry spine must be invisible: all zero
+assert d["resilience"]["numOomRetries"] == 0, d["resilience"]
+assert d["resilience"]["fetchRecomputes"] == 0, d["resilience"]
 print("bench-child dry-run ok:", d["metric"], d["value"], d["unit"],
-      "spread", d["spread"])
+      "spread", d["spread"], "resilience", d["resilience"])
 ' "$bench_line"
 
 echo "== radix spine: kernel interpret tests + join microbench smoke =="
@@ -53,6 +56,13 @@ assert d["parity_ok"] and d["matches"] > 0, d
 print("join microbench smoke ok: pallas probe", d["pallas_probe_ms"],
       "ms vs laxsort rank", d["laxsort_rank_ms"], "ms")
 ' "$micro_line"
+
+echo "== chaos: task-scoped OOM retry + deterministic fault injection =="
+# fast chaos gate (fixed fault seeds inside the suite, so the injection
+# schedule can never drift between runs): injected join-build OOMs and
+# dropped fetches must recover to bit-identical results, with the recovery
+# visible in the resilience counters
+JAX_PLATFORMS=cpu python -m pytest tests/test_retry_faults.py -q
 
 echo "== api coverage gate (0 missing vs reference GpuOverrides) =="
 python tools/api_validation.py 0 0

@@ -166,6 +166,36 @@ DIRECT_SPILL_BATCH_BYTES = conf(
     "Size at which a direct-spill batch file rotates (reference GDS "
     "batchWriteBufferSize)").bytes_conf("64m")
 
+STRICT_DEVICE_BUDGET = conf("spark.rapids.tpu.memory.hbm.strictBudget").doc(
+    "When a registration cannot spill the device tier back under the HBM "
+    "budget, raise a retryable DeviceOomError (the DeviceMemoryEventHandler "
+    "OOM analog) so the task-scoped retry framework (runtime/retry.py) can "
+    "spill, split the input batch and re-run. false restores the legacy "
+    "lenient accounting that silently left the device tier over budget"
+).boolean_conf(True)
+
+RETRY_MAX_SPLITS = conf("spark.rapids.tpu.memory.retry.maxSplits").doc(
+    "Times one input batch may be split in half by OOM split-and-retry "
+    "before the error is re-raised (reference RmmRapidsRetryIterator's "
+    "splitSpillableInHalfByRows ladder)").integer_conf(8)
+
+RETRY_SPLIT_FLOOR_BYTES = conf(
+    "spark.rapids.tpu.memory.retry.splitFloorBytes").doc(
+    "Split-and-retry never produces a batch smaller than this (nor below 2 "
+    "rows); at the floor one spill-only retry runs and then the OOM "
+    "propagates").bytes_conf("64k")
+
+TEST_FAULTS = conf("spark.rapids.tpu.test.faults").doc(
+    "Deterministic fault-injection spec 'kind:site:trigger,...' — kinds "
+    "oom / splitoom / transport; trigger COUNT, COUNT@SKIP or pPROB; e.g. "
+    "'oom:joins.build:2,transport:fetch:1' (grammar + site list in "
+    "runtime/faults.py). Chaos testing only — never set in production; "
+    "empty disables").string_conf(None)
+
+TEST_FAULTS_SEED = conf("spark.rapids.tpu.test.faults.seed").doc(
+    "Seed for probabilistic (pPROB) fault triggers: one seed yields one "
+    "deterministic injection schedule").integer_conf(0)
+
 UNSPILL_ENABLED = conf("spark.rapids.tpu.memory.hbm.unspill.enabled").doc(
     "Re-promote spilled buffers back to HBM on access "
     "(reference spark.rapids.memory.gpu.unspill.enabled)").boolean_conf(False)

@@ -24,6 +24,7 @@ from spark_rapids_tpu.ops import grouping as G
 from spark_rapids_tpu.ops.concat import concat_batches
 from spark_rapids_tpu.ops.filtering import compact_cols, gather_cols
 from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.runtime import retry as R
 from spark_rapids_tpu.runtime.tracing import trace_range
 
 PARTIAL = "partial"
@@ -483,6 +484,11 @@ class HashAggregateExec(TpuExec):
     def execute_partition(self, split):
         def it():
             merge_input = self.mode == FINAL
+
+            def agg_one(b, merge=merge_input):
+                with trace_range("HashAggregate.agg", self._agg_time):
+                    return self._aggregate_batch(b, merge=merge)
+
             acc = None
             for batch in self.child.execute_partition(split):
                 # acquire only once data is ready for device work — acquiring before
@@ -490,16 +496,29 @@ class HashAggregateExec(TpuExec):
                 # stage and deadlock the semaphore (reference RapidsShuffleIterator
                 # acquires on data arrival, RapidsShuffleIterator.scala:300)
                 acquire_semaphore(self.metrics)
-                with trace_range("HashAggregate.agg", self._agg_time):
-                    partial = self._aggregate_batch(batch, merge=merge_input)
-                if acc is None:
-                    acc = partial
-                else:
+                # per-batch update aggregation under the OOM ladder: a split
+                # aggregates the halves into two partials, which the merge
+                # loop below folds together — exactly the semantics of
+                # batches arriving pre-split (reference withRetry around the
+                # update aggregation, aggregate.scala:282-420)
+                for partial in R.with_retry([batch], agg_one, conf=self.conf,
+                                            scope="agg.update"):
+                    if acc is None:
+                        acc = partial
+                        continue
+
                     # incremental concat+merge loop (reference aggregate.scala:388)
-                    with trace_range("HashAggregate.concat", self._concat_time):
-                        both = concat_batches([acc, partial])
-                    with trace_range("HashAggregate.merge", self._agg_time):
-                        acc = self._aggregate_batch(both, merge=True)
+                    def merge_acc(a=acc, p=partial):
+                        with trace_range("HashAggregate.concat",
+                                         self._concat_time):
+                            both = concat_batches([a, p])
+                        with trace_range("HashAggregate.merge",
+                                         self._agg_time):
+                            return self._aggregate_batch(both, merge=True)
+
+                    # the merge needs BOTH partials at once — unsplittable,
+                    # so spill-only retry (withRetryNoSplit)
+                    acc = R.call_with_retry(merge_acc, scope="agg.merge")
             if acc is None:
                 if self.group_exprs:
                     return  # grouped agg over empty input → no rows (Spark)

@@ -20,8 +20,10 @@ import threading
 from spark_rapids_tpu import config as CFG
 from spark_rapids_tpu.exec.base import TaskContext, TpuExec
 from spark_rapids_tpu.exec.coalesce import concat_all
+from spark_rapids_tpu.runtime import faults as F
 from spark_rapids_tpu.runtime import memory as mem
 from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.runtime import retry as R
 from spark_rapids_tpu.runtime.tracing import trace_range
 
 class BroadcastTimeout(RuntimeError):
@@ -68,20 +70,29 @@ class BroadcastExchangeExec(TpuExec):
         return 1
 
     def _materialize(self) -> mem.SpillableColumnarBatch:
-        with trace_range("BroadcastExchange.build", self._build_time):
+        # "joins.build" fault scope: in the default (non-mesh) plan every
+        # equi-join builds through this exchange, so join-build OOM chaos
+        # specs target the broadcast materialization; the coalesce layer's
+        # registration retry splits over-budget input batches, and the final
+        # single-batch registration gets a spill-only retry
+        with trace_range("BroadcastExchange.build", self._build_time), \
+                F.scope("joins.build"):
             batches = []
             for split in range(self.child.num_partitions):
                 with TaskContext():
                     batches.extend(self.child.execute_partition(split))
-            batch = concat_all(iter(batches), self.child.output)
+            batch = concat_all(iter(batches), self.child.output,
+                               conf=self.conf)
             size = batch.device_memory_size()
             if self._max_bytes and size > self._max_bytes:
                 raise RuntimeError(
                     f"broadcast table {size} bytes exceeds "
                     f"{CFG.BROADCAST_MAX_TABLE_BYTES.key}={self._max_bytes} "
                     "(reference maxBroadcastTableSize guard)")
-            return mem.SpillableColumnarBatch(batch,
-                                              mem.ACTIVE_BATCHING_PRIORITY)
+            return R.call_with_retry(
+                lambda: mem.SpillableColumnarBatch(
+                    batch, mem.ACTIVE_BATCHING_PRIORITY),
+                scope="joins.build")
 
     def broadcast(self) -> mem.SpillableColumnarBatch:
         """The shared relation; first caller schedules the build, everyone

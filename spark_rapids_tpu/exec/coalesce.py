@@ -16,6 +16,7 @@ from spark_rapids_tpu.exec.base import TpuExec
 from spark_rapids_tpu.ops.concat import concat_batches
 from spark_rapids_tpu.runtime import memory as mem
 from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.runtime import retry as R
 
 
 @dataclasses.dataclass(frozen=True)
@@ -34,7 +35,8 @@ class RequireSingleBatch(CoalesceGoal):
     (reference RequireSingleBatch)."""
 
 
-def coalesce_iterator(it, goal: CoalesceGoal, metrics=None, use_catalog: bool = True):
+def coalesce_iterator(it, goal: CoalesceGoal, metrics=None, use_catalog: bool = True,
+                      conf=None):
     """Re-batch `it` per `goal` (reference AbstractGpuCoalesceIterator:133)."""
     concat_time = metrics.metric(M.CONCAT_TIME, M.MODERATE) if metrics else None
 
@@ -66,9 +68,17 @@ def coalesce_iterator(it, goal: CoalesceGoal, metrics=None, use_catalog: bool = 
             size = batch.device_memory_size()
             if limit is not None and pending and pending_bytes + size > limit:
                 yield flush()
-            pending.append(mem.SpillableColumnarBatch(batch, mem.ACTIVE_BATCHING_PRIORITY)
-                           if use_catalog else batch)
-            pending_bytes += size
+            if use_catalog:
+                # strict-budget registration under the OOM retry ladder: an
+                # over-budget batch spills others, then splits in half — the
+                # halves concat back to the same rows at flush
+                for sb in R.register_with_retry(
+                        batch, mem.ACTIVE_BATCHING_PRIORITY, conf=conf):
+                    pending.append(sb)
+                    pending_bytes += sb.size
+            else:
+                pending.append(batch)
+                pending_bytes += size
             if limit is not None and pending_bytes >= limit:
                 yield flush()
         out = flush()
@@ -82,9 +92,9 @@ def coalesce_iterator(it, goal: CoalesceGoal, metrics=None, use_catalog: bool = 
         pending = []
 
 
-def concat_all(it, schema) -> ColumnarBatch:
+def concat_all(it, schema, conf=None) -> ColumnarBatch:
     """Drain to exactly one batch (reference ConcatAndConsumeAll)."""
-    out = list(coalesce_iterator(it, RequireSingleBatch()))
+    out = list(coalesce_iterator(it, RequireSingleBatch(), conf=conf))
     if not out:
         return ColumnarBatch.empty(schema)
     assert len(out) == 1
@@ -105,7 +115,7 @@ class CoalesceBatchesExec(TpuExec):
     def execute_partition(self, split):
         return self.wrap_output(
             coalesce_iterator(self.child.execute_partition(split), self.goal,
-                              self.metrics))
+                              self.metrics, conf=self.conf))
 
     def args_string(self):
         return repr(self.goal)

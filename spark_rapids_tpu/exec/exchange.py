@@ -17,7 +17,11 @@ from concurrent.futures import ThreadPoolExecutor
 from spark_rapids_tpu import config as C
 from spark_rapids_tpu.exec.base import TpuExec, TaskContext
 from spark_rapids_tpu.exec.coalesce import coalesce_iterator, TargetSize
+from spark_rapids_tpu.runtime import faults as F
+from spark_rapids_tpu.runtime import memory as mem
 from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.runtime import retry as R
+from spark_rapids_tpu.runtime import tracing
 from spark_rapids_tpu.shuffle.manager import ShuffleBlockStore
 from spark_rapids_tpu.shuffle.partitioning import Partitioner, RangePartitioner
 
@@ -66,10 +70,25 @@ class ShuffleExchangeExec(TpuExec):
                 for batch in self.child.execute_partition(split):
                     if batch.num_rows == 0:
                         continue
-                    with self._partition_time.timed():
-                        pieces = self.partitioner.partition(batch, split)
-                    for pid, piece in pieces:
-                        store.write_block(self._shuffle_id, pid, piece)
+
+                    def partition_one(b):
+                        with self._partition_time.timed():
+                            return self.partitioner.partition(b, split)
+
+                    # map-side writer under the OOM ladder: partitioning a
+                    # split half writes the same rows to the same reduce ids,
+                    # so piece-granularity recovery is transparent downstream
+                    for pieces in R.with_retry([batch], partition_one,
+                                               conf=self.conf,
+                                               scope="exchange.map"):
+                        for pid, piece in pieces:
+                            # per-piece spill-only retry: a failed block
+                            # registration rolls back before raising, so the
+                            # re-attempt never double-writes
+                            R.call_with_retry(
+                                lambda p=pid, b=piece: store.write_block(
+                                    self._shuffle_id, p, b),
+                                scope="exchange.write")
 
         nthreads = max(1, min(self.conf.get(C.NUM_LOCAL_TASKS),
                               self.child.num_partitions))
@@ -125,23 +144,32 @@ class ShuffleExchangeExec(TpuExec):
         rows — and surfaces as TransportError (Spark would re-run the reduce
         task there; the local scheduler has no task-level rerun).
         KeyError counts as a fetch failure: a concurrent reader's
-        invalidation can yank the shuffle between ensure and read."""
+        invalidation can yank the shuffle between ensure and read, and
+        BufferClosedError the same way when the invalidation lands after
+        this reader snapshotted the block list."""
         from spark_rapids_tpu.shuffle.transport import TransportError
         store = ShuffleBlockStore.get()
         retries = self.conf.get(C.SHUFFLE_FETCH_MAX_RETRIES)
         for attempt in range(retries + 1):
             emitted = False
             try:
+                # fault-injection checkpoint: "transport:fetch:N" chaos specs
+                # drop reduce-side fetches here (the stage-retry ladder), the
+                # same site name the peer ladder in shuffle/fetch.py checks
+                F.maybe_inject("transport", "fetch")
                 for b in store.read_partition(self._shuffle_id, split):
                     emitted = True
                     yield b
                 return
-            except (TransportError, KeyError) as e:
+            except (TransportError, KeyError, mem.BufferClosedError) as e:
                 if emitted or attempt == retries:
                     raise TransportError(
                         f"reduce {split} fetch failed"
                         f"{' after partial read' if emitted else ''}: {e}"
                     ) from e
+                M.global_registry().metric(M.FETCH_RECOMPUTES).add(1)
+                tracing.span_event("fetch.recompute", split=split,
+                                   error=str(e)[:120])
                 self._invalidate_map_stage()
                 self._ensure_map_stage()
 
@@ -170,7 +198,7 @@ class ShuffleExchangeExec(TpuExec):
         # GpuShuffleCoalesceExec inserted by GpuTransitionOverrides:57-63)
         goal = TargetSize(self.conf.batch_size_bytes)
         yield from coalesce_iterator(self.read_reduce(split), goal,
-                                     self.metrics)
+                                     self.metrics, conf=self.conf)
 
     def execute_partition(self, split):
         # drop this task's permit before (possibly) blocking on the map stage —
@@ -265,7 +293,8 @@ class AdaptiveShuffleReaderExec(TpuExec):
                 # shuffle blocks leak
                 for _ in pids[opened:]:
                     ex.account_read_done()
-        return self.wrap_output(coalesce_iterator(it(), goal, self.metrics))
+        return self.wrap_output(coalesce_iterator(it(), goal, self.metrics,
+                                                  conf=self.conf))
 
     def args_string(self):
         specs = self._specs

@@ -34,8 +34,10 @@ from spark_rapids_tpu.expr.core import Col, EvalContext, Expression, bind_refere
 from spark_rapids_tpu.ops import joining as J
 from spark_rapids_tpu.ops.filtering import gather_cols, selection_mask, compact_cols
 from spark_rapids_tpu.ops.strings import union_dictionaries
+from spark_rapids_tpu.runtime import faults as F
 from spark_rapids_tpu.runtime import memory as mem
 from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.runtime import retry as R
 from spark_rapids_tpu.runtime.tracing import trace_range
 
 # max pairs expanded per output chunk (the JoinGatherer row-target analog)
@@ -521,6 +523,18 @@ class _JoinCore:
         live = np.arange(self.build_cap) < self.n_build
         return np.nonzero(live & ~self.build_matched_acc)[0]
 
+    # Retryable (reference trait behind withRestoreOnRetry): the matched-row
+    # accumulator is the core's only cross-batch mutable state — a probe
+    # attempt that OOMs after updating it must roll back before the split
+    # pieces re-probe
+    def checkpoint(self):
+        self._matched_ckpt = (None if self.build_matched_acc is None
+                              else self.build_matched_acc.copy())
+
+    def restore(self):
+        if getattr(self, "_matched_ckpt", None) is not None:
+            self.build_matched_acc = self._matched_ckpt.copy()
+
 
 class HashJoinExec(TpuExec):
     """Equi-join with a materialized build side (reference GpuShuffledHashJoinBase:97;
@@ -641,30 +655,49 @@ class HashJoinExec(TpuExec):
             yield ColumnarBatch([c.to_vector() for c in cols], n_out, out_schema)
             pos += out_cap
 
+    def _probe_stream(self, core, sb, stream_child, split, out_schema):
+        """Probe+emit loop shared by the shuffled and broadcast variants,
+        under the task-scoped OOM ladder: each stream batch probes inside
+        with_retry (an OOM spills, splits the stream batch and re-probes the
+        halves — the reference withRetry over the stream iterator) with the
+        matched-row accumulator checkpointed per attempt."""
+        def probe(b):
+            with trace_range("HashJoin.probe", self._join_time), \
+                    R.with_restore_on_retry(core):
+                return b, core.probe_batch(b)
+
+        for stream_batch in stream_child.execute_partition(split):
+            acquire_semaphore(self.metrics)
+            for piece, (build_perm, lo, hi, counts, total) in R.with_retry(
+                    [stream_batch], probe, conf=self.conf,
+                    scope="joins.gather"):
+                yield from self._emit(piece, sb.get_batch(), core,
+                                      build_perm, lo, hi, counts, total,
+                                      out_schema)
+
     def execute_partition(self, split):
         def it():
             build_child = self.children[1] if self.stream_is_left else self.children[0]
             stream_child = self.children[0] if self.stream_is_left else self.children[1]
-            with trace_range("HashJoin.build", self._build_time):
+            with trace_range("HashJoin.build", self._build_time), \
+                    F.scope("joins.build"):
                 build_batch = concat_all(build_child.execute_partition(split),
-                                         build_child.output)
-            # hold the built table spillable while we stream (reference
-            # LazySpillableColumnarBatch, GpuHashJoin.scala:200)
-            with mem.SpillableColumnarBatch(build_batch,
-                                            mem.ACTIVE_BATCHING_PRIORITY) as sb:
+                                         build_child.output, conf=self.conf)
+                # hold the built table spillable while we stream (reference
+                # LazySpillableColumnarBatch, GpuHashJoin.scala:200); the
+                # single-batch registration cannot split — spill-only retry
+                sb = R.call_with_retry(
+                    lambda: mem.SpillableColumnarBatch(
+                        build_batch, mem.ACTIVE_BATCHING_PRIORITY),
+                    scope="joins.build")
+            with sb:
                 bk = self.left_keys if not self.stream_is_left else self.right_keys
                 sk = self.right_keys if not self.stream_is_left else self.left_keys
                 core = _JoinCore(sb.get_batch(), bk, sk, self.join_type,
                                  stream_prefilter=self.stream_prefilter)
                 out_schema = self.output
-                for stream_batch in stream_child.execute_partition(split):
-                    acquire_semaphore(self.metrics)
-                    with trace_range("HashJoin.probe", self._join_time):
-                        build_perm, lo, hi, counts, total = core.probe_batch(
-                            stream_batch)
-                    yield from self._emit(stream_batch, sb.get_batch(), core,
-                                          build_perm, lo, hi, counts, total,
-                                          out_schema)
+                yield from self._probe_stream(core, sb, stream_child, split,
+                                              out_schema)
                 if self.join_type == J.FULL_OUTER:
                     yield from self._emit_unmatched_build(core, sb.get_batch(),
                                                           out_schema)
@@ -749,14 +782,8 @@ class BroadcastHashJoinExec(HashJoinExec):
             core = _JoinCore(sb.get_batch(), bk, sk, self.join_type,
                              stream_prefilter=self.stream_prefilter)
             out_schema = self.output
-            for stream_batch in stream_child.execute_partition(split):
-                acquire_semaphore(self.metrics)
-                with trace_range("BroadcastHashJoin.probe", self._join_time):
-                    build_perm, lo, hi, counts, total = core.probe_batch(
-                        stream_batch)
-                yield from self._emit(stream_batch, sb.get_batch(), core,
-                                      build_perm, lo, hi, counts, total,
-                                      out_schema)
+            yield from self._probe_stream(core, sb, stream_child, split,
+                                          out_schema)
             if core.build_matched_acc is not None:
                 self._shared.merge_matched(core.build_matched_acc)
             if self._shared.finish():

@@ -42,47 +42,54 @@ class SortExec(TpuExec):
             # SPILL STORE — under HBM pressure earlier batches move to
             # host/disk instead of OOMing — with leak-safe close on error
             from spark_rapids_tpu.exec.coalesce import concat_all
+            from spark_rapids_tpu.runtime import retry as R
             batch = concat_all(self.child.execute_partition(split),
-                               self.child.output)
+                               self.child.output, conf=self.conf)
             if batch.num_rows == 0:
                 return
             acquire_semaphore(self.metrics)
-            with trace_range("SortExec", self._sort_time):
-                from spark_rapids_tpu.expr.core import Col
-                from spark_rapids_tpu.expr.misc import CONTEXT_SENSITIVE
-                from spark_rapids_tpu.runtime import fuse
-                exprs, orders = self.sort_exprs, self.orders
-                ctx_sensitive = any(
-                    e.collect(lambda x: isinstance(x, CONTEXT_SENSITIVE))
-                    for e in exprs)
 
-                def kernel(cols, num_rows):
-                    cap = cols[0].values.shape[0]
-                    ctx = EvalContext(cols, num_rows, cap)
-                    key_cols = [e.eval(ctx) for e in exprs]
-                    perm = sort_permutation(key_cols, orders, num_rows, cap)
-                    live = jnp.arange(cap, dtype=jnp.int32) < num_rows
-                    return gather_cols(ctx.cols, perm, live)
+            def run_sort():
+                with trace_range("SortExec", self._sort_time):
+                    from spark_rapids_tpu.expr.core import Col
+                    from spark_rapids_tpu.expr.misc import CONTEXT_SENSITIVE
+                    from spark_rapids_tpu.runtime import fuse
+                    exprs, orders = self.sort_exprs, self.orders
+                    ctx_sensitive = any(
+                        e.collect(lambda x: isinstance(x, CONTEXT_SENSITIVE))
+                        for e in exprs)
 
-                if ctx_sensitive or not batch.columns:
-                    ctx = EvalContext.from_batch(batch, split)
-                    key_cols = [e.eval(ctx) for e in exprs]
-                    perm = sort_permutation(key_cols, orders, ctx.num_rows,
-                                            ctx.capacity)
-                    live = (jnp.arange(ctx.capacity, dtype=jnp.int32)
-                            < ctx.num_rows)
-                    cols = gather_cols(ctx.cols, perm, live)
-                else:
+                    def kernel(cols, num_rows):
+                        cap = cols[0].values.shape[0]
+                        ctx = EvalContext(cols, num_rows, cap)
+                        key_cols = [e.eval(ctx) for e in exprs]
+                        perm = sort_permutation(key_cols, orders, num_rows, cap)
+                        live = jnp.arange(cap, dtype=jnp.int32) < num_rows
+                        return gather_cols(ctx.cols, perm, live)
+
+                    if ctx_sensitive or not batch.columns:
+                        ctx = EvalContext.from_batch(batch, split)
+                        key_cols = [e.eval(ctx) for e in exprs]
+                        perm = sort_permutation(key_cols, orders, ctx.num_rows,
+                                                ctx.capacity)
+                        live = (jnp.arange(ctx.capacity, dtype=jnp.int32)
+                                < ctx.num_rows)
+                        return gather_cols(ctx.cols, perm, live)
                     key = ("sort", fuse.schema_key(self.child.output),
                            tuple(fuse.expr_key(e) for e in exprs),
                            tuple(repr(o) for o in orders))
                     in_cols = [Col.from_vector(c) for c in batch.columns]
                     nr = jnp.asarray(batch.lazy_num_rows, jnp.int32)
-                    cols = fuse.call_fused(key, "SortExec", lambda: kernel,
+                    return fuse.call_fused(key, "SortExec", lambda: kernel,
                                            (in_cols, nr),
                                            lambda: kernel(in_cols, nr))
-                yield ColumnarBatch([c.to_vector() for c in cols],
-                                    batch.lazy_num_rows, self.output)
+
+            # the total sort needs the whole batch (its inputs already sit
+            # spill-protected in the catalog while accumulating) — an OOM
+            # here gets spill-only retries (withRetryNoSplit)
+            cols = R.call_with_retry(run_sort, scope="sort.sort")
+            yield ColumnarBatch([c.to_vector() for c in cols],
+                                batch.lazy_num_rows, self.output)
         return self.wrap_output(it())
 
     def args_string(self):

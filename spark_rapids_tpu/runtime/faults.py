@@ -1,0 +1,163 @@
+"""Deterministic fault injection — the chaos layer for the retry spine.
+
+Nothing in a single-process engine naturally exercises the OOM/fetch
+recovery ladders, so faults are INJECTED: a seeded, config-driven registry
+(`spark.rapids.tpu.test.faults`) arms named sites across memory and shuffle,
+and `tests/test_retry_faults.py` proves end-to-end that injected failures
+recover to bit-identical results. The reference tests the same ladders with
+RmmSpark.forceRetryOOM / forceSplitAndRetryOOM task hooks; this is that
+facility without a JNI layer underneath.
+
+Spec grammar (comma-separated entries)::
+
+    entry   := kind ":" site ":" trigger
+    kind    := "oom" | "splitoom" | "transport"
+    trigger := COUNT | COUNT "@" SKIP | "p" PROB
+
+``oom`` raises a retryable runtime.retry.DeviceOomError, ``splitoom`` a
+SplitAndRetryOom, ``transport`` a shuffle TransportError. COUNT injects on
+that many eligible hits; ``@SKIP`` first lets SKIP eligible hits pass
+("oom:agg.update:1@3" skips three, injects once); ``pPROB`` injects each hit
+with the given probability from the seeded RNG (one seed → one
+deterministic schedule).
+
+Sites: with_retry/call_with_retry attempts check their ``scope`` label
+("joins.build", "joins.gather", "agg.update", "agg.merge", "sort.sort",
+"exchange.map", "exchange.write"); catalog registrations outside a scope
+check "catalog.add_batch"; the shuffle data plane checks "transport.send" /
+"transport.recv" (frame I/O) and "fetch" (per fetch attempt, both the peer
+ladder in shuffle/fetch.py and the stage ladder in exec/exchange.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+import re
+import threading
+
+_lock = threading.Lock()
+_active = False
+_entries: list = []
+_rng: random.Random | None = None
+_injected: list = []
+_tls = threading.local()
+
+_KINDS = ("oom", "splitoom", "transport")
+_ENTRY_RE = re.compile(
+    r"^(?P<kind>[a-z]+):(?P<site>[A-Za-z0-9_.\-]+):"
+    r"(?:(?P<count>\d+)(?:@(?P<skip>\d+))?|p(?P<prob>0?\.\d+|1(?:\.0*)?))$")
+
+
+class _Entry:
+    __slots__ = ("kind", "site", "count", "skip", "prob")
+
+    def __init__(self, kind, site, count, skip, prob):
+        self.kind = kind
+        self.site = site
+        self.count = count
+        self.skip = skip
+        self.prob = prob
+
+
+def parse_spec(spec: str) -> list:
+    entries = []
+    for raw in spec.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        m = _ENTRY_RE.match(raw)
+        if not m or m.group("kind") not in _KINDS:
+            raise ValueError(
+                f"bad fault spec entry {raw!r}; want kind:site:trigger with "
+                f"kind in {_KINDS} and trigger COUNT[@SKIP] or pPROB")
+        entries.append(_Entry(
+            m.group("kind"), m.group("site"),
+            int(m.group("count")) if m.group("count") else 0,
+            int(m.group("skip") or 0),
+            float(m.group("prob")) if m.group("prob") else None))
+    return entries
+
+
+def configure(spec: str | None, seed: int = 0) -> None:
+    """Arm (or with None/empty, disarm) the process-wide injector."""
+    global _active, _entries, _rng
+    with _lock:
+        _entries = parse_spec(spec) if spec else []
+        _rng = random.Random(seed)
+        _injected.clear()
+        _active = bool(_entries)
+
+
+def reset() -> None:
+    configure(None)
+
+
+def is_active() -> bool:
+    return _active
+
+
+def injected_log() -> list:
+    """[(kind, site), ...] in injection order — chaos tests assert the whole
+    configured schedule actually fired."""
+    with _lock:
+        return list(_injected)
+
+
+@contextlib.contextmanager
+def scope(site: str | None):
+    """Thread-local site label: catalog registrations inside the block
+    attribute their injection checks to `site` instead of
+    "catalog.add_batch"."""
+    prev = getattr(_tls, "site", None)
+    _tls.site = site
+    try:
+        yield
+    finally:
+        _tls.site = prev
+
+
+def current_scope() -> str | None:
+    return getattr(_tls, "site", None)
+
+
+def maybe_inject(kind: str, site: str) -> None:
+    """Raise the configured fault for (kind, site) if one is armed; a no-op
+    flag check when injection is off (the production fast path)."""
+    if not _active:
+        return
+    fire = None
+    with _lock:
+        for e in _entries:
+            # an "oom" checkpoint arms both OOM flavors — splitoom is the
+            # same fault class with a stronger recovery demand
+            kind_ok = (e.kind == kind
+                       or (kind == "oom" and e.kind == "splitoom"))
+            if not kind_ok or e.site != site:
+                continue
+            if e.prob is not None:
+                if _rng.random() < e.prob:
+                    fire = e
+                    break
+                return
+            if e.count <= 0:
+                continue
+            if e.skip > 0:
+                e.skip -= 1
+                return
+            e.count -= 1
+            fire = e
+            break
+        if fire is not None:
+            _injected.append((fire.kind, site))
+    if fire is not None:
+        _raise(fire.kind, site)
+
+
+def _raise(kind: str, site: str):
+    if kind == "transport":
+        from spark_rapids_tpu.shuffle.transport import TransportError
+        raise TransportError(f"[fault-injection] transport fault at {site}")
+    from spark_rapids_tpu.runtime.retry import DeviceOomError, SplitAndRetryOom
+    cls = SplitAndRetryOom if kind == "splitoom" else DeviceOomError
+    raise cls(f"[fault-injection] device OOM at {site}", injected=True)

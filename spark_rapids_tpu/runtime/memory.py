@@ -33,7 +33,9 @@ from spark_rapids_tpu import config as C
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.columnar.vector import TpuColumnVector
+from spark_rapids_tpu.runtime import faults as F
 from spark_rapids_tpu.runtime.arm import LeakTracker
+from spark_rapids_tpu.runtime.retry import DeviceOomError
 
 # -- spill priorities (reference SpillPriorities.scala:26) ---------------------
 # Lower value spills FIRST.
@@ -126,9 +128,14 @@ class BufferCatalog:
 
     def __init__(self, device_budget: int, host_budget: int, spill_dir: str | None = None,
                  unspill: bool = False, oom_dump_dir: str | None = None,
-                 direct_spill: bool = False, direct_batch_bytes: int = 64 << 20):
+                 direct_spill: bool = False, direct_batch_bytes: int = 64 << 20,
+                 strict_budget: bool = True):
         self.device_budget = device_budget
         self.host_budget = host_budget
+        # strict: registration that cannot spill back under budget raises a
+        # retryable DeviceOomError (spark.rapids.tpu.memory.hbm.strictBudget)
+        # instead of silently leaving the device tier over budget
+        self._strict = strict_budget
         self._spill_dir = spill_dir
         self._unspill = unspill
         self._oom_dump_dir = oom_dump_dir
@@ -147,15 +154,28 @@ class BufferCatalog:
     # -- registration --------------------------------------------------------
     def add_batch(self, batch: ColumnarBatch, priority: float = ACTIVE_ON_DECK_PRIORITY,
                   spill_callback=None) -> int:
+        # fault-injection checkpoint (runtime/faults.py): chaos specs target
+        # either the ambient operator scope ("joins.build" …) or the bare
+        # registration site
+        F.maybe_inject("oom", F.current_scope() or "catalog.add_batch")
         with self._lock:
             bid = next(self._ids)
             buf = RapidsBuffer(bid, batch, priority, spill_callback)
             self._buffers[bid] = buf
             self.device_bytes += buf.size
-            self._ensure_device_budget(exclude=bid)
+            try:
+                self._ensure_device_budget(exclude=bid, strict=self._strict)
+            except DeviceOomError:
+                # roll back: a failed registration must not leave a phantom
+                # buffer charged against the budget — the retry framework
+                # re-attempts registration from scratch
+                del self._buffers[bid]
+                self.device_bytes -= buf.size
+                raise
             return bid
 
-    def _ensure_device_budget(self, exclude: int | None = None):
+    def _ensure_device_budget(self, exclude: int | None = None,
+                              strict: bool = False):
         if self.device_bytes <= self.device_budget:
             return
         # spill lowest-priority device buffers first (reference spill-priority queue)
@@ -170,6 +190,32 @@ class BufferCatalog:
             # dump allocator state for postmortems (reference
             # spark.rapids.memory.gpu.oomDumpDir / DeviceMemoryEventHandler)
             self._dump_oom_state(exclude)
+            if strict:
+                spillable, pinned = self._device_breakdown(exclude)
+                new_sz = (self._buffers[exclude].size
+                          if exclude in self._buffers else 0)
+                raise DeviceOomError(
+                    f"device tier over budget after spill exhaustion: "
+                    f"{self.device_bytes}B > budget {self.device_budget}B "
+                    f"(new buffer {new_sz}B, other device buffers: "
+                    f"spillable {spillable}B, pinned>=ACTIVE_BATCHING "
+                    f"{pinned}B)",
+                    requested=new_sz, budget=self.device_budget,
+                    spillable_bytes=spillable, pinned_bytes=pinned)
+
+    def _device_breakdown(self, exclude=None):
+        """(spillable, pinned) device-tier byte totals excluding `exclude` —
+        pinned counts ACTIVE_BATCHING_PRIORITY and above (batches an
+        operator is actively consuming spill last)."""
+        spillable = pinned = 0
+        for b in self._buffers.values():
+            if b.tier != TierEnum.DEVICE or b.buffer_id == exclude:
+                continue
+            if b.priority >= ACTIVE_BATCHING_PRIORITY:
+                pinned += b.size
+            else:
+                spillable += b.size
+        return spillable, pinned
 
     def _dump_oom_state(self, exclude):
         if not self._oom_dump_dir:
@@ -194,6 +240,19 @@ class BufferCatalog:
                         f"host_budget={self.host_budget} "
                         f"buffers={len(self._buffers)} "
                         f"over_budget_buffer={exclude}\n")
+                # per-tier spillable vs pinned (>= ACTIVE_BATCHING_PRIORITY)
+                # totals: the postmortem's "why couldn't spill free enough"
+                for tier in (TierEnum.DEVICE, TierEnum.HOST, TierEnum.DISK):
+                    spillable = pinned = 0
+                    for b in self._buffers.values():
+                        if b.tier != tier:
+                            continue
+                        if b.priority >= ACTIVE_BATCHING_PRIORITY:
+                            pinned += b.size
+                        else:
+                            spillable += b.size
+                    f.write(f"tier={tier} spillable_bytes={spillable} "
+                            f"pinned_bytes={pinned}\n")
                 f.write("buffer_id\ttier\tsize\tpriority\n")
                 for b in sorted(self._buffers.values(),
                                 key=lambda x: -x.size):
@@ -419,6 +478,7 @@ class DeviceManager:
             oom_dump_dir=conf.get(C.OOM_DUMP_DIR),
             direct_spill=conf.get(C.DIRECT_SPILL_ENABLED),
             direct_batch_bytes=conf.get(C.DIRECT_SPILL_BATCH_BYTES),
+            strict_budget=conf.get(C.STRICT_DEVICE_BUDGET),
         )
 
     @classmethod

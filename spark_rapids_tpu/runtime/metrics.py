@@ -40,6 +40,18 @@ PARTITION_TIME = "partitionTime"
 COLLECT_TIME = "collectTime"
 NUM_PARTITIONS = "partitions"
 
+# resilience counters (reference: RmmRapidsRetryIterator retry/split counts
+# surfaced through GpuMetric, RapidsShuffleIterator fetch-failure accounting)
+NUM_OOM_RETRIES = "numOomRetries"
+NUM_OOM_SPLIT_RETRIES = "numOomSplitRetries"
+OOM_SPILL_BYTES = "oomRetrySpillBytes"
+FETCH_RETRIES = "fetchRetries"
+FETCH_FAILOVERS = "fetchFailovers"
+FETCH_RECOMPUTES = "fetchRecomputes"
+
+RESILIENCE_METRICS = (NUM_OOM_RETRIES, NUM_OOM_SPLIT_RETRIES, OOM_SPILL_BYTES,
+                      FETCH_RETRIES, FETCH_FAILOVERS, FETCH_RECOMPUTES)
+
 
 class GpuMetric:
     __slots__ = ("name", "level", "_value", "_lock", "_pending")
@@ -116,3 +128,33 @@ class MetricsRegistry:
 
     def snapshot(self):
         return {n: m.value for n, m in self._metrics.items() if m.level <= self.level}
+
+
+# -- process-wide resilience registry ----------------------------------------
+# Retry/split/fetch-failover counts outlive any one operator's registry (a
+# retry may span operator teardown), so they accumulate here; chaos tests
+# (tests/test_retry_faults.py) and bench.py's `resilience` JSON field read
+# whole-query totals from this registry.
+
+_global_registry: "MetricsRegistry | None" = None
+_global_lock = threading.Lock()
+
+
+def global_registry() -> MetricsRegistry:
+    global _global_registry
+    with _global_lock:
+        if _global_registry is None:
+            _global_registry = MetricsRegistry("DEBUG")
+        return _global_registry
+
+
+def reset_global_registry() -> None:
+    global _global_registry
+    with _global_lock:
+        _global_registry = None
+
+
+def resilience_snapshot() -> dict:
+    """All resilience counters (zeros included) — the shape bench.py records."""
+    g = global_registry()
+    return {name: g.metric(name).value for name in RESILIENCE_METRICS}

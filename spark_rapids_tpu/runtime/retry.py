@@ -1,0 +1,265 @@
+"""Task-scoped OOM retry — the RmmRapidsRetryIterator / withRetry analog.
+
+Reference: RmmRapidsRetryIterator.scala — when a device allocation fails
+mid-operator the reference does NOT kill the task: the operator rolls its
+state back to a checkpoint (`withRestoreOnRetry` + the `Retryable` trait),
+the allocator synchronously spills lower-priority buffers, and the attempt
+re-runs; a `SplitAndRetryOOM` additionally splits the input batch in half
+before retrying (`withRetry` + `splitSpillableInHalfByRows`).
+
+TPU twist: XLA exposes no alloc-failure callback to trap (SURVEY.md §7), so
+the "allocation failure" here is the proactive budget check in
+runtime/memory.py raising `DeviceOomError` under strict mode
+(spark.rapids.tpu.memory.hbm.strictBudget), or an injected fault from
+runtime/faults.py. The recovery ladder per retryable OOM:
+
+  1. record it (global resilience counters in runtime/metrics.py + an
+     ``oom.retry`` span event in runtime/tracing.py),
+  2. synchronously spill lower-priority buffers down to half the device
+     budget,
+  3. split the input batch in half and re-queue the halves — down to
+     spark.rapids.tpu.memory.retry.splitFloorBytes / a 2-row floor and at
+     most spark.rapids.tpu.memory.retry.maxSplits times per input,
+  4. when unsplittable, allow ONE spill-only retry, then re-raise.
+
+Splitting is EAGER (every retryable OOM on a splittable input splits): with
+no rollback-to-checkpoint malloc underneath, halving the working set is the
+one lever that reliably changes the outcome, and halves land in existing
+power-of-two capacity buckets (columnar/vector.bucket_capacity) so no new
+XLA programs compile. When nothing OOMs the framework is a try/except and a
+fault-registry flag check per attempt — no measurable overhead.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.vector import TpuColumnVector, bucket_capacity
+from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.runtime import tracing
+
+
+class DeviceOomError(RuntimeError):
+    """Device (HBM budget) OOM — the RetryOOM analog. ``retryable`` marks it
+    recoverable by the with_retry ladder: release this attempt's work, spill,
+    (maybe) split the input, re-run."""
+
+    retryable = True
+
+    def __init__(self, msg: str, *, requested: int = 0, budget: int = 0,
+                 spillable_bytes: int = 0, pinned_bytes: int = 0,
+                 injected: bool = False):
+        super().__init__(msg)
+        self.requested = requested
+        self.budget = budget
+        self.spillable_bytes = spillable_bytes
+        self.pinned_bytes = pinned_bytes
+        self.injected = injected
+
+
+class SplitAndRetryOom(DeviceOomError):
+    """Spilling alone cannot satisfy the attempt; the input must be split
+    before the retry (reference SplitAndRetryOOM). Raised against an
+    unsplittable input it propagates immediately."""
+
+
+# -- checkpoint/restore (reference Retryable + withRestoreOnRetry) ------------
+
+@contextlib.contextmanager
+def with_restore_on_retry(*checkpointables):
+    """Snapshot restorable operator state (objects with ``checkpoint()`` /
+    ``restore()``) before an attempt; a retryable OOM rolls the state back
+    before propagating to the surrounding with_retry ladder, so the re-run
+    never double-applies side effects."""
+    for c in checkpointables:
+        c.checkpoint()
+    try:
+        yield
+    except DeviceOomError as e:
+        if getattr(e, "retryable", False):
+            for c in checkpointables:
+                c.restore()
+        raise
+
+
+# -- batch splitting ----------------------------------------------------------
+
+def split_batch(batch: ColumnarBatch, floor_bytes: int = 0):
+    """[first_half, second_half] by rows, or None when the batch cannot be
+    split: fewer than 2 rows, halves would undershoot ``floor_bytes``, or a
+    column type without row-slicing support (list vectors)."""
+    n = batch.num_rows
+    if n < 2:
+        return None
+    if batch.columns:
+        if batch.device_memory_size() // 2 < floor_bytes:
+            return None
+        if any(type(c) is not TpuColumnVector for c in batch.columns):
+            return None
+    mid = n // 2
+    return [_slice_rows(batch, 0, mid), _slice_rows(batch, mid, n)]
+
+
+def _slice_rows(batch: ColumnarBatch, start: int, stop: int) -> ColumnarBatch:
+    n = stop - start
+    cap = bucket_capacity(n)
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    cols = []
+    for c in batch.columns:
+        end = min(start + cap, c.capacity)
+        v = c.data[start:end]
+        m = c.validity[start:end]
+        pad = cap - (end - start)
+        if pad:
+            v = jnp.concatenate(
+                [v, jnp.full((pad,), c.dtype.default_value(), v.dtype)])
+            m = jnp.concatenate([m, jnp.zeros((pad,), m.dtype)])
+        m = m & (idx < n)
+        cols.append(TpuColumnVector(c.dtype, v, m, c.dictionary))
+    return ColumnarBatch(cols, n, batch.schema,
+                         metadata=getattr(batch, "metadata", None))
+
+
+# -- the ladder ---------------------------------------------------------------
+
+def _default_catalog():
+    from spark_rapids_tpu.runtime.memory import DeviceManager
+    return DeviceManager.get().catalog
+
+
+def _spill_for_retry(catalog=None) -> int:
+    cat = catalog if catalog is not None else _default_catalog()
+    spilled = cat.synchronous_spill(cat.device_budget // 2)
+    if spilled:
+        M.global_registry().metric(M.OOM_SPILL_BYTES).add(spilled)
+    return spilled
+
+
+def _record_oom(site, oom, batch=None):
+    M.global_registry().metric(M.NUM_OOM_RETRIES).add(1)
+    tracing.span_event(
+        "oom.retry", site=site,
+        rows=(batch.num_rows if batch is not None and batch.columns else None),
+        injected=getattr(oom, "injected", False))
+
+
+def _record_split(site, batch, halves):
+    M.global_registry().metric(M.NUM_OOM_SPLIT_RETRIES).add(1)
+    tracing.span_event("oom.split", site=site, rows=batch.num_rows,
+                       into=[h.num_rows for h in halves])
+
+
+def _attempt(site, call):
+    """Run one attempt under the fault scope for `site` (so catalog
+    registrations inside attribute to it) with an attempt-level injection
+    checkpoint first — deterministic specs count attempts, not internal
+    allocation calls."""
+    from spark_rapids_tpu.runtime import faults as F
+    if site is None:
+        return call()
+    with F.scope(site):
+        F.maybe_inject("oom", site)
+        return call()
+
+
+def _resolve_limits(conf, max_splits, split_floor_bytes):
+    from spark_rapids_tpu import config as C
+    if conf is not None:
+        if max_splits is None:
+            max_splits = conf.get(C.RETRY_MAX_SPLITS)
+        if split_floor_bytes is None:
+            split_floor_bytes = conf.get(C.RETRY_SPLIT_FLOOR_BYTES)
+    if max_splits is None:
+        max_splits = C.RETRY_MAX_SPLITS.default
+    if split_floor_bytes is None:
+        split_floor_bytes = C.RETRY_SPLIT_FLOOR_BYTES.default
+    return max_splits, split_floor_bytes
+
+
+def with_retry(inputs, fn, *, conf=None, scope=None, splittable=True,
+               max_splits=None, split_floor_bytes=None, catalog=None):
+    """Generator: run ``fn`` over each input batch, recovering from retryable
+    device OOMs by spill + split-and-retry. Yields fn's return values — one
+    per input normally, several when an input was split (callers must accept
+    piece-granularity results; every wired operator does: split probe/agg/
+    partition pieces compose to the unsplit answer).
+
+    ``inputs``: iterable of ColumnarBatch or SpillableColumnarBatch (a
+    spillable input is acquired per attempt and closed after its last piece
+    succeeds, keeping it spillable between attempts)."""
+    from spark_rapids_tpu.runtime.memory import SpillableColumnarBatch
+    max_splits, split_floor_bytes = _resolve_limits(conf, max_splits,
+                                                    split_floor_bytes)
+    site_default = scope
+    for item in inputs:
+        pending = [(item, False)]   # (piece, already-spill-retried)
+        splits_used = 0
+        while pending:
+            cur, retried = pending.pop(0)
+            spillable = isinstance(cur, SpillableColumnarBatch)
+            batch = cur.get_batch() if spillable else cur
+            try:
+                result = _attempt(site_default, lambda: fn(batch))
+            except DeviceOomError as oom:
+                if not getattr(oom, "retryable", False):
+                    raise
+                from spark_rapids_tpu.runtime import faults as F
+                site = site_default or F.current_scope() or "<unscoped>"
+                _record_oom(site, oom, batch)
+                _spill_for_retry(catalog)
+                halves = None
+                if splittable and splits_used < max_splits:
+                    halves = split_batch(batch, floor_bytes=split_floor_bytes)
+                if halves is not None:
+                    splits_used += 1
+                    _record_split(site, batch, halves)
+                    if spillable:
+                        cur.close()
+                    pending[:0] = [(h, False) for h in halves]
+                    continue
+                if isinstance(oom, SplitAndRetryOom) or retried:
+                    raise   # ladder exhausted
+                pending.insert(0, (cur, True))   # one spill-only retry
+                continue
+            if spillable:
+                cur.close()
+            yield result
+
+
+def call_with_retry(thunk, *, scope=None, max_retries=2, catalog=None):
+    """Run a zero-arg callable under spill-only OOM retry — the
+    withRetryNoSplit analog, for work that cannot be split: single-batch
+    registration, merge aggregation of accumulated partials, a whole-batch
+    total sort."""
+    attempt = 0
+    while True:
+        try:
+            return _attempt(scope, thunk)
+        except DeviceOomError as oom:
+            if not getattr(oom, "retryable", False) or attempt >= max_retries:
+                raise
+            attempt += 1
+            from spark_rapids_tpu.runtime import faults as F
+            _record_oom(scope or F.current_scope() or "<unscoped>", oom)
+            _spill_for_retry(catalog)
+
+
+def register_with_retry(batch, priority, *, conf=None, scope=None,
+                        catalog=None, spill_callback=None, max_splits=None,
+                        split_floor_bytes=None):
+    """Register ``batch`` into the spill catalog as one or more
+    SpillableColumnarBatch pieces, recovering from a strict-budget
+    DeviceOomError by spilling and splitting (a failed registration rolls
+    back cleanly in the catalog, so re-attempts are idempotent)."""
+    from spark_rapids_tpu.runtime.memory import SpillableColumnarBatch
+
+    def register(b):
+        return SpillableColumnarBatch(b, priority, catalog=catalog,
+                                      spill_callback=spill_callback)
+
+    return list(with_retry([batch], register, conf=conf, scope=scope,
+                           catalog=catalog, max_splits=max_splits,
+                           split_floor_bytes=split_floor_bytes))

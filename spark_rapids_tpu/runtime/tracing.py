@@ -7,10 +7,36 @@ Perfetto/XProf, coupled to GpuMetric timers, gated by spark.rapids.tpu.sql.trace
 
 from __future__ import annotations
 
+import collections
 import time
 from contextlib import contextmanager
 
 _enabled = False
+
+# zero-duration span events (oom.retry / oom.split / fetch.recompute …): a
+# bounded in-memory ring that chaos tests and postmortems read regardless of
+# whether the profiler is capturing; with tracing enabled each event also
+# lands as a profiler annotation
+_events: "collections.deque" = collections.deque(maxlen=512)
+
+
+def span_event(name: str, **attrs) -> None:
+    _events.append((name, attrs))
+    if _enabled:
+        import jax
+        label = name + ("[" + ",".join(f"{k}={v}" for k, v in attrs.items())
+                        + "]" if attrs else "")
+        with jax.profiler.TraceAnnotation(label):
+            pass
+
+
+def recent_events(name: str | None = None) -> list:
+    evs = list(_events)
+    return evs if name is None else [e for e in evs if e[0] == name]
+
+
+def clear_events() -> None:
+    _events.clear()
 
 
 def set_enabled(v: bool):

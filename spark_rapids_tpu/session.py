@@ -514,6 +514,13 @@ class TpuSession:
         pdir = self.conf.get(CFG.PROFILE_DIR)
         if pdir:
             tracing.start_profile(pdir)
+        # deterministic fault injection (chaos testing, runtime/faults.py):
+        # process-global like the switches above — only an EXPLICIT setting
+        # arms or re-seeds the injector
+        if CFG.TEST_FAULTS.key in self.conf.settings:
+            from spark_rapids_tpu.runtime import faults
+            faults.configure(self.conf.get(CFG.TEST_FAULTS),
+                             self.conf.get(CFG.TEST_FAULTS_SEED))
 
     # -- data sources --------------------------------------------------------
     def read_parquet(self, path, pushed_filter=None,

@@ -11,12 +11,23 @@ ultimately recomputes the map stage. Two complementary layers here:
 - exec/exchange.py owns the STAGE ladder for its local reads: a failed read
   invalidates the map outputs and re-runs the map stage (Spark's
   FetchFailed → stage retry), bounded by spark.rapids.tpu.shuffle.fetch.maxRetries.
+
+Retries back off EXPONENTIALLY with jitter and a hard cap (a linear,
+jitter-free backoff synchronizes a fleet of failed fetchers into retry
+stampedes against a recovering peer), and every retry/failover/recompute is
+counted into the process-wide resilience registry
+(runtime/metrics.global_registry) so chaos tests and bench.py can assert on
+them.
 """
 
 from __future__ import annotations
 
+import random
 import time
 
+from spark_rapids_tpu.runtime import faults as F
+from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.runtime import tracing
 from spark_rapids_tpu.shuffle.transport import TransportError
 
 
@@ -26,25 +37,45 @@ class ShuffleFetchIterator:
 
     def __init__(self, client_factories: list, shuffle_id: int, reduce_id: int,
                  recompute=None, max_retries: int = 2,
-                 retry_backoff_s: float = 0.05):
+                 retry_backoff_s: float = 0.05,
+                 retry_backoff_max_s: float = 2.0, jitter=None):
         """client_factories: zero-arg callables, each returning a FRESH
         ShuffleClient for one peer (a dead connection must not be reused).
         recompute: zero-arg callable yielding the partition's batches by
         re-running the map-side work; raises if it cannot.
-        max_retries: EXTRA attempts per peer beyond the first."""
+        max_retries: EXTRA attempts per peer beyond the first.
+        retry_backoff_s / retry_backoff_max_s: base and cap of the jittered
+        exponential backoff between same-peer attempts.
+        jitter: optional random.Random override; the default seeds from the
+        (shuffle, reduce) ids so a schedule is reproducible per partition
+        while staying decorrelated across partitions."""
         self.client_factories = client_factories
         self.shuffle_id = shuffle_id
         self.reduce_id = reduce_id
         self.recompute = recompute
         self.max_retries = max_retries
         self.retry_backoff_s = retry_backoff_s
+        self.retry_backoff_max_s = retry_backoff_max_s
+        self._rng = jitter or random.Random(
+            0x5F37 ^ (shuffle_id << 16) ^ reduce_id)
         self.errors: list[str] = []
 
+    def _backoff(self, attempt: int) -> float:
+        """Jittered exponential delay: base·2^attempt capped, scaled by a
+        uniform [0.5, 1.0) factor (decorrelates concurrent fetchers)."""
+        d = min(self.retry_backoff_s * (2 ** attempt),
+                self.retry_backoff_max_s)
+        return d * (0.5 + self._rng.random() / 2)
+
     def __iter__(self):
-        for factory in self.client_factories:
+        g = M.global_registry()
+        for pi, factory in enumerate(self.client_factories):
             for attempt in range(self.max_retries + 1):
                 batches = []
                 try:
+                    # chaos checkpoint, shared site name with the stage
+                    # ladder in exec/exchange.py ("transport:fetch:N")
+                    F.maybe_inject("transport", "fetch")
                     client = factory()
                     for b in client.fetch_blocks(self.shuffle_id,
                                                  self.reduce_id):
@@ -53,14 +84,22 @@ class ShuffleFetchIterator:
                         batches.append(b)
                 except TransportError as e:
                     self.errors.append(
-                        f"peer attempt {attempt}: {e}")
+                        f"peer {pi} attempt {attempt}: {e}")
+                    tracing.span_event("fetch.error", peer=pi,
+                                       attempt=attempt, error=str(e)[:120])
                     if attempt < self.max_retries:  # no sleep before failover
-                        time.sleep(self.retry_backoff_s * (attempt + 1))
+                        g.metric(M.FETCH_RETRIES).add(1)
+                        time.sleep(self._backoff(attempt))
                     continue
                 yield from batches
                 return
+            if pi < len(self.client_factories) - 1:
+                g.metric(M.FETCH_FAILOVERS).add(1)
         if self.recompute is None:
             raise TransportError(
                 "all peers failed for shuffle %d reduce %d: %s"
                 % (self.shuffle_id, self.reduce_id, "; ".join(self.errors)))
+        g.metric(M.FETCH_RECOMPUTES).add(1)
+        tracing.span_event("fetch.recompute", shuffle=self.shuffle_id,
+                           reduce=self.reduce_id)
         yield from self.recompute()

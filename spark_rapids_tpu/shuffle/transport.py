@@ -23,6 +23,7 @@ import struct
 import threading
 
 from spark_rapids_tpu import config as CFG
+from spark_rapids_tpu.runtime import faults as F
 from spark_rapids_tpu.shuffle.compression import (BatchedTableCompressor,
                                                   TableCompressionCodec,
                                                   get_codec)
@@ -45,6 +46,9 @@ class TransportError(RuntimeError):
 
 
 def _send_frame(sock, msg_type: int, payload: bytes):
+    # chaos hook: an injected "transport:transport.send" fault models a peer
+    # dying mid-stream (the write side never completes the frame)
+    F.maybe_inject("transport", "transport.send")
     sock.sendall(_FRAME.pack(msg_type, len(payload)) + payload)
 
 
@@ -59,6 +63,9 @@ def _recv_exact(sock, n: int) -> bytes:
 
 
 def _recv_frame(sock):
+    # chaos hook: an injected "transport:transport.recv" fault models a
+    # truncated/NEVER-arriving frame on the read side
+    F.maybe_inject("transport", "transport.recv")
     hdr = _recv_exact(sock, _FRAME.size)
     msg_type, length = _FRAME.unpack(hdr)
     return msg_type, _recv_exact(sock, length)
@@ -138,7 +145,9 @@ class _ServerHandler(socketserver.BaseRequestHandler):
                 else:
                     _send_frame(sock, MSG_ERROR,
                                 f"bad message {msg_type}".encode())
-        except (ConnectionError, BrokenPipeError):
+        except (ConnectionError, BrokenPipeError, TransportError):
+            # a transport fault mid-dispatch (incl. injected chaos faults)
+            # drops the connection — the client observes peer death
             return
 
     def _blocks(self, server, shuffle_id, reduce_id):

@@ -110,21 +110,45 @@ def test_spill_callback_feeds_metrics(tmp_path):
     assert seen and seen[0] == one
 
 
-def test_oom_dump_dir(tmp_path):
+def test_oom_dump_dir_and_strict_raise(tmp_path):
     """When spill cannot reach the budget, allocator state is dumped
-    (spark.rapids.tpu.memory.hbm.oomDumpDir, reference oomDumpDir)."""
+    (spark.rapids.tpu.memory.hbm.oomDumpDir, reference oomDumpDir) and
+    strict mode (hbm.strictBudget, the default) raises a retryable
+    DeviceOomError with the spillable/pinned breakdown, rolling the failed
+    registration back out of the catalog."""
     from spark_rapids_tpu.runtime.memory import (ACTIVE_ON_DECK_PRIORITY,
                                                  BufferCatalog)
+    from spark_rapids_tpu.runtime.retry import DeviceOomError
     cat = BufferCatalog(device_budget=1, host_budget=1 << 30,
                         oom_dump_dir=str(tmp_path))
     b, _ = make_batch(64)
     # a single unspillable-situation: add under a tiny budget; after spilling
     # everything else (nothing), the new buffer itself keeps us over budget
-    cat.add_batch(b, ACTIVE_ON_DECK_PRIORITY)
+    with pytest.raises(DeviceOomError) as ei:
+        cat.add_batch(b, ACTIVE_ON_DECK_PRIORITY)
+    assert ei.value.retryable and ei.value.budget == 1
+    assert "spillable" in str(ei.value)
+    # rollback: the phantom registration must not stay charged
+    assert cat.num_buffers == 0 and cat.device_bytes == 0
     dumps = list(tmp_path.glob("hbm-oom-*.txt"))
     assert dumps, "expected an OOM dump file"
     txt = dumps[0].read_text()
     assert "device_bytes=" in txt and "buffer_id" in txt
+    # per-tier spillable-vs-pinned breakdown (postmortem satellite)
+    assert "tier=DEVICE spillable_bytes=" in txt and "pinned_bytes=" in txt
+
+
+def test_lenient_budget_keeps_legacy_over_budget(tmp_path):
+    """strictBudget=false restores the pre-retry behavior: the catalog stays
+    (knowingly) over budget instead of raising."""
+    from spark_rapids_tpu.runtime.memory import BufferCatalog
+    cat = BufferCatalog(device_budget=1, host_budget=1 << 30,
+                        strict_budget=False, oom_dump_dir=str(tmp_path))
+    b, t = make_batch(64)
+    bid = cat.add_batch(b)
+    assert cat.get_tier(bid) == TierEnum.DEVICE
+    assert cat.device_bytes > cat.device_budget
+    assert cat.acquire_batch(bid).to_arrow().equals(t)
 
 
 def test_direct_spill_store_roundtrip(tmp_path):

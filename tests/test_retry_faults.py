@@ -1,0 +1,595 @@
+"""Chaos suite: task-scoped OOM retry (split-and-retry) + deterministic
+fault injection across memory and shuffle.
+
+Mirrors the reference's RmmRetryIteratorSuite / fault-injection tests built
+on RmmSpark.forceRetryOOM / forceSplitAndRetryOOM: injected device OOMs and
+transport faults must recover through the retry ladders
+(runtime/retry.py, shuffle/fetch.py, exec/exchange.py) to results
+bit-identical with a fault-free run, with the recovery visible in the
+process-wide resilience counters (runtime/metrics.global_registry) and span
+events (runtime/tracing.recent_events)."""
+
+import glob
+import os
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.runtime import faults as F
+from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.runtime import retry as R
+from spark_rapids_tpu.runtime import tracing
+from spark_rapids_tpu.runtime.memory import (BufferCatalog, DeviceManager,
+                                             TierEnum)
+from spark_rapids_tpu.runtime.retry import DeviceOomError, SplitAndRetryOom
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos_state():
+    F.reset()
+    M.reset_global_registry()
+    tracing.clear_events()
+    yield
+    F.reset()
+    M.reset_global_registry()
+    tracing.clear_events()
+
+
+def make_batch(n=100, seed=0):
+    r = np.random.default_rng(seed)
+    t = pa.table({
+        "a": pa.array([None if x % 7 == 0 else int(x)
+                       for x in r.integers(0, 1000, n)], pa.int64()),
+        "d": pa.array(r.normal(size=n)),
+        "s": pa.array([f"w{i % 13}" for i in range(n)]),
+    })
+    return ColumnarBatch.from_arrow(t), t
+
+
+# -- fault spec / injector ----------------------------------------------------
+
+def test_fault_spec_grammar():
+    entries = F.parse_spec("oom:joins.build:2,transport:fetch:1@3,"
+                           "splitoom:agg.update:p0.5")
+    assert [(e.kind, e.site, e.count, e.skip, e.prob) for e in entries] == [
+        ("oom", "joins.build", 2, 0, None),
+        ("transport", "fetch", 1, 3, None),
+        ("splitoom", "agg.update", 0, 0, 0.5)]
+    for bad in ("oom:x", "nuke:x:1", "oom:x:y", "oom:x:1@"):
+        with pytest.raises(ValueError):
+            F.parse_spec(bad)
+
+
+def test_injector_counts_and_skip():
+    F.configure("oom:x:2@1,transport:y:1", seed=0)
+    F.maybe_inject("oom", "x")                  # skipped (the @1)
+    for _ in range(2):
+        with pytest.raises(DeviceOomError):
+            F.maybe_inject("oom", "x")
+    F.maybe_inject("oom", "x")                  # exhausted
+    F.maybe_inject("oom", "other-site")         # never armed
+    F.maybe_inject("transport", "x")            # kind mismatch
+    from spark_rapids_tpu.shuffle.transport import TransportError
+    with pytest.raises(TransportError):
+        F.maybe_inject("transport", "y")
+    assert F.injected_log() == [("oom", "x"), ("oom", "x"),
+                                ("transport", "y")]
+
+
+def test_injector_seeded_probability_is_deterministic():
+    def schedule(seed, hits=50):
+        F.configure("oom:p.site:p0.3", seed=seed)
+        fired = []
+        for i in range(hits):
+            try:
+                F.maybe_inject("oom", "p.site")
+                fired.append(False)
+            except DeviceOomError:
+                fired.append(True)
+        return fired
+
+    a, b = schedule(11), schedule(11)
+    assert a == b and any(a) and not all(a)
+    assert schedule(12) != a
+
+
+# -- split / retry framework --------------------------------------------------
+
+def test_split_batch_roundtrip_and_floors():
+    b, t = make_batch(101)
+    halves = R.split_batch(b)
+    assert [h.num_rows for h in halves] == [50, 51]
+    got = pa.concat_tables([h.to_arrow() for h in halves])
+    assert got.to_pylist() == t.to_pylist()
+    # byte floor: halves below the floor refuse to split
+    assert R.split_batch(b, floor_bytes=b.device_memory_size()) is None
+    # row floor
+    one, _ = make_batch(1)
+    assert R.split_batch(one) is None
+
+
+def test_with_retry_splits_then_recovers():
+    b, t = make_batch(64, seed=3)
+    F.configure("oom:site.z:2", seed=0)
+    pieces = list(R.with_retry([b], lambda x: x, scope="site.z",
+                               split_floor_bytes=1))
+    assert [p.num_rows for p in pieces] == [16, 16, 32]
+    got = pa.concat_tables([p.to_arrow() for p in pieces])
+    assert got.to_pylist() == t.to_pylist()
+    snap = M.resilience_snapshot()
+    assert snap[M.NUM_OOM_RETRIES] == 2
+    assert snap[M.NUM_OOM_SPLIT_RETRIES] == 2
+    assert len(tracing.recent_events("oom.retry")) == 2
+    assert len(tracing.recent_events("oom.split")) == 2
+
+
+def test_with_retry_floor_allows_one_spill_retry_then_raises():
+    b, _ = make_batch(64)
+    F.configure("oom:site.w:99", seed=0)   # every attempt OOMs
+    with pytest.raises(DeviceOomError):
+        # floor above the batch size: no split possible → one spill-only
+        # retry, then re-raise
+        list(R.with_retry([b], lambda x: x, scope="site.w",
+                          split_floor_bytes=1 << 30))
+    assert M.resilience_snapshot()[M.NUM_OOM_SPLIT_RETRIES] == 0
+    assert M.resilience_snapshot()[M.NUM_OOM_RETRIES] == 2
+
+
+def test_split_and_retry_oom_skips_spill_only_retry():
+    b, _ = make_batch(64)
+    F.configure("splitoom:site.v:99", seed=0)
+    with pytest.raises(SplitAndRetryOom):
+        list(R.with_retry([b], lambda x: x, scope="site.v",
+                          splittable=False))
+    # exactly one attempt: SplitAndRetryOom against an unsplittable input
+    # must not burn a useless spill-only retry
+    assert M.resilience_snapshot()[M.NUM_OOM_RETRIES] == 1
+
+
+def test_with_retry_max_splits_bound():
+    b, _ = make_batch(64)
+    F.configure("oom:site.m:99", seed=0)
+    with pytest.raises(DeviceOomError):
+        list(R.with_retry([b], lambda x: x, scope="site.m",
+                          max_splits=2, split_floor_bytes=1))
+    assert M.resilience_snapshot()[M.NUM_OOM_SPLIT_RETRIES] == 2
+
+
+def test_with_restore_on_retry_rolls_back():
+    class Acc:
+        def __init__(self):
+            self.vals = []
+            self._ckpt = None
+
+        def checkpoint(self):
+            self._ckpt = list(self.vals)
+
+        def restore(self):
+            self.vals = list(self._ckpt)
+
+    acc = Acc()
+    F.configure("oom:site.r:1", seed=0)
+    b, _ = make_batch(16)
+
+    def fn(x):
+        with R.with_restore_on_retry(acc):
+            acc.vals.append(x.num_rows)   # side effect BEFORE the oom
+            F.maybe_inject("oom", "site.r")
+            return x.num_rows
+
+    out = list(R.with_retry([b], fn, split_floor_bytes=1))
+    # first attempt appended 16 then OOMed → restored; halves re-ran clean
+    assert acc.vals == [8, 8] and sum(out) == 16
+
+
+def test_call_with_retry_spill_only():
+    F.configure("oom:site.c:2", seed=0)
+    calls = []
+
+    def thunk():
+        calls.append(1)
+        F.maybe_inject("oom", "site.c")
+        return "ok"
+
+    assert R.call_with_retry(thunk) == "ok"
+    assert len(calls) == 3
+    assert M.resilience_snapshot()[M.NUM_OOM_RETRIES] == 2
+
+
+# -- strict budget + catalog recovery ----------------------------------------
+
+def test_register_with_retry_splits_oversized_batch():
+    b, t = make_batch(256, seed=5)
+    cat = BufferCatalog(device_budget=int(b.device_memory_size() * 0.6),
+                        host_budget=1 << 30)
+    pieces = R.register_with_retry(b, 100.0, catalog=cat, split_floor_bytes=1)
+    assert len(pieces) >= 2
+    got = pa.concat_tables([p.get_batch().to_arrow() for p in pieces])
+    assert got.to_pylist() == t.to_pylist()
+    assert M.resilience_snapshot()[M.NUM_OOM_SPLIT_RETRIES] >= 1
+    for p in pieces:
+        p.close()
+    assert cat.num_buffers == 0
+
+
+def test_spill_for_retry_frees_lower_priority_buffers(tmp_path):
+    from spark_rapids_tpu.runtime.memory import (
+        OUTPUT_FOR_SHUFFLE_INITIAL_PRIORITY)
+    b0, _ = make_batch(128, seed=1)
+    # budget fits exactly this buffer; the retry spill targets budget//2,
+    # so the lower-priority shuffle output must leave the device tier
+    cat = BufferCatalog(device_budget=b0.device_memory_size(),
+                        host_budget=1 << 30, spill_dir=str(tmp_path))
+    bid = cat.add_batch(b0, OUTPUT_FOR_SHUFFLE_INITIAL_PRIORITY)
+    assert cat.get_tier(bid) == TierEnum.DEVICE
+    R._spill_for_retry(cat)
+    assert cat.get_tier(bid) != TierEnum.DEVICE
+    assert M.resilience_snapshot()[M.OOM_SPILL_BYTES] > 0
+
+
+# -- operator-level recovery --------------------------------------------------
+
+def _join_plan(conf):
+    from spark_rapids_tpu.exec.basic import ArrowScanExec
+    from spark_rapids_tpu.exec.joins import HashJoinExec
+    from spark_rapids_tpu.expr.core import col
+    left = pa.table({"k": pa.array(np.arange(300, dtype=np.int64) % 40),
+                     "v": pa.array(np.arange(300, dtype=np.float64))})
+    right = pa.table({"k": pa.array(np.arange(40, dtype=np.int64)),
+                      "w": pa.array(np.arange(40, dtype=np.int64) * 10)})
+    return HashJoinExec("inner", [col("k")], [col("k")],
+                        ArrowScanExec([left], batch_rows=64),
+                        ArrowScanExec([right]), conf=conf)
+
+
+def _sorted_rows(table):
+    return sorted(table.to_pylist(),
+                  key=lambda r: tuple((v is None, v) for v in r.values()))
+
+
+def test_hash_join_recovers_from_probe_and_build_oom():
+    conf = RapidsConf({C.RETRY_SPLIT_FLOOR_BYTES.key: "1b"})
+    expect = _sorted_rows(_join_plan(conf).execute_collect())
+    F.configure("oom:joins.build:1,oom:joins.gather:2", seed=0)
+    got = _sorted_rows(_join_plan(conf).execute_collect())
+    assert got == expect
+    snap = M.resilience_snapshot()
+    assert snap[M.NUM_OOM_RETRIES] == 3
+    assert snap[M.NUM_OOM_SPLIT_RETRIES] >= 2   # both gather ooms split
+    assert F.injected_log().count(("oom", "joins.gather")) == 2
+
+
+def test_full_outer_join_matched_acc_restores_under_oom():
+    from spark_rapids_tpu.exec.basic import ArrowScanExec
+    from spark_rapids_tpu.exec.joins import HashJoinExec
+    from spark_rapids_tpu.expr.core import col
+    left = pa.table({"k": pa.array([1, 2, 3, 4, 5, 6, 7, 8], pa.int64())})
+    right = pa.table({"k": pa.array([2, 4, 6, 8, 10, 12], pa.int64())})
+
+    def run():
+        ex = HashJoinExec(
+            "fullouter", [col("k")], [col("k")],
+            ArrowScanExec([left], batch_rows=4), ArrowScanExec([right]),
+            conf=RapidsConf({C.RETRY_SPLIT_FLOOR_BYTES.key: "1b"}))
+        return _sorted_rows(ex.execute_collect())
+
+    expect = run()
+    F.configure("oom:joins.gather:2", seed=0)
+    got = run()
+    # unmatched-build rows emitted exactly once despite re-probed attempts
+    assert got == expect
+
+
+def test_aggregate_recovers_from_update_and_merge_oom():
+    from spark_rapids_tpu.exec.aggregate import HashAggregateExec
+    from spark_rapids_tpu.exec.basic import ArrowScanExec
+    from spark_rapids_tpu.expr.core import Alias, col
+    from spark_rapids_tpu.expr.aggregates import Sum
+    # integer values: int sums are order-independent, so split partials are
+    # BIT-identical to the single pass (float sums can drift a ulp when the
+    # reduction order changes — same caveat as the reference's
+    # variableFloatAgg)
+    t = pa.table({"k": pa.array(np.arange(500, dtype=np.int64) % 17),
+                  "v": pa.array(
+                      np.random.default_rng(0).integers(-1000, 1000, 500))})
+
+    def run():
+        ex = HashAggregateExec(
+            [col("k")], [Alias(Sum(col("v")), "sv")],
+            ArrowScanExec([t], batch_rows=100),
+            conf=RapidsConf({C.RETRY_SPLIT_FLOOR_BYTES.key: "1b"}))
+        return _sorted_rows(ex.execute_collect())
+
+    expect = run()
+    F.configure("oom:agg.update:2,oom:agg.merge:1", seed=0)
+    got = run()
+    assert got == expect
+    snap = M.resilience_snapshot()
+    assert snap[M.NUM_OOM_RETRIES] == 3
+    assert snap[M.NUM_OOM_SPLIT_RETRIES] == 2
+    assert len(F.injected_log()) == 3
+
+
+def test_sort_recovers_with_spill_only_retry():
+    from spark_rapids_tpu.exec.basic import ArrowScanExec
+    from spark_rapids_tpu.exec.sort import SortExec
+    from spark_rapids_tpu.expr.core import col
+    from spark_rapids_tpu.ops.sorting import SortOrder
+    vals = np.random.default_rng(4).integers(0, 1000, 400)
+    t = pa.table({"v": pa.array(vals)})
+
+    def run():
+        ex = SortExec([col("v")], [SortOrder()],
+                      ArrowScanExec([t], batch_rows=128))
+        return ex.execute_collect()["v"].to_pylist()
+
+    expect = run()
+    assert expect == sorted(vals.tolist())
+    F.configure("oom:sort.sort:1", seed=0)
+    assert run() == expect
+    assert M.resilience_snapshot()[M.NUM_OOM_RETRIES] == 1
+
+
+def test_exchange_map_oom_and_fetch_fault_recover():
+    from spark_rapids_tpu.exec.basic import ArrowScanExec
+    from spark_rapids_tpu.exec.exchange import ShuffleExchangeExec
+    from spark_rapids_tpu.expr.core import col
+    from spark_rapids_tpu.shuffle.manager import ShuffleBlockStore
+    from spark_rapids_tpu.shuffle.partitioning import HashPartitioner
+    t = pa.table({"a": pa.array(np.arange(200, dtype=np.int64)),
+                  "b": pa.array([f"x{i % 9}" for i in range(200)])})
+
+    def run():
+        ShuffleBlockStore.reset()
+        ex = ShuffleExchangeExec(
+            HashPartitioner([col("a")], 3), ArrowScanExec([t], batch_rows=64),
+            conf=RapidsConf({C.RETRY_SPLIT_FLOOR_BYTES.key: "1b",
+                             C.NUM_LOCAL_TASKS.key: 1}))
+        return _sorted_rows(ex.execute_collect())
+
+    expect = run()
+    F.configure("oom:exchange.map:2,oom:exchange.write:1,transport:fetch:1",
+                seed=0)
+    got = run()
+    assert got == expect
+    snap = M.resilience_snapshot()
+    assert snap[M.NUM_OOM_SPLIT_RETRIES] >= 2
+    assert snap[M.FETCH_RECOMPUTES] == 1
+    assert ("transport", "fetch") in F.injected_log()
+    assert tracing.recent_events("fetch.recompute")
+    ShuffleBlockStore.reset()
+
+
+# -- shuffle transport / heartbeat error paths --------------------------------
+
+def test_fetch_backoff_is_jittered_exponential_and_capped():
+    from spark_rapids_tpu.shuffle.fetch import ShuffleFetchIterator
+    it = ShuffleFetchIterator([], 1, 0, retry_backoff_s=0.05,
+                              retry_backoff_max_s=0.4)
+    delays = [it._backoff(a) for a in range(12)]
+    for a, d in enumerate(delays):
+        ceiling = min(0.05 * 2 ** a, 0.4)
+        assert ceiling / 2 <= d <= ceiling          # jitter in [0.5, 1.0)×
+    assert max(delays) <= 0.4                        # hard cap
+    # same (shuffle, reduce) → same deterministic jitter schedule
+    it2 = ShuffleFetchIterator([], 1, 0, retry_backoff_s=0.05,
+                               retry_backoff_max_s=0.4)
+    assert [it2._backoff(a) for a in range(12)] == delays
+
+
+def test_fetch_retry_failover_recompute_counters(tmp_path):
+    from spark_rapids_tpu.shuffle.fetch import ShuffleFetchIterator
+    from spark_rapids_tpu.shuffle.manager import ShuffleBlockStore
+    from spark_rapids_tpu.shuffle.transport import TransportError
+    ShuffleBlockStore.reset()
+    store = ShuffleBlockStore.get()
+    batch, t = make_batch(40, seed=9)
+    sid = store.register_shuffle()
+    store.write_block(sid, 0, batch)
+
+    class DeadClient:
+        def fetch_blocks(self, shuffle_id, reduce_id):
+            raise TransportError("peer unreachable")
+            yield  # pragma: no cover
+
+    class GoodClient:
+        def fetch_blocks(self, shuffle_id, reduce_id):
+            yield from store.read_partition(shuffle_id, reduce_id)
+
+    it = ShuffleFetchIterator([DeadClient, GoodClient], sid, 0,
+                              max_retries=1, retry_backoff_s=0.0)
+    out = [b.to_arrow() for b in it]
+    assert len(out) == 1 and out[0].num_rows == 40
+    snap = M.resilience_snapshot()
+    assert snap[M.FETCH_RETRIES] == 1      # one same-peer retry
+    assert snap[M.FETCH_FAILOVERS] == 1    # one failover to the replica
+
+    recomputed = {"n": 0}
+
+    def recompute():
+        recomputed["n"] += 1
+        yield batch
+
+    it2 = ShuffleFetchIterator([DeadClient], sid, 0, recompute=recompute,
+                               max_retries=0, retry_backoff_s=0.0)
+    assert len(list(it2)) == 1 and recomputed["n"] == 1
+    assert M.resilience_snapshot()[M.FETCH_RECOMPUTES] == 1
+    ShuffleBlockStore.reset()
+
+
+def test_tcp_peer_death_mid_stream_fails_over_without_double_consume():
+    """Injected send fault on the server's first data chunk (sends 1-3 are
+    the metadata/transfer handshake): the connection dies mid-stream, the
+    failing attempt is buffered (never partially emitted), the iterator
+    fails over to a healthy factory, and the partition arrives exactly
+    once."""
+    from spark_rapids_tpu.shuffle.fetch import ShuffleFetchIterator
+    from spark_rapids_tpu.shuffle.manager import ShuffleBlockStore
+    from spark_rapids_tpu.shuffle.transport import TcpTransport
+    ShuffleBlockStore.reset()
+    store = ShuffleBlockStore.get()
+    batch, t = make_batch(60, seed=11)
+    sid = store.register_shuffle()
+    store.write_block(sid, 0, batch)
+    transport = TcpTransport(RapidsConf())
+    try:
+        addr = ("127.0.0.1", transport.port)
+        # sends: client META_REQ, server META_RESP, client TRANSFER_REQ,
+        # then the injected fault kills the server's first BLOCK_CHUNK
+        F.configure("transport:transport.send:1@3", seed=0)
+        it = ShuffleFetchIterator(
+            [lambda: transport.make_client(addr)] * 2, sid, 0,
+            max_retries=0, retry_backoff_s=0.0)
+        out = [b.to_arrow() for b in it]
+        assert len(out) == 1 and out[0].to_pylist() == t.to_pylist()
+        assert len(it.errors) == 1
+        assert M.resilience_snapshot()[M.FETCH_FAILOVERS] == 1
+        assert F.injected_log() == [("transport", "transport.send")]
+    finally:
+        transport.shutdown()
+        ShuffleBlockStore.reset()
+
+
+def test_tcp_truncated_frame_one_failover():
+    """A server advertising full block sizes but sending truncated payloads
+    → 'short block' TransportError → exactly one failover to the healthy
+    replica, no double-consume."""
+    from spark_rapids_tpu.shuffle.fetch import ShuffleFetchIterator
+    from spark_rapids_tpu.shuffle.manager import ShuffleBlockStore
+    from spark_rapids_tpu.shuffle.transport import (TcpShuffleServer,
+                                                    TcpTransport)
+    ShuffleBlockStore.reset()
+    store = ShuffleBlockStore.get()
+    batch, t = make_batch(50, seed=12)
+    sid = store.register_shuffle()
+    store.write_block(sid, 0, batch)
+    transport = TcpTransport(RapidsConf())
+    real = TcpShuffleServer.serialized_blocks
+    calls = {"n": 0}
+
+    def flaky_blocks(self, shuffle_id, reduce_id):
+        blobs = real(self, shuffle_id, reduce_id)
+        calls["n"] += 1
+        if calls["n"] == 2:
+            # call 1 = metadata (full sizes), call 2 = the transfer:
+            # truncate the payload so the client's size check trips
+            return [b[:-8] for b in blobs]
+        return blobs
+
+    TcpShuffleServer.serialized_blocks = flaky_blocks
+    try:
+        addr = ("127.0.0.1", transport.port)
+        it = ShuffleFetchIterator(
+            [lambda: transport.make_client(addr)] * 2, sid, 0,
+            max_retries=0, retry_backoff_s=0.0)
+        out = [b.to_arrow() for b in it]
+        assert len(out) == 1 and out[0].to_pylist() == t.to_pylist()
+        assert len(it.errors) == 1 and "short block" in it.errors[0]
+        assert M.resilience_snapshot()[M.FETCH_FAILOVERS] == 1
+    finally:
+        TcpShuffleServer.serialized_blocks = real
+        transport.shutdown()
+        ShuffleBlockStore.reset()
+
+
+def test_heartbeat_endpoint_survives_transient_manager_failure():
+    from spark_rapids_tpu.shuffle.heartbeat import (
+        RapidsShuffleHeartbeatEndpoint, RapidsShuffleHeartbeatManager)
+    mgr = RapidsShuffleHeartbeatManager(timeout_s=60)
+    a = RapidsShuffleHeartbeatEndpoint(mgr, "exec-a", "h1", 1, interval_s=0.01)
+    try:
+        real = mgr.heartbeat
+        fails = {"n": 3}
+
+        def flaky(executor_id):
+            if fails["n"] > 0:
+                fails["n"] -= 1
+                raise ConnectionError("driver unreachable")
+            return real(executor_id)
+
+        mgr.heartbeat = flaky
+        # the beat loop swallows transient failures and keeps beating
+        waiter = threading.Event()
+        for _ in range(500):            # up to 5s on a loaded box
+            if fails["n"] == 0:
+                break
+            waiter.wait(0.01)
+        assert fails["n"] == 0          # failures were consumed, not fatal
+        mgr.register("exec-b", "h2", 2)
+        a.beat_now()                    # recovered: learns the new peer
+        assert [p.executor_id for p in a.known_peers()] == ["exec-b"]
+    finally:
+        a.close()
+
+
+def test_heartbeat_expiry_names_dead_peers_for_invalidation():
+    from spark_rapids_tpu.shuffle.heartbeat import (
+        RapidsShuffleHeartbeatManager)
+    mgr = RapidsShuffleHeartbeatManager(timeout_s=0.03)
+    mgr.register("exec-dead", "h", 1)
+    mgr.register("exec-live", "h", 2)
+    threading.Event().wait(0.05)
+    mgr.heartbeat("exec-live")
+    dead = mgr.expire_dead()
+    assert [p.executor_id for p in dead] == ["exec-dead"]
+    assert {p.executor_id for p in mgr.live_peers()} == {"exec-live"}
+    with pytest.raises(KeyError):
+        mgr.heartbeat("exec-dead")
+
+
+# -- the acceptance chaos run: TPC-H q18 --------------------------------------
+
+@pytest.fixture(scope="module")
+def tpch_paths(tmp_path_factory):
+    from spark_rapids_tpu.benchmarks import tpch
+    d = tmp_path_factory.mktemp("tpch_chaos")
+    return tpch.generate(0.005, str(d))
+
+
+def _run_q18(paths, extra_conf=None):
+    """q18 over explicit per-file scan partitions (multi-partition scans put
+    a real ShuffleExchangeExec under the group-by, so the fetch ladder is
+    live — directory scans collapse to one partition)."""
+    from spark_rapids_tpu.benchmarks import tpch
+    from spark_rapids_tpu.session import TpuSession
+    conf = {C.NUM_LOCAL_TASKS.key: 1}
+    conf.update(extra_conf or {})
+    spark = TpuSession(conf)
+    dfs = {}
+    for name, p in paths.items():
+        files = sorted(glob.glob(os.path.join(p, "*.parquet"))) or [p]
+        dfs[name] = spark.read_parquet(files, files_per_partition=2)
+    return tpch.q18(dfs).collect().to_pylist()
+
+
+def test_q18_chaos_bit_identical(tpch_paths):
+    """THE acceptance run: two injected join-build OOMs + one dropped fetch
+    still produce results bit-identical with the fault-free run, with ≥2
+    splits and ≥1 fetch recovery in the metrics."""
+    clean = _run_q18(tpch_paths)
+    M.reset_global_registry()
+    tracing.clear_events()
+    chaos = _run_q18(tpch_paths, {
+        C.TEST_FAULTS.key: "oom:joins.build:2,transport:fetch:1",
+        C.TEST_FAULTS_SEED.key: 42,
+        C.RETRY_SPLIT_FLOOR_BYTES.key: "1b",
+    })
+    assert chaos == clean
+    snap = M.resilience_snapshot()
+    assert snap[M.NUM_OOM_SPLIT_RETRIES] >= 2
+    assert snap[M.FETCH_RECOMPUTES] + snap[M.FETCH_RETRIES] >= 1
+    # the whole configured schedule fired
+    log = F.injected_log()
+    assert log.count(("oom", "joins.build")) == 2
+    assert log.count(("transport", "fetch")) == 1
+    F.reset()
+    # and with injection disarmed the same query is fault-free again
+    M.reset_global_registry()
+    assert _run_q18(tpch_paths) == clean
+    snap = M.resilience_snapshot()
+    assert snap[M.NUM_OOM_RETRIES] == 0 and snap[M.FETCH_RECOMPUTES] == 0
