@@ -200,6 +200,29 @@ def child_main():
                 "oracle_e2e_s": round(np_e2e, 4),
                 "vs_baseline": round(np_e2e / eng, 3),
             }
+            # per-operator attribution (query observability collector): the
+            # last timed rep's self-time breakdown, so BENCH_*.json
+            # trajectories are attributable to operators, not whole queries
+            qm = spark.last_query_metrics()
+            if qm is not None:
+                ops = []
+                for n in qm.node_summaries():
+                    if n["id"] is None:
+                        continue
+                    m = n["metrics"]
+                    self_s = m.get("selfTime", 0) / 1e9
+                    build_s = m.get("buildSelfTime", 0) / 1e9
+                    ops.append({"op": f"{n['name']}#{n['id']}",
+                                "self_s": round(self_s, 4),
+                                "rows": m.get("numOutputRows")})
+                    if build_s > 0:
+                        ops.append({"op": f"{n['name']}#{n['id']} (build)",
+                                    "self_s": round(build_s, 4)})
+                ops.sort(key=lambda r: -r["self_s"])
+                total_self = sum(r["self_s"] for r in ops)
+                per_query[name]["operators"] = ops[:8]
+                per_query[name]["op_coverage"] = (
+                    round(total_self / qm.wall_s, 3) if qm.wall_s else None)
 
     # resilience counters (retry/split/fetch-failover totals across the
     # whole ladder run): with faults disabled these must be zero — a later

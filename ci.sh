@@ -64,6 +64,62 @@ echo "== chaos: task-scoped OOM retry + deterministic fault injection =="
 # visible in the resilience counters
 JAX_PLATFORMS=cpu python -m pytest tests/test_retry_faults.py -q
 
+echo "== observability: event log overhead + profiler gate =="
+# run the q18 ladder query with the event log disabled then enabled: the log
+# must add <5% wall time, and tools/profiler.py must replay it into a report
+# with a clean schema and a non-empty operator breakdown (join build named)
+obs_dir=$(mktemp -d)
+JAX_PLATFORMS=cpu SRT_OBS_DIR="$obs_dir" python - <<'PYEOF'
+import jax; jax.config.update("jax_platforms", "cpu")
+import os, statistics, time
+import spark_rapids_tpu  # noqa: F401  (enables x64)
+from spark_rapids_tpu.benchmarks import tpch
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.runtime import eventlog
+
+paths = tpch.generate(0.01, "/tmp/tpch_ci_sf0.01")
+REPS = 5
+
+def run(conf):
+    spark = TpuSession(conf)
+    dfs = tpch.load(spark, paths, files_per_partition=4)
+    df = tpch.q18(dfs)
+    df.collect()                      # warm (compiles cached after)
+    ts = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        df.collect()
+        ts.append(time.perf_counter() - t0)
+    return statistics.median(ts)
+
+off_s = run({})
+on_s = run({"spark.rapids.tpu.eventLog.dir": os.environ["SRT_OBS_DIR"],
+            "spark.rapids.tpu.eventLog.healthSample.intervalSeconds": 0.5})
+eventlog.shutdown()
+overhead = (on_s - off_s) / off_s
+print(f"event log overhead on q18: off={off_s:.4f}s on={on_s:.4f}s "
+      f"({overhead:+.1%})")
+# <5% wall-time budget, with a small absolute floor so scheduler noise on a
+# loaded CI box cannot flake a sub-25ms delta into a failure
+assert on_s <= off_s * 1.05 + 0.02, (on_s, off_s)
+PYEOF
+obs_log=$(ls "$obs_dir"/*.jsonl | head -1)
+python tools/profiler.py report "$obs_log" --json > /tmp/obs_report.json
+python -c '
+import json
+r = json.load(open("/tmp/obs_report.json"))
+assert r["violations"] == [], r["violations"][:5]
+qs = [q for q in r["queries"] if q["operators"]]
+assert qs, "no query with a non-empty operator breakdown"
+q18 = qs[-1]
+names = " ".join(o["op"] for o in q18["operators"])
+assert "(build)" in names, names   # the join build is a distinct line item
+print("profiler gate ok:", len(qs), "queries,",
+      len(q18["operators"]), "operators, self-time coverage",
+      q18["coverage"])
+'
+rm -rf "$obs_dir"
+
 echo "== api coverage gate (0 missing vs reference GpuOverrides) =="
 python tools/api_validation.py 0 0
 

@@ -433,6 +433,20 @@ BROADCAST_MAX_TABLE_BYTES = conf("spark.rapids.tpu.sql.broadcast.maxTableBytes"
     "Fail a broadcast whose materialized relation exceeds this size "
     "(reference maxBroadcastTableSize guard); 0 disables").bytes_conf("8g")
 
+EVENT_LOG_DIR = conf("spark.rapids.tpu.eventLog.dir").doc(
+    "Directory for the structured JSONL event log (query/stage/batch "
+    "lifecycle, spill, OOM-retry/split, fetch retry/failover/recompute, "
+    "heartbeat loss, executor health gauges — runtime/eventlog.py; the Spark "
+    "event-log analog consumed by tools/profiler.py). Empty disables with "
+    "near-zero overhead").string_conf(None)
+
+EVENT_LOG_HEALTH_INTERVAL = conf(
+    "spark.rapids.tpu.eventLog.healthSample.intervalSeconds").doc(
+    "Period of the executor-health gauge sampler (HBM used/free + "
+    "spill-catalog tier occupancy) written to the event log by the "
+    "heartbeat/sampler thread; <=0 disables sampling. Only meaningful when "
+    "eventLog.dir is set").double_conf(5.0)
+
 PROFILE_DIR = conf("spark.rapids.tpu.profile.dir").doc(
     "Directory for a whole-session XProf/Perfetto capture "
     "(jax.profiler.start_trace; the reference's Nsight workflow, "

@@ -63,6 +63,13 @@ class TpuExec:
         self._out_rows = self.metrics.metric(M.NUM_OUTPUT_ROWS, M.ESSENTIAL)
         self._out_batches = self.metrics.metric(M.NUM_OUTPUT_BATCHES, M.MODERATE)
         self._op_time = self.metrics.metric(M.OP_TIME, M.MODERATE)
+        self._self_time = self.metrics.metric(M.SELF_TIME, M.ESSENTIAL)
+        # query-scoped observability (SQL-UI analog): conversion runs inside
+        # the action's QueryMetricsCollector scope, so every exec registers
+        # its registry under a plan-node id at construction
+        collector = M.current_collector()
+        self._node_id = (collector.register(self)
+                         if collector is not None else None)
 
     @property
     def child(self) -> "TpuExec":
@@ -87,9 +94,12 @@ class TpuExec:
         from concurrent.futures import ThreadPoolExecutor
         from spark_rapids_tpu.config import NUM_LOCAL_TASKS
         nthreads = max(1, min(self.conf.get(NUM_LOCAL_TASKS), self.num_partitions))
+        collector = M.current_collector()
 
         def run(split):
-            with TaskContext():
+            # re-enter the driving action's query scope on the pool thread so
+            # metrics/events fired by operators attribute to this query
+            with M.collector_context(collector), TaskContext():
                 return [b.to_arrow() for b in self.execute_partition(split)]
 
         if self.num_partitions == 1:
@@ -103,13 +113,29 @@ class TpuExec:
         return pa.concat_tables(tables)
 
     def wrap_output(self, it):
-        """Instrument an output iterator with row/batch metrics. Row counts
+        """Instrument an output iterator with row/batch metrics and one
+        self-time attribution frame per batch pull: time spent producing a
+        batch, minus time charged by nested operator frames on this thread,
+        lands in this node's selfTime (the SQL-UI op-time analog). Row counts
         accumulate LAZILY (device scalars fold in at metric read time) — a
         per-batch host sync here would serialize every operator on the
         accelerator round-trip."""
-        for b in it:
+        from spark_rapids_tpu.runtime import eventlog as EL
+        it = iter(it)
+        while True:
+            with M.node_frame(self._node_id, self._self_time):
+                try:
+                    b = next(it)
+                except StopIteration:
+                    return
             self._out_batches.add(1)
             self._out_rows.add_lazy(b.lazy_num_rows)
+            if EL.enabled():
+                # batch lifecycle event; never force a device sync for the
+                # row count — a still-lazy count is logged as null
+                n = b.lazy_num_rows
+                EL.emit("batch", node=self._node_id,
+                        rows=n if isinstance(n, int) else None)
             yield b
 
     def tree_string(self, indent=0):

@@ -100,10 +100,27 @@ class BroadcastExchangeExec(TpuExec):
         future with broadcastTimeout)."""
         with self._lock:
             if self._future is None:
-                self._future = _spawn_build(self._materialize)
+                # the build thread must re-enter the caller's query scope
+                # (metrics/events attribution) and charge its wall time to
+                # this node's selfTime — consumers only ever BLOCK on the
+                # future, so the build is otherwise invisible to the
+                # per-thread attribution frames
+                collector = M.current_collector()
+
+                def build():
+                    with M.collector_context(collector), \
+                            M.node_frame(self._node_id,
+                                         self.metrics.metric(
+                                             M.BUILD_SELF_TIME, M.ESSENTIAL)):
+                        return self._materialize()
+
+                self._future = _spawn_build(build)
             fut = self._future
         try:
-            return fut.result(timeout=self._timeout)
+            # metric=None frame: the build thread charges itself; the
+            # consumer's blocked wait must not double-count in its own frame
+            with M.node_frame(self._node_id, None):
+                return fut.result(timeout=self._timeout)
         except concurrent.futures.TimeoutError:
             raise BroadcastTimeout(
                 f"broadcast of {self.child.args_string()!s} did not finish "

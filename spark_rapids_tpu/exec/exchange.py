@@ -17,6 +17,7 @@ from concurrent.futures import ThreadPoolExecutor
 from spark_rapids_tpu import config as C
 from spark_rapids_tpu.exec.base import TpuExec, TaskContext
 from spark_rapids_tpu.exec.coalesce import coalesce_iterator, TargetSize
+from spark_rapids_tpu.runtime import eventlog as EL
 from spark_rapids_tpu.runtime import faults as F
 from spark_rapids_tpu.runtime import memory as mem
 from spark_rapids_tpu.runtime import metrics as M
@@ -51,6 +52,11 @@ class ShuffleExchangeExec(TpuExec):
         store = ShuffleBlockStore.get()
         serialized = not self.conf.get(C.SHUFFLE_MANAGER_ENABLED)
         self._shuffle_id = store.register_shuffle(serialized=serialized)
+        collector = M.current_collector()
+        EL.emit("stage.map.start", node=self._node_id,
+                shuffle=self._shuffle_id,
+                map_partitions=self.child.num_partitions,
+                reduce_partitions=self.partitioner.num_partitions)
 
         if isinstance(self.partitioner, RangePartitioner):
             # driver-side sample pass to pick range bounds (reference
@@ -66,7 +72,12 @@ class ShuffleExchangeExec(TpuExec):
                 self.partitioner.set_bounds_from_sample(samples)
 
         def map_task(split):
-            with TaskContext():
+            # pool thread: re-enter the query scope and open an attribution
+            # frame for this exchange so map-side partitioning time lands on
+            # this node's selfTime (child operator frames subtract their own)
+            with M.collector_context(collector), \
+                    M.node_frame(self._node_id, self._self_time), \
+                    TaskContext():
                 for batch in self.child.execute_partition(split):
                     if batch.num_rows == 0:
                         continue
@@ -97,6 +108,14 @@ class ShuffleExchangeExec(TpuExec):
         else:
             with ThreadPoolExecutor(max_workers=nthreads) as pool:
                 list(pool.map(map_task, range(self.child.num_partitions)))
+        if EL.enabled():
+            # per-reduce-partition byte sizes: the profiler's shuffle-skew
+            # input (bounded: one int per reduce partition)
+            sizes = ShuffleBlockStore.get().partition_sizes(
+                self._shuffle_id, self.partitioner.num_partitions)
+            EL.emit("stage.map.end", node=self._node_id,
+                    shuffle=self._shuffle_id,
+                    partition_sizes=[int(s) for s in sizes])
 
     def _ensure_map_stage(self):
         if self._map_done.is_set():
@@ -171,7 +190,8 @@ class ShuffleExchangeExec(TpuExec):
                 tracing.span_event("fetch.recompute", split=split,
                                    error=str(e)[:120])
                 self._invalidate_map_stage()
-                self._ensure_map_stage()
+                with M.node_frame(self._node_id, None):
+                    self._ensure_map_stage()
 
     def account_read_done(self):
         """One reduce partition finished (drained OR abandoned unopened);
@@ -208,7 +228,11 @@ class ShuffleExchangeExec(TpuExec):
         from spark_rapids_tpu.exec.base import current_task_id
         from spark_rapids_tpu.runtime.semaphore import TpuSemaphore
         TpuSemaphore.get().release_if_necessary(current_task_id())
-        self._ensure_map_stage()
+        # metric=None frame: waiting for (or inline-running) the map stage is
+        # charged by the map tasks' own frames; the parent operator's frame
+        # must not double-count the blocked wall time
+        with M.node_frame(self._node_id, None):
+            self._ensure_map_stage()
         return self.wrap_output(self._reader(split))
 
     def args_string(self):
@@ -250,7 +274,9 @@ class AdaptiveShuffleReaderExec(TpuExec):
         if self._specs is not None:
             return self._specs
         ex = self.child
-        ex._ensure_map_stage()        # own double-checked synchronization
+        # same no-double-count contract as ShuffleExchangeExec.execute_partition
+        with M.node_frame(ex._node_id, None):
+            ex._ensure_map_stage()    # own double-checked synchronization
         with self._spec_lock:
             if self._specs is None:
                 n = ex.partitioner.num_partitions

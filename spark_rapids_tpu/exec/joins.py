@@ -679,7 +679,15 @@ class HashJoinExec(TpuExec):
         def it():
             build_child = self.children[1] if self.stream_is_left else self.children[0]
             stream_child = self.children[0] if self.stream_is_left else self.children[1]
+            # nested attribution frame: the build's own work (concat +
+            # spillable registration, minus child pulls) lands in
+            # buildSelfTime and is subtracted from this join's selfTime, so
+            # the profiler can render the build as a distinct line item
+            # without double counting (buildTime stays the INCLUSIVE timer)
             with trace_range("HashJoin.build", self._build_time), \
+                    M.node_frame(self._node_id,
+                                 self.metrics.metric(M.BUILD_SELF_TIME,
+                                                     M.MODERATE)), \
                     F.scope("joins.build"):
                 build_batch = concat_all(build_child.execute_partition(split),
                                          build_child.output, conf=self.conf)

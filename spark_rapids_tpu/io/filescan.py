@@ -426,7 +426,9 @@ class FileSourceScanExec(TpuExec):
                     scan_readahead_budget)
                 gen = R.readahead_tables(
                     gen, depth, scan_readahead_budget(
-                        conf.get(CFG.SCAN_READAHEAD_MAX_BUFFER)))
+                        conf.get(CFG.SCAN_READAHEAD_MAX_BUFFER)),
+                    stall_metric=self.metrics.metric(
+                        M.READAHEAD_STALL_TIME, M.MODERATE))
             for tbl in gen:
                 acquire_semaphore(self.metrics)
                 with trace_range("FileScan.h2d", self._scan_time):

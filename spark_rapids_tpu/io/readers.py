@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import concurrent.futures as futures
 import threading
+import time
 import typing
 
 import pyarrow as pa
@@ -237,7 +238,8 @@ def reader_for(fmt: str, **kw) -> FormatReader:
 
 # -- scan readahead ----------------------------------------------------------
 
-def readahead_tables(gen, depth: int, budget_bytes: int | None = None):
+def readahead_tables(gen, depth: int, budget_bytes: int | None = None,
+                     stall_metric=None):
     """Bounded background readahead over a table generator: a daemon thread
     drains `gen` up to `depth` items ahead of the consumer so host decode of
     batch N+1 overlaps whatever the consumer does with batch N (device
@@ -246,7 +248,10 @@ def readahead_tables(gen, depth: int, budget_bytes: int | None = None):
     error re-raises at the consumer's position. `budget_bytes` additionally
     bounds the BYTES buffered (spill-budget awareness — see
     runtime/memory.scan_readahead_budget); one oversized table may always
-    be staged so progress never deadlocks.
+    be staged so progress never deadlocks. `stall_metric` (a GpuMetric)
+    accumulates the nanoseconds the CONSUMER spent blocked waiting on the
+    producer — the "readahead stall time" the profiling tool surfaces: a
+    large value means decode, not device compute, is the bottleneck.
 
     Reference analog: MultiFileCloudParquetPartitionReader:1377 prefetches
     whole files on a pool; this stage generalizes the overlap to every
@@ -294,7 +299,12 @@ def readahead_tables(gen, depth: int, budget_bytes: int | None = None):
     t.start()
     try:
         while True:
-            kind, val, nb = q.get()
+            if stall_metric is not None:
+                t0 = time.perf_counter_ns()
+                kind, val, nb = q.get()
+                stall_metric.add(time.perf_counter_ns() - t0)
+            else:
+                kind, val, nb = q.get()
             if kind == "done":
                 return
             if kind == "error":

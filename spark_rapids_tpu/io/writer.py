@@ -208,12 +208,15 @@ def write_columnar(exec_or_node, path: str, fmt: str = "parquet",
                    else entry.default) if entry is not None else "ARROW"
     native = str(writer_type).upper() == "NATIVE"
 
+    from spark_rapids_tpu.runtime import metrics as M
+    collector = M.current_collector()
+
     def run_split(split):
         writer = _TaskWriter(temp_dir, split, fmt, compression, partition_by,
                              schema, job_uuid, native=native)
         try:
             if isinstance(exec_or_node, TpuExec):
-                with TaskContext():
+                with M.collector_context(collector), TaskContext():
                     for batch in exec_or_node.execute_partition(split):
                         writer.write_batch(batch)
             else:

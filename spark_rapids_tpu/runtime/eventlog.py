@@ -1,0 +1,230 @@
+"""Structured JSONL event log — the Spark event-log analog.
+
+Reference: Spark writes SparkListenerEvent JSON lines that the RAPIDS
+Profiling Tool (tools/profiling) post-processes into tuning reports; the
+reference plugin's own metrics ride inside those events. Here the engine is
+standalone, so this module IS the listener bus: query/stage/batch lifecycle,
+spill, OOM-retry/split, fetch retry/failover/recompute, heartbeat loss and
+periodic executor health gauges are appended as one JSON object per line to
+``spark.rapids.tpu.eventLog.dir``, and tools/profiler.py replays the file
+into an analysis report.
+
+Overhead contract: when no directory is configured every emit() is a single
+attribute load + None check — hot paths (per-batch lifecycle) additionally
+pre-check enabled() so no event dict is even built.
+
+Record schema (validated by validate_record(), enforced by the profiler):
+  event  str   one of KNOWN_EVENTS
+  ts     float unix wall-clock seconds (human correlation)
+  t      float monotonic seconds — strictly non-decreasing within one file
+               (computed under the writer lock)
+  query  str|None  query id from the ambient QueryMetricsCollector
+  node   int|None  plan-node id from the ambient node_frame stack
+plus per-event payload fields.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import threading
+import time
+
+from spark_rapids_tpu.runtime import metrics as M
+
+KNOWN_EVENTS = frozenset({
+    # query lifecycle (emitted by DataFrame actions, session.py)
+    "query.start", "query.end", "query.error",
+    # stage/batch lifecycle
+    "stage.map.start", "stage.map.end", "batch",
+    # memory pressure (runtime/memory.py + runtime/retry.py via tracing)
+    "spill", "oom.retry", "oom.split",
+    # shuffle fetch ladder (shuffle/fetch.py + exec/exchange.py via tracing)
+    "fetch.error", "fetch.retry", "fetch.failover", "fetch.recompute",
+    # liveness (shuffle/heartbeat.py + the health sampler below)
+    "heartbeat.loss", "executor.health",
+})
+
+# events that only make sense inside a query's dynamic extent; the profiler
+# flags them as schema violations when they carry no query id
+QUERY_SCOPED_EVENTS = frozenset({
+    "query.start", "query.end", "query.error", "batch",
+    "stage.map.start", "stage.map.end",
+})
+
+_lock = threading.Lock()
+_writer: "EventLogWriter | None" = None
+_sampler: "_HealthSampler | None" = None
+
+
+class EventLogWriter:
+    """Append-only JSONL writer; one file per process per configure()."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "a", encoding="utf-8")
+        self._lock = threading.Lock()
+        self._last_t = 0.0
+
+    def write(self, record: dict) -> None:
+        with self._lock:
+            # stamp under the lock: `t` is the file's ordering key and must
+            # never run backwards between adjacent lines
+            t = time.monotonic()
+            if t < self._last_t:
+                t = self._last_t
+            self._last_t = t
+            record["t"] = t
+            line = json.dumps(record, separators=(",", ":"), default=str)
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+
+def configure(directory: str, health_interval_s: float = 0.0) -> str:
+    """Open an event log file under `directory` (created if missing) and make
+    it the process-wide sink; returns the file path. health_interval_s > 0
+    additionally starts the periodic executor-health sampler."""
+    global _writer, _sampler
+    os.makedirs(directory, exist_ok=True)
+    stamp = datetime.datetime.now().strftime("%Y%m%d-%H%M%S")
+    path = os.path.join(directory,
+                        f"events-{os.getpid()}-{stamp}.jsonl")
+    with _lock:
+        if _writer is not None:
+            _writer.close()
+        _writer = EventLogWriter(path)
+        if _sampler is not None:
+            _sampler.stop()
+            _sampler = None
+        if health_interval_s > 0:
+            _sampler = _HealthSampler(health_interval_s)
+    return path
+
+
+def shutdown() -> None:
+    global _writer, _sampler
+    with _lock:
+        if _sampler is not None:
+            _sampler.stop()
+            _sampler = None
+        if _writer is not None:
+            _writer.close()
+            _writer = None
+
+
+def enabled() -> bool:
+    return _writer is not None
+
+
+def current_path() -> str | None:
+    w = _writer
+    return w.path if w is not None else None
+
+
+def emit(event: str, *, query: str | None = None, node: int | None = None,
+         **fields) -> None:
+    """Append one event. `query`/`node` default to the ambient query scope
+    (runtime/metrics collector + node_frame stack); a no-op when no event
+    log is configured."""
+    w = _writer
+    if w is None:
+        return
+    record = {
+        "event": event,
+        "ts": time.time(),
+        "t": 0.0,   # stamped by the writer under its lock
+        "query": query if query is not None else M.current_query_id(),
+        "node": node if node is not None else M.current_node(),
+    }
+    record.update(fields)
+    w.write(record)
+
+
+def health_payload() -> dict:
+    """Executor health gauges: HBM budget/used/free plus per-tier
+    spill-catalog occupancy. Never forces device initialization — an
+    unstarted DeviceManager reports empty gauges."""
+    from spark_rapids_tpu.runtime.memory import DeviceManager, TierEnum
+    dm = DeviceManager._instance
+    if dm is None:
+        return {"device_initialized": False}
+    cat = dm.catalog
+    tiers = {TierEnum.DEVICE: [0, 0], TierEnum.HOST: [0, 0],
+             TierEnum.DISK: [0, 0]}
+    with cat._lock:
+        for b in cat._buffers.values():
+            tiers[b.tier][0] += 1
+            tiers[b.tier][1] += b.size
+        out = {
+            "device_initialized": True,
+            "hbm_budget_bytes": cat.device_budget,
+            "hbm_used_bytes": cat.device_bytes,
+            "hbm_free_bytes": max(cat.device_budget - cat.device_bytes, 0),
+            "host_spill_budget_bytes": cat.host_budget,
+            "host_spill_used_bytes": cat.host_bytes,
+            "spilled_to_host_bytes": cat.spilled_to_host_bytes,
+            "spilled_to_disk_bytes": cat.spilled_to_disk_bytes,
+            "tiers": {t: {"buffers": n, "bytes": sz}
+                      for t, (n, sz) in tiers.items()},
+        }
+    return out
+
+
+def emit_health(executor: str | None = None) -> None:
+    if _writer is None:
+        return
+    emit("executor.health", query=None, node=None,
+         executor=executor, **health_payload())
+
+
+class _HealthSampler:
+    """Daemon thread emitting executor.health gauges on a fixed period (the
+    local stand-in for the shuffle heartbeat thread's sampling duty when no
+    transport endpoint is running)."""
+
+    def __init__(self, interval_s: float):
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="srt-eventlog-health")
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                emit_health()
+            except Exception:   # noqa: BLE001 — sampling must never crash
+                pass
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=2)
+
+
+def validate_record(rec: dict) -> list:
+    """Schema check for one parsed line; returns a list of violation strings
+    (empty = valid). Shared by tools/profiler.py and the tests so the
+    enforced schema cannot drift from the emitted one."""
+    errs = []
+    ev = rec.get("event")
+    if not isinstance(ev, str):
+        errs.append("missing 'event'")
+        return errs
+    if ev not in KNOWN_EVENTS:
+        errs.append(f"unknown event {ev!r}")
+    if not isinstance(rec.get("ts"), (int, float)):
+        errs.append(f"{ev}: missing numeric 'ts'")
+    if not isinstance(rec.get("t"), (int, float)):
+        errs.append(f"{ev}: missing monotonic 't'")
+    if "query" not in rec or "node" not in rec:
+        errs.append(f"{ev}: missing query/node attribution keys")
+    if ev in QUERY_SCOPED_EVENTS and not rec.get("query"):
+        errs.append(f"{ev}: query-scoped event without a query id")
+    return errs

@@ -33,6 +33,7 @@ from spark_rapids_tpu import config as C
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.columnar.vector import TpuColumnVector
+from spark_rapids_tpu.runtime import eventlog as EL
 from spark_rapids_tpu.runtime import faults as F
 from spark_rapids_tpu.runtime.arm import LeakTracker
 from spark_rapids_tpu.runtime.retry import DeviceOomError
@@ -270,6 +271,10 @@ class BufferCatalog:
         self.device_bytes -= buf.size
         self.host_bytes += hb.nbytes()
         self.spilled_to_host_bytes += buf.size
+        if EL.enabled():
+            EL.emit("spill", tier_from=TierEnum.DEVICE, tier_to=TierEnum.HOST,
+                    bytes=buf.size, buffer=buf.buffer_id,
+                    priority=buf.priority)
         if buf.spill_callback:
             buf.spill_callback(buf.size)
         self._ensure_host_budget()
@@ -314,6 +319,10 @@ class BufferCatalog:
             buf._handle = None
         self.host_bytes -= hb.nbytes()
         self.spilled_to_disk_bytes += hb.nbytes()
+        if EL.enabled():
+            EL.emit("spill", tier_from=TierEnum.HOST, tier_to=TierEnum.DISK,
+                    bytes=hb.nbytes(), buffer=buf.buffer_id,
+                    priority=buf.priority)
         buf._host = None
         buf.tier = TierEnum.DISK
 

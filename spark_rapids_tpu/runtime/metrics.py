@@ -7,8 +7,11 @@ makeSpillCallback feeding spill bytes back into the running operator's metrics."
 
 from __future__ import annotations
 
+import itertools
+import os
 import threading
 import time
+import uuid
 from contextlib import contextmanager
 
 ESSENTIAL = 0
@@ -39,6 +42,15 @@ WRITE_TIME = "writeTime"
 PARTITION_TIME = "partitionTime"
 COLLECT_TIME = "collectTime"
 NUM_PARTITIONS = "partitions"
+# derived wall-clock attribution: time spent producing this node's output
+# batches minus time spent inside child nodes on the same thread (the SQL
+# UI's "op time" self-time column; maintained by TpuExec.wrap_output frames)
+SELF_TIME = "selfTime"
+# the build region's own self time (a nested node_frame inside the join's
+# output frame: charged here, subtracted from the join's selfTime) — the
+# profiler renders it as the "(build)" line item
+BUILD_SELF_TIME = "buildSelfTime"
+READAHEAD_STALL_TIME = "readaheadStallTime"
 
 # resilience counters (reference: RmmRapidsRetryIterator retry/split counts
 # surfaced through GpuMetric, RapidsShuffleIterator fetch-failure accounting)
@@ -109,6 +121,11 @@ class _NoopMetric(GpuMetric):
     def add(self, v):
         pass
 
+    def add_lazy(self, v):
+        # must drop like add/set: appending device scalars to _pending on a
+        # metric whose value is never read would pin them forever
+        pass
+
     def set(self, v):
         pass
 
@@ -158,3 +175,218 @@ def resilience_snapshot() -> dict:
     """All resilience counters (zeros included) — the shape bench.py records."""
     g = global_registry()
     return {name: g.metric(name).value for name in RESILIENCE_METRICS}
+
+
+# -- query-scoped collection ---------------------------------------------------
+# The SQL-UI analog: every exec node registers its MetricsRegistry with the
+# query's collector at construction (TpuExec.__init__), so a finished query
+# can render its plan tree annotated per node and attribute events
+# (spill/oom/fetch) to plan-node ids. The collector is carried in a
+# thread-local; pool-based schedulers re-enter it on worker threads via
+# collector_context().
+
+_collector_tls = threading.local()
+_query_counter = itertools.count(1)
+
+
+def current_collector() -> "QueryMetricsCollector | None":
+    return getattr(_collector_tls, "collector", None)
+
+
+def current_query_id() -> str | None:
+    c = current_collector()
+    return c.query_id if c is not None else None
+
+
+@contextmanager
+def collector_context(collector: "QueryMetricsCollector | None"):
+    """Make `collector` the thread's current query scope (None allowed: a
+    worker thread spawned outside any query keeps a clean scope)."""
+    prev = getattr(_collector_tls, "collector", None)
+    _collector_tls.collector = collector
+    try:
+        yield collector
+    finally:
+        _collector_tls.collector = prev
+
+
+class _Frame:
+    __slots__ = ("node_id", "child_ns")
+
+    def __init__(self, node_id):
+        self.node_id = node_id
+        self.child_ns = 0
+
+
+_frame_tls = threading.local()
+
+
+def current_node() -> int | None:
+    """Plan-node id of the innermost operator computing on this thread (the
+    node-attribution stack maintained by node_frame) — events emitted while
+    an operator runs land on its plan node."""
+    stack = getattr(_frame_tls, "stack", None)
+    return stack[-1].node_id if stack else None
+
+
+@contextmanager
+def node_frame(node_id, self_time_metric):
+    """One attribution frame: wall time inside the frame, minus time spent in
+    nested frames on the same thread, accumulates into `self_time_metric`
+    (pass None to attribute events without charging time — e.g. while
+    blocking on another thread's work that charges itself)."""
+    stack = getattr(_frame_tls, "stack", None)
+    if stack is None:
+        stack = _frame_tls.stack = []
+    f = _Frame(node_id)
+    stack.append(f)
+    t0 = time.perf_counter_ns()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter_ns() - t0
+        stack.pop()
+        if self_time_metric is not None:
+            self_time_metric.add(max(dt - f.child_ns, 0))
+        if stack:
+            stack[-1].child_ns += dt
+
+
+class QueryMetricsCollector:
+    """Per-query registry of plan-node metric sets (the SQLExecution /
+    SQL-UI metrics-aggregation analog). Created by a DataFrame action,
+    populated during plan conversion (exec construction) and execution,
+    finished when the action returns; session.last_query_metrics() and
+    DataFrame.explain(metrics=True) read it afterwards."""
+
+    def __init__(self, description: str = ""):
+        self.query_id = f"q{next(_query_counter):04d}-{os.getpid():x}-" \
+                        f"{uuid.uuid4().hex[:8]}"
+        self.description = description
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._nodes: dict[int, object] = {}   # node_id -> exec node
+        self.root = None
+        self._t0 = time.perf_counter()
+        self._resilience_base = resilience_snapshot()
+        self.wall_s: float | None = None
+        self._resilience: dict | None = None
+
+    # -- population (plan conversion + execution) -----------------------------
+    def register(self, exec_node) -> int:
+        with self._lock:
+            nid = next(self._ids)
+            self._nodes[nid] = exec_node
+            return nid
+
+    def set_root(self, root) -> None:
+        self.root = root
+
+    def finish(self) -> None:
+        if self.wall_s is None:
+            self.wall_s = time.perf_counter() - self._t0
+            end = resilience_snapshot()
+            self._resilience = {
+                k: end[k] - self._resilience_base.get(k, 0) for k in end}
+
+    # -- read-out -------------------------------------------------------------
+    def query_resilience(self) -> dict:
+        """Resilience counter DELTAS attributable to this query (the
+        process-wide registry accumulates across queries; the delta between
+        query start and finish isolates one query's share)."""
+        if self._resilience is not None:
+            return dict(self._resilience)
+        end = resilience_snapshot()
+        return {k: end[k] - self._resilience_base.get(k, 0) for k in end}
+
+    def _walk(self, node, parent_id, depth, visit):
+        """Duck-typed hybrid-tree walk (no imports of exec/plan here): device
+        execs carry _node_id/metrics, HostBridgeNode carries tpu_exec, host
+        PlanNodes carry children; DeviceBridgeExec's host subtree is walked
+        as unregistered host nodes."""
+        nid = getattr(node, "_node_id", None)
+        if nid is not None or hasattr(node, "metrics"):
+            visit(node, nid, parent_id, depth)
+            parent_id = nid
+        elif hasattr(node, "tpu_exec"):          # HostBridgeNode
+            visit(node, None, parent_id, depth)
+            self._walk(node.tpu_exec, parent_id, depth + 1, visit)
+            return
+        else:                                     # host PlanNode
+            visit(node, None, parent_id, depth)
+        for c in getattr(node, "children", []) or []:
+            self._walk(c, parent_id, depth + 1, visit)
+        host_node = getattr(node, "host_node", None)   # DeviceBridgeExec
+        if host_node is not None:
+            self._walk(host_node, parent_id, depth + 1, visit)
+
+    def node_summaries(self) -> list:
+        """[{id, name, args, parent, depth, metrics}] in plan-tree preorder
+        (registered nodes that never made the executed tree are appended with
+        parent None so nothing silently disappears)."""
+        out, seen = [], set()
+
+        def visit(node, nid, parent_id, depth):
+            entry = {
+                "id": nid,
+                "name": type(node).__name__,
+                "args": (node.args_string()
+                         if hasattr(node, "args_string") else ""),
+                "parent": parent_id,
+                "depth": depth,
+                "metrics": (node.metrics.snapshot()
+                            if hasattr(node, "metrics") else {}),
+            }
+            out.append(entry)
+            if nid is not None:
+                seen.add(nid)
+
+        if self.root is not None:
+            self._walk(self.root, None, 0, visit)
+        with self._lock:
+            stragglers = [(nid, n) for nid, n in self._nodes.items()
+                          if nid not in seen]
+        for nid, n in sorted(stragglers):
+            visit(n, nid, None, 0)
+        return out
+
+    def node_metrics(self) -> dict:
+        """{node_id: metrics snapshot} for every registered node."""
+        with self._lock:
+            items = list(self._nodes.items())
+        return {nid: n.metrics.snapshot() for nid, n in items
+                if hasattr(n, "metrics")}
+
+    def annotated_plan(self) -> str:
+        """The explain tree annotated per node with its metric snapshot —
+        the SQL-UI plan-with-metrics analog."""
+        lines = [f"Query {self.query_id}"
+                 + (f" [{self.description}]" if self.description else "")
+                 + (f" wall={self.wall_s:.4f}s" if self.wall_s is not None
+                    else " (running)")]
+
+        def fmt(mname, v):
+            if mname.endswith(("Time", "time")) or mname == SELF_TIME:
+                return f"{mname}={v / 1e6:.1f}ms"
+            return f"{mname}={v}"
+
+        def visit(node, nid, parent_id, depth):
+            head = "  " * depth + "*" + type(node).__name__
+            args = (node.args_string()
+                    if hasattr(node, "args_string") else "")
+            if args:
+                head += " " + args
+            if nid is not None:
+                snap = node.metrics.snapshot()
+                # zero metrics are noise — except the row count, which is
+                # load-bearing even (especially) when it is zero
+                ann = ", ".join(fmt(k, v) for k, v in sorted(snap.items())
+                                if v or k == NUM_OUTPUT_ROWS)
+                head += f"  [id={nid}" + (f", {ann}" if ann else "") + "]"
+            lines.append(head)
+
+        if self.root is not None:
+            self._walk(self.root, None, 0, visit)
+        else:
+            lines.append("  (no executed plan recorded)")
+        return "\n".join(lines)

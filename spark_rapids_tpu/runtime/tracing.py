@@ -8,20 +8,31 @@ Perfetto/XProf, coupled to GpuMetric timers, gated by spark.rapids.tpu.sql.trace
 from __future__ import annotations
 
 import collections
+import contextlib
 import time
-from contextlib import contextmanager
+
+from spark_rapids_tpu.runtime import metrics as _metrics
 
 _enabled = False
 
 # zero-duration span events (oom.retry / oom.split / fetch.recompute …): a
 # bounded in-memory ring that chaos tests and postmortems read regardless of
 # whether the profiler is capturing; with tracing enabled each event also
-# lands as a profiler annotation
+# lands as a profiler annotation, and with the event log configured it is
+# appended there too (runtime/eventlog.py)
 _events: "collections.deque" = collections.deque(maxlen=512)
 
 
 def span_event(name: str, **attrs) -> None:
+    # tag with the ambient query id so concurrent sessions/tests can filter
+    # the process-global ring down to their own query (recent_events(query=))
+    qid = _metrics.current_query_id()
+    if qid is not None:
+        attrs = dict(attrs, query=qid)
     _events.append((name, attrs))
+    from spark_rapids_tpu.runtime import eventlog
+    if eventlog.enabled():
+        eventlog.emit(name, **attrs)
     if _enabled:
         import jax
         label = name + ("[" + ",".join(f"{k}={v}" for k, v in attrs.items())
@@ -30,9 +41,15 @@ def span_event(name: str, **attrs) -> None:
             pass
 
 
-def recent_events(name: str | None = None) -> list:
+def recent_events(name: str | None = None, query: str | None = None) -> list:
+    """Ring contents, optionally filtered by event name and/or the query id
+    the event was tagged with (query=None returns every event regardless)."""
     evs = list(_events)
-    return evs if name is None else [e for e in evs if e[0] == name]
+    if name is not None:
+        evs = [e for e in evs if e[0] == name]
+    if query is not None:
+        evs = [e for e in evs if e[1].get("query") == query]
+    return evs
 
 
 def clear_events() -> None:
@@ -44,19 +61,14 @@ def set_enabled(v: bool):
     _enabled = bool(v)
 
 
-@contextmanager
+@contextlib.contextmanager
 def trace_range(name: str, metric=None):
     """NvtxWithMetrics analog: profiler annotation + optional timing metric."""
     t0 = time.perf_counter_ns() if metric is not None else 0
-    if _enabled:
-        import jax
-        with jax.profiler.TraceAnnotation(name):
-            try:
-                yield
-            finally:
-                if metric is not None:
-                    metric.add(time.perf_counter_ns() - t0)
-    else:
+    with contextlib.ExitStack() as stack:
+        if _enabled:
+            import jax
+            stack.enter_context(jax.profiler.TraceAnnotation(name))
         try:
             yield
         finally:
@@ -90,12 +102,16 @@ def start_profile(outdir: str) -> None:
 
 
 def stop_profile() -> None:
-    """Flush and stop the capture (safe to call when not profiling)."""
+    """Flush and stop the capture (safe to call when not profiling). The
+    atexit hook registered by start_profile is removed so repeated
+    start/stop cycles don't stack handlers."""
     global _profiling
     if _profiling:
+        import atexit
         import jax
         try:
             jax.profiler.stop_trace()
         except Exception:
             pass
         _profiling = False
+        atexit.unregister(stop_profile)

@@ -45,6 +45,7 @@ class DataFrame:
     def __init__(self, plan: NN.PlanNode, session: "TpuSession"):
         self._plan = plan
         self.session = session
+        self._last_collector = None   # QueryMetricsCollector of the last action
 
     # -- transformations (lazy: build plan nodes) ----------------------------
     def select(self, *cols) -> "DataFrame":
@@ -212,14 +213,56 @@ class DataFrame:
     def columns(self) -> list:
         return [f.name for f in self._plan.output]
 
-    def explain(self, all_nodes: bool = True) -> str:
+    def explain(self, all_nodes: bool = True, metrics: bool = False) -> str:
         from spark_rapids_tpu.plan.overrides import explain_plan
+        if metrics:
+            # SQL-UI analog: the executed plan tree annotated per node with
+            # its metric snapshot — requires a completed action on this frame
+            c = self._last_collector
+            if c is None:
+                return ("<no completed action on this DataFrame — run "
+                        "collect()/count()/write first for "
+                        "explain(metrics=True)>\n"
+                        + explain_plan(self._plan, self.session.conf,
+                                       all_nodes))
+            return c.annotated_plan()
         return explain_plan(self._plan, self.session.conf, all_nodes)
 
     # -- actions -------------------------------------------------------------
+    def _run_action(self, plan, run):
+        """Execute one action under a fresh QueryMetricsCollector: plan
+        conversion registers every exec node with it, `run(hybrid)` executes,
+        and the finished collector (annotated plan, per-node metrics,
+        query-scoped resilience deltas) lands on the DataFrame and the
+        session for explain(metrics=True) / last_query_metrics(). Query
+        lifecycle is mirrored to the structured event log when configured."""
+        from spark_rapids_tpu.runtime import eventlog as EL
+        from spark_rapids_tpu.runtime import metrics as M
+        collector = M.QueryMetricsCollector(description=type(plan).__name__)
+        self._last_collector = collector
+        self.session._last_collector = collector
+        with M.collector_context(collector):
+            hybrid = TpuOverrides(self.session.conf).apply(plan)
+            collector.set_root(hybrid)
+            EL.emit("query.start", query=collector.query_id,
+                    description=collector.description)
+            try:
+                out = run(hybrid)
+            except BaseException as e:
+                collector.finish()
+                EL.emit("query.error", query=collector.query_id,
+                        error=repr(e)[:200], wall_s=collector.wall_s)
+                raise
+        collector.finish()
+        EL.emit("query.end", query=collector.query_id,
+                description=collector.description,
+                wall_s=collector.wall_s,
+                resilience=collector.query_resilience(),
+                nodes=collector.node_summaries())
+        return out
+
     def collect(self) -> pa.Table:
-        hybrid = TpuOverrides(self.session.conf).apply(self._plan)
-        return execute_hybrid(hybrid)
+        return self._run_action(self._plan, execute_hybrid)
 
     def collect_host(self) -> pa.Table:
         """CPU-only execution (the withCpuSparkSession analog for tests)."""
@@ -244,7 +287,7 @@ class DataFrame:
     def count(self) -> int:
         from spark_rapids_tpu.expr.aggregates import Count
         agg = NN.AggregateNode([], [E.Alias(Count(None), "count")], self._plan)
-        out = execute_hybrid(TpuOverrides(self.session.conf).apply(agg))
+        out = self._run_action(agg, execute_hybrid)
         return out.column("count")[0].as_py()
 
     def to_pandas(self):
@@ -261,9 +304,11 @@ class DataFrame:
 
     def _write(self, path, fmt, partition_by, mode):
         from spark_rapids_tpu.io.writer import write_columnar
-        hybrid = TpuOverrides(self.session.conf).apply(self._plan)
-        return write_columnar(hybrid, path, fmt, partition_by=partition_by,
-                              mode=mode, conf=self.session.conf)
+        return self._run_action(
+            self._plan,
+            lambda hybrid: write_columnar(hybrid, path, fmt,
+                                          partition_by=partition_by,
+                                          mode=mode, conf=self.session.conf))
 
 
 class GroupedData:
@@ -521,6 +566,24 @@ class TpuSession:
             from spark_rapids_tpu.runtime import faults
             faults.configure(self.conf.get(CFG.TEST_FAULTS),
                              self.conf.get(CFG.TEST_FAULTS_SEED))
+        # structured event log (Spark event-log analog, runtime/eventlog.py):
+        # process-global like the switches above — only an EXPLICIT setting
+        # opens (or closes, when set empty) the sink
+        if CFG.EVENT_LOG_DIR.key in self.conf.settings:
+            from spark_rapids_tpu.runtime import eventlog
+            elog_dir = self.conf.get(CFG.EVENT_LOG_DIR)
+            if elog_dir:
+                eventlog.configure(
+                    elog_dir, self.conf.get(CFG.EVENT_LOG_HEALTH_INTERVAL))
+            else:
+                eventlog.shutdown()
+        self._last_collector = None
+
+    def last_query_metrics(self):
+        """QueryMetricsCollector of the most recently completed action on
+        this session (None before any action): per-node metric snapshots,
+        the annotated plan, wall time and query-scoped resilience deltas."""
+        return self._last_collector
 
     # -- data sources --------------------------------------------------------
     def read_parquet(self, path, pushed_filter=None,

@@ -89,12 +89,19 @@ class ShuffleFetchIterator:
                                        attempt=attempt, error=str(e)[:120])
                     if attempt < self.max_retries:  # no sleep before failover
                         g.metric(M.FETCH_RETRIES).add(1)
+                        tracing.span_event("fetch.retry", peer=pi,
+                                           attempt=attempt,
+                                           shuffle=self.shuffle_id,
+                                           reduce=self.reduce_id)
                         time.sleep(self._backoff(attempt))
                     continue
                 yield from batches
                 return
             if pi < len(self.client_factories) - 1:
                 g.metric(M.FETCH_FAILOVERS).add(1)
+                tracing.span_event("fetch.failover", from_peer=pi,
+                                   shuffle=self.shuffle_id,
+                                   reduce=self.reduce_id)
         if self.recompute is None:
             raise TransportError(
                 "all peers failed for shuffle %d reduce %d: %s"

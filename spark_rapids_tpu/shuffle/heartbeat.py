@@ -11,6 +11,9 @@ from __future__ import annotations
 import threading
 import time
 
+from spark_rapids_tpu.runtime import eventlog as EL
+from spark_rapids_tpu.runtime import tracing
+
 
 class PeerInfo:
     __slots__ = ("executor_id", "host", "port", "last_seen")
@@ -66,7 +69,10 @@ class RapidsShuffleHeartbeatManager:
                     if now - p.last_seen >= self.timeout_s]
             for p in dead:
                 del self._peers[p.executor_id]
-            return dead
+        for p in dead:
+            tracing.span_event("heartbeat.loss", executor=p.executor_id,
+                               last_seen_age_s=round(now - p.last_seen, 3))
+        return dead
 
 
 class RapidsShuffleHeartbeatEndpoint:
@@ -96,6 +102,12 @@ class RapidsShuffleHeartbeatEndpoint:
                 self._update(self.manager.heartbeat(self.executor_id))
             except Exception:
                 pass  # driver unreachable: keep trying; Spark handles real death
+            # the beat thread doubles as the executor health sampler
+            # (HBM used/free + spill-catalog tiers) when the event log is on
+            try:
+                EL.emit_health(executor=self.executor_id)
+            except Exception:
+                pass  # sampling must never kill liveness
 
     def beat_now(self):
         self._update(self.manager.heartbeat(self.executor_id))

@@ -190,12 +190,21 @@ class ArrowEvalPythonExec(TpuExec):
                 return ColumnarBatch.from_arrow(out, self.output)
 
         def it():
+            # prefetch threads re-enter the query scope so any event they
+            # fire (spill during H2D, etc.) attributes to this query/node
+            collector = M.current_collector()
+
+            def eval_in_scope(batch):
+                with M.collector_context(collector), \
+                        M.node_frame(self._node_id, None):
+                    return eval_batch(batch)
+
             pending = []
             pool = futures.ThreadPoolExecutor(max_workers=self.prefetch)
             try:
                 for batch in self.child.execute_partition(split):
                     acquire_semaphore(self.metrics)
-                    pending.append(pool.submit(eval_batch, batch))
+                    pending.append(pool.submit(eval_in_scope, batch))
                     while len(pending) > self.prefetch:
                         yield pending.pop(0).result()
                 for f in pending:

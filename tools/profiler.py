@@ -1,0 +1,367 @@
+"""profiler — replay a structured event log into a tuning report.
+
+The Profiling Tool analog (reference tools/ "Profiling Tool" post-processes
+Spark event logs + Rapids metrics into per-query tuning reports). Input is
+the JSONL event log written by spark_rapids_tpu/runtime/eventlog.py
+(knob spark.rapids.tpu.eventLog.dir); output is a per-query report:
+
+  - operator self-time table (top operators by self time, join builds as
+    distinct line items, coverage vs measured query wall time)
+  - spill hotspots (bytes/tier per plan node)
+  - OOM retry/split hotspots and fetch retry/failover/recompute attribution
+  - shuffle partition skew per exchange (max/mean of reduce-partition bytes)
+  - scan readahead stall time (decode-bound scans)
+
+Usage:
+  python tools/profiler.py report <eventlog.jsonl> [--json] [--top N]
+  python tools/profiler.py report <eventlog.jsonl> --compare <other.jsonl>
+
+Exit status is non-zero on schema violations or when no query in the log
+carries a non-empty operator breakdown — CI uses this as a gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+
+
+def _eventlog_module():
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    from spark_rapids_tpu.runtime import eventlog
+    return eventlog
+
+
+# ---------------------------------------------------------------------------
+# parsing + validation
+# ---------------------------------------------------------------------------
+
+def load_log(path: str):
+    """Parse one event log; returns (records, violations)."""
+    eventlog = _eventlog_module()
+    records, violations = [], []
+    last_t = None
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                violations.append(f"{path}:{lineno}: unparseable line ({e})")
+                continue
+            for v in eventlog.validate_record(rec):
+                violations.append(f"{path}:{lineno}: {v}")
+            t = rec.get("t")
+            if isinstance(t, (int, float)):
+                if last_t is not None and t < last_t:
+                    violations.append(
+                        f"{path}:{lineno}: monotonic timestamp regression "
+                        f"({t} < {last_t})")
+                last_t = t
+            records.append(rec)
+    return records, violations
+
+
+# ---------------------------------------------------------------------------
+# analysis
+# ---------------------------------------------------------------------------
+
+def _node_label(nodes_by_id: dict, nid) -> str:
+    n = nodes_by_id.get(nid)
+    if n is None:
+        return f"node#{nid}" if nid is not None else "<driver>"
+    return f"{n['name']}#{nid}"
+
+
+def analyze(records: list) -> dict:
+    """Group the log into per-query analyses keyed off query.end events."""
+    by_query: dict = {}
+    for rec in records:
+        by_query.setdefault(rec.get("query"), []).append(rec)
+
+    queries = []
+    for rec in records:
+        if rec["event"] != "query.end":
+            continue
+        qid = rec.get("query")
+        evs = by_query.get(qid, [])
+        nodes = rec.get("nodes") or []
+        nodes_by_id = {n["id"]: n for n in nodes if n.get("id") is not None}
+        wall_s = rec.get("wall_s") or 0.0
+
+        # operator self-time table; the build region carries its own
+        # attribution frame (buildSelfTime, disjoint from selfTime by
+        # construction) and renders as a distinct "(build)" line item
+        ops = []
+        for n in nodes_by_id.values():
+            m = n.get("metrics") or {}
+            self_s = m.get("selfTime", 0) / 1e9
+            build_s = m.get("buildSelfTime", 0) / 1e9
+            row = {
+                "op": _node_label(nodes_by_id, n["id"]),
+                "args": n.get("args", ""),
+                "self_s": round(self_s, 6),
+                "rows": m.get("numOutputRows"),
+                "batches": m.get("numOutputBatches"),
+            }
+            ops.append(row)
+            if build_s > 0:
+                ops.append({
+                    "op": _node_label(nodes_by_id, n["id"]) + " (build)",
+                    "args": "",
+                    "self_s": round(build_s, 6),
+                    "rows": None, "batches": None,
+                })
+        ops.sort(key=lambda r: -r["self_s"])
+        total_self = sum(r["self_s"] for r in ops)
+
+        # spill hotspots per node
+        spills: dict = {}
+        for e in evs:
+            if e["event"] != "spill":
+                continue
+            key = _node_label(nodes_by_id, e.get("node"))
+            s = spills.setdefault(key, {"events": 0, "bytes": 0, "tiers": {}})
+            s["events"] += 1
+            s["bytes"] += e.get("bytes", 0)
+            tier = f"{e.get('tier_from')}->{e.get('tier_to')}"
+            s["tiers"][tier] = s["tiers"].get(tier, 0) + e.get("bytes", 0)
+
+        # OOM retry/split + fetch ladder attribution per node
+        retries: dict = {}
+        for e in evs:
+            if e["event"] not in ("oom.retry", "oom.split", "fetch.error",
+                                  "fetch.retry", "fetch.failover",
+                                  "fetch.recompute"):
+                continue
+            key = _node_label(nodes_by_id, e.get("node"))
+            r = retries.setdefault(key, {})
+            r[e["event"]] = r.get(e["event"], 0) + 1
+            if e["event"] == "oom.split" and e.get("site"):
+                r.setdefault("sites", set()).add(e["site"])
+        for r in retries.values():
+            if "sites" in r:
+                r["sites"] = sorted(r["sites"])
+
+        # shuffle partition skew per exchange map stage
+        shuffles = []
+        for e in evs:
+            if e["event"] != "stage.map.end":
+                continue
+            sizes = e.get("partition_sizes") or []
+            nonzero = [s for s in sizes if s] or [0]
+            mean = sum(sizes) / len(sizes) if sizes else 0
+            shuffles.append({
+                "node": _node_label(nodes_by_id, e.get("node")),
+                "shuffle": e.get("shuffle"),
+                "partitions": len(sizes),
+                "total_bytes": sum(sizes),
+                "max_bytes": max(sizes) if sizes else 0,
+                "skew": round(max(sizes) / mean, 3) if mean else 1.0,
+                "empty_partitions": sum(1 for s in sizes if not s),
+                "largest_vs_median": round(
+                    max(sizes) / max(sorted(nonzero)[len(nonzero) // 2], 1), 3)
+                    if sizes else 1.0,
+            })
+
+        # readahead stall time per scan node
+        stalls = []
+        for n in nodes_by_id.values():
+            st = (n.get("metrics") or {}).get("readaheadStallTime", 0)
+            if st:
+                stalls.append({"node": _node_label(nodes_by_id, n["id"]),
+                               "stall_s": round(st / 1e9, 6)})
+        stalls.sort(key=lambda r: -r["stall_s"])
+
+        queries.append({
+            "query": qid,
+            "description": rec.get("description", ""),
+            "wall_s": wall_s,
+            "total_self_s": round(total_self, 6),
+            "coverage": round(total_self / wall_s, 3) if wall_s else None,
+            "operators": ops,
+            "spill": spills,
+            "retries": retries,
+            "shuffles": shuffles,
+            "readahead_stalls": stalls,
+            "resilience": rec.get("resilience") or {},
+            "batches": sum(1 for e in evs if e["event"] == "batch"),
+        })
+
+    health = [r for r in records if r["event"] == "executor.health"]
+    hb_loss = [r for r in records if r["event"] == "heartbeat.loss"]
+    return {
+        "queries": queries,
+        "events_total": len(records),
+        "health_samples": len(health),
+        "heartbeat_losses": len(hb_loss),
+        "errors": sum(1 for r in records if r["event"] == "query.error"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024
+    return f"{n}B"
+
+
+def render(analysis: dict, top: int = 15) -> str:
+    out = []
+    for i, q in enumerate(analysis["queries"]):
+        out.append(f"== query {i}: {q['query']} [{q['description']}] "
+                   f"wall={q['wall_s']:.4f}s self-total={q['total_self_s']:.4f}s"
+                   + (f" coverage={q['coverage']:.0%}"
+                      if q["coverage"] is not None else ""))
+        out.append("  top operators by self time:")
+        out.append(f"    {'self_s':>10}  {'rows':>12}  {'batches':>8}  operator")
+        for r in q["operators"][:top]:
+            rows = "" if r["rows"] is None else str(r["rows"])
+            bat = "" if r["batches"] is None else str(r["batches"])
+            out.append(f"    {r['self_s']:>10.4f}  {rows:>12}  {bat:>8}  "
+                       f"{r['op']}"
+                       + (f" {r['args']}" if r["args"] else ""))
+        if q["spill"]:
+            out.append("  spill hotspots:")
+            for node, s in sorted(q["spill"].items(),
+                                  key=lambda kv: -kv[1]["bytes"]):
+                tiers = ", ".join(f"{t}={_fmt_bytes(b)}"
+                                  for t, b in s["tiers"].items())
+                out.append(f"    {node}: {s['events']} spills "
+                           f"{_fmt_bytes(s['bytes'])} ({tiers})")
+        if q["retries"]:
+            out.append("  retry/fetch hotspots:")
+            for node, r in sorted(q["retries"].items()):
+                kv = ", ".join(f"{k}={v}" for k, v in sorted(r.items()))
+                out.append(f"    {node}: {kv}")
+        if q["shuffles"]:
+            out.append("  shuffle partition skew:")
+            for s in q["shuffles"]:
+                out.append(
+                    f"    {s['node']} shuffle={s['shuffle']}: "
+                    f"{s['partitions']} partitions "
+                    f"{_fmt_bytes(s['total_bytes'])} total, "
+                    f"max={_fmt_bytes(s['max_bytes'])} "
+                    f"skew(max/mean)={s['skew']} "
+                    f"empty={s['empty_partitions']}")
+        if q["readahead_stalls"]:
+            out.append("  scan readahead stall time:")
+            for s in q["readahead_stalls"]:
+                out.append(f"    {s['node']}: {s['stall_s']:.4f}s")
+        if any(q["resilience"].values()):
+            out.append(f"  resilience deltas: {q['resilience']}")
+        out.append("")
+    out.append(f"{len(analysis['queries'])} queries, "
+               f"{analysis['events_total']} events, "
+               f"{analysis['health_samples']} health samples, "
+               f"{analysis['heartbeat_losses']} heartbeat losses, "
+               f"{analysis['errors']} query errors")
+    return "\n".join(out)
+
+
+def render_compare(a: dict, b: dict, name_a: str, name_b: str) -> str:
+    """Diff two runs: matched by query order, operator self time aggregated
+    by operator NAME (plan-node ids are not stable across runs)."""
+    out = [f"== compare A={name_a} B={name_b}"]
+    pairs = list(zip(a["queries"], b["queries"]))
+    if len(a["queries"]) != len(b["queries"]):
+        out.append(f"  (query count differs: {len(a['queries'])} vs "
+                   f"{len(b['queries'])}; comparing the common prefix)")
+    for i, (qa, qb) in enumerate(pairs):
+        dw = qb["wall_s"] - qa["wall_s"]
+        pct = (dw / qa["wall_s"] * 100) if qa["wall_s"] else 0.0
+        out.append(f"-- query {i} [{qa['description']}]: wall "
+                   f"{qa['wall_s']:.4f}s -> {qb['wall_s']:.4f}s "
+                   f"({pct:+.1f}%)")
+
+        def by_name(q):
+            agg: dict = {}
+            for r in q["operators"]:
+                name = r["op"].split("#")[0] + (
+                    " (build)" if r["op"].endswith("(build)") else "")
+                agg[name] = agg.get(name, 0.0) + r["self_s"]
+            return agg
+        na, nb = by_name(qa), by_name(qb)
+        rows = sorted(set(na) | set(nb),
+                      key=lambda n: -abs(nb.get(n, 0) - na.get(n, 0)))
+        for name in rows:
+            va, vb = na.get(name, 0.0), nb.get(name, 0.0)
+            if max(va, vb) < 1e-4:
+                continue
+            out.append(f"    {va:>10.4f}s -> {vb:>10.4f}s  "
+                       f"({vb - va:+.4f}s)  {name}")
+        sa = sum(s["bytes"] for s in qa["spill"].values())
+        sb = sum(s["bytes"] for s in qb["spill"].values())
+        if sa or sb:
+            out.append(f"    spill bytes: {_fmt_bytes(sa)} -> {_fmt_bytes(sb)}")
+        ra = {k: v for k, v in qa["resilience"].items() if v}
+        rb = {k: v for k, v in qb["resilience"].items() if v}
+        if ra or rb:
+            out.append(f"    resilience: {ra or '{}'} -> {rb or '{}'}")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="profiler.py", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    rep = sub.add_parser("report", help="analyze one event log")
+    rep.add_argument("eventlog")
+    rep.add_argument("--compare", metavar="OTHER",
+                     help="second event log; print a diff of the two runs")
+    rep.add_argument("--json", action="store_true",
+                     help="machine-readable analysis instead of text")
+    rep.add_argument("--top", type=int, default=15,
+                     help="operator table rows per query")
+    args = p.parse_args(argv)
+
+    records, violations = load_log(args.eventlog)
+    analysis = analyze(records)
+    rc = 0
+    if violations:
+        for v in violations:
+            print(f"SCHEMA VIOLATION: {v}", file=sys.stderr)
+        rc = 1
+    if not any(q["operators"] for q in analysis["queries"]):
+        print("ERROR: no query with a non-empty operator breakdown in "
+              f"{args.eventlog}", file=sys.stderr)
+        rc = 1
+
+    if args.compare:
+        other_records, other_violations = load_log(args.compare)
+        if other_violations:
+            for v in other_violations:
+                print(f"SCHEMA VIOLATION: {v}", file=sys.stderr)
+            rc = 1
+        other = analyze(other_records)
+        print(render_compare(analysis, other, args.eventlog, args.compare))
+        return rc
+    if args.json:
+        analysis["violations"] = violations
+        print(json.dumps(analysis, indent=2, default=str))
+    else:
+        print(render(analysis, top=args.top))
+    return rc
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # downstream closed early (e.g. piped into head): not an error
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+        sys.exit(0)
