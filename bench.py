@@ -151,9 +151,13 @@ def child_main():
     paths = tpch.generate(TPCH_SF, DATA_DIR)
     # COALESCING stitches the per-partition files into few large batches —
     # fewer per-batch fixed costs; measured fastest on both backends at this
-    # scale (docs/tuning.md; reference COALESCING reader role)
+    # scale (docs/tuning.md; reference COALESCING reader role).
+    # SRT_PIPELINE=0 disables the pipelined executor for A/B runs (the ci.sh
+    # pipeline gate and perf_notes round-7 use this switch).
+    pipeline_on = os.environ.get("SRT_PIPELINE", "1") == "1"
     spark = TpuSession({
-        "spark.rapids.tpu.sql.format.parquet.reader.type": "COALESCING"})
+        "spark.rapids.tpu.sql.format.parquet.reader.type": "COALESCING",
+        "spark.rapids.tpu.pipeline.enabled": pipeline_on})
     dfs = tpch.load(spark, paths, files_per_partition=4)
     tb = tpch.load_np(paths)
     n_lineitem = len(tb["lineitem"]["l_orderkey"])
@@ -206,12 +210,17 @@ def child_main():
             qm = spark.last_query_metrics()
             if qm is not None:
                 ops = []
+                queue_stall_ns = 0
                 for n in qm.node_summaries():
                     if n["id"] is None:
                         continue
                     m = n["metrics"]
                     self_s = m.get("selfTime", 0) / 1e9
                     build_s = m.get("buildSelfTime", 0) / 1e9
+                    # pipeline queue stall total (consumer wait, all edges)
+                    queue_stall_ns += sum(
+                        v for k, v in m.items()
+                        if k.startswith("queueWaitTime:"))
                     ops.append({"op": f"{n['name']}#{n['id']}",
                                 "self_s": round(self_s, 4),
                                 "rows": m.get("numOutputRows")})
@@ -223,6 +232,8 @@ def child_main():
                 per_query[name]["operators"] = ops[:8]
                 per_query[name]["op_coverage"] = (
                     round(total_self / qm.wall_s, 3) if qm.wall_s else None)
+                per_query[name]["queue_stall_s"] = round(
+                    queue_stall_ns / 1e9, 4)
 
     # resilience counters (retry/split/fetch-failover totals across the
     # whole ladder run): with faults disabled these must be zero — a later
@@ -241,6 +252,7 @@ def child_main():
         "baseline_denominator": "numpy-oracle e2e (per-query parquet re-read)",
         "reps": BENCH_REPS,
         "stat": "median",
+        "pipeline": pipeline_on,
         "spread": round(max(spreads), 3),
         "variance_ok": max(spreads) <= BENCH_MAX_SPREAD,
         "queries": per_query,

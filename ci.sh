@@ -64,6 +64,53 @@ echo "== chaos: task-scoped OOM retry + deterministic fault injection =="
 # visible in the resilience counters
 JAX_PLATFORMS=cpu python -m pytest tests/test_retry_faults.py -q
 
+echo "== pipelined executor: q18 A/B gate + chaos with the pipeline on =="
+# overlap of decode / device compute / exchange I/O needs real parallelism:
+# on <2 cores the gate auto-skips (with the reason logged); on a multi-core
+# box q18 with pipeline.enabled=true must beat enabled=false by >=1.15x
+# (median of 5, the bench ladder's query + reader config), bit-identically
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import os
+cores = os.cpu_count() or 1
+if cores < 2:
+    print(f"pipeline A/B gate SKIPPED: {cores} core(s) — "
+          "decode/compute/exchange overlap needs >=2 cores")
+    raise SystemExit(0)
+import jax; jax.config.update("jax_platforms", "cpu")
+import statistics, time
+import spark_rapids_tpu  # noqa: F401  (enables x64)
+from spark_rapids_tpu.benchmarks import tpch
+from spark_rapids_tpu.session import TpuSession
+
+paths = tpch.generate(0.01, "/tmp/tpch_ci_sf0.01")
+
+def run(pipeline_on):
+    spark = TpuSession({
+        "spark.rapids.tpu.sql.format.parquet.reader.type": "COALESCING",
+        "spark.rapids.tpu.pipeline.enabled": pipeline_on})
+    dfs = tpch.load(spark, paths, files_per_partition=4)
+    df = tpch.q18(dfs)
+    rows = df.collect().to_pylist()     # warm (compiles cached after)
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        df.collect()
+        ts.append(time.perf_counter() - t0)
+    return statistics.median(ts), rows
+
+on_s, on_rows = run(True)
+off_s, off_rows = run(False)
+assert on_rows == off_rows, "pipeline on/off results differ"
+speedup = off_s / on_s
+print(f"pipeline gate: q18 off={off_s:.4f}s on={on_s:.4f}s "
+      f"({speedup:.2f}x, {cores} cores)")
+assert speedup >= 1.15, f"pipeline speedup {speedup:.2f}x < 1.15x"
+PYEOF
+# chaos once with the pipeline explicitly on: an injected worker-thread
+# decode fault must fail cleanly (no leaked registrations/threads) and an
+# injected split-OOM inside a pipeline segment must recover bit-identically
+JAX_PLATFORMS=cpu python -m pytest tests/test_pipeline.py -q
+
 echo "== observability: event log overhead + profiler gate =="
 # run the q18 ladder query with the event log disabled then enabled: the log
 # must add <5% wall time, and tools/profiler.py must replay it into a report

@@ -80,10 +80,15 @@ def _executor_main(conn, platform: str, conf_settings: dict):
         exec_root = to_device_plan(plan, conf)
         with TaskContext():
             for split in task["splits"]:
+                seq = 0
                 for batch in exec_root.execute_partition(split):
+                    seq += 1
                     for pid, piece in part.partition(batch, split):
                         if piece.num_rows:
-                            store.write_block(sid, pid, piece)
+                            # stable per-reduce-partition block order (same
+                            # contract as the local exchange map writer)
+                            store.write_block(sid, pid, piece,
+                                              seq=(split, seq))
         return {"sizes": store.partition_sizes(sid, part.num_partitions)}
 
     def run_result(task):

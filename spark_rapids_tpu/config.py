@@ -187,9 +187,11 @@ RETRY_SPLIT_FLOOR_BYTES = conf(
 
 TEST_FAULTS = conf("spark.rapids.tpu.test.faults").doc(
     "Deterministic fault-injection spec 'kind:site:trigger,...' — kinds "
-    "oom / splitoom / transport; trigger COUNT, COUNT@SKIP or pPROB; e.g. "
-    "'oom:joins.build:2,transport:fetch:1' (grammar + site list in "
-    "runtime/faults.py). Chaos testing only — never set in production; "
+    "oom / splitoom / transport / error; trigger COUNT, COUNT@SKIP or "
+    "pPROB; e.g. 'oom:joins.build:2,transport:fetch:1,"
+    "error:pipeline.put.scan.decode:1' (grammar + site list in "
+    "runtime/faults.py; pipeline.put/get sites fire whatever kind is "
+    "armed). Chaos testing only — never set in production; "
     "empty disables").string_conf(None)
 
 TEST_FAULTS_SEED = conf("spark.rapids.tpu.test.faults.seed").doc(
@@ -415,6 +417,26 @@ SCAN_READAHEAD_MAX_BUFFER = conf(
     "effective budget also shrinks to the spill catalog's free host "
     "headroom (runtime/memory.scan_readahead_budget) so prefetch never "
     "competes with host spill storage").bytes_conf("256m")
+
+PIPELINE_ENABLED = conf("spark.rapids.tpu.pipeline.enabled").doc(
+    "Run each plan segment's batch loop on its own worker thread at the "
+    "pipeline breakers (scan, exchange map/reduce, join build, sort, final "
+    "collect), connected by bounded byte-budgeted queues, so host decode, "
+    "device compute and exchange I/O overlap (runtime/pipeline.py; the "
+    "reference gets this overlap from CUDA streams + UCX's async progress "
+    "thread). Results are bit-identical either way").boolean_conf(True)
+
+PIPELINE_QUEUE_DEPTH = conf("spark.rapids.tpu.pipeline.queueDepth").doc(
+    "Batches one pipeline queue edge may hold ahead of its consumer; 2 is "
+    "classic double buffering (batch N resident while N+1 decodes/uploads)"
+).integer_conf(2)
+
+PIPELINE_MAX_QUEUE_BYTES = conf("spark.rapids.tpu.pipeline.maxQueueBytes").doc(
+    "Byte cap per pipeline queue edge; the effective budget also shrinks "
+    "to the spill catalog's free host headroom "
+    "(runtime/memory.host_prefetch_budget) and queued device batches are "
+    "registered as spillable so the OOM-retry ladder can steal them"
+).bytes_conf("256m")
 
 PALLAS_ENABLED = conf("spark.rapids.tpu.sql.pallas.enabled").doc(
     "Route the string murmur3 hash, parquet bit-unpack, dense group-by "

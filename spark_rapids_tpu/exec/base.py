@@ -93,14 +93,25 @@ class TpuExec:
         import pyarrow as pa
         from concurrent.futures import ThreadPoolExecutor
         from spark_rapids_tpu.config import NUM_LOCAL_TASKS
+        from spark_rapids_tpu.runtime import pipeline as P
         nthreads = max(1, min(self.conf.get(NUM_LOCAL_TASKS), self.num_partitions))
         collector = M.current_collector()
+        pipe_on = P.enabled(self.conf)
 
         def run(split):
             # re-enter the driving action's query scope on the pool thread so
             # metrics/events fired by operators attribute to this query
             with M.collector_context(collector), TaskContext():
-                return [b.to_arrow() for b in self.execute_partition(split)]
+                it = self.execute_partition(split)
+                if pipe_on:
+                    # final-collect pipeline segment: upstream compute runs
+                    # on the stage's worker thread while this thread does the
+                    # D2H arrow conversion of the previous batch
+                    it = P.stage_iterator(
+                        it, edge="collect", conf=self.conf,
+                        registry=self.metrics, node_id=self._node_id,
+                        spillable=True)
+                return [b.to_arrow() for b in it]
 
         if self.num_partitions == 1:
             parts = [run(0)]

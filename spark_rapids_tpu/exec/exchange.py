@@ -36,6 +36,7 @@ class ShuffleExchangeExec(TpuExec):
         self._map_done = threading.Event()
         self._map_lock = threading.Lock()
         self._shuffle_id = None
+        self._pending_shuffle_id = None
         self._partition_time = self.metrics.metric(M.PARTITION_TIME, M.MODERATE)
         self._reads_left = self.partitioner.num_partitions
         self._reads_lock = threading.Lock()
@@ -51,10 +52,16 @@ class ShuffleExchangeExec(TpuExec):
     def _run_map_stage(self):
         store = ShuffleBlockStore.get()
         serialized = not self.conf.get(C.SHUFFLE_MANAGER_ENABLED)
-        self._shuffle_id = store.register_shuffle(serialized=serialized)
+        # write to a PRIVATE shuffle id and publish it only when every block
+        # is in the store: a concurrent reader re-resolving self._shuffle_id
+        # mid-rebuild (its fetch failure raced this recompute) must never see
+        # a half-written shuffle as complete — it sees the stale/None id,
+        # gets KeyError, and its own recompute ladder blocks on the barrier
+        sid = store.register_shuffle(serialized=serialized)
+        self._pending_shuffle_id = sid
         collector = M.current_collector()
         EL.emit("stage.map.start", node=self._node_id,
-                shuffle=self._shuffle_id,
+                shuffle=sid,
                 map_partitions=self.child.num_partitions,
                 reduce_partitions=self.partitioner.num_partitions)
 
@@ -71,6 +78,9 @@ class ShuffleExchangeExec(TpuExec):
             if samples:
                 self.partitioner.set_bounds_from_sample(samples)
 
+        from spark_rapids_tpu.runtime import pipeline as P
+        pipe_on = P.enabled(self.conf)
+
         def map_task(split):
             # pool thread: re-enter the query scope and open an attribution
             # frame for this exchange so map-side partitioning time lands on
@@ -78,7 +88,18 @@ class ShuffleExchangeExec(TpuExec):
             with M.collector_context(collector), \
                     M.node_frame(self._node_id, self._self_time), \
                     TaskContext():
-                for batch in self.child.execute_partition(split):
+                child_it = self.child.execute_partition(split)
+                if pipe_on:
+                    # map-segment boundary: upstream compute produces on the
+                    # stage's worker thread while THIS thread partitions,
+                    # serializes and writes the previous batch
+                    child_it = P.stage_iterator(
+                        child_it, edge="exchange.map", conf=self.conf,
+                        registry=self.metrics,
+                        node_id=getattr(self.child, "_node_id", None),
+                        spillable=True)
+                piece_seq = 0
+                for batch in child_it:
                     if batch.num_rows == 0:
                         continue
 
@@ -92,13 +113,20 @@ class ShuffleExchangeExec(TpuExec):
                     for pieces in R.with_retry([batch], partition_one,
                                                conf=self.conf,
                                                scope="exchange.map"):
+                        piece_seq += 1
                         for pid, piece in pieces:
                             # per-piece spill-only retry: a failed block
                             # registration rolls back before raising, so the
-                            # re-attempt never double-writes
+                            # re-attempt never double-writes. seq pins each
+                            # block's position to (map split, piece order):
+                            # concurrent map tasks + pipeline stages may
+                            # WRITE out of order, but order-sensitive
+                            # consumers (first/last) still see a stable
+                            # stream per reduce partition
                             R.call_with_retry(
-                                lambda p=pid, b=piece: store.write_block(
-                                    self._shuffle_id, p, b),
+                                lambda p=pid, b=piece, s=piece_seq:
+                                    store.write_block(sid, p, b,
+                                                      seq=(split, s)),
                                 scope="exchange.write")
 
         nthreads = max(1, min(self.conf.get(C.NUM_LOCAL_TASKS),
@@ -112,10 +140,12 @@ class ShuffleExchangeExec(TpuExec):
             # per-reduce-partition byte sizes: the profiler's shuffle-skew
             # input (bounded: one int per reduce partition)
             sizes = ShuffleBlockStore.get().partition_sizes(
-                self._shuffle_id, self.partitioner.num_partitions)
+                sid, self.partitioner.num_partitions)
             EL.emit("stage.map.end", node=self._node_id,
-                    shuffle=self._shuffle_id,
+                    shuffle=sid,
                     partition_sizes=[int(s) for s in sizes])
+        self._shuffle_id = sid          # publish: the map outputs are complete
+        self._pending_shuffle_id = None
 
     def _ensure_map_stage(self):
         if self._map_done.is_set():
@@ -127,11 +157,13 @@ class ShuffleExchangeExec(TpuExec):
                     self._run_map_stage()
                 except BaseException as e:
                     # don't re-run the map stage per reduce task, and don't strand
-                    # the partially written blocks in the catalog
+                    # the partially written blocks in the catalog (the failed
+                    # build wrote to the still-unpublished pending id)
                     self._map_error = e
-                    if self._shuffle_id is not None:
-                        ShuffleBlockStore.get().unregister_shuffle(self._shuffle_id)
-                        self._shuffle_id = None
+                    pending = getattr(self, "_pending_shuffle_id", None)
+                    if pending is not None:
+                        ShuffleBlockStore.get().unregister_shuffle(pending)
+                        self._pending_shuffle_id = None
                 finally:
                     self._map_done.set()
         self._raise_if_failed()
@@ -141,14 +173,28 @@ class ShuffleExchangeExec(TpuExec):
         if err is not None:
             raise RuntimeError("shuffle map stage failed") from err
 
-    def _invalidate_map_stage(self):
+    def _invalidate_map_stage(self, observed):
         """Forget the map outputs so the next read recomputes them (the
         standalone analog of Spark's FetchFailed → stage retry,
         RapidsShuffleIterator.scala:82,153). `_reads_left` is NOT reset: it
         counts reader completions, and each reduce partition still finishes
         exactly once — the last one out unregisters whatever shuffle id is
-        then current."""
+        then current.
+
+        `observed` is the shuffle generation the caller's read actually
+        failed against. Concurrent reduce readers (pipeline stage threads)
+        all race the same invalidation: the first one tears the stale
+        generation down and rebuilds; the rest fail against that SAME stale
+        id (KeyError/BufferClosedError mid-yank) and must not invalidate the
+        freshly rebuilt outputs — they see `_shuffle_id != observed` and
+        fall through to `_ensure_map_stage`, which hands them the new
+        generation (or blocks on the in-flight rebuild)."""
         with self._map_lock:
+            if observed is None or self._shuffle_id != observed:
+                # this reader never saw a live generation (it raced the
+                # invalidate→republish window) or a newer one exists: either
+                # way there is nothing of its own to tear down
+                return
             if self._shuffle_id is not None:
                 ShuffleBlockStore.get().unregister_shuffle(self._shuffle_id)
                 self._shuffle_id = None
@@ -171,12 +217,16 @@ class ShuffleExchangeExec(TpuExec):
         retries = self.conf.get(C.SHUFFLE_FETCH_MAX_RETRIES)
         for attempt in range(retries + 1):
             emitted = False
+            # pin the generation this attempt reads: on failure only THIS id
+            # may be invalidated (a concurrent reader's recompute may already
+            # have published a newer one that must survive)
+            sid = self._shuffle_id
             try:
                 # fault-injection checkpoint: "transport:fetch:N" chaos specs
                 # drop reduce-side fetches here (the stage-retry ladder), the
                 # same site name the peer ladder in shuffle/fetch.py checks
                 F.maybe_inject("transport", "fetch")
-                for b in store.read_partition(self._shuffle_id, split):
+                for b in store.read_partition(sid, split):
                     emitted = True
                     yield b
                 return
@@ -189,7 +239,7 @@ class ShuffleExchangeExec(TpuExec):
                 M.global_registry().metric(M.FETCH_RECOMPUTES).add(1)
                 tracing.span_event("fetch.recompute", split=split,
                                    error=str(e)[:120])
-                self._invalidate_map_stage()
+                self._invalidate_map_stage(sid)
                 with M.node_frame(self._node_id, None):
                     self._ensure_map_stage()
 
@@ -233,7 +283,16 @@ class ShuffleExchangeExec(TpuExec):
         # must not double-count the blocked wall time
         with M.node_frame(self._node_id, None):
             self._ensure_map_stage()
-        return self.wrap_output(self._reader(split))
+        from spark_rapids_tpu.runtime import pipeline as P
+        it = self._reader(split)
+        if P.enabled(self.conf):
+            # reduce-segment boundary: fetch + decompress + coalesce run on
+            # the stage's worker thread, overlapping downstream compute
+            it = P.stage_iterator(
+                it, edge="exchange.reduce", conf=self.conf,
+                registry=self.metrics, node_id=self._node_id,
+                self_time_metric=self._self_time, spillable=True)
+        return self.wrap_output(it)
 
     def args_string(self):
         return f"{type(self.partitioner).__name__}({self.partitioner.num_partitions})"
@@ -319,8 +378,15 @@ class AdaptiveShuffleReaderExec(TpuExec):
                 # shuffle blocks leak
                 for _ in pids[opened:]:
                     ex.account_read_done()
-        return self.wrap_output(coalesce_iterator(it(), goal, self.metrics,
-                                                  conf=self.conf))
+        from spark_rapids_tpu.runtime import pipeline as P
+        out = coalesce_iterator(it(), goal, self.metrics, conf=self.conf)
+        if P.enabled(self.conf):
+            # same reduce-segment boundary as the direct reader
+            out = P.stage_iterator(
+                out, edge="exchange.reduce", conf=self.conf,
+                registry=self.metrics, node_id=self._node_id,
+                self_time_metric=self._self_time, spillable=True)
+        return self.wrap_output(out)
 
     def args_string(self):
         specs = self._specs

@@ -689,8 +689,19 @@ class HashJoinExec(TpuExec):
                                  self.metrics.metric(M.BUILD_SELF_TIME,
                                                      M.MODERATE)), \
                     F.scope("joins.build"):
-                build_batch = concat_all(build_child.execute_partition(split),
-                                         build_child.output, conf=self.conf)
+                from spark_rapids_tpu.runtime import pipeline as P
+                build_it = build_child.execute_partition(split)
+                if P.enabled(self.conf):
+                    # build-segment boundary: the build subtree (scan +
+                    # upstream operators) produces on the stage's worker
+                    # thread while this thread registers/concats
+                    build_it = P.stage_iterator(
+                        build_it, edge="join.build", conf=self.conf,
+                        registry=self.metrics,
+                        node_id=getattr(build_child, "_node_id", None),
+                        spillable=True)
+                build_batch = concat_all(build_it, build_child.output,
+                                         conf=self.conf)
                 # hold the built table spillable while we stream (reference
                 # LazySpillableColumnarBatch, GpuHashJoin.scala:200); the
                 # single-batch registration cannot split — spill-only retry

@@ -42,9 +42,19 @@ class SortExec(TpuExec):
             # SPILL STORE — under HBM pressure earlier batches move to
             # host/disk instead of OOMing — with leak-safe close on error
             from spark_rapids_tpu.exec.coalesce import concat_all
+            from spark_rapids_tpu.runtime import pipeline as P
             from spark_rapids_tpu.runtime import retry as R
-            batch = concat_all(self.child.execute_partition(split),
-                               self.child.output, conf=self.conf)
+            src = self.child.execute_partition(split)
+            if P.enabled(self.conf):
+                # sort-segment boundary: the input subtree produces on the
+                # stage's worker thread while this thread accumulates the
+                # single-batch goal in the spill store
+                src = P.stage_iterator(
+                    src, edge="sort.input", conf=self.conf,
+                    registry=self.metrics,
+                    node_id=getattr(self.child, "_node_id", None),
+                    spillable=True)
+            batch = concat_all(src, self.child.output, conf=self.conf)
             if batch.num_rows == 0:
                 return
             acquire_semaphore(self.metrics)

@@ -373,6 +373,19 @@ class FileSourceScanExec(TpuExec):
                     yield batch
         return it()
 
+    def _maybe_pipeline(self, it, edge, depth=None):
+        """Detach a device-batch iterator onto its own pipeline segment:
+        decode/upload work runs on the stage's worker thread (charged to
+        this scan's selfTime there), queued batches sit spillable in the
+        catalog, and the downstream consumer overlaps its compute."""
+        from spark_rapids_tpu.runtime import pipeline as P
+        if not P.enabled(self.conf):
+            return it
+        return P.stage_iterator(
+            it, edge=edge, conf=self.conf, registry=self.metrics,
+            node_id=self._node_id, self_time_metric=self._self_time,
+            spillable=True, depth=depth)
+
     def execute_partition(self, split):
         conf = self.conf
         strategy = conf.get(CFG.PARQUET_READER_TYPE).upper()
@@ -395,38 +408,52 @@ class FileSourceScanExec(TpuExec):
             dev_it = self._device_decode_batches(
                 split, batch_rows, conf.get(CFG.MAX_READER_BATCH_SIZE_BYTES))
             if dev_it is not None:
-                return self.wrap_output(dev_it)
+                return self.wrap_output(
+                    self._maybe_pipeline(dev_it, "scan.device"))
 
         if decode_engaged(CFG.CSV_DEVICE_DECODE):
             dev_it = self._csv_device_decode_batches(split)
             if dev_it is not None:
-                return self.wrap_output(dev_it)
+                return self.wrap_output(
+                    self._maybe_pipeline(dev_it, "scan.device"))
 
         if decode_engaged(CFG.ORC_DEVICE_DECODE):
             dev_it = self._orc_device_decode_batches(
                 split, batch_rows, conf.get(CFG.MAX_READER_BATCH_SIZE_BYTES))
             if dev_it is not None:
-                return self.wrap_output(dev_it)
+                return self.wrap_output(
+                    self._maybe_pipeline(dev_it, "scan.device"))
 
         part = self.node.partitions[split]
         # 1:1 provenance is provable only for single-file partitions on the
         # host reader path (multi-file strategies may stitch files)
         host_meta = _scan_meta(part.paths[0]) if len(part.paths) == 1 else None
+        from spark_rapids_tpu.runtime import pipeline as P
+        pipe_on = P.enabled(conf)
 
         def it():
             gen = self.node.tables_for(
                 split, batch_rows, strategy, threads,
                 rebase_mode=conf.get(CFG.PARQUET_REBASE_MODE))
             depth = conf.get(CFG.SCAN_READAHEAD_DEPTH)
+            if pipe_on and depth <= 0:
+                depth = conf.get(CFG.PIPELINE_QUEUE_DEPTH)
             if depth > 0:
-                # readahead stays BEFORE the semaphore: it buffers host
-                # arrow tables only, so admission control still gates every
-                # device upload
+                # decode readahead stays BEFORE the semaphore: it buffers
+                # host arrow tables only, so admission control still gates
+                # every device upload. One mechanism, one byte budget: the
+                # scan's decode edge is a pipeline stage whose cap is the
+                # tighter of the readahead and pipeline byte knobs
                 from spark_rapids_tpu.runtime.memory import (
-                    scan_readahead_budget)
-                gen = R.readahead_tables(
-                    gen, depth, scan_readahead_budget(
-                        conf.get(CFG.SCAN_READAHEAD_MAX_BUFFER)),
+                    host_prefetch_budget)
+                budget = host_prefetch_budget(min(
+                    conf.get(CFG.SCAN_READAHEAD_MAX_BUFFER),
+                    conf.get(CFG.PIPELINE_MAX_QUEUE_BYTES)))
+                gen = P.stage_iterator(
+                    gen, edge="scan.decode", conf=conf,
+                    registry=self.metrics, node_id=self._node_id,
+                    self_time_metric=self._self_time,
+                    depth=depth, max_bytes=budget,
                     stall_metric=self.metrics.metric(
                         M.READAHEAD_STALL_TIME, M.MODERATE))
             for tbl in gen:
@@ -435,7 +462,11 @@ class FileSourceScanExec(TpuExec):
                     batch = ColumnarBatch.from_arrow(tbl, self.output)
                 batch.metadata = host_meta
                 yield batch
-        return self.wrap_output(it())
+
+        # double-buffered host→device transfer: the upload stage's worker
+        # converts batch N+1 (and its decode edge prefetches N+2) while the
+        # consumer computes on batch N
+        return self.wrap_output(self._maybe_pipeline(it(), "scan.upload"))
 
     def args_string(self):
         return self.node.args_string()

@@ -14,8 +14,6 @@ column bytes are read (reference filterBlocks, GpuParquetScan.scala:271-295)."""
 from __future__ import annotations
 
 import concurrent.futures as futures
-import threading
-import time
 import typing
 
 import pyarrow as pa
@@ -240,85 +238,33 @@ def reader_for(fmt: str, **kw) -> FormatReader:
 
 def readahead_tables(gen, depth: int, budget_bytes: int | None = None,
                      stall_metric=None):
-    """Bounded background readahead over a table generator: a daemon thread
+    """Bounded background readahead over a table generator: a worker thread
     drains `gen` up to `depth` items ahead of the consumer so host decode of
     batch N+1 overlaps whatever the consumer does with batch N (device
     upload + compute). Order-preserving and exception-transparent: items
     arrive exactly as `gen` would have yielded them, and a producer-side
     error re-raises at the consumer's position. `budget_bytes` additionally
     bounds the BYTES buffered (spill-budget awareness — see
-    runtime/memory.scan_readahead_budget); one oversized table may always
+    runtime/memory.host_prefetch_budget); one oversized table may always
     be staged so progress never deadlocks. `stall_metric` (a GpuMetric)
     accumulates the nanoseconds the CONSUMER spent blocked waiting on the
     producer — the "readahead stall time" the profiling tool surfaces: a
     large value means decode, not device compute, is the bottleneck.
 
-    Reference analog: MultiFileCloudParquetPartitionReader:1377 prefetches
-    whole files on a pool; this stage generalizes the overlap to every
-    reader strategy at batch granularity."""
+    Since the pipelined executor landed this is a thin front over ONE shared
+    mechanism — runtime/pipeline.stage_iterator's BoundedBatchQueue — so the
+    scan readahead and every other stage boundary share queue semantics and
+    one byte-budget policy (the reference analog remains
+    MultiFileCloudParquetPartitionReader:1377's prefetch role, generalized
+    past the MULTITHREADED reader to batch granularity)."""
     if depth <= 0:
         yield from gen
         return
-    import queue
-    budget = float("inf") if budget_bytes is None else budget_bytes
-    q: "queue.Queue" = queue.Queue(maxsize=depth)
-    stop = threading.Event()
-    cond = threading.Condition()
-    buffered = [0]
-
-    def _put(item) -> bool:
-        while not stop.is_set():
-            try:
-                q.put(item, timeout=0.05)
-                return True
-            except queue.Full:
-                continue
-        return False
-
-    def produce():
-        try:
-            for tbl in gen:
-                nb = getattr(tbl, "nbytes", 0)
-                with cond:
-                    # block while over budget — unless nothing is buffered,
-                    # in which case one table must pass (progress guarantee)
-                    while (not stop.is_set() and buffered[0] > 0
-                           and buffered[0] + nb > budget):
-                        cond.wait(timeout=0.05)
-                    if stop.is_set():
-                        return
-                    buffered[0] += nb
-                if not _put(("item", tbl, nb)):
-                    return
-            _put(("done", None, 0))
-        except BaseException as e:  # noqa: BLE001 — re-raised by consumer
-            _put(("error", e, 0))
-
-    t = threading.Thread(target=produce, daemon=True,
-                         name="srt-scan-readahead")
-    t.start()
-    try:
-        while True:
-            if stall_metric is not None:
-                t0 = time.perf_counter_ns()
-                kind, val, nb = q.get()
-                stall_metric.add(time.perf_counter_ns() - t0)
-            else:
-                kind, val, nb = q.get()
-            if kind == "done":
-                return
-            if kind == "error":
-                raise val
-            with cond:
-                buffered[0] -= nb
-                cond.notify_all()
-            yield val
-    finally:
-        # consumer closed early (limit hit, error downstream): release the
-        # producer so the thread exits instead of leaking on a full queue
-        stop.set()
-        with cond:
-            cond.notify_all()
+    from spark_rapids_tpu.runtime import pipeline as P
+    yield from P.stage_iterator(
+        gen, edge="scan.decode", depth=depth,
+        max_bytes=float("inf") if budget_bytes is None else budget_bytes,
+        stall_metric=stall_metric)
 
 
 # -- multi-file strategies ---------------------------------------------------

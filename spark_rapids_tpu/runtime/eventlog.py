@@ -44,6 +44,9 @@ KNOWN_EVENTS = frozenset({
     "fetch.error", "fetch.retry", "fetch.failover", "fetch.recompute",
     # liveness (shuffle/heartbeat.py + the health sampler below)
     "heartbeat.loss", "executor.health",
+    # pipelined executor queue edges (runtime/pipeline.py): a producer or
+    # consumer blocked past the stall threshold, bounded per queue
+    "pipeline.stall",
 })
 
 # events that only make sense inside a query's dynamic extent; the profiler

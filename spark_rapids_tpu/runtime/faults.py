@@ -11,15 +11,16 @@ facility without a JNI layer underneath.
 Spec grammar (comma-separated entries)::
 
     entry   := kind ":" site ":" trigger
-    kind    := "oom" | "splitoom" | "transport"
+    kind    := "oom" | "splitoom" | "transport" | "error"
     trigger := COUNT | COUNT "@" SKIP | "p" PROB
 
 ``oom`` raises a retryable runtime.retry.DeviceOomError, ``splitoom`` a
-SplitAndRetryOom, ``transport`` a shuffle TransportError. COUNT injects on
-that many eligible hits; ``@SKIP`` first lets SKIP eligible hits pass
-("oom:agg.update:1@3" skips three, injects once); ``pPROB`` injects each hit
-with the given probability from the seeded RNG (one seed → one
-deterministic schedule).
+SplitAndRetryOom, ``transport`` a shuffle TransportError, ``error`` a plain
+RuntimeError (a fault NO recovery ladder absorbs — proves clean whole-query
+failure paths). COUNT injects on that many eligible hits; ``@SKIP`` first
+lets SKIP eligible hits pass ("oom:agg.update:1@3" skips three, injects
+once); ``pPROB`` injects each hit with the given probability from the
+seeded RNG (one seed → one deterministic schedule).
 
 Sites: with_retry/call_with_retry attempts check their ``scope`` label
 ("joins.build", "joins.gather", "agg.update", "agg.merge", "sort.sort",
@@ -27,6 +28,11 @@ Sites: with_retry/call_with_retry attempts check their ``scope`` label
 check "catalog.add_batch"; the shuffle data plane checks "transport.send" /
 "transport.recv" (frame I/O) and "fetch" (per fetch attempt, both the peer
 ladder in shuffle/fetch.py and the stage ladder in exec/exchange.py).
+Pipeline queue boundaries (runtime/pipeline.py) check "pipeline.put" /
+"pipeline.get" plus the edge-qualified "pipeline.put.<edge>" /
+"pipeline.get.<edge>" via :func:`maybe_inject_any` — any armed kind fires
+there, proving a worker-thread fault cancels the whole pipeline and
+re-raises at the consumer.
 """
 
 from __future__ import annotations
@@ -43,7 +49,7 @@ _rng: random.Random | None = None
 _injected: list = []
 _tls = threading.local()
 
-_KINDS = ("oom", "splitoom", "transport")
+_KINDS = ("oom", "splitoom", "transport", "error")
 _ENTRY_RE = re.compile(
     r"^(?P<kind>[a-z]+):(?P<site>[A-Za-z0-9_.\-]+):"
     r"(?:(?P<count>\d+)(?:@(?P<skip>\d+))?|p(?P<prob>0?\.\d+|1(?:\.0*)?))$")
@@ -121,19 +127,13 @@ def current_scope() -> str | None:
     return getattr(_tls, "site", None)
 
 
-def maybe_inject(kind: str, site: str) -> None:
-    """Raise the configured fault for (kind, site) if one is armed; a no-op
-    flag check when injection is off (the production fast path)."""
-    if not _active:
-        return
+def _select_and_fire(site: str, kind_ok) -> None:
+    """Shared trigger walk: find the first armed entry for `site` whose kind
+    satisfies `kind_ok`, honor its COUNT/@SKIP/pPROB trigger, raise."""
     fire = None
     with _lock:
         for e in _entries:
-            # an "oom" checkpoint arms both OOM flavors — splitoom is the
-            # same fault class with a stronger recovery demand
-            kind_ok = (e.kind == kind
-                       or (kind == "oom" and e.kind == "splitoom"))
-            if not kind_ok or e.site != site:
+            if not kind_ok(e.kind) or e.site != site:
                 continue
             if e.prob is not None:
                 if _rng.random() < e.prob:
@@ -154,10 +154,32 @@ def maybe_inject(kind: str, site: str) -> None:
         _raise(fire.kind, site)
 
 
+def maybe_inject(kind: str, site: str) -> None:
+    """Raise the configured fault for (kind, site) if one is armed; a no-op
+    flag check when injection is off (the production fast path)."""
+    if not _active:
+        return
+    # an "oom" checkpoint arms both OOM flavors — splitoom is the same
+    # fault class with a stronger recovery demand
+    _select_and_fire(site, lambda k: k == kind
+                     or (kind == "oom" and k == "splitoom"))
+
+
+def maybe_inject_any(site: str) -> None:
+    """Raise whatever fault is armed for `site`, regardless of kind — the
+    pipeline queue put/get hooks use this so one chaos spec can drive any
+    fault class through a stage boundary."""
+    if not _active:
+        return
+    _select_and_fire(site, lambda k: True)
+
+
 def _raise(kind: str, site: str):
     if kind == "transport":
         from spark_rapids_tpu.shuffle.transport import TransportError
         raise TransportError(f"[fault-injection] transport fault at {site}")
+    if kind == "error":
+        raise RuntimeError(f"[fault-injection] error at {site}")
     from spark_rapids_tpu.runtime.retry import DeviceOomError, SplitAndRetryOom
     cls = SplitAndRetryOom if kind == "splitoom" else DeviceOomError
     raise cls(f"[fault-injection] device OOM at {site}", injected=True)

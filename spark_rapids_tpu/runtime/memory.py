@@ -405,15 +405,20 @@ class BufferCatalog:
         return len(self._buffers)
 
 
-def scan_readahead_budget(max_buffer_bytes: int) -> int:
-    """Byte budget for scan-readahead host buffering: the configured cap,
-    shrunk to the spill catalog's free host headroom so prefetched tables
-    never evict spilled device buffers to disk. The floor guarantees the
-    readahead thread can always stage at least one typical reader batch
-    (a zero budget would serialize decode behind compute again)."""
+def host_prefetch_budget(max_buffer_bytes: int) -> int:
+    """Byte budget for prefetch buffering ahead of a consumer (scan
+    readahead and every pipeline queue edge, runtime/pipeline.py): the
+    configured cap, shrunk to the spill catalog's free host headroom so
+    prefetched data never evicts spilled device buffers to disk. The floor
+    guarantees a producer can always stage at least one typical reader
+    batch (a zero budget would serialize decode behind compute again)."""
     cat = DeviceManager.get().catalog
     headroom = max(cat.host_budget - cat.host_bytes, 0)
     return max(min(max_buffer_bytes, headroom), 16 << 20)
+
+
+# historical name (the scan readahead predates the generalized pipeline)
+scan_readahead_budget = host_prefetch_budget
 
 
 class SpillableColumnarBatch:

@@ -51,6 +51,12 @@ SELF_TIME = "selfTime"
 # profiler renders it as the "(build)" line item
 BUILD_SELF_TIME = "buildSelfTime"
 READAHEAD_STALL_TIME = "readaheadStallTime"
+# pipeline queue edges (runtime/pipeline.py): per-edge metric names are
+# suffixed "<name>:<edge>" (e.g. "queueWaitTime:scan.decode") so one exec
+# can own several edges and the profiler can attribute stalls per edge
+QUEUE_WAIT_TIME = "queueWaitTime"      # consumer blocked on an empty queue
+QUEUE_FULL_TIME = "queueFullTime"      # producer blocked on a full queue
+QUEUE_DEPTH_PEAK = "queueDepthPeak"    # high-water mark of queued batches
 
 # resilience counters (reference: RmmRapidsRetryIterator retry/split counts
 # surfaced through GpuMetric, RapidsShuffleIterator fetch-failure accounting)

@@ -47,6 +47,18 @@ class TpuSemaphore:
         with self._holders_lock:
             self._holders[task_id] = 1
 
+    def release_current(self) -> None:
+        """Release the CALLING thread's task permit if it holds one — used
+        by pipeline stages (runtime/pipeline.py) before blocking on a full
+        queue, so a held permit can never starve the consumer that must
+        drain it (reference: the shuffle iterator releases while blocked,
+        RapidsShuffleIterator.scala:300). Operators re-acquire per batch via
+        acquire_if_necessary."""
+        from spark_rapids_tpu.exec.base import _task_local
+        tid = getattr(_task_local, "task_id", None)
+        if tid is not None:
+            self.release_if_necessary(tid)
+
     def release_if_necessary(self, task_id: int) -> None:
         """Release the task's permit entirely (reference completeAndRelease on task
         completion)."""

@@ -73,7 +73,14 @@ class ShuffleBlockStore:
             self._serialized_mode.setdefault(shuffle_id, serialized)
 
     # -- write side (RapidsCachingWriter.write:90) ---------------------------
-    def write_block(self, shuffle_id: int, reduce_id: int, batch: ColumnarBatch):
+    def write_block(self, shuffle_id: int, reduce_id: int,
+                    batch: ColumnarBatch, seq=None):
+        """`seq` (any ordered tuple, e.g. (map_split, batch_index)) pins
+        this block's position within the reduce partition independent of
+        WRITE order — concurrent map tasks (thread pool + pipeline stages)
+        finish in scheduler order, but order-sensitive consumers (first/
+        last aggregates) need a stable stream. None appends in arrival
+        order after all seq-tagged blocks (the pre-pipeline behavior)."""
         serialized = self._serialized_mode[shuffle_id]
         if serialized:
             blob = ser.serialize_batch(batch)
@@ -81,13 +88,19 @@ class ShuffleBlockStore:
             blob = mem.SpillableColumnarBatch(
                 batch, priority=mem.OUTPUT_FOR_SHUFFLE_INITIAL_PRIORITY)
         with self._lock:
-            self._blocks[shuffle_id].setdefault(reduce_id, []).append(blob)
+            lst = self._blocks[shuffle_id].setdefault(reduce_id, [])
+            lst.append((seq, len(lst), blob))
+
+    @staticmethod
+    def _ordered(entries):
+        return sorted(entries, key=lambda e: (
+            (0, e[0]) if e[0] is not None else (1,), e[1]))
 
     # -- read side (RapidsCachingReader / RapidsShuffleIterator) -------------
     def read_partition(self, shuffle_id: int, reduce_id: int):
         with self._lock:
-            blobs = list(self._blocks[shuffle_id].get(reduce_id, ()))
-        for blob in blobs:
+            entries = self._ordered(self._blocks[shuffle_id].get(reduce_id, ()))
+        for _, _, blob in entries:
             if isinstance(blob, bytes):
                 yield ser.deserialize_batch(blob)
             else:
@@ -101,7 +114,7 @@ class ShuffleBlockStore:
             out = []
             for pid in range(num_partitions):
                 total = 0
-                for b in parts.get(pid, ()):
+                for _, _, b in parts.get(pid, ()):
                     total += len(b) if isinstance(b, bytes) else b.size
                 out.append(total)
             return out
@@ -111,8 +124,8 @@ class ShuffleBlockStore:
             parts = self._blocks.pop(shuffle_id, {})
             self._serialized_mode.pop(shuffle_id, None)
             listeners = list(self._unregister_listeners)
-        for blobs in parts.values():
-            for b in blobs:
+        for entries in parts.values():
+            for _, _, b in entries:
                 if not isinstance(b, bytes):
                     b.close()
         for cb in listeners:

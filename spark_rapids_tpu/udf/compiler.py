@@ -9,11 +9,15 @@ execution and merge as If(cond, then, else); the result is a bound Expression
 that runs fused on the device instead of a per-row Python call.
 
 Coverage: arithmetic/comparison/boolean operators, constants, arguments,
-ternaries and nested conditionals, `and`/`or` short-circuits (CPython 3.12
-emits COPY + POP_JUMP + POP_TOP for these; the fork at the jump reconverges as
-If), math.* calls, abs(), str methods (upper/lower/strip), len(). Anything else
-returns None and the caller falls back to the Python-worker runtime (#40),
-exactly the compiled-else-fallback contract of the reference's Plugin.scala:28."""
+ternaries and nested conditionals, `and`/`or` short-circuits, math.* calls,
+abs(), str methods (upper/lower/strip), len(). Both CPython bytecode dialects
+in the support window are handled: 3.10's specialized opcodes
+(BINARY_ADD/..., CALL_FUNCTION/CALL_METHOD, JUMP_IF_{TRUE,FALSE}_OR_POP,
+JUMP_ABSOLUTE) and 3.11+'s unified forms (BINARY_OP, CALL + PUSH_NULL,
+COPY/SWAP; 3.12 emits COPY + POP_JUMP + POP_TOP for short-circuits — the
+fork at the jump reconverges as If). Anything else returns None and the
+caller falls back to the Python-worker runtime (#40), exactly the
+compiled-else-fallback contract of the reference's Plugin.scala:28."""
 
 from __future__ import annotations
 
@@ -34,10 +38,18 @@ class _CannotCompile(Exception):
     pass
 
 
-# BINARY_OP argument → expression class (CPython 3.12 oparg values)
+# BINARY_OP argument → expression class (CPython 3.11+ oparg values)
 _BINOPS = {
     "+": A.Add, "-": A.Subtract, "*": A.Multiply, "/": A.Divide,
     "%": A.Remainder, "//": A.IntegralDivide, "**": M.Pow,
+}
+
+# pre-3.11 specialized binary opcodes (one opcode per operator)
+_BINOP_NAMES = {
+    "BINARY_ADD": A.Add, "BINARY_SUBTRACT": A.Subtract,
+    "BINARY_MULTIPLY": A.Multiply, "BINARY_TRUE_DIVIDE": A.Divide,
+    "BINARY_MODULO": A.Remainder, "BINARY_FLOOR_DIVIDE": A.IntegralDivide,
+    "BINARY_POWER": M.Pow,
 }
 
 _CMPOPS = {
@@ -171,13 +183,23 @@ class _Compiler:
                 idx += 1
             elif op == "LOAD_METHOD":
                 recv = stack.pop()
-                if not isinstance(recv, Expression) or \
-                        ins.argval not in _STR_METHODS:
+                if isinstance(recv, _Marker) and recv.kind == "module":
+                    # pre-3.11 method load on a module (math.sqrt etc. —
+                    # 3.12 routes these through LOAD_ATTR instead)
+                    key = (recv.payload, ins.argval)
+                    if key not in _MATH_CALLS:
+                        raise _CannotCompile(f"unsupported call {key}")
+                    stack.append(_Marker("mathfn", _MATH_CALLS[key]))
+                elif isinstance(recv, Expression) and \
+                        ins.argval in _STR_METHODS:
+                    stack.append(_Marker("strmethod",
+                                         (_STR_METHODS[ins.argval], recv)))
+                else:
                     raise _CannotCompile(f"unsupported method {ins.argval}")
-                stack.append(_Marker("strmethod",
-                                     (_STR_METHODS[ins.argval], recv)))
                 idx += 1
-            elif op == "CALL":
+            elif op in ("CALL", "CALL_FUNCTION", "CALL_METHOD"):
+                # 3.11+ unified CALL; pre-3.11 CALL_FUNCTION/CALL_METHOD
+                # (the symbolic stack holds ONE marker per callee either way)
                 nargs = ins.arg
                 cargs = [stack.pop() for _ in range(nargs)][::-1]
                 callee = stack.pop()
@@ -194,6 +216,10 @@ class _Compiler:
                 if sym not in _BINOPS:
                     raise _CannotCompile(f"unsupported binop {ins.argrepr}")
                 stack.append(_BINOPS[sym](self._expr(l), self._expr(r)))
+                idx += 1
+            elif op in _BINOP_NAMES:
+                r, l = stack.pop(), stack.pop()
+                stack.append(_BINOP_NAMES[op](self._expr(l), self._expr(r)))
                 idx += 1
             elif op == "COMPARE_OP":
                 r, l = stack.pop(), stack.pop()
@@ -216,8 +242,22 @@ class _Compiler:
                 then_e = self._exec(idx + 1, stack, depth + 1)
                 else_e = self._exec(target, stack, depth + 1)
                 return C.If(cond, then_e, else_e)
+            elif op in ("JUMP_IF_FALSE_OR_POP", "JUMP_IF_TRUE_OR_POP"):
+                # pre-3.11 and/or short-circuit: the jumping arm KEEPS the
+                # condition value on the stack, the falling-through arm pops
+                # it — fork both and reconverge as If
+                cond = self._expr(stack[-1])
+                target = self.by_offset[ins.argval]
+                keep = self._exec(target, stack, depth + 1)
+                drop = self._exec(idx + 1, stack[:-1], depth + 1)
+                if op == "JUMP_IF_FALSE_OR_POP":
+                    return C.If(cond, drop, keep)   # true → evaluate rest
+                return C.If(cond, keep, drop)       # true → keep cond
             elif op == "COPY":
                 stack.append(stack[-ins.arg])
+                idx += 1
+            elif op == "DUP_TOP":
+                stack.append(stack[-1])
                 idx += 1
             elif op == "POP_TOP":
                 stack.pop()
@@ -225,7 +265,10 @@ class _Compiler:
             elif op == "SWAP":
                 stack[-1], stack[-ins.arg] = stack[-ins.arg], stack[-1]
                 idx += 1
-            elif op in ("JUMP_FORWARD", "JUMP_BACKWARD"):
+            elif op == "ROT_TWO":
+                stack[-1], stack[-2] = stack[-2], stack[-1]
+                idx += 1
+            elif op in ("JUMP_FORWARD", "JUMP_BACKWARD", "JUMP_ABSOLUTE"):
                 target = self.by_offset[ins.argval]
                 if target in seen:
                     raise _CannotCompile("loop in UDF bytecode")

@@ -178,6 +178,35 @@ def analyze(records: list) -> dict:
                                "stall_s": round(st / 1e9, 6)})
         stalls.sort(key=lambda r: -r["stall_s"])
 
+        # pipeline queue stalls per edge (runtime/pipeline.py): metric names
+        # are "<name>:<edge>" on the consuming node — wait = consumer
+        # starved (upstream too slow), full = producer backed up
+        # (downstream too slow); pipeline.stall events corroborate
+        edges: dict = {}
+        for n in nodes_by_id.values():
+            for mname, v in (n.get("metrics") or {}).items():
+                if ":" not in mname:
+                    continue
+                base, edge = mname.split(":", 1)
+                if base not in ("queueWaitTime", "queueFullTime",
+                                "queueDepthPeak"):
+                    continue
+                e = edges.setdefault(edge, {
+                    "edge": edge, "node": _node_label(nodes_by_id, n["id"]),
+                    "wait_s": 0.0, "full_s": 0.0, "depth_peak": 0,
+                    "stall_events": 0})
+                if base == "queueWaitTime":
+                    e["wait_s"] = round(e["wait_s"] + v / 1e9, 6)
+                elif base == "queueFullTime":
+                    e["full_s"] = round(e["full_s"] + v / 1e9, 6)
+                else:
+                    e["depth_peak"] = max(e["depth_peak"], v)
+        for ev in evs:
+            if ev["event"] == "pipeline.stall" and ev.get("edge") in edges:
+                edges[ev["edge"]]["stall_events"] += 1
+        pipeline_edges = sorted(edges.values(),
+                                key=lambda r: -(r["wait_s"] + r["full_s"]))
+
         queries.append({
             "query": qid,
             "description": rec.get("description", ""),
@@ -189,6 +218,7 @@ def analyze(records: list) -> dict:
             "retries": retries,
             "shuffles": shuffles,
             "readahead_stalls": stalls,
+            "pipeline_edges": pipeline_edges,
             "resilience": rec.get("resilience") or {},
             "batches": sum(1 for e in evs if e["event"] == "batch"),
         })
@@ -258,6 +288,16 @@ def render(analysis: dict, top: int = 15) -> str:
             out.append("  scan readahead stall time:")
             for s in q["readahead_stalls"]:
                 out.append(f"    {s['node']}: {s['stall_s']:.4f}s")
+        if q.get("pipeline_edges"):
+            out.append("  pipeline queue stalls per edge "
+                       "(wait=consumer starved, full=producer backed up):")
+            for e in q["pipeline_edges"]:
+                out.append(
+                    f"    {e['edge']} @ {e['node']}: "
+                    f"wait={e['wait_s']:.4f}s full={e['full_s']:.4f}s "
+                    f"depth_peak={e['depth_peak']}"
+                    + (f" stall_events={e['stall_events']}"
+                       if e["stall_events"] else ""))
         if any(q["resilience"].values()):
             out.append(f"  resilience deltas: {q['resilience']}")
         out.append("")
@@ -304,6 +344,13 @@ def render_compare(a: dict, b: dict, name_a: str, name_b: str) -> str:
         sb = sum(s["bytes"] for s in qb["spill"].values())
         if sa or sb:
             out.append(f"    spill bytes: {_fmt_bytes(sa)} -> {_fmt_bytes(sb)}")
+        qa_stall = sum(e["wait_s"] + e["full_s"]
+                       for e in qa.get("pipeline_edges", []))
+        qb_stall = sum(e["wait_s"] + e["full_s"]
+                       for e in qb.get("pipeline_edges", []))
+        if qa_stall or qb_stall:
+            out.append(f"    pipeline queue stall: {qa_stall:.4f}s -> "
+                       f"{qb_stall:.4f}s")
         ra = {k: v for k, v in qa["resilience"].items() if v}
         rb = {k: v for k, v in qb["resilience"].items() if v}
         if ra or rb:
