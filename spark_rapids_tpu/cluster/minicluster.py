@@ -24,19 +24,42 @@ Execution model:
   (TpuOverrides) never inserts its own exchanges;
 - the final (result) stage returns Arrow IPC bytes to the driver.
 
-Fault tolerance: a dead executor (broken pipe / EOF on its channel, or a task
-failing with a transport error against a dead peer) raises ExecutorLostError;
-the driver HEALS the pool (respawns the slot with a fresh block server) and
-re-runs the query's stages from the start with fresh shuffle ids — the
-standalone, coarser-grained form of Spark's FetchFailed → lineage recompute
-(reference RapidsShuffleIterator.scala:82,153), bounded by max_attempts.
+Fault tolerance — recovery proportional to what was lost (the Spark
+task-retry / FetchFailed → lineage-recompute ladder, reference
+RapidsShuffleIterator.scala:82,153):
+
+- a **MapOutputTracker** on the driver records, per shuffle id, which
+  executor hosts each map split's blocks, epoch-stamped: the epoch bumps
+  whenever a shuffle's outputs are invalidated, and any task reply computed
+  under a stale epoch is discarded and re-run (the reducer may have read a
+  half-rebuilt partition);
+- **task attempts**: a failed task (exception, injected fault, or a
+  `cluster.task.timeoutSeconds` deadline) retries up to
+  `cluster.task.maxFailures` times, preferring a different executor;
+  per-executor failure strikes **blacklist** an executor from placement
+  after `cluster.blacklist.maxTaskFailures`;
+- **lineage-scoped recompute**: on executor death (broken channel, or the
+  driver's poll of the heartbeat manager's expire_dead), the driver respawns
+  the slot, consults the tracker for exactly the map splits that lived on
+  the dead peer, re-runs only those under a bumped epoch, re-publishes
+  addresses into every live RemoteSourceNode, and reuses every surviving
+  stage output verbatim; the whole-query `_heal()` retry remains only as a
+  final fallback once `cluster.stage.maxRecomputes` is exhausted;
+- optional **speculative execution** (`cluster.speculation.enabled`):
+  stragglers past `speculation.multiplier` × the median completed task time
+  are duplicated on idle executors; the first completion wins (dedup keyed
+  by `(shuffle_id, map_split)`) and the loser's blocks are dropped so
+  results stay bit-identical.
 """
 
 from __future__ import annotations
 
+import collections
 import itertools
 import multiprocessing as mp
 import os
+import statistics
+import time
 import traceback
 
 import pyarrow as pa
@@ -51,7 +74,8 @@ import pyarrow as pa
 # executor process
 # ---------------------------------------------------------------------------
 
-def _executor_main(conn, platform: str, conf_settings: dict):
+def _executor_main(conn, executor_index: int, platform: str,
+                   conf_settings: dict):
     """Executor entry (spawned): block server + task loop (the standalone
     Plugin.scala:137-211 executor-side bring-up analog)."""
     if platform:
@@ -61,13 +85,19 @@ def _executor_main(conn, platform: str, conf_settings: dict):
         jax.config.update("jax_platforms", platform)
     import cloudpickle
     import spark_rapids_tpu  # noqa: F401  (x64 etc.)
+    from spark_rapids_tpu import config as CFG
     from spark_rapids_tpu.config import RapidsConf
     from spark_rapids_tpu.exec.base import TaskContext
     from spark_rapids_tpu.plan.transitions import to_device_plan
+    from spark_rapids_tpu.runtime import faults as F
     from spark_rapids_tpu.shuffle.manager import ShuffleBlockStore
     from spark_rapids_tpu.shuffle.transport import TcpTransport
 
     conf = RapidsConf(conf_settings)
+    # arm the chaos injector in the executor too (exec_kill / oom / transport
+    # sites fire where the work actually runs); the driver strips the spec
+    # from RESPAWNED replacements so COUNT triggers cannot re-fire forever
+    F.configure(conf.get(CFG.TEST_FAULTS), conf.get(CFG.TEST_FAULTS_SEED))
     store = ShuffleBlockStore.get()
     transport = TcpTransport(conf)
     conn.send({"op": "ready", "port": transport.port, "pid": os.getpid()})
@@ -76,28 +106,47 @@ def _executor_main(conn, platform: str, conf_settings: dict):
         plan = task["plan"]
         part = task["partitioner"].bind(plan.output)
         sid = task["shuffle_id"]
+        # the map task's identity within the shuffle: pins block order per
+        # reduce partition AND lets the driver drop exactly this task's
+        # output (speculation losers, stale/failed attempts)
+        map_split = task["map_split"]
         store.ensure_shuffle(sid)
+        # task-START checkpoint (distinct site from the per-batch one so
+        # batch-counted @SKIP triggers stay stable): lets exec_kill/hang
+        # fire even for a task whose input produces zero batches
+        F.maybe_inject_any("cluster.map.begin")
+        F.maybe_inject_any(f"cluster.map.begin.{executor_index}")
         exec_root = to_device_plan(plan, conf)
         with TaskContext():
             for split in task["splits"]:
                 seq = 0
                 for batch in exec_root.execute_partition(split):
+                    # chaos checkpoint (any armed kind fires, like the
+                    # pipeline queue sites): exec_kill dies mid-task with
+                    # blocks partially written, error drives task-attempt
+                    # retries, hang drives the task deadline
+                    F.maybe_inject_any("cluster.map")
+                    F.maybe_inject_any(f"cluster.map.{executor_index}")
                     seq += 1
                     for pid, piece in part.partition(batch, split):
                         if piece.num_rows:
                             # stable per-reduce-partition block order (same
                             # contract as the local exchange map writer)
                             store.write_block(sid, pid, piece,
-                                              seq=(split, seq))
+                                              seq=(map_split, seq))
         return {"sizes": store.partition_sizes(sid, part.num_partitions)}
 
     def run_result(task):
         plan = task["plan"]
+        F.maybe_inject_any("cluster.result.begin")
+        F.maybe_inject_any(f"cluster.result.begin.{executor_index}")
         exec_root = to_device_plan(plan, conf)
         tables = []
         with TaskContext():
             for split in task["splits"]:
                 for batch in exec_root.execute_partition(split):
+                    F.maybe_inject_any("cluster.result")
+                    F.maybe_inject_any(f"cluster.result.{executor_index}")
                     tables.append(batch.to_arrow())
         if not tables:
             out = plan.output.to_arrow().empty_table()
@@ -126,6 +175,9 @@ def _executor_main(conn, platform: str, conf_settings: dict):
             elif op == "drop_shuffle":
                 store.unregister_shuffle(msg["shuffle_id"])
                 reply = {}
+            elif op == "drop_map_output":
+                reply = {"dropped": store.drop_map_output(
+                    msg["shuffle_id"], msg["map_split"])}
             else:
                 raise ValueError(f"unknown op {op}")
             reply.update({"op": "done", "ok": True})
@@ -136,7 +188,7 @@ def _executor_main(conn, platform: str, conf_settings: dict):
 
 
 # ---------------------------------------------------------------------------
-# driver
+# driver-side plan plumbing
 # ---------------------------------------------------------------------------
 
 def _clone_plan(plan):
@@ -170,9 +222,135 @@ def _has_non_source_leaves(plan):
 
 
 class ExecutorLostError(RuntimeError):
-    """An executor process died (channel broke) or a task failed against a
-    dead shuffle peer; the driver heals the pool and retries the query."""
+    """Partial (lineage-scoped) recovery was exhausted or impossible: the
+    driver heals the whole pool and retries the query — the final rung of
+    the recovery ladder, not the first responder it used to be."""
 
+
+class PlacementPolicy:
+    """Deterministic, seedable round-robin task placement (replaces the old
+    bare itertools.cycle): the seed rotates which executor receives the
+    first task, so attempt/blacklist tests can pin which executor hosts
+    which map split. `prefer_not` lets a retry avoid the executors that
+    already failed the task when an alternative exists."""
+
+    def __init__(self, n_executors: int, seed: int = 0):
+        self.n = max(n_executors, 1)
+        self._next = seed % self.n
+
+    def pick(self, eligible, prefer_not=()):
+        order = [(self._next + i) % self.n for i in range(self.n)]
+        choices = [e for e in order
+                   if e in eligible and e not in prefer_not] \
+            or [e for e in order if e in eligible]
+        if not choices:
+            return None
+        c = choices[0]
+        self._next = (c + 1) % self.n
+        return c
+
+
+class _ShuffleState:
+    __slots__ = ("shuffle_id", "subtree", "partitioner", "mode", "splits",
+                 "hosts", "epoch", "recomputes")
+
+    def __init__(self, shuffle_id, subtree, partitioner, mode, splits):
+        self.shuffle_id = shuffle_id
+        self.subtree = subtree          # map-stage child plan (lineage)
+        self.partitioner = partitioner
+        self.mode = mode                # "pinned" | "plain" task shape
+        self.splits = list(splits)
+        self.hosts = {}                 # map_split -> executor index
+        self.epoch = 0                  # bumped on every invalidation
+        self.recomputes = 0             # partial recomputes consumed
+
+
+class MapOutputTracker:
+    """Driver-side map-output registry (Spark MapOutputTrackerMaster
+    analog): which executor hosts each map split's blocks, per shuffle,
+    epoch-stamped so stale reads are detectable, plus enough lineage
+    (subtree + partitioner + task shape) to re-run exactly the lost
+    splits."""
+
+    def __init__(self):
+        self._shuffles: dict[int, _ShuffleState] = {}
+
+    def register_shuffle(self, shuffle_id, subtree, partitioner, mode,
+                         splits) -> _ShuffleState:
+        st = _ShuffleState(shuffle_id, subtree, partitioner, mode, splits)
+        self._shuffles[shuffle_id] = st
+        return st
+
+    def state(self, shuffle_id) -> _ShuffleState | None:
+        return self._shuffles.get(shuffle_id)
+
+    def sids(self) -> list:
+        return sorted(self._shuffles)
+
+    def epoch(self, shuffle_id) -> int:
+        st = self._shuffles.get(shuffle_id)
+        return st.epoch if st is not None else 0
+
+    def epochs(self, shuffle_ids) -> dict:
+        return {sid: self.epoch(sid) for sid in shuffle_ids}
+
+    def register_map_output(self, shuffle_id, map_split, executor_idx):
+        self._shuffles[shuffle_id].hosts[map_split] = executor_idx
+
+    def on_executor_lost(self, executor_idx) -> list:
+        """Invalidate every map split hosted on the dead executor; returns
+        [(state, [lost splits])] in ascending shuffle-id (= dependency)
+        order, with each affected shuffle's epoch bumped."""
+        out = []
+        for sid in sorted(self._shuffles):
+            st = self._shuffles[sid]
+            lost = sorted(s for s, h in st.hosts.items() if h == executor_idx)
+            if lost:
+                st.epoch += 1
+                for s in lost:
+                    del st.hosts[s]
+                out.append((st, lost))
+        return out
+
+    def subtrees(self) -> list:
+        return [st.subtree for st in self._shuffles.values()]
+
+
+class _TaskSpec:
+    __slots__ = ("idx", "op", "subtree", "pin", "split", "shuffle_id",
+                 "partitioner", "read_sids", "attempts", "tried",
+                 "speculated")
+
+    def __init__(self, idx, op, subtree, pin, split, shuffle_id=None,
+                 partitioner=None):
+        self.idx = idx
+        self.op = op                    # "map" | "result"
+        self.subtree = subtree
+        self.pin = pin                  # reduce id to pin sources to, or None
+        self.split = split              # map split id / subtree partition
+        self.shuffle_id = shuffle_id
+        self.partitioner = partitioner
+        self.read_sids = sorted({s.shuffle_id for s in
+                                 _collect_sources(subtree, [])})
+        self.attempts = 0
+        self.tried: set = set()
+        self.speculated = False
+
+
+class _Running:
+    __slots__ = ("spec", "t0", "epochs", "speculative", "gen")
+
+    def __init__(self, spec, t0, epochs, speculative, gen):
+        self.spec = spec
+        self.t0 = t0
+        self.epochs = epochs            # {sid: epoch} at dispatch time
+        self.speculative = speculative
+        self.gen = gen                  # executor incarnation at dispatch
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
 
 class MiniCluster:
     """Driver for N executor processes; `collect(df)` runs the DataFrame's
@@ -180,7 +358,10 @@ class MiniCluster:
 
     def __init__(self, n_executors: int = 2, conf=None, platform: str = "cpu",
                  max_attempts: int = 3):
+        from spark_rapids_tpu import config as CFG
         from spark_rapids_tpu.config import RapidsConf
+        from spark_rapids_tpu.shuffle.heartbeat import (
+            RapidsShuffleHeartbeatManager)
         self.conf = conf or RapidsConf()
         self.n_executors = n_executors
         self.max_attempts = max_attempts
@@ -188,34 +369,79 @@ class MiniCluster:
         self._shuffle_ids = itertools.count(1000)
         self._conns = [None] * n_executors
         self._procs = [None] * n_executors
+        self._gen = [0] * n_executors       # incarnation per slot
+        self._exec_ids = [None] * n_executors
         self.addresses = [None] * n_executors
+        self._hb = RapidsShuffleHeartbeatManager(
+            timeout_s=self.conf.get(CFG.CLUSTER_HEARTBEAT_TIMEOUT))
+        self._tracker = MapOutputTracker()
+        self._current_root = None           # plan of the in-flight query
+        self._exec_failures = [0] * n_executors
+        self._blacklist: set = set()
+        self._placement = PlacementPolicy(
+            n_executors, self.conf.get(CFG.CLUSTER_PLACEMENT_SEED))
+        self._task_max_failures = self.conf.get(CFG.CLUSTER_TASK_MAX_FAILURES)
+        self._task_timeout_s = self.conf.get(CFG.CLUSTER_TASK_TIMEOUT)
+        self._blacklist_max = self.conf.get(
+            CFG.CLUSTER_BLACKLIST_MAX_TASK_FAILURES)
+        self._stage_max_recomputes = self.conf.get(
+            CFG.CLUSTER_STAGE_MAX_RECOMPUTES)
+        self._speculation = self.conf.get(CFG.CLUSTER_SPECULATION_ENABLED)
+        self._speculation_mult = self.conf.get(
+            CFG.CLUSTER_SPECULATION_MULTIPLIER)
         for ei in range(n_executors):
             self._spawn_executor(ei)
-        self._rr = itertools.cycle(range(n_executors))
         self.task_log: list = []        # (stage_op, executor_idx) per task
         self._after_stage_hook = None   # test fault-injection point
 
-    def _spawn_executor(self, ei: int):
+    # -- pool management ----------------------------------------------------
+    def _spawn_executor(self, ei: int, arm_faults: bool = True):
+        from spark_rapids_tpu import config as CFG
         ctx = mp.get_context("spawn")
         parent, child = ctx.Pipe()
+        settings = dict(self.conf.settings)
+        if not arm_faults:
+            # replacement executors come up clean: re-parsing a COUNT
+            # trigger in the respawn would fire the same fault forever
+            settings.pop(CFG.TEST_FAULTS.key, None)
         p = ctx.Process(target=_executor_main,
-                        args=(child, self._platform,
-                              dict(self.conf.settings)),
+                        args=(child, ei, self._platform, settings),
                         daemon=True)
         p.start()
-        hello = parent.recv()
+        # bounded handshake: a child that dies during bring-up must surface
+        # as an error, not hang the driver in recv() forever
+        if not parent.poll(120):
+            p.kill()
+            p.join(timeout=5)
+            raise RuntimeError(f"executor {ei} never came up")
+        try:
+            hello = parent.recv()
+        except (EOFError, OSError) as e:
+            p.join(timeout=5)
+            raise RuntimeError(f"executor {ei} died during bring-up") from e
         assert hello["op"] == "ready"
         self._conns[ei] = parent
         self._procs[ei] = p
         self.addresses[ei] = ("127.0.0.1", hello["port"])
+        self._gen[ei] += 1
+        old_eid = self._exec_ids[ei]
+        if old_eid is not None:
+            # a replaced incarnation must not fire a spurious expiry later
+            self._hb.deregister(old_eid)
+        eid = f"exec-{ei}-g{self._gen[ei]}"
+        self._hb.register(eid, "127.0.0.1", hello["port"])
+        self._exec_ids[ei] = eid
+        self._exec_failures[ei] = 0
+        self._blacklist.discard(ei)
 
     def _heal(self):
-        """Restart the WHOLE pool. Survivors may hold in-flight tasks whose
-        replies would desynchronize the request/reply pipe protocol on
-        retry (a stale ok=True task reply would be consumed as the next
-        ensure_shuffle ack); since the retry re-runs every stage anyway,
-        clean processes are both simpler and correct (Spark's
-        executor-replacement role)."""
+        """Restart the WHOLE pool — the LAST rung of the recovery ladder,
+        reached only when lineage-scoped recovery is exhausted
+        (cluster.stage.maxRecomputes) or no executor is placeable.
+        Survivors may hold in-flight tasks whose replies would
+        desynchronize the request/reply pipe protocol on retry; since the
+        retry re-runs every stage anyway, clean processes are both simpler
+        and correct (Spark's executor-replacement role)."""
         for ei, p in enumerate(self._procs):
             try:
                 self._conns[ei].close()
@@ -228,58 +454,374 @@ class MiniCluster:
                 if p.is_alive():
                     p.kill()
                     p.join(timeout=5)
-            self._spawn_executor(ei)
+            self._spawn_executor(ei, arm_faults=False)
+        self._tracker = MapOutputTracker()
+
+    # -- liveness -----------------------------------------------------------
+    def _poll_liveness(self) -> list:
+        """Beat the heartbeat manager for every live executor process, then
+        poll expire_dead (the driver-side failure detector the reference
+        runs in RapidsShuffleHeartbeatManager); returns the slot indices
+        the manager expired."""
+        for ei, p in enumerate(self._procs):
+            if p is not None and p.is_alive():
+                try:
+                    self._hb.heartbeat(self._exec_ids[ei])
+                except KeyError:
+                    pass
+        expired = self._hb.expire_dead()
+        slots = []
+        by_eid = {eid: ei for ei, eid in enumerate(self._exec_ids)}
+        for peer in expired:
+            ei = by_eid.get(peer.executor_id)
+            if ei is not None:
+                slots.append(ei)
+        return slots
+
+    def check_liveness(self) -> list:
+        """Public poll: expire dead executors via the heartbeat manager and
+        run the same lineage-scoped recovery as a mid-task loss. Returns
+        the recovered slot indices."""
+        recovered = []
+        for ei in self._poll_liveness():
+            if self._procs[ei] is not None and not self._procs[ei].is_alive():
+                self._handle_executor_loss(
+                    ei, {}, collections.deque(), frozenset(),
+                    reason="heartbeat.expired")
+                recovered.append(ei)
+        return recovered
+
+    # -- loss recovery ------------------------------------------------------
+    def _handle_executor_loss(self, ei, running, pending, busy,
+                              reason="channel", depth=0, done=None):
+        """The lineage-scoped recovery path: respawn the slot, invalidate
+        exactly the map splits the dead peer hosted, re-run only those
+        under a bumped epoch, and re-publish addresses. In-flight work on
+        other executors keeps running; its replies are discarded if the
+        epoch moved underneath them."""
+        from spark_rapids_tpu.runtime import metrics as M
+        from spark_rapids_tpu.runtime import tracing
+        g = M.global_registry()
+        g.metric(M.EXECUTORS_LOST).add(1)
+        tracing.span_event("executor.lost", executor=ei,
+                           generation=self._gen[ei], reason=reason)
+        run = running.pop(ei, None)
+        if run is not None and (done is None or run.spec.idx not in done):
+            pending.appendleft(run.spec)
+        try:
+            self._conns[ei].close()
+        except OSError:
+            pass
+        p = self._procs[ei]
+        if p is not None:
+            if p.is_alive():
+                p.kill()
+            p.join(timeout=10)
+        self._spawn_executor(ei, arm_faults=False)
+        # the fresh block store must know every live shuffle id — a peer
+        # with no blocks still serves (empty) metadata to reducers
+        for sid in self._tracker.sids():
+            self._conns[ei].send({"op": "ensure_shuffle", "shuffle_id": sid})
+            reply = self._conns[ei].recv()
+            assert reply.get("ok"), reply
+        self._republish_addresses()
+        lost = self._tracker.on_executor_lost(ei)
+        for st, splits in lost:
+            st.recomputes += 1
+            if st.recomputes > self._stage_max_recomputes:
+                raise ExecutorLostError(
+                    f"shuffle {st.shuffle_id} exceeded "
+                    f"cluster.stage.maxRecomputes="
+                    f"{self._stage_max_recomputes}; healing the pool")
+        for st, splits in lost:
+            g.metric(M.STAGE_PARTIAL_RECOMPUTES).add(1)
+            g.metric(M.MAP_TASKS_RECOMPUTED).add(len(splits))
+            tracing.span_event("stage.recompute.partial",
+                               shuffle=st.shuffle_id, epoch=st.epoch,
+                               splits=len(splits),
+                               total_splits=len(st.splits))
+            specs = [self._make_map_spec(st, s, i)
+                     for i, s in enumerate(splits)]
+            # recompute runs on executors not busy with outer work (the
+            # respawned slot is always idle, so progress is guaranteed)
+            self._run_tasks(specs, busy=frozenset(busy) | set(running),
+                            depth=depth + 1)
+
+    def _republish_addresses(self):
+        """Push the (possibly respawned) pool's addresses into every live
+        RemoteSourceNode — the driver's plan and every tracked lineage
+        subtree share node objects, so one walk re-points future task
+        ships and recomputes at the new block servers."""
+        roots = list(self._tracker.subtrees())
+        if self._current_root is not None:
+            roots.append(self._current_root)
+        seen = set()
+        for root in roots:
+            for src in _collect_sources(root, []):
+                if id(src) not in seen:
+                    seen.add(id(src))
+                    src.locations = [tuple(a) for a in self.addresses]
+
+    def _stamp_epochs(self, plan):
+        for src in _collect_sources(plan, []):
+            src.epoch = self._tracker.epoch(src.shuffle_id)
 
     # -- task plumbing ------------------------------------------------------
-    def _dispatch(self, jobs):
-        """jobs: list of (executor_idx, op, task_dict). Runs each executor's
-        queue sequentially, executors in parallel; returns replies in job
-        order. A broken channel or a transport-failure reply raises
-        ExecutorLostError (caught by collect()'s retry ladder)."""
-        import cloudpickle
-        by_exec: dict[int, list] = {}
-        for j, (ei, op, task) in enumerate(jobs):
-            by_exec.setdefault(ei, []).append((j, op, task))
-            self.task_log.append((op, ei))
-        if len(self.task_log) > 4096:    # observability ring, not a ledger
-            del self.task_log[:-2048]
-        replies = [None] * len(jobs)
-        # send one task per executor at a time (the Pipe is a simple duplex
-        # channel); round-robin until all queues drain
-        pending = {ei: list(q) for ei, q in by_exec.items()}
-        inflight = {}
-        while pending or inflight:
-            for ei, q in list(pending.items()):
-                if ei not in inflight and q:
-                    j, op, task = q.pop(0)
-                    try:
-                        self._conns[ei].send(
-                            {"op": op, "task": cloudpickle.dumps(task)})
-                    except (BrokenPipeError, OSError) as e:
-                        raise ExecutorLostError(
-                            f"executor {ei} channel broke on send: {e}") \
-                            from e
-                    inflight[ei] = j
-                if not q:
-                    del pending[ei]
-            for ei, j in list(inflight.items()):
-                try:
-                    reply = self._conns[ei].recv()
-                except (EOFError, OSError) as e:
-                    raise ExecutorLostError(
-                        f"executor {ei} died mid-task: {e}") from e
-                if not reply.get("ok"):
-                    err = reply.get("error") or ""
-                    if "TransportError" in err:
-                        # fetch against a dead peer: a stage-level loss, not
-                        # a task bug — retry through the heal ladder
-                        raise ExecutorLostError(
-                            f"executor {ei} fetch failed:\n{err}")
+    def _make_map_spec(self, st: _ShuffleState, split: int,
+                       idx: int | None = None) -> _TaskSpec:
+        return _TaskSpec(idx if idx is not None else split, "map",
+                         st.subtree,
+                         split if st.mode == "pinned" else None, split,
+                         shuffle_id=st.shuffle_id,
+                         partitioner=st.partitioner)
+
+    def _build_task(self, spec: _TaskSpec) -> dict:
+        if spec.pin is not None:
+            plan = _pin_sources(_clone_plan(spec.subtree), spec.pin)
+            splits = [0]
+        else:
+            plan = spec.subtree
+            splits = [spec.split]
+        self._stamp_epochs(plan)
+        task = {"plan": plan, "splits": splits}
+        if spec.op == "map":
+            task.update({"shuffle_id": spec.shuffle_id,
+                         "partitioner": spec.partitioner,
+                         "map_split": spec.split})
+        return task
+
+    def _drop_map_output(self, ei: int, spec: _TaskSpec, running, pending,
+                         busy, depth=0, done=None):
+        """Evict one map attempt's blocks from a LIVE executor (speculation
+        loser, stale-epoch or failed attempt that may have written partial
+        output); a dead executor's blocks died with its store."""
+        try:
+            self._conns[ei].send({"op": "drop_map_output",
+                                  "shuffle_id": spec.shuffle_id,
+                                  "map_split": spec.split})
+            reply = self._conns[ei].recv()
+            assert reply.get("ok"), reply
+        except (BrokenPipeError, EOFError, OSError):
+            self._handle_executor_loss(ei, running, pending, busy,
+                                       depth=depth, done=done)
+
+    def _charge_failure(self, ei: int, spec: _TaskSpec, reason: str,
+                        err: str = ""):
+        from spark_rapids_tpu.runtime import metrics as M
+        from spark_rapids_tpu.runtime import tracing
+        g = M.global_registry()
+        spec.attempts += 1
+        spec.tried.add(ei)
+        g.metric(M.TASK_ATTEMPTS).add(1)
+        tracing.span_event("task.attempt", executor=ei, op=spec.op,
+                           split=spec.split, shuffle=spec.shuffle_id,
+                           attempt=spec.attempts, reason=reason,
+                           error=err[-200:] if err else "")
+        self._exec_failures[ei] += 1
+        if (ei not in self._blacklist
+                and self._exec_failures[ei] >= self._blacklist_max):
+            self._blacklist.add(ei)
+            g.metric(M.EXECUTORS_BLACKLISTED).add(1)
+            tracing.span_event("executor.blacklisted", executor=ei,
+                               failures=self._exec_failures[ei])
+
+    # -- the scheduler loop -------------------------------------------------
+    def _run_tasks(self, specs: list, busy=frozenset(), depth: int = 0
+                   ) -> dict:
+        """Run every spec to completion across the pool; returns
+        {spec.idx: reply}. One in-flight task per executor (the Pipe is a
+        simple duplex channel); handles attempts, blacklisting, deadlines,
+        executor loss (with nested lineage recompute) and speculation."""
+        import multiprocessing.connection as mpc
+
+        from spark_rapids_tpu.runtime import metrics as M
+        from spark_rapids_tpu.runtime import tracing
+        g = M.global_registry()
+        if depth > 8:
+            raise ExecutorLostError("recovery recursion exhausted")
+        pending = collections.deque(specs)
+        running: dict[int, _Running] = {}
+        done: dict = {}
+        durations: list = []
+        total = {s.idx for s in specs}
+
+        def dispatch(spec, speculative=False):
+            import cloudpickle
+            eligible = {ei for ei in range(self.n_executors)
+                        if ei not in running and ei not in busy
+                        and ei not in self._blacklist
+                        and self._procs[ei] is not None
+                        and self._procs[ei].is_alive()}
+            ei = self._placement.pick(eligible, prefer_not=spec.tried)
+            if ei is None:
+                return None
+            task = self._build_task(spec)
+            epochs = self._tracker.epochs(spec.read_sids)
+            try:
+                self._conns[ei].send(
+                    {"op": spec.op, "task": cloudpickle.dumps(task)})
+            except (BrokenPipeError, OSError):
+                self._handle_executor_loss(ei, running, pending, busy,
+                                           depth=depth, done=done)
+                return False
+            running[ei] = _Running(spec, time.monotonic(), epochs,
+                                   speculative, self._gen[ei])
+            self.task_log.append((spec.op, ei))
+            if len(self.task_log) > 4096:   # observability ring, not a ledger
+                del self.task_log[:-2048]
+            return ei
+
+        def handle_reply(ei, run, reply):
+            spec = run.spec
+            if not reply.get("ok"):
+                err = reply.get("error") or ""
+                if "TransportError" in err:
+                    dead = [k for k, p in enumerate(self._procs)
+                            if p is not None and not p.is_alive()]
+                    if dead:
+                        # a fetch against a dead peer is not the task's
+                        # fault (Spark: FetchFailed doesn't count against
+                        # task attempts) — recover the peers, retry free
+                        for k in dead:
+                            self._handle_executor_loss(k, running, pending,
+                                                       busy, depth=depth,
+                                                       done=done)
+                        if spec.op == "map":
+                            self._drop_map_output(ei, spec, running, pending,
+                                                  busy, depth=depth,
+                                                  done=done)
+                        if spec.idx not in done:
+                            pending.appendleft(spec)
+                        return
+                # a real task failure: partial map output on a LIVE
+                # executor must be evicted before the retry re-writes it
+                if spec.op == "map":
+                    self._drop_map_output(ei, spec, running, pending, busy,
+                                          depth=depth, done=done)
+                self._charge_failure(ei, spec, "failure", err)
+                if spec.attempts >= self._task_max_failures:
                     raise RuntimeError(
-                        f"executor {ei} task failed:\n{err}")
-                replies[j] = reply
-                del inflight[ei]
-        return replies
+                        f"task {spec.op}/{spec.split} failed "
+                        f"{spec.attempts} times "
+                        f"(cluster.task.maxFailures="
+                        f"{self._task_max_failures}); last error:\n{err}")
+                if spec.idx not in done:
+                    pending.append(spec)
+                return
+            if spec.idx in done:
+                # a duplicate (speculation) or re-run lost the race: the
+                # winner's blocks are the only copy allowed to survive
+                g.metric(M.SPECULATION_LOST).add(1)
+                tracing.span_event("speculation.lost", executor=ei,
+                                   op=spec.op, split=spec.split,
+                                   shuffle=spec.shuffle_id)
+                if spec.op == "map":
+                    self._drop_map_output(ei, spec, running, pending, busy,
+                                          depth=depth, done=done)
+                return
+            if run.epochs != self._tracker.epochs(spec.read_sids):
+                # computed against metadata that moved underneath it (a
+                # peer died and its splits were rebuilt mid-flight): the
+                # reply may have read a half-rebuilt partition — discard
+                g.metric(M.TASK_ATTEMPTS).add(1)
+                tracing.span_event("task.attempt", executor=ei, op=spec.op,
+                                   split=spec.split, shuffle=spec.shuffle_id,
+                                   attempt=spec.attempts + 1,
+                                   reason="stale_epoch")
+                if spec.op == "map":
+                    self._drop_map_output(ei, spec, running, pending, busy,
+                                          depth=depth, done=done)
+                pending.appendleft(spec)
+                return
+            done[spec.idx] = reply
+            durations.append(time.monotonic() - run.t0)
+            if spec.op == "map":
+                self._tracker.register_map_output(spec.shuffle_id,
+                                                  spec.split, ei)
+            if run.speculative:
+                g.metric(M.SPECULATION_WON).add(1)
+                tracing.span_event("speculation.won", executor=ei,
+                                   op=spec.op, split=spec.split,
+                                   shuffle=spec.shuffle_id)
+
+        while not total.issubset(done.keys()) or running:
+            # heartbeat-manager failure detection (expire_dead), polled by
+            # the driver every scheduling round
+            for ei in self._poll_liveness():
+                if (self._procs[ei] is not None
+                        and not self._procs[ei].is_alive()):
+                    self._handle_executor_loss(ei, running, pending, busy,
+                                               reason="heartbeat.expired",
+                                               depth=depth, done=done)
+            # a nested recovery may have respawned a slot under an outer
+            # in-flight task: its reply can never arrive on the new pipe
+            for ei, run in list(running.items()):
+                if run.gen != self._gen[ei]:
+                    del running[ei]
+                    if run.spec.idx not in done:
+                        pending.appendleft(run.spec)
+            # fill idle executors (a False dispatch respawned the slot it
+            # targeted, so retrying the same spec makes progress)
+            while pending:
+                r = dispatch(pending[0])
+                if r is None:
+                    break               # no idle eligible executor
+                if r is False:
+                    continue
+                pending.popleft()
+            if not running:
+                if not pending and total.issubset(done.keys()):
+                    break
+                if pending:
+                    raise ExecutorLostError(
+                        f"no placeable executor for {len(pending)} pending "
+                        f"task(s) (blacklisted={sorted(self._blacklist)})")
+            conns = {self._conns[ei]: ei for ei in running}
+            ready = mpc.wait(list(conns), timeout=0.05)
+            now = time.monotonic()
+            if not ready:
+                # deadline scan: a task past cluster.task.timeoutSeconds is
+                # on a wedged executor — the pipe protocol cannot cancel a
+                # task, so the executor is killed and replaced
+                if self._task_timeout_s > 0:
+                    for ei, run in list(running.items()):
+                        if now - run.t0 > self._task_timeout_s:
+                            self._charge_failure(ei, run.spec, "timeout")
+                            if run.spec.attempts >= self._task_max_failures:
+                                raise RuntimeError(
+                                    f"task {run.spec.op}/{run.spec.split} "
+                                    f"timed out {run.spec.attempts} times")
+                            self._handle_executor_loss(ei, running, pending,
+                                                       busy,
+                                                       reason="task.timeout",
+                                                       depth=depth,
+                                                       done=done)
+                # speculation: duplicate stragglers on idle executors
+                if (self._speculation and depth == 0 and not pending
+                        and running and durations):
+                    med = statistics.median(durations)
+                    for ei, run in list(running.items()):
+                        if (run.speculative or run.spec.speculated
+                                or run.spec.idx in done):
+                            continue
+                        if now - run.t0 <= self._speculation_mult * med:
+                            continue
+                        run.spec.speculated = True
+                        dispatch(run.spec, speculative=True)
+                continue
+            for conn in ready:
+                ei = conns[conn]
+                if ei not in running:
+                    continue            # pool changed while iterating
+                try:
+                    reply = conn.recv()
+                except (EOFError, OSError):
+                    self._handle_executor_loss(ei, running, pending, busy,
+                                               depth=depth, done=done)
+                    continue
+                run = running.pop(ei)
+                handle_reply(ei, run, reply)
+        return done
 
     # -- scheduling ---------------------------------------------------------
     def collect(self, df) -> pa.Table:
@@ -288,9 +830,8 @@ class MiniCluster:
             try:
                 return self._collect_once(df)
             except ExecutorLostError as e:
-                # lineage recompute, coarse-grained: heal the pool and re-run
-                # all stages with fresh shuffle ids (Spark FetchFailed →
-                # stage retry; reference RapidsShuffleIterator.scala:82,153)
+                # the FINAL fallback: lineage-scoped recovery was exhausted,
+                # heal the pool and re-run all stages with fresh shuffle ids
                 last = e
                 self._heal()
         raise last
@@ -300,12 +841,42 @@ class MiniCluster:
                                                       stage_order)
         plan = _clone_plan(df._plan)
         plan = ensure_distribution(plan, self.n_executors)
-        for exchange, parent, idx in stage_order(plan):
-            source = self._run_map_stage(exchange)
-            parent.children[idx] = source
-            if self._after_stage_hook is not None:
-                self._after_stage_hook(self)
-        return self._run_result_stage(plan)
+        self._tracker = MapOutputTracker()
+        self._current_root = plan
+        try:
+            for exchange, parent, idx in stage_order(plan):
+                source = self._run_map_stage(exchange)
+                parent.children[idx] = source
+                if self._after_stage_hook is not None:
+                    self._after_stage_hook(self)
+            out = self._run_result_stage(plan)
+        finally:
+            self._current_root = None
+        self._cleanup_shuffles(self._tracker.sids())
+        # the finished query's lineage is dead weight: a loss between
+        # queries should respawn the slot, not recompute dropped shuffles
+        self._tracker = MapOutputTracker()
+        return out
+
+    def _broadcast_ensure_shuffle(self, sid: int):
+        """Every executor must know the shuffle id — a peer with no map
+        task for it still serves (empty) metadata requests from reducers.
+        An executor lost mid-broadcast is recovered in place (the respawn
+        path re-ensures every tracked shuffle, including this one)."""
+        for ei in range(self.n_executors):
+            for _ in range(2):
+                try:
+                    self._conns[ei].send({"op": "ensure_shuffle",
+                                          "shuffle_id": sid})
+                    reply = self._conns[ei].recv()
+                    assert reply.get("ok"), reply
+                    break
+                except (BrokenPipeError, EOFError, OSError):
+                    self._handle_executor_loss(
+                        ei, {}, collections.deque(), frozenset())
+            else:
+                raise ExecutorLostError(
+                    f"executor {ei} unreachable for ensure_shuffle")
 
     def _run_map_stage(self, exchange):
         from spark_rapids_tpu.plan import nodes as NN
@@ -322,26 +893,17 @@ class MiniCluster:
                 "range partitioning needs driver-side sampling (use "
                 "sort with a single exchange in MiniCluster)")
         sid = next(self._shuffle_ids)
-        # every executor must know the shuffle id — a peer with no map task
-        # for it still serves (empty) metadata requests from reducers
-        try:
-            for c in self._conns:
-                c.send({"op": "ensure_shuffle", "shuffle_id": sid})
-            for c in self._conns:
-                reply = c.recv()
-                assert reply.get("ok"), reply
-        except (BrokenPipeError, EOFError, OSError) as e:
-            raise ExecutorLostError(f"ensure_shuffle: {e}") from e
-        jobs = []
-        for split, task in self._stage_tasks(child):
-            task.update({"shuffle_id": sid, "partitioner": part})
-            jobs.append((next(self._rr), "map", task))
-        self._dispatch(jobs)
+        mode, splits = self._stage_shape(child)
+        st = self._tracker.register_shuffle(sid, child, part, mode, splits)
+        self._broadcast_ensure_shuffle(sid)
+        specs = [self._make_map_spec(st, s, i) for i, s in enumerate(splits)]
+        self._run_tasks(specs)
         return NN.RemoteSourceNode(sid, child.output, part.num_partitions,
-                                   list(self.addresses))
+                                   [tuple(a) for a in self.addresses],
+                                   epoch=self._tracker.epoch(sid))
 
-    def _stage_tasks(self, subtree):
-        """Yield (split, task) covering every partition of `subtree`.
+    def _stage_shape(self, subtree):
+        """Task shape covering every partition of `subtree`.
         Co-partitioned shuffle inputs → one pinned task per reduce id;
         everything else → one task per partition of the subtree (a UNION of
         a scan leaf with a shuffle source spreads its leaf splits and reduce
@@ -349,36 +911,74 @@ class MiniCluster:
         sources = _collect_sources(subtree, [])
         if sources and not _has_non_source_leaves(subtree) and \
                 len({s.n_parts for s in sources}) == 1:
-            n = sources[0].n_parts
-            for r in range(n):
-                yield r, {"plan": _pin_sources(_clone_plan(subtree), r),
-                          "splits": [0]}
-        else:
-            for s in range(subtree.num_partitions):
-                yield s, {"plan": subtree, "splits": [s]}
+            return "pinned", list(range(sources[0].n_parts))
+        return "plain", list(range(subtree.num_partitions))
 
     def _run_result_stage(self, plan) -> pa.Table:
-        jobs = [(next(self._rr), "result", task)
-                for _, task in self._stage_tasks(plan)]
-        replies = self._dispatch(jobs)
+        from spark_rapids_tpu import types as T
+        mode, splits = self._stage_shape(plan)
+        specs = [_TaskSpec(i, "result", plan,
+                           s if mode == "pinned" else None, s)
+                 for i, s in enumerate(splits)]
+        replies = self._run_tasks(specs)
         tables = []
-        for r in replies:
-            t = pa.ipc.open_stream(r["ipc"]).read_all()
-            if t.num_rows or not tables:
+        for i in range(len(specs)):
+            t = pa.ipc.open_stream(replies[i]["ipc"]).read_all()
+            if t.num_rows:
                 tables.append(t)
+        if not tables:
+            # derive the empty-result schema from the plan's DECLARED
+            # output instead of trusting the first (possibly schema-less)
+            # empty reply: an all-empty multi-executor result must not
+            # concat mismatched tables
+            return pa.Table.from_arrays(
+                [pa.array([], T.to_arrow_type(f.data_type))
+                 for f in plan.output],
+                names=[f.name for f in plan.output])
         return pa.concat_tables(tables)
+
+    def _cleanup_shuffles(self, sids):
+        """Best-effort: drop a finished query's shuffle blocks from every
+        executor store (they are never read again; leaving them would grow
+        executor memory query over query)."""
+        for ei in range(self.n_executors):
+            try:
+                for sid in sids:
+                    self._conns[ei].send({"op": "drop_shuffle",
+                                          "shuffle_id": sid})
+                    self._conns[ei].recv()
+            except (BrokenPipeError, EOFError, OSError):
+                pass
 
     def shutdown(self):
         for c in self._conns:
+            if c is None:
+                continue
             try:
                 c.send({"op": "stop"})
-                c.recv()
-            except (EOFError, OSError):
+                if c.poll(5):
+                    c.recv()
+            except (BrokenPipeError, EOFError, OSError):
                 pass
         for p in self._procs:
+            if p is None:
+                continue
             p.join(timeout=10)
             if p.is_alive():
                 p.terminate()
+                p.join(timeout=5)
+            if p.is_alive():
+                # terminate() can be ignored by a wedged child; escalate so
+                # chaos tests never leak zombie processes
+                p.kill()
+                p.join(timeout=5)
+        for c in self._conns:
+            if c is None:
+                continue
+            try:
+                c.close()
+            except OSError:
+                pass
 
     def __enter__(self):
         return self

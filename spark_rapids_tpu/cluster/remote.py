@@ -17,13 +17,16 @@ from spark_rapids_tpu.runtime import metrics as M
 class RemoteFetchExec(TpuExec):
     def __init__(self, shuffle_id: int, schema: T.StructType, n_parts: int,
                  locations: list, pinned_reduce: int | None = None,
-                 conf=None):
+                 epoch: int = 0, conf=None):
         super().__init__(conf=conf)
         self.shuffle_id = shuffle_id
         self.schema = schema
         self.n_parts = n_parts
         self.locations = list(locations)
         self.pinned_reduce = pinned_reduce
+        # map-output epoch the driver stamped at task-ship time; rides the
+        # fetch-retry events so stale-metadata fetches are attributable
+        self.epoch = epoch
         self._fetch_time = self.metrics.metric(M.READ_FS_TIME, M.MODERATE)
 
     @property
@@ -35,21 +38,31 @@ class RemoteFetchExec(TpuExec):
         return 1 if self.pinned_reduce is not None else self.n_parts
 
     def execute_partition(self, split):
+        from spark_rapids_tpu.shuffle.fetch import iter_union_blocks
         from spark_rapids_tpu.shuffle.transport import (InflightThrottle,
                                                         TcpShuffleClient)
         rid = self.pinned_reduce if self.pinned_reduce is not None else split
         bounce = self.conf.get(CFG.SHUFFLE_BOUNCE_BUFFER_SIZE)
         throttle = InflightThrottle(
             self.conf.get(CFG.SHUFFLE_MAX_INFLIGHT_BYTES))
+        retries = self.conf.get(CFG.SHUFFLE_FETCH_MAX_RETRIES)
+        # fresh client per attempt (a dead connection must not be reused);
+        # per-peer retry+backoff via the shuffle fetch ladder — peers hold
+        # DISJOINT block sets here, so there is no failover, and a peer
+        # that stays dead surfaces as TransportError for the driver's
+        # lineage-scoped recompute to classify
+        factories = [
+            (lambda a=tuple(addr): TcpShuffleClient(a, bounce, throttle))
+            for addr in self.locations]
 
         def it():
-            for addr in self.locations:
-                client = TcpShuffleClient(tuple(addr), bounce, throttle)
-                for batch in client.fetch_blocks(self.shuffle_id, rid):
-                    acquire_semaphore(self.metrics)
-                    yield batch
+            for batch in iter_union_blocks(factories, self.shuffle_id, rid,
+                                           max_retries=retries,
+                                           epoch=self.epoch):
+                acquire_semaphore(self.metrics)
+                yield batch
         return self.wrap_output(it())
 
     def args_string(self):
         return (f"shuffle={self.shuffle_id} pinned={self.pinned_reduce} "
-                f"peers={len(self.locations)}")
+                f"peers={len(self.locations)} epoch={self.epoch}")

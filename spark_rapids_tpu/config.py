@@ -455,6 +455,57 @@ BROADCAST_MAX_TABLE_BYTES = conf("spark.rapids.tpu.sql.broadcast.maxTableBytes"
     "Fail a broadcast whose materialized relation exceeds this size "
     "(reference maxBroadcastTableSize guard); 0 disables").bytes_conf("8g")
 
+CLUSTER_TASK_MAX_FAILURES = conf("spark.rapids.tpu.cluster.task.maxFailures").doc(
+    "Attempts one MiniCluster task gets before the query fails with the "
+    "task's error; each retry is placed on a different executor when one is "
+    "available (Spark spark.task.maxFailures)").integer_conf(4)
+
+CLUSTER_TASK_TIMEOUT = conf("spark.rapids.tpu.cluster.task.timeoutSeconds").doc(
+    "Deadline for one MiniCluster task; a task running past it has its "
+    "executor killed (the pipe protocol cannot cancel a wedged task) and is "
+    "retried on another executor, counting as a task failure against the "
+    "slow executor. <=0 disables the deadline").double_conf(0.0)
+
+CLUSTER_BLACKLIST_MAX_TASK_FAILURES = conf(
+    "spark.rapids.tpu.cluster.blacklist.maxTaskFailures").doc(
+    "Task failures charged to one executor before the driver blacklists it "
+    "from further task placement (Spark spark.blacklist.* / "
+    "spark.excludeOnFailure.*); a respawned executor starts with a clean "
+    "record").integer_conf(2)
+
+CLUSTER_STAGE_MAX_RECOMPUTES = conf(
+    "spark.rapids.tpu.cluster.stage.maxRecomputes").doc(
+    "Partial (lineage-scoped) recomputes one shuffle's map outputs may go "
+    "through after executor losses before the driver falls back to the "
+    "whole-query heal ladder (Spark spark.stage.maxConsecutiveAttempts)"
+).integer_conf(4)
+
+CLUSTER_SPECULATION_ENABLED = conf(
+    "spark.rapids.tpu.cluster.speculation.enabled").doc(
+    "Speculatively duplicate a stage's straggler tasks on idle executors "
+    "once they exceed speculation.multiplier x the median completed task "
+    "time; the first finisher wins and the loser's map outputs are "
+    "discarded so results stay bit-identical (Spark spark.speculation)"
+).boolean_conf(False)
+
+CLUSTER_SPECULATION_MULTIPLIER = conf(
+    "spark.rapids.tpu.cluster.speculation.multiplier").doc(
+    "How many times slower than the median completed task time a running "
+    "task must be before it is speculated "
+    "(Spark spark.speculation.multiplier)").double_conf(3.0)
+
+CLUSTER_PLACEMENT_SEED = conf("spark.rapids.tpu.cluster.placement.seed").doc(
+    "Seed for the MiniCluster's deterministic round-robin task placement "
+    "(rotates which executor gets the first task); tests use it to pin "
+    "which executor hosts which map split").integer_conf(0)
+
+CLUSTER_HEARTBEAT_TIMEOUT = conf(
+    "spark.rapids.tpu.cluster.heartbeat.timeoutSeconds").doc(
+    "Seconds without a liveness beat before the driver's heartbeat manager "
+    "expires a MiniCluster executor (expire_dead -> partial stage "
+    "recompute); beats are recorded on every task reply and liveness scan"
+).double_conf(60.0)
+
 EVENT_LOG_DIR = conf("spark.rapids.tpu.eventLog.dir").doc(
     "Directory for the structured JSONL event log (query/stage/batch "
     "lifecycle, spill, OOM-retry/split, fetch retry/failover/recompute, "

@@ -989,13 +989,20 @@ class RemoteSourceNode(PlanNode):
     single-partition so stage-local planning never inserts exchanges."""
 
     def __init__(self, shuffle_id: int, schema: T.StructType, n_parts: int,
-                 locations: list, pinned_reduce: int | None = None):
+                 locations: list, pinned_reduce: int | None = None,
+                 epoch: int = 0):
         super().__init__()
         self.shuffle_id = shuffle_id
         self.schema = schema
         self.n_parts = n_parts
         self.locations = list(locations)
         self.pinned_reduce = pinned_reduce
+        # map-output epoch this node's metadata (locations) was stamped at;
+        # the driver's MapOutputTracker bumps the shuffle's epoch whenever
+        # map outputs are invalidated/recomputed, and discards any task
+        # reply computed under a stale epoch (the reducer may have seen a
+        # half-rebuilt partition)
+        self.epoch = epoch
 
     @property
     def output(self):
@@ -1007,7 +1014,8 @@ class RemoteSourceNode(PlanNode):
 
     def pinned(self, reduce_id: int) -> "RemoteSourceNode":
         return RemoteSourceNode(self.shuffle_id, self.schema, self.n_parts,
-                                self.locations, pinned_reduce=reduce_id)
+                                self.locations, pinned_reduce=reduce_id,
+                                epoch=self.epoch)
 
     def execute_host(self, split):
         from spark_rapids_tpu import config as CFG
@@ -1027,4 +1035,5 @@ class RemoteSourceNode(PlanNode):
 
     def args_string(self):
         return (f"shuffle={self.shuffle_id} parts={self.n_parts} "
-                f"pinned={self.pinned_reduce} hosts={len(self.locations)}")
+                f"pinned={self.pinned_reduce} hosts={len(self.locations)} "
+                f"epoch={self.epoch}")

@@ -1051,7 +1051,8 @@ def _register_all():
         from spark_rapids_tpu.cluster.remote import RemoteFetchExec
         n = meta.node
         return RemoteFetchExec(n.shuffle_id, n.schema, n.n_parts, n.locations,
-                               n.pinned_reduce, conf=meta.conf)
+                               n.pinned_reduce, epoch=getattr(n, "epoch", 0),
+                               conf=meta.conf)
 
     exr(NN.RemoteSourceNode, "remote shuffle fetch over TCP peers",
         conv_remote_source)

@@ -44,6 +44,12 @@ KNOWN_EVENTS = frozenset({
     "fetch.error", "fetch.retry", "fetch.failover", "fetch.recompute",
     # liveness (shuffle/heartbeat.py + the health sampler below)
     "heartbeat.loss", "executor.health",
+    # cluster fault recovery (cluster/minicluster.py driver scheduler):
+    # task retry/timeout/stale-epoch re-attempts, executor death and
+    # blacklisting, lineage-scoped partial map-stage recompute, and
+    # speculative-duplicate outcomes
+    "task.attempt", "executor.lost", "executor.blacklisted",
+    "stage.recompute.partial", "speculation.won", "speculation.lost",
     # pipelined executor queue edges (runtime/pipeline.py): a producer or
     # consumer blocked past the stall threshold, bounded per queue
     "pipeline.stall",

@@ -11,13 +11,19 @@ facility without a JNI layer underneath.
 Spec grammar (comma-separated entries)::
 
     entry   := kind ":" site ":" trigger
-    kind    := "oom" | "splitoom" | "transport" | "error"
+    kind    := "oom" | "splitoom" | "transport" | "error" | "exec_kill"
+             | "hang"
     trigger := COUNT | COUNT "@" SKIP | "p" PROB
 
 ``oom`` raises a retryable runtime.retry.DeviceOomError, ``splitoom`` a
 SplitAndRetryOom, ``transport`` a shuffle TransportError, ``error`` a plain
 RuntimeError (a fault NO recovery ladder absorbs — proves clean whole-query
-failure paths). COUNT injects on that many eligible hits; ``@SKIP`` first
+failure paths), ``exec_kill`` SIGKILLs the process serving the
+checkpoint — the MiniCluster executor chaos hook: the process dies mid-task
+with all its shuffle blocks, exercising the driver's lineage-scoped
+recovery (cluster/minicluster.py) — and ``hang`` sleeps forever at the
+site (the wedged-executor simulation that exercises the driver's
+``cluster.task.timeoutSeconds`` deadline). COUNT injects on that many eligible hits; ``@SKIP`` first
 lets SKIP eligible hits pass ("oom:agg.update:1@3" skips three, injects
 once); ``pPROB`` injects each hit with the given probability from the
 seeded RNG (one seed → one deterministic schedule).
@@ -32,7 +38,14 @@ Pipeline queue boundaries (runtime/pipeline.py) check "pipeline.put" /
 "pipeline.get" plus the edge-qualified "pipeline.put.<edge>" /
 "pipeline.get.<edge>" via :func:`maybe_inject_any` — any armed kind fires
 there, proving a worker-thread fault cancels the whole pipeline and
-re-raises at the consumer.
+re-raises at the consumer. MiniCluster executors check "cluster.map" /
+"cluster.result" per produced batch plus the executor-qualified
+"cluster.map.<idx>" / "cluster.result.<idx>" (so one spec can SIGKILL
+exactly one of N executors mid-task), and "cluster.map.begin" /
+"cluster.result.begin" (+ ".<idx>") once at task START — the site that
+still fires when a task's input produces zero batches; the driver disarms
+faults on respawned replacement executors so a COUNT trigger cannot
+re-fire forever.
 """
 
 from __future__ import annotations
@@ -49,9 +62,9 @@ _rng: random.Random | None = None
 _injected: list = []
 _tls = threading.local()
 
-_KINDS = ("oom", "splitoom", "transport", "error")
+_KINDS = ("oom", "splitoom", "transport", "error", "exec_kill", "hang")
 _ENTRY_RE = re.compile(
-    r"^(?P<kind>[a-z]+):(?P<site>[A-Za-z0-9_.\-]+):"
+    r"^(?P<kind>[a-z_]+):(?P<site>[A-Za-z0-9_.\-]+):"
     r"(?:(?P<count>\d+)(?:@(?P<skip>\d+))?|p(?P<prob>0?\.\d+|1(?:\.0*)?))$")
 
 
@@ -175,6 +188,18 @@ def maybe_inject_any(site: str) -> None:
 
 
 def _raise(kind: str, site: str):
+    if kind == "exec_kill":
+        # die the way a real executor crash does: no cleanup, no goodbye on
+        # the driver pipe, shuffle blocks lost with the process
+        import os
+        import signal
+        os.kill(os.getpid(), signal.SIGKILL)
+    if kind == "hang":
+        # wedge, don't die: the process stays alive and unresponsive so
+        # only a task deadline (driver-side kill) can unstick the slot
+        import time
+        while True:
+            time.sleep(3600)
     if kind == "transport":
         from spark_rapids_tpu.shuffle.transport import TransportError
         raise TransportError(f"[fault-injection] transport fault at {site}")

@@ -66,9 +66,23 @@ OOM_SPILL_BYTES = "oomRetrySpillBytes"
 FETCH_RETRIES = "fetchRetries"
 FETCH_FAILOVERS = "fetchFailovers"
 FETCH_RECOMPUTES = "fetchRecomputes"
+# cluster-scheduler recovery (cluster/minicluster.py): task re-attempts,
+# executor deaths and blacklistings, lineage-scoped partial stage
+# recomputes (map tasks re-run counted separately so chaos tests can prove
+# recovery cost was proportional to the loss), and speculation outcomes
+TASK_ATTEMPTS = "taskAttempts"
+EXECUTORS_LOST = "executorsLost"
+EXECUTORS_BLACKLISTED = "executorsBlacklisted"
+STAGE_PARTIAL_RECOMPUTES = "stagePartialRecomputes"
+MAP_TASKS_RECOMPUTED = "mapTasksRecomputed"
+SPECULATION_WON = "speculationWon"
+SPECULATION_LOST = "speculationLost"
 
 RESILIENCE_METRICS = (NUM_OOM_RETRIES, NUM_OOM_SPLIT_RETRIES, OOM_SPILL_BYTES,
-                      FETCH_RETRIES, FETCH_FAILOVERS, FETCH_RECOMPUTES)
+                      FETCH_RETRIES, FETCH_FAILOVERS, FETCH_RECOMPUTES,
+                      TASK_ATTEMPTS, EXECUTORS_LOST, EXECUTORS_BLACKLISTED,
+                      STAGE_PARTIAL_RECOMPUTES, MAP_TASKS_RECOMPUTED,
+                      SPECULATION_WON, SPECULATION_LOST)
 
 
 class GpuMetric:

@@ -28,7 +28,7 @@ import time
 from spark_rapids_tpu.runtime import faults as F
 from spark_rapids_tpu.runtime import metrics as M
 from spark_rapids_tpu.runtime import tracing
-from spark_rapids_tpu.shuffle.transport import TransportError
+from spark_rapids_tpu.shuffle.transport import _NO_KEY, TransportError
 
 
 class ShuffleFetchIterator:
@@ -68,6 +68,15 @@ class ShuffleFetchIterator:
         return d * (0.5 + self._rng.random() / 2)
 
     def __iter__(self):
+        for _, b in self.iter_keyed():
+            yield b
+
+    def iter_keyed(self):
+        """The retry→failover→recompute ladder, yielding (sort_key, batch)
+        via the clients' keyed fetch API: sort_key is the block's
+        (map_split, seq) wire key so a multi-peer union reader can merge
+        several peers' disjoint block sets into one canonical order
+        (recomputed batches carry the sort-last sentinel)."""
         g = M.global_registry()
         for pi, factory in enumerate(self.client_factories):
             for attempt in range(self.max_retries + 1):
@@ -77,11 +86,19 @@ class ShuffleFetchIterator:
                     # ladder in exec/exchange.py ("transport:fetch:N")
                     F.maybe_inject("transport", "fetch")
                     client = factory()
-                    for b in client.fetch_blocks(self.shuffle_id,
-                                                 self.reduce_id):
+                    keyed_fetch = getattr(client, "fetch_blocks_with_keys",
+                                          None)
+                    if keyed_fetch is not None:
+                        stream = keyed_fetch(self.shuffle_id, self.reduce_id)
+                    else:
+                        # duck-typed client without the keyed API: sentinel
+                        # keys keep per-client arrival order
+                        stream = ((_NO_KEY, b) for b in client.fetch_blocks(
+                            self.shuffle_id, self.reduce_id))
+                    for kb in stream:
                         # buffer before yielding: a mid-stream failure must
                         # not emit a partial partition twice
-                        batches.append(b)
+                        batches.append(kb)
                 except TransportError as e:
                     self.errors.append(
                         f"peer {pi} attempt {attempt}: {e}")
@@ -109,4 +126,41 @@ class ShuffleFetchIterator:
         g.metric(M.FETCH_RECOMPUTES).add(1)
         tracing.span_event("fetch.recompute", shuffle=self.shuffle_id,
                            reduce=self.reduce_id)
-        yield from self.recompute()
+        for b in self.recompute():
+            yield _NO_KEY, b
+
+
+def iter_union_blocks(peer_factories: list, shuffle_id: int, reduce_id: int,
+                      max_retries: int = 2, epoch: int | None = None):
+    """Fetch one reduce partition as the UNION of every peer's blocks (the
+    MiniCluster data layout: each mapper parked its buckets locally, so
+    peers hold DISJOINT block sets — failing over between them would lose
+    data, unlike the replica semantics of ShuffleFetchIterator). Each peer
+    gets its own same-peer retry ladder with jittered backoff; a peer that
+    stays unreachable raises TransportError so the driver can classify the
+    loss and run a lineage-scoped recompute. `epoch` tags the retry events
+    with the map-output epoch the fetch was planned under.
+
+    The union is merged into canonical (map_split, seq) key order, NOT
+    concatenated in peer order: after a partial stage recompute a map
+    split's blocks live on a DIFFERENT peer than in a clean run, and
+    order-sensitive consumers (float aggregation, limit) must still see a
+    bit-identical stream. Untagged blocks carry the sort-last sentinel and
+    keep their (peer, arrival) order."""
+    keyed = []
+    for pi, factory in enumerate(peer_factories):
+        it = ShuffleFetchIterator([factory], shuffle_id, reduce_id,
+                                  recompute=None, max_retries=max_retries,
+                                  jitter=random.Random(
+                                      0x7A11 ^ (shuffle_id << 16)
+                                      ^ (reduce_id << 4) ^ pi))
+        try:
+            for key, batch in it.iter_keyed():
+                keyed.append((key, pi, len(keyed), batch))
+        except TransportError as e:
+            raise TransportError(
+                f"peer {pi} unreachable for shuffle {shuffle_id} reduce "
+                f"{reduce_id} (epoch {epoch}): {e}") from e
+    keyed.sort(key=lambda t: (t[0], t[1], t[2]))
+    for _, _, _, batch in keyed:
+        yield batch

@@ -54,6 +54,13 @@ class RapidsShuffleHeartbeatManager:
             p.last_seen = time.monotonic()
             return [q for eid, q in self._peers.items() if eid != executor_id]
 
+    def deregister(self, executor_id: str) -> None:
+        """Forget an executor the driver REPLACED on purpose (MiniCluster
+        respawn): the dead incarnation must not fire a spurious
+        heartbeat-loss expiry after its slot is already healthy again."""
+        with self._lock:
+            self._peers.pop(executor_id, None)
+
     def live_peers(self) -> list:
         now = time.monotonic()
         with self._lock:

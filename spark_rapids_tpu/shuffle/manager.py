@@ -36,8 +36,11 @@ class ShuffleBlockStore:
         # shuffle_id -> reduce_id -> list[SpillableColumnarBatch]
         self._blocks: dict[int, dict[int, list]] = {}
         self._serialized_mode: dict[int, bool] = {}
-        # notified on unregister so transports drop their serialized-frame
-        # caches alongside the device blocks
+        # notified on unregister AND on any block mutation (write into an
+        # existing shuffle, drop_map_output) so transports drop their
+        # serialized-frame caches: after a partial stage recompute adds a
+        # lost split's blocks to a SURVIVING executor, a reducer re-fetch
+        # must not be served the stale pre-recompute frames
         self._unregister_listeners: list = []
 
     def add_unregister_listener(self, cb) -> None:
@@ -90,6 +93,9 @@ class ShuffleBlockStore:
         with self._lock:
             lst = self._blocks[shuffle_id].setdefault(reduce_id, [])
             lst.append((seq, len(lst), blob))
+            listeners = list(self._unregister_listeners)
+        for cb in listeners:
+            cb(shuffle_id)
 
     @staticmethod
     def _ordered(entries):
@@ -98,13 +104,30 @@ class ShuffleBlockStore:
 
     # -- read side (RapidsCachingReader / RapidsShuffleIterator) -------------
     def read_partition(self, shuffle_id: int, reduce_id: int):
+        for _, batch in self.read_partition_with_keys(shuffle_id, reduce_id):
+            yield batch
+
+    def read_partition_with_keys(self, shuffle_id: int, reduce_id: int):
+        """Yield (seq, batch) in the partition's pinned order. The seq key
+        crosses the transport so a reducer can merge blocks from SEVERAL
+        peers into one canonical (map_split, seq) order — after a partial
+        stage recompute moves a map split to a different executor, the
+        reduce-side stream must still be bit-identical to a clean run."""
         with self._lock:
             entries = self._ordered(self._blocks[shuffle_id].get(reduce_id, ()))
-        for _, _, blob in entries:
+        for seq, _, blob in entries:
             if isinstance(blob, bytes):
-                yield ser.deserialize_batch(blob)
+                yield seq, ser.deserialize_batch(blob)
             else:
-                yield blob.get_batch()
+                yield seq, blob.get_batch()
+
+    def partition_keys(self, shuffle_id: int, reduce_id: int) -> list:
+        """Just the ordered seq tags of one partition's blocks (no blob
+        access) — the transport metadata path ships these alongside sizes."""
+        with self._lock:
+            entries = self._ordered(self._blocks[shuffle_id].get(reduce_id,
+                                                                 ()))
+        return [seq for seq, _, _ in entries]
 
     def partition_sizes(self, shuffle_id: int, num_partitions: int) -> list:
         """Bytes per reduce partition — the map-output statistics AQE's
@@ -118,6 +141,36 @@ class ShuffleBlockStore:
                     total += len(b) if isinstance(b, bytes) else b.size
                 out.append(total)
             return out
+
+    def drop_map_output(self, shuffle_id: int, map_split: int) -> int:
+        """Discard every block one map split wrote across all reduce
+        partitions of `shuffle_id` (seq tuples lead with the map split —
+        the MiniCluster writer contract). Used to evict a speculation
+        LOSER's duplicate output so the winning attempt's blocks are the
+        only copy; returns the number of blocks dropped."""
+        dropped = []
+        with self._lock:
+            parts = self._blocks.get(shuffle_id)
+            if parts is None:
+                return 0
+            for rid, entries in parts.items():
+                keep = []
+                for e in entries:
+                    seq = e[0]
+                    if (isinstance(seq, tuple) and seq
+                            and seq[0] == map_split):
+                        dropped.append(e)
+                    else:
+                        keep.append(e)
+                parts[rid] = keep
+            listeners = list(self._unregister_listeners)
+        for _, _, b in dropped:
+            if not isinstance(b, bytes):
+                b.close()
+        if dropped:
+            for cb in listeners:
+                cb(shuffle_id)
+        return len(dropped)
 
     def unregister_shuffle(self, shuffle_id: int):
         with self._lock:

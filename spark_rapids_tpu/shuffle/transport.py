@@ -81,6 +81,19 @@ class BlockMeta:
         self.size = size
 
 
+# blocks written without a seq tag sort after every tagged block (the
+# store's _ordered contract); on the wire that is a sentinel key
+_NO_KEY = (1 << 62, 1 << 62)
+
+
+def _encode_seq(seq) -> tuple:
+    """Normalize a store seq tag to the fixed two-int wire key."""
+    if (isinstance(seq, tuple) and 1 <= len(seq) <= 2
+            and all(isinstance(x, int) and 0 <= x < _NO_KEY[0] for x in seq)):
+        return (seq[0], seq[1] if len(seq) == 2 else 0)
+    return _NO_KEY
+
+
 class RapidsShuffleTransport:
     """Trait: make a server for local blocks + clients for peers
     (reference RapidsShuffleTransport:328)."""
@@ -106,6 +119,14 @@ class ShuffleClient:
         """Yield deserialized ColumnarBatches for one reduce partition."""
         raise NotImplementedError
 
+    def fetch_blocks_with_keys(self, shuffle_id: int, reduce_id: int):
+        """Yield (sort_key, batch): sort_key is the block's (map_split,
+        seq) wire key so a multi-peer reducer can merge the union into one
+        canonical order. Default keeps per-client order with the no-key
+        sentinel (single-peer readers never need the merge)."""
+        for b in self.fetch_blocks(shuffle_id, reduce_id):
+            yield _NO_KEY, b
+
 
 # ---------------------------------------------------------------------------
 # Local (loopback) transport — reference's short-circuit RapidsCachingReader
@@ -121,6 +142,11 @@ class LocalTransport(RapidsShuffleTransport):
         class _Local(ShuffleClient):
             def fetch_blocks(self, shuffle_id, reduce_id):
                 yield from store.read_partition(shuffle_id, reduce_id)
+
+            def fetch_blocks_with_keys(self, shuffle_id, reduce_id):
+                for seq, b in store.read_partition_with_keys(shuffle_id,
+                                                             reduce_id):
+                    yield _encode_seq(seq), b
         return _Local()
 
 
@@ -158,14 +184,20 @@ class _ServerHandler(socketserver.BaseRequestHandler):
         shuffle_id, reduce_id = struct.unpack("<II", payload)
         try:
             blobs = self._blocks(server, shuffle_id, reduce_id)
+            keys = server.block_keys(shuffle_id, reduce_id)
         except KeyError:
             _send_frame(sock, MSG_ERROR,
                         f"unknown shuffle {shuffle_id}".encode())
             return
+        # per block: size + the store's (map_split, seq) key, so a reducer
+        # merging several peers can reconstruct one canonical block order
+        if len(keys) != len(blobs):       # raced a concurrent write: re-read
+            keys = (keys + [None] * len(blobs))[:len(blobs)]
         out = io.BytesIO()
         out.write(struct.pack("<I", len(blobs)))
-        for b in blobs:
-            out.write(struct.pack("<Q", len(b)))
+        for b, k in zip(blobs, keys):
+            k0, k1 = _encode_seq(k)
+            out.write(struct.pack("<QQQ", len(b), k0, k1))
         _send_frame(sock, MSG_METADATA_RESP, out.getvalue())
 
     def _transfer(self, server, sock, payload):
@@ -212,13 +244,26 @@ class TcpShuffleServer:
         key = (shuffle_id, reduce_id)
         with self._cache_lock:
             if key in self._frame_cache:
-                return self._frame_cache[key]
-        frames = [ser.serialize_batch(b)
-                  for b in self.store.read_partition(shuffle_id, reduce_id)]
+                return self._frame_cache[key][0]
+        keys, frames = [], []
+        for seq, b in self.store.read_partition_with_keys(shuffle_id,
+                                                          reduce_id):
+            keys.append(seq)
+            frames.append(ser.serialize_batch(b))
         frames = self.compressor.compress_all(frames)
         with self._cache_lock:
-            self._frame_cache[key] = frames
+            self._frame_cache[key] = (frames, keys)
         return frames
+
+    def block_keys(self, shuffle_id: int, reduce_id: int) -> list:
+        """Ordered seq tags matching serialized_blocks' frame order (served
+        from the same cache; falls back to the store for patched/uncached
+        paths)."""
+        key = (shuffle_id, reduce_id)
+        with self._cache_lock:
+            if key in self._frame_cache:
+                return self._frame_cache[key][1]
+        return self.store.partition_keys(shuffle_id, reduce_id)
 
     def invalidate(self, shuffle_id: int):
         with self._cache_lock:
@@ -246,7 +291,17 @@ class TcpShuffleClient(ShuffleClient):
         for blob in self.fetch_serialized(shuffle_id, reduce_id):
             yield ser.deserialize_batch(TableCompressionCodec.decode(blob))
 
+    def fetch_blocks_with_keys(self, shuffle_id, reduce_id):
+        for key, blob in self.fetch_serialized_with_keys(shuffle_id,
+                                                         reduce_id):
+            yield key, ser.deserialize_batch(
+                TableCompressionCodec.decode(blob))
+
     def fetch_serialized(self, shuffle_id, reduce_id):
+        for _, blob in self.fetch_serialized_with_keys(shuffle_id, reduce_id):
+            yield blob
+
+    def fetch_serialized_with_keys(self, shuffle_id, reduce_id):
         # every socket failure — refused connect, reset/broken pipe mid-
         # stream, timeout — must surface as TransportError: the exchange's
         # recompute ladder (and the reference's TransferError→
@@ -269,9 +324,9 @@ class TcpShuffleClient(ShuffleClient):
             if msg_type == MSG_ERROR:
                 raise TransportError(payload.decode())
             (n_blocks,) = struct.unpack_from("<I", payload, 0)
-            sizes = [struct.unpack_from("<Q", payload, 4 + 8 * i)[0]
+            metas = [struct.unpack_from("<QQQ", payload, 4 + 24 * i)
                      for i in range(n_blocks)]
-            for index, size in enumerate(sizes):
+            for index, (size, k0, k1) in enumerate(metas):
                 with self.throttle.acquire(size):
                     _send_frame(sock, MSG_TRANSFER_REQ,
                                 struct.pack("<IIIQ", shuffle_id, reduce_id,
@@ -289,7 +344,7 @@ class TcpShuffleClient(ShuffleClient):
                     if len(buf) != size:
                         raise TransportError(
                             f"short block: got {len(buf)} want {size}")
-                    yield bytes(buf)
+                    yield (k0, k1), bytes(buf)
         finally:
             sock.close()
 

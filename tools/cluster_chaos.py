@@ -1,0 +1,95 @@
+"""cluster_chaos — CI gate for the MiniCluster's fault-recovery ladder.
+
+Runs one TPC-H query twice on a 3-executor MiniCluster — clean, then with
+an injected executor SIGKILL (`exec_kill` fault, runtime/faults.py) — and
+asserts the recovery contract end to end:
+
+  - the killed run's result is bit-identical to the clean run's;
+  - recovery was lineage-scoped: strictly fewer map tasks recomputed than
+    the clean run executed (losing 1 of N executors costs ~1/N of a
+    stage), and the whole-query `_heal()` fallback never fired;
+  - the ladder is visible in the structured event log (`executor.lost`,
+    `stage.recompute.partial`).
+
+Must be a real script file, not a `python -` heredoc: the spawn-based
+executor bootstrap re-imports __main__, and stdin cannot be re-imported.
+
+Usage:
+  python tools/cluster_chaos.py --data-dir /tmp/tpch_sf0.01 \
+      [--eventlog-dir DIR] [--query q18] [--scale 0.01] [--executors 3] \
+      [--fault exec_kill:cluster.result:1]
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="cluster_chaos.py", description=__doc__)
+    p.add_argument("--data-dir", required=True)
+    p.add_argument("--eventlog-dir", default=None)
+    p.add_argument("--query", default="q18")
+    p.add_argument("--scale", type=float, default=0.01)
+    p.add_argument("--executors", type=int, default=3)
+    # default: SIGKILL executor 0 as it STARTS its result task — every map
+    # stage's outputs exist by then, so recovery must rebuild exactly the
+    # dead peer's splits; the task-start site fires even for a query whose
+    # final stage emits zero batches (q18 at sf0.01 returns 0 rows)
+    p.add_argument("--fault", default="exec_kill:cluster.result.begin.0:1")
+    args = p.parse_args(argv)
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import spark_rapids_tpu  # noqa: F401  (enables x64)
+    from spark_rapids_tpu.benchmarks import tpch
+    from spark_rapids_tpu.cluster import MiniCluster
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.runtime import eventlog
+    from spark_rapids_tpu.runtime import metrics as M
+    from spark_rapids_tpu.session import TpuSession
+
+    paths = tpch.generate(args.scale, args.data_dir)
+    settings = {}
+    if args.eventlog_dir:
+        settings["spark.rapids.tpu.eventLog.dir"] = args.eventlog_dir
+    spark = TpuSession(settings)
+    dfs = tpch.load(spark, paths, files_per_partition=4)
+    df = tpch.QUERIES[args.query](dfs)
+
+    with MiniCluster(n_executors=args.executors, platform="cpu") as c:
+        clean = c.collect(df)
+        clean_map_tasks = sum(1 for op, _ in c.task_log if op == "map")
+
+    base = M.resilience_snapshot()
+    conf = RapidsConf(dict(settings,
+                           **{"spark.rapids.tpu.test.faults": args.fault}))
+    with MiniCluster(n_executors=args.executors, conf=conf,
+                     platform="cpu") as c:
+        heals = []
+        orig = c._heal
+        c._heal = lambda: (heals.append(1), orig())[-1]
+        chaos = c.collect(df)
+    delta = {k: v - base[k]
+             for k, v in M.resilience_snapshot().items() if v - base[k]}
+    eventlog.shutdown()
+
+    assert chaos.equals(clean), \
+        f"killed-executor {args.query} is not bit-identical to the clean run"
+    assert not heals, \
+        f"whole-query heal fired; partial recovery expected ({delta})"
+    assert delta.get("executorsLost", 0) >= 1, delta
+    assert delta.get("stagePartialRecomputes", 0) >= 1, delta
+    assert 1 <= delta.get("mapTasksRecomputed", 0) < clean_map_tasks, \
+        (delta, clean_map_tasks)
+    print(f"cluster chaos ok [{args.query}, {args.executors} executors, "
+          f"fault {args.fault}]: {delta} "
+          f"(clean run map tasks: {clean_map_tasks})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

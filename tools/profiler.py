@@ -223,10 +223,40 @@ def analyze(records: list) -> dict:
             "batches": sum(1 for e in evs if e["event"] == "batch"),
         })
 
+    # cluster recovery ladder (cluster/minicluster.py driver scheduler):
+    # aggregated across the whole log, not per query — an executor death is
+    # cluster state, and recovery events may land outside a query scope
+    # (heartbeat polls between queries)
+    attempts: dict = {}
+    for r in records:
+        if r["event"] == "task.attempt":
+            reason = r.get("reason", "unknown")
+            attempts[reason] = attempts.get(reason, 0) + 1
+    recomputes = [{
+        "shuffle": r.get("shuffle"), "epoch": r.get("epoch"),
+        "splits": r.get("splits"), "total_splits": r.get("total_splits"),
+    } for r in records if r["event"] == "stage.recompute.partial"]
+    recovery = {
+        "task_attempts": attempts,
+        "executors_lost": sum(1 for r in records
+                              if r["event"] == "executor.lost"),
+        "lost_reasons": sorted({r.get("reason", "") for r in records
+                                if r["event"] == "executor.lost"}),
+        "executors_blacklisted": sum(
+            1 for r in records if r["event"] == "executor.blacklisted"),
+        "partial_recomputes": recomputes,
+        "map_tasks_recomputed": sum(r["splits"] or 0 for r in recomputes),
+        "speculation_won": sum(1 for r in records
+                               if r["event"] == "speculation.won"),
+        "speculation_lost": sum(1 for r in records
+                                if r["event"] == "speculation.lost"),
+    }
+
     health = [r for r in records if r["event"] == "executor.health"]
     hb_loss = [r for r in records if r["event"] == "heartbeat.loss"]
     return {
         "queries": queries,
+        "recovery": recovery,
         "events_total": len(records),
         "health_samples": len(health),
         "heartbeat_losses": len(hb_loss),
@@ -300,6 +330,27 @@ def render(analysis: dict, top: int = 15) -> str:
                        if e["stall_events"] else ""))
         if any(q["resilience"].values()):
             out.append(f"  resilience deltas: {q['resilience']}")
+        out.append("")
+    rec = analysis.get("recovery") or {}
+    if (rec.get("executors_lost") or rec.get("task_attempts")
+            or rec.get("speculation_won") or rec.get("speculation_lost")):
+        out.append("== recovery (task attempt -> partial stage recompute -> "
+                   "whole-query heal):")
+        if rec["task_attempts"]:
+            kv = ", ".join(f"{k}={v}"
+                           for k, v in sorted(rec["task_attempts"].items()))
+            out.append(f"  task attempts by reason: {kv}")
+        if rec["executors_lost"]:
+            out.append(f"  executors lost: {rec['executors_lost']} "
+                       f"(reasons: {', '.join(rec['lost_reasons'])}); "
+                       f"blacklisted: {rec['executors_blacklisted']}")
+        for pr in rec["partial_recomputes"]:
+            out.append(f"  partial recompute shuffle={pr['shuffle']} "
+                       f"epoch={pr['epoch']}: {pr['splits']}/"
+                       f"{pr['total_splits']} map splits re-run")
+        if rec["speculation_won"] or rec["speculation_lost"]:
+            out.append(f"  speculation: won={rec['speculation_won']} "
+                       f"lost={rec['speculation_lost']}")
         out.append("")
     out.append(f"{len(analysis['queries'])} queries, "
                f"{analysis['events_total']} events, "
