@@ -139,6 +139,60 @@ grep -q "recovery (task attempt" /tmp/chaos_profile.txt
 grep -q "partial recompute shuffle=" /tmp/chaos_profile.txt
 rm -rf "$chaos_dir"
 
+echo "== multi-tenant: concurrent chaos (cancel + OOM + shed isolation) =="
+# 4 concurrent TPC-H queries: one killed by its deadline, one recovering
+# injected join-build OOMs, two survivors bit-identical to solo runs with
+# EVERY query-scoped resilience counter zero; a 5th submission sheds with a
+# pickle-round-tripped backoff hint; nothing leaks (threads/buffers/permits)
+mt_dir=$(mktemp -d)
+JAX_PLATFORMS=cpu python tools/concurrent_chaos.py \
+  --data-dir /tmp/tpch_ci_sf0.01 --eventlog-dir "$mt_dir"
+mt_log=$(ls "$mt_dir"/*.jsonl | head -1)
+python - "$mt_log" <<'PYEOF'
+import json, sys
+events = [json.loads(ln)["event"] for ln in open(sys.argv[1]) if ln.strip()]
+# all four lifecycle outcomes visible in one log: admitted queries, the
+# deadline kill, the shed submission (after queueing), and the OOM recovery
+for want in ("query.admitted", "query.deadline", "query.queued",
+             "query.shed", "oom.retry", "query.end"):
+    assert want in events, (want, sorted(set(events)))
+print("multi-tenant event log ok:",
+      events.count("query.admitted"), "admitted,",
+      events.count("query.deadline"), "deadline,",
+      events.count("query.shed"), "shed,",
+      events.count("oom.retry"), "oom.retry")
+PYEOF
+# the profiler renders the admission/lifecycle table from the same log
+python tools/profiler.py report "$mt_log" > /tmp/mt_profile.txt || true
+grep -q "admission / lifecycle" /tmp/mt_profile.txt
+grep -q "deadline q" /tmp/mt_profile.txt
+grep -q "shed " /tmp/mt_profile.txt
+rm -rf "$mt_dir"
+# scheduler + lifecycle unit/integration suite (cancellation leak checks,
+# admission, shed round-trip, CRC corruption ladders, eventlog rotation)
+JAX_PLATFORMS=cpu python -m pytest tests/test_scheduler.py -q
+
+echo "== multi-tenant: concurrent aggregate-throughput gate =="
+# 4 concurrent q18s through the admission scheduler must beat 4 sequential
+# runs by >=1.2x aggregate on >=2 cores (overlap of scan decode, device
+# compute and exchange I/O ACROSS queries); the 1-core box auto-skips with
+# the reason logged. Isolation is asserted unconditionally: bit-identical
+# rows, distinct query ids, zero scoped resilience counters
+conc_line=$(JAX_PLATFORMS=cpu TPCH_SF=0.01 TPCH_DIR=/tmp/tpch_ci_sf0.01 \
+  python bench.py --concurrent 4 | tail -1)
+python -c '
+import json, sys
+d = json.loads(sys.argv[1])
+assert d["isolation_ok"], d
+if "gate_skipped" in d:
+    print("concurrent throughput gate SKIPPED:", d["gate_skipped"],
+          "(measured", d["throughput_x"], "x)")
+else:
+    assert d["throughput_x"] >= 1.2, d
+    print("concurrent throughput gate ok:", d["throughput_x"], "x on",
+          d["cores"], "cores")
+' "$conc_line"
+
 echo "== observability: event log overhead + profiler gate =="
 # run the q18 ladder query with the event log disabled then enabled: the log
 # must add <5% wall time, and tools/profiler.py must replay it into a report

@@ -501,8 +501,7 @@ class MiniCluster:
         epoch moved underneath them."""
         from spark_rapids_tpu.runtime import metrics as M
         from spark_rapids_tpu.runtime import tracing
-        g = M.global_registry()
-        g.metric(M.EXECUTORS_LOST).add(1)
+        M.resilience_add(M.EXECUTORS_LOST)
         tracing.span_event("executor.lost", executor=ei,
                            generation=self._gen[ei], reason=reason)
         run = running.pop(ei, None)
@@ -534,8 +533,8 @@ class MiniCluster:
                     f"cluster.stage.maxRecomputes="
                     f"{self._stage_max_recomputes}; healing the pool")
         for st, splits in lost:
-            g.metric(M.STAGE_PARTIAL_RECOMPUTES).add(1)
-            g.metric(M.MAP_TASKS_RECOMPUTED).add(len(splits))
+            M.resilience_add(M.STAGE_PARTIAL_RECOMPUTES)
+            M.resilience_add(M.MAP_TASKS_RECOMPUTED, len(splits))
             tracing.span_event("stage.recompute.partial",
                                shuffle=st.shuffle_id, epoch=st.epoch,
                                splits=len(splits),
@@ -609,10 +608,9 @@ class MiniCluster:
                         err: str = ""):
         from spark_rapids_tpu.runtime import metrics as M
         from spark_rapids_tpu.runtime import tracing
-        g = M.global_registry()
         spec.attempts += 1
         spec.tried.add(ei)
-        g.metric(M.TASK_ATTEMPTS).add(1)
+        M.resilience_add(M.TASK_ATTEMPTS)
         tracing.span_event("task.attempt", executor=ei, op=spec.op,
                            split=spec.split, shuffle=spec.shuffle_id,
                            attempt=spec.attempts, reason=reason,
@@ -621,7 +619,7 @@ class MiniCluster:
         if (ei not in self._blacklist
                 and self._exec_failures[ei] >= self._blacklist_max):
             self._blacklist.add(ei)
-            g.metric(M.EXECUTORS_BLACKLISTED).add(1)
+            M.resilience_add(M.EXECUTORS_BLACKLISTED)
             tracing.span_event("executor.blacklisted", executor=ei,
                                failures=self._exec_failures[ei])
 
@@ -636,7 +634,6 @@ class MiniCluster:
 
         from spark_rapids_tpu.runtime import metrics as M
         from spark_rapids_tpu.runtime import tracing
-        g = M.global_registry()
         if depth > 8:
             raise ExecutorLostError("recovery recursion exhausted")
         pending = collections.deque(specs)
@@ -711,7 +708,7 @@ class MiniCluster:
             if spec.idx in done:
                 # a duplicate (speculation) or re-run lost the race: the
                 # winner's blocks are the only copy allowed to survive
-                g.metric(M.SPECULATION_LOST).add(1)
+                M.resilience_add(M.SPECULATION_LOST)
                 tracing.span_event("speculation.lost", executor=ei,
                                    op=spec.op, split=spec.split,
                                    shuffle=spec.shuffle_id)
@@ -723,7 +720,7 @@ class MiniCluster:
                 # computed against metadata that moved underneath it (a
                 # peer died and its splits were rebuilt mid-flight): the
                 # reply may have read a half-rebuilt partition — discard
-                g.metric(M.TASK_ATTEMPTS).add(1)
+                M.resilience_add(M.TASK_ATTEMPTS)
                 tracing.span_event("task.attempt", executor=ei, op=spec.op,
                                    split=spec.split, shuffle=spec.shuffle_id,
                                    attempt=spec.attempts + 1,
@@ -739,7 +736,7 @@ class MiniCluster:
                 self._tracker.register_map_output(spec.shuffle_id,
                                                   spec.split, ei)
             if run.speculative:
-                g.metric(M.SPECULATION_WON).add(1)
+                M.resilience_add(M.SPECULATION_WON)
                 tracing.span_event("speculation.won", executor=ei,
                                    op=spec.op, split=spec.split,
                                    shuffle=spec.shuffle_id)

@@ -187,16 +187,19 @@ RETRY_SPLIT_FLOOR_BYTES = conf(
 
 TEST_FAULTS = conf("spark.rapids.tpu.test.faults").doc(
     "Deterministic fault-injection spec 'kind:site:trigger,...' — kinds "
-    "oom / splitoom / transport / error; trigger COUNT, COUNT@SKIP or "
+    "oom / splitoom / transport / error / exec_kill / hang / cancel / "
+    "slow / corrupt; trigger COUNT, COUNT@SKIP or "
     "pPROB; e.g. 'oom:joins.build:2,transport:fetch:1,"
-    "error:pipeline.put.scan.decode:1' (grammar + site list in "
+    "cancel:pipeline.put.scan.decode:1' (grammar + site list in "
     "runtime/faults.py; pipeline.put/get sites fire whatever kind is "
     "armed). Chaos testing only — never set in production; "
     "empty disables").string_conf(None)
 
 TEST_FAULTS_SEED = conf("spark.rapids.tpu.test.faults.seed").doc(
-    "Seed for probabilistic (pPROB) fault triggers: one seed yields one "
-    "deterministic injection schedule").integer_conf(0)
+    "Seed for probabilistic (pPROB) fault triggers; each (kind, site) "
+    "entry draws from its own stream seeded by (seed, kind, site), so one "
+    "seed yields one deterministic schedule per site even under the "
+    "pipeline's worker-thread interleavings").integer_conf(0)
 
 UNSPILL_ENABLED = conf("spark.rapids.tpu.memory.hbm.unspill.enabled").doc(
     "Re-promote spilled buffers back to HBM on access "
@@ -506,6 +509,54 @@ CLUSTER_HEARTBEAT_TIMEOUT = conf(
     "recompute); beats are recorded on every task reply and liveness scan"
 ).double_conf(60.0)
 
+SCHEDULER_MAX_CONCURRENT = conf("spark.rapids.tpu.scheduler.maxConcurrent").doc(
+    "Queries the driver-side scheduler admits concurrently "
+    "(runtime/scheduler.py; the Spark fair-scheduler pool-size analog). "
+    "Structural: process-global, applied only by a session that sets it "
+    "explicitly").integer_conf(4)
+
+SCHEDULER_QUEUE_MAX_DEPTH = conf("spark.rapids.tpu.scheduler.queue.maxDepth").doc(
+    "Submissions allowed to wait for admission; one more is SHED immediately "
+    "with a retryable QueryRejectedError carrying a backoff hint (load "
+    "shedding at the front door instead of OOM cascades). 0 disables the "
+    "depth bound").integer_conf(32)
+
+SCHEDULER_QUEUE_TIMEOUT = conf("spark.rapids.tpu.scheduler.queue.timeoutSeconds").doc(
+    "A submission still queued for admission after this long is shed with a "
+    "retryable QueryRejectedError (backoff hint included); <=0 waits "
+    "forever").double_conf(30.0)
+
+SCHEDULER_PRIORITY = conf("spark.rapids.tpu.scheduler.priority").doc(
+    "Admission priority of THIS session's queries (higher admits first; the "
+    "Spark fair-scheduler pool-weight analog). Read per submission, so "
+    "sessions with different priorities share one scheduler").integer_conf(0)
+
+SCHEDULER_PRIORITY_AGING = conf(
+    "spark.rapids.tpu.scheduler.priority.agingSeconds").doc(
+    "Queue-wait seconds that add +1 effective priority to a waiting "
+    "submission, so low-priority tenants cannot be starved by a stream of "
+    "high-priority arrivals; <=0 disables aging").double_conf(10.0)
+
+SCHEDULER_QUERY_DEADLINE = conf(
+    "spark.rapids.tpu.scheduler.query.deadlineSeconds").doc(
+    "Per-query wall-clock deadline measured from submission (queue wait "
+    "included); past it the query's CancelToken flips and every cooperative "
+    "checkpoint raises QueryDeadlineError, draining the pipeline without "
+    "leaking threads, device buffers or semaphore permits. <=0 disables"
+).double_conf(0.0)
+
+SHUFFLE_CHECKSUM = conf("spark.rapids.tpu.shuffle.checksum.enabled").doc(
+    "Stamp every serialized shuffle block with a CRC32C checksum in the "
+    "transport metadata and verify on fetch; a mismatch is a fetch failure "
+    "routed through the existing retry/failover/recompute ladder (Spark "
+    "shuffle checksums, SPARK-35275 analog)").boolean_conf(True)
+
+SPILL_CHECKSUM = conf("spark.rapids.tpu.memory.spill.checksum.enabled").doc(
+    "Stamp disk-tier spill payloads with a CRC32C checksum and verify on "
+    "unspill; a mismatch raises SpillCorruptionError, which shuffle readers "
+    "treat as a fetch failure (map-stage recompute) instead of decoding "
+    "silently corrupt rows").boolean_conf(True)
+
 EVENT_LOG_DIR = conf("spark.rapids.tpu.eventLog.dir").doc(
     "Directory for the structured JSONL event log (query/stage/batch "
     "lifecycle, spill, OOM-retry/split, fetch retry/failover/recompute, "
@@ -519,6 +570,16 @@ EVENT_LOG_HEALTH_INTERVAL = conf(
     "spill-catalog tier occupancy) written to the event log by the "
     "heartbeat/sampler thread; <=0 disables sampling. Only meaningful when "
     "eventLog.dir is set").double_conf(5.0)
+
+EVENT_LOG_MAX_BYTES = conf("spark.rapids.tpu.eventLog.maxBytes").doc(
+    "Size at which the event-log JSONL file rotates (events-*.jsonl -> "
+    ".1 -> .2 ... keepFiles retained), so a long-lived serving session "
+    "cannot grow one file without bound; 0 disables rotation").bytes_conf(0)
+
+EVENT_LOG_KEEP_FILES = conf("spark.rapids.tpu.eventLog.keepFiles").doc(
+    "Rotated event-log files retained per active file (the keep-N of the "
+    "size-based rotation; older rotations are deleted). Only meaningful "
+    "when eventLog.maxBytes > 0").integer_conf(4)
 
 PROFILE_DIR = conf("spark.rapids.tpu.profile.dir").doc(
     "Directory for a whole-session XProf/Perfetto capture "

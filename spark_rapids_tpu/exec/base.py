@@ -132,8 +132,14 @@ class TpuExec:
         per-batch host sync here would serialize every operator on the
         accelerator round-trip."""
         from spark_rapids_tpu.runtime import eventlog as EL
+        from spark_rapids_tpu.runtime.scheduler import check_cancel
         it = iter(it)
         while True:
+            # cooperative cancellation checkpoint on EVERY operator's batch
+            # pull (runtime/scheduler.py): session.cancel()/deadline expiry
+            # drains the whole operator chain one batch later, no matter
+            # which segment a thread is computing in
+            check_cancel()
             with M.node_frame(self._node_id, self._self_time):
                 try:
                     b = next(it)

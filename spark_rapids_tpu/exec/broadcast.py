@@ -116,15 +116,25 @@ class BroadcastExchangeExec(TpuExec):
 
                 self._future = _spawn_build(build)
             fut = self._future
-        try:
-            # metric=None frame: the build thread charges itself; the
-            # consumer's blocked wait must not double-count in its own frame
-            with M.node_frame(self._node_id, None):
-                return fut.result(timeout=self._timeout)
-        except concurrent.futures.TimeoutError:
-            raise BroadcastTimeout(
-                f"broadcast of {self.child.args_string()!s} did not finish "
-                f"within {self._timeout}s") from None
+        from spark_rapids_tpu.runtime.scheduler import check_cancel
+        import time as _time
+        deadline = (_time.monotonic() + self._timeout
+                    if self._timeout is not None else None)
+        # metric=None frame: the build thread charges itself; the
+        # consumer's blocked wait must not double-count in its own frame.
+        # The wait polls so a cancelled/deadlined query drains instead of
+        # camping on a peer-started build for broadcastTimeout seconds
+        with M.node_frame(self._node_id, None):
+            while True:
+                check_cancel()
+                try:
+                    return fut.result(timeout=0.05)
+                except concurrent.futures.TimeoutError:
+                    if (deadline is not None
+                            and _time.monotonic() >= deadline):
+                        raise BroadcastTimeout(
+                            f"broadcast of {self.child.args_string()!s} did "
+                            f"not finish within {self._timeout}s") from None
 
     def release(self) -> None:
         """Close the relation (called by the last consumer). If the build is
@@ -140,6 +150,15 @@ class BroadcastExchangeExec(TpuExec):
                 f.result().close()
 
         fut.add_done_callback(close_result)
+
+    def abort_query(self):
+        """Query-death cleanup (session._run_action's exec sweep): the
+        shared-broadcast reader countdown only counts readers whose
+        generators STARTED — a cancelled query can abandon a stream
+        partition's iterator unstarted, leaving the countdown short and the
+        relation orphaned in HBM. release() is idempotent, so the sweep and
+        a late last-reader countdown cannot double-close."""
+        self.release()
 
     def execute_partition(self, split: int):
         # host-bridge / reuse path (GpuBroadcastToCpuExec analog): stream the

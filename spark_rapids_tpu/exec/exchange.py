@@ -171,6 +171,11 @@ class ShuffleExchangeExec(TpuExec):
     def _raise_if_failed(self):
         err = getattr(self, "_map_error", None)
         if err is not None:
+            from spark_rapids_tpu.runtime.scheduler import QueryCancelledError
+            if isinstance(err, QueryCancelledError):
+                # keep the typed cancellation visible at the session so the
+                # lifecycle classifies as cancelled/deadline, not query.error
+                raise err
             raise RuntimeError("shuffle map stage failed") from err
 
     def _invalidate_map_stage(self, observed):
@@ -211,11 +216,18 @@ class ShuffleExchangeExec(TpuExec):
         KeyError counts as a fetch failure: a concurrent reader's
         invalidation can yank the shuffle between ensure and read, and
         BufferClosedError the same way when the invalidation lands after
-        this reader snapshotted the block list."""
+        this reader snapshotted the block list. SpillCorruptionError too:
+        a shuffle block whose disk-tier spill payload failed its CRC is a
+        lost block — recompute the map outputs rather than decode corrupt
+        rows (the Spark shuffle-checksum → FetchFailed contract)."""
         from spark_rapids_tpu.shuffle.transport import TransportError
+        from spark_rapids_tpu.runtime import scheduler as SCHED
         store = ShuffleBlockStore.get()
         retries = self.conf.get(C.SHUFFLE_FETCH_MAX_RETRIES)
         for attempt in range(retries + 1):
+            # cancellation wins over the stage-retry ladder: a cancelled
+            # query must not pay for a map-stage recompute first
+            SCHED.check_cancel()
             emitted = False
             # pin the generation this attempt reads: on failure only THIS id
             # may be invalidated (a concurrent reader's recompute may already
@@ -230,18 +242,39 @@ class ShuffleExchangeExec(TpuExec):
                     emitted = True
                     yield b
                 return
-            except (TransportError, KeyError, mem.BufferClosedError) as e:
+            except (TransportError, KeyError, mem.BufferClosedError,
+                    mem.SpillCorruptionError) as e:
                 if emitted or attempt == retries:
                     raise TransportError(
                         f"reduce {split} fetch failed"
                         f"{' after partial read' if emitted else ''}: {e}"
                     ) from e
-                M.global_registry().metric(M.FETCH_RECOMPUTES).add(1)
+                M.resilience_add(M.FETCH_RECOMPUTES)
                 tracing.span_event("fetch.recompute", split=split,
                                    error=str(e)[:120])
                 self._invalidate_map_stage(sid)
                 with M.node_frame(self._node_id, None):
                     self._ensure_map_stage()
+
+    def abort_query(self):
+        """Query-death cleanup (called by session._run_action on cancel/
+        error): when reduce partitions were never all consumed, the
+        read-completion countdown can never free the shuffle blocks — a
+        cancelled query's unvisited splits have no reader to account them.
+        Unregister whatever map outputs are live so the query leaks no
+        device buffers. Racing readers (worker threads still draining)
+        observe BufferClosedError/KeyError, whose recompute ladder checks
+        the cancel token first and drains instead of rebuilding."""
+        with self._reads_lock:
+            if self._reads_left <= 0:
+                return                  # normal completion already freed them
+        store = ShuffleBlockStore.get()
+        with self._map_lock:
+            for sid in (self._shuffle_id, self._pending_shuffle_id):
+                if sid is not None:
+                    store.unregister_shuffle(sid)
+            self._shuffle_id = None
+            self._pending_shuffle_id = None
 
     def account_read_done(self):
         """One reduce partition finished (drained OR abandoned unopened);

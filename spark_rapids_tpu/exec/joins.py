@@ -778,6 +778,30 @@ class _SharedBroadcast:
     def close(self) -> None:
         self.exchange.release()
 
+    def reader(self):
+        """Per-reader idempotent countdown handle: `finish_once()` counts
+        this reader down at most once, True for the last reader overall.
+        Consumers call it on the NORMAL path (to emit full-outer unmatched
+        rows before closing) AND from a finally (so a stream partition
+        abandoned mid-iteration — downstream limit, error, cooperative
+        cancellation draining the pipeline — still releases the broadcast
+        relation instead of leaking it in HBM)."""
+        shared = self
+
+        class _Reader:
+            __slots__ = ("_counted",)
+
+            def __init__(self):
+                self._counted = False
+
+            def finish_once(self) -> bool:
+                if self._counted:
+                    return False
+                self._counted = True
+                return shared.finish()
+
+        return _Reader()
+
 
 class BroadcastHashJoinExec(HashJoinExec):
     """Build side is broadcast (materialized once, shared across stream partitions)
@@ -793,24 +817,32 @@ class BroadcastHashJoinExec(HashJoinExec):
 
     def execute_partition(self, split):
         def it():
-            stream_child = self.children[0] if self.stream_is_left else self.children[1]
-            with trace_range("BroadcastHashJoin.build", self._build_time):
-                sb = self._shared.get()
-            bk = self.left_keys if not self.stream_is_left else self.right_keys
-            sk = self.right_keys if not self.stream_is_left else self.left_keys
-            core = _JoinCore(sb.get_batch(), bk, sk, self.join_type,
-                             stream_prefilter=self.stream_prefilter)
-            out_schema = self.output
-            yield from self._probe_stream(core, sb, stream_child, split,
-                                          out_schema)
-            if core.build_matched_acc is not None:
-                self._shared.merge_matched(core.build_matched_acc)
-            if self._shared.finish():
-                if self.join_type == J.FULL_OUTER:
-                    core.build_matched_acc = self._shared.matched_acc
-                    yield from self._emit_unmatched_build(core, sb.get_batch(),
-                                                          out_schema)
-                self._shared.close()
+            reader = self._shared.reader()
+            try:
+                stream_child = self.children[0] if self.stream_is_left else self.children[1]
+                with trace_range("BroadcastHashJoin.build", self._build_time):
+                    sb = self._shared.get()
+                bk = self.left_keys if not self.stream_is_left else self.right_keys
+                sk = self.right_keys if not self.stream_is_left else self.left_keys
+                core = _JoinCore(sb.get_batch(), bk, sk, self.join_type,
+                                 stream_prefilter=self.stream_prefilter)
+                out_schema = self.output
+                yield from self._probe_stream(core, sb, stream_child, split,
+                                              out_schema)
+                if core.build_matched_acc is not None:
+                    self._shared.merge_matched(core.build_matched_acc)
+                if reader.finish_once():
+                    if self.join_type == J.FULL_OUTER:
+                        core.build_matched_acc = self._shared.matched_acc
+                        yield from self._emit_unmatched_build(
+                            core, sb.get_batch(), out_schema)
+                    self._shared.close()
+            finally:
+                # abandoned mid-stream (limit / error / cancellation): still
+                # count this reader down so the LAST one out releases the
+                # broadcast relation instead of leaking it in HBM
+                if reader.finish_once():
+                    self._shared.close()
         return self.wrap_output(it())
 
 
@@ -857,25 +889,32 @@ class NestedLoopJoinExec(TpuExec):
 
     def execute_partition(self, split):
         def it():
-            sb = self._shared.get()
-            build = sb.get_batch()
-            n_build = build.num_rows
-            out_schema = self.output
-            pair_schema = self._pair_schema()
-            right_matched_acc = (np.zeros(build.capacity, dtype=bool)
-                                 if self.join_type == J.FULL_OUTER else None)
-            for lb in self.children[0].execute_partition(split):
-                acquire_semaphore(self.metrics)
-                with trace_range("NestedLoopJoin", self._join_time):
-                    yield from self._join_batch(lb, build, n_build, out_schema,
-                                                pair_schema, right_matched_acc)
-            if right_matched_acc is not None:
-                self._shared.merge_matched(right_matched_acc)
-            if self._shared.finish():
-                if self.join_type == J.FULL_OUTER:
-                    yield from self._unmatched_right(
-                        build, n_build, self._shared.matched_acc, out_schema)
-                self._shared.close()
+            reader = self._shared.reader()
+            try:
+                sb = self._shared.get()
+                build = sb.get_batch()
+                n_build = build.num_rows
+                out_schema = self.output
+                pair_schema = self._pair_schema()
+                right_matched_acc = (np.zeros(build.capacity, dtype=bool)
+                                     if self.join_type == J.FULL_OUTER else None)
+                for lb in self.children[0].execute_partition(split):
+                    acquire_semaphore(self.metrics)
+                    with trace_range("NestedLoopJoin", self._join_time):
+                        yield from self._join_batch(lb, build, n_build, out_schema,
+                                                    pair_schema, right_matched_acc)
+                if right_matched_acc is not None:
+                    self._shared.merge_matched(right_matched_acc)
+                if reader.finish_once():
+                    if self.join_type == J.FULL_OUTER:
+                        yield from self._unmatched_right(
+                            build, n_build, self._shared.matched_acc, out_schema)
+                    self._shared.close()
+            finally:
+                # same contract as BroadcastHashJoinExec: an abandoned
+                # reader still counts down; the last one out releases
+                if reader.finish_once():
+                    self._shared.close()
         return self.wrap_output(it())
 
     def _join_batch(self, lb, build, n_build, out_schema, pair_schema, matched_acc):

@@ -1,0 +1,38 @@
+"""Block checksums for shuffle frames and spill files.
+
+Reference: Spark stamps shuffle blocks with checksums (SPARK-35275) so a
+corrupted fetch is diagnosed as a fetch failure (recompute) instead of a
+deserialization crash deep inside an operator; the reference plugin
+inherits that via the Spark shuffle layer. Here the engine owns both data
+planes, so this module is the shared primitive: the TCP transport stamps
+each serialized block's checksum into the metadata response and the client
+verifies after reassembly (shuffle/transport.py), and the buffer catalog
+stamps disk-tier spill payloads and verifies on unspill
+(runtime/memory.py). Both mismatches route through the existing
+fetch-failure → recompute ladder.
+
+CRC32C (Castagnoli) via the `crc32c` package when present; otherwise
+zlib's CRC32 — the container bakes no crc32c wheel and the constraint is
+deterministic corruption DETECTION within one process/cluster generation,
+which either polynomial provides (every participant resolves the same
+implementation, and the algorithm name travels with the checksum so a
+mixed deployment would fail loudly rather than silently pass).
+"""
+
+from __future__ import annotations
+
+import zlib
+
+try:
+    import crc32c as _crc32c_mod
+    CHECKSUM_ALGO = "crc32c"
+
+    def block_checksum(data, value: int = 0) -> int:
+        """CRC of `data` (bytes-like), optionally chained from `value`."""
+        return _crc32c_mod.crc32c(data, value)
+except ImportError:                      # no crc32c wheel in the image
+    CHECKSUM_ALGO = "crc32"
+
+    def block_checksum(data, value: int = 0) -> int:
+        """CRC of `data` (bytes-like), optionally chained from `value`."""
+        return zlib.crc32(data, value) & 0xFFFFFFFF

@@ -36,6 +36,12 @@ from spark_rapids_tpu.runtime import metrics as M
 KNOWN_EVENTS = frozenset({
     # query lifecycle (emitted by DataFrame actions, session.py)
     "query.start", "query.end", "query.error",
+    # multi-tenant lifecycle (runtime/scheduler.py): admission queueing and
+    # grants, load shedding (queue full / queue timeout), cooperative
+    # cancellation and deadline expiry, and fair-share demotion of a peer's
+    # spillable device buffers during another query's OOM recovery
+    "query.queued", "query.admitted", "query.shed",
+    "query.cancelled", "query.deadline", "query.demoted",
     # stage/batch lifecycle
     "stage.map.start", "stage.map.end", "batch",
     # memory pressure (runtime/memory.py + runtime/retry.py via tracing)
@@ -60,6 +66,8 @@ KNOWN_EVENTS = frozenset({
 QUERY_SCOPED_EVENTS = frozenset({
     "query.start", "query.end", "query.error", "batch",
     "stage.map.start", "stage.map.end",
+    "query.queued", "query.admitted", "query.shed",
+    "query.cancelled", "query.deadline", "query.demoted",
 })
 
 _lock = threading.Lock()
@@ -68,10 +76,18 @@ _sampler: "_HealthSampler | None" = None
 
 
 class EventLogWriter:
-    """Append-only JSONL writer; one file per process per configure()."""
+    """Append-only JSONL writer; one file per process per configure().
 
-    def __init__(self, path: str):
+    ``max_bytes`` > 0 enables size-based rotation: when the active file
+    crosses the bound it shifts to ``<path>.1`` (existing ``.N`` shift up,
+    ``keep`` rotations retained, older deleted) and a fresh active file
+    opens — long-lived serving sessions cannot grow one JSONL without
+    bound. `t` stays monotonic ACROSS rotations (one logical stream)."""
+
+    def __init__(self, path: str, max_bytes: int = 0, keep: int = 4):
         self.path = path
+        self.max_bytes = max(0, int(max_bytes))
+        self.keep = max(1, int(keep))
         self._f = open(path, "a", encoding="utf-8")
         self._lock = threading.Lock()
         self._last_t = 0.0
@@ -88,6 +104,25 @@ class EventLogWriter:
             line = json.dumps(record, separators=(",", ":"), default=str)
             self._f.write(line + "\n")
             self._f.flush()
+            if self.max_bytes and self._f.tell() >= self.max_bytes:
+                self._rotate()
+
+    def _rotate(self) -> None:
+        # under self._lock. Shift events.jsonl.(keep-1) off the end, then
+        # .N -> .N+1 descending, then the active file to .1, reopen fresh
+        try:
+            self._f.close()
+            oldest = f"{self.path}.{self.keep}"
+            if os.path.exists(oldest):
+                os.unlink(oldest)
+            for i in range(self.keep - 1, 0, -1):
+                src = f"{self.path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{self.path}.{i + 1}")
+            os.replace(self.path, f"{self.path}.1")
+        except OSError:
+            pass   # rotation must never crash the engine; keep appending
+        self._f = open(self.path, "a", encoding="utf-8")
 
     def close(self) -> None:
         try:
@@ -96,10 +131,12 @@ class EventLogWriter:
             pass
 
 
-def configure(directory: str, health_interval_s: float = 0.0) -> str:
+def configure(directory: str, health_interval_s: float = 0.0,
+              max_bytes: int = 0, keep: int = 4) -> str:
     """Open an event log file under `directory` (created if missing) and make
     it the process-wide sink; returns the file path. health_interval_s > 0
-    additionally starts the periodic executor-health sampler."""
+    additionally starts the periodic executor-health sampler; max_bytes > 0
+    enables size-based rotation keeping `keep` rotated files."""
     global _writer, _sampler
     os.makedirs(directory, exist_ok=True)
     stamp = datetime.datetime.now().strftime("%Y%m%d-%H%M%S")
@@ -108,7 +145,7 @@ def configure(directory: str, health_interval_s: float = 0.0) -> str:
     with _lock:
         if _writer is not None:
             _writer.close()
-        _writer = EventLogWriter(path)
+        _writer = EventLogWriter(path, max_bytes=max_bytes, keep=keep)
         if _sampler is not None:
             _sampler.stop()
             _sampler = None

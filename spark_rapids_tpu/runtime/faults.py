@@ -12,7 +12,7 @@ Spec grammar (comma-separated entries)::
 
     entry   := kind ":" site ":" trigger
     kind    := "oom" | "splitoom" | "transport" | "error" | "exec_kill"
-             | "hang"
+             | "hang" | "cancel" | "slow" | "corrupt"
     trigger := COUNT | COUNT "@" SKIP | "p" PROB
 
 ``oom`` raises a retryable runtime.retry.DeviceOomError, ``splitoom`` a
@@ -23,10 +23,24 @@ checkpoint — the MiniCluster executor chaos hook: the process dies mid-task
 with all its shuffle blocks, exercising the driver's lineage-scoped
 recovery (cluster/minicluster.py) — and ``hang`` sleeps forever at the
 site (the wedged-executor simulation that exercises the driver's
-``cluster.task.timeoutSeconds`` deadline). COUNT injects on that many eligible hits; ``@SKIP`` first
+``cluster.task.timeoutSeconds`` deadline). ``cancel`` flips the ambient
+query's CancelToken at the site and raises the typed QueryCancelledError —
+the race-pinning chaos hook for the multi-tenant lifecycle
+(runtime/scheduler.py): it cancels a query at EXACTLY the checkpoint named,
+where an external ``session.cancel()`` could only race it. ``slow`` sleeps
+250ms at the site and continues (no raise) — widens race windows so
+deadline/cancel races and scheduler queue timeouts become deterministic.
+``corrupt`` never raises from the generic checkpoints; it arms
+:func:`maybe_corrupt` sites (transport block reassembly, spill file write)
+to flip one byte of the payload, proving the CRC detection → fetch-failure
+ladders end to end. COUNT injects on that many eligible hits; ``@SKIP`` first
 lets SKIP eligible hits pass ("oom:agg.update:1@3" skips three, injects
-once); ``pPROB`` injects each hit with the given probability from the
-seeded RNG (one seed → one deterministic schedule).
+once); ``pPROB`` injects each hit with the given probability from a
+PER-SITE seeded RNG — each (kind, site) entry draws from its own stream
+seeded by (seed, kind, site), so one seed yields one deterministic
+schedule per site regardless of how the pipeline's worker threads
+interleave hits ACROSS sites (a process-global stream made chaos runs
+irreproducible under concurrency).
 
 Sites: with_retry/call_with_retry attempts check their ``scope`` label
 ("joins.build", "joins.gather", "agg.update", "agg.merge", "sort.sort",
@@ -58,28 +72,33 @@ import threading
 _lock = threading.Lock()
 _active = False
 _entries: list = []
-_rng: random.Random | None = None
 _injected: list = []
 _tls = threading.local()
 
-_KINDS = ("oom", "splitoom", "transport", "error", "exec_kill", "hang")
+_KINDS = ("oom", "splitoom", "transport", "error", "exec_kill", "hang",
+          "cancel", "slow", "corrupt")
 _ENTRY_RE = re.compile(
     r"^(?P<kind>[a-z_]+):(?P<site>[A-Za-z0-9_.\-]+):"
     r"(?:(?P<count>\d+)(?:@(?P<skip>\d+))?|p(?P<prob>0?\.\d+|1(?:\.0*)?))$")
 
 
 class _Entry:
-    __slots__ = ("kind", "site", "count", "skip", "prob")
+    __slots__ = ("kind", "site", "count", "skip", "prob", "rng")
 
-    def __init__(self, kind, site, count, skip, prob):
+    def __init__(self, kind, site, count, skip, prob, seed=0):
         self.kind = kind
         self.site = site
         self.count = count
         self.skip = skip
         self.prob = prob
+        # per-site stream: pPROB draws must not depend on which OTHER sites'
+        # threads consumed a shared stream first (pipeline workers interleave
+        # nondeterministically); str seeds hash via sha512, stable across
+        # processes — one (seed, kind, site) is one schedule, always
+        self.rng = random.Random(f"{seed}|{kind}|{site}")
 
 
-def parse_spec(spec: str) -> list:
+def parse_spec(spec: str, seed: int = 0) -> list:
     entries = []
     for raw in spec.split(","):
         raw = raw.strip()
@@ -94,16 +113,16 @@ def parse_spec(spec: str) -> list:
             m.group("kind"), m.group("site"),
             int(m.group("count")) if m.group("count") else 0,
             int(m.group("skip") or 0),
-            float(m.group("prob")) if m.group("prob") else None))
+            float(m.group("prob")) if m.group("prob") else None,
+            seed=seed))
     return entries
 
 
 def configure(spec: str | None, seed: int = 0) -> None:
     """Arm (or with None/empty, disarm) the process-wide injector."""
-    global _active, _entries, _rng
+    global _active, _entries
     with _lock:
-        _entries = parse_spec(spec) if spec else []
-        _rng = random.Random(seed)
+        _entries = parse_spec(spec, seed) if spec else []
         _injected.clear()
         _active = bool(_entries)
 
@@ -140,54 +159,95 @@ def current_scope() -> str | None:
     return getattr(_tls, "site", None)
 
 
-def _select_and_fire(site: str, kind_ok) -> None:
+def _select(site: str, kind_ok) -> "str | None":
     """Shared trigger walk: find the first armed entry for `site` whose kind
-    satisfies `kind_ok`, honor its COUNT/@SKIP/pPROB trigger, raise."""
-    fire = None
+    satisfies `kind_ok`, honor its COUNT/@SKIP/pPROB trigger; returns the
+    firing kind (already logged) or None."""
     with _lock:
         for e in _entries:
             if not kind_ok(e.kind) or e.site != site:
                 continue
             if e.prob is not None:
-                if _rng.random() < e.prob:
-                    fire = e
-                    break
-                return
+                if e.rng.random() < e.prob:
+                    _injected.append((e.kind, site))
+                    return e.kind
+                return None
             if e.count <= 0:
                 continue
             if e.skip > 0:
                 e.skip -= 1
-                return
+                return None
             e.count -= 1
-            fire = e
-            break
-        if fire is not None:
-            _injected.append((fire.kind, site))
-    if fire is not None:
-        _raise(fire.kind, site)
+            _injected.append((e.kind, site))
+            return e.kind
+    return None
+
+
+def _select_and_fire(site: str, kind_ok) -> None:
+    kind = _select(site, kind_ok)
+    if kind is not None:
+        _raise(kind, site)
 
 
 def maybe_inject(kind: str, site: str) -> None:
     """Raise the configured fault for (kind, site) if one is armed; a no-op
-    flag check when injection is off (the production fast path)."""
+    flag check when injection is off (the production fast path). A "cancel"
+    entry also satisfies any checkpoint kind — cancellation races are worth
+    pinning at every recovery-ladder site, not only the generic ones."""
     if not _active:
         return
     # an "oom" checkpoint arms both OOM flavors — splitoom is the same
     # fault class with a stronger recovery demand
     _select_and_fire(site, lambda k: k == kind
-                     or (kind == "oom" and k == "splitoom"))
+                     or (kind == "oom" and k == "splitoom")
+                     or k in ("cancel", "slow"))
 
 
 def maybe_inject_any(site: str) -> None:
     """Raise whatever fault is armed for `site`, regardless of kind — the
     pipeline queue put/get hooks use this so one chaos spec can drive any
-    fault class through a stage boundary."""
+    fault class through a stage boundary. ("corrupt" entries stay silent
+    here: they only act through maybe_corrupt's payload sites.)"""
     if not _active:
         return
-    _select_and_fire(site, lambda k: True)
+    _select_and_fire(site, lambda k: k != "corrupt")
+
+
+def maybe_corrupt(site: str, data: bytes) -> bytes:
+    """Payload checkpoint: when a "corrupt" entry is armed for `site`, flip
+    one byte of `data` (middle of the buffer) so the CRC verification on
+    the other side of the wire/spill must catch it; otherwise return `data`
+    unchanged. Sites: "transport.corrupt" (client-side block reassembly,
+    shuffle/transport.py) and "spill.write" (disk-tier spill payload,
+    runtime/memory.py)."""
+    if not _active or not data:
+        return data
+    if _select(site, lambda k: k == "corrupt") is None:
+        return data
+    flipped = bytearray(data)
+    flipped[len(flipped) // 2] ^= 0xFF
+    return bytes(flipped)
 
 
 def _raise(kind: str, site: str):
+    if kind == "slow":
+        # widen the race window, then continue — no error: the site runs
+        # 250ms later than it would have, which is what deadline/cancel
+        # race tests and queue-timeout tests need to be deterministic
+        import time
+        time.sleep(0.25)
+        return
+    if kind == "cancel":
+        # cancel the ambient query AT this exact checkpoint: the token flips
+        # (so every other thread of the query drains cooperatively) and this
+        # thread raises the typed error immediately
+        from spark_rapids_tpu.runtime import scheduler as SCHED
+        tok = SCHED.current_token()
+        if tok is not None:
+            tok.cancel(f"fault-injection at {site}")
+            tok.check()
+        raise SCHED.QueryCancelledError(
+            f"[fault-injection] cancel at {site}")
     if kind == "exec_kill":
         # die the way a real executor crash does: no cleanup, no goodbye on
         # the driver pipe, shuffle blocks lost with the process

@@ -60,6 +60,14 @@ class BufferClosedError(RuntimeError):
     assertion failures."""
 
 
+class SpillCorruptionError(RuntimeError):
+    """A disk-tier spill payload failed its CRC on unspill
+    (memory.spill.checksum.enabled). Shuffle readers treat this exactly
+    like a fetch failure — invalidate the map outputs, recompute — instead
+    of decoding silently corrupt rows (the Spark shuffle-checksum →
+    FetchFailed contract, SPARK-35275 analog)."""
+
+
 @dataclasses.dataclass
 class HostColumn:
     """Host image of one TpuColumnVector (the RapidsHostColumnVector analog)."""
@@ -104,10 +112,10 @@ class RapidsBuffer:
     (reference RapidsBufferStore.RapidsBufferBase)."""
 
     __slots__ = ("buffer_id", "tier", "priority", "size", "_device", "_host",
-                 "_path", "_handle", "spill_callback")
+                 "_path", "_handle", "spill_callback", "query", "_crc")
 
     def __init__(self, buffer_id: int, batch: ColumnarBatch, priority: float,
-                 spill_callback=None):
+                 spill_callback=None, query: str | None = None):
         self.buffer_id = buffer_id
         self.tier = TierEnum.DEVICE
         self.priority = priority
@@ -117,6 +125,10 @@ class RapidsBuffer:
         self._path: str | None = None
         self._handle = None          # (file, offset, len) in the direct store
         self.spill_callback = spill_callback
+        # owning query (ambient collector at registration): the multi-tenant
+        # scheduler's per-query accounting + fair-share demotion key
+        self.query = query
+        self._crc = None             # disk-tier payload checksum
 
 
 class BufferCatalog:
@@ -130,9 +142,12 @@ class BufferCatalog:
     def __init__(self, device_budget: int, host_budget: int, spill_dir: str | None = None,
                  unspill: bool = False, oom_dump_dir: str | None = None,
                  direct_spill: bool = False, direct_batch_bytes: int = 64 << 20,
-                 strict_budget: bool = True):
+                 strict_budget: bool = True, spill_checksum: bool = True):
         self.device_budget = device_budget
         self.host_budget = host_budget
+        # CRC disk-tier spill payloads and verify on unspill
+        # (memory.spill.checksum.enabled)
+        self._spill_checksum = spill_checksum
         # strict: registration that cannot spill back under budget raises a
         # retryable DeviceOomError (spark.rapids.tpu.memory.hbm.strictBudget)
         # instead of silently leaving the device tier over budget
@@ -159,9 +174,11 @@ class BufferCatalog:
         # either the ambient operator scope ("joins.build" …) or the bare
         # registration site
         F.maybe_inject("oom", F.current_scope() or "catalog.add_batch")
+        from spark_rapids_tpu.runtime import metrics as M
         with self._lock:
             bid = next(self._ids)
-            buf = RapidsBuffer(bid, batch, priority, spill_callback)
+            buf = RapidsBuffer(bid, batch, priority, spill_callback,
+                               query=M.current_query_id())
             self._buffers[bid] = buf
             self.device_bytes += buf.size
             try:
@@ -306,6 +323,14 @@ class BufferCatalog:
     def _spill_host_buffer(self, buf: RapidsBuffer):
         hb = buf._host
         payload = pickle.dumps(hb, protocol=pickle.HIGHEST_PROTOCOL)
+        # CRC the CLEAN payload, then the chaos checkpoint
+        # ("corrupt:spill.write:N") may flip a byte of what actually lands
+        # on disk — modeling bit rot between write and unspill, which the
+        # read-side verification must DETECT rather than decode
+        if self._spill_checksum:
+            from spark_rapids_tpu.runtime.checksum import block_checksum
+            buf._crc = block_checksum(payload)
+        payload = F.maybe_corrupt("spill.write", payload)
         if self._direct_spill:
             # GDS-analog batched aligned store (reference RapidsGdsStore)
             buf._handle = self._get_direct_store().write(payload)
@@ -341,11 +366,20 @@ class BufferCatalog:
             hb = buf._host
             if hb is None:
                 if buf._handle is not None:
-                    hb = pickle.loads(
-                        self._get_direct_store().read(buf._handle))
+                    payload = self._get_direct_store().read(buf._handle)
                 else:
                     with open(buf._path, "rb") as f:
-                        hb = pickle.load(f)
+                        payload = f.read()
+                if buf._crc is not None:
+                    from spark_rapids_tpu.runtime.checksum import \
+                        block_checksum
+                    got = block_checksum(payload)
+                    if got != buf._crc:
+                        raise SpillCorruptionError(
+                            f"buffer {buffer_id} spill payload checksum "
+                            f"mismatch on unspill (stored {buf._crc:#x}, "
+                            f"read {got:#x}, {len(payload)}B)")
+                hb = pickle.loads(payload)
             batch = host_to_batch(hb)
             if self._unspill:
                 if buf.tier == TierEnum.HOST:
@@ -399,6 +433,37 @@ class BufferCatalog:
             finally:
                 self.device_budget = saved
             return before - self.device_bytes
+
+    # -- per-query accounting (multi-tenant scheduler, runtime/scheduler.py) --
+    def query_device_bytes(self) -> dict:
+        """{query_id: device-tier bytes} for every owning query (None key =
+        buffers registered outside any query scope) — the fair-share input
+        of the scheduler's OOM demotion policy."""
+        with self._lock:
+            out: dict = {}
+            for b in self._buffers.values():
+                if b.tier == TierEnum.DEVICE:
+                    out[b.query] = out.get(b.query, 0) + b.size
+            return out
+
+    def spill_query_device(self, query_id: str) -> int:
+        """Demote ONE query's device tier: spill its spillable device
+        buffers (below ACTIVE_BATCHING priority — a batch an operator is
+        mid-consume stays pinned), lowest priority first; returns bytes
+        spilled. The fair-share degradation path: an over-share peer pays
+        a recoverable unspill instead of the under-share faulting query
+        paying with batch splits."""
+        with self._lock:
+            victims = sorted(
+                (b for b in self._buffers.values()
+                 if b.tier == TierEnum.DEVICE and b.query == query_id
+                 and b.priority < ACTIVE_BATCHING_PRIORITY),
+                key=lambda b: b.priority)
+            spilled = 0
+            for b in victims:
+                spilled += b.size
+                self._spill_device_buffer(b)
+            return spilled
 
     @property
     def num_buffers(self):
@@ -493,6 +558,7 @@ class DeviceManager:
             direct_spill=conf.get(C.DIRECT_SPILL_ENABLED),
             direct_batch_bytes=conf.get(C.DIRECT_SPILL_BATCH_BYTES),
             strict_budget=conf.get(C.STRICT_DEVICE_BUDGET),
+            spill_checksum=conf.get(C.SPILL_CHECKSUM),
         )
 
     @classmethod

@@ -77,12 +77,19 @@ STAGE_PARTIAL_RECOMPUTES = "stagePartialRecomputes"
 MAP_TASKS_RECOMPUTED = "mapTasksRecomputed"
 SPECULATION_WON = "speculationWon"
 SPECULATION_LOST = "speculationLost"
+# multi-tenant query lifecycle (runtime/scheduler.py): shed submissions,
+# cancelled/deadlined queries and fair-share demotions of a victim query's
+# device buffers during a peer's OOM recovery
+QUERIES_SHED = "queriesShed"
+QUERIES_CANCELLED = "queriesCancelled"
+QUERY_DEMOTIONS = "queryDemotions"
 
 RESILIENCE_METRICS = (NUM_OOM_RETRIES, NUM_OOM_SPLIT_RETRIES, OOM_SPILL_BYTES,
                       FETCH_RETRIES, FETCH_FAILOVERS, FETCH_RECOMPUTES,
                       TASK_ATTEMPTS, EXECUTORS_LOST, EXECUTORS_BLACKLISTED,
                       STAGE_PARTIAL_RECOMPUTES, MAP_TASKS_RECOMPUTED,
-                      SPECULATION_WON, SPECULATION_LOST)
+                      SPECULATION_WON, SPECULATION_LOST,
+                      QUERIES_SHED, QUERIES_CANCELLED, QUERY_DEMOTIONS)
 
 
 class GpuMetric:
@@ -197,6 +204,20 @@ def resilience_snapshot() -> dict:
     return {name: g.metric(name).value for name in RESILIENCE_METRICS}
 
 
+def resilience_add(name: str, v: int = 1) -> None:
+    """Increment one resilience counter in the process-wide registry AND in
+    the ambient query's own scoped registry. Concurrent queries made the old
+    start/finish DELTA attribution wrong — a peer's retry landing inside
+    another query's window leaked across query scopes; routing every
+    increment through here pins it to the query whose thread did the work
+    (worker threads re-enter their query's collector scope, so the ambient
+    collector is the right owner even off the driving thread)."""
+    global_registry().metric(name).add(v)
+    c = current_collector()
+    if c is not None:
+        c._resilience_local.metric(name).add(v)
+
+
 # -- query-scoped collection ---------------------------------------------------
 # The SQL-UI analog: every exec node registers its MetricsRegistry with the
 # query's collector at construction (TpuExec.__init__), so a finished query
@@ -288,7 +309,15 @@ class QueryMetricsCollector:
         self._nodes: dict[int, object] = {}   # node_id -> exec node
         self.root = None
         self._t0 = time.perf_counter()
-        self._resilience_base = resilience_snapshot()
+        # query-scoped resilience counters: resilience_add() mirrors every
+        # process-wide increment here, keyed by the worker thread's ambient
+        # collector — correct under concurrent queries where the old
+        # start/finish delta would count a peer's retries as this query's
+        self._resilience_local = MetricsRegistry("DEBUG")
+        # cooperative cancellation (runtime/scheduler.py): the session's
+        # action sets the query's CancelToken here so every thread that
+        # re-enters this collector's scope can reach it
+        self.cancel_token = None
         self.wall_s: float | None = None
         self._resilience: dict | None = None
 
@@ -305,19 +334,18 @@ class QueryMetricsCollector:
     def finish(self) -> None:
         if self.wall_s is None:
             self.wall_s = time.perf_counter() - self._t0
-            end = resilience_snapshot()
-            self._resilience = {
-                k: end[k] - self._resilience_base.get(k, 0) for k in end}
+            self._resilience = self.query_resilience()
 
     # -- read-out -------------------------------------------------------------
     def query_resilience(self) -> dict:
-        """Resilience counter DELTAS attributable to this query (the
-        process-wide registry accumulates across queries; the delta between
-        query start and finish isolates one query's share)."""
+        """Resilience counters attributable to THIS query (zeros included).
+        Accumulated directly in the query's scoped registry by
+        resilience_add() — not a delta of the process-wide registry, which
+        concurrent peers mutate inside this query's window."""
         if self._resilience is not None:
             return dict(self._resilience)
-        end = resilience_snapshot()
-        return {k: end[k] - self._resilience_base.get(k, 0) for k in end}
+        return {name: self._resilience_local.metric(name).value
+                for name in RESILIENCE_METRICS}
 
     def _walk(self, node, parent_id, depth, visit):
         """Duck-typed hybrid-tree walk (no imports of exec/plan here): device

@@ -53,6 +53,7 @@ from spark_rapids_tpu import config as C
 from spark_rapids_tpu.runtime import faults as F
 from spark_rapids_tpu.runtime import metrics as M
 from spark_rapids_tpu.runtime import tracing
+from spark_rapids_tpu.runtime.scheduler import check_cancel as _check_cancel
 
 # waits shorter than this are scheduling noise, not stalls; longer ones emit
 # a pipeline.stall span event, capped per queue so a persistently starved
@@ -138,6 +139,11 @@ class BoundedBatchQueue:
                     len(self._items) >= self.depth
                     or (self.max_bytes is not None
                         and self._bytes + nb > self.max_bytes)):
+                # cooperative cancellation: a producer parked on a full edge
+                # must observe session.cancel()/deadline expiry — the raise
+                # propagates through produce()'s fail() path so the consumer
+                # sees the SAME typed error (runtime/scheduler.py)
+                _check_cancel()
                 if t0 is None:
                     t0 = time.perf_counter_ns()
                     self._release_device_permit()
@@ -181,6 +187,10 @@ class BoundedBatchQueue:
         err = None
         with self._cond:
             while (not self._items and not self._done and not self._closed):
+                # symmetric to put(): a consumer starved on an empty queue
+                # observes cancellation directly (its finally closes the
+                # edge, which unblocks and stops the producer)
+                _check_cancel()
                 if t0 is None:
                     t0 = time.perf_counter_ns()
                     # symmetric to put(): a consumer blocked on an empty
@@ -295,6 +305,9 @@ def stage_iterator(gen, *, edge: str, conf=None, registry=None, node_id=None,
         try:
             with M.collector_context(collector), TaskContext():
                 while True:
+                    # segment batch loops are the issue's canonical
+                    # cancellation points: one check per produced item
+                    _check_cancel()
                     if frame_producer:
                         with M.node_frame(node_id, self_time_metric):
                             try:

@@ -134,20 +134,26 @@ def _spill_for_retry(catalog=None) -> int:
     cat = catalog if catalog is not None else _default_catalog()
     spilled = cat.synchronous_spill(cat.device_budget // 2)
     if spilled:
-        M.global_registry().metric(M.OOM_SPILL_BYTES).add(spilled)
+        M.resilience_add(M.OOM_SPILL_BYTES, spilled)
     return spilled
 
 
 def _record_oom(site, oom, batch=None):
-    M.global_registry().metric(M.NUM_OOM_RETRIES).add(1)
+    M.resilience_add(M.NUM_OOM_RETRIES)
     tracing.span_event(
         "oom.retry", site=site,
         rows=(batch.num_rows if batch is not None and batch.columns else None),
         injected=getattr(oom, "injected", False))
+    # multi-tenant escalation hook (runtime/scheduler.py): fair-share
+    # demotion of an over-share victim + bounded admission re-check, so one
+    # query's OOM ladder leans on peers' SPILLABLE state instead of
+    # splitting an under-share query's own batches
+    from spark_rapids_tpu.runtime import scheduler as SCHED
+    SCHED.on_oom_retry()
 
 
 def _record_split(site, batch, halves):
-    M.global_registry().metric(M.NUM_OOM_SPLIT_RETRIES).add(1)
+    M.resilience_add(M.NUM_OOM_SPLIT_RETRIES)
     tracing.span_event("oom.split", site=site, rows=batch.num_rows,
                        into=[h.num_rows for h in halves])
 
@@ -194,10 +200,15 @@ def with_retry(inputs, fn, *, conf=None, scope=None, splittable=True,
     max_splits, split_floor_bytes = _resolve_limits(conf, max_splits,
                                                     split_floor_bytes)
     site_default = scope
+    from spark_rapids_tpu.runtime import scheduler as SCHED
     for item in inputs:
         pending = [(item, False)]   # (piece, already-spill-retried)
         splits_used = 0
         while pending:
+            # a cancelled/deadlined query must not be kept alive by its own
+            # recovery ladder: the check runs before every attempt so
+            # cancellation wins over (and is never absorbed by) retries
+            SCHED.check_cancel()
             cur, retried = pending.pop(0)
             spillable = isinstance(cur, SpillableColumnarBatch)
             batch = cur.get_batch() if spillable else cur
@@ -234,8 +245,10 @@ def call_with_retry(thunk, *, scope=None, max_retries=2, catalog=None):
     withRetryNoSplit analog, for work that cannot be split: single-batch
     registration, merge aggregation of accumulated partials, a whole-batch
     total sort."""
+    from spark_rapids_tpu.runtime import scheduler as SCHED
     attempt = 0
     while True:
+        SCHED.check_cancel()   # cancellation wins over spill-only retries too
         try:
             return _attempt(scope, thunk)
         except DeviceOomError as oom:

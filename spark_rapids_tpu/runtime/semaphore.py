@@ -40,8 +40,13 @@ class TpuSemaphore:
         with self._holders_lock:
             if task_id in self._holders:
                 return
+        from spark_rapids_tpu.runtime.scheduler import check_cancel
         t0 = time.perf_counter_ns()
-        self._sem.acquire()
+        # polled acquire: a cancelled/deadlined query must not camp on the
+        # permit queue — every waiter is a cooperative cancellation point
+        # (runtime/scheduler.py), and a raise here leaves no permit held
+        while not self._sem.acquire(timeout=0.05):
+            check_cancel()
         if wait_metric is not None:
             wait_metric.add(time.perf_counter_ns() - t0)
         with self._holders_lock:

@@ -33,6 +33,23 @@ from spark_rapids_tpu.plan.overrides import TpuOverrides
 from spark_rapids_tpu.plan.transitions import execute_hybrid
 
 
+def _abort_execs(collector) -> None:
+    """Query-death sweep: give every exec registered with the dead query's
+    collector its `abort_query()` cleanup hook (shuffle exchanges free map
+    outputs whose read-completion countdown can never finish — a cancelled
+    or failed query's unvisited reduce splits have no reader to account
+    them). Hooks must never mask the original error."""
+    with collector._lock:
+        nodes = list(collector._nodes.values())
+    for node in nodes:
+        hook = getattr(node, "abort_query", None)
+        if hook is not None:
+            try:
+                hook()
+            except Exception:   # noqa: BLE001 — cleanup must not mask
+                pass
+
+
 def _to_expr(c) -> E.Expression:
     if isinstance(c, E.Expression):
         return c
@@ -235,24 +252,70 @@ class DataFrame:
         and the finished collector (annotated plan, per-node metrics,
         query-scoped resilience deltas) lands on the DataFrame and the
         session for explain(metrics=True) / last_query_metrics(). Query
-        lifecycle is mirrored to the structured event log when configured."""
+        lifecycle is mirrored to the structured event log when configured.
+
+        Multi-tenant lifecycle (runtime/scheduler.py): the action is
+        ADMITTED against the process-wide QueryScheduler before it executes
+        (declared footprint from scan stats + plan shape), carries a
+        CancelToken (+ optional scheduler.query.deadlineSeconds deadline)
+        on its collector so session.cancel(query_id) reaches every worker
+        thread, and releases its admission slot on every exit path. A shed
+        submission raises QueryRejectedError (retryable, backoff hint); a
+        cancellation/deadline classifies as query.cancelled/query.deadline
+        in the event log, not query.error."""
+        from spark_rapids_tpu import config as CFG
         from spark_rapids_tpu.runtime import eventlog as EL
         from spark_rapids_tpu.runtime import metrics as M
+        from spark_rapids_tpu.runtime import scheduler as SCHED
+        conf = self.session.conf
         collector = M.QueryMetricsCollector(description=type(plan).__name__)
+        deadline_s = conf.get(CFG.SCHEDULER_QUERY_DEADLINE)
+        token = SCHED.CancelToken(
+            collector.query_id,
+            deadline_s=deadline_s if deadline_s > 0 else None)
+        collector.cancel_token = token
         self._last_collector = collector
         self.session._last_collector = collector
+        sched = SCHED.QueryScheduler.get()
+        admitted = False
         with M.collector_context(collector):
-            hybrid = TpuOverrides(self.session.conf).apply(plan)
+            hybrid = TpuOverrides(conf).apply(plan)
             collector.set_root(hybrid)
-            EL.emit("query.start", query=collector.query_id,
-                    description=collector.description)
             try:
+                queue_timeout = conf.get(CFG.SCHEDULER_QUEUE_TIMEOUT)
+                sched.submit(
+                    collector.query_id,
+                    SCHED.estimate_footprint(plan),
+                    priority=conf.get(CFG.SCHEDULER_PRIORITY),
+                    token=token,
+                    timeout_s=queue_timeout if queue_timeout > 0 else None,
+                    description=collector.description)
+                admitted = True
+                EL.emit("query.start", query=collector.query_id,
+                        description=collector.description)
                 out = run(hybrid)
+            except SCHED.QueryCancelledError as e:
+                M.resilience_add(M.QUERIES_CANCELLED)
+                collector.finish()
+                _abort_execs(collector)
+                EL.emit("query.deadline" if isinstance(
+                            e, SCHED.QueryDeadlineError)
+                        else "query.cancelled",
+                        query=collector.query_id, reason=e.reason,
+                        admitted=admitted, wall_s=collector.wall_s)
+                raise
+            except SCHED.QueryRejectedError:
+                collector.finish()   # query.shed already emitted by submit()
+                raise
             except BaseException as e:
                 collector.finish()
+                _abort_execs(collector)
                 EL.emit("query.error", query=collector.query_id,
                         error=repr(e)[:200], wall_s=collector.wall_s)
                 raise
+            finally:
+                if admitted:
+                    sched.release(collector.query_id)
         collector.finish()
         EL.emit("query.end", query=collector.query_id,
                 description=collector.description,
@@ -574,9 +637,22 @@ class TpuSession:
             elog_dir = self.conf.get(CFG.EVENT_LOG_DIR)
             if elog_dir:
                 eventlog.configure(
-                    elog_dir, self.conf.get(CFG.EVENT_LOG_HEALTH_INTERVAL))
+                    elog_dir, self.conf.get(CFG.EVENT_LOG_HEALTH_INTERVAL),
+                    max_bytes=self.conf.get(CFG.EVENT_LOG_MAX_BYTES),
+                    keep=self.conf.get(CFG.EVENT_LOG_KEEP_FILES))
             else:
                 eventlog.shutdown()
+        # multi-tenant query scheduler (runtime/scheduler.py): STRUCTURAL
+        # knobs (concurrency, queue depth, aging) are process-global like
+        # the switches above — only an EXPLICIT setting reconfigures the
+        # shared instance; per-query values (priority, deadline, queue
+        # timeout, footprint estimate) are read from this session's conf at
+        # every submission
+        if any(k.key in self.conf.settings for k in (
+                CFG.SCHEDULER_MAX_CONCURRENT, CFG.SCHEDULER_QUEUE_MAX_DEPTH,
+                CFG.SCHEDULER_PRIORITY_AGING)):
+            from spark_rapids_tpu.runtime.scheduler import QueryScheduler
+            QueryScheduler.get().reconfigure(self.conf)
         self._last_collector = None
 
     def last_query_metrics(self):
@@ -584,6 +660,25 @@ class TpuSession:
         this session (None before any action): per-node metric snapshots,
         the annotated plan, wall time and query-scoped resilience deltas."""
         return self._last_collector
+
+    # -- multi-tenant lifecycle (runtime/scheduler.py) -----------------------
+    def cancel(self, query_id: str, reason: str = "cancelled") -> bool:
+        """Cooperatively cancel a running OR queued query by id (ids come
+        from active_queries(), or last_query_metrics().query_id on the
+        submitting thread). The query observes the token at its next
+        checkpoint — pipeline queue waits, per-batch operator pulls, fetch
+        backoffs, the OOM retry ladder — and drains without leaking
+        threads, device buffers, or semaphore permits. Returns False for
+        an unknown/already-finished id."""
+        from spark_rapids_tpu.runtime.scheduler import QueryScheduler
+        return QueryScheduler.get().cancel(query_id, reason)
+
+    def active_queries(self) -> list:
+        """Every queued or running query on the process-wide scheduler:
+        [{query, state, estimate_bytes, priority, waited_s|running_s,
+        description}] — the serving endpoint's `ps`."""
+        from spark_rapids_tpu.runtime.scheduler import QueryScheduler
+        return QueryScheduler.get().active_queries()
 
     # -- data sources --------------------------------------------------------
     def read_parquet(self, path, pushed_filter=None,

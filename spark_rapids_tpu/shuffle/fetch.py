@@ -28,6 +28,7 @@ import time
 from spark_rapids_tpu.runtime import faults as F
 from spark_rapids_tpu.runtime import metrics as M
 from spark_rapids_tpu.runtime import tracing
+from spark_rapids_tpu.runtime.memory import SpillCorruptionError
 from spark_rapids_tpu.shuffle.transport import _NO_KEY, TransportError
 
 
@@ -77,9 +78,12 @@ class ShuffleFetchIterator:
         (map_split, seq) wire key so a multi-peer union reader can merge
         several peers' disjoint block sets into one canonical order
         (recomputed batches carry the sort-last sentinel)."""
-        g = M.global_registry()
+        from spark_rapids_tpu.runtime import scheduler as SCHED
         for pi, factory in enumerate(self.client_factories):
             for attempt in range(self.max_retries + 1):
+                # a cancelled query must not grind through the whole
+                # retry -> failover -> recompute ladder first
+                SCHED.check_cancel()
                 batches = []
                 try:
                     # chaos checkpoint, shared site name with the stage
@@ -99,23 +103,28 @@ class ShuffleFetchIterator:
                         # buffer before yielding: a mid-stream failure must
                         # not emit a partial partition twice
                         batches.append(kb)
-                except TransportError as e:
+                except (TransportError, SpillCorruptionError) as e:
+                    # a CRC mismatch — on the wire (TransportError from the
+                    # TCP client) or in a peer's spilled block (unspill
+                    # verification) — IS a fetch failure: retry, fail over,
+                    # recompute; never decode corrupt rows
                     self.errors.append(
                         f"peer {pi} attempt {attempt}: {e}")
                     tracing.span_event("fetch.error", peer=pi,
                                        attempt=attempt, error=str(e)[:120])
                     if attempt < self.max_retries:  # no sleep before failover
-                        g.metric(M.FETCH_RETRIES).add(1)
+                        M.resilience_add(M.FETCH_RETRIES)
                         tracing.span_event("fetch.retry", peer=pi,
                                            attempt=attempt,
                                            shuffle=self.shuffle_id,
                                            reduce=self.reduce_id)
+                        SCHED.check_cancel()   # don't sleep a dead query
                         time.sleep(self._backoff(attempt))
                     continue
                 yield from batches
                 return
             if pi < len(self.client_factories) - 1:
-                g.metric(M.FETCH_FAILOVERS).add(1)
+                M.resilience_add(M.FETCH_FAILOVERS)
                 tracing.span_event("fetch.failover", from_peer=pi,
                                    shuffle=self.shuffle_id,
                                    reduce=self.reduce_id)
@@ -123,7 +132,7 @@ class ShuffleFetchIterator:
             raise TransportError(
                 "all peers failed for shuffle %d reduce %d: %s"
                 % (self.shuffle_id, self.reduce_id, "; ".join(self.errors)))
-        g.metric(M.FETCH_RECOMPUTES).add(1)
+        M.resilience_add(M.FETCH_RECOMPUTES)
         tracing.span_event("fetch.recompute", shuffle=self.shuffle_id,
                            reduce=self.reduce_id)
         for b in self.recompute():

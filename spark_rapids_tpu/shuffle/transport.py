@@ -84,6 +84,9 @@ class BlockMeta:
 # blocks written without a seq tag sort after every tagged block (the
 # store's _ordered contract); on the wire that is a sentinel key
 _NO_KEY = (1 << 62, 1 << 62)
+# wire sentinel for "no checksum" (shuffle.checksum.enabled=false on the
+# serving side): a real CRC fits 32 bits, so this value can never collide
+_NO_CRC = 1 << 62
 
 
 def _encode_seq(seq) -> tuple:
@@ -185,19 +188,23 @@ class _ServerHandler(socketserver.BaseRequestHandler):
         try:
             blobs = self._blocks(server, shuffle_id, reduce_id)
             keys = server.block_keys(shuffle_id, reduce_id)
+            crcs = server.block_crcs(shuffle_id, reduce_id)
         except KeyError:
             _send_frame(sock, MSG_ERROR,
                         f"unknown shuffle {shuffle_id}".encode())
             return
         # per block: size + the store's (map_split, seq) key, so a reducer
-        # merging several peers can reconstruct one canonical block order
+        # merging several peers can reconstruct one canonical block order,
+        # plus the block's CRC (the sentinel below = checksums disabled)
         if len(keys) != len(blobs):       # raced a concurrent write: re-read
             keys = (keys + [None] * len(blobs))[:len(blobs)]
+        if len(crcs) != len(blobs):
+            crcs = (crcs + [_NO_CRC] * len(blobs))[:len(blobs)]
         out = io.BytesIO()
         out.write(struct.pack("<I", len(blobs)))
-        for b, k in zip(blobs, keys):
+        for b, k, c in zip(blobs, keys, crcs):
             k0, k1 = _encode_seq(k)
-            out.write(struct.pack("<QQQ", len(b), k0, k1))
+            out.write(struct.pack("<QQQQ", len(b), k0, k1, c))
         _send_frame(sock, MSG_METADATA_RESP, out.getvalue())
 
     def _transfer(self, server, sock, payload):
@@ -221,9 +228,10 @@ class TcpShuffleServer:
     frames cached for subsequent fetchers."""
 
     def __init__(self, store: ShuffleBlockStore, codec: TableCompressionCodec,
-                 port: int = 0, num_threads: int = 4):
+                 port: int = 0, num_threads: int = 4, checksum: bool = True):
         self.store = store
         self.codec = codec
+        self.checksum = checksum
         self.compressor = BatchedTableCompressor(codec, num_threads)
         self._cache_lock = threading.Lock()
         self._frame_cache: dict = {}
@@ -251,8 +259,13 @@ class TcpShuffleServer:
             keys.append(seq)
             frames.append(ser.serialize_batch(b))
         frames = self.compressor.compress_all(frames)
+        if self.checksum:
+            from spark_rapids_tpu.runtime.checksum import block_checksum
+            crcs = [block_checksum(f) for f in frames]
+        else:
+            crcs = [_NO_CRC] * len(frames)
         with self._cache_lock:
-            self._frame_cache[key] = (frames, keys)
+            self._frame_cache[key] = (frames, keys, crcs)
         return frames
 
     def block_keys(self, shuffle_id: int, reduce_id: int) -> list:
@@ -264,6 +277,15 @@ class TcpShuffleServer:
             if key in self._frame_cache:
                 return self._frame_cache[key][1]
         return self.store.partition_keys(shuffle_id, reduce_id)
+
+    def block_crcs(self, shuffle_id: int, reduce_id: int) -> list:
+        """Per-frame CRCs matching serialized_blocks' order (the sentinel
+        when checksums are off or the cache was raced)."""
+        key = (shuffle_id, reduce_id)
+        with self._cache_lock:
+            if key in self._frame_cache:
+                return self._frame_cache[key][2]
+        return []
 
     def invalidate(self, shuffle_id: int):
         with self._cache_lock:
@@ -324,9 +346,9 @@ class TcpShuffleClient(ShuffleClient):
             if msg_type == MSG_ERROR:
                 raise TransportError(payload.decode())
             (n_blocks,) = struct.unpack_from("<I", payload, 0)
-            metas = [struct.unpack_from("<QQQ", payload, 4 + 24 * i)
+            metas = [struct.unpack_from("<QQQQ", payload, 4 + 32 * i)
                      for i in range(n_blocks)]
-            for index, (size, k0, k1) in enumerate(metas):
+            for index, (size, k0, k1, crc) in enumerate(metas):
                 with self.throttle.acquire(size):
                     _send_frame(sock, MSG_TRANSFER_REQ,
                                 struct.pack("<IIIQ", shuffle_id, reduce_id,
@@ -344,7 +366,21 @@ class TcpShuffleClient(ShuffleClient):
                     if len(buf) != size:
                         raise TransportError(
                             f"short block: got {len(buf)} want {size}")
-                    yield (k0, k1), bytes(buf)
+                    # chaos checkpoint ("corrupt:transport.corrupt:N"): flip
+                    # a byte of the reassembled block so the CRC below must
+                    # catch it — proving mismatch → TransportError → the
+                    # fetch retry/failover/recompute ladder, end to end
+                    block = F.maybe_corrupt("transport.corrupt", bytes(buf))
+                    if crc != _NO_CRC:
+                        from spark_rapids_tpu.runtime.checksum import \
+                            block_checksum
+                        got = block_checksum(block)
+                        if got != crc:
+                            raise TransportError(
+                                f"shuffle {shuffle_id} reduce {reduce_id} "
+                                f"block {index} checksum mismatch (sent "
+                                f"{crc:#x}, got {got:#x}, {size}B)")
+                    yield (k0, k1), block
         finally:
             sock.close()
 
@@ -389,7 +425,8 @@ class TcpTransport(RapidsShuffleTransport):
         conf = conf or RapidsConf()
         codec = get_codec(conf.get(CFG.SHUFFLE_COMPRESSION_CODEC))
         self.store = ShuffleBlockStore.get()
-        self.server = TcpShuffleServer(self.store, codec)
+        self.server = TcpShuffleServer(self.store, codec,
+                                       checksum=conf.get(CFG.SHUFFLE_CHECKSUM))
         self.bounce_bytes = conf.get(CFG.SHUFFLE_BOUNCE_BUFFER_SIZE)
         self.throttle = InflightThrottle(conf.get(CFG.SHUFFLE_MAX_INFLIGHT_BYTES))
 

@@ -163,14 +163,22 @@ def test_explain_metrics_before_action():
 
 
 def test_query_resilience_isolated_across_queries():
+    """resilience_add pins each increment to the AMBIENT query's scoped
+    registry (not a start/finish delta of the process-wide one, which
+    CONCURRENT queries mutate inside each other's windows — the
+    multi-tenant scheduler's attribution contract)."""
     c1 = M.QueryMetricsCollector()
-    M.global_registry().metric(M.NUM_OOM_RETRIES).add(2)
-    c1.finish()
     c2 = M.QueryMetricsCollector()
-    M.global_registry().metric(M.NUM_OOM_RETRIES).add(3)
-    M.global_registry().metric(M.FETCH_RECOMPUTES).add(1)
+    # interleaved increments, the shape a concurrent peer produces: the old
+    # delta attribution would have charged c2's retries to c1 as well
+    with M.collector_context(c1):
+        M.resilience_add(M.NUM_OOM_RETRIES, 2)
+    with M.collector_context(c2):
+        M.resilience_add(M.NUM_OOM_RETRIES, 3)
+        M.resilience_add(M.FETCH_RECOMPUTES)
+    c1.finish()
     c2.finish()
-    # the process-wide registry accumulates; the per-query deltas isolate
+    # the process-wide registry accumulates; the scoped registries isolate
     assert M.resilience_snapshot()[M.NUM_OOM_RETRIES] == 5
     assert c1.query_resilience()[M.NUM_OOM_RETRIES] == 2
     assert c1.query_resilience()[M.FETCH_RECOMPUTES] == 0

@@ -207,9 +207,22 @@ def analyze(records: list) -> dict:
         pipeline_edges = sorted(edges.values(),
                                 key=lambda r: -(r["wait_s"] + r["full_s"]))
 
+        # admission lifecycle (runtime/scheduler.py): queue wait + declared
+        # footprint of this query's admission grant
+        admission = None
+        for e in evs:
+            if e["event"] == "query.admitted":
+                admission = {
+                    "waited_s": e.get("waited_s", 0.0),
+                    "estimate_bytes": e.get("estimate_bytes", 0),
+                    "priority": e.get("priority", 0),
+                    "running_at_admit": e.get("running", 1),
+                }
+
         queries.append({
             "query": qid,
             "description": rec.get("description", ""),
+            "admission": admission,
             "wall_s": wall_s,
             "total_self_s": round(total_self, 6),
             "coverage": round(total_self / wall_s, 3) if wall_s else None,
@@ -252,11 +265,38 @@ def analyze(records: list) -> dict:
                                 if r["event"] == "speculation.lost"),
     }
 
+    # multi-tenant admission/lifecycle (runtime/scheduler.py): aggregated
+    # across the whole log — shed submissions never reach query.end, and a
+    # cancelled query's story is its lifecycle events, not an operator table
+    waits = [r.get("waited_s", 0.0) for r in records
+             if r["event"] == "query.admitted"]
+    sheds = [{"query": r.get("query"), "reason": r.get("reason"),
+              "backoff_hint_s": r.get("backoff_hint_s")}
+             for r in records if r["event"] == "query.shed"]
+    cancelled = [{"query": r.get("query"), "reason": r.get("reason"),
+                  "kind": r["event"].split(".", 1)[1]}
+                 for r in records
+                 if r["event"] in ("query.cancelled", "query.deadline")]
+    demotions = [{"query": r.get("query"),
+                  "faulting_query": r.get("faulting_query"),
+                  "bytes": r.get("bytes", 0)}
+                 for r in records if r["event"] == "query.demoted"]
+    admission = {
+        "admitted": len(waits),
+        "queued": sum(1 for r in records if r["event"] == "query.queued"),
+        "max_wait_s": round(max(waits), 4) if waits else 0.0,
+        "mean_wait_s": round(sum(waits) / len(waits), 4) if waits else 0.0,
+        "shed": sheds,
+        "cancelled": cancelled,
+        "demotions": demotions,
+    }
+
     health = [r for r in records if r["event"] == "executor.health"]
     hb_loss = [r for r in records if r["event"] == "heartbeat.loss"]
     return {
         "queries": queries,
         "recovery": recovery,
+        "admission": admission,
         "events_total": len(records),
         "health_samples": len(health),
         "heartbeat_losses": len(hb_loss),
@@ -283,6 +323,12 @@ def render(analysis: dict, top: int = 15) -> str:
                    f"wall={q['wall_s']:.4f}s self-total={q['total_self_s']:.4f}s"
                    + (f" coverage={q['coverage']:.0%}"
                       if q["coverage"] is not None else ""))
+        adm = q.get("admission")
+        if adm is not None:
+            out.append(
+                f"  admission: waited {adm['waited_s']:.4f}s, estimate "
+                f"{_fmt_bytes(adm['estimate_bytes'])}, priority "
+                f"{adm['priority']}, {adm['running_at_admit']} running")
         out.append("  top operators by self time:")
         out.append(f"    {'self_s':>10}  {'rows':>12}  {'batches':>8}  operator")
         for r in q["operators"][:top]:
@@ -352,11 +398,29 @@ def render(analysis: dict, top: int = 15) -> str:
             out.append(f"  speculation: won={rec['speculation_won']} "
                        f"lost={rec['speculation_lost']}")
         out.append("")
+    adm = analysis.get("admission") or {}
+    if (adm.get("shed") or adm.get("cancelled") or adm.get("demotions")
+            or (adm.get("admitted", 0) and adm.get("max_wait_s", 0) > 0)):
+        out.append("== admission / lifecycle (driver-side query scheduler):")
+        out.append(f"  admitted={adm['admitted']} queued={adm['queued']} "
+                   f"wait mean={adm['mean_wait_s']:.4f}s "
+                   f"max={adm['max_wait_s']:.4f}s")
+        for s in adm.get("shed", []):
+            out.append(f"  shed {s['query']}: {s['reason']} "
+                       f"(retry after ~{s['backoff_hint_s']}s)")
+        for c in adm.get("cancelled", []):
+            out.append(f"  {c['kind']} {c['query']}: {c['reason']}")
+        for d in adm.get("demotions", []):
+            out.append(f"  demoted {d['query']} ({_fmt_bytes(d['bytes'])} "
+                       f"spilled) for faulting peer {d['faulting_query']}")
+        out.append("")
     out.append(f"{len(analysis['queries'])} queries, "
                f"{analysis['events_total']} events, "
                f"{analysis['health_samples']} health samples, "
                f"{analysis['heartbeat_losses']} heartbeat losses, "
-               f"{analysis['errors']} query errors")
+               f"{analysis['errors']} query errors, "
+               f"{len(adm.get('shed', []))} shed, "
+               f"{len(adm.get('cancelled', []))} cancelled")
     return "\n".join(out)
 
 
