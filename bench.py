@@ -473,7 +473,8 @@ def join_microbench(smoke: bool = False):
     }
 
 
-def concurrent_bench(n: int, query: str = "q18", reps: int = 2):
+def concurrent_bench(n: int, query: str = "q18", reps: int = 2,
+                     endpoint: bool = False):
     """Multi-tenant aggregate-throughput mode (``--concurrent N``): N copies
     of one TPC-H query run back-to-back (sequential) and then fanned out on
     N threads through the driver-side QueryScheduler (concurrent), value-
@@ -483,7 +484,16 @@ def concurrent_bench(n: int, query: str = "q18", reps: int = 2):
     faults — a peer's retries can no longer leak into another query's
     scope) and its distinct query id. On <2 cores the measurement still
     runs but the line carries ``gate_skipped`` so ci.sh can skip its
-    >=1.2x assertion with the reason logged."""
+    >=1.2x assertion with the reason logged.
+
+    ``--endpoint`` routes every submission through the Arrow-over-TCP
+    serving endpoint (runtime/endpoint.py) instead of in-process collects:
+    each worker is a real EndpointClient speaking SQL over a socket, the
+    per-query isolation evidence comes from the wire's summary frame, and
+    the line additionally embeds the process-wide resilience snapshot
+    (ci.sh asserts it all-zero — serving through the front door with no
+    faults must be invisible to every recovery ladder). Endpoint mode uses
+    the official SQL text, so the query must be one of q1/q3/q5."""
     import threading
     import jax
     if os.environ.get("JAX_PLATFORMS") == "cpu":
@@ -502,6 +512,9 @@ def concurrent_bench(n: int, query: str = "q18", reps: int = 2):
         "spark.rapids.tpu.scheduler.maxConcurrent": n,
     }
     spark = TpuSession(conf)
+
+    if endpoint:
+        return _endpoint_concurrent_bench(spark, paths, n, query, reps, cores)
 
     def build_df():
         dfs = tpch.load(spark, paths, files_per_partition=4)
@@ -573,6 +586,99 @@ def concurrent_bench(n: int, query: str = "q18", reps: int = 2):
             r and r["rows_ok"] and not r["resilience_nonzero"]
             and len({x["query_id"] for x in results}) == n
             for r in results),
+    }
+    if errors:
+        line["errors"] = errors
+    if cores < 2:
+        line["gate_skipped"] = (
+            f"{cores} core(s): concurrent queries cannot overlap on one "
+            "core; throughput gate needs >=2")
+    return line
+
+
+def _endpoint_concurrent_bench(spark, paths, n, query, reps, cores):
+    """The --endpoint half of concurrent_bench: n clients over TCP."""
+    import threading
+    from spark_rapids_tpu.benchmarks import tpch
+    from spark_rapids_tpu.runtime import metrics as M
+    from spark_rapids_tpu.runtime.endpoint import EndpointClient
+    from spark_rapids_tpu.sql.tpch_queries import SQL_QUERIES
+
+    assert query in SQL_QUERIES, \
+        f"--endpoint needs official SQL text; {query} not in {sorted(SQL_QUERIES)}"
+    sql = SQL_QUERIES[query]
+    tpch.load(spark, paths, files_per_partition=4)   # registers temp views
+    baseline = spark.sql(sql).collect().to_pylist()  # warm + value oracle
+    ep = spark.serve()
+    addr = ("127.0.0.1", ep.port)
+    try:
+        # sequential: n wire submissions back to back, per-rep median
+        seq_ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                cli = EndpointClient(addr, timeout_s=300)
+                rows = cli.submit(sql).to_pylist()
+                assert rows == baseline, "sequential endpoint run diverged"
+            seq_ts.append(time.perf_counter() - t0)
+        sequential_s = statistics.median(seq_ts)
+
+        def run_concurrent():
+            results = [None] * n
+            errors = []
+            barrier = threading.Barrier(n + 1)
+
+            def worker(i):
+                cli = EndpointClient(addr, timeout_s=300)
+                try:
+                    barrier.wait()
+                    rows = cli.submit(sql).to_pylist()
+                    s = cli.last_summary or {}
+                    results[i] = {
+                        "query_id": s.get("query"),
+                        "wall_s": s.get("wall_s"),
+                        "rows_ok": rows == baseline,
+                        "resilience_nonzero": s.get("resilience") or {},
+                    }
+                except BaseException as e:  # noqa: BLE001
+                    errors.append(repr(e)[:200])
+
+            threads = [threading.Thread(target=worker, args=(i,),
+                                        daemon=True) for i in range(n)]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
+            return time.perf_counter() - t0, results, errors
+
+        conc_ts, results, errors = [], None, None
+        for _ in range(reps):
+            wall, results, errors = run_concurrent()
+            if errors:
+                break
+            conc_ts.append(wall)
+        concurrent_s = statistics.median(conc_ts) if conc_ts else 0.0
+    finally:
+        ep.shutdown(grace_s=5)
+
+    line = {
+        "metric": f"tpch_sf{TPCH_SF}_{query}_endpoint_concurrent{n}",
+        "n": n, "query": query, "reps": reps, "cores": cores,
+        "endpoint": True,
+        "sequential_s": round(sequential_s, 4),
+        "concurrent_s": round(concurrent_s, 4),
+        "throughput_x": (round(sequential_s / concurrent_s, 3)
+                         if concurrent_s else 0.0),
+        "per_query": results,
+        "isolation_ok": bool(results) and all(
+            r and r["rows_ok"] and not r["resilience_nonzero"]
+            and len({x["query_id"] for x in results}) == n
+            for r in results),
+        # serving with no faults must be invisible to every recovery
+        # ladder — including the endpoint's own disconnect counter
+        "resilience": M.resilience_snapshot(),
     }
     if errors:
         line["errors"] = errors
@@ -674,13 +780,16 @@ if __name__ == "__main__":
         with watcher_paused():
             print(json.dumps(join_microbench(smoke="--smoke" in sys.argv)))
     elif "--concurrent" in sys.argv:
-        # multi-tenant aggregate-throughput mode: one JSON line
+        # multi-tenant aggregate-throughput mode: one JSON line;
+        # --endpoint routes every submission over the Arrow-over-TCP
+        # serving endpoint (SQL text, so q1/q3/q5 only)
         i = sys.argv.index("--concurrent")
         n = int(sys.argv[i + 1]) if len(sys.argv) > i + 1 else 4
+        ep_mode = "--endpoint" in sys.argv
         q = (sys.argv[sys.argv.index("--query") + 1]
-             if "--query" in sys.argv else "q18")
+             if "--query" in sys.argv else ("q5" if ep_mode else "q18"))
         with watcher_paused():
-            print(json.dumps(concurrent_bench(n, q)))
+            print(json.dumps(concurrent_bench(n, q, endpoint=ep_mode)))
     elif os.environ.get("_SRT_BENCH_CHILD") == "1":
         child_main()
     else:

@@ -193,6 +193,51 @@ else:
           d["cores"], "cores")
 ' "$conc_line"
 
+echo "== serving endpoint: wire chaos (mid-stream kill + shed + SIGTERM drain) =="
+# concurrent clients against the Arrow-over-TCP endpoint: one client killed
+# while its query is in flight (disconnect → CancelToken → clean drain), a
+# submission shed over the wire with its backoff hint arriving typed, then
+# a real SIGTERM drain under load — the in-flight query finishes
+# bit-identically, a mid-drain submission sheds with reason=draining, and
+# nothing leaks (threads/buffers/permits)
+ep_dir=$(mktemp -d)
+JAX_PLATFORMS=cpu python tools/endpoint_chaos.py \
+  --data-dir /tmp/tpch_ci_sf0.01 --eventlog-dir "$ep_dir"
+ep_log=$(ls "$ep_dir"/*.jsonl | head -1)
+python - "$ep_log" <<'PYEOF'
+import json, sys
+events = [json.loads(ln)["event"] for ln in open(sys.argv[1]) if ln.strip()]
+for want in ("endpoint.start", "client.connected", "client.disconnected",
+             "query.cancelled", "query.shed", "server.drain",
+             "endpoint.stop"):
+    assert want in events, (want, sorted(set(events)))
+print("endpoint event log ok:",
+      events.count("client.connected"), "connected,",
+      events.count("client.disconnected"), "disconnected,",
+      events.count("query.shed"), "shed,",
+      events.count("server.drain"), "server.drain")
+PYEOF
+rm -rf "$ep_dir"
+# endpoint + transport unit/integration suite (frame fuzz, CRC corruption,
+# disconnect cancellation both FIN and RST, drain, exception pickles)
+JAX_PLATFORMS=cpu python -m pytest tests/test_endpoint.py \
+  tests/test_transport.py -q
+
+echo "== serving endpoint: no-faults concurrent bench through the wire =="
+# N concurrent clients through the endpoint with no faults armed: isolation
+# evidence from the wire's summary frames, and EVERY process-wide resilience
+# counter zero — serving through the front door must be invisible to the
+# recovery ladders (including the endpoint's own disconnect counter)
+ep_line=$(JAX_PLATFORMS=cpu TPCH_SF=0.01 TPCH_DIR=/tmp/tpch_ci_sf0.01 \
+  python bench.py --concurrent 2 --endpoint --query q5 | tail -1)
+python -c '
+import json, sys
+d = json.loads(sys.argv[1])
+assert d["endpoint"] and d["isolation_ok"], d
+assert not any(d["resilience"].values()), d["resilience"]
+print("endpoint bench ok:", d["metric"], "throughput", d["throughput_x"], "x")
+' "$ep_line"
+
 echo "== observability: event log overhead + profiler gate =="
 # run the q18 ladder query with the event log disabled then enabled: the log
 # must add <5% wall time, and tools/profiler.py must replay it into a report

@@ -545,6 +545,55 @@ SCHEDULER_QUERY_DEADLINE = conf(
     "leaking threads, device buffers or semaphore permits. <=0 disables"
 ).double_conf(0.0)
 
+TRANSPORT_MAX_FRAME_BYTES = conf(
+    "spark.rapids.tpu.shuffle.transport.maxFrameBytes").doc(
+    "Upper bound on one length-prefixed wire frame (shuffle data plane AND "
+    "the query endpoint); a longer length prefix raises TransportError "
+    "BEFORE any allocation, so a corrupt/truncated header cannot trigger a "
+    "multi-GB read. Applied process-wide by whichever server/endpoint is "
+    "constructed with it").bytes_conf("1g")
+
+ENDPOINT_HOST = conf("spark.rapids.tpu.endpoint.host").doc(
+    "Bind address of the Arrow-over-TCP query endpoint "
+    "(runtime/endpoint.py); loopback by default — bind wider only behind "
+    "a trusted network boundary (the error channel carries pickled typed "
+    "exceptions)").string_conf("127.0.0.1")
+
+ENDPOINT_PORT = conf("spark.rapids.tpu.endpoint.port").doc(
+    "TCP port of the query endpoint; 0 picks an ephemeral port (exposed as "
+    "QueryEndpoint.port)").integer_conf(0)
+
+ENDPOINT_IDLE_TIMEOUT = conf("spark.rapids.tpu.endpoint.idleTimeoutSeconds").doc(
+    "Per-connection blocking-I/O timeout on the query endpoint: a client "
+    "that neither submits nor drains its result stream for this long is "
+    "treated as disconnected — its in-flight query is cancelled and its "
+    "connection closed (the keepalive window of the serving contract). "
+    "<=0 disables").double_conf(300.0)
+
+ENDPOINT_REQUEST_TIMEOUT = conf(
+    "spark.rapids.tpu.endpoint.requestTimeoutSeconds").doc(
+    "Wall-clock bound on one endpoint submission (queue wait + execution + "
+    "result streaming); past it the query's CancelToken flips with reason "
+    "request_timeout and the client receives the typed cancellation error. "
+    "<=0 disables (per-query scheduler deadlines still apply)"
+).double_conf(0.0)
+
+ENDPOINT_DRAIN_GRACE = conf("spark.rapids.tpu.endpoint.drain.graceSeconds").doc(
+    "Graceful-drain budget of QueryEndpoint.shutdown() (the SIGTERM path): "
+    "new submissions are shed immediately with a retryable "
+    "QueryRejectedError while in-flight queries get this long to finish; "
+    "past it their CancelTokens flip (reason drain) — the hard-kill "
+    "escalation — before the endpoint closes").double_conf(30.0)
+
+ENDPOINT_STREAM_BUFFER = conf(
+    "spark.rapids.tpu.endpoint.maxStreamBufferBytes").doc(
+    "Byte bound on result batches buffered between a query's executor and "
+    "its client connection (Arrow-IPC payload bytes); a slow client "
+    "backpressures the producer instead of growing the heap. The effective "
+    "budget also shrinks to the spill catalog's free host headroom "
+    "(runtime/memory.host_prefetch_budget), sharing the prefetch budget "
+    "with the scan readahead and pipeline queues").bytes_conf("64m")
+
 SHUFFLE_CHECKSUM = conf("spark.rapids.tpu.shuffle.checksum.enabled").doc(
     "Stamp every serialized shuffle block with a CRC32C checksum in the "
     "transport metadata and verify on fetch; a mismatch is a fetch failure "

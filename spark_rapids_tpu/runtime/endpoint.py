@@ -1,0 +1,688 @@
+"""Arrow-over-TCP query-serving endpoint — the network front door.
+
+The reference plugin is reachable because Spark itself is: external clients
+hand SQL to a Thrift/Connect server and stream columnar results back. This
+engine's multi-tenant core stopped at the Python API — PR 6 built admission
+control, deadlines, cooperative cancellation and overload shedding
+(runtime/scheduler.py) and made ``QueryRejectedError`` pickle-round-trippable
+*for exactly this boundary*. This module is the remaining half of ROADMAP
+item 2: a driver-side TCP server that accepts SQL submissions, routes them
+through the scheduler, and streams Arrow-IPC result batches back, speaking
+the shuffle transport's length-prefixed frame protocol
+(shuffle/transport.py ``send_frame``/``recv_frame``) with CRC32C-stamped
+payloads (runtime/checksum.py).
+
+The robustness core is the failure surface, not the happy path:
+
+- **Disconnect-driven cancellation.** Every active connection is watched
+  for half-close/RST/idle-timeout while its query runs; a lost client fires
+  the query's ``CancelToken`` (reason ``client_disconnect``) so the PR-6
+  drain path frees buffers, semaphore permits and shuffle map outputs —
+  a killed client costs the engine nothing beyond the work already done.
+- **Backpressure.** Result batches flow through a byte-bounded
+  :class:`_ResultStream` whose budget is capped by the shared host-prefetch
+  budget (``endpoint.maxStreamBufferBytes`` ∧ free host spill headroom): a
+  slow client stalls its own producer, never the heap or its neighbours.
+- **Graceful drain.** :meth:`QueryEndpoint.shutdown` (the SIGTERM path via
+  :meth:`install_signal_handlers`) stops accepting, sheds new submissions
+  with retryable backoff-hinted ``QueryRejectedError``, gives in-flight
+  queries ``endpoint.drain.graceSeconds`` to finish, then flips their
+  tokens (reason ``drain``) — the hard-kill escalation — before closing.
+- **Typed errors over the wire.** Server-side failures are pickled and
+  re-raised typed at the client: ``QueryRejectedError`` (with its
+  ``backoff_hint_s``), ``QueryCancelledError``/``QueryDeadlineError``,
+  ``DeviceOomError``, ``TransportError``, ``SpillCorruptionError`` — so
+  :meth:`EndpointClient.submit_with_retry` can honor the scheduler's own
+  backoff hints instead of guessing.
+- **Chaos surface.** Fault sites ``endpoint.accept`` / ``endpoint.recv`` /
+  ``endpoint.send`` (any armed kind fires, runtime/faults.py) and the
+  ``endpoint.corrupt`` payload site (byte flip AFTER the CRC is stamped,
+  so the client's verification must catch it) drive tools/endpoint_chaos.py
+  and tests/test_endpoint.py.
+
+Every transition is visible in the event log: ``endpoint.start`` /
+``endpoint.stop``, ``client.connected`` / ``client.disconnected``,
+``server.drain`` — alongside the scheduler's query lifecycle events.
+
+Trust model: the error channel carries pickled exceptions, so the endpoint
+binds loopback by default (``endpoint.host``) and belongs behind the same
+trust boundary as the shuffle data plane — it is the driver's front door,
+not an internet-facing gateway.
+"""
+
+from __future__ import annotations
+
+import collections
+import copy
+import json
+import pickle
+import select
+import socket
+import socketserver
+import struct
+import threading
+import time
+
+import pyarrow as pa
+
+from spark_rapids_tpu import config as CFG
+from spark_rapids_tpu.runtime import faults as F
+from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.runtime import scheduler as SCHED
+from spark_rapids_tpu.runtime.checksum import block_checksum
+from spark_rapids_tpu.shuffle.transport import (TransportError,
+                                                configure_socket,
+                                                max_frame_bytes as
+                                                _default_max_frame,
+                                                recv_frame, send_frame)
+
+# endpoint message ids — disjoint from the shuffle control plane's 1..5 so a
+# client pointed at the wrong port fails loudly instead of half-parsing
+MSG_SUBMIT = 16         # client→server: JSON request (sql + per-query knobs)
+MSG_RESULT_BATCH = 17   # server→client: <Q crc> + Arrow-IPC stream payload
+MSG_RESULT_END = 18     # server→client: JSON summary (query id, rows, ...)
+MSG_QUERY_ERROR = 19    # server→client: pickled typed exception
+MSG_PING = 20           # client→server: liveness probe
+MSG_PONG = 21           # server→client: liveness reply
+
+_CRC = struct.Struct("<Q")
+
+# request knobs a client may set per submission — mapped onto the session
+# conf keys the scheduler reads at submit time; everything else in the
+# request JSON is rejected (the wire must not become a generic conf setter)
+_REQUEST_KNOBS = {
+    "priority": (CFG.SCHEDULER_PRIORITY.key, int),
+    "deadline_s": (CFG.SCHEDULER_QUERY_DEADLINE.key, float),
+    "queue_timeout_s": (CFG.SCHEDULER_QUEUE_TIMEOUT.key, float),
+}
+
+
+def _table_to_ipc(tbl: pa.Table) -> bytes:
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, tbl.schema) as w:
+        w.write_table(tbl)
+    return sink.getvalue().to_pybytes()
+
+
+def _ipc_to_table(data: bytes) -> pa.Table:
+    return pa.ipc.open_stream(pa.BufferReader(data)).read_all()
+
+
+def _pickle_error(exc: BaseException) -> bytes:
+    try:
+        return pickle.dumps(exc)
+    except Exception:   # noqa: BLE001 — an unpicklable error still travels
+        return pickle.dumps(RuntimeError(
+            f"{type(exc).__name__}: {exc!r}"[:500]))
+
+
+def _unpickle_error(payload: bytes) -> BaseException:
+    try:
+        exc = pickle.loads(payload)
+    except Exception as e:   # noqa: BLE001
+        return TransportError(f"undecodable server error frame: {e!r}")
+    if isinstance(exc, BaseException):
+        return exc
+    return TransportError(f"server error frame was not an exception: {exc!r}")
+
+
+class _ResultStream:
+    """Byte-bounded handoff between a query's executor thread and its client
+    connection — the endpoint's backpressure edge. Same progress guarantee
+    as the pipeline queues: one item is always accepted when empty, so a
+    single result batch larger than the budget cannot deadlock the query.
+    The producer's full-wait runs :func:`scheduler.check_cancel`, so a
+    cancelled query (disconnect, drain, deadline) unblocks immediately."""
+
+    def __init__(self, max_bytes: int):
+        self._cond = threading.Condition()
+        self._items: collections.deque = collections.deque()
+        self._bytes = 0
+        self.max_bytes = max(1, int(max_bytes))
+        self._done = False
+        self._summary = None
+        self._error: BaseException | None = None
+        self._closed = False
+
+    def put(self, payload: bytes) -> bool:
+        """Producer side; blocks while over budget. False = consumer gone
+        (connection closed) — the producer must stop, not retry."""
+        with self._cond:
+            while (not self._closed and self._items
+                   and self._bytes + len(payload) > self.max_bytes):
+                SCHED.check_cancel()
+                self._cond.wait(0.05)
+            if self._closed:
+                return False
+            self._items.append(payload)
+            self._bytes += len(payload)
+            self._cond.notify_all()
+            return True
+
+    def finish(self, summary: dict) -> None:
+        with self._cond:
+            self._summary = summary
+            self._done = True
+            self._cond.notify_all()
+
+    def fail(self, exc: BaseException) -> None:
+        with self._cond:
+            self._error = exc
+            self._done = True
+            self._cond.notify_all()
+
+    def get(self, timeout: float):
+        """Consumer side: ("batch", bytes) | ("error", exc) |
+        ("end", summary) | None on timeout. Queued batches drain before a
+        terminal item is surfaced (results already produced still ship)."""
+        with self._cond:
+            if not self._items and not self._done:
+                self._cond.wait(timeout)
+            if self._items:
+                p = self._items.popleft()
+                self._bytes -= len(p)
+                self._cond.notify_all()
+                return ("batch", p)
+            if self._done:
+                if self._error is not None:
+                    return ("error", self._error)
+                return ("end", self._summary)
+            return None
+
+    def close(self) -> None:
+        """Consumer-side cancel: unblocks and stops the producer."""
+        with self._cond:
+            self._closed = True
+            self._items.clear()
+            self._bytes = 0
+            self._cond.notify_all()
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        endpoint: QueryEndpoint = self.server.owner   # type: ignore
+        endpoint._handle_connection(self.request, self.client_address)
+
+
+class QueryEndpoint:
+    """The serving endpoint bound to one :class:`TpuSession` (whose temp
+    views are the queryable catalog). Listening starts at construction;
+    ``with QueryEndpoint(session) as ep: ...`` drains on exit."""
+
+    def __init__(self, session, host: str | None = None,
+                 port: int | None = None):
+        from spark_rapids_tpu.runtime import eventlog as EL
+        from spark_rapids_tpu.shuffle import transport as TR
+        self.session = session
+        conf = session.conf
+        self.idle_timeout = conf.get(CFG.ENDPOINT_IDLE_TIMEOUT)
+        self.request_timeout = conf.get(CFG.ENDPOINT_REQUEST_TIMEOUT)
+        self.drain_grace = conf.get(CFG.ENDPOINT_DRAIN_GRACE)
+        self.stream_buffer = conf.get(CFG.ENDPOINT_STREAM_BUFFER)
+        TR.set_max_frame_bytes(conf.get(CFG.TRANSPORT_MAX_FRAME_BYTES))
+        self._draining = False
+        self._drain_deadline = None
+        self._closing = False
+        self._lock = threading.Lock()
+        self._conns: set = set()
+        self._active: dict = {}        # id(stream) -> {df, stream, query}
+        self._next_worker = 0
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+        self._srv = _Server((host or conf.get(CFG.ENDPOINT_HOST),
+                             port if port is not None
+                             else conf.get(CFG.ENDPOINT_PORT)), _Handler)
+        self._srv.owner = self
+        self.host, self.port = self._srv.server_address[:2]
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True, name="srt-endpoint")
+        self._thread.start()
+        EL.emit("endpoint.start", query=None, host=self.host, port=self.port)
+
+    # -- connection lifecycle ------------------------------------------------
+    def _handle_connection(self, sock, peer):
+        from spark_rapids_tpu.runtime import eventlog as EL
+        try:
+            # chaos: an armed endpoint.accept fault kills the connection at
+            # admission — the client observes connect-then-close and retries
+            F.maybe_inject_any("endpoint.accept")
+        except BaseException:   # noqa: BLE001 — any fault kind drops the conn
+            return
+        configure_socket(
+            sock, timeout_s=self.idle_timeout if self.idle_timeout > 0
+            else None)
+        with self._lock:
+            if self._closing:
+                return
+            self._conns.add(sock)
+        EL.emit("client.connected", query=None, peer=f"{peer[0]}:{peer[1]}")
+        try:
+            while not self._closing:
+                try:
+                    F.maybe_inject_any("endpoint.recv")
+                    msg, payload = recv_frame(sock)
+                except (TransportError, OSError, RuntimeError):
+                    return   # idle timeout, client close, or any fault kind
+                if msg == MSG_PING:
+                    send_frame(sock, MSG_PONG, b"")
+                    continue
+                if msg != MSG_SUBMIT:
+                    self._send_error(sock, TransportError(
+                        f"unexpected message {msg} (want SUBMIT)"))
+                    return
+                if not self._serve_query(sock, payload):
+                    return
+        except (OSError, RuntimeError):
+            return   # connection-level failure: the conn dies, not the server
+        finally:
+            with self._lock:
+                self._conns.discard(sock)
+
+    def _send_error(self, sock, exc) -> bool:
+        try:
+            send_frame(sock, MSG_QUERY_ERROR, _pickle_error(exc))
+            return True
+        except OSError:
+            return False
+
+    def _shed_draining(self, sock) -> bool:
+        remaining = 0.0
+        if self._drain_deadline is not None:
+            remaining = max(0.0, self._drain_deadline - time.monotonic())
+        hint = round(remaining + 1.0, 3)
+        return self._send_error(sock, SCHED.QueryRejectedError(
+            f"endpoint draining (shutdown in progress); retry another "
+            f"replica after ~{hint}s", backoff_hint_s=hint,
+            reason="draining"))
+
+    def _request_session(self, req: dict):
+        """Per-request session view: shares the server session's temp views
+        and process switches, but carries its own conf with the request's
+        scheduler knobs — concurrent requests must not mutate shared conf."""
+        overrides = {}
+        for field, (key, conv) in _REQUEST_KNOBS.items():
+            if req.get(field) is not None:
+                overrides[key] = conv(req[field])
+        sess = copy.copy(self.session)
+        if overrides:
+            sess.conf = self.session.conf.copy_with(**overrides)
+        return sess
+
+    # -- one submission ------------------------------------------------------
+    def _serve_query(self, sock, payload) -> bool:
+        """Run one submission and stream its results; returns False when the
+        connection is dead and the handler loop should exit."""
+        if self._draining:
+            return self._shed_draining(sock)
+        try:
+            req = json.loads(payload.decode("utf-8"))
+            sql = req["sql"]
+            unknown = set(req) - set(_REQUEST_KNOBS) - {"sql", "description"}
+            if unknown:
+                raise ValueError(f"unknown request fields {sorted(unknown)}")
+            sess = self._request_session(req)
+            df = sess.sql(sql)
+        except BaseException as e:   # noqa: BLE001 — parse/plan errors travel
+            return self._send_error(sock, e)
+
+        from spark_rapids_tpu.runtime.memory import host_prefetch_budget
+        stream = _ResultStream(host_prefetch_budget(self.stream_buffer))
+        entry = {"df": df, "stream": stream,
+                 "description": req.get("description", "")}
+        key = id(stream)
+        with self._lock:
+            raced_drain = self._draining   # raced shutdown(): shed, don't run
+            if not raced_drain:
+                self._active[key] = entry
+                self._next_worker += 1
+                wname = f"srt-endpoint-w{self._next_worker}"
+        if raced_drain:
+            return self._shed_draining(sock)
+        worker = threading.Thread(target=self._run_query,
+                                  args=(df, stream), daemon=True, name=wname)
+        worker.start()
+        try:
+            return self._pump(sock, df, stream)
+        finally:
+            # leak guard on EVERY exit path (including a pump bug or an
+            # unexpected fault class): the stream must be closed and a
+            # still-running worker cancelled, or it would block forever on a
+            # full stream nobody drains
+            stream.close()
+            if worker.is_alive():
+                self._cancel_query(df, "connection_closed", wait_s=1.0)
+            worker.join(timeout=60)
+            with self._lock:
+                self._active.pop(key, None)
+
+    def _run_query(self, df, stream: _ResultStream):
+        """Worker thread: execute the action, pushing each result batch into
+        the stream as a CRC-stamped Arrow-IPC payload. Partitions run in
+        order on this one thread (batch order must be deterministic for the
+        bit-identity contract); the pipelined executor still overlaps
+        decode/compute/exchange inside each partition, and the stream's
+        byte budget overlaps compute with the network send."""
+        from spark_rapids_tpu.exec.base import TaskContext, TpuExec
+        from spark_rapids_tpu.runtime import pipeline as P
+        counts = {"rows": 0, "batches": 0}
+
+        def sink(tbl: pa.Table):
+            body = _table_to_ipc(tbl)
+            crc = block_checksum(body)
+            # chaos: flip a byte AFTER the CRC is stamped — the client's
+            # verification must catch it and raise typed TransportError
+            body = F.maybe_corrupt("endpoint.corrupt", body)
+            if not stream.put(_CRC.pack(crc) + body):
+                SCHED.check_cancel()   # raises the token's typed error
+                raise SCHED.QueryCancelledError(
+                    "result stream closed by the connection")
+            counts["rows"] += tbl.num_rows
+            counts["batches"] += 1
+
+        def run(hybrid):
+            if isinstance(hybrid, TpuExec):
+                pipe_on = P.enabled(hybrid.conf)
+                for split in range(hybrid.num_partitions):
+                    with TaskContext():
+                        it = hybrid.execute_partition(split)
+                        if pipe_on:
+                            it = P.stage_iterator(
+                                it, edge="collect", conf=hybrid.conf,
+                                registry=hybrid.metrics,
+                                node_id=hybrid._node_id, spillable=True)
+                        for b in it:
+                            sink(b.to_arrow())
+                if counts["batches"] == 0:
+                    sink(hybrid.output.to_arrow().empty_table())
+            else:
+                sink(hybrid.collect_host())
+            return None
+
+        try:
+            df._run_action(df._plan, run)
+            qm = df._last_collector
+            stream.finish({
+                "query": qm.query_id, "rows": counts["rows"],
+                "batches": counts["batches"],
+                "wall_s": round(qm.wall_s, 4),
+                "resilience": {k: v for k, v in
+                               qm.query_resilience().items() if v},
+            })
+        except BaseException as e:   # noqa: BLE001 — marshalled to the client
+            stream.fail(e)
+
+    def _cancel_query(self, df, reason: str, wait_s: float = 5.0) -> str | None:
+        """Flip the query's CancelToken (waiting briefly for the collector to
+        exist — the submit/disconnect race is microseconds wide); returns the
+        query id when known."""
+        deadline = time.monotonic() + wait_s
+        while time.monotonic() < deadline:
+            c = df._last_collector
+            tok = getattr(c, "cancel_token", None) if c is not None else None
+            if tok is not None:
+                tok.cancel(reason)
+                return c.query_id
+            time.sleep(0.01)
+        return None
+
+    def _pump(self, sock, df, stream: _ResultStream) -> bool:
+        """Connection-thread loop: watch the socket for disconnect while
+        relaying stream items as frames. Returns False when the connection
+        died (the handler loop must exit)."""
+        deadline = (time.monotonic() + self.request_timeout
+                    if self.request_timeout > 0 else None)
+        timed_out = False
+        while True:
+            # disconnect probe: the client sends nothing mid-query, so any
+            # readability is a half-close (b""), an RST (OSError), or a
+            # protocol violation — all treated as a lost client
+            try:
+                readable, _, _ = select.select([sock], [], [], 0)
+            except (OSError, ValueError):
+                readable = [sock]
+            if readable:
+                try:
+                    data = sock.recv(1 << 16)
+                except OSError:
+                    data = b""
+                # half-close (b""), RST (OSError) and mid-query traffic (a
+                # protocol violation) all end the connection the same way
+                return self._disconnected(df, stream,
+                                          half_close=not data)
+            if deadline is not None and not timed_out \
+                    and time.monotonic() > deadline:
+                timed_out = True
+                self._cancel_query(df, "request_timeout")
+            item = stream.get(timeout=0.05)
+            if item is None:
+                continue
+            kind, val = item
+            try:
+                if kind == "batch":
+                    F.maybe_inject_any("endpoint.send")
+                    send_frame(sock, MSG_RESULT_BATCH, val)
+                elif kind == "end":
+                    send_frame(sock, MSG_RESULT_END,
+                               json.dumps(val).encode("utf-8"))
+                    return True
+                else:   # error
+                    return self._send_error(sock, val)
+            except (OSError, RuntimeError) as e:
+                # a dead client socket, or an injected endpoint.send fault
+                # of any kind: the server-side write path died —
+                # indistinguishable from a lost client
+                return self._disconnected(
+                    df, stream, send_fault=isinstance(e, RuntimeError))
+
+    def _disconnected(self, df, stream: _ResultStream, **detail) -> bool:
+        from spark_rapids_tpu.runtime import eventlog as EL
+        qid = self._cancel_query(df, "client_disconnect")
+        M.resilience_add(M.CLIENT_DISCONNECTS)
+        EL.emit("client.disconnected", query=qid, **detail)
+        stream.close()
+        return False
+
+    # -- drain / shutdown ----------------------------------------------------
+    def active_queries(self) -> int:
+        with self._lock:
+            return len(self._active)
+
+    def shutdown(self, grace_s: float | None = None) -> dict:
+        """Graceful drain: stop accepting, shed new submissions (retryable,
+        backoff-hinted), let in-flight queries finish within ``grace_s``
+        (default ``endpoint.drain.graceSeconds``), then deadline-kill the
+        stragglers via their CancelTokens — the hard-kill escalation — and
+        close every connection. Idempotent; returns drain statistics."""
+        from spark_rapids_tpu.runtime import eventlog as EL
+        grace = self.drain_grace if grace_s is None else grace_s
+        with self._lock:
+            first = not self._draining
+            self._draining = True
+            if first:
+                self._drain_deadline = time.monotonic() + max(0.0, grace)
+            in_flight = len(self._active)
+        if not first:
+            return {"in_flight": in_flight, "cancelled": 0, "repeat": True}
+        EL.emit("server.drain", query=None, phase="begin",
+                in_flight=in_flight, grace_s=grace)
+        # the listener stays up through the grace window: a client arriving
+        # mid-drain gets the typed QueryRejectedError with a backoff hint
+        # (retry another replica / later) instead of a blind refused connect
+        while time.monotonic() < self._drain_deadline and self.active_queries():
+            time.sleep(0.05)
+        cancelled = 0
+        with self._lock:
+            stragglers = list(self._active.values())
+        for entry in stragglers:
+            if self._cancel_query(entry["df"], "drain", wait_s=0.5):
+                cancelled += 1
+        # bounded wait for the cancelled queries to drain through their
+        # cooperative checkpoints, then stop accepting and force the
+        # remaining connections closed
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and self.active_queries():
+            time.sleep(0.05)
+        self._srv.shutdown()
+        self._srv.server_close()
+        self._closing = True
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._thread.join(timeout=5)
+        stats = {"in_flight": in_flight, "cancelled": cancelled,
+                 "leaked": self.active_queries()}
+        EL.emit("server.drain", query=None, phase="end", **stats)
+        EL.emit("endpoint.stop", query=None, port=self.port)
+        return stats
+
+    def install_signal_handlers(self, grace_s: float | None = None) -> None:
+        """SIGTERM → graceful drain (main thread only). The handler runs
+        shutdown() on a helper thread so the signal frame returns
+        immediately; the process exits once the drain completes and the
+        caller's main loop observes ``draining``."""
+        import signal
+
+        def _on_term(signum, frame):
+            threading.Thread(target=self.shutdown, args=(grace_s,),
+                             daemon=True, name="srt-endpoint-drain").start()
+        signal.signal(signal.SIGTERM, _on_term)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def __enter__(self) -> "QueryEndpoint":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+class EndpointClient:
+    """Remote submitter (tools/tpu_client.py is the CLI front). One
+    connection per submission; closing the connection mid-stream is the
+    cancellation protocol — the server cancels the query on disconnect."""
+
+    def __init__(self, address, *, timeout_s: float = 60.0,
+                 max_frame_bytes: int | None = None):
+        self.address = tuple(address)
+        self.timeout_s = timeout_s
+        self.max_frame = max_frame_bytes or _default_max_frame()
+        self.last_summary: dict | None = None
+
+    def connect(self):
+        try:
+            sock = socket.create_connection(self.address,
+                                            timeout=self.timeout_s)
+        except OSError as e:
+            raise TransportError(
+                f"endpoint {self.address} unreachable: {e}") from e
+        configure_socket(sock, timeout_s=self.timeout_s)
+        return sock
+
+    def ping(self) -> bool:
+        sock = self.connect()
+        try:
+            send_frame(sock, MSG_PING, b"")
+            msg, _ = recv_frame(sock, max_bytes=self.max_frame)
+            return msg == MSG_PONG
+        except (TransportError, OSError):
+            return False
+        finally:
+            sock.close()
+
+    def submit_iter(self, sql: str, *, priority: int | None = None,
+                    deadline_s: float | None = None,
+                    queue_timeout_s: float | None = None,
+                    description: str = ""):
+        """Generator of result tables, one per streamed Arrow-IPC batch;
+        ``self.last_summary`` carries the MSG_RESULT_END stats afterwards.
+        Abandoning the generator closes the connection, which cancels the
+        query server-side. Raises the server's typed exception on failure
+        and TransportError on any wire-level fault (CRC mismatch, short
+        read, reset)."""
+        req = {"sql": sql, "description": description,
+               "priority": priority, "deadline_s": deadline_s,
+               "queue_timeout_s": queue_timeout_s}
+        sock = self.connect()
+        try:
+            try:
+                send_frame(sock, MSG_SUBMIT, json.dumps(
+                    {k: v for k, v in req.items() if v is not None}
+                ).encode("utf-8"))
+                while True:
+                    msg, payload = recv_frame(sock, max_bytes=self.max_frame)
+                    if msg == MSG_RESULT_BATCH:
+                        (crc,) = _CRC.unpack_from(payload, 0)
+                        body = payload[_CRC.size:]
+                        got = block_checksum(body)
+                        if got != crc:
+                            raise TransportError(
+                                f"result batch checksum mismatch (sent "
+                                f"{crc:#x}, got {got:#x}, {len(body)}B)")
+                        yield _ipc_to_table(body)
+                    elif msg == MSG_RESULT_END:
+                        self.last_summary = json.loads(payload)
+                        return
+                    elif msg == MSG_QUERY_ERROR:
+                        raise _unpickle_error(payload)
+                    else:
+                        raise TransportError(
+                            f"unexpected endpoint message {msg}")
+            except TransportError:
+                raise
+            except OSError as e:
+                raise TransportError(
+                    f"endpoint {self.address} connection failed: {e}") from e
+        finally:
+            sock.close()
+
+    def submit(self, sql: str, **kw) -> pa.Table:
+        """Submit and collect the whole result (a schema-bearing empty table
+        for empty results)."""
+        tables = list(self.submit_iter(sql, **kw))
+        return pa.concat_tables(tables)
+
+    def submit_with_retry(self, sql: str, *, max_attempts: int = 5,
+                          backoff_cap_s: float = 10.0, on_retry=None,
+                          **kw) -> pa.Table:
+        """Submit, honoring the serving contract: a retryable rejection
+        (shed/drain) sleeps its ``backoff_hint_s``; a transport fault
+        (endpoint died mid-handshake, reset) retries with jittered
+        exponential backoff; non-retryable typed errors propagate
+        immediately."""
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                return self.submit(sql, **kw)
+            except SCHED.QueryRejectedError as e:
+                if attempt >= max_attempts:
+                    raise
+                delay = min(max(0.05, e.backoff_hint_s), backoff_cap_s)
+            except TransportError as e:
+                if attempt >= max_attempts or not getattr(
+                        e, "retryable", False):
+                    raise
+                delay = min(0.1 * (2 ** (attempt - 1)), backoff_cap_s)
+            if on_retry is not None:
+                on_retry(attempt, delay)
+            time.sleep(delay)

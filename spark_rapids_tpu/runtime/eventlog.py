@@ -59,6 +59,10 @@ KNOWN_EVENTS = frozenset({
     # pipelined executor queue edges (runtime/pipeline.py): a producer or
     # consumer blocked past the stall threshold, bounded per queue
     "pipeline.stall",
+    # query-serving endpoint (runtime/endpoint.py): listener lifecycle,
+    # client connections, disconnect-driven cancellation, graceful drain
+    "endpoint.start", "endpoint.stop",
+    "client.connected", "client.disconnected", "server.drain",
 })
 
 # events that only make sense inside a query's dynamic extent; the profiler

@@ -59,7 +59,12 @@ exactly one of N executors mid-task), and "cluster.map.begin" /
 "cluster.result.begin" (+ ".<idx>") once at task START — the site that
 still fires when a task's input produces zero batches; the driver disarms
 faults on respawned replacement executors so a COUNT trigger cannot
-re-fire forever.
+re-fire forever. The query-serving endpoint (runtime/endpoint.py) checks
+"endpoint.accept" (connection admitted), "endpoint.recv" (request frame
+read) and "endpoint.send" (per result frame) via :func:`maybe_inject_any`
+— any armed kind fires at the wire — and "endpoint.corrupt" is a
+:func:`maybe_corrupt` payload site (result batch after its CRC is stamped,
+so the client's verification must catch the flip).
 """
 
 from __future__ import annotations
@@ -219,7 +224,8 @@ def maybe_corrupt(site: str, data: bytes) -> bytes:
     the other side of the wire/spill must catch it; otherwise return `data`
     unchanged. Sites: "transport.corrupt" (client-side block reassembly,
     shuffle/transport.py) and "spill.write" (disk-tier spill payload,
-    runtime/memory.py)."""
+    runtime/memory.py) and "endpoint.corrupt" (result batch after CRC
+    stamping, runtime/endpoint.py)."""
     if not _active or not data:
         return data
     if _select(site, lambda k: k == "corrupt") is None:

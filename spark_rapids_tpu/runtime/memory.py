@@ -65,7 +65,12 @@ class SpillCorruptionError(RuntimeError):
     (memory.spill.checksum.enabled). Shuffle readers treat this exactly
     like a fetch failure — invalidate the map outputs, recompute — instead
     of decoding silently corrupt rows (the Spark shuffle-checksum →
-    FetchFailed contract, SPARK-35275 analog)."""
+    FetchFailed contract, SPARK-35275 analog). ``retryable`` marks a
+    resubmission safe at the serving boundary (the recompute ladder already
+    ran server-side); single-arg construction keeps the default pickle
+    round-trip lossless for the endpoint's error channel."""
+
+    retryable = True
 
 
 @dataclasses.dataclass

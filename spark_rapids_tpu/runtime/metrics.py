@@ -83,13 +83,18 @@ SPECULATION_LOST = "speculationLost"
 QUERIES_SHED = "queriesShed"
 QUERIES_CANCELLED = "queriesCancelled"
 QUERY_DEMOTIONS = "queryDemotions"
+# serving endpoint (runtime/endpoint.py): a client connection lost while its
+# query was in flight (half-close, RST, or idle-timeout expiry) — the query
+# was cancelled by the disconnect path
+CLIENT_DISCONNECTS = "clientDisconnects"
 
 RESILIENCE_METRICS = (NUM_OOM_RETRIES, NUM_OOM_SPLIT_RETRIES, OOM_SPILL_BYTES,
                       FETCH_RETRIES, FETCH_FAILOVERS, FETCH_RECOMPUTES,
                       TASK_ATTEMPTS, EXECUTORS_LOST, EXECUTORS_BLACKLISTED,
                       STAGE_PARTIAL_RECOMPUTES, MAP_TASKS_RECOMPUTED,
                       SPECULATION_WON, SPECULATION_LOST,
-                      QUERIES_SHED, QUERIES_CANCELLED, QUERY_DEMOTIONS)
+                      QUERIES_SHED, QUERIES_CANCELLED, QUERY_DEMOTIONS,
+                      CLIENT_DISCONNECTS)
 
 
 class GpuMetric:

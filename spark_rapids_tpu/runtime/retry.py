@@ -42,10 +42,19 @@ from spark_rapids_tpu.runtime import metrics as M
 from spark_rapids_tpu.runtime import tracing
 
 
+def _rebuild_oom(cls, msg, requested, budget, spillable_bytes, pinned_bytes,
+                 injected):
+    return cls(msg, requested=requested, budget=budget,
+               spillable_bytes=spillable_bytes, pinned_bytes=pinned_bytes,
+               injected=injected)
+
+
 class DeviceOomError(RuntimeError):
     """Device (HBM budget) OOM — the RetryOOM analog. ``retryable`` marks it
     recoverable by the with_retry ladder: release this attempt's work, spill,
-    (maybe) split the input, re-run."""
+    (maybe) split the input, re-run. Pickles losslessly (context fields and
+    the concrete subclass preserved) so the serving endpoint can ship an
+    unrecovered OOM to a remote client typed."""
 
     retryable = True
 
@@ -58,6 +67,11 @@ class DeviceOomError(RuntimeError):
         self.spillable_bytes = spillable_bytes
         self.pinned_bytes = pinned_bytes
         self.injected = injected
+
+    def __reduce__(self):
+        return (_rebuild_oom, (type(self), str(self), self.requested,
+                               self.budget, self.spillable_bytes,
+                               self.pinned_bytes, self.injected))
 
 
 class SplitAndRetryOom(DeviceOomError):

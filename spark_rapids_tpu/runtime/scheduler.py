@@ -96,10 +96,16 @@ class QueryRejectedError(RuntimeError):
                                     self.query_id, self.reason))
 
 
+def _rebuild_cancelled(cls, msg, query_id, reason):
+    return cls(msg, query_id=query_id, reason=reason)
+
+
 class QueryCancelledError(RuntimeError):
     """The query's CancelToken fired (session.cancel / a chaos ``cancel``
     fault). NOT retryable by the OOM ladder — cancellation must drain the
-    pipeline, not re-run it."""
+    pipeline, not re-run it. Pickles losslessly (subclass, query_id and
+    reason preserved) so the serving endpoint can ship a drain/disconnect/
+    deadline kill to a remote client typed."""
 
     retryable = False
 
@@ -108,6 +114,10 @@ class QueryCancelledError(RuntimeError):
         super().__init__(msg)
         self.query_id = query_id
         self.reason = reason
+
+    def __reduce__(self):
+        return (_rebuild_cancelled, (type(self), str(self), self.query_id,
+                                     self.reason))
 
 
 class QueryDeadlineError(QueryCancelledError):
@@ -170,7 +180,7 @@ class CancelToken:
             cls = (QueryDeadlineError if self._reason == "deadline"
                    else QueryCancelledError)
             raise cls(f"query {self.query_id} {self._reason}",
-                      query_id=self.query_id)
+                      query_id=self.query_id, reason=self._reason)
         if self._deadline is not None and time.monotonic() >= self._deadline:
             self.cancel("deadline")
             raise QueryDeadlineError(

@@ -680,6 +680,17 @@ class TpuSession:
         from spark_rapids_tpu.runtime.scheduler import QueryScheduler
         return QueryScheduler.get().active_queries()
 
+    def serve(self, host: str | None = None, port: int | None = None):
+        """Start the Arrow-over-TCP query endpoint on this session
+        (runtime/endpoint.py): remote clients submit SQL over this
+        session's temp views and stream Arrow-IPC result batches back,
+        routed through the multi-tenant scheduler (admission, priority,
+        deadline, shedding). Listening starts immediately; call
+        ``.shutdown()`` (or use as a context manager) for a graceful
+        drain. host/port default to endpoint.host / endpoint.port."""
+        from spark_rapids_tpu.runtime.endpoint import QueryEndpoint
+        return QueryEndpoint(self, host=host, port=port)
+
     # -- data sources --------------------------------------------------------
     def read_parquet(self, path, pushed_filter=None,
                      files_per_partition: int = 1) -> DataFrame:

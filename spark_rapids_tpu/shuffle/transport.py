@@ -42,7 +42,51 @@ _FRAME = struct.Struct("<BI")            # msg type, payload length
 
 class TransportError(RuntimeError):
     """Fetch failure → the caller turns this into a recompute, the way
-    TransferError becomes FetchFailedException (RapidsShuffleIterator.scala:82)."""
+    TransferError becomes FetchFailedException (RapidsShuffleIterator.scala:82).
+    ``retryable`` marks it safe to resubmit at the serving boundary (the
+    recompute/failover ladders already ran server-side); pickles losslessly
+    so the query endpoint can ship it to a remote client typed."""
+
+    retryable = True
+
+
+# frame-length sanity bound (transport.maxFrameBytes): a corrupt or hostile
+# length prefix must raise a typed error BEFORE any allocation, not attempt
+# a multi-GB read. Process-global like the codec registry; TcpTransport and
+# the query endpoint apply their conf value at construction.
+DEFAULT_MAX_FRAME_BYTES = 1 << 30
+_max_frame_bytes = DEFAULT_MAX_FRAME_BYTES
+
+
+def set_max_frame_bytes(n: int) -> None:
+    global _max_frame_bytes
+    _max_frame_bytes = int(n) if n and int(n) > 0 else DEFAULT_MAX_FRAME_BYTES
+
+
+def max_frame_bytes() -> int:
+    return _max_frame_bytes
+
+
+def configure_socket(sock, *, timeout_s: "float | None" = None) -> None:
+    """Shared socket discipline for every long-lived data-plane connection
+    (shuffle fetch, query endpoint): SO_KEEPALIVE so the OS detects dead
+    peers instead of only heartbeat expiry, TCP_NODELAY so small control
+    frames are not nagled behind bulk data, aggressive keepalive probes
+    where the platform exposes them, and an optional blocking timeout."""
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    # probe after 30s idle, every 10s, declare dead after 3 misses — only
+    # where the platform exposes the knobs (Linux); the portable SO_KEEPALIVE
+    # default (2h) still beats no detection at all
+    for opt, val in (("TCP_KEEPIDLE", 30), ("TCP_KEEPINTVL", 10),
+                     ("TCP_KEEPCNT", 3)):
+        if hasattr(socket, opt):
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, getattr(socket, opt), val)
+            except OSError:
+                pass
+    if timeout_s is not None:
+        sock.settimeout(timeout_s)
 
 
 def _send_frame(sock, msg_type: int, payload: bytes):
@@ -62,13 +106,24 @@ def _recv_exact(sock, n: int) -> bytes:
     return bytes(buf)
 
 
-def _recv_frame(sock):
+def _recv_frame(sock, max_bytes: "int | None" = None):
     # chaos hook: an injected "transport:transport.recv" fault models a
     # truncated/NEVER-arriving frame on the read side
     F.maybe_inject("transport", "transport.recv")
     hdr = _recv_exact(sock, _FRAME.size)
     msg_type, length = _FRAME.unpack(hdr)
+    limit = max_bytes if max_bytes is not None else _max_frame_bytes
+    if length > limit:
+        raise TransportError(
+            f"frame length {length} exceeds transport.maxFrameBytes={limit} "
+            "(corrupt or truncated length prefix)")
     return msg_type, _recv_exact(sock, length)
+
+
+# public aliases: the query endpoint (runtime/endpoint.py) speaks the same
+# length-prefixed frame protocol over its own message-id space
+send_frame = _send_frame
+recv_frame = _recv_frame
 
 
 class BlockMeta:
@@ -339,6 +394,10 @@ class TcpShuffleClient(ShuffleClient):
 
     def _fetch_serialized(self, shuffle_id, reduce_id):
         sock = socket.create_connection(self.address, timeout=30)
+        # keepalive + nodelay + timeout: a peer that died without closing is
+        # detected by the OS probes / the socket timeout, not only by the
+        # heartbeat manager's (much slower) expiry ladder
+        configure_socket(sock, timeout_s=30)
         try:
             _send_frame(sock, MSG_METADATA_REQ,
                         struct.pack("<II", shuffle_id, reduce_id))
@@ -424,6 +483,7 @@ class TcpTransport(RapidsShuffleTransport):
         from spark_rapids_tpu.config import RapidsConf
         conf = conf or RapidsConf()
         codec = get_codec(conf.get(CFG.SHUFFLE_COMPRESSION_CODEC))
+        set_max_frame_bytes(conf.get(CFG.TRANSPORT_MAX_FRAME_BYTES))
         self.store = ShuffleBlockStore.get()
         self.server = TcpShuffleServer(self.store, codec,
                                        checksum=conf.get(CFG.SHUFFLE_CHECKSUM))

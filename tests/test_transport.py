@@ -20,8 +20,9 @@ from spark_rapids_tpu.shuffle.heartbeat import (
 )
 from spark_rapids_tpu.shuffle.manager import ShuffleBlockStore
 from spark_rapids_tpu.shuffle.transport import (
-    InflightThrottle, LocalTransport, RapidsShuffleTransport, TcpTransport,
-    TransportError,
+    DEFAULT_MAX_FRAME_BYTES, InflightThrottle, LocalTransport,
+    RapidsShuffleTransport, TcpTransport, TransportError, configure_socket,
+    max_frame_bytes, recv_frame, send_frame, set_max_frame_bytes,
 )
 
 
@@ -201,6 +202,106 @@ def test_oversized_block_still_transfers():
     th = InflightThrottle(10)
     with th.acquire(1000):
         pass
+
+
+# -- frame hardening (wire fuzz: corrupt/truncated prefixes) ------------------
+
+def _socketpair():
+    import socket
+    return socket.socketpair()
+
+
+def test_recv_frame_rejects_oversized_length_before_allocating():
+    """A corrupt length prefix must raise TransportError instead of
+    attempting a multi-GB read (transport.maxFrameBytes)."""
+    import struct
+    a, b = _socketpair()
+    try:
+        # a header claiming a 1 TB payload, then nothing
+        a.sendall(struct.pack("<BI", 2, (1 << 32) - 1))
+        set_max_frame_bytes(1 << 20)
+        with pytest.raises(TransportError, match="maxFrameBytes"):
+            recv_frame(b)
+    finally:
+        set_max_frame_bytes(DEFAULT_MAX_FRAME_BYTES)
+        a.close()
+        b.close()
+
+
+def test_recv_frame_explicit_limit_and_exact_bound():
+    a, b = _socketpair()
+    try:
+        send_frame(a, 7, b"x" * 64)
+        msg, payload = recv_frame(b, max_bytes=64)   # exactly at the bound
+        assert msg == 7 and payload == b"x" * 64
+        send_frame(a, 7, b"y" * 65)
+        with pytest.raises(TransportError, match="maxFrameBytes"):
+            recv_frame(b, max_bytes=64)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_frame_truncated_header_and_payload():
+    a, b = _socketpair()
+    try:
+        a.sendall(b"\x02\xff")   # 2 of 5 header bytes, then close
+        a.close()
+        with pytest.raises(TransportError, match="peer closed"):
+            recv_frame(b)
+    finally:
+        b.close()
+    import struct
+    a, b = _socketpair()
+    try:
+        # full header promising 100 bytes, only 10 delivered, then close
+        a.sendall(struct.pack("<BI", 2, 100) + b"z" * 10)
+        a.close()
+        with pytest.raises(TransportError, match="peer closed"):
+            recv_frame(b)
+    finally:
+        b.close()
+
+
+def test_tcp_transport_applies_max_frame_conf(store):
+    transport = TcpTransport(RapidsConf({
+        "spark.rapids.tpu.shuffle.transport.maxFrameBytes": "2m"}))
+    try:
+        assert max_frame_bytes() == 2 << 20
+    finally:
+        transport.shutdown()
+        set_max_frame_bytes(DEFAULT_MAX_FRAME_BYTES)
+
+
+def test_configure_socket_sets_keepalive_nodelay_timeout():
+    import socket
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    a = socket.create_connection(srv.getsockname(), timeout=5)
+    b, _ = srv.accept()
+    try:
+        configure_socket(a, timeout_s=12.5)
+        assert a.getsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE) == 1
+        assert a.getsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY) != 0
+        assert a.gettimeout() == 12.5
+        configure_socket(b)          # no timeout: blocking socket untouched
+        assert b.gettimeout() is None
+    finally:
+        a.close()
+        b.close()
+        srv.close()
+
+
+def test_transport_error_pickle_roundtrip_retryable():
+    """TransportError crosses the serving wire typed: the pickle must keep
+    the message, the class, and the retryable marker."""
+    import pickle
+    e = TransportError("peer ('1.2.3.4', 9) fetch failed: ECONNRESET")
+    rt = pickle.loads(pickle.dumps(e))
+    assert type(rt) is TransportError
+    assert str(rt) == str(e)
+    assert rt.retryable is True
 
 
 # -- heartbeat ---------------------------------------------------------------

@@ -1,0 +1,265 @@
+"""Endpoint-chaos gate: concurrent clients against the Arrow-over-TCP query
+endpoint, with a client killed mid-flight, a submission shed over the wire,
+and a SIGTERM graceful drain under load.
+
+The serving contract (runtime/endpoint.py), proven end to end in one
+process:
+
+  - q5 is submitted over TCP and its client is KILLED while the query is
+    mid-aggregation (a ``slow:agg.update`` fault pins the race): the server
+    detects the half-close, fires the query's CancelToken
+    (``client.disconnected`` + ``query.cancelled`` in the event log), and
+    the drain leaks nothing — threads, catalog buffers, semaphore permits.
+  - q1 and q3 are the survivors: their endpoint results are bit-identical
+    to direct in-process collects, with every query-scoped resilience
+    counter zero (the wire's summary frame carries the scoped counters).
+  - a submission against a deterministically full scheduler sheds with a
+    retryable QueryRejectedError whose ``backoff_hint_s`` arrives TYPED at
+    the client — the pickle round-trip is the wire itself.
+  - SIGTERM (the real signal, via install_signal_handlers) drains the
+    endpoint under load: an in-flight q1 finishes bit-identically, a
+    submission arriving mid-drain sheds with reason ``draining`` and a
+    backoff hint, and ``server.drain`` begin/end land in the event log.
+
+Usage:
+  python tools/endpoint_chaos.py --data-dir DIR --eventlog-dir DIR
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import os
+import pathlib
+import signal
+import sys
+import threading
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="endpoint_chaos.py", description=__doc__)
+    p.add_argument("--data-dir", required=True)
+    p.add_argument("--eventlog-dir", required=True)
+    p.add_argument("--sf", type=float, default=0.01)
+    args = p.parse_args(argv)
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import spark_rapids_tpu  # noqa: F401  (enables x64)
+    from spark_rapids_tpu.benchmarks import tpch
+    from spark_rapids_tpu.runtime import eventlog
+    from spark_rapids_tpu.runtime import faults
+    from spark_rapids_tpu.runtime import metrics as M
+    from spark_rapids_tpu.runtime import scheduler as SCHED
+    from spark_rapids_tpu.runtime.endpoint import EndpointClient
+    from spark_rapids_tpu.runtime.memory import DeviceManager
+    from spark_rapids_tpu.runtime.semaphore import TpuSemaphore
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.sql.tpch_queries import SQL_QUERIES
+
+    paths = tpch.generate(args.sf, args.data_dir)
+    base_conf = {
+        "spark.rapids.tpu.sql.format.parquet.reader.type": "COALESCING",
+        "spark.rapids.tpu.pipeline.enabled": True,
+    }
+
+    # -- solo baselines (no faults, before the event log opens) --------------
+    solo_spark = TpuSession(base_conf)
+    tpch.load(solo_spark, paths, files_per_partition=4)
+    solo = {q: solo_spark.sql(SQL_QUERIES[q]).collect().to_pylist()
+            for q in ("q1", "q3", "q5")}
+
+    cat = DeviceManager.get().catalog
+    buffers_base = cat.num_buffers
+
+    # -- the serving session: event log armed, endpoint up --------------------
+    server_spark = TpuSession(dict(base_conf, **{
+        "spark.rapids.tpu.eventLog.dir": args.eventlog_dir,
+        "spark.rapids.tpu.scheduler.maxConcurrent": 4,
+    }))
+    tpch.load(server_spark, paths, files_per_partition=4)
+    ep = server_spark.serve()
+    addr = ("127.0.0.1", ep.port)
+
+    outcomes: dict = {}
+    lock = threading.Lock()
+
+    def record(name, **kv):
+        with lock:
+            outcomes[name] = kv
+
+    def run_client(name, q, delay_s):
+        time.sleep(delay_s)
+        cli = EndpointClient(addr, timeout_s=120)
+        try:
+            rows = cli.submit(SQL_QUERIES[q]).to_pylist()
+            record(name, rows=rows, summary=cli.last_summary)
+        except BaseException as e:  # noqa: BLE001 — reported, asserted below
+            record(name, error=type(e).__name__, detail=repr(e)[:200])
+
+    # -- wave 1: kill victim (head start) + two survivors ---------------------
+    # the slow faults land in the victim's aggregation (it runs alone during
+    # its head start), holding it mid-query while its socket is killed; any
+    # leftover slow hits in a survivor only add 250ms sleeps, never errors
+    faults.configure("slow:agg.update:4", seed=3)
+    killed = {}
+
+    def kill_victim():
+        from spark_rapids_tpu.runtime.endpoint import MSG_SUBMIT
+        from spark_rapids_tpu.shuffle.transport import send_frame
+        cli = EndpointClient(addr, timeout_s=120)
+        sock = cli.connect()
+        send_frame(sock, MSG_SUBMIT,
+                   json.dumps({"sql": SQL_QUERIES["q5"],
+                               "description": "kill-victim"}).encode())
+        time.sleep(0.3)            # mid-aggregation (slowed ~1s)
+        sock.close()               # the kill: half-close mid-flight
+        killed["closed_at"] = time.time()
+
+    threads = [
+        threading.Thread(target=kill_victim, daemon=True),
+        threading.Thread(target=run_client, args=("q1", "q1", 0.5),
+                         daemon=True),
+        threading.Thread(target=run_client, args=("q3", "q3", 0.6),
+                         daemon=True),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    # the cancelled victim must fully drain off the endpoint
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and ep.active_queries():
+        time.sleep(0.05)
+    faults.reset()
+
+    # -- shed over the wire: deterministically full scheduler -----------------
+    sched = SCHED.QueryScheduler.get()
+    occupant = f"occupant-{id(sched):x}"
+    sched.submit(occupant, 1, description="endpoint-shed occupant")
+    saved_max = sched.max_concurrent
+    sched.max_concurrent = 1
+    shed_err = None
+    try:
+        EndpointClient(addr, timeout_s=120).submit(
+            SQL_QUERIES["q1"], queue_timeout_s=0.05)
+    except SCHED.QueryRejectedError as e:
+        shed_err = e
+    except BaseException as e:  # noqa: BLE001
+        shed_err = e
+    finally:
+        sched.max_concurrent = saved_max
+        sched.release(occupant)
+
+    # -- SIGTERM drain under load ---------------------------------------------
+    # q5 is the in-flight victim: its 4 join builds + aggregation give the
+    # slow faults enough sites to hold it mid-query for several seconds, so
+    # the mid-drain probe deterministically lands while it is still running
+    ep.install_signal_handlers(grace_s=60)
+    faults.configure("slow:joins.build:8,slow:agg.update:8", seed=3)
+    drain_flight = {}
+
+    def drain_client():
+        cli = EndpointClient(addr, timeout_s=120)
+        try:
+            drain_flight["rows"] = cli.submit(SQL_QUERIES["q5"]).to_pylist()
+        except BaseException as e:  # noqa: BLE001
+            drain_flight["error"] = repr(e)[:200]
+
+    dt = threading.Thread(target=drain_client, daemon=True)
+    dt.start()
+    time.sleep(0.5)                       # in-flight mid-aggregation
+    os.kill(os.getpid(), signal.SIGTERM)  # the real signal path
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 10 and not ep.draining:
+        time.sleep(0.02)
+    drain_shed = None
+    try:
+        EndpointClient(addr, timeout_s=120).submit(SQL_QUERIES["q3"])
+    except BaseException as e:  # noqa: BLE001
+        drain_shed = e
+    dt.join(timeout=120)
+    # the drain thread closes the endpoint once in-flight queries finish
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 60 and ep._thread.is_alive():
+        time.sleep(0.05)
+    faults.reset()
+    eventlog.shutdown()
+
+    # -- assertions -----------------------------------------------------------
+    failures = []
+
+    def check(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    # survivors bit-identical over the wire, scoped counters clean
+    for name in ("q1", "q3"):
+        o = outcomes.get(name, {})
+        check(o.get("rows") == solo[name],
+              f"{name} endpoint rows differ from solo "
+              f"({o.get('error', 'rows mismatch')})")
+        check(not (o.get("summary") or {}).get("resilience"),
+              f"{name} scoped resilience leaked: {o.get('summary')}")
+    # the killed client's query was cancelled by the disconnect path
+    snap = M.resilience_snapshot()
+    check(snap.get("clientDisconnects", 0) >= 1,
+          f"no client disconnect counted: {snap}")
+    check(snap.get("queriesCancelled", 0) >= 1,
+          f"no query cancelled by the kill: {snap}")
+    # the shed submission arrived typed with its backoff hint intact
+    check(isinstance(shed_err, SCHED.QueryRejectedError),
+          f"shed outcome was {shed_err!r}, wanted QueryRejectedError")
+    if isinstance(shed_err, SCHED.QueryRejectedError):
+        check(shed_err.retryable and shed_err.backoff_hint_s > 0,
+              f"shed error lost its contract: {vars(shed_err)}")
+    # drain: in-flight finished bit-identical, mid-drain submission shed
+    check(drain_flight.get("rows") == solo["q5"],
+          f"in-flight query diverged under drain: {drain_flight}")
+    check(isinstance(drain_shed, SCHED.QueryRejectedError)
+          and getattr(drain_shed, "reason", "") == "draining"
+          and drain_shed.backoff_hint_s > 0,
+          f"mid-drain submission outcome was {drain_shed!r}")
+    check(not ep._thread.is_alive(), "endpoint listener thread survived drain")
+
+    # nothing leaked: threads, device buffers, semaphore permits
+    gc.collect()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and (
+            cat.num_buffers > buffers_base
+            or any(t.name.startswith(("srt-pipe-", "srt-endpoint"))
+                   for t in threading.enumerate())):
+        time.sleep(0.1)
+    check(cat.num_buffers <= buffers_base,
+          f"leaked {cat.num_buffers - buffers_base} catalog buffers")
+    check(not TpuSemaphore.get()._holders,
+          f"leaked semaphore permits: {TpuSemaphore.get()._holders}")
+    stragglers = [t.name for t in threading.enumerate()
+                  if t.name.startswith(("srt-pipe-", "srt-endpoint"))]
+    check(not stragglers, f"leaked endpoint/pipeline threads: {stragglers}")
+
+    print(json.dumps({
+        "outcomes": {k: {kk: vv for kk, vv in v.items() if kk != "rows"}
+                     for k, v in outcomes.items()},
+        "shed": (None if not isinstance(shed_err, SCHED.QueryRejectedError)
+                 else {"backoff_hint_s": shed_err.backoff_hint_s,
+                       "reason": shed_err.reason}),
+        "drain_shed": (None if not isinstance(drain_shed,
+                                              SCHED.QueryRejectedError)
+                       else {"backoff_hint_s": drain_shed.backoff_hint_s,
+                             "reason": drain_shed.reason}),
+        "resilience": {k: v for k, v in snap.items() if v},
+        "failures": failures,
+    }, default=str))
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

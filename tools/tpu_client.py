@@ -1,0 +1,92 @@
+"""Remote SQL client for the Arrow-over-TCP query endpoint.
+
+The CLI front for spark_rapids_tpu.runtime.endpoint.EndpointClient: submit
+one SQL statement to a running QueryEndpoint (see TpuSession.serve()),
+stream the Arrow result back, and honor the serving contract — a retryable
+QueryRejectedError (overload shed / graceful drain) is retried after its
+server-supplied ``backoff_hint_s``; non-retryable typed errors exit with
+the error class named.
+
+Usage:
+  python tools/tpu_client.py --port 8765 --sql "select count(*) c from t"
+  python tools/tpu_client.py --port 8765 --sql-file q.sql --priority 5 \
+      --deadline 30 --retries 8 --quiet
+
+Exit codes: 0 ok, 2 rejected/unreachable after all retries, 3 query error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tpu_client.py", description=__doc__)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--sql", help="SQL text (or use --sql-file / stdin '-')")
+    p.add_argument("--sql-file", help="read the SQL text from this file")
+    p.add_argument("--priority", type=int, default=None,
+                   help="admission priority (scheduler.priority)")
+    p.add_argument("--deadline", type=float, default=None,
+                   help="per-query deadline seconds (queue wait included)")
+    p.add_argument("--queue-timeout", type=float, default=None,
+                   help="seconds to wait for admission before the server "
+                        "sheds this submission")
+    p.add_argument("--retries", type=int, default=5,
+                   help="max attempts across shed/transport retries")
+    p.add_argument("--timeout", type=float, default=120.0,
+                   help="socket timeout seconds (per frame gap)")
+    p.add_argument("--quiet", action="store_true",
+                   help="print only the summary line, not the rows")
+    args = p.parse_args(argv)
+
+    sql = args.sql
+    if sql is None and args.sql_file:
+        sql = (sys.stdin.read() if args.sql_file == "-"
+               else pathlib.Path(args.sql_file).read_text())
+    if not sql:
+        p.error("one of --sql / --sql-file is required")
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    from spark_rapids_tpu.runtime.endpoint import EndpointClient
+    from spark_rapids_tpu.runtime.scheduler import (QueryCancelledError,
+                                                    QueryRejectedError)
+    from spark_rapids_tpu.shuffle.transport import TransportError
+
+    cli = EndpointClient((args.host, args.port), timeout_s=args.timeout)
+
+    def on_retry(attempt, delay):
+        print(f"retry {attempt}/{args.retries} in {delay:.2f}s "
+              "(server backoff hint honored)", file=sys.stderr)
+
+    try:
+        table = cli.submit_with_retry(
+            sql, max_attempts=max(1, args.retries), on_retry=on_retry,
+            priority=args.priority, deadline_s=args.deadline,
+            queue_timeout_s=args.queue_timeout,
+            description="tpu_client")
+    except (QueryRejectedError, TransportError) as e:
+        print(f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    except QueryCancelledError as e:
+        print(f"{type(e).__name__} ({e.reason}): {e}", file=sys.stderr)
+        return 3
+    except Exception as e:   # noqa: BLE001 — server-marshalled typed error
+        print(f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 3
+
+    if not args.quiet:
+        for row in table.to_pylist():
+            print(row)
+    s = cli.last_summary or {}
+    print(f"OK query={s.get('query')} rows={table.num_rows} "
+          f"batches={s.get('batches')} wall_s={s.get('wall_s')}",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
