@@ -209,6 +209,12 @@ def child_main():
             # trajectories are attributable to operators, not whole queries
             qm = spark.last_query_metrics()
             if qm is not None:
+                # retrace denominator: the last timed rep runs hot, so a
+                # healthy compile cache shows compiles == 0 here while
+                # dispatches stays O(batches) (ROADMAP item 1's gate input)
+                cm = qm.compile_metrics()
+                per_query[name]["compiles"] = cm["compiles"]
+                per_query[name]["dispatches"] = cm["dispatches"]
                 ops = []
                 queue_stall_ns = 0
                 for n in qm.node_summaries():
@@ -238,8 +244,10 @@ def child_main():
     # resilience counters (retry/split/fetch-failover totals across the
     # whole ladder run): with faults disabled these must be zero — a later
     # round seeing nonzero values here caught a real robustness regression
+    from spark_rapids_tpu.runtime import fuse as rfuse
     from spark_rapids_tpu.runtime import metrics as rmetrics
     resilience = rmetrics.resilience_snapshot()
+    compile_totals = rfuse.stage_metrics()
 
     geo = lambda xs: math.exp(sum(math.log(x) for x in xs) / len(xs))
     qnames = "".join(tpch.QUERIES)
@@ -257,6 +265,10 @@ def child_main():
         "variance_ok": max(spreads) <= BENCH_MAX_SPREAD,
         "queries": per_query,
         "resilience": resilience,
+        # whole-process XLA compile/dispatch totals (runtime/fuse.py);
+        # per-query hot-rep deltas live in queries.<q>.compiles/dispatches
+        "compiles": compile_totals["traces"],
+        "dispatches": compile_totals["dispatches"],
     }
     if not line["variance_ok"]:
         line["degraded"] = (f"spread {line['spread']} exceeds "
@@ -473,6 +485,26 @@ def join_microbench(smoke: bool = False):
     }
 
 
+def _latency_percentiles():
+    """p50/p95/p99 end-to-end latency per priority class plus the admission
+    queue-wait distribution, from the fixed-bucket histograms every
+    completed action observes into (runtime/metrics.py; the serving STATS
+    endpoint exposes the same families)."""
+    from spark_rapids_tpu.runtime import metrics as M
+    out = {}
+    for name in sorted(M.histograms_snapshot()):
+        if name.startswith("query.latency.priority"):
+            key = "priority" + name[len("query.latency.priority"):]
+        elif name == "admission.wait":
+            key = "admission_wait"
+        else:
+            continue
+        pct = M.histogram_percentiles(name)
+        if pct is not None:
+            out[key] = pct
+    return out
+
+
 def concurrent_bench(n: int, query: str = "q18", reps: int = 2,
                      endpoint: bool = False):
     """Multi-tenant aggregate-throughput mode (``--concurrent N``): N copies
@@ -586,6 +618,9 @@ def concurrent_bench(n: int, query: str = "q18", reps: int = 2,
             r and r["rows_ok"] and not r["resilience_nonzero"]
             and len({x["query_id"] for x in results}) == n
             for r in results),
+        # per-priority latency distribution across every run this process
+        # made (sequential + concurrent): the serving tier's SLO numbers
+        "latency": _latency_percentiles(),
     }
     if errors:
         line["errors"] = errors
@@ -679,6 +714,7 @@ def _endpoint_concurrent_bench(spark, paths, n, query, reps, cores):
         # serving with no faults must be invisible to every recovery
         # ladder — including the endpoint's own disconnect counter
         "resilience": M.resilience_snapshot(),
+        "latency": _latency_percentiles(),
     }
     if errors:
         line["errors"] = errors

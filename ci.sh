@@ -38,8 +38,15 @@ assert "spread" in d and "queries" in d, d
 # with no faults configured the retry spine AND the cluster recovery
 # ladder must be invisible: every resilience counter zero
 assert not any(d["resilience"].values()), d["resilience"]
+# compile/retrace telemetry: whole-process totals plus per-query hot-rep
+# deltas (the retrace denominator for the fusion roadmap gate)
+assert d["compiles"] > 0 and d["dispatches"] > 0, d
+for q, pq in d["queries"].items():
+    assert "compiles" in pq and "dispatches" in pq, (q, pq)
 print("bench-child dry-run ok:", d["metric"], d["value"], d["unit"],
-      "spread", d["spread"], "resilience", d["resilience"])
+      "spread", d["spread"], "resilience", d["resilience"],
+      "hot-rep compiles",
+      {q: pq["compiles"] for q, pq in d["queries"].items()})
 ' "$bench_line"
 
 echo "== radix spine: kernel interpret tests + join microbench smoke =="
@@ -121,7 +128,10 @@ echo "== cluster chaos: executor kill mid-q18 on a 3-executor MiniCluster =="
 chaos_dir=$(mktemp -d)
 JAX_PLATFORMS=cpu python tools/cluster_chaos.py \
   --data-dir /tmp/tpch_ci_sf0.01 --eventlog-dir "$chaos_dir" --query q18
-chaos_log=$(ls "$chaos_dir"/*.jsonl | head -1)
+# executors write their own events-*.jsonl (clock-offset-stamped) into the
+# same dir now; the ladder assertions read the DRIVER's file, identified by
+# the driver-only executor.lost event
+chaos_log=$(grep -l "executor.lost" "$chaos_dir"/events-*.jsonl | head -1)
 python - "$chaos_log" <<'PYEOF'
 import json, sys
 events = [json.loads(ln)["event"] for ln in open(sys.argv[1]) if ln.strip()]
@@ -137,6 +147,36 @@ PYEOF
 python tools/profiler.py report "$chaos_log" > /tmp/chaos_profile.txt || true
 grep -q "recovery (task attempt" /tmp/chaos_profile.txt
 grep -q "partial recompute shuffle=" /tmp/chaos_profile.txt
+# distributed trace of the SAME 3-executor q18 chaos run: the per-process
+# span files (driver + executors + the respawned incarnation) must merge
+# into one Perfetto-loadable Chrome trace sharing the query's trace id,
+# and the critical-path table must be non-empty and name a bounding edge
+python tools/profiler.py trace "$chaos_dir" --out /tmp/chaos_trace.json \
+  > /tmp/chaos_trace.txt
+grep -q "critical path" /tmp/chaos_trace.txt
+grep -q "bounding edge:" /tmp/chaos_trace.txt
+python - /tmp/chaos_trace.json <<'PYEOF'
+import json, sys
+t = json.load(open(sys.argv[1]))
+evs = [e for e in t["traceEvents"] if e["ph"] != "M"]
+meta = [e for e in t["traceEvents"] if e["ph"] == "M"]
+assert evs and meta, (len(evs), len(meta))
+for e in evs:
+    assert {"name", "ph", "ts", "pid", "tid"} <= set(e), e
+    assert e["ph"] != "X" or "dur" in e, e
+pids = {e["pid"] for e in evs}
+traces = {e["args"].get("trace") for e in evs if e.get("args")}
+assert len(pids) >= 2, pids      # driver + executor lanes
+assert len(traces) == 1, traces  # every span carries the query's trace id
+print("chaos chrome trace ok:", len(evs), "events from", len(pids),
+      "processes, trace", traces.pop())
+PYEOF
+# a malformed span file must fail the trace export loudly
+bad_dir=$(mktemp -d); echo '{broken json' > "$bad_dir/spans-1-x.jsonl"
+if python tools/profiler.py trace "$bad_dir" >/dev/null 2>&1; then
+  echo "profiler trace accepted a malformed span file"; exit 1
+fi
+rm -rf "$bad_dir"
 rm -rf "$chaos_dir"
 
 echo "== multi-tenant: concurrent chaos (cancel + OOM + shed isolation) =="
@@ -184,13 +224,21 @@ python -c '
 import json, sys
 d = json.loads(sys.argv[1])
 assert d["isolation_ok"], d
+# per-priority latency percentiles from the new fixed-bucket histograms
+# must be embedded and internally consistent (p50 <= p95 <= p99)
+lat = d["latency"]
+assert any(k.startswith("priority") for k in lat), lat
+for k, v in lat.items():
+    if k.startswith("priority"):
+        assert v["p50"] <= v["p95"] <= v["p99"], (k, v)
+        assert v["count"] >= d["n"], (k, v)
 if "gate_skipped" in d:
     print("concurrent throughput gate SKIPPED:", d["gate_skipped"],
           "(measured", d["throughput_x"], "x)")
 else:
     assert d["throughput_x"] >= 1.2, d
     print("concurrent throughput gate ok:", d["throughput_x"], "x on",
-          d["cores"], "cores")
+          d["cores"], "cores,", "p50/p95/p99", lat)
 ' "$conc_line"
 
 echo "== serving endpoint: wire chaos (mid-stream kill + shed + SIGTERM drain) =="
@@ -238,10 +286,11 @@ assert not any(d["resilience"].values()), d["resilience"]
 print("endpoint bench ok:", d["metric"], "throughput", d["throughput_x"], "x")
 ' "$ep_line"
 
-echo "== observability: event log overhead + profiler gate =="
-# run the q18 ladder query with the event log disabled then enabled: the log
-# must add <5% wall time, and tools/profiler.py must replay it into a report
-# with a clean schema and a non-empty operator breakdown (join build named)
+echo "== observability: event log + tracing overhead + profiler gate =="
+# run the q18 ladder query with telemetry disabled then with the event log
+# AND the span plane both on: together they must add <5% wall time, and
+# tools/profiler.py must replay the log into a report with a clean schema
+# and a non-empty operator breakdown (join build named)
 obs_dir=$(mktemp -d)
 JAX_PLATFORMS=cpu SRT_OBS_DIR="$obs_dir" python - <<'PYEOF'
 import jax; jax.config.update("jax_platforms", "cpu")
@@ -268,16 +317,19 @@ def run(conf):
 
 off_s = run({})
 on_s = run({"spark.rapids.tpu.eventLog.dir": os.environ["SRT_OBS_DIR"],
-            "spark.rapids.tpu.eventLog.healthSample.intervalSeconds": 0.5})
+            "spark.rapids.tpu.eventLog.healthSample.intervalSeconds": 0.5,
+            "spark.rapids.tpu.trace.dir": os.environ["SRT_OBS_DIR"]})
 eventlog.shutdown()
+from spark_rapids_tpu.runtime import tracing
+tracing.shutdown_spans()
 overhead = (on_s - off_s) / off_s
-print(f"event log overhead on q18: off={off_s:.4f}s on={on_s:.4f}s "
-      f"({overhead:+.1%})")
+print(f"event log + tracing overhead on q18: off={off_s:.4f}s "
+      f"on={on_s:.4f}s ({overhead:+.1%})")
 # <5% wall-time budget, with a small absolute floor so scheduler noise on a
 # loaded CI box cannot flake a sub-25ms delta into a failure
 assert on_s <= off_s * 1.05 + 0.02, (on_s, off_s)
 PYEOF
-obs_log=$(ls "$obs_dir"/*.jsonl | head -1)
+obs_log=$(ls "$obs_dir"/events-*.jsonl | head -1)
 python tools/profiler.py report "$obs_log" --json > /tmp/obs_report.json
 python -c '
 import json
@@ -292,6 +344,11 @@ print("profiler gate ok:", len(qs), "queries,",
       len(q18["operators"]), "operators, self-time coverage",
       q18["coverage"])
 '
+# the SAME run's span file must export to a Perfetto-loadable trace with a
+# non-empty critical path (single-process: operator trace_range spans)
+python tools/profiler.py trace "$obs_dir" --out /tmp/obs_trace.json \
+  > /tmp/obs_trace.txt
+grep -q "bounding edge:" /tmp/obs_trace.txt
 rm -rf "$obs_dir"
 
 echo "== api coverage gate (0 missing vs reference GpuOverrides) =="

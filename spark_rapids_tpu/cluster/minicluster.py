@@ -89,7 +89,9 @@ def _executor_main(conn, executor_index: int, platform: str,
     from spark_rapids_tpu.config import RapidsConf
     from spark_rapids_tpu.exec.base import TaskContext
     from spark_rapids_tpu.plan.transitions import to_device_plan
+    from spark_rapids_tpu.runtime import eventlog as EL
     from spark_rapids_tpu.runtime import faults as F
+    from spark_rapids_tpu.runtime import tracing
     from spark_rapids_tpu.shuffle.manager import ShuffleBlockStore
     from spark_rapids_tpu.shuffle.transport import TcpTransport
 
@@ -98,6 +100,16 @@ def _executor_main(conn, executor_index: int, platform: str,
     # sites fire where the work actually runs); the driver strips the spec
     # from RESPAWNED replacements so COUNT triggers cannot re-fire forever
     F.configure(conf.get(CFG.TEST_FAULTS), conf.get(CFG.TEST_FAULTS_SEED))
+    # executor-side telemetry sinks: spans and event-log records land in
+    # per-process files under the SAME directories the driver uses, merged
+    # later by timestamp + the clock offset the driver measures below
+    tdir = conf.get(CFG.TRACE_DIR)
+    if tdir:
+        tracing.configure_spans(tdir, process=f"executor-{executor_index}")
+    edir = conf.get(CFG.EVENT_LOG_DIR)
+    if edir:
+        EL.configure(edir, max_bytes=conf.get(CFG.EVENT_LOG_MAX_BYTES),
+                     keep=conf.get(CFG.EVENT_LOG_KEEP_FILES))
     store = ShuffleBlockStore.get()
     transport = TcpTransport(conf)
     conn.send({"op": "ready", "port": transport.port, "pid": os.getpid()})
@@ -111,13 +123,17 @@ def _executor_main(conn, executor_index: int, platform: str,
         # output (speculation losers, stale/failed attempts)
         map_split = task["map_split"]
         store.ensure_shuffle(sid)
+        # the task's trace id pins the PROCESS (one task at a time here), so
+        # pipeline worker threads and the shuffle fetch path inherit it
+        tracing.set_process_trace(task.get("trace"))
         # task-START checkpoint (distinct site from the per-batch one so
         # batch-counted @SKIP triggers stay stable): lets exec_kill/hang
         # fire even for a task whose input produces zero batches
         F.maybe_inject_any("cluster.map.begin")
         F.maybe_inject_any(f"cluster.map.begin.{executor_index}")
         exec_root = to_device_plan(plan, conf)
-        with TaskContext():
+        with tracing.span("task.map", shuffle=sid, split=map_split), \
+                TaskContext():
             for split in task["splits"]:
                 seq = 0
                 for batch in exec_root.execute_partition(split):
@@ -138,11 +154,13 @@ def _executor_main(conn, executor_index: int, platform: str,
 
     def run_result(task):
         plan = task["plan"]
+        tracing.set_process_trace(task.get("trace"))
         F.maybe_inject_any("cluster.result.begin")
         F.maybe_inject_any(f"cluster.result.begin.{executor_index}")
         exec_root = to_device_plan(plan, conf)
         tables = []
-        with TaskContext():
+        with tracing.span("task.result", splits=len(task["splits"])), \
+                TaskContext():
             for split in task["splits"]:
                 for batch in exec_root.execute_partition(split):
                     F.maybe_inject_any("cluster.result")
@@ -169,6 +187,16 @@ def _executor_main(conn, executor_index: int, platform: str,
                 reply = run_map(cloudpickle.loads(msg["task"]))
             elif op == "result":
                 reply = run_result(cloudpickle.loads(msg["task"]))
+            elif op == "clock":
+                # driver-side two-timestamp exchange: our wall clock, read
+                # as close to the reply as the pipe protocol allows
+                reply = {"t": time.time()}
+            elif op == "clock_set":
+                # the measured offset toward the driver's clock: stamped
+                # into event-log records and span files so merged timelines
+                # order correctly across processes
+                EL.set_clock_offset(msg["offset"])
+                reply = {}
             elif op == "ensure_shuffle":
                 store.ensure_shuffle(msg["shuffle_id"])
                 reply = {}
@@ -184,6 +212,10 @@ def _executor_main(conn, executor_index: int, platform: str,
         except BaseException:  # noqa: BLE001 — shipped back to the driver
             reply = {"op": "done", "ok": False,
                      "error": traceback.format_exc()}
+        finally:
+            # the task's trace id must not bleed into the next task (or
+            # into fetch serving between tasks)
+            tracing.set_process_trace(None)
         conn.send(reply)
 
 
@@ -420,6 +452,25 @@ class MiniCluster:
             p.join(timeout=5)
             raise RuntimeError(f"executor {ei} died during bring-up") from e
         assert hello["op"] == "ready"
+        # two-timestamp clock exchange riding the registration handshake
+        # (the heartbeat register below is the same handshake's driver
+        # half): executor_clock + offset ≈ driver_clock, error bounded by
+        # half the pipe round-trip — the correction that lets executor
+        # event-log records and span files merge onto the driver timeline
+        from spark_rapids_tpu.runtime import tracing
+        try:
+            t0 = time.time()
+            parent.send({"op": "clock"})
+            clock = parent.recv()
+            t1 = time.time()
+            offset = tracing.estimate_clock_offset(t0, clock["t"], t1)
+            parent.send({"op": "clock_set", "offset": offset})
+            assert parent.recv().get("ok")
+        except (EOFError, OSError) as e:
+            p.kill()
+            p.join(timeout=5)
+            raise RuntimeError(
+                f"executor {ei} died during clock handshake") from e
         self._conns[ei] = parent
         self._procs[ei] = p
         self.addresses[ei] = ("127.0.0.1", hello["port"])
@@ -575,6 +626,7 @@ class MiniCluster:
                          partitioner=st.partitioner)
 
     def _build_task(self, spec: _TaskSpec) -> dict:
+        from spark_rapids_tpu.runtime import tracing
         if spec.pin is not None:
             plan = _pin_sources(_clone_plan(spec.subtree), spec.pin)
             splits = [0]
@@ -582,7 +634,8 @@ class MiniCluster:
             plan = spec.subtree
             splits = [spec.split]
         self._stamp_epochs(plan)
-        task = {"plan": plan, "splits": splits}
+        task = {"plan": plan, "splits": splits,
+                "trace": tracing.current_trace_id()}
         if spec.op == "map":
             task.update({"shuffle_id": spec.shuffle_id,
                          "partitioner": spec.partitioner,
@@ -834,19 +887,31 @@ class MiniCluster:
         raise last
 
     def _collect_once(self, df) -> pa.Table:
+        import uuid
+
         from spark_rapids_tpu.plan.distribute import (ensure_distribution,
                                                       stage_order)
+        from spark_rapids_tpu.runtime import tracing
         plan = _clone_plan(df._plan)
         plan = ensure_distribution(plan, self.n_executors)
         self._tracker = MapOutputTracker()
         self._current_root = plan
+        # one trace id for the whole distributed query: inherited from an
+        # ambient session query when there is one, else minted here; every
+        # task ships it (_build_task) so executor spans and their shuffle
+        # fetches land on the same merged timeline
+        trace_id = tracing.current_trace_id() or \
+            f"cluster-{os.getpid():x}-{uuid.uuid4().hex[:8]}"
         try:
-            for exchange, parent, idx in stage_order(plan):
-                source = self._run_map_stage(exchange)
-                parent.children[idx] = source
-                if self._after_stage_hook is not None:
-                    self._after_stage_hook(self)
-            out = self._run_result_stage(plan)
+            with tracing.trace_context(trace_id), \
+                    tracing.span("cluster.query",
+                                 executors=self.n_executors):
+                for exchange, parent, idx in stage_order(plan):
+                    source = self._run_map_stage(exchange)
+                    parent.children[idx] = source
+                    if self._after_stage_hook is not None:
+                        self._after_stage_hook(self)
+                out = self._run_result_stage(plan)
         finally:
             self._current_root = None
         self._cleanup_shuffles(self._tracker.sids())

@@ -630,6 +630,33 @@ EVENT_LOG_KEEP_FILES = conf("spark.rapids.tpu.eventLog.keepFiles").doc(
     "size-based rotation; older rotations are deleted). Only meaningful "
     "when eventLog.maxBytes > 0").integer_conf(4)
 
+TRACE_DIR = conf("spark.rapids.tpu.trace.dir").doc(
+    "Directory for per-process JSONL span files (runtime/tracing.py): every "
+    "trace_range/span region and span_event instant is appended with its "
+    "wall-clock start, duration, pid/thread and the ambient query's trace "
+    "id, which propagates across MiniCluster tasks, shuffle fetches and "
+    "endpoint submissions. tools/profiler.py trace merges the files into "
+    "Chrome-trace JSON (Perfetto) with a critical-path table. Empty "
+    "disables with near-zero overhead").string_conf(None)
+
+TRACE_ID_OVERRIDE = conf("spark.rapids.tpu.trace.id").doc(
+    "Explicit trace id for this session's next queries (normally derived "
+    "from the query id); clients submitting over the endpoint can instead "
+    "set 'trace' per request. Empty derives per query").string_conf(None)
+
+ENDPOINT_STATS_ENABLED = conf("spark.rapids.tpu.endpoint.stats.enabled").doc(
+    "Serve STATS frames on the query endpoint: a Prometheus-style text "
+    "snapshot of live serving metrics — admission/shed/cancel/deadline "
+    "counters, the resilience registry, HBM/spill-tier/queue-depth gauges "
+    "and latency histograms per priority class (tools/tpu_client.py "
+    "--stats)").boolean_conf(True)
+
+ENDPOINT_STATS_HISTOGRAMS = conf(
+    "spark.rapids.tpu.endpoint.stats.histograms.enabled").doc(
+    "Include histogram families (query latency per priority class, "
+    "admission queue wait) in STATS snapshots; counters and gauges are "
+    "always served").boolean_conf(True)
+
 PROFILE_DIR = conf("spark.rapids.tpu.profile.dir").doc(
     "Directory for a whole-session XProf/Perfetto capture "
     "(jax.profiler.start_trace; the reference's Nsight workflow, "

@@ -84,12 +84,16 @@ MSG_RESULT_END = 18     # server→client: JSON summary (query id, rows, ...)
 MSG_QUERY_ERROR = 19    # server→client: pickled typed exception
 MSG_PING = 20           # client→server: liveness probe
 MSG_PONG = 21           # server→client: liveness reply
+MSG_STATS = 22          # client→server: live serving-metrics snapshot probe
+MSG_STATS_RESP = 23     # server→client: Prometheus-style text exposition
 
 _CRC = struct.Struct("<Q")
 
 # request knobs a client may set per submission — mapped onto the session
 # conf keys the scheduler reads at submit time; everything else in the
-# request JSON is rejected (the wire must not become a generic conf setter)
+# request JSON is rejected (the wire must not become a generic conf setter).
+# 'trace' is NOT a conf key: it is the client's distributed trace id, handed
+# to the query's collector so server-side spans merge with the client's own
 _REQUEST_KNOBS = {
     "priority": (CFG.SCHEDULER_PRIORITY.key, int),
     "deadline_s": (CFG.SCHEDULER_QUERY_DEADLINE.key, float),
@@ -114,6 +118,89 @@ def _pickle_error(exc: BaseException) -> bytes:
     except Exception:   # noqa: BLE001 — an unpicklable error still travels
         return pickle.dumps(RuntimeError(
             f"{type(exc).__name__}: {exc!r}"[:500]))
+
+
+# ---------------------------------------------------------------------------
+# live serving metrics (STATS frames)
+# ---------------------------------------------------------------------------
+
+def _hist_family(name: str):
+    """Map a runtime/metrics histogram name to its Prometheus family +
+    label string."""
+    if name.startswith("query.latency.priority"):
+        p = name[len("query.latency.priority"):]
+        return "srt_query_latency_seconds", f'priority="{p}"'
+    if name == "admission.wait":
+        return "srt_admission_wait_seconds", ""
+    safe = "".join(c if c.isalnum() else "_" for c in name)
+    return f"srt_{safe}", ""
+
+
+def render_stats(include_histograms: bool = True) -> str:
+    """Prometheus-style text snapshot of the live serving metrics: query
+    lifecycle counters (admitted / shed / cancelled / deadline), the whole
+    resilience registry, memory + queue gauges (HBM in use, spill tiers,
+    admission queue depth, active queries, pipeline queue occupancy,
+    endpoint connections) and the fixed-bucket latency histograms."""
+    from spark_rapids_tpu.runtime import eventlog as EL
+    lines = []
+
+    def fam(name, mtype):
+        lines.append(f"# TYPE {name} {mtype}")
+
+    sched = SCHED.QueryScheduler.get().stats()
+    for key, metric in (("admitted", "srt_queries_admitted_total"),
+                        ("shed", "srt_queries_shed_total"),
+                        ("demotions", "srt_query_demotions_total")):
+        fam(metric, "counter")
+        lines.append(f"{metric} {sched[key]}")
+    counters = M.counters_snapshot()
+    fam("srt_queries_deadline_total", "counter")
+    lines.append("srt_queries_deadline_total "
+                 f"{counters.get('queries.deadline', 0)}")
+    fam("srt_resilience_total", "counter")
+    for k, v in sorted(M.resilience_snapshot().items()):
+        lines.append(f'srt_resilience_total{{counter="{k}"}} {v}')
+
+    fam("srt_scheduler_running", "gauge")
+    lines.append(f"srt_scheduler_running {sched['running']}")
+    fam("srt_scheduler_queue_depth", "gauge")
+    lines.append(f"srt_scheduler_queue_depth {sched['queued']}")
+    health = EL.health_payload()
+    if health.get("device_initialized"):
+        fam("srt_hbm_bytes", "gauge")
+        for kind in ("budget", "used", "free"):
+            lines.append(f'srt_hbm_bytes{{kind="{kind}"}} '
+                         f'{health[f"hbm_{kind}_bytes"]}')
+        fam("srt_spill_tier_bytes", "gauge")
+        for tier, d in sorted(health["tiers"].items()):
+            lines.append(f'srt_spill_tier_bytes{{tier="{tier}"}} '
+                         f'{d["bytes"]}')
+    fuse = health.get("fuse", {})
+    fam("srt_fuse_total", "counter")
+    for k in ("traces", "dispatches"):
+        lines.append(f'srt_fuse_total{{kind="{k}"}} {fuse.get(k, 0)}')
+    fam("srt_gauge", "gauge")
+    for k, v in sorted(M.gauges_snapshot().items()):
+        lines.append(f'srt_gauge{{name="{k}"}} {v}')
+
+    if include_histograms:
+        for name, snap in sorted(M.histograms_snapshot().items()):
+            family, label = _hist_family(name)
+            fam(family, "histogram")
+            cum = 0
+            for bound, count in zip(snap["bounds"], snap["counts"]):
+                cum += count
+                sep = "," if label else ""
+                lines.append(f'{family}_bucket{{{label}{sep}le="{bound}"}} '
+                             f"{cum}")
+            sep = "," if label else ""
+            lines.append(f'{family}_bucket{{{label}{sep}le="+Inf"}} '
+                         f'{snap["count"]}')
+            lab = f"{{{label}}}" if label else ""
+            lines.append(f"{family}_sum{lab} {round(snap['sum'], 6)}")
+            lines.append(f"{family}_count{lab} {snap['count']}")
+    return "\n".join(lines) + "\n"
 
 
 def _unpickle_error(payload: bytes) -> BaseException:
@@ -223,6 +310,8 @@ class QueryEndpoint:
         self.request_timeout = conf.get(CFG.ENDPOINT_REQUEST_TIMEOUT)
         self.drain_grace = conf.get(CFG.ENDPOINT_DRAIN_GRACE)
         self.stream_buffer = conf.get(CFG.ENDPOINT_STREAM_BUFFER)
+        self.stats_enabled = conf.get(CFG.ENDPOINT_STATS_ENABLED)
+        self.stats_histograms = conf.get(CFG.ENDPOINT_STATS_HISTOGRAMS)
         TR.set_max_frame_bytes(conf.get(CFG.TRANSPORT_MAX_FRAME_BYTES))
         self._draining = False
         self._drain_deadline = None
@@ -261,6 +350,7 @@ class QueryEndpoint:
             if self._closing:
                 return
             self._conns.add(sock)
+            M.set_gauge("endpoint.connections", len(self._conns))
         EL.emit("client.connected", query=None, peer=f"{peer[0]}:{peer[1]}")
         try:
             while not self._closing:
@@ -271,6 +361,14 @@ class QueryEndpoint:
                     return   # idle timeout, client close, or any fault kind
                 if msg == MSG_PING:
                     send_frame(sock, MSG_PONG, b"")
+                    continue
+                if msg == MSG_STATS:
+                    if not self.stats_enabled:
+                        self._send_error(sock, RuntimeError(
+                            "endpoint.stats.enabled=false on this endpoint"))
+                        return
+                    send_frame(sock, MSG_STATS_RESP, render_stats(
+                        self.stats_histograms).encode("utf-8"))
                     continue
                 if msg != MSG_SUBMIT:
                     self._send_error(sock, TransportError(
@@ -283,6 +381,7 @@ class QueryEndpoint:
         finally:
             with self._lock:
                 self._conns.discard(sock)
+                M.set_gauge("endpoint.connections", len(self._conns))
 
     def _send_error(self, sock, exc) -> bool:
         try:
@@ -323,7 +422,8 @@ class QueryEndpoint:
         try:
             req = json.loads(payload.decode("utf-8"))
             sql = req["sql"]
-            unknown = set(req) - set(_REQUEST_KNOBS) - {"sql", "description"}
+            unknown = set(req) - set(_REQUEST_KNOBS) - {"sql", "description",
+                                                        "trace"}
             if unknown:
                 raise ValueError(f"unknown request fields {sorted(unknown)}")
             sess = self._request_session(req)
@@ -345,7 +445,8 @@ class QueryEndpoint:
         if raced_drain:
             return self._shed_draining(sock)
         worker = threading.Thread(target=self._run_query,
-                                  args=(df, stream), daemon=True, name=wname)
+                                  args=(df, stream, req.get("trace")),
+                                  daemon=True, name=wname)
         worker.start()
         try:
             return self._pump(sock, df, stream)
@@ -361,15 +462,20 @@ class QueryEndpoint:
             with self._lock:
                 self._active.pop(key, None)
 
-    def _run_query(self, df, stream: _ResultStream):
+    def _run_query(self, df, stream: _ResultStream, trace: str | None = None):
         """Worker thread: execute the action, pushing each result batch into
         the stream as a CRC-stamped Arrow-IPC payload. Partitions run in
         order on this one thread (batch order must be deterministic for the
         bit-identity contract); the pipelined executor still overlaps
         decode/compute/exchange inside each partition, and the stream's
-        byte budget overlaps compute with the network send."""
+        byte budget overlaps compute with the network send. A client-supplied
+        `trace` id is handed to the query's collector so server-side spans
+        land in the client's distributed trace."""
         from spark_rapids_tpu.exec.base import TaskContext, TpuExec
         from spark_rapids_tpu.runtime import pipeline as P
+        from spark_rapids_tpu.runtime import tracing
+        if trace:
+            tracing.set_pending_trace(str(trace))
         counts = {"rows": 0, "batches": 0}
 
         def sink(tbl: pa.Table):
@@ -408,7 +514,8 @@ class QueryEndpoint:
             df._run_action(df._plan, run)
             qm = df._last_collector
             stream.finish({
-                "query": qm.query_id, "rows": counts["rows"],
+                "query": qm.query_id, "trace": qm.trace_id,
+                "rows": counts["rows"],
                 "batches": counts["batches"],
                 "wall_s": round(qm.wall_s, 4),
                 "resilience": {k: v for k, v in
@@ -609,10 +716,30 @@ class EndpointClient:
         finally:
             sock.close()
 
+    def stats(self) -> str:
+        """Live serving-metrics snapshot (Prometheus-style text): admission
+        counters, resilience registry, HBM/spill/queue gauges and latency
+        histograms. Raises the server's typed error when STATS is disabled
+        (endpoint.stats.enabled=false)."""
+        sock = self.connect()
+        try:
+            send_frame(sock, MSG_STATS, b"")
+            msg, payload = recv_frame(sock, max_bytes=self.max_frame)
+            if msg == MSG_QUERY_ERROR:
+                raise _unpickle_error(payload)
+            if msg != MSG_STATS_RESP:
+                raise TransportError(f"unexpected endpoint message {msg}")
+            return payload.decode("utf-8")
+        except OSError as e:
+            raise TransportError(
+                f"endpoint {self.address} stats failed: {e}") from e
+        finally:
+            sock.close()
+
     def submit_iter(self, sql: str, *, priority: int | None = None,
                     deadline_s: float | None = None,
                     queue_timeout_s: float | None = None,
-                    description: str = ""):
+                    description: str = "", trace: str | None = None):
         """Generator of result tables, one per streamed Arrow-IPC batch;
         ``self.last_summary`` carries the MSG_RESULT_END stats afterwards.
         Abandoning the generator closes the connection, which cancels the
@@ -621,7 +748,7 @@ class EndpointClient:
         read, reset)."""
         req = {"sql": sql, "description": description,
                "priority": priority, "deadline_s": deadline_s,
-               "queue_timeout_s": queue_timeout_s}
+               "queue_timeout_s": queue_timeout_s, "trace": trace}
         sock = self.connect()
         try:
             try:

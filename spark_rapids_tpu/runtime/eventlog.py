@@ -15,12 +15,20 @@ pre-check enabled() so no event dict is even built.
 
 Record schema (validated by validate_record(), enforced by the profiler):
   event  str   one of KNOWN_EVENTS
-  ts     float unix wall-clock seconds (human correlation)
+  ts     float unix wall-clock seconds — the CROSS-PROCESS ordering key once
+               each process's clock offset is applied (ts + offset ≈ driver
+               wall clock); `t` alone cannot order records from different
+               processes (each process's monotonic clock has an arbitrary
+               epoch)
   t      float monotonic seconds — strictly non-decreasing within one file
                (computed under the writer lock)
+  pid    int   writing process (executor records merge with driver records)
   query  str|None  query id from the ambient QueryMetricsCollector
   node   int|None  plan-node id from the ambient node_frame stack
-plus per-event payload fields.
+plus per-event payload fields, and `offset` (heartbeat-handshake-derived
+clock correction toward the driver's clock, seconds) on records written by
+a process whose offset was measured (set_clock_offset — MiniCluster
+executors receive theirs from the driver's two-timestamp exchange).
 """
 
 from __future__ import annotations
@@ -77,6 +85,21 @@ QUERY_SCOPED_EVENTS = frozenset({
 _lock = threading.Lock()
 _writer: "EventLogWriter | None" = None
 _sampler: "_HealthSampler | None" = None
+
+# clock correction toward the driver's wall clock (seconds): measured by the
+# driver's two-timestamp exchange on the executor spawn/heartbeat handshake
+# and pushed to the executor, so its records (and span files —
+# runtime/tracing reads this too) can be merged onto one timeline
+_clock_offset = 0.0
+
+
+def set_clock_offset(offset_s: float) -> None:
+    global _clock_offset
+    _clock_offset = float(offset_s)
+
+
+def clock_offset() -> float:
+    return _clock_offset
 
 
 class EventLogWriter:
@@ -169,6 +192,19 @@ def shutdown() -> None:
             _writer = None
 
 
+# MiniCluster executor processes have no QueryMetricsCollector, so the
+# ambient-collector query lookup below comes up empty there; runtime/tracing
+# registers current_trace_id() here (a setter, to avoid the circular import)
+# so query-scoped records written inside a shipped task still correlate —
+# the task's trace id IS the query's cross-process identity
+_query_fallback = None
+
+
+def set_query_fallback(fn) -> None:
+    global _query_fallback
+    _query_fallback = fn
+
+
 def enabled() -> bool:
     return _writer is not None
 
@@ -186,25 +222,39 @@ def emit(event: str, *, query: str | None = None, node: int | None = None,
     w = _writer
     if w is None:
         return
+    q = query if query is not None else M.current_query_id()
+    if q is None and _query_fallback is not None:
+        q = _query_fallback()
     record = {
         "event": event,
         "ts": time.time(),
         "t": 0.0,   # stamped by the writer under its lock
-        "query": query if query is not None else M.current_query_id(),
+        "pid": os.getpid(),
+        "query": q,
         "node": node if node is not None else M.current_node(),
     }
+    if _clock_offset:
+        record["offset"] = _clock_offset
     record.update(fields)
     w.write(record)
 
 
 def health_payload() -> dict:
     """Executor health gauges: HBM budget/used/free plus per-tier
-    spill-catalog occupancy. Never forces device initialization — an
-    unstarted DeviceManager reports empty gauges."""
+    spill-catalog occupancy, the process's fuse compile/dispatch counters
+    (retrace visibility per heartbeat) and the live gauge registry
+    (endpoint connection count, pipeline queue occupancy). Never forces
+    device initialization — an unstarted DeviceManager reports empty
+    memory gauges."""
+    from spark_rapids_tpu.runtime import fuse
     from spark_rapids_tpu.runtime.memory import DeviceManager, TierEnum
+    extra = {"fuse": fuse.stage_metrics()}
+    gauges = M.gauges_snapshot()
+    if gauges:
+        extra["gauges"] = gauges
     dm = DeviceManager._instance
     if dm is None:
-        return {"device_initialized": False}
+        return {"device_initialized": False, **extra}
     cat = dm.catalog
     tiers = {TierEnum.DEVICE: [0, 0], TierEnum.HOST: [0, 0],
              TierEnum.DISK: [0, 0]}
@@ -223,6 +273,7 @@ def health_payload() -> dict:
             "spilled_to_disk_bytes": cat.spilled_to_disk_bytes,
             "tiers": {t: {"buffers": n, "bytes": sz}
                       for t, (n, sz) in tiers.items()},
+            **extra,
         }
     return out
 

@@ -29,6 +29,8 @@ import types as _types
 
 import jax
 
+from spark_rapids_tpu.runtime import metrics as _M
+
 _lock = threading.Lock()
 _kernels: dict = {}
 _MAX_KERNELS = 2048
@@ -85,6 +87,10 @@ class BatchKernel:
         def traced(*args):
             with _lock:
                 _counts["traces"] += 1
+            # per-query retrace attribution: the tracing thread runs inside
+            # the query's collector scope, so the compile lands on the query
+            # that paid for it (metrics.compile_add, the resilience pattern)
+            _M.compile_add("compiles")
             return fn(*args)
 
         self._jit = jax.jit(traced)
@@ -99,6 +105,7 @@ class BatchKernel:
     def __call__(self, *args):
         with _lock:
             _counts["dispatches"] += 1
+        _M.compile_add("dispatches")
         if _PROFILE:
             import time
             t0 = time.perf_counter()

@@ -7,6 +7,7 @@ makeSpillCallback feeding spill bytes back into the running operator's metrics."
 
 from __future__ import annotations
 
+import bisect
 import itertools
 import os
 import threading
@@ -223,6 +224,168 @@ def resilience_add(name: str, v: int = 1) -> None:
         c._resilience_local.metric(name).add(v)
 
 
+# -- process-wide gauges / counters / histograms ------------------------------
+# The live serving-metrics plane (endpoint STATS frames, executor.health
+# samples): gauges are last-write-wins instantaneous values (endpoint
+# connection count, pipeline queue occupancy), counters are monotonic
+# (deadline kills), and histograms are fixed-bucket distributions cheap
+# enough to observe on every query completion.
+
+_gauge_lock = threading.Lock()
+_gauges: dict[str, float] = {}
+_counters: dict[str, int] = {}
+
+
+def set_gauge(name: str, value) -> None:
+    with _gauge_lock:
+        _gauges[name] = value
+
+
+def add_gauge(name: str, delta) -> None:
+    with _gauge_lock:
+        _gauges[name] = _gauges.get(name, 0) + delta
+
+
+def gauges_snapshot() -> dict:
+    with _gauge_lock:
+        return dict(_gauges)
+
+
+def counter_add(name: str, v: int = 1) -> None:
+    with _gauge_lock:
+        _counters[name] = _counters.get(name, 0) + v
+
+
+def counters_snapshot() -> dict:
+    with _gauge_lock:
+        return dict(_counters)
+
+
+def reset_observability() -> None:
+    """Test hook: clear gauges, counters and histograms."""
+    global _histograms
+    with _gauge_lock:
+        _gauges.clear()
+        _counters.clear()
+    with _hist_lock:
+        _histograms = {}
+
+
+# latency-shaped default bounds: 1ms .. 5min, roughly x2.5 per step —
+# fine enough for p99 interpolation at interactive scales, coarse enough
+# that one histogram is 18 ints
+DEFAULT_HISTOGRAM_BOUNDS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
+
+
+class Histogram:
+    """Lock-cheap fixed-bucket histogram: observe() is one bisect over a
+    static bound tuple plus four guarded int/float updates — cheap enough
+    for per-query (not per-batch) call sites. Bucket i counts values
+    v <= bounds[i]; the last bucket is the +inf overflow. min/max are
+    tracked so percentile() can clamp interpolation to observed reality."""
+
+    __slots__ = ("name", "bounds", "_counts", "_sum", "_count",
+                 "_min", "_max", "_lock")
+
+    def __init__(self, name: str, bounds=None):
+        self.name = name
+        self.bounds = tuple(sorted(bounds)) if bounds \
+            else DEFAULT_HISTOGRAM_BOUNDS
+        self._counts = [0] * (len(self.bounds) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._min = None
+        self._max = None
+        self._lock = threading.Lock()
+
+    def observe(self, v) -> None:
+        v = float(v)
+        i = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+            if self._min is None or v < self._min:
+                self._min = v
+            if self._max is None or v > self._max:
+                self._max = v
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"bounds": list(self.bounds),
+                    "counts": list(self._counts),
+                    "sum": self._sum, "count": self._count,
+                    "min": self._min, "max": self._max}
+
+    def percentile(self, q: float) -> float | None:
+        """Linear-interpolated q-quantile (q in [0,1]) from the bucket
+        cumulative counts, clamped to the observed [min, max]; None before
+        any observation."""
+        with self._lock:
+            if not self._count:
+                return None
+            counts = list(self._counts)
+            total, lo, hi = self._count, self._min, self._max
+        target = q * total
+        cum = 0.0
+        for i, c in enumerate(counts):
+            if cum + c >= target and c:
+                b_lo = self.bounds[i - 1] if i > 0 else 0.0
+                b_hi = self.bounds[i] if i < len(self.bounds) else hi
+                frac = (target - cum) / c
+                v = b_lo + (b_hi - b_lo) * frac
+                return min(max(v, lo), hi)
+            cum += c
+        return hi
+
+
+_hist_lock = threading.Lock()
+_histograms: dict[str, Histogram] = {}
+
+
+def histogram(name: str, bounds=None) -> Histogram:
+    """Fetch-or-create the process-wide histogram `name` (shared across
+    sessions, like the resilience registry)."""
+    with _hist_lock:
+        h = _histograms.get(name)
+        if h is None:
+            h = _histograms[name] = Histogram(name, bounds)
+        return h
+
+
+def histograms_snapshot() -> dict:
+    with _hist_lock:
+        items = list(_histograms.items())
+    return {name: h.snapshot() for name, h in items}
+
+
+def histogram_percentiles(name: str, qs=(0.5, 0.95, 0.99)) -> dict | None:
+    with _hist_lock:
+        h = _histograms.get(name)
+    if h is None or not h._count:
+        return None
+    out = {f"p{int(q * 100)}": round(h.percentile(q), 6) for q in qs}
+    out["count"] = h._count
+    return out
+
+
+# -- per-query compile/retrace accounting --------------------------------------
+# runtime/fuse.py mirrors every XLA trace (compile) and program replay
+# (dispatch) into the ambient query's collector, the same pattern as
+# resilience_add: the process-global fuse counters stay authoritative for
+# whole-process telemetry, while the per-query deltas establish the
+# retrace denominator (ROADMAP item 1's zero-retrace gate reads these from
+# last_query_metrics()).
+
+def compile_add(kind: str, v: int = 1) -> None:
+    c = current_collector()
+    if c is not None:
+        with c._compile_lock:
+            c._compile_local[kind] = c._compile_local.get(kind, 0) + v
+
+
 # -- query-scoped collection ---------------------------------------------------
 # The SQL-UI analog: every exec node registers its MetricsRegistry with the
 # query's collector at construction (TpuExec.__init__), so a finished query
@@ -309,6 +472,11 @@ class QueryMetricsCollector:
         self.query_id = f"q{next(_query_counter):04d}-{os.getpid():x}-" \
                         f"{uuid.uuid4().hex[:8]}"
         self.description = description
+        # cross-process trace id: defaults to the query id; the serving
+        # endpoint/session may override it from the client's SUBMIT frame
+        # (runtime/tracing.current_trace_id reads it through the ambient
+        # collector so every worker thread inherits it)
+        self.trace_id = self.query_id
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
         self._nodes: dict[int, object] = {}   # node_id -> exec node
@@ -319,6 +487,11 @@ class QueryMetricsCollector:
         # collector — correct under concurrent queries where the old
         # start/finish delta would count a peer's retries as this query's
         self._resilience_local = MetricsRegistry("DEBUG")
+        # query-scoped compile/dispatch counters, mirrored by compile_add()
+        # from runtime/fuse.py — the retrace denominator (a healthy repeat
+        # query shows compiles == 0 here while dispatches == O(batches))
+        self._compile_lock = threading.Lock()
+        self._compile_local = {"compiles": 0, "dispatches": 0}
         # cooperative cancellation (runtime/scheduler.py): the session's
         # action sets the query's CancelToken here so every thread that
         # re-enters this collector's scope can reach it
@@ -351,6 +524,12 @@ class QueryMetricsCollector:
             return dict(self._resilience)
         return {name: self._resilience_local.metric(name).value
                 for name in RESILIENCE_METRICS}
+
+    def compile_metrics(self) -> dict:
+        """XLA compiles (traces) and program dispatches attributable to THIS
+        query (runtime/fuse.py mirrors them here via compile_add)."""
+        with self._compile_lock:
+            return dict(self._compile_local)
 
     def _walk(self, node, parent_id, depth, visit):
         """Duck-typed hybrid-tree walk (no imports of exec/plan here): device
@@ -413,10 +592,12 @@ class QueryMetricsCollector:
     def annotated_plan(self) -> str:
         """The explain tree annotated per node with its metric snapshot —
         the SQL-UI plan-with-metrics analog."""
+        cm = self.compile_metrics()
         lines = [f"Query {self.query_id}"
                  + (f" [{self.description}]" if self.description else "")
                  + (f" wall={self.wall_s:.4f}s" if self.wall_s is not None
-                    else " (running)")]
+                    else " (running)")
+                 + f" compiles={cm['compiles']} dispatches={cm['dispatches']}"]
 
         def fmt(mname, v):
             if mname.endswith(("Time", "time")) or mname == SELF_TIME:

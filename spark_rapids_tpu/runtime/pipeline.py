@@ -157,6 +157,10 @@ class BoundedBatchQueue:
             if self._depth_gauge is not None:
                 self._depth_gauge.set(self.peak_depth)
             self._cond.notify_all()
+        # live process-wide occupancy (the STATS endpoint's pipeline gauges);
+        # outside the queue lock — the gauge has its own
+        M.add_gauge("pipeline.queued.batches", 1)
+        M.add_gauge("pipeline.queued.bytes", nb)
         if t0 is not None:
             dt = time.perf_counter_ns() - t0
             if self._full is not None:
@@ -201,6 +205,8 @@ class BoundedBatchQueue:
                 item, nb = self._items.popleft()
                 self._bytes -= nb
                 self._cond.notify_all()
+                M.add_gauge("pipeline.queued.batches", -1)
+                M.add_gauge("pipeline.queued.bytes", -nb)
                 out = ("item", item)
             elif self._error is not None:
                 err = self._error
@@ -227,7 +233,9 @@ class BoundedBatchQueue:
             self._items.clear()
             self._bytes = 0
             self._cond.notify_all()
-        for item, _ in items:
+        for item, nb in items:
+            M.add_gauge("pipeline.queued.batches", -1)
+            M.add_gauge("pipeline.queued.bytes", -nb)
             if cleanup is not None:
                 try:
                     cleanup(item)
@@ -303,7 +311,11 @@ def stage_iterator(gen, *, edge: str, conf=None, registry=None, node_id=None,
         from spark_rapids_tpu.runtime import retry as R
         it = iter(gen)
         try:
-            with M.collector_context(collector), TaskContext():
+            # one span per segment run: the srt-pipe-<edge> thread becomes
+            # its own lane in the merged Perfetto timeline (trace id via the
+            # re-entered collector scope, or the executor's process trace)
+            with M.collector_context(collector), TaskContext(), \
+                    tracing.span(f"pipeline.{edge}"):
                 while True:
                     # segment batch loops are the issue's canonical
                     # cancellation points: one check per produced item

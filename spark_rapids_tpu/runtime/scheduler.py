@@ -433,6 +433,9 @@ class QueryScheduler:
                 raise
             waited = time.monotonic() - t.enqueue_t
             running = len(self._running)
+        # admission queue-wait distribution (STATS histograms / bench
+        # percentiles): observed once per admitted query
+        M.histogram("admission.wait").observe(waited)
         EL.emit("query.admitted", query=query_id,
                 estimate_bytes=t.estimate, priority=t.priority,
                 waited_s=round(waited, 4), running=running,
@@ -458,6 +461,17 @@ class QueryScheduler:
             t.token.cancel(reason)
             self._cond.notify_all()
         return True
+
+    def stats(self) -> dict:
+        """Lifetime counters + instantaneous queue state for the serving
+        STATS snapshot (runtime/endpoint.py): admitted/shed/demotions since
+        process start, plus running and queued right now."""
+        with self._cond:
+            return {"admitted": self.admitted, "shed": self.shed,
+                    "demotions": self.demotions,
+                    "running": len(self._running),
+                    "queued": len(self._waiting),
+                    "max_concurrent": self.max_concurrent}
 
     def active_queries(self) -> list:
         """[{query, state, estimate_bytes, priority, waited_s|running_s}]

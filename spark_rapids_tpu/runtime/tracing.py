@@ -1,16 +1,45 @@
-"""Trace ranges — the NVTX analog.
+"""Trace ranges — the NVTX analog, plus the cross-process span plane.
 
 Reference: NvtxWithMetrics.scala:42 couples an NVTX range with a timing metric;
 ranges wrap every hot region (GpuSemaphore.scala:107, aggregate.scala:356) and are
 viewed in Nsight. TPU equivalent: jax.profiler.TraceAnnotation ranges viewable in
-Perfetto/XProf, coupled to GpuMetric timers, gated by spark.rapids.tpu.sql.trace.enabled."""
+Perfetto/XProf, coupled to GpuMetric timers, gated by spark.rapids.tpu.sql.trace.enabled.
+
+Distributed spans (spark.rapids.tpu.trace.dir): the reference views
+whole-cluster execution in Nsight because NVTX ranges from every process land
+in one capture. Here each process appends its ranges to its own JSONL span
+file (``spans-<pid>-<stamp>.jsonl``) tagged with a per-query **trace id** that
+propagates across every process boundary — the MiniCluster task protocol,
+shuffle-transport frame headers, and the endpoint SUBMIT frame — so
+``tools/profiler.py trace <dir>`` can merge them into one Chrome-trace
+timeline (Perfetto) with per-process clock-offset correction
+(runtime/eventlog.set_clock_offset, measured by the driver's two-timestamp
+handshake exchange) and walk the critical path.
+
+Span record schema (validate_span):
+  name  str    range name (trace_range/span) or event name (span_event)
+  ph    "X"|"i"  complete span | zero-duration instant
+  ts    float  wall-clock epoch seconds at span start (LOCAL clock)
+  dur   float  seconds (ph == "X" only)
+  pid   int    writing process
+  proc  str    process label ("driver", "executor-N", ...)
+  tid   str    thread name (pipeline edges appear as their srt-pipe-* lanes)
+  trace str|None  the query's trace id (None for out-of-query spans)
+  off   float  clock offset toward the driver (omitted when 0)
+  args  dict   optional attributes
+"""
 
 from __future__ import annotations
 
 import collections
 import contextlib
+import datetime
+import json
+import os
+import threading
 import time
 
+from spark_rapids_tpu.runtime import eventlog as _eventlog
 from spark_rapids_tpu.runtime import metrics as _metrics
 
 _enabled = False
@@ -23,6 +52,198 @@ _enabled = False
 _events: "collections.deque" = collections.deque(maxlen=512)
 
 
+# ---------------------------------------------------------------------------
+# trace context: which query's trace do spans on this thread belong to
+# ---------------------------------------------------------------------------
+
+_trace_tls = threading.local()
+# per-process default (MiniCluster executors run one task at a time, so the
+# task loop pins the whole process — including pipeline worker threads that
+# never re-enter a collector scope — to the task's trace id)
+_process_trace: "str | None" = None
+
+
+def current_trace_id() -> "str | None":
+    """The trace id spans on this thread are tagged with: an explicit
+    thread-local trace_context() (transport server threads serving a remote
+    fetch), else the ambient query collector's trace id (driver-side worker
+    threads re-enter that scope), else the process default (executor task
+    loops)."""
+    tid = getattr(_trace_tls, "trace", None)
+    if tid is not None:
+        return tid
+    c = _metrics.current_collector()
+    if c is not None:
+        return getattr(c, "trace_id", None) or c.query_id
+    return _process_trace
+
+
+@contextlib.contextmanager
+def trace_context(trace_id: "str | None"):
+    """Pin this thread's spans to `trace_id` (None = no-op passthrough to
+    the ambient lookup)."""
+    prev = getattr(_trace_tls, "trace", None)
+    _trace_tls.trace = trace_id
+    try:
+        yield
+    finally:
+        _trace_tls.trace = prev
+
+
+def set_process_trace(trace_id: "str | None") -> None:
+    """Pin the whole PROCESS to `trace_id` (executor task loops: worker
+    threads spawned by the pipelined executor inherit it without any
+    collector plumbing)."""
+    global _process_trace
+    _process_trace = trace_id
+
+
+# one-shot trace-id handoff into the next collector created on this thread
+# (the endpoint worker thread sets the client's SUBMIT trace id here before
+# running the action; session._run_action takes it)
+def set_pending_trace(trace_id: "str | None") -> None:
+    _trace_tls.pending = trace_id
+
+
+def take_pending_trace() -> "str | None":
+    t = getattr(_trace_tls, "pending", None)
+    _trace_tls.pending = None
+    return t
+
+
+# executor-side event-log records fall back to the ambient trace id for
+# their `query` tag (see eventlog.set_query_fallback) — registered at the
+# bottom of this module once current_trace_id exists
+
+
+def estimate_clock_offset(t_local_send: float, t_remote: float,
+                          t_local_recv: float) -> float:
+    """Two-timestamp offset estimate: assuming symmetric message latency,
+    remote_clock + offset ≈ local_clock. Error is bounded by half the
+    round-trip time."""
+    return (t_local_send + t_local_recv) / 2.0 - t_remote
+
+
+# ---------------------------------------------------------------------------
+# span sink: per-process JSONL span files
+# ---------------------------------------------------------------------------
+
+class SpanWriter:
+    """Append-only JSONL span sink, one file per process per configure."""
+
+    def __init__(self, path: str, process: str):
+        self.path = path
+        self.process = process
+        self._f = open(path, "a", encoding="utf-8")
+        self._lock = threading.Lock()
+
+    def write(self, rec: dict) -> None:
+        line = json.dumps(rec, separators=(",", ":"), default=str)
+        with self._lock:
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+
+_span_writer: "SpanWriter | None" = None
+
+
+def configure_spans(directory: str, process: "str | None" = None) -> str:
+    """Open a span file under `directory` (created if missing) and make it
+    this process's sink; returns the file path. `process` labels the
+    Perfetto process lane ("driver", "executor-3", ...)."""
+    global _span_writer
+    os.makedirs(directory, exist_ok=True)
+    stamp = datetime.datetime.now().strftime("%Y%m%d-%H%M%S")
+    path = os.path.join(directory, f"spans-{os.getpid()}-{stamp}.jsonl")
+    if _span_writer is not None:
+        _span_writer.close()
+    _span_writer = SpanWriter(path, process or f"pid{os.getpid()}")
+    return path
+
+
+def spans_enabled() -> bool:
+    return _span_writer is not None
+
+
+def span_path() -> "str | None":
+    w = _span_writer
+    return w.path if w is not None else None
+
+
+def shutdown_spans() -> None:
+    global _span_writer
+    if _span_writer is not None:
+        _span_writer.close()
+        _span_writer = None
+
+
+def _emit_span(name: str, ph: str, ts: float, dur: "float | None",
+               attrs: "dict | None") -> None:
+    w = _span_writer
+    if w is None:
+        return
+    rec = {"name": name, "ph": ph, "ts": ts, "pid": os.getpid(),
+           "proc": w.process, "tid": threading.current_thread().name,
+           "trace": current_trace_id()}
+    if dur is not None:
+        rec["dur"] = dur
+    off = _eventlog.clock_offset()
+    if off:
+        rec["off"] = off
+    if attrs:
+        rec["args"] = attrs
+    w.write(rec)
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """Explicit span-file range (the trace_range analog for regions that
+    have no metric and no NVTX need: tasks, pipeline segments, fetches).
+    Free when no span sink is configured."""
+    if _span_writer is None:
+        yield
+        return
+    ts = time.time()
+    t0 = time.perf_counter_ns()
+    try:
+        yield
+    finally:
+        if _span_writer is not None:
+            _emit_span(name, "X", ts, (time.perf_counter_ns() - t0) / 1e9,
+                       attrs)
+
+
+def validate_span(rec: dict) -> list:
+    """Schema check for one parsed span record; returns violation strings
+    (empty = valid). Shared by tools/profiler.py trace and the tests."""
+    errs = []
+    if not isinstance(rec.get("name"), str):
+        errs.append("missing 'name'")
+        return errs
+    name = rec["name"]
+    if rec.get("ph") not in ("X", "i"):
+        errs.append(f"{name}: ph must be 'X' or 'i'")
+    if not isinstance(rec.get("ts"), (int, float)):
+        errs.append(f"{name}: missing numeric 'ts'")
+    if rec.get("ph") == "X" and not isinstance(rec.get("dur"), (int, float)):
+        errs.append(f"{name}: X span without numeric 'dur'")
+    if not isinstance(rec.get("pid"), int):
+        errs.append(f"{name}: missing int 'pid'")
+    if not isinstance(rec.get("tid"), str):
+        errs.append(f"{name}: missing thread name 'tid'")
+    return errs
+
+
+# ---------------------------------------------------------------------------
+# span events + ranges
+# ---------------------------------------------------------------------------
+
 def span_event(name: str, **attrs) -> None:
     # tag with the ambient query id so concurrent sessions/tests can filter
     # the process-global ring down to their own query (recent_events(query=))
@@ -30,11 +251,14 @@ def span_event(name: str, **attrs) -> None:
     if qid is not None:
         attrs = dict(attrs, query=qid)
     _events.append((name, attrs))
-    from spark_rapids_tpu.runtime import eventlog
-    if eventlog.enabled():
-        eventlog.emit(name, **attrs)
+    if _eventlog.enabled():
+        _eventlog.emit(name, **attrs)
+    if _span_writer is not None:
+        _emit_span(name, "i", time.time(), None, attrs)
     if _enabled:
         import jax
+        # label construction stays behind the enable check: formatting every
+        # attr dict on a disabled path costs real time at batch granularity
         label = name + ("[" + ",".join(f"{k}={v}" for k, v in attrs.items())
                         + "]" if attrs else "")
         with jax.profiler.TraceAnnotation(label):
@@ -63,8 +287,14 @@ def set_enabled(v: bool):
 
 @contextlib.contextmanager
 def trace_range(name: str, metric=None):
-    """NvtxWithMetrics analog: profiler annotation + optional timing metric."""
-    t0 = time.perf_counter_ns() if metric is not None else 0
+    """NvtxWithMetrics analog: profiler annotation + optional timing metric
+    + (when a span sink is configured) a span-file range, so every
+    NVTX-wrapped hot region lands on the merged distributed timeline for
+    free."""
+    w = _span_writer
+    need_t = metric is not None or w is not None
+    t0 = time.perf_counter_ns() if need_t else 0
+    ts = time.time() if w is not None else 0.0
     with contextlib.ExitStack() as stack:
         if _enabled:
             import jax
@@ -72,8 +302,12 @@ def trace_range(name: str, metric=None):
         try:
             yield
         finally:
-            if metric is not None:
-                metric.add(time.perf_counter_ns() - t0)
+            if need_t:
+                dt = time.perf_counter_ns() - t0
+                if metric is not None:
+                    metric.add(dt)
+                if w is not None and _span_writer is not None:
+                    _emit_span(name, "X", ts, dt / 1e9, None)
 
 
 _profiling = False
@@ -115,3 +349,6 @@ def stop_profile() -> None:
             pass
         _profiling = False
         atexit.unregister(stop_profile)
+
+
+_eventlog.set_query_fallback(current_trace_id)

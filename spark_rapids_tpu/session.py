@@ -267,8 +267,15 @@ class DataFrame:
         from spark_rapids_tpu.runtime import eventlog as EL
         from spark_rapids_tpu.runtime import metrics as M
         from spark_rapids_tpu.runtime import scheduler as SCHED
+        from spark_rapids_tpu.runtime import tracing
         conf = self.session.conf
         collector = M.QueryMetricsCollector(description=type(plan).__name__)
+        # cross-process trace id: a pending handoff (endpoint SUBMIT frame)
+        # wins, then an explicit session override, else the query id — every
+        # span this query emits, in every process it touches, carries it
+        collector.trace_id = (tracing.take_pending_trace()
+                              or conf.get(CFG.TRACE_ID_OVERRIDE)
+                              or collector.query_id)
         deadline_s = conf.get(CFG.SCHEDULER_QUERY_DEADLINE)
         token = SCHED.CancelToken(
             collector.query_id,
@@ -277,8 +284,17 @@ class DataFrame:
         self._last_collector = collector
         self.session._last_collector = collector
         sched = SCHED.QueryScheduler.get()
+        priority = conf.get(CFG.SCHEDULER_PRIORITY)
+
+        def observe_latency():
+            # end-to-end latency histogram per priority class (admission
+            # wait included) — the serving tier's STATS/percentile source
+            if collector.wall_s is not None:
+                M.histogram(f"query.latency.priority{priority}").observe(
+                    collector.wall_s)
         admitted = False
-        with M.collector_context(collector):
+        with M.collector_context(collector), \
+                tracing.span("query", query=collector.query_id):
             hybrid = TpuOverrides(conf).apply(plan)
             collector.set_root(hybrid)
             try:
@@ -286,7 +302,7 @@ class DataFrame:
                 sched.submit(
                     collector.query_id,
                     SCHED.estimate_footprint(plan),
-                    priority=conf.get(CFG.SCHEDULER_PRIORITY),
+                    priority=priority,
                     token=token,
                     timeout_s=queue_timeout if queue_timeout > 0 else None,
                     description=collector.description)
@@ -296,7 +312,10 @@ class DataFrame:
                 out = run(hybrid)
             except SCHED.QueryCancelledError as e:
                 M.resilience_add(M.QUERIES_CANCELLED)
+                if isinstance(e, SCHED.QueryDeadlineError):
+                    M.counter_add("queries.deadline")
                 collector.finish()
+                observe_latency()
                 _abort_execs(collector)
                 EL.emit("query.deadline" if isinstance(
                             e, SCHED.QueryDeadlineError)
@@ -317,9 +336,13 @@ class DataFrame:
                 if admitted:
                     sched.release(collector.query_id)
         collector.finish()
+        observe_latency()
+        compile_m = collector.compile_metrics()
         EL.emit("query.end", query=collector.query_id,
                 description=collector.description,
                 wall_s=collector.wall_s,
+                compiles=compile_m["compiles"],
+                dispatches=compile_m["dispatches"],
                 resilience=collector.query_resilience(),
                 nodes=collector.node_summaries())
         return out
@@ -622,6 +645,17 @@ class TpuSession:
         pdir = self.conf.get(CFG.PROFILE_DIR)
         if pdir:
             tracing.start_profile(pdir)
+        # distributed span plane (trace.dir): per-process JSONL span files
+        # merged by tools/profiler.py trace — process-global like the
+        # switches above; only an EXPLICIT setting opens (or closes, when
+        # set empty) the sink. MiniCluster executors open their own from
+        # the same conf key (cluster/minicluster._executor_main)
+        if CFG.TRACE_DIR.key in self.conf.settings:
+            tdir = self.conf.get(CFG.TRACE_DIR)
+            if tdir:
+                tracing.configure_spans(tdir, process="driver")
+            else:
+                tracing.shutdown_spans()
         # deterministic fault injection (chaos testing, runtime/faults.py):
         # process-global like the switches above — only an EXPLICIT setting
         # arms or re-seeds the injector

@@ -24,6 +24,7 @@ import threading
 
 from spark_rapids_tpu import config as CFG
 from spark_rapids_tpu.runtime import faults as F
+from spark_rapids_tpu.runtime import tracing
 from spark_rapids_tpu.shuffle.compression import (BatchedTableCompressor,
                                                   TableCompressionCodec,
                                                   get_codec)
@@ -124,6 +125,24 @@ def _recv_frame(sock, max_bytes: "int | None" = None):
 # length-prefixed frame protocol over its own message-id space
 send_frame = _send_frame
 recv_frame = _recv_frame
+
+
+# -- trace-context propagation over the wire ---------------------------------
+# Request payloads (MSG_METADATA_REQ / MSG_TRANSFER_REQ) carry the fetching
+# query's trace id as trailing UTF-8 bytes after their fixed-width fields, so
+# spans the SERVING process emits while a reducer pulls (D2H serialize,
+# compress, chunked send) land on the same merged timeline as the reducer's
+# own spans. Absent bytes (an empty suffix) mean no ambient trace.
+
+def _trace_suffix() -> bytes:
+    tid = tracing.current_trace_id()
+    return tid.encode("utf-8") if tid else b""
+
+
+def _decode_trace(payload: bytes, offset: int) -> "str | None":
+    if len(payload) <= offset:
+        return None
+    return payload[offset:].decode("utf-8", "replace")
 
 
 class BlockMeta:
@@ -239,15 +258,20 @@ class _ServerHandler(socketserver.BaseRequestHandler):
         return blobs
 
     def _metadata(self, server, sock, payload):
-        shuffle_id, reduce_id = struct.unpack("<II", payload)
-        try:
-            blobs = self._blocks(server, shuffle_id, reduce_id)
-            keys = server.block_keys(shuffle_id, reduce_id)
-            crcs = server.block_crcs(shuffle_id, reduce_id)
-        except KeyError:
-            _send_frame(sock, MSG_ERROR,
-                        f"unknown shuffle {shuffle_id}".encode())
-            return
+        shuffle_id, reduce_id = struct.unpack_from("<II", payload, 0)
+        with tracing.trace_context(_decode_trace(payload, 8)), \
+                tracing.span("shuffle.serve.metadata", shuffle=shuffle_id,
+                             reduce=reduce_id):
+            try:
+                # first fetcher pays the D2H serialize + compress here —
+                # the span makes that cost visible on the serving process
+                blobs = self._blocks(server, shuffle_id, reduce_id)
+                keys = server.block_keys(shuffle_id, reduce_id)
+                crcs = server.block_crcs(shuffle_id, reduce_id)
+            except KeyError:
+                _send_frame(sock, MSG_ERROR,
+                            f"unknown shuffle {shuffle_id}".encode())
+                return
         # per block: size + the store's (map_split, seq) key, so a reducer
         # merging several peers can reconstruct one canonical block order,
         # plus the block's CRC (the sentinel below = checksums disabled)
@@ -263,18 +287,23 @@ class _ServerHandler(socketserver.BaseRequestHandler):
         _send_frame(sock, MSG_METADATA_RESP, out.getvalue())
 
     def _transfer(self, server, sock, payload):
-        shuffle_id, reduce_id, index, chunk = struct.unpack("<IIIQ", payload)
-        try:
-            blob = self._blocks(server, shuffle_id, reduce_id)[index]
-        except (KeyError, IndexError):
-            _send_frame(sock, MSG_ERROR, b"unknown block")
-            return
-        # windowed send: bounce-buffer-sized chunks (WindowedBlockIterator)
-        for off in range(0, len(blob), chunk):
-            piece = blob[off:off + chunk]
-            hdr = struct.pack("<IIQ", index, 1 if off + chunk >= len(blob)
-                              else 0, off)
-            _send_frame(sock, MSG_BLOCK_CHUNK, hdr + piece)
+        shuffle_id, reduce_id, index, chunk = struct.unpack_from(
+            "<IIIQ", payload, 0)
+        with tracing.trace_context(_decode_trace(payload, 20)), \
+                tracing.span("shuffle.serve.block", shuffle=shuffle_id,
+                             reduce=reduce_id, index=index):
+            try:
+                blob = self._blocks(server, shuffle_id, reduce_id)[index]
+            except (KeyError, IndexError):
+                _send_frame(sock, MSG_ERROR, b"unknown block")
+                return
+            # windowed send: bounce-buffer-sized chunks
+            # (WindowedBlockIterator)
+            for off in range(0, len(blob), chunk):
+                piece = blob[off:off + chunk]
+                hdr = struct.pack("<IIQ", index,
+                                  1 if off + chunk >= len(blob) else 0, off)
+                _send_frame(sock, MSG_BLOCK_CHUNK, hdr + piece)
 
 
 class TcpShuffleServer:
@@ -398,9 +427,10 @@ class TcpShuffleClient(ShuffleClient):
         # detected by the OS probes / the socket timeout, not only by the
         # heartbeat manager's (much slower) expiry ladder
         configure_socket(sock, timeout_s=30)
+        trace = _trace_suffix()
         try:
             _send_frame(sock, MSG_METADATA_REQ,
-                        struct.pack("<II", shuffle_id, reduce_id))
+                        struct.pack("<II", shuffle_id, reduce_id) + trace)
             msg_type, payload = _recv_frame(sock)
             if msg_type == MSG_ERROR:
                 raise TransportError(payload.decode())
@@ -409,19 +439,27 @@ class TcpShuffleClient(ShuffleClient):
                      for i in range(n_blocks)]
             for index, (size, k0, k1, crc) in enumerate(metas):
                 with self.throttle.acquire(size):
-                    _send_frame(sock, MSG_TRANSFER_REQ,
-                                struct.pack("<IIIQ", shuffle_id, reduce_id,
-                                            index, self.bounce_bytes))
-                    buf = bytearray()
-                    while True:
-                        msg_type, payload = _recv_frame(sock)
-                        if msg_type == MSG_ERROR:
-                            raise TransportError(payload.decode())
-                        assert msg_type == MSG_BLOCK_CHUNK, msg_type
-                        bidx, last, off = struct.unpack_from("<IIQ", payload, 0)
-                        buf.extend(payload[16:])
-                        if last:
-                            break
+                    # span scoped to the wire transfer only — the trailing
+                    # yield suspends this generator at the consumer's pace,
+                    # which must not inflate the fetch span
+                    with tracing.span("shuffle.fetch.block",
+                                      shuffle=shuffle_id, reduce=reduce_id,
+                                      index=index, bytes=size):
+                        _send_frame(sock, MSG_TRANSFER_REQ,
+                                    struct.pack("<IIIQ", shuffle_id,
+                                                reduce_id, index,
+                                                self.bounce_bytes) + trace)
+                        buf = bytearray()
+                        while True:
+                            msg_type, payload = _recv_frame(sock)
+                            if msg_type == MSG_ERROR:
+                                raise TransportError(payload.decode())
+                            assert msg_type == MSG_BLOCK_CHUNK, msg_type
+                            bidx, last, off = struct.unpack_from(
+                                "<IIQ", payload, 0)
+                            buf.extend(payload[16:])
+                            if last:
+                                break
                     if len(buf) != size:
                         raise TransportError(
                             f"short block: got {len(buf)} want {size}")

@@ -31,6 +31,10 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="cluster_chaos.py", description=__doc__)
     p.add_argument("--data-dir", required=True)
     p.add_argument("--eventlog-dir", default=None)
+    p.add_argument("--trace-dir", default=None,
+                   help="span-file directory (spark.rapids.tpu.trace.dir) "
+                        "for the distributed trace of both runs; defaults "
+                        "to --eventlog-dir when that is set")
     p.add_argument("--query", default="q18")
     p.add_argument("--scale", type=float, default=0.01)
     p.add_argument("--executors", type=int, default=3)
@@ -56,6 +60,9 @@ def main(argv=None) -> int:
     settings = {}
     if args.eventlog_dir:
         settings["spark.rapids.tpu.eventLog.dir"] = args.eventlog_dir
+    trace_dir = args.trace_dir or args.eventlog_dir
+    if trace_dir:
+        settings["spark.rapids.tpu.trace.dir"] = trace_dir
     spark = TpuSession(settings)
     dfs = tpch.load(spark, paths, files_per_partition=4)
     df = tpch.QUERIES[args.query](dfs)

@@ -1,4 +1,4 @@
-"""profiler — replay a structured event log into a tuning report.
+"""profiler — replay structured logs into tuning reports and trace views.
 
 The Profiling Tool analog (reference tools/ "Profiling Tool" post-processes
 Spark event logs + Rapids metrics into per-query tuning reports). Input is
@@ -12,12 +12,28 @@ the JSONL event log written by spark_rapids_tpu/runtime/eventlog.py
   - shuffle partition skew per exchange (max/mean of reduce-partition bytes)
   - scan readahead stall time (decode-bound scans)
 
+``trace`` merges the per-process span files written under
+spark.rapids.tpu.trace.dir (runtime/tracing.py) — driver, MiniCluster
+executors (respawned incarnations included), endpoint workers — into ONE
+Chrome-trace-event JSON that loads in Perfetto: one pid lane per process,
+one tid lane per thread (pipeline edges appear as their srt-pipe-* worker
+threads, task slots as executor main threads), zero-duration instants for
+oom.retry / oom.split / fetch.recompute / spill. Per-process clock offsets
+(measured by the driver's two-timestamp handshake, runtime/eventlog
+set_clock_offset) are applied before merging so cross-process ordering is
+correct. It also prints a **critical-path table**: the longest dependent
+chain of spans bounding the query's wall time, with per-edge blame
+(decode vs compute vs exchange vs queue-wait) — the direct input to the
+fusion/concurrency/scale-out items on the roadmap.
+
 Usage:
   python tools/profiler.py report <eventlog.jsonl> [--json] [--top N]
   python tools/profiler.py report <eventlog.jsonl> --compare <other.jsonl>
+  python tools/profiler.py trace <logdir> [--query TRACE] [--out trace.json]
 
-Exit status is non-zero on schema violations or when no query in the log
-carries a non-empty operator breakdown — CI uses this as a gate.
+Exit status is non-zero on schema violations, when no query in the log
+carries a non-empty operator breakdown (report), or on malformed span files
+/ an empty merged trace (trace) — CI uses both as gates.
 """
 
 from __future__ import annotations
@@ -305,6 +321,231 @@ def analyze(records: list) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# distributed trace: span-file merge + Chrome export + critical path
+# ---------------------------------------------------------------------------
+
+def _tracing_module():
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    from spark_rapids_tpu.runtime import tracing
+    return tracing
+
+
+def load_spans(logdir: str):
+    """Parse every spans-*.jsonl under `logdir`; returns (records,
+    violations). Each record gains `_t0`/`_t1`: clock-offset-corrected
+    start/end epoch seconds (instants have _t0 == _t1)."""
+    tracing = _tracing_module()
+    records, violations = [], []
+    paths = sorted(pathlib.Path(logdir).glob("spans-*.jsonl"))
+    if not paths:
+        violations.append(f"{logdir}: no spans-*.jsonl span files")
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError as e:
+                    violations.append(
+                        f"{path}:{lineno}: unparseable line ({e})")
+                    continue
+                errs = tracing.validate_span(rec)
+                if errs:
+                    violations.extend(f"{path}:{lineno}: {v}" for v in errs)
+                    continue
+                off = rec.get("off", 0.0) or 0.0
+                rec["_t0"] = rec["ts"] + off
+                rec["_t1"] = rec["_t0"] + (rec.get("dur") or 0.0)
+                records.append(rec)
+    return records, violations
+
+
+def pick_trace(records: list, query: "str | None" = None):
+    """Select one trace's spans. `query` matches the trace id exactly (a
+    query id IS its default trace id). Default: the trace with the latest
+    activity (the run the operator just finished)."""
+    by_trace: dict = {}
+    for r in records:
+        if r.get("trace"):
+            by_trace.setdefault(r["trace"], []).append(r)
+    if query is not None:
+        return query, by_trace.get(query, [])
+    if not by_trace:
+        return None, []
+    tid = max(by_trace, key=lambda t: max(r["_t1"] for r in by_trace[t]))
+    return tid, by_trace[tid]
+
+
+def chrome_trace(spans: list) -> dict:
+    """Chrome-trace-event JSON (Perfetto-loadable): one pid lane per
+    process (labelled by its `proc`), one tid lane per thread, `X` complete
+    events for ranges and `i` instants for span events; timestamps in
+    microseconds relative to the earliest span, clock offsets applied."""
+    if not spans:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    base = min(s["_t0"] for s in spans)
+    events = []
+    procs: dict = {}
+    tids: dict = {}
+    for s in spans:
+        pid = s["pid"]
+        if pid not in procs:
+            procs[pid] = s.get("proc", f"pid{pid}")
+            events.append({"name": "process_name", "ph": "M", "pid": pid,
+                           "tid": 0, "args": {"name": procs[pid]}})
+        tkey = (pid, s["tid"])
+        if tkey not in tids:
+            tids[tkey] = len([k for k in tids if k[0] == pid]) + 1
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": tids[tkey], "args": {"name": s["tid"]}})
+        ev = {"name": s["name"], "ph": s["ph"], "pid": pid,
+              "tid": tids[tkey], "ts": round((s["_t0"] - base) * 1e6, 3),
+              "args": dict(s.get("args") or {}, trace=s.get("trace"))}
+        if s["ph"] == "X":
+            ev["dur"] = round((s.get("dur") or 0.0) * 1e6, 3)
+        else:
+            ev["s"] = "t"
+        events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# span-name → blame category for the critical-path table. Deliberately
+# name-based: every producer of spans (trace_range call sites, task/pipeline
+# wrappers, the fetch/serve paths) is in-repo, so the mapping is total
+# enough, and anything novel lands in "other" rather than crashing.
+_BLAME = (
+    ("queue-wait", ("queue", "wait", "admission", "semaphore", "stall")),
+    ("decode", ("decode", "scan", "readahead", "parquet", "orc", "csv")),
+    ("exchange", ("fetch", "exchange", "shuffle", "transport", "serve",
+                  "h2d", "d2h", "broadcast", "spill")),
+    ("compute", ("project", "filter", "agg", "join", "sort", "window",
+                 "expand", "generate", "udf", "pandas", "python", "task.",
+                 "pipeline.", "compute")),
+)
+
+# container/window spans excluded from the dependent-chain walk: they
+# overlap everything inside them and carry no blame of their own
+_WINDOW_NAMES = ("query", "cluster.query")
+
+
+def _blame_category(name: str) -> str:
+    n = name.lower()
+    for cat, keys in _BLAME:
+        if any(k in n for k in keys):
+            return cat
+    return "other"
+
+
+def critical_path(spans: list):
+    """The longest dependent chain of spans bounding the trace's wall time.
+
+    Window = the trace's `query`/`cluster.query` span (fallback: the full
+    span extent). Backward greedy walk: from the window's end, repeatedly
+    take the span active at the cursor with the LATEST start (the innermost
+    leaf — container spans lose ties by construction), jump to its start,
+    and record uncovered gaps as idle. Returns (window, chain, blame) where
+    chain entries carry their clipped contribution and blame sums
+    contributions per category."""
+    windows = [s for s in spans if s["ph"] == "X"
+               and s["name"] in _WINDOW_NAMES]
+    xs = [s for s in spans if s["ph"] == "X"
+          and s["name"] not in _WINDOW_NAMES and (s.get("dur") or 0) > 0]
+    if windows:
+        w = max(windows, key=lambda s: s.get("dur") or 0.0)
+        t_start, t_end = w["_t0"], w["_t1"]
+        wname = w["name"]
+    elif xs:
+        t_start = min(s["_t0"] for s in xs)
+        t_end = max(s["_t1"] for s in xs)
+        wname = "(extent)"
+    else:
+        return None, [], {}
+    window = {"name": wname, "start": t_start, "wall_s": t_end - t_start}
+    eps = 1e-7
+    chain = []
+    cursor = t_end
+    while cursor > t_start + eps and len(chain) < 1024:
+        active = [s for s in xs
+                  if s["_t0"] < cursor - eps and s["_t1"] >= cursor - eps]
+        if active:
+            s = max(active, key=lambda a: a["_t0"])
+            lo = max(s["_t0"], t_start)
+            chain.append({"name": s["name"], "proc": s.get("proc"),
+                          "tid": s["tid"],
+                          "category": _blame_category(s["name"]),
+                          "start_s": lo - t_start,
+                          "contrib_s": min(s["_t1"], cursor) - lo,
+                          "span_dur_s": s.get("dur") or 0.0})
+            cursor = s["_t0"]
+        else:
+            ends = [s["_t1"] for s in xs if s["_t1"] < cursor - eps]
+            nxt = max(ends) if ends else t_start
+            nxt = max(nxt, t_start)
+            chain.append({"name": "(unattributed)", "proc": None,
+                          "tid": None, "category": "other",
+                          "start_s": nxt - t_start,
+                          "contrib_s": cursor - nxt, "span_dur_s": 0.0})
+            cursor = nxt
+    chain.reverse()
+    blame: dict = {}
+    for c in chain:
+        blame[c["category"]] = blame.get(c["category"], 0.0) + c["contrib_s"]
+    return window, chain, blame
+
+
+def render_critical_path(window, chain, blame, top: int = 15) -> str:
+    out = [f"== critical path: window {window['name']} "
+           f"wall={window['wall_s']:.4f}s, {len(chain)} chain segments"]
+    total = sum(blame.values()) or 1.0
+    ranked = sorted(blame.items(), key=lambda kv: -kv[1])
+    out.append("  per-edge blame (chain seconds bounding wall time):")
+    for cat, s in ranked:
+        out.append(f"    {cat:>10}: {s:>9.4f}s  {s / total:>6.1%}")
+    if ranked:
+        out.append(f"  bounding edge: {ranked[0][0]} "
+                   f"({ranked[0][1]:.4f}s of {window['wall_s']:.4f}s wall)")
+    merged = sorted(chain, key=lambda c: -c["contrib_s"])[:top]
+    out.append(f"  top chain segments (of {len(chain)}):")
+    out.append(f"    {'start_s':>9}  {'contrib_s':>9}  {'category':>10}  "
+               "span @ process/thread")
+    for c in merged:
+        loc = f"{c['proc']}/{c['tid']}" if c["proc"] else "-"
+        out.append(f"    {c['start_s']:>9.4f}  {c['contrib_s']:>9.4f}  "
+                   f"{c['category']:>10}  {c['name']} @ {loc}")
+    return "\n".join(out)
+
+
+def trace_main(args) -> int:
+    records, violations = load_spans(args.logdir)
+    rc = 0
+    if violations:
+        for v in violations:
+            print(f"SPAN SCHEMA VIOLATION: {v}", file=sys.stderr)
+        rc = 1
+    trace_id, spans = pick_trace(records, args.query)
+    if not spans:
+        print(f"ERROR: no spans for trace {args.query or '<latest>'} in "
+              f"{args.logdir}", file=sys.stderr)
+        return 1
+    trace = chrome_trace(spans)
+    out_path = args.out or os.path.join(args.logdir, "trace.json")
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(trace, f)
+    n_procs = len({s["pid"] for s in spans})
+    print(f"trace {trace_id}: {len(spans)} spans from {n_procs} process(es) "
+          f"-> {out_path} (load in Perfetto / chrome://tracing)")
+    window, chain, blame = critical_path(spans)
+    if window is None or not chain:
+        print("ERROR: empty critical path (no complete spans in the trace)",
+              file=sys.stderr)
+        return 1
+    print(render_critical_path(window, chain, blame, top=args.top))
+    return rc
+
+
+# ---------------------------------------------------------------------------
 # rendering
 # ---------------------------------------------------------------------------
 
@@ -488,7 +729,23 @@ def main(argv=None) -> int:
                      help="machine-readable analysis instead of text")
     rep.add_argument("--top", type=int, default=15,
                      help="operator table rows per query")
+    tr = sub.add_parser(
+        "trace", help="merge span files into Chrome-trace JSON + critical "
+                      "path (Perfetto)")
+    tr.add_argument("logdir", help="directory holding spans-*.jsonl files "
+                                   "(spark.rapids.tpu.trace.dir)")
+    tr.add_argument("--query", default=None,
+                    help="trace id to export (a query id is its own trace "
+                         "id); default: the most recent trace")
+    tr.add_argument("--out", default=None,
+                    help="Chrome-trace JSON output path "
+                         "(default <logdir>/trace.json)")
+    tr.add_argument("--top", type=int, default=15,
+                    help="critical-path chain segments to print")
     args = p.parse_args(argv)
+
+    if args.cmd == "trace":
+        return trace_main(args)
 
     records, violations = load_log(args.eventlog)
     analysis = analyze(records)

@@ -11,6 +11,12 @@ Usage:
   python tools/tpu_client.py --port 8765 --sql "select count(*) c from t"
   python tools/tpu_client.py --port 8765 --sql-file q.sql --priority 5 \
       --deadline 30 --retries 8 --quiet
+  python tools/tpu_client.py --port 8765 stats      # live serving metrics
+
+``stats`` (or --stats) fetches the endpoint's live serving-metrics snapshot
+— a Prometheus-style text exposition of admission/shed/cancel/deadline
+counters, the resilience registry, HBM/spill/queue gauges and per-priority
+latency histograms — without submitting a query.
 
 Exit codes: 0 ok, 2 rejected/unreachable after all retries, 3 query error.
 """
@@ -24,10 +30,18 @@ import sys
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tpu_client.py", description=__doc__)
+    p.add_argument("command", nargs="?", choices=["stats"],
+                   help="'stats' fetches the live serving-metrics snapshot")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, required=True)
     p.add_argument("--sql", help="SQL text (or use --sql-file / stdin '-')")
     p.add_argument("--sql-file", help="read the SQL text from this file")
+    p.add_argument("--stats", action="store_true",
+                   help="fetch the live serving-metrics snapshot (alias of "
+                        "the 'stats' command)")
+    p.add_argument("--trace", default=None,
+                   help="distributed trace id attached to this submission "
+                        "(server-side spans merge into it)")
     p.add_argument("--priority", type=int, default=None,
                    help="admission priority (scheduler.priority)")
     p.add_argument("--deadline", type=float, default=None,
@@ -43,12 +57,13 @@ def main(argv=None) -> int:
                    help="print only the summary line, not the rows")
     args = p.parse_args(argv)
 
+    stats_mode = args.stats or args.command == "stats"
     sql = args.sql
     if sql is None and args.sql_file:
         sql = (sys.stdin.read() if args.sql_file == "-"
                else pathlib.Path(args.sql_file).read_text())
-    if not sql:
-        p.error("one of --sql / --sql-file is required")
+    if not sql and not stats_mode:
+        p.error("one of --sql / --sql-file / stats is required")
 
     sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
     from spark_rapids_tpu.runtime.endpoint import EndpointClient
@@ -58,6 +73,17 @@ def main(argv=None) -> int:
 
     cli = EndpointClient((args.host, args.port), timeout_s=args.timeout)
 
+    if stats_mode:
+        try:
+            print(cli.stats(), end="")
+        except TransportError as e:
+            print(f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 2
+        except Exception as e:   # noqa: BLE001 — typed server error
+            print(f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 3
+        return 0
+
     def on_retry(attempt, delay):
         print(f"retry {attempt}/{args.retries} in {delay:.2f}s "
               "(server backoff hint honored)", file=sys.stderr)
@@ -66,7 +92,7 @@ def main(argv=None) -> int:
         table = cli.submit_with_retry(
             sql, max_attempts=max(1, args.retries), on_retry=on_retry,
             priority=args.priority, deadline_s=args.deadline,
-            queue_timeout_s=args.queue_timeout,
+            queue_timeout_s=args.queue_timeout, trace=args.trace,
             description="tpu_client")
     except (QueryRejectedError, TransportError) as e:
         print(f"{type(e).__name__}: {e}", file=sys.stderr)
