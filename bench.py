@@ -240,6 +240,18 @@ def child_main():
                     round(total_self / qm.wall_s, 3) if qm.wall_s else None)
                 per_query[name]["queue_stall_s"] = round(
                     queue_stall_ns / 1e9, 4)
+                # memory trajectory (allocation-site heap profiler): BENCH
+                # files record the hot rep's device high-water mark and who
+                # owned it, not just throughput
+                msum = qm.memory or {}
+                if msum:
+                    per_query[name]["peak_device_bytes"] = \
+                        msum.get("peak_device_bytes", 0)
+                    msites = msum.get("sites") or {}
+                    if msites:
+                        per_query[name]["top_alloc_site"] = max(
+                            msites.items(),
+                            key=lambda kv: kv[1].get("peak_bytes", 0))[0]
 
     # resilience counters (retry/split/fetch-failover totals across the
     # whole ladder run): with faults disabled these must be zero — a later

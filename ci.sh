@@ -36,18 +36,32 @@ d = json.loads(sys.argv[1])
 assert "metric" in d and d["value"] > 0, d
 assert "spread" in d and "queries" in d, d
 # with no faults configured the retry spine AND the cluster recovery
-# ladder must be invisible: every resilience counter zero
+# ladder must be invisible: every resilience counter zero — the
+# memoryLeakedBuffers counter riding here makes leak-freedom a standing
+# invariant of every no-faults bench
 assert not any(d["resilience"].values()), d["resilience"]
 # compile/retrace telemetry: whole-process totals plus per-query hot-rep
 # deltas (the retrace denominator for the fusion roadmap gate)
 assert d["compiles"] > 0 and d["dispatches"] > 0, d
 for q, pq in d["queries"].items():
     assert "compiles" in pq and "dispatches" in pq, (q, pq)
+    # memory trajectory: every per-query entry records its device
+    # high-water mark and the allocation site that owned it
+    assert pq.get("peak_device_bytes", 0) > 0, (q, pq)
+    assert pq.get("top_alloc_site"), (q, pq)
 print("bench-child dry-run ok:", d["metric"], d["value"], d["unit"],
       "spread", d["spread"], "resilience", d["resilience"],
       "hot-rep compiles",
-      {q: pq["compiles"] for q, pq in d["queries"].items()})
+      {q: pq["compiles"] for q, pq in d["queries"].items()},
+      "peak_dev", {q: pq["peak_device_bytes"] for q, pq in d["queries"].items()})
 ' "$bench_line"
+# perf-trajectory soft gate: compare the line against the committed
+# baseline (warn >10%, fail >25% geomean regression of the per-query
+# oracle-normalized scores). The sf0.01 CI dry-run is NOT comparable to
+# the committed sf0.1 line, so this prints the SKIP reason here; round
+# drivers comparing same-scale lines get the real gate
+echo "$bench_line" > /tmp/ci_bench_line.json
+python tools/bench_compare.py /tmp/ci_bench_line.json --baseline BENCH_r06.json
 
 echo "== radix spine: kernel interpret tests + join microbench smoke =="
 # the exact kernel set the next chip window's probe latch will exercise,
@@ -165,11 +179,20 @@ for e in evs:
     assert {"name", "ph", "ts", "pid", "tid"} <= set(e), e
     assert e["ph"] != "X" or "dur" in e, e
 pids = {e["pid"] for e in evs}
-traces = {e["args"].get("trace") for e in evs if e.get("args")}
+# counter samples (ph C) carry numeric series only, no trace-id arg
+traces = {e["args"].get("trace") for e in evs
+          if e.get("args") and e["ph"] != "C"}
 assert len(pids) >= 2, pids      # driver + executor lanes
 assert len(traces) == 1, traces  # every span carries the query's trace id
+# executor MEMORY lanes: the merged trace must carry per-process memory
+# counter tracks from >=2 processes (executors allocate shuffle blobs in
+# their own catalogs; their samples ride the same span files)
+mem_pids = {e["pid"] for e in evs
+            if e["ph"] == "C" and e["name"] == "memory"}
+assert len(mem_pids) >= 2, ("memory counter lanes", mem_pids)
 print("chaos chrome trace ok:", len(evs), "events from", len(pids),
-      "processes, trace", traces.pop())
+      "processes, trace", traces.pop(), "memory lanes from",
+      len(mem_pids), "processes")
 PYEOF
 # a malformed span file must fail the trace export loudly
 bad_dir=$(mktemp -d); echo '{broken json' > "$bad_dir/spans-1-x.jsonl"
@@ -316,9 +339,14 @@ def run(conf):
     return statistics.median(ts)
 
 off_s = run({})
+# memory profiling rides inside the SAME <5% budget: allocation-site
+# accounting is always on, and the fine-grained watermark timeline
+# (64k sample interval) is part of the "on" run being timed
 on_s = run({"spark.rapids.tpu.eventLog.dir": os.environ["SRT_OBS_DIR"],
             "spark.rapids.tpu.eventLog.healthSample.intervalSeconds": 0.5,
-            "spark.rapids.tpu.trace.dir": os.environ["SRT_OBS_DIR"]})
+            "spark.rapids.tpu.trace.dir": os.environ["SRT_OBS_DIR"],
+            "spark.rapids.tpu.memory.profile.watermarkIntervalBytes": "64k",
+            "spark.rapids.tpu.memory.leak.check": "true"})
 eventlog.shutdown()
 from spark_rapids_tpu.runtime import tracing
 tracing.shutdown_spans()
@@ -344,11 +372,42 @@ print("profiler gate ok:", len(qs), "queries,",
       len(q18["operators"]), "operators, self-time coverage",
       q18["coverage"])
 '
+# memory observability plane from the SAME q18 run: the heap profiler must
+# attribute >=90% of the recorded peak to NAMED allocation sites, the
+# watermark timeline must be monotone, and a clean run reports zero leaks
+python tools/profiler.py memory "$obs_log" > /tmp/obs_memory.txt
+grep -q "watermark timeline" /tmp/obs_memory.txt
+grep -q "no leaks detected" /tmp/obs_memory.txt
+python tools/profiler.py memory "$obs_log" --json > /tmp/obs_memory.json
+python -c '
+import json
+m = json.load(open("/tmp/obs_memory.json"))
+assert m["watermarks"], "no watermark samples"
+marks = [w["watermark_bytes"] for w in m["watermarks"]]
+assert marks == sorted(marks), "watermark ran backwards"
+assert not m["leaks"], m["leaks"]
+assert m["peak_attribution"] is not None and m["peak_attribution"] >= 0.9, \
+    (m["peak_attribution"], m["peak"])
+assert m["queries"] and all(q["peak_device_bytes"] > 0 for q in m["queries"])
+print("memory profiler gate ok:", len(m["watermarks"]), "samples, peak",
+      m["peak"]["device_bytes"], "B, attribution", m["peak_attribution"],
+      "to sites", sorted(m["peak"]["sites"]))
+'
 # the SAME run's span file must export to a Perfetto-loadable trace with a
-# non-empty critical path (single-process: operator trace_range spans)
+# non-empty critical path (single-process: operator trace_range spans) AND
+# per-process memory counter lanes (ph C) alongside the span lanes
 python tools/profiler.py trace "$obs_dir" --out /tmp/obs_trace.json \
   > /tmp/obs_trace.txt
 grep -q "bounding edge:" /tmp/obs_trace.txt
+python - /tmp/obs_trace.json <<'PYEOF'
+import json, sys
+t = json.load(open(sys.argv[1]))
+cs = [e for e in t["traceEvents"] if e["ph"] == "C" and e["name"] == "memory"]
+assert cs, "no memory counter-track samples in the chrome trace"
+for e in cs:
+    assert set(e["args"]) == {"device_bytes", "host_bytes", "disk_bytes"}, e
+print("memory counter lanes ok:", len(cs), "samples")
+PYEOF
 rm -rf "$obs_dir"
 
 echo "== api coverage gate (0 missing vs reference GpuOverrides) =="

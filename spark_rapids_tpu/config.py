@@ -188,7 +188,7 @@ RETRY_SPLIT_FLOOR_BYTES = conf(
 TEST_FAULTS = conf("spark.rapids.tpu.test.faults").doc(
     "Deterministic fault-injection spec 'kind:site:trigger,...' — kinds "
     "oom / splitoom / transport / error / exec_kill / hang / cancel / "
-    "slow / corrupt; trigger COUNT, COUNT@SKIP or "
+    "slow / corrupt / leak; trigger COUNT, COUNT@SKIP or "
     "pPROB; e.g. 'oom:joins.build:2,transport:fetch:1,"
     "cancel:pipeline.put.scan.decode:1' (grammar + site list in "
     "runtime/faults.py; pipeline.put/get sites fire whatever kind is "
@@ -665,6 +665,35 @@ PROFILE_DIR = conf("spark.rapids.tpu.profile.dir").doc(
 OOM_DUMP_DIR = conf("spark.rapids.tpu.memory.hbm.oomDumpDir").doc(
     "Directory to write allocator state on device OOM "
     "(reference spark.rapids.memory.gpu.oomDumpDir)").string_conf(None)
+
+MEMORY_WATERMARK_INTERVAL = conf(
+    "spark.rapids.tpu.memory.profile.watermarkIntervalBytes").doc(
+    "Granularity of the HBM watermark timeline: a memory.watermark event "
+    "(+ Chrome counter-track sample when trace.dir is set) is emitted when "
+    "any spill tier's occupancy or the device high-water mark moves by this "
+    "many bytes since the last sample, bounding sample volume to "
+    "O(peak/interval) rather than one per allocation. The allocation-site "
+    "accounting itself is always on (a few dict updates under the catalog "
+    "lock)").bytes_conf("16m")
+
+MEMORY_PROFILE_TOPK = conf("spark.rapids.tpu.memory.profile.topK").doc(
+    "Allocation sites listed per watermark sample, per-query memory "
+    "summary and STATS gauge family (sites beyond the top K by bytes are "
+    "dropped from the EVENT payloads only — session.heap_snapshot() and "
+    "the leak detector always see every site)").integer_conf(10)
+
+MEMORY_LEAK_CHECK = conf("spark.rapids.tpu.memory.leak.check").doc(
+    "End-of-query leak detection: after an action drains, any non-retained "
+    "catalog buffer still tagged to the finished query raises a "
+    "memory.leak event + memoryLeakedBuffers resilience counter with the "
+    "per-site breakdown, and the buffers are reclaimed. false disables "
+    "(the buffers then linger until process exit)").boolean_conf(True)
+
+MEMORY_LEAK_STRICT = conf("spark.rapids.tpu.memory.leak.strict").doc(
+    "Escalate a detected end-of-query leak into a MemoryLeakError after "
+    "the event/counter/reclaim, so test suites fail loudly on any leak "
+    "instead of logging it (chaos specs use the 'leak' fault kind to prove "
+    "the detector end to end)").boolean_conf(False)
 
 SPARK_VERSION = conf("spark.rapids.tpu.spark.version").doc(
     "Spark behavior generation to emulate; selects the semantic shim "

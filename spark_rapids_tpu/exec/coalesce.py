@@ -72,8 +72,10 @@ def coalesce_iterator(it, goal: CoalesceGoal, metrics=None, use_catalog: bool = 
                 # strict-budget registration under the OOM retry ladder: an
                 # over-budget batch spills others, then splits in half — the
                 # halves concat back to the same rows at flush
-                for sb in R.register_with_retry(
-                        batch, mem.ACTIVE_BATCHING_PRIORITY, conf=conf):
+                with mem.alloc_site("coalesce.batch"):
+                    sbs = R.register_with_retry(
+                        batch, mem.ACTIVE_BATCHING_PRIORITY, conf=conf)
+                for sb in sbs:
                     pending.append(sb)
                     pending_bytes += sb.size
             else:

@@ -73,8 +73,13 @@ class CacheNode(PlanNode):
                 with TaskContext():
                     batches = list(hybrid.execute_partition(split))
                 if batches:
-                    out.append(mem.SpillableColumnarBatch(
-                        concat_batches(batches)))
+                    # retained: cache partitions OUTLIVE the materializing
+                    # query on purpose (until unpersist), so the end-of-query
+                    # leak detector must not flag them; the query tag stays
+                    # for fair-share demotion accounting
+                    with mem.alloc_site("cache.device", retained=True):
+                        out.append(mem.SpillableColumnarBatch(
+                            concat_batches(batches)))
                 else:
                     out.append(None)
             self._device_batches = out

@@ -176,6 +176,16 @@ def render_stats(include_histograms: bool = True) -> str:
         for tier, d in sorted(health["tiers"].items()):
             lines.append(f'srt_spill_tier_bytes{{tier="{tier}"}} '
                          f'{d["bytes"]}')
+        # memory observability plane: process device high-water mark + live
+        # device bytes per allocation site (who holds the HBM right now)
+        fam("srt_hbm_watermark_bytes", "gauge")
+        lines.append("srt_hbm_watermark_bytes "
+                     f"{health.get('hbm_watermark_bytes', 0)}")
+        mem_sites = health.get("memory_sites") or {}
+        if mem_sites:
+            fam("srt_memory_site_bytes", "gauge")
+            for site, v in sorted(mem_sites.items()):
+                lines.append(f'srt_memory_site_bytes{{site="{site}"}} {v}')
     fuse = health.get("fuse", {})
     fam("srt_fuse_total", "counter")
     for k in ("traces", "dispatches"):

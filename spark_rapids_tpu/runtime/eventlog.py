@@ -71,6 +71,11 @@ KNOWN_EVENTS = frozenset({
     # client connections, disconnect-driven cancellation, graceful drain
     "endpoint.start", "endpoint.stop",
     "client.connected", "client.disconnected", "server.drain",
+    # memory observability plane (runtime/memory.py): watermark timeline
+    # samples (per-tier occupancy + device high-water mark + top sites by
+    # live bytes), full allocation-site heap snapshots at query end, and
+    # end-of-query leak detections with their per-site breakdown
+    "memory.watermark", "memory.snapshot", "memory.leak",
 })
 
 # events that only make sense inside a query's dynamic extent; the profiler
@@ -166,7 +171,10 @@ def configure(directory: str, health_interval_s: float = 0.0,
     enables size-based rotation keeping `keep` rotated files."""
     global _writer, _sampler
     os.makedirs(directory, exist_ok=True)
-    stamp = datetime.datetime.now().strftime("%Y%m%d-%H%M%S")
+    # microsecond stamp: two configure() calls in the same process and
+    # second (back-to-back sessions sharing a directory) must not silently
+    # append to one file
+    stamp = datetime.datetime.now().strftime("%Y%m%d-%H%M%S-%f")
     path = os.path.join(directory,
                         f"events-{os.getpid()}-{stamp}.jsonl")
     with _lock:
@@ -262,11 +270,19 @@ def health_payload() -> dict:
         for b in cat._buffers.values():
             tiers[b.tier][0] += 1
             tiers[b.tier][1] += b.size
+        # top allocation sites by live device bytes (heap profiler): who
+        # holds the HBM right now, bounded to the configured top-K
+        mem_sites = dict(sorted(
+            ((s, st.live_device) for s, st in cat._site_stats.items()
+             if st.live_device > 0),
+            key=lambda kv: -kv[1])[:cat._top_k])
         out = {
             "device_initialized": True,
             "hbm_budget_bytes": cat.device_budget,
             "hbm_used_bytes": cat.device_bytes,
             "hbm_free_bytes": max(cat.device_budget - cat.device_bytes, 0),
+            "hbm_watermark_bytes": cat.watermark_bytes,
+            "memory_sites": mem_sites,
             "host_spill_budget_bytes": cat.host_budget,
             "host_spill_used_bytes": cat.host_bytes,
             "spilled_to_host_bytes": cat.spilled_to_host_bytes,

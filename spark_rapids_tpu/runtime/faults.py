@@ -33,7 +33,12 @@ deadline/cancel races and scheduler queue timeouts become deterministic.
 ``corrupt`` never raises from the generic checkpoints; it arms
 :func:`maybe_corrupt` sites (transport block reassembly, spill file write)
 to flip one byte of the payload, proving the CRC detection → fetch-failure
-ladders end to end. COUNT injects on that many eligible hits; ``@SKIP`` first
+ladders end to end. ``leak`` likewise never raises: it arms
+:func:`should_leak` at buffer-release sites (SpillableColumnarBatch.close,
+checked against the buffer's allocation site, e.g. "leak:joins.build:1") to
+SKIP the catalog release, proving the end-of-query leak detector
+(runtime/memory.py) catches, reports and reclaims what the operator
+forgot. COUNT injects on that many eligible hits; ``@SKIP`` first
 lets SKIP eligible hits pass ("oom:agg.update:1@3" skips three, injects
 once); ``pPROB`` injects each hit with the given probability from a
 PER-SITE seeded RNG — each (kind, site) entry draws from its own stream
@@ -81,7 +86,7 @@ _injected: list = []
 _tls = threading.local()
 
 _KINDS = ("oom", "splitoom", "transport", "error", "exec_kill", "hang",
-          "cancel", "slow", "corrupt")
+          "cancel", "slow", "corrupt", "leak")
 _ENTRY_RE = re.compile(
     r"^(?P<kind>[a-z_]+):(?P<site>[A-Za-z0-9_.\-]+):"
     r"(?:(?P<count>\d+)(?:@(?P<skip>\d+))?|p(?P<prob>0?\.\d+|1(?:\.0*)?))$")
@@ -211,11 +216,24 @@ def maybe_inject(kind: str, site: str) -> None:
 def maybe_inject_any(site: str) -> None:
     """Raise whatever fault is armed for `site`, regardless of kind — the
     pipeline queue put/get hooks use this so one chaos spec can drive any
-    fault class through a stage boundary. ("corrupt" entries stay silent
-    here: they only act through maybe_corrupt's payload sites.)"""
+    fault class through a stage boundary. ("corrupt" and "leak" entries
+    stay silent here: corrupt only acts through maybe_corrupt's payload
+    sites, leak only through should_leak's release sites.)"""
     if not _active:
         return
-    _select_and_fire(site, lambda k: k != "corrupt")
+    _select_and_fire(site, lambda k: k not in ("corrupt", "leak"))
+
+
+def should_leak(site: str) -> bool:
+    """Release checkpoint: True when a "leak" entry is armed for `site` —
+    the caller then SKIPS the buffer release it was about to perform
+    (SpillableColumnarBatch.close keeps the catalog entry alive), modeling
+    a refcount bug that the end-of-query leak detector
+    (runtime/memory.BufferCatalog.finish_query) must catch, report and
+    reclaim. Never raises; a no-op flag check when injection is off."""
+    if not _active:
+        return False
+    return _select(site, lambda k: k == "leak") is not None
 
 
 def maybe_corrupt(site: str, data: bytes) -> bytes:

@@ -16,6 +16,7 @@ staging is plain host RAM (TPU DMA runs from pageable host memory via PJRT).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import heapq
 import itertools
@@ -35,6 +36,7 @@ from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.columnar.vector import TpuColumnVector
 from spark_rapids_tpu.runtime import eventlog as EL
 from spark_rapids_tpu.runtime import faults as F
+from spark_rapids_tpu.runtime import tracing as TR
 from spark_rapids_tpu.runtime.arm import LeakTracker
 from spark_rapids_tpu.runtime.retry import DeviceOomError
 
@@ -51,6 +53,55 @@ class TierEnum:
     DEVICE = "DEVICE"
     HOST = "HOST"
     DISK = "DISK"
+
+
+# -- allocation-site attribution ----------------------------------------------
+# Every catalogued buffer is tagged with the subsystem that registered it
+# ("joins.build", "exchange.map", "pipeline.queue", ...) plus the ambient
+# plan-node id, so the heap profiler can say WHO holds device memory, not
+# just how much is held. The label resolves through a dedicated thread-local
+# first (explicit alloc_site() blocks at registration call sites), then the
+# fault-injection scope (runtime/retry.py already wraps every retry attempt
+# in F.scope(site), which names exactly the subsystems we want), and only
+# then the unattributed bucket.
+
+UNATTRIBUTED_SITE = "catalog.add_batch"
+
+_alloc_tls = threading.local()
+
+
+@contextlib.contextmanager
+def alloc_site(site: str, retained: bool = False):
+    """Tag catalog registrations inside the block with allocation site
+    `site`. ``retained=True`` marks the buffers as intentionally outliving
+    their query (DataFrame cache partitions), exempting them from the
+    end-of-query leak detector while keeping their query tag for the
+    fair-share demotion accounting."""
+    prev = getattr(_alloc_tls, "site", None)
+    _alloc_tls.site = (site, retained)
+    try:
+        yield
+    finally:
+        _alloc_tls.site = prev
+
+
+def current_alloc_site() -> "tuple[str, bool]":
+    """(site, retained) for a registration happening now on this thread."""
+    v = getattr(_alloc_tls, "site", None)
+    if v is not None:
+        return v
+    s = F.current_scope()
+    if s:
+        return s, False
+    return UNATTRIBUTED_SITE, False
+
+
+class MemoryLeakError(RuntimeError):
+    """The end-of-query leak detector found buffers still tagged to a
+    finished query and ``memory.leak.strict`` is on. Non-strict mode only
+    emits the ``memory.leak`` event + resilience counter and reclaims the
+    buffers; strict mode additionally fails the query so tests can turn
+    any leak into a hard failure."""
 
 
 class BufferClosedError(RuntimeError):
@@ -117,10 +168,13 @@ class RapidsBuffer:
     (reference RapidsBufferStore.RapidsBufferBase)."""
 
     __slots__ = ("buffer_id", "tier", "priority", "size", "_device", "_host",
-                 "_path", "_handle", "spill_callback", "query", "_crc")
+                 "_path", "_handle", "spill_callback", "query", "_crc",
+                 "site", "node", "retained", "_disk_len")
 
     def __init__(self, buffer_id: int, batch: ColumnarBatch, priority: float,
-                 spill_callback=None, query: str | None = None):
+                 spill_callback=None, query: str | None = None,
+                 site: str = UNATTRIBUTED_SITE, node: int | None = None,
+                 retained: bool = False):
         self.buffer_id = buffer_id
         self.tier = TierEnum.DEVICE
         self.priority = priority
@@ -134,6 +188,29 @@ class RapidsBuffer:
         # scheduler's per-query accounting + fair-share demotion key
         self.query = query
         self._crc = None             # disk-tier payload checksum
+        # allocation-site attribution (heap profiler): subsystem label +
+        # ambient plan-node id; retained buffers outlive their query on
+        # purpose (cache partitions) and are exempt from leak detection
+        self.site = site
+        self.node = node
+        self.retained = retained
+        self._disk_len = 0           # bytes held in the disk tier
+
+
+class _SiteStats:
+    """Process-lifetime accounting for one allocation site: live device
+    bytes (maintained across spill/unspill transitions), the site's own
+    device high-water mark, and cumulative alloc/free traffic."""
+
+    __slots__ = ("live_device", "peak_device", "cumulative", "allocs",
+                 "frees")
+
+    def __init__(self):
+        self.live_device = 0
+        self.peak_device = 0
+        self.cumulative = 0
+        self.allocs = 0
+        self.frees = 0
 
 
 class BufferCatalog:
@@ -147,7 +224,9 @@ class BufferCatalog:
     def __init__(self, device_budget: int, host_budget: int, spill_dir: str | None = None,
                  unspill: bool = False, oom_dump_dir: str | None = None,
                  direct_spill: bool = False, direct_batch_bytes: int = 64 << 20,
-                 strict_budget: bool = True, spill_checksum: bool = True):
+                 strict_budget: bool = True, spill_checksum: bool = True,
+                 watermark_interval_bytes: int = 16 << 20,
+                 profile_top_k: int = 10):
         self.device_budget = device_budget
         self.host_budget = host_budget
         # CRC disk-tier spill payloads and verify on unspill
@@ -171,6 +250,19 @@ class BufferCatalog:
         # metrics (reference GpuMetric spill counters)
         self.spilled_to_host_bytes = 0
         self.spilled_to_disk_bytes = 0
+        # allocation-site heap profiler: per-site process-lifetime stats,
+        # per-query peak/cumulative breakdowns (popped by finish_query so
+        # long-lived serving processes stay bounded), the process device
+        # high-water mark, and the last watermark sample emitted into the
+        # event log / Chrome counter track
+        self.disk_bytes = 0
+        self.watermark_bytes = 0
+        self._watermark_interval = max(1, int(watermark_interval_bytes))
+        self._top_k = max(1, int(profile_top_k))
+        self._site_stats: dict[str, _SiteStats] = {}
+        self._query_mem: dict[str, dict] = {}
+        self._last_sample: "tuple | None" = None
+        self._last_sample_watermark = 0
 
     # -- registration --------------------------------------------------------
     def add_batch(self, batch: ColumnarBatch, priority: float = ACTIVE_ON_DECK_PRIORITY,
@@ -180,10 +272,12 @@ class BufferCatalog:
         # registration site
         F.maybe_inject("oom", F.current_scope() or "catalog.add_batch")
         from spark_rapids_tpu.runtime import metrics as M
+        site, retained = current_alloc_site()
         with self._lock:
             bid = next(self._ids)
             buf = RapidsBuffer(bid, batch, priority, spill_callback,
-                               query=M.current_query_id())
+                               query=M.current_query_id(), site=site,
+                               node=M.current_node(), retained=retained)
             self._buffers[bid] = buf
             self.device_bytes += buf.size
             try:
@@ -195,7 +289,106 @@ class BufferCatalog:
                 del self._buffers[bid]
                 self.device_bytes -= buf.size
                 raise
+            self._account_alloc(buf)
             return bid
+
+    # -- allocation-site heap accounting (under self._lock) ------------------
+    def _account_alloc(self, buf: RapidsBuffer):
+        st = self._site_stats.get(buf.site)
+        if st is None:
+            st = self._site_stats[buf.site] = _SiteStats()
+        st.live_device += buf.size
+        if st.live_device > st.peak_device:
+            st.peak_device = st.live_device
+        st.cumulative += buf.size
+        st.allocs += 1
+        if buf.query is not None:
+            qm = self._query_mem.get(buf.query)
+            if qm is None:
+                # bound the per-query map: queries finished through
+                # session._run_action pop their entry; out-of-band
+                # registrations (tests driving collectors by hand) must not
+                # grow it forever in a long-lived process
+                if len(self._query_mem) > 512:
+                    self._query_mem.pop(next(iter(self._query_mem)))
+                qm = self._query_mem[buf.query] = {
+                    "live": 0, "peak": 0, "cum": 0, "allocs": 0, "sites": {}}
+            qm["live"] += buf.size
+            qm["peak"] = max(qm["peak"], qm["live"])
+            qm["cum"] += buf.size
+            qm["allocs"] += 1
+            # per-(query, site): [live_device, peak_device, cumulative,
+            # plan-node ids seen]
+            s = qm["sites"].get(buf.site)
+            if s is None:
+                s = qm["sites"][buf.site] = [0, 0, 0, set()]
+            s[0] += buf.size
+            s[1] = max(s[1], s[0])
+            s[2] += buf.size
+            if buf.node is not None:
+                s[3].add(buf.node)
+        self._maybe_sample()
+
+    def _account_device_delta(self, buf: RapidsBuffer, delta: int):
+        """A buffer moved into (+) or out of (-) the device tier without
+        being allocated or freed (spill, unspill)."""
+        st = self._site_stats.get(buf.site)
+        if st is not None:
+            st.live_device += delta
+            if delta > 0 and st.live_device > st.peak_device:
+                st.peak_device = st.live_device
+        if buf.query is not None:
+            qm = self._query_mem.get(buf.query)
+            if qm is not None:
+                qm["live"] += delta
+                if delta > 0:
+                    qm["peak"] = max(qm["peak"], qm["live"])
+                s = qm["sites"].get(buf.site)
+                if s is not None:
+                    s[0] += delta
+                    if delta > 0:
+                        s[1] = max(s[1], s[0])
+
+    def _account_free(self, buf: RapidsBuffer):
+        st = self._site_stats.get(buf.site)
+        if st is not None:
+            st.frees += 1
+        if buf.tier == TierEnum.DEVICE:
+            self._account_device_delta(buf, -buf.size)
+        self._maybe_sample()
+
+    def _maybe_sample(self):
+        """Watermark-timeline sample (under self._lock): update the process
+        device high-water mark, and when telemetry is on emit a
+        ``memory.watermark`` event + a Chrome counter-track sample — on the
+        first allocation, whenever the watermark grows by the configured
+        interval, and whenever any tier's occupancy moved by the interval
+        since the last sample. Bounded: monotone growth emits
+        O(peak / interval) samples, not one per allocation."""
+        if self.device_bytes > self.watermark_bytes:
+            self.watermark_bytes = self.device_bytes
+        if not (EL.enabled() or TR.spans_enabled()):
+            return
+        cur = (self.device_bytes, self.host_bytes, self.disk_bytes)
+        if (self._last_sample is not None
+                and self.watermark_bytes - self._last_sample_watermark
+                < self._watermark_interval
+                and all(abs(a - b) < self._watermark_interval
+                        for a, b in zip(cur, self._last_sample))):
+            return
+        self._last_sample = cur
+        self._last_sample_watermark = self.watermark_bytes
+        top = sorted(((s, st.live_device)
+                      for s, st in self._site_stats.items()
+                      if st.live_device > 0),
+                     key=lambda kv: -kv[1])[:self._top_k]
+        if EL.enabled():
+            EL.emit("memory.watermark", device_bytes=cur[0],
+                    host_bytes=cur[1], disk_bytes=cur[2],
+                    watermark_bytes=self.watermark_bytes,
+                    budget=self.device_budget, sites=dict(top))
+        TR.counter("memory", {"device_bytes": cur[0], "host_bytes": cur[1],
+                              "disk_bytes": cur[2]})
 
     def _ensure_device_budget(self, exclude: int | None = None,
                               strict: bool = False):
@@ -276,11 +469,31 @@ class BufferCatalog:
                             spillable += b.size
                     f.write(f"tier={tier} spillable_bytes={spillable} "
                             f"pinned_bytes={pinned}\n")
-                f.write("buffer_id\ttier\tsize\tpriority\n")
+                # per-site live breakdown (heap profiler): the OOM names the
+                # culprit SUBSYSTEM, not just tier totals. Derived from the
+                # live registry (the over-budget buffer is registered but
+                # not yet site-accounted at this point), joined with the
+                # process-lifetime site stats where they exist
+                live_by_site: dict = {}
+                for b in self._buffers.values():
+                    if b.tier == TierEnum.DEVICE:
+                        live_by_site[b.site] = \
+                            live_by_site.get(b.site, 0) + b.size
+                f.write("top sites by live device bytes:\n")
+                for site, live in sorted(live_by_site.items(),
+                                         key=lambda kv: -kv[1])[:10]:
+                    st = self._site_stats.get(site) or _SiteStats()
+                    f.write(f"site={site} live_device={live} "
+                            f"peak_device={max(st.peak_device, live)} "
+                            f"cumulative={st.cumulative} "
+                            f"allocs={st.allocs} frees={st.frees}\n")
+                f.write("buffer_id\ttier\tsize\tpriority\tsite\tnode\t"
+                        "query\n")
                 for b in sorted(self._buffers.values(),
                                 key=lambda x: -x.size):
                     f.write(f"{b.buffer_id}\t{b.tier}\t{b.size}\t"
-                            f"{b.priority}\n")
+                            f"{b.priority}\t{b.site}\t{b.node}\t"
+                            f"{b.query}\n")
         except OSError:
             pass  # dumping must never turn an OOM into a crash
 
@@ -293,12 +506,19 @@ class BufferCatalog:
         self.device_bytes -= buf.size
         self.host_bytes += hb.nbytes()
         self.spilled_to_host_bytes += buf.size
+        self._account_device_delta(buf, -buf.size)
         if EL.enabled():
             EL.emit("spill", tier_from=TierEnum.DEVICE, tier_to=TierEnum.HOST,
                     bytes=buf.size, buffer=buf.buffer_id,
                     priority=buf.priority)
+        # spill-tier transition as an instant on the trace timeline, next to
+        # the memory counter lanes (span-file only; the event log line above
+        # is the analysis copy)
+        TR.instant("memory.spill", tier_from=TierEnum.DEVICE,
+                   tier_to=TierEnum.HOST, bytes=buf.size, site=buf.site)
         if buf.spill_callback:
             buf.spill_callback(buf.size)
+        self._maybe_sample()
         self._ensure_host_budget()
 
     def _ensure_host_budget(self):
@@ -349,12 +569,17 @@ class BufferCatalog:
             buf._handle = None
         self.host_bytes -= hb.nbytes()
         self.spilled_to_disk_bytes += hb.nbytes()
+        buf._disk_len = hb.nbytes()
+        self.disk_bytes += buf._disk_len
         if EL.enabled():
             EL.emit("spill", tier_from=TierEnum.HOST, tier_to=TierEnum.DISK,
                     bytes=hb.nbytes(), buffer=buf.buffer_id,
                     priority=buf.priority)
+        TR.instant("memory.spill", tier_from=TierEnum.HOST,
+                   tier_to=TierEnum.DISK, bytes=hb.nbytes(), site=buf.site)
         buf._host = None
         buf.tier = TierEnum.DISK
+        self._maybe_sample()
 
     # -- access --------------------------------------------------------------
     def acquire_batch(self, buffer_id: int) -> ColumnarBatch:
@@ -395,11 +620,16 @@ class BufferCatalog:
                 else:
                     os.unlink(buf._path)
                     buf._path = None
+                if buf.tier == TierEnum.DISK:
+                    self.disk_bytes -= buf._disk_len
+                    buf._disk_len = 0
                 buf._host = None
                 buf._device = batch
                 buf.tier = TierEnum.DEVICE
                 self.device_bytes += buf.size
+                self._account_device_delta(buf, buf.size)
                 self._ensure_device_budget(exclude=buffer_id)
+                self._maybe_sample()
             return batch
 
     def get_tier(self, buffer_id: int) -> str:
@@ -418,13 +648,16 @@ class BufferCatalog:
                 self.device_bytes -= buf.size
             elif buf.tier == TierEnum.HOST:
                 self.host_bytes -= buf._host.nbytes()
-            elif buf._handle is not None:
-                self._get_direct_store().delete(buf._handle)
-            elif buf._path:
-                try:
-                    os.unlink(buf._path)
-                except OSError:
-                    pass
+            else:
+                self.disk_bytes -= buf._disk_len
+                if buf._handle is not None:
+                    self._get_direct_store().delete(buf._handle)
+                elif buf._path:
+                    try:
+                        os.unlink(buf._path)
+                    except OSError:
+                        pass
+            self._account_free(buf)
 
     def synchronous_spill(self, target_device_bytes: int) -> int:
         """Spill until the device tier holds <= target bytes; returns bytes spilled
@@ -470,9 +703,142 @@ class BufferCatalog:
                 self._spill_device_buffer(b)
             return spilled
 
+    # -- allocation-site heap profiler read-out ------------------------------
+    def buffer_site(self, buffer_id: int) -> str:
+        with self._lock:
+            buf = self._buffers.get(buffer_id)
+            return buf.site if buf is not None else UNATTRIBUTED_SITE
+
+    def heap_snapshot(self) -> dict:
+        """Live heap structure by allocation site: per-site tier occupancy
+        of the buffers alive right now (computed by scanning the registry —
+        bounded by live buffer count), joined with the site's process-
+        lifetime peak/cumulative/alloc/free stats. The programmatic face of
+        ``tools/profiler.py memory`` (session.heap_snapshot())."""
+        with self._lock:
+            live: dict = {}
+            for b in self._buffers.values():
+                e = live.setdefault(b.site, {
+                    "buffers": 0, "tiers": {}, "nodes": set(),
+                    "queries": set(), "retained_bytes": 0})
+                if b.tier == TierEnum.DEVICE:
+                    sz = b.size
+                elif b.tier == TierEnum.HOST:
+                    sz = b._host.nbytes()
+                else:
+                    sz = b._disk_len
+                e["buffers"] += 1
+                e["tiers"][b.tier] = e["tiers"].get(b.tier, 0) + sz
+                if b.node is not None:
+                    e["nodes"].add(b.node)
+                if b.query is not None:
+                    e["queries"].add(b.query)
+                if b.retained:
+                    e["retained_bytes"] += sz
+            sites = []
+            for site, st in self._site_stats.items():
+                e = live.get(site) or {"buffers": 0, "tiers": {},
+                                       "nodes": set(), "queries": set(),
+                                       "retained_bytes": 0}
+                sites.append({
+                    "site": site,
+                    "buffers": e["buffers"],
+                    "tiers": dict(e["tiers"]),
+                    "live_bytes": sum(e["tiers"].values()),
+                    "device_bytes": e["tiers"].get(TierEnum.DEVICE, 0),
+                    "retained_bytes": e["retained_bytes"],
+                    "nodes": sorted(e["nodes"]),
+                    "queries": sorted(e["queries"]),
+                    "peak_device_bytes": st.peak_device,
+                    "cumulative_bytes": st.cumulative,
+                    "allocs": st.allocs,
+                    "frees": st.frees,
+                })
+            sites.sort(key=lambda s: (-s["device_bytes"], -s["live_bytes"],
+                                      -s["cumulative_bytes"]))
+            return {
+                "device_bytes": self.device_bytes,
+                "host_bytes": self.host_bytes,
+                "disk_bytes": self.disk_bytes,
+                "watermark_bytes": self.watermark_bytes,
+                "device_budget": self.device_budget,
+                "buffers": len(self._buffers),
+                "sites": sites,
+            }
+
+    def query_memory(self, query_id: str) -> dict:
+        """Per-query memory summary (peak/cumulative device bytes + the
+        top-K sites by peak) without finishing the query's accounting."""
+        with self._lock:
+            qm = self._query_mem.get(query_id)
+            return self._query_summary(qm)
+
+    def _query_summary(self, qm) -> dict:
+        ranked = sorted((qm or {}).get("sites", {}).items(),
+                        key=lambda kv: -kv[1][1])[:self._top_k]
+        return {
+            "peak_device_bytes": qm["peak"] if qm else 0,
+            "cumulative_bytes": qm["cum"] if qm else 0,
+            "allocs": qm["allocs"] if qm else 0,
+            "sites": {site: {"peak_bytes": v[1], "cumulative_bytes": v[2],
+                             "nodes": sorted(v[3])}
+                      for site, v in ranked},
+        }
+
+    def finish_query(self, query_id: str, leak_check: bool = True):
+        """End-of-query epilogue: pop the query's memory accounting and
+        return (summary, leak). When ``leak_check``, any non-retained
+        buffer still tagged to the finished query is a LEAK — a
+        ``memory.leak`` event + resilience counter fire with the per-site
+        breakdown, and the buffers are reclaimed so one leaky operator
+        cannot bleed the HBM budget across queries. ``leak`` is None on a
+        clean query, else {bytes, buffers, sites}."""
+        with self._lock:
+            qm = self._query_mem.pop(query_id, None)
+            summary = self._query_summary(qm)
+            leaked = ([b for b in self._buffers.values()
+                       if b.query == query_id and not b.retained]
+                      if leak_check else [])
+        if not leaked:
+            return summary, None
+        by_site: dict = {}
+        total = 0
+        for b in leaked:
+            by_site[b.site] = by_site.get(b.site, 0) + b.size
+            total += b.size
+        leak = {"bytes": total, "buffers": len(leaked), "sites": by_site}
+        from spark_rapids_tpu.runtime import metrics as M
+        M.resilience_add(M.MEMORY_LEAKS, len(leaked))
+        TR.span_event("memory.leak", bytes=total, buffers=len(leaked),
+                      sites=by_site)
+        # reclaim: the detector's report is the alarm; holding the bytes
+        # hostage afterwards would punish every later tenant for it
+        for b in leaked:
+            self.remove(b.buffer_id)
+        return summary, leak
+
     @property
     def num_buffers(self):
         return len(self._buffers)
+
+
+# memory-profile knobs applied by a session that sets them EXPLICITLY
+# (the process-global-switch pattern of tracing/faults/eventlog): the
+# DeviceManager catalog is constructed lazily with default conf, so the
+# session pushes the values onto the live catalog and remembers them for a
+# catalog created later
+_profile_override: "tuple[int, int] | None" = None
+
+
+def set_profile_options(watermark_interval_bytes: int, top_k: int) -> None:
+    global _profile_override
+    _profile_override = (int(watermark_interval_bytes), int(top_k))
+    dm = DeviceManager._instance
+    if dm is not None:
+        cat = dm.catalog
+        with cat._lock:
+            cat._watermark_interval = max(1, int(watermark_interval_bytes))
+            cat._top_k = max(1, int(top_k))
 
 
 def host_prefetch_budget(max_buffer_bytes: int) -> int:
@@ -499,6 +865,7 @@ class SpillableColumnarBatch:
                  catalog: "BufferCatalog | None" = None, spill_callback=None):
         self.catalog = catalog or DeviceManager.get().catalog
         self.buffer_id = self.catalog.add_batch(batch, priority, spill_callback)
+        self._site = self.catalog.buffer_site(self.buffer_id)
         self.num_rows = batch.num_rows
         self.schema = batch.schema
         self.size = batch.device_memory_size()
@@ -516,8 +883,14 @@ class SpillableColumnarBatch:
     def close(self):
         if not self._closed:
             self._closed = True
-            self.catalog.remove(self.buffer_id)
             LeakTracker.release(self._leak)
+            # chaos hook ("leak:<site>:N", runtime/faults.py): model a
+            # refcount bug — the handle closes normally but the catalog
+            # entry is never freed, which the end-of-query leak detector
+            # (BufferCatalog.finish_query) MUST catch and reclaim
+            if F.should_leak(self._site):
+                return
+            self.catalog.remove(self.buffer_id)
 
     def __enter__(self):
         return self
@@ -564,7 +937,12 @@ class DeviceManager:
             direct_batch_bytes=conf.get(C.DIRECT_SPILL_BATCH_BYTES),
             strict_budget=conf.get(C.STRICT_DEVICE_BUDGET),
             spill_checksum=conf.get(C.SPILL_CHECKSUM),
+            watermark_interval_bytes=conf.get(C.MEMORY_WATERMARK_INTERVAL),
+            profile_top_k=conf.get(C.MEMORY_PROFILE_TOPK),
         )
+        if _profile_override is not None:
+            self.catalog._watermark_interval = max(1, _profile_override[0])
+            self.catalog._top_k = max(1, _profile_override[1])
 
     @classmethod
     def initialize(cls, conf: C.RapidsConf | None = None) -> "DeviceManager":

@@ -88,6 +88,11 @@ QUERY_DEMOTIONS = "queryDemotions"
 # query was in flight (half-close, RST, or idle-timeout expiry) — the query
 # was cancelled by the disconnect path
 CLIENT_DISCONNECTS = "clientDisconnects"
+# memory observability plane (runtime/memory.py): catalog buffers a finished
+# query left behind, caught + reclaimed by the end-of-query leak detector.
+# Riding the resilience registry makes leak-freedom a standing CI invariant:
+# the no-faults bench gates already assert every counter here is zero
+MEMORY_LEAKS = "memoryLeakedBuffers"
 
 RESILIENCE_METRICS = (NUM_OOM_RETRIES, NUM_OOM_SPLIT_RETRIES, OOM_SPILL_BYTES,
                       FETCH_RETRIES, FETCH_FAILOVERS, FETCH_RECOMPUTES,
@@ -95,7 +100,7 @@ RESILIENCE_METRICS = (NUM_OOM_RETRIES, NUM_OOM_SPLIT_RETRIES, OOM_SPILL_BYTES,
                       STAGE_PARTIAL_RECOMPUTES, MAP_TASKS_RECOMPUTED,
                       SPECULATION_WON, SPECULATION_LOST,
                       QUERIES_SHED, QUERIES_CANCELLED, QUERY_DEMOTIONS,
-                      CLIENT_DISCONNECTS)
+                      CLIENT_DISCONNECTS, MEMORY_LEAKS)
 
 
 class GpuMetric:
@@ -498,6 +503,10 @@ class QueryMetricsCollector:
         self.cancel_token = None
         self.wall_s: float | None = None
         self._resilience: dict | None = None
+        # per-query memory summary (peak device bytes + top allocation
+        # sites), set by the action's memory epilogue
+        # (session._finish_query_memory); None for host-only queries
+        self.memory: dict | None = None
 
     # -- population (plan conversion + execution) -----------------------------
     def register(self, exec_node) -> int:

@@ -333,8 +333,13 @@ def stage_iterator(gen, *, edge: str, conf=None, registry=None, node_id=None,
                             break
                     if spillable and _spillable_ok(item):
                         ok = True
-                        for sb in R.register_with_retry(
-                                item, mem.ACTIVE_ON_DECK_PRIORITY, conf=conf):
+                        # heap-profiler attribution: queued device batches
+                        # are held by the queue edge, not the producing
+                        # operator (which already closed its frame)
+                        with mem.alloc_site("pipeline.queue"):
+                            sbs = R.register_with_retry(
+                                item, mem.ACTIVE_ON_DECK_PRIORITY, conf=conf)
+                        for sb in sbs:
                             if ok:
                                 ok = q.put(sb, sb.size)
                             if not ok:

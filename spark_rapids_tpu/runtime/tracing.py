@@ -17,8 +17,11 @@ timeline (Perfetto) with per-process clock-offset correction
 handshake exchange) and walk the critical path.
 
 Span record schema (validate_span):
-  name  str    range name (trace_range/span) or event name (span_event)
-  ph    "X"|"i"  complete span | zero-duration instant
+  name  str    range name (trace_range/span) or event name (span_event) or
+               counter track name (counter)
+  ph    "X"|"i"|"C"  complete span | zero-duration instant | counter sample
+                     (args = {series: number}, the Chrome counter-track form
+                     the memory plane uses for per-tier occupancy lanes)
   ts    float  wall-clock epoch seconds at span start (LOCAL clock)
   dur   float  seconds (ph == "X" only)
   pid   int    writing process
@@ -159,7 +162,8 @@ def configure_spans(directory: str, process: "str | None" = None) -> str:
     Perfetto process lane ("driver", "executor-3", ...)."""
     global _span_writer
     os.makedirs(directory, exist_ok=True)
-    stamp = datetime.datetime.now().strftime("%Y%m%d-%H%M%S")
+    # microsecond stamp: same collision guard as the event log's configure
+    stamp = datetime.datetime.now().strftime("%Y%m%d-%H%M%S-%f")
     path = os.path.join(directory, f"spans-{os.getpid()}-{stamp}.jsonl")
     if _span_writer is not None:
         _span_writer.close()
@@ -219,6 +223,25 @@ def span(name: str, **attrs):
                        attrs)
 
 
+def instant(name: str, **attrs) -> None:
+    """Span-file-only zero-duration instant (no event-log or ring
+    forwarding — for records whose analysis copy is already emitted
+    elsewhere, e.g. spill-tier transitions next to the memory counter
+    lanes). Free when no span sink is configured."""
+    if _span_writer is not None:
+        _emit_span(name, "i", time.time(), None, attrs or None)
+
+
+def counter(name: str, values: dict) -> None:
+    """Chrome counter-track sample (ph "C"): `values` maps series name to a
+    number; Perfetto renders one stacked counter lane per (process, name).
+    The memory plane emits its per-tier occupancy here so HBM/host/disk
+    levels plot alongside the span lanes. Free when no sink is
+    configured."""
+    if _span_writer is not None:
+        _emit_span(name, "C", time.time(), None, dict(values))
+
+
 def validate_span(rec: dict) -> list:
     """Schema check for one parsed span record; returns violation strings
     (empty = valid). Shared by tools/profiler.py trace and the tests."""
@@ -227,12 +250,14 @@ def validate_span(rec: dict) -> list:
         errs.append("missing 'name'")
         return errs
     name = rec["name"]
-    if rec.get("ph") not in ("X", "i"):
-        errs.append(f"{name}: ph must be 'X' or 'i'")
+    if rec.get("ph") not in ("X", "i", "C"):
+        errs.append(f"{name}: ph must be 'X', 'i' or 'C'")
     if not isinstance(rec.get("ts"), (int, float)):
         errs.append(f"{name}: missing numeric 'ts'")
     if rec.get("ph") == "X" and not isinstance(rec.get("dur"), (int, float)):
         errs.append(f"{name}: X span without numeric 'dur'")
+    if rec.get("ph") == "C" and not isinstance(rec.get("args"), dict):
+        errs.append(f"{name}: C counter sample without an args series dict")
     if not isinstance(rec.get("pid"), int):
         errs.append(f"{name}: missing int 'pid'")
     if not isinstance(rec.get("tid"), str):

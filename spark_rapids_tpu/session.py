@@ -50,6 +50,41 @@ def _abort_execs(collector) -> None:
                 pass
 
 
+def _finish_query_memory(collector, conf, leak_check: bool = True):
+    """Memory-plane epilogue of one action (runtime/memory.py): pop the
+    query's allocation-site accounting into ``collector.memory`` (peak +
+    per-site breakdown — bench.py and the query.end event embed it), run
+    the end-of-query leak detector (event + resilience counter + reclaim)
+    and emit a full heap snapshot into the event log. Idempotent per
+    collector (success and error paths both call it; first wins) and a
+    no-op when the device was never initialized (host-only plans).
+
+    ``leak_check=False`` on the cancel/error paths: those drains are
+    COOPERATIVE — worker threads may legitimately still be closing their
+    buffers when the exception propagates, so a scan here would race them
+    (PR-6's polling leak checks own those paths). Only a cleanly drained
+    action can assert "still tagged == leaked". Returns the leak info
+    dict, or None when clean/skipped."""
+    from spark_rapids_tpu import config as CFG
+    from spark_rapids_tpu.runtime import eventlog as EL
+    from spark_rapids_tpu.runtime.memory import DeviceManager
+    if getattr(collector, "_memory_done", False):
+        return None
+    collector._memory_done = True
+    dm = DeviceManager._instance
+    if dm is None:
+        return None
+    summary, leak = dm.catalog.finish_query(
+        collector.query_id,
+        leak_check=leak_check and conf.get(CFG.MEMORY_LEAK_CHECK))
+    collector.memory = summary
+    if EL.enabled():
+        snap = dm.catalog.heap_snapshot()
+        snap["sites"] = snap["sites"][:conf.get(CFG.MEMORY_PROFILE_TOPK)]
+        EL.emit("memory.snapshot", query=collector.query_id, **snap)
+    return leak
+
+
 def _to_expr(c) -> E.Expression:
     if isinstance(c, E.Expression):
         return c
@@ -310,6 +345,18 @@ class DataFrame:
                 EL.emit("query.start", query=collector.query_id,
                         description=collector.description)
                 out = run(hybrid)
+                # end-of-query leak detection (memory observability plane):
+                # the action has drained, so any device bytes still tagged
+                # to this query are a leak — event + counter + reclaim,
+                # escalated to a hard failure under memory.leak.strict
+                leak = _finish_query_memory(collector, conf)
+                if leak is not None and conf.get(CFG.MEMORY_LEAK_STRICT):
+                    from spark_rapids_tpu.runtime.memory import \
+                        MemoryLeakError
+                    raise MemoryLeakError(
+                        f"query {collector.query_id} leaked "
+                        f"{leak['bytes']}B in {leak['buffers']} buffer(s): "
+                        f"{leak['sites']}")
             except SCHED.QueryCancelledError as e:
                 M.resilience_add(M.QUERIES_CANCELLED)
                 if isinstance(e, SCHED.QueryDeadlineError):
@@ -317,6 +364,7 @@ class DataFrame:
                 collector.finish()
                 observe_latency()
                 _abort_execs(collector)
+                _finish_query_memory(collector, conf, leak_check=False)
                 EL.emit("query.deadline" if isinstance(
                             e, SCHED.QueryDeadlineError)
                         else "query.cancelled",
@@ -325,10 +373,12 @@ class DataFrame:
                 raise
             except SCHED.QueryRejectedError:
                 collector.finish()   # query.shed already emitted by submit()
+                _finish_query_memory(collector, conf, leak_check=False)
                 raise
             except BaseException as e:
                 collector.finish()
                 _abort_execs(collector)
+                _finish_query_memory(collector, conf, leak_check=False)
                 EL.emit("query.error", query=collector.query_id,
                         error=repr(e)[:200], wall_s=collector.wall_s)
                 raise
@@ -344,6 +394,7 @@ class DataFrame:
                 compiles=compile_m["compiles"],
                 dispatches=compile_m["dispatches"],
                 resilience=collector.query_resilience(),
+                memory=collector.memory,
                 nodes=collector.node_summaries())
         return out
 
@@ -676,6 +727,16 @@ class TpuSession:
                     keep=self.conf.get(CFG.EVENT_LOG_KEEP_FILES))
             else:
                 eventlog.shutdown()
+        # memory observability plane (runtime/memory.py): watermark sample
+        # granularity + site top-K are process-global like the switches
+        # above — only an EXPLICIT setting pushes them onto the (lazily
+        # constructed) buffer catalog
+        if any(k.key in self.conf.settings for k in (
+                CFG.MEMORY_WATERMARK_INTERVAL, CFG.MEMORY_PROFILE_TOPK)):
+            from spark_rapids_tpu.runtime import memory as MEM
+            MEM.set_profile_options(
+                self.conf.get(CFG.MEMORY_WATERMARK_INTERVAL),
+                self.conf.get(CFG.MEMORY_PROFILE_TOPK))
         # multi-tenant query scheduler (runtime/scheduler.py): STRUCTURAL
         # knobs (concurrency, queue depth, aging) are process-global like
         # the switches above — only an EXPLICIT setting reconfigures the
@@ -694,6 +755,15 @@ class TpuSession:
         this session (None before any action): per-node metric snapshots,
         the annotated plan, wall time and query-scoped resilience deltas."""
         return self._last_collector
+
+    def heap_snapshot(self) -> dict:
+        """Live allocation-site heap snapshot of the process-wide buffer
+        catalog (runtime/memory.py): per-site tier occupancy, plan nodes,
+        owning queries, process-lifetime peak/cumulative traffic, plus the
+        device high-water mark — the programmatic face of
+        ``tools/profiler.py memory`` and the STATS memory gauges."""
+        from spark_rapids_tpu.runtime.memory import DeviceManager
+        return DeviceManager.get().catalog.heap_snapshot()
 
     # -- multi-tenant lifecycle (runtime/scheduler.py) -----------------------
     def cancel(self, query_id: str, reason: str = "cancelled") -> bool:

@@ -88,8 +88,14 @@ class ShuffleBlockStore:
         if serialized:
             blob = ser.serialize_batch(batch)
         else:
-            blob = mem.SpillableColumnarBatch(
-                batch, priority=mem.OUTPUT_FOR_SHUFFLE_INITIAL_PRIORITY)
+            # heap-profiler attribution: inherit the retry ladder's scope
+            # ("exchange.write") when the exchange exec drives this; direct
+            # writers (tests, recompute paths) fall back to a named site
+            # instead of the unattributed bucket
+            from spark_rapids_tpu.runtime import faults as F
+            with mem.alloc_site(F.current_scope() or "exchange.block"):
+                blob = mem.SpillableColumnarBatch(
+                    batch, priority=mem.OUTPUT_FOR_SHUFFLE_INITIAL_PRIORITY)
         with self._lock:
             lst = self._blocks[shuffle_id].setdefault(reduce_id, [])
             lst.append((seq, len(lst), blob))

@@ -1,0 +1,450 @@
+"""Memory observability plane: allocation-site heap profiler, watermark
+timelines, end-of-query leak detection.
+
+Covers: site/node tagging through the ambient alloc-site + fault-scope
+ladder, per-site/per-query accounting across spill transitions, the
+watermark timeline (event-log samples + Chrome counter-track records,
+monotone under the OOM-split chaos path), the end-of-query leak detector
+(proven by the `leak` fault kind: event + resilience counter + reclaim +
+strict-mode escalation), the OOM-dump site breakdown, the profiler
+`memory` subcommand incl. --diff math, and the STATS memory gauge
+families.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu import config as CFG
+from spark_rapids_tpu.runtime import eventlog as EL
+from spark_rapids_tpu.runtime import faults
+from spark_rapids_tpu.runtime import memory as mem
+from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.runtime import tracing
+from spark_rapids_tpu.session import TpuSession
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _profiler():
+    spec = importlib.util.spec_from_file_location(
+        "srt_profiler", os.path.join(REPO, "tools", "profiler.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    EL.shutdown()
+    faults.reset()
+    M.reset_global_registry()
+    tracing.clear_events()
+    mem.set_profile_options(
+        CFG.MEMORY_WATERMARK_INTERVAL.default, CFG.MEMORY_PROFILE_TOPK.default)
+    yield
+    EL.shutdown()
+    tracing.shutdown_spans()
+    faults.reset()
+    M.reset_global_registry()
+    tracing.clear_events()
+    mem.set_profile_options(
+        CFG.MEMORY_WATERMARK_INTERVAL.default, CFG.MEMORY_PROFILE_TOPK.default)
+
+
+def make_batch(rows=256, seed=0):
+    import numpy as np
+    from spark_rapids_tpu.plan.nodes import ScanNode
+    r = np.random.default_rng(seed)
+    tbl = pa.table({"a": r.integers(0, 1000, rows),
+                    "b": r.normal(0, 1, rows)})
+    node = ScanNode([tbl])
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    return ColumnarBatch.from_arrow(tbl)
+
+
+def _catalog(**kw):
+    kw.setdefault("device_budget", 1 << 30)
+    kw.setdefault("host_budget", 1 << 30)
+    return mem.BufferCatalog(**kw)
+
+
+# -- site tagging + accounting ------------------------------------------------
+
+def test_alloc_site_tagging_and_snapshot():
+    cat = _catalog()
+    with mem.alloc_site("test.site"):
+        bid = cat.add_batch(make_batch())
+    snap = cat.heap_snapshot()
+    sites = {s["site"]: s for s in snap["sites"]}
+    assert "test.site" in sites
+    e = sites["test.site"]
+    assert e["live_bytes"] > 0 and e["device_bytes"] == e["live_bytes"]
+    assert e["allocs"] == 1 and e["frees"] == 0
+    assert e["tiers"] == {mem.TierEnum.DEVICE: e["live_bytes"]}
+    assert snap["watermark_bytes"] >= e["live_bytes"]
+    cat.remove(bid)
+    snap2 = cat.heap_snapshot()
+    e2 = {s["site"]: s for s in snap2["sites"]}["test.site"]
+    assert e2["live_bytes"] == 0 and e2["frees"] == 1
+    # process-lifetime peak/cumulative survive the free
+    assert e2["peak_device_bytes"] == e["live_bytes"]
+    assert e2["cumulative_bytes"] == e["live_bytes"]
+
+
+def test_site_falls_back_to_fault_scope_then_unattributed():
+    cat = _catalog()
+    with faults.scope("joins.build"):
+        b1 = cat.add_batch(make_batch())
+    b2 = cat.add_batch(make_batch())
+    sites = {s["site"] for s in cat.heap_snapshot()["sites"]}
+    assert "joins.build" in sites
+    assert mem.UNATTRIBUTED_SITE in sites
+    assert cat.buffer_site(b1) == "joins.build"
+    assert cat.buffer_site(b2) == mem.UNATTRIBUTED_SITE
+
+
+def test_site_live_tracks_spill_transitions():
+    # tiny device budget: the second registration spills the first to host
+    b = make_batch()
+    sz = b.device_memory_size()
+    cat = _catalog(device_budget=int(sz * 1.5))
+    with mem.alloc_site("spillee"):
+        cat.add_batch(make_batch(seed=1), priority=-100.0)
+    with mem.alloc_site("resident"):
+        cat.add_batch(make_batch(seed=2))
+    sites = {s["site"]: s for s in cat.heap_snapshot()["sites"]}
+    assert sites["spillee"]["device_bytes"] == 0
+    assert sites["spillee"]["tiers"].get(mem.TierEnum.HOST, 0) > 0
+    assert sites["spillee"]["live_bytes"] > 0      # still live, other tier
+    assert sites["resident"]["device_bytes"] > 0
+
+
+def test_oom_dump_names_culprit_sites(tmp_path):
+    b = make_batch()
+    sz = b.device_memory_size()
+    # one registration alone exceeds the lenient budget with nothing else
+    # to spill: the catalog stays over budget and dumps allocator state
+    cat = _catalog(device_budget=int(sz * 0.5), strict_budget=False,
+                   oom_dump_dir=str(tmp_path))
+    with mem.alloc_site("hog.subsystem"):
+        cat.add_batch(make_batch(seed=1))
+    dumps = list(tmp_path.glob("hbm-oom-*.txt"))
+    assert dumps, "no OOM dump written"
+    text = dumps[0].read_text()
+    assert "top sites by live device bytes:" in text
+    assert "site=hog.subsystem" in text
+    # the per-buffer table names site/node/query columns
+    assert "buffer_id\ttier\tsize\tpriority\tsite\tnode\tquery" in text
+
+
+# -- watermark timeline -------------------------------------------------------
+
+def test_watermark_events_and_counter_track(tmp_path):
+    EL.configure(str(tmp_path))
+    tracing.configure_spans(str(tmp_path), process="driver")
+    cat = _catalog(watermark_interval_bytes=1)
+    ids = [cat.add_batch(make_batch(seed=i)) for i in range(4)]
+    for bid in ids:
+        cat.remove(bid)
+    EL.shutdown()
+    tracing.shutdown_spans()
+    events = [json.loads(ln) for ln in
+              open(next(tmp_path.glob("events-*.jsonl")))]
+    wms = [e for e in events if e["event"] == "memory.watermark"]
+    assert len(wms) >= 4
+    for e in wms:
+        assert not EL.validate_record(e), EL.validate_record(e)
+        assert e["device_bytes"] >= 0 and "sites" in e
+    marks = [e["watermark_bytes"] for e in wms]
+    assert marks == sorted(marks), "watermark ran backwards"
+    spans = [json.loads(ln) for ln in
+             open(next(tmp_path.glob("spans-*.jsonl")))]
+    counters = [s for s in spans if s["ph"] == "C" and s["name"] == "memory"]
+    assert counters, "no Chrome counter-track samples"
+    for s in counters:
+        assert not tracing.validate_span(s), tracing.validate_span(s)
+        assert set(s["args"]) == {"device_bytes", "host_bytes", "disk_bytes"}
+
+
+def test_counter_samples_render_as_chrome_counter_lane(tmp_path):
+    tracing.configure_spans(str(tmp_path), process="driver")
+    with tracing.trace_context("trace-x"):
+        tracing.counter("memory", {"device_bytes": 123, "host_bytes": 0,
+                                   "disk_bytes": 0})
+        with tracing.span("query"):
+            pass
+    tracing.shutdown_spans()
+    prof = _profiler()
+    records, violations = prof.load_spans(str(tmp_path))
+    assert violations == []
+    _, spans = prof.pick_trace(records, "trace-x")
+    trace = prof.chrome_trace(spans)
+    cs = [e for e in trace["traceEvents"] if e["ph"] == "C"]
+    assert len(cs) == 1
+    # counter args are numeric series ONLY — a trace-id string would become
+    # a bogus stacked series in Perfetto
+    assert cs[0]["args"] == {"device_bytes": 123, "host_bytes": 0,
+                             "disk_bytes": 0}
+
+
+# -- end-of-query leak detection ---------------------------------------------
+
+def _join_dfs(spark):
+    df1 = spark.create_dataframe(pa.table(
+        {"k": list(range(400)), "v": [float(i) for i in range(400)]}))
+    df2 = spark.create_dataframe(pa.table(
+        {"k": list(range(0, 800, 2)), "w": [float(i) for i in range(400)]}))
+    return df1.join(df2, on="k").agg(F.sum("v").alias("s"))
+
+
+def test_leak_fault_detected_counted_and_reclaimed(tmp_path):
+    spark = TpuSession({
+        "spark.rapids.tpu.test.faults": "leak:joins.build:1",
+        "spark.rapids.tpu.eventLog.dir": str(tmp_path)})
+    out = _join_dfs(spark).collect()
+    assert out.num_rows == 1
+    assert ("leak", "joins.build") in faults.injected_log()
+    # detector: resilience counter (process-wide AND query-scoped) + event
+    assert M.resilience_snapshot()[M.MEMORY_LEAKS] == 1
+    qm = spark.last_query_metrics()
+    assert qm.query_resilience()[M.MEMORY_LEAKS] == 1
+    evs = tracing.recent_events("memory.leak")
+    assert len(evs) == 1
+    assert evs[0][1]["sites"] == {"joins.build": evs[0][1]["bytes"]}
+    assert evs[0][1]["query"] == qm.query_id
+    # reclaimed: nothing is still tagged to the finished query
+    from spark_rapids_tpu.runtime.memory import DeviceManager
+    assert qm.query_id not in DeviceManager.get().catalog.query_device_bytes()
+    EL.shutdown()
+    log = [json.loads(ln) for ln in open(next(tmp_path.glob("*.jsonl")))]
+    leaks = [e for e in log if e["event"] == "memory.leak"]
+    assert len(leaks) == 1 and leaks[0]["query"] == qm.query_id
+
+
+def test_clean_run_reports_zero_leaks(tmp_path):
+    spark = TpuSession({"spark.rapids.tpu.eventLog.dir": str(tmp_path)})
+    _join_dfs(spark).collect()
+    assert M.resilience_snapshot()[M.MEMORY_LEAKS] == 0
+    assert tracing.recent_events("memory.leak") == []
+    EL.shutdown()
+    log = [json.loads(ln) for ln in open(next(tmp_path.glob("*.jsonl")))]
+    assert not [e for e in log if e["event"] == "memory.leak"]
+    # clean query: every alloc was freed (summary riding query.end)
+    end = [e for e in log if e["event"] == "query.end"][-1]
+    assert end["memory"]["peak_device_bytes"] > 0
+    assert "joins.build" in end["memory"]["sites"]
+
+
+def test_leak_strict_mode_raises():
+    spark = TpuSession({
+        "spark.rapids.tpu.test.faults": "leak:joins.build:1",
+        "spark.rapids.tpu.memory.leak.strict": "true"})
+    with pytest.raises(mem.MemoryLeakError, match="joins.build"):
+        _join_dfs(spark).collect()
+    # the strict escalation still reclaimed the buffers first
+    from spark_rapids_tpu.runtime.memory import DeviceManager
+    qid = spark.last_query_metrics().query_id
+    assert qid not in DeviceManager.get().catalog.query_device_bytes()
+
+
+def test_leak_check_disabled_leaves_buffers():
+    spark = TpuSession({
+        "spark.rapids.tpu.test.faults": "leak:joins.build:1",
+        "spark.rapids.tpu.memory.leak.check": "false"})
+    _join_dfs(spark).collect()
+    assert M.resilience_snapshot()[M.MEMORY_LEAKS] == 0
+    from spark_rapids_tpu.runtime.memory import DeviceManager
+    cat = DeviceManager.get().catalog
+    qid = spark.last_query_metrics().query_id
+    leaked = cat.query_device_bytes().get(qid, 0)
+    assert leaked > 0, "disabled detector should leave the leak in place"
+    # manual cleanup so later tests see a clean catalog
+    with cat._lock:
+        stale = [b.buffer_id for b in cat._buffers.values()
+                 if b.query == qid]
+    for bid in stale:
+        cat.remove(bid)
+
+
+def test_cached_partitions_are_retained_not_leaks():
+    spark = TpuSession()
+    df = spark.create_dataframe(pa.table(
+        {"k": [1, 2, 3] * 50, "v": [1.0] * 150})).cache()
+    assert df.filter(F.col("k") > 1).count() == 100
+    # the cache's device partitions outlive the query by design: no leak
+    assert M.resilience_snapshot()[M.MEMORY_LEAKS] == 0
+    assert tracing.recent_events("memory.leak") == []
+    snap = spark.heap_snapshot()
+    sites = {s["site"]: s for s in snap["sites"]}
+    assert sites.get("cache.device", {}).get("retained_bytes", 0) > 0
+    df.unpersist()
+
+
+# -- q18 end to end -----------------------------------------------------------
+
+def test_q18_join_build_bytes_land_on_join_node(tmp_path):
+    from spark_rapids_tpu.benchmarks import tpch
+    paths = tpch.generate(0.005, str(tmp_path / "tpch"))
+    spark = TpuSession({
+        "spark.rapids.tpu.eventLog.dir": str(tmp_path / "log"),
+        "spark.rapids.tpu.memory.profile.watermarkIntervalBytes": "1k"})
+    dfs = tpch.load(spark, paths)
+    tpch.q18(dfs).collect()
+    qm = spark.last_query_metrics()
+    msum = qm.memory
+    assert msum is not None and msum["peak_device_bytes"] > 0
+    build = msum["sites"].get("joins.build")
+    assert build is not None and build["peak_bytes"] > 0
+    # the build bytes are attributed to a JOIN plan node, by id
+    names = {n["id"]: n["name"] for n in qm.node_summaries()
+             if n["id"] is not None}
+    assert build["nodes"], "join build carried no node attribution"
+    assert any(("Join" in names.get(nid, "")
+                or "Broadcast" in names.get(nid, ""))
+               for nid in build["nodes"]), \
+        {nid: names.get(nid) for nid in build["nodes"]}
+    # clean run: zero leaks, and ≥90% of the recorded peak is attributed
+    # to NAMED sites (the acceptance bar for the heap profiler)
+    EL.shutdown()
+    log_dir = tmp_path / "log"
+    records = [json.loads(ln)
+               for p in sorted(log_dir.glob("events-*.jsonl"))
+               for ln in open(p) if ln.strip()]
+    assert not [e for e in records if e["event"] == "memory.leak"]
+    prof = _profiler()
+    memo = prof.analyze_memory(records)
+    assert memo["peak_attribution"] is not None
+    assert memo["peak_attribution"] >= 0.9, memo["peak"]
+
+
+def test_q18_watermark_monotone_under_oom_split_chaos(tmp_path):
+    from spark_rapids_tpu.benchmarks import tpch
+    paths = tpch.generate(0.005, str(tmp_path / "tpch"))
+    spark = TpuSession({
+        "spark.rapids.tpu.eventLog.dir": str(tmp_path / "log"),
+        "spark.rapids.tpu.memory.profile.watermarkIntervalBytes": "1k",
+        "spark.rapids.tpu.test.faults": "oom:joins.build:2",
+        # sf0.005 batches sit under the default 64k split floor; the chaos
+        # ladder needs real splits to recover two back-to-back OOMs
+        "spark.rapids.tpu.memory.retry.splitFloorBytes": "1b"})
+    dfs = tpch.load(spark, paths)
+    tpch.q18(dfs).collect()
+    qm = spark.last_query_metrics()
+    res = qm.query_resilience()
+    assert res[M.NUM_OOM_RETRIES] >= 1, res
+    assert res[M.MEMORY_LEAKS] == 0, res   # recovery must not leak
+    EL.shutdown()
+    records = [json.loads(ln)
+               for p in sorted((tmp_path / "log").glob("events-*.jsonl"))
+               for ln in open(p) if ln.strip()]
+    wms = [e for e in records if e["event"] == "memory.watermark"]
+    assert len(wms) >= 2, "chaos run produced too few watermark samples"
+    marks = [e["watermark_bytes"] for e in wms]
+    assert marks == sorted(marks), "watermark regressed under OOM chaos"
+    assert not [e for e in records if e["event"] == "memory.leak"]
+
+
+# -- profiler memory subcommand ----------------------------------------------
+
+def _fake_log(path, sites_a):
+    """Minimal event log with one watermark + one snapshot."""
+    recs = [
+        {"event": "memory.watermark", "ts": 1.0, "t": 1.0, "pid": 1,
+         "query": "qx", "node": None, "device_bytes": 100, "host_bytes": 0,
+         "disk_bytes": 0, "watermark_bytes": 100, "budget": 1000,
+         "sites": {s: e["live_bytes"] for s, e in sites_a.items()}},
+        {"event": "memory.snapshot", "ts": 2.0, "t": 2.0, "pid": 1,
+         "query": "qx", "node": None, "device_bytes": 100, "host_bytes": 0,
+         "disk_bytes": 0, "watermark_bytes": 100, "device_budget": 1000,
+         "buffers": len(sites_a),
+         "sites": [dict(site=s, **e) for s, e in sites_a.items()]},
+    ]
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_snapshot_diff_math(tmp_path):
+    prof = _profiler()
+    a = tmp_path / "a.jsonl"
+    b = tmp_path / "b.jsonl"
+    _fake_log(a, {"joins.build": {"live_bytes": 60, "peak_device_bytes": 80,
+                                  "cumulative_bytes": 100},
+                  "gone.site": {"live_bytes": 40, "peak_device_bytes": 40,
+                                "cumulative_bytes": 40}})
+    _fake_log(b, {"joins.build": {"live_bytes": 90, "peak_device_bytes": 95,
+                                  "cumulative_bytes": 200},
+                  "new.site": {"live_bytes": 10, "peak_device_bytes": 10,
+                               "cumulative_bytes": 10}})
+    ra, _ = prof.load_log(str(a))
+    rb, _ = prof.load_log(str(b))
+    d = prof.diff_memory(prof.analyze_memory(ra), prof.analyze_memory(rb))
+    rows = {r["site"]: r for r in d["sites"]}
+    jb = rows["joins.build"]
+    assert (jb["live_a"], jb["live_b"], jb["delta_live"]) == (60, 90, 30)
+    assert jb["delta_peak"] == 15 and jb["delta_cumulative"] == 100
+    assert rows["gone.site"]["delta_live"] == -40
+    assert rows["new.site"]["delta_live"] == 10
+    assert d["totals"]["device_bytes"] == 0   # both snapshots read 100
+
+
+def test_profiler_memory_cli(tmp_path):
+    spark = TpuSession({
+        "spark.rapids.tpu.eventLog.dir": str(tmp_path),
+        "spark.rapids.tpu.memory.profile.watermarkIntervalBytes": "1k"})
+    _join_dfs(spark).collect()
+    EL.shutdown()
+    log = str(next(tmp_path.glob("events-*.jsonl")))
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "profiler.py"),
+         "memory", log], capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert "heap snapshot" in out.stdout
+    assert "watermark timeline" in out.stdout
+    assert "joins.build" in out.stdout
+    assert "no leaks detected" in out.stdout
+    # --diff against itself: all deltas zero, rc 0
+    diff = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "profiler.py"),
+         "memory", log, "--diff", log], capture_output=True, text=True)
+    assert diff.returncode == 0, diff.stderr
+    assert "memory diff" in diff.stdout
+    # a log with no memory-plane events fails loudly (CI gate contract)
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    bad = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "profiler.py"),
+         "memory", str(empty)], capture_output=True, text=True)
+    assert bad.returncode == 1
+
+
+# -- serving surface ----------------------------------------------------------
+
+def test_stats_render_memory_gauges():
+    spark = TpuSession()
+    _join_dfs(spark).collect()
+    from spark_rapids_tpu.runtime.endpoint import render_stats
+    text = render_stats()
+    assert "srt_hbm_watermark_bytes" in text
+    # site gauges appear when something is live; the watermark gauge is
+    # unconditional once the device is initialized
+    from spark_rapids_tpu.runtime.memory import DeviceManager
+    assert DeviceManager.get().catalog.watermark_bytes > 0
+
+
+def test_session_heap_snapshot_shape():
+    spark = TpuSession()
+    _join_dfs(spark).collect()
+    snap = spark.heap_snapshot()
+    assert {"device_bytes", "host_bytes", "disk_bytes", "watermark_bytes",
+            "device_budget", "buffers", "sites"} <= set(snap)
+    for e in snap["sites"]:
+        assert {"site", "tiers", "live_bytes", "peak_device_bytes",
+                "cumulative_bytes", "allocs", "frees", "nodes"} <= set(e)

@@ -1,0 +1,163 @@
+"""bench_compare — gate a bench JSON line against a committed baseline.
+
+Perf work (ROADMAP item 1) must land against a guarded trajectory: this
+tool compares the current ``bench.py`` output line against a committed
+``BENCH_rNN.json`` baseline per query and computes the geomean ratio of the
+oracle-normalized ``vs_baseline`` scores (engine speed relative to the
+numpy-oracle e2e denominator on the SAME box — the most machine-portable
+number a bench line carries). ci.sh wires it as a **soft gate**:
+
+  - geomean regression > ``--warn``  (default 10%)  -> WARN, exit 0
+  - geomean regression > ``--fail``  (default 25%)  -> FAIL, exit 1
+  - lines not comparable (different scale factor / query set, a degraded
+    marker on either side) -> SKIP, exit 0 with the reason printed — the
+    CI dry-run at sf0.01 on CPU must not be judged against a committed
+    sf0.1 accelerator line.
+
+Memory trajectory rides along: per-query ``peak_device_bytes`` deltas are
+printed when both lines carry them (bench.py embeds them from the
+allocation-site heap profiler), so a perf win that doubles the high-water
+mark is visible in the same report.
+
+Usage:
+  python tools/bench_compare.py <current.json> [--baseline BENCH_r06.json]
+                                [--warn 0.10] [--fail 0.25]
+
+<current.json> may be a file whose LAST line is the bench JSON (bench.py
+output redirected to a file works as-is), or ``-`` for stdin.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import re
+import sys
+
+
+def load_line(path: str) -> dict:
+    """Bench JSON from `path`: a whole-file JSON document (the committed
+    pretty-printed BENCH_rNN.json form) or the last parseable JSON line
+    with a 'metric' key (raw bench.py output)."""
+    text = sys.stdin.read() if path == "-" else open(path).read()
+    try:
+        d = json.loads(text)
+        if isinstance(d, dict) and "metric" in d.get("parsed", {}):
+            return d["parsed"]   # r05-and-earlier watcher wrapper form
+        if isinstance(d, dict) and "metric" in d:
+            return d
+    except ValueError:
+        pass
+    for ln in reversed(text.splitlines()):
+        ln = ln.strip()
+        if ln.startswith("{") and ln.endswith("}"):
+            try:
+                d = json.loads(ln)
+            except ValueError:
+                continue
+            if "metric" in d:
+                return d
+    raise SystemExit(f"no bench JSON line with a 'metric' key in {path}")
+
+
+def _sf(metric: str) -> "str | None":
+    m = re.search(r"sf([0-9.]+)", metric or "")
+    return m.group(1) if m else None
+
+
+def _platform(d: dict) -> str:
+    deg = d.get("degraded") or ""
+    return "cpu" if ("platform=cpu" in deg or "cpu-fallback" in deg) \
+        else "tpu"
+
+
+def comparable(cur: dict, base: dict) -> "str | None":
+    """None when the two lines can be judged against each other, else the
+    reason they cannot (SKIP, not FAIL — an incomparable pair proves
+    nothing about the trajectory). A degraded marker alone does NOT skip:
+    the committed baselines on this box carry platform=cpu, and two cpu
+    lines at the same scale ARE comparable — only a platform or scale
+    mismatch, or a noisy measurement, voids the comparison."""
+    if _sf(cur.get("metric", "")) != _sf(base.get("metric", "")):
+        return (f"scale factor differs: {cur.get('metric')} vs "
+                f"{base.get('metric')}")
+    if _platform(cur) != _platform(base):
+        return (f"platform differs: {_platform(cur)} vs {_platform(base)}")
+    if cur.get("variance_ok") is False:
+        return f"current measurement too noisy (spread {cur.get('spread')})"
+    if base.get("variance_ok") is False:
+        return (f"baseline measurement too noisy "
+                f"(spread {base.get('spread')})")
+    common = set(cur.get("queries") or {}) & set(base.get("queries") or {})
+    if not common:
+        return "no common per-query entries"
+    if any((cur["queries"][q].get("vs_baseline") or 0) <= 0
+           or (base["queries"][q].get("vs_baseline") or 0) <= 0
+           for q in common):
+        return "missing/zero vs_baseline on a common query"
+    return None
+
+
+def compare(cur: dict, base: dict) -> dict:
+    common = sorted(set(cur["queries"]) & set(base["queries"]))
+    rows = []
+    for q in common:
+        c, b = cur["queries"][q], base["queries"][q]
+        ratio = c["vs_baseline"] / b["vs_baseline"]
+        row = {"query": q,
+               "base_vs_baseline": b["vs_baseline"],
+               "cur_vs_baseline": c["vs_baseline"],
+               "ratio": round(ratio, 4)}
+        if "peak_device_bytes" in c and "peak_device_bytes" in b:
+            row["peak_device_bytes"] = c["peak_device_bytes"]
+            row["peak_delta_bytes"] = (c["peak_device_bytes"]
+                                       - b["peak_device_bytes"])
+        rows.append(row)
+    geomean = math.exp(sum(math.log(r["ratio"]) for r in rows) / len(rows))
+    return {"queries": rows, "geomean_ratio": round(geomean, 4),
+            "regression": round(max(0.0, 1.0 - geomean), 4)}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="bench_compare.py", description=__doc__)
+    p.add_argument("current", help="bench JSON line (file or '-')")
+    p.add_argument("--baseline", default="BENCH_r06.json",
+                   help="committed baseline bench JSON")
+    p.add_argument("--warn", type=float, default=0.10,
+                   help="geomean regression fraction that warns")
+    p.add_argument("--fail", type=float, default=0.25,
+                   help="geomean regression fraction that fails (rc 1)")
+    args = p.parse_args(argv)
+
+    cur = load_line(args.current)
+    base = load_line(args.baseline)
+    reason = comparable(cur, base)
+    if reason is not None:
+        print(f"bench_compare SKIP (not comparable): {reason}")
+        return 0
+    d = compare(cur, base)
+    for r in d["queries"]:
+        extra = ""
+        if "peak_delta_bytes" in r:
+            extra = (f"  peak_dev {r['peak_device_bytes']}B "
+                     f"({r['peak_delta_bytes']:+d}B vs baseline)")
+        print(f"  {r['query']}: vs_baseline {r['base_vs_baseline']} -> "
+              f"{r['cur_vs_baseline']}  (x{r['ratio']}){extra}")
+    reg = d["regression"]
+    verdict = (f"geomean ratio {d['geomean_ratio']} "
+               f"(regression {reg:.1%}) vs {args.baseline}")
+    if reg > args.fail:
+        print(f"bench_compare FAIL: {verdict} exceeds fail "
+              f"threshold {args.fail:.0%}")
+        return 1
+    if reg > args.warn:
+        print(f"bench_compare WARN: {verdict} exceeds warn "
+              f"threshold {args.warn:.0%}")
+        return 0
+    print(f"bench_compare OK: {verdict}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,14 +26,23 @@ chain of spans bounding the query's wall time, with per-edge blame
 (decode vs compute vs exchange vs queue-wait) — the direct input to the
 fusion/concurrency/scale-out items on the roadmap.
 
+``memory`` replays the memory observability plane (runtime/memory.py):
+heap-snapshot tables of live bytes by allocation site/node/tier, per-query
+peak attribution (which subsystem owned the high-water mark), the watermark
+timeline, and end-of-query leak detections. ``--diff`` compares the final
+heap snapshots of two logs per site (live/peak/cumulative deltas) — the
+before/after view for hunting growth between runs.
+
 Usage:
   python tools/profiler.py report <eventlog.jsonl> [--json] [--top N]
   python tools/profiler.py report <eventlog.jsonl> --compare <other.jsonl>
   python tools/profiler.py trace <logdir> [--query TRACE] [--out trace.json]
+  python tools/profiler.py memory <eventlog.jsonl> [--diff <other.jsonl>]
 
 Exit status is non-zero on schema violations, when no query in the log
-carries a non-empty operator breakdown (report), or on malformed span files
-/ an empty merged trace (trace) — CI uses both as gates.
+carries a non-empty operator breakdown (report), on malformed span files
+/ an empty merged trace (trace), or when the log carries no memory-plane
+events at all (memory) — CI uses these as gates.
 """
 
 from __future__ import annotations
@@ -401,11 +410,17 @@ def chrome_trace(spans: list) -> dict:
             events.append({"name": "thread_name", "ph": "M", "pid": pid,
                            "tid": tids[tkey], "args": {"name": s["tid"]}})
         ev = {"name": s["name"], "ph": s["ph"], "pid": pid,
-              "tid": tids[tkey], "ts": round((s["_t0"] - base) * 1e6, 3),
-              "args": dict(s.get("args") or {}, trace=s.get("trace"))}
+              "tid": tids[tkey], "ts": round((s["_t0"] - base) * 1e6, 3)}
+        if s["ph"] == "C":
+            # counter track (memory lanes): args are numeric series only —
+            # Perfetto plots one stacked lane per (process, name), so no
+            # trace-id string may pollute the series dict
+            ev["args"] = dict(s.get("args") or {})
+        else:
+            ev["args"] = dict(s.get("args") or {}, trace=s.get("trace"))
         if s["ph"] == "X":
             ev["dur"] = round((s.get("dur") or 0.0) * 1e6, 3)
-        else:
+        elif s["ph"] == "i":
             ev["s"] = "t"
         events.append(ev)
     return {"traceEvents": events, "displayTimeUnit": "ms"}
@@ -542,6 +557,222 @@ def trace_main(args) -> int:
               file=sys.stderr)
         return 1
     print(render_critical_path(window, chain, blame, top=args.top))
+    return rc
+
+
+# ---------------------------------------------------------------------------
+# memory plane: heap snapshots, watermark timeline, leak detections
+# ---------------------------------------------------------------------------
+
+UNATTRIBUTED_SITE = "catalog.add_batch"
+
+
+def analyze_memory(records: list) -> dict:
+    """Replay the memory-plane events of one log: watermark timeline per
+    process, the final heap snapshot, per-query peak/site summaries (from
+    query.end's embedded memory field), leak detections, and the peak
+    attribution ratio — the fraction of the highest recorded device
+    occupancy held by NAMED allocation sites (vs the unattributed
+    bucket)."""
+    watermarks = [{
+        "t": r.get("t"), "pid": r.get("pid"), "query": r.get("query"),
+        "device_bytes": r.get("device_bytes", 0),
+        "host_bytes": r.get("host_bytes", 0),
+        "disk_bytes": r.get("disk_bytes", 0),
+        "watermark_bytes": r.get("watermark_bytes", 0),
+        "sites": r.get("sites") or {},
+    } for r in records if r["event"] == "memory.watermark"]
+    snapshots = [r for r in records if r["event"] == "memory.snapshot"]
+    leaks = [{
+        "query": r.get("query"), "bytes": r.get("bytes", 0),
+        "buffers": r.get("buffers", 0), "sites": r.get("sites") or {},
+    } for r in records if r["event"] == "memory.leak"]
+    queries = [{
+        "query": r.get("query"), "description": r.get("description", ""),
+        **(r.get("memory") or {}),
+    } for r in records if r["event"] == "query.end" and r.get("memory")]
+
+    peak = max(watermarks, key=lambda w: w["device_bytes"], default=None)
+    attribution = None
+    if peak and peak["device_bytes"]:
+        named = sum(v for s, v in peak["sites"].items()
+                    if s != UNATTRIBUTED_SITE)
+        attribution = round(named / peak["device_bytes"], 4)
+
+    snap = None
+    if snapshots:
+        s = snapshots[-1]
+        snap = {k: s.get(k) for k in ("device_bytes", "host_bytes",
+                                      "disk_bytes", "watermark_bytes",
+                                      "device_budget", "buffers")}
+        snap["sites"] = s.get("sites") or []
+    return {
+        "watermarks": watermarks,
+        "snapshot": snap,
+        "queries": queries,
+        "leaks": leaks,
+        "peak": peak,
+        "peak_attribution": attribution,
+    }
+
+
+def diff_memory(a: dict, b: dict) -> dict:
+    """Per-site deltas between two analyses' final heap snapshots (B - A):
+    live/peak/cumulative bytes per site plus the tier totals — the
+    before/after math of ``memory --diff``."""
+    sa = {e["site"]: e for e in ((a.get("snapshot") or {}).get("sites") or [])}
+    sb = {e["site"]: e for e in ((b.get("snapshot") or {}).get("sites") or [])}
+    rows = []
+    for site in sorted(set(sa) | set(sb)):
+        ea, eb = sa.get(site, {}), sb.get(site, {})
+        rows.append({
+            "site": site,
+            "live_a": ea.get("live_bytes", 0),
+            "live_b": eb.get("live_bytes", 0),
+            "delta_live": eb.get("live_bytes", 0) - ea.get("live_bytes", 0),
+            "delta_peak": (eb.get("peak_device_bytes", 0)
+                           - ea.get("peak_device_bytes", 0)),
+            "delta_cumulative": (eb.get("cumulative_bytes", 0)
+                                 - ea.get("cumulative_bytes", 0)),
+        })
+    rows.sort(key=lambda r: (-abs(r["delta_live"]), -abs(r["delta_peak"])))
+    ta, tb = a.get("snapshot") or {}, b.get("snapshot") or {}
+    totals = {k: (tb.get(k) or 0) - (ta.get(k) or 0)
+              for k in ("device_bytes", "host_bytes", "disk_bytes",
+                        "watermark_bytes", "buffers")}
+    return {"sites": rows, "totals": totals,
+            "leaks_a": len(a.get("leaks") or []),
+            "leaks_b": len(b.get("leaks") or [])}
+
+
+def render_memory(mem: dict, top: int = 15) -> str:
+    out = []
+    snap = mem.get("snapshot")
+    if snap:
+        out.append(f"== heap snapshot (final): device "
+                   f"{_fmt_bytes(snap['device_bytes'])} / budget "
+                   f"{_fmt_bytes(snap['device_budget'])}, host "
+                   f"{_fmt_bytes(snap['host_bytes'])}, disk "
+                   f"{_fmt_bytes(snap['disk_bytes'])}, watermark "
+                   f"{_fmt_bytes(snap['watermark_bytes'])}, "
+                   f"{snap['buffers']} live buffers")
+        out.append(f"  {'live':>10}  {'peak_dev':>10}  {'cumulative':>11}  "
+                   f"{'allocs':>7}  {'frees':>7}  site [tiers] nodes")
+        for e in snap["sites"][:top]:
+            tiers = ",".join(f"{t}={_fmt_bytes(v)}"
+                             for t, v in sorted((e.get("tiers") or {}).items()))
+            nodes = ",".join(str(n) for n in (e.get("nodes") or [])[:6])
+            out.append(
+                f"  {_fmt_bytes(e.get('live_bytes', 0)):>10}  "
+                f"{_fmt_bytes(e.get('peak_device_bytes', 0)):>10}  "
+                f"{_fmt_bytes(e.get('cumulative_bytes', 0)):>11}  "
+                f"{e.get('allocs', 0):>7}  {e.get('frees', 0):>7}  "
+                f"{e['site']}" + (f" [{tiers}]" if tiers else "")
+                + (f" nodes={nodes}" if nodes else ""))
+    for q in mem["queries"]:
+        out.append(f"== query {q['query']} [{q.get('description', '')}]: "
+                   f"peak {_fmt_bytes(q.get('peak_device_bytes', 0))}, "
+                   f"cumulative {_fmt_bytes(q.get('cumulative_bytes', 0))}, "
+                   f"{q.get('allocs', 0)} allocs")
+        sites = sorted((q.get("sites") or {}).items(),
+                       key=lambda kv: -kv[1].get("peak_bytes", 0))
+        for site, s in sites[:top]:
+            nodes = ",".join(str(n) for n in (s.get("nodes") or [])[:6])
+            out.append(f"    {_fmt_bytes(s.get('peak_bytes', 0)):>10} peak  "
+                       f"{_fmt_bytes(s.get('cumulative_bytes', 0)):>10} cum  "
+                       f"{site}" + (f" nodes={nodes}" if nodes else ""))
+    wm = mem["watermarks"]
+    if wm:
+        out.append(f"== watermark timeline ({len(wm)} samples):")
+        shown = wm if len(wm) <= top else \
+            [wm[i * (len(wm) - 1) // (top - 1)] for i in range(top)]
+        out.append(f"    {'t':>12}  {'device':>10}  {'host':>10}  "
+                   f"{'disk':>10}  {'watermark':>10}  top site")
+        for w in shown:
+            tops = max(w["sites"].items(), key=lambda kv: kv[1],
+                       default=(None, 0))
+            out.append(f"    {w['t']:>12.4f}  "
+                       f"{_fmt_bytes(w['device_bytes']):>10}  "
+                       f"{_fmt_bytes(w['host_bytes']):>10}  "
+                       f"{_fmt_bytes(w['disk_bytes']):>10}  "
+                       f"{_fmt_bytes(w['watermark_bytes']):>10}  "
+                       + (f"{tops[0]}={_fmt_bytes(tops[1])}"
+                          if tops[0] else "-"))
+    peak = mem.get("peak")
+    if peak:
+        out.append(f"== peak: {_fmt_bytes(peak['device_bytes'])} device at "
+                   f"t={peak['t']:.4f}"
+                   + (f", attribution {mem['peak_attribution']:.0%} to "
+                      "named sites"
+                      if mem.get("peak_attribution") is not None else ""))
+        for site, v in sorted(peak["sites"].items(), key=lambda kv: -kv[1]):
+            out.append(f"    {_fmt_bytes(v):>10}  {site}")
+    if mem["leaks"]:
+        out.append(f"== LEAKS ({len(mem['leaks'])} detected):")
+        for lk in mem["leaks"]:
+            sites = ", ".join(f"{s}={_fmt_bytes(v)}"
+                              for s, v in sorted(lk["sites"].items()))
+            out.append(f"    query {lk['query']}: {_fmt_bytes(lk['bytes'])} "
+                       f"in {lk['buffers']} buffer(s) [{sites}]")
+    else:
+        out.append("== no leaks detected")
+    return "\n".join(out)
+
+
+def render_memory_diff(d: dict, name_a: str, name_b: str,
+                       top: int = 15) -> str:
+    out = [f"== memory diff A={name_a} B={name_b}"]
+    t = d["totals"]
+    out.append("  totals (B-A): " + ", ".join(
+        f"{k}={'+' if v >= 0 else ''}{_fmt_bytes(v)}"
+        if k != "buffers" else f"{k}={v:+d}"
+        for k, v in t.items()))
+    out.append(f"  {'live A':>10}  {'live B':>10}  {'Δlive':>11}  "
+               f"{'Δpeak':>11}  {'Δcumulative':>12}  site")
+    for r in d["sites"][:top]:
+        out.append(f"  {_fmt_bytes(r['live_a']):>10}  "
+                   f"{_fmt_bytes(r['live_b']):>10}  "
+                   f"{'+' if r['delta_live'] >= 0 else ''}"
+                   f"{_fmt_bytes(r['delta_live']):>10}  "
+                   f"{'+' if r['delta_peak'] >= 0 else ''}"
+                   f"{_fmt_bytes(r['delta_peak']):>10}  "
+                   f"{'+' if r['delta_cumulative'] >= 0 else ''}"
+                   f"{_fmt_bytes(r['delta_cumulative']):>11}  {r['site']}")
+    out.append(f"  leaks: {d['leaks_a']} -> {d['leaks_b']}")
+    return "\n".join(out)
+
+
+def memory_main(args) -> int:
+    records, violations = load_log(args.eventlog)
+    rc = 0
+    if violations:
+        for v in violations:
+            print(f"SCHEMA VIOLATION: {v}", file=sys.stderr)
+        rc = 1
+    mem = analyze_memory(records)
+    if not (mem["watermarks"] or mem["snapshot"] or mem["queries"]):
+        print(f"ERROR: no memory-plane events in {args.eventlog} "
+              "(memory.watermark / memory.snapshot / query.end memory)",
+              file=sys.stderr)
+        return 1
+    if args.diff:
+        other_records, other_violations = load_log(args.diff)
+        if other_violations:
+            for v in other_violations:
+                print(f"SCHEMA VIOLATION: {v}", file=sys.stderr)
+            rc = 1
+        other = analyze_memory(other_records)
+        d = diff_memory(mem, other)
+        if args.json:
+            print(json.dumps(d, indent=2, default=str))
+        else:
+            print(render_memory_diff(d, args.eventlog, args.diff,
+                                     top=args.top))
+        return rc
+    if args.json:
+        print(json.dumps(mem, indent=2, default=str))
+    else:
+        print(render_memory(mem, top=args.top))
     return rc
 
 
@@ -742,10 +973,23 @@ def main(argv=None) -> int:
                          "(default <logdir>/trace.json)")
     tr.add_argument("--top", type=int, default=15,
                     help="critical-path chain segments to print")
+    mm = sub.add_parser(
+        "memory", help="heap-snapshot tables, watermark timeline and leak "
+                       "detections from the memory observability plane")
+    mm.add_argument("eventlog")
+    mm.add_argument("--diff", metavar="OTHER",
+                    help="second event log; print per-site deltas between "
+                         "the two final heap snapshots")
+    mm.add_argument("--json", action="store_true",
+                    help="machine-readable analysis instead of text")
+    mm.add_argument("--top", type=int, default=15,
+                    help="sites / timeline samples per table")
     args = p.parse_args(argv)
 
     if args.cmd == "trace":
         return trace_main(args)
+    if args.cmd == "memory":
+        return memory_main(args)
 
     records, violations = load_log(args.eventlog)
     analysis = analyze(records)
