@@ -202,6 +202,38 @@ fi
 rm -rf "$bad_dir"
 rm -rf "$chaos_dir"
 
+echo "== mesh-cluster chaos: unified plane, mesh kill mid-q18 -> degraded TCP fallback =="
+# the combined N-process x M-chip plane (ROADMAP item 4): a 2-executor
+# MiniCluster, each executor driving a 4-device local mesh. The script
+# asserts the whole contract: the CLEAN mesh run used mesh tasks with every
+# resilience counter zero (meshDegradedFallbacks rides the all-zero gate),
+# and the killed run — a participant SIGKILLed INSIDE the mesh collective —
+# degraded its group to the per-split TCP path under a bumped epoch,
+# recomputed earlier stages' lost splits lineage-scoped, never reached the
+# whole-query heal, and stayed bit-identical
+mesh_dir=$(mktemp -d)
+JAX_PLATFORMS=cpu python tools/cluster_chaos.py \
+  --data-dir /tmp/tpch_ci_sf0.01 --eventlog-dir "$mesh_dir" --query q18 \
+  --mesh --executors 2
+# the degraded-mode ladder must be visible in the DRIVER's event log
+mesh_log=$(grep -l "mesh.degraded" "$mesh_dir"/events-*.jsonl | head -1)
+python - "$mesh_log" <<'PYEOF'
+import json, sys
+events = [json.loads(ln)["event"] for ln in open(sys.argv[1]) if ln.strip()]
+for want in ("mesh.attach", "mesh.detach", "mesh.degraded", "executor.lost"):
+    assert want in events, (want, sorted(set(events)))
+print("mesh chaos event log ok:",
+      events.count("mesh.attach"), "mesh.attach,",
+      events.count("mesh.degraded"), "mesh.degraded,",
+      events.count("mesh.detach"), "mesh.detach")
+PYEOF
+rm -rf "$mesh_dir"
+# mesh-plane unit/integration suite: wave pid bit-exactness vs the
+# per-batch partitioner, kill/hang/error degraded fallbacks,
+# movement-aware placement + spill-aware demotion, the typed-ENOSPC OOM
+# ladder, and spawn-handshake retry
+JAX_PLATFORMS=cpu python -m pytest tests/test_mesh_cluster.py -q -m 'not slow'
+
 echo "== multi-tenant: concurrent chaos (cancel + OOM + shed isolation) =="
 # 4 concurrent TPC-H queries: one killed by its deadline, one recovering
 # injected join-build OOMs, two survivors bit-identical to solo runs with

@@ -74,12 +74,35 @@ import pyarrow as pa
 # executor process
 # ---------------------------------------------------------------------------
 
+def _mesh_conf_raw(conf_settings: dict):
+    """Parse the cluster.mesh knobs from the RAW settings dict — needed
+    BEFORE any spark_rapids_tpu import (the config module pulls in jax,
+    and the XLA device-count flag must be set first)."""
+    pre = "spark.rapids.tpu.cluster.mesh."
+    enabled = str(conf_settings.get(pre + "enabled", "")
+                  ).strip().lower() in ("true", "1", "yes")
+    try:
+        n = int(conf_settings.get(pre + "devicesPerExecutor", 0) or 0)
+    except (TypeError, ValueError):
+        n = 0
+    return enabled, n
+
+
 def _executor_main(conn, executor_index: int, platform: str,
                    conf_settings: dict):
     """Executor entry (spawned): block server + task loop (the standalone
     Plugin.scala:137-211 executor-side bring-up analog)."""
     if platform:
         os.environ["JAX_PLATFORMS"] = platform
+    mesh_on, mesh_n = _mesh_conf_raw(conf_settings)
+    if mesh_on and platform == "cpu":
+        # the local mesh needs >=2 devices; on the CPU platform they only
+        # exist if the XLA host-device flag is set before jax initializes
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{mesh_n if mesh_n > 0 else 8}").strip()
     import jax
     if platform:
         jax.config.update("jax_platforms", platform)
@@ -112,9 +135,118 @@ def _executor_main(conn, executor_index: int, platform: str,
                      keep=conf.get(CFG.EVENT_LOG_KEEP_FILES))
     store = ShuffleBlockStore.get()
     transport = TcpTransport(conf)
-    conn.send({"op": "ready", "port": transport.port, "pid": os.getpid()})
+    # the reduce side short-circuits fetches addressed to THIS executor's
+    # block server straight into the local store (cluster/remote.py) — the
+    # read movement-aware placement schedules for
+    from spark_rapids_tpu.cluster import remote as R
+    R.set_local_address(("127.0.0.1", transport.port))
+    # local mesh bring-up (unified mesh-cluster plane): report the ACTUAL
+    # attached width on the handshake so the driver sizes mesh task groups
+    # to what this process really has (mesh.attach / degraded re-plans)
+    mesh_width = 0
+    if mesh_on:
+        try:
+            from spark_rapids_tpu.distributed.mesh import LocalMesh
+            mesh_width = LocalMesh.get(mesh_n).n
+        except Exception:
+            mesh_width = 0
+    conn.send({"op": "ready", "port": transport.port, "pid": os.getpid(),
+               "mesh": mesh_width})
+
+    def run_mesh_map(task):
+        """A MESH map task: up to mesh-width lanes (one map split each) run
+        in one task; per partition wave, every lane's current batch gets
+        its Spark-exact partition ids from ONE jitted shard_map dispatch on
+        the local mesh, with the wave's per-partition row counts psum-ed
+        over ICI (distributed/mesh.LocalMesh). Blocks are sliced with the
+        exact per-batch path and parked under the same (map_split, seq)
+        keys as the TCP-only plane — bit-identical by construction, so the
+        driver can transparently re-plan a failed mesh task per-split.
+        Any failure of the mesh itself (bring-up, shrink, collective)
+        surfaces as MeshDegradedError → the driver's degraded fallback;
+        failures INSIDE a lane's subtree execution stay ordinary task
+        failures and ride the attempt ladder."""
+        from spark_rapids_tpu.distributed.mesh import (LocalMesh,
+                                                       MeshDegradedError)
+        from spark_rapids_tpu.shuffle.partitioning import (
+            slice_into_partitions)
+        plan = task["plan"]
+        lanes = task["mesh_lanes"]
+        sid = task["shuffle_id"]
+        part = task["partitioner"].bind(plan.output)
+        store.ensure_shuffle(sid)
+        tracing.set_process_trace(task.get("trace"))
+        try:
+            # mesh_kill / mesh_hang / degrade chaos sites: INSIDE the
+            # degrade guard, so exec_kill dies mid-collective with partial
+            # blocks parked, hang wedges until the task deadline, and
+            # error proves the transparent mesh→TCP fallback
+            F.maybe_inject_any("cluster.mesh.begin")
+            F.maybe_inject_any(f"cluster.mesh.begin.{executor_index}")
+            lm = LocalMesh.get(mesh_n)
+            if lm.n < len(lanes):
+                raise MeshDegradedError(
+                    f"mesh shrank: width {lm.n} < {len(lanes)} lanes")
+        except MeshDegradedError:
+            raise
+        except Exception as e:
+            raise MeshDegradedError(f"mesh bring-up failed: {e!r}") from e
+        waves = rows_exchanged = 0
+        with tracing.span("task.mesh_map", shuffle=sid,
+                          lanes=len(lanes)), TaskContext():
+            iters, seqs = [], []
+            for lane in lanes:
+                if lane["pin"] is not None:
+                    lplan = _pin_sources(_clone_plan(plan), lane["pin"])
+                    lsplit = 0
+                else:
+                    lplan = _clone_plan(plan)
+                    lsplit = lane["split"]
+                iters.append(to_device_plan(lplan, conf)
+                             .execute_partition(lsplit))
+                seqs.append(0)
+            live = list(range(len(lanes)))
+            while live:
+                wave = []
+                for li in list(live):
+                    try:
+                        wave.append((li, next(iters[li])))
+                    except StopIteration:
+                        live.remove(li)
+                if not wave:
+                    break
+                try:
+                    F.maybe_inject_any("cluster.mesh")
+                    F.maybe_inject_any(f"cluster.mesh.{executor_index}")
+                    pids_list, counts = lm.partition_wave(
+                        [b for _, b in wave], part)
+                except MeshDegradedError:
+                    raise
+                except Exception as e:
+                    raise MeshDegradedError(
+                        f"mesh collective failed: {e!r}") from e
+                waves += 1
+                if counts is not None:
+                    rows_exchanged += int(counts.sum())
+                for (li, b), pids in zip(wave, pids_list):
+                    seqs[li] += 1
+                    for pid, piece in slice_into_partitions(
+                            b, pids, part.num_partitions):
+                        if piece.num_rows:
+                            store.write_block(
+                                sid, pid, piece,
+                                seq=(lanes[li]["split"], seqs[li]))
+        return {"sizes": store.partition_sizes(sid, part.num_partitions),
+                "split_sizes": {
+                    lane["split"]: store.split_partition_sizes(
+                        sid, part.num_partitions, lane["split"])
+                    for lane in lanes},
+                "mesh": {"waves": waves, "lanes": len(lanes),
+                         "rows_exchanged": rows_exchanged}}
 
     def run_map(task):
+        if task.get("mesh_lanes") is not None:
+            return run_mesh_map(task)
         plan = task["plan"]
         part = task["partitioner"].bind(plan.output)
         sid = task["shuffle_id"]
@@ -150,7 +282,11 @@ def _executor_main(conn, executor_index: int, platform: str,
                             # contract as the local exchange map writer)
                             store.write_block(sid, pid, piece,
                                               seq=(map_split, seq))
-        return {"sizes": store.partition_sizes(sid, part.num_partitions)}
+        # per-split map-output statistics ride every reply so the driver's
+        # MapOutputTracker can place reducers where their bytes live
+        return {"sizes": store.partition_sizes(sid, part.num_partitions),
+                "split_sizes": {map_split: store.split_partition_sizes(
+                    sid, part.num_partitions, map_split)}}
 
     def run_result(task):
         plan = task["plan"]
@@ -209,9 +345,13 @@ def _executor_main(conn, executor_index: int, platform: str,
             else:
                 raise ValueError(f"unknown op {op}")
             reply.update({"op": "done", "ok": True})
-        except BaseException:  # noqa: BLE001 — shipped back to the driver
+        except BaseException as exc:  # noqa: BLE001 — shipped to the driver
             reply = {"op": "done", "ok": False,
                      "error": traceback.format_exc()}
+            # typed marker: the driver treats a degraded mesh as a
+            # transparent re-plan, NOT a task failure (no attempt strike)
+            if type(exc).__name__ == "MeshDegradedError":
+                reply["mesh_degraded"] = True
         finally:
             # the task's trace id must not bleed into the next task (or
             # into fetch serving between tasks)
@@ -264,13 +404,20 @@ class PlacementPolicy:
     bare itertools.cycle): the seed rotates which executor receives the
     first task, so attempt/blacklist tests can pin which executor hosts
     which map split. `prefer_not` lets a retry avoid the executors that
-    already failed the task when an alternative exists."""
+    already failed the task when an alternative exists. `preferred` is the
+    movement-aware override: when the caller already knows which executor
+    holds the task's biggest input (MapOutputTracker byte accounting), that
+    host wins WITHOUT advancing the round-robin cursor, so the rotation
+    schedule of ordinary picks stays deterministic around it."""
 
     def __init__(self, n_executors: int, seed: int = 0):
         self.n = max(n_executors, 1)
         self._next = seed % self.n
 
-    def pick(self, eligible, prefer_not=()):
+    def pick(self, eligible, prefer_not=(), preferred=None):
+        if (preferred is not None and preferred in eligible
+                and preferred not in prefer_not):
+            return preferred
         order = [(self._next + i) % self.n for i in range(self.n)]
         choices = [e for e in order
                    if e in eligible and e not in prefer_not] \
@@ -284,7 +431,7 @@ class PlacementPolicy:
 
 class _ShuffleState:
     __slots__ = ("shuffle_id", "subtree", "partitioner", "mode", "splits",
-                 "hosts", "epoch", "recomputes")
+                 "hosts", "epoch", "recomputes", "split_sizes")
 
     def __init__(self, shuffle_id, subtree, partitioner, mode, splits):
         self.shuffle_id = shuffle_id
@@ -295,6 +442,7 @@ class _ShuffleState:
         self.hosts = {}                 # map_split -> executor index
         self.epoch = 0                  # bumped on every invalidation
         self.recomputes = 0             # partial recomputes consumed
+        self.split_sizes = {}           # map_split -> [bytes per reduce id]
 
 
 class MapOutputTracker:
@@ -326,8 +474,53 @@ class MapOutputTracker:
     def epochs(self, shuffle_ids) -> dict:
         return {sid: self.epoch(sid) for sid in shuffle_ids}
 
-    def register_map_output(self, shuffle_id, map_split, executor_idx):
-        self._shuffles[shuffle_id].hosts[map_split] = executor_idx
+    def register_map_output(self, shuffle_id, map_split, executor_idx,
+                            sizes=None):
+        """Record the split's host and (when the reply carried them) its
+        per-reduce-partition byte sizes — the statistic movement-aware
+        reduce placement reads. Re-registration after a partial recompute
+        overwrites both, so the bytes always follow the live copy."""
+        st = self._shuffles[shuffle_id]
+        st.hosts[map_split] = executor_idx
+        if sizes is not None:
+            st.split_sizes[map_split] = list(sizes)
+
+    def invalidate_splits(self, shuffle_id, splits) -> None:
+        """Drop specific splits' outputs (degraded mesh task, partial
+        attempt) and bump the shuffle's epoch so any in-flight reply that
+        read the pre-drop layout is discarded and re-run."""
+        st = self._shuffles.get(shuffle_id)
+        if st is None:
+            return
+        st.epoch += 1
+        for s in splits:
+            st.hosts.pop(s, None)
+            st.split_sizes.pop(s, None)
+
+    def bytes_by_executor(self, shuffle_ids, reduce_id) -> dict:
+        """executor -> map-output bytes it holds for `reduce_id` across
+        `shuffle_ids` (Theseus-style movement statistic: the reduce task's
+        cheapest host is the one already holding the most of its input)."""
+        out: dict = {}
+        for sid in shuffle_ids:
+            st = self._shuffles.get(sid)
+            if st is None:
+                continue
+            for split, ei in st.hosts.items():
+                sizes = st.split_sizes.get(split)
+                if sizes and 0 <= reduce_id < len(sizes):
+                    out[ei] = out.get(ei, 0) + sizes[reduce_id]
+        return out
+
+    def executor_load(self, executor_idx) -> int:
+        """Total shuffle bytes parked on one executor across every live
+        shuffle — the spill-pressure proxy placement demotion checks."""
+        total = 0
+        for st in self._shuffles.values():
+            for split, ei in st.hosts.items():
+                if ei == executor_idx:
+                    total += sum(st.split_sizes.get(split, ()))
+        return total
 
     def on_executor_lost(self, executor_idx) -> list:
         """Invalidate every map split hosted on the dead executor; returns
@@ -341,6 +534,7 @@ class MapOutputTracker:
                 st.epoch += 1
                 for s in lost:
                     del st.hosts[s]
+                    st.split_sizes.pop(s, None)
                 out.append((st, lost))
         return out
 
@@ -351,10 +545,10 @@ class MapOutputTracker:
 class _TaskSpec:
     __slots__ = ("idx", "op", "subtree", "pin", "split", "shuffle_id",
                  "partitioner", "read_sids", "attempts", "tried",
-                 "speculated")
+                 "speculated", "lanes")
 
     def __init__(self, idx, op, subtree, pin, split, shuffle_id=None,
-                 partitioner=None):
+                 partitioner=None, lanes=None):
         self.idx = idx
         self.op = op                    # "map" | "result"
         self.subtree = subtree
@@ -362,11 +556,18 @@ class _TaskSpec:
         self.split = split              # map split id / subtree partition
         self.shuffle_id = shuffle_id
         self.partitioner = partitioner
+        # mesh map task: [(split, pin_or_None)] — one lane per local mesh
+        # device; None means the ordinary single-split task shape
+        self.lanes = lanes
         self.read_sids = sorted({s.shuffle_id for s in
                                  _collect_sources(subtree, [])})
         self.attempts = 0
         self.tried: set = set()
         self.speculated = False
+
+    def splits_covered(self) -> list:
+        return ([s for s, _ in self.lanes] if self.lanes is not None
+                else [self.split])
 
 
 class _Running:
@@ -421,6 +622,19 @@ class MiniCluster:
         self._speculation = self.conf.get(CFG.CLUSTER_SPECULATION_ENABLED)
         self._speculation_mult = self.conf.get(
             CFG.CLUSTER_SPECULATION_MULTIPLIER)
+        # unified mesh-cluster plane state (docs/cluster.md): per-slot
+        # attached mesh width from the spawn handshake, and whether the
+        # slot's mesh is still trusted for mesh task groups
+        self._mesh_enabled = self.conf.get(CFG.CLUSTER_MESH_ENABLED)
+        self._mesh = [0] * n_executors
+        self._mesh_ok = [False] * n_executors
+        self._movement_aware = self.conf.get(
+            CFG.CLUSTER_PLACEMENT_MOVEMENT_AWARE)
+        self._max_loaded_bytes = self.conf.get(
+            CFG.CLUSTER_PLACEMENT_MAX_LOADED_BYTES)
+        self._spawn_retries = self.conf.get(CFG.CLUSTER_SPAWN_MAX_RETRIES)
+        self.mesh_stats = {"mesh_tasks": 0, "waves": 0, "degraded": 0}
+        self.placement_stats = {"preferred": 0, "demoted": 0}
         for ei in range(n_executors):
             self._spawn_executor(ei)
         self.task_log: list = []        # (stage_op, executor_idx) per task
@@ -428,6 +642,26 @@ class MiniCluster:
 
     # -- pool management ----------------------------------------------------
     def _spawn_executor(self, ei: int, arm_faults: bool = True):
+        """Bring up slot `ei` with ONE bounded retry on a transient
+        socket/pipe bring-up failure (cluster.spawn.maxRetries): a flaky
+        handshake must not cost the slot — or, on the loss-recovery path,
+        the whole query — before a second attempt was even made. Retries
+        are visible as executor.spawn.retry events; they never charge the
+        executor a blacklist strike (nothing ran yet)."""
+        from spark_rapids_tpu.runtime import tracing
+        last = None
+        for attempt in range(self._spawn_retries + 1):
+            try:
+                return self._spawn_executor_once(ei, arm_faults)
+            except RuntimeError as e:
+                last = e
+                if attempt < self._spawn_retries:
+                    tracing.span_event("executor.spawn.retry", executor=ei,
+                                       attempt=attempt + 1,
+                                       error=str(e)[:200])
+        raise last
+
+    def _spawn_executor_once(self, ei: int, arm_faults: bool = True):
         from spark_rapids_tpu import config as CFG
         ctx = mp.get_context("spawn")
         parent, child = ctx.Pipe()
@@ -484,6 +718,15 @@ class MiniCluster:
         self._exec_ids[ei] = eid
         self._exec_failures[ei] = 0
         self._blacklist.discard(ei)
+        # mesh plane: the handshake reports the ACTUAL local mesh width
+        # (0 = none); a respawned slot attaches a fresh, trusted mesh —
+        # the dead incarnation's mesh generation died with it
+        self._mesh[ei] = hello.get("mesh", 0) or 0
+        self._mesh_ok[ei] = self._mesh[ei] >= 2
+        if self._mesh[ei]:
+            tracing.span_event("mesh.attach", executor=ei,
+                               devices=self._mesh[ei],
+                               generation=self._gen[ei])
 
     def _heal(self):
         """Restart the WHOLE pool — the LAST rung of the recovery ladder,
@@ -544,20 +787,34 @@ class MiniCluster:
 
     # -- loss recovery ------------------------------------------------------
     def _handle_executor_loss(self, ei, running, pending, busy,
-                              reason="channel", depth=0, done=None):
+                              reason="channel", depth=0, done=None,
+                              total=None):
         """The lineage-scoped recovery path: respawn the slot, invalidate
         exactly the map splits the dead peer hosted, re-run only those
         under a bumped epoch, and re-publish addresses. In-flight work on
         other executors keeps running; its replies are discarded if the
-        epoch moved underneath them."""
+        epoch moved underneath them. An in-flight MESH task on the dead
+        executor — a participant lost inside the collective (mesh_kill) or
+        wedged in it past the task deadline (mesh_hang) — is NOT retried as
+        a mesh task: its mesh generation is invalidated (mesh.detach) and
+        its lanes re-plan onto the per-split TCP path under a bumped epoch
+        (the degraded-mode fallback, counted in meshDegradedFallbacks)."""
         from spark_rapids_tpu.runtime import metrics as M
         from spark_rapids_tpu.runtime import tracing
         M.resilience_add(M.EXECUTORS_LOST)
         tracing.span_event("executor.lost", executor=ei,
                            generation=self._gen[ei], reason=reason)
+        if self._mesh[ei]:
+            tracing.span_event("mesh.detach", executor=ei,
+                               generation=self._gen[ei], reason=reason)
         run = running.pop(ei, None)
         if run is not None and (done is None or run.spec.idx not in done):
-            pending.appendleft(run.spec)
+            if run.spec.lanes is not None:
+                self._degrade_mesh_spec(run.spec, ei, pending, total,
+                                        reason=f"executor.lost:{reason}",
+                                        executor_dead=True)
+            else:
+                pending.appendleft(run.spec)
         try:
             self._conns[ei].close()
         except OSError:
@@ -627,6 +884,17 @@ class MiniCluster:
 
     def _build_task(self, spec: _TaskSpec) -> dict:
         from spark_rapids_tpu.runtime import tracing
+        if spec.lanes is not None:
+            # mesh map task: ship the UNPINNED subtree once; the executor
+            # pins a clone per lane (one lane per local mesh device)
+            plan = _clone_plan(spec.subtree)
+            self._stamp_epochs(plan)
+            return {"plan": plan, "splits": [],
+                    "mesh_lanes": [{"split": s, "pin": p}
+                                   for s, p in spec.lanes],
+                    "shuffle_id": spec.shuffle_id,
+                    "partitioner": spec.partitioner,
+                    "trace": tracing.current_trace_id()}
         if spec.pin is not None:
             plan = _pin_sources(_clone_plan(spec.subtree), spec.pin)
             splits = [0]
@@ -646,16 +914,62 @@ class MiniCluster:
                          busy, depth=0, done=None):
         """Evict one map attempt's blocks from a LIVE executor (speculation
         loser, stale-epoch or failed attempt that may have written partial
-        output); a dead executor's blocks died with its store."""
+        output); a dead executor's blocks died with its store. A mesh
+        task's attempt drops every lane's split."""
         try:
-            self._conns[ei].send({"op": "drop_map_output",
-                                  "shuffle_id": spec.shuffle_id,
-                                  "map_split": spec.split})
-            reply = self._conns[ei].recv()
-            assert reply.get("ok"), reply
+            for s in spec.splits_covered():
+                self._conns[ei].send({"op": "drop_map_output",
+                                      "shuffle_id": spec.shuffle_id,
+                                      "map_split": s})
+                reply = self._conns[ei].recv()
+                assert reply.get("ok"), reply
         except (BrokenPipeError, EOFError, OSError):
             self._handle_executor_loss(ei, running, pending, busy,
                                        depth=depth, done=done)
+
+    def _degrade_mesh_spec(self, spec: _TaskSpec, ei, pending, total,
+                           reason: str, executor_dead: bool,
+                           running=None, busy=frozenset(), depth=0,
+                           done=None):
+        """Degraded-mode fallback (the robustness core of the unified
+        plane): a mesh task that cannot run — or finish — on an executor's
+        local mesh is transparently re-planned as SINGLE-split TCP tasks
+        under a bumped map-output epoch, bit-identical to the healthy run.
+        No task-attempt strike is charged: degradation is capacity loss,
+        not task failure. When the executor survived (mesh shrank, chips
+        unavailable, collective error) its partial blocks are evicted
+        first and its mesh is distrusted for future groups; a dead
+        executor's blocks died with its store and its RESPAWN attaches a
+        fresh, trusted mesh."""
+        from spark_rapids_tpu.runtime import metrics as M
+        from spark_rapids_tpu.runtime import tracing
+        splits = spec.splits_covered()
+        M.resilience_add(M.MESH_DEGRADED_FALLBACKS)
+        self.mesh_stats["degraded"] += 1
+        tracing.span_event("mesh.degraded", executor=ei,
+                           shuffle=spec.shuffle_id, splits=len(splits),
+                           reason=reason)
+        if not executor_dead and ei is not None and ei >= 0:
+            if self._mesh_ok[ei]:
+                self._mesh_ok[ei] = False
+                tracing.span_event("mesh.detach", executor=ei,
+                                   generation=self._gen[ei],
+                                   reason="degraded")
+            self._drop_map_output(ei, spec, running if running is not None
+                                  else {}, pending, busy, depth=depth,
+                                  done=done)
+        # bump the epoch so an in-flight reply that read the pre-drop
+        # layout is discarded, then re-plan each lane as its own TCP task
+        self._tracker.invalidate_splits(spec.shuffle_id, splits)
+        st = self._tracker.state(spec.shuffle_id)
+        if total is not None:
+            total.discard(spec.idx)
+        for s in splits:
+            nspec = self._make_map_spec(
+                st, s, idx=("degraded", spec.shuffle_id, s, st.epoch))
+            if total is not None:
+                total.add(nspec.idx)
+            pending.append(nspec)
 
     def _charge_failure(self, ei: int, spec: _TaskSpec, reason: str,
                         err: str = ""):
@@ -676,6 +990,33 @@ class MiniCluster:
             tracing.span_event("executor.blacklisted", executor=ei,
                                failures=self._exec_failures[ei])
 
+    def _preferred_executor(self, spec: _TaskSpec, eligible):
+        """Movement-aware placement: the executor already holding the most
+        map-output bytes for this reduce partition (Theseus's
+        movement-optimized scheduling — the read becomes a local
+        block-store short-circuit instead of a TCP fetch). Spill-aware
+        demotion: an executor parking more than placement.maxLoadedBytes
+        of shuffle data is over its HBM/host budget proxy, and piling its
+        reduce work on top would only force disk spills — demote to
+        round-robin (placement.demoted)."""
+        from spark_rapids_tpu.runtime import tracing
+        by = self._tracker.bytes_by_executor(spec.read_sids, spec.pin)
+        if not by:
+            return None
+        best = max(sorted(by), key=lambda e: by[e])
+        if by[best] <= 0 or best not in eligible or best in spec.tried:
+            return None
+        load = self._tracker.executor_load(best)
+        if load > self._max_loaded_bytes:
+            self.placement_stats["demoted"] += 1
+            tracing.span_event("placement.demoted", executor=best,
+                               loaded_bytes=load,
+                               budget=self._max_loaded_bytes,
+                               reduce=spec.pin)
+            return None
+        self.placement_stats["preferred"] += 1
+        return best
+
     # -- the scheduler loop -------------------------------------------------
     def _run_tasks(self, specs: list, busy=frozenset(), depth: int = 0
                    ) -> dict:
@@ -693,6 +1034,8 @@ class MiniCluster:
         running: dict[int, _Running] = {}
         done: dict = {}
         durations: list = []
+        # MUTABLE: a degraded mesh task swaps its group idx for per-split
+        # idxs, so completion tracks whatever the plan degraded into
         total = {s.idx for s in specs}
 
         def dispatch(spec, speculative=False):
@@ -702,7 +1045,25 @@ class MiniCluster:
                         and ei not in self._blacklist
                         and self._procs[ei] is not None
                         and self._procs[ei].is_alive()}
-            ei = self._placement.pick(eligible, prefer_not=spec.tried)
+            preferred = None
+            if spec.lanes is not None:
+                # a mesh group may only land on a trusted mesh at least as
+                # wide as the group; when NO placeable executor still has
+                # one (all degraded/blacklisted), the group itself degrades
+                capable = {ei for ei in range(self.n_executors)
+                           if self._mesh_ok[ei]
+                           and self._mesh[ei] >= len(spec.lanes)
+                           and ei not in self._blacklist
+                           and self._procs[ei] is not None
+                           and self._procs[ei].is_alive()}
+                if not capable:
+                    return "degrade"
+                eligible &= capable
+            elif (self._movement_aware and spec.pin is not None
+                    and spec.read_sids):
+                preferred = self._preferred_executor(spec, eligible)
+            ei = self._placement.pick(eligible, prefer_not=spec.tried,
+                                      preferred=preferred)
             if ei is None:
                 return None
             task = self._build_task(spec)
@@ -712,11 +1073,13 @@ class MiniCluster:
                     {"op": spec.op, "task": cloudpickle.dumps(task)})
             except (BrokenPipeError, OSError):
                 self._handle_executor_loss(ei, running, pending, busy,
-                                           depth=depth, done=done)
+                                           depth=depth, done=done,
+                                           total=total)
                 return False
             running[ei] = _Running(spec, time.monotonic(), epochs,
                                    speculative, self._gen[ei])
-            self.task_log.append((spec.op, ei))
+            self.task_log.append(
+                (spec.op if spec.lanes is None else "map.mesh", ei))
             if len(self.task_log) > 4096:   # observability ring, not a ledger
                 del self.task_log[:-2048]
             return ei
@@ -725,6 +1088,16 @@ class MiniCluster:
             spec = run.spec
             if not reply.get("ok"):
                 err = reply.get("error") or ""
+                if reply.get("mesh_degraded") and spec.lanes is not None:
+                    # the executor is alive but its mesh is not (shrank,
+                    # chips unavailable, collective failed): transparent
+                    # re-plan onto the TCP path, no attempt strike
+                    reason = (err.strip().splitlines() or ["mesh"])[-1]
+                    self._degrade_mesh_spec(
+                        spec, ei, pending, total, reason=reason[-160:],
+                        executor_dead=False, running=running, busy=busy,
+                        depth=depth, done=done)
+                    return
                 if "TransportError" in err:
                     dead = [k for k, p in enumerate(self._procs)
                             if p is not None and not p.is_alive()]
@@ -786,8 +1159,14 @@ class MiniCluster:
             done[spec.idx] = reply
             durations.append(time.monotonic() - run.t0)
             if spec.op == "map":
-                self._tracker.register_map_output(spec.shuffle_id,
-                                                  spec.split, ei)
+                sizes = reply.get("split_sizes") or {}
+                for s in spec.splits_covered():
+                    self._tracker.register_map_output(spec.shuffle_id, s,
+                                                      ei, sizes.get(s))
+                if spec.lanes is not None:
+                    mesh = reply.get("mesh") or {}
+                    self.mesh_stats["mesh_tasks"] += 1
+                    self.mesh_stats["waves"] += mesh.get("waves", 0)
             if run.speculative:
                 M.resilience_add(M.SPECULATION_WON)
                 tracing.span_event("speculation.won", executor=ei,
@@ -802,7 +1181,8 @@ class MiniCluster:
                         and not self._procs[ei].is_alive()):
                     self._handle_executor_loss(ei, running, pending, busy,
                                                reason="heartbeat.expired",
-                                               depth=depth, done=done)
+                                               depth=depth, done=done,
+                                               total=total)
             # a nested recovery may have respawned a slot under an outer
             # in-flight task: its reply can never arrive on the new pipe
             for ei, run in list(running.items()):
@@ -811,12 +1191,20 @@ class MiniCluster:
                     if run.spec.idx not in done:
                         pending.appendleft(run.spec)
             # fill idle executors (a False dispatch respawned the slot it
-            # targeted, so retrying the same spec makes progress)
+            # targeted, so retrying the same spec makes progress; a
+            # "degrade" dispatch found NO placeable mesh executor left for
+            # the group — it re-plans per-split and the loop continues)
             while pending:
                 r = dispatch(pending[0])
                 if r is None:
                     break               # no idle eligible executor
                 if r is False:
+                    continue
+                if r == "degrade":
+                    spec = pending.popleft()
+                    self._degrade_mesh_spec(spec, -1, pending, total,
+                                            reason="no_mesh_executor",
+                                            executor_dead=True)
                     continue
                 pending.popleft()
             if not running:
@@ -845,14 +1233,19 @@ class MiniCluster:
                                                        busy,
                                                        reason="task.timeout",
                                                        depth=depth,
-                                                       done=done)
+                                                       done=done,
+                                                       total=total)
                 # speculation: duplicate stragglers on idle executors
                 if (self._speculation and depth == 0 and not pending
                         and running and durations):
                     med = statistics.median(durations)
                     for ei, run in list(running.items()):
                         if (run.speculative or run.spec.speculated
-                                or run.spec.idx in done):
+                                or run.spec.idx in done
+                                or run.spec.lanes is not None):
+                            # mesh groups are never speculated: a duplicate
+                            # group racing a straggler would double-write N
+                            # lanes' blocks for one slow chip
                             continue
                         if now - run.t0 <= self._speculation_mult * med:
                             continue
@@ -867,7 +1260,8 @@ class MiniCluster:
                     reply = conn.recv()
                 except (EOFError, OSError):
                     self._handle_executor_loss(ei, running, pending, busy,
-                                               depth=depth, done=done)
+                                               depth=depth, done=done,
+                                               total=total)
                     continue
                 run = running.pop(ei)
                 handle_reply(ei, run, reply)
@@ -958,11 +1352,49 @@ class MiniCluster:
         mode, splits = self._stage_shape(child)
         st = self._tracker.register_shuffle(sid, child, part, mode, splits)
         self._broadcast_ensure_shuffle(sid)
-        specs = [self._make_map_spec(st, s, i) for i, s in enumerate(splits)]
-        self._run_tasks(specs)
+        self._run_tasks(self._make_stage_specs(st))
         return NN.RemoteSourceNode(sid, child.output, part.num_partitions,
                                    [tuple(a) for a in self.addresses],
                                    epoch=self._tracker.epoch(sid))
+
+    def _mesh_group_width(self) -> int:
+        """Lane width for mesh map tasks: the NARROWEST trusted mesh among
+        placeable executors (groups must fit wherever they land); 0 when
+        the mesh plane is off or no trusted mesh remains."""
+        if not self._mesh_enabled:
+            return 0
+        widths = [self._mesh[ei] for ei in range(self.n_executors)
+                  if self._mesh_ok[ei] and ei not in self._blacklist]
+        return min(widths) if widths else 0
+
+    def _make_stage_specs(self, st: _ShuffleState) -> list:
+        """Task specs for one map stage. On the unified plane, a
+        hash-partitioned stage's splits are grouped into mesh tasks of up
+        to the local mesh width — one task drives M lanes on one
+        executor's chips, with inter-executor movement still riding the
+        TCP shuffle. Everything else (single/round-robin partitioners,
+        mesh plane off or fully degraded) keeps the per-split shape."""
+        from spark_rapids_tpu.shuffle import partitioning as SP
+        width = self._mesh_group_width()
+        if (width < 2 or len(st.splits) < 2
+                or not isinstance(st.partitioner, SP.HashPartitioner)):
+            return [self._make_map_spec(st, s, i)
+                    for i, s in enumerate(st.splits)]
+        specs = []
+        for gi in range(0, len(st.splits), width):
+            group = st.splits[gi:gi + width]
+            if len(group) == 1:
+                specs.append(self._make_map_spec(st, group[0],
+                                                 idx=("m", gi)))
+            else:
+                lanes = [(s, s if st.mode == "pinned" else None)
+                         for s in group]
+                specs.append(_TaskSpec(("m", gi), "map", st.subtree, None,
+                                       group[0],
+                                       shuffle_id=st.shuffle_id,
+                                       partitioner=st.partitioner,
+                                       lanes=lanes))
+        return specs
 
     def _stage_shape(self, subtree):
         """Task shape covering every partition of `subtree`.

@@ -4,7 +4,17 @@ Reference: RapidsShuffleIterator (RapidsShuffleInternalManagerBase.scala /
 RapidsShuffleClient.doFetch) — a reduce task's input iterator that fetches
 its partition's blocks from every mapper's block server. Here each fetch is
 the TcpTransport windowed/throttled protocol; blocks deserialize straight to
-device batches."""
+device batches.
+
+Movement-aware short-circuit (unified mesh-cluster plane): when one of the
+peer addresses IS this executor's own block server — which movement-aware
+placement (cluster/minicluster.PlacementPolicy preferred picks) arranges on
+purpose — the fetch reads the local ShuffleBlockStore directly instead of
+taking a TCP loop through its own server (the reference's
+RapidsCachingReader local-block path). The local read still runs inside the
+ShuffleFetchIterator ladder, so chaos checkpoints and cancellation behave
+identically, and block (map_split, seq) keys keep the canonical merge
+order."""
 
 from __future__ import annotations
 
@@ -12,6 +22,34 @@ from spark_rapids_tpu import config as CFG
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.exec.base import TpuExec, acquire_semaphore
 from spark_rapids_tpu.runtime import metrics as M
+
+_local_address: "tuple | None" = None
+
+
+def set_local_address(addr) -> None:
+    """Executor bring-up registers its own block-server address so fetches
+    addressed to self short-circuit into the local store."""
+    global _local_address
+    _local_address = tuple(addr) if addr is not None else None
+
+
+def local_address():
+    return _local_address
+
+
+class LocalStoreClient:
+    """Duck-typed ShuffleClient serving this process's own blocks straight
+    from the ShuffleBlockStore — no socket, no serialization round-trip.
+    Yields the same (map_split, seq)-keyed stream as the TCP client so the
+    union merge stays canonical."""
+
+    def fetch_blocks_with_keys(self, shuffle_id: int, reduce_id: int):
+        from spark_rapids_tpu.runtime import tracing
+        from spark_rapids_tpu.shuffle.manager import ShuffleBlockStore
+        tracing.span_event("fetch.local", shuffle=shuffle_id,
+                           reduce=reduce_id)
+        yield from ShuffleBlockStore.get().read_partition_with_keys(
+            shuffle_id, reduce_id)
 
 
 class RemoteFetchExec(TpuExec):
@@ -50,9 +88,17 @@ class RemoteFetchExec(TpuExec):
         # per-peer retry+backoff via the shuffle fetch ladder — peers hold
         # DISJOINT block sets here, so there is no failover, and a peer
         # that stays dead surfaces as TransportError for the driver's
-        # lineage-scoped recompute to classify
+        # lineage-scoped recompute to classify. The executor's OWN address
+        # short-circuits to the local block store (movement-aware
+        # placement schedules reducers onto their byte-dominant host
+        # precisely so this read is local)
+        short_circuit = (local_address()
+                         if self.conf.get(
+                             CFG.CLUSTER_PLACEMENT_MOVEMENT_AWARE)
+                         else None)
         factories = [
-            (lambda a=tuple(addr): TcpShuffleClient(a, bounce, throttle))
+            (lambda: LocalStoreClient()) if tuple(addr) == short_circuit
+            else (lambda a=tuple(addr): TcpShuffleClient(a, bounce, throttle))
             for addr in self.locations]
 
         def it():

@@ -188,7 +188,7 @@ RETRY_SPLIT_FLOOR_BYTES = conf(
 TEST_FAULTS = conf("spark.rapids.tpu.test.faults").doc(
     "Deterministic fault-injection spec 'kind:site:trigger,...' — kinds "
     "oom / splitoom / transport / error / exec_kill / hang / cancel / "
-    "slow / corrupt / leak; trigger COUNT, COUNT@SKIP or "
+    "slow / corrupt / leak / disk_full; trigger COUNT, COUNT@SKIP or "
     "pPROB; e.g. 'oom:joins.build:2,transport:fetch:1,"
     "cancel:pipeline.put.scan.decode:1' (grammar + site list in "
     "runtime/faults.py; pipeline.put/get sites fire whatever kind is "
@@ -508,6 +508,52 @@ CLUSTER_HEARTBEAT_TIMEOUT = conf(
     "expires a MiniCluster executor (expire_dead -> partial stage "
     "recompute); beats are recorded on every task reply and liveness scan"
 ).double_conf(60.0)
+
+CLUSTER_MESH_ENABLED = conf("spark.rapids.tpu.cluster.mesh.enabled").doc(
+    "Unified mesh-cluster plane: every MiniCluster executor brings up a "
+    "LOCAL device mesh (distributed/mesh.LocalMesh) and the driver groups a "
+    "hash-partitioned map stage's splits into mesh tasks of up to "
+    "devicesPerExecutor lanes — partition ids for all lanes are computed in "
+    "ONE jitted shard_map program over the executor's chips with the "
+    "map-output statistics all-reduced over ICI, while shuffle blocks still "
+    "cross executors over the TCP transport (N processes x M chips, the "
+    "reference's production shape). A mesh failure degrades transparently "
+    "to per-split TCP execution, bit-identical (docs/cluster.md)"
+).boolean_conf(False)
+
+CLUSTER_MESH_DEVICES = conf(
+    "spark.rapids.tpu.cluster.mesh.devicesPerExecutor").doc(
+    "Devices in each executor's local mesh (also the lane width of one mesh "
+    "map task); 0 uses every device visible to the executor process. "
+    "Executors report their ACTUAL attached width on the spawn handshake "
+    "(mesh.attach), and a mesh that comes up narrower than the group being "
+    "dispatched degrades that task to the per-split TCP path"
+).integer_conf(0)
+
+CLUSTER_PLACEMENT_MOVEMENT_AWARE = conf(
+    "spark.rapids.tpu.cluster.placement.movementAware").doc(
+    "Schedule a reduce task on the executor already holding the most "
+    "map-output bytes for its reduce partition (per-split sizes tracked by "
+    "the MapOutputTracker from every map reply), so the biggest input is a "
+    "local block-store read instead of a TCP fetch — Theseus's "
+    "movement-optimized placement. Falls back to seeded round-robin when "
+    "the preferred host is busy, blacklisted, dead, or over "
+    "placement.maxLoadedBytes").boolean_conf(True)
+
+CLUSTER_PLACEMENT_MAX_LOADED_BYTES = conf(
+    "spark.rapids.tpu.cluster.placement.maxLoadedBytes").doc(
+    "Spill-aware demotion threshold for movement-aware placement: when the "
+    "byte-dominant executor already parks more than this many shuffle bytes "
+    "(a proxy for its HBM+host spill budget), the preferred pick is DEMOTED "
+    "back to round-robin so reduce work does not pile onto a host that "
+    "would only spill it to disk (placement.demoted event)").bytes_conf("2g")
+
+CLUSTER_SPAWN_MAX_RETRIES = conf(
+    "spark.rapids.tpu.cluster.spawn.maxRetries").doc(
+    "Extra bring-up attempts a MiniCluster executor slot gets when the "
+    "spawn handshake fails on a transient socket/pipe error before the "
+    "driver gives up on the slot (executor.spawn.retry event per retry)"
+).integer_conf(1)
 
 SCHEDULER_MAX_CONCURRENT = conf("spark.rapids.tpu.scheduler.maxConcurrent").doc(
     "Queries the driver-side scheduler admits concurrently "

@@ -5,6 +5,8 @@ two-tier: the TCP transport (shuffle/transport.py) for cross-host DCN, and THIS
 package for intra-slice execution — whole query stages jitted over a
 jax.sharding.Mesh with XLA collectives (all_to_all) riding ICI."""
 
-from spark_rapids_tpu.distributed.mesh import MeshExecutor, encode_shards  # noqa: F401
+from spark_rapids_tpu.distributed.mesh import (  # noqa: F401
+    LocalMesh, MeshDegradedError, MeshExecutor, encode_shards,
+    put_stacked_shards)
 from spark_rapids_tpu.distributed.exchange import (  # noqa: F401
     MeshExchangeExec, mesh_devices, row_exchange)

@@ -33,7 +33,7 @@ from spark_rapids_tpu import config as C
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.columnar.vector import TpuColumnVector, bucket_capacity
-from spark_rapids_tpu.distributed.mesh import encode_shards
+from spark_rapids_tpu.distributed.mesh import encode_shards, put_stacked_shards
 from spark_rapids_tpu.exec.base import TpuExec, TaskContext
 from spark_rapids_tpu.expr.core import Col, EvalContext
 from spark_rapids_tpu.ops import hashing as H
@@ -243,16 +243,7 @@ class MeshExchangeExec(TpuExec):
 
         with self._partition_time.timed():
             step = self._build_program(schema, cap, global_dicts)
-            sharding = NamedSharding(self.mesh, P("data", None))
-            vals, masks = [], []
-            for ci in range(len(schema.fields)):
-                vals.append(jax.device_put(
-                    jnp.stack([s[0][ci].values for s in shards]), sharding))
-                masks.append(jax.device_put(
-                    jnp.stack([s[0][ci].validity for s in shards]), sharding))
-            nrows = jax.device_put(
-                jnp.asarray([s[1] for s in shards], jnp.int32),
-                NamedSharding(self.mesh, P("data")))
+            vals, masks, nrows = put_stacked_shards(self.mesh, shards)
             out = step(*vals, *masks, nrows)
 
         n_out = len(schema.fields)

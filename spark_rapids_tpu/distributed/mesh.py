@@ -22,6 +22,8 @@ transport between slices (SURVEY.md §5 distributed backend mapping)."""
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 import jax
@@ -102,6 +104,161 @@ def encode_shards(tables, schema: T.StructType, n: int):
                 for i, f in enumerate(schema)]
         shards.append((cols, 0))
     return shards, cap, global_dicts
+
+
+def put_stacked_shards(mesh: Mesh, shards):
+    """device_put every field of `shards` ([(cols, n_rows)] with one entry
+    per mesh device) stacked over the mesh's "data" axis. Returns
+    (vals, masks, nrows) ready to feed a shard_map program — the ingest
+    step shared by MeshExecutor.aggregate, MeshExchangeExec._run_exchange
+    and LocalMesh.partition_wave."""
+    sharding = NamedSharding(mesh, P("data", None))
+    vals, masks = [], []
+    for ci in range(len(shards[0][0])):
+        vals.append(jax.device_put(
+            jnp.stack([s[0][ci].values for s in shards]), sharding))
+        masks.append(jax.device_put(
+            jnp.stack([s[0][ci].validity for s in shards]), sharding))
+    nrows = jax.device_put(
+        jnp.asarray([s[1] for s in shards], jnp.int32),
+        NamedSharding(mesh, P("data")))
+    return vals, masks, nrows
+
+
+class MeshDegradedError(RuntimeError):
+    """The executor's local mesh is unavailable (fewer than 2 devices),
+    narrower than the task group being dispatched (mesh shrank), or failed
+    inside its collective region. The cluster driver treats a reply
+    carrying this as DEGRADATION, not task failure: the mesh task's splits
+    are transparently re-planned onto the per-split TCP-shuffle path under
+    a bumped map-output epoch — no task-attempt strike, bit-identical
+    results (cluster/minicluster.py)."""
+
+
+class LocalMesh:
+    """One MiniCluster executor's device mesh — the intra-process half of
+    the unified mesh-cluster plane (ROADMAP item 4: N processes x M chips).
+
+    A mesh map task carries up to `n` lanes (one map split each); per
+    partition wave, the Spark-exact murmur3 partition ids of EVERY lane's
+    current batch are computed in ONE jitted shard_map dispatch (lane =
+    shard), and the wave's per-reduce-partition row counts are all-reduced
+    over ICI with `lax.psum` — the map-output-statistics exchange. Block
+    CONTENT never rides the mesh here: each lane's batch is sliced with the
+    exact per-batch path (shuffle.partitioning.slice_into_partitions) and
+    parked in the TCP block store under the same (map_split, seq) keys, so
+    mesh-plane blocks are bit-identical with the TCP-only plane — which is
+    what makes the transparent mesh→TCP degraded fallback sound."""
+
+    _instance: "LocalMesh | None" = None
+    _ilock = threading.Lock()
+
+    def __init__(self, n_devices: int = 0):
+        devs = jax.devices()
+        n = len(devs) if n_devices <= 0 else min(n_devices, len(devs))
+        if n < 2:
+            raise MeshDegradedError(
+                f"local mesh unavailable: {len(devs)} visible device(s), "
+                f"{n_devices} requested")
+        self.n = n
+        self.mesh = Mesh(np.array(devs[:n]), ("data",))
+        self._steps: dict = {}
+
+    @classmethod
+    def get(cls, n_devices: int = 0) -> "LocalMesh":
+        with cls._ilock:
+            if cls._instance is None:
+                cls._instance = LocalMesh(n_devices)
+            return cls._instance
+
+    @classmethod
+    def reset(cls):
+        with cls._ilock:
+            cls._instance = None
+
+    def _pid_step(self, dtypes, cap: int, n_out: int):
+        """Jitted shard_map program keyed by (key dtypes, capacity, reduce
+        fan-out): per shard, murmur3 partition ids masked to a sentinel on
+        padding rows, plus the psum-reduced live row count per partition."""
+        key = (tuple(type(dt).__name__ for dt in dtypes), cap, n_out)
+        step = self._steps.get(key)
+        if step is not None:
+            return step
+        from spark_rapids_tpu.ops import hashing as H
+        from spark_rapids_tpu.shuffle.partitioning import murmur3_row_hash
+        nk = len(dtypes)
+
+        def shard_step(*flat):
+            vals = flat[:nk]
+            masks = flat[nk:2 * nk]
+            n_rows = flat[2 * nk][0]
+            cols = [Col(v[0], m[0], dt)
+                    for v, m, dt in zip(vals, masks, dtypes)]
+            h = murmur3_row_hash(cols, cap)
+            pids = H.pmod(h, n_out)
+            live = jnp.arange(cap, dtype=jnp.int32) < n_rows
+            pids = jnp.where(live, pids, jnp.int32(n_out))
+            counts = jnp.bincount(pids, length=n_out + 1)[:n_out]
+            return pids[None], jax.lax.psum(counts, "data")
+
+        spec = P("data", None)
+        shard_map = getattr(jax, "shard_map", None)
+        if shard_map is None:  # older jax
+            from jax.experimental.shard_map import shard_map
+        step = jax.jit(shard_map(
+            shard_step, mesh=self.mesh,
+            in_specs=tuple([spec] * (2 * nk) + [P("data")]),
+            out_specs=(spec, P())))
+        self._steps[key] = step
+        return step
+
+    @staticmethod
+    def _pad_col(col: Col, cap: int) -> Col:
+        n = col.values.shape[0]
+        if n >= cap:
+            return col
+        default = jnp.asarray(col.dtype.default_value(),
+                              dtype=col.values.dtype)
+        return Col(jnp.concatenate([col.values,
+                                    jnp.full((cap - n,), default)]),
+                   jnp.concatenate([col.validity,
+                                    jnp.zeros((cap - n,), jnp.bool_)]),
+                   col.dtype)
+
+    def partition_wave(self, batches: list, partitioner):
+        """One wave: `batches` holds each live lane's current batch (≤ n).
+        Returns ([pids per batch, each sliced to that batch's capacity],
+        wave_counts) where wave_counts is the psum-reduced live-row count
+        per reduce partition (None on the per-batch string fallback).
+        Lanes whose keys include string columns fall back to the per-batch
+        pid path: per-lane dictionaries cannot be trace-time constants of
+        one stacked program (docs/cluster.md)."""
+        if len(batches) > self.n:
+            raise MeshDegradedError(
+                f"mesh shrank: {self.n} device(s) < {len(batches)} lanes")
+        n_out = partitioner.num_partitions
+        keys_per_lane = []
+        for b in batches:
+            ctx = EvalContext.from_batch(b)
+            keys_per_lane.append([e.eval(ctx)
+                                  for e in partitioner.key_exprs])
+        if any(k.is_string for k in keys_per_lane[0]):
+            return [partitioner.part_ids(b) for b in batches], None
+        cap = max(b.capacity for b in batches)
+        dtypes = [k.dtype for k in keys_per_lane[0]]
+        shards = [([self._pad_col(k, cap) for k in keys], b.num_rows)
+                  for keys, b in zip(keys_per_lane, batches)]
+        while len(shards) < self.n:    # idle lanes: empty pad shards
+            shards.append((
+                [Col(jnp.full((cap,), dt.default_value(),
+                              dtype=dt.jnp_dtype),
+                     jnp.zeros((cap,), jnp.bool_), dt) for dt in dtypes],
+                0))
+        vals, masks, nrows = put_stacked_shards(self.mesh, shards)
+        pids, counts = self._pid_step(dtypes, cap, n_out)(
+            *vals, *masks, nrows)
+        return ([pids[d][:b.capacity] for d, b in enumerate(batches)],
+                np.asarray(counts))
 
 
 class MeshExecutor:
@@ -246,18 +403,7 @@ class MeshExecutor:
         shards, cap, _dicts = self._encode_shards(tables, schema)
         step = self._build_step(schema, group_exprs, agg_exprs, filter_expr,
                                 cap)
-
-        sharding = NamedSharding(self.mesh, P("data", None))
-        n_in = len(schema.fields)
-        vals, masks = [], []
-        for ci in range(n_in):
-            vals.append(jax.device_put(
-                jnp.stack([s[0][ci].values for s in shards]), sharding))
-            masks.append(jax.device_put(
-                jnp.stack([s[0][ci].validity for s in shards]), sharding))
-        nrows = jax.device_put(
-            jnp.asarray([s[1] for s in shards], jnp.int32),
-            NamedSharding(self.mesh, P("data")))
+        vals, masks, nrows = put_stacked_shards(self.mesh, shards)
         out = step(*vals, *masks, nrows)
 
         group_b = [bind_references(e, schema) for e in group_exprs]

@@ -64,6 +64,14 @@ KNOWN_EVENTS = frozenset({
     # speculative-duplicate outcomes
     "task.attempt", "executor.lost", "executor.blacklisted",
     "stage.recompute.partial", "speculation.won", "speculation.lost",
+    # unified mesh-cluster plane (cluster/minicluster.py): an executor's
+    # local mesh attaching on the spawn handshake, detaching on loss or
+    # degradation, a mesh task transparently re-planned onto the per-split
+    # TCP path, a transient spawn-handshake failure retried, movement-aware
+    # reduce placement demoted off an over-budget host, and a reduce-side
+    # fetch short-circuited to the executor's own block store
+    "mesh.attach", "mesh.detach", "mesh.degraded",
+    "executor.spawn.retry", "placement.demoted", "fetch.local",
     # pipelined executor queue edges (runtime/pipeline.py): a producer or
     # consumer blocked past the stall threshold, bounded per queue
     "pipeline.stall",

@@ -12,7 +12,7 @@ Spec grammar (comma-separated entries)::
 
     entry   := kind ":" site ":" trigger
     kind    := "oom" | "splitoom" | "transport" | "error" | "exec_kill"
-             | "hang" | "cancel" | "slow" | "corrupt"
+             | "hang" | "cancel" | "slow" | "corrupt" | "leak" | "disk_full"
     trigger := COUNT | COUNT "@" SKIP | "p" PROB
 
 ``oom`` raises a retryable runtime.retry.DeviceOomError, ``splitoom`` a
@@ -64,7 +64,19 @@ exactly one of N executors mid-task), and "cluster.map.begin" /
 "cluster.result.begin" (+ ".<idx>") once at task START — the site that
 still fires when a task's input produces zero batches; the driver disarms
 faults on respawned replacement executors so a COUNT trigger cannot
-re-fire forever. The query-serving endpoint (runtime/endpoint.py) checks
+re-fire forever. The unified mesh-cluster plane adds the mesh-collective
+sites "cluster.mesh.begin" (+ ".<idx>", once at mesh bring-up inside a
+mesh map task) and "cluster.mesh" (+ ".<idx>", per partition wave, INSIDE
+the jitted collective region) — the mesh_kill/mesh_hang chaos hooks:
+``exec_kill`` there dies mid-collective with partial blocks parked
+(driver: executor loss → degraded TCP re-plan under a bumped epoch),
+``hang`` there wedges the collective so ONLY the task deadline can
+surface it, and ``error`` there proves the transparent mesh→TCP
+degraded fallback without losing the process. ``disk_full`` raises a
+retryable runtime.retry.SpillCapacityError at the disk-spill writer
+("spill.write", runtime/memory.py) — the typed ENOSPC: it rides the OOM
+recovery ladder (spill elsewhere / split / retry) instead of escaping as
+a raw OSError. The query-serving endpoint (runtime/endpoint.py) checks
 "endpoint.accept" (connection admitted), "endpoint.recv" (request frame
 read) and "endpoint.send" (per result frame) via :func:`maybe_inject_any`
 — any armed kind fires at the wire — and "endpoint.corrupt" is a
@@ -86,7 +98,7 @@ _injected: list = []
 _tls = threading.local()
 
 _KINDS = ("oom", "splitoom", "transport", "error", "exec_kill", "hang",
-          "cancel", "slow", "corrupt", "leak")
+          "cancel", "slow", "corrupt", "leak", "disk_full")
 _ENTRY_RE = re.compile(
     r"^(?P<kind>[a-z_]+):(?P<site>[A-Za-z0-9_.\-]+):"
     r"(?:(?P<count>\d+)(?:@(?P<skip>\d+))?|p(?P<prob>0?\.\d+|1(?:\.0*)?))$")
@@ -216,12 +228,14 @@ def maybe_inject(kind: str, site: str) -> None:
 def maybe_inject_any(site: str) -> None:
     """Raise whatever fault is armed for `site`, regardless of kind — the
     pipeline queue put/get hooks use this so one chaos spec can drive any
-    fault class through a stage boundary. ("corrupt" and "leak" entries
-    stay silent here: corrupt only acts through maybe_corrupt's payload
-    sites, leak only through should_leak's release sites.)"""
+    fault class through a stage boundary. ("corrupt", "leak" and
+    "disk_full" entries stay silent here: corrupt only acts through
+    maybe_corrupt's payload sites, leak only through should_leak's release
+    sites, disk_full only at the spill-writer checkpoint.)"""
     if not _active:
         return
-    _select_and_fire(site, lambda k: k not in ("corrupt", "leak"))
+    _select_and_fire(site, lambda k: k not in ("corrupt", "leak",
+                                               "disk_full"))
 
 
 def should_leak(site: str) -> bool:
@@ -287,6 +301,10 @@ def _raise(kind: str, site: str):
     if kind == "transport":
         from spark_rapids_tpu.shuffle.transport import TransportError
         raise TransportError(f"[fault-injection] transport fault at {site}")
+    if kind == "disk_full":
+        from spark_rapids_tpu.runtime.retry import SpillCapacityError
+        raise SpillCapacityError(
+            f"[fault-injection] disk full (ENOSPC) at {site}", injected=True)
     if kind == "error":
         raise RuntimeError(f"[fault-injection] error at {site}")
     from spark_rapids_tpu.runtime.retry import DeviceOomError, SplitAndRetryOom

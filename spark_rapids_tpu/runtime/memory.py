@@ -38,7 +38,7 @@ from spark_rapids_tpu.runtime import eventlog as EL
 from spark_rapids_tpu.runtime import faults as F
 from spark_rapids_tpu.runtime import tracing as TR
 from spark_rapids_tpu.runtime.arm import LeakTracker
-from spark_rapids_tpu.runtime.retry import DeviceOomError
+from spark_rapids_tpu.runtime.retry import DeviceOomError, SpillCapacityError
 
 # -- spill priorities (reference SpillPriorities.scala:26) ---------------------
 # Lower value spills FIRST.
@@ -556,17 +556,39 @@ class BufferCatalog:
             from spark_rapids_tpu.runtime.checksum import block_checksum
             buf._crc = block_checksum(payload)
         payload = F.maybe_corrupt("spill.write", payload)
-        if self._direct_spill:
-            # GDS-analog batched aligned store (reference RapidsGdsStore)
-            buf._handle = self._get_direct_store().write(payload)
-            buf._path = None
-        else:
-            path = os.path.join(self._spill_dir_path(),
-                                f"buffer-{buf.buffer_id}.spill")
-            with open(path, "wb") as f:
-                f.write(payload)
-            buf._path = path
-            buf._handle = None
+        # disk-capacity checkpoint BEFORE any bytes land: the injected
+        # ENOSPC ("disk_full:spill.write:N") and a real ENOSPC from the
+        # writes below both surface as the typed, RETRYABLE
+        # SpillCapacityError — the buffer stays intact in its host tier and
+        # the OOM ladder (spill elsewhere / split / retry) absorbs it,
+        # instead of a raw OSError escaping the operator mid-spill
+        F.maybe_inject("disk_full", "spill.write")
+        try:
+            if self._direct_spill:
+                # GDS-analog batched aligned store (reference RapidsGdsStore)
+                buf._handle = self._get_direct_store().write(payload)
+                buf._path = None
+            else:
+                path = os.path.join(self._spill_dir_path(),
+                                    f"buffer-{buf.buffer_id}.spill")
+                try:
+                    with open(path, "wb") as f:
+                        f.write(payload)
+                except OSError:
+                    # a partial file must not survive to be unspilled later
+                    with contextlib.suppress(OSError):
+                        os.unlink(path)
+                    raise
+                buf._path = path
+                buf._handle = None
+        except OSError as e:
+            import errno
+            buf._crc = None
+            if e.errno == errno.ENOSPC:
+                raise SpillCapacityError(
+                    f"disk spill tier full writing buffer "
+                    f"{buf.buffer_id} ({len(payload)} B): {e}") from e
+            raise
         self.host_bytes -= hb.nbytes()
         self.spilled_to_disk_bytes += hb.nbytes()
         buf._disk_len = hb.nbytes()

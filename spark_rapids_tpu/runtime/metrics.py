@@ -78,6 +78,12 @@ STAGE_PARTIAL_RECOMPUTES = "stagePartialRecomputes"
 MAP_TASKS_RECOMPUTED = "mapTasksRecomputed"
 SPECULATION_WON = "speculationWon"
 SPECULATION_LOST = "speculationLost"
+# unified mesh-cluster plane (cluster/minicluster.py + distributed/mesh.py):
+# a mesh map task that could not run (or finish) on its executor's local
+# mesh and was transparently re-planned onto the per-split TCP-shuffle path
+# under a bumped epoch. Zero in every healthy run — rides the no-faults
+# all-zero gates like the rest of the recovery ladder
+MESH_DEGRADED_FALLBACKS = "meshDegradedFallbacks"
 # multi-tenant query lifecycle (runtime/scheduler.py): shed submissions,
 # cancelled/deadlined queries and fair-share demotions of a victim query's
 # device buffers during a peer's OOM recovery
@@ -99,6 +105,7 @@ RESILIENCE_METRICS = (NUM_OOM_RETRIES, NUM_OOM_SPLIT_RETRIES, OOM_SPILL_BYTES,
                       TASK_ATTEMPTS, EXECUTORS_LOST, EXECUTORS_BLACKLISTED,
                       STAGE_PARTIAL_RECOMPUTES, MAP_TASKS_RECOMPUTED,
                       SPECULATION_WON, SPECULATION_LOST,
+                      MESH_DEGRADED_FALLBACKS,
                       QUERIES_SHED, QUERIES_CANCELLED, QUERY_DEMOTIONS,
                       CLIENT_DISCONNECTS, MEMORY_LEAKS)
 

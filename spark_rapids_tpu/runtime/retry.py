@@ -80,7 +80,14 @@ class SplitAndRetryOom(DeviceOomError):
     unsplittable input it propagates immediately."""
 
 
-# -- checkpoint/restore (reference Retryable + withRestoreOnRetry) ------------
+class SpillCapacityError(DeviceOomError):
+    """The disk-spill tier ran out of capacity (ENOSPC from the spill
+    writer, or the injected ``disk_full`` fault). Typed and RETRYABLE: a
+    full disk mid-spill is memory pressure, not corruption — the with_retry
+    ladder responds exactly as it does to a device OOM (release this
+    attempt's buffers, spill what still fits elsewhere, split the input),
+    instead of letting a raw OSError escape the operator. Pickles
+    losslessly like its base so the serving endpoint can ship it typed."""
 
 @contextlib.contextmanager
 def with_restore_on_retry(*checkpointables):

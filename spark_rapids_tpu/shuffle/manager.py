@@ -148,6 +148,26 @@ class ShuffleBlockStore:
                 out.append(total)
             return out
 
+    def split_partition_sizes(self, shuffle_id: int, num_partitions: int,
+                              map_split: int) -> list:
+        """Bytes per reduce partition written by ONE map split (seq tuples
+        lead with the map split — the MiniCluster writer contract). This is
+        the per-split map-output statistic the driver's MapOutputTracker
+        records for movement-aware reduce placement: after a partial
+        recompute moves a split to another executor, the tracker re-adds
+        these sizes under the new host and placement follows the bytes."""
+        with self._lock:
+            parts = self._blocks.get(shuffle_id, {})
+            out = []
+            for pid in range(num_partitions):
+                total = 0
+                for seq, _, b in parts.get(pid, ()):
+                    if (isinstance(seq, tuple) and seq
+                            and seq[0] == map_split):
+                        total += len(b) if isinstance(b, bytes) else b.size
+                out.append(total)
+            return out
+
     def drop_map_output(self, shuffle_id: int, map_split: int) -> int:
         """Discard every block one map split wrote across all reduce
         partitions of `shuffle_id` (seq tuples lead with the map split —

@@ -14,10 +14,19 @@ asserts the recovery contract end to end:
 Must be a real script file, not a `python -` heredoc: the spawn-based
 executor bootstrap re-imports __main__, and stdin cannot be re-imported.
 
+`--mesh` switches both runs onto the UNIFIED MESH-CLUSTER PLANE
+(spark.rapids.tpu.cluster.mesh.enabled): every executor drives a local
+device mesh and map stages run as mesh task groups. The gate then also
+asserts the mesh-specific recovery contract: the clean run used mesh tasks
+with ZERO resilience noise (meshDegradedFallbacks included), and the
+killed run — a participant SIGKILLed inside the mesh collective — degraded
+transparently to the per-split TCP path (meshDegradedFallbacks >= 1,
+`mesh.degraded` in the event log) while staying bit-identical.
+
 Usage:
   python tools/cluster_chaos.py --data-dir /tmp/tpch_sf0.01 \
       [--eventlog-dir DIR] [--query q18] [--scale 0.01] [--executors 3] \
-      [--fault exec_kill:cluster.result:1]
+      [--fault exec_kill:cluster.result:1] [--mesh] [--mesh-devices 4]
 """
 
 from __future__ import annotations
@@ -42,8 +51,19 @@ def main(argv=None) -> int:
     # stage's outputs exist by then, so recovery must rebuild exactly the
     # dead peer's splits; the task-start site fires even for a query whose
     # final stage emits zero batches (q18 at sf0.01 returns 0 rows)
-    p.add_argument("--fault", default="exec_kill:cluster.result.begin.0:1")
+    p.add_argument("--fault", default=None)
+    p.add_argument("--mesh", action="store_true",
+                   help="run both collections on the unified mesh-cluster "
+                        "plane and assert the degraded-fallback contract")
+    p.add_argument("--mesh-devices", type=int, default=4)
     args = p.parse_args(argv)
+    if args.fault is None:
+        # mesh default: SIGKILL executor 1 at its SECOND mesh task's
+        # bring-up (@1 skips the first) — inside the collective region,
+        # after earlier stages parked outputs, so the loss exercises both
+        # the degraded re-plan AND the lineage-scoped recompute
+        args.fault = ("exec_kill:cluster.mesh.begin.1:1@1" if args.mesh
+                      else "exec_kill:cluster.result.begin.0:1")
 
     sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
     import jax
@@ -63,13 +83,33 @@ def main(argv=None) -> int:
     trace_dir = args.trace_dir or args.eventlog_dir
     if trace_dir:
         settings["spark.rapids.tpu.trace.dir"] = trace_dir
+    if args.mesh:
+        settings["spark.rapids.tpu.cluster.mesh.enabled"] = "true"
+        settings["spark.rapids.tpu.cluster.mesh.devicesPerExecutor"] = \
+            str(args.mesh_devices)
     spark = TpuSession(settings)
     dfs = tpch.load(spark, paths, files_per_partition=4)
     df = tpch.QUERIES[args.query](dfs)
 
-    with MiniCluster(n_executors=args.executors, platform="cpu") as c:
+    clean_base = M.resilience_snapshot()
+    clean_conf = RapidsConf(settings) if args.mesh else None
+    with MiniCluster(n_executors=args.executors, conf=clean_conf,
+                     platform="cpu") as c:
         clean = c.collect(df)
-        clean_map_tasks = sum(1 for op, _ in c.task_log if op == "map")
+        clean_map_tasks = sum(1 for op, _ in c.task_log
+                              if op in ("map", "map.mesh"))
+        clean_mesh = dict(c.mesh_stats)
+    clean_delta = {k: v - clean_base[k]
+                   for k, v in M.resilience_snapshot().items()
+                   if v - clean_base[k]}
+    # the healthy plane (mesh or not) must be invisible to every recovery
+    # ladder — meshDegradedFallbacks rides this all-zero assert too
+    assert not clean_delta, \
+        f"no-faults clean run left resilience noise: {clean_delta}"
+    if args.mesh:
+        assert clean_mesh["mesh_tasks"] >= 1, \
+            f"mesh plane enabled but no mesh task ran: {clean_mesh}"
+        assert clean_mesh["degraded"] == 0, clean_mesh
 
     base = M.resilience_snapshot()
     conf = RapidsConf(dict(settings,
@@ -80,6 +120,7 @@ def main(argv=None) -> int:
         orig = c._heal
         c._heal = lambda: (heals.append(1), orig())[-1]
         chaos = c.collect(df)
+        chaos_mesh = dict(c.mesh_stats)
     delta = {k: v - base[k]
              for k, v in M.resilience_snapshot().items() if v - base[k]}
     eventlog.shutdown()
@@ -89,12 +130,21 @@ def main(argv=None) -> int:
     assert not heals, \
         f"whole-query heal fired; partial recovery expected ({delta})"
     assert delta.get("executorsLost", 0) >= 1, delta
-    assert delta.get("stagePartialRecomputes", 0) >= 1, delta
-    assert 1 <= delta.get("mapTasksRecomputed", 0) < clean_map_tasks, \
-        (delta, clean_map_tasks)
+    if args.mesh:
+        # a participant killed inside the collective must have degraded
+        # its group onto the TCP path, and earlier stages' lost splits
+        # must have recomputed lineage-scoped, not whole-query
+        assert delta.get("meshDegradedFallbacks", 0) >= 1, delta
+        assert chaos_mesh["degraded"] >= 1, chaos_mesh
+        assert delta.get("mapTasksRecomputed", 0) >= 1, delta
+    else:
+        assert delta.get("stagePartialRecomputes", 0) >= 1, delta
+        assert 1 <= delta.get("mapTasksRecomputed", 0) < clean_map_tasks, \
+            (delta, clean_map_tasks)
     print(f"cluster chaos ok [{args.query}, {args.executors} executors, "
-          f"fault {args.fault}]: {delta} "
-          f"(clean run map tasks: {clean_map_tasks})")
+          f"mesh={args.mesh}, fault {args.fault}]: {delta} "
+          f"(clean run map tasks: {clean_map_tasks}, "
+          f"mesh stats: {clean_mesh})")
     return 0
 
 
