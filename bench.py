@@ -252,6 +252,17 @@ def child_main():
                         per_query[name]["top_alloc_site"] = max(
                             msites.items(),
                             key=lambda kv: kv[1].get("peak_bytes", 0))[0]
+                # statistics plane (runtime/stats.py): how far the admission
+                # estimate was from the hot rep's observed peak, and whether
+                # the plan-history store primed it — trajectories of
+                # estimate_error show the history store learning a workload
+                stats = qm.stats or {}
+                if stats.get("estimate_error") is not None:
+                    per_query[name]["estimate_error"] = \
+                        stats["estimate_error"]
+                if stats:
+                    per_query[name]["history_hit"] = \
+                        bool(stats.get("history_hit"))
 
     # resilience counters (retry/split/fetch-failover totals across the
     # whole ladder run): with faults disabled these must be zero — a later

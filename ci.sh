@@ -49,6 +49,10 @@ for q, pq in d["queries"].items():
     # high-water mark and the allocation site that owned it
     assert pq.get("peak_device_bytes", 0) > 0, (q, pq)
     assert pq.get("top_alloc_site"), (q, pq)
+    # statistics plane: every per-query entry carries the footprint
+    # estimate error (no history dir here, so hits must be False)
+    assert pq.get("estimate_error") is not None, (q, pq)
+    assert pq.get("history_hit") is False, (q, pq)
 print("bench-child dry-run ok:", d["metric"], d["value"], d["unit"],
       "spread", d["spread"], "resilience", d["resilience"],
       "hot-rep compiles",
@@ -441,6 +445,77 @@ for e in cs:
 print("memory counter lanes ok:", len(cs), "samples")
 PYEOF
 rm -rf "$obs_dir"
+
+echo "== statistics plane: plan-history estimate-error gate =="
+# q18 twice through a FRESH history dir: run 1 is a cold-start miss whose
+# admission estimate comes from the static heuristic; run 2 must hit the
+# plan-history store (estimate == run 1's observed device peak), cutting
+# the estimate error at least in half WITHOUT changing results (a warm run
+# pipelines fewer batches than a compile-stalled cold one, so its peak sits
+# below the recorded one — the estimate stays conservative, not exact).
+# The footprint floor is
+# dropped to 64k because at SF 0.01 the default 16MB floor would dominate
+# both runs' estimates and mask the history path entirely.
+stats_dir=$(mktemp -d)
+JAX_PLATFORMS=cpu SRT_STATS_DIR="$stats_dir" python - <<'PYEOF'
+import jax; jax.config.update("jax_platforms", "cpu")
+import os
+import spark_rapids_tpu  # noqa: F401  (enables x64)
+from spark_rapids_tpu.benchmarks import tpch
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.runtime import eventlog, metrics
+
+base = os.environ["SRT_STATS_DIR"]
+paths = tpch.generate(0.01, "/tmp/tpch_ci_sf0.01")
+
+def run(tag):
+    spark = TpuSession({
+        "spark.rapids.tpu.eventLog.dir": os.path.join(base, tag),
+        "spark.rapids.tpu.stats.history.dir": os.path.join(base, "hist"),
+        "spark.rapids.tpu.scheduler.footprint.floorBytes": "64k",
+    })
+    dfs = tpch.load(spark, paths, files_per_partition=4)
+    # hash-repartition lineitem so q18's big aggregate runs behind a real
+    # shuffle: per-reduce-partition sizes feed the skew table the read-out
+    # gate asserts on (hash on l_orderkey is deliberately uneven)
+    dfs["lineitem"] = dfs["lineitem"].repartition(4, "l_orderkey")
+    out = tpch.q18(dfs).collect()
+    return out, spark.last_query_metrics().stats
+
+out1, st1 = run("run1")
+out2, st2 = run("run2")
+eventlog.shutdown()
+assert st1["history_hit"] is False and st2["history_hit"] is True, (st1, st2)
+e1, e2 = st1["estimate_error"], st2["estimate_error"]
+# acceptance: run 2's absolute error at most half of run 1's (tiny epsilon
+# for peak jitter between a cold and a compile-warm run)
+assert e2 <= e1 / 2 + 1e-3, (e1, e2)
+assert out1.to_pydict() == out2.to_pydict(), "history changed query results"
+res = metrics.resilience_snapshot()
+assert not any(res.values()), res
+print(f"stats gate ok: estimate error run1={e1:.3f} -> run2={e2:.3f}, "
+      f"history_hit={st2['history_hit']}, results identical, "
+      f"resilience all-zero")
+PYEOF
+stats_log=$(ls "$stats_dir"/run2/events-*.jsonl | head -1)
+# the plan.stats records must pass the event-log schema (validate_record
+# runs inside the profiler's load), and the stats read-out must print the
+# per-node ledger and name q18's skewed reduce partition
+python tools/profiler.py stats "$stats_log" > /tmp/stats_readout.txt
+grep -q "node ledger" /tmp/stats_readout.txt
+grep -q "at partition" /tmp/stats_readout.txt
+python tools/profiler.py stats "$stats_log" --json > /tmp/stats_readout.json
+python -c '
+import json
+d = json.load(open("/tmp/stats_readout.json"))
+assert d["violations"] == [], d["violations"][:5]
+qs = [q for q in d["queries"] if q["stats"]]
+assert qs and qs[-1]["stats"]["history_hit"] is True, "no history hit"
+assert qs[-1]["shuffles"], "no shuffle skew rows for q18"
+print("stats read-out gate ok:", len(qs), "queries with plan.stats,",
+      len(qs[-1]["shuffles"]), "shuffle skew rows")
+'
+rm -rf "$stats_dir"
 
 echo "== api coverage gate (0 missing vs reference GpuOverrides) =="
 python tools/api_validation.py 0 0

@@ -1336,6 +1336,8 @@ class MiniCluster:
 
     def _run_map_stage(self, exchange):
         from spark_rapids_tpu.plan import nodes as NN
+        from spark_rapids_tpu.runtime import eventlog as EL
+        from spark_rapids_tpu.runtime import metrics as M
         from spark_rapids_tpu.shuffle import partitioning as SP
         child = exchange.child
         if exchange.partitioning == "hash":
@@ -1353,6 +1355,24 @@ class MiniCluster:
         st = self._tracker.register_shuffle(sid, child, part, mode, splits)
         self._broadcast_ensure_shuffle(sid)
         self._run_tasks(self._make_stage_specs(st))
+        # stats plane: per-reduce-partition byte totals from the tracker's
+        # split sizes, recorded into the ambient query's collector so the
+        # shuffle-skew read-outs (plan.stats, profiler) cover mesh-plane map
+        # stages too — not only the local exchange path
+        if st.split_sizes:
+            totals = [0] * part.num_partitions
+            for split_sizes in st.split_sizes.values():
+                for rid, b in enumerate(split_sizes[:part.num_partitions]):
+                    totals[rid] += int(b)
+            collector = M.current_collector()
+            if collector is not None:
+                collector.record_shuffle_sizes(None, sid, totals)
+            if EL.enabled():
+                # driver-side skew record: executors ran the map tasks, so
+                # without this the DRIVER's log has no partition sizes and
+                # the profiler's skew table goes blind on cluster runs
+                EL.emit("stage.map.end", shuffle=sid,
+                        partition_sizes=totals)
         return NN.RemoteSourceNode(sid, child.output, part.num_partitions,
                                    [tuple(a) for a in self.addresses],
                                    epoch=self._tracker.epoch(sid))

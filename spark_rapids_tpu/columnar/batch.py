@@ -64,9 +64,13 @@ class ColumnarBatch:
     # -- host interop -------------------------------------------------------
     def to_arrow(self):
         import pyarrow as pa
+        from spark_rapids_tpu.runtime import metrics as _M
         n = self.num_rows
         names = (self.schema.names if self.schema is not None
                  else [f"c{i}" for i in range(self.num_cols)])
+        # stats-plane transfer ledger: device bytes crossing to the host at
+        # this boundary, attributed to the innermost operator frame
+        _M.stats_add("d2hBytes", self.device_memory_size())
         # from_arrays, not a dict: Spark allows duplicate output column names
         return pa.Table.from_arrays(
             [col.to_arrow(n) for col in self.columns], names=list(names))
@@ -74,7 +78,10 @@ class ColumnarBatch:
     @staticmethod
     def from_arrow(table, schema: T.StructType | None = None) -> "ColumnarBatch":
         from spark_rapids_tpu.columnar import arrow as ai
-        return ai.table_to_device(table, schema=schema)
+        from spark_rapids_tpu.runtime import metrics as _M
+        batch = ai.table_to_device(table, schema=schema)
+        _M.stats_add("h2dBytes", batch.device_memory_size())
+        return batch
 
     @staticmethod
     def empty(schema: T.StructType) -> "ColumnarBatch":

@@ -591,6 +591,20 @@ SCHEDULER_QUERY_DEADLINE = conf(
     "leaking threads, device buffers or semaphore permits. <=0 disables"
 ).double_conf(0.0)
 
+SCHEDULER_FOOTPRINT_FLOOR = conf(
+    "spark.rapids.tpu.scheduler.footprint.floorBytes").doc(
+    "Lower bound on the admission footprint estimate "
+    "(scheduler.estimate_footprint): no query books less HBM than this, so "
+    "tiny plans cannot stampede admission. Applies to both the static "
+    "heuristic and history-based estimates").bytes_conf("16m")
+
+SCHEDULER_FOOTPRINT_DECODE_EXPANSION = conf(
+    "spark.rapids.tpu.scheduler.footprint.decodeExpansion").doc(
+    "Multiplier from on-disk scan bytes to estimated decoded device bytes "
+    "in the static (cold-start) footprint heuristic; only used when the "
+    "plan-shape history store has no observation for the plan's "
+    "fingerprint").double_conf(3.0)
+
 TRANSPORT_MAX_FRAME_BYTES = conf(
     "spark.rapids.tpu.shuffle.transport.maxFrameBytes").doc(
     "Upper bound on one length-prefixed wire frame (shuffle data plane AND "
@@ -675,6 +689,25 @@ EVENT_LOG_KEEP_FILES = conf("spark.rapids.tpu.eventLog.keepFiles").doc(
     "Rotated event-log files retained per active file (the keep-N of the "
     "size-based rotation; older rotations are deleted). Only meaningful "
     "when eventLog.maxBytes > 0").integer_conf(4)
+
+STATS_HISTORY_DIR = conf("spark.rapids.tpu.stats.history.dir").doc(
+    "Directory of the on-disk plan-shape history store "
+    "(runtime/history.py): per-fingerprint observed peak device bytes, "
+    "cardinalities and shuffle skew, written at query end and read at "
+    "submit so scheduler.estimate_footprint books HBM from observation "
+    "instead of the static decode heuristic. Structural: process-global, "
+    "applied only by a session that sets it explicitly. Empty disables"
+).string_conf(None)
+
+STATS_HISTORY_MAX_SHAPES = conf("spark.rapids.tpu.stats.history.maxShapes").doc(
+    "Plan-shape fingerprints retained in the history store; beyond it the "
+    "least-recently-updated shapes are evicted on write, bounding the file "
+    "for long-lived serving sessions").integer_conf(256)
+
+STATS_HISTORY_ENABLED = conf("spark.rapids.tpu.stats.history.enabled").doc(
+    "Consult and update the plan-shape history store (when history.dir is "
+    "set). false keeps the static footprint heuristic while the stats "
+    "plane still captures per-node observations").boolean_conf(True)
 
 TRACE_DIR = conf("spark.rapids.tpu.trace.dir").doc(
     "Directory for per-process JSONL span files (runtime/tracing.py): every "

@@ -79,6 +79,9 @@ class HashAggregateExec(TpuExec):
                           else bind_references(prefilter, bind_to))
         self._agg_time = self.metrics.metric(M.AGG_TIME, M.MODERATE)
         self._concat_time = self.metrics.metric(M.CONCAT_TIME, M.MODERATE)
+        # observed input cardinality (stats plane): with output rows this
+        # gives the aggregation's reduction factor per node
+        self._in_rows = self.metrics.metric(M.NUM_INPUT_ROWS, M.ESSENTIAL)
 
     @property
     def output(self):
@@ -491,6 +494,7 @@ class HashAggregateExec(TpuExec):
 
             acc = None
             for batch in self.child.execute_partition(split):
+                self._in_rows.add_lazy(batch.lazy_num_rows)
                 # acquire only once data is ready for device work — acquiring before
                 # pulling the child would hold a permit across a blocking shuffle map
                 # stage and deadlock the semaphore (reference RapidsShuffleIterator

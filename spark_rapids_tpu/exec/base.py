@@ -147,6 +147,10 @@ class TpuExec:
                     return
             self._out_batches.add(1)
             self._out_rows.add_lazy(b.lazy_num_rows)
+            # stats plane: observed output bytes per node (array metadata
+            # only — device_memory_size never syncs the device)
+            M.stats_add("outputBytes", b.device_memory_size(),
+                        node=self._node_id)
             if EL.enabled():
                 # batch lifecycle event; never force a device sync for the
                 # row count — a still-lazy count is logged as null

@@ -136,11 +136,17 @@ class ShuffleExchangeExec(TpuExec):
         else:
             with ThreadPoolExecutor(max_workers=nthreads) as pool:
                 list(pool.map(map_task, range(self.child.num_partitions)))
+        # per-reduce-partition byte sizes: the shuffle-skew input of the
+        # stats plane (bounded: one int per reduce partition). Recorded into
+        # the query's collector unconditionally so skew survives into
+        # plan.stats/history even with the event log off or the map stage run
+        # by the mesh plane
+        sizes = ShuffleBlockStore.get().partition_sizes(
+            sid, self.partitioner.num_partitions)
+        collector = M.current_collector()
+        if collector is not None:
+            collector.record_shuffle_sizes(self._node_id, sid, sizes)
         if EL.enabled():
-            # per-reduce-partition byte sizes: the profiler's shuffle-skew
-            # input (bounded: one int per reduce partition)
-            sizes = ShuffleBlockStore.get().partition_sizes(
-                sid, self.partitioner.num_partitions)
             EL.emit("stage.map.end", node=self._node_id,
                     shuffle=sid,
                     partition_sizes=[int(s) for s in sizes])

@@ -666,7 +666,11 @@ class HashJoinExec(TpuExec):
                     R.with_restore_on_retry(core):
                 return b, core.probe_batch(b)
 
+        # observed stream-side input cardinality (stats plane): out rows /
+        # probe rows is the join's selectivity read-out
+        in_rows = self.metrics.metric(M.NUM_INPUT_ROWS, M.ESSENTIAL)
         for stream_batch in stream_child.execute_partition(split):
+            in_rows.add_lazy(stream_batch.lazy_num_rows)
             acquire_semaphore(self.metrics)
             for piece, (build_perm, lo, hi, counts, total) in R.with_retry(
                     [stream_batch], probe, conf=self.conf,

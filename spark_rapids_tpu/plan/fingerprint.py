@@ -1,0 +1,98 @@
+"""Canonical plan-shape fingerprints.
+
+A fingerprint identifies a plan by its SHAPE — operator tree, output dtypes,
+key columns and expression structure — with literal VALUES normalized out, so
+`WHERE qty > 300` and `WHERE qty > 314` share one fingerprint while a changed
+dtype, key column or operator does not. This is the reuse key of the stats
+plane: the PlanHistoryStore (runtime/history.py) records observed peak device
+bytes / cardinalities / skew per fingerprint, and scheduler.estimate_footprint
+reads them back on the next submission of the same shape. It is deliberately
+the same notion of identity a compiled-stage cache or shared plan cache needs:
+anything that changes the traced program must change the fingerprint, and
+nothing else should.
+
+Contrast with runtime/fuse.py's `expr_key`, which keys literal values too
+(a literal is baked into the traced XLA program as a constant); the
+fingerprint keys only the literal's TYPE, because observed statistics
+generalize across literal values but compiled programs do not.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr import core as E
+
+# data-carrying fields normalized to shape-only markers: partition payloads
+# (ScanNode tables) and scan paths vary per dataset without changing the plan
+# shape; cached stats remain keyed to the shape, with the static heuristic
+# still blended in as the data-size guard
+_DATA_FIELDS = ("partitions", "paths")
+
+
+def _norm_expr(e) -> tuple:
+    if isinstance(e, E.Literal):
+        return ("lit", repr(e.dtype))
+    parts = [type(e).__qualname__]
+    d = vars(e) if hasattr(e, "__dict__") else {
+        s: getattr(e, s, None) for s in getattr(e, "__slots__", ())}
+    for k in sorted(d):
+        if k == "children":
+            continue
+        parts.append((k, _norm(d[k])))
+    parts.append(tuple(_norm_expr(c) for c in getattr(e, "children", ())))
+    return tuple(parts)
+
+
+def _norm(v):
+    if isinstance(v, E.Expression):
+        return _norm_expr(v)
+    if isinstance(v, T.StructType):
+        return ("schema", tuple((f.name, repr(f.data_type), bool(f.nullable))
+                                for f in v))
+    if isinstance(v, T.DataType):
+        return repr(v)
+    if isinstance(v, (list, tuple)):
+        return tuple(_norm(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((str(k), _norm(x)) for k, x in v.items()))
+    if isinstance(v, (str, int, float, bool, bytes, type(None))):
+        return (type(v).__name__, v)
+    if isinstance(v, type):
+        return ("class", v.__qualname__)
+    if callable(v):
+        return ("fn", getattr(v, "__qualname__", "?"))
+    return ("obj", type(v).__qualname__)
+
+
+def _norm_node(node) -> tuple:
+    parts = [node.name()]
+    d = vars(node) if hasattr(node, "__dict__") else {}
+    for k in sorted(d):
+        if k == "children":
+            continue
+        if k.lstrip("_") in _DATA_FIELDS:
+            parts.append((k, ("data",)))
+            continue
+        parts.append((k, _norm(d[k])))
+    try:
+        out = node.output
+        parts.append(("out", tuple((f.name, repr(f.data_type)) for f in out)))
+    except Exception:
+        pass
+    parts.append(tuple(_norm_node(c) for c in node.children))
+    return tuple(parts)
+
+
+def plan_shape(plan) -> tuple:
+    """Canonical nested-tuple shape of a PlanNode tree (debug/test surface —
+    fingerprint() is the production key)."""
+    return _norm_node(plan)
+
+
+def plan_fingerprint(plan) -> str:
+    """Stable hex fingerprint of a plan's shape. Equal across runs and
+    processes for equal shapes (sha256 over the canonical repr)."""
+    canon = repr(plan_shape(plan)).encode()
+    return hashlib.sha256(canon).hexdigest()[:16]

@@ -190,8 +190,16 @@ def render_stats(include_histograms: bool = True) -> str:
     fam("srt_fuse_total", "counter")
     for k in ("traces", "dispatches"):
         lines.append(f'srt_fuse_total{{kind="{k}"}} {fuse.get(k, 0)}')
+    # stats plane: plan-shape history occupancy + submit-time hit counter
+    gauges = M.gauges_snapshot()
+    fam("srt_history_shapes", "gauge")
+    lines.append(f"srt_history_shapes {gauges.get('history.shapes', 0)}")
+    fam("srt_history_hit_total", "counter")
+    lines.append(f"srt_history_hit_total {counters.get('history.hit', 0)}")
     fam("srt_gauge", "gauge")
-    for k, v in sorted(M.gauges_snapshot().items()):
+    for k, v in sorted(gauges.items()):
+        if k == "history.shapes":   # already exposed as its own family
+            continue
         lines.append(f'srt_gauge{{name="{k}"}} {v}')
 
     if include_histograms:

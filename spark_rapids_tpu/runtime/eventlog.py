@@ -84,6 +84,11 @@ KNOWN_EVENTS = frozenset({
     # live bytes), full allocation-site heap snapshots at query end, and
     # end-of-query leak detections with their per-site breakdown
     "memory.watermark", "memory.snapshot", "memory.leak",
+    # runtime statistics plane (runtime/stats.py): one end-of-query record
+    # carrying the plan fingerprint, footprint estimate vs observed peak,
+    # the per-node cardinality/dispatch/transfer ledger and per-shuffle
+    # reduce-partition sizes with skew summaries
+    "plan.stats",
 })
 
 # events that only make sense inside a query's dynamic extent; the profiler
@@ -93,6 +98,7 @@ QUERY_SCOPED_EVENTS = frozenset({
     "stage.map.start", "stage.map.end",
     "query.queued", "query.admitted", "query.shed",
     "query.cancelled", "query.deadline", "query.demoted",
+    "plan.stats",
 })
 
 _lock = threading.Lock()

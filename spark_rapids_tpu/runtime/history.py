@@ -1,0 +1,157 @@
+"""On-disk plan-shape history store.
+
+Persists observed per-shape statistics (peak device bytes, output
+cardinalities, shuffle skew) keyed by the canonical plan fingerprint
+(plan/fingerprint.py), written at query end and read at submit. This is the
+memory that turns the admission controller's static x3 decode heuristic into
+an observed-footprint estimate on the second run of a shape — the Spark CBO
+analog, except the statistics come from the runtime itself rather than
+ANALYZE TABLE.
+
+File format: one JSON document `plan_history.json` in the configured
+directory — {"version": 1, "shapes": {fp: entry}} where entry carries
+runs / peak_device_bytes / out_rows / per-node rows / skew / updated (a
+monotonically increasing sequence, not wall clock, so LRU eviction is
+deterministic). Writes are read-merge-replace via os.replace so concurrent
+sessions sharing a directory never observe a torn file. A corrupt or
+unreadable file degrades to an empty store with one warning — history is an
+optimization, never a query-failure source.
+
+Process-global wiring follows the eventlog pattern: a session that sets
+`stats.history.dir` explicitly calls configure(); estimate_footprint and the
+end-of-query writer use get().
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+
+log = logging.getLogger("spark_rapids_tpu.history")
+
+_FILE = "plan_history.json"
+_VERSION = 1
+
+
+class PlanHistoryStore:
+    """Read/merge/write access to one history directory. Thread-safe; every
+    write re-reads the file so sessions sharing a directory compose."""
+
+    def __init__(self, directory: str, max_shapes: int = 256):
+        self.directory = directory
+        self.max_shapes = max(int(max_shapes), 1)
+        self.path = os.path.join(directory, _FILE)
+        self._lock = threading.Lock()
+        self._warned = False
+        os.makedirs(directory, exist_ok=True)
+
+    # -- file I/O -------------------------------------------------------------
+
+    def _load(self) -> dict:
+        """{fp: entry}; corrupt/missing file -> {} (warn once, never raise)."""
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+            shapes = doc.get("shapes")
+            if not isinstance(shapes, dict):
+                raise ValueError("missing shapes map")
+            return shapes
+        except FileNotFoundError:
+            return {}
+        except (OSError, ValueError, TypeError, KeyError) as e:
+            if not self._warned:
+                self._warned = True
+                log.warning(
+                    "plan history %s unreadable (%s); starting empty — "
+                    "footprint estimates fall back to the static heuristic",
+                    self.path, e)
+            return {}
+
+    def _store(self, shapes: dict) -> None:
+        if len(shapes) > self.max_shapes:
+            victims = sorted(shapes, key=lambda fp: shapes[fp].get("updated", 0))
+            for fp in victims[:len(shapes) - self.max_shapes]:
+                del shapes[fp]
+        tmp = self.path + ".tmp"
+        doc = {"version": _VERSION, "shapes": shapes}
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, separators=(",", ":"))
+        os.replace(tmp, self.path)
+
+    # -- API ------------------------------------------------------------------
+
+    def lookup(self, fingerprint: str) -> dict | None:
+        with self._lock:
+            entry = self._load().get(fingerprint)
+        return dict(entry) if isinstance(entry, dict) else None
+
+    def record(self, fingerprint: str, obs: dict) -> dict:
+        """Merge one query's observations into the shape's entry and persist.
+        `obs` carries peak_device_bytes / out_rows / nodes / shuffle_skew /
+        estimate_bytes for this run; peaks keep the max, cardinalities keep
+        the latest. Returns the merged entry. Never raises."""
+        try:
+            with self._lock:
+                shapes = self._load()
+                entry = shapes.get(fingerprint)
+                if not isinstance(entry, dict):
+                    entry = {"runs": 0}
+                entry["runs"] = int(entry.get("runs", 0)) + 1
+                peak = int(obs.get("peak_device_bytes") or 0)
+                if peak:
+                    entry["peak_device_bytes"] = max(
+                        peak, int(entry.get("peak_device_bytes", 0)))
+                for k in ("out_rows", "nodes", "shuffle_skew",
+                          "estimate_bytes"):
+                    if obs.get(k) is not None:
+                        entry[k] = obs[k]
+                entry["updated"] = 1 + max(
+                    (int(e.get("updated", 0)) for e in shapes.values()),
+                    default=0)
+                shapes[fingerprint] = entry
+                self._store(shapes)
+                self._publish_gauges(len(shapes))
+                return dict(entry)
+        except OSError as e:
+            if not self._warned:
+                self._warned = True
+                log.warning("plan history %s not writable (%s); observations "
+                            "for this shape are dropped", self.path, e)
+            return dict(obs)
+
+    def shape_count(self) -> int:
+        with self._lock:
+            return len(self._load())
+
+    def _publish_gauges(self, n: int) -> None:
+        from spark_rapids_tpu.runtime import metrics as M
+        M.set_gauge("history.shapes", n)
+
+
+# -- process-global instance (eventlog-style explicit-switch wiring) ----------
+
+_ilock = threading.Lock()
+_instance: PlanHistoryStore | None = None
+
+
+def configure(directory: str | None, max_shapes: int = 256) -> None:
+    global _instance
+    with _ilock:
+        if not directory:
+            _instance = None
+            return
+        if (_instance is not None and _instance.directory == directory
+                and _instance.max_shapes == max(int(max_shapes), 1)):
+            return
+        _instance = PlanHistoryStore(directory, max_shapes)
+    _instance._publish_gauges(_instance.shape_count())
+
+
+def get() -> PlanHistoryStore | None:
+    return _instance
+
+
+def shutdown() -> None:
+    configure(None)

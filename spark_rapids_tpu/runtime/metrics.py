@@ -394,8 +394,32 @@ def histogram_percentiles(name: str, qs=(0.5, 0.95, 0.99)) -> dict | None:
 def compile_add(kind: str, v: int = 1) -> None:
     c = current_collector()
     if c is not None:
+        nid = current_node()
         with c._compile_lock:
             c._compile_local[kind] = c._compile_local.get(kind, 0) + v
+            # per-node mirror: the innermost attribution frame on this thread
+            # is the operator whose kernel compiled/dispatched, which makes
+            # the fusion gate (dispatches per batch on a chain) measurable
+            # per chain instead of per process
+            if nid is not None:
+                d = c._node_stats.setdefault(nid, {})
+                d[kind] = d.get(kind, 0) + v
+
+
+def stats_add(key: str, v, node: int | None = None) -> None:
+    """Accumulate one observed-statistics counter into the ambient query's
+    stats ledger, attributed to `node` (default: the innermost node_frame on
+    this thread; no frame -> query-level). Always on — a dict update under a
+    lock, the same cost class as the memory accounting — so the stats plane
+    does not depend on the metrics level."""
+    c = current_collector()
+    if c is None:
+        return
+    nid = node if node is not None else current_node()
+    with c._compile_lock:
+        d = (c._node_stats.setdefault(nid, {}) if nid is not None
+             else c._query_stats)
+        d[key] = d.get(key, 0) + v
 
 
 # -- query-scoped collection ---------------------------------------------------
@@ -504,6 +528,19 @@ class QueryMetricsCollector:
         # query shows compiles == 0 here while dispatches == O(batches))
         self._compile_lock = threading.Lock()
         self._compile_local = {"compiles": 0, "dispatches": 0}
+        # observed-statistics ledger (runtime/stats.py reads it): per-node
+        # counters fed by stats_add/compile_add (output bytes, h2d/d2h
+        # transfer bytes, per-node compiles/dispatches, input rows) plus
+        # query-level counters for increments with no ambient node frame
+        self._node_stats: dict[int, dict] = {}
+        self._query_stats: dict = {}
+        # per-shuffle reduce-partition byte sizes recorded by the map stage
+        # (exchange/mesh), independent of the event log being enabled
+        self._shuffle_stats: list[dict] = []
+        # admission footprint info ({estimate, static, history_hit,
+        # fingerprint, ...}) set at submit; plan.stats payload set at finish
+        self.footprint: dict | None = None
+        self.stats: dict | None = None
         # cooperative cancellation (runtime/scheduler.py): the session's
         # action sets the query's CancelToken here so every thread that
         # re-enters this collector's scope can reach it
@@ -546,6 +583,27 @@ class QueryMetricsCollector:
         query (runtime/fuse.py mirrors them here via compile_add)."""
         with self._compile_lock:
             return dict(self._compile_local)
+
+    def node_stats(self) -> dict:
+        """{node_id: {stat: value}} snapshot of the observed-stats ledger."""
+        with self._compile_lock:
+            return {nid: dict(d) for nid, d in self._node_stats.items()}
+
+    def query_stats(self) -> dict:
+        with self._compile_lock:
+            return dict(self._query_stats)
+
+    def record_shuffle_sizes(self, node_id, shuffle_id, sizes) -> None:
+        """Per-reduce-partition byte sizes observed at map-stage completion
+        (the MapOutputTracker read-out); one entry per completed map stage."""
+        with self._compile_lock:
+            self._shuffle_stats.append({
+                "node": node_id, "shuffle": int(shuffle_id),
+                "partition_sizes": [int(s) for s in sizes]})
+
+    def shuffle_stats(self) -> list:
+        with self._compile_lock:
+            return [dict(e) for e in self._shuffle_stats]
 
     def _walk(self, node, parent_id, depth, visit):
         """Duck-typed hybrid-tree walk (no imports of exec/plan here): device

@@ -208,8 +208,10 @@ def check_cancel() -> None:
 # footprint estimation (admission input)
 # ---------------------------------------------------------------------------
 
-# decoded columns are larger than their parquet/orc encoding; 3x is the
-# round-number expansion BASELINE.md's scan measurements showed for TPC-H
+# defaults when no conf reaches the estimator; the knobs are
+# scheduler.footprint.{decodeExpansion,floorBytes} (config.py). 3x is the
+# round-number decode expansion BASELINE.md's scan measurements showed for
+# TPC-H
 _DECODE_EXPANSION = 3.0
 # every pipeline breaker (join build / agg / sort / exchange) holds an extra
 # working set of roughly one batch stream alongside the scan
@@ -217,14 +219,16 @@ _BREAKER_FACTOR = 0.5
 _MIN_FOOTPRINT = 16 << 20
 
 
-def estimate_footprint(plan, conf=None) -> int:
-    """Estimated device-memory footprint of one query, from scan stats +
-    plan shape: sum of on-disk scan bytes x decode expansion, scaled by
-    (1 + 0.5 x breaker count) for join-build/agg/sort/exchange working
-    sets, floored at 16MB (a scanless plan still stages batches). The
-    estimate feeds admission only — the strict HBM budget + OOM ladder
-    remain the hard enforcement, so a wrong estimate degrades fairness,
-    never safety."""
+def _static_footprint(plan, conf=None) -> int:
+    """The cold-start heuristic: sum of on-disk scan bytes x decode
+    expansion, scaled by (1 + 0.5 x breaker count) for
+    join-build/agg/sort/exchange working sets, floored (a scanless plan
+    still stages batches)."""
+    from spark_rapids_tpu import config as CFG
+    expansion = (conf.get(CFG.SCHEDULER_FOOTPRINT_DECODE_EXPANSION)
+                 if conf is not None else _DECODE_EXPANSION)
+    floor = (conf.get(CFG.SCHEDULER_FOOTPRINT_FLOOR)
+             if conf is not None else _MIN_FOOTPRINT)
     scan_bytes = 0
     breakers = 0
     seen = set()
@@ -247,8 +251,55 @@ def estimate_footprint(plan, conf=None) -> int:
                     except OSError:
                         pass
         stack.extend(getattr(node, "children", []) or [])
-    est = int(scan_bytes * _DECODE_EXPANSION * (1 + _BREAKER_FACTOR * breakers))
-    return max(est, _MIN_FOOTPRINT)
+    est = int(scan_bytes * expansion * (1 + _BREAKER_FACTOR * breakers))
+    return max(est, int(floor))
+
+
+def estimate_footprint_ex(plan, conf=None) -> dict:
+    """Estimated device-memory footprint of one query plus its provenance:
+    {estimate, static, history_hit, fingerprint, prior}. When the plan-shape
+    history store (runtime/history.py) holds an observed peak for this
+    plan's fingerprint, the observation IS the estimate (floored) — observed
+    beats modeled; the static heuristic remains the cold-start fallback.
+    The estimate feeds admission only — the strict HBM budget + OOM ladder
+    remain the hard enforcement, so a wrong estimate degrades fairness,
+    never safety."""
+    from spark_rapids_tpu import config as CFG
+    static = _static_footprint(plan, conf)
+    out = {"estimate": static, "static": static, "history_hit": False,
+           "fingerprint": None, "prior": None}
+    try:
+        from spark_rapids_tpu.plan.fingerprint import plan_fingerprint
+        out["fingerprint"] = plan_fingerprint(plan)
+    except Exception:   # noqa: BLE001 — fingerprint is advisory, never fatal
+        return out
+    enabled = conf is None or conf.get(CFG.STATS_HISTORY_ENABLED)
+    if not enabled:
+        return out
+    from spark_rapids_tpu.runtime import history as H
+    store = H.get()
+    if store is None:
+        return out
+    try:
+        prior = store.lookup(out["fingerprint"])
+    except Exception:   # noqa: BLE001 — history is advisory, never fatal
+        return out
+    if prior is None:
+        return out
+    out["prior"] = prior
+    peak = int(prior.get("peak_device_bytes") or 0)
+    if peak > 0:
+        floor = (conf.get(CFG.SCHEDULER_FOOTPRINT_FLOOR)
+                 if conf is not None else _MIN_FOOTPRINT)
+        out["estimate"] = max(peak, int(floor))
+        out["history_hit"] = True
+        M.counter_add("history.hit")
+    return out
+
+
+def estimate_footprint(plan, conf=None) -> int:
+    """int facade over estimate_footprint_ex (existing call sites/tests)."""
+    return estimate_footprint_ex(plan, conf)["estimate"]
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,251 @@
+"""Runtime statistics plane: per-query observed-stats aggregation.
+
+The capture side lives where the data flows — exec/base.wrap_output (output
+rows/batches/bytes), columnar/batch (host<->device transfer bytes),
+runtime/fuse via metrics.compile_add (per-node compiles/dispatches),
+exec/exchange + the mesh map stages (per-reduce-partition byte sizes). This
+module is the read-out: it merges the collector's metric snapshots with the
+stats ledger into one per-node table, derives selectivities and shuffle skew,
+builds the `plan.stats` event payload, writes the plan-shape history entry at
+query end, and renders `explain(stats=True)` (observed vs estimated rows per
+node).
+
+Everything here runs once per query at finish — per-batch cost stays in the
+capture hooks, which are dict increments under the collector lock.
+"""
+
+from __future__ import annotations
+
+from spark_rapids_tpu.runtime import metrics as M
+
+# stats-ledger keys (capture hooks write these via metrics.stats_add)
+OUTPUT_BYTES = "outputBytes"       # device bytes produced (wrap_output)
+H2D_BYTES = "h2dBytes"             # host->device upload bytes (from_arrow)
+D2H_BYTES = "d2hBytes"             # device->host bytes (to_arrow)
+
+# history/payload node lists are bounded: a pathological plan cannot grow the
+# event record or history file without bound
+MAX_NODES = 64
+
+_ERROR_BOUNDS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0)
+
+
+def estimate_error_histogram() -> "M.Histogram":
+    """Process-wide histogram of |estimate - observed peak| / observed peak
+    per finished device query (the admission-accuracy read-out on STATS)."""
+    return M.histogram("footprint.estimate.error", _ERROR_BOUNDS)
+
+
+def node_table(collector) -> list:
+    """Per-node observed statistics in plan-tree preorder: metric snapshot
+    rows/batches merged with the stats ledger (bytes, transfers, per-node
+    compiles/dispatches) plus derived selectivity (out rows / sum of metered
+    child out rows)."""
+    summaries = collector.node_summaries()
+    ledger = collector.node_stats()
+    entries = []
+    for s in summaries:
+        m = s.get("metrics") or {}
+        led = ledger.get(s["id"], {}) if s["id"] is not None else {}
+        e = {
+            "id": s["id"],
+            "name": s["name"],
+            "args": s["args"],
+            "parent": s["parent"],
+            "depth": s["depth"],
+            "rows": m.get(M.NUM_OUTPUT_ROWS),
+            "batches": m.get(M.NUM_OUTPUT_BATCHES),
+            "in_rows": m.get(M.NUM_INPUT_ROWS),
+            "output_bytes": led.get(OUTPUT_BYTES),
+            "h2d_bytes": led.get(H2D_BYTES),
+            "d2h_bytes": led.get(D2H_BYTES),
+            "compiles": led.get("compiles"),
+            "dispatches": led.get("dispatches"),
+        }
+        entries.append(e)
+    # selectivity from the tree itself: children identified by parent id
+    rows_by_id = {e["id"]: e["rows"] for e in entries if e["id"] is not None}
+    kids: dict = {}
+    for e in entries:
+        if e["parent"] is not None and e["id"] is not None:
+            kids.setdefault(e["parent"], []).append(e["id"])
+    for e in entries:
+        src = e["in_rows"]
+        if src is None:
+            metered = [rows_by_id[c] for c in kids.get(e["id"], ())
+                       if rows_by_id.get(c) is not None]
+            src = sum(metered) if metered else None
+        if src and e["rows"] is not None:
+            e["selectivity"] = round(e["rows"] / src, 6)
+        else:
+            e["selectivity"] = None
+    return entries
+
+
+def skew_summary(sizes) -> dict | None:
+    """Reduce-partition skew: which partition is largest and by how much vs
+    the mean of non-empty partitions (ratio 1.0 == perfectly even)."""
+    sizes = [int(s) for s in sizes]
+    total = sum(sizes)
+    if not sizes or total <= 0:
+        return None
+    mean = total / len(sizes)
+    mx = max(sizes)
+    return {"partitions": len(sizes), "total_bytes": total,
+            "max_partition": sizes.index(mx), "max_bytes": mx,
+            "mean_bytes": int(mean), "skew_ratio": round(mx / mean, 3)}
+
+
+def _shuffles(collector) -> list:
+    out = []
+    for e in collector.shuffle_stats():
+        entry = dict(e)
+        sk = skew_summary(e.get("partition_sizes") or ())
+        if sk:
+            entry.update(sk)
+        out.append(entry)
+    return out
+
+
+def _root_rows(entries) -> int | None:
+    for e in entries:   # preorder: first metered node is the plan root's
+        if e["rows"] is not None:    # device side (collect() row count)
+            return int(e["rows"])
+    return None
+
+
+def plan_stats_payload(collector) -> dict:
+    """The plan.stats event-log record body (also session.last_query stats)."""
+    fp = collector.footprint or {}
+    entries = node_table(collector)
+    peak = (collector.memory or {}).get("peak_device_bytes")
+    estimate = fp.get("estimate")
+    err = None
+    if peak and estimate is not None:
+        err = round(abs(int(estimate) - int(peak)) / int(peak), 6)
+    nodes = []
+    for e in entries[:MAX_NODES]:
+        n = {k: e[k] for k in ("id", "name", "rows", "batches", "selectivity",
+                               "output_bytes", "h2d_bytes", "d2h_bytes",
+                               "compiles", "dispatches")
+             if e[k] is not None or k in ("id", "name", "rows")}
+        nodes.append(n)
+    return {
+        "fingerprint": fp.get("fingerprint"),
+        "estimate_bytes": estimate,
+        "static_estimate_bytes": fp.get("static"),
+        "history_hit": bool(fp.get("history_hit")),
+        "estimate_error": err,
+        "peak_device_bytes": peak,
+        "out_rows": _root_rows(entries),
+        "nodes": nodes,
+        "shuffles": _shuffles(collector),
+    }
+
+
+def finish_query(collector, conf=None) -> dict:
+    """End-of-action stats epilogue: build the plan.stats payload, record the
+    shape into the history store (when configured + enabled), and publish the
+    estimate-error/history telemetry. Never raises — the stats plane must not
+    turn a finished query into a failure."""
+    try:
+        payload = plan_stats_payload(collector)
+        collector.stats = payload
+        if payload["estimate_error"] is not None:
+            estimate_error_histogram().observe(payload["estimate_error"])
+        if _history_enabled(conf) and payload["fingerprint"]:
+            from spark_rapids_tpu.runtime import history as H
+            store = H.get()
+            if store is not None:
+                worst = max((s.get("skew_ratio", 0) for s in
+                             payload["shuffles"]), default=None)
+                store.record(payload["fingerprint"], {
+                    "peak_device_bytes": payload["peak_device_bytes"],
+                    "estimate_bytes": payload["estimate_bytes"],
+                    "out_rows": payload["out_rows"],
+                    "nodes": [{"name": n["name"], "rows": n.get("rows")}
+                              for n in payload["nodes"]],
+                    "shuffle_skew": worst,
+                })
+        return payload
+    except Exception:   # noqa: BLE001
+        import logging
+        logging.getLogger("spark_rapids_tpu.stats").warning(
+            "stats epilogue failed", exc_info=True)
+        return collector.stats or {}
+
+
+def _history_enabled(conf) -> bool:
+    if conf is None:
+        return True   # caller already gated; store presence decides
+    from spark_rapids_tpu import config as CFG
+    return bool(conf.get(CFG.STATS_HISTORY_ENABLED))
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def annotated_stats_plan(collector) -> str:
+    """The explain(stats=True) rendering: the executed tree with observed vs
+    estimated rows per node (estimates from the shape's history entry seen at
+    submit; '-' on a cold shape), selectivity and the per-node
+    dispatch/transfer ledger."""
+    fp = collector.footprint or {}
+    prior_nodes = (fp.get("prior") or {}).get("nodes") or []
+    entries = node_table(collector)
+    # match history rows to this run's metered nodes positionally (ids are
+    # assigned in conversion order, deterministic for an equal shape)
+    metered = [e for e in entries if e["id"] is not None]
+    est_by_id = {}
+    for i, e in enumerate(metered):
+        if i < len(prior_nodes) and prior_nodes[i].get("name") == e["name"]:
+            est_by_id[e["id"]] = prior_nodes[i].get("rows")
+    head = [f"Query {collector.query_id} stats"
+            + (f" [{collector.description}]" if collector.description else "")]
+    if fp:
+        peak = (collector.memory or {}).get("peak_device_bytes")
+        head.append(
+            f"  footprint: estimate={_fmt_bytes(fp.get('estimate'))} "
+            f"observed_peak={_fmt_bytes(peak)} "
+            f"history_hit={bool(fp.get('history_hit'))} "
+            f"fingerprint={fp.get('fingerprint') or '-'}")
+    lines = head
+    for e in entries:
+        pad = "  " * e["depth"]
+        line = f"{pad}*{e['name']}"
+        if e["id"] is None:
+            lines.append(line)
+            continue
+        est = est_by_id.get(e["id"])
+        bits = [f"id={e['id']}",
+                f"rows={e['rows'] if e['rows'] is not None else '-'}",
+                f"est={est if est is not None else '-'}"]
+        if e["selectivity"] is not None:
+            bits.append(f"sel={e['selectivity']:.4f}")
+        if e["dispatches"]:
+            bits.append(f"dispatches={e['dispatches']}")
+        if e["compiles"]:
+            bits.append(f"compiles={e['compiles']}")
+        if e["output_bytes"]:
+            bits.append(f"out={_fmt_bytes(e['output_bytes'])}")
+        if e["h2d_bytes"]:
+            bits.append(f"h2d={_fmt_bytes(e['h2d_bytes'])}")
+        if e["d2h_bytes"]:
+            bits.append(f"d2h={_fmt_bytes(e['d2h_bytes'])}")
+        lines.append(line + "  [" + ", ".join(bits) + "]")
+    for s in _shuffles(collector):
+        if "skew_ratio" in s:
+            lines.append(
+                f"  shuffle {s['shuffle']} (node {s['node']}): "
+                f"{s['partitions']} partitions, total="
+                f"{_fmt_bytes(s['total_bytes'])}, max=p{s['max_partition']} "
+                f"{_fmt_bytes(s['max_bytes'])} (skew x{s['skew_ratio']})")
+    return "\n".join(lines)

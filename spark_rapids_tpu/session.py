@@ -265,18 +265,24 @@ class DataFrame:
     def columns(self) -> list:
         return [f.name for f in self._plan.output]
 
-    def explain(self, all_nodes: bool = True, metrics: bool = False) -> str:
+    def explain(self, all_nodes: bool = True, metrics: bool = False,
+                stats: bool = False) -> str:
         from spark_rapids_tpu.plan.overrides import explain_plan
-        if metrics:
+        if metrics or stats:
             # SQL-UI analog: the executed plan tree annotated per node with
             # its metric snapshot — requires a completed action on this frame
             c = self._last_collector
             if c is None:
                 return ("<no completed action on this DataFrame — run "
                         "collect()/count()/write first for "
-                        "explain(metrics=True)>\n"
+                        f"explain({'stats' if stats else 'metrics'}=True)>\n"
                         + explain_plan(self._plan, self.session.conf,
                                        all_nodes))
+            if stats:
+                # stats plane: observed vs estimated rows per node plus the
+                # per-node dispatch/transfer ledger and shuffle skew
+                from spark_rapids_tpu.runtime import stats as STATS
+                return STATS.annotated_stats_plan(c)
             return c.annotated_plan()
         return explain_plan(self._plan, self.session.conf, all_nodes)
 
@@ -334,9 +340,14 @@ class DataFrame:
             collector.set_root(hybrid)
             try:
                 queue_timeout = conf.get(CFG.SCHEDULER_QUEUE_TIMEOUT)
+                # admission footprint: per-shape observed history when the
+                # store has seen this plan's fingerprint, else the static
+                # scan-bytes heuristic (stats plane; provenance kept on the
+                # collector for plan.stats / bench / explain(stats=True))
+                collector.footprint = SCHED.estimate_footprint_ex(plan, conf)
                 sched.submit(
                     collector.query_id,
-                    SCHED.estimate_footprint(plan),
+                    collector.footprint["estimate"],
                     priority=priority,
                     token=token,
                     timeout_s=queue_timeout if queue_timeout > 0 else None,
@@ -387,6 +398,11 @@ class DataFrame:
                     sched.release(collector.query_id)
         collector.finish()
         observe_latency()
+        # stats epilogue: build the per-node observed-stats payload, fold
+        # this run into the plan-shape history store, publish the
+        # estimate-error histogram (never raises)
+        from spark_rapids_tpu.runtime import stats as STATS
+        stats_payload = STATS.finish_query(collector, conf)
         compile_m = collector.compile_metrics()
         EL.emit("query.end", query=collector.query_id,
                 description=collector.description,
@@ -395,7 +411,12 @@ class DataFrame:
                 dispatches=compile_m["dispatches"],
                 resilience=collector.query_resilience(),
                 memory=collector.memory,
+                estimate_bytes=stats_payload.get("estimate_bytes"),
+                history_hit=stats_payload.get("history_hit"),
+                estimate_error=stats_payload.get("estimate_error"),
                 nodes=collector.node_summaries())
+        if EL.enabled():
+            EL.emit("plan.stats", query=collector.query_id, **stats_payload)
         return out
 
     def collect(self) -> pa.Table:
@@ -737,6 +758,18 @@ class TpuSession:
             MEM.set_profile_options(
                 self.conf.get(CFG.MEMORY_WATERMARK_INTERVAL),
                 self.conf.get(CFG.MEMORY_PROFILE_TOPK))
+        # plan-shape history store (stats plane, runtime/history.py):
+        # process-global like the switches above — only an EXPLICIT setting
+        # opens (or closes, when set empty) the store
+        if any(k.key in self.conf.settings for k in (
+                CFG.STATS_HISTORY_DIR, CFG.STATS_HISTORY_MAX_SHAPES)):
+            from spark_rapids_tpu.runtime import history as HIST
+            hdir = self.conf.get(CFG.STATS_HISTORY_DIR)
+            if hdir:
+                HIST.configure(hdir,
+                               self.conf.get(CFG.STATS_HISTORY_MAX_SHAPES))
+            else:
+                HIST.shutdown()
         # multi-tenant query scheduler (runtime/scheduler.py): STRUCTURAL
         # knobs (concurrency, queue depth, aging) are process-global like
         # the switches above — only an EXPLICIT setting reconfigures the

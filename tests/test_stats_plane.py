@@ -1,0 +1,329 @@
+"""Runtime statistics plane: plan-shape fingerprints, the on-disk plan
+history store, history-primed footprint estimates and the stats read-outs.
+
+Covers: fingerprint stability (literal values normalized out, dtypes and
+group keys kept), history round-trip across two sessions through the same
+directory (run 2 hits, estimate error shrinks, results bit-identical),
+corrupt/empty history files degrading to the static estimate with a warning
+— never a query failure, the per-node observed-stats ledger (rows,
+selectivity, dispatch mirrors, host<->device transfer bytes), the
+plan.stats event-log record, explain(stats=True), the footprint knobs
+(scheduler.footprint.{floorBytes,decodeExpansion}) and the profiler's
+``stats`` subcommand."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.runtime import eventlog as EL
+from spark_rapids_tpu.runtime import faults
+from spark_rapids_tpu.runtime import history as H
+from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.runtime import tracing
+from spark_rapids_tpu.session import TpuSession
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    EL.shutdown()
+    faults.reset()
+    M.reset_global_registry()
+    tracing.clear_events()
+    H.shutdown()
+    yield
+    EL.shutdown()
+    faults.reset()
+    M.reset_global_registry()
+    tracing.clear_events()
+    H.shutdown()
+
+
+def _session(**extra):
+    return TpuSession(dict(extra))
+
+
+def _table(n=300):
+    return pa.table({"k": [1, 2, 3] * (n // 3),
+                     "v": [1.0, 2.0, 3.0] * (n // 3),
+                     "w": list(range(n))})
+
+
+def _fingerprint_of(spark, df):
+    df.collect()
+    return spark.last_query_metrics().footprint["fingerprint"]
+
+
+# -- plan-shape fingerprint ---------------------------------------------------
+
+def test_fingerprint_ignores_literal_values():
+    spark = _session()
+    df = spark.create_dataframe(_table())
+    a = _fingerprint_of(spark, df.filter(F.col("v") > F.lit(1.0)))
+    b = _fingerprint_of(spark, df.filter(F.col("v") > F.lit(250.0)))
+    assert a == b, "literal VALUE must not change the plan shape"
+
+
+def test_fingerprint_keeps_dtypes_and_keys():
+    spark = _session()
+    df = spark.create_dataframe(_table())
+    base = _fingerprint_of(spark, df.group_by("k").agg(
+        F.sum(F.col("v")).alias("s")))
+    other_key = _fingerprint_of(spark, df.group_by("w").agg(
+        F.sum(F.col("v")).alias("s")))
+    assert base != other_key, "group key is part of the shape"
+    # int literal vs float literal: the literal's DTYPE stays significant
+    a = _fingerprint_of(spark, df.filter(F.col("w") > F.lit(10)))
+    b = _fingerprint_of(spark, df.filter(F.col("v") > F.lit(10.0)))
+    assert a != b
+
+
+def test_fingerprint_is_deterministic_across_sessions():
+    a = _session()
+    b = _session()
+    fa = _fingerprint_of(a, a.create_dataframe(_table()).group_by("k").agg(
+        F.sum(F.col("v")).alias("s")))
+    fb = _fingerprint_of(b, b.create_dataframe(_table()).group_by("k").agg(
+        F.sum(F.col("v")).alias("s")))
+    assert fa == fb
+
+
+# -- history store ------------------------------------------------------------
+
+def test_history_round_trip_across_sessions(tmp_path):
+    hist = str(tmp_path / "hist")
+
+    def run():
+        spark = _session(**{
+            "spark.rapids.tpu.stats.history.dir": hist,
+            "spark.rapids.tpu.scheduler.footprint.floorBytes": "1k"})
+        df = spark.create_dataframe(_table()).group_by("k").agg(
+            F.sum(F.col("v")).alias("s"))
+        out = df.collect()
+        qm = spark.last_query_metrics()
+        return out, qm.footprint, qm.stats
+
+    out1, fp1, st1 = run()
+    assert fp1["history_hit"] is False
+    assert os.path.exists(os.path.join(hist, "plan_history.json"))
+    out2, fp2, st2 = run()
+    assert fp2["history_hit"] is True
+    assert fp2["fingerprint"] == fp1["fingerprint"]
+    # the recorded observation IS the estimate: error collapses on run 2
+    assert st2["estimate_error"] <= st1["estimate_error"]
+    assert fp2["estimate"] >= st1["peak_device_bytes"]
+    assert out1.to_pydict() == out2.to_pydict()
+
+
+def test_corrupt_history_degrades_to_static(tmp_path, caplog):
+    hist = tmp_path / "hist"
+    hist.mkdir()
+    (hist / "plan_history.json").write_text("{not json!!")
+    spark = _session(**{"spark.rapids.tpu.stats.history.dir": str(hist)})
+    df = spark.create_dataframe(_table()).group_by("k").agg(
+        F.sum(F.col("v")).alias("s"))
+    out = df.collect()          # must not raise
+    assert out.num_rows == 3
+    fp = spark.last_query_metrics().footprint
+    assert fp["history_hit"] is False
+    assert fp["estimate"] == fp["static"]
+    assert any("history" in r.message.lower() for r in caplog.records)
+
+
+def test_history_disabled_by_knob(tmp_path):
+    hist = str(tmp_path / "hist")
+    conf = {"spark.rapids.tpu.stats.history.dir": hist,
+            "spark.rapids.tpu.stats.history.enabled": "false"}
+    for _ in range(2):
+        spark = _session(**conf)
+        df = spark.create_dataframe(_table()).group_by("k").agg(
+            F.sum(F.col("v")).alias("s"))
+        df.collect()
+        fp = spark.last_query_metrics().footprint
+        assert fp["history_hit"] is False
+    assert not os.path.exists(os.path.join(hist, "plan_history.json"))
+
+
+def test_history_evicts_to_max_shapes(tmp_path):
+    store = H.PlanHistoryStore(str(tmp_path), max_shapes=2)
+    for i in range(5):
+        store.record(f"fp{i:02d}", {"peak_device_bytes": 100 + i})
+    assert store.shape_count() == 2
+    # newest entries survive LRU eviction
+    reloaded = H.PlanHistoryStore(str(tmp_path), max_shapes=2)
+    assert reloaded.lookup("fp04") is not None
+    assert reloaded.lookup("fp00") is None
+
+
+def test_history_record_merges_peak():
+    class _Mem(H.PlanHistoryStore):
+        def _store(self, shapes):
+            self._shapes = shapes
+
+    s = _Mem.__new__(_Mem)
+    s.max_shapes = 8
+    s._dir = None
+    import threading
+    s._lock = threading.Lock()
+    s._shapes = {}
+    s._load = lambda: dict(s._shapes)
+    s.record("fp", {"peak_device_bytes": 100, "out_rows": 5})
+    e = s.record("fp", {"peak_device_bytes": 40, "out_rows": 7})
+    assert e["runs"] == 2
+    assert e["peak_device_bytes"] == 100   # max across runs, never shrinks
+    assert e["out_rows"] == 7              # cardinalities track the latest
+
+
+# -- per-node ledger, plan.stats record and read-outs -------------------------
+
+def test_node_ledger_and_plan_stats_event(tmp_path):
+    spark = _session(**{"spark.rapids.tpu.eventLog.dir": str(tmp_path)})
+    df = spark.create_dataframe(_table(), num_partitions=2)
+    q = df.group_by("k").agg(F.sum(F.col("v")).alias("s")).sort("k")
+    res = q.collect()
+    assert res.num_rows == 3
+    qm = spark.last_query_metrics()
+    st = qm.stats
+    assert st is not None and st["fingerprint"]
+    nodes = {n["name"]: n for n in st["nodes"]}
+    agg = next(v for k, v in nodes.items() if "Aggregate" in k)
+    assert agg["rows"] >= 3 and agg["output_bytes"] > 0
+    # selectivity: aggregate reduces 300 input rows to 3 groups
+    final_aggs = [v for k, v in nodes.items()
+                  if "Aggregate" in k and v.get("selectivity")]
+    assert any(v["selectivity"] <= 0.5 for v in final_aggs)
+    # dispatch mirror: at least one node ran a compiled kernel
+    assert any(n.get("dispatches") for n in st["nodes"])
+    # host->device ledger: the ArrowScan uploaded real bytes
+    assert any(n.get("h2d_bytes") for n in st["nodes"])
+    # the exchange's per-reduce-partition sizes ride in
+    assert st["shuffles"] and st["shuffles"][0]["partitions"] == 2
+    assert st["shuffles"][0]["max_partition"] in (0, 1)
+
+    path = EL.current_path()
+    EL.shutdown()
+    recs = [json.loads(line) for line in open(path)]
+    ps = [r for r in recs if r["event"] == "plan.stats"]
+    assert len(ps) == 1
+    assert EL.validate_record(ps[0]) == []
+    assert ps[0]["query"] == qm.query_id
+    assert ps[0]["fingerprint"] == st["fingerprint"]
+    end = [r for r in recs if r["event"] == "query.end"][0]
+    assert "estimate_error" in end and "history_hit" in end
+
+
+def test_explain_stats_annotation():
+    spark = _session()
+    df = spark.create_dataframe(_table())
+    q = df.group_by("k").agg(F.sum(F.col("v")).alias("s"))
+    q.collect()
+    s = q.explain(stats=True)
+    assert "footprint:" in s and "fingerprint=" in s
+    assert "rows=3" in s and "h2d=" in s
+    # before any action the annotated form explains itself
+    fresh = spark.create_dataframe(_table())
+    assert "no completed action" in fresh.explain(stats=True)
+
+
+def test_footprint_floor_knob():
+    from spark_rapids_tpu.runtime import scheduler as SCHED
+    spark = _session(**{
+        "spark.rapids.tpu.scheduler.footprint.floorBytes": "128m"})
+    df = spark.create_dataframe(_table())
+    est = SCHED.estimate_footprint(df._plan, spark.conf)
+    assert est >= 128 << 20
+    small = _session(**{
+        "spark.rapids.tpu.scheduler.footprint.floorBytes": "1k"})
+    assert SCHED.estimate_footprint(df._plan, small.conf) < 128 << 20
+
+
+def test_footprint_decode_expansion_knob(tmp_path):
+    import numpy as np
+    from spark_rapids_tpu.runtime import scheduler as SCHED
+    t = pa.table({"a": np.arange(50000, dtype=np.int64)})
+    import pyarrow.parquet as pq
+    pq.write_table(t, str(tmp_path / "f.parquet"))
+    lo = _session(**{
+        "spark.rapids.tpu.scheduler.footprint.floorBytes": "1k",
+        "spark.rapids.tpu.scheduler.footprint.decodeExpansion": "1.0"})
+    hi = _session(**{
+        "spark.rapids.tpu.scheduler.footprint.floorBytes": "1k",
+        "spark.rapids.tpu.scheduler.footprint.decodeExpansion": "10.0"})
+    plan = lo.read_parquet(str(tmp_path / "f.parquet"))._plan
+    e_lo = SCHED.estimate_footprint(plan, lo.conf)
+    e_hi = SCHED.estimate_footprint(plan, hi.conf)
+    assert e_hi > e_lo * 5
+
+
+def _run_profiler(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "profiler.py"), *args],
+        capture_output=True, text=True, cwd=REPO)
+
+
+def test_profiler_stats_subcommand(tmp_path):
+    spark = _session(**{"spark.rapids.tpu.eventLog.dir": str(tmp_path)})
+    df = spark.create_dataframe(_table(), num_partitions=2)
+    df.group_by("k").agg(F.sum(F.col("v")).alias("s")).collect()
+    path = EL.current_path()
+    EL.shutdown()
+
+    proc = _run_profiler("stats", path)
+    assert proc.returncode == 0, proc.stderr
+    assert "footprint estimate error" in proc.stdout
+    assert "node ledger" in proc.stdout
+    assert "at partition" in proc.stdout      # skew row names the partition
+
+    proc = _run_profiler("stats", path, "--json")
+    assert proc.returncode == 0, proc.stderr
+    d = json.loads(proc.stdout)
+    assert d["violations"] == []
+    qs = [q for q in d["queries"] if q["stats"]]
+    assert qs and qs[0]["stats"]["peak_device_bytes"] >= 0
+    assert qs[0]["shuffles"]
+
+
+def test_cluster_map_stage_feeds_skew(tmp_path):
+    """When the cluster plane runs the map stage (executors write the
+    blocks, the driver only sees MapOutputTracker split sizes), the
+    per-reduce-partition totals must still reach the ambient collector AND
+    the driver's event log, so the profiler skew table is not blind on
+    cluster runs."""
+    import numpy as np
+    from spark_rapids_tpu.cluster import MiniCluster
+
+    spark = _session(**{"spark.rapids.tpu.eventLog.dir": str(tmp_path)})
+    rng = np.random.default_rng(7)
+    df = spark.create_dataframe(
+        pa.table({"k": rng.integers(0, 50, 4000), "v": rng.random(4000)}),
+        num_partitions=2)
+    q = df.group_by("k").agg(F.sum(F.col("v")).alias("s"))
+    col = M.QueryMetricsCollector("cluster group-by")
+    with MiniCluster(n_executors=2, platform="cpu") as c:
+        with M.collector_context(col):
+            out = c.collect(q)
+    assert out.num_rows == 50
+    shuffles = col.shuffle_stats()
+    assert shuffles, "cluster map stage recorded no partition sizes"
+    assert sum(shuffles[0]["partition_sizes"]) > 0
+    path = EL.current_path()
+    EL.shutdown()
+    recs = [json.loads(line) for line in open(path)]
+    ends = [r for r in recs if r["event"] == "stage.map.end"
+            and r.get("partition_sizes")]
+    assert ends, "driver log has no stage.map.end with partition sizes"
+    assert EL.validate_record(ends[-1]) == []
+
+
+def test_profiler_stats_errors_without_records(tmp_path):
+    log = tmp_path / "events-empty.jsonl"
+    log.write_text("")
+    proc = _run_profiler("stats", str(log))
+    assert proc.returncode == 1
+    assert "no plan.stats" in proc.stderr

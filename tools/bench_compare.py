@@ -17,7 +17,10 @@ number a bench line carries). ci.sh wires it as a **soft gate**:
 Memory trajectory rides along: per-query ``peak_device_bytes`` deltas are
 printed when both lines carry them (bench.py embeds them from the
 allocation-site heap profiler), so a perf win that doubles the high-water
-mark is visible in the same report.
+mark is visible in the same report. Per-query ``estimate_error`` deltas
+(runtime statistics plane: |admission estimate - observed peak| / peak)
+ride the same way, so a change that degrades footprint estimation shows
+up next to the perf numbers it would distort.
 
 Usage:
   python tools/bench_compare.py <current.json> [--baseline BENCH_r06.json]
@@ -113,6 +116,10 @@ def compare(cur: dict, base: dict) -> dict:
             row["peak_device_bytes"] = c["peak_device_bytes"]
             row["peak_delta_bytes"] = (c["peak_device_bytes"]
                                        - b["peak_device_bytes"])
+        if "estimate_error" in c and "estimate_error" in b:
+            row["estimate_error"] = c["estimate_error"]
+            row["estimate_error_delta"] = round(
+                c["estimate_error"] - b["estimate_error"], 6)
         rows.append(row)
     geomean = math.exp(sum(math.log(r["ratio"]) for r in rows) / len(rows))
     return {"queries": rows, "geomean_ratio": round(geomean, 4),
@@ -142,6 +149,9 @@ def main(argv=None) -> int:
         if "peak_delta_bytes" in r:
             extra = (f"  peak_dev {r['peak_device_bytes']}B "
                      f"({r['peak_delta_bytes']:+d}B vs baseline)")
+        if "estimate_error_delta" in r:
+            extra += (f"  est_err {r['estimate_error']} "
+                      f"({r['estimate_error_delta']:+.3f} vs baseline)")
         print(f"  {r['query']}: vs_baseline {r['base_vs_baseline']} -> "
               f"{r['cur_vs_baseline']}  (x{r['ratio']}){extra}")
     reg = d["regression"]

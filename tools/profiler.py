@@ -173,26 +173,43 @@ def analyze(records: list) -> dict:
             if "sites" in r:
                 r["sites"] = sorted(r["sites"])
 
-        # shuffle partition skew per exchange map stage
-        shuffles = []
-        for e in evs:
-            if e["event"] != "stage.map.end":
-                continue
-            sizes = e.get("partition_sizes") or []
+        # shuffle partition skew per exchange map stage. Unified on the
+        # stats plane: stage.map.end events where present, backfilled from
+        # the query's plan.stats record (which carries the same
+        # per-reduce-partition sizes via the collector/MapOutputTracker) so
+        # skew is reported even when the mesh plane ran the map stage and no
+        # stage.map.end landed in this log
+        def skew_row(node, sid, sizes):
+            sizes = [int(s) for s in (sizes or [])]
             nonzero = [s for s in sizes if s] or [0]
             mean = sum(sizes) / len(sizes) if sizes else 0
-            shuffles.append({
-                "node": _node_label(nodes_by_id, e.get("node")),
-                "shuffle": e.get("shuffle"),
+            return {
+                "node": _node_label(nodes_by_id, node),
+                "shuffle": sid,
                 "partitions": len(sizes),
                 "total_bytes": sum(sizes),
                 "max_bytes": max(sizes) if sizes else 0,
+                "max_partition": sizes.index(max(sizes)) if sizes else None,
                 "skew": round(max(sizes) / mean, 3) if mean else 1.0,
                 "empty_partitions": sum(1 for s in sizes if not s),
                 "largest_vs_median": round(
                     max(sizes) / max(sorted(nonzero)[len(nonzero) // 2], 1), 3)
                     if sizes else 1.0,
-            })
+            }
+
+        shuffles = []
+        for e in evs:
+            if e["event"] == "stage.map.end":
+                shuffles.append(skew_row(e.get("node"), e.get("shuffle"),
+                                         e.get("partition_sizes")))
+        plan_stats = next((e for e in evs if e["event"] == "plan.stats"),
+                          None)
+        seen_sids = {s["shuffle"] for s in shuffles}
+        for s in (plan_stats or {}).get("shuffles") or []:
+            if s.get("shuffle") in seen_sids:
+                continue
+            shuffles.append(skew_row(s.get("node"), s.get("shuffle"),
+                                     s.get("partition_sizes")))
 
         # readahead stall time per scan node
         stalls = []
@@ -255,6 +272,7 @@ def analyze(records: list) -> dict:
             "spill": spills,
             "retries": retries,
             "shuffles": shuffles,
+            "stats": plan_stats,
             "readahead_stalls": stalls,
             "pipeline_edges": pipeline_edges,
             "resilience": rec.get("resilience") or {},
@@ -829,8 +847,10 @@ def render(analysis: dict, top: int = 15) -> str:
                     f"    {s['node']} shuffle={s['shuffle']}: "
                     f"{s['partitions']} partitions "
                     f"{_fmt_bytes(s['total_bytes'])} total, "
-                    f"max={_fmt_bytes(s['max_bytes'])} "
-                    f"skew(max/mean)={s['skew']} "
+                    f"max={_fmt_bytes(s['max_bytes'])}"
+                    + (f" (partition {s['max_partition']})"
+                       if s.get("max_partition") is not None else "")
+                    + f" skew(max/mean)={s['skew']} "
                     f"empty={s['empty_partitions']}")
         if q["readahead_stalls"]:
             out.append("  scan readahead stall time:")
@@ -946,6 +966,111 @@ def render_compare(a: dict, b: dict, name_a: str, name_b: str) -> str:
 
 
 # ---------------------------------------------------------------------------
+# stats subcommand: runtime statistics plane read-out (plan.stats records)
+# ---------------------------------------------------------------------------
+
+def render_stats(analysis: dict, top: int = 15) -> str:
+    """Estimate-error table, per-node dispatch/transfer ledger and shuffle
+    skew tables from the plan.stats records in one event log."""
+    out = []
+    with_stats = [(i, q) for i, q in enumerate(analysis["queries"])
+                  if q.get("stats")]
+
+    out.append("== footprint estimate error (scheduler admission vs observed "
+               "device peak):")
+    out.append(f"  {'query':>5}  {'estimate':>10}  {'static':>10}  "
+               f"{'observed':>10}  {'error':>8}  {'hit':>5}  description")
+    for i, q in enumerate(analysis["queries"]):
+        st = q.get("stats") or {}
+        err = st.get("estimate_error")
+        out.append(
+            f"  {i:>5}  "
+            f"{_fmt_bytes(st.get('estimate_bytes') or 0):>10}  "
+            f"{_fmt_bytes(st.get('static_estimate_bytes') or 0):>10}  "
+            f"{_fmt_bytes(st.get('peak_device_bytes') or 0):>10}  "
+            f"{('' if err is None else format(err, '.3f')):>8}  "
+            f"{str(bool(st.get('history_hit'))).lower():>5}  "
+            f"{q['description']}"
+            + ("" if st else "  [no plan.stats record]"))
+    out.append("")
+
+    for i, q in with_stats:
+        st = q["stats"]
+        out.append(f"== query {i}: {q['query']} [{q['description']}] "
+                   f"fingerprint={st.get('fingerprint')}")
+        nodes = st.get("nodes") or []
+        if nodes:
+            out.append("  node ledger (rows / selectivity / dispatch & "
+                       "transfer counters):")
+            out.append(f"    {'id':>4}  {'rows':>10}  {'batches':>7}  "
+                       f"{'sel':>6}  {'disp':>5}  {'comp':>5}  "
+                       f"{'output':>9}  {'h2d':>9}  {'d2h':>9}  node")
+            def _cell(v, fmt=str):
+                return "" if v is None else fmt(v)
+            for n in nodes[:max(top, 1)]:
+                out.append(
+                    f"    {_cell(n.get('id')):>4}  "
+                    f"{_cell(n.get('rows')):>10}  "
+                    f"{_cell(n.get('batches')):>7}  "
+                    f"{_cell(n.get('selectivity'), lambda v: format(v, '.3f')):>6}  "
+                    f"{_cell(n.get('dispatches')):>5}  "
+                    f"{_cell(n.get('compiles')):>5}  "
+                    f"{_cell(n.get('output_bytes'), _fmt_bytes):>9}  "
+                    f"{_cell(n.get('h2d_bytes'), _fmt_bytes):>9}  "
+                    f"{_cell(n.get('d2h_bytes'), _fmt_bytes):>9}  "
+                    f"{'  ' * (n.get('depth') or 0)}{n.get('name')}"
+                    + (f" {n['args']}" if n.get("args") else ""))
+            if len(nodes) > max(top, 1):
+                out.append(f"    ... {len(nodes) - max(top, 1)} more nodes")
+        if q["shuffles"]:
+            out.append("  shuffle partition skew:")
+            for s in q["shuffles"]:
+                out.append(
+                    f"    {s['node']} shuffle={s['shuffle']}: "
+                    f"{s['partitions']} partitions "
+                    f"{_fmt_bytes(s['total_bytes'])} total, "
+                    f"max={_fmt_bytes(s['max_bytes'])}"
+                    + (f" at partition {s['max_partition']}"
+                       if s.get("max_partition") is not None else "")
+                    + f" skew(max/mean)={s['skew']} "
+                    f"empty={s['empty_partitions']}")
+        out.append("")
+
+    hits = sum(1 for _, q in with_stats
+               if (q["stats"] or {}).get("history_hit"))
+    out.append(f"{len(analysis['queries'])} queries, {len(with_stats)} with "
+               f"plan.stats, {hits} history hits")
+    return "\n".join(out)
+
+
+def stats_main(args) -> int:
+    records, violations = load_log(args.eventlog)
+    analysis = analyze(records)
+    rc = 0
+    if violations:
+        for v in violations:
+            print(f"SCHEMA VIOLATION: {v}", file=sys.stderr)
+        rc = 1
+    if not any(q.get("stats") for q in analysis["queries"]):
+        print(f"ERROR: no plan.stats record in {args.eventlog} (stats plane "
+              "disabled, or log predates it)", file=sys.stderr)
+        rc = 1
+    if args.json:
+        payload = {
+            "queries": [{"query": q["query"],
+                         "description": q["description"],
+                         "stats": q.get("stats"),
+                         "shuffles": q["shuffles"]}
+                        for q in analysis["queries"]],
+            "violations": violations,
+        }
+        print(json.dumps(payload, indent=2, default=str))
+    else:
+        print(render_stats(analysis, top=args.top))
+    return rc
+
+
+# ---------------------------------------------------------------------------
 # CLI
 # ---------------------------------------------------------------------------
 
@@ -984,12 +1109,22 @@ def main(argv=None) -> int:
                     help="machine-readable analysis instead of text")
     mm.add_argument("--top", type=int, default=15,
                     help="sites / timeline samples per table")
+    st = sub.add_parser(
+        "stats", help="runtime statistics plane: footprint estimate error, "
+                      "per-node dispatch/transfer ledger, shuffle skew")
+    st.add_argument("eventlog")
+    st.add_argument("--json", action="store_true",
+                    help="machine-readable analysis instead of text")
+    st.add_argument("--top", type=int, default=15,
+                    help="node-ledger rows per query")
     args = p.parse_args(argv)
 
     if args.cmd == "trace":
         return trace_main(args)
     if args.cmd == "memory":
         return memory_main(args)
+    if args.cmd == "stats":
+        return stats_main(args)
 
     records, violations = load_log(args.eventlog)
     analysis = analyze(records)
