@@ -154,10 +154,14 @@ def child_main():
     # scale (docs/tuning.md; reference COALESCING reader role).
     # SRT_PIPELINE=0 disables the pipelined executor for A/B runs (the ci.sh
     # pipeline gate and perf_notes round-7 use this switch).
+    # SRT_STAGE_FUSION=0 likewise disables whole-stage fusion (the ci.sh
+    # fusion gate compares dispatch counts and bit-identity across the two).
     pipeline_on = os.environ.get("SRT_PIPELINE", "1") == "1"
+    fusion_on = os.environ.get("SRT_STAGE_FUSION", "1") == "1"
     spark = TpuSession({
         "spark.rapids.tpu.sql.format.parquet.reader.type": "COALESCING",
-        "spark.rapids.tpu.pipeline.enabled": pipeline_on})
+        "spark.rapids.tpu.pipeline.enabled": pipeline_on,
+        "spark.rapids.tpu.sql.stageFusion.enabled": fusion_on})
     dfs = tpch.load(spark, paths, files_per_partition=4)
     tb = tpch.load_np(paths)
     n_lineitem = len(tb["lineitem"]["l_orderkey"])
@@ -284,6 +288,7 @@ def child_main():
         "reps": BENCH_REPS,
         "stat": "median",
         "pipeline": pipeline_on,
+        "fusion": fusion_on,
         "spread": round(max(spreads), 3),
         "variance_ok": max(spreads) <= BENCH_MAX_SPREAD,
         "queries": per_query,

@@ -136,6 +136,136 @@ PYEOF
 # injected split-OOM inside a pipeline segment must recover bit-identically
 JAX_PLATFORMS=cpu python -m pytest tests/test_pipeline.py -q
 
+echo "== whole-stage chain fusion: >=3x per-batch dispatch drop, bit-identical =="
+# the broadcast-join probe chains (q18's agg->orders->customer shape, q5's
+# orders->customer hops) must collapse to ~1 dispatch per stream batch: the
+# chain-region dispatch count (the spine of BHJ/Project/Filter nodes the
+# chain absorbed) drops >=3x vs stageFusion.enabled=false, with bit-identical
+# rows. q18's canonical HAVING>300 yields 0 rows at SF 0.01 (no emits to
+# save on the unfused side), so the flowing-rows ratio is asserted on q5 and
+# on q18's own plan shape with the threshold lowered; canonical q18 asserts
+# chain formation + bit-identity.
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import jax; jax.config.update("jax_platforms", "cpu")
+import spark_rapids_tpu  # noqa: F401  (enables x64)
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.benchmarks import tpch
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.runtime import stats as STATS
+
+# 12 files -> 12 stream batches: enough for the per-hop one-off build-prep
+# dispatches to amortize out of the region ratio
+paths = tpch.generate(0.01, "/tmp/tpch_ci_sf0.01_f12", files_per_table=12)
+c = F.col
+
+def q18_flow(dfs):
+    # q18's exact plan shape with the HAVING threshold lowered so every
+    # stream batch carries matches through both probe hops
+    li = dfs["lineitem"]
+    big = (li.group_by(c("l_orderkey"))
+           .agg(F.sum(c("l_quantity")).alias("sum_qty"))
+           .filter(c("sum_qty") > F.lit(30.0)))
+    orders = dfs["orders"].select(
+        c("o_orderkey").alias("l_orderkey"), c("o_custkey"),
+        c("o_orderdate"), c("o_totalprice"))
+    cust = dfs["customer"].select(c("c_custkey").alias("o_custkey"))
+    return big.join(orders, on="l_orderkey").join(cust, on="o_custkey")
+
+def chain_region(root):
+    # unfused: the stream spine the chain would absorb (topmost BHJ down
+    # through stream children over BHJ/Project/Filter, excluding the scan)
+    def find(n):
+        if type(n).__name__ == "BroadcastHashJoinExec":
+            return n
+        for ch in n.children:
+            r = find(ch)
+            if r is not None:
+                return r
+    n, out = find(root), []
+    while type(n).__name__ in ("BroadcastHashJoinExec", "ProjectExec",
+                               "FilterExec"):
+        out.append(n)
+        si = ((0 if n.stream_is_left else 1)
+              if type(n).__name__ == "BroadcastHashJoinExec" else 0)
+        n = n.children[si]
+    return out
+
+def find_chain(n):
+    if type(n).__name__ == "BroadcastHashJoinChainExec":
+        return n
+    for ch in n.children:
+        r = find_chain(ch)
+        if r is not None:
+            return r
+
+def run(make_df, fusion):
+    spark = TpuSession({"spark.rapids.tpu.sql.stageFusion.enabled": fusion})
+    dfs = tpch.load(spark, paths, files_per_partition=12)
+    df = make_df(dfs)
+    df.collect()                        # warm: traces + capacity predictions
+    rows = sorted(map(tuple, (r.values()
+                              for r in df.collect().to_pylist())))
+    cl = df._last_collector
+    disp = {e["id"]: e["dispatches"] or 0 for e in STATS.node_table(cl)}
+    if fusion:
+        chain = find_chain(cl.root)
+        assert chain is not None, "no chain formed"
+        return rows, disp[chain._node_id]
+    assert find_chain(cl.root) is None, "chain formed with fusion off"
+    return rows, sum(disp.get(n._node_id, 0) for n in chain_region(cl.root))
+
+for name, make_df in (("q5", tpch.q5), ("q18-flow", q18_flow)):
+    r_on, reg_on = run(make_df, True)
+    r_off, reg_off = run(make_df, False)
+    assert r_on == r_off, f"{name}: fused rows differ"
+    assert len(r_on) > 0, f"{name}: no rows flowed through the chain"
+    ratio = reg_off / max(reg_on, 1)
+    print(f"chain gate: {name} region dispatches unfused={reg_off} "
+          f"fused={reg_on} ({ratio:.2f}x)")
+    assert ratio >= 3.0, f"{name}: chain dispatch drop {ratio:.2f}x < 3x"
+
+# canonical q18 (empty output at this SF): chain forms, rows bit-identical
+r_on, _ = run(tpch.q18, True)
+r_off, _ = run(tpch.q18, False)
+assert r_on == r_off, "q18: fused rows differ"
+print("chain gate: q18 canonical bit-identical (chain formed)")
+PYEOF
+
+echo "== persistent stage cache: warm-start q18 replays with 0 traces =="
+# cross-process contract: a fresh session pointed at a populated cache dir
+# must replay every fused stage from serialized executables — zero Python
+# retraces, zero XLA compiles (each heredoc below is its own process)
+stage_cache_dir=$(mktemp -d /tmp/srt_stagecache.XXXXXX)
+for phase in populate replay; do
+SRT_CI_PHASE="$phase" SRT_CI_CACHE_DIR="$stage_cache_dir" \
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import os
+import jax; jax.config.update("jax_platforms", "cpu")
+import spark_rapids_tpu  # noqa: F401  (enables x64)
+from spark_rapids_tpu.benchmarks import tpch
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.runtime import fuse, stage_cache
+
+phase = os.environ["SRT_CI_PHASE"]
+paths = tpch.generate(0.01, "/tmp/tpch_ci_sf0.01")
+spark = TpuSession({
+    "spark.rapids.tpu.sql.stage.cache.enabled": True,
+    "spark.rapids.tpu.sql.stage.cache.dir": os.environ["SRT_CI_CACHE_DIR"]})
+dfs = tpch.load(spark, paths, files_per_partition=4)
+tpch.q18(dfs).collect()
+st = stage_cache.get()
+traces = fuse.stage_metrics()["traces"]
+print(f"stage-cache gate [{phase}]: traces={traces} hits={st.hits} "
+      f"saves={st.saves}")
+if phase == "populate":
+    assert st.saves > 0, "populate session saved no stage executables"
+else:
+    assert traces == 0, f"warm-start q18 retraced {traces} stages"
+    assert st.hits > 0, "warm-start session hit no cache entries"
+PYEOF
+done
+rm -rf "$stage_cache_dir"
+
 echo "== cluster chaos: executor kill mid-q18 on a 3-executor MiniCluster =="
 # losing 1 of 3 executors mid-query must cost ~1/N of a stage, not the
 # query: the killed run must be bit-identical to the clean run, recompute

@@ -286,6 +286,23 @@ ENABLE_WHOLE_STAGE_FUSION = conf("spark.rapids.tpu.sql.stageFusion.enabled").doc
     "TPU-first optimization with no reference analog (cudf launches one kernel per op)"
 ).boolean_conf(True)
 
+STAGE_CACHE_ENABLED = conf("spark.rapids.tpu.sql.stage.cache.enabled").doc(
+    "Persist compiled stage executables (serialized XLA programs) to disk and "
+    "reload them in later sessions, skipping tracing and compilation entirely "
+    "on warm starts. Requires stage.cache.dir. Entries are keyed by backend "
+    "platform + jax/package versions + kernel semantics + argument signature; "
+    "corrupt or stale entries degrade to a retrace with a warning"
+).boolean_conf(False)
+
+STAGE_CACHE_DIR = conf("spark.rapids.tpu.sql.stage.cache.dir").doc(
+    "Directory for the persistent compiled-stage cache (created on demand). "
+    "Safe to share across sessions of the same build; entries from other "
+    "backends/versions are ignored").string_conf("")
+
+STAGE_CACHE_MAX_BYTES = conf("spark.rapids.tpu.sql.stage.cache.maxBytes").doc(
+    "On-disk size budget for the compiled-stage cache; least-recently-used "
+    "entries are pruned past it").bytes_conf("256m")
+
 PARQUET_READER_TYPE = conf("spark.rapids.tpu.sql.format.parquet.reader.type").doc(
     "PERFILE | MULTITHREADED | COALESCING (reference GpuParquetScan.scala:317,426 "
     "reader strategies)").string_conf("MULTITHREADED")
@@ -853,6 +870,18 @@ class RapidsConf:
     @property
     def stage_fusion_enabled(self):
         return self.get(ENABLE_WHOLE_STAGE_FUSION)
+
+    @property
+    def stage_cache_enabled(self):
+        return self.get(STAGE_CACHE_ENABLED)
+
+    @property
+    def stage_cache_dir(self):
+        return self.get(STAGE_CACHE_DIR)
+
+    @property
+    def stage_cache_max_bytes(self):
+        return self.get(STAGE_CACHE_MAX_BYTES)
 
     def copy_with(self, **kv):
         s = dict(self.settings)

@@ -77,6 +77,12 @@ class HashAggregateExec(TpuExec):
         bind_to = child.output if not prefilter_on_projected else None
         self.prefilter = (prefilter if prefilter is None or bind_to is None
                           else bind_references(prefilter, bind_to))
+        # HAVING fusion: a Filter directly ABOVE this aggregate folded into
+        # the finalize kernel (fuse_having, planner-only). Evaluated against
+        # self.output after f.evaluate; surviving groups compact in the same
+        # program (or via the host-indexed epilogue) — the separate FilterExec
+        # dispatch and its full-width capacity disappear.
+        self.postfilter = None
         self._agg_time = self.metrics.metric(M.AGG_TIME, M.MODERATE)
         self._concat_time = self.metrics.metric(M.CONCAT_TIME, M.MODERATE)
         # observed input cardinality (stats plane): with output rows this
@@ -95,6 +101,17 @@ class HashAggregateExec(TpuExec):
             for e in self.agg_exprs:
                 fields.append(T.StructField(e.name, _agg_fn(e).dtype, True))
         return T.StructType(fields)
+
+    def fuse_having(self, condition):
+        """Fold a HAVING predicate into finalization (plan/overrides
+        conv_filter). The condition must reference only this aggregate's
+        OUTPUT columns; COMPLETE/FINAL modes only (PARTIAL output is
+        state-typed and the filter must see evaluated aggregates)."""
+        assert self.mode != PARTIAL
+        from spark_rapids_tpu.expr import predicates as P
+        cond = bind_references(condition, self.output)
+        self.postfilter = (cond if self.postfilter is None
+                           else P.And(self.postfilter, cond))
 
     def _partial_schema(self):
         fields = [T.StructField(e.name, e.dtype, True) for e in self.group_exprs]
@@ -123,26 +140,37 @@ class HashAggregateExec(TpuExec):
         if batch.columns and not ctx_sensitive:
             in_cols = [Col.from_vector(c) for c in batch.columns]
             nr = jnp.asarray(batch.lazy_num_rows, jnp.int32)
-            vmin_t, has_hint = self._key_range_hint(batch, in_cols, nr, merge)
+            vmin_t, has_hint, presorted = self._key_range_hint(
+                batch, in_cols, nr, merge)
             key = ("agg", merge, fuse.schema_key(
                 self._partial_schema() if merge else self.child.output),
                 tuple(fuse.expr_key(e) for e in self.group_exprs),
                 tuple(fuse.expr_key(e) for e in self.agg_exprs),
                 fuse.expr_key(pre) if pre is not None else None,
                 tuple(fuse.expr_key(e) for e in prep) if prep is not None
-                else None, self.prefilter_on_projected, has_hint)
+                else None, self.prefilter_on_projected, has_hint, presorted)
 
             def build():
                 def kernel(cols, num_rows, vmin):
                     ctx = EvalContext(cols, num_rows, cols[0].values.shape[0])
                     return self._agg_kernel(
                         ctx, merge,
-                        range_hint=(vmin, True) if has_hint else None)
+                        range_hint=(vmin, True) if has_hint else None,
+                        presorted=presorted)
                 return kernel
 
             compacted, n_groups = fuse.call_fused(
                 key, "HashAggregateExec", build, (in_cols, nr, vmin_t),
                 lambda: self._agg_kernel(EvalContext.from_batch(batch), merge))
+            # stage-boundary right-sizing: a high-reduction aggregation at a
+            # big capacity stops dragging that capacity into downstream
+            # programs (merge/finalize/join build) — one count sync, one tiny
+            # slice program (ops/filtering.maybe_host_resize)
+            if compacted and self.conf.stage_fusion_enabled:
+                from spark_rapids_tpu.ops.filtering import maybe_host_resize
+                resized = maybe_host_resize(compacted, n_groups)
+                if resized is not None:
+                    compacted, n_groups = resized
         else:
             compacted, n_groups = self._agg_kernel(
                 EvalContext.from_batch(batch), merge)
@@ -150,30 +178,35 @@ class HashAggregateExec(TpuExec):
         return ColumnarBatch(cols, n_groups, self._partial_schema())
 
     def _key_range_hint(self, batch, in_cols, nr, merge: bool):
-        """(vmin_traced, has_hint) for the single-wide-int-key group-by: one
-        cheap min/max reduction + ONE host sync per batch decides whether
-        the key range fits the packed single-operand sort (the join-build
-        strategy-pick pattern, exec/joins._prep_fast_build). A statically
-        64-bit key (LONG/TIMESTAMP) otherwise forces the 2-operand wide
-        sort — ~3x the packed cost at 1M rows (docs/perf_notes.md). Gated
-        to big capacities (below, the comparator fallback is already
-        cheap), keys with no hoisted preprojection (the stats pass reads
-        the raw batch), and int dtypes too wide to pack statically."""
+        """(vmin_traced, has_hint, presorted) for the single-wide-int-key
+        group-by: one cheap reduction + ONE host sync per batch decides
+        whether the key range fits the packed single-operand sort (the
+        join-build strategy-pick pattern, exec/joins._prep_fast_build) — a
+        statically 64-bit key (LONG/TIMESTAMP) otherwise forces the 2-operand
+        wide sort, ~3x the packed cost at 1M rows (docs/perf_notes.md). The
+        same probe now also checks whether the live rows already ARRIVE
+        key-sorted with no nulls (clustered fact tables — TPC-H lineitem is
+        physically ordered by l_orderkey): then the sort vanishes entirely
+        and the segment path runs over the input order (the sorted-input
+        group-by; `presorted` wins over the hint). Gated to big capacities
+        (below, the comparator fallback is already cheap), keys with no
+        hoisted preprojection (the probe reads the raw batch), and int
+        dtypes too wide to pack statically."""
         from spark_rapids_tpu.runtime import fuse
         zero = jnp.zeros((), jnp.int64)
         cap = batch.capacity
         if (len(self.group_exprs) != 1 or cap < (1 << 17)
                 or (not merge and self.preproject is not None)):
-            return zero, False
+            return zero, False, False
         e = self.group_exprs[0]
         try:
             kdt = e.dtype
         except Exception:  # noqa: BLE001 — unresolvable dtype: no hint
-            return zero, False
+            return zero, False, False
         if (not isinstance(kdt, (T.IntegralType, T.TimestampType))
                 or isinstance(kdt, T.BooleanType)
                 or jnp.iinfo(kdt.jnp_dtype).bits <= 32):
-            return zero, False   # narrow keys already pack statically
+            return zero, False, False   # narrow keys already pack statically
         skey = ("agg_key_stats", merge, fuse.schema_key(
             self._partial_schema() if merge else self.child.output),
             fuse.expr_key(e))
@@ -184,25 +217,36 @@ class HashAggregateExec(TpuExec):
                 ctx = EvalContext(cols, num_rows, cap_)
                 k = ctx.cols[0] if merge else e.eval(ctx)
                 vals = k.values.astype(jnp.int64)
-                eligible = k.validity & (
-                    jnp.arange(cap_, dtype=jnp.int32) < num_rows)
+                live = jnp.arange(cap_, dtype=jnp.int32) < num_rows
+                eligible = k.validity & live
                 vmin = jnp.min(jnp.where(eligible, vals,
                                          jnp.iinfo(jnp.int64).max))
                 vmax = jnp.max(jnp.where(eligible, vals,
                                          jnp.iinfo(jnp.int64).min))
-                return vmin, vmax
+                # sorted = every live row valid AND values nondecreasing over
+                # the live prefix (all-valid means validity boundaries cannot
+                # reorder groups, so input order == sorted group order)
+                all_valid = jnp.all(k.validity | ~live)
+                nondec = jnp.all(jnp.where(live[1:],
+                                           vals[1:] >= vals[:-1], True))
+                return vmin, vmax, all_valid & nondec
             return kernel
 
-        vmin_t, vmax_t = fuse.call_fused(
+        vmin_t, vmax_t, sorted_t = fuse.call_fused(
             skey, "HashAggregateExec.key_stats", build, (in_cols, nr),
             lambda: build()(in_cols, nr))
         vmin, vmax = int(vmin_t), int(vmax_t)
+        presorted = bool(sorted_t) and self.conf.stage_fusion_enabled
         w = 62 - max((cap - 1).bit_length(), 1) - 1
-        fits = vmax >= vmin and (vmax - vmin) < (1 << w)
-        return jnp.asarray(vmin if fits else 0, jnp.int64), fits
+        fits = vmax >= vmin and (vmax - vmin) < (1 << w) and not presorted
+        return jnp.asarray(vmin if fits else 0, jnp.int64), fits, presorted
 
-    def _agg_kernel(self, ctx: EvalContext, merge: bool, range_hint=None):
-        """Pure per-batch aggregation body (traceable)."""
+    def _agg_kernel(self, ctx: EvalContext, merge: bool, range_hint=None,
+                    presorted: bool = False):
+        """Pure per-batch aggregation body (traceable). `presorted` asserts
+        the per-batch probe (_key_range_hint) PROVED the single key column
+        arrives sorted and null-free: the segment sort AND every row gather
+        collapse to identity."""
         cap = ctx.capacity
         keep = None
 
@@ -235,12 +279,17 @@ class HashAggregateExec(TpuExec):
                 key_cols = [e.eval(ctx) for e in self.group_exprs]
                 keep = None
             combined = G.combine_compact_keys(key_cols)
+            presorted = presorted and combined is None and len(key_cols) == 1
             perm, seg_ids, boundary, live = G.group_segments(
                 [combined] if combined is not None else key_cols,
                 ctx.num_rows, cap,
                 range_hint=(range_hint if combined is None
-                            and len(key_cols) == 1 else None))
-            sorted_keys = gather_cols(key_cols, perm, live)
+                            and len(key_cols) == 1 else None),
+                presorted=presorted)
+            sorted_keys = ([Col(c.values, c.validity & live, c.dtype,
+                                c.dictionary) for c in key_cols]
+                           if presorted else
+                           gather_cols(key_cols, perm, live))
         else:
             if keep is not None:
                 # segment kernels need contiguous runs — masked rows mid-run
@@ -265,15 +314,20 @@ class HashAggregateExec(TpuExec):
             f = _agg_fn(e)
             nstates = len(f.state_types)
             if merge:
-                ins = gather_cols([ctx.cols[off + i] for i in range(nstates)],
-                                  perm, live)
+                ins = [ctx.cols[off + i] for i in range(nstates)]
+                ins = ([Col(c.values, c.validity & live, c.dtype,
+                            c.dictionary) for c in ins]
+                       if presorted else gather_cols(ins, perm, live))
                 outs = f.merge(ins, segctx)
             else:
                 if f.child is None:
                     in_col = Col(jnp.zeros((cap,), jnp.int8), live, T.BYTE)
                 else:
                     in_col = f.child.eval(ctx)
-                in_sorted = gather_cols([in_col], perm, live)[0]
+                in_sorted = (Col(in_col.values, in_col.validity & live,
+                                 in_col.dtype, in_col.dictionary)
+                             if presorted else
+                             gather_cols([in_col], perm, live)[0])
                 outs = f.update(in_sorted, segctx)
             off += nstates
             state_cols.extend(outs)
@@ -450,6 +504,9 @@ class HashAggregateExec(TpuExec):
 
     def _finalize(self, partial: ColumnarBatch) -> ColumnarBatch:
         from spark_rapids_tpu.expr.core import Col
+        from spark_rapids_tpu.ops.filtering import (fused_compact_cols,
+                                                    host_compact_cols,
+                                                    selection_mask)
         from spark_rapids_tpu.runtime import fuse
 
         def body(ctx):
@@ -461,12 +518,23 @@ class HashAggregateExec(TpuExec):
                 states = [ctx.cols[off + i] for i in range(len(f.state_types))]
                 off += len(f.state_types)
                 out.append(f.evaluate(states))
-            return out
+            if self.postfilter is None:
+                return out, None
+            # fused HAVING: the predicate sees the EVALUATED output columns;
+            # the keep mask leaves the kernel so the epilogue can choose the
+            # host-indexed compaction (right-sized capacity) over the
+            # in-program one
+            octx = EvalContext(out, ctx.num_rows, ctx.capacity)
+            keep = selection_mask(self.postfilter.eval(octx), octx.num_rows,
+                                  octx.capacity)
+            return out, keep
 
         if partial.columns:
             key = ("agg_final", fuse.schema_key(self._partial_schema()),
                    tuple(fuse.expr_key(e) for e in self.group_exprs),
-                   tuple(fuse.expr_key(e) for e in self.agg_exprs))
+                   tuple(fuse.expr_key(e) for e in self.agg_exprs),
+                   fuse.expr_key(self.postfilter)
+                   if self.postfilter is not None else None)
 
             def build():
                 def kernel(cols, num_rows):
@@ -476,12 +544,18 @@ class HashAggregateExec(TpuExec):
 
             in_cols = [Col.from_vector(c) for c in partial.columns]
             nr = jnp.asarray(partial.lazy_num_rows, jnp.int32)
-            out = fuse.call_fused(
+            out, keep = fuse.call_fused(
                 key, "HashAggregateExec.finalize", build, (in_cols, nr),
                 lambda: body(EvalContext.from_batch(partial)))
         else:
-            out = body(EvalContext.from_batch(partial))
-        return ColumnarBatch([c.to_vector() for c in out], partial.lazy_num_rows,
+            out, keep = body(EvalContext.from_batch(partial))
+        num_rows = partial.lazy_num_rows
+        if keep is not None:
+            res = host_compact_cols(out, keep)
+            if res is None:
+                res = fused_compact_cols(out, keep)
+            out, num_rows = res
+        return ColumnarBatch([c.to_vector() for c in out], num_rows,
                              self.output)
 
     def execute_partition(self, split):

@@ -115,6 +115,8 @@ class FilterExec(TpuExec):
             keep = selection_mask(pred, ctx.num_rows, ctx.capacity)
             return compact_cols(ctx.cols, keep)
 
+        fusion = self.conf.stage_fusion_enabled
+
         def it():
             for batch in self.child.execute_partition(split):
                 acquire_semaphore(self.metrics)
@@ -127,6 +129,15 @@ class FilterExec(TpuExec):
                         new_cols, count = fuse.call_fused(
                             key, "FilterExec", build, (in_cols, nr),
                             lambda: eager(batch))
+                        if fusion and new_cols:
+                            # selective filters re-land at a right-sized
+                            # capacity so downstream programs stop paying the
+                            # stale one (ops/filtering.maybe_host_resize)
+                            from spark_rapids_tpu.ops.filtering import \
+                                maybe_host_resize
+                            resized = maybe_host_resize(new_cols, count)
+                            if resized is not None:
+                                new_cols, count = resized
                     yield ColumnarBatch([c.to_vector() for c in new_cols], count,
                                         self.output, metadata=batch.metadata)
         return self.wrap_output(it())

@@ -45,11 +45,50 @@ class ExpandExec(TpuExec):
         return self.wrap_output(it())
 
     def _expand(self, batch: ColumnarBatch, k: int) -> ColumnarBatch:
-        cap = batch.capacity
-        ctx = EvalContext.from_batch(batch)
-        per_proj = [[e.eval(ctx) for e in proj] for proj in self.projections]
+        from spark_rapids_tpu.expr.misc import CONTEXT_SENSITIVE
+        from spark_rapids_tpu.runtime import fuse
         n_rows = batch.lazy_num_rows
         out_rows = n_rows * k
+        # static output capacity: the host-known bucket when the row count is
+        # known, else the padded worst case — either way a STATIC shape, so
+        # the whole expand (k evals + interleave + re-bucket) traces as one
+        # fused program keyed on it
+        target = bucket_capacity(out_rows if isinstance(out_rows, int)
+                                 else batch.capacity * k)
+        ctx_sensitive = any(
+            e.collect(lambda x: isinstance(x, CONTEXT_SENSITIVE))
+            for proj in self.projections for e in proj)
+        if batch.columns and not ctx_sensitive:
+            key = ("expand", fuse.schema_key(self.child.output),
+                   tuple(tuple(fuse.expr_key(e) for e in proj)
+                         for proj in self.projections), target)
+
+            def build():
+                def kernel(cols, num_rows):
+                    ctx = EvalContext(cols, num_rows,
+                                      cols[0].values.shape[0])
+                    return self._expand_kernel(ctx, k, target)
+                return kernel
+
+            in_cols = [Col.from_vector(c) for c in batch.columns]
+            nr = jnp.asarray(n_rows, jnp.int32)
+            out_cols = fuse.call_fused(
+                key, "ExpandExec", build, (in_cols, nr),
+                lambda: self._expand_kernel(EvalContext.from_batch(batch),
+                                            k, target))
+        else:
+            out_cols = self._expand_kernel(EvalContext.from_batch(batch),
+                                           k, target)
+        return ColumnarBatch([c.to_vector() for c in out_cols], out_rows,
+                             self._out)
+
+    def _expand_kernel(self, ctx: EvalContext, k: int, target: int):
+        """Pure per-batch expand body (traceable): k projection evals, the
+        row-major interleave, and the re-land at the static `target`
+        capacity (downstream kernels assume power-of-two buckets)."""
+        cap = ctx.capacity
+        per_proj = [[e.eval(ctx) for e in proj] for proj in self.projections]
+        out_rows = ctx.num_rows * k
         out_cap = cap * k
         out_cols = []
         for ci, field in enumerate(self._out):
@@ -62,27 +101,9 @@ class ExpandExec(TpuExec):
             live = jnp.arange(out_cap, dtype=jnp.int64) < out_rows
             out_cols.append(Col(vals, valid & live, field.data_type,
                                 cols[0].dictionary))
-        # shrink to the bucketed output capacity when the host count is known
-        if isinstance(out_rows, int):
-            target = bucket_capacity(out_rows)
-            if target < out_cap:
-                out_cols = slice_to_capacity(out_cols, out_rows, target)
-                out_cap = target
-        # k projections make out_cap = k * 2^m; downstream kernels assume
-        # power-of-two bucket capacities (e.g. the segment range-sum tree) —
-        # pad dead rows up to the bucket
-        bucket = bucket_capacity(out_cap)
-        if bucket != out_cap:
-            pad = bucket - out_cap
-            out_cols = [
-                Col(jnp.concatenate(
-                        [c.values, jnp.zeros((pad,), c.values.dtype)]),
-                    jnp.concatenate([c.validity,
-                                     jnp.zeros((pad,), jnp.bool_)]),
-                    c.dtype, c.dictionary)
-                for c in out_cols]
-        return ColumnarBatch([c.to_vector() for c in out_cols], out_rows,
-                             self._out)
+        if target != out_cap:
+            out_cols = slice_to_capacity(out_cols, None, target)
+        return out_cols
 
     def args_string(self):
         return f"{len(self.projections)} projections"

@@ -32,7 +32,8 @@ from spark_rapids_tpu.exec.base import TpuExec, TaskContext, acquire_semaphore
 from spark_rapids_tpu.exec.coalesce import concat_all
 from spark_rapids_tpu.expr.core import Col, EvalContext, Expression, bind_references
 from spark_rapids_tpu.ops import joining as J
-from spark_rapids_tpu.ops.filtering import gather_cols, selection_mask, compact_cols
+from spark_rapids_tpu.ops.filtering import (
+    gather_cols, selection_mask, compact_cols, slice_to_capacity)
 from spark_rapids_tpu.ops.strings import union_dictionaries
 from spark_rapids_tpu.runtime import faults as F
 from spark_rapids_tpu.runtime import memory as mem
@@ -57,6 +58,59 @@ def _align_string_keys(build_keys, stream_keys):
 def _null_extended(cols, idx, valid):
     """Gather `cols` rows by idx where valid, null otherwise (outer join side)."""
     return gather_cols(cols, idx, valid)
+
+
+def _emit_pairs(join_type, stream_is_left, condition, preproject,
+                stream_batch, build_batch, build_perm, lo, hi, counts, total,
+                out_schema):
+    """Pair-expansion emit shared by HashJoinExec and the join-chain fallback:
+    expand in chunks (one fused program per chunk capacity), yield batches."""
+    from spark_rapids_tpu.runtime import fuse
+    total = int(total)
+    semi_anti = join_type in (J.LEFT_SEMI, J.LEFT_ANTI)
+    cond = condition
+    cond_key = fuse.expr_key(cond) if cond is not None else None
+    out_key = fuse.schema_key(out_schema)
+    pos = 0
+    while pos < total:
+        out_cap = bucket_capacity(min(total - pos, _MAX_CHUNK_ROWS))
+
+        def kernel(build_perm, lo, hi, counts, s_in, b_in, start, n_out,
+                   _cap=out_cap):
+            s_idx, b_idx, b_matched, live = J.expand_pairs(
+                build_perm, lo, hi, counts, start, _cap)
+            s_cols = gather_cols(s_in, s_idx, live)
+            if preproject is not None:
+                pctx = EvalContext(s_cols, n_out, _cap)
+                s_cols = [e.eval(pctx) for e in preproject]
+            if semi_anti:
+                cols = s_cols
+            else:
+                b_cols = _null_extended(b_in, b_idx, b_matched)
+                cols = (s_cols + b_cols) if stream_is_left else (b_cols + s_cols)
+            if cond is not None:
+                ctx = EvalContext(cols, n_out, _cap)
+                pred = cond.eval(ctx)
+                keep = pred.values & pred.validity & live
+                return compact_cols(cols, keep)
+            return cols, None
+
+        key = ("join_emit", semi_anti, stream_is_left, out_cap,
+               cond_key, out_key,
+               tuple(fuse.expr_key(e) for e in preproject)
+               if preproject is not None else None)
+        s_in = [Col.from_vector(c) for c in stream_batch.columns]
+        b_in = ([] if semi_anti else
+                [Col.from_vector(c) for c in build_batch.columns])
+        start = jnp.asarray(pos, jnp.int32)
+        n_out_t = jnp.asarray(min(total - pos, out_cap), jnp.int32)
+        args = (build_perm, lo, hi, counts, s_in, b_in, start, n_out_t)
+        cols, count = fuse.call_fused(key, "HashJoin.emit",
+                                      lambda: kernel, args,
+                                      lambda: kernel(*args))
+        n_out = min(total - pos, out_cap) if count is None else count
+        yield ColumnarBatch([c.to_vector() for c in cols], n_out, out_schema)
+        pos += out_cap
 
 
 def _int_backed(dtype) -> bool:
@@ -518,6 +572,83 @@ class _JoinCore:
             self.build_matched_acc |= np.asarray(matched)
         return self._build_perm, lo, hi, counts, total
 
+    # -- whole-stage join-chain surface (BroadcastHashJoinChainExec) ---------
+
+    def chain_capable(self) -> bool:
+        """True when this core's probe matches AT MOST ONE build row per
+        stream row through a shared compiled program — the property that lets
+        a stack of joins fuse into one static-shape per-batch kernel (output
+        rows <= stream rows, so stream capacity bounds every hop)."""
+        return (self.fast and not self.ctx_sensitive
+                and self.build_matched_acc is None
+                and self._probe_mode in ("dense", "one", "pallas_hash"))
+
+    def chain_static(self):
+        """Kernel-key part: everything `chain_lookup` bakes into the trace."""
+        mode = self._probe_mode
+        return (mode,
+                getattr(self, "_vmin", None) if mode == "dense" else None,
+                getattr(self, "_dense_size", None) if mode == "dense" else None,
+                getattr(self, "_hash_buckets", None)
+                if mode == "pallas_hash" else None)
+
+    def chain_args(self):
+        """Traced operands for `chain_lookup` (unused modes ride dummies so
+        the pytree shape stays uniform across modes)."""
+        mode = self._probe_mode
+        dense = (self._dense_table if mode == "dense"
+                 else jnp.zeros((1,), jnp.int32))
+        hk = (self._hash_keys if mode == "pallas_hash"
+              else jnp.zeros((1,), jnp.int64))
+        hr = (self._hash_rows if mode == "pallas_hash"
+              else jnp.zeros((1,), jnp.int32))
+        return (self._sorted_build, self._n_valid, self._build_perm,
+                dense, hk, hr)
+
+    def chain_lookup(self):
+        """Traceable single-match probe `(chain_args, stream_key_col) ->
+        (build_row, hit)`: the unique-match mode branches of
+        `_probe_batch_fast`, with the position->row mapping through
+        `_build_perm` folded in (expand_pairs does that mapping on the
+        unfused path). Validity/liveness masking is the caller's job."""
+        from spark_rapids_tpu.ops import pallas_kernels as PK
+        mode = self._probe_mode
+        vmin = getattr(self, "_vmin", 0)
+        dsize = getattr(self, "_dense_size", 0)
+        buckets = getattr(self, "_hash_buckets", 0)
+
+        def lookup(cargs, k):
+            sorted_build, n_valid, perm, dense, hk, hr = cargs
+            pcap = perm.shape[0]
+            svals = (k.values.astype(jnp.int8)
+                     if k.values.dtype == jnp.bool_ else k.values)
+            if mode == "pallas_hash":
+                pos, found = PK.hash_join_probe(
+                    hk, hr, svals.astype(jnp.int64), buckets)
+                row = perm[jnp.clip(pos, 0, pcap - 1)]
+                return jnp.where(found, row, 0).astype(jnp.int32), found
+            if mode == "dense":
+                slot = svals.astype(jnp.int64) - vmin
+                in_dom = (slot >= 0) & (slot < dsize - 1)
+                r = dense[jnp.clip(slot, 0, dsize - 1)]
+                hit = in_dom & (r >= 0)
+                row = perm[jnp.clip(r, 0, pcap - 1)]
+                return jnp.where(hit, row, 0).astype(jnp.int32), hit
+            # mode == "one": single searchsorted + equality (same common-type
+            # promotion as the unfused fast probe — casting the stream DOWN
+            # would wrap values and fabricate matches)
+            common = jnp.promote_types(svals.dtype, sorted_build.dtype)
+            sc = sorted_build.astype(common)
+            sv = svals.astype(common)
+            bcap = sc.shape[0]
+            lo = jnp.minimum(jnp.searchsorted(sc, sv, side="left"),
+                             n_valid).astype(jnp.int32)
+            found = (sc[jnp.clip(lo, 0, bcap - 1)] == sv) & (lo < n_valid)
+            row = perm[jnp.clip(lo, 0, pcap - 1)]
+            return jnp.where(found, row, 0).astype(jnp.int32), found
+
+        return lookup
+
     def unmatched_build_indices(self):
         assert self.build_matched_acc is not None
         live = np.arange(self.build_cap) < self.n_build
@@ -605,55 +736,10 @@ class HashJoinExec(TpuExec):
               total, out_schema):
         """Expand pairs in chunks (one fused program per chunk capacity) and
         yield output batches."""
-        from spark_rapids_tpu.runtime import fuse
-        total = int(total)
-        semi_anti = self.join_type in (J.LEFT_SEMI, J.LEFT_ANTI)
-        stream_is_left = self.stream_is_left
-        cond = self.condition
-        cond_key = fuse.expr_key(cond) if cond is not None else None
-        out_key = fuse.schema_key(out_schema)
-        pos = 0
-        while pos < total:
-            out_cap = bucket_capacity(min(total - pos, _MAX_CHUNK_ROWS))
-
-            preproject = self.stream_preproject
-
-            def kernel(build_perm, lo, hi, counts, s_in, b_in, start, n_out,
-                       _cap=out_cap):
-                s_idx, b_idx, b_matched, live = J.expand_pairs(
-                    build_perm, lo, hi, counts, start, _cap)
-                s_cols = gather_cols(s_in, s_idx, live)
-                if preproject is not None:
-                    pctx = EvalContext(s_cols, n_out, _cap)
-                    s_cols = [e.eval(pctx) for e in preproject]
-                if semi_anti:
-                    cols = s_cols
-                else:
-                    b_cols = _null_extended(b_in, b_idx, b_matched)
-                    cols = (s_cols + b_cols) if stream_is_left else (b_cols + s_cols)
-                if cond is not None:
-                    ctx = EvalContext(cols, n_out, _cap)
-                    pred = cond.eval(ctx)
-                    keep = pred.values & pred.validity & live
-                    return compact_cols(cols, keep)
-                return cols, None
-
-            key = ("join_emit", semi_anti, stream_is_left, out_cap,
-                   cond_key, out_key,
-                   tuple(fuse.expr_key(e) for e in self.stream_preproject)
-                   if self.stream_preproject is not None else None)
-            s_in = [Col.from_vector(c) for c in stream_batch.columns]
-            b_in = ([] if semi_anti else
-                    [Col.from_vector(c) for c in build_batch.columns])
-            start = jnp.asarray(pos, jnp.int32)
-            n_out_t = jnp.asarray(min(total - pos, out_cap), jnp.int32)
-            args = (build_perm, lo, hi, counts, s_in, b_in, start, n_out_t)
-            cols, count = fuse.call_fused(key, "HashJoin.emit",
-                                          lambda: kernel, args,
-                                          lambda: kernel(*args))
-            n_out = min(total - pos, out_cap) if count is None else count
-            yield ColumnarBatch([c.to_vector() for c in cols], n_out, out_schema)
-            pos += out_cap
+        yield from _emit_pairs(
+            self.join_type, self.stream_is_left, self.condition,
+            self.stream_preproject, stream_batch, build_batch, build_perm,
+            lo, hi, counts, total, out_schema)
 
     def _probe_stream(self, core, sb, stream_child, split, out_schema):
         """Probe+emit loop shared by the shuffled and broadcast variants,
@@ -848,6 +934,237 @@ class BroadcastHashJoinExec(HashJoinExec):
                 if reader.finish_once():
                     self._shared.close()
         return self.wrap_output(it())
+
+
+def _chainable(node) -> bool:
+    """A broadcast hash join the chain fuser may absorb: inner, single
+    int-backed equi key, no residual condition, every hoisted term
+    context-free — the static half of the contract (`_JoinCore.chain_capable`
+    checks the build-content half at run time)."""
+    from spark_rapids_tpu.expr.misc import is_context_free
+    return (type(node) is BroadcastHashJoinExec
+            and node.join_type == J.INNER and node.condition is None
+            and len(node.left_keys) == 1
+            and _int_backed(node.left_keys[0].dtype)
+            and _int_backed(node.right_keys[0].dtype)
+            and is_context_free(*node.left_keys, *node.right_keys)
+            and (node.stream_prefilter is None
+                 or is_context_free(node.stream_prefilter))
+            and (node.stream_preproject is None
+                 or is_context_free(*node.stream_preproject)))
+
+
+def maybe_chain(join, conf=None):
+    """Collapse `BHJ(stream=BHJ(...))` stacks into one
+    BroadcastHashJoinChainExec (planner hook, bottom-up: the stream child is
+    already chained if it could be). Returns `join` unchanged when the stack
+    doesn't qualify."""
+    if not _chainable(join):
+        return join
+    si = 0 if join.stream_is_left else 1
+    stream = join.children[si]
+    if isinstance(stream, BroadcastHashJoinChainExec):
+        return BroadcastHashJoinChainExec(stream.children[0],
+                                          stream.hops + [join], conf=conf)
+    if _chainable(stream):
+        si2 = 0 if stream.stream_is_left else 1
+        return BroadcastHashJoinChainExec(stream.children[si2],
+                                          [stream, join], conf=conf)
+    return join
+
+
+class BroadcastHashJoinChainExec(TpuExec):
+    """A stack of inner single-int-key broadcast hash joins probed by ONE
+    fused per-batch kernel — the whole-stage-codegen analog for q18's shape
+    (probe chains between exchanges collapse into a single XLA program).
+
+    Each absorbed join ("hop") keeps its BroadcastExchangeExec child in the
+    plan tree; this node takes over the probe side. When every hop's build
+    turns out unique-keyed at run time (`_JoinCore.chain_capable`: dense /
+    one / pallas_hash probe modes), a stream row matches at most one build
+    row per hop, so stream capacity statically bounds every intermediate —
+    probe -> gather -> probe -> gather -> compact runs as one dispatch per
+    batch instead of (project + probe + emit) per hop. The output lands at a
+    PREDICTED capacity bucket (last batch's survivor count): steady-state
+    batches pay exactly one dispatch, a mispredicted batch pays one retry at
+    full capacity. Non-unique / context-sensitive builds degrade per batch
+    to the classic sequential probe+emit path — degraded, never wrong."""
+
+    stream_child_index = 0   # the fused pipeline continues into children[0]
+
+    def __init__(self, stream, hops, conf=None):
+        super().__init__(
+            stream,
+            *[h.children[1 if h.stream_is_left else 0] for h in hops],
+            conf=conf)
+        self.hops = list(hops)
+        self._build_time = self.metrics.metric(M.BUILD_TIME, M.MODERATE)
+        self._join_time = self.metrics.metric(M.JOIN_TIME, M.MODERATE)
+
+    @property
+    def output(self) -> T.StructType:
+        return self.hops[-1].output
+
+    @property
+    def num_partitions(self):
+        return self.children[0].num_partitions
+
+    def execute_partition(self, split):
+        def it():
+            readers = [(h, h._shared.reader()) for h in self.hops]
+            try:
+                with trace_range("BroadcastHashJoin.build", self._build_time):
+                    # outermost hop first: the nested (unfused) iterators
+                    # materialize the outer join's build before pulling the
+                    # stream triggers the inner one — keep that order so
+                    # chaos schedules and memory watermarks line up
+                    sbs = [None] * len(self.hops)
+                    for i in reversed(range(len(self.hops))):
+                        sbs[i] = self.hops[i]._shared.get()
+                cores = []
+                for h, sb in zip(self.hops, sbs):
+                    bk = (h.left_keys if not h.stream_is_left
+                          else h.right_keys)
+                    sk = (h.right_keys if not h.stream_is_left
+                          else h.left_keys)
+                    cores.append(_JoinCore(
+                        sb.get_batch(), bk, sk, h.join_type,
+                        stream_prefilter=h.stream_prefilter))
+                fused_ok = all(c.chain_capable() for c in cores)
+                out_schema = self.output
+                in_rows = self.metrics.metric(M.NUM_INPUT_ROWS, M.ESSENTIAL)
+                pred_cap = [None]   # survivor-count capacity prediction
+
+                def probe(b):
+                    with trace_range("HashJoinChain.probe", self._join_time):
+                        return self._fused_probe(b, cores, sbs, pred_cap,
+                                                 out_schema)
+
+                for stream_batch in self.children[0].execute_partition(split):
+                    in_rows.add_lazy(stream_batch.lazy_num_rows)
+                    acquire_semaphore(self.metrics)
+                    if fused_ok:
+                        for out in R.with_retry([stream_batch], probe,
+                                                conf=self.conf,
+                                                scope="joins.gather"):
+                            if out is not None:
+                                yield out
+                    else:
+                        yield from self._fallback(stream_batch, cores, sbs)
+            finally:
+                # abandoned mid-stream (limit / error / cancellation): still
+                # count each reader down so the LAST one out releases its
+                # broadcast relation instead of leaking it in HBM
+                for h, r in readers:
+                    if r.finish_once():
+                        h._shared.close()
+        return self.wrap_output(it())
+
+    def _fused_probe(self, stream_batch, cores, sbs, pred_cap, out_schema):
+        """One fused program per (stream shape, output bucket): every hop's
+        key eval + prefilter + unique-match lookup + build gather + stream
+        preproject, then a single front-compaction, sliced to the predicted
+        output bucket. Returns the output batch or None (no survivors)."""
+        from spark_rapids_tpu.runtime import fuse
+        scap = stream_batch.capacity
+        specs = [(c.stream_key_exprs[0], c.stream_prefilter,
+                  h.stream_preproject, h.stream_is_left)
+                 for h, c in zip(self.hops, cores)]
+        spec_key = tuple(
+            (fuse.expr_key(sk),
+             fuse.expr_key(pf) if pf is not None else None,
+             tuple(fuse.expr_key(e) for e in pp) if pp is not None else None,
+             sil)
+            for sk, pf, pp, sil in specs)
+        statics = tuple(c.chain_static() for c in cores)
+        stream_cols = [Col.from_vector(c) for c in stream_batch.columns]
+        n_stream = jnp.asarray(stream_batch.lazy_num_rows, jnp.int32)
+        hop_args = tuple(
+            (c.chain_args(), [Col.from_vector(x)
+                              for x in sb.get_batch().columns])
+            for c, sb in zip(cores, sbs))
+
+        def run(cap):
+            key = ("join_chain", cap, statics, spec_key,
+                   fuse.schema_key(stream_batch.schema)
+                   if stream_batch.schema else None)
+
+            def build():
+                lookups = [c.chain_lookup() for c in cores]
+
+                def kernel(stream_cols, n_stream, hop_args):
+                    cap_in = stream_cols[0].values.shape[0]
+                    live = jnp.arange(cap_in, dtype=jnp.int32) < n_stream
+                    cur = stream_cols
+                    for lk, (cargs, b_cols), spec in zip(lookups, hop_args,
+                                                         specs):
+                        sk_expr, prefilter, preproject, sil = spec
+                        ctx = EvalContext(cur, n_stream, cap_in)
+                        if prefilter is not None:
+                            p = prefilter.eval(ctx)
+                            live = live & p.values & p.validity
+                        k = sk_expr.eval(ctx)
+                        row, hit = lk(cargs, k)
+                        hit = hit & k.validity & live
+                        bg = gather_cols(b_cols, jnp.where(hit, row, 0), hit)
+                        s_cols = ([e.eval(ctx) for e in preproject]
+                                  if preproject is not None else cur)
+                        cur = (s_cols + bg) if sil else (bg + s_cols)
+                        live = hit
+                    out, count = compact_cols(cur, live)
+                    if cap != cap_in:
+                        out = slice_to_capacity(out, None, cap)
+                    return out, count
+
+                return kernel
+
+            args = (stream_cols, n_stream, hop_args)
+            return fuse.call_fused(key, "HashJoinChain.probe", build, args,
+                                   lambda: build()(*args))
+
+        cap = min(pred_cap[0], scap) if pred_cap[0] is not None else scap
+        cols, count = run(cap)
+        count = int(count)   # one host sync per batch (the emit-total analog)
+        if count == 0:
+            pred_cap[0] = bucket_capacity(1)
+            return None
+        # output capacity must be bucket_capacity(count) EXACTLY — the
+        # unfused emit's chunk capacity — or downstream float reductions see
+        # a different XLA tree shape and bit-identity breaks. Steady state
+        # predicts the right bucket (1 dispatch); a miss pays one rerun.
+        tgt = bucket_capacity(count)
+        pred_cap[0] = tgt
+        if tgt != cap:
+            cols, _ = run(tgt)
+        return ColumnarBatch([c.to_vector() for c in cols], count, out_schema)
+
+    def _fallback(self, stream_batch, cores, sbs):
+        """Non-unique or context-sensitive build on some hop: probe + emit
+        each hop sequentially (exactly the unfused two-node behavior)."""
+        batches = [stream_batch]
+        for h, core, sb in zip(self.hops, cores, sbs):
+            schema = h.output
+
+            def probe(b):
+                with trace_range("HashJoin.probe", self._join_time), \
+                        R.with_restore_on_retry(core):
+                    return b, core.probe_batch(b)
+
+            nxt = []
+            for b in batches:
+                for piece, (perm, lo, hi, counts, total) in R.with_retry(
+                        [b], probe, conf=self.conf, scope="joins.gather"):
+                    nxt.extend(_emit_pairs(
+                        h.join_type, h.stream_is_left, None,
+                        h.stream_preproject, piece, sb.get_batch(), perm,
+                        lo, hi, counts, total, schema))
+            batches = nxt
+        return batches
+
+    def args_string(self):
+        return " -> ".join(
+            f"{h.join_type} lk={h.left_keys} rk={h.right_keys}"
+            for h in self.hops)
 
 
 class NestedLoopJoinExec(TpuExec):

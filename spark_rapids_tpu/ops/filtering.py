@@ -78,6 +78,103 @@ def gather_cols(cols, indices, valid_out):
     return out
 
 
+def host_compact_cols(cols, keep_mask, min_shrink: int = 4):
+    """Host-indexed stage-boundary compaction: sync the keep mask, gather the
+    survivors into a RIGHT-SIZED capacity bucket.
+
+    The in-program `compact_cols` pays a capacity-wide scatter + per-column
+    gathers (~53 ms at 1M rows on XLA:CPU) and keeps the output at the INPUT
+    capacity — a high-reduction stage (HAVING over a group-by, a selective
+    filter) then drags that stale capacity through every downstream operator.
+    One host round-trip (mask sync + np.nonzero, ~1 ms at 1M rows) instead
+    yields the survivor indices, and a tiny gather program lands the output
+    at bucket_capacity(count): the 3-row result of a 1M-capacity stage flows
+    on at capacity 8 (measured ~50x on the compaction itself, and every
+    downstream per-batch program shrinks with it — docs/perf_notes.md r7).
+
+    Returns (new_cols, count) or None when the output would not shrink by at
+    least `min_shrink` (caller falls back to the in-program compact — for
+    low-reduction stages the device path is the right one, and the sync
+    would only serialize the pipeline)."""
+    import numpy as np
+    from spark_rapids_tpu.columnar.vector import bucket_capacity
+    from spark_rapids_tpu.runtime import fuse
+
+    keep = np.asarray(keep_mask)
+    capacity = int(keep.shape[0])
+    idx = np.nonzero(keep)[0]
+    count = int(idx.size)
+    out_cap = bucket_capacity(count)
+    if out_cap * min_shrink > capacity:
+        return None
+    pad = np.zeros(out_cap, dtype=np.int32)
+    pad[:count] = idx.astype(np.int32)
+    idx_dev = jnp.asarray(pad)
+    n_t = jnp.asarray(count, jnp.int32)
+    key = ("host_compact", capacity, out_cap,
+           tuple((c.dtype, str(c.values.dtype)) for c in cols))
+
+    def build():
+        def kernel(cols, indices, n):
+            valid_out = jnp.arange(out_cap, dtype=jnp.int32) < n
+            return gather_cols(cols, indices, valid_out)
+        return kernel
+
+    out = fuse.call_fused(key, "host_compact", build, (cols, idx_dev, n_t),
+                          lambda: build()(cols, idx_dev, n_t))
+    return out, count
+
+
+def maybe_host_resize(cols, count, min_shrink: int = 4):
+    """Re-land FRONT-COMPACTED columns (survivors first, tail invalid — the
+    compact_cols output contract) at bucket_capacity(count): one host sync of
+    the live count, then a tiny fused slice program. Returns (cols, n) with a
+    HOST int count, or None when the input capacity is small or the shrink is
+    under `min_shrink` (the sync would serialize the pipeline for nothing).
+
+    This is the stage-boundary half of the host-compaction design
+    (docs/perf_notes.md r7): a high-reduction operator output stops dragging
+    its stale input capacity through every downstream per-batch program."""
+    from spark_rapids_tpu.columnar.vector import bucket_capacity
+    from spark_rapids_tpu.runtime import fuse
+
+    capacity = int(cols[0].values.shape[0])
+    if capacity < (1 << 16):
+        return None
+    n = int(count)
+    out_cap = bucket_capacity(n)
+    if out_cap * min_shrink > capacity:
+        return None
+    key = ("cap_slice", capacity, out_cap,
+           tuple((c.dtype, str(c.values.dtype)) for c in cols))
+
+    def build():
+        def kernel(cols):
+            return slice_to_capacity(cols, None, out_cap)
+        return kernel
+
+    out = fuse.call_fused(key, "cap_slice", build, (cols,),
+                          lambda: slice_to_capacity(cols, n, out_cap))
+    return out, n
+
+
+def fused_compact_cols(cols, keep_mask):
+    """compact_cols as its own fused program (device fallback for epilogues
+    whose host-compaction path declined — see host_compact_cols)."""
+    from spark_rapids_tpu.runtime import fuse
+    capacity = int(keep_mask.shape[0])
+    key = ("mask_compact", capacity,
+           tuple((c.dtype, str(c.values.dtype)) for c in cols))
+
+    def build():
+        def kernel(cols, keep):
+            return compact_cols(cols, keep)
+        return kernel
+
+    return fuse.call_fused(key, "mask_compact", build, (cols, keep_mask),
+                           lambda: compact_cols(cols, keep_mask))
+
+
 def slice_to_capacity(cols, count, new_capacity: int):
     """Shrink/grow the padded capacity (host-known count required)."""
     out = []

@@ -167,7 +167,8 @@ def resolve_dense_group_sums(reqs, codes, n_domain: int, live):
     return outs
 
 
-def group_segments(key_cols, num_rows, capacity: int, range_hint=None):
+def group_segments(key_cols, num_rows, capacity: int, range_hint=None,
+                   presorted: bool = False):
     """Sort by keys and compute segment structure.
 
     Returns (perm, seg_ids, boundary, live) where perm is the sorting permutation,
@@ -175,12 +176,22 @@ def group_segments(key_cols, num_rows, capacity: int, range_hint=None):
     overflow bucket that is later discarded), boundary marks first row of each group.
     `range_hint` forwards a caller's key-range probe to the packed sort
     (ops/sorting._packed_key) for single statically-wide int keys.
+    `presorted=True` asserts the caller PROVED the live rows already arrive
+    key-sorted (exec/aggregate's per-batch key-stats probe): the sort and the
+    key gather vanish — equal keys are contiguous by hypothesis, so segment
+    detection runs directly over the input order (the sorted-input group-by,
+    Spark's sort-aware aggregate analog).
     """
-    orders = [SortOrder() for _ in key_cols]
-    perm = sort_permutation(key_cols, orders, num_rows, capacity,
-                            range_hint=range_hint)
     live = jnp.arange(capacity, dtype=jnp.int32) < num_rows
-    sorted_keys = gather_cols(key_cols, perm, live)
+    if presorted:
+        perm = jnp.arange(capacity, dtype=jnp.int32)
+        sorted_keys = [Col(c.values, c.validity & live, c.dtype, c.dictionary)
+                       for c in key_cols]
+    else:
+        orders = [SortOrder() for _ in key_cols]
+        perm = sort_permutation(key_cols, orders, num_rows, capacity,
+                                range_hint=range_hint)
+        sorted_keys = gather_cols(key_cols, perm, live)
 
     neq = jnp.zeros((capacity,), jnp.bool_)
     for c in sorted_keys:

@@ -31,32 +31,35 @@ from spark_rapids_tpu.expr import core as E
 _DATA_FIELDS = ("partitions", "paths")
 
 
-def _norm_expr(e) -> tuple:
+def _norm_expr(e, literals: bool = False) -> tuple:
     if isinstance(e, E.Literal):
-        return ("lit", repr(e.dtype))
+        return (("lit", repr(e.dtype), repr(e.value)) if literals
+                else ("lit", repr(e.dtype)))
     parts = [type(e).__qualname__]
     d = vars(e) if hasattr(e, "__dict__") else {
         s: getattr(e, s, None) for s in getattr(e, "__slots__", ())}
     for k in sorted(d):
         if k == "children":
             continue
-        parts.append((k, _norm(d[k])))
-    parts.append(tuple(_norm_expr(c) for c in getattr(e, "children", ())))
+        parts.append((k, _norm(d[k], literals)))
+    parts.append(tuple(_norm_expr(c, literals)
+                       for c in getattr(e, "children", ())))
     return tuple(parts)
 
 
-def _norm(v):
+def _norm(v, literals: bool = False):
     if isinstance(v, E.Expression):
-        return _norm_expr(v)
+        return _norm_expr(v, literals)
     if isinstance(v, T.StructType):
         return ("schema", tuple((f.name, repr(f.data_type), bool(f.nullable))
                                 for f in v))
     if isinstance(v, T.DataType):
         return repr(v)
     if isinstance(v, (list, tuple)):
-        return tuple(_norm(x) for x in v)
+        return tuple(_norm(x, literals) for x in v)
     if isinstance(v, dict):
-        return tuple(sorted((str(k), _norm(x)) for k, x in v.items()))
+        return tuple(sorted((str(k), _norm(x, literals))
+                            for k, x in v.items()))
     if isinstance(v, (str, int, float, bool, bytes, type(None))):
         return (type(v).__name__, v)
     if isinstance(v, type):
@@ -66,7 +69,7 @@ def _norm(v):
     return ("obj", type(v).__qualname__)
 
 
-def _norm_node(node) -> tuple:
+def _norm_node(node, literals: bool = False) -> tuple:
     parts = [node.name()]
     d = vars(node) if hasattr(node, "__dict__") else {}
     for k in sorted(d):
@@ -75,13 +78,13 @@ def _norm_node(node) -> tuple:
         if k.lstrip("_") in _DATA_FIELDS:
             parts.append((k, ("data",)))
             continue
-        parts.append((k, _norm(d[k])))
+        parts.append((k, _norm(d[k], literals)))
     try:
         out = node.output
         parts.append(("out", tuple((f.name, repr(f.data_type)) for f in out)))
     except Exception:
         pass
-    parts.append(tuple(_norm_node(c) for c in node.children))
+    parts.append(tuple(_norm_node(c, literals) for c in node.children))
     return tuple(parts)
 
 
@@ -95,4 +98,13 @@ def plan_fingerprint(plan) -> str:
     """Stable hex fingerprint of a plan's shape. Equal across runs and
     processes for equal shapes (sha256 over the canonical repr)."""
     canon = repr(plan_shape(plan)).encode()
+    return hashlib.sha256(canon).hexdigest()[:16]
+
+
+def plan_signature(plan) -> str:
+    """Like `plan_fingerprint` but with literal VALUES kept: the identity a
+    compiled-program cache needs (`WHERE qty > 300` and `> 314` trace to
+    DIFFERENT XLA programs — the literal is a baked-in constant), where the
+    stats plane deliberately wants them to collide."""
+    canon = repr(_norm_node(plan, literals=True)).encode()
     return hashlib.sha256(canon).hexdigest()[:16]

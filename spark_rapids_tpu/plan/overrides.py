@@ -679,6 +679,19 @@ def _register_all():
         return XB.ProjectExec(meta.node.project_list, kids[0], conf=meta.conf)
 
     def conv_filter(meta, kids):
+        # HAVING fusion: a Filter directly above a finalizing aggregate folds
+        # into the finalize kernel (exec/aggregate.fuse_having) — the
+        # separate FilterExec dispatch and its full-width capacity disappear,
+        # and the surviving groups re-land right-sized. Semantics-preserving:
+        # the predicate sees exactly the aggregate's output columns.
+        from spark_rapids_tpu.expr.misc import is_context_free
+        child = kids[0]
+        if (meta.conf.stage_fusion_enabled
+                and isinstance(child, XA.HashAggregateExec)
+                and child.mode != XA.PARTIAL
+                and is_context_free(meta.node.condition)):
+            child.fuse_having(meta.node.condition)
+            return child
         return XB.FilterExec(meta.node.condition, kids[0], conf=meta.conf)
 
     def conv_limit(meta, kids):
@@ -714,19 +727,26 @@ def _register_all():
 
         prefilter = preproject = None
         pre_on_proj = False
-        if isinstance(child, XB.FilterExec) and clean_filter(child):
-            prefilter = child.condition           # Agg(Filter(...))
-            child = child.children[0]
-            if isinstance(child, XB.ProjectExec) and clean_project(child):
-                preproject = child.project_list   # Agg(Filter(Project(x)))
-                child = child.children[0]
-                pre_on_proj = True                # condition binds to project
-        elif isinstance(child, XB.ProjectExec) and clean_project(child):
-            preproject = child.project_list       # Agg(Project(...))
-            child = child.children[0]
+        if meta.conf.stage_fusion_enabled:
+            # arbitrary-depth Filter/Project stacks compose into raw-terms
+            # (prefilter, preproject) via BoundReference substitution
+            from spark_rapids_tpu.plan.stages import compose_prestage
+            prefilter, preproject, child = compose_prestage(child)
+        else:
+            # legacy depth-2 patterns (fusion knob off)
             if isinstance(child, XB.FilterExec) and clean_filter(child):
-                prefilter = child.condition       # Agg(Project(Filter(x)))
+                prefilter = child.condition           # Agg(Filter(...))
                 child = child.children[0]
+                if isinstance(child, XB.ProjectExec) and clean_project(child):
+                    preproject = child.project_list   # Agg(Filter(Project(x)))
+                    child = child.children[0]
+                    pre_on_proj = True                # condition binds to proj
+            elif isinstance(child, XB.ProjectExec) and clean_project(child):
+                preproject = child.project_list       # Agg(Project(...))
+                child = child.children[0]
+                if isinstance(child, XB.FilterExec) and clean_filter(child):
+                    prefilter = child.condition       # Agg(Project(Filter(x)))
+                    child = child.children[0]
         fused = dict(prefilter=prefilter, preproject=preproject,
                      prefilter_on_projected=pre_on_proj)
         if child.num_partitions == 1 or not n.group_exprs:
@@ -791,11 +811,14 @@ def _register_all():
                 jt, n.left_keys, n.right_keys, lex, rex,
                 condition=n.condition, build_side=build_side, conf=meta.conf)
         # whole-stage hoist of the stream side's Filter (and an intervening
-        # Project) into the probe/emit kernels — inner single-int-key joins
-        # only: filtered rows emit zero pairs, so no semantics change;
-        # outer/semi/anti emit per-unfiltered-row and keep their FilterExec.
-        # Broadcast path only — the mesh path partitions the stream BEFORE
-        # probing and must filter pre-exchange.
+        # Project), or a bare Project, into the probe/emit kernels — inner
+        # single-int-key joins only: filtered rows emit zero pairs, so no
+        # semantics change; outer/semi/anti emit per-unfiltered-row and keep
+        # their FilterExec. A bare Project's exprs re-derive on post-join
+        # gathered rows in the emit kernel, so the full-width projected
+        # intermediate never materializes. Broadcast path only — the mesh
+        # path partitions the stream BEFORE probing and must filter
+        # pre-exchange.
         stream_prefilter = stream_preproject = stream_schema = None
         left_keys, right_keys = n.left_keys, n.right_keys
         if jt == "inner" and len(n.left_keys) == 1:
@@ -804,21 +827,25 @@ def _register_all():
 
             si = 0 if build_side == "right" else 1
             skid = (left, right)[si]
-            proj = None
+            proj = fkid = None
             if (isinstance(skid, XB.ProjectExec)
                     and isinstance(skid.children[0], XB.FilterExec)
                     and clean(*skid.project_list)):
                 proj, fkid = skid, skid.children[0]
             elif isinstance(skid, XB.FilterExec):
                 fkid = skid
-            else:
-                fkid = None
-            if (fkid is not None
+            elif (meta.conf.stage_fusion_enabled
+                    and isinstance(skid, XB.ProjectExec)
+                    and clean(*skid.project_list)):
+                proj = skid   # bare Project: emit-kernel hoist, no prefilter
+            if ((proj is not None or fkid is not None)
                     and _XJm._int_backed(n.left_keys[0].dtype)
                     and _XJm._int_backed(n.right_keys[0].dtype)
-                    and clean(fkid.condition, *n.left_keys, *n.right_keys)):
-                stream_prefilter = fkid.condition
-                new_kid = fkid.children[0]
+                    and clean(*n.left_keys, *n.right_keys)
+                    and (fkid is None or clean(fkid.condition))):
+                stream_prefilter = (fkid.condition if fkid is not None
+                                    else None)
+                new_kid = (fkid if fkid is not None else proj).children[0]
                 skeys = list((left_keys, right_keys)[si])
                 if proj is not None:
                     # keys were bound against the project's output: substitute
@@ -839,12 +866,17 @@ def _register_all():
                     left, left_keys = new_kid, skeys
                 else:
                     right, right_keys = new_kid, skeys
-        return XJ.BroadcastHashJoinExec(
+        bhj = XJ.BroadcastHashJoinExec(
             jt, left_keys, right_keys, left, right, condition=n.condition,
             build_side=build_side, conf=meta.conf,
             stream_prefilter=stream_prefilter,
             stream_preproject=stream_preproject,
             stream_schema=stream_schema)
+        if meta.conf.stage_fusion_enabled:
+            # probe-chain fusion: a BHJ whose stream child is another BHJ (or
+            # an already-formed chain) collapses into one per-batch kernel
+            return XJ.maybe_chain(bhj, conf=meta.conf)
+        return bhj
 
     def conv_sort(meta, kids):
         from spark_rapids_tpu.ops.sorting import SortOrder

@@ -89,6 +89,12 @@ KNOWN_EVENTS = frozenset({
     # the per-node cardinality/dispatch/transfer ledger and per-shuffle
     # reduce-partition sizes with skew summaries
     "plan.stats",
+    # whole-stage fusion plane (plan/stages.py + runtime/stage_cache.py):
+    # one record per fused stage at plan time (members + absorbed logical
+    # operators — the join key against plan.stats node dispatches), and a
+    # persistent-cache entry that failed to deserialize and was dropped in
+    # favor of a retrace
+    "stage.fused", "stage.cache.corrupt",
 })
 
 # events that only make sense inside a query's dynamic extent; the profiler
@@ -98,7 +104,7 @@ QUERY_SCOPED_EVENTS = frozenset({
     "stage.map.start", "stage.map.end",
     "query.queued", "query.admitted", "query.shed",
     "query.cancelled", "query.deadline", "query.demoted",
-    "plan.stats",
+    "plan.stats", "stage.fused",
 })
 
 _lock = threading.Lock()

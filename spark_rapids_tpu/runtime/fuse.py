@@ -42,6 +42,12 @@ _MAX_KERNELS = 2048
 # not kernel objects.
 _MAX_EXECUTABLES = 900
 _inserts = 0
+# the get_kernel eviction only ran on INSERTS, so a long-lived multi-shape
+# stage kernel could accumulate executables between inserts and silently
+# blow the LLVM code-memory backstop; traces are the event that actually
+# grows the executable population, so sweeps are also trace-driven
+_SWEEP_EVERY_TRACES = 32
+_last_sweep_traces = 0
 
 # counters are module-global (queries share kernels); reset via reset_metrics()
 _counts = {"traces": 0, "dispatches": 0}
@@ -67,9 +73,21 @@ def stage_metrics() -> dict:
 
 
 def reset_metrics():
+    global _last_sweep_traces
     with _lock:
         _counts["traces"] = 0
         _counts["dispatches"] = 0
+        _last_sweep_traces = 0
+
+
+class _Unset:
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<unset>"
+
+
+_UNSET = _Unset()
 
 
 class BatchKernel:
@@ -77,12 +95,28 @@ class BatchKernel:
 
     The wrapped python body runs once per (shape, dtype, aux) signature —
     counting its executions counts XLA compiles; counting __call__ counts
-    dispatches."""
+    dispatches.
 
-    __slots__ = ("name", "_jit")
+    When the persistent stage cache is configured (runtime/stage_cache.py)
+    and the semantic key has a stable cross-process digest, compiled
+    executables are looked up / saved through `jax.jit(...).lower().compile()`
+    + serialize_executable instead of the in-process jit cache: a fresh
+    process replays the stored XLA executable with ZERO Python traces. Any
+    undigestable key or argument signature quietly falls back to the plain
+    jit path — the cache is an accelerator, never a correctness gate."""
 
-    def __init__(self, fn, name: str):
+    __slots__ = ("name", "_jit", "_key", "_digest", "_compiled")
+
+    # bound per-kernel: a fused multi-shape stage kernel may legitimately
+    # hold many signatures, but FIFO-dropping the oldest keeps any one
+    # kernel from monopolizing the executable budget
+    _MAX_SIGS = 64
+
+    def __init__(self, fn, name: str, key=None):
         self.name = name
+        self._key = key
+        self._digest = _UNSET       # lazily: hex str, or None (undigestable)
+        self._compiled: dict = {}   # sig digest -> AOT-loaded executable
 
         def traced(*args):
             with _lock:
@@ -96,26 +130,144 @@ class BatchKernel:
         self._jit = jax.jit(traced)
 
     def cache_size(self) -> int:
-        """Live compiled-executable count (one per traced shape signature)."""
+        """Live compiled-executable count: one per traced shape signature in
+        the jit cache PLUS one per AOT executable held for the persistent
+        stage cache (a fused stage kernel can hold many — the budget must see
+        them all, not just the jit side)."""
+        n = len(self._compiled)
         try:
-            return max(int(self._jit._cache_size()), 1)
+            return max(int(self._jit._cache_size()) + n, 1)
         except Exception:
-            return 1
+            return max(n, 1)
+
+    def _dispatch(self, args):
+        from spark_rapids_tpu.runtime import stage_cache as _SC
+        store = _SC.get()
+        if store is not None:
+            if self._digest is _UNSET:
+                self._digest = (key_digest(self._key)
+                                if self._key is not None else None)
+            if self._digest is not None:
+                sig = _sig_digest(args)
+                if sig is not None:
+                    return self._dispatch_persistent(store, sig, args)
+        return self._jit(*args)
+
+    def _dispatch_persistent(self, store, sig, args):
+        exe = self._compiled.get(sig)
+        if exe is None:
+            # platform + jax version namespace the entry: a shared cache dir
+            # must never hand a CPU executable to a TPU session (or a new
+            # jax an old serialization format)
+            entry = f"{_backend_tag()}-{self._digest}-{sig}"
+            data = store.load(entry)
+            if data is not None:
+                try:
+                    exe = _deserialize_executable(data)
+                except Exception as e:  # noqa: BLE001 — corrupt entry:
+                    # degrade to retrace-with-warning, never failure
+                    store.invalidate(entry, repr(e))
+                    exe = None
+            if exe is None:
+                # cold: AOT-compile through the counting wrapper (the trace
+                # lands in the ledger exactly like a jit-path trace)
+                exe = self._jit.lower(*args).compile()
+                try:
+                    data = _serialize_executable(exe)
+                    # round-trip validation before the entry lands on disk:
+                    # an executable rehydrated from jax's own persistent
+                    # compile cache serializes WITHOUT its object code
+                    # ("Symbols not found" on the next load) — better a
+                    # memory-only kernel now than a corrupt entry later
+                    _deserialize_executable(data)
+                    store.save(entry, data)
+                except Exception as e:  # noqa: BLE001 — unserializable
+                    store.note_unserializable(entry, repr(e))
+            with _lock:
+                while len(self._compiled) >= self._MAX_SIGS:
+                    self._compiled.pop(next(iter(self._compiled)))
+                self._compiled[sig] = exe
+        return exe(*args)
 
     def __call__(self, *args):
+        global _last_sweep_traces
+        do_sweep = False
         with _lock:
             _counts["dispatches"] += 1
+            # trace-driven executable sweep (multi-shape stage kernels grow
+            # the executable population WITHOUT get_kernel inserts)
+            if _counts["traces"] - _last_sweep_traces >= _SWEEP_EVERY_TRACES:
+                _last_sweep_traces = _counts["traces"]
+                do_sweep = True
+        if do_sweep:
+            _sweep_executables()
         _M.compile_add("dispatches")
         if _PROFILE:
             import time
             t0 = time.perf_counter()
-            out = jax.block_until_ready(self._jit(*args))
+            out = jax.block_until_ready(self._dispatch(args))
             dt = time.perf_counter() - t0
             with _lock:
                 tot, n = _profile.get(self.name, (0.0, 0))
                 _profile[self.name] = (tot + dt, n + 1)
             return out
-        return self._jit(*args)
+        return self._dispatch(args)
+
+
+_backend_tag_memo = None
+
+# BUMP whenever any kernel BODY changes behavior under an unchanged semantic
+# key: persistent entries are keyed by (semantic key, arg signature), not by
+# the traced HLO, so a stale store replaying an old program would be a silent
+# wrong answer — the version tag turns it into a cache miss instead.
+KERNEL_CACHE_VERSION = 1
+
+
+def _backend_tag() -> str:
+    global _backend_tag_memo
+    if _backend_tag_memo is None:
+        import spark_rapids_tpu as _pkg
+        _backend_tag_memo = (f"{jax.devices()[0].platform}-{jax.__version__}-"
+                             f"{_pkg.__version__}-k{KERNEL_CACHE_VERSION}")
+    return _backend_tag_memo
+
+
+def _serialize_executable(exe) -> bytes:
+    import pickle
+    from jax.experimental import serialize_executable as _se
+    payload, in_tree, out_tree = _se.serialize(exe)
+    return pickle.dumps((payload, in_tree, out_tree))
+
+
+def _deserialize_executable(data: bytes):
+    import pickle
+    from jax.experimental import serialize_executable as _se
+    payload, in_tree, out_tree = pickle.loads(data)
+    return _se.deserialize_and_load(payload, in_tree, out_tree)
+
+
+def _sweep_locked() -> list:
+    """Evict oldest kernels (insertion order) until the live-executable total
+    is comfortably under budget. Caller holds _lock; returns the evicted
+    kernels so their destructors can run outside it."""
+    evicted = []
+    total = sum(kk.cache_size() for kk in _kernels.values()
+                if isinstance(kk, BatchKernel))   # skip _EAGER
+    if total > _MAX_EXECUTABLES or len(_kernels) >= _MAX_KERNELS:
+        order = list(_kernels)
+        while order and (total > int(_MAX_EXECUTABLES * 0.75)
+                         or len(_kernels) >= _MAX_KERNELS):
+            victim = _kernels.pop(order.pop(0))
+            if isinstance(victim, BatchKernel):
+                total -= victim.cache_size()
+                evicted.append(victim)
+    return evicted
+
+
+def _sweep_executables():
+    with _lock:
+        evicted = _sweep_locked()
+    del evicted   # destructors run outside the lock
 
 
 def get_kernel(key, name: str, build) -> BatchKernel:
@@ -127,23 +279,12 @@ def get_kernel(key, name: str, build) -> BatchKernel:
         k = _kernels.get(key)
     if k is not None:
         return k
-    k = BatchKernel(build(), name)
+    k = BatchKernel(build(), name, key=key)
     evicted = []
     with _lock:
         _inserts += 1
         if len(_kernels) >= _MAX_KERNELS or _inserts % 32 == 0:
-            total = sum(kk.cache_size() for kk in _kernels.values()
-                        if isinstance(kk, BatchKernel))   # skip _EAGER
-            if total > _MAX_EXECUTABLES or len(_kernels) >= _MAX_KERNELS:
-                # evict oldest (insertion order) until comfortably under
-                # budget; anything hot re-traces on next use
-                order = list(_kernels)
-                while order and (total > int(_MAX_EXECUTABLES * 0.75)
-                                 or len(_kernels) >= _MAX_KERNELS):
-                    victim = _kernels.pop(order.pop(0))
-                    if isinstance(victim, BatchKernel):
-                        total -= victim.cache_size()
-                        evicted.append(victim)
+            evicted = _sweep_locked()
         out = _kernels.setdefault(key, k)
     del evicted   # destructors run outside the lock
     return out
@@ -333,3 +474,112 @@ class DictRef:
 
     def __repr__(self):
         return f"DictRef(len={len(self.arr)})"
+
+
+# -- cross-process digests (persistent compiled-stage cache) ------------------
+#
+# The in-memory semantic keys above only need to be HASHABLE; the on-disk
+# stage cache additionally needs keys that are STABLE ACROSS PROCESSES, so
+# they are reduced to a sha256 over a canonical byte encoding. Anything
+# without a stable content encoding (UNKEYABLE markers, foreign objects)
+# makes the whole key undigestable and the kernel stays memory-only.
+
+import hashlib as _hashlib
+
+
+class _Undigestable(Exception):
+    pass
+
+
+def _hash_part(h, v):
+    from spark_rapids_tpu import types as T
+    if v is None or isinstance(v, (bool, int, float, str)):
+        h.update(f"{type(v).__name__}:{v!r};".encode())
+    elif isinstance(v, bytes):
+        h.update(b"b:")
+        h.update(v)
+        h.update(b";")
+    elif isinstance(v, tuple) or isinstance(v, list):
+        h.update(f"t{len(v)}(".encode())
+        for p in v:
+            _hash_part(h, p)
+        h.update(b")")
+    elif isinstance(v, T.DataType):
+        h.update(f"dt:{v!r};".encode())
+    elif isinstance(v, DictRef):
+        h.update(f"dr:{_dict_digest(v.arr)};".encode())
+    elif v is _EAGER or isinstance(v, _Unkeyable):
+        raise _Undigestable(v)
+    else:
+        raise _Undigestable(v)
+
+
+def key_digest(key) -> str | None:
+    """Stable cross-process hex digest of a semantic kernel key, or None when
+    some component has no canonical byte encoding (those kernels never reach
+    the persistent stage cache)."""
+    h = _hashlib.sha256()
+    try:
+        _hash_part(h, key)
+    except _Undigestable:
+        return None
+    return h.hexdigest()[:32]
+
+
+# host string dictionaries recur across batches; content digests are memoized
+# by (id, len) — the len guard keeps an address-reuse collision from pairing
+# a freed array's digest with a different same-address dictionary of equal
+# length (astronomically unlikely to ALSO hash-collide, and the persistent
+# cache is advisory)
+_dict_digest_memo: dict = {}
+
+
+def _dict_digest(arr) -> str:
+    k = (id(arr), len(arr))
+    v = _dict_digest_memo.get(k)
+    if v is None:
+        h = _hashlib.sha256()
+        for s in arr:
+            h.update(repr(s).encode())
+            h.update(b"\x00")
+        v = h.hexdigest()[:16]
+        if len(_dict_digest_memo) > 4096:
+            _dict_digest_memo.clear()
+        _dict_digest_memo[k] = v
+    return v
+
+
+def _sig_digest(args) -> str | None:
+    """Per-call argument-signature digest: everything `jax.jit` keys its own
+    cache on (pytree structure, array shapes/dtypes, static leaves) reduced
+    to a stable string. Python scalars are weak-typed DYNAMIC jit arguments —
+    their VALUE is not baked into the program, so they contribute type only.
+    Returns None for unsupported leaves (that call falls back to plain jit)."""
+    h = _hashlib.sha256()
+    try:
+        _sig_part(h, args)
+    except _Undigestable:
+        return None
+    return h.hexdigest()[:32]
+
+
+def _sig_part(h, v):
+    from spark_rapids_tpu.expr.core import Col
+    if isinstance(v, Col):
+        d = _dict_digest(v.dictionary) if v.dictionary is not None else None
+        h.update(f"C:{v.dtype!r}:{v.values.shape}:{v.values.dtype}:"
+                 f"{v.validity.shape}:{d};".encode())
+    elif isinstance(v, (tuple, list)):
+        h.update(f"t{len(v)}(".encode())
+        for p in v:
+            _sig_part(h, p)
+        h.update(b")")
+    elif isinstance(v, bool) or isinstance(v, (int, float)):
+        # weak-typed dynamic scalar: type matters, value does not
+        h.update(f"s:{type(v).__name__};".encode())
+    elif v is None:
+        h.update(b"n;")
+    elif hasattr(v, "shape") and hasattr(v, "dtype"):
+        h.update(f"a:{v.shape}:{v.dtype};".encode())
+    else:
+        raise _Undigestable(v)

@@ -1,0 +1,203 @@
+"""Persistent compiled-stage cache — XLA executables on disk across sessions.
+
+Reference contrast: the reference pays cudf JIT/PTX compilation per process
+and leans on the CUDA driver's own binary cache; here every fused stage is an
+XLA program whose compile cost (seconds per shape signature on the remote
+compiler path) recurs on EVERY fresh session. This store keeps the serialized
+executables (jax AOT export, `jax.experimental.serialize_executable`) keyed
+by the kernel's cross-process semantic-key digest + argument-signature digest
+(runtime/fuse.key_digest / _sig_digest), so a fresh session's first run of a
+known query shape replays stored programs with ZERO Python traces.
+
+Failure posture mirrors runtime/history.py: a corrupt/unreadable entry is
+deleted, logged once, surfaced as a `stage.cache.corrupt` event, and the
+kernel silently retraces — the cache can only ever cost a recompile, never a
+query. Writes are atomic (tmp + os.replace); the directory is pruned to
+`maxBytes` by mtime LRU after each save.
+
+Wiring: TpuSession.__init__ configures the process-global store from the
+`spark.rapids.tpu.sql.stage.cache.{enabled,dir,maxBytes}` knobs (explicit
+settings only — the other process-global planes follow the same rule).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+
+_SUFFIX = ".xc"
+
+
+class StageCacheStore:
+    """One directory of serialized XLA executables, one file per
+    (kernel-key digest, argument-signature digest) entry."""
+
+    def __init__(self, directory: str, max_bytes: int = 256 << 20):
+        self.directory = directory
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._warned = False
+        # observability counters (tests + profiler read these)
+        self.hits = 0
+        self.misses = 0
+        self.saves = 0
+        self.corrupt = 0
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, entry: str) -> str:
+        return os.path.join(self.directory, entry + _SUFFIX)
+
+    def load(self, entry: str) -> bytes | None:
+        try:
+            with open(self._path(entry), "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            with self._lock:
+                self.misses += 1
+            return None
+        except OSError as e:
+            self._warn_once(f"unreadable stage-cache entry {entry}: {e!r}")
+            with self._lock:
+                self.misses += 1
+            return None
+        with self._lock:
+            self.hits += 1
+        return data
+
+    def save(self, entry: str, data: bytes) -> None:
+        if len(data) > self.max_bytes:
+            return
+        path = self._path(entry)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        except OSError as e:
+            self._warn_once(f"stage-cache write failed: {e!r}")
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return
+        with self._lock:
+            self.saves += 1
+        self._prune()
+
+    def invalidate(self, entry: str, reason: str) -> None:
+        """A stored executable failed to deserialize: delete it, log once,
+        emit a warning event — the caller retraces (degraded, never fatal)."""
+        with self._lock:
+            self.corrupt += 1
+        try:
+            os.unlink(self._path(entry))
+        except OSError:
+            pass
+        self._warn_once(
+            f"corrupt stage-cache entry {entry} ({reason}); retracing")
+        try:
+            from spark_rapids_tpu.runtime import eventlog as EL
+            if EL.enabled():
+                EL.emit("stage.cache.corrupt", entry=entry, reason=reason)
+        except Exception:  # noqa: BLE001 — observability must not fail a query
+            pass
+
+    def note_unserializable(self, entry: str, reason: str) -> None:
+        """An executable compiled but would not serialize (backend-specific);
+        the kernel keeps working memory-only."""
+        self._warn_once(
+            f"stage-cache entry {entry} not serializable ({reason}); "
+            "kernel stays memory-only")
+
+    def entries(self) -> list:
+        try:
+            return sorted(n[:-len(_SUFFIX)] for n in os.listdir(self.directory)
+                          if n.endswith(_SUFFIX))
+        except OSError:
+            return []
+
+    def total_bytes(self) -> int:
+        total = 0
+        try:
+            for n in os.listdir(self.directory):
+                if n.endswith(_SUFFIX):
+                    total += os.path.getsize(os.path.join(self.directory, n))
+        except OSError:
+            pass
+        return total
+
+    def _prune(self) -> None:
+        """mtime-LRU down to max_bytes (oldest executables are the ones least
+        likely to match a current plan shape)."""
+        try:
+            files = []
+            for n in os.listdir(self.directory):
+                if not n.endswith(_SUFFIX):
+                    continue
+                p = os.path.join(self.directory, n)
+                st = os.stat(p)
+                files.append((st.st_mtime, st.st_size, p))
+        except OSError:
+            return
+        total = sum(sz for _, sz, _ in files)
+        if total <= self.max_bytes:
+            return
+        files.sort()
+        for _, sz, p in files:
+            if total <= self.max_bytes:
+                break
+            try:
+                os.unlink(p)
+                total -= sz
+            except OSError:
+                pass
+
+    def _warn_once(self, msg: str) -> None:
+        with self._lock:
+            if self._warned:
+                return
+            self._warned = True
+        warnings.warn(f"spark_rapids_tpu stage cache: {msg}", RuntimeWarning,
+                      stacklevel=3)
+
+
+# -- process-global instance (the runtime/history.py configure idiom) --------
+
+_ilock = threading.Lock()
+_store: StageCacheStore | None = None
+
+
+def _disable_jax_persistent_compile_cache() -> None:
+    """An executable rehydrated from jax's own persistent compile cache
+    serializes WITHOUT its object code — every store entry saved from one
+    fails with "Symbols not found" in the next session. jax memoizes the
+    cache-enabled check at the first compile, so the only reliable posture
+    is to switch its cache off BEFORE anything compiles: the stage cache
+    subsumes its role for fused stages (which dominate compile time), and
+    fuse.py's save-time round-trip validation backstops late enables."""
+    try:
+        import jax
+        jax.config.update("jax_enable_compilation_cache", False)
+    except Exception:  # noqa: BLE001 — a missing knob must not fail a session
+        pass
+
+
+def configure(directory: str, max_bytes: int = 256 << 20) -> StageCacheStore:
+    global _store
+    with _ilock:
+        if (_store is None or _store.directory != directory
+                or _store.max_bytes != int(max_bytes)):
+            _disable_jax_persistent_compile_cache()
+            _store = StageCacheStore(directory, max_bytes)
+        return _store
+
+
+def get() -> StageCacheStore | None:
+    return _store
+
+
+def shutdown() -> None:
+    global _store
+    with _ilock:
+        _store = None

@@ -266,8 +266,20 @@ class DataFrame:
         return [f.name for f in self._plan.output]
 
     def explain(self, all_nodes: bool = True, metrics: bool = False,
-                stats: bool = False) -> str:
+                stats: bool = False, fused: bool = False) -> str:
         from spark_rapids_tpu.plan.overrides import explain_plan
+        if fused:
+            # whole-stage view: the exec tree with Spark's `*(k)` stage
+            # markers plus a per-stage summary of members and fused-in
+            # operators; after an action the last collector's tree is reused
+            # so per-node dispatch counts ride along
+            from spark_rapids_tpu.plan.overrides import TpuOverrides
+            from spark_rapids_tpu.plan.stages import explain_fused
+            c = self._last_collector
+            if c is not None and c.root is not None:
+                return explain_fused(c.root, c)
+            return explain_fused(
+                TpuOverrides(self.session.conf).apply(self._plan))
         if metrics or stats:
             # SQL-UI analog: the executed plan tree annotated per node with
             # its metric snapshot — requires a completed action on this frame
@@ -338,6 +350,9 @@ class DataFrame:
                 tracing.span("query", query=collector.query_id):
             hybrid = TpuOverrides(conf).apply(plan)
             collector.set_root(hybrid)
+            if EL.enabled():
+                from spark_rapids_tpu.plan.stages import emit_stage_events
+                emit_stage_events(hybrid, collector.query_id)
             try:
                 queue_timeout = conf.get(CFG.SCHEDULER_QUEUE_TIMEOUT)
                 # admission footprint: per-shape observed history when the
@@ -770,6 +785,19 @@ class TpuSession:
                                self.conf.get(CFG.STATS_HISTORY_MAX_SHAPES))
             else:
                 HIST.shutdown()
+        # persistent compiled-stage cache (runtime/stage_cache.py):
+        # process-global like the switches above — only an EXPLICIT setting
+        # opens (or closes, when disabled or the dir is empty) the store
+        if any(k.key in self.conf.settings for k in (
+                CFG.STAGE_CACHE_ENABLED, CFG.STAGE_CACHE_DIR,
+                CFG.STAGE_CACHE_MAX_BYTES)):
+            from spark_rapids_tpu.runtime import stage_cache
+            sc_dir = self.conf.get(CFG.STAGE_CACHE_DIR)
+            if self.conf.stage_cache_enabled and sc_dir:
+                stage_cache.configure(
+                    sc_dir, self.conf.get(CFG.STAGE_CACHE_MAX_BYTES))
+            else:
+                stage_cache.shutdown()
         # multi-tenant query scheduler (runtime/scheduler.py): STRUCTURAL
         # knobs (concurrency, queue depth, aging) are process-global like
         # the switches above — only an EXPLICIT setting reconfigures the

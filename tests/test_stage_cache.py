@@ -1,0 +1,120 @@
+"""Persistent compiled-stage cache: cross-session round-trip (a FRESH
+PROCESS replays stored XLA executables with zero traces), corruption
+degrading to a warned retrace, LRU pruning, and the session conf wiring.
+
+Both the populate and the replay sessions run as subprocesses: the
+zero-traces contract is a statement about PROCESS boundaries, and a pytest
+parent is a poor stand-in for a fresh session — its jax persistent compile
+cache is already warm and memoized on, which is exactly the hazard
+stage_cache.configure() defuses for real sessions."""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import spark_rapids_tpu
+from spark_rapids_tpu.runtime import stage_cache
+from spark_rapids_tpu.session import TpuSession
+
+SF = 0.01
+
+_CHILD = r"""
+import json, sys
+from spark_rapids_tpu.benchmarks import tpch
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.runtime import fuse, stage_cache
+paths = tpch.generate(%r, %r)
+spark = TpuSession({
+    "spark.rapids.tpu.sql.stage.cache.enabled": True,
+    "spark.rapids.tpu.sql.stage.cache.dir": sys.argv[1]})
+dfs = tpch.load(spark, paths)
+rows = tpch.QUERIES["q18"](dfs).collect().to_pylist()
+st = stage_cache.get()
+print(json.dumps({"rows": rows, "traces": fuse.stage_metrics()["traces"],
+                  "hits": st.hits, "misses": st.misses, "saves": st.saves,
+                  "corrupt": st.corrupt}))
+"""
+
+
+def _run_session(tmp_path, cache_dir):
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD % (SF, f"/tmp/tpch_sf{SF}"))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(spark_rapids_tpu.__file__))
+        + os.pathsep + env.get("PYTHONPATH", ""))
+    p = subprocess.run([sys.executable, str(script), cache_dir],
+                       capture_output=True, text=True, env=env, timeout=300)
+    assert p.returncode == 0, p.stderr
+    return json.loads(p.stdout.splitlines()[-1]), p.stderr
+
+
+def test_cross_session_roundtrip_and_corruption(tmp_path):
+    cdir = str(tmp_path / "stagecache")
+
+    # session 1: populate the store
+    out1, _ = _run_session(tmp_path, cdir)
+    assert out1["saves"] > 0
+    assert out1["traces"] > 0
+    n_entries = len(glob.glob(os.path.join(cdir, "*.xc")))
+    assert n_entries > 0
+
+    # session 2 (fresh process): every fused stage replays a stored
+    # executable — no Python retraces, no XLA compiles
+    out2, _ = _run_session(tmp_path, cdir)
+    assert out2["rows"] == out1["rows"]
+    assert out2["traces"] == 0
+    assert out2["hits"] > 0
+    assert out2["saves"] == 0
+
+    # corrupt one entry; session 3 degrades to a warned retrace and
+    # re-saves the entry — degraded, never wrong
+    garbage = b"this is not a serialized executable"
+    victim = sorted(glob.glob(os.path.join(cdir, "*.xc")))[0]
+    with open(victim, "wb") as f:
+        f.write(garbage)
+    out3, stderr = _run_session(tmp_path, cdir)
+    assert out3["rows"] == out1["rows"]
+    assert out3["corrupt"] >= 1
+    assert out3["traces"] >= 1
+    assert "corrupt stage-cache entry" in stderr
+    assert (not os.path.exists(victim)
+            or os.path.getsize(victim) != len(garbage))
+
+
+def test_prune_keeps_directory_under_budget(tmp_path):
+    store = stage_cache.StageCacheStore(str(tmp_path), max_bytes=200)
+    for i in range(10):
+        store.save(f"entry{i}", b"x" * 64)
+    assert store.total_bytes() <= 200
+    assert 0 < len(store.entries()) < 10
+
+
+def test_oversized_entry_is_not_stored(tmp_path):
+    store = stage_cache.StageCacheStore(str(tmp_path), max_bytes=16)
+    store.save("big", b"y" * 64)
+    assert store.entries() == []
+
+
+def test_session_conf_wiring(tmp_path):
+    d = str(tmp_path / "sc")
+    try:
+        TpuSession({"spark.rapids.tpu.sql.stage.cache.enabled": True,
+                    "spark.rapids.tpu.sql.stage.cache.dir": d})
+        st = stage_cache.get()
+        assert st is not None and st.directory == d
+        assert os.path.isdir(d)
+        # explicit disable closes the store
+        TpuSession({"spark.rapids.tpu.sql.stage.cache.enabled": False})
+        assert stage_cache.get() is None
+        # no stage.cache settings at all: process-global state untouched
+        stage_cache.configure(d, 1 << 20)
+        TpuSession()
+        assert stage_cache.get() is not None
+    finally:
+        stage_cache.shutdown()

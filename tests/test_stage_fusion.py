@@ -1,0 +1,253 @@
+"""Whole-stage fusion: bit-identity fused vs unfused (the
+`spark.rapids.tpu.sql.stageFusion.enabled` A/B), the HAVING-fusion and
+prestage-composition plan rewrites, the fused-stage explain() read-out, and
+the executable-budget accounting for multi-shape stage kernels."""
+
+import jax.numpy as jnp
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.benchmarks import tpch
+from spark_rapids_tpu.session import TpuSession
+
+SF = 0.01
+FUSION_KEY = "spark.rapids.tpu.sql.stageFusion.enabled"
+
+
+@pytest.fixture(scope="module")
+def paths():
+    return tpch.generate(SF, f"/tmp/tpch_sf{SF}")
+
+
+def _collect(paths, query, fusion: bool):
+    spark = TpuSession({FUSION_KEY: fusion})
+    dfs = tpch.load(spark, paths)
+    return tpch.QUERIES[query](dfs).collect().to_pylist()
+
+
+# -- bit-identity across the ladder ------------------------------------------
+
+@pytest.mark.parametrize("query", ["q1", "q3", "q5", "q18"])
+def test_ladder_bit_identical_fused_vs_unfused(paths, query):
+    fused = _collect(paths, query, True)
+    unfused = _collect(paths, query, False)
+    # exact equality, floats included: fusion re-orders no arithmetic — the
+    # fused program evaluates the same expression trees over the same rows
+    assert fused == unfused
+
+
+def _edge_table():
+    # dictionary-encoded key column + null-heavy value column: the layouts
+    # the fused paths special-case (dict digests in kernel signatures,
+    # validity masking through compaction and the presorted group-by)
+    n = 4000
+    keys = pa.array([f"k{i % 7}" if i % 11 else None
+                     for i in range(n)]).dictionary_encode()
+    vals = pa.array([float(i % 13) if i % 3 else None for i in range(n)],
+                    pa.float64())
+    ones = pa.array([1.0] * n, pa.float64())
+    return pa.table({"k": keys, "v": vals, "w": ones})
+
+
+def _edge_query(spark):
+    c = F.col
+    df = spark.create_dataframe(_edge_table())
+    return (df.filter(c("w") > F.lit(0.0))
+            .select(c("k"), (c("v") + c("w")).alias("x"))
+            .group_by(c("k"))
+            .agg(F.sum(c("x")).alias("sx"), F.count(c("x")).alias("cx"))
+            .filter(c("sx") > F.lit(100.0))
+            .sort(c("k")))
+
+
+def test_edge_batches_bit_identical_fused_vs_unfused():
+    got = {}
+    for fusion in (True, False):
+        spark = TpuSession({FUSION_KEY: fusion})
+        got[fusion] = _edge_query(spark).collect().to_pylist()
+    assert got[True] == got[False]
+    assert len(got[True]) > 0
+
+
+# -- plan rewrites -----------------------------------------------------------
+
+def _q18_agg_plan(paths, fusion: bool):
+    from spark_rapids_tpu.plan.overrides import TpuOverrides
+    c = F.col
+    spark = TpuSession({FUSION_KEY: fusion})
+    dfs = tpch.load(spark, paths)
+    df = (dfs["lineitem"].group_by(c("l_orderkey"))
+          .agg(F.sum(c("l_quantity")).alias("sum_qty"))
+          .filter(c("sum_qty") > F.lit(300.0)))
+    return TpuOverrides(spark.conf).apply(df._plan)
+
+
+def test_having_fuses_into_aggregate(paths):
+    from spark_rapids_tpu.exec.aggregate import HashAggregateExec
+    from spark_rapids_tpu.exec.basic import FilterExec
+
+    def find(node, cls):
+        out = [node] if isinstance(node, cls) else []
+        for ch in node.children:
+            out += find(ch, cls)
+        return out
+
+    fused = _q18_agg_plan(paths, True)
+    assert not find(fused, FilterExec)
+    final = [a for a in find(fused, HashAggregateExec)
+             if a.postfilter is not None]
+    assert len(final) == 1
+
+    unfused = _q18_agg_plan(paths, False)
+    assert find(unfused, FilterExec)
+    assert all(a.postfilter is None
+               for a in find(unfused, HashAggregateExec))
+
+
+def test_compose_prestage_folds_filter_project_stack():
+    from spark_rapids_tpu.exec.basic import FilterExec, ProjectExec
+    from spark_rapids_tpu.plan.stages import compose_prestage
+    c = F.col
+    spark = TpuSession()
+    df = (spark.create_dataframe(_edge_table())
+          .filter(c("w") > F.lit(0.0))
+          .select(c("k"), (c("v") + c("w")).alias("x")))
+    from spark_rapids_tpu.plan.overrides import TpuOverrides
+    top = TpuOverrides(spark.conf).apply(df._plan)
+    # walk down to the Project(Filter(scan)) stack the frame built
+    while not isinstance(top, (ProjectExec, FilterExec)):
+        top = top.children[0]
+    cond, terms, base = compose_prestage(top)
+    assert cond is not None and terms is not None
+    assert not isinstance(base, (ProjectExec, FilterExec))
+
+
+# -- broadcast-join probe chains ----------------------------------------------
+
+def _find(node, name):
+    out = [node] if type(node).__name__ == name else []
+    for ch in node.children:
+        out += _find(ch, name)
+    return out
+
+
+def test_probe_chain_forms_on_q18_and_q5(paths):
+    from spark_rapids_tpu.plan.overrides import TpuOverrides
+    for query in ("q18", "q5"):
+        spark = TpuSession({FUSION_KEY: True})
+        dfs = tpch.load(spark, paths)
+        root = TpuOverrides(spark.conf).apply(tpch.QUERIES[query](dfs)._plan)
+        chains = _find(root, "BroadcastHashJoinChainExec")
+        assert len(chains) == 1, query
+        assert len(chains[0].hops) == 2
+        # the absorbed joins left the tree; their exchanges stayed
+        assert not _find(root, "BroadcastHashJoinExec") or query == "q5"
+        spark2 = TpuSession({FUSION_KEY: False})
+        dfs2 = tpch.load(spark2, paths)
+        root2 = TpuOverrides(spark2.conf).apply(tpch.QUERIES[query](dfs2)._plan)
+        assert not _find(root2, "BroadcastHashJoinChainExec")
+
+
+def _chain_pair_query(spark, dup_builds: bool):
+    """Two stacked inner int-key broadcast joins; with `dup_builds` the
+    middle build has duplicate keys, so the chain degrades to the
+    sequential per-hop fallback at run time (probe mode 'two')."""
+    c = F.col
+    n = 5000
+    stream = spark.create_dataframe(pa.table({
+        "k": pa.array([i % 400 for i in range(n)], pa.int64()),
+        "v": pa.array([float(i % 17) for i in range(n)], pa.float64())}))
+    reps = 2 if dup_builds else 1
+    b1 = spark.create_dataframe(pa.table({
+        "k": pa.array([i for i in range(300) for _ in range(reps)],
+                      pa.int64()),
+        "j": pa.array([i * 2 for i in range(300) for _ in range(reps)],
+                      pa.int64())}))
+    b2 = spark.create_dataframe(pa.table({
+        "j": pa.array(list(range(0, 600, 3)), pa.int64()),
+        "w": pa.array([float(j) for j in range(0, 600, 3)], pa.float64())}))
+    return (stream.join(b1, on="k").join(b2, on="j")
+            .select(c("k"), c("v"), c("j"), c("w")))
+
+
+@pytest.mark.parametrize("dup_builds", [False, True])
+def test_chain_bit_identical_fused_vs_unfused(dup_builds):
+    got = {}
+    for fusion in (True, False):
+        spark = TpuSession({FUSION_KEY: fusion})
+        rows = _chain_pair_query(spark, dup_builds).collect().to_pylist()
+        got[fusion] = sorted(map(tuple, (r.values() for r in rows)))
+    assert got[True] == got[False]
+    assert len(got[True]) > 0
+
+
+def test_chain_single_dispatch_per_steady_state_batch(paths):
+    from spark_rapids_tpu.runtime import stats as STATS
+    spark = TpuSession({FUSION_KEY: True})
+    dfs = tpch.load(spark, paths, files_per_partition=4)
+    df = tpch.QUERIES["q5"](dfs)
+    df.collect()          # warm: traces + capacity predictions settle
+    df.collect()
+    tbl = STATS.node_table(df._last_collector)
+    chain = next(e for e in tbl if e["name"] == "BroadcastHashJoinChainExec")
+    # per-hop one-off build preps aside, the whole 2-hop probe chain costs
+    # ~1 dispatch per stream batch (vs probe+emit+project per hop unfused)
+    assert chain["batches"] >= 4
+    assert chain["dispatches"] <= 6 + 2 * chain["batches"]
+
+
+# -- explain(fused=True) read-out --------------------------------------------
+
+def test_explain_fused_names_stages_and_dispatches(paths):
+    spark = TpuSession({FUSION_KEY: True})
+    dfs = tpch.load(spark, paths)
+    df = tpch.QUERIES["q18"](dfs)
+    pre = df.explain(fused=True)          # before any action: tree only
+    assert "*(" in pre and "== Fused stages ==" in pre
+    df.collect()
+    post = df.explain(fused=True)
+    assert "*(" in post
+    assert "HashAggregateExec" in post
+    assert "Filter[HAVING]" in post       # the q18 HAVING hoist, named
+    assert "dispatches=" in post          # per-member dispatch counts
+
+
+# -- executable-budget accounting (multi-shape stage kernels) ----------------
+
+def test_cache_size_counts_every_shape_signature():
+    from spark_rapids_tpu.runtime import fuse
+    k = fuse.get_kernel(("test-multi-shape-kernel",), "t",
+                        lambda: (lambda c, n: c + n))
+    for cap in (8, 16, 32):
+        k(jnp.zeros(cap), jnp.asarray(1, jnp.int32))
+    assert k.cache_size() >= 3
+
+
+def test_sweep_budgets_executables_not_kernels(monkeypatch):
+    from spark_rapids_tpu.runtime import fuse
+    fuse.clear_kernels()
+    # ONE kernel holding many shape signatures must count against the
+    # executable budget as many, so the sweep evicts it
+    k = fuse.get_kernel(("test-sweep-victim",), "t",
+                        lambda: (lambda c, n: c * n))
+    for cap in (8, 16, 32, 64, 128, 256):
+        k(jnp.zeros(cap), jnp.asarray(2, jnp.int32))
+    assert k.cache_size() >= 6
+    monkeypatch.setattr(fuse, "_MAX_EXECUTABLES", 4)
+    fuse._sweep_executables()
+    with fuse._lock:
+        assert ("test-sweep-victim",) not in fuse._kernels
+
+
+def test_trace_driven_sweep_triggers_without_inserts(monkeypatch):
+    from spark_rapids_tpu.runtime import fuse
+    fuse.reset_metrics()
+    monkeypatch.setattr(fuse, "_SWEEP_EVERY_TRACES", 1)
+    k = fuse.get_kernel(("test-trace-sweep",), "t",
+                        lambda: (lambda c, n: c - n))
+    k(jnp.zeros(8), jnp.asarray(1, jnp.int32))
+    k(jnp.zeros(16), jnp.asarray(1, jnp.int32))  # new shape -> trace -> sweep
+    assert fuse._last_sweep_traces >= 1
+    fuse.reset_metrics()
+    assert fuse._last_sweep_traces == 0

@@ -261,9 +261,18 @@ def analyze(records: list) -> dict:
                     "running_at_admit": e.get("running", 1),
                 }
 
+        # whole-stage fusion plane (plan/stages.py): one stage.fused record
+        # per fused stage at plan time; joined with the plan.stats node
+        # ledger in render_stats for dispatches-per-batch
+        fused_stages = [
+            {"stage": e.get("stage"), "members": e.get("members") or [],
+             "nodes": e.get("nodes") or [], "fused": e.get("fused") or []}
+            for e in evs if e["event"] == "stage.fused"]
+
         queries.append({
             "query": qid,
             "description": rec.get("description", ""),
+            "fused_stages": fused_stages,
             "admission": admission,
             "wall_s": wall_s,
             "total_self_s": round(total_self, 6),
@@ -1022,6 +1031,25 @@ def render_stats(analysis: dict, top: int = 15) -> str:
                     + (f" {n['args']}" if n.get("args") else ""))
             if len(nodes) > max(top, 1):
                 out.append(f"    ... {len(nodes) - max(top, 1)} more nodes")
+        if q.get("fused_stages"):
+            by_id = {n.get("id"): n for n in nodes if n.get("id") is not None}
+            out.append("  fused stages (members / absorbed operators / "
+                       "dispatches per batch):")
+            for fs in q["fused_stages"]:
+                cells = []
+                for name, nid in zip(fs["members"], fs["nodes"]):
+                    n = by_id.get(nid) or {}
+                    d, b = n.get("dispatches"), n.get("batches")
+                    label = name
+                    if d is not None:
+                        label += f" [disp={d}"
+                        if b:
+                            label += f" ({d / b:.1f}/batch)"
+                        label += "]"
+                    cells.append(label)
+                out.append(f"    *({fs['stage']}) " + ", ".join(cells))
+                for f in fs["fused"]:
+                    out.append(f"        fused: {f}")
         if q["shuffles"]:
             out.append("  shuffle partition skew:")
             for s in q["shuffles"]:
@@ -1060,6 +1088,7 @@ def stats_main(args) -> int:
             "queries": [{"query": q["query"],
                          "description": q["description"],
                          "stats": q.get("stats"),
+                         "fused_stages": q.get("fused_stages"),
                          "shuffles": q["shuffles"]}
                         for q in analysis["queries"]],
             "violations": violations,
