@@ -173,7 +173,8 @@ def child_main():
     with watcher_paused():
         for name, q in tpch.QUERIES.items():
             df = q(dfs)
-            got = df.collect().to_pylist()      # warm (compiles cached after)
+            res = df.collect()                  # warm (compiles cached after)
+            got = res.to_pylist()
             exp = getattr(tpch, NP_QUERIES[name])(tb)
             CHECKS[name](got, exp)              # wrong answer → no number
             ts = []
@@ -267,6 +268,28 @@ def child_main():
                 if stats:
                     per_query[name]["history_hit"] = \
                         bool(stats.get("history_hit"))
+                # movement plane (runtime/movement.py): the hot rep's
+                # boundary-crossing bytes by link class — BENCH trajectories
+                # catch a change that silently starts moving more data, not
+                # just one that slows down
+                mstats = qm.movement_stats()
+                if mstats:
+                    def _mv(pred):
+                        return sum(v["bytes"] for k, v in mstats.items()
+                                   if pred(*k))
+                    total_moved = sum(v["bytes"] for v in mstats.values())
+                    per_query[name]["movement"] = {
+                        "tcp_bytes": _mv(lambda e, lk: lk == "tcp"),
+                        "loopback_bytes": _mv(
+                            lambda e, lk: lk == "loopback"),
+                        "h2d_bytes": _mv(lambda e, lk: e == "h2d"),
+                        "d2h_bytes": _mv(lambda e, lk: e == "d2h"),
+                        "spill_io_bytes": _mv(
+                            lambda e, lk: e.startswith("spill.")),
+                        "movement_amplification": (
+                            round(total_moved / res.nbytes, 3)
+                            if res.nbytes else None),
+                    }
 
     # resilience counters (retry/split/fetch-failover totals across the
     # whole ladder run): with faults disabled these must be zero — a later

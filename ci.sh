@@ -508,10 +508,13 @@ off_s = run({})
 # memory profiling rides inside the SAME <5% budget: allocation-site
 # accounting is always on, and the fine-grained watermark timeline
 # (64k sample interval) is part of the "on" run being timed
+# the movement ledger's fine-grained sampling (64k interval) rides inside
+# the same budget: capture hooks are always on, emission is part of "on"
 on_s = run({"spark.rapids.tpu.eventLog.dir": os.environ["SRT_OBS_DIR"],
             "spark.rapids.tpu.eventLog.healthSample.intervalSeconds": 0.5,
             "spark.rapids.tpu.trace.dir": os.environ["SRT_OBS_DIR"],
             "spark.rapids.tpu.memory.profile.watermarkIntervalBytes": "64k",
+            "spark.rapids.tpu.movement.sample.intervalBytes": "64k",
             "spark.rapids.tpu.memory.leak.check": "true"})
 eventlog.shutdown()
 from spark_rapids_tpu.runtime import tracing
@@ -575,6 +578,59 @@ for e in cs:
 print("memory counter lanes ok:", len(cs), "samples")
 PYEOF
 rm -rf "$obs_dir"
+
+echo "== movement plane: per-link byte ledger gate (3-executor q18) =="
+# q18 on a same-host 3-executor MiniCluster: the merged per-process ledgers
+# must cover the driver-registered map-output bytes (>=90%), classify every
+# transport byte loopback/local (tcp exactly 0 — the misattribution
+# regression), leave the retry edge at zero with no faults armed, and keep
+# every network edge at exactly zero on the single-process no-shuffle path
+mv_dir=$(mktemp -d)
+JAX_PLATFORMS=cpu python tools/movement_gate.py \
+  --data-dir /tmp/tpch_ci_sf0.01 --eventlog-dir "$mv_dir" --query q18
+# the movement read-out merges every per-process event log into one matrix
+python tools/profiler.py movement "$mv_dir"/events-*.jsonl \
+  > /tmp/mv_readout.txt
+grep -q "byte matrix" /tmp/mv_readout.txt
+grep -q "heaviest flow:" /tmp/mv_readout.txt
+grep -q "loopback-vs-remote:" /tmp/mv_readout.txt
+python tools/profiler.py movement "$mv_dir"/events-*.jsonl --json \
+  > /tmp/mv_readout.json
+python - "$mv_dir" <<'PYEOF'
+import glob, json, sys
+m = json.load(open("/tmp/mv_readout.json"))
+# denominator: the driver-registered per-reduce partition sizes
+reg = 0
+for path in glob.glob(sys.argv[1] + "/events-*.jsonl"):
+    for ln in open(path):
+        ln = ln.strip()
+        if not ln:
+            continue
+        rec = json.loads(ln)
+        if rec.get("event") == "stage.map.end" \
+                and rec.get("partition_sizes"):
+            reg += sum(rec["partition_sizes"])
+assert reg > 0, "no registered partition sizes in the merged logs"
+# the matrix's shuffle row (net -> host, payload units) must agree with
+# the registered map-output bytes within 10% (15% headroom upward)
+recv = m["matrix"].get("net->host", 0)
+assert 0.9 * reg <= recv <= 1.15 * reg, (recv, reg)
+by = m["by_link"]
+assert by["tcp"] == 0, by
+assert by["loopback"] > 0, by
+assert m["flows"] and m["queries"], (len(m["flows"]), len(m["queries"]))
+amp = [q for q in m["queries"] if q.get("amplification") is not None]
+assert amp, "no query carries a movement amplification factor"
+print(f"movement read-out gate ok: matrix shuffle row {recv}B vs "
+      f"registered {reg}B ({recv / reg:.2f}x), tcp=0, "
+      f"loopback={by['loopback']}B, amplification "
+      f"{amp[-1]['amplification']}x")
+PYEOF
+rm -rf "$mv_dir"
+# movement-plane unit/integration suite: ledger accounting, link
+# classification, retry reclassification under injected faults, the
+# 2-executor loopback/local split, and the chaos no-double-count invariant
+JAX_PLATFORMS=cpu python -m pytest tests/test_movement.py -q
 
 echo "== statistics plane: plan-history estimate-error gate =="
 # q18 twice through a FRESH history dir: run 1 is a cold-start miss whose

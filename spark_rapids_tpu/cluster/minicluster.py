@@ -133,6 +133,12 @@ def _executor_main(conn, executor_index: int, platform: str,
     if edir:
         EL.configure(edir, max_bytes=conf.get(CFG.EVENT_LOG_MAX_BYTES),
                      keep=conf.get(CFG.EVENT_LOG_KEEP_FILES))
+    # the movement ledger meters this process's own boundary crossings —
+    # same knobs as the driver so merged per-process samples line up
+    from spark_rapids_tpu.runtime import movement as MV
+    MV.configure(
+        sample_interval_bytes=conf.get(CFG.MOVEMENT_SAMPLE_INTERVAL),
+        enabled=conf.get(CFG.MOVEMENT_ENABLED))
     store = ShuffleBlockStore.get()
     transport = TcpTransport(conf)
     # the reduce side short-circuits fetches addressed to THIS executor's
@@ -321,8 +327,13 @@ def _executor_main(conn, executor_index: int, platform: str,
         try:
             if op == "map":
                 reply = run_map(cloudpickle.loads(msg["task"]))
+                # task-completion flush: the driver's profiler merge reads
+                # the LAST movement.sample per process, so every finished
+                # task leaves a current ledger snapshot behind
+                MV.maybe_emit(force=True)
             elif op == "result":
                 reply = run_result(cloudpickle.loads(msg["task"]))
+                MV.maybe_emit(force=True)
             elif op == "clock":
                 # driver-side two-timestamp exchange: our wall clock, read
                 # as close to the reply as the pipe protocol allows

@@ -44,12 +44,19 @@ class LocalStoreClient:
     union merge stays canonical."""
 
     def fetch_blocks_with_keys(self, shuffle_id: int, reduce_id: int):
+        from spark_rapids_tpu.runtime import movement as MV
         from spark_rapids_tpu.runtime import tracing
         from spark_rapids_tpu.shuffle.manager import ShuffleBlockStore
         tracing.span_event("fetch.local", shuffle=shuffle_id,
                            reduce=reduce_id)
-        yield from ShuffleBlockStore.get().read_partition_with_keys(
-            shuffle_id, reduce_id)
+        for seq, b in ShuffleBlockStore.get().read_partition_with_keys(
+                shuffle_id, reduce_id):
+            # zero network bytes — the read never leaves the process; only
+            # the store-unit payload column moves, under the `local` link,
+            # so the short-circuit can never inflate the TCP ledger
+            MV.record("shuffle.recv", 0, link="local", site="fetch.local",
+                      payload_bytes=b.device_memory_size())
+            yield seq, b
 
 
 class RemoteFetchExec(TpuExec):

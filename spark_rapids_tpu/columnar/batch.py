@@ -64,13 +64,14 @@ class ColumnarBatch:
     # -- host interop -------------------------------------------------------
     def to_arrow(self):
         import pyarrow as pa
-        from spark_rapids_tpu.runtime import metrics as _M
+        from spark_rapids_tpu.runtime import movement as _MV
         n = self.num_rows
         names = (self.schema.names if self.schema is not None
                  else [f"c{i}" for i in range(self.num_cols)])
-        # stats-plane transfer ledger: device bytes crossing to the host at
-        # this boundary, attributed to the innermost operator frame
-        _M.stats_add("d2hBytes", self.device_memory_size())
+        # device bytes crossing to the host at this boundary: one call
+        # feeds the per-node stats ledger (d2hBytes) AND the movement
+        # ledger's d2h/pcie edge (runtime/movement.py)
+        _MV.record_d2h(self.device_memory_size())
         # from_arrays, not a dict: Spark allows duplicate output column names
         return pa.Table.from_arrays(
             [col.to_arrow(n) for col in self.columns], names=list(names))
@@ -78,9 +79,9 @@ class ColumnarBatch:
     @staticmethod
     def from_arrow(table, schema: T.StructType | None = None) -> "ColumnarBatch":
         from spark_rapids_tpu.columnar import arrow as ai
-        from spark_rapids_tpu.runtime import metrics as _M
+        from spark_rapids_tpu.runtime import movement as _MV
         batch = ai.table_to_device(table, schema=schema)
-        _M.stats_add("h2dBytes", batch.device_memory_size())
+        _MV.record_h2d(batch.device_memory_size())
         return batch
 
     @staticmethod

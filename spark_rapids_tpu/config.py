@@ -772,6 +772,24 @@ MEMORY_WATERMARK_INTERVAL = conf(
     "accounting itself is always on (a few dict updates under the catalog "
     "lock)").bytes_conf("16m")
 
+MOVEMENT_ENABLED = conf("spark.rapids.tpu.movement.enabled").doc(
+    "Meter every byte crossing a process/device boundary in the unified "
+    "movement ledger (runtime/movement.py): shuffle send/recv per link "
+    "class, disk spill I/O, host-device transfers, ICI collective "
+    "estimates and endpoint egress. Feeds the query.end movement section, "
+    "movement.sample events, srt_movement_bytes STATS gauges and the "
+    "profiler's movement read-out. Off leaves only the raw per-node "
+    "h2d/d2h meters").boolean_conf(True)
+
+MOVEMENT_SAMPLE_INTERVAL = conf(
+    "spark.rapids.tpu.movement.sample.intervalBytes").doc(
+    "Granularity of movement.sample ledger snapshots (+ Chrome "
+    "counter-track samples when trace.dir is set): a cumulative snapshot "
+    "is emitted when the process has moved this many more bytes since the "
+    "last sample, bounding event volume to O(moved/interval) rather than "
+    "one per transfer. Forced flushes at query end and executor task "
+    "completion always happen regardless").bytes_conf("32m")
+
 MEMORY_PROFILE_TOPK = conf("spark.rapids.tpu.memory.profile.topK").doc(
     "Allocation sites listed per watermark sample, per-query memory "
     "summary and STATS gauge family (sites beyond the top K by bytes are "

@@ -257,6 +257,12 @@ class LocalMesh:
         vals, masks, nrows = put_stacked_shards(self.mesh, shards)
         pids, counts = self._pid_step(dtypes, cap, n_out)(
             *vals, *masks, nrows)
+        # movement ledger, ICI edge: the program's only collective is the
+        # psum of per-partition live-row counts — estimated from the
+        # dispatch shape (every device contributes one n_out count vector)
+        from spark_rapids_tpu.runtime import movement as MV
+        MV.record("ici.collective", n_out * 4 * self.n, link="ici",
+                  site="mesh.partition_wave")
         return ([pids[d][:b.capacity] for d, b in enumerate(batches)],
                 np.asarray(counts))
 
@@ -404,6 +410,15 @@ class MeshExecutor:
         step = self._build_step(schema, group_exprs, agg_exprs, filter_expr,
                                 cap)
         vals, masks, nrows = put_stacked_shards(self.mesh, shards)
+        # movement ledger, ICI edge: the exchange inside the program is a
+        # lax.all_to_all over every partial-aggregate column — estimated
+        # from the dispatch shapes (the stacked ingest arrays bound the
+        # exchanged payload; XLA may move less after the local partial)
+        from spark_rapids_tpu.runtime import movement as MV
+        MV.record("ici.collective",
+                  sum(int(v.nbytes) for v in vals)
+                  + sum(int(m.nbytes) for m in masks),
+                  link="ici", site="mesh.aggregate")
         out = step(*vals, *masks, nrows)
 
         group_b = [bind_references(e, schema) for e in group_exprs]

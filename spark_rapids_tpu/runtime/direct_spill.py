@@ -26,6 +26,9 @@ from __future__ import annotations
 import mmap
 import os
 import threading
+import time
+
+from spark_rapids_tpu.runtime import movement as MV
 
 ALIGN = 4096
 
@@ -134,21 +137,32 @@ class DirectSpillStore:
         """Spill one serialized buffer; returns handle (file_id, offset, len).
         Buffers accumulate into the current batch file until it reaches
         batch_bytes, then a new file starts (BatchSpiller rotation)."""
+        t0 = time.perf_counter()
         with self._lock:
             fid = self._current
             if fid is None or self._files[fid].size >= self.batch_bytes:
                 fid = self._rotate()
             offset = self._write_aligned(fid, payload)
             self._files[fid].live += 1
-            return (fid, offset, len(payload))
+        # movement ledger: physical bytes are the ALIGNED write (what the
+        # disk actually absorbs), payload bytes the logical buffer
+        MV.record("spill.write", -(-len(payload) // ALIGN) * ALIGN,
+                  link="disk", site="direct_spill",
+                  payload_bytes=len(payload),
+                  seconds=time.perf_counter() - t0)
+        return (fid, offset, len(payload))
 
     def read(self, handle: tuple[int, int, int]) -> bytes:
         fid, offset, length = handle
+        t0 = time.perf_counter()
         with self._lock:
             path = self._files[fid].path
         with open(path, "rb") as f:
             f.seek(offset)
-            return f.read(length)
+            data = f.read(length)
+        MV.record("spill.read", length, link="disk", site="direct_spill",
+                  seconds=time.perf_counter() - t0)
+        return data
 
     def delete(self, handle: tuple[int, int, int]) -> None:
         fid, _, _ = handle

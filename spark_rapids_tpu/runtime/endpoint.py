@@ -132,6 +132,11 @@ def _hist_family(name: str):
         return "srt_query_latency_seconds", f'priority="{p}"'
     if name == "admission.wait":
         return "srt_admission_wait_seconds", ""
+    # movement plane: per-transfer size / latency distributions
+    if name == "movement.transfer.bytes":
+        return "srt_movement_transfer_bytes", ""
+    if name == "movement.transfer.latency":
+        return "srt_movement_transfer_latency_seconds", ""
     safe = "".join(c if c.isalnum() else "_" for c in name)
     return f"srt_{safe}", ""
 
@@ -201,6 +206,14 @@ def render_stats(include_histograms: bool = True) -> str:
         if k == "history.shapes":   # already exposed as its own family
             continue
         lines.append(f'srt_gauge{{name="{k}"}} {v}')
+    # movement plane: cumulative bytes per (edge, link) from the ledger
+    from spark_rapids_tpu.runtime import movement as MV
+    flows = MV.edge_link_totals()
+    if flows:
+        fam("srt_movement_bytes", "gauge")
+        for (edge, link), v in sorted(flows.items()):
+            lines.append(f'srt_movement_bytes{{edge="{edge}",link="{link}"}} '
+                         f'{v["bytes"]}')
 
     if include_histograms:
         for name, snap in sorted(M.histograms_snapshot().items()):
@@ -563,6 +576,11 @@ class QueryEndpoint:
         deadline = (time.monotonic() + self.request_timeout
                     if self.request_timeout > 0 else None)
         timed_out = False
+        from spark_rapids_tpu.runtime import movement as MV
+        try:
+            egress_link = MV.classify_peer(sock.getpeername())
+        except OSError:
+            egress_link = "client"
         while True:
             # disconnect probe: the client sends nothing mid-query, so any
             # readability is a half-close (b""), an RST (OSError), or a
@@ -591,7 +609,12 @@ class QueryEndpoint:
             try:
                 if kind == "batch":
                     F.maybe_inject_any("endpoint.send")
+                    t0 = time.perf_counter()
                     send_frame(sock, MSG_RESULT_BATCH, val)
+                    # movement ledger: Arrow IPC bytes leaving to the client
+                    MV.record("endpoint.egress", len(val), link=egress_link,
+                              site="endpoint.result",
+                              seconds=time.perf_counter() - t0)
                 elif kind == "end":
                     send_frame(sock, MSG_RESULT_END,
                                json.dumps(val).encode("utf-8"))

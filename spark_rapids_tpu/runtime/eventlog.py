@@ -95,6 +95,14 @@ KNOWN_EVENTS = frozenset({
     # persistent-cache entry that failed to deserialize and was dropped in
     # favor of a retrace
     "stage.fused", "stage.cache.corrupt",
+    # data-movement observability plane (runtime/movement.py): cumulative
+    # ledger snapshots — every flow as (edge, link, bytes, payload_bytes,
+    # transfers) — emitted whenever a process has moved another
+    # movement.sample.intervalBytes since its last sample, plus a forced
+    # flush at query end and executor task completion. Deliberately NOT
+    # query-scoped: executor processes meter task work outside any driver
+    # query extent
+    "movement.sample",
 })
 
 # events that only make sense inside a query's dynamic extent; the profiler

@@ -24,6 +24,7 @@ import os
 import pickle
 import tempfile
 import threading
+import time
 import typing
 
 import numpy as np
@@ -566,11 +567,14 @@ class BufferCatalog:
         try:
             if self._direct_spill:
                 # GDS-analog batched aligned store (reference RapidsGdsStore)
+                # — the store itself meters its aligned I/O into the
+                # movement ledger (site "direct_spill")
                 buf._handle = self._get_direct_store().write(payload)
                 buf._path = None
             else:
                 path = os.path.join(self._spill_dir_path(),
                                     f"buffer-{buf.buffer_id}.spill")
+                t0 = time.perf_counter()
                 try:
                     with open(path, "wb") as f:
                         f.write(payload)
@@ -579,6 +583,10 @@ class BufferCatalog:
                     with contextlib.suppress(OSError):
                         os.unlink(path)
                     raise
+                from spark_rapids_tpu.runtime import movement as MV
+                MV.record("spill.write", len(payload), link="disk",
+                          site="spill.file",
+                          seconds=time.perf_counter() - t0)
                 buf._path = path
                 buf._handle = None
         except OSError as e:
@@ -620,8 +628,13 @@ class BufferCatalog:
                 if buf._handle is not None:
                     payload = self._get_direct_store().read(buf._handle)
                 else:
+                    t0 = time.perf_counter()
                     with open(buf._path, "rb") as f:
                         payload = f.read()
+                    from spark_rapids_tpu.runtime import movement as MV
+                    MV.record("spill.read", len(payload), link="disk",
+                              site="spill.file",
+                              seconds=time.perf_counter() - t0)
                 if buf._crc is not None:
                     from spark_rapids_tpu.runtime.checksum import \
                         block_checksum

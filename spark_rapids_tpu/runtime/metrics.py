@@ -274,13 +274,16 @@ def counters_snapshot() -> dict:
 
 
 def reset_observability() -> None:
-    """Test hook: clear gauges, counters and histograms."""
+    """Test hook: clear gauges, counters, histograms and the movement
+    ledger."""
     global _histograms
     with _gauge_lock:
         _gauges.clear()
         _counters.clear()
     with _hist_lock:
         _histograms = {}
+    from spark_rapids_tpu.runtime import movement
+    movement.reset()
 
 
 # latency-shaped default bounds: 1ms .. 5min, roughly x2.5 per step —
@@ -537,6 +540,10 @@ class QueryMetricsCollector:
         # per-shuffle reduce-partition byte sizes recorded by the map stage
         # (exchange/mesh), independent of the event log being enabled
         self._shuffle_stats: list[dict] = []
+        # per-query mirror of the movement ledger (runtime/movement.py):
+        # (edge, link) -> [bytes, payload_bytes, transfers] — the query.end
+        # movement section and bench.py's movement summary read this
+        self._movement: dict = {}
         # admission footprint info ({estimate, static, history_hit,
         # fingerprint, ...}) set at submit; plan.stats payload set at finish
         self.footprint: dict | None = None
@@ -604,6 +611,14 @@ class QueryMetricsCollector:
     def shuffle_stats(self) -> list:
         with self._compile_lock:
             return [dict(e) for e in self._shuffle_stats]
+
+    def movement_stats(self) -> dict:
+        """{(edge, link): {bytes, payload_bytes, transfers}} snapshot of
+        this query's movement mirror (runtime/movement.py)."""
+        with self._compile_lock:
+            return {k: {"bytes": v[0], "payload_bytes": v[1],
+                        "transfers": v[2]}
+                    for k, v in self._movement.items()}
 
     def _walk(self, node, parent_id, depth, visit):
         """Duck-typed hybrid-tree walk (no imports of exec/plan here): device

@@ -1,0 +1,365 @@
+"""Data-movement observability plane: the unified per-link byte ledger.
+
+Reference analogy: the shuffle-plugin's UCX transport counts request/receive
+bytes per peer (UCXShuffleTransport / RapidsShuffleServerOps metrics) and
+Spark's MapOutputStatistics name shuffle volume — but neither names EVERY
+byte a query moves. Theseus (PAPERS.md) argues distributed query throughput
+is won by scheduling data movement across the memory/interconnect hierarchy;
+before movement can be spent it must be metered. This module is the
+movement analog of the PR-9 heap profiler: a lock-cheap process-wide
+registry metering every byte that crosses a boundary, keyed by
+``(edge, link, site)``:
+
+  edge   what kind of crossing — ``shuffle.send``/``shuffle.recv`` (transport
+         data plane), ``shuffle.retry`` (bytes fetched by a FAILED attempt,
+         reclassified so retries never double-count the recv ledger),
+         ``spill.write``/``spill.read`` (disk spill tier),
+         ``h2d``/``d2h`` (Arrow boundary, unified with the PR-12 node meters),
+         ``ici.collective`` (estimated mesh all_to_all payloads),
+         ``endpoint.egress`` (Arrow IPC result frames to serving clients)
+  link   the physical lane — ``tcp`` (cross-host), ``loopback`` (same-host
+         TCP), ``local`` (in-process short-circuit, zero network), ``disk``,
+         ``pcie`` (host<->device), ``ici``, ``client`` (endpoint socket)
+  site   the capture point ("transport.fetch", "spill.file", ...)
+
+Each cell holds ``[bytes, payload_bytes, transfers, seconds]``. ``bytes``
+are PHYSICAL link bytes (wire frames, disk writes, device transfer sizes);
+``payload_bytes`` are block-store units (``device_memory_size()`` of the
+decoded batch — the unit ``ShuffleBlockStore.partition_sizes`` speaks), so
+the profiler's byte matrix can be cross-checked against map-output
+statistics even though the wire trims padding that the store accounts.
+Edges that have no store-unit distinction default payload == bytes.
+
+Dual accounting follows the PR-6 scoped pattern: every record lands in the
+process-global ledger AND the ambient ``QueryMetricsCollector``'s per-query
+mirror (aggregated by ``(edge, link)`` — the ``query.end`` movement
+section). Read-outs: a cumulative ``movement.sample`` event (threshold-based
+like the memory watermark timeline) + a Chrome counter track per edge,
+``srt_movement_bytes{edge=,link=}`` STATS gauges with transfer size/latency
+histograms, and ``tools/profiler.py movement``.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from spark_rapids_tpu.runtime import metrics as M
+
+# edge -> (source, destination) of the movement matrix rendered by
+# tools/profiler.py movement; shuffle.retry bytes were physically received
+# and then discarded by the fetch ladder, so they flow net -> discard
+EDGES = {
+    "shuffle.send": ("host", "net"),
+    "shuffle.recv": ("net", "host"),
+    "shuffle.retry": ("net", "discard"),
+    "spill.write": ("host", "disk"),
+    "spill.read": ("disk", "host"),
+    "h2d": ("host", "device"),
+    "d2h": ("device", "host"),
+    "ici.collective": ("device", "device"),
+    "endpoint.egress": ("host", "client"),
+}
+
+# edges whose ledger rows stay exactly zero on the single-process,
+# no-shuffle path (the ci.sh movement-gate invariant): everything except
+# the host<->device and mesh edges a purely local query legitimately uses
+NETWORK_EDGES = ("shuffle.send", "shuffle.recv", "shuffle.retry",
+                 "spill.write", "spill.read", "endpoint.egress")
+
+# transfer-size histogram bounds (bytes): 1KiB .. 1GiB, x8 per step
+TRANSFER_BYTES_BOUNDS = (
+    1 << 10, 1 << 13, 1 << 16, 1 << 19, 1 << 22,
+    1 << 25, 1 << 28, 1 << 30)
+
+_lock = threading.Lock()
+# (edge, link, site) -> [bytes, payload_bytes, transfers, seconds]
+_cells: dict = {}
+_enabled = True
+_sample_interval = 32 << 20
+_since_sample = 0
+_dirty = False       # anything recorded since the last emitted sample
+
+# thread-local stack of fetch-attempt tokens: every shuffle.recv record on
+# the thread is also noted into each open token, so an aborted attempt can
+# move exactly its own bytes from shuffle.recv to shuffle.retry
+_tls = threading.local()
+
+_LOOPBACK_HOSTS = frozenset({"localhost", "::1", "0.0.0.0"})
+
+
+def configure(sample_interval_bytes: "int | None" = None,
+              enabled: "bool | None" = None) -> None:
+    """Apply the movement.* conf knobs (session action prologue and the
+    MiniCluster executor bootstrap both call this — the ledger itself is
+    process-global, so the last configure wins, like the event log)."""
+    global _sample_interval, _enabled
+    with _lock:
+        if sample_interval_bytes is not None and sample_interval_bytes > 0:
+            _sample_interval = int(sample_interval_bytes)
+        if enabled is not None:
+            _enabled = bool(enabled)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def classify_peer(address) -> str:
+    """Link class of a peer socket address: ``loopback`` for same-host TCP
+    (loopback IPs or this process's own registered block-server host),
+    ``tcp`` for a genuinely remote peer, ``local`` for no address at all
+    (in-process reads never touch a socket). Keeping loopback out of the
+    ``tcp`` row is what makes the cross-host ledger honest — a MiniCluster
+    on one box moves plenty of TCP bytes but zero cross-host bytes."""
+    if address is None:
+        return "local"
+    host = address[0] if isinstance(address, (tuple, list)) else str(address)
+    host = str(host)
+    if host in _LOOPBACK_HOSTS or host.startswith("127."):
+        return "loopback"
+    from spark_rapids_tpu.cluster import remote as R
+    la = R.local_address()
+    if la is not None and str(la[0]) == host:
+        return "loopback"
+    return "tcp"
+
+
+def record(edge: str, nbytes: int, *, link: str = "local", site: str = "",
+           payload_bytes: "int | None" = None, transfers: int = 1,
+           seconds: "float | None" = None) -> None:
+    """Meter one boundary crossing: `nbytes` physical link bytes (pass 0
+    for a payload-only follow-up record), `payload_bytes` block-store-unit
+    bytes (None = same as nbytes), `seconds` the wire/disk transfer time
+    (feeds the size/latency histograms when present)."""
+    if not _enabled:
+        return
+    n = int(nbytes)
+    p = n if payload_bytes is None else int(payload_bytes)
+    emit = False
+    global _since_sample, _dirty
+    with _lock:
+        cell = _cells.get((edge, link, site))
+        if cell is None:
+            cell = _cells[(edge, link, site)] = [0, 0, 0, 0.0]
+        cell[0] += n
+        cell[1] += p
+        cell[2] += transfers
+        if seconds:
+            cell[3] += seconds
+        _dirty = True
+        _since_sample += n
+        if _since_sample >= _sample_interval:
+            _since_sample = 0
+            emit = True
+    if edge == "shuffle.recv":
+        for tok in getattr(_tls, "attempts", ()) or ():
+            c = tok.setdefault((link, site), [0, 0, 0, 0.0])
+            c[0] += n
+            c[1] += p
+            c[2] += transfers
+            if seconds:
+                c[3] += seconds
+    col = M.current_collector()
+    if col is not None:
+        mv = getattr(col, "_movement", None)
+        if mv is not None:
+            with col._compile_lock:
+                c = mv.setdefault((edge, link), [0, 0, 0])
+                c[0] += n
+                c[1] += p
+                c[2] += transfers
+    if seconds is not None:
+        M.histogram("movement.transfer.bytes",
+                    TRANSFER_BYTES_BOUNDS).observe(n)
+        M.histogram("movement.transfer.latency").observe(seconds)
+    if emit:
+        maybe_emit(force=True)
+
+
+def record_h2d(nbytes: int, site: str = "batch.from_arrow") -> None:
+    """Host->device upload at the Arrow boundary: one call feeds BOTH the
+    PR-12 per-node stats ledger (h2dBytes, attributed to the innermost
+    operator frame) and the movement ledger's pcie edge — the meters can
+    never drift apart."""
+    M.stats_add("h2dBytes", nbytes)
+    record("h2d", nbytes, link="pcie", site=site)
+
+
+def record_d2h(nbytes: int, site: str = "batch.to_arrow") -> None:
+    """Device->host download at the Arrow boundary (see record_h2d)."""
+    M.stats_add("d2hBytes", nbytes)
+    record("d2h", nbytes, link="pcie", site=site)
+
+
+# ---------------------------------------------------------------------------
+# fetch-attempt reclassification (the shuffle.retry edge)
+# ---------------------------------------------------------------------------
+
+def begin_attempt() -> dict:
+    """Open a fetch-attempt scope on this thread: shuffle.recv bytes
+    recorded while it is open are noted into the returned token. Tokens
+    nest (the union fetch wraps per-peer retry ladders)."""
+    stack = getattr(_tls, "attempts", None)
+    if stack is None:
+        stack = _tls.attempts = []
+    tok: dict = {}
+    stack.append(tok)
+    return tok
+
+
+def commit_attempt(tok: dict) -> None:
+    """The attempt's batches were yielded downstream — its bytes stay on
+    the shuffle.recv edge."""
+    stack = getattr(_tls, "attempts", None)
+    if stack and tok in stack:
+        stack.remove(tok)
+
+
+def abort_attempt(tok: dict) -> None:
+    """The attempt failed after (possibly) receiving bytes: move exactly
+    the bytes it noted from shuffle.recv to shuffle.retry, in the global
+    ledger AND the ambient collector mirror, and deduct them from any
+    still-open outer token so a task-level abort cannot move them twice.
+    This is the no-double-count invariant the chaos ledger test asserts:
+    total recv payload stays equal to the block store's partition sizes no
+    matter how many attempts it took."""
+    stack = getattr(_tls, "attempts", None)
+    if stack and tok in stack:
+        stack.remove(tok)
+    if not tok:
+        return
+    col = M.current_collector()
+    with _lock:
+        for (link, site), (n, p, t, s) in tok.items():
+            src = _cells.get(("shuffle.recv", link, site))
+            if src is not None:
+                src[0] -= n
+                src[1] -= p
+                src[2] -= t
+                src[3] -= s
+            dst = _cells.get(("shuffle.retry", link, site))
+            if dst is None:
+                dst = _cells[("shuffle.retry", link, site)] = [0, 0, 0, 0.0]
+            dst[0] += n
+            dst[1] += p
+            dst[2] += t
+            dst[3] += s
+    if col is not None:
+        mv = getattr(col, "_movement", None)
+        if mv is not None:
+            with col._compile_lock:
+                for (link, _site), (n, p, t, _s) in tok.items():
+                    src = mv.get(("shuffle.recv", link))
+                    if src is not None:
+                        src[0] -= n
+                        src[1] -= p
+                        src[2] -= t
+                    dst = mv.setdefault(("shuffle.retry", link), [0, 0, 0])
+                    dst[0] += n
+                    dst[1] += p
+                    dst[2] += t
+    for outer in getattr(_tls, "attempts", ()) or ():
+        for key, (n, p, t, s) in tok.items():
+            c = outer.get(key)
+            if c is not None:
+                c[0] -= n
+                c[1] -= p
+                c[2] -= t
+                c[3] -= s
+
+
+# ---------------------------------------------------------------------------
+# snapshots + read-outs
+# ---------------------------------------------------------------------------
+
+def snapshot() -> dict:
+    """{(edge, link, site): {bytes, payload_bytes, transfers, seconds}}."""
+    with _lock:
+        return {k: {"bytes": v[0], "payload_bytes": v[1],
+                    "transfers": v[2], "seconds": round(v[3], 6)}
+                for k, v in _cells.items()}
+
+
+def edge_link_totals() -> dict:
+    """{(edge, link): {bytes, payload_bytes, transfers}} aggregated over
+    capture sites — the STATS gauge family and movement.sample payload."""
+    out: dict = {}
+    with _lock:
+        for (edge, link, _site), v in _cells.items():
+            c = out.setdefault((edge, link), {"bytes": 0, "payload_bytes": 0,
+                                              "transfers": 0})
+            c["bytes"] += v[0]
+            c["payload_bytes"] += v[1]
+            c["transfers"] += v[2]
+    return out
+
+
+def total_bytes() -> int:
+    with _lock:
+        return sum(v[0] for v in _cells.values())
+
+
+def reset() -> None:
+    """Test hook (wired into metrics.reset_observability)."""
+    global _since_sample, _dirty
+    with _lock:
+        _cells.clear()
+        _since_sample = 0
+        _dirty = False
+
+
+def maybe_emit(force: bool = False) -> None:
+    """Emit the cumulative movement.sample event (+ the Chrome bandwidth
+    counter track, one series per edge). Threshold crossings in record()
+    force it; the session's query epilogue and the executor task loop force
+    a flush so short queries and freshly finished tasks are covered. The
+    payload is a CUMULATIVE snapshot — the profiler takes each process's
+    LAST sample and sums across processes, so emission frequency only
+    affects resolution, never totals."""
+    global _dirty
+    from spark_rapids_tpu.runtime import eventlog as EL
+    from spark_rapids_tpu.runtime import tracing as TR
+    if not (EL.enabled() or TR.spans_enabled()):
+        return
+    with _lock:
+        if not _dirty and not force:
+            return
+        if not _cells:
+            return
+        _dirty = False
+    totals = edge_link_totals()
+    flows = [{"edge": e, "link": lk, **c}
+             for (e, lk), c in sorted(totals.items())]
+    total = sum(c["bytes"] for c in totals.values())
+    if EL.enabled():
+        EL.emit("movement.sample", total_bytes=total, flows=flows)
+    if TR.spans_enabled():
+        by_edge: dict = {}
+        for (e, _lk), c in totals.items():
+            by_edge[e] = by_edge.get(e, 0) + c["bytes"]
+        TR.counter("movement", by_edge)
+
+
+def query_summary(collector, result_bytes: "int | None" = None) -> "dict | None":
+    """The query.end movement section from the collector's per-query
+    mirror: per-edge/per-link bytes plus the movement-amplification factor
+    (total bytes moved per result byte) when the action's result size is
+    known (pa.Table.nbytes); None when the query moved nothing."""
+    mv = getattr(collector, "_movement", None)
+    if mv is None:
+        return None
+    with collector._compile_lock:
+        items = {k: list(v) for k, v in mv.items()}
+    if not items:
+        return None
+    edges: dict = {}
+    total = 0
+    for (edge, link), (n, p, t) in sorted(items.items()):
+        edges.setdefault(edge, {})[link] = {
+            "bytes": n, "payload_bytes": p, "transfers": t}
+        total += n
+    out = {"total_bytes": total, "edges": edges}
+    if result_bytes:
+        out["result_bytes"] = int(result_bytes)
+        out["amplification"] = round(total / int(result_bytes), 3)
+    return out

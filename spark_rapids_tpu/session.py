@@ -320,8 +320,12 @@ class DataFrame:
         from spark_rapids_tpu.runtime import eventlog as EL
         from spark_rapids_tpu.runtime import metrics as M
         from spark_rapids_tpu.runtime import scheduler as SCHED
+        from spark_rapids_tpu.runtime import movement as MV
         from spark_rapids_tpu.runtime import tracing
         conf = self.session.conf
+        MV.configure(
+            sample_interval_bytes=conf.get(CFG.MOVEMENT_SAMPLE_INTERVAL),
+            enabled=conf.get(CFG.MOVEMENT_ENABLED))
         collector = M.QueryMetricsCollector(description=type(plan).__name__)
         # cross-process trace id: a pending handoff (endpoint SUBMIT frame)
         # wins, then an explicit session override, else the query id — every
@@ -429,9 +433,16 @@ class DataFrame:
                 estimate_bytes=stats_payload.get("estimate_bytes"),
                 history_hit=stats_payload.get("history_hit"),
                 estimate_error=stats_payload.get("estimate_error"),
-                nodes=collector.node_summaries())
+                nodes=collector.node_summaries(),
+                # movement plane: this query's boundary-crossing bytes by
+                # (edge, link) + amplification vs the result's Arrow size
+                movement=MV.query_summary(
+                    collector, result_bytes=getattr(out, "nbytes", None)))
         if EL.enabled():
             EL.emit("plan.stats", query=collector.query_id, **stats_payload)
+        # flush the process ledger snapshot so short queries still leave a
+        # movement.sample for the profiler even below the sample interval
+        MV.maybe_emit(force=True)
         return out
 
     def collect(self) -> pa.Table:

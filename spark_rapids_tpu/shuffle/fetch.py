@@ -27,6 +27,7 @@ import time
 
 from spark_rapids_tpu.runtime import faults as F
 from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.runtime import movement as MV
 from spark_rapids_tpu.runtime import tracing
 from spark_rapids_tpu.runtime.memory import SpillCorruptionError
 from spark_rapids_tpu.shuffle.transport import _NO_KEY, TransportError
@@ -85,6 +86,12 @@ class ShuffleFetchIterator:
                 # retry -> failover -> recompute ladder first
                 SCHED.check_cancel()
                 batches = []
+                # movement-ledger attempt scope: bytes this attempt pulls
+                # land on shuffle.recv; a failed attempt discards its
+                # buffered batches below, so abort_attempt moves exactly
+                # those bytes onto the shuffle.retry edge (re-fetching must
+                # not double-count the recv ledger against partition sizes)
+                tok = MV.begin_attempt()
                 try:
                     # chaos checkpoint, shared site name with the stage
                     # ladder in exec/exchange.py ("transport:fetch:N")
@@ -104,6 +111,7 @@ class ShuffleFetchIterator:
                         # not emit a partial partition twice
                         batches.append(kb)
                 except (TransportError, SpillCorruptionError) as e:
+                    MV.abort_attempt(tok)
                     # a CRC mismatch — on the wire (TransportError from the
                     # TCP client) or in a peer's spilled block (unspill
                     # verification) — IS a fetch failure: retry, fail over,
@@ -121,6 +129,12 @@ class ShuffleFetchIterator:
                         SCHED.check_cancel()   # don't sleep a dead query
                         time.sleep(self._backoff(attempt))
                     continue
+                except BaseException:
+                    # cancellation or an unexpected error: nothing retries
+                    # these bytes, keep them on shuffle.recv
+                    MV.commit_attempt(tok)
+                    raise
+                MV.commit_attempt(tok)
                 yield from batches
                 return
             if pi < len(self.client_factories) - 1:
@@ -157,19 +171,34 @@ def iter_union_blocks(peer_factories: list, shuffle_id: int, reduce_id: int,
     bit-identical stream. Untagged blocks carry the sort-last sentinel and
     keep their (peer, arrival) order."""
     keyed = []
-    for pi, factory in enumerate(peer_factories):
-        it = ShuffleFetchIterator([factory], shuffle_id, reduce_id,
-                                  recompute=None, max_retries=max_retries,
-                                  jitter=random.Random(
-                                      0x7A11 ^ (shuffle_id << 16)
-                                      ^ (reduce_id << 4) ^ pi))
-        try:
-            for key, batch in it.iter_keyed():
-                keyed.append((key, pi, len(keyed), batch))
-        except TransportError as e:
-            raise TransportError(
-                f"peer {pi} unreachable for shuffle {shuffle_id} reduce "
-                f"{reduce_id} (epoch {epoch}): {e}") from e
+    # task-level movement attempt: when one peer stays unreachable the
+    # WHOLE reduce task fails and the driver's recompute re-fetches every
+    # peer — the bytes the healthy peers already delivered to this failed
+    # attempt must move to the shuffle.retry edge (inner per-peer aborts
+    # already deducted their share from this outer token)
+    union_tok = MV.begin_attempt()
+    try:
+        for pi, factory in enumerate(peer_factories):
+            it = ShuffleFetchIterator([factory], shuffle_id, reduce_id,
+                                      recompute=None,
+                                      max_retries=max_retries,
+                                      jitter=random.Random(
+                                          0x7A11 ^ (shuffle_id << 16)
+                                          ^ (reduce_id << 4) ^ pi))
+            try:
+                for key, batch in it.iter_keyed():
+                    keyed.append((key, pi, len(keyed), batch))
+            except TransportError as e:
+                raise TransportError(
+                    f"peer {pi} unreachable for shuffle {shuffle_id} reduce "
+                    f"{reduce_id} (epoch {epoch}): {e}") from e
+    except TransportError:
+        MV.abort_attempt(union_tok)
+        raise
+    except BaseException:
+        MV.commit_attempt(union_tok)
+        raise
+    MV.commit_attempt(union_tok)
     keyed.sort(key=lambda t: (t[0], t[1], t[2]))
     for _, _, _, batch in keyed:
         yield batch

@@ -24,6 +24,7 @@ import threading
 
 from spark_rapids_tpu import config as CFG
 from spark_rapids_tpu.runtime import faults as F
+from spark_rapids_tpu.runtime import movement as MV
 from spark_rapids_tpu.runtime import tracing
 from spark_rapids_tpu.shuffle.compression import (BatchedTableCompressor,
                                                   TableCompressionCodec,
@@ -218,11 +219,18 @@ class LocalTransport(RapidsShuffleTransport):
 
         class _Local(ShuffleClient):
             def fetch_blocks(self, shuffle_id, reduce_id):
-                yield from store.read_partition(shuffle_id, reduce_id)
+                for _, b in self.fetch_blocks_with_keys(shuffle_id,
+                                                        reduce_id):
+                    yield b
 
             def fetch_blocks_with_keys(self, shuffle_id, reduce_id):
                 for seq, b in store.read_partition_with_keys(shuffle_id,
                                                              reduce_id):
+                    # in-process store read: zero network bytes, payload
+                    # units only, under the `local` link
+                    MV.record("shuffle.recv", 0, link="local",
+                              site="transport.local",
+                              payload_bytes=b.device_memory_size())
                     yield _encode_seq(seq), b
         return _Local()
 
@@ -235,6 +243,12 @@ class _ServerHandler(socketserver.BaseRequestHandler):
     def handle(self):
         server: TcpShuffleServer = self.server.owner  # type: ignore
         sock = self.request
+        # movement ledger link class of this connection's peer, classified
+        # once per connection (a fetcher on this host is loopback, not tcp)
+        try:
+            self._link = MV.classify_peer(sock.getpeername())
+        except OSError:
+            self._link = "loopback"
         try:
             while True:
                 try:
@@ -297,6 +311,8 @@ class _ServerHandler(socketserver.BaseRequestHandler):
             except (KeyError, IndexError):
                 _send_frame(sock, MSG_ERROR, b"unknown block")
                 return
+            import time as _time
+            t0 = _time.perf_counter()
             # windowed send: bounce-buffer-sized chunks
             # (WindowedBlockIterator)
             for off in range(0, len(blob), chunk):
@@ -304,6 +320,13 @@ class _ServerHandler(socketserver.BaseRequestHandler):
                 hdr = struct.pack("<IIQ", index,
                                   1 if off + chunk >= len(blob) else 0, off)
                 _send_frame(sock, MSG_BLOCK_CHUNK, hdr + piece)
+            payload_sizes = server.block_payload_sizes(shuffle_id, reduce_id)
+            MV.record("shuffle.send", len(blob),
+                      link=getattr(self, "_link", "loopback"),
+                      site="transport.serve",
+                      payload_bytes=(payload_sizes[index]
+                                     if index < len(payload_sizes) else 0),
+                      seconds=_time.perf_counter() - t0)
 
 
 class TcpShuffleServer:
@@ -319,6 +342,11 @@ class TcpShuffleServer:
         self.compressor = BatchedTableCompressor(codec, num_threads)
         self._cache_lock = threading.Lock()
         self._frame_cache: dict = {}
+        # per-block store-unit sizes (device_memory_size of the block as
+        # registered — the unit partition_sizes speaks), cached alongside
+        # the frames so the movement ledger's shuffle.send payload column
+        # cross-checks against map-output statistics
+        self._payload_cache: dict = {}
         # drop cached frames when the shuffle itself is unregistered
         store.add_unregister_listener(self.invalidate)
 
@@ -337,10 +365,11 @@ class TcpShuffleServer:
         with self._cache_lock:
             if key in self._frame_cache:
                 return self._frame_cache[key][0]
-        keys, frames = [], []
+        keys, frames, payloads = [], [], []
         for seq, b in self.store.read_partition_with_keys(shuffle_id,
                                                           reduce_id):
             keys.append(seq)
+            payloads.append(b.device_memory_size())
             frames.append(ser.serialize_batch(b))
         frames = self.compressor.compress_all(frames)
         if self.checksum:
@@ -350,6 +379,7 @@ class TcpShuffleServer:
             crcs = [_NO_CRC] * len(frames)
         with self._cache_lock:
             self._frame_cache[key] = (frames, keys, crcs)
+            self._payload_cache[key] = payloads
         return frames
 
     def block_keys(self, shuffle_id: int, reduce_id: int) -> list:
@@ -371,10 +401,17 @@ class TcpShuffleServer:
                 return self._frame_cache[key][2]
         return []
 
+    def block_payload_sizes(self, shuffle_id: int, reduce_id: int) -> list:
+        """Store-unit bytes per served block, matching serialized_blocks'
+        frame order (empty when the cache was invalidated mid-serve)."""
+        with self._cache_lock:
+            return self._payload_cache.get((shuffle_id, reduce_id), [])
+
     def invalidate(self, shuffle_id: int):
         with self._cache_lock:
             for key in [k for k in self._frame_cache if k[0] == shuffle_id]:
                 del self._frame_cache[key]
+                self._payload_cache.pop(key, None)
 
     def close(self):
         self._srv.shutdown()
@@ -392,16 +429,27 @@ class TcpShuffleClient(ShuffleClient):
         self.address = address
         self.bounce_bytes = bounce_bytes
         self.throttle = throttle
+        # loopback vs cross-host, decided once from the peer address
+        self.link = MV.classify_peer(address)
+
+    def _decoded(self, blob):
+        """Decode one wire frame and meter its block-store-unit size into
+        the movement ledger (payload-only follow-up to the wire-bytes
+        record _fetch_serialized already made — the ledger cell carries
+        both units)."""
+        batch = ser.deserialize_batch(TableCompressionCodec.decode(blob))
+        MV.record("shuffle.recv", 0, link=self.link, site="transport.fetch",
+                  payload_bytes=batch.device_memory_size(), transfers=0)
+        return batch
 
     def fetch_blocks(self, shuffle_id, reduce_id):
         for blob in self.fetch_serialized(shuffle_id, reduce_id):
-            yield ser.deserialize_batch(TableCompressionCodec.decode(blob))
+            yield self._decoded(blob)
 
     def fetch_blocks_with_keys(self, shuffle_id, reduce_id):
         for key, blob in self.fetch_serialized_with_keys(shuffle_id,
                                                          reduce_id):
-            yield key, ser.deserialize_batch(
-                TableCompressionCodec.decode(blob))
+            yield key, self._decoded(blob)
 
     def fetch_serialized(self, shuffle_id, reduce_id):
         for _, blob in self.fetch_serialized_with_keys(shuffle_id, reduce_id):
@@ -439,6 +487,8 @@ class TcpShuffleClient(ShuffleClient):
                      for i in range(n_blocks)]
             for index, (size, k0, k1, crc) in enumerate(metas):
                 with self.throttle.acquire(size):
+                    import time as _time
+                    t0 = _time.perf_counter()
                     # span scoped to the wire transfer only — the trailing
                     # yield suspends this generator at the consumer's pace,
                     # which must not inflate the fetch span
@@ -460,6 +510,12 @@ class TcpShuffleClient(ShuffleClient):
                             buf.extend(payload[16:])
                             if last:
                                 break
+                    # wire bytes crossed the link even when the CRC check
+                    # below rejects the block — the fetch ladder's abort
+                    # then reclassifies them onto the shuffle.retry edge
+                    MV.record("shuffle.recv", len(buf), link=self.link,
+                              site="transport.fetch", payload_bytes=0,
+                              seconds=_time.perf_counter() - t0)
                     if len(buf) != size:
                         raise TransportError(
                             f"short block: got {len(buf)} want {size}")

@@ -1,0 +1,392 @@
+"""Data-movement observability plane (runtime/movement.py): the per-link
+byte ledger, fetch-attempt retry reclassification, the per-query collector
+mirror, and the cluster-level link-honesty + ledger-integrity invariants.
+
+The two headline contracts this file pins down:
+
+  * link honesty (the misattribution fix): a same-host MiniCluster moves
+    plenty of TCP bytes but ZERO cross-host bytes — every transport byte
+    classifies ``loopback`` and every in-process short-circuit ``local``,
+    so the ``tcp`` row of the ledger can never be inflated by loopback
+    traffic;
+  * no-double-count under chaos: a killed executor plus a corrupted
+    (CRC-failed, retried) fetch still leave total shuffle.recv payload
+    equal to the map-output bytes the driver registered — failed attempts'
+    bytes move to the ``shuffle.retry`` edge instead of piling onto recv.
+"""
+
+import glob
+import json
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.cluster import MiniCluster
+from spark_rapids_tpu.cluster import remote as R
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.runtime import eventlog as EL
+from spark_rapids_tpu.runtime import faults
+from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.runtime import movement as MV
+from spark_rapids_tpu.runtime import tracing
+from spark_rapids_tpu.session import TpuSession
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    EL.shutdown()
+    faults.reset()
+    M.reset_global_registry()
+    M.reset_observability()          # clears the movement ledger too
+    tracing.clear_events()
+    yield
+    EL.shutdown()
+    faults.reset()
+    M.reset_global_registry()
+    M.reset_observability()
+    tracing.clear_events()
+
+
+def _last_samples(eventlog_dir):
+    """Last (cumulative) movement.sample per process + driver-registered
+    map-output bytes, from every per-process event file in the directory."""
+    samples, registered = {}, 0
+    for path in glob.glob(str(eventlog_dir) + "/events-*.jsonl"):
+        with open(path, encoding="utf-8") as f:
+            for ln in f:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                rec = json.loads(ln)
+                if rec.get("event") == "movement.sample":
+                    samples[rec.get("pid")] = rec
+                elif rec.get("event") == "stage.map.end" \
+                        and rec.get("partition_sizes"):
+                    registered += sum(rec["partition_sizes"])
+    return samples, registered
+
+
+def _flow_sum(samples, field, pred):
+    return sum(fl[field] for rec in samples.values()
+               for fl in rec.get("flows") or [] if pred(fl))
+
+
+# -- ledger core --------------------------------------------------------------
+
+def test_record_and_snapshot():
+    MV.record("shuffle.send", 1000, link="loopback", site="t")
+    # wire record with a trimmed payload, then a payload-only follow-up
+    MV.record("shuffle.send", 500, link="loopback", site="t",
+              payload_bytes=450)
+    MV.record("shuffle.recv", 0, link="loopback", site="t",
+              payload_bytes=300, transfers=0)
+    snap = MV.snapshot()
+    c = snap[("shuffle.send", "loopback", "t")]
+    assert (c["bytes"], c["payload_bytes"], c["transfers"]) == (1500, 1450, 2)
+    r = snap[("shuffle.recv", "loopback", "t")]
+    assert (r["bytes"], r["payload_bytes"], r["transfers"]) == (0, 300, 0)
+    assert MV.edge_link_totals()[("shuffle.send", "loopback")]["bytes"] == 1500
+    assert MV.total_bytes() == 1500
+    MV.reset()
+    assert MV.snapshot() == {} and MV.total_bytes() == 0
+
+
+def test_configure_enabled_gates_recording():
+    MV.configure(enabled=False)
+    try:
+        assert not MV.enabled()
+        MV.record("h2d", 123, link="pcie", site="t")
+        assert MV.total_bytes() == 0
+    finally:
+        MV.configure(enabled=True)
+    MV.record("h2d", 123, link="pcie", site="t")
+    assert MV.total_bytes() == 123
+
+
+def test_classify_peer():
+    assert MV.classify_peer(None) == "local"
+    assert MV.classify_peer(("127.0.0.1", 7337)) == "loopback"
+    assert MV.classify_peer(("localhost", 7337)) == "loopback"
+    assert MV.classify_peer(("::1", 7337)) == "loopback"
+    assert MV.classify_peer(("10.1.2.3", 7337)) == "tcp"
+    # this process's own registered block-server host is same-host by
+    # definition, whatever IP it registered under
+    prev = R.local_address()
+    R.set_local_address(("10.1.2.3", 9999))
+    try:
+        assert MV.classify_peer(("10.1.2.3", 7337)) == "loopback"
+        assert MV.classify_peer(("10.9.9.9", 7337)) == "tcp"
+    finally:
+        R.set_local_address(prev)
+
+
+def test_transfer_histograms_fed_by_timed_records():
+    MV.record("shuffle.recv", 4096, link="loopback", site="t", seconds=0.01)
+    h = M.histograms_snapshot()
+    assert h["movement.transfer.bytes"]["count"] == 1
+    assert h["movement.transfer.bytes"]["max"] == 4096.0
+    assert h["movement.transfer.latency"]["count"] == 1
+
+
+# -- fetch-attempt reclassification (the shuffle.retry edge) ------------------
+
+def test_attempt_abort_moves_recv_to_retry():
+    tok = MV.begin_attempt()
+    MV.record("shuffle.recv", 800, link="loopback", site="transport.fetch",
+              payload_bytes=700)
+    MV.abort_attempt(tok)
+    snap = MV.snapshot()
+    recv = snap[("shuffle.recv", "loopback", "transport.fetch")]
+    assert recv["bytes"] == 0 and recv["payload_bytes"] == 0
+    retry = snap[("shuffle.retry", "loopback", "transport.fetch")]
+    assert retry["bytes"] == 800 and retry["payload_bytes"] == 700
+    # a committed attempt's bytes stay on recv
+    tok2 = MV.begin_attempt()
+    MV.record("shuffle.recv", 300, link="loopback", site="transport.fetch")
+    MV.commit_attempt(tok2)
+    recv = MV.snapshot()[("shuffle.recv", "loopback", "transport.fetch")]
+    assert recv["bytes"] == 300
+
+
+def test_nested_attempt_abort_never_double_moves():
+    """The union fetch wraps per-peer retry ladders: an inner abort must
+    deduct its bytes from the still-open task-level token, so a later
+    task-level abort moves each byte exactly once."""
+    outer = MV.begin_attempt()
+    inner = MV.begin_attempt()
+    MV.record("shuffle.recv", 100, link="loopback", site="s")
+    MV.abort_attempt(inner)            # per-peer attempt failed
+    inner2 = MV.begin_attempt()
+    MV.record("shuffle.recv", 100, link="loopback", site="s")
+    MV.commit_attempt(inner2)          # retry succeeded
+    MV.abort_attempt(outer)            # then the whole task aborted
+    tot = MV.edge_link_totals()
+    assert tot[("shuffle.retry", "loopback")]["bytes"] == 200
+    recv = tot.get(("shuffle.recv", "loopback"))
+    assert recv is None or recv["bytes"] == 0
+
+
+def test_transport_corruption_lands_on_retry_edge():
+    """End-to-end over a real TCP fetch: the CRC-failed first attempt's
+    wire bytes move to shuffle.retry, the successful retry's payload is
+    counted exactly once on shuffle.recv (satellite: deterministic nonzero
+    retry-edge bytes from the corrupt fault)."""
+    from spark_rapids_tpu.shuffle.fetch import ShuffleFetchIterator
+    from spark_rapids_tpu.shuffle.manager import ShuffleBlockStore
+    from spark_rapids_tpu.shuffle.transport import TcpTransport
+    ShuffleBlockStore.reset()
+    store = ShuffleBlockStore.get()
+    rng = np.random.default_rng(21)
+    t = pa.table({"k": pa.array(rng.integers(0, 50, 200).astype(np.int64)),
+                  "v": pa.array(rng.normal(size=200))})
+    batch = ColumnarBatch.from_arrow(t)
+    sid = store.register_shuffle()
+    store.write_block(sid, 0, batch)
+    transport = TcpTransport(RapidsConf())
+    faults.configure("corrupt:transport.corrupt:1")
+    try:
+        MV.reset()                     # drop the from_arrow h2d noise
+        addr = ("127.0.0.1", transport.port)
+        it = ShuffleFetchIterator(
+            [lambda: transport.make_client(addr)], sid, 0,
+            max_retries=1, retry_backoff_s=0.0)
+        fetched = list(it)
+        assert len(it.errors) == 1 and "checksum mismatch" in it.errors[0]
+        got = fetched[0].to_arrow()
+        assert got.to_pylist() == t.to_pylist()
+        tot = MV.edge_link_totals()
+        retry = tot[("shuffle.retry", "loopback")]
+        assert retry["bytes"] > 0              # the corrupted full block
+        assert retry["payload_bytes"] == 0     # it never decoded
+        recv = tot[("shuffle.recv", "loopback")]
+        assert recv["bytes"] > 0
+        # payload counted ONCE despite two attempts, in block-store units
+        assert recv["payload_bytes"] == \
+            sum(b.device_memory_size() for b in fetched)
+    finally:
+        faults.reset()
+        transport.shutdown()
+        ShuffleBlockStore.reset()
+
+
+# -- per-query mirror + read-outs ---------------------------------------------
+
+def test_collector_mirror_and_query_summary():
+    col = M.QueryMetricsCollector("mv-test")
+    with M.collector_context(col):
+        MV.record("shuffle.recv", 1000, link="loopback",
+                  site="transport.fetch")
+        MV.record("h2d", 400, link="pcie", site="t")
+    stats = col.movement_stats()
+    assert stats[("shuffle.recv", "loopback")]["bytes"] == 1000
+    summ = MV.query_summary(col, result_bytes=700)
+    assert summ["total_bytes"] == 1400
+    assert summ["edges"]["h2d"]["pcie"]["bytes"] == 400
+    assert summ["result_bytes"] == 700
+    assert summ["amplification"] == 2.0
+    # a query that moved nothing reports no movement section at all
+    assert MV.query_summary(M.QueryMetricsCollector("empty")) is None
+    # an aborted attempt reclassifies inside the ambient mirror too
+    with M.collector_context(col):
+        tok = MV.begin_attempt()
+        MV.record("shuffle.recv", 50, link="loopback", site="transport.fetch")
+        MV.abort_attempt(tok)
+    stats = col.movement_stats()
+    assert stats[("shuffle.recv", "loopback")]["bytes"] == 1000
+    assert stats[("shuffle.retry", "loopback")]["bytes"] == 50
+    # the test hook clears the global ledger
+    M.reset_observability()
+    assert MV.total_bytes() == 0
+
+
+def test_query_end_movement_section_and_sample(tmp_path):
+    """The session action path: query.end carries the movement section with
+    an amplification factor, a forced movement.sample flush covers short
+    queries, and a no-shuffle local query keeps every network edge at
+    exactly zero while still metering h2d."""
+    spark = TpuSession({"spark.rapids.tpu.eventLog.dir": str(tmp_path)})
+    try:
+        t = pa.table({"k": pa.array(np.arange(100, dtype=np.int64)),
+                      "v": pa.array(np.arange(100, dtype=np.float64))})
+        df = (spark.create_dataframe(t)
+              .filter(F.col("k") < F.lit(50)).select("k", "v"))
+        out = df.collect()
+        assert out.num_rows == 50
+    finally:
+        EL.shutdown()
+    recs = []
+    for path in glob.glob(str(tmp_path) + "/events-*.jsonl"):
+        with open(path, encoding="utf-8") as f:
+            recs += [json.loads(ln) for ln in f if ln.strip()]
+    qend = [r for r in recs if r.get("event") == "query.end"]
+    assert qend and qend[-1].get("movement"), qend
+    mvs = qend[-1]["movement"]
+    assert mvs["total_bytes"] > 0
+    assert mvs["edges"]["h2d"]["pcie"]["bytes"] > 0
+    assert mvs["result_bytes"] == out.nbytes
+    assert mvs["amplification"] > 0
+    for edge in MV.NETWORK_EDGES:
+        assert edge not in mvs["edges"], mvs["edges"]
+    samples = [r for r in recs if r.get("event") == "movement.sample"]
+    assert samples, "query epilogue did not force a movement.sample flush"
+    for fl in samples[-1]["flows"]:
+        assert fl["edge"] not in MV.NETWORK_EDGES or fl["bytes"] == 0, fl
+
+
+# -- capture points -----------------------------------------------------------
+
+def test_arrow_boundary_meters_pcie():
+    t = pa.table({"v": pa.array(np.arange(128, dtype=np.float64))})
+    b = ColumnarBatch.from_arrow(t)
+    sz = b.device_memory_size()
+    assert sz > 0
+    assert MV.edge_link_totals()[("h2d", "pcie")]["bytes"] == sz
+    b.to_arrow()
+    assert MV.edge_link_totals()[("d2h", "pcie")]["bytes"] == sz
+    # unified with the PR-12 per-node stats meters: one call fed both
+    assert M.current_collector() is None   # (global path exercised above)
+
+
+def test_direct_spill_store_meters_io(tmp_path):
+    from spark_rapids_tpu.runtime.direct_spill import DirectSpillStore, ALIGN
+    store = DirectSpillStore(str(tmp_path), batch_bytes=1 << 20)
+    payload = b"x" * 5000
+    try:
+        h = store.write(payload)
+        assert store.read(h) == payload
+    finally:
+        store.close()
+    snap = MV.snapshot()
+    w = snap[("spill.write", "disk", "direct_spill")]
+    # physical bytes are the ALIGNED write, payload the logical buffer
+    assert w["bytes"] == -(-len(payload) // ALIGN) * ALIGN
+    assert w["payload_bytes"] == len(payload)
+    assert w["transfers"] == 1 and w["seconds"] >= 0
+    r = snap[("spill.read", "disk", "direct_spill")]
+    assert r["bytes"] == len(payload)
+
+
+# -- cluster invariants (the satellites) --------------------------------------
+
+def test_cluster_loopback_never_inflates_tcp(tmp_path):
+    """Satellite (misattribution fix): a 2-executor same-host cluster moves
+    zero ``tcp`` bytes — transport traffic is ``loopback``, short-circuited
+    same-executor fetches are ``local`` with zero network bytes."""
+    settings = {
+        "spark.rapids.tpu.eventLog.dir": str(tmp_path),
+        "spark.rapids.tpu.movement.sample.intervalBytes": "64k",
+    }
+    spark = TpuSession()               # driver log stays off: executor-only
+    rng = np.random.default_rng(7)
+    t = pa.table({"k": pa.array(rng.integers(0, 23, 4000).astype(np.int64)),
+                  "v": pa.array(rng.random(4000))})
+    df = (spark.create_dataframe(t, num_partitions=4)
+          .group_by(F.col("k")).agg(F.sum(F.col("v")).alias("s")))
+    with MiniCluster(n_executors=2, conf=RapidsConf(settings),
+                     platform="cpu") as c:
+        got = c.collect(df)
+    assert got.num_rows == 23
+    samples, _ = _last_samples(tmp_path)
+    assert len(samples) >= 2, f"expected both executor ledgers: {samples}"
+    tcp = _flow_sum(samples, "bytes", lambda fl: fl["link"] == "tcp")
+    loop = _flow_sum(samples, "bytes", lambda fl: fl["link"] == "loopback")
+    local = _flow_sum(samples, "payload_bytes",
+                      lambda fl: fl["link"] == "local"
+                      and fl["edge"] == "shuffle.recv")
+    local_wire = _flow_sum(samples, "bytes",
+                           lambda fl: fl["link"] == "local"
+                           and fl["edge"] == "shuffle.recv")
+    assert tcp == 0, f"same-host cluster inflated the tcp ledger: {tcp}B"
+    assert loop > 0, "no loopback transport bytes metered"
+    assert local > 0, "no short-circuited local fetches metered"
+    assert local_wire == 0, "local short-circuit reported network bytes"
+
+
+def test_cluster_chaos_ledger_integrity(tmp_path):
+    """Satellite (chaos): an executor SIGKILLed at result-task start plus a
+    CRC-corrupted fetch still leave shuffle.recv payload ~= the map-output
+    bytes the driver registered (no double-count across retries and
+    recomputes), with the failed attempt's bytes on the retry edge."""
+    rng = np.random.default_rng(11)
+    t = pa.table({"k": pa.array(rng.integers(0, 9, 3000).astype(np.int64)),
+                  "v": pa.array(rng.random(3000))})
+    # expectation BEFORE the event log opens: the driver-local run must not
+    # pollute the driver's stage/movement records
+    spark = TpuSession()
+    df = (spark.create_dataframe(t, num_partitions=4)
+          .group_by(F.col("k")).agg(F.sum(F.col("v")).alias("s")))
+    exp = {r["k"]: r["s"] for r in df.collect_host().to_pylist()}
+    settings = {
+        "spark.rapids.tpu.eventLog.dir": str(tmp_path),
+        "spark.rapids.tpu.movement.sample.intervalBytes": "64k",
+    }
+    TpuSession(settings)               # arms the DRIVER's event log
+    chaos = dict(settings)
+    chaos["spark.rapids.tpu.test.faults"] = \
+        "exec_kill:cluster.result.begin.0:1,corrupt:transport.corrupt:1"
+    MV.reset()
+    try:
+        with MiniCluster(n_executors=2, conf=RapidsConf(chaos),
+                         platform="cpu") as c:
+            got = {r["k"]: r["s"] for r in c.collect(df).to_pylist()}
+    finally:
+        EL.shutdown()
+    assert set(got) == set(exp)
+    for k in exp:
+        assert got[k] == pytest.approx(exp[k], rel=1e-9), k
+    samples, registered = _last_samples(tmp_path)
+    assert registered > 0, "driver log carries no stage.map.end sizes"
+    retry = _flow_sum(samples, "bytes",
+                      lambda fl: fl["edge"] == "shuffle.retry")
+    assert retry > 0, "corrupted fetch left no bytes on the retry edge"
+    recv = _flow_sum(samples, "payload_bytes",
+                     lambda fl: fl["edge"] == "shuffle.recv")
+    cov = recv / registered
+    assert 0.85 <= cov <= 1.2, \
+        (f"recv payload {recv}B vs registered {registered}B ({cov:.2f}x): "
+         f"retries/recomputes double-counted the ledger")
+    tcp = _flow_sum(samples, "bytes", lambda fl: fl["link"] == "tcp")
+    assert tcp == 0

@@ -20,7 +20,11 @@ allocation-site heap profiler), so a perf win that doubles the high-water
 mark is visible in the same report. Per-query ``estimate_error`` deltas
 (runtime statistics plane: |admission estimate - observed peak| / peak)
 ride the same way, so a change that degrades footprint estimation shows
-up next to the perf numbers it would distort.
+up next to the perf numbers it would distort. So do per-query ``movement``
+deltas (data-movement plane: total boundary-crossing bytes + movement
+amplification) — a perf win that silently moves twice the data is visible
+in the same report; a baseline committed before the movement fields
+existed is skipped per-field, never treated as zero.
 
 Usage:
   python tools/bench_compare.py <current.json> [--baseline BENCH_r06.json]
@@ -120,6 +124,23 @@ def compare(cur: dict, base: dict) -> dict:
             row["estimate_error"] = c["estimate_error"]
             row["estimate_error_delta"] = round(
                 c["estimate_error"] - b["estimate_error"], 6)
+        # movement trajectory (data-movement plane): total bytes the hot
+        # rep moved across boundaries — only when BOTH lines carry the
+        # section; a baseline committed before the movement plane existed
+        # honestly skips rather than pretending a zero
+        if "movement" in c and "movement" in b:
+            cm, bm = c["movement"], b["movement"]
+            moved = (lambda m: sum(v for k, v in m.items()
+                                   if isinstance(v, (int, float))
+                                   and k.endswith("_bytes")))
+            row["moved_bytes"] = moved(cm)
+            row["moved_delta_bytes"] = moved(cm) - moved(bm)
+            if cm.get("movement_amplification") is not None \
+                    and bm.get("movement_amplification") is not None:
+                row["amplification"] = cm["movement_amplification"]
+                row["amplification_delta"] = round(
+                    cm["movement_amplification"]
+                    - bm["movement_amplification"], 3)
         rows.append(row)
     geomean = math.exp(sum(math.log(r["ratio"]) for r in rows) / len(rows))
     return {"queries": rows, "geomean_ratio": round(geomean, 4),
@@ -152,6 +173,12 @@ def main(argv=None) -> int:
         if "estimate_error_delta" in r:
             extra += (f"  est_err {r['estimate_error']} "
                       f"({r['estimate_error_delta']:+.3f} vs baseline)")
+        if "moved_delta_bytes" in r:
+            extra += (f"  moved {r['moved_bytes']}B "
+                      f"({r['moved_delta_bytes']:+d}B vs baseline)")
+        if "amplification_delta" in r:
+            extra += (f"  amp {r['amplification']}x "
+                      f"({r['amplification_delta']:+.3f} vs baseline)")
         print(f"  {r['query']}: vs_baseline {r['base_vs_baseline']} -> "
               f"{r['cur_vs_baseline']}  (x{r['ratio']}){extra}")
     reg = d["regression"]

@@ -1,0 +1,150 @@
+"""movement_gate — CI gate for the data-movement observability plane.
+
+Runs one TPC-H query on an N-executor MiniCluster with the event log on
+and asserts the movement-ledger contract (runtime/movement.py) end to end:
+
+  - coverage: the shuffle.recv payload bytes summed across every process's
+    LAST movement.sample cover >=90% (and <=115%) of the map-output bytes
+    the driver registered (the stage.map.end partition-size records) — the
+    ledger sees what the block store served;
+  - link honesty: a same-host MiniCluster moves ZERO cross-host ``tcp``
+    bytes — every transport byte classifies ``loopback`` and every
+    short-circuited local-store fetch ``local``, so the cross-host ledger
+    can never be inflated by loopback traffic (the misattribution
+    regression this plane fixes);
+  - no-faults cleanliness: the shuffle.retry edge is exactly zero;
+  - single-process invariant: after a ledger reset, a no-shuffle local
+    query records exactly zero bytes on every network-capable edge
+    (movement.NETWORK_EDGES) while still metering its h2d/d2h traffic.
+
+Must be a real script file, not a ``python -`` heredoc: the spawn-based
+executor bootstrap re-imports __main__, and stdin cannot be re-imported.
+
+Usage:
+  python tools/movement_gate.py --data-dir /tmp/tpch_sf0.01 \
+      --eventlog-dir DIR [--query q18] [--scale 0.01] [--executors 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import pathlib
+import sys
+
+
+def _last_samples(eventlog_dir: str) -> tuple[dict, int]:
+    """(last movement.sample per pid, driver-registered map-output bytes)
+    parsed from every per-process event file in the directory."""
+    samples: dict = {}
+    registered = 0
+    for path in glob.glob(eventlog_dir + "/events-*.jsonl"):
+        with open(path, encoding="utf-8") as f:
+            for ln in f:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                rec = json.loads(ln)
+                if rec.get("event") == "movement.sample":
+                    samples[rec.get("pid")] = rec
+                elif rec.get("event") == "stage.map.end" \
+                        and rec.get("partition_sizes"):
+                    registered += sum(rec["partition_sizes"])
+    return samples, registered
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="movement_gate.py", description=__doc__)
+    p.add_argument("--data-dir", required=True)
+    p.add_argument("--eventlog-dir", required=True)
+    p.add_argument("--query", default="q18")
+    p.add_argument("--scale", type=float, default=0.01)
+    p.add_argument("--executors", type=int, default=3)
+    args = p.parse_args(argv)
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import pyarrow as pa
+    import spark_rapids_tpu  # noqa: F401  (enables x64)
+    import spark_rapids_tpu.functions as F
+    from spark_rapids_tpu.benchmarks import tpch
+    from spark_rapids_tpu.cluster import MiniCluster
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.runtime import eventlog
+    from spark_rapids_tpu.runtime import movement as MV
+    from spark_rapids_tpu.session import TpuSession
+
+    paths = tpch.generate(args.scale, args.data_dir)
+    settings = {
+        "spark.rapids.tpu.eventLog.dir": args.eventlog_dir,
+        # small interval: mid-task threshold emissions exercised too, not
+        # only the forced end-of-task flushes
+        "spark.rapids.tpu.movement.sample.intervalBytes": "64k"}
+    spark = TpuSession(settings)
+    dfs = tpch.load(spark, paths, files_per_partition=4)
+    df = tpch.QUERIES[args.query](dfs)
+
+    # the executors need the settings too (their bootstrap configures the
+    # event log + ledger from the cluster conf, not the driver session)
+    with MiniCluster(n_executors=args.executors, conf=RapidsConf(settings),
+                     platform="cpu") as c:
+        c.collect(df)
+
+    # single-process invariant, same driver process: a no-shuffle local
+    # query must leave every network-capable edge at exactly zero while
+    # its host<->device traffic is still metered
+    MV.reset()
+    local = spark.create_dataframe(pa.table({
+        "k": list(range(100)), "v": [float(i) for i in range(100)]}))
+    local.filter(F.col("k") < F.lit(50)).select("k", "v").collect()
+    snap = MV.snapshot()
+    net = {k: v for k, v in snap.items() if k[0] in MV.NETWORK_EDGES
+           and (v["bytes"] or v["payload_bytes"])}
+    assert not net, f"no-shuffle local query touched network edges: {net}"
+    pcie = sum(v["bytes"] for k, v in snap.items() if k[0] in ("h2d", "d2h"))
+    assert pcie > 0, f"local query metered no h2d/d2h traffic: {snap}"
+
+    eventlog.shutdown()
+
+    samples, registered = _last_samples(args.eventlog_dir)
+    assert registered > 0, "driver log carries no stage.map.end sizes"
+    assert len(samples) >= 2, \
+        f"expected driver + executor movement samples, got {sorted(samples)}"
+    by_edge_link: dict = {}
+    for rec in samples.values():
+        for f in rec.get("flows") or []:
+            k = (f["edge"], f["link"])
+            c = by_edge_link.setdefault(
+                k, {"bytes": 0, "payload_bytes": 0})
+            c["bytes"] += f["bytes"]
+            c["payload_bytes"] += f["payload_bytes"]
+
+    recv = sum(c["payload_bytes"] for (e, _lk), c in by_edge_link.items()
+               if e == "shuffle.recv")
+    cov = recv / registered
+    assert 0.90 <= cov <= 1.15, \
+        (f"shuffle.recv payload {recv}B vs registered {registered}B "
+         f"({cov:.2f}x) outside [0.90, 1.15]")
+    tcp = sum(c["bytes"] for (_e, lk), c in by_edge_link.items()
+              if lk == "tcp")
+    loop = sum(c["bytes"] for (_e, lk), c in by_edge_link.items()
+               if lk == "loopback")
+    assert tcp == 0, \
+        f"same-host cluster inflated the cross-host ledger: tcp={tcp}B"
+    assert loop > 0, f"no loopback transport bytes metered: {by_edge_link}"
+    retry = sum(c["bytes"] + c["payload_bytes"]
+                for (e, _lk), c in by_edge_link.items()
+                if e == "shuffle.retry")
+    assert retry == 0, f"no-faults run left retry-edge bytes: {retry}"
+
+    print(f"movement gate ok [{args.query}, {args.executors} executors]: "
+          f"recv payload {recv}B covers {cov:.2f}x of {registered}B "
+          f"registered, tcp=0B loopback={loop}B, retry=0, "
+          f"{len(samples)} process ledgers, local no-shuffle edges clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

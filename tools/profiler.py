@@ -33,11 +33,19 @@ timeline, and end-of-query leak detections. ``--diff`` compares the final
 heap snapshots of two logs per site (live/peak/cumulative deltas) — the
 before/after view for hunting growth between runs.
 
+``movement`` replays the data-movement plane (runtime/movement.py): the
+last cumulative movement.sample per process is summed across every log
+passed (driver + executor per-process files) into a source->destination
+byte matrix, a top-flows table per (edge, link), the loopback-vs-remote
+split of network-capable bytes, and per-query movement amplification
+(bytes moved per result byte, from query.end's movement section).
+
 Usage:
   python tools/profiler.py report <eventlog.jsonl> [--json] [--top N]
   python tools/profiler.py report <eventlog.jsonl> --compare <other.jsonl>
   python tools/profiler.py trace <logdir> [--query TRACE] [--out trace.json]
   python tools/profiler.py memory <eventlog.jsonl> [--diff <other.jsonl>]
+  python tools/profiler.py movement <eventlog.jsonl> [more.jsonl ...]
 
 Exit status is non-zero on schema violations, when no query in the log
 carries a non-empty operator breakdown (report), on malformed span files
@@ -804,6 +812,146 @@ def memory_main(args) -> int:
 
 
 # ---------------------------------------------------------------------------
+# movement plane (runtime/movement.py)
+# ---------------------------------------------------------------------------
+
+def _movement_module():
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    from spark_rapids_tpu.runtime import movement
+    return movement
+
+
+def analyze_movement(records: list) -> dict:
+    """Replay the movement plane of one or more merged per-process logs.
+    movement.sample events are CUMULATIVE ledger snapshots, so the merged
+    view is the LAST sample per pid summed across pids; per-query sections
+    come from query.end's embedded movement field. The matrix speaks
+    payload (block-store) units so its shuffle row cross-checks against
+    registered partition sizes; the link ratio speaks wire bytes."""
+    mv = _movement_module()
+    last_sample: dict = {}
+    for r in records:
+        if r.get("event") == "movement.sample":
+            last_sample[r.get("pid")] = r
+    flows: dict = {}
+    for rec in last_sample.values():
+        for f in rec.get("flows") or []:
+            k = (f.get("edge", "?"), f.get("link", "?"))
+            cell = flows.setdefault(
+                k, {"bytes": 0, "payload_bytes": 0, "transfers": 0})
+            cell["bytes"] += int(f.get("bytes", 0))
+            cell["payload_bytes"] += int(f.get("payload_bytes", 0))
+            cell["transfers"] += int(f.get("transfers", 0))
+
+    # source -> destination byte matrix in payload units
+    matrix: dict = {}
+    for (edge, _link), cell in flows.items():
+        src, dst = mv.EDGES.get(edge, ("?", "?"))
+        matrix[(src, dst)] = matrix.get((src, dst), 0) \
+            + cell["payload_bytes"]
+
+    # loopback-vs-remote split of the bytes that could have crossed a NIC
+    # (wire units; h2d/d2h/ici/disk never ride the network)
+    by_link = {"tcp": 0, "loopback": 0, "local": 0}
+    for (edge, link), cell in flows.items():
+        if edge in mv.NETWORK_EDGES and link in by_link:
+            by_link[link] += cell["bytes"]
+
+    queries = [{
+        "query": r.get("query"), "description": r.get("description", ""),
+        **(r.get("movement") or {}),
+    } for r in records if r.get("event") == "query.end" and r.get("movement")]
+
+    top = sorted(
+        ({"edge": e, "link": lk, **cell}
+         for (e, lk), cell in flows.items()),
+        key=lambda f: max(f["bytes"], f["payload_bytes"]), reverse=True)
+    return {
+        "processes": sorted(last_sample),
+        "flows": top,
+        "matrix": {f"{s}->{d}": v for (s, d), v in sorted(matrix.items())},
+        "by_link": by_link,
+        "queries": queries,
+        "total_bytes": sum(c["bytes"] for c in flows.values()),
+        "total_payload_bytes": sum(c["payload_bytes"]
+                                   for c in flows.values()),
+    }
+
+
+def render_movement(m: dict, top: int = 15) -> str:
+    out = [f"== movement: {len(m['processes'])} process ledger(s) merged, "
+           f"{_fmt_bytes(m['total_bytes'])} wire / "
+           f"{_fmt_bytes(m['total_payload_bytes'])} payload"]
+    if m["matrix"]:
+        out.append("  byte matrix (payload units, source -> destination):")
+        srcs = sorted({k.split("->")[0] for k in m["matrix"]})
+        dsts = sorted({k.split("->")[1] for k in m["matrix"]})
+        out.append("    " + f"{'':>8}" + "".join(f"{d:>12}" for d in dsts))
+        for s in srcs:
+            row = "".join(
+                f"{_fmt_bytes(m['matrix'][f'{s}->{d}']):>12}"
+                if f"{s}->{d}" in m["matrix"] else f"{'-':>12}"
+                for d in dsts)
+            out.append(f"    {s:>8}" + row)
+    if m["flows"]:
+        out.append("  top flows:")
+        out.append(f"    {'wire':>10}  {'payload':>10}  {'transfers':>9}  "
+                   "edge[link]")
+        for f in m["flows"][:top]:
+            out.append(f"    {_fmt_bytes(f['bytes']):>10}  "
+                       f"{_fmt_bytes(f['payload_bytes']):>10}  "
+                       f"{f['transfers']:>9}  {f['edge']}[{f['link']}]")
+        heaviest = m["flows"][0]
+        out.append(f"  heaviest flow: {heaviest['edge']}[{heaviest['link']}]"
+                   f" — {_fmt_bytes(heaviest['bytes'])} wire / "
+                   f"{_fmt_bytes(heaviest['payload_bytes'])} payload in "
+                   f"{heaviest['transfers']} transfer(s)")
+    lk = m["by_link"]
+    net = lk["tcp"] + lk["loopback"] + lk["local"]
+    if net:
+        out.append(
+            "  loopback-vs-remote: "
+            f"tcp={_fmt_bytes(lk['tcp'])} "
+            f"loopback={_fmt_bytes(lk['loopback'])} "
+            f"local={_fmt_bytes(lk['local'])}"
+            + (f" — {lk['tcp'] / net:.0%} of network-capable bytes "
+               "crossed a host boundary" if lk["tcp"]
+               else " — no cross-host traffic (everything stayed on-host)"))
+    for q in m["queries"]:
+        line = (f"  query {q['query']} [{q.get('description', '')}]: "
+                f"{_fmt_bytes(q.get('total_bytes', 0))} moved")
+        if q.get("result_bytes"):
+            line += (f", result {_fmt_bytes(q['result_bytes'])}, "
+                     f"amplification {q.get('amplification')}x")
+        out.append(line)
+    return "\n".join(out)
+
+
+def movement_main(args) -> int:
+    records, violations = [], []
+    for path in args.eventlog:
+        recs, viols = load_log(path)
+        records.extend(recs)
+        violations.extend(viols)
+    rc = 0
+    if violations:
+        for v in violations:
+            print(f"SCHEMA VIOLATION: {v}", file=sys.stderr)
+        rc = 1
+    m = analyze_movement(records)
+    if not (m["flows"] or m["queries"]):
+        print("ERROR: no movement-plane events in "
+              f"{', '.join(args.eventlog)} (movement.sample / query.end "
+              "movement)", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(m, indent=2, default=str))
+    else:
+        print(render_movement(m, top=args.top))
+    return rc
+
+
+# ---------------------------------------------------------------------------
 # rendering
 # ---------------------------------------------------------------------------
 
@@ -1146,6 +1294,17 @@ def main(argv=None) -> int:
                     help="machine-readable analysis instead of text")
     st.add_argument("--top", type=int, default=15,
                     help="node-ledger rows per query")
+    mv = sub.add_parser(
+        "movement", help="data-movement plane: source->dest byte matrix, "
+                         "top flows, loopback-vs-remote split and per-query "
+                         "movement amplification")
+    mv.add_argument("eventlog", nargs="+",
+                    help="one or more event logs (pass every per-process "
+                         "events-*.jsonl of a cluster run to merge them)")
+    mv.add_argument("--json", action="store_true",
+                    help="machine-readable analysis instead of text")
+    mv.add_argument("--top", type=int, default=15,
+                    help="flow rows in the top-flows table")
     args = p.parse_args(argv)
 
     if args.cmd == "trace":
@@ -1154,6 +1313,8 @@ def main(argv=None) -> int:
         return memory_main(args)
     if args.cmd == "stats":
         return stats_main(args)
+    if args.cmd == "movement":
+        return movement_main(args)
 
     records, violations = load_log(args.eventlog)
     analysis = analyze(records)
