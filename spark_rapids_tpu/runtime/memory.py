@@ -616,56 +616,66 @@ class BufferCatalog:
         """Materialize the buffer on device. If it was spilled and unspill is enabled
         it is re-registered in the device tier (reference unspill.enabled,
         RapidsBufferStore copy-back); otherwise the device copy is transient."""
-        with self._lock:
-            try:
-                buf = self._buffers[buffer_id]
-            except KeyError:
-                raise BufferClosedError(f"buffer {buffer_id} removed") from None
-            if buf.tier == TierEnum.DEVICE:
-                return buf._device
-            hb = buf._host
-            if hb is None:
-                if buf._handle is not None:
-                    payload = self._get_direct_store().read(buf._handle)
-                else:
-                    t0 = time.perf_counter()
-                    with open(buf._path, "rb") as f:
-                        payload = f.read()
-                    from spark_rapids_tpu.runtime import movement as MV
-                    MV.record("spill.read", len(payload), link="disk",
-                              site="spill.file",
-                              seconds=time.perf_counter() - t0)
-                if buf._crc is not None:
-                    from spark_rapids_tpu.runtime.checksum import \
-                        block_checksum
-                    got = block_checksum(payload)
-                    if got != buf._crc:
-                        raise SpillCorruptionError(
-                            f"buffer {buffer_id} spill payload checksum "
-                            f"mismatch on unspill (stored {buf._crc:#x}, "
-                            f"read {got:#x}, {len(payload)}B)")
-                hb = pickle.loads(payload)
-            batch = host_to_batch(hb)
-            if self._unspill:
-                if buf.tier == TierEnum.HOST:
-                    self.host_bytes -= hb.nbytes()
-                elif buf._handle is not None:
-                    self._get_direct_store().delete(buf._handle)
-                    buf._handle = None
-                else:
-                    os.unlink(buf._path)
-                    buf._path = None
-                if buf.tier == TierEnum.DISK:
-                    self.disk_bytes -= buf._disk_len
-                    buf._disk_len = 0
-                buf._host = None
-                buf._device = batch
-                buf.tier = TierEnum.DEVICE
-                self.device_bytes += buf.size
-                self._account_device_delta(buf, buf.size)
-                self._ensure_device_budget(exclude=buffer_id)
-                self._maybe_sample()
-            return batch
+        # (bytes, seconds) collected under the lock, metered after release:
+        # a sample-interval crossing in MV.record emits event-log/tracing
+        # I/O, which must not run under the hot buffer-catalog lock (same
+        # split direct_spill.py uses for its write path)
+        spill_read = None
+        try:
+            with self._lock:
+                try:
+                    buf = self._buffers[buffer_id]
+                except KeyError:
+                    raise BufferClosedError(
+                        f"buffer {buffer_id} removed") from None
+                if buf.tier == TierEnum.DEVICE:
+                    return buf._device
+                hb = buf._host
+                if hb is None:
+                    if buf._handle is not None:
+                        payload = self._get_direct_store().read(buf._handle)
+                    else:
+                        t0 = time.perf_counter()
+                        with open(buf._path, "rb") as f:
+                            payload = f.read()
+                        spill_read = (len(payload),
+                                      time.perf_counter() - t0)
+                    if buf._crc is not None:
+                        from spark_rapids_tpu.runtime.checksum import \
+                            block_checksum
+                        got = block_checksum(payload)
+                        if got != buf._crc:
+                            raise SpillCorruptionError(
+                                f"buffer {buffer_id} spill payload checksum "
+                                f"mismatch on unspill (stored {buf._crc:#x}, "
+                                f"read {got:#x}, {len(payload)}B)")
+                    hb = pickle.loads(payload)
+                batch = host_to_batch(hb)
+                if self._unspill:
+                    if buf.tier == TierEnum.HOST:
+                        self.host_bytes -= hb.nbytes()
+                    elif buf._handle is not None:
+                        self._get_direct_store().delete(buf._handle)
+                        buf._handle = None
+                    else:
+                        os.unlink(buf._path)
+                        buf._path = None
+                    if buf.tier == TierEnum.DISK:
+                        self.disk_bytes -= buf._disk_len
+                        buf._disk_len = 0
+                    buf._host = None
+                    buf._device = batch
+                    buf.tier = TierEnum.DEVICE
+                    self.device_bytes += buf.size
+                    self._account_device_delta(buf, buf.size)
+                    self._ensure_device_budget(exclude=buffer_id)
+                    self._maybe_sample()
+                return batch
+        finally:
+            if spill_read is not None:
+                from spark_rapids_tpu.runtime import movement as MV
+                MV.record("spill.read", spill_read[0], link="disk",
+                          site="spill.file", seconds=spill_read[1])
 
     def get_tier(self, buffer_id: int) -> str:
         return self._buffers[buffer_id].tier

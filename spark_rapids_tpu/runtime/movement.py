@@ -207,12 +207,26 @@ def begin_attempt() -> dict:
     return tok
 
 
+def _pop_token(tok: dict) -> None:
+    """Remove `tok` from this thread's attempt stack by IDENTITY. Nested
+    tokens (the union token plus the first per-peer token) start as equal
+    empty dicts and receive identical updates in record(), so value
+    comparison (``tok in stack`` / ``list.remove``) can pop a sibling
+    instead — leaking a zombie token that absorbs every future
+    shuffle.recv note and corrupting the no-double-count invariant."""
+    stack = getattr(_tls, "attempts", None)
+    if not stack:
+        return
+    for i, t in enumerate(stack):
+        if t is tok:
+            del stack[i]
+            return
+
+
 def commit_attempt(tok: dict) -> None:
     """The attempt's batches were yielded downstream — its bytes stay on
     the shuffle.recv edge."""
-    stack = getattr(_tls, "attempts", None)
-    if stack and tok in stack:
-        stack.remove(tok)
+    _pop_token(tok)
 
 
 def abort_attempt(tok: dict) -> None:
@@ -223,9 +237,7 @@ def abort_attempt(tok: dict) -> None:
     This is the no-double-count invariant the chaos ledger test asserts:
     total recv payload stays equal to the block store's partition sizes no
     matter how many attempts it took."""
-    stack = getattr(_tls, "attempts", None)
-    if stack and tok in stack:
-        stack.remove(tok)
+    _pop_token(tok)
     if not tok:
         return
     col = M.current_collector()

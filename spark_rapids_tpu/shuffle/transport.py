@@ -307,7 +307,9 @@ class _ServerHandler(socketserver.BaseRequestHandler):
                 tracing.span("shuffle.serve.block", shuffle=shuffle_id,
                              reduce=reduce_id, index=index):
             try:
-                blob = self._blocks(server, shuffle_id, reduce_id)[index]
+                blobs, payload_sizes = server.serve_entry(shuffle_id,
+                                                          reduce_id)
+                blob = blobs[index]
             except (KeyError, IndexError):
                 _send_frame(sock, MSG_ERROR, b"unknown block")
                 return
@@ -320,12 +322,10 @@ class _ServerHandler(socketserver.BaseRequestHandler):
                 hdr = struct.pack("<IIQ", index,
                                   1 if off + chunk >= len(blob) else 0, off)
                 _send_frame(sock, MSG_BLOCK_CHUNK, hdr + piece)
-            payload_sizes = server.block_payload_sizes(shuffle_id, reduce_id)
             MV.record("shuffle.send", len(blob),
                       link=getattr(self, "_link", "loopback"),
                       site="transport.serve",
-                      payload_bytes=(payload_sizes[index]
-                                     if index < len(payload_sizes) else 0),
+                      payload_bytes=payload_sizes[index],
                       seconds=_time.perf_counter() - t0)
 
 
@@ -406,6 +406,23 @@ class TcpShuffleServer:
         frame order (empty when the cache was invalidated mid-serve)."""
         with self._cache_lock:
             return self._payload_cache.get((shuffle_id, reduce_id), [])
+
+    def serve_entry(self, shuffle_id: int, reduce_id: int) -> tuple:
+        """Frames plus their matching store-unit payload sizes, snapshotted
+        as one consistent pair BEFORE the frames are served. Frame lookup
+        goes through serialized_blocks (the fault-injection patch point);
+        if invalidate() races between the build and the payload snapshot
+        the pair is rebuilt, so a served block is never metered with
+        payload_bytes=0 just because its shuffle was unregistered mid-send."""
+        key = (shuffle_id, reduce_id)
+        blobs: list = []
+        for _ in range(2):
+            blobs = self.serialized_blocks(shuffle_id, reduce_id)
+            with self._cache_lock:
+                payloads = self._payload_cache.get(key)
+            if payloads is not None and len(payloads) == len(blobs):
+                return blobs, payloads
+        return blobs, [0] * len(blobs)
 
     def invalidate(self, shuffle_id: int):
         with self._cache_lock:

@@ -169,6 +169,31 @@ def test_nested_attempt_abort_never_double_moves():
     assert recv is None or recv["bytes"] == 0
 
 
+def test_token_removal_is_by_identity_not_value():
+    """Nested tokens start as equal empty dicts and receive identical
+    updates in record(), so commit/abort must pop the exact token OBJECT —
+    value comparison removes a sibling instead (regression: a peer's retry
+    ladder exhausting then the union token aborting drove shuffle.recv
+    negative, over-counted shuffle.retry, and leaked a zombie token that
+    absorbed every later recv note on the thread)."""
+    union = MV.begin_attempt()
+    peer = MV.begin_attempt()          # value-equal to union throughout
+    MV.record("shuffle.recv", 100, link="loopback", site="s")
+    MV.abort_attempt(peer)             # first per-peer attempt failed
+    peer2 = MV.begin_attempt()
+    MV.record("shuffle.recv", 50, link="loopback", site="s")
+    MV.abort_attempt(peer2)            # retry failed too: ladder exhausted
+    MV.abort_attempt(union)            # so the whole union fetch aborts
+    tot = MV.edge_link_totals()
+    assert tot[("shuffle.retry", "loopback")]["bytes"] == 150
+    recv = tot.get(("shuffle.recv", "loopback"))
+    assert recv is None or recv["bytes"] == 0
+    # no zombie token left to absorb this thread's future recv notes
+    assert not getattr(MV._tls, "attempts", None)
+    MV.record("shuffle.recv", 30, link="loopback", site="s")
+    assert MV.edge_link_totals()[("shuffle.recv", "loopback")]["bytes"] == 30
+
+
 def test_transport_corruption_lands_on_retry_edge():
     """End-to-end over a real TCP fetch: the CRC-failed first attempt's
     wire bytes move to shuffle.retry, the successful retry's payload is
