@@ -132,6 +132,17 @@ Q_TABLES = {
 }
 
 
+def _h2d_sites():
+    """h2d bytes by metering SITE from the global movement ledger (the
+    per-query collector mirror aggregates by link only)."""
+    from spark_rapids_tpu.runtime import movement as MV
+    out: dict = {}
+    for (edge, link, site), rec in MV.snapshot().items():
+        if edge == "h2d":
+            out[site] = out.get(site, 0) + rec["bytes"]
+    return out
+
+
 def child_main():
     """Measured run; prints the JSON line on success. Runs in a subprocess so a
     wedged tunnel or backend crash cannot take down the parent."""
@@ -177,11 +188,19 @@ def child_main():
             got = res.to_pylist()
             exp = getattr(tpch, NP_QUERIES[name])(tb)
             CHECKS[name](got, exp)              # wrong answer → no number
+            # per-SITE h2d split (global ledger delta over the timed reps,
+            # averaged back to one rep): the per-query collector mirror has
+            # no site dimension, and the encoded-upload win is precisely the
+            # scan.encoded-vs-scan.device split (tools/bench_compare.py)
+            site0 = _h2d_sites()
             ts = []
             for _ in range(BENCH_REPS):
                 t0 = time.perf_counter()
                 df.collect()
                 ts.append(time.perf_counter() - t0)
+            site_delta = {
+                k: (v - site0.get(k, 0)) // BENCH_REPS
+                for k, v in _h2d_sites().items() if v - site0.get(k, 0) > 0}
             eng = statistics.median(ts)
             spread = (max(ts) - min(ts)) / eng if eng > 0 else 0.0
             # fair oracle: re-read this query's tables from parquet +
@@ -289,6 +308,7 @@ def child_main():
                         "movement_amplification": (
                             round(total_moved / res.nbytes, 3)
                             if res.nbytes else None),
+                        "h2d_sites": site_delta,
                     }
 
     # resilience counters (retry/split/fetch-failover totals across the

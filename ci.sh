@@ -65,7 +65,7 @@ print("bench-child dry-run ok:", d["metric"], d["value"], d["unit"],
 # the committed sf0.1 line, so this prints the SKIP reason here; round
 # drivers comparing same-scale lines get the real gate
 echo "$bench_line" > /tmp/ci_bench_line.json
-python tools/bench_compare.py /tmp/ci_bench_line.json --baseline BENCH_r06.json
+python tools/bench_compare.py /tmp/ci_bench_line.json --baseline BENCH_r07.json
 
 echo "== radix spine: kernel interpret tests + join microbench smoke =="
 # the exact kernel set the next chip window's probe latch will exercise,
@@ -265,6 +265,110 @@ else:
 PYEOF
 done
 rm -rf "$stage_cache_dir"
+
+echo "== scan-side chain: bit-identity + warm-start replay of fused scan stages =="
+# the scan-floor gate (perf_notes r9): q1 and q18 with the full scan-side
+# chain on (device decode + encoded upload + fused decode→filter→partial-agg
+# + chained group-by) must be bit-identical to the arrow path, and a FRESH
+# process pointed at the populated stage cache must replay every fused scan
+# stage (EncodedCol signatures included) with zero Python retraces
+scan_cache_dir=$(mktemp -d /tmp/srt_scancache.XXXXXX)
+for phase in populate replay; do
+SRT_CI_PHASE="$phase" SRT_CI_CACHE_DIR="$scan_cache_dir" \
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import os
+import jax; jax.config.update("jax_platforms", "cpu")
+import spark_rapids_tpu  # noqa: F401  (enables x64)
+from spark_rapids_tpu.benchmarks import tpch
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.runtime import fuse, stage_cache
+
+phase = os.environ["SRT_CI_PHASE"]
+paths = tpch.generate(0.01, "/tmp/tpch_ci_sf0.01_f12", files_per_table=12)
+ON = {
+    "spark.rapids.tpu.sql.stageFusion.enabled": True,
+    "spark.rapids.tpu.sql.parquet.deviceDecode.enabled": True,
+    "spark.rapids.tpu.sql.parquet.encodedUpload.enabled": True,
+    "spark.rapids.tpu.sql.stage.cache.enabled": True,
+    "spark.rapids.tpu.sql.stage.cache.dir": os.environ["SRT_CI_CACHE_DIR"]}
+
+def run(query, conf):
+    spark = TpuSession(dict(conf))
+    dfs = tpch.load(spark, paths, files_per_partition=3)
+    return tpch.QUERIES[query](dfs).collect().to_pylist()
+
+if phase == "populate":
+    for q in ("q1", "q18"):
+        on = run(q, ON)
+        off = run(q, {
+            "spark.rapids.tpu.sql.stageFusion.enabled": False,
+            "spark.rapids.tpu.sql.parquet.deviceDecode.enabled": False})
+        assert on == off, f"{q}: encoded scan-chain rows differ from arrow"
+    st = stage_cache.get()
+    print(f"scan gate [populate]: q1/q18 bit-identical, saves={st.saves}")
+    assert st.saves > 0, "populate session saved no stage executables"
+else:
+    run("q1", ON)
+    run("q18", ON)
+    traces = fuse.stage_metrics()["traces"]
+    st = stage_cache.get()
+    print(f"scan gate [replay]: traces={traces} hits={st.hits}")
+    assert traces == 0, f"warm-start fused scan stages retraced {traces}"
+    assert st.hits > 0, "warm-start session hit no cache entries"
+PYEOF
+done
+rm -rf "$scan_cache_dir"
+
+echo "== scan-side chain: encoded-upload h2d pricing via profiler.py movement =="
+# the movement read-out must PRICE the win: q1 (scan-heavy, dictionary-
+# friendly columns) re-run with dense device upload moves >=1.3x the PCIe
+# bytes of the encoded run, as replayed from the event logs by the
+# profiler's movement plane — the gate reads the TOOL, not the in-process
+# ledger, so the read-out path itself stays honest
+scan_mv_enc=$(mktemp -d)
+scan_mv_den=$(mktemp -d)
+for mode in enc den; do
+if [ "$mode" = enc ]; then obs="$scan_mv_enc"; else obs="$scan_mv_den"; fi
+SRT_CI_MODE="$mode" SRT_OBS_DIR="$obs" JAX_PLATFORMS=cpu python - <<'PYEOF'
+import os
+import jax; jax.config.update("jax_platforms", "cpu")
+import spark_rapids_tpu  # noqa: F401  (enables x64)
+from spark_rapids_tpu.benchmarks import tpch
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.runtime import eventlog
+
+paths = tpch.generate(0.01, "/tmp/tpch_ci_sf0.01_f12", files_per_table=12)
+spark = TpuSession({
+    "spark.rapids.tpu.sql.stageFusion.enabled": True,
+    "spark.rapids.tpu.sql.parquet.deviceDecode.enabled": True,
+    "spark.rapids.tpu.sql.parquet.encodedUpload.enabled":
+        os.environ["SRT_CI_MODE"] == "enc",
+    "spark.rapids.tpu.eventLog.dir": os.environ["SRT_OBS_DIR"],
+    "spark.rapids.tpu.movement.sample.intervalBytes": "64k"})
+dfs = tpch.load(spark, paths, files_per_partition=3)
+tpch.QUERIES["q1"](dfs).collect()
+eventlog.shutdown()
+PYEOF
+done
+for d in "$scan_mv_enc" "$scan_mv_den"; do
+  python tools/profiler.py movement "$d"/events-*.jsonl --json \
+    > "$d/movement.json"
+done
+python - "$scan_mv_enc/movement.json" "$scan_mv_den/movement.json" <<'PYEOF'
+import json, sys
+
+def h2d(p):
+    m = json.load(open(p))
+    return sum(f["bytes"] for f in m["flows"] if f["edge"] == "h2d")
+
+enc, den = h2d(sys.argv[1]), h2d(sys.argv[2])
+ratio = den / max(enc, 1)
+print(f"scan movement gate: q1 h2d dense={den}B encoded={enc}B "
+      f"({ratio:.2f}x)")
+assert enc > 0, "no h2d flow in the encoded run's movement plane"
+assert ratio >= 1.3, f"encoded upload h2d drop {ratio:.2f}x < 1.3x"
+PYEOF
+rm -rf "$scan_mv_enc" "$scan_mv_den"
 
 echo "== cluster chaos: executor kill mid-q18 on a 3-executor MiniCluster =="
 # losing 1 of 3 executors mid-query must cost ~1/N of a stage, not the

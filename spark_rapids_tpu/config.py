@@ -286,6 +286,27 @@ ENABLE_WHOLE_STAGE_FUSION = conf("spark.rapids.tpu.sql.stageFusion.enabled").doc
     "TPU-first optimization with no reference analog (cudf launches one kernel per op)"
 ).boolean_conf(True)
 
+ENABLE_SCAN_FUSION = conf("spark.rapids.tpu.sql.stageFusion.scan.enabled").doc(
+    "Fuse the parquet page-decode prologue (bit-unpack + dictionary gather + "
+    "null spread) into the consuming aggregate's per-batch program, so a scan "
+    "stage runs decode->project->filter->partial-agg as one XLA dispatch over "
+    "ENCODED page bytes; batches no consumer can absorb decode standalone "
+    "through the same fused kernel (degraded, never wrong). Requires "
+    "stageFusion.enabled").boolean_conf(True)
+
+ENABLE_GROUPBY_CHAIN = conf(
+    "spark.rapids.tpu.sql.stageFusion.groupBy.chain.enabled").doc(
+    "Chain the aggregation's per-batch update->concat->merge loop into one "
+    "fused program per input batch with predictive output capacity (the "
+    "broadcast-join probe-chain discipline): one host sync per batch instead "
+    "of the per-batch key-stats / concat-count / right-sizing syncs. A "
+    "mispredicted capacity discards the chained result and reruns the "
+    "unchained path for that batch. Batches below a small capacity floor "
+    "(1024) go unchained: the fused program's one-off compile cannot "
+    "amortize over toy batches and would count against an armed cluster "
+    "task deadline. Requires stageFusion.enabled"
+).boolean_conf(True)
+
 STAGE_CACHE_ENABLED = conf("spark.rapids.tpu.sql.stage.cache.enabled").doc(
     "Persist compiled stage executables (serialized XLA programs) to disk and "
     "reload them in later sessions, skipping tracing and compilation entirely "
@@ -823,6 +844,15 @@ PARQUET_DEVICE_DECODE = conf(
     "out-of-scope chunks fall back to arrow per column (reference "
     "GpuParquetScan device decode, stage one)").boolean_conf(True)
 
+PARQUET_ENCODED_UPLOAD = conf(
+    "spark.rapids.tpu.sql.parquet.encodedUpload.enabled").doc(
+    "Upload in-scope parquet data pages ENCODED — bit-packed dictionary "
+    "indices, definition levels and the dictionary itself — and expand to "
+    "dense columns lazily on device inside the first consuming kernel, so "
+    "H2D carries encoded bytes instead of dense columns (movement-ledger "
+    "h2d site scan.encoded). Out-of-scope pages upload dense; requires "
+    "parquet.deviceDecode.enabled").boolean_conf(True)
+
 PARQUET_REBASE_MODE = conf(
     "spark.rapids.tpu.sql.parquet.datetimeRebaseModeInRead").doc(
     "EXCEPTION | CORRECTED | LEGACY for dates before 1582-10-15 in parquet "
@@ -888,6 +918,16 @@ class RapidsConf:
     @property
     def stage_fusion_enabled(self):
         return self.get(ENABLE_WHOLE_STAGE_FUSION)
+
+    @property
+    def scan_fusion_enabled(self):
+        return (self.get(ENABLE_SCAN_FUSION)
+                and self.get(ENABLE_WHOLE_STAGE_FUSION))
+
+    @property
+    def groupby_chain_enabled(self):
+        return (self.get(ENABLE_GROUPBY_CHAIN)
+                and self.get(ENABLE_WHOLE_STAGE_FUSION))
 
     @property
     def stage_cache_enabled(self):

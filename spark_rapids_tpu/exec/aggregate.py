@@ -31,6 +31,9 @@ PARTIAL = "partial"
 FINAL = "final"
 COMPLETE = "complete"
 
+# smallest batch capacity the group-by chain will fuse (see _chain_step)
+_CHAIN_MIN_CAPACITY = 1024
+
 
 def _agg_fn(e) -> AggregateFunction:
     f = e.child if isinstance(e, Alias) else e
@@ -127,6 +130,8 @@ class HashAggregateExec(TpuExec):
         (runtime/fuse.py). In merge mode the batch is in keys+state layout; in
         update mode it is raw child output. Returns a batch in keys+state
         layout with one row per group."""
+        from spark_rapids_tpu.columnar.encoded import (EncodedColumnVector,
+                                                       densify_cols)
         from spark_rapids_tpu.expr.core import Col
         from spark_rapids_tpu.expr.misc import CONTEXT_SENSITIVE
         from spark_rapids_tpu.runtime import fuse
@@ -138,7 +143,17 @@ class HashAggregateExec(TpuExec):
                       *([pre] if pre is not None else []),
                       *(prep or [])))
         if batch.columns and not ctx_sensitive:
-            in_cols = [Col.from_vector(c) for c in batch.columns]
+            # scan-side chain: still-encoded scan columns enter the kernel AS
+            # ENCODED PAGES and expand inside this fused program (late
+            # materialization) — the standalone decode dispatch and its dense
+            # H2D column never exist. from_vector on anything else (including
+            # an already-forced encoded vector) yields the usual dense Col.
+            use_enc = not merge and self.conf.scan_fusion_enabled
+            in_cols = []
+            for c in batch.columns:
+                enc = (c.encoded if use_enc
+                       and isinstance(c, EncodedColumnVector) else None)
+                in_cols.append(enc if enc is not None else Col.from_vector(c))
             nr = jnp.asarray(batch.lazy_num_rows, jnp.int32)
             vmin_t, has_hint, presorted = self._key_range_hint(
                 batch, in_cols, nr, merge)
@@ -152,6 +167,7 @@ class HashAggregateExec(TpuExec):
 
             def build():
                 def kernel(cols, num_rows, vmin):
+                    cols = densify_cols(cols)
                     ctx = EvalContext(cols, num_rows, cols[0].values.shape[0])
                     return self._agg_kernel(
                         ctx, merge,
@@ -176,6 +192,114 @@ class HashAggregateExec(TpuExec):
                 EvalContext.from_batch(batch), merge)
         cols = [c.to_vector() for c in compacted]
         return ColumnarBatch(cols, n_groups, self._partial_schema())
+
+    def _chain_step(self, acc: ColumnarBatch, batch: ColumnarBatch,
+                    A: int, pred_P: int):
+        """One fused update→concat→merge step of the group-by chain: aggregate
+        the incoming batch, pad-concat the partial onto the accumulated
+        partials, and merge-aggregate — ONE program per batch, like
+        exec/joins.py chains probes. The unchained loop pays three host syncs
+        per batch (key-stats probe, concat's num_rows, right-sizing count);
+        the chain pays exactly one (the status readback below) and its output
+        capacity is PREDICTED from the caller's host-side group counts
+        (``bucket_capacity(A + pred_P)``), so no device count ever gates a
+        shape. The update, concat, and merge bodies are the SAME traced
+        functions the unchained path runs (``_agg_kernel``, ``concat_cols``),
+        and the result is accepted only when the predicted concat bucket
+        matches the one the unchained loop would have used — chained-vs-
+        unchained results are bit-identical; on any non-chainable shape or
+        mispredict the caller redoes the batch unchained (degraded, never
+        wrong).
+
+        Returns ``(accepted, merged_batch, merged_groups, update_groups)``
+        or None when the shape cannot chain at all.
+        """
+        from spark_rapids_tpu.columnar.encoded import (EncodedColumnVector,
+                                                       densify_cols)
+        from spark_rapids_tpu.columnar.vector import bucket_capacity
+        from spark_rapids_tpu.expr.misc import CONTEXT_SENSITIVE
+        from spark_rapids_tpu.ops.concat import concat_cols
+        from spark_rapids_tpu.runtime import fuse
+        import numpy as np
+        if not (batch.columns and acc.columns):
+            return None
+        # chaining only pays when its one-off trace+compile can amortize over
+        # real batches: the syncs it removes cost microseconds, the fused
+        # program costs seconds to compile, and a cluster executor compiling
+        # it mid-task under an armed task deadline can be killed for it —
+        # tiny batches (toy partitions, interactive map tasks) go unchained
+        if batch.capacity < _CHAIN_MIN_CAPACITY:
+            return None
+        pre = self.prefilter
+        prep = self.preproject
+        ctx_sensitive = any(
+            e.collect(lambda x: isinstance(x, CONTEXT_SENSITIVE))
+            for e in (*self.group_exprs, *self.agg_exprs,
+                      *([pre] if pre is not None else []),
+                      *(prep or [])))
+        if ctx_sensitive:
+            return None
+        acc_cap = acc.capacity
+        bcap = batch.capacity
+        Cc = bucket_capacity(max(A + pred_P, 1))
+        use_enc = self.conf.scan_fusion_enabled
+        in_cols = []
+        for c in batch.columns:
+            enc = (c.encoded if use_enc
+                   and isinstance(c, EncodedColumnVector) else None)
+            in_cols.append(enc if enc is not None else Col.from_vector(c))
+        acc_cols = [Col.from_vector(c) for c in acc.columns]
+        key = ("agg_chain", fuse.schema_key(self.child.output),
+               fuse.schema_key(self._partial_schema()), acc_cap, bcap, Cc,
+               tuple(fuse.expr_key(e) for e in self.group_exprs),
+               tuple(fuse.expr_key(e) for e in self.agg_exprs),
+               fuse.expr_key(pre) if pre is not None else None,
+               tuple(fuse.expr_key(e) for e in prep) if prep is not None
+               else None, self.prefilter_on_projected)
+
+        def build():
+            def kernel(a_cols, b_cols, acc_n, nr):
+                b_cols = densify_cols(b_cols)
+                uctx = EvalContext(b_cols, nr, bcap)
+                # no key-stats probe: skipping the range hint / presorted
+                # strategies is value-neutral (every sort embeds the row
+                # index, so all strategies produce the same total order)
+                upd_cols, upd_n = self._agg_kernel(uctx, merge=False)
+                counts_v = jnp.stack([acc_n, upd_n.astype(jnp.int32)])
+                per_col = [[a, u] for a, u in zip(a_cols, upd_cols)]
+                cat = concat_cols(per_col, counts_v, Cc, (acc_cap, bcap))
+                mctx = EvalContext(cat, acc_n + upd_n, Cc)
+                mg_cols, mg_n = self._agg_kernel(mctx, merge=True)
+                status = jnp.stack([jnp.asarray(mg_n, jnp.int32),
+                                    jnp.asarray(upd_n, jnp.int32)])
+                return mg_cols, status
+            return kernel
+
+        acc_n_t = jnp.asarray(acc.lazy_num_rows, jnp.int32)
+        nr_t = jnp.asarray(batch.lazy_num_rows, jnp.int32)
+        out = fuse.call_fused(key, "HashAggregateExec.chain", build,
+                              (acc_cols, in_cols, acc_n_t, nr_t),
+                              lambda: None)
+        if out is None:
+            return None   # uncacheable key or trace fallback → go unchained
+        mg_cols, status = out
+        st = np.asarray(status)   # the ONE host sync of the chained step
+        mg_n, upd_n = int(st[0]), int(st[1])
+        # accept only when the concat ran at the bucket the unchained loop's
+        # concat_batches would have picked (bucket of the TRUE total): the
+        # merge's f64 reduction order is capacity-sensitive, so an equal
+        # bucket is exactly the bit-identity condition
+        accepted = bucket_capacity(max(A + upd_n, 1)) == Cc
+        if accepted and self.conf.stage_fusion_enabled:
+            # same stage-boundary right-sizing the unchained merge applies —
+            # mg_n is already a host int, so this syncs nothing extra
+            from spark_rapids_tpu.ops.filtering import maybe_host_resize
+            resized = maybe_host_resize(mg_cols, mg_n)
+            if resized is not None:
+                mg_cols, mg_n = resized
+        merged = ColumnarBatch([c.to_vector() for c in mg_cols], mg_n,
+                               self._partial_schema())
+        return accepted, merged, mg_n, upd_n
 
     def _key_range_hint(self, batch, in_cols, nr, merge: bool):
         """(vmin_traced, has_hint, presorted) for the single-wide-int-key
@@ -213,6 +337,8 @@ class HashAggregateExec(TpuExec):
 
         def build():
             def kernel(cols, num_rows):
+                from spark_rapids_tpu.columnar.encoded import densify_cols
+                cols = densify_cols(cols)
                 cap_ = cols[0].values.shape[0]
                 ctx = EvalContext(cols, num_rows, cap_)
                 k = ctx.cols[0] if merge else e.eval(ctx)
@@ -567,6 +693,13 @@ class HashAggregateExec(TpuExec):
                     return self._aggregate_batch(b, merge=merge)
 
             acc = None
+            # group-by chain (host-side predictors): A = accumulated group
+            # count, pred_P = predicted partial-group count of the next batch
+            # (last observed). Both are plain ints maintained WITHOUT extra
+            # syncs on chained iterations.
+            chain_ok = (not merge_input and bool(self.group_exprs)
+                        and self.conf.groupby_chain_enabled)
+            A = pred_P = 0
             for batch in self.child.execute_partition(split):
                 self._in_rows.add_lazy(batch.lazy_num_rows)
                 # acquire only once data is ready for device work — acquiring before
@@ -574,6 +707,26 @@ class HashAggregateExec(TpuExec):
                 # stage and deadlock the semaphore (reference RapidsShuffleIterator
                 # acquires on data arrival, RapidsShuffleIterator.scala:300)
                 acquire_semaphore(self.metrics)
+                if acc is not None and chain_ok:
+                    def chain_step(a=acc, b=batch, A=A, P=pred_P):
+                        with trace_range("HashAggregate.chain",
+                                         self._agg_time):
+                            return self._chain_step(a, b, A, P)
+                    try:
+                        res = R.call_with_retry(chain_step, scope="agg.chain")
+                    except R.DeviceOomError:
+                        res = None   # fall back to the splittable update loop
+                    if res is not None:
+                        accepted, merged, mg_n, upd_n = res
+                        if accepted:
+                            acc, A, pred_P = merged, mg_n, upd_n
+                            continue
+                        # capacity mispredict: DISCARD the chained result and
+                        # redo this batch unchained — never accept a result
+                        # whose concat bucket differs from the unchained one
+                        # (degraded, never wrong). The observed update count
+                        # still improves the next prediction.
+                        pred_P = upd_n
                 # per-batch update aggregation under the OOM ladder: a split
                 # aggregates the halves into two partials, which the merge
                 # loop below folds together — exactly the semantics of
@@ -597,6 +750,13 @@ class HashAggregateExec(TpuExec):
                     # the merge needs BOTH partials at once — unsplittable,
                     # so spill-only retry (withRetryNoSplit)
                     acc = R.call_with_retry(merge_acc, scope="agg.merge")
+                if chain_ok and acc is not None:
+                    # refresh predictors after an unchained batch (first batch
+                    # or chain fallback): one count sync — the unchained loop
+                    # already syncs counts per merge, so this adds none on the
+                    # steady path and the chain adds exactly one per step
+                    A = acc.num_rows
+                    pred_P = pred_P or A
             if acc is None:
                 if self.group_exprs:
                     return  # grouped agg over empty input → no rows (Spark)

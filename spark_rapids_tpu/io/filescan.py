@@ -8,6 +8,7 @@ into FilePartitions by target size like Spark's FilePartition packing."""
 from __future__ import annotations
 
 import dataclasses
+import datetime
 import os
 import typing
 
@@ -57,6 +58,38 @@ def discover_partitions(root: str, fmt: str) -> list[FilePartition]:
     return out
 
 
+
+
+# proleptic-Gregorian vs hybrid-Julian calendars agree on every date from the
+# 1582-10-15 Gregorian cutover onward, so the legacy datetime rebase
+# (readers.py _rebase) is the identity there in EVERY rebase mode
+_GREGORIAN_CUTOVER = datetime.date(1582, 10, 15)
+
+
+def _dates_post_cutover(md, date_cols: list) -> bool:
+    """True when every row group's footer statistics PROVE all values of the
+    named date columns are on/after the Gregorian cutover — the condition
+    under which device decode (which never rebases) is bit-identical to the
+    arrow path's rebase handling. Missing stats fail closed."""
+    leaf = {}
+    for i in range(md.num_columns):
+        p = md.schema.column(i).path
+        if "." not in p:
+            leaf[p] = i
+    for name in date_cols:
+        i = leaf.get(name)
+        if i is None:
+            return False
+        for g in range(md.num_row_groups):
+            st = md.row_group(g).column(i).statistics
+            if st is None or not st.has_min_max:
+                return False
+            mn = st.min
+            if not isinstance(mn, datetime.date) or \
+                    isinstance(mn, datetime.datetime) or \
+                    mn < _GREGORIAN_CUTOVER:
+                return False
+    return True
 
 
 def _scan_meta(path: str) -> dict:
@@ -264,13 +297,18 @@ class FileSourceScanExec(TpuExec):
         part = node.partitions[split]
         if part.partition_values:
             return None
-        # temporal columns stay on the arrow path: it owns the legacy
-        # datetime rebase handling (readers.py _rebase); nested columns
-        # need the arrow list/struct conversion
-        if any(isinstance(f.data_type, (T.DateType, T.TimestampType,
+        # timestamps stay on the arrow path (it owns the legacy datetime
+        # rebase, readers.py _rebase); nested columns need the arrow
+        # list/struct conversion. DATE columns are admitted when footer
+        # statistics prove every value post-dates the Gregorian cutover
+        # (rebase is the identity there) — without this, scan-heavy TPC-H
+        # queries like q1 (l_shipdate filter) never reach device decode.
+        if any(isinstance(f.data_type, (T.TimestampType,
                                         T.ArrayType, T.StructDataType))
                for f in self.output):
             return None
+        date_cols = [f.name for f in self.output
+                     if isinstance(f.data_type, T.DateType)]
         files = []
         for path in part.paths:
             pf = pq.ParquetFile(path)
@@ -281,7 +319,10 @@ class FileSourceScanExec(TpuExec):
                    or md.row_group(g).total_byte_size > batch_bytes
                    for g in range(md.num_row_groups)):
                 return None
+            if date_cols and not _dates_post_cutover(md, date_cols):
+                return None
             files.append((path, pf, md.num_row_groups))
+        encoded = self.conf.get(CFG.PARQUET_ENCODED_UPLOAD)
 
         def it():
             cols = node._data_columns()
@@ -291,7 +332,8 @@ class FileSourceScanExec(TpuExec):
                     acquire_semaphore(self.metrics)
                     with trace_range("FileScan.devdecode", self._scan_time):
                         batch = PN.read_row_group_device(
-                            path, rg, self.output, cols, pf=pf)
+                            path, rg, self.output, cols, pf=pf,
+                            encoded=encoded)
                     batch.metadata = meta
                     yield batch
         return it()

@@ -367,7 +367,8 @@ def read_chunk_pages(path: str, row_group: int, column: int,
 
 # -- chunk → engine vector ----------------------------------------------------
 
-def chunk_to_device(pages: ChunkPages, spark_type, capacity: int):
+def chunk_to_device(pages: ChunkPages, spark_type, capacity: int,
+                    encoded: bool = False):
     """Decode a parsed chunk into a TpuColumnVector. The common fast path
     (every hybrid segment bit-packed) unpacks indices ON DEVICE; pages with
     mixed RLE runs fall back to the host hybrid decode, keeping the
@@ -401,7 +402,7 @@ def chunk_to_device(pages: ChunkPages, spark_type, capacity: int):
                               for s in segs)
             return _decode_single_page_fused(
                 packed, bw, def_levels, dict_dev, num_values, capacity,
-                pages, spark_type, sorted_dict)
+                pages, spark_type, sorted_dict, encoded=encoded)
 
     all_vals, all_valid = [], []
     for (num_values, def_levels, bw, page_bytes, values_off, segs) in \
@@ -423,7 +424,10 @@ def chunk_to_device(pages: ChunkPages, spark_type, capacity: int):
             nd = int(dict_dev.shape[0])
             idx_d = jnp.zeros((pcap,), jnp.int32).at[:len(idx)].set(
                 jnp.asarray(np.clip(idx, 0, max(nd - 1, 0))))
-            present = dict_dev[idx_d]
+            # an all-null page may carry an EMPTY dictionary — nothing to
+            # gather, every slot is the canonical default
+            present = dict_dev[idx_d] if nd else jnp.zeros((pcap,),
+                                                           dict_dev.dtype)
             dl = jnp.zeros((pcap,), jnp.bool_).at[:len(def_levels)].set(
                 jnp.asarray(def_levels.astype(bool)))
             vals, valid = PD.expand_present_to_rows(present, dl, pcap)
@@ -454,19 +458,17 @@ def chunk_to_device(pages: ChunkPages, spark_type, capacity: int):
     return TpuColumnVector(st, out_v, out_m)
 
 
-def _decode_single_page_fused(packed: bytes, bw: int, def_levels, dict_dev,
-                              num_values: int, capacity: int, pages,
-                              spark_type, sorted_dict):
-    """One jitted program per (bit width, shape bucket, output type):
-    bit-unpack → dictionary gather → definition-level spread → canonical
-    nulls. Cached via the fuse kernel cache like every exec stage."""
+def _page_spec_and_args(packed: bytes, bw: int, def_levels, dict_dev,
+                        num_values: int, capacity: int, pages, spark_type):
+    """Host prep shared by the standalone fused decode and the encoded-upload
+    vector: static EncodedPageSpec + the device argument tuple
+    (packed, dict, def-levels, n_present, n). The ONE place page bytes become
+    device buffers, so both paths upload identical payloads."""
     import jax.numpy as jnp
     from spark_rapids_tpu import types as T
-    from spark_rapids_tpu.columnar.vector import (TpuColumnVector,
-                                                  bucket_capacity)
+    from spark_rapids_tpu.columnar.vector import bucket_capacity
     from spark_rapids_tpu.ops import parquet_decode as PD
     from spark_rapids_tpu.ops import pallas_kernels as PK
-    from spark_rapids_tpu.runtime import fuse
 
     is_string = pages.physical_type == "BYTE_ARRAY"
     n_present = int(def_levels.sum())
@@ -478,30 +480,14 @@ def _decode_single_page_fused(packed: bytes, bw: int, def_levels, dict_dev,
                    "FLOAT": T.FLOAT, "DOUBLE": T.DOUBLE}
     st = T.STRING if is_string else (spark_type
                                      or np_to_spark[pages.physical_type])
-    want = jnp.int32 if is_string else jnp.dtype(st.jnp_dtype)
+    want = jnp.dtype(jnp.int32) if is_string else jnp.dtype(st.jnp_dtype)
     default = 0 if is_string else st.default_value()
-
-    def kernel(packed_d, dict_d, dl_d, n_present_t, n_t):
-        if use_pallas:
-            # pallas tile shapes need the STATIC present count (closed over;
-            # it is part of the cache key below)
-            idx = PK.bitunpack128(packed_d, bw, n_present, pcap)
-        else:
-            idx = PD.unpack_bits_device(packed_d, bw, n_present_t, pcap)
-        nd = dict_d.shape[0]
-        present = dict_d[jnp.clip(idx, 0, max(nd - 1, 0))]
-        present_padded = jnp.zeros((capacity,), present.dtype
-                                   ).at[:min(pcap, capacity)].set(
-            present[:capacity])
-        vals, valid = PD.expand_present_to_rows(present_padded, dl_d,
-                                                capacity)
-        live = jnp.arange(capacity, dtype=jnp.int32) < n_t
-        m = valid & live
-        v = jnp.where(m, vals.astype(want), jnp.asarray(default, want))
-        return v, m
-
-    key = ("pq_page_decode", bw, pcap, bcap, capacity, str(want),
-           is_string, use_pallas, n_present if use_pallas else None)
+    # n_present is only STATIC under pallas (tile shapes); zeroing it
+    # otherwise keeps the non-pallas compile cache shared across present
+    # counts, exactly like the pre-spec key did
+    spec = PD.EncodedPageSpec(bw, pcap, bcap, capacity, str(want), is_string,
+                              default, use_pallas,
+                              n_present if use_pallas else 0)
     if use_pallas:
         words = PK.bytes_to_words_u32(np.frombuffer(packed, np.uint8))
         packed_in = jnp.asarray(words)
@@ -515,22 +501,63 @@ def _decode_single_page_fused(packed: bytes, bw: int, def_levels, dict_dev,
     n = min(num_values, pages.num_values, capacity)
     args = (packed_in, dict_dev, jnp.asarray(dh),
             jnp.asarray(n_present, jnp.int32), jnp.asarray(n, jnp.int32))
-    v, m = fuse.call_fused(key, "ParquetScan.decode", lambda: kernel, args,
-                           lambda: kernel(*args))
+    return spec, st, args
+
+
+def _decode_single_page_fused(packed: bytes, bw: int, def_levels, dict_dev,
+                              num_values: int, capacity: int, pages,
+                              spark_type, sorted_dict, encoded: bool = False):
+    """One jitted program per (bit width, shape bucket, output type):
+    bit-unpack → dictionary gather → definition-level spread → canonical
+    nulls (ops/parquet_decode.decode_page_cols). Cached via the fuse kernel
+    cache like every exec stage. Under ``encoded`` the expansion is DEFERRED:
+    the encoded buffers are wrapped in an EncodedColumnVector and the first
+    consumer runs the same decode body — fused into its own program when it
+    can, standalone otherwise."""
+    from spark_rapids_tpu.columnar.vector import TpuColumnVector
+    from spark_rapids_tpu.columnar.encoded import (EncodedCol,
+                                                   EncodedColumnVector)
+    from spark_rapids_tpu.ops import parquet_decode as PD
+    from spark_rapids_tpu.runtime import fuse
+
+    spec, st, args = _page_spec_and_args(packed, bw, def_levels, dict_dev,
+                                         num_values, capacity, pages,
+                                         spark_type)
+    if encoded:
+        enc = EncodedCol(*args, spec, st,
+                         sorted_dict if spec.is_string else None)
+        return EncodedColumnVector(enc)
+
+    def build():
+        def kernel(packed_d, dict_d, dl_d, n_present_t, n_t):
+            return PD.decode_page_cols(spec, packed_d, dict_d, dl_d,
+                                       n_present_t, n_t)
+        return kernel
+
+    key = ("pq_page_decode", spec)
+    v, m = fuse.call_fused(key, "ParquetScan.decode", build, args,
+                           lambda: build()(*args))
     cv = TpuColumnVector(st, v, m)
-    return cv.with_dictionary(sorted_dict) if is_string else cv
+    return cv.with_dictionary(sorted_dict) if spec.is_string else cv
 
 
 def read_row_group_device(path: str, row_group: int, schema,
-                          columns: list[str] | None = None, pf=None):
+                          columns: list[str] | None = None, pf=None,
+                          encoded: bool = False):
     """Read one row group entirely via the device decode path; out-of-scope
     column chunks (compressed, non-dictionary, nested) fall back to arrow
     PER COLUMN (reference falls back per-file; per-column is strictly
-    finer). Pass `pf` to reuse one parsed footer across row groups."""
+    finer). Pass `pf` to reuse one parsed footer across row groups.
+
+    Every column's H2D payload is metered on the movement ledger with a
+    per-path site (scan.encoded / scan.device / scan.fallback), so the
+    encoded-upload win shows up as fewer h2d bytes, not just wall clock."""
     from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.columnar.encoded import EncodedColumnVector
     from spark_rapids_tpu.columnar.vector import bucket_capacity
     from spark_rapids_tpu import types as T
     from spark_rapids_tpu.columnar.arrow import array_to_device
+    from spark_rapids_tpu.runtime import movement as _MV
 
     if pf is None:
         import pyarrow.parquet as pq
@@ -553,11 +580,17 @@ def read_row_group_device(path: str, row_group: int, schema,
             if name not in leaf_of:
                 raise NotImplementedError(f"nested column {name}")
             pages = read_chunk_pages(path, row_group, leaf_of[name], md=md)
-            cols.append(chunk_to_device(
-                pages, sf.data_type if sf else None, cap))
+            cv = chunk_to_device(
+                pages, sf.data_type if sf else None, cap, encoded=encoded)
+            if isinstance(cv, EncodedColumnVector):
+                _MV.record_h2d(cv.encoded_payload_bytes(),
+                               site="scan.encoded")
+            else:
+                _MV.record_h2d(cv.device_memory_size(), site="scan.device")
         except NotImplementedError:
             arr = pf.read_row_group(row_group, columns=[name]).column(0)
-            cols.append(array_to_device(
-                arr, sf.data_type if sf else None, cap))
+            cv = array_to_device(arr, sf.data_type if sf else None, cap)
+            _MV.record_h2d(cv.device_memory_size(), site="scan.fallback")
+        cols.append(cv)
         fields.append(sf or T.StructField(name, cols[-1].dtype, True))
     return ColumnarBatch(cols, n_rows, T.StructType(fields))

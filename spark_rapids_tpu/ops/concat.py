@@ -13,6 +13,42 @@ from spark_rapids_tpu.columnar.vector import TpuColumnVector, bucket_capacity
 from spark_rapids_tpu.expr.core import Col
 
 
+def concat_cols(per_col, counts_v, cap: int, caps: tuple):
+    """TRACEABLE pad-concat body: for each column (a list of per-batch Cols),
+    write every batch's full capacity window into a fresh work buffer with
+    ordered dynamic_update_slice at the traced cumsum offsets, slice to the
+    static output bucket `cap`, and mask validity beyond the live total.
+    Shared verbatim by concat_batches and the chained group-by
+    (exec/aggregate._chain_step), so chained-vs-unchained concat results are
+    bit-identical by construction.
+
+    Ordered dus writes: batch i+1's window starts at off_i + count_i,
+    overwriting batch i's padding tail — pure copies, no gather-based
+    compaction. The work buffer is over-allocated by max(caps) so
+    off_i + cap_i can never exceed it (jax clamps out-of-range dus starts,
+    which would silently corrupt)."""
+    from spark_rapids_tpu.ops.strings import align_many
+    offs = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                            jnp.cumsum(counts_v)[:-1].astype(jnp.int32)])
+    wcap = cap + max(caps)
+    total_t = jnp.sum(counts_v)
+    live = jnp.arange(cap, dtype=jnp.int32) < total_t
+    out = []
+    for cols in per_col:
+        if cols[0].is_string:
+            cols = align_many(cols)
+        v = jnp.zeros((wcap,), cols[0].values.dtype)
+        m = jnp.zeros((wcap,), jnp.bool_)
+        for i, c in enumerate(cols):
+            v = jax.lax.dynamic_update_slice(v, c.values, (offs[i],))
+            m = jax.lax.dynamic_update_slice(m, c.validity, (offs[i],))
+        # input pad regions hold canonical defaults (zeros), so the only
+        # cleanup is masking validity beyond the live total
+        out.append(Col(v[:cap], m[:cap] & live, cols[0].dtype,
+                       cols[0].dictionary))
+    return out
+
+
 def concat_batches(batches) -> ColumnarBatch:
     """Concatenate batches (host-known row counts) into one device batch.
 
@@ -32,31 +68,7 @@ def concat_batches(batches) -> ColumnarBatch:
     caps = tuple(b.capacity for b in batches)
 
     def kernel(per_col, counts_v):
-        from spark_rapids_tpu.ops.strings import align_many
-        # ordered dynamic_update_slice writes: batch i+1's window starts at
-        # off_i + count_i, overwriting batch i's padding tail — pure copies,
-        # no gather-based compaction. The work buffer is over-allocated by
-        # max(caps) so off_i + cap_i can never exceed it (jax clamps
-        # out-of-range dus starts, which would silently corrupt).
-        offs = jnp.concatenate([jnp.zeros((1,), jnp.int32),
-                                jnp.cumsum(counts_v)[:-1].astype(jnp.int32)])
-        wcap = cap + max(caps)
-        total_t = jnp.sum(counts_v)
-        live = jnp.arange(cap, dtype=jnp.int32) < total_t
-        out = []
-        for cols in per_col:
-            if cols[0].is_string:
-                cols = align_many(cols)
-            v = jnp.zeros((wcap,), cols[0].values.dtype)
-            m = jnp.zeros((wcap,), jnp.bool_)
-            for i, c in enumerate(cols):
-                v = jax.lax.dynamic_update_slice(v, c.values, (offs[i],))
-                m = jax.lax.dynamic_update_slice(m, c.validity, (offs[i],))
-            # input pad regions hold canonical defaults (zeros), so the only
-            # cleanup is masking validity beyond the live total
-            out.append(Col(v[:cap], m[:cap] & live, cols[0].dtype,
-                           cols[0].dictionary))
-        return out
+        return concat_cols(per_col, counts_v, cap, caps)
 
     per_col = [[Col.from_vector(b.column(ci)) for b in batches]
                for ci in range(ncols)]

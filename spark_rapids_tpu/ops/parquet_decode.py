@@ -10,8 +10,26 @@ Static shapes throughout: byte buffers pad to the capacity bucket.
 
 from __future__ import annotations
 
+import typing
+
 import numpy as np
 import jax.numpy as jnp
+
+
+class EncodedPageSpec(typing.NamedTuple):
+    """Static shape/type facts of one encoded data page — everything the
+    traceable decode prologue (`decode_page_cols`) closes over. Hashable, so
+    it rides fuse-cache keys and pytree aux data directly; two pages with the
+    same spec share one compiled program regardless of their byte content."""
+    bit_width: int
+    pcap: int          # present-value capacity bucket
+    bcap: int          # packed-byte capacity bucket (0 under pallas words)
+    capacity: int      # output row capacity bucket
+    want: str          # decoded value dtype name (int32 codes for strings)
+    is_string: bool
+    default: object    # canonical fill for invalid slots
+    use_pallas: bool
+    n_present: int     # static present count (pallas tile shapes need it)
 
 
 def unpack_bits_device(packed: jnp.ndarray, bit_width: int, n: int,
@@ -48,6 +66,41 @@ def expand_present_to_rows(present_vals: jnp.ndarray,
     vals = present_vals[safe]
     valid = def_levels.astype(jnp.bool_)
     return vals, valid
+
+
+def decode_page_cols(spec: EncodedPageSpec, packed_d, dict_d, dl_d,
+                     n_present_t, n_t):
+    """TRACEABLE single-page decode: bit-unpack → dictionary gather →
+    definition-level spread → canonical nulls, returning (values, validity)
+    at spec.capacity. This is the single source of truth for page expansion —
+    the standalone fused decode kernel (io/parquet_native.py) and the
+    encoded-upload consumers (columnar/encoded.py, exec/aggregate.py) all
+    trace THIS body, so encoded-vs-dense results are bit-identical by
+    construction. Device args: packed bytes (or pallas words), the device
+    dictionary, def-levels as bool (capacity,), and int32 scalars for the
+    present/live counts."""
+    want = jnp.dtype(spec.want)
+    if spec.use_pallas:
+        from spark_rapids_tpu.ops import pallas_kernels as PK
+        # pallas tile shapes need the STATIC present count (part of the spec,
+        # hence part of every cache key that embeds the spec)
+        idx = PK.bitunpack128(packed_d, spec.bit_width, spec.n_present,
+                              spec.pcap)
+    else:
+        idx = unpack_bits_device(packed_d, spec.bit_width, n_present_t,
+                                 spec.pcap)
+    nd = dict_d.shape[0]
+    # an all-null page may carry an EMPTY dictionary: nothing to gather
+    present = (dict_d[jnp.clip(idx, 0, max(nd - 1, 0))] if nd
+               else jnp.zeros((spec.pcap,), dict_d.dtype))
+    cap = spec.capacity
+    present_padded = jnp.zeros((cap,), present.dtype
+                               ).at[:min(spec.pcap, cap)].set(present[:cap])
+    vals, valid = expand_present_to_rows(present_padded, dl_d, cap)
+    live = jnp.arange(cap, dtype=jnp.int32) < n_t
+    m = valid & live
+    v = jnp.where(m, vals.astype(want), jnp.asarray(spec.default, want))
+    return v, m
 
 
 def decode_dictionary_page(packed_bytes: np.ndarray, bit_width: int,

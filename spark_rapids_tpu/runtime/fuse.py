@@ -564,11 +564,26 @@ def _sig_digest(args) -> str | None:
 
 
 def _sig_part(h, v):
+    from spark_rapids_tpu import types as T
     from spark_rapids_tpu.expr.core import Col
+    from spark_rapids_tpu.columnar.encoded import EncodedCol
     if isinstance(v, Col):
         d = _dict_digest(v.dictionary) if v.dictionary is not None else None
         h.update(f"C:{v.dtype!r}:{v.values.shape}:{v.values.dtype}:"
                  f"{v.validity.shape}:{d};".encode())
+    elif isinstance(v, EncodedCol):
+        # aux (spec/dtype/dictionary) is STATIC — baked into the traced
+        # program, so its VALUES discriminate signatures (via _hash_part);
+        # children are ordinary dynamic arrays
+        children, aux = v.tree_flatten()
+        h.update(b"E(")
+        _hash_part(h, aux)
+        _sig_part(h, children)
+        h.update(b")")
+    elif isinstance(v, T.DataType):
+        h.update(f"dt:{v!r};".encode())
+    elif isinstance(v, DictRef):
+        h.update(f"dr:{_dict_digest(v.arr)};".encode())
     elif isinstance(v, (tuple, list)):
         h.update(f"t{len(v)}(".encode())
         for p in v:

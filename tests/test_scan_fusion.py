@@ -1,0 +1,155 @@
+"""Scan-side chain and chained group-by: bit-identity of the encoded-upload
+decode→filter→partial-agg path and the fused update→concat→merge loop vs
+their unfused/arrow twins (the `scan.enabled` / `groupBy.chain.enabled` A/Bs),
+engagement proof through the movement ledger, and the steady-state
+dispatch-count bound the chain exists to win."""
+
+import datetime
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.benchmarks import tpch
+from spark_rapids_tpu.session import TpuSession
+
+SF = 0.01
+FUSION = "spark.rapids.tpu.sql.stageFusion.enabled"
+SCAN_FUSION = "spark.rapids.tpu.sql.stageFusion.scan.enabled"
+GB_CHAIN = "spark.rapids.tpu.sql.stageFusion.groupBy.chain.enabled"
+ENCODED = "spark.rapids.tpu.sql.parquet.encodedUpload.enabled"
+DEVICE_DECODE = "spark.rapids.tpu.sql.parquet.deviceDecode.enabled"
+
+# explicit deviceDecode=True overrides the cpu-backend gate, so the encoded
+# path runs (and is testable) on the CPU CI backend
+FULL_ON = {FUSION: True, DEVICE_DECODE: True, ENCODED: True,
+           SCAN_FUSION: True, GB_CHAIN: True}
+ARROW = {FUSION: False, DEVICE_DECODE: False}
+
+
+@pytest.fixture(scope="module")
+def paths():
+    # 8 files/table at 2 files/partition: every partition feeds the
+    # aggregation multiple batches, so the group-by chain actually engages
+    return tpch.generate(SF, f"/tmp/tpch_scan_sf{SF}_f8", files_per_table=8)
+
+
+_memo: dict = {}
+
+
+def _collect(paths, query, conf):
+    key = (query, tuple(sorted(conf.items())))
+    if key not in _memo:
+        spark = TpuSession(dict(conf))
+        dfs = tpch.load(spark, paths, files_per_partition=2)
+        _memo[key] = tpch.QUERIES[query](dfs).collect().to_pylist()
+    return _memo[key]
+
+
+# -- bit-identity across the ladder ------------------------------------------
+
+@pytest.mark.parametrize("query", ["q1", "q3", "q5", "q18"])
+def test_ladder_bit_identical_scan_chain_vs_arrow(paths, query):
+    # exact equality, floats included: the encoded page expands through the
+    # SAME traced decode body the dense path runs, the chain concats through
+    # the SAME traced concat body, and chained results are only accepted at
+    # the capacity bucket the unchained loop would have used
+    assert _collect(paths, query, FULL_ON) == _collect(paths, query, ARROW)
+
+
+@pytest.mark.parametrize("knob", [ENCODED, GB_CHAIN, SCAN_FUSION])
+def test_q1_bit_identical_each_knob_off(paths, knob):
+    off = dict(FULL_ON)
+    off[knob] = False
+    assert _collect(paths, "q1", FULL_ON) == _collect(paths, "q1", off)
+
+
+# -- adversarial page layouts -------------------------------------------------
+
+def _edge_parquet(tmp_path):
+    """Dictionary strings with nulls (RLE-hybrid def levels), a
+    low-cardinality dict int, a null-heavy double, an ALL-NULL column (empty
+    dictionary page), and a post-1582 date — the layouts the encoded-upload
+    fast path special-cases or must cleanly degrade on. Row groups above the
+    chain's capacity floor make many batches so the chain runs too."""
+    n = 6000
+    tbl = pa.table({
+        "k": pa.array([f"grp{i % 5}" if i % 7 else None for i in range(n)]),
+        "i": pa.array([i % 11 for i in range(n)], pa.int64()),
+        "x": pa.array([float(i % 13) / 4 if i % 3 else None
+                       for i in range(n)], pa.float64()),
+        "z": pa.array([None] * n, pa.float64()),
+        "d": pa.array([datetime.date(2020, 1, 1 + i % 27)
+                       for i in range(n)]),
+    })
+    path = str(tmp_path / "edge.parquet")
+    pq.write_table(tbl, path, use_dictionary=True, row_group_size=1024)
+    return path
+
+
+def test_edge_pages_bit_identical_encoded_vs_arrow(tmp_path):
+    path = _edge_parquet(tmp_path)
+    c = F.col
+    got = {}
+    for name, conf in (("on", FULL_ON), ("arrow", ARROW)):
+        spark = TpuSession(dict(conf))
+        df = (spark.read_parquet(path)
+              .filter(c("i") > F.lit(2))
+              .group_by(c("k"))
+              .agg(F.sum(c("x")).alias("sx"), F.count(c("i")).alias("ci"),
+                   F.sum(c("z")).alias("sz"), F.min(c("d")).alias("md"))
+              .sort(c("k")))
+        got[name] = df.collect().to_pylist()
+    assert got["on"] == got["arrow"]
+    assert len(got["on"]) > 0
+
+
+# -- engagement: the ledger must see encoded bytes, and fewer of them ---------
+
+def _h2d_sites():
+    from spark_rapids_tpu.runtime import movement as MV
+    out: dict = {}
+    for (edge, link, site), rec in MV.snapshot().items():
+        if edge == "h2d":
+            out[site] = out.get(site, 0) + rec["bytes"]
+    return out
+
+
+def test_encoded_upload_cuts_h2d_bytes(paths):
+    def run(conf):
+        before = _h2d_sites()
+        spark = TpuSession(dict(conf))
+        dfs = tpch.load(spark, paths, files_per_partition=2)
+        tpch.QUERIES["q1"](dfs).collect()
+        after = _h2d_sites()
+        return {k: after.get(k, 0) - before.get(k, 0)
+                for k in set(after) | set(before)}
+
+    enc = run(FULL_ON)
+    dense = run({**FULL_ON, ENCODED: False})
+    assert enc.get("scan.encoded", 0) > 0          # the path engaged
+    assert dense.get("scan.encoded", 0) == 0
+    # the acceptance bar: encoded upload moves >=1.3x fewer bytes over PCIe
+    assert sum(dense.values()) >= 1.3 * sum(enc.values())
+
+
+# -- steady-state dispatch bound ----------------------------------------------
+
+def test_groupby_chain_cuts_steady_state_dispatches(paths):
+    from spark_rapids_tpu.runtime import stats as STATS
+
+    def agg_dispatches(chain):
+        spark = TpuSession({**FULL_ON, GB_CHAIN: chain})
+        dfs = tpch.load(spark, paths, files_per_partition=2)
+        df = tpch.QUERIES["q1"](dfs)
+        df.collect()          # warm: traces + capacity predictions settle
+        df.collect()
+        tbl = STATS.node_table(df._last_collector)
+        return sum(e["dispatches"] or 0 for e in tbl
+                   if e["name"] == "HashAggregateExec")
+
+    chained, unchained = agg_dispatches(True), agg_dispatches(False)
+    # the chain replaces key-stats + concat + merge + right-size dispatches
+    # with ONE fused program per steady-state batch
+    assert chained < unchained

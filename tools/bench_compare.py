@@ -141,6 +141,16 @@ def compare(cur: dict, base: dict) -> dict:
                 row["amplification_delta"] = round(
                     cm["movement_amplification"]
                     - bm["movement_amplification"], 3)
+            # h2d pricing (encoded-upload trajectory): the PCIe bytes a
+            # scan actually shipped, and how much of that was encoded pages
+            # rather than dense columns — only when both lines carry the
+            # per-site split (bench.py embeds it from the movement ledger)
+            if cm.get("h2d_sites") and bm.get("h2d_sites"):
+                ch, bh = cm["h2d_sites"], bm["h2d_sites"]
+                row["h2d_bytes"] = sum(ch.values())
+                row["h2d_delta_bytes"] = (sum(ch.values())
+                                          - sum(bh.values()))
+                row["h2d_encoded_bytes"] = ch.get("scan.encoded", 0)
         rows.append(row)
     geomean = math.exp(sum(math.log(r["ratio"]) for r in rows) / len(rows))
     return {"queries": rows, "geomean_ratio": round(geomean, 4),
@@ -150,7 +160,7 @@ def compare(cur: dict, base: dict) -> dict:
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="bench_compare.py", description=__doc__)
     p.add_argument("current", help="bench JSON line (file or '-')")
-    p.add_argument("--baseline", default="BENCH_r06.json",
+    p.add_argument("--baseline", default="BENCH_r07.json",
                    help="committed baseline bench JSON")
     p.add_argument("--warn", type=float, default=0.10,
                    help="geomean regression fraction that warns")
@@ -179,6 +189,10 @@ def main(argv=None) -> int:
         if "amplification_delta" in r:
             extra += (f"  amp {r['amplification']}x "
                       f"({r['amplification_delta']:+.3f} vs baseline)")
+        if "h2d_delta_bytes" in r:
+            extra += (f"  h2d {r['h2d_bytes']}B "
+                      f"({r['h2d_delta_bytes']:+d}B, "
+                      f"{r['h2d_encoded_bytes']}B encoded)")
         print(f"  {r['query']}: vs_baseline {r['base_vs_baseline']} -> "
               f"{r['cur_vs_baseline']}  (x{r['ratio']}){extra}")
     reg = d["regression"]

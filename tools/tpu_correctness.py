@@ -230,6 +230,9 @@ def main():
 
 if __name__ == "__main__":
     if "--child" in sys.argv:
+        # the parent spawns this script by PATH, so the child's sys.path[0]
+        # is tools/ — the package under REPO is not importable without this
+        sys.path.insert(0, str(REPO))
         child_main()
     else:
         main()
