@@ -577,7 +577,7 @@ def _latency_percentiles():
 
 
 def concurrent_bench(n: int, query: str = "q18", reps: int = 2,
-                     endpoint: bool = False):
+                     endpoint: bool = False, replicas: int = 1):
     """Multi-tenant aggregate-throughput mode (``--concurrent N``): N copies
     of one TPC-H query run back-to-back (sequential) and then fanned out on
     N threads through the driver-side QueryScheduler (concurrent), value-
@@ -617,7 +617,8 @@ def concurrent_bench(n: int, query: str = "q18", reps: int = 2,
     spark = TpuSession(conf)
 
     if endpoint:
-        return _endpoint_concurrent_bench(spark, paths, n, query, reps, cores)
+        return _endpoint_concurrent_bench(spark, paths, n, query, reps, cores,
+                                          replicas=replicas)
 
     def build_df():
         dfs = tpch.load(spark, paths, files_per_partition=4)
@@ -702,7 +703,8 @@ def concurrent_bench(n: int, query: str = "q18", reps: int = 2,
     return line
 
 
-def _endpoint_concurrent_bench(spark, paths, n, query, reps, cores):
+def _endpoint_concurrent_bench(spark, paths, n, query, reps, cores,
+                               replicas=1):
     """The --endpoint half of concurrent_bench: n clients over TCP."""
     import threading
     from spark_rapids_tpu.benchmarks import tpch
@@ -715,6 +717,9 @@ def _endpoint_concurrent_bench(spark, paths, n, query, reps, cores):
     sql = SQL_QUERIES[query]
     tpch.load(spark, paths, files_per_partition=4)   # registers temp views
     baseline = spark.sql(sql).collect().to_pylist()  # warm + value oracle
+    if replicas > 1:
+        return _fleet_concurrent_bench(baseline, sql, n, query, reps, cores,
+                                       replicas)
     ep = spark.serve()
     addr = ("127.0.0.1", ep.port)
     try:
@@ -793,6 +798,151 @@ def _endpoint_concurrent_bench(spark, paths, n, query, reps, cores):
         line["gate_skipped"] = (
             f"{cores} core(s): concurrent queries cannot overlap on one "
             "core; throughput gate needs >=2")
+    return line
+
+
+def _fleet_concurrent_bench(baseline, sql, n, query, reps, cores, replicas):
+    """The --replicas R half of endpoint mode: R real replica PROCESSES
+    (tools/fleet_replica.py) registered in one fleet directory and sharing
+    one compiled-stage cache — replica 0 compiles the workload, the rest
+    replay its shapes warm. Sequential = n wire submissions through ONE
+    replica; concurrent = n clients fanned across the fleet, worker i
+    leading with replica i %% R and carrying the rest as its failover
+    chain. The line embeds the client-side resilience snapshot: with no
+    faults, spreading load across replicas must count ZERO failovers."""
+    import signal
+    import threading
+    from spark_rapids_tpu.runtime import metrics as M
+    from spark_rapids_tpu.runtime.endpoint import EndpointClient
+
+    work = f"/tmp/srt_fleet_bench_{os.getpid()}"
+    fleet_dir = os.path.join(work, "fleet")
+    cache_dir = os.path.join(work, "stage_cache")
+    for d in (fleet_dir, cache_dir):
+        os.makedirs(d, exist_ok=True)
+    repl_script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "tools", "fleet_replica.py")
+    procs, addrs = [], []
+    try:
+        for r in range(replicas):
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            proc = subprocess.Popen(
+                [sys.executable, repl_script,
+                 "--fleet-dir", fleet_dir,
+                 "--data-dir", DATA_DIR, "--sf", str(TPCH_SF),
+                 "--stage-cache-dir", cache_dir,
+                 # generous lease: a GIL stall during a compile burst must
+                 # not expire a LIVE replica mid-benchmark
+                 "--lease-timeout", "10", "--heartbeat", "1",
+                 "--max-concurrent", str(n)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env)
+            port = None
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline:
+                ln = proc.stdout.readline()
+                if ln.startswith("READY "):
+                    port = int(ln.split()[1])
+                    break
+                if proc.poll() is not None:
+                    break
+            assert port is not None, f"fleet replica {r} never became READY"
+            threading.Thread(target=proc.stdout.read, daemon=True).start()
+            procs.append(proc)
+            addrs.append(("127.0.0.1", port))
+
+        # warm each replica once; replica 0 compiles into the shared stage
+        # cache first, so the rest start from its compiled shapes
+        for a in addrs:
+            rows = EndpointClient(a, timeout_s=600).submit(sql).to_pylist()
+            assert rows == baseline, "fleet replica warm-up diverged"
+
+        # sequential: n wire submissions back to back through one replica
+        seq_ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                rows = EndpointClient(
+                    addrs[0], timeout_s=600).submit(sql).to_pylist()
+                assert rows == baseline, "sequential fleet run diverged"
+            seq_ts.append(time.perf_counter() - t0)
+        sequential_s = statistics.median(seq_ts)
+
+        def run_concurrent():
+            results = [None] * n
+            errors = []
+            barrier = threading.Barrier(n + 1)
+
+            def worker(i):
+                order = addrs[i % replicas:] + addrs[:i % replicas]
+                cli = EndpointClient(order, timeout_s=600)
+                try:
+                    barrier.wait()
+                    rows = cli.submit_with_retry(sql).to_pylist()
+                    s = cli.last_summary or {}
+                    results[i] = {
+                        "query_id": s.get("query"),
+                        "replica": f"{cli.address[0]}:{cli.address[1]}",
+                        "wall_s": s.get("wall_s"),
+                        "rows_ok": rows == baseline,
+                        "resilience_nonzero": s.get("resilience") or {},
+                    }
+                except BaseException as e:  # noqa: BLE001
+                    errors.append(repr(e)[:200])
+
+            threads = [threading.Thread(target=worker, args=(i,),
+                                        daemon=True) for i in range(n)]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
+            return time.perf_counter() - t0, results, errors
+
+        conc_ts, results, errors = [], None, None
+        for _ in range(reps):
+            wall, results, errors = run_concurrent()
+            if errors:
+                break
+            conc_ts.append(wall)
+        concurrent_s = statistics.median(conc_ts) if conc_ts else 0.0
+    finally:
+        for proc in procs:
+            try:
+                proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        for proc in procs:
+            try:
+                proc.wait(timeout=90)
+            except Exception:   # noqa: BLE001
+                proc.kill()
+
+    line = {
+        "metric": f"tpch_sf{TPCH_SF}_{query}_endpoint{replicas}r_concurrent{n}",
+        "n": n, "query": query, "reps": reps, "cores": cores,
+        "endpoint": True, "replicas": replicas,
+        "sequential_s": round(sequential_s, 4),
+        "concurrent_s": round(concurrent_s, 4),
+        "throughput_x": (round(sequential_s / concurrent_s, 3)
+                         if concurrent_s else 0.0),
+        "per_query": results,
+        "isolation_ok": bool(results) and all(
+            r and r["rows_ok"] and not r["resilience_nonzero"]
+            and len({x["query_id"] for x in results}) == n
+            for r in results),
+        # CLIENT-side registry: a no-faults fleet run must count zero
+        # replicaFailovers — load spreading is routing, not recovery
+        "resilience": M.resilience_snapshot(),
+        "latency": _latency_percentiles(),
+    }
+    if errors:
+        line["errors"] = errors
+    if cores < 2:
+        line["gate_skipped"] = (
+            f"{cores} core(s): replicas cannot overlap on one core; "
+            "fleet throughput gate needs >=2")
     return line
 
 
@@ -895,8 +1045,13 @@ if __name__ == "__main__":
         ep_mode = "--endpoint" in sys.argv
         q = (sys.argv[sys.argv.index("--query") + 1]
              if "--query" in sys.argv else ("q5" if ep_mode else "q18"))
+        # --replicas R (endpoint mode only): R real replica processes
+        # behind one fleet directory + shared stage cache
+        r = (int(sys.argv[sys.argv.index("--replicas") + 1])
+             if "--replicas" in sys.argv else 1)
         with watcher_paused():
-            print(json.dumps(concurrent_bench(n, q, endpoint=ep_mode)))
+            print(json.dumps(concurrent_bench(n, q, endpoint=ep_mode,
+                                              replicas=r)))
     elif os.environ.get("_SRT_BENCH_CHILD") == "1":
         child_main()
     else:

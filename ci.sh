@@ -579,6 +579,43 @@ assert not any(d["resilience"].values()), d["resilience"]
 print("endpoint bench ok:", d["metric"], "throughput", d["throughput_x"], "x")
 ' "$ep_line"
 
+echo "== serving fleet: chaos gate (warm replicas, SIGKILL failover, lease adoption) =="
+# three real replica PROCESSES behind one fleet directory + shared stage
+# cache: replica A compiles the workload, a fresh replica B serves the same
+# shapes with ZERO retraces; a no-faults fleet load keeps every resilience
+# counter zero on both replicas; a victim replica is SIGKILLed mid-stream
+# and the client's submit_with_retry fails over to a survivor
+# bit-identically; a survivor adopts the victim's expired lease and
+# reclaims its orphaned shared-store write intents
+fleet_dir=$(mktemp -d)
+JAX_PLATFORMS=cpu python tools/fleet_chaos.py --work-dir "$fleet_dir"
+rm -rf "$fleet_dir"
+# fleet membership / client rotation / shared-store race / result-cache suite
+JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q
+
+echo "== serving fleet: 2-replica throughput through the wire =="
+# 2 replica processes sharing one compiled-stage cache: n concurrent
+# clients spread across the fleet must beat n sequential submissions
+# through ONE replica by >=1.5x on a multi-core box (on 1 core the line
+# carries gate_skipped and the assertion is skipped with the reason
+# logged); the client-side resilience snapshot must stay all-zero — load
+# spreading is routing, not recovery
+fleet_line=$(JAX_PLATFORMS=cpu TPCH_SF=0.01 TPCH_DIR=/tmp/tpch_ci_sf0.01 \
+  python bench.py --concurrent 2 --endpoint --replicas 2 --query q5 | tail -1)
+python -c '
+import json, sys
+d = json.loads(sys.argv[1])
+assert d["endpoint"] and d["replicas"] == 2 and d["isolation_ok"], d
+assert not any(d["resilience"].values()), d["resilience"]
+if "gate_skipped" in d:
+    print("fleet throughput gate SKIPPED:", d["gate_skipped"],
+          "| measured", d["throughput_x"], "x")
+else:
+    assert d["throughput_x"] >= 1.5, d
+    print("fleet throughput gate ok:", d["throughput_x"], "x on",
+          d["cores"], "cores")
+' "$fleet_line"
+
 echo "== observability: event log + tracing overhead + profiler gate =="
 # run the q18 ladder query with telemetry disabled then with the event log
 # AND the span plane both on: together they must add <5% wall time, and

@@ -761,6 +761,51 @@ TRACE_ID_OVERRIDE = conf("spark.rapids.tpu.trace.id").doc(
     "from the query id); clients submitting over the endpoint can instead "
     "set 'trace' per request. Empty derives per query").string_conf(None)
 
+FLEET_DIR = conf("spark.rapids.tpu.fleet.dir").doc(
+    "Shared fleet directory (runtime/fleet.py): every QueryEndpoint replica "
+    "registers a lease-stamped membership record here (heartbeat-renewed, "
+    "mtime-expired), so replicas and clients discover live peers and a "
+    "survivor's sweeper can adopt a dead replica's lease plus its "
+    "shared-store write intents. Must be on a filesystem visible to every "
+    "replica. Empty disables fleet membership").string_conf(None)
+
+FLEET_LEASE_TIMEOUT = conf("spark.rapids.tpu.fleet.lease.timeoutSeconds").doc(
+    "Age past which a replica's membership lease (its record file's mtime) "
+    "is considered expired: the replica stops being returned as a live "
+    "member and any surviving replica's sweeper may adopt the lease — "
+    "unlinking the record and reclaiming orphaned shared-store write "
+    "intents. Must comfortably exceed fleet.heartbeat.intervalSeconds"
+).double_conf(10.0)
+
+FLEET_HEARTBEAT_INTERVAL = conf(
+    "spark.rapids.tpu.fleet.heartbeat.intervalSeconds").doc(
+    "Period of a registered replica's lease-renewal heartbeat (an mtime "
+    "touch on its membership record); each beat also sweeps expired peer "
+    "leases, so fleet adoption needs no dedicated coordinator. <=0 "
+    "disables the heartbeat thread (the lease then expires unless renewed "
+    "manually)").double_conf(2.0)
+
+ENDPOINT_RESULT_CACHE_ENABLED = conf(
+    "spark.rapids.tpu.endpoint.resultCache.enabled").doc(
+    "Serve identical hot queries from an in-memory result cache on the "
+    "endpoint: hits are keyed by (catalog epoch, parameterized plan "
+    "signature, SQL text digest), stream the recorded Arrow-IPC frames "
+    "bit-identically, bypass scheduler admission entirely, and are "
+    "invalidated when the session catalog changes (any view "
+    "registration)").boolean_conf(False)
+
+ENDPOINT_RESULT_CACHE_MAX_BYTES = conf(
+    "spark.rapids.tpu.endpoint.resultCache.maxBytes").doc(
+    "Byte budget of the endpoint result cache (sum of cached Arrow-IPC "
+    "payload bytes); least-recently-hit entries are evicted beyond it, and "
+    "a single result larger than the budget is never admitted"
+).bytes_conf("64m")
+
+ENDPOINT_RESULT_CACHE_MAX_ENTRIES = conf(
+    "spark.rapids.tpu.endpoint.resultCache.maxEntries").doc(
+    "Entry-count bound on the endpoint result cache (bounds key/metadata "
+    "overhead independently of maxBytes)").integer_conf(64)
+
 ENDPOINT_STATS_ENABLED = conf("spark.rapids.tpu.endpoint.stats.enabled").doc(
     "Serve STATS frames on the query endpoint: a Prometheus-style text "
     "snapshot of live serving metrics — admission/shed/cancel/deadline "

@@ -34,6 +34,21 @@ The robustness core is the failure surface, not the happy path:
   ``DeviceOomError``, ``TransportError``, ``SpillCorruptionError`` — so
   :meth:`EndpointClient.submit_with_retry` can honor the scheduler's own
   backoff hints instead of guessing.
+- **Fleet membership + failover.** With ``fleet.dir`` set, the endpoint
+  registers a lease-stamped membership record (runtime/fleet.py) naming its
+  address and shared-store directories; its heartbeat doubles as the
+  standby sweeper that adopts dead peers' leases. A fleet-registered
+  replica converts a ``request_timeout`` kill into a retryable
+  ``QueryRejectedError`` (reason ``replica_timeout``) — on a fleet, a
+  wedged replica's queries belong on a surviving peer, so
+  :class:`EndpointClient` (which accepts a comma-separated replica list)
+  rotates instead of failing. Without a fleet the timeout stays a
+  non-retryable typed cancellation, exactly as before.
+- **Result cache.** With ``endpoint.resultCache.enabled``, fully-streamed
+  results are recorded (runtime/result_cache.py) keyed by catalog epoch +
+  plan signature + SQL digest; an identical re-submission replays the
+  recorded CRC-stamped frames bit-identically WITHOUT touching scheduler
+  admission — the hot set survives overload.
 - **Chaos surface.** Fault sites ``endpoint.accept`` / ``endpoint.recv`` /
   ``endpoint.send`` (any armed kind fires, runtime/faults.py) and the
   ``endpoint.corrupt`` payload site (byte flip AFTER the CRC is stamped,
@@ -56,6 +71,7 @@ import collections
 import copy
 import json
 import pickle
+import random
 import select
 import socket
 import socketserver
@@ -141,12 +157,13 @@ def _hist_family(name: str):
     return f"srt_{safe}", ""
 
 
-def render_stats(include_histograms: bool = True) -> str:
+def render_stats(include_histograms: bool = True, endpoint=None) -> str:
     """Prometheus-style text snapshot of the live serving metrics: query
     lifecycle counters (admitted / shed / cancelled / deadline), the whole
     resilience registry, memory + queue gauges (HBM in use, spill tiers,
     admission queue depth, active queries, pipeline queue occupancy,
-    endpoint connections) and the fixed-bucket latency histograms."""
+    endpoint connections) and the fixed-bucket latency histograms. An
+    `endpoint` adds its fleet-membership and result-cache families."""
     from spark_rapids_tpu.runtime import eventlog as EL
     lines = []
 
@@ -214,6 +231,23 @@ def render_stats(include_histograms: bool = True) -> str:
         for (edge, link), v in sorted(flows.items()):
             lines.append(f'srt_movement_bytes{{edge="{edge}",link="{link}"}} '
                          f'{v["bytes"]}')
+
+    if endpoint is not None and endpoint.fleet is not None:
+        fstats = endpoint.fleet.stats()
+        fam("srt_fleet_live_members", "gauge")
+        lines.append(f"srt_fleet_live_members {fstats['live_members']}")
+        fam("srt_fleet_total", "counter")
+        for k in ("heartbeats", "sweeps", "adoptions", "reclaimed_intents"):
+            lines.append(f'srt_fleet_total{{event="{k}"}} {fstats[k]}')
+    if endpoint is not None and endpoint.result_cache is not None:
+        rstats = endpoint.result_cache.stats()
+        fam("srt_result_cache_total", "counter")
+        for k in ("hits", "misses", "inserts", "evictions", "stale_drops"):
+            lines.append(f'srt_result_cache_total{{event="{k}"}} {rstats[k]}')
+        fam("srt_result_cache_bytes", "gauge")
+        lines.append(f"srt_result_cache_bytes {rstats['bytes']}")
+        fam("srt_result_cache_entries", "gauge")
+        lines.append(f"srt_result_cache_entries {rstats['entries']}")
 
     if include_histograms:
         for name, snap in sorted(M.histograms_snapshot().items()):
@@ -351,6 +385,12 @@ class QueryEndpoint:
         self._conns: set = set()
         self._active: dict = {}        # id(stream) -> {df, stream, query}
         self._next_worker = 0
+        self.result_cache = None
+        if conf.get(CFG.ENDPOINT_RESULT_CACHE_ENABLED):
+            from spark_rapids_tpu.runtime.result_cache import ResultCache
+            self.result_cache = ResultCache(
+                conf.get(CFG.ENDPOINT_RESULT_CACHE_MAX_BYTES),
+                conf.get(CFG.ENDPOINT_RESULT_CACHE_MAX_ENTRIES))
 
         class _Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -363,6 +403,20 @@ class QueryEndpoint:
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         daemon=True, name="srt-endpoint")
         self._thread.start()
+        # fleet membership: register this replica's lease once the port is
+        # bound, recording the shared-store dirs a survivor must reclaim
+        self.fleet = None
+        fleet_dir = conf.get(CFG.FLEET_DIR)
+        if fleet_dir:
+            from spark_rapids_tpu.runtime.fleet import FleetDirectory
+            stores = [conf.get(CFG.STAGE_CACHE_DIR)
+                      if conf.stage_cache_enabled else None,
+                      conf.get(CFG.STATS_HISTORY_DIR)]
+            self.fleet = FleetDirectory(
+                fleet_dir,
+                lease_timeout_s=conf.get(CFG.FLEET_LEASE_TIMEOUT),
+                heartbeat_interval_s=conf.get(CFG.FLEET_HEARTBEAT_INTERVAL))
+            self.fleet.register(self.host, self.port, stores=stores)
         EL.emit("endpoint.start", query=None, host=self.host, port=self.port)
 
     # -- connection lifecycle ------------------------------------------------
@@ -399,7 +453,7 @@ class QueryEndpoint:
                             "endpoint.stats.enabled=false on this endpoint"))
                         return
                     send_frame(sock, MSG_STATS_RESP, render_stats(
-                        self.stats_histograms).encode("utf-8"))
+                        self.stats_histograms, endpoint=self).encode("utf-8"))
                     continue
                 if msg != MSG_SUBMIT:
                     self._send_error(sock, TransportError(
@@ -462,6 +516,18 @@ class QueryEndpoint:
         except BaseException as e:   # noqa: BLE001 — parse/plan errors travel
             return self._send_error(sock, e)
 
+        # result cache: a hit replays the recorded frames bit-identically
+        # WITHOUT entering the scheduler — admission-exempt by design
+        record = None
+        if self.result_cache is not None:
+            ckey = self._result_cache_key(sql, df)
+            if ckey is not None:
+                hit = self.result_cache.get(ckey)
+                if hit is not None:
+                    return self._stream_cached(sock, hit)
+                record = {"key": ckey, "frames": [], "bytes": 0,
+                          "over": False}
+
         from spark_rapids_tpu.runtime.memory import host_prefetch_budget
         stream = _ResultStream(host_prefetch_budget(self.stream_buffer))
         entry = {"df": df, "stream": stream,
@@ -476,7 +542,7 @@ class QueryEndpoint:
         if raced_drain:
             return self._shed_draining(sock)
         worker = threading.Thread(target=self._run_query,
-                                  args=(df, stream, req.get("trace")),
+                                  args=(df, stream, req.get("trace"), record),
                                   daemon=True, name=wname)
         worker.start()
         try:
@@ -493,7 +559,8 @@ class QueryEndpoint:
             with self._lock:
                 self._active.pop(key, None)
 
-    def _run_query(self, df, stream: _ResultStream, trace: str | None = None):
+    def _run_query(self, df, stream: _ResultStream, trace: str | None = None,
+                   record: dict | None = None):
         """Worker thread: execute the action, pushing each result batch into
         the stream as a CRC-stamped Arrow-IPC payload. Partitions run in
         order on this one thread (batch order must be deterministic for the
@@ -501,7 +568,8 @@ class QueryEndpoint:
         decode/compute/exchange inside each partition, and the stream's
         byte budget overlaps compute with the network send. A client-supplied
         `trace` id is handed to the query's collector so server-side spans
-        land in the client's distributed trace."""
+        land in the client's distributed trace. `record` collects the clean
+        wire frames for the result cache (admitted only on success)."""
         from spark_rapids_tpu.exec.base import TaskContext, TpuExec
         from spark_rapids_tpu.runtime import pipeline as P
         from spark_rapids_tpu.runtime import tracing
@@ -512,6 +580,15 @@ class QueryEndpoint:
         def sink(tbl: pa.Table):
             body = _table_to_ipc(tbl)
             crc = block_checksum(body)
+            if record is not None and not record["over"]:
+                # record BEFORE fault corruption — a chaos byte flip must
+                # reach exactly one client, never be replayed from cache
+                clean = _CRC.pack(crc) + body
+                record["frames"].append(clean)
+                record["bytes"] += len(clean)
+                if record["bytes"] > self.result_cache.max_bytes:
+                    record["over"] = True
+                    record["frames"].clear()
             # chaos: flip a byte AFTER the CRC is stamped — the client's
             # verification must catch it and raise typed TransportError
             body = F.maybe_corrupt("endpoint.corrupt", body)
@@ -544,16 +621,54 @@ class QueryEndpoint:
         try:
             df._run_action(df._plan, run)
             qm = df._last_collector
-            stream.finish({
+            summary = {
                 "query": qm.query_id, "trace": qm.trace_id,
                 "rows": counts["rows"],
                 "batches": counts["batches"],
                 "wall_s": round(qm.wall_s, 4),
                 "resilience": {k: v for k, v in
                                qm.query_resilience().items() if v},
-            })
+            }
+            stream.finish(summary)
+            if record is not None and not record["over"]:
+                self.result_cache.put(record["key"], record["frames"],
+                                      summary)
         except BaseException as e:   # noqa: BLE001 — marshalled to the client
             stream.fail(e)
+
+    def _result_cache_key(self, sql: str, df):
+        """(catalog epoch, plan signature, sql digest) — or None for a plan
+        the signature can't cover (never cache what can't be keyed)."""
+        from spark_rapids_tpu.plan.fingerprint import plan_signature
+        from spark_rapids_tpu.runtime.result_cache import ResultCache
+        try:
+            sig = plan_signature(df._plan)
+        except Exception:   # noqa: BLE001 — unkeyable plan: run it, skip cache
+            return None
+        return ResultCache.key(self.session.catalog_epoch, sig, sql)
+
+    def _stream_cached(self, sock, hit: dict) -> bool:
+        """Replay a cached result: the recorded frames bit-identically, then
+        the recorded summary marked ``cached``."""
+        from spark_rapids_tpu.runtime import movement as MV
+        try:
+            egress_link = MV.classify_peer(sock.getpeername())
+        except OSError:
+            egress_link = "client"
+        try:
+            for frame in hit["frames"]:
+                t0 = time.perf_counter()
+                send_frame(sock, MSG_RESULT_BATCH, frame)
+                MV.record("endpoint.egress", len(frame), link=egress_link,
+                          site="endpoint.result",
+                          seconds=time.perf_counter() - t0)
+            summary = dict(hit["summary"])
+            summary["cached"] = True
+            send_frame(sock, MSG_RESULT_END,
+                       json.dumps(summary).encode("utf-8"))
+            return True
+        except OSError:
+            return False
 
     def _cancel_query(self, df, reason: str, wait_s: float = 5.0) -> str | None:
         """Flip the query's CancelToken (waiting briefly for the collector to
@@ -620,13 +735,32 @@ class QueryEndpoint:
                                json.dumps(val).encode("utf-8"))
                     return True
                 else:   # error
-                    return self._send_error(sock, val)
+                    return self._send_error(
+                        sock, self._fleet_retryable(val, timed_out))
             except (OSError, RuntimeError) as e:
                 # a dead client socket, or an injected endpoint.send fault
                 # of any kind: the server-side write path died —
                 # indistinguishable from a lost client
                 return self._disconnected(
                     df, stream, send_fault=isinstance(e, RuntimeError))
+
+    def _fleet_retryable(self, exc: BaseException,
+                         timed_out: bool) -> BaseException:
+        """On a fleet, a ``request_timeout`` kill means THIS replica wedged —
+        the query belongs on a surviving peer, so the client gets a
+        retryable rejection (reason ``replica_timeout``) its rotation
+        re-routes. Without a fleet the non-retryable typed cancellation is
+        unchanged (there is nowhere else to go)."""
+        if (self.fleet is not None and timed_out
+                and isinstance(exc, SCHED.QueryCancelledError)
+                and getattr(exc, "reason", "") == "request_timeout"):
+            return SCHED.QueryRejectedError(
+                f"replica {self.fleet.replica_id} exceeded "
+                f"requestTimeoutSeconds ({self.request_timeout}s); retry a "
+                f"surviving replica", backoff_hint_s=0.05,
+                query_id=getattr(exc, "query_id", None),
+                reason="replica_timeout", replica=self.fleet.replica_id)
+        return exc
 
     def _disconnected(self, df, stream: _ResultStream, **detail) -> bool:
         from spark_rapids_tpu.runtime import eventlog as EL
@@ -691,6 +825,8 @@ class QueryEndpoint:
             except OSError:
                 pass
         self._thread.join(timeout=5)
+        if self.fleet is not None:
+            self.fleet.deregister()
         stats = {"in_flight": in_flight, "cancelled": cancelled,
                  "leaked": self.active_queries()}
         EL.emit("server.drain", query=None, phase="end", **stats)
@@ -724,23 +860,72 @@ class QueryEndpoint:
 # client
 # ---------------------------------------------------------------------------
 
+def _parse_addresses(address) -> list:
+    """Normalize every accepted address spec to [(host, port), ...]:
+    one (host, port) tuple, one "host:port" string, a comma-separated
+    "host:port,host:port" replica list, or a sequence of either."""
+    def one(a):
+        if isinstance(a, str):
+            host, _, port = a.strip().rpartition(":")
+            if not host:
+                raise ValueError(f"address {a!r} needs host:port")
+            return (host, int(port))
+        return (a[0], int(a[1]))
+
+    if isinstance(address, str):
+        parts = [p for p in (s.strip() for s in address.split(",")) if p]
+        if not parts:
+            raise ValueError("empty endpoint address list")
+        return [one(p) for p in parts]
+    seq = list(address)
+    if len(seq) == 2 and isinstance(seq[0], str) and isinstance(seq[1], int):
+        return [(seq[0], seq[1])]   # the classic single (host, port) tuple
+    if not seq:
+        raise ValueError("empty endpoint address list")
+    return [one(a) for a in seq]
+
+
 class EndpointClient:
     """Remote submitter (tools/tpu_client.py is the CLI front). One
     connection per submission; closing the connection mid-stream is the
-    cancellation protocol — the server cancels the query on disconnect."""
+    cancellation protocol — the server cancels the query on disconnect.
+
+    `address` may name a whole replica fleet — a comma-separated
+    "host:port,host:port" list (or a sequence of addresses): plain submits
+    use the current replica, and :meth:`submit_with_retry` rotates to the
+    next one with jitter on any retryable failure (connection refused, a
+    replica dying mid-stream, shed/drain/replica_timeout rejections), so
+    failover needs no client code changes."""
 
     def __init__(self, address, *, timeout_s: float = 60.0,
                  max_frame_bytes: int | None = None):
-        self.address = tuple(address)
+        self.addresses = _parse_addresses(address)
+        self._addr_idx = 0
         self.timeout_s = timeout_s
         self.max_frame = max_frame_bytes or _default_max_frame()
         self.last_summary: dict | None = None
+
+    @property
+    def address(self) -> tuple:
+        """The replica currently targeted (rotation advances it)."""
+        return self.addresses[self._addr_idx]
+
+    def rotate(self) -> tuple:
+        """Advance to the next replica in the list; returns the new target.
+        Counts a replicaFailovers resilience event when there is more than
+        one replica (rotation on a fleet IS the failover)."""
+        if len(self.addresses) > 1:
+            self._addr_idx = (self._addr_idx + 1) % len(self.addresses)
+            M.resilience_add(M.REPLICA_FAILOVERS)
+        return self.address
 
     def connect(self):
         try:
             sock = socket.create_connection(self.address,
                                             timeout=self.timeout_s)
         except OSError as e:
+            # connection refused/reset IS retryable: the replica is gone,
+            # the fleet may not be — rotation finds out
             raise TransportError(
                 f"endpoint {self.address} unreachable: {e}") from e
         configure_socket(sock, timeout_s=self.timeout_s)
@@ -833,10 +1018,13 @@ class EndpointClient:
                           backoff_cap_s: float = 10.0, on_retry=None,
                           **kw) -> pa.Table:
         """Submit, honoring the serving contract: a retryable rejection
-        (shed/drain) sleeps its ``backoff_hint_s``; a transport fault
-        (endpoint died mid-handshake, reset) retries with jittered
-        exponential backoff; non-retryable typed errors propagate
-        immediately."""
+        (shed/drain/replica_timeout) sleeps its ``backoff_hint_s``; a
+        transport fault (endpoint died mid-handshake or mid-stream, reset,
+        connection refused) retries with jittered exponential backoff;
+        non-retryable typed errors propagate immediately. With a replica
+        list, every retryable failure first rotates to the next replica
+        (jittered, so a killed replica's clients don't stampede one
+        survivor) — failover is this loop, not new client code."""
         attempt = 0
         while True:
             attempt += 1
@@ -851,6 +1039,9 @@ class EndpointClient:
                         e, "retryable", False):
                     raise
                 delay = min(0.1 * (2 ** (attempt - 1)), backoff_cap_s)
+            if len(self.addresses) > 1:
+                self.rotate()
+                delay *= 0.5 + random.random() * 0.5   # jittered rotation
             if on_retry is not None:
                 on_retry(attempt, delay)
             time.sleep(delay)

@@ -1,0 +1,295 @@
+"""Serving-fleet replica plane: on-disk membership with lease adoption.
+
+N `QueryEndpoint` replicas (ROADMAP item 3: the millions-of-users serving
+deployment) register into one shared fleet directory so replicas and clients
+discover live peers without a coordinator process:
+
+  - **Membership record**: one `replica-<id>.json` per replica (id =
+    host-port-pid), written atomically via a pid-unique tmp + os.replace,
+    carrying the replica's address, pid, and the shared-store directories it
+    writes (stage cache, plan history) — the state a survivor must reclaim.
+  - **Lease**: the record file's mtime. A daemon heartbeat thread renews it
+    every `fleet.heartbeat.intervalSeconds`; a record older than
+    `fleet.lease.timeoutSeconds` is expired — the replica is dead (SIGKILL),
+    wedged, or partitioned, and is dropped from `members(live_only=True)`.
+  - **Adoption**: every heartbeat also runs `sweep_expired()` under a
+    cross-process advisory lock (runtime/locks.py), so exactly one survivor
+    adopts each expired lease: it unlinks the membership record and reclaims
+    the dead replica's shared-store WRITE INTENTS — orphaned
+    `*.tmp.<pid>...` files a mid-write crash left in the store directories
+    (completed entries are already durable via os.replace and stay). Each
+    adoption emits a `fleet.adopt` event and counts `fleetAdoptions` in the
+    resilience registry, which the no-faults gates assert stays zero.
+
+Failure posture mirrors the other shared stores: every filesystem race
+(record vanishing mid-read, peer sweeping concurrently) degrades to a skip,
+never an error — fleet membership can cost a stale member list for one
+heartbeat, never a query.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+
+from spark_rapids_tpu.runtime.locks import advisory_lock
+
+log = logging.getLogger("spark_rapids_tpu.fleet")
+
+_PREFIX = "replica-"
+_SUFFIX = ".json"
+_LOCK_FILE = "fleet.lock"
+
+
+def _record_name(replica_id: str) -> str:
+    return _PREFIX + replica_id + _SUFFIX
+
+
+def _is_write_intent(name: str, pid: int) -> bool:
+    """True for an orphaned tmp file written by `pid` — the `.tmp.<pid>` /
+    `.tmp.<pid>-<seq>` suffixes of stage_cache.save and history._store."""
+    marker = ".tmp."
+    idx = name.rfind(marker)
+    if idx < 0:
+        return False
+    tail = name[idx + len(marker):]
+    owner = tail.split("-", 1)[0]
+    return owner == str(pid)
+
+
+class FleetDirectory:
+    """One replica's view of the shared fleet directory. `register()` makes
+    this process a member (with heartbeat + sweeper); an unregistered
+    instance is a read-only observer clients use for discovery."""
+
+    def __init__(self, directory: str, *, lease_timeout_s: float = 10.0,
+                 heartbeat_interval_s: float = 2.0):
+        self.directory = directory
+        self.lease_timeout_s = max(float(lease_timeout_s), 0.1)
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self.replica_id: str | None = None
+        self._record_path: str | None = None
+        self._record: dict | None = None
+        self._hb_thread: threading.Thread | None = None
+        self._hb_stop = threading.Event()
+        self._lock = threading.Lock()
+        # observability counters (tests + STATS read these)
+        self.heartbeats = 0
+        self.sweeps = 0
+        self.adoptions = 0
+        self.reclaimed_intents = 0
+        os.makedirs(directory, exist_ok=True)
+
+    # -- membership -----------------------------------------------------------
+
+    def register(self, host: str, port: int, *, stores=(), extra=None) -> str:
+        """Write this replica's lease-stamped membership record and start the
+        heartbeat thread. Returns the replica id."""
+        rid = f"{host}-{port}-{os.getpid()}"
+        record = {
+            "replica": rid,
+            "host": host,
+            "port": int(port),
+            "pid": os.getpid(),
+            "stores": [s for s in stores if s],
+            "registered": time.time(),
+        }
+        if extra:
+            record.update(extra)
+        with self._lock:
+            self.replica_id = rid
+            self._record = record
+            self._record_path = os.path.join(self.directory, _record_name(rid))
+            self._write_record()
+        self._emit("fleet.register", replica=rid, host=host, port=int(port))
+        if self.heartbeat_interval_s > 0:
+            self._hb_stop.clear()
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, name=f"srt-fleet-hb-{port}",
+                daemon=True)
+            self._hb_thread.start()
+        return rid
+
+    def deregister(self) -> None:
+        """Stop the heartbeat and drop this replica's membership record (the
+        clean-shutdown path; a SIGKILLed replica instead expires and is
+        adopted)."""
+        self._hb_stop.set()
+        t = self._hb_thread
+        if t is not None:
+            t.join(timeout=self.heartbeat_interval_s + 5)
+            self._hb_thread = None
+        with self._lock:
+            rid, path = self.replica_id, self._record_path
+            self.replica_id = None
+            self._record_path = None
+            self._record = None
+        if path is not None:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            self._emit("fleet.deregister", replica=rid)
+
+    def renew(self) -> None:
+        """Renew this replica's lease: mtime touch, rewriting the record if
+        it vanished (e.g. the fleet directory was cleaned underneath us)."""
+        with self._lock:
+            if self._record_path is None:
+                return
+            try:
+                os.utime(self._record_path)
+                self.heartbeats += 1
+            except FileNotFoundError:
+                self._write_record()
+                self.heartbeats += 1
+            except OSError as e:
+                log.warning("fleet lease renewal failed (%s); peers may "
+                            "adopt this replica's lease", e)
+
+    def _write_record(self) -> None:
+        tmp = f"{self._record_path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self._record, f, separators=(",", ":"))
+        os.replace(tmp, self._record_path)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._hb_stop.wait(self.heartbeat_interval_s):
+            self.renew()
+            try:
+                self.sweep_expired()
+            except Exception as e:  # noqa: BLE001 — sweeping must not kill hb
+                log.warning("fleet sweep failed: %s", e)
+
+    # -- discovery ------------------------------------------------------------
+
+    def members(self, live_only: bool = True) -> list[dict]:
+        """All membership records, each with an `age_s` field; `live_only`
+        drops records whose lease (mtime) has expired."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        now = time.time()
+        out = []
+        for n in sorted(names):
+            if not (n.startswith(_PREFIX) and n.endswith(_SUFFIX)):
+                continue
+            p = os.path.join(self.directory, n)
+            try:
+                age = now - os.stat(p).st_mtime
+                with open(p, "r", encoding="utf-8") as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                continue  # swept/torn by a peer mid-read
+            if live_only and age > self.lease_timeout_s:
+                continue
+            rec["age_s"] = age
+            out.append(rec)
+        return out
+
+    def addresses(self) -> list[tuple]:
+        """(host, port) of every live member — the client discovery view."""
+        return [(m["host"], int(m["port"])) for m in self.members()
+                if m.get("host") and m.get("port")]
+
+    # -- adoption -------------------------------------------------------------
+
+    def sweep_expired(self) -> list[str]:
+        """Adopt every expired lease: unlink the membership record and
+        reclaim the dead replica's orphaned shared-store write intents.
+        Serialized across replicas by the fleet advisory lock, so each dead
+        replica is adopted exactly once. Returns adopted replica ids."""
+        try:
+            names = [n for n in os.listdir(self.directory)
+                     if n.startswith(_PREFIX) and n.endswith(_SUFFIX)]
+        except OSError:
+            return []
+        own = _record_name(self.replica_id) if self.replica_id else None
+        stale = []
+        now = time.time()
+        for n in names:
+            if n == own:
+                continue
+            try:
+                age = now - os.stat(os.path.join(self.directory, n)).st_mtime
+            except OSError:
+                continue
+            if age > self.lease_timeout_s:
+                stale.append(n)
+        if not stale:
+            return []
+        adopted = []
+        with advisory_lock(os.path.join(self.directory, _LOCK_FILE)):
+            with self._lock:
+                self.sweeps += 1
+            for n in stale:
+                p = os.path.join(self.directory, n)
+                try:
+                    # re-check under the lock: the replica may have renewed,
+                    # or a peer may have adopted it while we waited
+                    if time.time() - os.stat(p).st_mtime <= self.lease_timeout_s:
+                        continue
+                    with open(p, "r", encoding="utf-8") as f:
+                        rec = json.load(f)
+                    os.unlink(p)
+                except (OSError, ValueError):
+                    continue
+                reclaimed = self._reclaim_intents(rec)
+                rid = rec.get("replica", n)
+                adopted.append(rid)
+                with self._lock:
+                    self.adoptions += 1
+                    self.reclaimed_intents += reclaimed
+                from spark_rapids_tpu.runtime import metrics as M
+                M.resilience_add(M.FLEET_ADOPTIONS)
+                self._emit("fleet.adopt", replica=rid,
+                           by=self.replica_id, dead_pid=rec.get("pid"),
+                           reclaimed_intents=reclaimed)
+                log.info("fleet: adopted expired lease of %s "
+                         "(%d write intents reclaimed)", rid, reclaimed)
+        return adopted
+
+    def _reclaim_intents(self, rec: dict) -> int:
+        """Unlink orphaned `*.tmp.<pid>...` files the dead replica left in
+        its recorded store directories. Completed entries landed via
+        os.replace and are untouched — only half-written intents go."""
+        pid = rec.get("pid")
+        if not isinstance(pid, int):
+            return 0
+        n = 0
+        for d in rec.get("stores") or []:
+            try:
+                names = os.listdir(d)
+            except OSError:
+                continue
+            for name in names:
+                if _is_write_intent(name, pid):
+                    try:
+                        os.unlink(os.path.join(d, name))
+                        n += 1
+                    except OSError:
+                        pass
+        return n
+
+    # -- observability --------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"replica": self.replica_id,
+                    "heartbeats": self.heartbeats,
+                    "sweeps": self.sweeps,
+                    "adoptions": self.adoptions,
+                    "reclaimed_intents": self.reclaimed_intents,
+                    "live_members": len(self.members())}
+
+    def _emit(self, event: str, **fields) -> None:
+        try:
+            from spark_rapids_tpu.runtime import eventlog as EL
+            if EL.enabled():
+                EL.emit(event, **fields)
+        except Exception:  # noqa: BLE001 — observability must not fail serving
+            pass

@@ -12,10 +12,13 @@ File format: one JSON document `plan_history.json` in the configured
 directory — {"version": 1, "shapes": {fp: entry}} where entry carries
 runs / peak_device_bytes / out_rows / per-node rows / skew / updated (a
 monotonically increasing sequence, not wall clock, so LRU eviction is
-deterministic). Writes are read-merge-replace via os.replace so concurrent
-sessions sharing a directory never observe a torn file. A corrupt or
-unreadable file degrades to an empty store with one warning — history is an
-optimization, never a query-failure source.
+deterministic). Writes are read-merge-replace under a cross-process advisory
+lock (runtime/locks.py) and land via os.replace, so N replica processes
+sharing the directory never observe a torn file AND never drop each other's
+shapes — without the lock, two replicas' load/merge/replace windows overlap
+and the later replace silently reverts the earlier replica's merge. A
+corrupt or unreadable file degrades to an empty store with one warning —
+history is an optimization, never a query-failure source.
 
 Process-global wiring follows the eventlog pattern: a session that sets
 `stats.history.dir` explicitly calls configure(); estimate_footprint and the
@@ -28,6 +31,8 @@ import json
 import logging
 import os
 import threading
+
+from spark_rapids_tpu.runtime.locks import advisory_lock
 
 log = logging.getLogger("spark_rapids_tpu.history")
 
@@ -74,7 +79,10 @@ class PlanHistoryStore:
             victims = sorted(shapes, key=lambda fp: shapes[fp].get("updated", 0))
             for fp in victims[:len(shapes) - self.max_shapes]:
                 del shapes[fp]
-        tmp = self.path + ".tmp"
+        # pid-unique intent file: two replicas writing the shared name would
+        # race open/replace; a crashed replica's orphan is reclaimed by the
+        # fleet sweeper (runtime/fleet.py) via this recognizable suffix
+        tmp = f"{self.path}.tmp.{os.getpid()}"
         doc = {"version": _VERSION, "shapes": shapes}
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(doc, f, separators=(",", ":"))
@@ -93,7 +101,10 @@ class PlanHistoryStore:
         estimate_bytes for this run; peaks keep the max, cardinalities keep
         the latest. Returns the merged entry. Never raises."""
         try:
-            with self._lock:
+            # threading lock orders writers inside this process; the advisory
+            # lock closes the cross-process load→merge→replace window so two
+            # replicas can't drop each other's shapes (last-writer-wins)
+            with self._lock, advisory_lock(self.path + ".lock"):
                 shapes = self._load()
                 entry = shapes.get(fingerprint)
                 if not isinstance(entry, dict):

@@ -1,0 +1,44 @@
+"""Cross-process advisory file locks for the shared serving stores.
+
+The stage cache, the plan-history store, and the fleet directory are plain
+directories shared by N replica processes; ``advisory_lock`` is the one
+primitive they serialize critical sections with — ``fcntl.flock`` on a
+sidecar lock file, held for the duration of the ``with`` block.
+
+Advisory semantics are exactly what the stores need: readers that tolerate
+concurrent mutation (stage-cache loads racing a prune) never take the lock,
+while read-merge-replace writers (history record, fleet sweep) do, so two
+replicas can't silently drop each other's updates. On platforms without
+``fcntl`` (no POSIX), the lock degrades to the process-local ``threading``
+lock the stores already hold — single-process behavior is unchanged.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+try:
+    import fcntl
+except ImportError:  # pragma: no cover - non-POSIX
+    fcntl = None
+
+
+@contextlib.contextmanager
+def advisory_lock(path: str):
+    """Hold an exclusive cross-process advisory lock on ``path``.
+
+    The lock file is created if missing and never deleted by the holder
+    (unlinking a locked file would let a late-coming process lock a fresh
+    inode and run the critical section concurrently).
+    """
+    if fcntl is None:  # pragma: no cover - non-POSIX
+        yield
+        return
+    fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX)
+        yield
+    finally:
+        # closing the fd releases the flock
+        os.close(fd)

@@ -99,6 +99,13 @@ CLIENT_DISCONNECTS = "clientDisconnects"
 # Riding the resilience registry makes leak-freedom a standing CI invariant:
 # the no-faults bench gates already assert every counter here is zero
 MEMORY_LEAKS = "memoryLeakedBuffers"
+# serving fleet (runtime/fleet.py): a survivor's sweeper adopted a dead
+# replica's expired lease — unlinked the membership record and reclaimed its
+# orphaned shared-store write intents
+FLEET_ADOPTIONS = "fleetAdoptions"
+# fleet client (runtime/endpoint.py EndpointClient): a retryable failure
+# rotated the client to the next replica in its address list
+REPLICA_FAILOVERS = "replicaFailovers"
 
 RESILIENCE_METRICS = (NUM_OOM_RETRIES, NUM_OOM_SPLIT_RETRIES, OOM_SPILL_BYTES,
                       FETCH_RETRIES, FETCH_FAILOVERS, FETCH_RECOMPUTES,
@@ -107,7 +114,8 @@ RESILIENCE_METRICS = (NUM_OOM_RETRIES, NUM_OOM_SPLIT_RETRIES, OOM_SPILL_BYTES,
                       SPECULATION_WON, SPECULATION_LOST,
                       MESH_DEGRADED_FALLBACKS,
                       QUERIES_SHED, QUERIES_CANCELLED, QUERY_DEMOTIONS,
-                      CLIENT_DISCONNECTS, MEMORY_LEAKS)
+                      CLIENT_DISCONNECTS, MEMORY_LEAKS,
+                      FLEET_ADOPTIONS, REPLICA_FAILOVERS)
 
 
 class GpuMetric:

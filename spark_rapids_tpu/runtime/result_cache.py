@@ -1,0 +1,108 @@
+"""Parameterized-plan result cache for the serving endpoint.
+
+Identical hot queries — the head of a serving workload's distribution — are
+answered from memory without touching the scheduler, the executors, or the
+device: the endpoint records the exact CRC-stamped Arrow-IPC frame payloads
+it streamed for a query and replays them bit-identically on the next hit.
+
+Keying is three-part, each part closing a distinct staleness/aliasing hole:
+
+  - **catalog epoch** (session view-registration counter): any
+    `create_or_replace_temp_view` bumps it, so results computed against a
+    replaced view can never be served again;
+  - **plan signature** (plan/fingerprint.plan_signature): the parameterized
+    plan identity — shape plus literal VALUES — so `where v > 5` and
+    `where v > 6` are distinct entries while remaining fingerprint-keyed
+    for per-shape observability;
+  - **SQL text digest**: plan signatures normalize scan data sources away
+    (they are shape identities), so two same-shaped queries over different
+    views would alias without it.
+
+Admission-exempt by design: a hit never enters the scheduler queue, so a
+saturated fleet still serves its hot set instantly (and sheds only genuinely
+new work). Bounded by bytes AND entries with LRU eviction; a result larger
+than the byte budget is simply not admitted.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import threading
+
+
+def sql_digest(sql: str) -> str:
+    return hashlib.sha256(sql.strip().encode("utf-8")).hexdigest()[:16]
+
+
+class ResultCache:
+    """LRU over fully-materialized endpoint results (wire-frame payloads +
+    the summary dict). Thread-safe; all methods are O(1) amortized."""
+
+    def __init__(self, max_bytes: int = 64 << 20, max_entries: int = 64):
+        self.max_bytes = int(max_bytes)
+        self.max_entries = max(int(max_entries), 1)
+        self._entries: collections.OrderedDict = collections.OrderedDict()
+        self._lock = threading.Lock()
+        self.bytes = 0
+        # observability counters (STATS frames + tests read these)
+        self.hits = 0
+        self.misses = 0
+        self.inserts = 0
+        self.evictions = 0
+        self.stale_drops = 0
+
+    @staticmethod
+    def key(epoch: int, signature: str, sql: str) -> tuple:
+        return (int(epoch), signature, sql_digest(sql))
+
+    def get(self, key: tuple) -> dict | None:
+        """The cached result for `key` ({"frames": [bytes], "summary": dict})
+        or None. A hit refreshes LRU recency."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return entry
+
+    def put(self, key: tuple, frames: list, summary: dict) -> bool:
+        """Admit one result; returns False when it exceeds the byte budget.
+        Evicts LRU entries past either bound and drops entries from older
+        catalog epochs (their results can never be served again)."""
+        nbytes = sum(len(f) for f in frames)
+        if nbytes > self.max_bytes:
+            return False
+        epoch = key[0]
+        with self._lock:
+            for k in [k for k in self._entries if k[0] != epoch]:
+                self.bytes -= self._entries.pop(k)["nbytes"]
+                self.stale_drops += 1
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self.bytes -= old["nbytes"]
+            self._entries[key] = {"frames": list(frames),
+                                  "summary": dict(summary),
+                                  "nbytes": nbytes}
+            self.bytes += nbytes
+            while (self.bytes > self.max_bytes
+                   or len(self._entries) > self.max_entries):
+                _, victim = self._entries.popitem(last=False)
+                self.bytes -= victim["nbytes"]
+                self.evictions += 1
+            self.inserts += 1
+        return True
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.bytes = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self.bytes,
+                    "hits": self.hits, "misses": self.misses,
+                    "inserts": self.inserts, "evictions": self.evictions,
+                    "stale_drops": self.stale_drops}

@@ -70,30 +70,35 @@ QUERY_DEMOTIONS = M.QUERY_DEMOTIONS
 # typed lifecycle errors
 # ---------------------------------------------------------------------------
 
-def _rebuild_rejected(msg, backoff_hint_s, query_id, reason):
+def _rebuild_rejected(msg, backoff_hint_s, query_id, reason, replica=None):
     return QueryRejectedError(msg, backoff_hint_s=backoff_hint_s,
-                              query_id=query_id, reason=reason)
+                              query_id=query_id, reason=reason,
+                              replica=replica)
 
 
 class QueryRejectedError(RuntimeError):
     """The scheduler shed this submission (queue full, or queue wait past
     ``scheduler.queue.timeoutSeconds``). ``retryable`` marks it safe to
     resubmit; ``backoff_hint_s`` is the scheduler's estimate of when
-    capacity frees up. Pickles losslessly so a serving endpoint can ship it
-    back to a remote client with the hint intact."""
+    capacity frees up; ``replica`` names the fleet replica that shed (so a
+    rotating client can record WHO rejected). Pickles losslessly so a
+    serving endpoint can ship it back to a remote client with the hint
+    intact."""
 
     retryable = True
 
     def __init__(self, msg: str, *, backoff_hint_s: float = 1.0,
-                 query_id: str | None = None, reason: str = "shed"):
+                 query_id: str | None = None, reason: str = "shed",
+                 replica: str | None = None):
         super().__init__(msg)
         self.backoff_hint_s = backoff_hint_s
         self.query_id = query_id
         self.reason = reason
+        self.replica = replica
 
     def __reduce__(self):
         return (_rebuild_rejected, (str(self), self.backoff_hint_s,
-                                    self.query_id, self.reason))
+                                    self.query_id, self.reason, self.replica))
 
 
 def _rebuild_cancelled(cls, msg, query_id, reason):

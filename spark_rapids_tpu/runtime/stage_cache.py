@@ -12,8 +12,14 @@ known query shape replays stored programs with ZERO Python traces.
 Failure posture mirrors runtime/history.py: a corrupt/unreadable entry is
 deleted, logged once, surfaced as a `stage.cache.corrupt` event, and the
 kernel silently retraces — the cache can only ever cost a recompile, never a
-query. Writes are atomic (tmp + os.replace); the directory is pruned to
-`maxBytes` by mtime LRU after each save.
+query. Writes are atomic (tmp + os.replace) with a pid-unique tmp suffix, so
+N replica processes compiling the same shape never race on one tmp name; a
+crashed replica's orphaned tmp is reclaimed by the fleet sweeper
+(runtime/fleet.py). The directory is pruned to `maxBytes` by mtime LRU after
+each save, per-file ENOENT-tolerant because a peer replica may prune
+concurrently; an entry this process has seen that vanishes under a
+concurrent prune is a WARNED retrace (`pruned_misses`,
+`stage.cache.pruned_race` event) — degraded, never a query failure.
 
 Wiring: TpuSession.__init__ configures the process-global store from the
 `spark.rapids.tpu.sql.stage.cache.{enabled,dir,maxBytes}` knobs (explicit
@@ -22,11 +28,13 @@ settings only — the other process-global planes follow the same rule).
 
 from __future__ import annotations
 
+import itertools
 import os
 import threading
 import warnings
 
 _SUFFIX = ".xc"
+_tmp_seq = itertools.count()
 
 
 class StageCacheStore:
@@ -43,6 +51,10 @@ class StageCacheStore:
         self.misses = 0
         self.saves = 0
         self.corrupt = 0
+        # a load that missed an entry this process saved or hit before: a
+        # concurrent peer's LRU prune unlinked it — warned retrace, not error
+        self.pruned_misses = 0
+        self._seen: set = set()
         os.makedirs(directory, exist_ok=True)
 
     def _path(self, entry: str) -> str:
@@ -55,6 +67,11 @@ class StageCacheStore:
         except FileNotFoundError:
             with self._lock:
                 self.misses += 1
+                raced = entry in self._seen
+                if raced:
+                    self.pruned_misses += 1
+            if raced:
+                self._note_pruned_race(entry)
             return None
         except OSError as e:
             self._warn_once(f"unreadable stage-cache entry {entry}: {e!r}")
@@ -63,13 +80,16 @@ class StageCacheStore:
             return None
         with self._lock:
             self.hits += 1
+            self._seen.add(entry)
         return data
 
     def save(self, entry: str, data: bytes) -> None:
         if len(data) > self.max_bytes:
             return
         path = self._path(entry)
-        tmp = path + ".tmp"
+        # pid + sequence keeps tmp names unique across replicas AND across
+        # threads in one replica compiling the same signature
+        tmp = f"{path}.tmp.{os.getpid()}-{next(_tmp_seq)}"
         try:
             with open(tmp, "wb") as f:
                 f.write(data)
@@ -83,6 +103,7 @@ class StageCacheStore:
             return
         with self._lock:
             self.saves += 1
+            self._seen.add(entry)
         self._prune()
 
     def invalidate(self, entry: str, reason: str) -> None:
@@ -120,26 +141,36 @@ class StageCacheStore:
     def total_bytes(self) -> int:
         total = 0
         try:
-            for n in os.listdir(self.directory):
-                if n.endswith(_SUFFIX):
-                    total += os.path.getsize(os.path.join(self.directory, n))
+            names = os.listdir(self.directory)
         except OSError:
-            pass
+            return 0
+        for n in names:
+            if n.endswith(_SUFFIX):
+                try:
+                    total += os.path.getsize(os.path.join(self.directory, n))
+                except OSError:
+                    pass  # a peer replica pruned it mid-scan
         return total
 
     def _prune(self) -> None:
         """mtime-LRU down to max_bytes (oldest executables are the ones least
-        likely to match a current plan shape)."""
+        likely to match a current plan shape). Per-file stat tolerance: a
+        peer replica pruning concurrently unlinks entries mid-scan, which
+        must skip that entry, not abort the whole prune."""
         try:
-            files = []
-            for n in os.listdir(self.directory):
-                if not n.endswith(_SUFFIX):
-                    continue
-                p = os.path.join(self.directory, n)
-                st = os.stat(p)
-                files.append((st.st_mtime, st.st_size, p))
+            names = os.listdir(self.directory)
         except OSError:
             return
+        files = []
+        for n in names:
+            if not n.endswith(_SUFFIX):
+                continue
+            p = os.path.join(self.directory, n)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            files.append((st.st_mtime, st.st_size, p))
         total = sum(sz for _, sz, _ in files)
         if total <= self.max_bytes:
             return
@@ -152,6 +183,19 @@ class StageCacheStore:
                 total -= sz
             except OSError:
                 pass
+
+    def _note_pruned_race(self, entry: str) -> None:
+        """An entry this process had seen vanished: a concurrent peer's LRU
+        prune won the race. The kernel retraces — degraded, never wrong."""
+        self._warn_once(
+            f"stage-cache entry {entry} pruned by a concurrent replica; "
+            "retracing")
+        try:
+            from spark_rapids_tpu.runtime import eventlog as EL
+            if EL.enabled():
+                EL.emit("stage.cache.pruned_race", entry=entry)
+        except Exception:  # noqa: BLE001 — observability must not fail a query
+            pass
 
     def _warn_once(self, msg: str) -> None:
         with self._lock:

@@ -720,6 +720,10 @@ class TpuSession:
         self.conf = (conf if isinstance(conf, RapidsConf)
                      else RapidsConf(conf or {}))
         self._views: dict = {}   # temp-view catalog for session.sql()
+        # bumped on every view (re)registration; the endpoint result cache
+        # keys on it so results computed against a replaced catalog can
+        # never be served again
+        self._catalog_epoch = 0
         self.udf = UDFRegistration(self)
         from spark_rapids_tpu import config as CFG
         from spark_rapids_tpu.ops import pallas_kernels as PK
@@ -940,10 +944,18 @@ class TpuSession:
     # -- SQL -----------------------------------------------------------------
     def create_or_replace_temp_view(self, name: str, df: DataFrame) -> None:
         """Register `df` under `name` for session.sql() (SparkSession
-        createOrReplaceTempView analog)."""
+        createOrReplaceTempView analog). Bumps the catalog epoch, which
+        invalidates every endpoint result-cache entry."""
         self._views[name] = df
+        self._catalog_epoch += 1
 
     createOrReplaceTempView = create_or_replace_temp_view
+
+    @property
+    def catalog_epoch(self) -> int:
+        """Monotonic view-registration counter (the result-cache staleness
+        key)."""
+        return self._catalog_epoch
 
     def sql(self, text: str) -> DataFrame:
         """Run a SQL query over the registered temp views (the reference's

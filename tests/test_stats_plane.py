@@ -161,7 +161,7 @@ def test_history_evicts_to_max_shapes(tmp_path):
     assert reloaded.lookup("fp00") is None
 
 
-def test_history_record_merges_peak():
+def test_history_record_merges_peak(tmp_path):
     class _Mem(H.PlanHistoryStore):
         def _store(self, shapes):
             self._shapes = shapes
@@ -169,6 +169,9 @@ def test_history_record_merges_peak():
     s = _Mem.__new__(_Mem)
     s.max_shapes = 8
     s._dir = None
+    # record() takes the cross-process advisory lock at <path>.lock even
+    # when _store is overridden, so the mock needs a real lockable path
+    s.path = str(tmp_path / "plan_history.json")
     import threading
     s._lock = threading.Lock()
     s._shapes = {}

@@ -12,6 +12,14 @@ Usage:
   python tools/tpu_client.py --port 8765 --sql-file q.sql --priority 5 \
       --deadline 30 --retries 8 --quiet
   python tools/tpu_client.py --port 8765 stats      # live serving metrics
+  python tools/tpu_client.py \
+      --addresses 127.0.0.1:8765,127.0.0.1:8766 --sql "..."   # replica fleet
+
+``--addresses`` names a replica fleet (comma-separated host:port list):
+any retryable failure — connection refused, a replica dying mid-stream, a
+shed/drain/replica_timeout rejection — rotates to the next replica with
+jitter before retrying, so failover needs nothing beyond listing the
+replicas.
 
 ``stats`` (or --stats) fetches the endpoint's live serving-metrics snapshot
 — a Prometheus-style text exposition of admission/shed/cancel/deadline
@@ -33,7 +41,11 @@ def main(argv=None) -> int:
     p.add_argument("command", nargs="?", choices=["stats"],
                    help="'stats' fetches the live serving-metrics snapshot")
     p.add_argument("--host", default="127.0.0.1")
-    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--port", type=int)
+    p.add_argument("--addresses", default=None,
+                   help="comma-separated replica list host:port,host:port "
+                        "(replaces --host/--port; retryable failures rotate "
+                        "to the next replica)")
     p.add_argument("--sql", help="SQL text (or use --sql-file / stdin '-')")
     p.add_argument("--sql-file", help="read the SQL text from this file")
     p.add_argument("--stats", action="store_true",
@@ -57,6 +69,8 @@ def main(argv=None) -> int:
                    help="print only the summary line, not the rows")
     args = p.parse_args(argv)
 
+    if not args.addresses and args.port is None:
+        p.error("one of --port / --addresses is required")
     stats_mode = args.stats or args.command == "stats"
     sql = args.sql
     if sql is None and args.sql_file:
@@ -71,7 +85,8 @@ def main(argv=None) -> int:
                                                     QueryRejectedError)
     from spark_rapids_tpu.shuffle.transport import TransportError
 
-    cli = EndpointClient((args.host, args.port), timeout_s=args.timeout)
+    address = args.addresses or (args.host, args.port)
+    cli = EndpointClient(address, timeout_s=args.timeout)
 
     if stats_mode:
         try:
@@ -85,8 +100,10 @@ def main(argv=None) -> int:
         return 0
 
     def on_retry(attempt, delay):
+        target = f" via {cli.address[0]}:{cli.address[1]}" \
+            if len(cli.addresses) > 1 else ""
         print(f"retry {attempt}/{args.retries} in {delay:.2f}s "
-              "(server backoff hint honored)", file=sys.stderr)
+              f"(server backoff hint honored){target}", file=sys.stderr)
 
     try:
         table = cli.submit_with_retry(
