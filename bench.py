@@ -808,8 +808,10 @@ def _fleet_concurrent_bench(baseline, sql, n, query, reps, cores, replicas):
     replay its shapes warm. Sequential = n wire submissions through ONE
     replica; concurrent = n clients fanned across the fleet, worker i
     leading with replica i %% R and carrying the rest as its failover
-    chain. The line embeds the client-side resilience snapshot: with no
-    faults, spreading load across replicas must count ZERO failovers."""
+    chain. The line embeds the client-side resilience snapshot (with no
+    faults, spreading load across replicas must count ZERO failovers) plus
+    the serving-latency trajectory: per-replica journey counts
+    (served/failover/cached) and client-observed fleet p50/p95/p99."""
     import signal
     import threading
     from spark_rapids_tpu.runtime import metrics as M
@@ -876,14 +878,27 @@ def _fleet_concurrent_bench(baseline, sql, n, query, reps, cores, replicas):
             def worker(i):
                 order = addrs[i % replicas:] + addrs[:i % replicas]
                 cli = EndpointClient(order, timeout_s=600)
+                retries = []
                 try:
                     barrier.wait()
-                    rows = cli.submit_with_retry(sql).to_pylist()
+                    t0 = time.perf_counter()
+                    rows = cli.submit_with_retry(
+                        sql,
+                        on_retry=lambda a, d: retries.append(a)).to_pylist()
+                    client_s = time.perf_counter() - t0
                     s = cli.last_summary or {}
                     results[i] = {
                         "query_id": s.get("query"),
-                        "replica": f"{cli.address[0]}:{cli.address[1]}",
+                        # the SERVING replica's identity from the summary
+                        # frame (the journey plane stamps it), so failovers
+                        # attribute the serve to where it actually landed
+                        "replica": s.get("replica")
+                        or f"{cli.address[0]}:{cli.address[1]}",
+                        "journey": cli.last_journey,
+                        "failovers": len(retries),
+                        "cached": bool(s.get("cached")),
                         "wall_s": s.get("wall_s"),
+                        "client_s": round(client_s, 4),
                         "rows_ok": rows == baseline,
                         "resilience_nonzero": s.get("resilience") or {},
                     }
@@ -900,13 +915,32 @@ def _fleet_concurrent_bench(baseline, sql, n, query, reps, cores, replicas):
                 t.join()
             return time.perf_counter() - t0, results, errors
 
-        conc_ts, results, errors = [], None, None
+        conc_ts, results, errors, all_results = [], None, None, []
         for _ in range(reps):
             wall, results, errors = run_concurrent()
             if errors:
                 break
             conc_ts.append(wall)
+            all_results.extend(r for r in results if r)
         concurrent_s = statistics.median(conc_ts) if conc_ts else 0.0
+
+        # per-replica journey counts across every rep: where each serve
+        # landed, how many arrived via failover, how many were cache hits
+        journeys = {}
+        for r in all_results:
+            d = journeys.setdefault(
+                r["replica"], {"served": 0, "failover": 0, "cached": 0})
+            d["cached" if r["cached"] else "served"] += 1
+            d["failover"] += r["failovers"]
+        lats = sorted(r["client_s"] for r in all_results
+                      if r.get("client_s") is not None)
+
+        def _pct(p):
+            return (round(lats[min(len(lats) - 1,
+                                   int(p / 100.0 * len(lats)))], 4)
+                    if lats else None)
+
+        fleet_latency = {"p50": _pct(50), "p95": _pct(95), "p99": _pct(99)}
     finally:
         for proc in procs:
             try:
@@ -936,6 +970,11 @@ def _fleet_concurrent_bench(baseline, sql, n, query, reps, cores, replicas):
         # replicaFailovers — load spreading is routing, not recovery
         "resilience": M.resilience_snapshot(),
         "latency": _latency_percentiles(),
+        # serving-latency trajectory: per-replica journey outcome counts +
+        # client-observed (submit -> last row) percentiles across every
+        # rep — bench_compare.py diffs these between runs
+        "journeys": journeys,
+        "fleet_latency": fleet_latency,
     }
     if errors:
         line["errors"] = errors

@@ -586,12 +586,18 @@ echo "== serving fleet: chaos gate (warm replicas, SIGKILL failover, lease adopt
 # counter zero on both replicas; a victim replica is SIGKILLed mid-stream
 # and the client's submit_with_retry fails over to a survivor
 # bit-identically; a survivor adopts the victim's expired lease and
-# reclaims its orphaned shared-store write intents
+# reclaims its orphaned shared-store write intents. The fleet observability
+# plane gates inside the same harness: the victim's blackbox dump survives
+# the SIGKILL naming the in-flight query, the survivor's fleet.adopt
+# carries the dump path, profiler.py journey renders the cross-replica
+# failover timeline with rc=0, profiler.py fleet lists the dead victim's
+# tombstone, and the fleet-stats aggregate equals an independent re-sum of
+# every replica's raw counters
 fleet_dir=$(mktemp -d)
 JAX_PLATFORMS=cpu python tools/fleet_chaos.py --work-dir "$fleet_dir"
 rm -rf "$fleet_dir"
-# fleet membership / client rotation / shared-store race / result-cache suite
-JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q
+# fleet membership / journey / blackbox / client rotation / result-cache
+JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py tests/test_fleet_observability.py -q
 
 echo "== serving fleet: 2-replica throughput through the wire =="
 # 2 replica processes sharing one compiled-stage cache: n concurrent
@@ -607,6 +613,14 @@ import json, sys
 d = json.loads(sys.argv[1])
 assert d["endpoint"] and d["replicas"] == 2 and d["isolation_ok"], d
 assert not any(d["resilience"].values()), d["resilience"]
+# serving-latency trajectory: journey counts + fleet percentiles must be
+# embedded (bench_compare diffs them), and a no-faults run serves every
+# journey without a single failover hop
+assert d["journeys"] and all(
+    j["failover"] == 0 for j in d["journeys"].values()), d["journeys"]
+assert sum(j["served"] + j["cached"]
+           for j in d["journeys"].values()) >= d["n"], d["journeys"]
+assert d["fleet_latency"]["p50"] and d["fleet_latency"]["p99"], d
 if "gate_skipped" in d:
     print("fleet throughput gate SKIPPED:", d["gate_skipped"],
           "| measured", d["throughput_x"], "x")
@@ -660,6 +674,12 @@ on_s = run({"spark.rapids.tpu.eventLog.dir": os.environ["SRT_OBS_DIR"],
 eventlog.shutdown()
 from spark_rapids_tpu.runtime import tracing
 tracing.shutdown_spans()
+# the black-box flight recorder is ON by default: its ring must have been
+# recording during the timed "on" run (so it rides inside the same <5%
+# budget), holding the most recent event-log records for a crash dump
+from spark_rapids_tpu.runtime import blackbox
+assert blackbox.enabled() and blackbox.ring_len() > 0, (
+    blackbox.enabled(), blackbox.ring_len())
 overhead = (on_s - off_s) / off_s
 print(f"event log + tracing overhead on q18: off={off_s:.4f}s "
       f"on={on_s:.4f}s ({overhead:+.1%})")

@@ -819,6 +819,28 @@ ENDPOINT_STATS_HISTOGRAMS = conf(
     "admission queue wait) in STATS snapshots; counters and gauges are "
     "always served").boolean_conf(True)
 
+ENDPOINT_SLO_LATENCY_TARGET = conf(
+    "spark.rapids.tpu.endpoint.slo.latencyTargetSeconds").doc(
+    "Per-query serving-latency objective of the endpoint's SLO accounting "
+    "(runtime/endpoint.py): every served/cached submission whose wall time "
+    "exceeds the target counts an slo.breach event and an srt_slo_total "
+    "breach, and failed submissions count against availability; the "
+    "per-replica SLO snapshot rides the fleet heartbeat's lease-record "
+    "health summary so profiler.py fleet / fleet-stats can render a "
+    "fleet-merged breach table. <=0 disables SLO accounting"
+).double_conf(0.0)
+
+FLIGHT_RECORDER_MAX_EVENTS = conf(
+    "spark.rapids.tpu.flightRecorder.maxEvents").doc(
+    "Bound of the black-box flight recorder's in-memory ring "
+    "(runtime/blackbox.py): the most recent event-log records and tracing "
+    "instants are retained per process at near-zero cost (a deque append, "
+    "no I/O) and flushed to blackbox-<pid>.json on an unhandled endpoint "
+    "error, a deadline/drain hard-kill, or a stuck-query detection from the "
+    "fleet heartbeat — so a SIGKILLed replica leaves a record of what it "
+    "was doing for the survivor that adopts its lease. 0 disables the "
+    "ring; dumps land in eventLog.dir").integer_conf(512)
+
 PROFILE_DIR = conf("spark.rapids.tpu.profile.dir").doc(
     "Directory for a whole-session XProf/Perfetto capture "
     "(jax.profiler.start_trace; the reference's Nsight workflow, "

@@ -1,0 +1,165 @@
+"""Black-box flight recorder — the post-mortem record of a dying replica.
+
+A SIGKILLed or wedged serving replica dies with no chance to write a
+report: the survivor adopts its lease (runtime/fleet.py) but cannot explain
+what the victim was doing. This module keeps a bounded in-memory ring of
+the most recent event-log records (``flightRecorder.maxEvents``, default
+on) at near-zero cost — eventlog.emit appends each record it writes, one
+None check + deque append, no I/O — and flushes it to
+``blackbox-<pid>.json`` when something goes wrong:
+
+  - an **unhandled endpoint error** (an exception class the serving
+    contract does not expect) escaping a query worker,
+  - a **deadline hard-kill** — the endpoint's request-timeout or drain
+    escalation cancelling an in-flight query,
+  - a **stuck-query detection** from the fleet heartbeat's health
+    provider: the endpoint's connection thread can be wedged (a hung send,
+    a fault injection) and then cannot enforce its own deadline, but the
+    heartbeat thread stays alive until the very SIGKILL — so the dump
+    exists on disk *before* the process dies, and the adoption sweep can
+    attach its path to the ``fleet.adopt`` event.
+
+The dump is a single JSON object: process identity, the dump reason, the
+in-flight queries at dump time (from the endpoint-registered provider:
+query id, journey, attempt, SQL prefix, age), the event ring, and the
+tracing span ring (runtime/tracing.recent_events). Dumps are atomic
+(pid-unique tmp + os.replace) and per-reason throttled so a heartbeat-
+driven detector cannot spam the disk. The dump directory defaults to
+``eventLog.dir``; with no directory configured dump() is a no-op.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+
+DEFAULT_MAX_EVENTS = 512
+
+_lock = threading.Lock()
+_ring: collections.deque | None = None
+_dir: str | None = None
+_inflight_provider = None
+_last_dump: dict = {}   # reason -> monotonic time of the last dump
+_dump_count = 0
+
+
+def _install_ring(max_events: int) -> None:
+    global _ring
+    from spark_rapids_tpu.runtime import eventlog as EL
+    _ring = (collections.deque(maxlen=int(max_events))
+             if max_events > 0 else None)
+    EL.set_blackbox_ring(_ring)
+
+
+# the recorder is on by default: the ring exists from first import so every
+# configured event log feeds it without any bootstrap ordering concern
+_install_ring(DEFAULT_MAX_EVENTS)
+
+
+def configure(max_events: int | None = None,
+              directory: str | None = None) -> None:
+    """Resize (or disable, max_events=0) the ring and/or set the dump
+    directory. Called by TpuSession for explicitly-set knobs; the ring
+    itself needs no configuration to run at its default bound."""
+    with _lock:
+        if max_events is not None:
+            _install_ring(int(max_events))
+        if directory is not None:
+            global _dir
+            _dir = directory
+
+
+def set_inflight_provider(fn) -> None:
+    """Register the callable that names the process's in-flight queries at
+    dump time (the endpoint registers one walking its active registry);
+    None unregisters. Provider failures degrade to an empty list — the
+    recorder must never make a bad situation worse."""
+    global _inflight_provider
+    _inflight_provider = fn
+
+
+def enabled() -> bool:
+    return _ring is not None
+
+
+def ring_len() -> int:
+    r = _ring
+    return len(r) if r is not None else 0
+
+
+def dump_path() -> str | None:
+    """Where this process's dump lands (None when no directory is
+    configured) — recorded into the fleet membership record so a survivor
+    can name it on adoption."""
+    return (os.path.join(_dir, f"blackbox-{os.getpid()}.json")
+            if _dir else None)
+
+
+def dump(reason: str, *, min_interval_s: float = 1.0) -> str | None:
+    """Flush the ring + in-flight registry to blackbox-<pid>.json; returns
+    the path, or None when disabled/unconfigured/throttled. Repeated dumps
+    replace the file (the latest state is the post-mortem that matters);
+    per-reason throttling bounds a repeating detector to one dump per
+    ``min_interval_s``."""
+    path = dump_path()
+    if path is None or _ring is None:
+        return None
+    now = time.monotonic()
+    with _lock:
+        last = _last_dump.get(reason)
+        if last is not None and now - last < min_interval_s:
+            return None
+        _last_dump[reason] = now
+        global _dump_count
+        _dump_count += 1
+        seq = _dump_count
+    inflight = []
+    prov = _inflight_provider
+    if prov is not None:
+        try:
+            inflight = list(prov())
+        except Exception:   # noqa: BLE001 — a broken provider loses detail,
+            inflight = []   # never the dump
+    try:
+        from spark_rapids_tpu.runtime import tracing
+        spans = [{"name": n, **a} for n, a in tracing.recent_events()]
+    except Exception:   # noqa: BLE001
+        spans = []
+    payload = {
+        "pid": os.getpid(),
+        "reason": reason,
+        "ts": time.time(),
+        "dump_seq": seq,
+        "inflight": inflight,
+        "events": list(_ring),
+        "spans": spans,
+    }
+    try:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f, separators=(",", ":"), default=str)
+        os.replace(tmp, path)
+    except OSError:
+        return None
+    try:
+        from spark_rapids_tpu.runtime import eventlog as EL
+        if EL.enabled():
+            EL.emit("blackbox.dump", query=None, reason=reason, path=path,
+                    inflight=len(inflight), events=len(payload["events"]))
+    except Exception:   # noqa: BLE001 — observability must not fail serving
+        pass
+    return path
+
+
+def reset() -> None:
+    """Test hook: fresh ring at the current bound, throttles cleared."""
+    global _last_dump, _dump_count, _inflight_provider
+    with _lock:
+        r = _ring
+        _install_ring(r.maxlen if r is not None else 0)
+        _last_dump = {}
+        _dump_count = 0
+        _inflight_provider = None

@@ -78,10 +78,12 @@ import socketserver
 import struct
 import threading
 import time
+import uuid
 
 import pyarrow as pa
 
 from spark_rapids_tpu import config as CFG
+from spark_rapids_tpu.runtime import blackbox as BB
 from spark_rapids_tpu.runtime import faults as F
 from spark_rapids_tpu.runtime import metrics as M
 from spark_rapids_tpu.runtime import scheduler as SCHED
@@ -109,12 +111,17 @@ _CRC = struct.Struct("<Q")
 # conf keys the scheduler reads at submit time; everything else in the
 # request JSON is rejected (the wire must not become a generic conf setter).
 # 'trace' is NOT a conf key: it is the client's distributed trace id, handed
-# to the query's collector so server-side spans merge with the client's own
+# to the query's collector so server-side spans merge with the client's own.
+# 'journey'/'attempt' are likewise pure observability: the client-stamped
+# journey id survives submit_with_retry's replica rotation, so each
+# replica's query.journey record joins into one cross-replica timeline
 _REQUEST_KNOBS = {
     "priority": (CFG.SCHEDULER_PRIORITY.key, int),
     "deadline_s": (CFG.SCHEDULER_QUERY_DEADLINE.key, float),
     "queue_timeout_s": (CFG.SCHEDULER_QUEUE_TIMEOUT.key, float),
 }
+
+_META_FIELDS = {"sql", "description", "trace", "journey", "attempt"}
 
 
 def _table_to_ipc(tbl: pa.Table) -> bytes:
@@ -248,6 +255,13 @@ def render_stats(include_histograms: bool = True, endpoint=None) -> str:
         lines.append(f"srt_result_cache_bytes {rstats['bytes']}")
         fam("srt_result_cache_entries", "gauge")
         lines.append(f"srt_result_cache_entries {rstats['entries']}")
+    if endpoint is not None and endpoint.slo.target_s > 0:
+        sstats = endpoint.slo.snapshot()
+        fam("srt_slo_latency_target_seconds", "gauge")
+        lines.append(f"srt_slo_latency_target_seconds {sstats['target_s']}")
+        fam("srt_slo_total", "counter")
+        for k in ("served", "breaches", "errors"):
+            lines.append(f'srt_slo_total{{event="{k}"}} {sstats[k]}')
 
     if include_histograms:
         for name, snap in sorted(M.histograms_snapshot().items()):
@@ -266,6 +280,127 @@ def render_stats(include_histograms: bool = True, endpoint=None) -> str:
             lines.append(f"{family}_sum{lab} {round(snap['sum'], 6)}")
             lines.append(f"{family}_count{lab} {snap['count']}")
     return "\n".join(lines) + "\n"
+
+
+def parse_stats_text(text: str) -> dict:
+    """Parse a render_stats() exposition back into
+    ``{"counters": {series: value}, "gauges": {series: value}}`` keyed by
+    the full series string (``name{labels}``). Histogram families are
+    skipped — bucket counts do not sum meaningfully across label sets.
+    The inverse half of the fleet-stats rollup: aggregate counters are the
+    per-series SUM across replicas (gauges do not sum; they stay
+    per-replica)."""
+    out = {"counters": {}, "gauges": {}}
+    types: dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) >= 4:
+                types[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue
+        series, _, value = line.rpartition(" ")
+        if not series:
+            continue
+        name = series.split("{", 1)[0]
+        kind = types.get(name)
+        if kind not in ("counter", "gauge"):
+            continue
+        try:
+            v = float(value)
+        except ValueError:
+            continue
+        out["counters" if kind == "counter" else "gauges"][series] = v
+    return out
+
+
+def merge_fleet_stats(per_replica: dict) -> dict:
+    """Merge ``{address: stats_text | Exception}`` into the fleet rollup:
+    per-replica parsed counters/gauges (or the dial error) plus the
+    fleet-aggregate counter families, where every aggregate counter equals
+    the sum of the per-replica values — the invariant the ci fleet gate
+    asserts."""
+    replicas = {}
+    aggregate: dict[str, float] = {}
+    live = 0
+    for addr, text in per_replica.items():
+        if isinstance(text, BaseException):
+            replicas[addr] = {"ok": False,
+                              "error": f"{type(text).__name__}: {text}"}
+            continue
+        parsed = parse_stats_text(text)
+        replicas[addr] = {"ok": True, "raw": text, **parsed}
+        live += 1
+        for series, v in parsed["counters"].items():
+            aggregate[series] = aggregate.get(series, 0.0) + v
+    return {"replicas": replicas, "aggregate": {"counters": aggregate},
+            "live": live, "total": len(per_replica)}
+
+
+def render_fleet_stats(fs: dict) -> str:
+    """Human/CI-facing text of a merge_fleet_stats() rollup: one raw
+    per-replica section per address, then the aggregate counter families
+    (tpu_client.py fleet-stats prints this)."""
+    lines = []
+    for addr, rep in fs["replicas"].items():
+        lines.append(f"== replica {addr} ==")
+        if not rep["ok"]:
+            lines.append(f"UNREACHABLE {rep['error']}")
+        else:
+            lines.append(rep["raw"].rstrip("\n"))
+        lines.append("")
+    lines.append(f"== fleet aggregate ({fs['live']}/{fs['total']} "
+                 f"replicas) ==")
+    for series, v in sorted(fs["aggregate"]["counters"].items()):
+        out = int(v) if float(v).is_integer() else v
+        lines.append(f"{series} {out}")
+    return "\n".join(lines) + "\n"
+
+
+class _SloTracker:
+    """Per-replica serving-latency/availability accounting against
+    ``endpoint.slo.latencyTargetSeconds``. A served/cached submission over
+    the target is a breach; a failed submission (error/timeout/disconnect)
+    counts against availability. Inert (every observe a no-op) when the
+    target is <= 0."""
+
+    def __init__(self, target_s: float):
+        self.target_s = float(target_s)
+        self._lock = threading.Lock()
+        self.served = 0
+        self.breaches = 0
+        self.errors = 0
+
+    def observe(self, wall_s: float | None, ok: bool) -> bool:
+        """Record one finished submission; True when it breached the
+        latency target (the caller emits the slo.breach event)."""
+        if self.target_s <= 0:
+            return False
+        with self._lock:
+            if not ok:
+                self.errors += 1
+                return False
+            self.served += 1
+            if wall_s is not None and wall_s > self.target_s:
+                self.breaches += 1
+                return True
+            return False
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            finished = self.served + self.errors
+            return {
+                "target_s": self.target_s,
+                "served": self.served,
+                "breaches": self.breaches,
+                "errors": self.errors,
+                "availability": round(self.served / finished, 6)
+                if finished else 1.0,
+            }
 
 
 def _unpickle_error(payload: bytes) -> BaseException:
@@ -377,6 +512,7 @@ class QueryEndpoint:
         self.stream_buffer = conf.get(CFG.ENDPOINT_STREAM_BUFFER)
         self.stats_enabled = conf.get(CFG.ENDPOINT_STATS_ENABLED)
         self.stats_histograms = conf.get(CFG.ENDPOINT_STATS_HISTOGRAMS)
+        self.slo = _SloTracker(conf.get(CFG.ENDPOINT_SLO_LATENCY_TARGET))
         TR.set_max_frame_bytes(conf.get(CFG.TRANSPORT_MAX_FRAME_BYTES))
         self._draining = False
         self._drain_deadline = None
@@ -416,7 +552,21 @@ class QueryEndpoint:
                 fleet_dir,
                 lease_timeout_s=conf.get(CFG.FLEET_LEASE_TIMEOUT),
                 heartbeat_interval_s=conf.get(CFG.FLEET_HEARTBEAT_INTERVAL))
-            self.fleet.register(self.host, self.port, stores=stores)
+            # the membership record names this replica's blackbox dump path
+            # and lease timeout, so a survivor's fleet.adopt can point at
+            # the victim's post-mortem and an observer (profiler.py fleet)
+            # can judge liveness without knowing the fleet's config
+            extra = {"lease_timeout_s": self.fleet.lease_timeout_s}
+            if BB.dump_path():
+                extra["blackbox"] = BB.dump_path()
+            self.fleet.register(self.host, self.port, stores=stores,
+                                extra=extra)
+            # every heartbeat embeds this endpoint's health in the lease
+            # record AND runs the stuck-query watchdog — the heartbeat
+            # thread outlives a wedged connection thread, so deadline
+            # enforcement and the blackbox dump survive a hung send
+            self.fleet.set_health_provider(self._fleet_health)
+        BB.set_inflight_provider(self._inflight_snapshot)
         EL.emit("endpoint.start", query=None, host=self.host, port=self.port)
 
     # -- connection lifecycle ------------------------------------------------
@@ -502,18 +652,29 @@ class QueryEndpoint:
     def _serve_query(self, sock, payload) -> bool:
         """Run one submission and stream its results; returns False when the
         connection is dead and the handler loop should exit."""
-        if self._draining:
-            return self._shed_draining(sock)
         try:
             req = json.loads(payload.decode("utf-8"))
             sql = req["sql"]
-            unknown = set(req) - set(_REQUEST_KNOBS) - {"sql", "description",
-                                                        "trace"}
+            unknown = set(req) - set(_REQUEST_KNOBS) - _META_FIELDS
             if unknown:
                 raise ValueError(f"unknown request fields {sorted(unknown)}")
+            # the journey context exists from the first parsed byte, so
+            # even a shed or plan-error submission leaves its timeline
+            # record; an unstamped (legacy) client gets a server-minted id
+            jctx = {"journey": str(req.get("journey") or
+                                   "j-" + uuid.uuid4().hex[:12]),
+                    "attempt": max(1, int(req.get("attempt") or 1)),
+                    "t0": time.monotonic(), "done": False}
+        except BaseException as e:   # noqa: BLE001 — parse errors travel
+            return self._send_error(sock, e)
+        if self._draining:
+            self._journey_finish(jctx, "shed", reason="draining")
+            return self._shed_draining(sock)
+        try:
             sess = self._request_session(req)
             df = sess.sql(sql)
-        except BaseException as e:   # noqa: BLE001 — parse/plan errors travel
+        except BaseException as e:   # noqa: BLE001 — plan errors travel
+            self._journey_finish(jctx, "error", error=type(e).__name__)
             return self._send_error(sock, e)
 
         # result cache: a hit replays the recorded frames bit-identically
@@ -524,14 +685,15 @@ class QueryEndpoint:
             if ckey is not None:
                 hit = self.result_cache.get(ckey)
                 if hit is not None:
-                    return self._stream_cached(sock, hit)
+                    return self._stream_cached(sock, hit, jctx)
                 record = {"key": ckey, "frames": [], "bytes": 0,
                           "over": False}
 
         from spark_rapids_tpu.runtime.memory import host_prefetch_budget
         stream = _ResultStream(host_prefetch_budget(self.stream_buffer))
-        entry = {"df": df, "stream": stream,
-                 "description": req.get("description", "")}
+        entry = {"df": df, "stream": stream, "sql": sql[:500],
+                 "description": req.get("description", ""),
+                 "jny": jctx, "t0": jctx["t0"], "timed_out": False}
         key = id(stream)
         with self._lock:
             raced_drain = self._draining   # raced shutdown(): shed, don't run
@@ -540,13 +702,14 @@ class QueryEndpoint:
                 self._next_worker += 1
                 wname = f"srt-endpoint-w{self._next_worker}"
         if raced_drain:
+            self._journey_finish(jctx, "shed", reason="draining")
             return self._shed_draining(sock)
         worker = threading.Thread(target=self._run_query,
                                   args=(df, stream, req.get("trace"), record),
                                   daemon=True, name=wname)
         worker.start()
         try:
-            return self._pump(sock, df, stream)
+            return self._pump(sock, entry)
         finally:
             # leak guard on EVERY exit path (including a pump bug or an
             # unexpected fault class): the stream must be closed and a
@@ -626,6 +789,9 @@ class QueryEndpoint:
                 "rows": counts["rows"],
                 "batches": counts["batches"],
                 "wall_s": round(qm.wall_s, 4),
+                # XLA compiles attributable to THIS attempt: the journey
+                # plane's retrace count (a warm replica serves with 0)
+                "traces": qm.compile_metrics().get("compiles", 0),
                 "resilience": {k: v for k, v in
                                qm.query_resilience().items() if v},
             }
@@ -647,9 +813,11 @@ class QueryEndpoint:
             return None
         return ResultCache.key(self.session.catalog_epoch, sig, sql)
 
-    def _stream_cached(self, sock, hit: dict) -> bool:
+    def _stream_cached(self, sock, hit: dict, jctx: dict | None = None) -> bool:
         """Replay a cached result: the recorded frames bit-identically, then
-        the recorded summary marked ``cached``."""
+        the recorded summary marked ``cached`` and re-stamped with THIS
+        submission's journey (the recorded journey belongs to the
+        submission that populated the cache)."""
         from spark_rapids_tpu.runtime import movement as MV
         try:
             egress_link = MV.classify_peer(sock.getpeername())
@@ -664,10 +832,18 @@ class QueryEndpoint:
                           seconds=time.perf_counter() - t0)
             summary = dict(hit["summary"])
             summary["cached"] = True
+            if jctx is not None:
+                summary["journey"] = jctx["journey"]
+                summary["attempt"] = jctx["attempt"]
+                summary["replica"] = self.replica_name
             send_frame(sock, MSG_RESULT_END,
                        json.dumps(summary).encode("utf-8"))
+            self._journey_finish(jctx, "cached",
+                                 query=hit["summary"].get("query"), traces=0)
             return True
         except OSError:
+            self._journey_finish(jctx, "disconnect",
+                                 query=hit["summary"].get("query"))
             return False
 
     def _cancel_query(self, df, reason: str, wait_s: float = 5.0) -> str | None:
@@ -684,13 +860,13 @@ class QueryEndpoint:
             time.sleep(0.01)
         return None
 
-    def _pump(self, sock, df, stream: _ResultStream) -> bool:
+    def _pump(self, sock, entry: dict) -> bool:
         """Connection-thread loop: watch the socket for disconnect while
         relaying stream items as frames. Returns False when the connection
         died (the handler loop must exit)."""
-        deadline = (time.monotonic() + self.request_timeout
+        df, stream, jctx = entry["df"], entry["stream"], entry["jny"]
+        deadline = (entry["t0"] + self.request_timeout
                     if self.request_timeout > 0 else None)
-        timed_out = False
         from spark_rapids_tpu.runtime import movement as MV
         try:
             egress_link = MV.classify_peer(sock.getpeername())
@@ -711,12 +887,17 @@ class QueryEndpoint:
                     data = b""
                 # half-close (b""), RST (OSError) and mid-query traffic (a
                 # protocol violation) all end the connection the same way
-                return self._disconnected(df, stream,
+                return self._disconnected(df, stream, jctx,
                                           half_close=not data)
-            if deadline is not None and not timed_out \
+            if deadline is not None and not entry["timed_out"] \
                     and time.monotonic() > deadline:
-                timed_out = True
+                # entry-shared flag: the heartbeat watchdog (_sweep_stuck)
+                # enforces the same deadline when THIS thread is wedged
+                entry["timed_out"] = True
                 self._cancel_query(df, "request_timeout")
+                # deadline hard-kill: flush the flight recorder while the
+                # in-flight registry still names the killed query
+                BB.dump("deadline_kill")
             item = stream.get(timeout=0.05)
             if item is None:
                 continue
@@ -731,18 +912,30 @@ class QueryEndpoint:
                               site="endpoint.result",
                               seconds=time.perf_counter() - t0)
                 elif kind == "end":
+                    # echo the journey in the summary frame (a copy: the
+                    # result cache must record the journey-free original)
+                    val = dict(val)
+                    if jctx is not None:
+                        val["journey"] = jctx["journey"]
+                        val["attempt"] = jctx["attempt"]
+                        val["replica"] = self.replica_name
                     send_frame(sock, MSG_RESULT_END,
                                json.dumps(val).encode("utf-8"))
+                    self._journey_finish(jctx, "served",
+                                         query=val.get("query"),
+                                         wall_s=val.get("wall_s"),
+                                         traces=val.get("traces", 0))
                     return True
                 else:   # error
-                    return self._send_error(
-                        sock, self._fleet_retryable(val, timed_out))
+                    exc = self._fleet_retryable(val, entry["timed_out"])
+                    self._journey_error(jctx, exc, entry)
+                    return self._send_error(sock, exc)
             except (OSError, RuntimeError) as e:
                 # a dead client socket, or an injected endpoint.send fault
                 # of any kind: the server-side write path died —
                 # indistinguishable from a lost client
                 return self._disconnected(
-                    df, stream, send_fault=isinstance(e, RuntimeError))
+                    df, stream, jctx, send_fault=isinstance(e, RuntimeError))
 
     def _fleet_retryable(self, exc: BaseException,
                          timed_out: bool) -> BaseException:
@@ -762,13 +955,144 @@ class QueryEndpoint:
                 reason="replica_timeout", replica=self.fleet.replica_id)
         return exc
 
-    def _disconnected(self, df, stream: _ResultStream, **detail) -> bool:
+    def _disconnected(self, df, stream: _ResultStream, jctx=None,
+                      **detail) -> bool:
         from spark_rapids_tpu.runtime import eventlog as EL
         qid = self._cancel_query(df, "client_disconnect")
         M.resilience_add(M.CLIENT_DISCONNECTS)
         EL.emit("client.disconnected", query=qid, **detail)
+        self._journey_finish(jctx, "disconnect", query=qid)
         stream.close()
         return False
+
+    # -- journey plane -------------------------------------------------------
+    @property
+    def replica_name(self) -> str:
+        """This replica's identity in journey records and summary frames:
+        the fleet replica id when registered, host:port otherwise."""
+        if self.fleet is not None and self.fleet.replica_id:
+            return self.fleet.replica_id
+        return f"{self.host}:{self.port}"
+
+    def _journey_finish(self, jctx, outcome: str, *, query=None,
+                        wall_s=None, **fields) -> None:
+        """Emit the submission's terminal query.journey record exactly once
+        — the connection thread and the heartbeat watchdog can race to
+        close the same submission — and feed the SLO accounting (a shed is
+        a redirect, not an availability loss)."""
+        from spark_rapids_tpu.runtime import eventlog as EL
+        if jctx is None:
+            return
+        with self._lock:
+            if jctx["done"]:
+                return
+            jctx["done"] = True
+        if wall_s is None:
+            wall_s = time.monotonic() - jctx["t0"]
+        wall_s = round(float(wall_s), 4)
+        breach = False
+        if outcome in ("served", "cached"):
+            breach = self.slo.observe(wall_s, ok=True)
+        elif outcome != "shed":
+            self.slo.observe(wall_s, ok=False)
+        extra = {k: v for k, v in fields.items() if v is not None}
+        EL.emit("query.journey", query=query, journey=jctx["journey"],
+                attempt=jctx["attempt"], replica=self.replica_name,
+                outcome=outcome, wall_s=wall_s, **extra)
+        if breach:
+            EL.emit("slo.breach", query=query, journey=jctx["journey"],
+                    attempt=jctx["attempt"], replica=self.replica_name,
+                    wall_s=wall_s, target_s=self.slo.target_s)
+
+    def _journey_error(self, jctx, exc: BaseException, entry: dict) -> None:
+        """Close a submission's journey from its error path, classifying
+        the outcome, and flush the flight recorder when the exception class
+        is one the serving contract does not expect."""
+        if isinstance(exc, SCHED.QueryRejectedError):
+            outcome = ("replica_timeout"
+                       if getattr(exc, "reason", "") == "replica_timeout"
+                       else "shed")
+        elif entry["timed_out"]:
+            outcome = "timeout"
+        else:
+            outcome = "error"
+        self._journey_finish(jctx, outcome,
+                             query=getattr(exc, "query_id", None),
+                             error=type(exc).__name__,
+                             reason=getattr(exc, "reason", None))
+        if outcome == "error" and not isinstance(
+                exc, (SCHED.QueryCancelledError, TransportError)):
+            BB.dump("endpoint_error")
+
+    def _inflight_snapshot(self) -> list:
+        """Blackbox dump detail: what this endpoint is serving right now —
+        the record a survivor reads to explain a dead replica."""
+        now = time.monotonic()
+        with self._lock:
+            entries = list(self._active.values())
+        out = []
+        for e in entries:
+            c = e["df"]._last_collector
+            jctx = e.get("jny") or {}
+            out.append({
+                "query": c.query_id if c is not None else None,
+                "journey": jctx.get("journey"),
+                "attempt": jctx.get("attempt"),
+                "sql": e.get("sql", ""),
+                "description": e.get("description", ""),
+                "age_s": round(now - e.get("t0", now), 4),
+                "timed_out": bool(e.get("timed_out")),
+            })
+        return out
+
+    def _sweep_stuck(self) -> None:
+        """Heartbeat-side deadline enforcement: the connection thread that
+        normally enforces requestTimeoutSeconds can itself be wedged (a
+        hung send), so every fleet heartbeat re-checks the age of each
+        in-flight submission. A stuck one is cancelled, its journey closed
+        (``replica_timeout`` on a fleet — the client re-routes), and the
+        flight recorder dumped while this process can still write — the
+        post-mortem a SIGKILL would otherwise erase."""
+        limit = self.request_timeout
+        if limit <= 0:
+            return
+        now = time.monotonic()
+        with self._lock:
+            stuck = [e for e in self._active.values()
+                     if now - e["t0"] > limit and not e["timed_out"]]
+            for e in stuck:
+                e["timed_out"] = True
+        for e in stuck:
+            qid = self._cancel_query(e["df"], "request_timeout", wait_s=0.1)
+            outcome = ("replica_timeout" if self.fleet is not None
+                       else "timeout")
+            self._journey_finish(e["jny"], outcome, query=qid, stuck=True)
+        if stuck:
+            BB.dump("stuck_query", min_interval_s=min(1.0, limit))
+
+    def _fleet_health(self) -> dict:
+        """Compact health summary embedded in this replica's lease record
+        on every heartbeat — the per-replica row of the fleet roster
+        (profiler.py fleet), preserved in the departed tombstone when a
+        survivor adopts the lease. Doubles as the stuck-query watchdog's
+        clock: the heartbeat thread outlives a wedged connection thread."""
+        from spark_rapids_tpu.runtime import eventlog as EL
+        self._sweep_stuck()
+        h = EL.health_payload()
+        out = {
+            "active_queries": self.active_queries(),
+            "hbm_watermark_bytes": int(h.get("hbm_watermark_bytes") or 0),
+            "fuse": h.get("fuse", {}),
+            "resilience": {k: v for k, v in
+                           M.resilience_snapshot().items() if v},
+        }
+        if self.result_cache is not None:
+            rs = self.result_cache.stats()
+            out["result_cache"] = {"hits": rs["hits"],
+                                   "misses": rs["misses"]}
+        if self.slo.target_s > 0:
+            out["slo"] = self.slo.snapshot()
+        return out
 
     # -- drain / shutdown ----------------------------------------------------
     def active_queries(self) -> int:
@@ -804,6 +1128,10 @@ class QueryEndpoint:
         for entry in stragglers:
             if self._cancel_query(entry["df"], "drain", wait_s=0.5):
                 cancelled += 1
+        if cancelled:
+            # drain hard-kill: in-flight queries are being force-cancelled;
+            # leave the post-mortem before their state drains away
+            BB.dump("drain_kill")
         # bounded wait for the cancelled queries to drain through their
         # cooperative checkpoints, then stop accepting and force the
         # remaining connections closed
@@ -904,6 +1232,7 @@ class EndpointClient:
         self.timeout_s = timeout_s
         self.max_frame = max_frame_bytes or _default_max_frame()
         self.last_summary: dict | None = None
+        self.last_journey: str | None = None
 
     @property
     def address(self) -> tuple:
@@ -919,15 +1248,15 @@ class EndpointClient:
             M.resilience_add(M.REPLICA_FAILOVERS)
         return self.address
 
-    def connect(self):
+    def connect(self, address=None):
+        addr = address if address is not None else self.address
         try:
-            sock = socket.create_connection(self.address,
-                                            timeout=self.timeout_s)
+            sock = socket.create_connection(addr, timeout=self.timeout_s)
         except OSError as e:
             # connection refused/reset IS retryable: the replica is gone,
             # the fleet may not be — rotation finds out
             raise TransportError(
-                f"endpoint {self.address} unreachable: {e}") from e
+                f"endpoint {addr} unreachable: {e}") from e
         configure_socket(sock, timeout_s=self.timeout_s)
         return sock
 
@@ -942,12 +1271,14 @@ class EndpointClient:
         finally:
             sock.close()
 
-    def stats(self) -> str:
+    def stats(self, address=None) -> str:
         """Live serving-metrics snapshot (Prometheus-style text): admission
         counters, resilience registry, HBM/spill/queue gauges and latency
-        histograms. Raises the server's typed error when STATS is disabled
-        (endpoint.stats.enabled=false)."""
-        sock = self.connect()
+        histograms. `address` targets a specific replica (default: the
+        currently-targeted one). Raises the server's typed error when STATS
+        is disabled (endpoint.stats.enabled=false)."""
+        addr = address if address is not None else self.address
+        sock = self.connect(addr)
         try:
             send_frame(sock, MSG_STATS, b"")
             msg, payload = recv_frame(sock, max_bytes=self.max_frame)
@@ -958,23 +1289,53 @@ class EndpointClient:
             return payload.decode("utf-8")
         except OSError as e:
             raise TransportError(
-                f"endpoint {self.address} stats failed: {e}") from e
+                f"endpoint {addr} stats failed: {e}") from e
         finally:
             sock.close()
+
+    def stats_all(self) -> dict:
+        """Per-replica stats across the WHOLE replica list — never just the
+        one replica the client happens to target. ``{"host:port": text |
+        Exception}``; a dial failure is recorded, not raised, so one dead
+        replica cannot hide the rest of the fleet."""
+        out = {}
+        for addr in self.addresses:
+            key = f"{addr[0]}:{addr[1]}"
+            try:
+                out[key] = self.stats(addr)
+            except Exception as e:   # noqa: BLE001 — typed server errors
+                out[key] = e         # (stats disabled) report per-replica
+        return out
+
+    def fleet_stats(self) -> dict:
+        """Fleet-wide stats rollup: dial every replica in the list, parse
+        each Prometheus snapshot, and merge — per-replica counters/gauges
+        (or the dial error) plus fleet-aggregate counter families where
+        every aggregate equals the sum of per-replica values
+        (tools/tpu_client.py fleet-stats renders this)."""
+        return merge_fleet_stats(self.stats_all())
 
     def submit_iter(self, sql: str, *, priority: int | None = None,
                     deadline_s: float | None = None,
                     queue_timeout_s: float | None = None,
-                    description: str = "", trace: str | None = None):
+                    description: str = "", trace: str | None = None,
+                    journey: str | None = None, attempt: int | None = None):
         """Generator of result tables, one per streamed Arrow-IPC batch;
         ``self.last_summary`` carries the MSG_RESULT_END stats afterwards.
         Abandoning the generator closes the connection, which cancels the
         query server-side. Raises the server's typed exception on failure
         and TransportError on any wire-level fault (CRC mismatch, short
-        read, reset)."""
+        read, reset). Every submission is stamped with a journey id +
+        attempt number (minted here when the caller has none):
+        submit_with_retry reuses one journey across its replica rotation,
+        so each replica's query.journey record joins one timeline."""
+        if journey is None:
+            journey = "j-" + uuid.uuid4().hex[:12]
+        self.last_journey = journey
         req = {"sql": sql, "description": description,
                "priority": priority, "deadline_s": deadline_s,
-               "queue_timeout_s": queue_timeout_s, "trace": trace}
+               "queue_timeout_s": queue_timeout_s, "trace": trace,
+               "journey": journey, "attempt": max(1, int(attempt or 1))}
         sock = self.connect()
         try:
             try:
@@ -1024,12 +1385,21 @@ class EndpointClient:
         non-retryable typed errors propagate immediately. With a replica
         list, every retryable failure first rotates to the next replica
         (jittered, so a killed replica's clients don't stampede one
-        survivor) — failover is this loop, not new client code."""
+        survivor) — failover is this loop, not new client code.
+
+        One journey id spans every attempt, and when the caller passed no
+        trace id the journey doubles as the trace — so a failed-over
+        submission's server-side spans land in ONE distributed trace
+        instead of orphaning attempt 1's spans under a per-attempt id."""
+        journey = kw.pop("journey", None) or "j-" + uuid.uuid4().hex[:12]
+        if kw.get("trace") is None:
+            kw["trace"] = journey
         attempt = 0
         while True:
             attempt += 1
             try:
-                return self.submit(sql, **kw)
+                return self.submit(sql, journey=journey, attempt=attempt,
+                                   **kw)
             except SCHED.QueryRejectedError as e:
                 if attempt >= max_attempts:
                     raise

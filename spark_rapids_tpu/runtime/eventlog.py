@@ -103,6 +103,27 @@ KNOWN_EVENTS = frozenset({
     # query-scoped: executor processes meter task work outside any driver
     # query extent
     "movement.sample",
+    # serving-fleet membership plane (runtime/fleet.py): a replica writing
+    # its lease-stamped record, dropping it on clean shutdown, and a
+    # survivor adopting an expired peer's lease (carrying the dead
+    # replica's blackbox-dump path when its record named one)
+    "fleet.register", "fleet.deregister", "fleet.adopt",
+    # fleet observability plane (runtime/endpoint.py): one query.journey
+    # record per endpoint submission at its terminal transition — the
+    # cross-replica failover timeline's unit (see JOURNEY_OUTCOMES) —
+    # plus SLO breach detections and black-box flight-recorder dumps
+    "query.journey", "slo.breach", "blackbox.dump",
+})
+
+# terminal outcome of one endpoint submission attempt (the query.journey
+# `outcome` field); profiler.py journey rejects records outside this set.
+# `replica_timeout` is the fleet conversion of a request-timeout kill (the
+# client re-routes); a solo endpoint's kill stays `timeout`. A failover is
+# not an outcome — it is the profiler-derived label for a journey whose
+# attempt N ended retryably and whose attempt N+1 exists on another replica
+JOURNEY_OUTCOMES = frozenset({
+    "served", "cached", "shed", "replica_timeout", "timeout",
+    "error", "disconnect",
 })
 
 # events that only make sense inside a query's dynamic extent; the profiler
@@ -241,6 +262,19 @@ def set_query_fallback(fn) -> None:
     _query_fallback = fn
 
 
+# black-box flight recorder (runtime/blackbox.py) registers its bounded
+# deque here so every record the log sees is also retained in memory for a
+# post-mortem dump — one None check + deque append on the emit path, and
+# nothing at all when no event log is configured (the overhead contract
+# above is unchanged)
+_blackbox_ring = None
+
+
+def set_blackbox_ring(ring) -> None:
+    global _blackbox_ring
+    _blackbox_ring = ring
+
+
 def enabled() -> bool:
     return _writer is not None
 
@@ -273,6 +307,9 @@ def emit(event: str, *, query: str | None = None, node: int | None = None,
         record["offset"] = _clock_offset
     record.update(fields)
     w.write(record)
+    ring = _blackbox_ring
+    if ring is not None:
+        ring.append(record)
 
 
 def health_payload() -> dict:
@@ -372,4 +409,17 @@ def validate_record(rec: dict) -> list:
         errs.append(f"{ev}: missing query/node attribution keys")
     if ev in QUERY_SCOPED_EVENTS and not rec.get("query"):
         errs.append(f"{ev}: query-scoped event without a query id")
+    if ev == "query.journey":
+        # the journey plane's own schema: without these four fields the
+        # cross-replica timeline cannot be assembled, so the profiler
+        # treats their absence as a hard violation (journey rc != 0)
+        if not rec.get("journey"):
+            errs.append("query.journey: missing journey id")
+        if not isinstance(rec.get("attempt"), int) or rec["attempt"] < 1:
+            errs.append("query.journey: missing positive integer 'attempt'")
+        if not rec.get("replica"):
+            errs.append("query.journey: missing replica identity")
+        if rec.get("outcome") not in JOURNEY_OUTCOMES:
+            errs.append(f"query.journey: outcome {rec.get('outcome')!r} "
+                        f"not in {sorted(JOURNEY_OUTCOMES)}")
     return errs

@@ -12,6 +12,10 @@ discover live peers without a coordinator process:
     every `fleet.heartbeat.intervalSeconds`; a record older than
     `fleet.lease.timeoutSeconds` is expired — the replica is dead (SIGKILL),
     wedged, or partitioned, and is dropped from `members(live_only=True)`.
+    Each renewal rewrites the record embedding the registered health
+    provider's compact summary (active queries, HBM watermark, cache hit
+    rates, resilience counters, SLO snapshot), so the fleet directory is
+    also the fleet-wide health roster (`profiler.py fleet`).
   - **Adoption**: every heartbeat also runs `sweep_expired()` under a
     cross-process advisory lock (runtime/locks.py), so exactly one survivor
     adopts each expired lease: it unlinks the membership record and reclaims
@@ -40,6 +44,7 @@ from spark_rapids_tpu.runtime.locks import advisory_lock
 log = logging.getLogger("spark_rapids_tpu.fleet")
 
 _PREFIX = "replica-"
+_DEPARTED_PREFIX = "departed-"
 _SUFFIX = ".json"
 _LOCK_FILE = "fleet.lock"
 
@@ -73,6 +78,7 @@ class FleetDirectory:
         self.replica_id: str | None = None
         self._record_path: str | None = None
         self._record: dict | None = None
+        self._health_provider = None
         self._hb_thread: threading.Thread | None = None
         self._hb_stop = threading.Event()
         self._lock = threading.Lock()
@@ -134,16 +140,38 @@ class FleetDirectory:
                 pass
             self._emit("fleet.deregister", replica=rid)
 
+    def set_health_provider(self, fn) -> None:
+        """Register a callable returning a compact JSON-serializable health
+        summary; every lease renewal embeds its latest result in the
+        membership record, so the fleet directory doubles as the roster of
+        last-known replica state — a dead replica's final record (preserved
+        as a `departed-` tombstone on adoption) still names what it was
+        doing. None unregisters."""
+        with self._lock:
+            self._health_provider = fn
+
     def renew(self) -> None:
-        """Renew this replica's lease: mtime touch, rewriting the record if
-        it vanished (e.g. the fleet directory was cleaned underneath us)."""
+        """Renew this replica's lease by rewriting the record (the atomic
+        os.replace stamps a fresh mtime), embedding the health provider's
+        current summary. The provider runs OUTSIDE the fleet lock — it may
+        take the endpoint's own locks — and its failure degrades to a
+        health-less renewal, never a lost lease."""
         with self._lock:
             if self._record_path is None:
                 return
+            prov = self._health_provider
+        health = None
+        if prov is not None:
             try:
-                os.utime(self._record_path)
-                self.heartbeats += 1
-            except FileNotFoundError:
+                health = prov()
+            except Exception as e:  # noqa: BLE001 — health is best-effort
+                log.warning("fleet health provider failed: %s", e)
+        with self._lock:
+            if self._record_path is None:
+                return   # deregistered while the provider ran
+            if health is not None:
+                self._record["health"] = health
+            try:
                 self._write_record()
                 self.heartbeats += 1
             except OSError as e:
@@ -153,7 +181,7 @@ class FleetDirectory:
     def _write_record(self) -> None:
         tmp = f"{self._record_path}.tmp.{os.getpid()}"
         with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(self._record, f, separators=(",", ":"))
+            json.dump(self._record, f, separators=(",", ":"), default=str)
         os.replace(tmp, self._record_path)
 
     def _heartbeat_loop(self) -> None:
@@ -244,14 +272,53 @@ class FleetDirectory:
                 with self._lock:
                     self.adoptions += 1
                     self.reclaimed_intents += reclaimed
+                # preserve the victim's final record (last-known health,
+                # blackbox path) as a departed- tombstone: the roster
+                # (profiler.py fleet) can still explain a dead replica
+                self._write_tombstone(rec, adopted_by=self.replica_id)
                 from spark_rapids_tpu.runtime import metrics as M
                 M.resilience_add(M.FLEET_ADOPTIONS)
                 self._emit("fleet.adopt", replica=rid,
                            by=self.replica_id, dead_pid=rec.get("pid"),
-                           reclaimed_intents=reclaimed)
+                           reclaimed_intents=reclaimed,
+                           blackbox=rec.get("blackbox"))
                 log.info("fleet: adopted expired lease of %s "
                          "(%d write intents reclaimed)", rid, reclaimed)
         return adopted
+
+    def _write_tombstone(self, rec: dict, adopted_by: str | None) -> None:
+        rec = dict(rec)
+        rec["departed"] = time.time()
+        rec["adopted_by"] = adopted_by
+        name = _DEPARTED_PREFIX + str(rec.get("replica", "unknown")) + _SUFFIX
+        path = os.path.join(self.directory, name)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(rec, f, separators=(",", ":"), default=str)
+            os.replace(tmp, path)
+        except OSError:
+            pass   # the tombstone is observability, never load-bearing
+
+    def departed(self) -> list[dict]:
+        """Tombstones of adopted (dead) replicas: each is the victim's final
+        membership record — last-known health included — plus `departed`
+        (adoption wall-clock) and `adopted_by`."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        out = []
+        for n in sorted(names):
+            if not (n.startswith(_DEPARTED_PREFIX) and n.endswith(_SUFFIX)):
+                continue
+            try:
+                with open(os.path.join(self.directory, n), "r",
+                          encoding="utf-8") as f:
+                    out.append(json.load(f))
+            except (OSError, ValueError):
+                continue
+        return out
 
     def _reclaim_intents(self, rec: dict) -> int:
         """Unlink orphaned `*.tmp.<pid>...` files the dead replica left in

@@ -778,6 +778,18 @@ class TpuSession:
                     keep=self.conf.get(CFG.EVENT_LOG_KEEP_FILES))
             else:
                 eventlog.shutdown()
+        # black-box flight recorder (runtime/blackbox.py): the in-memory
+        # ring runs at its default bound with no configuration; the dump
+        # directory follows eventLog.dir, and an EXPLICIT maxEvents setting
+        # resizes (0 disables) the process-global ring
+        if any(k.key in self.conf.settings for k in (
+                CFG.FLIGHT_RECORDER_MAX_EVENTS, CFG.EVENT_LOG_DIR)):
+            from spark_rapids_tpu.runtime import blackbox
+            blackbox.configure(
+                max_events=self.conf.get(CFG.FLIGHT_RECORDER_MAX_EVENTS)
+                if CFG.FLIGHT_RECORDER_MAX_EVENTS.key in self.conf.settings
+                else None,
+                directory=self.conf.get(CFG.EVENT_LOG_DIR) or None)
         # memory observability plane (runtime/memory.py): watermark sample
         # granularity + site top-K are process-global like the switches
         # above — only an EXPLICIT setting pushes them onto the (lazily

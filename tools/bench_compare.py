@@ -26,6 +26,12 @@ amplification) — a perf win that silently moves twice the data is visible
 in the same report; a baseline committed before the movement fields
 existed is skipped per-field, never treated as zero.
 
+Serving-latency trajectories ride independently of the per-query gate:
+when BOTH lines carry ``fleet_latency`` (bench.py --concurrent --endpoint
+--replicas embeds client-observed p50/p95/p99 plus per-replica journey
+counts), the percentile deltas and journey totals are printed even though
+a fleet line has no per-query ``vs_baseline`` section to gate on.
+
 Usage:
   python tools/bench_compare.py <current.json> [--baseline BENCH_r06.json]
                                 [--warn 0.10] [--fail 0.25]
@@ -170,6 +176,28 @@ def main(argv=None) -> int:
 
     cur = load_line(args.current)
     base = load_line(args.baseline)
+    # serving-latency trajectory (fleet observability plane): printed
+    # BEFORE — and regardless of — the per-query comparability gate, since
+    # a fleet line carries fleet_latency/journeys instead of "queries"
+    if cur.get("fleet_latency") and base.get("fleet_latency"):
+        cf, bf = cur["fleet_latency"], base["fleet_latency"]
+        parts = []
+        for k in ("p50", "p95", "p99"):
+            c, b = cf.get(k), bf.get(k)
+            if c is not None and b is not None:
+                parts.append(f"{k} {b}s -> {c}s ({c - b:+.4f}s)")
+        if parts:
+            print("fleet serving latency: " + "  ".join(parts))
+
+        def _tot(line, key):
+            return sum(j.get(key, 0)
+                       for j in (line.get("journeys") or {}).values())
+
+        print(f"fleet journeys: "
+              f"served {_tot(base, 'served')} -> {_tot(cur, 'served')}  "
+              f"cached {_tot(base, 'cached')} -> {_tot(cur, 'cached')}  "
+              f"failovers {_tot(base, 'failover')} -> "
+              f"{_tot(cur, 'failover')}")
     reason = comparable(cur, base)
     if reason is not None:
         print(f"bench_compare SKIP (not comparable): {reason}")

@@ -25,6 +25,21 @@ real replica PROCESSES (tools/fleet_replica.py) over shared on-disk state:
   - **Survivor health**: after the chaos the survivor still serves
     bit-identically, with zero leaked buffers (memoryLeakedBuffers == 0),
     an idle scheduler, and zero active queries.
+  - **Fleet-stats rollup**: with both replicas live, the fleet-aggregate
+    counters (EndpointClient.fleet_stats) equal an INDEPENDENT re-sum of
+    each replica's raw Prometheus text — the rollup invents and loses
+    nothing.
+  - **Black-box flight recorder**: the victim gets a request timeout, so
+    its heartbeat watchdog detects the wedged query and dumps
+    ``blackbox-<pid>.json`` BEFORE the SIGKILL lands; the dump names the
+    in-flight query (journey id + SQL), and the survivor's ``fleet.adopt``
+    event carries the dump's path.
+  - **Cross-replica journey**: ``profiler.py journey`` over every
+    replica's event log renders the failover under ONE journey id —
+    attempt 1 replica_timeout on the victim, attempt 2 served on the
+    survivor with traces == 0 — exiting 0 (no schema violations).
+  - **Fleet roster**: ``profiler.py fleet`` lists the dead victim from its
+    ``departed-`` tombstone — last-known health and blackbox path intact.
 
 Usage:
   python tools/fleet_chaos.py --work-dir DIR [--sf 0.01]
@@ -50,6 +65,23 @@ def _stat_value(stats_text: str, pattern: str) -> float:
         if re.search(pattern, ln) and not ln.startswith("# "):
             return float(ln.rsplit(None, 1)[1])
     raise AssertionError(f"no STATS line matches {pattern!r}")
+
+
+def _counter_series(stats_text: str) -> dict:
+    """Independent counter parse of one raw Prometheus exposition —
+    deliberately NOT endpoint.parse_stats_text, so comparing the fleet
+    aggregate against a re-sum of these is a real cross-check."""
+    out, family, kind = {}, None, None
+    for ln in stats_text.splitlines():
+        if ln.startswith("# TYPE "):
+            _, _, family, kind = ln.split(None, 3)
+            continue
+        if not ln.strip() or ln.startswith("#"):
+            continue
+        series, val = ln.rsplit(None, 1)
+        if kind == "counter" and series.split("{", 1)[0] == family:
+            out[series] = out.get(series, 0.0) + float(val)
+    return out
 
 
 def main(argv=None) -> int:
@@ -103,7 +135,7 @@ def main(argv=None) -> int:
     # seconds of the SIGKILL
     lease_timeout, heartbeat = 8.0, 1.0
 
-    def spawn_replica(tag, faults=None):
+    def spawn_replica(tag, faults=None, request_timeout=None):
         cmd = [sys.executable, str(repo / "tools" / "fleet_replica.py"),
                "--fleet-dir", str(dirs["fleet"]),
                "--data-dir", str(dirs["data"]), "--sf", str(args.sf),
@@ -114,6 +146,8 @@ def main(argv=None) -> int:
                "--heartbeat", str(heartbeat)]
         if faults:
             cmd += ["--faults", faults]
+        if request_timeout is not None:
+            cmd += ["--request-timeout", str(request_timeout)]
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                 stderr=subprocess.STDOUT, text=True, env=env)
@@ -191,13 +225,39 @@ def main(argv=None) -> int:
     check(_stat_value(stats_b, r"srt_fleet_live_members") == 2,
           "replica B does not see 2 live members")
 
+    # -- phase 2b: fleet-stats rollup over the two live replicas -------------
+    # the aggregate must equal an INDEPENDENT re-sum of each replica's raw
+    # exposition for every counter series — the rollup invents nothing
+    fleet_cli = EndpointClient([addr_a, addr_b], timeout_s=300)
+    fs = fleet_cli.fleet_stats()
+    check(fs["live"] == 2 and fs["total"] == 2,
+          f"fleet-stats saw {fs['live']}/{fs['total']} replicas, want 2/2")
+    resum = {}
+    for rep in fs["replicas"].values():
+        for series, v in _counter_series(rep.get("raw", "")).items():
+            resum[series] = resum.get(series, 0.0) + v
+    agg = fs["aggregate"]["counters"]
+    check(set(agg) == set(resum),
+          f"fleet aggregate counter families diverge from the re-sum: "
+          f"{sorted(set(agg) ^ set(resum))[:8]}")
+    for series in resum:
+        if abs(agg.get(series, 0.0) - resum[series]) > 1e-9:
+            check(False, f"fleet aggregate {series}={agg.get(series)} != "
+                         f"sum of per-replica {resum[series]}")
+    report["fleet_counter_series"] = len(resum)
+
     # -- phase 3: SIGKILL a victim mid-stream; client fails over --------------
     # the victim's armed hang fault wedges q5 forever at its first result
     # frame (endpoint.send is a maybe_inject_any site, so "hang" fires
     # there), so the kill deterministically lands while the client is
     # mid-stream (a timed slow fault loses the race when the shared stage
-    # cache makes the query finish in under the kill delay)
-    proc_v, addr_v = spawn_replica("victim", faults="hang:endpoint.send:1")
+    # cache makes the query finish in under the kill delay). The victim
+    # also gets a request timeout: its connection thread is the wedged one,
+    # so the HEARTBEAT watchdog must detect the stuck query, close its
+    # journey (replica_timeout) and dump the flight recorder — all before
+    # the SIGKILL, which is exactly the post-mortem the dump exists for.
+    proc_v, addr_v = spawn_replica("victim", faults="hang:endpoint.send:1",
+                                   request_timeout=1.0)
     flight = {}
     retries = []
 
@@ -208,12 +268,15 @@ def main(argv=None) -> int:
                 SQL_QUERIES["q5"],
                 on_retry=lambda a, d: retries.append(a)).to_pylist()
             flight["summary"] = cli.last_summary
+            flight["journey"] = cli.last_journey
         except BaseException as e:  # noqa: BLE001
             flight["error"] = repr(e)[:200]
 
     ft = threading.Thread(target=failover_client, daemon=True)
     ft.start()
-    time.sleep(2.0)                     # mid-aggregation on the victim
+    # long enough for the query to wedge, age past the 1s request timeout,
+    # and a heartbeat (1s) to run the watchdog sweep + blackbox dump
+    time.sleep(4.0)
     os.kill(proc_v.pid, signal.SIGKILL)
     killed_at = time.monotonic()
     # plant an orphaned write intent under the victim's pid: the mid-write
@@ -254,6 +317,75 @@ def main(argv=None) -> int:
     check(adopt_events, "no fleet.adopt event in the event log")
     check(any(rec.get("dead_pid") == proc_v.pid for rec in adopt_events),
           f"fleet.adopt events name the wrong pid: {adopt_events}")
+
+    # -- phase 4b: the victim's black-box dump survived the SIGKILL ----------
+    bb_path = dirs["eventlog"] / f"blackbox-{proc_v.pid}.json"
+    check(bb_path.exists(), "victim wrote no blackbox dump before dying")
+    jny = flight.get("journey")
+    check(jny, "client recorded no journey id for the failover flight")
+    if bb_path.exists():
+        bb = json.loads(bb_path.read_text())
+        check(bb.get("reason") == "stuck_query",
+              f"blackbox dumped for {bb.get('reason')!r}, want stuck_query")
+        named = [i for i in bb.get("inflight", [])
+                 if i.get("journey") == jny]
+        check(named, f"blackbox in-flight registry does not name the "
+                     f"wedged journey {jny}: {bb.get('inflight')}")
+        check(named and named[0].get("sql"),
+              "blackbox in-flight entry carries no SQL")
+        check(bb.get("events"), "blackbox event ring is empty")
+        check(any(rec.get("blackbox") == str(bb_path)
+                  for rec in adopt_events),
+              f"no fleet.adopt event carries the victim's blackbox path "
+              f"{bb_path}")
+        report["blackbox_inflight"] = len(bb.get("inflight", []))
+
+    # -- phase 4c: profiler renders the cross-replica failover timeline ------
+    logs = sorted(str(f) for f in dirs["eventlog"].glob("*.jsonl"))
+    jr = subprocess.run(
+        [sys.executable, str(repo / "tools" / "profiler.py"), "journey",
+         *logs, "--journey", str(jny), "--json"],
+        capture_output=True, text=True)
+    check(jr.returncode == 0,
+          f"profiler journey exited {jr.returncode}: {jr.stderr[:500]}")
+    if jr.returncode == 0:
+        ja = json.loads(jr.stdout)
+        js = ja.get("journeys", [])
+        check(len(js) == 1, f"journey {jny} rendered {len(js)} times")
+        attempts = js[0]["attempts"] if js else []
+        check(len(attempts) >= 2,
+              f"failover journey has {len(attempts)} attempts, want >= 2")
+        if len(attempts) >= 2:
+            a1, a2 = attempts[0], attempts[-1]
+            check(a1["outcome"] == "replica_timeout"
+                  and str(proc_v.pid) in str(a1["replica"]),
+                  f"attempt 1 should be replica_timeout on the victim: {a1}")
+            check(a2["outcome"] == "served" and a2["traces"] == 0
+                  and str(proc_v.pid) not in str(a2["replica"]),
+                  f"attempt 2 should be served warm on a survivor: {a2}")
+            check(js[0]["failovers"] >= 1,
+                  f"no failover derived in the merged timeline: {js[0]}")
+
+    # -- phase 4d: the fleet roster still explains the dead victim -----------
+    fr = subprocess.run(
+        [sys.executable, str(repo / "tools" / "profiler.py"), "fleet",
+         str(dirs["fleet"]), "--json"],
+        capture_output=True, text=True)
+    check(fr.returncode == 0,
+          f"profiler fleet exited {fr.returncode}: {fr.stderr[:500]}")
+    if fr.returncode == 0:
+        roster = json.loads(fr.stdout)
+        dead = [r for r in roster["replicas"]
+                if r["status"] == "departed" and r.get("pid") == proc_v.pid]
+        check(dead, f"victim pid {proc_v.pid} missing from the departed "
+                    f"roster: {[r.get('replica') for r in roster['replicas']]}")
+        if dead:
+            check(dead[0].get("health", {}).get("active_queries") is not None,
+                  f"victim tombstone lost its last-known health: {dead[0]}")
+            check(dead[0].get("blackbox") == str(bb_path),
+                  f"victim tombstone lost its blackbox path: {dead[0]}")
+        check(roster["live"] >= 2, f"live survivors missing from the "
+                                   f"roster: {roster['live']}")
 
     # -- phase 5: survivor health after the chaos -----------------------------
     rows = cli_b.submit(SQL_QUERIES["q1"]).to_pylist()

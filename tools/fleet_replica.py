@@ -19,6 +19,7 @@ Usage:
   python tools/fleet_replica.py --fleet-dir D --synthetic 200 \
       [--port 0] [--stage-cache-dir D] [--history-dir D] [--eventlog-dir D]
       [--lease-timeout 3] [--heartbeat 0.5] [--request-timeout 0]
+      [--slo-target 0]
       [--max-concurrent 4] [--result-cache] [--faults SPEC [--faults-seed N]]
 """
 
@@ -45,6 +46,9 @@ def main(argv=None) -> int:
     p.add_argument("--lease-timeout", type=float, default=3.0)
     p.add_argument("--heartbeat", type=float, default=0.5)
     p.add_argument("--request-timeout", type=float, default=0.0)
+    p.add_argument("--slo-target", type=float, default=0.0,
+                   help="endpoint.slo.latencyTargetSeconds: latency SLO "
+                        "accounted per served query (0 disables)")
     p.add_argument("--max-concurrent", type=int, default=4)
     p.add_argument("--result-cache", action="store_true")
     p.add_argument("--faults", default=None,
@@ -72,6 +76,8 @@ def main(argv=None) -> int:
         "spark.rapids.tpu.fleet.heartbeat.intervalSeconds": args.heartbeat,
         "spark.rapids.tpu.endpoint.requestTimeoutSeconds":
             args.request_timeout,
+        "spark.rapids.tpu.endpoint.slo.latencyTargetSeconds":
+            args.slo_target,
         "spark.rapids.tpu.endpoint.drain.graceSeconds": args.drain_grace,
     }
     if args.stage_cache_dir:

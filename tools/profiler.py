@@ -40,17 +40,36 @@ byte matrix, a top-flows table per (edge, link), the loopback-vs-remote
 split of network-capable bytes, and per-query movement amplification
 (bytes moved per result byte, from query.end's movement section).
 
+``journey`` merges the ``query.journey`` records of ANY number of replica
+event logs into cross-replica query timelines: one submission = one
+journey id (stamped by EndpointClient, stable across submit_with_retry
+failover), each replica that saw an attempt contributes one terminal
+record, and the merged view orders attempts and derives the failover
+transitions — ``submitted -> replica_timeout@A -> served@B`` — with
+per-attempt latency, retrace counts and SLO breach totals.
+
+``fleet`` reads a fleet membership directory (runtime/fleet.py): live
+``replica-*.json`` lease records with the health summary each heartbeat
+embeds (active queries, HBM watermark, cache hit rates, resilience
+counters, SLO accounting), plus ``departed-*.json`` tombstones — a dead
+replica's FINAL record, so the roster still explains what it was doing
+when it died, including its black-box flight-recorder dump path.
+
 Usage:
   python tools/profiler.py report <eventlog.jsonl> [--json] [--top N]
   python tools/profiler.py report <eventlog.jsonl> --compare <other.jsonl>
   python tools/profiler.py trace <logdir> [--query TRACE] [--out trace.json]
   python tools/profiler.py memory <eventlog.jsonl> [--diff <other.jsonl>]
   python tools/profiler.py movement <eventlog.jsonl> [more.jsonl ...]
+  python tools/profiler.py journey <eventlog.jsonl> [more.jsonl ...]
+  python tools/profiler.py fleet <fleet.dir> [--json]
 
 Exit status is non-zero on schema violations, when no query in the log
 carries a non-empty operator breakdown (report), on malformed span files
-/ an empty merged trace (trace), or when the log carries no memory-plane
-events at all (memory) — CI uses these as gates.
+/ an empty merged trace (trace), when the log carries no memory-plane
+events at all (memory), when no ``query.journey`` record exists in any
+log passed (journey), or when the fleet directory holds no membership
+record or tombstone (fleet) — CI uses these as gates.
 """
 
 from __future__ import annotations
@@ -60,6 +79,7 @@ import json
 import os
 import pathlib
 import sys
+import time
 
 
 def _eventlog_module():
@@ -1248,6 +1268,241 @@ def stats_main(args) -> int:
 
 
 # ---------------------------------------------------------------------------
+# fleet observability: cross-replica query journeys + fleet roster
+# ---------------------------------------------------------------------------
+
+_JOURNEY_OK = ("served", "cached")
+
+
+def analyze_journeys(records: list) -> dict:
+    """Group query.journey records — merged from any number of replica
+    logs — into per-journey attempt timelines. Attempts are ordered by
+    (attempt, wall-clock ts); a failover is DERIVED, not recorded: a
+    non-success attempt followed by an attempt on a different replica."""
+    journeys: dict = {}
+    breaches = 0
+    for rec in records:
+        ev = rec.get("event")
+        if ev == "slo.breach":
+            breaches += 1
+            continue
+        if ev != "query.journey" or not rec.get("journey"):
+            continue
+        journeys.setdefault(rec["journey"], []).append(rec)
+    out = []
+    for jid, recs in journeys.items():
+        recs.sort(key=lambda r: (r.get("attempt") or 0, r.get("ts") or 0.0))
+        attempts, prev, failovers = [], None, 0
+        for r in recs:
+            a = {"attempt": r.get("attempt"), "replica": r.get("replica"),
+                 "outcome": r.get("outcome"), "wall_s": r.get("wall_s"),
+                 "traces": r.get("traces"), "query": r.get("query"),
+                 "ts": r.get("ts"), "failover_from": None}
+            for k in ("error", "reason", "stuck"):
+                if r.get(k) is not None:
+                    a[k] = r[k]
+            if (prev is not None and prev["outcome"] not in _JOURNEY_OK
+                    and a["replica"] != prev["replica"]):
+                a["failover_from"] = prev["replica"]
+                failovers += 1
+            attempts.append(a)
+            prev = a
+        ts = [a["ts"] for a in attempts if a["ts"] is not None]
+        out.append({
+            "journey": jid,
+            "attempts": attempts,
+            "failovers": failovers,
+            "outcome": attempts[-1]["outcome"],
+            "replicas": sorted({a["replica"] for a in attempts
+                                if a["replica"]}),
+            "span_s": round(max(ts) - min(ts), 4) if ts else None,
+        })
+    out.sort(key=lambda j: min((a["ts"] or 0.0) for a in j["attempts"]))
+    total = len(out)
+    return {
+        "journeys": out,
+        "total": total,
+        "served": sum(1 for j in out if j["outcome"] in _JOURNEY_OK),
+        "failovers": sum(j["failovers"] for j in out),
+        "slo_breaches": breaches,
+    }
+
+
+def render_journeys(analysis: dict) -> str:
+    L = [f"{analysis['total']} journeys, {analysis['served']} served, "
+         f"{analysis['failovers']} failovers, "
+         f"{analysis['slo_breaches']} SLO breaches", ""]
+    for j in analysis["journeys"]:
+        span = f", span {j['span_s']}s" if j["span_s"] is not None else ""
+        L.append(f"== journey {j['journey']} — "
+                 f"{len(j['attempts'])} attempt(s), "
+                 f"{j['failovers']} failover(s), "
+                 f"outcome {j['outcome']}{span} ==")
+        for a in j["attempts"]:
+            parts = [f"  attempt {a['attempt']}",
+                     f"replica {a['replica']}",
+                     f"outcome {a['outcome']}"]
+            if a["wall_s"] is not None:
+                parts.append(f"wall_s {a['wall_s']}")
+            if a["traces"] is not None:
+                parts.append(f"traces {a['traces']}")
+            if a["query"]:
+                parts.append(f"query {a['query']}")
+            if a.get("error"):
+                parts.append(f"error {a['error']}")
+            if a.get("reason"):
+                parts.append(f"reason {a['reason']}")
+            if a.get("stuck"):
+                parts.append("stuck")
+            line = "  ".join(parts)
+            if a["failover_from"]:
+                line += f"   <- failover from {a['failover_from']}"
+            L.append(line)
+        L.append("")
+    return "\n".join(L)
+
+
+def journey_main(args) -> int:
+    records, violations = [], []
+    for path in args.eventlog:
+        recs, vio = load_log(path)
+        records.extend(recs)
+        violations.extend(vio)
+    rc = 0
+    if violations:
+        for v in violations:
+            print(f"SCHEMA VIOLATION: {v}", file=sys.stderr)
+        rc = 1
+    analysis = analyze_journeys(records)
+    if args.journey:
+        analysis["journeys"] = [j for j in analysis["journeys"]
+                                if j["journey"] == args.journey]
+        if not analysis["journeys"]:
+            print(f"ERROR: journey {args.journey} not found",
+                  file=sys.stderr)
+            rc = 1
+    if not analysis["journeys"] and not args.journey:
+        print("ERROR: no query.journey records in "
+              + ", ".join(args.eventlog), file=sys.stderr)
+        rc = 1
+    if args.json:
+        analysis["violations"] = violations
+        print(json.dumps(analysis, indent=2, default=str))
+    else:
+        print(render_journeys(analysis))
+    return rc
+
+
+def analyze_fleet(fleet_dir: str) -> dict:
+    """Read a fleet membership directory into the fleet roster: live
+    replica-*.json lease records (liveness judged against each record's
+    own embedded lease_timeout_s vs the file mtime — the lease stamp) and
+    departed-*.json tombstones carrying a dead replica's final state."""
+    now = time.time()
+    replicas = []
+    try:
+        names = sorted(os.listdir(fleet_dir))
+    except OSError as e:
+        raise SystemExit(f"ERROR: cannot read fleet dir {fleet_dir}: {e}")
+    for n in names:
+        if not n.endswith(".json"):
+            continue
+        live = n.startswith("replica-")
+        if not live and not n.startswith("departed-"):
+            continue
+        p = os.path.join(fleet_dir, n)
+        try:
+            mtime = os.stat(p).st_mtime
+            with open(p, encoding="utf-8") as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue   # swept / torn mid-read by a live fleet
+        age = now - mtime
+        timeout = rec.get("lease_timeout_s")
+        if live:
+            expired = (isinstance(timeout, (int, float))
+                       and age > float(timeout))
+            status = "expired" if expired else "live"
+        else:
+            status = "departed"
+        replicas.append({**rec, "status": status, "age_s": round(age, 1)})
+    order = {"live": 0, "expired": 1, "departed": 2}
+    replicas.sort(key=lambda r: (order[r["status"]],
+                                 str(r.get("replica"))))
+    return {
+        "dir": fleet_dir,
+        "replicas": replicas,
+        "live": sum(1 for r in replicas if r["status"] == "live"),
+        "expired": sum(1 for r in replicas if r["status"] == "expired"),
+        "departed": sum(1 for r in replicas if r["status"] == "departed"),
+    }
+
+
+def render_fleet(analysis: dict) -> str:
+    L = [f"== fleet roster {analysis['dir']} — {analysis['live']} live, "
+         f"{analysis['expired']} expired, "
+         f"{analysis['departed']} departed ==", ""]
+    slo_rows = []
+    for r in analysis["replicas"]:
+        h = r.get("health") or {}
+        L.append(f"replica {r.get('replica')}  [{r['status']}]  "
+                 f"pid {r.get('pid')}  age {r['age_s']}s")
+        if r["status"] == "departed":
+            by = r.get("adopted_by") or "?"
+            L.append(f"  adopted by {by}")
+        cells = [f"active_queries {h.get('active_queries', '-')}"]
+        if h.get("hbm_watermark_bytes"):
+            cells.append(
+                f"hbm_watermark {_fmt_bytes(h['hbm_watermark_bytes'])}")
+        rc_ = h.get("result_cache")
+        if rc_:
+            cells.append(f"result_cache {rc_.get('hits', 0)}h/"
+                         f"{rc_.get('misses', 0)}m")
+        fuse = h.get("fuse") or {}
+        if fuse:
+            cells.append(f"fuse traces {fuse.get('traces', 0)} "
+                         f"dispatches {fuse.get('dispatches', 0)}")
+        L.append("  last health: " + "  ".join(cells)
+                 if h else "  last health: (none recorded)")
+        res = h.get("resilience") or {}
+        if res:
+            L.append("  resilience: " + "  ".join(
+                f"{k}={v}" for k, v in sorted(res.items())))
+        if r.get("blackbox"):
+            L.append(f"  blackbox: {r['blackbox']}")
+        slo = h.get("slo")
+        if slo:
+            slo_rows.append((r.get("replica"), slo))
+        L.append("")
+    if slo_rows:
+        L.append("== SLO ==")
+        L.append(f"{'replica':40s} {'target_s':>9s} {'served':>7s} "
+                 f"{'breaches':>9s} {'avail':>7s}")
+        for rid, slo in slo_rows:
+            avail = slo.get("availability")
+            L.append(f"{str(rid):40s} {slo.get('target_s', 0):>9} "
+                     f"{slo.get('served', 0):>7} "
+                     f"{slo.get('breaches', 0):>9} "
+                     f"{('-' if avail is None else f'{avail:.4f}'):>7}")
+        L.append("")
+    return "\n".join(L)
+
+
+def fleet_main(args) -> int:
+    analysis = analyze_fleet(args.fleetdir)
+    rc = 0
+    if not analysis["replicas"]:
+        print(f"ERROR: no membership records or tombstones in "
+              f"{args.fleetdir}", file=sys.stderr)
+        rc = 1
+    if args.json:
+        print(json.dumps(analysis, indent=2, default=str))
+    else:
+        print(render_fleet(analysis))
+    return rc
+
+
+# ---------------------------------------------------------------------------
 # CLI
 # ---------------------------------------------------------------------------
 
@@ -1305,6 +1560,24 @@ def main(argv=None) -> int:
                     help="machine-readable analysis instead of text")
     mv.add_argument("--top", type=int, default=15,
                     help="flow rows in the top-flows table")
+    jn = sub.add_parser(
+        "journey", help="cross-replica query journeys: merge replica event "
+                        "logs into per-submission failover timelines")
+    jn.add_argument("eventlog", nargs="+",
+                    help="one or more replica event logs (pass every "
+                         "replica's events-*.jsonl to merge the fleet)")
+    jn.add_argument("--journey", default=None,
+                    help="render only this journey id")
+    jn.add_argument("--json", action="store_true",
+                    help="machine-readable analysis instead of text")
+    fl = sub.add_parser(
+        "fleet", help="fleet roster: live lease records with embedded "
+                      "health, departed tombstones, SLO breach table")
+    fl.add_argument("fleetdir",
+                    help="fleet membership directory "
+                         "(spark.rapids.tpu.fleet.dir)")
+    fl.add_argument("--json", action="store_true",
+                    help="machine-readable analysis instead of text")
     args = p.parse_args(argv)
 
     if args.cmd == "trace":
@@ -1315,6 +1588,10 @@ def main(argv=None) -> int:
         return stats_main(args)
     if args.cmd == "movement":
         return movement_main(args)
+    if args.cmd == "journey":
+        return journey_main(args)
+    if args.cmd == "fleet":
+        return fleet_main(args)
 
     records, violations = load_log(args.eventlog)
     analysis = analyze(records)

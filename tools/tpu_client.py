@@ -24,9 +24,17 @@ replicas.
 ``stats`` (or --stats) fetches the endpoint's live serving-metrics snapshot
 — a Prometheus-style text exposition of admission/shed/cancel/deadline
 counters, the resilience registry, HBM/spill/queue gauges and per-priority
-latency histograms — without submitting a query.
+latency histograms — without submitting a query. With ``--addresses`` it
+fans out across the WHOLE replica list (one section per replica), never
+just the first reachable one.
+
+``fleet-stats`` merges every replica's snapshot into the fleet rollup:
+per-replica sections plus the fleet-aggregate counter families, where
+every aggregate counter equals the sum of the per-replica values.
 
 Exit codes: 0 ok, 2 rejected/unreachable after all retries, 3 query error.
+For stats/fleet-stats, 2 means NO replica was reachable — partial fleets
+still report with the dead replicas marked UNREACHABLE.
 """
 
 from __future__ import annotations
@@ -38,8 +46,10 @@ import sys
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tpu_client.py", description=__doc__)
-    p.add_argument("command", nargs="?", choices=["stats"],
-                   help="'stats' fetches the live serving-metrics snapshot")
+    p.add_argument("command", nargs="?", choices=["stats", "fleet-stats"],
+                   help="'stats' fetches every replica's live "
+                        "serving-metrics snapshot; 'fleet-stats' merges "
+                        "them with fleet-aggregate counter families")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int)
     p.add_argument("--addresses", default=None,
@@ -72,15 +82,17 @@ def main(argv=None) -> int:
     if not args.addresses and args.port is None:
         p.error("one of --port / --addresses is required")
     stats_mode = args.stats or args.command == "stats"
+    fleet_stats_mode = args.command == "fleet-stats"
     sql = args.sql
     if sql is None and args.sql_file:
         sql = (sys.stdin.read() if args.sql_file == "-"
                else pathlib.Path(args.sql_file).read_text())
-    if not sql and not stats_mode:
-        p.error("one of --sql / --sql-file / stats is required")
+    if not sql and not stats_mode and not fleet_stats_mode:
+        p.error("one of --sql / --sql-file / stats / fleet-stats is required")
 
     sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
-    from spark_rapids_tpu.runtime.endpoint import EndpointClient
+    from spark_rapids_tpu.runtime.endpoint import (EndpointClient,
+                                                   render_fleet_stats)
     from spark_rapids_tpu.runtime.scheduler import (QueryCancelledError,
                                                     QueryRejectedError)
     from spark_rapids_tpu.shuffle.transport import TransportError
@@ -88,15 +100,28 @@ def main(argv=None) -> int:
     address = args.addresses or (args.host, args.port)
     cli = EndpointClient(address, timeout_s=args.timeout)
 
+    if fleet_stats_mode:
+        fs = cli.fleet_stats()
+        print(render_fleet_stats(fs), end="")
+        return 0 if fs["live"] else 2
+
     if stats_mode:
-        try:
-            print(cli.stats(), end="")
-        except TransportError as e:
-            print(f"{type(e).__name__}: {e}", file=sys.stderr)
+        # fan out across the WHOLE replica list: one replica's death (or the
+        # client happening to target it) must not hide the others' metrics
+        reachable, failed = 0, []
+        for addr, text in cli.stats_all().items():
+            if len(cli.addresses) > 1:
+                print(f"== replica {addr} ==")
+            if isinstance(text, BaseException):
+                failed.append((addr, text))
+                print(f"UNREACHABLE {type(text).__name__}: {text}")
+            else:
+                print(text, end="")
+                reachable += 1
+        if not reachable:
+            for addr, e in failed:
+                print(f"{addr}: {type(e).__name__}: {e}", file=sys.stderr)
             return 2
-        except Exception as e:   # noqa: BLE001 — typed server error
-            print(f"{type(e).__name__}: {e}", file=sys.stderr)
-            return 3
         return 0
 
     def on_retry(attempt, delay):
