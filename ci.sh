@@ -472,6 +472,37 @@ rm -rf "$mesh_dir"
 # ladder, and spawn-handshake retry
 JAX_PLATFORMS=cpu python -m pytest tests/test_mesh_cluster.py -q -m 'not slow'
 
+echo "== two-level exchange: intra-mesh content over ICI (movement gate) =="
+# q18 twice on a 2-executor x 4-chip mesh cluster (child processes, so the
+# cumulative per-process ledgers stay separable): twoLevel=off vs on must
+# show >=2x fewer loopback/TCP shuffle payload bytes with the savings
+# appearing on the ici.collective edge, and bit-identical result digests
+tl_dir=$(mktemp -d)
+JAX_PLATFORMS=cpu python tools/movement_gate.py \
+  --data-dir /tmp/tpch_ci_sf0.01 --eventlog-dir "$tl_dir" --query q18 \
+  --executors 2 --two-level-compare
+# the profiler read-out separates the two exchange levels at a glance
+python tools/profiler.py movement "$tl_dir"/twolevel-on/events-*.jsonl \
+  > /tmp/tl_readout.txt
+grep -q "exchange levels:" /tmp/tl_readout.txt
+grep -q "intra-mesh(ici)=" /tmp/tl_readout.txt
+rm -rf "$tl_dir"
+
+echo "== sf1 q18 out-of-core completion smoke (>=2 executors) =="
+# the scale-out proof: q18 at sf1 completes on 2 executors with BOTH
+# memory tiers shrunk below the working set (device -> host -> disk
+# spill asserted from the ledger), two-level exchange on; auto-skip
+# (logged) on a 1-core box, per the gate's >=2-executor contract
+if [ "$(nproc)" -ge 2 ]; then
+  ooc_dir=$(mktemp -d)
+  JAX_PLATFORMS=cpu python tools/movement_gate.py \
+    --data-dir /tmp/tpch_ci_sf1 --eventlog-dir "$ooc_dir" --query q18 \
+    --executors 2 --ooc-smoke --scale 1.0 --ooc-limit 256m
+  rm -rf "$ooc_dir"
+else
+  echo "SKIP: sf1 out-of-core smoke needs >=2 cores, have $(nproc)"
+fi
+
 echo "== multi-tenant: concurrent chaos (cancel + OOM + shed isolation) =="
 # 4 concurrent TPC-H queries: one killed by its deadline, one recovering
 # injected join-build OOMs, two survivors bit-identical to solo runs with

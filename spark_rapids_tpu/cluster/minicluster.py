@@ -139,6 +139,12 @@ def _executor_main(conn, executor_index: int, platform: str,
     MV.configure(
         sample_interval_bytes=conf.get(CFG.MOVEMENT_SAMPLE_INTERVAL),
         enabled=conf.get(CFG.MOVEMENT_ENABLED))
+    # device + memory bring-up with the CLUSTER conf (the plugin.py:82
+    # executor-side analog): without this the lazily-built DeviceManager
+    # uses a default conf and out-of-core budgets (hbm.limitBytes,
+    # spillStorageSize) silently do not apply on executors
+    from spark_rapids_tpu.runtime.memory import DeviceManager
+    DeviceManager.initialize(conf)
     store = ShuffleBlockStore.get()
     transport = TcpTransport(conf)
     # the reduce side short-circuits fetches addressed to THIS executor's
@@ -164,14 +170,27 @@ def _executor_main(conn, executor_index: int, platform: str,
         in one task; per partition wave, every lane's current batch gets
         its Spark-exact partition ids from ONE jitted shard_map dispatch on
         the local mesh, with the wave's per-partition row counts psum-ed
-        over ICI (distributed/mesh.LocalMesh). Blocks are sliced with the
-        exact per-batch path and parked under the same (map_split, seq)
-        keys as the TCP-only plane — bit-identical by construction, so the
-        driver can transparently re-plan a failed mesh task per-split.
-        Any failure of the mesh itself (bring-up, shrink, collective)
-        surfaces as MeshDegradedError → the driver's degraded fallback;
-        failures INSIDE a lane's subtree execution stay ordinary task
-        failures and ride the attempt ladder."""
+        over ICI (distributed/mesh.LocalMesh).
+
+        TWO-LEVEL EXCHANGE (docs/cluster.md): when the driver shipped a
+        `reduce_owned` set (the reduce partitions whose consumers will be
+        placed on THIS executor) and the wave schema is fixed-width, the
+        owned partitions' content moves lane→lane as `lax.all_to_all` over
+        ICI (LocalMesh.exchange_wave) and the receiving lane writes the
+        shards straight into the process-local block store under the SAME
+        (map_split, seq) keys the per-batch path would use — so
+        iter_union_blocks' canonical-key merge keeps bit-identity with the
+        TCP plane by construction, and only cross-host partitions are
+        sliced with the exact per-batch path and parked for the TCP fetch.
+        String-keyed waves (counts is None) and variable-width schemas
+        fall back to slice-and-park for every partition WITHOUT breaking
+        the mesh group. Any failure of the mesh itself (bring-up, shrink,
+        collective, exchange) surfaces as MeshDegradedError → the driver's
+        degraded fallback; failures INSIDE a lane's subtree execution stay
+        ordinary task failures and ride the attempt ladder."""
+        import numpy as np
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+        from spark_rapids_tpu.columnar.vector import TpuColumnVector
         from spark_rapids_tpu.distributed.mesh import (LocalMesh,
                                                        MeshDegradedError)
         from spark_rapids_tpu.shuffle.partitioning import (
@@ -180,6 +199,8 @@ def _executor_main(conn, executor_index: int, platform: str,
         lanes = task["mesh_lanes"]
         sid = task["shuffle_id"]
         part = task["partitioner"].bind(plan.output)
+        owned = sorted(task.get("reduce_owned") or ())
+        two_level = bool(owned) and LocalMesh.exchangeable_schema(plan.output)
         store.ensure_shuffle(sid)
         tracing.set_process_trace(task.get("trace"))
         try:
@@ -197,7 +218,7 @@ def _executor_main(conn, executor_index: int, platform: str,
             raise
         except Exception as e:
             raise MeshDegradedError(f"mesh bring-up failed: {e!r}") from e
-        waves = rows_exchanged = 0
+        waves = rows_exchanged = ici_rows = 0
         with tracing.span("task.mesh_map", shuffle=sid,
                           lanes=len(lanes)), TaskContext():
             iters, seqs = [], []
@@ -234,21 +255,73 @@ def _executor_main(conn, executor_index: int, platform: str,
                 waves += 1
                 if counts is not None:
                     rows_exchanged += int(counts.sum())
+                # level 1: owned partitions' content rides ICI — routed
+                # round-robin over the wave's live lanes; the dest lane
+                # choice only balances ICI traffic (the block store is
+                # process-local, so any lane's write serves the consumer
+                # placed on this executor)
+                dm = None
+                if two_level and counts is not None:
+                    dm = np.full((part.num_partitions,), -1, np.int32)
+                    for i, rid in enumerate(owned):
+                        dm[rid] = i % len(wave)
+                    try:
+                        F.maybe_inject_any("cluster.mesh.exchange")
+                        F.maybe_inject_any(
+                            f"cluster.mesh.exchange.{executor_index}")
+                        rvals, rmasks, rpids, rcounts = lm.exchange_wave(
+                            [b for _, b in wave], pids_list, dm,
+                            part.num_partitions)
+                    except MeshDegradedError:
+                        raise
+                    except Exception as e:
+                        raise MeshDegradedError(
+                            f"mesh exchange failed: {e!r}") from e
+                # level 2: cross-host (and fallback) partitions slice with
+                # the exact per-batch path and park for the TCP fetch
                 for (li, b), pids in zip(wave, pids_list):
                     seqs[li] += 1
                     for pid, piece in slice_into_partitions(
                             b, pids, part.num_partitions):
+                        if dm is not None and dm[pid] >= 0:
+                            continue  # rode ICI in this wave
                         if piece.num_rows:
                             store.write_block(
                                 sid, pid, piece,
                                 seq=(lanes[li]["split"], seqs[li]))
+                if dm is not None:
+                    # receiving lanes park the ICI shards under the SOURCE
+                    # lane's (map_split, seq) key — identical to what the
+                    # per-batch path would have written for that wave
+                    for d in range(len(wave)):
+                        for s in range(len(wave)):
+                            if int(rcounts[d][s]) == 0:
+                                continue
+                            src_schema = wave[s][1].schema or plan.output
+                            cols = [TpuColumnVector(
+                                        f.data_type, rvals[c][d][s],
+                                        rmasks[c][d][s])
+                                    for c, f in enumerate(src_schema)]
+                            mini = ColumnarBatch(cols, int(rcounts[d][s]),
+                                                 src_schema)
+                            src_li = wave[s][0]
+                            for pid, piece in slice_into_partitions(
+                                    mini, rpids[d][s],
+                                    part.num_partitions):
+                                if piece.num_rows:
+                                    store.write_block(
+                                        sid, pid, piece,
+                                        seq=(lanes[src_li]["split"],
+                                             seqs[src_li]))
+                                    ici_rows += piece.num_rows
         return {"sizes": store.partition_sizes(sid, part.num_partitions),
                 "split_sizes": {
                     lane["split"]: store.split_partition_sizes(
                         sid, part.num_partitions, lane["split"])
                     for lane in lanes},
                 "mesh": {"waves": waves, "lanes": len(lanes),
-                         "rows_exchanged": rows_exchanged}}
+                         "rows_exchanged": rows_exchanged,
+                         "ici_rows": ici_rows}}
 
     def run_map(task):
         if task.get("mesh_lanes") is not None:
@@ -442,7 +515,7 @@ class PlacementPolicy:
 
 class _ShuffleState:
     __slots__ = ("shuffle_id", "subtree", "partitioner", "mode", "splits",
-                 "hosts", "epoch", "recomputes", "split_sizes")
+                 "hosts", "epoch", "recomputes", "split_sizes", "owners")
 
     def __init__(self, shuffle_id, subtree, partitioner, mode, splits):
         self.shuffle_id = shuffle_id
@@ -454,6 +527,11 @@ class _ShuffleState:
         self.epoch = 0                  # bumped on every invalidation
         self.recomputes = 0             # partial recomputes consumed
         self.split_sizes = {}           # map_split -> [bytes per reduce id]
+        # two-level exchange: reduce id -> owning executor (None = shuffle
+        # runs single-level). Owned partitions' content rides ICI inside
+        # the owner's mesh tasks and the partition's consumer is placed at
+        # the owner, so those bytes are read via the local short-circuit
+        self.owners = None
 
 
 class MapOutputTracker:
@@ -637,6 +715,7 @@ class MiniCluster:
         # attached mesh width from the spawn handshake, and whether the
         # slot's mesh is still trusted for mesh task groups
         self._mesh_enabled = self.conf.get(CFG.CLUSTER_MESH_ENABLED)
+        self._two_level = self.conf.get(CFG.CLUSTER_MESH_TWO_LEVEL)
         self._mesh = [0] * n_executors
         self._mesh_ok = [False] * n_executors
         self._movement_aware = self.conf.get(
@@ -644,7 +723,8 @@ class MiniCluster:
         self._max_loaded_bytes = self.conf.get(
             CFG.CLUSTER_PLACEMENT_MAX_LOADED_BYTES)
         self._spawn_retries = self.conf.get(CFG.CLUSTER_SPAWN_MAX_RETRIES)
-        self.mesh_stats = {"mesh_tasks": 0, "waves": 0, "degraded": 0}
+        self.mesh_stats = {"mesh_tasks": 0, "waves": 0, "degraded": 0,
+                           "ici_rows": 0}
         self.placement_stats = {"preferred": 0, "demoted": 0}
         for ei in range(n_executors):
             self._spawn_executor(ei)
@@ -893,19 +973,27 @@ class MiniCluster:
                          shuffle_id=st.shuffle_id,
                          partitioner=st.partitioner)
 
-    def _build_task(self, spec: _TaskSpec) -> dict:
+    def _build_task(self, spec: _TaskSpec, ei: int | None = None) -> dict:
         from spark_rapids_tpu.runtime import tracing
         if spec.lanes is not None:
             # mesh map task: ship the UNPINNED subtree once; the executor
             # pins a clone per lane (one lane per local mesh device)
             plan = _clone_plan(spec.subtree)
             self._stamp_epochs(plan)
-            return {"plan": plan, "splits": [],
+            task = {"plan": plan, "splits": [],
                     "mesh_lanes": [{"split": s, "pin": p}
                                    for s, p in spec.lanes],
                     "shuffle_id": spec.shuffle_id,
                     "partitioner": spec.partitioner,
                     "trace": tracing.current_trace_id()}
+            st = self._tracker.state(spec.shuffle_id)
+            if ei is not None and st is not None and st.owners is not None:
+                # two-level exchange: the reduce partitions THIS executor
+                # owns ride ICI inside the task's waves; the rest slice
+                # and park for the TCP fetch
+                task["reduce_owned"] = [r for r, o in enumerate(st.owners)
+                                        if o == ei]
+            return task
         if spec.pin is not None:
             plan = _pin_sources(_clone_plan(spec.subtree), spec.pin)
             splits = [0]
@@ -1028,6 +1116,35 @@ class MiniCluster:
         self.placement_stats["preferred"] += 1
         return best
 
+    def _owner_executor(self, spec: _TaskSpec, eligible):
+        """Two-level placement: the executor OWNING the task's reduce
+        partition(s) under the upstream shuffles' ownership assignment —
+        the host whose mesh tasks already routed those partitions' content
+        over ICI into its local store. Mesh consumer groups vote with
+        every lane's pin; ties and unowned shuffles return None (fall back
+        to byte-based preference / round-robin)."""
+        pins = ([p for _, p in spec.lanes if p is not None]
+                if spec.lanes is not None
+                else [spec.pin] if spec.pin is not None else [])
+        if not pins:
+            return None
+        votes: dict = {}
+        for sid in spec.read_sids:
+            st = self._tracker.state(sid)
+            if st is None or st.owners is None:
+                continue
+            for p in pins:
+                if 0 <= p < len(st.owners):
+                    votes[st.owners[p]] = votes.get(st.owners[p], 0) + 1
+        if not votes:
+            return None
+        best = max(sorted(votes), key=lambda e: votes[e])
+        if best not in eligible or best in spec.tried:
+            return None
+        self.placement_stats["owner"] = \
+            self.placement_stats.get("owner", 0) + 1
+        return best
+
     # -- the scheduler loop -------------------------------------------------
     def _run_tasks(self, specs: list, busy=frozenset(), depth: int = 0
                    ) -> dict:
@@ -1070,14 +1187,20 @@ class MiniCluster:
                 if not capable:
                     return "degrade"
                 eligible &= capable
+                # two-level: a consumer mesh group prefers the executor
+                # owning its lanes' reduce partitions — the owned bytes
+                # are already in that executor's local store
+                if self._movement_aware and spec.read_sids:
+                    preferred = self._owner_executor(spec, eligible)
             elif (self._movement_aware and spec.pin is not None
                     and spec.read_sids):
-                preferred = self._preferred_executor(spec, eligible)
+                preferred = (self._owner_executor(spec, eligible)
+                             or self._preferred_executor(spec, eligible))
             ei = self._placement.pick(eligible, prefer_not=spec.tried,
                                       preferred=preferred)
             if ei is None:
                 return None
-            task = self._build_task(spec)
+            task = self._build_task(spec, ei)
             epochs = self._tracker.epochs(spec.read_sids)
             try:
                 self._conns[ei].send(
@@ -1178,6 +1301,7 @@ class MiniCluster:
                     mesh = reply.get("mesh") or {}
                     self.mesh_stats["mesh_tasks"] += 1
                     self.mesh_stats["waves"] += mesh.get("waves", 0)
+                    self.mesh_stats["ici_rows"] += mesh.get("ici_rows", 0)
             if run.speculative:
                 M.resilience_add(M.SPECULATION_WON)
                 tracing.span_event("speculation.won", executor=ei,
@@ -1364,6 +1488,22 @@ class MiniCluster:
         sid = next(self._shuffle_ids)
         mode, splits = self._stage_shape(child)
         st = self._tracker.register_shuffle(sid, child, part, mode, splits)
+        # two-level exchange: assign every reduce partition an OWNING
+        # executor up front (round-robin over placeable executors, so the
+        # assignment is deterministic and balanced). Map tasks route owned
+        # partitions' content over ICI; consumer placement below routes the
+        # partition's reader to the owner, turning those bytes into local
+        # short-circuit reads instead of loopback/TCP fetches
+        if (self._two_level and self._mesh_group_width() >= 2
+                and len(splits) >= 2
+                and isinstance(part, SP.HashPartitioner)):
+            placeable = [ei for ei in range(self.n_executors)
+                         if ei not in self._blacklist
+                         and self._procs[ei] is not None
+                         and self._procs[ei].is_alive()]
+            if placeable:
+                st.owners = [placeable[r % len(placeable)]
+                             for r in range(part.num_partitions)]
         self._broadcast_ensure_shuffle(sid)
         self._run_tasks(self._make_stage_specs(st))
         # stats plane: per-reduce-partition byte totals from the tracker's
@@ -1411,9 +1551,25 @@ class MiniCluster:
                 or not isinstance(st.partitioner, SP.HashPartitioner)):
             return [self._make_map_spec(st, s, i)
                     for i, s in enumerate(st.splits)]
+        splits = st.splits
+        if st.mode == "pinned":
+            # two-level: order a consumer stage's reduce-id splits by the
+            # upstream ownership assignment, so each mesh group's lanes
+            # share ONE owner and the whole group can be placed there
+            owners = None
+            for src in _collect_sources(st.subtree, []):
+                up = self._tracker.state(src.shuffle_id)
+                if up is not None and up.owners is not None:
+                    owners = up.owners
+                    break
+            if owners is not None:
+                splits = sorted(splits,
+                                key=lambda s: (owners[s]
+                                               if 0 <= s < len(owners)
+                                               else -1, s))
         specs = []
-        for gi in range(0, len(st.splits), width):
-            group = st.splits[gi:gi + width]
+        for gi in range(0, len(splits), width):
+            group = splits[gi:gi + width]
             if len(group) == 1:
                 specs.append(self._make_map_spec(st, group[0],
                                                  idx=("m", gi)))

@@ -243,7 +243,16 @@ SHUFFLE_TRANSPORT_CLASS = conf("spark.rapids.tpu.shuffle.transport.class").doc(
 SHUFFLE_COMPRESSION_CODEC = conf("spark.rapids.tpu.shuffle.compression.codec").doc(
     "none | lz4 | copy — codec for shuffle buffers (reference "
     "spark.rapids.shuffle.compression.codec over nvcomp; here a native C++ LZ4)"
-).string_conf("none")
+).string_conf("lz4")
+
+SHUFFLE_COMPRESSION_TCP_ONLY = conf(
+    "spark.rapids.tpu.shuffle.compression.tcpOnly").doc(
+    "Compress shuffle frames only for peers whose link classifies as "
+    "genuinely tcp (cross-host): loopback/local/ici stay uncompressed — "
+    "spending CPU to shrink bytes that never cross a real wire loses. The "
+    "movement ledger's wire-vs-payload dual units make the ratio visible "
+    "per link class. false compresses every serialized transfer whenever "
+    "the codec is active").boolean_conf(True)
 
 SHUFFLE_MAX_INFLIGHT_BYTES = conf(
     "spark.rapids.tpu.shuffle.maxBytesInFlight").doc(
@@ -558,6 +567,21 @@ CLUSTER_MESH_ENABLED = conf("spark.rapids.tpu.cluster.mesh.enabled").doc(
     "reference's production shape). A mesh failure degrades transparently "
     "to per-split TCP execution, bit-identical (docs/cluster.md)"
 ).boolean_conf(False)
+
+CLUSTER_MESH_TWO_LEVEL = conf(
+    "spark.rapids.tpu.cluster.mesh.exchange.twoLevel").doc(
+    "Two-level shuffle exchange on the mesh-cluster plane: the driver "
+    "assigns every reduce partition an OWNING executor; inside that "
+    "executor's mesh tasks the owned partitions' content moves lane→lane "
+    "as lax.all_to_all over ICI (LocalMesh.exchange_wave) and lands "
+    "directly in the process-local block store, while only partitions "
+    "owned by OTHER hosts are sliced out and parked for the TCP fetch. "
+    "Consumers are placed at their partition's owner so the ICI-moved "
+    "bytes are read via the local short-circuit. Waves with string keys "
+    "or variable-width columns fall back to slice-and-park per batch "
+    "without breaking the mesh group; any exchange failure degrades the "
+    "task to per-split TCP under a bumped epoch, bit-identical "
+    "(docs/cluster.md)").boolean_conf(True)
 
 CLUSTER_MESH_DEVICES = conf(
     "spark.rapids.tpu.cluster.mesh.devicesPerExecutor").doc(

@@ -143,11 +143,22 @@ class LocalMesh:
     partition wave, the Spark-exact murmur3 partition ids of EVERY lane's
     current batch are computed in ONE jitted shard_map dispatch (lane =
     shard), and the wave's per-reduce-partition row counts are all-reduced
-    over ICI with `lax.psum` — the map-output-statistics exchange. Block
-    CONTENT never rides the mesh here: each lane's batch is sliced with the
-    exact per-batch path (shuffle.partitioning.slice_into_partitions) and
-    parked in the TCP block store under the same (map_split, seq) keys, so
-    mesh-plane blocks are bit-identical with the TCP-only plane — which is
+    over ICI with `lax.psum` — the map-output-statistics exchange.
+
+    TWO-LEVEL EXCHANGE (docs/cluster.md): block content for reduce
+    partitions OWNED by this host (the driver's ownership assignment, i.e.
+    the partitions whose consumer will be placed here) rides
+    `exchange_wave` — every fixed-width column moves lane→lane with ONE
+    `lax.all_to_all` per carrier over ICI, and the receiving lane writes
+    the shards into the local block store under the SAME (map_split, seq)
+    keys the per-batch path would have used, so `iter_union_blocks`'
+    canonical-key merge keeps bit-identity with the TCP plane by
+    construction. Only partitions owned by OTHER hosts are sliced with the
+    per-batch path (shuffle.partitioning.slice_into_partitions) and parked
+    for the TCP fetch. Waves whose schema carries variable-width columns
+    (strings, lists, maps, structs) fall back to slice-and-park for the
+    whole wave without breaking the mesh group, and any failure inside the
+    collective degrades the task to per-split TCP execution — which is
     what makes the transparent mesh→TCP degraded fallback sound."""
 
     _instance: "LocalMesh | None" = None
@@ -257,14 +268,173 @@ class LocalMesh:
         vals, masks, nrows = put_stacked_shards(self.mesh, shards)
         pids, counts = self._pid_step(dtypes, cap, n_out)(
             *vals, *masks, nrows)
+        counts = np.asarray(counts)
         # movement ledger, ICI edge: the program's only collective is the
-        # psum of per-partition live-row counts — estimated from the
-        # dispatch shape (every device contributes one n_out count vector)
+        # psum of per-partition live-row counts — metered as the ACTUAL
+        # per-lane operand bytes (every device contributes one n_out count
+        # vector of the psum operand's real dtype)
         from spark_rapids_tpu.runtime import movement as MV
-        MV.record("ici.collective", n_out * 4 * self.n, link="ici",
-                  site="mesh.partition_wave")
+        op_bytes = int(counts.dtype.itemsize) * n_out * self.n
+        MV.record("ici.collective", op_bytes, link="ici",
+                  site="mesh.partition_wave", payload_bytes=op_bytes)
         return ([pids[d][:b.capacity] for d, b in enumerate(batches)],
-                np.asarray(counts))
+                counts)
+
+    # -- two-level content exchange -----------------------------------------
+    @staticmethod
+    def exchangeable_schema(schema) -> bool:
+        """Whether a batch schema can ride the ICI content exchange: every
+        column must be fixed-width on device. Variable-width carriers
+        (strings with per-batch dictionaries, lists, maps, structs) fall
+        back to the per-batch slice-and-park path for the whole wave."""
+        return all(not isinstance(f.data_type,
+                                  (T.StringType, T.ArrayType, T.MapType,
+                                   T.StructDataType, T.NullType))
+                   for f in schema)
+
+    def _exchange_step(self, dtypes, cap: int, cap_ex: int, n_out: int):
+        """Jitted shard_map program keyed by (column dtypes, input
+        capacity, exchange-block capacity, fan-out): per lane, rows whose
+        reduce partition is owned by THIS host are compacted per
+        destination lane and every column carrier (values, validity, pid)
+        moves lane→lane with one `lax.all_to_all` over ICI. Returns the
+        received shards still stacked per (dest lane, source lane) with
+        the received pids sentinel-masked past each source's live count."""
+        key = ("exchange", tuple(type(dt).__name__ for dt in dtypes),
+               cap, cap_ex, n_out)
+        step = self._steps.get(key)
+        if step is not None:
+            return step
+        from spark_rapids_tpu.ops.filtering import compact_cols
+        nc = len(dtypes)
+        n_dev = self.n
+
+        def shard_step(*flat):
+            vals = flat[:nc]
+            masks = flat[nc:2 * nc]
+            pids = flat[2 * nc][0]          # (cap,) sentinel n_out on pads
+            dest_map = flat[2 * nc + 1]     # (n_out+1,) lane or -1
+            dest = dest_map[pids]
+            cols = [Col(v[0], m[0], dt)
+                    for v, m, dt in zip(vals, masks, dtypes)]
+            idcol = Col(pids, jnp.ones((cap,), jnp.bool_), T.IntegerType())
+            sv, sm, sp, sn = [], [], [], []
+            for d in range(n_dev):
+                keep = dest == jnp.int32(d)
+                cc, cn = compact_cols(cols + [idcol], keep)
+                sv.append([c.values[:cap_ex] for c in cc[:-1]])
+                sm.append([c.validity[:cap_ex] for c in cc[:-1]])
+                sp.append(cc[-1].values[:cap_ex])
+                sn.append(jnp.minimum(cn, jnp.int32(cap_ex)))
+            stacked_v = [jnp.stack([sv[d][c] for d in range(n_dev)])
+                         for c in range(nc)]
+            stacked_m = [jnp.stack([sm[d][c] for d in range(n_dev)])
+                         for c in range(nc)]
+            spids = jnp.stack(sp)
+            scnt = jnp.stack(sn).astype(jnp.int32)
+            rv = [jax.lax.all_to_all(a, "data", 0, 0) for a in stacked_v]
+            rm = [jax.lax.all_to_all(a, "data", 0, 0) for a in stacked_m]
+            rp = jax.lax.all_to_all(spids, "data", 0, 0)
+            rn = jax.lax.all_to_all(scnt, "data", 0, 0)
+            # sentinel-mask the received pids past each source's live count
+            # so the host-side per-pid slicing sinks padding rows
+            live = jnp.arange(cap_ex, dtype=jnp.int32)[None, :] < rn[:, None]
+            rp = jnp.where(live, rp, jnp.int32(n_out))
+            return (tuple(v[None] for v in rv) + tuple(m[None] for m in rm)
+                    + (rp[None], rn[None]))
+
+        spec = P("data", None)
+        shard_map = getattr(jax, "shard_map", None)
+        if shard_map is None:  # older jax
+            from jax.experimental.shard_map import shard_map
+        step = jax.jit(shard_map(
+            shard_step, mesh=self.mesh,
+            in_specs=tuple([spec] * (2 * nc) + [spec, P()]),
+            out_specs=tuple([P("data", None, None)] * (2 * nc + 1)
+                            + [spec])))
+        self._steps[key] = step
+        return step
+
+    def exchange_wave(self, batches: list, pids_list: list, dest_map,
+                      n_out: int):
+        """Move one wave's intra-host reduce-partition CONTENT over ICI:
+        `dest_map` maps pid → receiving lane for partitions owned by this
+        host (-1 for cross-host pids, which stay on the slice-and-park
+        path). Returns (recv_vals, recv_masks, recv_pids, recv_counts)
+        where recv_vals[c][dest][src] is source lane `src`'s rows for the
+        partitions assigned to lane `dest`, in source batch order — the
+        receiving lane reconstructs per-(map_split, pid) blocks from them
+        bit-identically to the per-batch path. The movement ledger meters
+        the ACTUAL per-lane all_to_all operand bytes on the ici edge, with
+        the live-row content bytes as the payload unit."""
+        if len(batches) > self.n:
+            raise MeshDegradedError(
+                f"mesh shrank: {self.n} device(s) < {len(batches)} lanes")
+        cap = max(b.capacity for b in batches)
+        cols_per_lane = [[Col.from_vector(c) for c in b.columns]
+                         for b in batches]
+        dtypes = [c.dtype for c in cols_per_lane[0]]
+        # dest_map indexed by pid; slot n_out is the pad-row sentinel and
+        # always routes off-mesh (-1)
+        dm = np.full((n_out + 1,), -1, np.int32)
+        dm[:n_out] = np.asarray(dest_map, np.int32)[:n_out]
+        # host-side per-(lane, dest) live-row counts size the exchange
+        # block (one d2h sync of the wave's pid vectors, same sync the
+        # per-batch slice path pays for its bincount)
+        row_bytes = sum(np.dtype(dt.jnp_dtype).itemsize + 1
+                        for dt in dtypes) + 4
+        live_rows = 0
+        max_cnt = 1
+        for b, pids in zip(batches, pids_list):
+            p = np.asarray(pids)[:b.num_rows]
+            d = dm[p]
+            d = d[d >= 0]
+            live_rows += int(d.size)
+            if d.size:
+                max_cnt = max(max_cnt, int(np.bincount(d).max()))
+        cap_ex = min(bucket_capacity(max_cnt), cap)
+        shards = []
+        pid_rows = []
+        for b, cols, pids in zip(batches, cols_per_lane, pids_list):
+            shards.append(([self._pad_col(c, cap) for c in cols],
+                           b.num_rows))
+            p = jnp.asarray(pids, jnp.int32)
+            if p.shape[0] < cap:
+                p = jnp.concatenate(
+                    [p, jnp.full((cap - p.shape[0],), jnp.int32(n_out))])
+            pid_rows.append(p)
+        while len(shards) < self.n:    # idle lanes: empty pad shards
+            shards.append((
+                [Col(jnp.full((cap,), dt.default_value(),
+                              dtype=dt.jnp_dtype),
+                     jnp.zeros((cap,), jnp.bool_), dt) for dt in dtypes],
+                0))
+            pid_rows.append(jnp.full((cap,), jnp.int32(n_out)))
+        vals, masks, _nrows = put_stacked_shards(self.mesh, shards)
+        sharding = NamedSharding(self.mesh, P("data", None))
+        pids_stacked = jax.device_put(jnp.stack(pid_rows), sharding)
+        dm_dev = jax.device_put(jnp.asarray(dm),
+                                NamedSharding(self.mesh, P()))
+        step = self._exchange_step(dtypes, cap, cap_ex, n_out)
+        out = step(*vals, *masks, pids_stacked, dm_dev)
+        nc = len(dtypes)
+        rv, rm = list(out[:nc]), list(out[nc:2 * nc])
+        rp, rn = out[2 * nc], out[2 * nc + 1]
+        rn = np.asarray(rn)             # sync: collective errors surface HERE
+        # movement ledger, ICI edge: the REAL all_to_all operand bytes
+        # (per-lane (n, cap_ex) carriers for every value/validity/pid
+        # column plus the count vector, summed over lanes), dual-unit with
+        # the wave's live-row content bytes as the payload column
+        from spark_rapids_tpu.runtime import movement as MV
+        per_lane = (sum(self.n * cap_ex * np.dtype(dt.jnp_dtype).itemsize
+                        for dt in dtypes)
+                    + nc * self.n * cap_ex          # validity carriers
+                    + self.n * cap_ex * 4           # pid carrier
+                    + self.n * 4)                   # count vector
+        MV.record("ici.collective", per_lane * self.n, link="ici",
+                  site="mesh.exchange_wave",
+                  payload_bytes=live_rows * row_bytes)
+        return rv, rm, rp, rn
 
 
 class MeshExecutor:
@@ -410,19 +580,25 @@ class MeshExecutor:
         step = self._build_step(schema, group_exprs, agg_exprs, filter_expr,
                                 cap)
         vals, masks, nrows = put_stacked_shards(self.mesh, shards)
-        # movement ledger, ICI edge: the exchange inside the program is a
-        # lax.all_to_all over every partial-aggregate column — estimated
-        # from the dispatch shapes (the stacked ingest arrays bound the
-        # exchanged payload; XLA may move less after the local partial)
-        from spark_rapids_tpu.runtime import movement as MV
-        MV.record("ici.collective",
-                  sum(int(v.nbytes) for v in vals)
-                  + sum(int(m.nbytes) for m in masks),
-                  link="ici", site="mesh.aggregate")
-        out = step(*vals, *masks, nrows)
-
         group_b = [bind_references(e, schema) for e in group_exprs]
         aggs = [_unalias(bind_references(e, schema)) for e in agg_exprs]
+        # movement ledger, ICI edge: the exchange inside the program is one
+        # lax.all_to_all per partial-aggregate carrier — metered as the
+        # ACTUAL operand bytes: every device contributes a (n_dev, cap)
+        # values + validity pair per key/state column plus its per-dest
+        # count vector (the partials ride at full capacity; the live-row
+        # subset is not knowable host-side without a d2h sync)
+        from spark_rapids_tpu.runtime import movement as MV
+        part_dtypes = ([g.dtype for g in group_b]
+                       + [st for a in aggs for st in a.state_types])
+        n = self.n
+        op_bytes = n * n * cap * sum(
+            np.dtype(dt.jnp_dtype).itemsize + 1 for dt in part_dtypes)
+        op_bytes += n * n * 4  # per-dest count vectors
+        MV.record("ici.collective", op_bytes, link="ici",
+                  site="mesh.aggregate", payload_bytes=op_bytes)
+        out = step(*vals, *masks, nrows)
+
         n_out = len(group_b) + len(aggs)
         out_v, out_m, groups = out[:n_out], out[n_out:2 * n_out], out[-1]
         counts = np.asarray(groups)

@@ -15,7 +15,7 @@ registry metering every byte that crosses a boundary, keyed by
          reclassified so retries never double-count the recv ledger),
          ``spill.write``/``spill.read`` (disk spill tier),
          ``h2d``/``d2h`` (Arrow boundary, unified with the PR-12 node meters),
-         ``ici.collective`` (estimated mesh all_to_all payloads),
+         ``ici.collective`` (real mesh collective operand bytes),
          ``endpoint.egress`` (Arrow IPC result frames to serving clients)
   link   the physical lane — ``tcp`` (cross-host), ``loopback`` (same-host
          TCP), ``local`` (in-process short-circuit, zero network), ``disk``,

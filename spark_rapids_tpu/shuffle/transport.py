@@ -26,7 +26,8 @@ from spark_rapids_tpu import config as CFG
 from spark_rapids_tpu.runtime import faults as F
 from spark_rapids_tpu.runtime import movement as MV
 from spark_rapids_tpu.runtime import tracing
-from spark_rapids_tpu.shuffle.compression import (BatchedTableCompressor,
+from spark_rapids_tpu.shuffle.compression import (CODEC_NONE,
+                                                  BatchedTableCompressor,
                                                   TableCompressionCodec,
                                                   get_codec)
 from spark_rapids_tpu.shuffle.manager import ShuffleBlockStore
@@ -249,6 +250,10 @@ class _ServerHandler(socketserver.BaseRequestHandler):
             self._link = MV.classify_peer(sock.getpeername())
         except OSError:
             self._link = "loopback"
+        # the whole connection is served on this thread, so the link class
+        # can steer per-link policy (compress only genuinely-tcp peers)
+        # without changing the serialized_blocks patch-point signature
+        server._serving_link.link = self._link
         try:
             while True:
                 try:
@@ -332,14 +337,27 @@ class _ServerHandler(socketserver.BaseRequestHandler):
 class TcpShuffleServer:
     """Serves local shuffle blocks to peers (reference RapidsShuffleServer:71).
     Device-resident blocks are serialized (D2H) once on first request and the
-    frames cached for subsequent fetchers."""
+    frames cached for subsequent fetchers.
+
+    With ``tcp_only`` (the compression.tcpOnly knob) the codec is applied per
+    connection LINK CLASS: only genuinely cross-host (``tcp``) peers get
+    compressed frames — loopback fetchers on the same box pay the raw wire,
+    which is free, instead of an lz4 round-trip, which is not. Frames are
+    cached per (shuffle, reduce, compressed?) variant so a mixed audience
+    never sees a frame built for the other link class."""
 
     def __init__(self, store: ShuffleBlockStore, codec: TableCompressionCodec,
-                 port: int = 0, num_threads: int = 4, checksum: bool = True):
+                 port: int = 0, num_threads: int = 4, checksum: bool = True,
+                 tcp_only: bool = True):
         self.store = store
         self.codec = codec
         self.checksum = checksum
+        self.tcp_only = tcp_only
         self.compressor = BatchedTableCompressor(codec, num_threads)
+        # per-connection-thread link class, set by _ServerHandler.handle();
+        # lets serialized_blocks keep its (sid, rid) signature (tests and
+        # fault injectors patch it) while still serving per-link variants
+        self._serving_link = threading.local()
         self._cache_lock = threading.Lock()
         self._frame_cache: dict = {}
         # per-block store-unit sizes (device_memory_size of the block as
@@ -360,8 +378,19 @@ class TcpShuffleServer:
                                         daemon=True, name="shuffle-server")
         self._thread.start()
 
+    def _compress_serving(self) -> bool:
+        """Whether frames built for the CURRENT connection thread should be
+        codec-compressed: never for the none codec, always when tcpOnly is
+        off, otherwise only when the peer classified as cross-host tcp."""
+        if self.codec.codec_id == CODEC_NONE:
+            return False
+        if not self.tcp_only:
+            return True
+        return getattr(self._serving_link, "link", None) == "tcp"
+
     def serialized_blocks(self, shuffle_id: int, reduce_id: int) -> list:
-        key = (shuffle_id, reduce_id)
+        compress = self._compress_serving()
+        key = (shuffle_id, reduce_id, compress)
         with self._cache_lock:
             if key in self._frame_cache:
                 return self._frame_cache[key][0]
@@ -371,7 +400,8 @@ class TcpShuffleServer:
             keys.append(seq)
             payloads.append(b.device_memory_size())
             frames.append(ser.serialize_batch(b))
-        frames = self.compressor.compress_all(frames)
+        if compress:
+            frames = self.compressor.compress_all(frames)
         if self.checksum:
             from spark_rapids_tpu.runtime.checksum import block_checksum
             crcs = [block_checksum(f) for f in frames]
@@ -386,7 +416,7 @@ class TcpShuffleServer:
         """Ordered seq tags matching serialized_blocks' frame order (served
         from the same cache; falls back to the store for patched/uncached
         paths)."""
-        key = (shuffle_id, reduce_id)
+        key = (shuffle_id, reduce_id, self._compress_serving())
         with self._cache_lock:
             if key in self._frame_cache:
                 return self._frame_cache[key][1]
@@ -395,7 +425,7 @@ class TcpShuffleServer:
     def block_crcs(self, shuffle_id: int, reduce_id: int) -> list:
         """Per-frame CRCs matching serialized_blocks' order (the sentinel
         when checksums are off or the cache was raced)."""
-        key = (shuffle_id, reduce_id)
+        key = (shuffle_id, reduce_id, self._compress_serving())
         with self._cache_lock:
             if key in self._frame_cache:
                 return self._frame_cache[key][2]
@@ -404,8 +434,9 @@ class TcpShuffleServer:
     def block_payload_sizes(self, shuffle_id: int, reduce_id: int) -> list:
         """Store-unit bytes per served block, matching serialized_blocks'
         frame order (empty when the cache was invalidated mid-serve)."""
+        key = (shuffle_id, reduce_id, self._compress_serving())
         with self._cache_lock:
-            return self._payload_cache.get((shuffle_id, reduce_id), [])
+            return self._payload_cache.get(key, [])
 
     def serve_entry(self, shuffle_id: int, reduce_id: int) -> tuple:
         """Frames plus their matching store-unit payload sizes, snapshotted
@@ -414,7 +445,7 @@ class TcpShuffleServer:
         if invalidate() races between the build and the payload snapshot
         the pair is rebuilt, so a served block is never metered with
         payload_bytes=0 just because its shuffle was unregistered mid-send."""
-        key = (shuffle_id, reduce_id)
+        key = (shuffle_id, reduce_id, self._compress_serving())
         blobs: list = []
         for _ in range(2):
             blobs = self.serialized_blocks(shuffle_id, reduce_id)
@@ -596,8 +627,9 @@ class TcpTransport(RapidsShuffleTransport):
         codec = get_codec(conf.get(CFG.SHUFFLE_COMPRESSION_CODEC))
         set_max_frame_bytes(conf.get(CFG.TRANSPORT_MAX_FRAME_BYTES))
         self.store = ShuffleBlockStore.get()
-        self.server = TcpShuffleServer(self.store, codec,
-                                       checksum=conf.get(CFG.SHUFFLE_CHECKSUM))
+        self.server = TcpShuffleServer(
+            self.store, codec, checksum=conf.get(CFG.SHUFFLE_CHECKSUM),
+            tcp_only=conf.get(CFG.SHUFFLE_COMPRESSION_TCP_ONLY))
         self.bounce_bytes = conf.get(CFG.SHUFFLE_BOUNCE_BUFFER_SIZE)
         self.throttle = InflightThrottle(conf.get(CFG.SHUFFLE_MAX_INFLIGHT_BYTES))
 

@@ -400,20 +400,117 @@ def test_local_mesh_shrink_raises_degraded():
         lm.partition_wave(batches, part)     # 3 lanes > 2 devices
 
 
+# -- two-level exchange: partition content over ICI ---------------------------
+
+def test_two_level_exchange_bit_identical_and_rides_ici(df, tcp_table):
+    """Default-on two-level plane: reduce partitions owned by this
+    executor move lane->lane as all_to_all over ICI (ici_rows counted,
+    consumers placed at the owner), and the result stays bit-identical
+    to the TCP-only plane with zero resilience noise."""
+    got, delta, stats = _run_mesh(df)
+    assert got.equals(tcp_table), "two-level result differs from TCP plane"
+    assert stats["mesh"]["ici_rows"] > 0, stats
+    assert stats["placement"].get("owner", 0) >= 1, stats
+    assert stats["mesh"]["degraded"] == 0, stats
+    assert not delta, f"two-level run left resilience noise: {delta}"
+
+
+def test_two_level_off_keeps_content_off_ici(df, tcp_table):
+    """The twoLevel knob off: same mesh grouping, same bytes, but no
+    partition content rides ICI (the pid program's psum is all that
+    touches the collective plane)."""
+    got, _, stats = _run_mesh(
+        df, {"spark.rapids.tpu.cluster.mesh.exchange.twoLevel": "false"})
+    assert got.equals(tcp_table)
+    assert stats["mesh"]["ici_rows"] == 0, stats
+    assert stats["mesh"]["mesh_tasks"] >= 1, stats
+
+
+def test_two_level_string_keys_fall_back_without_breaking_group(spark):
+    """String keys cannot ride the stacked all_to_all program (per-batch
+    dictionaries), so the wave falls back to per-batch slice-and-park —
+    WITHOUT degrading the mesh group or charging a fallback."""
+    words = ["alpha", "beta", "gamma", "delta", "epsilon"]
+    rng = np.random.default_rng(11)
+    t = pa.table({"s": pa.array([words[i % 5] for i in
+                                 rng.integers(0, 5, 2000)]),
+                  "v": pa.array(rng.random(2000))})
+    sdf = (spark.create_dataframe(t, num_partitions=N_SPLITS)
+           .group_by(F.col("s")).agg(F.sum(F.col("v")).alias("t")))
+    with MiniCluster(n_executors=N_EXEC, platform="cpu") as c:
+        tcp = c.collect(sdf)
+    got, delta, stats = _run_mesh(sdf)
+    assert got.equals(tcp), "string-key fallback is not bit-identical"
+    assert stats["mesh"]["mesh_tasks"] >= 1, stats
+    assert stats["mesh"]["ici_rows"] == 0, stats
+    assert stats["mesh"]["degraded"] == 0, stats
+    assert not delta, delta
+
+
+def test_mesh_kill_mid_all_to_all_degrades_to_tcp(df, tcp_table):
+    """An executor SIGKILLed INSIDE the content all_to_all: the loss is
+    detected, the group re-plans per-split onto TCP under a bumped epoch
+    (partial intra-mesh shards dropped with the dead store — bit-identity
+    is the no-leak proof), counted in meshDegradedFallbacks."""
+    got, delta, stats = _run_mesh(
+        df, {"spark.rapids.tpu.test.faults":
+             "exec_kill:cluster.mesh.exchange.1:1"})
+    assert got.equals(tcp_table), "kill-mid-exchange is not bit-identical"
+    assert delta.get("executorsLost", 0) >= 1, delta
+    assert delta.get("meshDegradedFallbacks", 0) >= 1, delta
+    assert all(stats["alive"]), "pool not restored"
+    names = {n for n, _ in tracing.recent_events()}
+    assert {"mesh.degraded", "executor.lost"} <= names, names
+
+
+def test_mesh_exchange_error_degrades_transparently(df, tcp_table):
+    """The all_to_all itself failing with the executor alive: transparent
+    re-plan onto per-split TCP — surviving partial writes are dropped via
+    drop_map_output under the bumped epoch, no executor lost, no attempt
+    strike charged, result bit-identical."""
+    got, delta, stats = _run_mesh(
+        df, {"spark.rapids.tpu.test.faults":
+             "error:cluster.mesh.exchange.0:1"})
+    assert got.equals(tcp_table)
+    assert delta.get("meshDegradedFallbacks", 0) >= 1, delta
+    assert delta.get("executorsLost", 0) == 0, delta
+    assert delta.get("taskAttempts", 0) == 0, \
+        f"degradation must not charge attempt strikes: {delta}"
+    names = {n for n, _ in tracing.recent_events()}
+    assert "mesh.degraded" in names, names
+
+
 # -- the q18 ladder query over the combined plane -----------------------------
+
+def _load_multisplit(spark, paths):
+    """Load each TPC-H table as an explicit sorted file LIST (one file per
+    split) — directory loads collapse to a single FilePartition, which
+    would leave nothing for a mesh group to exchange."""
+    import os
+    dfs = {}
+    for name, p in paths.items():
+        if os.path.isdir(p):
+            fs = sorted(os.path.join(p, f) for f in os.listdir(p)
+                        if f.endswith(".parquet"))
+            dfs[name] = spark.read_parquet(fs, files_per_partition=1)
+        else:
+            dfs[name] = spark.read_parquet(p)
+        spark.create_or_replace_temp_view(name, dfs[name])
+    return dfs
+
 
 @pytest.mark.slow
 def test_mesh_cluster_q18_bit_identical_vs_single_process(tmp_path_factory):
     """TPC-H q18 on a 2-executor MiniCluster driving local meshes: the
-    combined plane reproduces both the TCP-only cluster bytes and the
-    single-process result."""
+    two-level plane (content over ICI) reproduces the TCP-only cluster
+    bytes, the twoLevel-off mesh bytes, AND the single-process result."""
     import jax
     jax.config.update("jax_platforms", "cpu")
     from spark_rapids_tpu.benchmarks import tpch
     data = str(tmp_path_factory.mktemp("tpch")) + "/sf001"
     paths = tpch.generate(0.01, data)
     spark = TpuSession()
-    dfs = tpch.load(spark, paths, files_per_partition=4)
+    dfs = _load_multisplit(spark, paths)
     q18 = tpch.QUERIES["q18"](dfs)
     single = q18.collect()
     with MiniCluster(n_executors=N_EXEC, platform="cpu") as c:
@@ -422,6 +519,16 @@ def test_mesh_cluster_q18_bit_identical_vs_single_process(tmp_path_factory):
     with MiniCluster(n_executors=N_EXEC, conf=conf, platform="cpu") as c:
         mesh = c.collect(q18)
         stats = dict(c.mesh_stats)
-    assert mesh.equals(tcp), "combined-plane q18 differs from TCP plane"
-    assert mesh.equals(single), "combined-plane q18 differs from 1-process"
+    off_conf = RapidsConf(dict(
+        MESH_CONF,
+        **{"spark.rapids.tpu.cluster.mesh.exchange.twoLevel": "false"}))
+    with MiniCluster(n_executors=N_EXEC, conf=off_conf,
+                     platform="cpu") as c:
+        mesh_off = c.collect(q18)
+        stats_off = dict(c.mesh_stats)
+    assert mesh.equals(tcp), "two-level q18 differs from TCP plane"
+    assert mesh.equals(single), "two-level q18 differs from 1-process"
+    assert mesh.equals(mesh_off), "two-level q18 differs from twoLevel=off"
     assert stats["mesh_tasks"] >= 1 and stats["degraded"] == 0, stats
+    assert stats["ici_rows"] > 0, stats
+    assert stats_off["ici_rows"] == 0, stats_off

@@ -127,6 +127,59 @@ def test_tcp_transport_roundtrip(store, codec):
         transport.shutdown()
 
 
+def test_compression_tcp_only_serves_per_link_variants(store):
+    """With compression.tcpOnly (the default) the lz4 codec only applies
+    to genuinely cross-host peers: loopback fetchers get raw serialization
+    frames (TPUB magic), tcp peers get codec frames (TPUC magic) that
+    decode to the same bytes, each cached as its own variant."""
+    sid, expect = fill_shuffle(store, n_blocks=1, reduce_ids=(0,))
+    transport = TcpTransport(RapidsConf(
+        {"spark.rapids.tpu.shuffle.compression.codec": "lz4"}))
+    try:
+        server = transport.server
+        server._serving_link.link = "loopback"
+        raw = server.serialized_blocks(sid, 0)
+        assert raw and all(f[:4] == b"TPUB" for f in raw), \
+            "loopback frames must stay uncompressed"
+        server._serving_link.link = "tcp"
+        comp = server.serialized_blocks(sid, 0)
+        assert comp and all(f[:4] == b"TPUC" for f in comp), \
+            "cross-host frames must be codec-framed"
+        assert [TableCompressionCodec.decode(f) for f in comp] == raw
+        # both variants live side by side in the cache
+        assert {(sid, 0, False), (sid, 0, True)} <= \
+            set(server._frame_cache)
+        # a real loopback fetch round-trips on the raw variant
+        client = transport.make_client(("127.0.0.1", transport.port))
+        got = collect(client, sid, 0)
+        assert got.to_pylist() == expect[0].to_pylist()
+    finally:
+        transport.shutdown()
+
+
+def test_compression_tcp_only_disabled_compresses_every_link(store):
+    """tcpOnly=false restores the compress-everything behavior (and the
+    none codec never compresses regardless of link)."""
+    sid, _ = fill_shuffle(store, n_blocks=1, reduce_ids=(0,))
+    transport = TcpTransport(RapidsConf({
+        "spark.rapids.tpu.shuffle.compression.codec": "lz4",
+        "spark.rapids.tpu.shuffle.compression.tcpOnly": "false"}))
+    try:
+        transport.server._serving_link.link = "loopback"
+        frames = transport.server.serialized_blocks(sid, 0)
+        assert frames and all(f[:4] == b"TPUC" for f in frames)
+    finally:
+        transport.shutdown()
+    none = TcpTransport(RapidsConf(
+        {"spark.rapids.tpu.shuffle.compression.codec": "none"}))
+    try:
+        none.server._serving_link.link = "tcp"
+        frames = none.server.serialized_blocks(sid, 0)
+        assert frames and all(f[:4] == b"TPUB" for f in frames)
+    finally:
+        none.shutdown()
+
+
 def test_tcp_transport_concurrent_fetches(store):
     sid, expect = fill_shuffle(store, n_blocks=4, reduce_ids=tuple(range(6)))
     conf = RapidsConf({
@@ -343,9 +396,11 @@ def test_unregister_invalidates_server_cache(store):
         client = transport.make_client(("127.0.0.1", transport.port))
         got = collect(client, sid, 0)
         assert got.num_rows == expect[0].num_rows
-        assert (sid, 0) in transport.server._frame_cache
+        assert any(k[:2] == (sid, 0)
+                   for k in transport.server._frame_cache)
         store.unregister_shuffle(sid)
-        assert (sid, 0) not in transport.server._frame_cache
+        assert not any(k[:2] == (sid, 0)
+                       for k in transport.server._frame_cache)
     finally:
         transport.shutdown()
 

@@ -58,11 +58,15 @@ def main(argv=None) -> int:
     p.add_argument("--mesh-devices", type=int, default=4)
     args = p.parse_args(argv)
     if args.fault is None:
-        # mesh default: SIGKILL executor 1 at its SECOND mesh task's
-        # bring-up (@1 skips the first) — inside the collective region,
-        # after earlier stages parked outputs, so the loss exercises both
-        # the degraded re-plan AND the lineage-scoped recompute
-        args.fault = ("exec_kill:cluster.mesh.begin.1:1@1" if args.mesh
+        # mesh default: SIGKILL whichever executor reaches its SECOND mesh
+        # task's bring-up (@1 skips each process's first hit of the
+        # non-indexed site) — inside the mesh-task region, after the
+        # victim's first group parked outputs, so the loss exercises both
+        # the degraded re-plan AND the lineage-scoped recompute. The site
+        # is deliberately not executor-indexed: the two-level exchange
+        # places each mesh group at its partition owner, so which executor
+        # collects two groups first is a placement detail, not a contract.
+        args.fault = ("exec_kill:cluster.mesh.begin:1@1" if args.mesh
                       else "exec_kill:cluster.result.begin.0:1")
 
     sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
@@ -88,7 +92,23 @@ def main(argv=None) -> int:
         settings["spark.rapids.tpu.cluster.mesh.devicesPerExecutor"] = \
             str(args.mesh_devices)
     spark = TpuSession(settings)
-    dfs = tpch.load(spark, paths, files_per_partition=4)
+    if args.mesh:
+        # explicit sorted file lists, one file per split: directory loads
+        # collapse to a single FilePartition, and single-split scans never
+        # form mesh task groups — the @1-indexed kill site needs executor 1
+        # to run a second mesh task with the first one's outputs parked
+        import os
+        dfs = {}
+        for name, pth in paths.items():
+            if os.path.isdir(pth):
+                fs = sorted(os.path.join(pth, f) for f in os.listdir(pth)
+                            if f.endswith(".parquet"))
+                dfs[name] = spark.read_parquet(fs, files_per_partition=1)
+            else:
+                dfs[name] = spark.read_parquet(pth)
+            spark.create_or_replace_temp_view(name, dfs[name])
+    else:
+        dfs = tpch.load(spark, paths, files_per_partition=4)
     df = tpch.QUERIES[args.query](dfs)
 
     clean_base = M.resilience_snapshot()

@@ -17,12 +17,28 @@ and asserts the movement-ledger contract (runtime/movement.py) end to end:
     query records exactly zero bytes on every network-capable edge
     (movement.NETWORK_EDGES) while still metering its h2d/d2h traffic.
 
+Two more gate modes ride the same script:
+
+  - ``--two-level-compare``: runs the mesh-cluster q18 twice in child
+    processes (twoLevel off, then on — separate processes so neither
+    ledger/eventlog state bleeds) and asserts the two-level exchange
+    contract: loopback/TCP shuffle payload bytes drop >=2x, the delta
+    appears on the ``ici.collective`` edge, results bit-identical;
+  - ``--ooc-smoke``: one out-of-core completion run (hbm.limitBytes
+    shrunk below the working set) of the two-level plane on >=2
+    executors — completes, spills to the host/disk tiers, bit-stable
+    digest printed.
+
 Must be a real script file, not a ``python -`` heredoc: the spawn-based
 executor bootstrap re-imports __main__, and stdin cannot be re-imported.
 
 Usage:
   python tools/movement_gate.py --data-dir /tmp/tpch_sf0.01 \
       --eventlog-dir DIR [--query q18] [--scale 0.01] [--executors 3]
+  python tools/movement_gate.py --data-dir D --eventlog-dir DIR \
+      --two-level-compare [--executors 2]
+  python tools/movement_gate.py --data-dir D --eventlog-dir DIR \
+      --ooc-smoke [--scale 1.0] [--ooc-limit 256m]
 """
 
 from __future__ import annotations
@@ -30,7 +46,9 @@ from __future__ import annotations
 import argparse
 import glob
 import json
+import os
 import pathlib
+import subprocess
 import sys
 
 
@@ -54,6 +72,167 @@ def _last_samples(eventlog_dir: str) -> tuple[dict, int]:
     return samples, registered
 
 
+def _flows(samples: dict) -> dict:
+    """(edge, link) -> {bytes, payload_bytes} summed over process ledgers."""
+    out: dict = {}
+    for rec in samples.values():
+        for f in rec.get("flows") or []:
+            c = out.setdefault((f["edge"], f["link"]),
+                               {"bytes": 0, "payload_bytes": 0})
+            c["bytes"] += f["bytes"]
+            c["payload_bytes"] += f["payload_bytes"]
+    return out
+
+
+def _load_multisplit(spark, paths):
+    """Load each table as an explicit sorted file list (one file per
+    split): directory loads collapse to a single FilePartition, leaving
+    nothing for a mesh task group to exchange."""
+    dfs = {}
+    for name, p in paths.items():
+        if os.path.isdir(p):
+            fs = sorted(os.path.join(p, f) for f in os.listdir(p)
+                        if f.endswith(".parquet"))
+            dfs[name] = spark.read_parquet(fs, files_per_partition=1)
+        else:
+            dfs[name] = spark.read_parquet(p)
+        spark.create_or_replace_temp_view(name, dfs[name])
+    return dfs
+
+
+def _mesh_run(args, two_level: bool, extra: dict | None = None) -> int:
+    """Child-process body: one mesh-cluster run of the query with the
+    two-level exchange on/off; digest + mesh stats land in
+    <eventlog-dir>/result.json for the comparing parent."""
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import hashlib
+    import pyarrow as pa
+    import spark_rapids_tpu  # noqa: F401
+    from spark_rapids_tpu.benchmarks import tpch
+    from spark_rapids_tpu.cluster import MiniCluster
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.runtime import eventlog
+    from spark_rapids_tpu.session import TpuSession
+
+    settings = {
+        "spark.rapids.tpu.eventLog.dir": args.eventlog_dir,
+        "spark.rapids.tpu.movement.sample.intervalBytes": "64k",
+        "spark.rapids.tpu.cluster.mesh.enabled": "true",
+        "spark.rapids.tpu.cluster.mesh.devicesPerExecutor": "4",
+        "spark.rapids.tpu.cluster.mesh.exchange.twoLevel":
+            "true" if two_level else "false",
+        **(extra or {})}
+    spark = TpuSession(settings)
+    paths = tpch.generate(args.scale, args.data_dir)
+    dfs = _load_multisplit(spark, paths)
+    df = tpch.QUERIES[args.query](dfs)
+    with MiniCluster(n_executors=args.executors, conf=RapidsConf(settings),
+                     platform="cpu") as c:
+        out = c.collect(df)
+        mesh_stats = dict(c.mesh_stats)
+        placement = dict(c.placement_stats)
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, out.schema) as w:
+        w.write_table(out)
+    digest = hashlib.sha256(sink.getvalue().to_pybytes()).hexdigest()
+    eventlog.shutdown()
+    with open(os.path.join(args.eventlog_dir, "result.json"), "w") as f:
+        json.dump({"digest": digest, "rows": out.num_rows,
+                   "mesh_stats": mesh_stats, "placement": placement}, f)
+    print(f"mesh run ok [{args.query}, twoLevel={two_level}]: "
+          f"{out.num_rows} rows, digest {digest[:16]}, {mesh_stats}")
+    return 0
+
+
+def _child(args, mode: str, eventlog_dir: str) -> dict:
+    """Run one --two-level-run child and return its parsed result.json +
+    summed ledger flows."""
+    cmd = [sys.executable, str(pathlib.Path(__file__).resolve()),
+           "--data-dir", args.data_dir, "--eventlog-dir", eventlog_dir,
+           "--query", args.query, "--scale", str(args.scale),
+           "--executors", str(args.executors), "--two-level-run", mode]
+    subprocess.run(cmd, check=True)
+    samples, _ = _last_samples(eventlog_dir)
+    with open(os.path.join(eventlog_dir, "result.json")) as f:
+        res = json.load(f)
+    res["flows"] = _flows(samples)
+    return res
+
+
+def two_level_compare(args) -> int:
+    """Parent body of --two-level-compare: the acceptance assertion for
+    the two-level exchange, straight from the movement ledgers."""
+    off_dir = os.path.join(args.eventlog_dir, "twolevel-off")
+    on_dir = os.path.join(args.eventlog_dir, "twolevel-on")
+    for d in (off_dir, on_dir):
+        os.makedirs(d, exist_ok=True)
+    off = _child(args, "off", off_dir)
+    on = _child(args, "on", on_dir)
+
+    def shuffle_wire_payload(flows, links):
+        w = sum(c["bytes"] for (e, lk), c in flows.items()
+                if e.startswith("shuffle.") and lk in links)
+        pb = sum(c["payload_bytes"] for (e, lk), c in flows.items()
+                 if e.startswith("shuffle.") and lk in links)
+        return w, pb
+
+    def ici(flows):
+        return tuple(sum(c[k] for (e, _lk), c in flows.items()
+                         if e == "ici.collective")
+                     for k in ("bytes", "payload_bytes"))
+
+    assert on["digest"] == off["digest"], \
+        f"two-level result differs: {on['digest']} vs {off['digest']}"
+    _, off_pb = shuffle_wire_payload(off["flows"], ("loopback", "tcp"))
+    _, on_pb = shuffle_wire_payload(on["flows"], ("loopback", "tcp"))
+    assert off_pb > 0, f"baseline moved no shuffle bytes: {off['flows']}"
+    ratio = off_pb / max(on_pb, 1)
+    assert ratio >= 2.0, \
+        (f"two-level exchange saved only {ratio:.2f}x loopback/tcp shuffle "
+         f"payload ({off_pb}B -> {on_pb}B), need >=2x")
+    off_ici_w, _ = ici(off["flows"])
+    on_ici_w, on_ici_pb = ici(on["flows"])
+    assert on_ici_w > off_ici_w and on_ici_pb > 0, \
+        (f"saved bytes did not appear on the ici edge: wire "
+         f"{off_ici_w}B -> {on_ici_w}B, payload {on_ici_pb}B")
+    assert on["mesh_stats"].get("ici_rows", 0) > 0, on["mesh_stats"]
+    assert on["mesh_stats"].get("degraded", 0) == 0, on["mesh_stats"]
+    print(f"two-level movement gate ok [{args.query}, {args.executors} "
+          f"executors]: loopback/tcp shuffle payload {off_pb}B -> {on_pb}B "
+          f"({ratio:.1f}x saved), ici wire {off_ici_w}B -> {on_ici_w}B "
+          f"(payload {on_ici_pb}B), {on['mesh_stats']['ici_rows']} rows "
+          f"over ICI, digests identical ({on['digest'][:16]})")
+    return 0
+
+
+def ooc_smoke(args) -> int:
+    """--ooc-smoke body: the two-level plane completes OUT-OF-CORE — the
+    device budget shrunk below the working set forces the spill tiers —
+    on >=2 executors, and the ledgers prove spilling actually happened."""
+    # both tiers shrunk: device pressure spills to host, host pressure on
+    # to disk — spill.write/read are the DISK tier's (metered) edges, so
+    # this is what makes "completed out-of-core" assertable
+    rc = _mesh_run(args, two_level=True, extra={
+        "spark.rapids.tpu.memory.hbm.limitBytes": args.ooc_limit,
+        "spark.rapids.tpu.memory.host.spillStorageSize": args.ooc_limit})
+    samples, _ = _last_samples(args.eventlog_dir)
+    flows = _flows(samples)
+    spilled = sum(c["bytes"] for (e, _lk), c in flows.items()
+                  if e in ("spill.write", "spill.read"))
+    assert spilled > 0, \
+        (f"out-of-core run never touched the spill tiers under "
+         f"hbm.limitBytes={args.ooc_limit}: {sorted(flows)}")
+    with open(os.path.join(args.eventlog_dir, "result.json")) as f:
+        res = json.load(f)
+    print(f"ooc smoke ok [{args.query}, sf{args.scale:g}, {args.executors} "
+          f"executors, hbm.limitBytes={args.ooc_limit}]: completed with "
+          f"{spilled}B on the spill edges, {res['rows']} rows, digest "
+          f"{res['digest'][:16]}")
+    return rc
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="movement_gate.py", description=__doc__)
     p.add_argument("--data-dir", required=True)
@@ -61,7 +240,19 @@ def main(argv=None) -> int:
     p.add_argument("--query", default="q18")
     p.add_argument("--scale", type=float, default=0.01)
     p.add_argument("--executors", type=int, default=3)
+    p.add_argument("--two-level-compare", action="store_true")
+    p.add_argument("--two-level-run", choices=("on", "off"),
+                   help="(internal) one child run of the compare mode")
+    p.add_argument("--ooc-smoke", action="store_true")
+    p.add_argument("--ooc-limit", default="256m",
+                   help="hbm.limitBytes for the --ooc-smoke run")
     args = p.parse_args(argv)
+    if args.two_level_run:
+        return _mesh_run(args, two_level=args.two_level_run == "on")
+    if args.two_level_compare:
+        return two_level_compare(args)
+    if args.ooc_smoke:
+        return ooc_smoke(args)
 
     sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
     import jax

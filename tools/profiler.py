@@ -877,6 +877,24 @@ def analyze_movement(records: list) -> dict:
         if edge in mv.NETWORK_EDGES and link in by_link:
             by_link[link] += cell["bytes"]
 
+    # two-level exchange rollup: the intra-mesh level is everything that
+    # rode ICI collectives (cluster/minicluster.py's exchange_wave path);
+    # the block-store level splits into same-host (loopback TCP + in-process
+    # short-circuit reads) and genuinely cross-host TCP. Separating the
+    # levels at a glance is what shows a two-level run moving shuffle
+    # content off the loopback rows and onto the ici row.
+    levels = {k: {"bytes": 0, "payload_bytes": 0}
+              for k in ("intra_mesh", "same_host", "cross_host")}
+    for (edge, link), cell in flows.items():
+        if link == "ici":
+            lvl = "intra_mesh"
+        elif edge.startswith("shuffle."):
+            lvl = "cross_host" if link == "tcp" else "same_host"
+        else:
+            continue
+        levels[lvl]["bytes"] += cell["bytes"]
+        levels[lvl]["payload_bytes"] += cell["payload_bytes"]
+
     queries = [{
         "query": r.get("query"), "description": r.get("description", ""),
         **(r.get("movement") or {}),
@@ -891,6 +909,7 @@ def analyze_movement(records: list) -> dict:
         "flows": top,
         "matrix": {f"{s}->{d}": v for (s, d), v in sorted(matrix.items())},
         "by_link": by_link,
+        "exchange_levels": levels,
         "queries": queries,
         "total_bytes": sum(c["bytes"] for c in flows.values()),
         "total_payload_bytes": sum(c["payload_bytes"]
@@ -926,6 +945,18 @@ def render_movement(m: dict, top: int = 15) -> str:
                    f" — {_fmt_bytes(heaviest['bytes'])} wire / "
                    f"{_fmt_bytes(heaviest['payload_bytes'])} payload in "
                    f"{heaviest['transfers']} transfer(s)")
+    lv = m.get("exchange_levels") or {}
+    if any(v["bytes"] or v["payload_bytes"] for v in lv.values()):
+        im, sh, xh = (lv.get(k, {"bytes": 0, "payload_bytes": 0})
+                      for k in ("intra_mesh", "same_host", "cross_host"))
+        out.append(
+            "  exchange levels: "
+            f"intra-mesh(ici)={_fmt_bytes(im['bytes'])} wire"
+            f"/{_fmt_bytes(im['payload_bytes'])} payload  "
+            f"same-host={_fmt_bytes(sh['bytes'])}"
+            f"/{_fmt_bytes(sh['payload_bytes'])}  "
+            f"cross-host={_fmt_bytes(xh['bytes'])}"
+            f"/{_fmt_bytes(xh['payload_bytes'])}")
     lk = m["by_link"]
     net = lk["tcp"] + lk["loopback"] + lk["local"]
     if net:
