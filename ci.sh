@@ -65,7 +65,7 @@ print("bench-child dry-run ok:", d["metric"], d["value"], d["unit"],
 # the committed sf0.1 line, so this prints the SKIP reason here; round
 # drivers comparing same-scale lines get the real gate
 echo "$bench_line" > /tmp/ci_bench_line.json
-python tools/bench_compare.py /tmp/ci_bench_line.json --baseline BENCH_r07.json
+python tools/bench_compare.py /tmp/ci_bench_line.json --baseline BENCH_r08.json
 
 echo "== radix spine: kernel interpret tests + join microbench smoke =="
 # the exact kernel set the next chip window's probe latch will exercise,
@@ -660,6 +660,23 @@ else:
     print("fleet throughput gate ok:", d["throughput_x"], "x on",
           d["cores"], "cores")
 ' "$fleet_line"
+
+echo "== streaming: exactly-once epoch chaos (kill mid-commit, bit-identical replay) =="
+# a >=20-epoch windowed-agg stream through the epoch coordinator: state
+# rows/bytes must stay FLAT under the watermark (retirement works), the
+# steady-state tail must run with zero compiles, a child coordinator
+# SIGKILLed by exec_kill INSIDE the commit window must replay its epoch
+# bit-identically at attempt 2 (streamEpochReplays counted exactly once),
+# the profiler's streaming read-out must schema-validate the journal (and
+# reject a corrupted copy), and a single-giant-epoch oracle must reproduce
+# the exact final state + checksum (merge associativity cross-check)
+stream_dir=$(mktemp -d)
+JAX_PLATFORMS=cpu python tools/stream_chaos.py --work-dir "$stream_dir"
+rm -rf "$stream_dir"
+# streaming unit/integration suite: journal fencing + corruption refusal,
+# CRC-verified idempotent APPEND, commit-crash + snapshot-corruption
+# recovery, endpoint wire path, cross-replica staleness
+JAX_PLATFORMS=cpu python -m pytest tests/test_streaming.py -q -m 'not slow'
 
 echo "== observability: event log + tracing overhead + profiler gate =="
 # run the q18 ladder query with telemetry disabled then with the event log

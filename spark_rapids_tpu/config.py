@@ -809,6 +809,32 @@ FLEET_HEARTBEAT_INTERVAL = conf(
     "disables the heartbeat thread (the lease then expires unless renewed "
     "manually)").double_conf(2.0)
 
+STREAM_WATERMARK_DELAY = conf(
+    "spark.rapids.tpu.streaming.watermark.delaySeconds").doc(
+    "Event-time lateness bound of a windowed streaming aggregation "
+    "(streaming/coordinator.py): after each committed epoch the watermark "
+    "advances to max(event time) - delay, window groups entirely below it "
+    "are retired out of the incremental state (emitted once as finalized "
+    "rows), and later-arriving rows for a retired window are dropped — "
+    "this is what keeps state bytes bounded on an unbounded stream. <0 "
+    "(the default) disables retirement (state grows with the key space)"
+).double_conf(-1.0)
+
+STREAM_MAX_BATCHES_PER_EPOCH = conf(
+    "spark.rapids.tpu.streaming.maxBatchesPerEpoch").doc(
+    "Cap on the input batches one micro-batch epoch consumes "
+    "(streaming/coordinator.py): a backlogged source is drained over "
+    "several epochs of bounded footprint instead of one giant admitted "
+    "query. <=0 means unbounded (drain everything pending)"
+).integer_conf(32)
+
+STREAM_JOURNAL_HISTORY = conf(
+    "spark.rapids.tpu.streaming.journal.maxCommits").doc(
+    "Commit records retained in a stream's epoch journal for "
+    "observability (profiler.py streaming); the exactly-once state itself "
+    "(committed epoch, consumed batch ids, pending begin) is never "
+    "truncated").integer_conf(256)
+
 ENDPOINT_RESULT_CACHE_ENABLED = conf(
     "spark.rapids.tpu.endpoint.resultCache.enabled").doc(
     "Serve identical hot queries from an in-memory result cache on the "

@@ -34,6 +34,15 @@ COMPLETE = "complete"
 # smallest batch capacity the group-by chain will fuse (see _chain_step)
 _CHAIN_MIN_CAPACITY = 1024
 
+# the partial→merge contract per aggregate op: which op folds two PARTIAL
+# states of the named op into one (sums and counts re-SUM; min/max are
+# idempotent under themselves). This is the same algebra the FINAL-mode
+# merge below implements batch-to-batch; streaming/coordinator.py reuses it
+# epoch-to-epoch — incremental streaming state IS a parked partial batch,
+# and any consumer that parks partials across queries must merge with
+# exactly these ops or double-count
+AGG_MERGE_OPS = {"sum": "sum", "count": "sum", "min": "min", "max": "max"}
+
 
 def _agg_fn(e) -> AggregateFunction:
     f = e.child if isinstance(e, Alias) else e

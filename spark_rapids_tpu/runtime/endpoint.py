@@ -104,8 +104,15 @@ MSG_PING = 20           # client→server: liveness probe
 MSG_PONG = 21           # server→client: liveness reply
 MSG_STATS = 22          # client→server: live serving-metrics snapshot probe
 MSG_STATS_RESP = 23     # server→client: Prometheus-style text exposition
+MSG_APPEND = 24         # client→server: <I hlen> + JSON header (source,
+#                         batch, crc) + Arrow-IPC stream payload — one
+#                         durable streaming-source batch (streaming/)
+MSG_APPEND_ACK = 25     # server→client: JSON ack (duplicate flag, rows,
+#                         catalog epoch, replica) — sent only after the
+#                         batch is durable on disk
 
 _CRC = struct.Struct("<Q")
+_HDR = struct.Struct("<I")
 
 # request knobs a client may set per submission — mapped onto the session
 # conf keys the scheduler reads at submit time; everything else in the
@@ -605,6 +612,10 @@ class QueryEndpoint:
                     send_frame(sock, MSG_STATS_RESP, render_stats(
                         self.stats_histograms, endpoint=self).encode("utf-8"))
                     continue
+                if msg == MSG_APPEND:
+                    if not self._serve_append(sock, payload):
+                        return
+                    continue
                 if msg != MSG_SUBMIT:
                     self._send_error(sock, TransportError(
                         f"unexpected message {msg} (want SUBMIT)"))
@@ -634,6 +645,40 @@ class QueryEndpoint:
             f"endpoint draining (shutdown in progress); retry another "
             f"replica after ~{hint}s", backoff_hint_s=hint,
             reason="draining"))
+
+    def _serve_append(self, sock, payload) -> bool:
+        """One streaming APPEND: CRC-verify, persist durably, bump the
+        catalog epoch (local + fleet-shared), THEN ack — the ack is the
+        durability receipt, so a client that saw it can stop retrying and
+        a client that didn't can retry blindly (idempotent by (source,
+        batch_id)). Returns False when the connection is dead."""
+        try:
+            (hlen,) = _HDR.unpack_from(payload, 0)
+            hdr = json.loads(payload[_HDR.size:_HDR.size + hlen]
+                             .decode("utf-8"))
+            source, batch = hdr["source"], hdr["batch"]
+            crc = int(hdr["crc"])
+            body = payload[_HDR.size + hlen:]
+        except BaseException as e:   # noqa: BLE001 — parse errors travel
+            return self._send_error(sock, e)
+        if self._draining:
+            return self._shed_draining(sock)
+        try:
+            # on the SERVER session, never a request copy: the epoch bump
+            # must land on the session the result-cache key reads
+            ack = self.session.streaming_append(source, batch,
+                                                ipc_body=body, crc=crc)
+        except BaseException as e:   # noqa: BLE001 — typed errors travel
+            return self._send_error(sock, e)
+        ack["replica"] = self.replica_name
+        try:
+            send_frame(sock, MSG_APPEND_ACK,
+                       json.dumps(ack).encode("utf-8"))
+            return True
+        except OSError:
+            # the batch IS durable; the client that missed this ack will
+            # retry into the duplicate path and get its receipt there
+            return False
 
     def _request_session(self, req: dict):
         """Per-request session view: shares the server session's temp views
@@ -1374,6 +1419,69 @@ class EndpointClient:
         for empty results)."""
         tables = list(self.submit_iter(sql, **kw))
         return pa.concat_tables(tables)
+
+    def append(self, source: str, batch_id: str, tbl: pa.Table) -> dict:
+        """Ship one streaming batch as a CRC-stamped Arrow-IPC APPEND
+        frame; returns the server's ack (duplicate flag, rows, catalog
+        epoch, replica). The ack means DURABLE — the server persisted the
+        batch before replying. Raises the server's typed error, or a
+        retryable TransportError on any wire-level fault."""
+        from spark_rapids_tpu.streaming.source import table_to_ipc
+        body = table_to_ipc(tbl)
+        hdr = json.dumps({"source": source, "batch": batch_id,
+                          "crc": block_checksum(body)}).encode("utf-8")
+        sock = self.connect()
+        try:
+            try:
+                send_frame(sock, MSG_APPEND,
+                           _HDR.pack(len(hdr)) + hdr + body)
+                msg, payload = recv_frame(sock, max_bytes=self.max_frame)
+                if msg == MSG_QUERY_ERROR:
+                    raise _unpickle_error(payload)
+                if msg != MSG_APPEND_ACK:
+                    raise TransportError(
+                        f"unexpected endpoint message {msg} "
+                        f"(want APPEND_ACK)")
+                return json.loads(payload)
+            except TransportError:
+                raise
+            except OSError as e:
+                raise TransportError(
+                    f"endpoint {self.address} append failed: {e}") from e
+        finally:
+            sock.close()
+
+    def append_with_retry(self, source: str, batch_id: str, tbl: pa.Table,
+                          *, max_attempts: int = 5,
+                          backoff_cap_s: float = 10.0,
+                          on_retry=None) -> dict:
+        """APPEND under the same fleet rotation contract as
+        submit_with_retry — safe to retry blindly because APPEND is
+        idempotent by (source, batch_id): a replica that died AFTER
+        persisting but BEFORE acking turns the retry into a ``duplicate``
+        ack, never a double ingest. Retryable rejections (shed/drain)
+        honor their backoff hint; transport faults back off exponentially;
+        with a replica list every retryable failure rotates first."""
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                return self.append(source, batch_id, tbl)
+            except SCHED.QueryRejectedError as e:
+                if attempt >= max_attempts:
+                    raise
+                delay = min(max(0.05, e.backoff_hint_s), backoff_cap_s)
+            except TransportError as e:
+                if attempt >= max_attempts or not getattr(
+                        e, "retryable", False):
+                    raise
+                delay = min(0.1 * (2 ** (attempt - 1)), backoff_cap_s)
+            if len(self.addresses) > 1:
+                self.rotate()
+                delay *= 0.5 + random.random() * 0.5   # jittered rotation
+            if on_retry is not None:
+                on_retry(attempt, delay)
+            time.sleep(delay)
 
     def submit_with_retry(self, sql: str, *, max_attempts: int = 5,
                           backoff_cap_s: float = 10.0, on_retry=None,

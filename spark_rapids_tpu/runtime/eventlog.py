@@ -113,6 +113,14 @@ KNOWN_EVENTS = frozenset({
     # cross-replica failover timeline's unit (see JOURNEY_OUTCOMES) —
     # plus SLO breach detections and black-box flight-recorder dumps
     "query.journey", "slo.breach", "blackbox.dump",
+    # streaming plane (streaming/): one record per durable APPEND, one per
+    # journaled epoch.begin, and one per epoch.commit carrying the epoch's
+    # input rows, state rows/bytes, retired rows, watermark and state
+    # checksum — the bounded-state timeline tools/profiler.py streaming
+    # renders. Deliberately NOT query-scoped: the epoch's admitted query
+    # emits its own query.* records; these mark the protocol transitions
+    # around it
+    "stream.append", "stream.epoch.begin", "stream.epoch.commit",
 })
 
 # terminal outcome of one endpoint submission attempt (the query.journey

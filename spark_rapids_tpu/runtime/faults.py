@@ -82,6 +82,15 @@ read) and "endpoint.send" (per result frame) via :func:`maybe_inject_any`
 — any armed kind fires at the wire — and "endpoint.corrupt" is a
 :func:`maybe_corrupt` payload site (result batch after its CRC is stamped,
 so the client's verification must catch the flip).
+The streaming plane (streaming/) checks "streaming.ingest" (before an
+APPEND's first durable byte — a fault there must leave nothing the next
+listing can see), "streaming.epoch.commit" (top of the journal's commit
+write — ``exec_kill`` there dies with the epoch's work finished but
+unjournaled, the exactly-once replay window), and "streaming.state" (the
+state-snapshot writer) via :func:`maybe_inject_any`; "streaming.state" is
+also a :func:`maybe_corrupt` payload site (snapshot bytes after the
+checksum is taken, so recovery's verification must catch the flip and
+rebuild from the batch log).
 """
 
 from __future__ import annotations

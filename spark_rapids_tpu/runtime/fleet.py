@@ -360,3 +360,49 @@ class FleetDirectory:
                 EL.emit(event, **fields)
         except Exception:  # noqa: BLE001 — observability must not fail serving
             pass
+
+
+# -- shared catalog epoch -----------------------------------------------------
+# One monotonic counter file per fleet directory. A catalog-changing event on
+# ANY replica (a streaming APPEND landing through one endpoint) must
+# invalidate EVERY replica's result cache, including replicas that never saw
+# the append — the cache keys on the session's catalog epoch, and the
+# session folds this shared counter in (session.catalog_epoch) whenever
+# fleet.dir is configured. Same write discipline as the lease records:
+# read-modify-replace via a pid-unique intent under the advisory lock, so
+# two replicas bumping concurrently lose neither bump.
+
+_EPOCH_FILE = "catalog_epoch.json"
+
+
+def shared_catalog_epoch(directory: str) -> int:
+    """The fleet-wide catalog epoch; 0 for a fresh/unreadable counter (an
+    unreadable counter can cost a stale cache MISS path only after a bump
+    lands, and bumps rewrite the file whole)."""
+    try:
+        with open(os.path.join(directory, _EPOCH_FILE),
+                  "r", encoding="utf-8") as f:
+            return int(json.load(f).get("epoch", 0))
+    except (OSError, ValueError, TypeError):
+        return 0
+
+
+def bump_shared_catalog_epoch(directory: str) -> int:
+    """Atomically advance the fleet-wide catalog epoch; returns the new
+    value. Never raises — a bump that cannot land degrades to a warning
+    (serving keeps working; at worst a peer replica can serve one stale
+    cached frame until its own catalog changes)."""
+    path = os.path.join(directory, _EPOCH_FILE)
+    try:
+        os.makedirs(directory, exist_ok=True)
+        with advisory_lock(path + ".lock"):
+            epoch = shared_catalog_epoch(directory) + 1
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"epoch": epoch}, f)
+            os.replace(tmp, path)
+        return epoch
+    except OSError as e:
+        log.warning("shared catalog epoch bump failed under %s: %s",
+                    directory, e)
+        return 0

@@ -106,6 +106,13 @@ FLEET_ADOPTIONS = "fleetAdoptions"
 # fleet client (runtime/endpoint.py EndpointClient): a retryable failure
 # rotated the client to the next replica in its address list
 REPLICA_FAILOVERS = "replicaFailovers"
+# streaming epochs (streaming/coordinator.py): a pending (begun,
+# uncommitted) epoch re-run after a crash/kill, and a committed state
+# snapshot that failed its journal checksum and was rebuilt from the
+# consumed batch log. Both zero in every clean run — a no-faults stream
+# never replays and never rebuilds
+STREAM_EPOCH_REPLAYS = "streamEpochReplays"
+STREAM_STATE_REBUILDS = "streamStateRebuilds"
 
 RESILIENCE_METRICS = (NUM_OOM_RETRIES, NUM_OOM_SPLIT_RETRIES, OOM_SPILL_BYTES,
                       FETCH_RETRIES, FETCH_FAILOVERS, FETCH_RECOMPUTES,
@@ -115,7 +122,8 @@ RESILIENCE_METRICS = (NUM_OOM_RETRIES, NUM_OOM_SPLIT_RETRIES, OOM_SPILL_BYTES,
                       MESH_DEGRADED_FALLBACKS,
                       QUERIES_SHED, QUERIES_CANCELLED, QUERY_DEMOTIONS,
                       CLIENT_DISCONNECTS, MEMORY_LEAKS,
-                      FLEET_ADOPTIONS, REPLICA_FAILOVERS)
+                      FLEET_ADOPTIONS, REPLICA_FAILOVERS,
+                      STREAM_EPOCH_REPLAYS, STREAM_STATE_REBUILDS)
 
 
 class GpuMetric:

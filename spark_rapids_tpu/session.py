@@ -720,6 +720,11 @@ class TpuSession:
         self.conf = (conf if isinstance(conf, RapidsConf)
                      else RapidsConf(conf or {}))
         self._views: dict = {}   # temp-view catalog for session.sql()
+        # streaming sources (streaming/source.py): resolved to a FRESH
+        # DataFrame on every sql() call — a file-scan plan freezes its file
+        # list at construction, and a stream's whole point is that the
+        # list grows
+        self._stream_sources: dict = {}
         # bumped on every view (re)registration; the endpoint result cache
         # keys on it so results computed against a replaced catalog can
         # never be served again
@@ -965,12 +970,79 @@ class TpuSession:
 
     @property
     def catalog_epoch(self) -> int:
-        """Monotonic view-registration counter (the result-cache staleness
-        key)."""
-        return self._catalog_epoch
+        """Monotonic catalog-staleness counter (the result-cache key): the
+        local view-registration counter, plus — when this session belongs
+        to a fleet — the shared fleet-wide counter, so a streaming APPEND
+        processed by a PEER replica still invalidates this replica's
+        cached results (the peer bumps the shared counter; this property
+        folds it in on the next cache-key computation)."""
+        epoch = self._catalog_epoch
+        from spark_rapids_tpu import config as CFG
+        fleet_dir = self.conf.get(CFG.FLEET_DIR)
+        if fleet_dir:
+            from spark_rapids_tpu.runtime import fleet as FL
+            epoch += FL.shared_catalog_epoch(fleet_dir)
+        return epoch
+
+    # -- streaming ------------------------------------------------------------
+    def create_stream_source(self, name: str, directory: str, schema=None):
+        """Register a micro-batch streaming source (streaming/source.py):
+        a durable batch log fed by directory tail and/or endpoint APPEND
+        frames, queryable under `name` in session.sql() — re-resolved to a
+        fresh scan on every sql() call, so queries always see every batch
+        durable at plan time. `schema` (pyarrow) makes the empty source
+        queryable and gates appends; omitted, it is adopted from the first
+        batch."""
+        from spark_rapids_tpu.streaming.source import StreamingSource
+        src = StreamingSource(name, directory, schema=schema)
+        self._stream_sources[name] = src
+        self._catalog_epoch += 1
+        return src
+
+    def streaming_append(self, source: str, batch_id: str, table=None, *,
+                         ipc_body: bytes | None = None,
+                         crc: int | None = None) -> dict:
+        """Durably append one batch to a registered stream source —
+        idempotent by (source, batch_id). A FRESH append bumps the catalog
+        epoch (and the fleet-shared epoch when fleet.dir is set), so no
+        result cache in the fleet can serve a pre-append frame; a
+        duplicate bumps nothing. Returns the APPEND ack fields."""
+        src = self._stream_sources.get(source)
+        if src is None:
+            raise ValueError(f"unknown stream source {source!r} "
+                             f"(create_stream_source first)")
+        if ipc_body is not None:
+            table, fresh = src.append_ipc(batch_id, ipc_body,
+                                          int(crc or 0))
+        else:
+            fresh = src.append_table(batch_id, table)
+        if fresh:
+            self._catalog_epoch += 1
+            from spark_rapids_tpu import config as CFG
+            fleet_dir = self.conf.get(CFG.FLEET_DIR)
+            if fleet_dir:
+                from spark_rapids_tpu.runtime import fleet as FL
+                FL.bump_shared_catalog_epoch(fleet_dir)
+        return {"source": source, "batch": batch_id,
+                "duplicate": not fresh, "rows": table.num_rows,
+                "epoch": self.catalog_epoch}
+
+    def _refresh_stream_views(self) -> None:
+        """Re-resolve every stream source to a fresh DataFrame before SQL
+        lowering (no epoch bump — freshness is data arriving, staleness is
+        keyed by the APPEND-time bumps). A source that is still empty with
+        no declared schema is skipped; querying it stays an unknown-view
+        error until its first batch lands."""
+        for name, src in self._stream_sources.items():
+            try:
+                self._views[name] = src.dataframe(self)
+            except ValueError:
+                self._views.pop(name, None)
 
     def sql(self, text: str) -> DataFrame:
         """Run a SQL query over the registered temp views (the reference's
         entire surface is SQL text — qa_nightly_sql.py; see sql/)."""
         from spark_rapids_tpu.sql import lower_sql
+        if self._stream_sources:
+            self._refresh_stream_views()
         return DataFrame(lower_sql(text, self._views, self), self)

@@ -1,0 +1,24 @@
+"""Micro-batch streaming: exactly-once continuous ingestion.
+
+Three pieces (docs/streaming.md has the full contract):
+
+  - :class:`~spark_rapids_tpu.streaming.source.StreamingSource` — a durable
+    append-only batch log (directory tail + endpoint APPEND frames),
+    idempotent by (source, batch_id).
+  - :class:`~spark_rapids_tpu.streaming.journal.EpochJournal` — the
+    crash-consistent epoch.begin/epoch.commit journal exactly-once hangs
+    off.
+  - :class:`~spark_rapids_tpu.streaming.coordinator.EpochCoordinator` —
+    runs each micro-batch as a normal admitted query against incremental
+    aggregation state held as a spillable retained catalog buffer.
+"""
+
+from spark_rapids_tpu.streaming.coordinator import (EpochCoordinator,
+                                                    StreamStateCorruptError)
+from spark_rapids_tpu.streaming.journal import (EpochJournal,
+                                                JournalCorruptError,
+                                                validate_doc)
+from spark_rapids_tpu.streaming.source import StreamingSource
+
+__all__ = ["EpochCoordinator", "EpochJournal", "JournalCorruptError",
+           "StreamStateCorruptError", "StreamingSource", "validate_doc"]

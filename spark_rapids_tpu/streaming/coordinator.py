@@ -1,0 +1,410 @@
+"""Micro-batch epoch coordinator: incremental aggregation, exactly once.
+
+Each epoch is ONE normal admitted query — it enters the multi-tenant
+scheduler, rides the pipelined executor, and (because the plan shape is
+identical every epoch: a fixed-path delta scan unioned with a
+marker-normalized state scan) replays from the persistent compiled-stage
+cache, so a steady-state epoch retraces nothing::
+
+    delta  = scan(epoch's batch files) |> [window bucket] |> partial agg
+    state' = (state ∪ delta-partial) |> merge agg            # one query
+    state' sorted canonically, watermark-retired, checksummed, snapshotted
+    journal.commit(epoch, checksum)                          # the only truth
+
+The update→merge split reuses exec/aggregate.py's own partial/merge
+contract (AGG_MERGE_OPS): sums and counts merge by SUM, min/max by
+MIN/MAX — so the incremental state is exactly a parked partial-aggregation
+batch, and merging N epochs is associative no matter how batches were
+grouped into epochs.
+
+Crash consistency is the journal's (streaming/journal.py): work happens
+between ``epoch.begin`` and ``epoch.commit``; the state snapshot is written
+atomically BEFORE the commit and named by epoch, so the commit record's
+checksum always has a matching durable artifact and a stale partial from a
+killed attempt can never be adopted (the shuffle-epoch-bump fencing idiom).
+Replays are bit-identical because the begin record pins the exact batch
+ids, the delta scan is a single deterministic partition, and the state is
+canonically sorted before checksum/snapshot.
+
+Residency: the live state is a spillable, retained catalog buffer under
+allocation site ``streaming.state`` — query-tagged and visible to the
+memory plane's watermark timeline/heap snapshots, spillable under pressure,
+exempt from the end-of-query leak detector (it outlives queries BY DESIGN;
+per-epoch scratch is not exempt and stays leak-checked). Watermark
+retirement (``streaming.watermark.delaySeconds``) runs host-side on the
+collected state — never as a per-epoch literal in the engine plan, which
+would bake a new constant into the kernel every epoch and retrace.
+
+Mutual exclusion across processes: the whole begin→run→commit span holds
+an advisory flock on ``<stream>/coordinator.lock``. flock dies with its
+process (runtime/locks.py), so a SIGKILLed coordinator blocks nobody — a
+fleet survivor adopting the stream proceeds straight into replay.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+import pyarrow as pa
+import pyarrow.compute as pc
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.runtime.checksum import block_checksum
+from spark_rapids_tpu.runtime.locks import advisory_lock
+from spark_rapids_tpu.streaming import source as SRC
+from spark_rapids_tpu.streaming.journal import EpochJournal
+
+_STATE_PREFIX = "state-"
+
+# update-side builder and merge-side builder per supported aggregate op.
+# The merge column operates on the update output's NAME — e.g. sum(v) lands
+# as sum_v, and every later epoch merges sum(sum_v)
+_UPDATE = {"sum": F.sum, "count": F.count, "min": F.min, "max": F.max}
+
+
+class StreamStateCorruptError(RuntimeError):
+    """A committed state snapshot failed its journal checksum — detected,
+    never silently served; recovery rebuilds from the consumed batch log."""
+
+
+class EpochCoordinator:
+    """Drives one stream's windowed/keyed incremental aggregation.
+
+    `aggs` is a list of (op, column) with op in sum/count/min/max; the
+    state carries one column per agg named ``<op>_<column>``. With
+    `time_column` + `window_seconds`, a ``window`` bucket column (floor of
+    event time to the window width) joins the group keys and the watermark
+    retires buckets entirely below max(event time) - delay."""
+
+    def __init__(self, session, src: SRC.StreamingSource, *, keys: list,
+                 aggs: list, time_column: str | None = None,
+                 window_seconds: int = 0, state_dir: str | None = None):
+        from spark_rapids_tpu import config as CFG
+        from spark_rapids_tpu.exec.aggregate import AGG_MERGE_OPS
+        self.session = session
+        self.source = src
+        self.keys = list(keys)
+        self.aggs = [(op, c) for op, c in aggs]
+        for op, _ in self.aggs:
+            if op not in _UPDATE or op not in AGG_MERGE_OPS:
+                raise ValueError(f"unsupported streaming aggregate {op!r}")
+        self.time_column = time_column
+        self.window_seconds = int(window_seconds)
+        if bool(time_column) != bool(self.window_seconds):
+            raise ValueError("time_column and window_seconds go together")
+        self.state_dir = state_dir or os.path.join(src.directory, "_state")
+        os.makedirs(self.state_dir, exist_ok=True)
+        self.journal = EpochJournal(
+            self.state_dir, source=src.name,
+            max_commits=session.conf.get(CFG.STREAM_JOURNAL_HISTORY))
+        self.watermark_delay = session.conf.get(CFG.STREAM_WATERMARK_DELAY)
+        self.max_batches = session.conf.get(CFG.STREAM_MAX_BATCHES_PER_EPOCH)
+        self._owner_lock = os.path.join(self.state_dir, "coordinator.lock")
+        self._lock = threading.Lock()
+        self._state_buf = None        # SpillableColumnarBatch (retained)
+        self._state_schema = None     # pyarrow schema of the state table
+        self._watermark = None
+        self._loaded = False
+        self._last_compiles = None
+
+    # -- naming ---------------------------------------------------------------
+
+    @property
+    def group_cols(self) -> list:
+        cols = list(self.keys)
+        if self.window_seconds:
+            cols.append("window")
+        return cols
+
+    @property
+    def agg_cols(self) -> list:
+        return [f"{op}_{c}" for op, c in self.aggs]
+
+    def _snapshot_path(self, epoch: int) -> str:
+        return os.path.join(self.state_dir, f"{_STATE_PREFIX}{epoch}.arrow")
+
+    # -- state residency -------------------------------------------------------
+
+    def _canonical(self, tbl: pa.Table) -> pa.Table:
+        """Deterministic row order — the bit-identity anchor: group keys are
+        unique after the merge agg, so sorting by them totally orders the
+        table regardless of which attempt produced it."""
+        if tbl.num_rows <= 1:
+            return tbl
+        return tbl.sort_by([(k, "ascending") for k in self.group_cols])
+
+    def _set_state(self, tbl: pa.Table) -> None:
+        """Swap the retained catalog buffer to `tbl` (the cache.device
+        idiom, plan/cache.py): spillable, site-tagged streaming.state."""
+        from spark_rapids_tpu import types as T
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+        from spark_rapids_tpu.runtime import memory as mem
+        from spark_rapids_tpu.runtime import metrics as M
+        old, self._state_buf = self._state_buf, None
+        if old is not None:
+            old.close()
+        if tbl.num_rows:
+            with mem.alloc_site("streaming.state", retained=True):
+                self._state_buf = mem.SpillableColumnarBatch(
+                    ColumnarBatch.from_arrow(
+                        tbl, T.StructType.from_arrow(tbl.schema)))
+        self._state_schema = tbl.schema
+        self._loaded = True
+        M.set_gauge("streaming.state.rows", tbl.num_rows)
+        M.set_gauge("streaming.state.bytes", tbl.nbytes)
+
+    def state_table(self) -> pa.Table:
+        """The live state as a host table (unspills if demoted). Empty —
+        with the state schema once known — before the first commit."""
+        with self._lock:
+            if not self._loaded:
+                with advisory_lock(self._owner_lock):
+                    self._recover_locked()
+            if self._state_buf is None:
+                schema = self._state_schema or pa.schema([])
+                return schema.empty_table()
+            return self._state_buf.get_batch().to_arrow()
+
+    @property
+    def watermark(self):
+        return self._watermark
+
+    @property
+    def last_epoch_compiles(self):
+        """XLA compiles of the most recent epoch query on this session —
+        the steady-state ==0 gate's readout."""
+        return self._last_compiles
+
+    def close(self) -> None:
+        """Release the retained state buffer (the catalog is leak-checked
+        by tests even for exempt sites: retained means 'exempt while
+        live', not 'abandonable')."""
+        with self._lock:
+            buf, self._state_buf = self._state_buf, None
+            if buf is not None:
+                buf.close()
+            self._loaded = False
+
+    # -- snapshot I/O ----------------------------------------------------------
+
+    def _write_snapshot(self, epoch: int, tbl: pa.Table) -> int:
+        """Atomic epoch-stamped state snapshot; returns its checksum. The
+        ``streaming.state`` site arms both generic faults (exec_kill dies
+        with the snapshot possibly written but the commit not — recovery
+        fences the orphan by epoch) and payload corruption (the checksum
+        verification on load must catch the flip)."""
+        from spark_rapids_tpu.runtime import faults as FLT
+        FLT.maybe_inject_any("streaming.state")
+        body = SRC.table_to_ipc(tbl)
+        crc = block_checksum(body)
+        body = FLT.maybe_corrupt("streaming.state", body)
+        path = self._snapshot_path(epoch)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(body)
+        os.replace(tmp, path)
+        return crc
+
+    def _gc_snapshots(self, keep_epoch: int) -> None:
+        for name in os.listdir(self.state_dir):
+            if not name.startswith(_STATE_PREFIX):
+                continue
+            stem = name[len(_STATE_PREFIX):].split(".", 1)[0]
+            with contextlib.suppress(ValueError, OSError):
+                if int(stem) != keep_epoch:
+                    os.unlink(os.path.join(self.state_dir, name))
+
+    def _load_snapshot(self, epoch: int, want_checksum: int) -> pa.Table:
+        try:
+            with open(self._snapshot_path(epoch), "rb") as f:
+                body = f.read()
+        except OSError as e:
+            raise StreamStateCorruptError(
+                f"state snapshot for committed epoch {epoch} missing: "
+                f"{e}") from e
+        if block_checksum(body) != want_checksum:
+            raise StreamStateCorruptError(
+                f"state snapshot for committed epoch {epoch} fails its "
+                f"journal checksum")
+        return SRC.ipc_to_table(body)
+
+    # -- the epoch query -------------------------------------------------------
+
+    def _delta_frame(self, batch_ids: list):
+        """This epoch's input as ONE deterministic scan partition, window
+        bucket attached. The bucket is integer arithmetic on the event-time
+        column with the CONSTANT window width — no per-epoch literals, so
+        the traced kernel is identical every epoch."""
+        from spark_rapids_tpu.io.filescan import FileScanNode
+        from spark_rapids_tpu.session import DataFrame
+        paths = [self.source.batch_path(b) for b in batch_ids]
+        df = DataFrame(FileScanNode(paths, "parquet",
+                                    files_per_partition=len(paths)),
+                       self.session)
+        if self.window_seconds:
+            tc = F.col(self.time_column)
+            df = df.with_column(
+                "window", tc - (tc % F.lit(self.window_seconds)))
+        return df
+
+    def _epoch_result(self, batch_ids: list) -> pa.Table:
+        """Run the epoch's admitted query: partial agg over the delta,
+        merged with the parked state when one exists."""
+        update = [_UPDATE[op](F.col(c)).alias(n)
+                  for (op, c), n in zip(self.aggs, self.agg_cols)]
+        partial = self._delta_frame(batch_ids) \
+            .group_by(*self.group_cols).agg(*update)
+        state = None
+        if self._state_buf is not None:
+            state = self.session.create_dataframe(
+                self._state_buf.get_batch().to_arrow())
+        if state is not None:
+            merge = [self._merge_expr(op, n)
+                     for (op, _), n in zip(self.aggs, self.agg_cols)]
+            partial = state.union(partial) \
+                .group_by(*self.group_cols).agg(*merge)
+        out = partial.collect()
+        qm = self.session.last_query_metrics()
+        self._last_compiles = (qm.compile_metrics().get("compiles", 0)
+                               if qm is not None else None)
+        return out
+
+    def _merge_expr(self, op: str, name: str):
+        from spark_rapids_tpu.exec.aggregate import AGG_MERGE_OPS
+        return _UPDATE[AGG_MERGE_OPS[op]](F.col(name)).alias(name)
+
+    def _retire(self, tbl: pa.Table):
+        """Host-side watermark retirement; returns (kept, retired_rows,
+        watermark). The watermark only advances — late max(event time)
+        regressions can't resurrect a retired bucket."""
+        if (not self.window_seconds or self.watermark_delay < 0
+                or not tbl.num_rows):
+            return tbl, 0, self._watermark
+        high = pc.max(tbl["window"]).as_py()
+        wm = high - self.watermark_delay
+        if pa.types.is_integer(tbl.schema.field("window").type):
+            wm = int(wm // 1)
+        if self._watermark is not None:
+            wm = max(wm, self._watermark)
+        keep = pc.greater_equal(tbl["window"], pa.scalar(
+            wm, type=tbl.schema.field("window").type))
+        kept = tbl.filter(keep)
+        retired = tbl.num_rows - kept.num_rows
+        return kept, retired, wm
+
+    # -- protocol --------------------------------------------------------------
+
+    def _recover_locked(self) -> dict | None:
+        """Load committed state (rebuilding it from the consumed batch log
+        when the snapshot is corrupt/missing) and replay a pending epoch if
+        one exists. Returns the replayed commit record or None. Caller
+        holds self._lock; the cross-process owner flock must already be
+        held when this can write (run_epoch / recover)."""
+        from spark_rapids_tpu.runtime import metrics as M
+        doc = self.journal.snapshot()
+        committed = int(doc["committed_epoch"])
+        if not self._loaded:
+            if committed == 0:
+                self._loaded = True
+            else:
+                last = doc["commits"][-1] if doc["commits"] else None
+                want = int(last["state_checksum"]) if (
+                    last and int(last["epoch"]) == committed) else None
+                try:
+                    if want is None:
+                        raise StreamStateCorruptError(
+                            f"no commit record for epoch {committed} "
+                            f"(journal history truncated)")
+                    tbl = self._load_snapshot(committed, want)
+                except StreamStateCorruptError:
+                    M.resilience_add(M.STREAM_STATE_REBUILDS)
+                    tbl = self._rebuild_state(doc["consumed"])
+                self._set_state(tbl)
+            if doc["commits"]:
+                self._watermark = doc["commits"][-1].get("watermark")
+        pending = doc["begin"]
+        if not pending:
+            return None
+        # replay: the SAME batch ids against the committed state, under a
+        # bumped attempt (the stale-partial fence); counted as resilience —
+        # a no-faults stream never replays
+        M.resilience_add(M.STREAM_EPOCH_REPLAYS)
+        epoch = int(pending["epoch"])
+        attempt = self.journal.begin(
+            epoch, pending["batch_ids"],
+            prev_state_checksum=pending.get("prev_state_checksum", 0))
+        return self._run_epoch_locked(epoch, pending["batch_ids"], attempt)
+
+    def _rebuild_state(self, consumed: list) -> pa.Table:
+        """Deterministic full re-aggregation of every consumed batch — the
+        recovery of last resort behind a corrupt snapshot. Correct because
+        the batch log is append-only and commits are associative."""
+        if not consumed:
+            schema = self._state_schema
+            return schema.empty_table() if schema else \
+                pa.schema([]).empty_table()
+        saved, self._state_buf = self._state_buf, None
+        if saved is not None:
+            saved.close()
+        tbl = self._canonical(self._epoch_result(sorted(consumed)))
+        # re-apply the journal's watermark so a rebuild can't resurrect
+        # buckets the committed timeline already retired
+        if self.window_seconds and self._watermark is not None:
+            tbl = tbl.filter(pc.greater_equal(
+                tbl["window"], pa.scalar(
+                    self._watermark,
+                    type=tbl.schema.field("window").type)))
+        return tbl
+
+    def _run_epoch_locked(self, epoch: int, batch_ids: list,
+                          attempt: int) -> dict:
+        rows_in = sum(pq.read_metadata(self.source.batch_path(b)).num_rows
+                      for b in batch_ids)
+        out = self._canonical(self._epoch_result(batch_ids))
+        kept, retired, wm = self._retire(out)
+        crc = self._write_snapshot(epoch, kept)
+        rec = self.journal.commit(
+            epoch, state_checksum=crc, state_rows=kept.num_rows,
+            state_bytes=kept.nbytes, rows_in=rows_in,
+            retired_rows=retired, watermark=wm,
+            compiles=self._last_compiles)
+        # only after the commit is durable: adopt the state + gc the old
+        # snapshot (crash before this line replays epoch N+0 nothing — the
+        # commit already names this snapshot)
+        self._set_state(kept)
+        self._watermark = wm
+        self._gc_snapshots(epoch)
+        return rec
+
+    def recover(self) -> dict | None:
+        """Explicit recovery entry (restart / fleet adoption): load state,
+        replay any pending epoch. Returns the replayed commit or None."""
+        with self._lock, advisory_lock(self._owner_lock):
+            return self._recover_locked()
+
+    def run_epoch(self) -> dict | None:
+        """One micro-batch step: recover if needed, take the oldest
+        unconsumed batches (bounded by streaming.maxBatchesPerEpoch), run
+        the epoch, commit. Returns the commit record, or None when the
+        source has nothing new."""
+        with self._lock, advisory_lock(self._owner_lock):
+            replayed = self._recover_locked()
+            if replayed is not None:
+                return replayed
+            doc = self.journal.snapshot()
+            consumed = set(doc["consumed"])
+            pending = [b for b in self.source.list_batches()
+                       if b not in consumed]
+            if not pending:
+                return None
+            if self.max_batches > 0:
+                pending = pending[:self.max_batches]
+            epoch = int(doc["committed_epoch"]) + 1
+            last = doc["commits"][-1] if doc["commits"] else None
+            attempt = self.journal.begin(
+                epoch, pending,
+                prev_state_checksum=last["state_checksum"] if last else 0)
+            return self._run_epoch_locked(epoch, pending, attempt)

@@ -1,0 +1,274 @@
+"""Crash-consistent epoch journal — the exactly-once spine of a stream.
+
+One JSON document `epoch_journal.json` per stream directory records the
+micro-batch epoch protocol::
+
+    epoch.begin  {epoch, batch_ids, attempt, prev_state_checksum}
+    epoch.commit {epoch, state_checksum, state_rows, watermark, ...}
+
+The write discipline is the PlanHistoryStore idiom (runtime/history.py):
+read-modify-replace under a cross-process advisory lock (runtime/locks.py)
+with a pid-unique intent file landing via ``os.replace`` — a SIGKILL at any
+byte leaves either the old document or the new one, never a torn file, and
+a crashed writer's orphaned ``*.tmp.<pid>`` intent is recognizable to the
+fleet sweeper (runtime/fleet.py).
+
+Exactly-once falls out of three invariants the journal enforces:
+
+  - ``begin`` is written BEFORE the epoch's query runs, naming the exact
+    input batch ids; a crash between begin and commit leaves the begin
+    record pending, and recovery replays those ids — not whatever the
+    source directory lists now — against the last committed state, so the
+    replay is bit-identical with the run that died.
+  - Re-beginning a pending epoch bumps its ``attempt`` counter — the same
+    fencing idiom as the shuffle epoch bump (cluster/minicluster.py
+    MapOutputTracker): state snapshots are stamped with the epoch they
+    belong to, so a stale partial from a dead attempt can never be adopted
+    as committed state.
+  - ``commit`` folds the epoch's batch ids into the ``consumed`` set in
+    the SAME atomic replace that advances ``committed_epoch`` — a batch id
+    is consumed if and only if its epoch committed, which is what makes
+    APPEND idempotent by (source, batch_id) and committed epochs
+    impossible to reapply.
+
+The document is deliberately small: ``consumed``/``committed_epoch``/
+``begin`` are the protocol state and never truncated; the ``commits``
+history is an observability tail (profiler.py streaming) bounded by
+``streaming.journal.maxCommits``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+
+from spark_rapids_tpu.runtime.locks import advisory_lock
+
+log = logging.getLogger("spark_rapids_tpu.streaming")
+
+FILE = "epoch_journal.json"
+_VERSION = 1
+
+
+class JournalCorruptError(RuntimeError):
+    """The journal exists but cannot carry the exactly-once contract.
+
+    Unlike plan history, the journal is NOT an optimization: silently
+    degrading a corrupt journal to empty would re-consume every committed
+    batch. The stream refuses to run instead."""
+
+
+class EpochJournal:
+    """One stream's epoch journal. Thread-safe inside the process; the
+    advisory lock orders writers across replica processes sharing the
+    stream directory."""
+
+    def __init__(self, directory: str, *, source: str = "",
+                 max_commits: int = 256):
+        self.directory = directory
+        self.source = source
+        self.max_commits = max(int(max_commits), 1)
+        self.path = os.path.join(directory, FILE)
+        self._lock = threading.Lock()
+        os.makedirs(directory, exist_ok=True)
+
+    # -- file I/O -------------------------------------------------------------
+
+    def _empty(self) -> dict:
+        return {"version": _VERSION, "source": self.source,
+                "committed_epoch": 0, "consumed": [], "begin": None,
+                "commits": []}
+
+    def _load(self) -> dict:
+        """The document; a MISSING file is a fresh stream (empty doc), a
+        corrupt one raises — exactly-once state must never silently
+        degrade."""
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except FileNotFoundError:
+            return self._empty()
+        except (OSError, ValueError) as e:
+            raise JournalCorruptError(
+                f"epoch journal {self.path} unreadable: {e}") from e
+        errs = validate_doc(doc)
+        if errs:
+            raise JournalCorruptError(
+                f"epoch journal {self.path} violates its schema: {errs}")
+        return doc
+
+    def _store(self, doc: dict) -> None:
+        doc["commits"] = doc["commits"][-self.max_commits:]
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, separators=(",", ":"))
+        os.replace(tmp, self.path)
+
+    # -- reads ----------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock, advisory_lock(self.path + ".lock"):
+            return self._load()
+
+    def committed_epoch(self) -> int:
+        return int(self.snapshot()["committed_epoch"])
+
+    def pending(self) -> dict | None:
+        """The begin record of an epoch that began but never committed —
+        what recovery must replay — or None."""
+        begin = self.snapshot()["begin"]
+        return dict(begin) if begin else None
+
+    def is_consumed(self, batch_id: str) -> bool:
+        return batch_id in self.snapshot()["consumed"]
+
+    def last_commit(self) -> dict | None:
+        commits = self.snapshot()["commits"]
+        return dict(commits[-1]) if commits else None
+
+    # -- protocol writes -------------------------------------------------------
+
+    def begin(self, epoch: int, batch_ids: list, *,
+              prev_state_checksum: int = 0) -> int:
+        """Journal epoch.begin; returns the attempt number. Re-beginning the
+        SAME pending epoch (recovery replay) bumps the attempt — the
+        stale-partial fence; beginning any other epoch than committed+1, or
+        while a different epoch is pending, is a protocol bug and raises."""
+        with self._lock, advisory_lock(self.path + ".lock"):
+            doc = self._load()
+            committed = int(doc["committed_epoch"])
+            pending = doc["begin"]
+            if epoch != committed + 1:
+                raise ValueError(
+                    f"epoch.begin {epoch} out of order "
+                    f"(committed {committed})")
+            if pending and int(pending["epoch"]) != epoch:
+                raise ValueError(
+                    f"epoch.begin {epoch} while epoch "
+                    f"{pending['epoch']} is pending")
+            dup = set(batch_ids) & set(doc["consumed"])
+            if dup:
+                raise ValueError(
+                    f"epoch.begin {epoch} names already-consumed "
+                    f"batches {sorted(dup)}")
+            attempt = int(pending["attempt"]) + 1 if pending else 1
+            doc["begin"] = {"epoch": epoch,
+                            "batch_ids": list(batch_ids),
+                            "attempt": attempt,
+                            "prev_state_checksum": int(prev_state_checksum)}
+            self._store(doc)
+        from spark_rapids_tpu.runtime import eventlog as EL
+        EL.emit("stream.epoch.begin", query=None, source=self.source,
+                epoch=epoch, attempt=attempt, batches=len(batch_ids))
+        return attempt
+
+    def commit(self, epoch: int, *, state_checksum: int, state_rows: int,
+               state_bytes: int, rows_in: int = 0, retired_rows: int = 0,
+               watermark=None, compiles: int | None = None) -> dict:
+        """Journal epoch.commit: advance committed_epoch and fold the
+        pending begin's batch ids into ``consumed`` in ONE atomic replace.
+        The armed ``streaming.epoch.commit`` fault site fires BEFORE the
+        write — an exec_kill there dies with the epoch's work done but
+        unjournaled, the exact window recovery must close."""
+        from spark_rapids_tpu.runtime import eventlog as EL
+        from spark_rapids_tpu.runtime import faults as F
+        F.maybe_inject_any("streaming.epoch.commit")
+        with self._lock, advisory_lock(self.path + ".lock"):
+            doc = self._load()
+            pending = doc["begin"]
+            if not pending or int(pending["epoch"]) != epoch:
+                raise ValueError(
+                    f"epoch.commit {epoch} without a matching begin "
+                    f"(pending {pending and pending['epoch']})")
+            rec = {"epoch": epoch, "batch_ids": list(pending["batch_ids"]),
+                   "attempt": int(pending["attempt"]),
+                   "state_checksum": int(state_checksum),
+                   "state_rows": int(state_rows),
+                   "state_bytes": int(state_bytes),
+                   "rows_in": int(rows_in),
+                   "retired_rows": int(retired_rows),
+                   "watermark": watermark}
+            if compiles is not None:
+                rec["compiles"] = int(compiles)
+            doc["committed_epoch"] = epoch
+            doc["consumed"] = sorted(set(doc["consumed"]) |
+                                     set(pending["batch_ids"]))
+            doc["begin"] = None
+            doc["commits"].append(rec)
+            self._store(doc)
+        EL.emit("stream.epoch.commit", query=None, source=self.source,
+                epoch=epoch, attempt=rec["attempt"],
+                batches=len(rec["batch_ids"]), rows_in=rec["rows_in"],
+                state_rows=rec["state_rows"],
+                state_bytes=rec["state_bytes"],
+                retired_rows=rec["retired_rows"],
+                watermark=watermark, state_checksum=rec["state_checksum"])
+        return rec
+
+
+def validate_doc(doc: dict) -> list:
+    """Schema check of one journal document; returns violation strings
+    (empty = valid). Shared by the journal's own loads, tools/profiler.py
+    streaming and the tests, so the enforced schema cannot drift."""
+    errs = []
+    if not isinstance(doc, dict):
+        return ["journal document is not an object"]
+    if doc.get("version") != _VERSION:
+        errs.append(f"version {doc.get('version')!r} != {_VERSION}")
+    committed = doc.get("committed_epoch")
+    if not isinstance(committed, int) or committed < 0:
+        errs.append("committed_epoch missing or negative")
+        committed = 0
+    consumed = doc.get("consumed")
+    if (not isinstance(consumed, list)
+            or not all(isinstance(b, str) for b in consumed)):
+        errs.append("consumed is not a list of batch ids")
+        consumed = []
+    begin = doc.get("begin")
+    if begin is not None:
+        if not isinstance(begin, dict):
+            errs.append("begin is not an object")
+        else:
+            if begin.get("epoch") != committed + 1:
+                errs.append(
+                    f"pending begin epoch {begin.get('epoch')!r} is not "
+                    f"committed_epoch+1 ({committed + 1})")
+            if not isinstance(begin.get("attempt"), int) or \
+                    begin["attempt"] < 1:
+                errs.append("begin: missing positive integer 'attempt'")
+            ids = begin.get("batch_ids")
+            if not isinstance(ids, list) or not ids:
+                errs.append("begin: missing non-empty batch_ids")
+            elif set(ids) & set(consumed):
+                errs.append("begin names already-consumed batch ids")
+    commits = doc.get("commits")
+    if not isinstance(commits, list):
+        errs.append("commits is not a list")
+        commits = []
+    last = None
+    for rec in commits:
+        if not isinstance(rec, dict):
+            errs.append("commit record is not an object")
+            continue
+        ep = rec.get("epoch")
+        if not isinstance(ep, int) or ep < 1:
+            errs.append(f"commit epoch {ep!r} invalid")
+            continue
+        if last is not None and ep != last + 1:
+            errs.append(f"commit epochs not contiguous: {last} -> {ep}")
+        last = ep
+        for field in ("state_checksum", "state_rows", "state_bytes",
+                      "rows_in", "retired_rows", "attempt"):
+            if not isinstance(rec.get(field), int):
+                errs.append(f"commit {ep}: missing integer {field!r}")
+        ids = rec.get("batch_ids")
+        if not isinstance(ids, list) or not ids:
+            errs.append(f"commit {ep}: missing non-empty batch_ids")
+        elif not set(ids) <= set(consumed):
+            errs.append(f"commit {ep}: batch ids missing from consumed")
+    if commits and last != committed:
+        errs.append(
+            f"last commit {last} != committed_epoch {committed}")
+    return errs

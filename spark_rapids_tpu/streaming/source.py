@@ -1,0 +1,171 @@
+"""Micro-batch streaming source: a durable, append-only batch log.
+
+Both ingestion paths converge on one on-disk layout — parquet batch files
+named ``<batch_id>.parquet`` directly in the source directory:
+
+  - **directory tail**: an external producer drops parquet files in; the
+    source discovers them by fresh listing (never at plan construction —
+    a registered view must see rows appended after registration).
+  - **endpoint APPEND**: a client ships a CRC-stamped Arrow-IPC payload
+    (runtime/endpoint.py MSG_APPEND); the frame is CRC-verified, decoded,
+    and persisted HERE — durably, via a pid-unique intent file and
+    ``os.replace`` — before the ACK is sent. Durability-before-ACK is what
+    lets a fleet survivor adopting a dead replica's stream replay an
+    acknowledged batch the dead replica never committed.
+
+Idempotence by ``(source, batch_id)`` is structural: the batch id IS the
+file name, a second APPEND of an existing id (or of an id the journal
+already consumed) writes nothing and ACKs ``duplicate`` — which is what
+makes APPEND safe to retry blindly across fleet replicas.
+
+The atomic-replace discipline doubles as the partial-write fence: a client
+that dies mid-APPEND (or a replica SIGKILLed mid-write) leaves at most an
+orphaned ``*.tmp.<pid>`` intent the fleet sweeper reclaims — a fresh
+listing can never observe a torn batch.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import re
+import threading
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu.runtime.checksum import block_checksum
+from spark_rapids_tpu.shuffle.transport import TransportError
+
+_BATCH_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_.\-]{0,127}$")
+_SUFFIX = ".parquet"
+
+
+def table_to_ipc(tbl: pa.Table) -> bytes:
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, tbl.schema) as w:
+        w.write_table(tbl)
+    return sink.getvalue()
+
+
+def ipc_to_table(body: bytes) -> pa.Table:
+    return pa.ipc.open_stream(io.BytesIO(body)).read_all()
+
+
+class StreamingSource:
+    """One named stream over one batch-log directory.
+
+    `schema` (a pyarrow schema) makes the empty source queryable and gates
+    appends; when omitted it is adopted from the first batch seen."""
+
+    def __init__(self, name: str, directory: str,
+                 schema: pa.Schema | None = None):
+        if not _BATCH_ID_RE.match(name or ""):
+            raise ValueError(f"invalid stream source name {name!r}")
+        self.name = name
+        self.directory = directory
+        self.schema = schema
+        self._lock = threading.Lock()
+        os.makedirs(directory, exist_ok=True)
+
+    # -- batch log ------------------------------------------------------------
+
+    def list_batches(self) -> list:
+        """Sorted batch ids from a FRESH directory listing; write intents
+        and dotfiles never appear (atomic replace is the publish)."""
+        out = []
+        for name in os.listdir(self.directory):
+            if not name.endswith(_SUFFIX) or name.startswith((".", "_")):
+                continue
+            out.append(name[:-len(_SUFFIX)])
+        return sorted(out)
+
+    def batch_path(self, batch_id: str) -> str:
+        return os.path.join(self.directory, batch_id + _SUFFIX)
+
+    def has_batch(self, batch_id: str) -> bool:
+        return os.path.exists(self.batch_path(batch_id))
+
+    def _adopt_schema(self) -> pa.Schema | None:
+        if self.schema is None:
+            ids = self.list_batches()
+            if ids:
+                self.schema = pq.read_schema(self.batch_path(ids[0]))
+        return self.schema
+
+    # -- ingest ---------------------------------------------------------------
+
+    def append_table(self, batch_id: str, tbl: pa.Table) -> bool:
+        """Persist one batch durably; False when (source, batch_id) already
+        exists — the idempotent-duplicate path, which MUST stay cheap and
+        side-effect-free (a retried APPEND lands here)."""
+        from spark_rapids_tpu.runtime import eventlog as EL
+        from spark_rapids_tpu.runtime import faults as F
+        from spark_rapids_tpu.runtime import metrics as M
+        if not _BATCH_ID_RE.match(batch_id or ""):
+            raise ValueError(f"invalid batch id {batch_id!r}")
+        path = self.batch_path(batch_id)
+        with self._lock:
+            if os.path.exists(path):
+                return False
+            schema = self._adopt_schema()
+            if schema is not None and not tbl.schema.equals(
+                    schema, check_metadata=False):
+                raise ValueError(
+                    f"append to {self.name!r} with schema "
+                    f"{tbl.schema.names}/{[str(t) for t in tbl.schema.types]}"
+                    f" != source schema {schema.names}/"
+                    f"{[str(t) for t in schema.types]}")
+            # chaos: an armed streaming.ingest fault fires before any byte
+            # is durable — the client sees a typed error and retries; an
+            # exec_kill here leaves at most a reclaimable intent file
+            F.maybe_inject_any("streaming.ingest")
+            tmp = f"{path}.tmp.{os.getpid()}"
+            pq.write_table(tbl, tmp)
+            os.replace(tmp, path)
+            if self.schema is None:
+                self.schema = tbl.schema
+        EL.emit("stream.append", query=None, source=self.name,
+                batch=batch_id, rows=tbl.num_rows)
+        M.counter_add("streaming.appends")
+        return True
+
+    def append_ipc(self, batch_id: str, body: bytes, crc: int):
+        """Verify the wire CRC, decode, persist; returns (table, fresh)
+        where fresh=False is the idempotent-duplicate path. A CRC mismatch
+        is a retryable TransportError — the payload was damaged in flight,
+        the client's retry re-sends it intact — and is checked BEFORE the
+        duplicate shortcut, so a torn re-send never ACKs as a duplicate."""
+        got = block_checksum(body)
+        if got != crc:
+            raise TransportError(
+                f"APPEND payload checksum mismatch (sent {crc:#x}, got "
+                f"{got:#x}, {len(body)}B)")
+        tbl = ipc_to_table(body)
+        return tbl, self.append_table(batch_id, tbl)
+
+    # -- query surface --------------------------------------------------------
+
+    def dataframe(self, session):
+        """A FRESH DataFrame over the batch log — re-listed per call, so a
+        view resolved through it sees every batch durable at plan time
+        (io/filescan.py freezes file lists at construction; the session
+        re-resolves stream views on every sql())."""
+        from spark_rapids_tpu import types as T
+        from spark_rapids_tpu.io.filescan import FileScanNode
+        from spark_rapids_tpu.plan import nodes as NN
+        from spark_rapids_tpu.session import DataFrame
+        ids = self.list_batches()
+        if not ids:
+            schema = self._adopt_schema()
+            if schema is None:
+                raise ValueError(
+                    f"stream source {self.name!r} is empty and has no "
+                    f"declared schema; pass schema= or append first")
+            return DataFrame(NN.ScanNode([schema.empty_table()],
+                                         T.StructType.from_arrow(schema)),
+                             session)
+        paths = [self.batch_path(b) for b in ids]
+        return DataFrame(FileScanNode(paths, "parquet",
+                                      files_per_partition=len(paths)),
+                         session)

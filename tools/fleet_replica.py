@@ -51,6 +51,13 @@ def main(argv=None) -> int:
                         "accounted per served query (0 disables)")
     p.add_argument("--max-concurrent", type=int, default=4)
     p.add_argument("--result-cache", action="store_true")
+    p.add_argument("--stream-source", action="append", default=[],
+                   metavar="NAME:DIR",
+                   help="register a streaming source (streaming/source.py) "
+                        "over the shared batch-log DIR; repeatable. Clients "
+                        "APPEND through any replica and query through any "
+                        "other — the shared fleet catalog epoch keeps every "
+                        "replica's result cache honest")
     p.add_argument("--faults", default=None,
                    help="chaos fault spec armed in THIS replica "
                         "(runtime/faults.py), e.g. slow:agg.update:8")
@@ -104,6 +111,12 @@ def main(argv=None) -> int:
                                       type=pa.float64())})
         spark.create_or_replace_temp_view(
             "t", spark.create_dataframe(tbl, num_partitions=2))
+
+    for spec in args.stream_source:
+        name, _, sdir = spec.partition(":")
+        if not sdir:
+            p.error(f"--stream-source wants NAME:DIR, got {spec!r}")
+        spark.create_stream_source(name, sdir)
 
     if args.faults:
         from spark_rapids_tpu.runtime import faults

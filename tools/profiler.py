@@ -63,13 +63,22 @@ Usage:
   python tools/profiler.py movement <eventlog.jsonl> [more.jsonl ...]
   python tools/profiler.py journey <eventlog.jsonl> [more.jsonl ...]
   python tools/profiler.py fleet <fleet.dir> [--json]
+  python tools/profiler.py streaming <state.dir> [--eventlog LOG ...]
 
 Exit status is non-zero on schema violations, when no query in the log
 carries a non-empty operator breakdown (report), on malformed span files
 / an empty merged trace (trace), when the log carries no memory-plane
 events at all (memory), when no ``query.journey`` record exists in any
-log passed (journey), or when the fleet directory holds no membership
-record or tombstone (fleet) — CI uses these as gates.
+log passed (journey), when the fleet directory holds no membership
+record or tombstone (fleet), or when an epoch journal violates its own
+schema (streaming) — CI uses these as gates.
+
+``streaming`` reads a stream's state directory (streaming/journal.py):
+the epoch journal's commit timeline — per-epoch attempt, batch count,
+rows in, state rows/bytes, retired rows, watermark, compiles — validated
+against the journal's own schema validator, plus a pending-begin line
+when a crashed epoch awaits replay, plus stream.* event counts from any
+replica event logs passed with ``--eventlog``.
 """
 
 from __future__ import annotations
@@ -1534,6 +1543,96 @@ def fleet_main(args) -> int:
 
 
 # ---------------------------------------------------------------------------
+# streaming: the epoch journal + stream events
+# ---------------------------------------------------------------------------
+
+def analyze_streaming(state_dir: str, eventlogs=()) -> dict:
+    """One stream's epoch timeline: the journal document (schema-validated
+    by the journal's OWN validator, so the enforced schema cannot drift
+    from what this tool accepts) plus the stream.* event counts of any
+    replica event logs passed alongside."""
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    from spark_rapids_tpu.streaming import journal as J
+    path = os.path.join(state_dir, J.FILE)
+    out = {"journal": path, "violations": [], "log_violations": [],
+           "doc": None, "events": {}}
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except OSError as e:
+        out["violations"].append(f"journal unreadable: {e}")
+        return out
+    except ValueError as e:
+        out["violations"].append(f"journal is not JSON: {e}")
+        return out
+    out["doc"] = doc
+    out["violations"] = J.validate_doc(doc)
+    counts = {}
+    for lp in eventlogs:
+        recs, vio = load_log(lp)
+        out["log_violations"].extend(vio)
+        for rec in recs:
+            ev = rec.get("event", "")
+            if ev.startswith("stream."):
+                counts[ev] = counts.get(ev, 0) + 1
+    out["events"] = counts
+    return out
+
+
+def render_streaming(analysis: dict) -> str:
+    lines = [f"== epoch journal {analysis['journal']} =="]
+    doc = analysis.get("doc")
+    if doc:
+        lines.append(
+            f"source {doc.get('source') or '?'}  committed epoch "
+            f"{doc.get('committed_epoch')}  consumed batches "
+            f"{len(doc.get('consumed') or [])}")
+        pending = doc.get("begin")
+        if pending:
+            lines.append(
+                f"PENDING epoch {pending.get('epoch')} attempt "
+                f"{pending.get('attempt')} over "
+                f"{len(pending.get('batch_ids') or [])} batch(es) — "
+                f"a crashed run; the next coordinator replays it")
+        commits = doc.get("commits") or []
+        if commits:
+            lines.append(f"{'epoch':>6} {'att':>4} {'batches':>8} "
+                         f"{'rows_in':>8} {'state_rows':>10} "
+                         f"{'state_bytes':>11} {'retired':>8} "
+                         f"{'watermark':>10} {'compiles':>8}")
+            for rec in commits:
+                lines.append(
+                    f"{rec.get('epoch'):>6} {rec.get('attempt'):>4} "
+                    f"{len(rec.get('batch_ids') or []):>8} "
+                    f"{rec.get('rows_in'):>8} {rec.get('state_rows'):>10} "
+                    f"{rec.get('state_bytes'):>11} "
+                    f"{rec.get('retired_rows'):>8} "
+                    f"{str(rec.get('watermark')):>10} "
+                    f"{str(rec.get('compiles', '?')):>8}")
+    if analysis.get("events"):
+        lines.append("-- stream events --")
+        for ev in sorted(analysis["events"]):
+            lines.append(f"  {ev}: {analysis['events'][ev]}")
+    for v in analysis.get("violations", []):
+        lines.append(f"JOURNAL VIOLATION: {v}")
+    return "\n".join(lines)
+
+
+def streaming_main(args) -> int:
+    analysis = analyze_streaming(args.statedir, args.eventlog or ())
+    rc = 1 if (analysis["violations"] or analysis["log_violations"]) else 0
+    for v in analysis["log_violations"]:
+        print(f"SCHEMA VIOLATION: {v}", file=sys.stderr)
+    for v in analysis["violations"]:
+        print(f"JOURNAL VIOLATION: {v}", file=sys.stderr)
+    if args.json:
+        print(json.dumps(analysis, indent=2, default=str))
+    else:
+        print(render_streaming(analysis))
+    return rc
+
+
+# ---------------------------------------------------------------------------
 # CLI
 # ---------------------------------------------------------------------------
 
@@ -1609,6 +1708,19 @@ def main(argv=None) -> int:
                          "(spark.rapids.tpu.fleet.dir)")
     fl.add_argument("--json", action="store_true",
                     help="machine-readable analysis instead of text")
+    sm = sub.add_parser(
+        "streaming", help="continuous-ingestion plane: epoch journal "
+                          "timeline (commits, attempts, watermark, state "
+                          "size, compiles) validated against the journal "
+                          "schema, plus stream.* event counts")
+    sm.add_argument("statedir",
+                    help="stream state directory holding epoch_journal.json "
+                         "(the coordinator's state_dir, by default "
+                         "<stream>/_state)")
+    sm.add_argument("--eventlog", nargs="*", default=[],
+                    help="replica event logs to count stream.* events from")
+    sm.add_argument("--json", action="store_true",
+                    help="machine-readable analysis instead of text")
     args = p.parse_args(argv)
 
     if args.cmd == "trace":
@@ -1623,6 +1735,8 @@ def main(argv=None) -> int:
         return journey_main(args)
     if args.cmd == "fleet":
         return fleet_main(args)
+    if args.cmd == "streaming":
+        return streaming_main(args)
 
     records, violations = load_log(args.eventlog)
     analysis = analyze(records)

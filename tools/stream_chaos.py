@@ -1,0 +1,275 @@
+"""Streaming-chaos gate: a long micro-batch stream with a coordinator
+process killed mid-epoch, replayed exactly once, bit-identically.
+
+The continuous-ingestion contract (streaming/*), proven end to end:
+
+  - **Long clean stream**: 20+ single-batch epochs of windowed incremental
+    aggregation, every commit at attempt 1, every epoch's state matching
+    the journal's own running record.
+  - **Flat state**: watermark retirement (streaming.watermark.delaySeconds)
+    holds state rows/bytes constant once the window horizon fills — the
+    state of an infinite stream is bounded.
+  - **Steady state compiles NOTHING**: after the two plan shapes (first
+    epoch, union+merge) are traced, every further epoch commits with
+    ``compiles == 0`` — micro-batches ride the compiled-stage cache.
+  - **Kill mid-epoch, replay exactly once**: a REAL coordinator process is
+    SIGKILLed inside the commit window (exec_kill armed at the
+    ``streaming.epoch.commit`` fault site: epoch query run, state snapshot
+    written, journal NOT advanced). A fresh coordinator adopting the
+    stream replays the pending epoch under a bumped attempt and lands
+    bit-identically — same state table, same state checksum — as an
+    unkilled oracle that ingested the same batches, and the replay is the
+    ONLY resilience event of the whole run.
+  - **Associativity cross-check**: the oracle consumes ALL batches in one
+    giant epoch; equality with the 21-epoch incremental state proves the
+    partial/merge algebra (exec/aggregate.py AGG_MERGE_OPS) is grouping-
+    independent.
+  - **Journal schema**: ``profiler.py streaming`` validates the journal
+    against the journal's own schema validator and renders the epoch
+    timeline (exit 0); a deliberately corrupted copy must FAIL it
+    (exit != 0) — the gate provably bites.
+
+Usage:
+  python tools/stream_chaos.py --work-dir DIR [--epochs 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+
+_KILL_CHILD = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import spark_rapids_tpu  # noqa: F401
+from spark_rapids_tpu.runtime import faults
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.streaming import EpochCoordinator, StreamingSource
+
+spark = TpuSession({"spark.rapids.tpu.streaming.maxBatchesPerEpoch": 1,
+                    "spark.rapids.tpu.streaming.watermark.delaySeconds": 20})
+src = StreamingSource("clicks", sys.argv[1])
+coord = EpochCoordinator(spark, src, keys=["k"],
+                         aggs=[("sum", "v"), ("count", "v"), ("max", "v")],
+                         time_column="ts", window_seconds=10)
+print("ADOPTED", coord.journal.committed_epoch(), flush=True)
+faults.configure("exec_kill:streaming.epoch.commit:1", seed=1)
+coord.run_epoch()
+print("SURVIVED", flush=True)     # must never be reached
+"""
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="stream_chaos.py", description=__doc__)
+    p.add_argument("--work-dir", required=True,
+                   help="scratch root: stream/oracle/eventlog subdirs are "
+                        "created inside")
+    p.add_argument("--epochs", type=int, default=20,
+                   help="clean epochs before the kill (>= 20 for the gate)")
+    args = p.parse_args(argv)
+
+    root = pathlib.Path(args.work_dir)
+    dirs = {name: root / name for name in ("stream", "oracle", "eventlog")}
+    for d in dirs.values():
+        d.mkdir(parents=True, exist_ok=True)
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    sys.path.insert(0, str(repo))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import pyarrow as pa
+    import spark_rapids_tpu  # noqa: F401  (enables x64)
+    from spark_rapids_tpu.runtime import eventlog
+    from spark_rapids_tpu.runtime import metrics as M
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.streaming import (EpochCoordinator, EpochJournal,
+                                            StreamingSource)
+
+    failures = []
+
+    def check(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    def batch(i, rows=8):
+        base = i * 10
+        return pa.table({
+            "k": pa.array([j % 2 for j in range(rows)], type=pa.int64()),
+            "v": pa.array([float(base + j) for j in range(rows)],
+                          type=pa.float64()),
+            "ts": pa.array([base + j for j in range(rows)],
+                           type=pa.int64())})
+
+    def coordinator(spark, src):
+        return EpochCoordinator(
+            spark, src, keys=["k"],
+            aggs=[("sum", "v"), ("count", "v"), ("max", "v")],
+            time_column="ts", window_seconds=10)
+
+    report = {}
+    res_before = M.resilience_snapshot()
+    spark = TpuSession({
+        "spark.rapids.tpu.streaming.maxBatchesPerEpoch": 1,
+        "spark.rapids.tpu.streaming.watermark.delaySeconds": 20,
+        "spark.rapids.tpu.eventLog.dir": str(dirs["eventlog"])})
+    src = StreamingSource("clicks", str(dirs["stream"]))
+
+    # -- phase 1: a long clean stream ----------------------------------------
+    coord = coordinator(spark, src)
+    commits = []
+    for i in range(args.epochs):
+        src.append_table(f"b-{i:04d}", batch(i))
+        rec = coord.run_epoch()
+        check(rec is not None and rec["epoch"] == i + 1,
+              f"epoch {i + 1} did not commit: {rec}")
+        if rec:
+            commits.append(rec)
+    check(len(commits) >= 20, f"only {len(commits)} epochs committed")
+    check(all(r["attempt"] == 1 for r in commits),
+          "a clean epoch committed above attempt 1")
+    check(all(r["rows_in"] == 8 for r in commits),
+          "an epoch ingested the wrong row count")
+    # flat state: once the watermark horizon fills (3 live 10s windows at
+    # delay 20), rows and bytes never grow again
+    tail = commits[4:]
+    check(all(r["state_rows"] == tail[0]["state_rows"] for r in tail),
+          f"state rows not flat: {[r['state_rows'] for r in commits]}")
+    check(all(r["state_bytes"] == tail[0]["state_bytes"] for r in tail),
+          f"state bytes not flat: {[r['state_bytes'] for r in commits]}")
+    check(all(r["retired_rows"] > 0 for r in tail),
+          "steady-state epochs retired nothing despite the watermark")
+    # steady state retraces nothing: the tail of the stream compiles ZERO
+    # (early epochs trace the two plan shapes; a mid-stream one-off can
+    # still land when a growing encoded batch crosses a capacity bucket)
+    steady = commits[-10:]
+    check(all(r.get("compiles") == 0 for r in steady),
+          f"steady-state epochs compiled: "
+          f"{[(r['epoch'], r.get('compiles')) for r in commits]}")
+    total_compiles = sum(r.get("compiles") or 0 for r in commits)
+    check(total_compiles <= 10,
+          f"the stream compiled {total_compiles} times over "
+          f"{len(commits)} epochs — the stage cache is not carrying it")
+    report["epochs"] = len(commits)
+    report["steady_state_rows"] = tail[0]["state_rows"]
+    report["steady_state_bytes"] = tail[0]["state_bytes"]
+    report["compiles_by_epoch"] = [r.get("compiles") for r in commits]
+    check(M.resilience_snapshot() == res_before,
+          "the clean stream tripped a resilience counter")
+    coord.close()
+
+    # -- phase 2: kill a real coordinator process mid-epoch ------------------
+    kill_epoch = args.epochs + 1
+    src.append_table(f"b-{args.epochs:04d}", batch(args.epochs))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = str(repo) + os.pathsep + env.get("PYTHONPATH", "")
+    child = subprocess.Popen(
+        [sys.executable, "-c", _KILL_CHILD, str(dirs["stream"])],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+    out, _ = child.communicate(timeout=600)
+    check(f"ADOPTED {args.epochs}" in out,
+          f"child never adopted the committed stream: {out[-500:]}")
+    check("SURVIVED" not in out, "the armed exec_kill never fired")
+    check(child.returncode == -signal.SIGKILL,
+          f"child exited {child.returncode}, want SIGKILL")
+    journal = EpochJournal(str(dirs["stream"] / "_state"), source="clicks")
+    pending = journal.pending()
+    check(pending is not None and pending["epoch"] == kill_epoch,
+          f"no pending begin for epoch {kill_epoch} after the kill: "
+          f"{pending}")
+    report["killed_pid"] = child.pid
+
+    # -- phase 3: recovery replays the pending epoch exactly once ------------
+    recovered = coordinator(spark, src)
+    rec = recovered.run_epoch()      # recovers, then replays the pending epoch
+    check(rec is not None and rec["epoch"] == kill_epoch
+          and rec["attempt"] == 2,
+          f"recovery did not replay epoch {kill_epoch} at attempt 2: {rec}")
+    check(recovered.run_epoch() is None,
+          "a second run after recovery found phantom work")
+    state = recovered.state_table()
+    recovered.close()
+    snap = M.resilience_snapshot()
+    check(snap["streamEpochReplays"] == res_before["streamEpochReplays"] + 1,
+          f"expected exactly one epoch replay, got "
+          f"{snap['streamEpochReplays'] - res_before['streamEpochReplays']}")
+    check(snap["streamStateRebuilds"] == res_before["streamStateRebuilds"],
+          "recovery rebuilt state instead of loading the committed snapshot")
+
+    # -- phase 4: the journal passes its schema gate (and a corrupt one
+    #    fails it). Runs BEFORE the oracle phase: the event log is
+    #    process-global, and the oracle's epoch must not pollute the
+    #    stream's event counts ------------------------------------------------
+    eventlog.shutdown()
+    logs = sorted(str(f) for f in dirs["eventlog"].glob("*.jsonl"))
+    pr = subprocess.run(
+        [sys.executable, str(repo / "tools" / "profiler.py"), "streaming",
+         str(dirs["stream"] / "_state"), "--eventlog", *logs, "--json"],
+        capture_output=True, text=True, env=env)
+    check(pr.returncode == 0,
+          f"profiler streaming exited {pr.returncode}: {pr.stderr[:500]}")
+    if pr.returncode == 0:
+        pa_doc = json.loads(pr.stdout)
+        doc = pa_doc["doc"]
+        check(doc["committed_epoch"] == kill_epoch,
+              f"journal committed {doc['committed_epoch']}, want "
+              f"{kill_epoch}")
+        check(len(doc["consumed"]) == kill_epoch,
+              "consumed set does not cover every batch")
+        ev = pa_doc["events"]
+        check(ev.get("stream.epoch.commit") == kill_epoch,
+              f"event log saw {ev.get('stream.epoch.commit')} commits")
+        # 20 clean begins + the replay's begin; the killed attempt's begin
+        # lives in the journal (attempt fencing), not this process's log
+        check(ev.get("stream.epoch.begin") == kill_epoch,
+              f"event log saw {ev.get('stream.epoch.begin')} begins")
+    bad_dir = root / "corrupt"
+    bad_dir.mkdir(exist_ok=True)
+    good = (dirs["stream"] / "_state" / "epoch_journal.json").read_text()
+    bad = json.loads(good)
+    bad["committed_epoch"] += 1      # last commit no longer matches
+    (bad_dir / "epoch_journal.json").write_text(json.dumps(bad))
+    pr = subprocess.run(
+        [sys.executable, str(repo / "tools" / "profiler.py"), "streaming",
+         str(bad_dir)],
+        capture_output=True, text=True, env=env)
+    check(pr.returncode != 0, "profiler accepted a corrupted journal")
+
+    # -- phase 5: bit-identity with the unkilled oracle ----------------------
+    # the oracle ingests the SAME batches in ONE giant epoch: equality also
+    # proves the partial/merge algebra is grouping-independent
+    osrc = StreamingSource("clicks", str(dirs["oracle"]))
+    for i in range(kill_epoch):
+        osrc.append_table(f"b-{i:04d}", batch(i))
+    ospark = TpuSession({
+        "spark.rapids.tpu.streaming.watermark.delaySeconds": 20,
+        "spark.rapids.tpu.streaming.maxBatchesPerEpoch": 0})
+    oracle = coordinator(ospark, osrc)
+    orec = oracle.run_epoch()
+    ostate = oracle.state_table()
+    oracle.close()
+    check(state.equals(ostate),
+          f"replayed state diverged from the oracle: "
+          f"{state.num_rows} vs {ostate.num_rows} rows")
+    check(rec["state_checksum"] == orec["state_checksum"],
+          f"state checksum diverged: {rec['state_checksum']:#x} vs "
+          f"{orec['state_checksum']:#x}")
+    report["final_state_rows"] = state.num_rows
+    report["final_watermark"] = rec["watermark"]
+
+    report["failures"] = failures
+    print(json.dumps(report, default=str))
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

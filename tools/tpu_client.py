@@ -32,6 +32,18 @@ just the first reachable one.
 per-replica sections plus the fleet-aggregate counter families, where
 every aggregate counter equals the sum of the per-replica values.
 
+``append`` ships one streaming-source batch (a parquet file, read locally)
+as a CRC-stamped Arrow-IPC APPEND frame::
+
+  python tools/tpu_client.py --port 8765 append --source clicks \
+      --batch b-0042 --file clicks.parquet
+
+The ack is a durability receipt (the server persisted the batch before
+replying). Retries ride the same fleet rotation as SQL submissions and are
+always safe: APPEND is idempotent by (source, batch id) — a replica that
+died after persisting but before acking turns the retry into a
+``duplicate`` ack.
+
 Exit codes: 0 ok, 2 rejected/unreachable after all retries, 3 query error.
 For stats/fleet-stats, 2 means NO replica was reachable — partial fleets
 still report with the dead replicas marked UNREACHABLE.
@@ -46,10 +58,12 @@ import sys
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tpu_client.py", description=__doc__)
-    p.add_argument("command", nargs="?", choices=["stats", "fleet-stats"],
+    p.add_argument("command", nargs="?",
+                   choices=["stats", "fleet-stats", "append"],
                    help="'stats' fetches every replica's live "
                         "serving-metrics snapshot; 'fleet-stats' merges "
-                        "them with fleet-aggregate counter families")
+                        "them with fleet-aggregate counter families; "
+                        "'append' ships one streaming-source batch")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int)
     p.add_argument("--addresses", default=None,
@@ -77,18 +91,27 @@ def main(argv=None) -> int:
                    help="socket timeout seconds (per frame gap)")
     p.add_argument("--quiet", action="store_true",
                    help="print only the summary line, not the rows")
+    p.add_argument("--source", help="append: target stream source name")
+    p.add_argument("--batch", help="append: batch id (the idempotence key; "
+                                   "re-sending the same id is always safe)")
+    p.add_argument("--file", help="append: local parquet file to ship")
     args = p.parse_args(argv)
 
     if not args.addresses and args.port is None:
         p.error("one of --port / --addresses is required")
     stats_mode = args.stats or args.command == "stats"
     fleet_stats_mode = args.command == "fleet-stats"
+    append_mode = args.command == "append"
+    if append_mode and not (args.source and args.batch and args.file):
+        p.error("append requires --source, --batch and --file")
     sql = args.sql
     if sql is None and args.sql_file:
         sql = (sys.stdin.read() if args.sql_file == "-"
                else pathlib.Path(args.sql_file).read_text())
-    if not sql and not stats_mode and not fleet_stats_mode:
-        p.error("one of --sql / --sql-file / stats / fleet-stats is required")
+    if not sql and not stats_mode and not fleet_stats_mode and \
+            not append_mode:
+        p.error("one of --sql / --sql-file / stats / fleet-stats / append "
+                "is required")
 
     sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
     from spark_rapids_tpu.runtime.endpoint import (EndpointClient,
@@ -129,6 +152,25 @@ def main(argv=None) -> int:
             if len(cli.addresses) > 1 else ""
         print(f"retry {attempt}/{args.retries} in {delay:.2f}s "
               f"(server backoff hint honored){target}", file=sys.stderr)
+
+    if append_mode:
+        import pyarrow.parquet as pq
+        try:
+            ack = cli.append_with_retry(
+                args.source, args.batch, pq.read_table(args.file),
+                max_attempts=max(1, args.retries), on_retry=on_retry)
+        except (QueryRejectedError, TransportError) as e:
+            print(f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 2
+        except Exception as e:  # noqa: BLE001 — server-marshalled typed error
+            print(f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 3
+        dup = " duplicate" if ack.get("duplicate") else ""
+        print(f"OK append source={ack.get('source')} "
+              f"batch={ack.get('batch')} rows={ack.get('rows')} "
+              f"epoch={ack.get('epoch')} replica={ack.get('replica')}{dup}",
+              file=sys.stderr)
+        return 0
 
     try:
         table = cli.submit_with_retry(
